@@ -1,0 +1,149 @@
+"""Headline benchmark.
+
+Measures flagship-transformer training throughput through the full framework
+path (JaxTrainer -> worker actor -> collective-plane mesh -> jitted train
+step) against a pure-JAX loop in the same process. vs_baseline is the
+framework/pure ratio — the BASELINE.md target is >= 0.90 (framework overhead
+<= 10%); >1.0 is noise-level win.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+On a TPU host the worker claims the chip (the driver process never imports
+jax — by design, see _private/node.py); on CPU it runs a scaled-down config.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def train_loop(config):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.air import session
+    from ray_tpu.models.transformer import TransformerConfig, init_params, make_train_step
+
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    if on_tpu:
+        cfg = TransformerConfig(
+            vocab_size=32000,
+            d_model=1024,
+            n_layers=8,
+            n_heads=16,
+            n_kv_heads=16,
+            d_ff=2816,
+            max_seq_len=1024,
+            dtype=jnp.bfloat16,
+            remat=False,
+        )
+        batch, seq, steps = 8, 1024, 20
+    else:
+        cfg = TransformerConfig(
+            vocab_size=1024,
+            d_model=128,
+            n_layers=2,
+            n_heads=4,
+            n_kv_heads=4,
+            d_ff=256,
+            max_seq_len=128,
+            dtype=jnp.float32,
+            remat=False,
+        )
+        batch, seq, steps = 4, 128, 10
+
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    opt = optax.adamw(1e-3)
+    opt_state = opt.init(params)
+    step = jax.jit(make_train_step(cfg, opt), donate_argnums=(0, 1))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size)
+    batch_arr = {"tokens": tokens}
+
+    # Warmup/compile.
+    for _ in range(2):
+        params, opt_state, loss = step(params, opt_state, batch_arr)
+    jax.block_until_ready(loss)
+
+    # Pure-JAX baseline: tight loop, no framework interaction.
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, batch_arr)
+    jax.block_until_ready(loss)
+    raw_s = time.perf_counter() - t0
+
+    # Framework path: same loop but reporting through the air session each
+    # step (what a real JaxTrainer loop does).
+    t0 = time.perf_counter()
+    for i in range(steps):
+        params, opt_state, loss = step(params, opt_state, batch_arr)
+        session.report({"step": i, "loss": float(loss)})
+    jax.block_until_ready(loss)
+    fw_s = time.perf_counter() - t0
+
+    tok = batch * seq * steps
+    session.report(
+        {
+            "final": True,
+            "tokens_per_sec_framework": tok / fw_s,
+            "tokens_per_sec_raw": tok / raw_s,
+            "ratio": raw_s / fw_s if fw_s > 0 else 0.0,
+            "backend": jax.default_backend(),
+        }
+    )
+
+
+def main():
+    os.environ.setdefault("RAY_TPU_NUM_TPUS", os.environ.get("BENCH_NUM_TPUS", ""))
+    import ray_tpu
+    from ray_tpu.air.config import RunConfig, ScalingConfig
+    from ray_tpu.train.jax import JaxTrainer
+
+    explicit = os.environ.get("RAY_TPU_NUM_TPUS")
+    if explicit not in (None, ""):
+        n_tpus = int(explicit)
+    else:
+        n_tpus = 0
+        try:
+            from ray_tpu._private.node import detect_tpu_chips
+
+            n_tpus = detect_tpu_chips()
+        except Exception:
+            pass
+        # Under the axon tunnel there is one chip but no /dev/accel*; assume
+        # TPU when the axon plugin env is present.
+        if n_tpus == 0 and os.environ.get("PALLAS_AXON_POOL_IPS"):
+            n_tpus = 1
+            os.environ["RAY_TPU_NUM_TPUS"] = "1"
+
+    ray_tpu.init(num_cpus=4, num_tpus=n_tpus or None)
+    use_tpu = n_tpus > 0
+    trainer = JaxTrainer(
+        train_loop,
+        scaling_config=ScalingConfig(
+            num_workers=1, use_tpu=use_tpu, tpu_per_worker=1 if use_tpu else 0
+        ),
+        run_config=RunConfig(storage_path="/tmp/rtpu_bench"),
+    )
+    result = trainer.fit()
+    m = result.metrics
+    ray_tpu.shutdown()
+    backend = m.get("backend", "cpu")
+    suffix = "_tpu" if backend in ("tpu", "axon") else "_cpu"
+    print(
+        json.dumps(
+            {
+                "metric": "flagship_transformer_train_tokens_per_sec" + suffix,
+                "value": round(m["tokens_per_sec_framework"], 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(m["ratio"], 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,232 @@
+"""ray_tpu — a TPU-native distributed runtime + ML toolkit.
+
+A brand-new framework with the capability set of the reference (Ray: core
+task/actor/object runtime plus Train/Tune/Data/Serve/RLlib-class libraries),
+designed around JAX/XLA/pjit/Pallas: TPU chips and ICI slices are first-class
+schedulable resources, and the accelerator collective plane is gang-scheduled
+actor groups materialising a ``jax.sharding.Mesh`` (XLA collectives over ICI)
+instead of NCCL process groups.
+
+Public API analog of python/ray/_private/worker.py:1106 (init), :2409 (get),
+:2524 (put), :2587 (wait), :2919 (remote).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ray_tpu import exceptions  # noqa: F401
+from ray_tpu.actor import ActorClass, ActorHandle, method  # noqa: F401
+from ray_tpu.object_ref import ObjectRef  # noqa: F401
+from ray_tpu.remote_function import RemoteFunction
+
+__version__ = "0.1.0"
+
+_init_lock = threading.Lock()
+_global_node = None
+
+
+def init(
+    address=None,
+    *,
+    num_cpus: int | None = None,
+    num_tpus: int | None = None,
+    resources: dict | None = None,
+    object_store_memory: int | None = None,
+    namespace: str = "",
+    labels: dict | None = None,
+    ignore_reinit_error: bool = False,
+    _system_config: dict | None = None,
+):
+    """Start (or connect to) a cluster and attach this process as a driver."""
+    global _global_node
+    from ray_tpu._private import worker_context
+    from ray_tpu._private.core_worker import DRIVER, CoreWorker
+    from ray_tpu._private.node import Node
+
+    with _init_lock:
+        if worker_context.get_core_worker_if_initialized() is not None:
+            if ignore_reinit_error:
+                return worker_context.get_core_worker()
+            raise RuntimeError("ray_tpu.init() called twice; pass ignore_reinit_error=True")
+
+        if address is None:
+            node = Node(
+                head=True,
+                num_cpus=num_cpus,
+                num_tpus=num_tpus,
+                resources=resources,
+                object_store_memory=object_store_memory,
+                labels=labels,
+                _system_config=_system_config,
+            )
+            _global_node = node
+            gcs_address = node.gcs_address
+            raylet_address = node.raylet.address
+            arena_name = node.raylet.arena_name
+            node_id = node.raylet.node_id
+            session_dir = node.session_dir
+        else:
+            # Connect to an existing cluster: find a raylet (prefer local host).
+            from ray_tpu._private.rpc import RpcClient
+
+            gcs_address = tuple(address) if not isinstance(address, str) else _parse_addr(address)
+            gcs = RpcClient(gcs_address, label="gcs")
+            nodes_resp = gcs.call("get_nodes")
+            alive = [n for n in nodes_resp["nodes"].values() if n["state"] == "ALIVE"]
+            if not alive:
+                gcs.close()
+                raise RuntimeError("no alive nodes in cluster")
+            target = alive[0]
+            raylet_address = tuple(target["address"])
+            arena_name = target["arena_name"]
+            node_id = target["node_id"]
+            session_dir = "/tmp/ray_tpu/driver"
+            gcs.close()
+
+        cw = CoreWorker(
+            mode=DRIVER,
+            gcs_address=gcs_address,
+            raylet_address=raylet_address,
+            arena_name=arena_name,
+            node_id=node_id,
+            session_dir=session_dir,
+            namespace=namespace,
+        )
+        worker_context.set_core_worker(cw)
+        return cw
+
+
+def _parse_addr(address: str) -> tuple:
+    host, port = address.rsplit(":", 1)
+    return (host, int(port))
+
+
+def shutdown():
+    global _global_node
+    from ray_tpu._private import worker_context
+
+    with _init_lock:
+        cw = worker_context.get_core_worker_if_initialized()
+        if cw is not None:
+            cw.shutdown()
+            worker_context.set_core_worker(None)
+        if _global_node is not None:
+            _global_node.stop()
+            _global_node = None
+
+
+def is_initialized() -> bool:
+    from ray_tpu._private import worker_context
+
+    return worker_context.get_core_worker_if_initialized() is not None
+
+
+def remote(*args, **kwargs):
+    """``@ray_tpu.remote`` decorator for functions and classes."""
+
+    def make(obj):
+        if isinstance(obj, type):
+            return ActorClass(obj, **kwargs)
+        return RemoteFunction(obj, **kwargs)
+
+    if len(args) == 1 and callable(args[0]) and not kwargs:
+        return make(args[0])
+    if args:
+        raise TypeError("@remote takes keyword options only, e.g. @remote(num_cpus=2)")
+    return make
+
+
+def get(refs, *, timeout: float | None = None):
+    from ray_tpu._private import worker_context
+
+    return worker_context.get_core_worker().get(refs, timeout=timeout)
+
+
+def put(value) -> ObjectRef:
+    from ray_tpu._private import worker_context
+
+    return worker_context.get_core_worker().put(value)
+
+
+def wait(refs, *, num_returns: int = 1, timeout: float | None = None, fetch_local: bool = True):
+    from ray_tpu._private import worker_context
+
+    return worker_context.get_core_worker().wait(
+        refs, num_returns=num_returns, timeout=timeout, fetch_local=fetch_local
+    )
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    from ray_tpu._private import worker_context
+
+    cw = worker_context.get_core_worker()
+    cw.gcs.call("kill_actor", {"actor_id": actor.actor_id, "no_restart": no_restart})
+
+
+def get_actor(name: str, namespace: str = "") -> ActorHandle:
+    from ray_tpu._private import worker_context
+
+    cw = worker_context.get_core_worker()
+    resp = cw.gcs.call("get_actor", {"name": name, "namespace": namespace or cw.namespace})
+    if not resp.get("found"):
+        raise ValueError(f"no actor named {name!r}")
+    return ActorHandle(resp["info"]["actor_id"], name=name)
+
+
+def nodes() -> list:
+    from ray_tpu._private import worker_context
+
+    cw = worker_context.get_core_worker()
+    return list(cw.gcs.call("get_nodes")["nodes"].values())
+
+
+def cluster_resources() -> dict:
+    out: dict = {}
+    for n in nodes():
+        if n["state"] != "ALIVE":
+            continue
+        for k, v in n["resources_total"].items():
+            out[k] = out.get(k, 0) + v
+    return out
+
+
+def available_resources() -> dict:
+    out: dict = {}
+    for n in nodes():
+        if n["state"] != "ALIVE":
+            continue
+        for k, v in n["resources_available"].items():
+            out[k] = out.get(k, 0) + v
+    return out
+
+
+def timeline() -> list:
+    """Task-event history (analog of `ray timeline`, chrome-trace entries)."""
+    from ray_tpu._private import worker_context
+
+    cw = worker_context.get_core_worker()
+    return cw.gcs.call("get_task_events")["events"]
+
+
+__all__ = [
+    "ActorClass",
+    "ActorHandle",
+    "ObjectRef",
+    "RemoteFunction",
+    "available_resources",
+    "cluster_resources",
+    "exceptions",
+    "get",
+    "get_actor",
+    "init",
+    "is_initialized",
+    "kill",
+    "method",
+    "nodes",
+    "put",
+    "remote",
+    "shutdown",
+    "timeline",
+    "wait",
+]

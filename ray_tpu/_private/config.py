@@ -1,0 +1,115 @@
+"""Config/flag registry.
+
+TPU-native analog of the reference's ``RAY_CONFIG`` macro registry
+(src/ray/common/ray_config_def.h:22, materialised in ray_config.h:60): a single
+source of truth for runtime tunables, each overridable per-process via a
+``RAY_TPU_<NAME>`` environment variable and cluster-wide via the ``_system_config``
+dict handed to ``ray_tpu.init``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass, field, fields
+from typing import Any
+
+_ENV_PREFIX = "RAY_TPU_"
+
+
+def _coerce(value: str, typ: type) -> Any:
+    if typ is bool:
+        return value.lower() in ("1", "true", "yes", "on")
+    if typ is int:
+        return int(value)
+    if typ is float:
+        return float(value)
+    if typ in (dict, list):
+        return json.loads(value)
+    return value
+
+
+@dataclass
+class Config:
+    """All runtime tunables. Defaults match single-host development use."""
+
+    # --- object store ---
+    object_store_memory: int = 512 * 1024 * 1024  # arena capacity per node
+    object_store_min_alloc: int = 64  # smallest arena block
+    # objects <= this many bytes live in the owner's in-process store and are
+    # shipped inline in RPCs (reference: 100KB in-process memory store cutoff).
+    max_direct_call_object_size: int = 100 * 1024
+    object_transfer_chunk_bytes: int = 4 * 1024 * 1024
+    object_spill_dir: str = ""  # empty -> <session_dir>/spill
+    object_spill_threshold: float = 0.8  # arena fullness ratio triggering spill
+
+    # --- scheduling / raylet ---
+    worker_lease_timeout_s: float = 30.0
+    worker_idle_timeout_s: float = 300.0  # idle workers kept warm for reuse
+    max_workers_per_node: int = 64
+    worker_startup_timeout_s: float = 60.0
+    scheduler_spread_threshold: float = 0.5  # hybrid policy pack->spread knob
+    prestart_workers: int = 0
+
+    # --- health / failure detection ---
+    heartbeat_interval_s: float = 0.5
+    node_death_timeout_s: float = 5.0
+    health_check_failure_threshold: int = 5
+
+    # --- RPC ---
+    rpc_connect_timeout_s: float = 10.0
+    rpc_retries: int = 3
+    rpc_retry_delay_s: float = 0.2
+
+    # --- tasks / actors ---
+    default_max_retries: int = 3
+    default_actor_max_restarts: int = 0
+    actor_call_queue_depth: int = 10_000
+
+    # --- logging / events ---
+    log_to_driver: bool = True
+    event_stats: bool = True
+    task_events_buffer_size: int = 10_000
+
+    # --- collectives ---
+    collective_rendezvous_timeout_s: float = 60.0
+
+    # --- misc ---
+    session_dir_root: str = "/tmp/ray_tpu"
+
+    def apply_overrides(self, system_config: dict | None = None) -> None:
+        """Env vars take precedence over _system_config, which beats defaults."""
+        if system_config:
+            for key, value in system_config.items():
+                if not hasattr(self, key):
+                    raise ValueError(f"Unknown system config key: {key}")
+                setattr(self, key, value)
+        for f in fields(self):
+            env = os.environ.get(_ENV_PREFIX + f.name.upper())
+            if env is not None:
+                setattr(self, f.name, _coerce(env, f.type if isinstance(f.type, type) else type(getattr(self, f.name))))
+
+    def to_dict(self) -> dict:
+        return {f.name: getattr(self, f.name) for f in fields(self)}
+
+
+_config_lock = threading.Lock()
+_config: Config | None = None
+
+
+def get_config() -> Config:
+    global _config
+    with _config_lock:
+        if _config is None:
+            _config = Config()
+            _config.apply_overrides()
+        return _config
+
+
+def init_config(system_config: dict | None = None) -> Config:
+    global _config
+    with _config_lock:
+        _config = Config()
+        _config.apply_overrides(system_config)
+        return _config

@@ -1,0 +1,912 @@
+"""CoreWorker — the per-process runtime.
+
+TPU-native analog of the reference's CoreWorker
+(src/ray/core_worker/core_worker.h:284) plus its Cython binding
+(python/ray/_raylet.pyx:2625): lives in every driver and worker process and
+implements
+
+- task submission (core_worker.cc:1893 SubmitTask) through the local raylet
+- actor creation via GCS + direct actor task transport
+  (direct_actor_task_submitter.h:67) — actor calls go straight to the actor
+  process over its own RPC server, the raylet is not involved after creation
+- Put/Get/Wait over the two-tier object store: small objects in the owner's
+  in-process store (memory_store.h:43), large objects in the node's shm arena
+  (plasma_store_provider.h:88)
+- ownership + distributed reference counting (reference_count.h:61, simplified
+  borrower protocol: every materialised ObjectRef increfs its owner, task args
+  are pinned for the task's lifetime)
+- task retry + lineage reconstruction (task_manager.h:164,
+  object_recovery_manager.h:41): specs of completed tasks are retained so a
+  lost object can be rebuilt by re-executing its creating task
+- the task execution loop for worker processes (core_worker.cc:2512), including
+  the ordered actor scheduling queue (actor_scheduling_queue.h:40) and
+  concurrency groups via thread pools.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import hashlib
+import logging
+import threading
+import time
+from concurrent.futures import Future as ConcurrentFuture
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+
+import cloudpickle
+
+from ray_tpu._private import serialization
+from ray_tpu._private.config import get_config
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.rpc import ConnectionLost, EventLoopThread, RpcClient, RpcError, RpcServer
+from ray_tpu._private.store.object_store import StoreClient
+from ray_tpu._private.task_spec import ACTOR_CREATION_TASK, ACTOR_TASK, NORMAL_TASK, TaskSpec
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    GetTimeoutError,
+    ObjectLostError,
+    OwnerDiedError,
+    TaskCancelledError,
+    TaskError,
+    WorkerCrashedError,
+)
+
+logger = logging.getLogger(__name__)
+
+DRIVER = "driver"
+WORKER = "worker"
+
+
+@dataclass
+class PendingTask:
+    spec: TaskSpec
+    retries_left: int
+    arg_refs: list = field(default_factory=list)
+
+
+@dataclass
+class OwnedObject:
+    ref_count: int = 0
+    pinned: int = 0  # pins from in-flight tasks that use this object as an arg
+    in_plasma: bool = False
+    location_hint: str | None = None
+
+
+class CoreWorker:
+    def __init__(
+        self,
+        mode: str,
+        gcs_address,
+        raylet_address,
+        arena_name: str,
+        node_id: str,
+        session_dir: str,
+        job_id: JobID | None = None,
+        worker_id: str | None = None,
+        namespace: str = "",
+    ):
+        self.mode = mode
+        self.cfg = get_config()
+        self.node_id = node_id
+        self.session_dir = session_dir
+        self.namespace = namespace
+        self.worker_id = worker_id or WorkerID.from_random().hex()
+        self._io = EventLoopThread.get()
+
+        self.gcs = RpcClient(tuple(gcs_address), label="gcs")
+        self.raylet = RpcClient(tuple(raylet_address), label="raylet")
+        self.store = StoreClient(arena_name, self.raylet)
+
+        if job_id is None:
+            job_hex = self.gcs.call("next_job_id")["job_id"]
+            job_id = JobID.from_hex(job_hex)
+        self.job_id = job_id
+        self.current_task_id = TaskID.for_driver(job_id)
+        self._task_counter = 0
+
+        # Own RPC server (the "core worker service").
+        self.server = RpcServer(f"core-{self.worker_id[:8]}")
+        self.server.register_all(self)
+        self.server.start("127.0.0.1", 0)
+        self.address = self.server.address
+
+        # Object bookkeeping (all guarded by _lock; events live on the IO loop).
+        self._lock = threading.Lock()
+        self.in_process_store: dict[str, dict] = {}  # oid -> {data | value}
+        self.owned: dict[str, OwnedObject] = {}
+        self._object_events: dict[str, asyncio.Event] = {}
+        self.pending_tasks: dict[str, PendingTask] = {}
+        self.lineage: collections.OrderedDict[str, TaskSpec] = collections.OrderedDict()
+        self._borrowed_decref_queue: list = []
+
+        # Function table cache (reference: _private/function_manager.py).
+        self._function_cache: dict[str, object] = {}
+        self._exported_functions: set[str] = set()
+
+        # Actor-call transport state.
+        self._actor_clients: dict[str, RpcClient] = {}
+        self._actor_addrs: dict[str, tuple] = {}
+        self._actor_seq: dict[str, int] = collections.defaultdict(int)
+        self._actor_pending: dict[str, set] = collections.defaultdict(set)
+        self._actor_submit_locks: dict[str, asyncio.Lock] = collections.defaultdict(asyncio.Lock)
+
+        # Execution state (worker mode).
+        self._executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="task-exec")
+        self._actor_instance = None
+        self._actor_id: str | None = None
+        self._actor_creation_spec: TaskSpec | None = None
+        self._actor_exec_queue: asyncio.Queue | None = None
+        self._actor_concurrency_pool: ThreadPoolExecutor | None = None
+        self._actor_async_loop: asyncio.AbstractEventLoop | None = None
+        self._shutdown = False
+
+    # ==================================================================
+    # Submission-side API
+    # ==================================================================
+
+    def _next_task_id(self) -> TaskID:
+        self._task_counter += 1
+        return TaskID.for_task(ActorID(self.current_task_id.binary()[:16]))
+
+    def _export_function(self, func) -> str:
+        pickled = cloudpickle.dumps(func)
+        key = "fn:" + hashlib.sha1(pickled).hexdigest()
+        if key not in self._exported_functions:
+            self.gcs.call("kv_put", {"key": key, "value": pickled, "overwrite": False})
+            self._exported_functions.add(key)
+            self._function_cache[key] = func
+        return key
+
+    def _prepare_args(self, args: tuple, kwargs: dict) -> tuple[list, list]:
+        """Serialize positional+keyword args into wire form; returns
+        (wire_args, referenced_refs). kwargs ride as a trailing marker."""
+        from ray_tpu.object_ref import ObjectRef
+
+        wire = []
+        refs = []
+        flat = list(args) + [("__kwargs__", kwargs)] if kwargs else list(args)
+        for arg in flat:
+            if isinstance(arg, ObjectRef):
+                refs.append(arg)
+                wire.append(["r", arg.hex(), list(arg.owner_addr or self.address)])
+            else:
+                ser = serialization.serialize(arg)
+                refs.extend(ser.contained_refs)
+                data = ser.to_bytes()
+                if len(data) > self.cfg.max_direct_call_object_size:
+                    ref = self.put_serialized(ser)
+                    refs.append(ref)
+                    wire.append(["r", ref.hex(), list(self.address)])
+                else:
+                    wire.append(["v", data])
+        return wire, refs
+
+    def submit_task(self, func, args=(), kwargs=None, **opts):
+        """Submit a normal task; returns list[ObjectRef]."""
+        from ray_tpu.object_ref import ObjectRef
+
+        kwargs = kwargs or {}
+        task_id = self._next_task_id()
+        wire_args, arg_refs = self._prepare_args(args, kwargs)
+        num_returns = opts.get("num_returns", 1)
+        spec = TaskSpec(
+            task_id=task_id.hex(),
+            job_id=self.job_id.hex(),
+            name=opts.get("name") or getattr(func, "__name__", "task"),
+            task_type=NORMAL_TASK,
+            function_key=self._export_function(func),
+            args=wire_args,
+            num_returns=num_returns,
+            resources=opts.get("resources") or {"CPU": 1},
+            max_retries=opts.get("max_retries", self.cfg.default_max_retries),
+            retry_exceptions=bool(opts.get("retry_exceptions", False)),
+            owner_addr=list(self.address),
+            owner_worker_id=self.worker_id,
+            placement_group_id=opts.get("placement_group_id", ""),
+            placement_group_bundle_index=opts.get("placement_group_bundle_index", -1),
+            scheduling_strategy=opts.get("scheduling_strategy", "DEFAULT"),
+            runtime_env=opts.get("runtime_env") or {},
+        )
+        self._register_pending(spec, arg_refs)
+        self.raylet.call("submit_task", {"spec": spec.to_wire()})
+        return [
+            ObjectRef(ObjectID.for_return(task_id, i), self.address)
+            for i in range(num_returns)
+        ]
+
+    def _register_pending(self, spec: TaskSpec, arg_refs: list):
+        with self._lock:
+            self.pending_tasks[spec.task_id] = PendingTask(
+                spec=spec, retries_left=spec.max_retries, arg_refs=list(arg_refs)
+            )
+            for oid in spec.return_object_ids():
+                self.owned.setdefault(oid, OwnedObject())
+                self._ensure_event(oid)
+        for ref in arg_refs:
+            self._pin_arg(ref)
+
+    def _pin_arg(self, ref):
+        if ref.owner_addr is None or tuple(ref.owner_addr) == tuple(self.address):
+            with self._lock:
+                obj = self.owned.setdefault(ref.hex(), OwnedObject())
+                obj.pinned += 1
+        else:
+            self._push_to_owner(ref, "incref")
+
+    def _unpin_args(self, arg_refs):
+        for ref in arg_refs:
+            if ref.owner_addr is None or tuple(ref.owner_addr) == tuple(self.address):
+                with self._lock:
+                    obj = self.owned.get(ref.hex())
+                    if obj is not None:
+                        obj.pinned = max(0, obj.pinned - 1)
+                        self._maybe_free_locked(ref.hex(), obj)
+            else:
+                self._push_to_owner(ref, "decref")
+
+    def _push_to_owner(self, ref, method: str):
+        async def _push():
+            try:
+                client = RpcClient(tuple(ref.owner_addr), label="owner")
+                await client.apush(method, {"object_id": ref.hex()})
+                client.close()
+            except Exception:
+                pass
+
+        self._io.spawn(_push())
+
+    # ---- puts ----
+
+    def put(self, value) -> "object":
+        ser = serialization.serialize(value)
+        return self.put_serialized(ser)
+
+    def put_serialized(self, ser: serialization.SerializedObject):
+        from ray_tpu.object_ref import ObjectRef
+
+        oid = ObjectID.for_put(self.current_task_id)
+        oid_hex = oid.hex()
+        with self._lock:
+            self.owned.setdefault(oid_hex, OwnedObject())
+        if ser.total_size > self.cfg.max_direct_call_object_size:
+            self.store.put_serialized(oid_hex, ser)
+            with self._lock:
+                self.owned[oid_hex].in_plasma = True
+                self.owned[oid_hex].location_hint = self.node_id
+        else:
+            with self._lock:
+                self.in_process_store[oid_hex] = {"data": ser.to_bytes()}
+        self._set_event(oid_hex)
+        return ObjectRef(oid, self.address)
+
+    # ---- gets ----
+
+    def _ensure_event(self, oid_hex: str) -> asyncio.Event:
+        ev = self._object_events.get(oid_hex)
+        if ev is None:
+            ev = asyncio.Event()
+            self._object_events[oid_hex] = ev
+        return ev
+
+    def _set_event(self, oid_hex: str):
+        def _set():
+            with self._lock:
+                ev = self._ensure_event(oid_hex)
+            ev.set()
+
+        self._io.loop.call_soon_threadsafe(_set)
+
+    async def _wait_event(self, oid_hex: str, timeout: float | None):
+        with self._lock:
+            ev = self._ensure_event(oid_hex)
+        if timeout is None:
+            await ev.wait()
+        else:
+            await asyncio.wait_for(ev.wait(), timeout)
+
+    def get(self, refs, timeout: float | None = None):
+        single = not isinstance(refs, list)
+        ref_list = [refs] if single else refs
+        deadline = None if timeout is None else time.monotonic() + timeout
+        values = [self._get_one(ref, deadline) for ref in ref_list]
+        for v in values:
+            if isinstance(v, TaskError):
+                if isinstance(v.cause, (TaskCancelledError, ActorDiedError)):
+                    raise v.cause
+                raise v
+            if isinstance(v, (ObjectLostError, WorkerCrashedError, ActorDiedError, TaskCancelledError)):
+                raise v
+        return values[0] if single else values
+
+    def _remaining(self, deadline) -> float | None:
+        if deadline is None:
+            return None
+        rem = deadline - time.monotonic()
+        if rem <= 0:
+            raise GetTimeoutError("ray_tpu.get() timed out")
+        return rem
+
+    def _get_one(self, ref, deadline):
+        oid_hex = ref.hex()
+        is_owner = ref.owner_addr is None or tuple(ref.owner_addr) == tuple(self.address)
+        attempts = 0
+        while True:
+            attempts += 1
+            # 1. In-process store.
+            with self._lock:
+                entry = self.in_process_store.get(oid_hex)
+            if entry is not None:
+                return self._materialize(oid_hex, entry)
+            # 2. Pending task we own: wait for completion.
+            task_id = oid_hex[: TaskID.SIZE * 2]
+            with self._lock:
+                pending = task_id in self.pending_tasks
+            if pending and is_owner:
+                try:
+                    self._io.run(self._wait_event(oid_hex, self._remaining(deadline)))
+                except (asyncio.TimeoutError, TimeoutError):
+                    raise GetTimeoutError("ray_tpu.get() timed out")
+                continue
+            # 3. Local/remote plasma.
+            with self._lock:
+                obj = self.owned.get(oid_hex)
+                in_plasma = obj.in_plasma if obj else None
+            if is_owner and in_plasma is False and entry is None:
+                # Owned, not in plasma, not in-process => lost; try lineage.
+                if self._try_reconstruct(oid_hex):
+                    continue
+                raise ObjectLostError(oid_hex)
+            try:
+                rem = self._remaining(deadline)
+                view = self.store.get_view(oid_hex, timeout=min(rem, 2.0) if rem else 2.0)
+                try:
+                    return serialization.deserialize(view)
+                finally:
+                    self.store.release(oid_hex)
+            except GetTimeoutError:
+                raise
+            except Exception:
+                pass
+            # 4. Borrower path: ask the owner directly.
+            if not is_owner:
+                result = self._fetch_from_owner(ref, deadline)
+                if result is not _MISSING:
+                    return result
+            else:
+                # Only reconstruct when no copy exists anywhere (a slow pull
+                # must not trigger a spurious re-execution).
+                if not self._has_any_location(oid_hex):
+                    if self._try_reconstruct(oid_hex):
+                        continue
+                    if attempts > 3:
+                        raise ObjectLostError(oid_hex)
+            time.sleep(0.05)
+            self._remaining(deadline)
+
+    def _materialize(self, oid_hex: str, entry: dict):
+        if "value" not in entry:
+            entry["value"] = serialization.deserialize(entry["data"])
+        return entry["value"]
+
+    def _fetch_from_owner(self, ref, deadline):
+        try:
+            client = RpcClient(tuple(ref.owner_addr), label="owner-fetch")
+            try:
+                rem = self._remaining(deadline)
+                resp = client.call(
+                    "get_inline",
+                    {"object_id": ref.hex(), "wait": True},
+                    timeout=rem,
+                )
+            finally:
+                client.close()
+        except GetTimeoutError:
+            raise
+        except Exception:
+            raise OwnerDiedError(ref.hex(), f"owner of {ref.hex()} is unreachable")
+        kind = resp.get("kind")
+        if kind == "inline":
+            return serialization.deserialize(resp["data"])
+        if kind == "plasma":
+            return _MISSING  # loop will pull via local raylet
+        raise ObjectLostError(ref.hex())
+
+    def _has_any_location(self, oid_hex: str) -> bool:
+        try:
+            resp = self.gcs.call("get_object_locations", {"object_id": oid_hex}, timeout=5)
+            return bool(resp.get("locations"))
+        except Exception:
+            return False
+
+    def _try_reconstruct(self, oid_hex: str) -> bool:
+        """Lineage reconstruction (reference: object_recovery_manager.h:90)."""
+        task_id = oid_hex[: TaskID.SIZE * 2]
+        with self._lock:
+            spec = self.lineage.get(task_id)
+            if spec is None or spec.max_retries <= 0:
+                return False
+            if task_id in self.pending_tasks:
+                return True
+            self.lineage.pop(task_id, None)
+            for oid in spec.return_object_ids():
+                ev = self._object_events.get(oid)
+                if ev is not None:
+                    self._io.loop.call_soon_threadsafe(ev.clear)
+                obj = self.owned.get(oid)
+                if obj is not None:
+                    obj.in_plasma = False
+        logger.info("reconstructing object %s by re-executing task %s", oid_hex[:8], task_id[:8])
+        self._register_pending(spec, [])
+        self.raylet.call("submit_task", {"spec": spec.to_wire()})
+        return True
+
+    # ---- wait ----
+
+    def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        pending = list(refs)
+        ready: list = []
+        while True:
+            still = []
+            for ref in pending:
+                if self._is_ready(ref, fetch_local):
+                    ready.append(ref)
+                else:
+                    still.append(ref)
+            pending = still
+            if len(ready) >= num_returns or not pending:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            time.sleep(0.02)
+        return ready, pending
+
+    def _is_ready(self, ref, fetch_local: bool) -> bool:
+        oid_hex = ref.hex()
+        with self._lock:
+            if oid_hex in self.in_process_store:
+                return True
+            task_id = oid_hex[: TaskID.SIZE * 2]
+            if task_id in self.pending_tasks:
+                return False
+            obj = self.owned.get(oid_hex)
+        if obj is not None and obj.in_plasma:
+            if not fetch_local:
+                return True
+            return self.store.contains(oid_hex)
+        if ref.owner_addr is not None and tuple(ref.owner_addr) != tuple(self.address):
+            if self.store.contains(oid_hex):
+                return True
+            try:
+                client = RpcClient(tuple(ref.owner_addr), label="owner-probe")
+                try:
+                    resp = client.call("get_inline", {"object_id": oid_hex, "wait": False}, timeout=2)
+                finally:
+                    client.close()
+                return resp.get("kind") in ("inline", "plasma")
+            except Exception:
+                return False
+        return obj is not None and (obj.in_plasma or oid_hex in self.in_process_store)
+
+    def as_future(self, ref) -> ConcurrentFuture:
+        fut: ConcurrentFuture = ConcurrentFuture()
+
+        def _resolve():
+            try:
+                fut.set_result(self.get(ref))
+            except BaseException as e:
+                fut.set_exception(e)
+
+        threading.Thread(target=_resolve, daemon=True).start()
+        return fut
+
+    # ==================================================================
+    # Actor submission (reference: direct_actor_task_submitter.h:67)
+    # ==================================================================
+
+    def create_actor(self, cls, args, kwargs, **opts):
+        actor_id = ActorID.of(self.job_id)
+        wire_args, arg_refs = self._prepare_args(args, kwargs or {})
+        spec = TaskSpec(
+            task_id=TaskID.for_task(actor_id).hex(),
+            job_id=self.job_id.hex(),
+            name=f"{cls.__name__}.__init__",
+            task_type=ACTOR_CREATION_TASK,
+            function_key=self._export_function(cls),
+            args=wire_args,
+            num_returns=0,
+            # Actors hold no CPU while alive (reference semantics: num_cpus=0
+            # default for actor lifetime) so many actors can share a node.
+            resources=opts.get("resources") or {},
+            owner_addr=list(self.address),
+            owner_worker_id=self.worker_id,
+            actor_id=actor_id.hex(),
+            max_restarts=opts.get("max_restarts", self.cfg.default_actor_max_restarts),
+            max_task_retries=opts.get("max_task_retries", 0),
+            max_concurrency=opts.get("max_concurrency", 1),
+            actor_name=opts.get("name") or "",
+            namespace=opts.get("namespace") or self.namespace,
+            get_if_exists=opts.get("get_if_exists", False),
+            placement_group_id=opts.get("placement_group_id", ""),
+            placement_group_bundle_index=opts.get("placement_group_bundle_index", -1),
+            scheduling_strategy=opts.get("scheduling_strategy", "DEFAULT"),
+        )
+        for ref in arg_refs:
+            self._pin_arg(ref)
+        resp = self.gcs.call("register_actor", {"spec": spec.to_wire()})
+        if not resp.get("ok"):
+            raise ValueError(resp.get("error", "actor registration failed"))
+        return {
+            "actor_id": resp["actor_id"],
+            "max_task_retries": spec.max_task_retries,
+            "name": spec.actor_name,
+        }
+
+    def _resolve_actor(self, actor_id: str, timeout: float | None = None) -> tuple:
+        timeout = timeout if timeout is not None else self.cfg.worker_lease_timeout_s
+        deadline = time.monotonic() + timeout
+        while True:
+            addr = self._actor_addrs.get(actor_id)
+            if addr is not None:
+                return addr
+            resp = self.gcs.call("get_actor", {"actor_id": actor_id})
+            if not resp.get("found"):
+                raise ActorDiedError(f"actor {actor_id[:8]} not found")
+            info = resp["info"]
+            if info["state"] == "ALIVE" and info.get("address"):
+                addr = tuple(info["address"])
+                self._actor_addrs[actor_id] = addr
+                return addr
+            if info["state"] == "DEAD":
+                raise ActorDiedError(
+                    f"actor {actor_id[:8]} is dead: {info.get('death_cause', '')}",
+                    actor_id=actor_id,
+                )
+            if time.monotonic() > deadline:
+                raise ActorDiedError(f"timed out resolving actor {actor_id[:8]}")
+            time.sleep(0.05)
+
+    def _actor_client(self, actor_id: str) -> RpcClient:
+        addr = self._resolve_actor(actor_id)
+        client = self._actor_clients.get(actor_id)
+        if client is None or client.address != addr:
+            if client is not None:
+                client.close()
+            # Short connect timeout: a dead actor should surface as
+            # ActorDiedError quickly; restarts re-resolve through GCS anyway.
+            client = RpcClient(addr, label=f"actor-{actor_id[:8]}", connect_timeout=2.0)
+            self._actor_clients[actor_id] = client
+        return client
+
+    def submit_actor_task(self, actor_id: str, method_name: str, args, kwargs, num_returns=1, max_task_retries=0):
+        from ray_tpu.object_ref import ObjectRef
+
+        task_id = self._next_task_id()
+        wire_args, arg_refs = self._prepare_args(args, kwargs or {})
+        self._actor_seq[actor_id] += 1
+        spec = TaskSpec(
+            task_id=task_id.hex(),
+            job_id=self.job_id.hex(),
+            name=method_name,
+            task_type=ACTOR_TASK,
+            args=wire_args,
+            num_returns=num_returns,
+            owner_addr=list(self.address),
+            owner_worker_id=self.worker_id,
+            actor_id=actor_id,
+            method_name=method_name,
+            seq_no=self._actor_seq[actor_id],
+            max_task_retries=max_task_retries,
+        )
+        self._register_pending(spec, arg_refs)
+        self._actor_pending[actor_id].add(spec.task_id)
+        self._io.spawn(self._drive_actor_call(spec, attempts_left=max(0, max_task_retries)))
+        return [
+            ObjectRef(ObjectID.for_return(task_id, i), self.address)
+            for i in range(num_returns)
+        ]
+
+    async def _drive_actor_call(self, spec: TaskSpec, attempts_left: int):
+        actor_id = spec.actor_id
+        loop = asyncio.get_event_loop()
+        # Per-actor FIFO lock: resolve + send under the lock so calls hit the
+        # wire in submission order (reference: sequential_actor_submit_queue.h);
+        # responses are awaited outside so calls still pipeline.
+        lock = self._actor_submit_locks[actor_id]
+        while True:
+            try:
+                async with lock:
+                    client = await loop.run_in_executor(None, self._actor_client, actor_id)
+                    fut = await client.astart_call("actor_call", {"spec": spec.to_wire()})
+                resp = await fut
+                self._handle_task_done(spec.task_id, resp)
+                return
+            except ActorDiedError as e:
+                self._fail_task(spec.task_id, e)
+                return
+            except (ConnectionLost, RpcError, OSError) as e:
+                # Actor process may be restarting; drop the cached address and
+                # re-resolve (reference: GCS-driven actor restart, client resubmit).
+                self._actor_addrs.pop(actor_id, None)
+                old = self._actor_clients.pop(actor_id, None)
+                if old is not None:
+                    old.close()
+                if attempts_left <= 0:
+                    self._fail_task(
+                        spec.task_id,
+                        ActorDiedError(f"actor {actor_id[:8]} died during call: {e}", actor_id=actor_id),
+                    )
+                    return
+                attempts_left -= 1
+                await asyncio.sleep(0.1)
+
+    def _fail_task(self, task_id: str, error: BaseException):
+        with self._lock:
+            pending = self.pending_tasks.pop(task_id, None)
+        if pending is None:
+            return
+        ser = serialization.serialize(error).to_bytes()
+        with self._lock:
+            for oid in pending.spec.return_object_ids():
+                self.in_process_store[oid] = {"data": ser, "value": error}
+        for oid in pending.spec.return_object_ids():
+            self._set_event(oid)
+        if pending.spec.actor_id:
+            self._actor_pending[pending.spec.actor_id].discard(task_id)
+        self._unpin_args(pending.arg_refs)
+
+    # ==================================================================
+    # Owner-side RPC handlers
+    # ==================================================================
+
+    async def rpc_task_done(self, req):
+        self._handle_task_done(req["task_id"], req)
+        return {"ok": True}
+
+    def _handle_task_done(self, task_id: str, payload: dict):
+        with self._lock:
+            pending = self.pending_tasks.get(task_id)
+        if pending is None:
+            return
+        error = payload.get("error")
+        if error is not None and pending.spec.retry_exceptions and pending.retries_left > 0:
+            pending.retries_left -= 1
+            # May run on the IO loop (rpc handler) — must not block.
+            self._io.spawn(self.raylet.acall("submit_task", {"spec": pending.spec.to_wire()}))
+            return
+        with self._lock:
+            self.pending_tasks.pop(task_id, None)
+            for oid, kind, data in payload.get("results", []):
+                if kind == "inline":
+                    self.in_process_store[oid] = {"data": data}
+                else:  # plasma
+                    obj = self.owned.setdefault(oid, OwnedObject())
+                    obj.in_plasma = True
+                    obj.location_hint = data
+            if error is not None:
+                for oid in pending.spec.return_object_ids():
+                    self.in_process_store[oid] = {"data": error}
+            # Retain lineage for reconstruction.
+            self.lineage[task_id] = pending.spec
+            while len(self.lineage) > 10_000:
+                self.lineage.popitem(last=False)
+        for oid in pending.spec.return_object_ids():
+            self._set_event(oid)
+        if pending.spec.actor_id:
+            self._actor_pending[pending.spec.actor_id].discard(task_id)
+        self._unpin_args(pending.arg_refs)
+
+    async def rpc_task_failed(self, req):
+        """Raylet tells us a worker died mid-task (reference: retry path)."""
+        task_id = req["task_id"]
+        with self._lock:
+            pending = self.pending_tasks.get(task_id)
+        if pending is None:
+            return {"ok": True}
+        if req.get("retriable", True) and pending.retries_left > 0:
+            pending.retries_left -= 1
+            logger.info(
+                "task %s failed (%s); retrying (%d left)",
+                task_id[:8],
+                req.get("message", ""),
+                pending.retries_left,
+            )
+            await self.raylet.acall("submit_task", {"spec": pending.spec.to_wire()})
+        else:
+            self._fail_task(task_id, WorkerCrashedError(req.get("message", "worker crashed")))
+        return {"ok": True}
+
+    async def rpc_get_inline(self, req):
+        """Serve an owned object to a borrower."""
+        oid_hex = req["object_id"]
+        with self._lock:
+            entry = self.in_process_store.get(oid_hex)
+            obj = self.owned.get(oid_hex)
+        if entry is not None:
+            return {"kind": "inline", "data": entry["data"]}
+        if obj is not None and obj.in_plasma:
+            return {"kind": "plasma", "location": obj.location_hint}
+        task_id = oid_hex[: TaskID.SIZE * 2]
+        with self._lock:
+            pending = task_id in self.pending_tasks
+        if pending and req.get("wait"):
+            await self._wait_event(oid_hex, self.cfg.worker_lease_timeout_s)
+            with self._lock:
+                entry = self.in_process_store.get(oid_hex)
+                obj = self.owned.get(oid_hex)
+            if entry is not None:
+                return {"kind": "inline", "data": entry["data"]}
+            if obj is not None and obj.in_plasma:
+                return {"kind": "plasma", "location": obj.location_hint}
+        return {"kind": "missing"}
+
+    async def rpc_incref(self, req):
+        with self._lock:
+            self.owned.setdefault(req["object_id"], OwnedObject()).ref_count += 1
+        return {"ok": True}
+
+    async def rpc_decref(self, req):
+        oid = req["object_id"]
+        with self._lock:
+            obj = self.owned.get(oid)
+            if obj is not None:
+                obj.ref_count -= 1
+                self._maybe_free_locked(oid, obj)
+        return {"ok": True}
+
+    def register_ref(self, ref):
+        oid = ref.hex()
+        if ref.owner_addr is None or tuple(ref.owner_addr) == tuple(self.address):
+            with self._lock:
+                self.owned.setdefault(oid, OwnedObject()).ref_count += 1
+        else:
+            self._push_to_owner(ref, "incref")
+
+    def deregister_ref(self, ref):
+        if self._shutdown:
+            return
+        oid = ref.hex()
+        if ref.owner_addr is None or tuple(ref.owner_addr) == tuple(self.address):
+            with self._lock:
+                obj = self.owned.get(oid)
+                if obj is not None:
+                    obj.ref_count -= 1
+                    self._maybe_free_locked(oid, obj)
+        else:
+            self._push_to_owner(ref, "decref")
+
+    def _maybe_free_locked(self, oid: str, obj: OwnedObject):
+        """Free the object once all refs + pins are gone. Caller holds _lock."""
+        if obj.ref_count > 0 or obj.pinned > 0:
+            return
+        task_id = oid[: TaskID.SIZE * 2]
+        if task_id in self.pending_tasks:
+            return
+        self.in_process_store.pop(oid, None)
+        self.owned.pop(oid, None)
+        self._object_events.pop(oid, None)
+        if obj.in_plasma:
+            async def _free():
+                try:
+                    await self.raylet.acall("free_object", {"object_id": oid})
+                except Exception:
+                    pass
+
+            self._io.spawn(_free())
+
+    # ==================================================================
+    # Execution side (worker mode; reference: core_worker.cc:2512 loop)
+    # ==================================================================
+
+    def _load_function(self, key: str):
+        fn = self._function_cache.get(key)
+        if fn is None:
+            resp = self.gcs.call("kv_get", {"key": key})
+            if not resp.get("found"):
+                raise RuntimeError(f"function {key} not in GCS function table")
+            fn = cloudpickle.loads(resp["value"])
+            self._function_cache[key] = fn
+        return fn
+
+    def _resolve_args(self, wire_args: list):
+        from ray_tpu.object_ref import ObjectRef
+
+        args = []
+        kwargs = {}
+        for arg in wire_args:
+            if arg[0] == "r":
+                ref = ObjectRef(ObjectID.from_hex(arg[1]), tuple(arg[2]))
+                value = self.get(ref)
+            else:
+                value = serialization.deserialize(arg[1])
+            if isinstance(value, tuple) and len(value) == 2 and value[0] == "__kwargs__":
+                kwargs = value[1]
+            else:
+                args.append(value)
+        return args, kwargs
+
+    def _package_results(self, spec: TaskSpec, values: list) -> list:
+        """Serialize return values; small inline, large to plasma."""
+        results = []
+        for i, value in enumerate(values):
+            oid = spec.return_object_ids()[i]
+            ser = serialization.serialize(value)
+            if ser.total_size > self.cfg.max_direct_call_object_size:
+                self.store.put_serialized(oid, ser)
+                results.append([oid, "plasma", self.node_id])
+            else:
+                results.append([oid, "inline", ser.to_bytes()])
+        return results
+
+    def execute_task(self, spec: TaskSpec) -> dict:
+        """Run one task; returns the task_done payload."""
+        prev_task_id = self.current_task_id
+        self.current_task_id = TaskID.from_hex(spec.task_id)
+        start = time.time()
+        try:
+            if spec.is_actor_task():
+                fn = getattr(self._actor_instance, spec.method_name)
+            else:
+                fn = self._load_function(spec.function_key)
+            args, kwargs = self._resolve_args(spec.args)
+            if spec.is_actor_creation():
+                instance = fn(*args, **kwargs)
+                self._actor_instance = instance
+                self._actor_id = spec.actor_id
+                self._actor_creation_spec = spec
+                values = []
+            else:
+                out = fn(*args, **kwargs)
+                if asyncio.iscoroutine(out):
+                    out = self._run_actor_coroutine(out)
+                if spec.num_returns == 0:
+                    values = []
+                elif spec.num_returns == 1:
+                    values = [out]
+                else:
+                    values = list(out)
+                    if len(values) != spec.num_returns:
+                        raise ValueError(
+                            f"task {spec.name} declared num_returns={spec.num_returns} "
+                            f"but returned {len(values)} values"
+                        )
+            results = self._package_results(spec, values)
+            payload = {"task_id": spec.task_id, "results": results, "error": None}
+        except BaseException as e:  # noqa: BLE001 — errors ship to the caller
+            logger.debug("task %s raised", spec.name, exc_info=True)
+            err = TaskError.from_exception(e, task_name=spec.name)
+            payload = {
+                "task_id": spec.task_id,
+                "results": [],
+                "error": serialization.serialize(err).to_bytes(),
+            }
+        finally:
+            self.current_task_id = prev_task_id
+        payload["duration_s"] = time.time() - start
+        return payload
+
+    def _run_actor_coroutine(self, coro):
+        """Async actor methods run on a dedicated per-actor event loop."""
+        if self._actor_async_loop is None:
+            loop = asyncio.new_event_loop()
+            t = threading.Thread(target=loop.run_forever, name="actor-async", daemon=True)
+            t.start()
+            self._actor_async_loop = loop
+        return asyncio.run_coroutine_threadsafe(coro, self._actor_async_loop).result()
+
+    # ---- shutdown ----
+
+    def shutdown(self):
+        self._shutdown = True
+        for c in self._actor_clients.values():
+            c.close()
+        self.server.stop()
+        self.store.close()
+        self.gcs.close()
+        self.raylet.close()
+        self._executor.shutdown(wait=False)
+
+
+_MISSING = object()

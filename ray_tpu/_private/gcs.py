@@ -1,0 +1,607 @@
+"""GCS — Global Control Service.
+
+TPU-native analog of the reference's GCS server
+(src/ray/gcs/gcs_server/gcs_server.cc:119-160): the cluster control plane,
+wiring per-domain managers over one RPC server:
+
+- node membership + health checking (gcs_node_manager.h, gcs_health_check_manager.h:39)
+- actor lifecycle + restart state machine (gcs_actor_manager.h:281)
+- placement groups with 2-phase reserve/commit (gcs_placement_group_manager.h)
+- cluster KV store, also the function table (gcs_kv_manager.h, gcs_function_manager.h)
+- object directory (reference: ownership-based directory; centralised here —
+  ownership_based_object_directory.h — acceptable at the per-pod scale this
+  control plane targets, revisit for 2k-node envelopes)
+- pub/sub fan-out (src/ray/pubsub/publisher.h:307)
+- task-event history (gcs_task_manager.h) powering the state API and timeline
+- job table
+
+Storage is in-memory (reference default) with an optional JSON snapshot for
+GCS fault-tolerance tests (reference: redis_store_client.h).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import time
+
+from ray_tpu._private.config import get_config
+from ray_tpu._private.rpc import EventLoopThread, RpcClient, RpcServer
+from ray_tpu._private.task_spec import TaskSpec
+
+logger = logging.getLogger(__name__)
+
+# Actor states (reference: src/ray/design_docs/actor_states.rst)
+PENDING_CREATION = "PENDING_CREATION"
+ALIVE = "ALIVE"
+RESTARTING = "RESTARTING"
+DEAD = "DEAD"
+
+
+class GcsServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, persist_path: str | None = None):
+        self.cfg = get_config()
+        self.server = RpcServer("gcs")
+        self.server.register_all(self)
+        self.server.start(host, port)
+        self.address = self.server.address
+        self.persist_path = persist_path
+
+        # Tables.
+        self.nodes: dict[str, dict] = {}
+        self.actors: dict[str, dict] = {}
+        self.named_actors: dict[tuple[str, str], str] = {}  # (namespace, name) -> actor_id
+        self.kv: dict[str, bytes] = {}
+        self.object_locations: dict[str, set[str]] = {}
+        self.placement_groups: dict[str, dict] = {}
+        self.jobs: dict[str, dict] = {}
+        self.task_events: list[dict] = []
+        self._job_counter = 0
+        self._subscribers: dict[str, list] = {}  # channel -> [writer]
+        self._raylet_clients: dict[str, RpcClient] = {}
+        self._io = EventLoopThread.get()
+        self._health_task = self._io.spawn(self._health_check_loop())
+        if persist_path and os.path.exists(persist_path):
+            self._load_snapshot()
+
+    # ------------------------------------------------------------------
+    # Nodes & health
+    # ------------------------------------------------------------------
+
+    async def rpc_register_node(self, req):
+        node_id = req["node_id"]
+        self.nodes[node_id] = {
+            "node_id": node_id,
+            "address": req["address"],
+            "resources_total": req["resources"],
+            "resources_available": dict(req["resources"]),
+            "labels": req.get("labels", {}),
+            "arena_name": req.get("arena_name", ""),
+            "state": "ALIVE",
+            "last_heartbeat": time.monotonic(),
+            "store_usage": {},
+        }
+        await self._publish("node_updates", {"node_id": node_id, "state": "ALIVE"})
+        return {"ok": True}
+
+    async def rpc_heartbeat(self, req):
+        node = self.nodes.get(req["node_id"])
+        if node is None or node["state"] == "DEAD":
+            return {"ok": False, "dead": True}
+        node["last_heartbeat"] = time.monotonic()
+        node["resources_available"] = req.get("resources_available", node["resources_available"])
+        node["store_usage"] = req.get("store_usage", node["store_usage"])
+        # Return the cluster resource view: this doubles as the resource
+        # syncer (reference: src/ray/common/ray_syncer/ray_syncer.h:86).
+        return {"ok": True, "nodes": self._cluster_view()}
+
+    def _cluster_view(self):
+        return {
+            nid: {
+                "address": n["address"],
+                "resources_total": n["resources_total"],
+                "resources_available": n["resources_available"],
+                "labels": n["labels"],
+                "state": n["state"],
+            }
+            for nid, n in self.nodes.items()
+            if n["state"] == "ALIVE"
+        }
+
+    async def rpc_get_nodes(self, req):
+        return {"nodes": self.nodes}
+
+    async def rpc_drain_node(self, req):
+        node = self.nodes.get(req["node_id"])
+        if node is not None:
+            node["state"] = "DRAINING"
+        return {"ok": True}
+
+    async def _health_check_loop(self):
+        # Reference: GcsHealthCheckManager (gcs_health_check_manager.h:39).
+        while True:
+            await asyncio.sleep(self.cfg.heartbeat_interval_s)
+            now = time.monotonic()
+            for node_id, node in list(self.nodes.items()):
+                if node["state"] != "ALIVE":
+                    continue
+                if now - node["last_heartbeat"] > self.cfg.node_death_timeout_s:
+                    await self._on_node_death(node_id)
+
+    async def _on_node_death(self, node_id: str):
+        node = self.nodes.get(node_id)
+        if node is None or node["state"] == "DEAD":
+            return
+        node["state"] = "DEAD"
+        logger.warning("GCS: node %s declared dead", node_id[:8])
+        # Drop its object copies from the directory.
+        for oid, locs in list(self.object_locations.items()):
+            locs.discard(node_id)
+            if not locs:
+                del self.object_locations[oid]
+        # Restart or kill its actors.
+        for actor_id, info in list(self.actors.items()):
+            if info.get("node_id") == node_id and info["state"] in (ALIVE, PENDING_CREATION):
+                await self._handle_actor_failure(actor_id, f"node {node_id[:8]} died")
+        await self._publish("node_updates", {"node_id": node_id, "state": "DEAD"})
+
+    # ------------------------------------------------------------------
+    # Actors (reference: gcs_actor_manager.h:281 + gcs_actor_scheduler.h)
+    # ------------------------------------------------------------------
+
+    async def rpc_register_actor(self, req):
+        spec = TaskSpec.from_wire(req["spec"])
+        actor_id = spec.actor_id
+        if spec.actor_name:
+            key = (spec.namespace, spec.actor_name)
+            existing = self.named_actors.get(key)
+            if existing is not None and self.actors[existing]["state"] != DEAD:
+                if spec.get_if_exists:
+                    return {"ok": True, "existing": True, "actor_id": existing}
+                return {"ok": False, "error": f"actor name {spec.actor_name!r} taken"}
+            self.named_actors[key] = actor_id
+        self.actors[actor_id] = {
+            "actor_id": actor_id,
+            "state": PENDING_CREATION,
+            "spec": req["spec"],
+            "address": None,
+            "node_id": None,
+            "worker_id": None,
+            "name": spec.actor_name,
+            "namespace": spec.namespace,
+            "num_restarts": 0,
+            "max_restarts": spec.max_restarts,
+            "death_cause": "",
+        }
+        ok = await self._schedule_actor_creation(actor_id)
+        if not ok:
+            return {"ok": False, "error": "no feasible node for actor"}
+        return {"ok": True, "existing": False, "actor_id": actor_id}
+
+    async def _schedule_actor_creation(self, actor_id: str) -> bool:
+        """Forward the creation task to a raylet (GcsActorScheduler analog)."""
+        info = self.actors[actor_id]
+        spec = TaskSpec.from_wire(info["spec"])
+        target = self._pick_node_for(spec)
+        if target is None:
+            return False
+        client = self._raylet_client(target)
+        try:
+            await client.acall("submit_task", {"spec": info["spec"]})
+            return True
+        except Exception:
+            logger.exception("failed to submit actor creation to node %s", target[:8])
+            return False
+
+    def _pick_node_for(self, spec: TaskSpec) -> str | None:
+        # Least-loaded feasible node.
+        best, best_score = None, None
+        for node_id, node in self.nodes.items():
+            if node["state"] != "ALIVE":
+                continue
+            total = node["resources_total"]
+            if any(total.get(k, 0) < v for k, v in spec.resources.items()):
+                continue
+            avail = node["resources_available"]
+            score = sum(avail.get(k, 0) / max(total.get(k, 1), 1) for k in ("CPU", "TPU"))
+            if best_score is None or score > best_score:
+                best, best_score = node_id, score
+        return best
+
+    async def rpc_actor_alive(self, req):
+        info = self.actors.get(req["actor_id"])
+        if info is None:
+            return {"ok": False}
+        info.update(
+            state=ALIVE,
+            address=req["address"],
+            node_id=req["node_id"],
+            worker_id=req.get("worker_id"),
+        )
+        await self._publish("actor_updates", {"actor_id": req["actor_id"], "state": ALIVE, "address": req["address"]})
+        return {"ok": True}
+
+    async def rpc_report_worker_death(self, req):
+        """Raylet reports a dead worker and any actor it hosted."""
+        for actor_id in req.get("actor_ids", []):
+            await self._handle_actor_failure(actor_id, req.get("reason", "worker died"))
+        return {"ok": True}
+
+    async def _handle_actor_failure(self, actor_id: str, reason: str):
+        info = self.actors.get(actor_id)
+        if info is None or info["state"] == DEAD:
+            return
+        max_restarts = info["max_restarts"]
+        if max_restarts == -1 or info["num_restarts"] < max_restarts:
+            info["num_restarts"] += 1
+            info["state"] = RESTARTING
+            info["address"] = None
+            await self._publish("actor_updates", {"actor_id": actor_id, "state": RESTARTING})
+            ok = await self._schedule_actor_creation(actor_id)
+            if ok:
+                return
+            reason += " (restart scheduling failed)"
+        info["state"] = DEAD
+        info["death_cause"] = reason
+        info["address"] = None
+        await self._publish("actor_updates", {"actor_id": actor_id, "state": DEAD, "reason": reason})
+
+    async def rpc_kill_actor(self, req):
+        actor_id = req["actor_id"]
+        info = self.actors.get(actor_id)
+        if info is None:
+            return {"ok": False}
+        no_restart = req.get("no_restart", True)
+        addr = info.get("address")
+        if no_restart:
+            info["state"] = DEAD
+            info["death_cause"] = "ray_tpu.kill"
+            if info.get("name"):
+                self.named_actors.pop((info["namespace"], info["name"]), None)
+        if addr:
+            try:
+                client = RpcClient(tuple(addr), label="actor-worker")
+                await client.acall("kill_self", {"no_restart": no_restart})
+                client.close()
+            except Exception:
+                pass
+        if no_restart:
+            await self._publish("actor_updates", {"actor_id": actor_id, "state": DEAD, "reason": "killed"})
+        return {"ok": True}
+
+    async def rpc_get_actor(self, req):
+        actor_id = req.get("actor_id")
+        if actor_id is None:
+            key = (req.get("namespace", ""), req["name"])
+            actor_id = self.named_actors.get(key)
+            if actor_id is None:
+                return {"found": False}
+        info = self.actors.get(actor_id)
+        if info is None:
+            return {"found": False}
+        out = {k: v for k, v in info.items() if k != "spec"}
+        return {"found": True, "info": out}
+
+    async def rpc_list_actors(self, req):
+        return {
+            "actors": [
+                {k: v for k, v in info.items() if k != "spec"} for info in self.actors.values()
+            ]
+        }
+
+    # ------------------------------------------------------------------
+    # KV store (reference: gcs_kv_manager.h; function table rides on this)
+    # ------------------------------------------------------------------
+
+    async def rpc_kv_put(self, req):
+        overwrite = req.get("overwrite", True)
+        key = req["key"]
+        if not overwrite and key in self.kv:
+            return {"ok": False, "added": False}
+        self.kv[key] = req["value"]
+        return {"ok": True, "added": True}
+
+    async def rpc_kv_get(self, req):
+        value = self.kv.get(req["key"])
+        return {"found": value is not None, "value": value}
+
+    async def rpc_kv_del(self, req):
+        existed = self.kv.pop(req["key"], None) is not None
+        return {"ok": True, "existed": existed}
+
+    async def rpc_kv_keys(self, req):
+        prefix = req.get("prefix", "")
+        return {"keys": [k for k in self.kv if k.startswith(prefix)]}
+
+    # ------------------------------------------------------------------
+    # Object directory
+    # ------------------------------------------------------------------
+
+    async def rpc_add_object_location(self, req):
+        self.object_locations.setdefault(req["object_id"], set()).add(req["node_id"])
+        return {"ok": True}
+
+    async def rpc_remove_object_location(self, req):
+        locs = self.object_locations.get(req["object_id"])
+        if locs:
+            locs.discard(req["node_id"])
+            if not locs:
+                del self.object_locations[req["object_id"]]
+        return {"ok": True}
+
+    async def rpc_get_object_locations(self, req):
+        locs = self.object_locations.get(req["object_id"], set())
+        out = []
+        for nid in locs:
+            node = self.nodes.get(nid)
+            if node and node["state"] == "ALIVE":
+                out.append({"node_id": nid, "address": node["address"]})
+        return {"locations": out}
+
+    # ------------------------------------------------------------------
+    # Placement groups (reference: gcs_placement_group_manager.h, 2PC in
+    # gcs_placement_group_scheduler.h; bundle policies PACK/SPREAD/
+    # STRICT_PACK/STRICT_SPREAD in policy/bundle_scheduling_policy.h:31)
+    # ------------------------------------------------------------------
+
+    async def rpc_create_placement_group(self, req):
+        pg_id = req["pg_id"]
+        bundles = req["bundles"]  # list[dict resource->qty]
+        strategy = req.get("strategy", "PACK")
+        self.placement_groups[pg_id] = {
+            "pg_id": pg_id,
+            "bundles": bundles,
+            "strategy": strategy,
+            "state": "PENDING",
+            "bundle_nodes": [None] * len(bundles),
+            "name": req.get("name", ""),
+        }
+        ok = await self._schedule_placement_group(pg_id)
+        return {"ok": ok, "state": self.placement_groups[pg_id]["state"]}
+
+    async def _schedule_placement_group(self, pg_id: str) -> bool:
+        pg = self.placement_groups[pg_id]
+        bundles, strategy = pg["bundles"], pg["strategy"]
+        alive = [(nid, n) for nid, n in self.nodes.items() if n["state"] == "ALIVE"]
+        plan = self._plan_bundles(bundles, strategy, alive)
+        if plan is None:
+            pg["state"] = "PENDING"  # infeasible now; retried on node join
+            return False
+        # Phase 1: prepare (reserve) on each node; Phase 2: commit.
+        reserved = []
+        try:
+            for idx, node_id in enumerate(plan):
+                client = self._raylet_client(node_id)
+                resp = await client.acall(
+                    "prepare_bundle",
+                    {"pg_id": pg_id, "bundle_index": idx, "resources": bundles[idx]},
+                )
+                if not resp.get("ok"):
+                    raise RuntimeError(f"bundle {idx} reserve failed on {node_id[:8]}")
+                reserved.append((idx, node_id))
+            for idx, node_id in reserved:
+                await self._raylet_client(node_id).acall(
+                    "commit_bundle", {"pg_id": pg_id, "bundle_index": idx}
+                )
+        except Exception as e:
+            logger.warning("PG %s scheduling rolled back: %s", pg_id[:8], e)
+            for idx, node_id in reserved:
+                try:
+                    await self._raylet_client(node_id).acall(
+                        "return_bundle", {"pg_id": pg_id, "bundle_index": idx}
+                    )
+                except Exception:
+                    pass
+            return False
+        pg["bundle_nodes"] = list(plan)
+        pg["state"] = "CREATED"
+        await self._publish("pg_updates", {"pg_id": pg_id, "state": "CREATED"})
+        return True
+
+    def _plan_bundles(self, bundles, strategy, alive):
+        """Bin-pack bundles onto nodes honoring the placement strategy."""
+        avail = {nid: dict(n["resources_available"]) for nid, n in alive}
+
+        def fits(nid, res):
+            return all(avail[nid].get(k, 0) >= v for k, v in res.items())
+
+        def take(nid, res):
+            for k, v in res.items():
+                avail[nid][k] = avail[nid].get(k, 0) - v
+
+        plan: list[str | None] = [None] * len(bundles)
+        if strategy == "STRICT_PACK":
+            # All bundles on a single node (maps to "one ICI slice" for TPU
+            # gang scheduling — see util/placement_group.py).
+            for nid, _ in alive:
+                trial = dict(avail[nid])
+                ok = True
+                for b in bundles:
+                    if all(trial.get(k, 0) >= v for k, v in b.items()):
+                        for k, v in b.items():
+                            trial[k] = trial.get(k, 0) - v
+                    else:
+                        ok = False
+                        break
+                if ok:
+                    return [nid] * len(bundles)
+            return None
+        if strategy == "STRICT_SPREAD":
+            if len(bundles) > len(alive):
+                return None
+            used_nodes: set[str] = set()
+            for i, b in enumerate(bundles):
+                placed = False
+                for nid, _ in alive:
+                    if nid in used_nodes:
+                        continue
+                    if fits(nid, b):
+                        take(nid, b)
+                        plan[i] = nid
+                        used_nodes.add(nid)
+                        placed = True
+                        break
+                if not placed:
+                    return None
+            return plan
+        # PACK / SPREAD best-effort.
+        order = list(alive)
+        for i, b in enumerate(bundles):
+            if strategy == "SPREAD":
+                order = sorted(alive, key=lambda kv: sum(1 for p in plan if p == kv[0]))
+            placed = False
+            for nid, _ in order:
+                if fits(nid, b):
+                    take(nid, b)
+                    plan[i] = nid
+                    placed = True
+                    break
+            if not placed:
+                return None
+        return plan
+
+    async def rpc_remove_placement_group(self, req):
+        pg = self.placement_groups.get(req["pg_id"])
+        if pg is None:
+            return {"ok": False}
+        for idx, node_id in enumerate(pg["bundle_nodes"]):
+            if node_id is None:
+                continue
+            try:
+                await self._raylet_client(node_id).acall(
+                    "return_bundle", {"pg_id": req["pg_id"], "bundle_index": idx}
+                )
+            except Exception:
+                pass
+        pg["state"] = "REMOVED"
+        return {"ok": True}
+
+    async def rpc_get_placement_group(self, req):
+        pg = self.placement_groups.get(req["pg_id"])
+        if pg is None:
+            return {"found": False}
+        return {"found": True, "info": pg}
+
+    # ------------------------------------------------------------------
+    # Jobs
+    # ------------------------------------------------------------------
+
+    async def rpc_next_job_id(self, req):
+        self._job_counter += 1
+        job_id = f"{self._job_counter:08x}"
+        self.jobs[job_id] = {"job_id": job_id, "state": "RUNNING", "start_time": time.time()}
+        return {"job_id": job_id}
+
+    # ------------------------------------------------------------------
+    # Task events (reference: gcs_task_manager.h; powers `ray timeline`)
+    # ------------------------------------------------------------------
+
+    async def rpc_record_task_events(self, req):
+        self.task_events.extend(req["events"])
+        overflow = len(self.task_events) - self.cfg.task_events_buffer_size
+        if overflow > 0:
+            del self.task_events[:overflow]
+        return {"ok": True}
+
+    async def rpc_get_task_events(self, req):
+        return {"events": self.task_events[-req.get("limit", 1000):]}
+
+    # ------------------------------------------------------------------
+    # Pub/sub (reference: src/ray/pubsub/publisher.h:307)
+    # ------------------------------------------------------------------
+
+    async def rpc_subscribe(self, req):
+        """Register the requesting connection for pushes on a channel.
+
+        Channels are fanned out over dedicated RpcClient connections the
+        subscriber opens toward GCS; the subscriber passes its own push-back
+        address and we connect back (long-poll-free push).
+        """
+        channel = req["channel"]
+        addr = req["address"]
+        client = RpcClient(tuple(addr) if isinstance(addr, list) else addr, label=f"sub-{channel}")
+        self._subscribers.setdefault(channel, []).append(client)
+        return {"ok": True}
+
+    async def _publish(self, channel: str, message: dict):
+        subs = self._subscribers.get(channel, [])
+        dead = []
+        for client in subs:
+            try:
+                await client.apush("pubsub", {"channel": channel, "message": message})
+            except Exception:
+                dead.append(client)
+        for d in dead:
+            subs.remove(d)
+
+    async def rpc_publish(self, req):
+        await self._publish(req["channel"], req["message"])
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
+    # Persistence (reference: HA GCS via redis_store_client.h + gcs_init_data.h)
+    # ------------------------------------------------------------------
+
+    def _snapshot(self) -> dict:
+        return {
+            "kv": {k: v.hex() if isinstance(v, bytes) else v for k, v in self.kv.items()},
+            "named_actors": {f"{ns}\x00{name}": aid for (ns, name), aid in self.named_actors.items()},
+            "job_counter": self._job_counter,
+        }
+
+    def save_snapshot(self):
+        if not self.persist_path:
+            return
+        with open(self.persist_path, "w") as f:
+            json.dump(self._snapshot(), f)
+
+    def _load_snapshot(self):
+        with open(self.persist_path) as f:
+            snap = json.load(f)
+        self.kv = {k: bytes.fromhex(v) for k, v in snap.get("kv", {}).items()}
+        for key, aid in snap.get("named_actors", {}).items():
+            ns, name = key.split("\x00", 1)
+            self.named_actors[(ns, name)] = aid
+        self._job_counter = snap.get("job_counter", 0)
+
+    def _raylet_client(self, node_id: str) -> RpcClient:
+        client = self._raylet_clients.get(node_id)
+        if client is None:
+            node = self.nodes[node_id]
+            client = RpcClient(tuple(node["address"]), label=f"raylet-{node_id[:8]}")
+            self._raylet_clients[node_id] = client
+        return client
+
+    def stop(self):
+        self._health_task.cancel()
+        self.save_snapshot()
+        for c in self._raylet_clients.values():
+            c.close()
+        self.server.stop()
+
+
+def main():
+    import argparse
+
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--address-file", default="")
+    parser.add_argument("--persist-path", default="")
+    args = parser.parse_args()
+    server = GcsServer(args.host, args.port, persist_path=args.persist_path or None)
+    if args.address_file:
+        tmp = args.address_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"address": list(server.address)}, f)
+        os.replace(tmp, args.address_file)
+    import threading
+
+    threading.Event().wait()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,694 @@
+"""Raylet — per-node daemon.
+
+TPU-native analog of the reference's raylet process (src/ray/raylet/main.cc:109,
+NodeManager node_manager.h:117): hosts
+
+- the node's shared-memory object store daemon (StoreCore; reference runs
+  plasma inside the raylet too, plasma/store_runner.h)
+- the worker pool: spawns/pools Python worker processes
+  (worker_pool.cc:426 StartWorkerProcess, :1150 PopWorker)
+- the two-level scheduler: cluster-level placement with spillback to other
+  raylets (cluster_task_manager.h:42) and local dispatch to leased workers
+  (local_task_manager.h:58), with placement-group bundle accounting
+  (placement_group_resource_manager.h)
+- chunked node-to-node object transfer (object_manager.h:117, pull_manager.h:52)
+- heartbeat/resource sync with GCS (ray_syncer.h:86) and worker-failure
+  reporting.
+
+TPU chips are first-class resources here: a node's resource set is
+{"CPU": n, "TPU": m, "memory": bytes, ...custom}, with slice topology carried
+in node labels (e.g. {"tpu_slice": "v5e-8", "ici_group": "..."}) so placement
+groups can gang-schedule onto ICI domains.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import subprocess
+import sys
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+from ray_tpu._private.config import get_config
+from ray_tpu._private.ids import NodeID, WorkerID
+from ray_tpu._private.rpc import EventLoopThread, RpcClient, RpcServer
+from ray_tpu._private.store.arena import create_arena
+from ray_tpu._private.store.object_store import StoreCore
+from ray_tpu._private.task_spec import TaskSpec
+
+logger = logging.getLogger(__name__)
+
+CHUNK = 4 * 1024 * 1024
+
+
+@dataclass
+class WorkerHandle:
+    worker_id: str
+    pid: int
+    address: tuple | None = None
+    client: RpcClient | None = None
+    proc: subprocess.Popen | None = None
+    state: str = "starting"  # starting | idle | busy | actor | dead
+    current_task: TaskSpec | None = None
+    actor_id: str | None = None
+    last_idle: float = field(default_factory=time.monotonic)
+
+
+class Raylet:
+    def __init__(
+        self,
+        gcs_address,
+        session_dir: str,
+        resources: dict | None = None,
+        labels: dict | None = None,
+        node_ip: str = "127.0.0.1",
+        object_store_memory: int | None = None,
+    ):
+        self.cfg = get_config()
+        self.node_id = NodeID.from_random().hex()
+        self.session_dir = session_dir
+        self.node_ip = node_ip
+        os.makedirs(session_dir, exist_ok=True)
+
+        self.arena_name = f"/rtpu_{self.node_id[:12]}"
+        capacity = object_store_memory or self.cfg.object_store_memory
+        self.arena = create_arena(self.arena_name, capacity)
+        spill_dir = self.cfg.object_spill_dir or os.path.join(session_dir, "spill", self.node_id[:8])
+        self.store = StoreCore(self.arena, spill_dir)
+
+        self.resources_total = dict(resources or {"CPU": os.cpu_count() or 1})
+        self.resources_total.setdefault("memory", 4 * 1024 * 1024 * 1024)
+        self.resources_available = dict(self.resources_total)
+        # Placement-group bundle pools: (pg_id, idx) -> {resource: available}.
+        self.bundles: dict[tuple, dict] = {}
+        self.bundle_reserved: dict[tuple, dict] = {}
+        self.labels = dict(labels or {})
+
+        self.workers: dict[str, WorkerHandle] = {}
+        self.task_queue: deque[TaskSpec] = deque()
+        self.cluster_view: dict = {}
+        self._pulls_inflight: dict[str, asyncio.Future] = {}
+        self._peer_clients: dict[str, RpcClient] = {}
+
+        self.server = RpcServer(f"raylet-{self.node_id[:8]}")
+        self.server.register_all(self)
+        self.server.start(node_ip, 0)
+        self.address = self.server.address
+
+        self.gcs = RpcClient(tuple(gcs_address) if isinstance(gcs_address, (list, tuple)) else gcs_address, label="gcs")
+        self._io = EventLoopThread.get()
+        self._io.run(self._register())
+        self._hb_task = self._io.spawn(self._heartbeat_loop())
+        self._reap_task = self._io.spawn(self._reap_loop())
+        self._stopped = False
+
+    async def _register(self):
+        await self.gcs.acall(
+            "register_node",
+            {
+                "node_id": self.node_id,
+                "address": list(self.address),
+                "resources": self.resources_total,
+                "labels": self.labels,
+                "arena_name": self.arena_name,
+            },
+        )
+
+    async def _heartbeat_loop(self):
+        while True:
+            try:
+                resp = await self.gcs.acall(
+                    "heartbeat",
+                    {
+                        "node_id": self.node_id,
+                        "resources_available": self.resources_available,
+                        "store_usage": self.store.usage(),
+                    },
+                )
+                if resp.get("dead"):
+                    logger.error("raylet %s: GCS declared us dead; exiting", self.node_id[:8])
+                    os._exit(1)
+                self.cluster_view = resp.get("nodes", {})
+                await self._retry_pg_tasks()
+            except Exception:
+                pass
+            await asyncio.sleep(self.cfg.heartbeat_interval_s)
+
+    async def _retry_pg_tasks(self):
+        """Re-route queued tasks that cannot run on this node: PG tasks whose
+        bundle lives elsewhere, locally-infeasible tasks awaiting spillback
+        (the cluster view may have been empty at submit), and strict
+        node-affinity tasks targeting another node."""
+        stuck = [s for s in self.task_queue if self._must_reroute(s)]
+        for spec in stuck:
+            self.task_queue.remove(spec)
+            await self._queue_and_schedule(spec)
+
+    def _must_reroute(self, spec: TaskSpec) -> bool:
+        if spec.placement_group_id:
+            return self._resource_pool(spec) is None
+        strategy = spec.scheduling_strategy or "DEFAULT"
+        if strategy.startswith("node:"):
+            parts = strategy.split(":")
+            return parts[1] != self.node_id and not (len(parts) > 2 and parts[2] == "soft")
+        feasible_here = all(
+            self.resources_total.get(k, 0) >= v for k, v in spec.resources.items()
+        )
+        return not feasible_here
+
+    # ------------------------------------------------------------------
+    # Store RPC surface (clients on this node)
+    # ------------------------------------------------------------------
+
+    async def rpc_store_create(self, req):
+        offset = await self.store.create(req["object_id"], req["size"])
+        if offset is None:
+            return {"offset": 0, "exists": True}
+        return {"offset": offset, "exists": False}
+
+    async def rpc_store_seal(self, req):
+        self.store.seal(req["object_id"])
+        await self.gcs.acall(
+            "add_object_location", {"object_id": req["object_id"], "node_id": self.node_id}
+        )
+        return {"ok": True}
+
+    async def rpc_store_abort(self, req):
+        self.store.abort(req["object_id"])
+        return {"ok": True}
+
+    async def rpc_store_get(self, req):
+        object_id = req["object_id"]
+        timeout = req.get("timeout")
+        if object_id not in self.store.objects:
+            # Not local: pull from a remote copy (reference: PullManager).
+            await self._pull_object(object_id, timeout)
+        offset, size = await self.store.get(object_id, timeout)
+        return {"offset": offset, "size": size}
+
+    async def rpc_store_contains(self, req):
+        return {"found": self.store.contains(req["object_id"])}
+
+    async def rpc_store_release(self, req):
+        self.store.release(req["object_id"])
+        return {"ok": True}
+
+    async def rpc_free_object(self, req):
+        """Owner frees an object cluster-wide (ref count hit zero)."""
+        object_id = req["object_id"]
+        resp = await self.gcs.acall("get_object_locations", {"object_id": object_id})
+        for loc in resp["locations"]:
+            if loc["node_id"] == self.node_id:
+                self.store.delete(object_id)
+                await self.gcs.acall(
+                    "remove_object_location", {"object_id": object_id, "node_id": self.node_id}
+                )
+            else:
+                try:
+                    await self._peer(loc["node_id"], loc["address"]).acall(
+                        "delete_local_object", {"object_id": object_id}
+                    )
+                except Exception:
+                    pass
+        return {"ok": True}
+
+    async def rpc_delete_local_object(self, req):
+        self.store.delete(req["object_id"])
+        await self.gcs.acall(
+            "remove_object_location", {"object_id": req["object_id"], "node_id": self.node_id}
+        )
+        return {"ok": True}
+
+    # ---- node-to-node transfer (reference: object_manager.h push/pull) ----
+
+    async def rpc_fetch_object_info(self, req):
+        object_id = req["object_id"]
+        if not self.store.contains(object_id):
+            return {"found": False}
+        offset, size = await self.store.get(object_id)
+        self.store.release(object_id)
+        return {"found": True, "size": size}
+
+    async def rpc_fetch_object_chunk(self, req):
+        object_id = req["object_id"]
+        offset, size = await self.store.get(object_id)
+        try:
+            start = req["start"]
+            end = min(start + req["length"], size)
+            data = bytes(self.arena.read(offset + start, end - start))
+            return {"data": data}
+        finally:
+            self.store.release(object_id)
+
+    async def _pull_object(self, object_id: str, timeout: float | None):
+        fut = self._pulls_inflight.get(object_id)
+        if fut is not None:
+            await fut
+            return
+        fut = asyncio.get_event_loop().create_future()
+        self._pulls_inflight[object_id] = fut
+        try:
+            deadline = time.monotonic() + (timeout if timeout is not None else 3600.0)
+            while time.monotonic() < deadline:
+                resp = await self.gcs.acall("get_object_locations", {"object_id": object_id})
+                locs = [l for l in resp["locations"] if l["node_id"] != self.node_id]
+                if not locs:
+                    await asyncio.sleep(0.05)
+                    continue
+                loc = locs[0]
+                peer = self._peer(loc["node_id"], loc["address"])
+                try:
+                    info = await peer.acall("fetch_object_info", {"object_id": object_id})
+                    if not info.get("found"):
+                        await asyncio.sleep(0.05)
+                        continue
+                    size = info["size"]
+                    offset = await self.store.create(object_id, size)
+                    pos = 0
+                    while pos < size:
+                        chunk = await peer.acall(
+                            "fetch_object_chunk",
+                            {"object_id": object_id, "start": pos, "length": CHUNK},
+                        )
+                        data = chunk["data"]
+                        self.arena.write(offset + pos, data)
+                        pos += len(data)
+                    self.store.seal(object_id)
+                    await self.gcs.acall(
+                        "add_object_location", {"object_id": object_id, "node_id": self.node_id}
+                    )
+                    fut.set_result(True)
+                    return
+                except Exception as e:
+                    logger.debug("pull of %s from %s failed: %s", object_id[:8], loc["node_id"][:8], e)
+                    self.store.abort(object_id)
+                    await asyncio.sleep(0.05)
+            raise TimeoutError(f"pull of {object_id} timed out")
+        except BaseException as e:
+            if not fut.done():
+                fut.set_exception(e)
+            raise
+        finally:
+            self._pulls_inflight.pop(object_id, None)
+            if not fut.done():
+                fut.set_result(False)
+
+    def _peer(self, node_id: str, address) -> RpcClient:
+        client = self._peer_clients.get(node_id)
+        if client is None:
+            client = RpcClient(tuple(address), label=f"peer-{node_id[:8]}")
+            self._peer_clients[node_id] = client
+        return client
+
+    # ------------------------------------------------------------------
+    # Placement-group bundles (2PC; reference: placement_group_resource_manager.h)
+    # ------------------------------------------------------------------
+
+    async def rpc_prepare_bundle(self, req):
+        key = (req["pg_id"], req["bundle_index"])
+        res = req["resources"]
+        if any(self.resources_available.get(k, 0) < v for k, v in res.items()):
+            return {"ok": False}
+        for k, v in res.items():
+            self.resources_available[k] -= v
+        self.bundle_reserved[key] = dict(res)
+        return {"ok": True}
+
+    async def rpc_commit_bundle(self, req):
+        key = (req["pg_id"], req["bundle_index"])
+        res = self.bundle_reserved.pop(key, None)
+        if res is None:
+            return {"ok": False}
+        self.bundles[key] = dict(res)
+        return {"ok": True}
+
+    async def rpc_return_bundle(self, req):
+        key = (req["pg_id"], req["bundle_index"])
+        res = self.bundle_reserved.pop(key, None) or self.bundles.pop(key, None)
+        if res:
+            for k, v in res.items():
+                self.resources_available[k] = self.resources_available.get(k, 0) + v
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
+    # Scheduling (reference: ClusterTaskManager + LocalTaskManager)
+    # ------------------------------------------------------------------
+
+    async def rpc_submit_task(self, req):
+        spec = TaskSpec.from_wire(req["spec"])
+        await self._queue_and_schedule(spec)
+        return {"ok": True}
+
+    async def _queue_and_schedule(self, spec: TaskSpec):
+        if spec.placement_group_id and self._resource_pool(spec) is None:
+            # Bundle lives elsewhere: ask GCS for its node and forward there.
+            resp = await self.gcs.acall(
+                "get_placement_group", {"pg_id": spec.placement_group_id}
+            )
+            if resp.get("found"):
+                idx = max(spec.placement_group_bundle_index, 0)
+                bundle_nodes = resp["info"]["bundle_nodes"]
+                target_node = bundle_nodes[idx] if idx < len(bundle_nodes) else None
+                if target_node and target_node != self.node_id:
+                    node = self.cluster_view.get(target_node)
+                    if node is not None:
+                        await self._peer(target_node, node["address"]).acall(
+                            "submit_task", {"spec": spec.to_wire()}
+                        )
+                        return
+            # Bundle not placed yet: queue; dispatch retries as views update.
+            self.task_queue.append(spec)
+            await self._dispatch()
+            return
+        target = self._pick_node(spec)
+        if target is not None and target != self.node_id:
+            # Spillback (reference: cluster_task_manager.cc:44 + spillback reply).
+            node = self.cluster_view.get(target)
+            if node is not None:
+                try:
+                    await self._peer(target, node["address"]).acall("submit_task", {"spec": spec.to_wire()})
+                    return
+                except Exception:
+                    pass
+        self.task_queue.append(spec)
+        await self._dispatch()
+
+    def _feasible_local(self, spec: TaskSpec) -> bool:
+        pool = self._resource_pool(spec)
+        total = self.resources_total if pool is self.resources_available else pool
+        return all(total.get(k, 0) >= v for k, v in spec.resources.items())
+
+    def _resource_pool(self, spec: TaskSpec):
+        if spec.placement_group_id:
+            key = (spec.placement_group_id, max(spec.placement_group_bundle_index, 0))
+            return self.bundles.get(key)
+        return self.resources_available
+
+    def _pick_node(self, spec: TaskSpec) -> str | None:
+        """Cluster-level placement: hybrid pack-then-spread policy
+        (reference: policy/hybrid_scheduling_policy.h:50)."""
+        strategy = spec.scheduling_strategy or "DEFAULT"
+        if spec.placement_group_id:
+            return self.node_id if self._resource_pool(spec) is not None else self._pg_bundle_node(spec)
+        if strategy.startswith("node:"):
+            parts = strategy.split(":")
+            node_id = parts[1]
+            soft = len(parts) > 2 and parts[2] == "soft"
+            if node_id == self.node_id or node_id in self.cluster_view:
+                return node_id
+            return self.node_id if soft else None
+        feasible_here = all(
+            self.resources_total.get(k, 0) >= v for k, v in spec.resources.items()
+        )
+        fits_now = all(
+            self.resources_available.get(k, 0) >= v for k, v in spec.resources.items()
+        )
+        if strategy == "SPREAD":
+            # Round-robin across feasible nodes by lowest utilisation.
+            best, best_score = None, None
+            for nid, node in {**self.cluster_view, self.node_id: self._self_view()}.items():
+                total, avail = node["resources_total"], node["resources_available"]
+                if any(total.get(k, 0) < v for k, v in spec.resources.items()):
+                    continue
+                score = sum(avail.get(k, 0) / max(total.get(k, 1), 1) for k in total)
+                if best_score is None or score > best_score:
+                    best, best_score = nid, score
+            return best
+        if fits_now or feasible_here:
+            return self.node_id
+        # Infeasible here: find a feasible peer.
+        for nid, node in self.cluster_view.items():
+            if nid == self.node_id:
+                continue
+            if all(node["resources_total"].get(k, 0) >= v for k, v in spec.resources.items()):
+                return nid
+        return self.node_id if feasible_here else None
+
+    def _pg_bundle_node(self, spec: TaskSpec) -> str | None:
+        # Bundle lives on another node; ask GCS which.
+        return None  # handled by core_worker resolving bundle location up front
+
+    def _self_view(self):
+        return {
+            "resources_total": self.resources_total,
+            "resources_available": self.resources_available,
+            "address": list(self.address),
+        }
+
+    async def _dispatch(self):
+        """Local dispatch loop (reference: local_task_manager.cc:101)."""
+        made_progress = True
+        while made_progress and self.task_queue:
+            made_progress = False
+            for _ in range(len(self.task_queue)):
+                spec = self.task_queue.popleft()
+                if self._must_reroute(spec):
+                    # Wrong node for this task; the heartbeat loop re-routes it
+                    # once the cluster view / PG placement catches up.
+                    self.task_queue.append(spec)
+                    continue
+                pool = self._resource_pool(spec)
+                if pool is None:
+                    self.task_queue.append(spec)
+                    continue
+                if any(pool.get(k, 0) < v for k, v in spec.resources.items()):
+                    self.task_queue.append(spec)
+                    continue
+                worker = self._pop_idle_worker()
+                if worker is None:
+                    if self._num_live_workers() < self.cfg.max_workers_per_node:
+                        self._start_worker()
+                    self.task_queue.appendleft(spec)
+                    return
+                for k, v in spec.resources.items():
+                    pool[k] = pool.get(k, 0) - v
+                worker.state = "actor" if spec.is_actor_creation() else "busy"
+                worker.current_task = spec
+                if spec.is_actor_creation():
+                    worker.actor_id = spec.actor_id
+                made_progress = True
+                asyncio.ensure_future(self._push_to_worker(worker, spec))
+
+    async def _push_to_worker(self, worker: WorkerHandle, spec: TaskSpec):
+        try:
+            await worker.client.acall(
+                "push_task",
+                {"spec": spec.to_wire(), "assigned_resources": spec.resources},
+            )
+        except Exception:
+            logger.exception("push_task to worker %s failed", worker.worker_id[:8])
+            await self._on_worker_death(worker, "push_task failed")
+
+    def _pop_idle_worker(self) -> WorkerHandle | None:
+        for w in self.workers.values():
+            if w.state == "idle":
+                return w
+        return None
+
+    def _num_live_workers(self) -> int:
+        return sum(1 for w in self.workers.values() if w.state != "dead")
+
+    # ---- worker pool (reference: worker_pool.cc) ----
+
+    def _start_worker(self):
+        worker_id = WorkerID.from_random().hex()
+        env = os.environ.copy()
+        env["RAY_TPU_WORKER_ID"] = worker_id
+        env["RAY_TPU_NODE_ID"] = self.node_id
+        env["RAY_TPU_RAYLET_ADDR"] = json.dumps(list(self.address))
+        env["RAY_TPU_GCS_ADDR"] = json.dumps(list(self.gcs.address))
+        env["RAY_TPU_ARENA_NAME"] = self.arena_name
+        env["RAY_TPU_SESSION_DIR"] = self.session_dir
+        # Workers must import the same modules the driver pickles by reference
+        # (cloudpickle serializes importable functions by name); ship the
+        # driver-side sys.path (reference: runtime-env py_modules/working_dir).
+        extra_path = os.pathsep.join(p for p in sys.path if p)
+        env["PYTHONPATH"] = (
+            extra_path + os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else extra_path
+        )
+        log_path = os.path.join(self.session_dir, "logs", f"worker-{worker_id[:8]}")
+        os.makedirs(os.path.dirname(log_path), exist_ok=True)
+        stdout = open(log_path + ".out", "ab")
+        stderr = open(log_path + ".err", "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_main"],
+            env=env,
+            stdout=stdout,
+            stderr=stderr,
+            cwd=os.getcwd(),
+        )
+        self.workers[worker_id] = WorkerHandle(worker_id=worker_id, pid=proc.pid, proc=proc)
+
+    async def rpc_register_worker(self, req):
+        worker_id = req["worker_id"]
+        handle = self.workers.get(worker_id)
+        if handle is None:
+            handle = WorkerHandle(worker_id=worker_id, pid=req["pid"])
+            self.workers[worker_id] = handle
+        handle.address = tuple(req["address"])
+        handle.client = RpcClient(handle.address, label=f"worker-{worker_id[:8]}")
+        handle.state = "idle"
+        handle.last_idle = time.monotonic()
+        await self._dispatch()
+        return {"ok": True, "node_id": self.node_id}
+
+    async def rpc_task_finished(self, req):
+        """Worker reports completion; release resources + lease for reuse."""
+        worker = self.workers.get(req["worker_id"])
+        if worker is None:
+            return {"ok": False}
+        spec = worker.current_task
+        if spec is not None:
+            pool = self._resource_pool(spec)
+            if pool is not None:
+                for k, v in spec.resources.items():
+                    pool[k] = pool.get(k, 0) + v
+        worker.current_task = None
+        if worker.state == "busy":
+            worker.state = "idle"
+            worker.last_idle = time.monotonic()
+        await self._dispatch()
+        return {"ok": True}
+
+    async def rpc_actor_ready(self, req):
+        """Actor finished __init__; keep the worker dedicated but free to serve."""
+        worker = self.workers.get(req["worker_id"])
+        if worker is not None:
+            worker.current_task = None
+        return {"ok": True}
+
+    async def _reap_loop(self):
+        """Monitor worker processes; report deaths (reference: worker failure path)."""
+        while True:
+            await asyncio.sleep(0.2)
+            for worker in list(self.workers.values()):
+                if worker.state == "dead":
+                    continue
+                if worker.proc is not None and worker.proc.poll() is not None:
+                    await self._on_worker_death(
+                        worker, f"worker process exited with code {worker.proc.returncode}"
+                    )
+            # Scale down long-idle workers beyond the prestart floor.
+            now = time.monotonic()
+            idle = [w for w in self.workers.values() if w.state == "idle"]
+            for w in idle[self.cfg.prestart_workers:] if len(idle) > self.cfg.prestart_workers else []:
+                if now - w.last_idle > self.cfg.worker_idle_timeout_s:
+                    w.state = "dead"
+                    if w.proc is not None:
+                        w.proc.terminate()
+
+    async def _on_worker_death(self, worker: WorkerHandle, reason: str):
+        if worker.state == "dead":
+            return
+        prev_state = worker.state
+        worker.state = "dead"
+        spec = worker.current_task
+        logger.warning("worker %s died: %s", worker.worker_id[:8], reason)
+        if spec is not None:
+            pool = self._resource_pool(spec)
+            if pool is not None:
+                for k, v in spec.resources.items():
+                    pool[k] = pool.get(k, 0) + v
+            # Tell the owner so it can retry (reference: task_manager.h:335).
+            if spec.owner_addr:
+                try:
+                    owner = RpcClient(tuple(spec.owner_addr), label="owner")
+                    await owner.acall(
+                        "task_failed",
+                        {
+                            "task_id": spec.task_id,
+                            "error": "WorkerCrashedError",
+                            "message": reason,
+                            "retriable": True,
+                        },
+                    )
+                    owner.close()
+                except Exception:
+                    pass
+        if prev_state == "actor" and worker.actor_id:
+            try:
+                await self.gcs.acall(
+                    "report_worker_death",
+                    {"actor_ids": [worker.actor_id], "reason": reason},
+                )
+            except Exception:
+                pass
+        worker.current_task = None
+        await self._dispatch()
+
+    # ------------------------------------------------------------------
+    # Introspection
+    # ------------------------------------------------------------------
+
+    async def rpc_get_state(self, req):
+        return {
+            "node_id": self.node_id,
+            "resources_total": self.resources_total,
+            "resources_available": self.resources_available,
+            "num_workers": self._num_live_workers(),
+            "queued_tasks": len(self.task_queue),
+            "store": self.store.usage(),
+            "workers": {
+                wid: {"state": w.state, "pid": w.pid, "actor_id": w.actor_id}
+                for wid, w in self.workers.items()
+            },
+        }
+
+    def stop(self):
+        if self._stopped:
+            return
+        self._stopped = True
+        self._hb_task.cancel()
+        self._reap_task.cancel()
+        for w in self.workers.values():
+            if w.proc is not None and w.proc.poll() is None:
+                w.proc.terminate()
+        for w in self.workers.values():
+            if w.proc is not None:
+                try:
+                    w.proc.wait(timeout=2)
+                except Exception:
+                    w.proc.kill()
+        self.server.stop()
+        self.gcs.close()
+        for c in self._peer_clients.values():
+            c.close()
+        self.store.close()
+
+
+def main():
+    import argparse
+
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gcs-address", required=True)
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--resources", default="{}")
+    parser.add_argument("--labels", default="{}")
+    parser.add_argument("--object-store-memory", type=int, default=0)
+    parser.add_argument("--address-file", default="")
+    args = parser.parse_args()
+    gcs_addr = json.loads(args.gcs_address)
+    raylet = Raylet(
+        gcs_addr,
+        args.session_dir,
+        resources=json.loads(args.resources) or None,
+        labels=json.loads(args.labels),
+        object_store_memory=args.object_store_memory or None,
+    )
+    if args.address_file:
+        tmp = args.address_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"address": list(raylet.address), "node_id": raylet.node_id, "arena": raylet.arena_name}, f)
+        os.replace(tmp, args.address_file)
+    import threading
+
+    threading.Event().wait()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,344 @@
+"""Async RPC layer.
+
+TPU-native analog of the reference's gRPC server/client wrappers
+(src/ray/rpc/grpc_server.h:73, src/ray/rpc/client_call.h:181): length-prefixed
+msgpack frames over asyncio TCP/Unix sockets, with a method-dispatch server,
+retrying clients, and one background IO event loop per process (the analog of
+the reference's instrumented_io_context, src/ray/common/asio/).
+
+Wire format: 4-byte big-endian length, then a msgpack array
+``[type, seq, method, payload]`` where type is REQUEST/RESPONSE/ERROR/PUSH.
+Payloads are msgpack-native structures; rich Python objects are serialized by
+the caller (see serialization.py) before they enter the RPC layer.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import logging
+import threading
+import time
+import traceback
+from typing import Any, Awaitable, Callable
+
+import msgpack
+
+logger = logging.getLogger(__name__)
+
+REQUEST, RESPONSE, ERROR, PUSH = 0, 1, 2, 3
+
+_MAX_FRAME = 1 << 31
+
+
+class RpcError(Exception):
+    pass
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+def _pack(msg) -> bytes:
+    body = msgpack.packb(msg, use_bin_type=True)
+    return len(body).to_bytes(4, "big") + body
+
+
+async def _read_frame(reader: asyncio.StreamReader):
+    header = await reader.readexactly(4)
+    length = int.from_bytes(header, "big")
+    if length > _MAX_FRAME:
+        raise RpcError(f"frame too large: {length}")
+    body = await reader.readexactly(length)
+    return msgpack.unpackb(body, raw=False)
+
+
+class EventLoopThread:
+    """One background asyncio loop per process; all sockets live here.
+
+    Analog of the per-process instrumented_io_context event loop in the
+    reference (src/ray/common/asio/instrumented_io_context.h:27), including
+    per-handler call stats for debugging.
+    """
+
+    _instance: "EventLoopThread | None" = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self):
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._run, name="ray_tpu_io", daemon=True
+        )
+        self.handler_stats: dict[str, list] = collections.defaultdict(
+            lambda: [0, 0.0]
+        )  # name -> [count, total_s]
+        self._thread.start()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    @classmethod
+    def get(cls) -> "EventLoopThread":
+        with cls._instance_lock:
+            if cls._instance is None or not cls._instance._thread.is_alive():
+                cls._instance = cls()
+            return cls._instance
+
+    @classmethod
+    def reset(cls):
+        with cls._instance_lock:
+            inst, cls._instance = cls._instance, None
+        if inst is not None:
+            inst.loop.call_soon_threadsafe(inst.loop.stop)
+
+    def run(self, coro: Awaitable, timeout: float | None = None):
+        """Run a coroutine on the IO loop from any other thread, blocking."""
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def spawn(self, coro: Awaitable) -> "asyncio.Future":
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+
+Handler = Callable[[dict], Awaitable[Any]]
+
+
+class RpcServer:
+    """Method-dispatch RPC server. Register async handlers by name."""
+
+    def __init__(self, name: str = "server"):
+        self.name = name
+        self._handlers: dict[str, Handler] = {}
+        self._server: asyncio.Server | None = None
+        self._conns: set[asyncio.StreamWriter] = set()
+        self.address: tuple[str, int] | str | None = None
+        self._io = EventLoopThread.get()
+
+    def register(self, method: str, handler: Handler):
+        self._handlers[method] = handler
+
+    def register_all(self, obj, prefix: str = ""):
+        """Register every ``rpc_<name>`` coroutine method of obj as <name>."""
+        for attr in dir(obj):
+            if attr.startswith("rpc_"):
+                self._handlers[prefix + attr[4:]] = getattr(obj, attr)
+
+    async def _serve_conn(self, reader, writer):
+        self._conns.add(writer)
+        try:
+            while True:
+                try:
+                    mtype, seq, method, payload = await _read_frame(reader)
+                except (asyncio.IncompleteReadError, ConnectionResetError):
+                    return
+                if mtype == REQUEST:
+                    asyncio.ensure_future(
+                        self._dispatch(writer, seq, method, payload)
+                    )
+                elif mtype == PUSH:
+                    asyncio.ensure_future(self._dispatch(None, seq, method, payload))
+        finally:
+            self._conns.discard(writer)
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _dispatch(self, writer, seq, method, payload):
+        start = time.monotonic()
+        handler = self._handlers.get(method)
+        try:
+            if handler is None:
+                raise RpcError(f"no handler for method {method!r} on {self.name}")
+            result = await handler(payload)
+            if writer is not None:
+                writer.write(_pack([RESPONSE, seq, method, result]))
+                await writer.drain()
+        except Exception as e:
+            if writer is not None:
+                err = {"error": repr(e), "traceback": traceback.format_exc()}
+                try:
+                    writer.write(_pack([ERROR, seq, method, err]))
+                    await writer.drain()
+                except Exception:
+                    pass
+            else:
+                logger.exception("push handler %s failed", method)
+        finally:
+            stats = self._io.handler_stats[f"{self.name}.{method}"]
+            stats[0] += 1
+            stats[1] += time.monotonic() - start
+
+    async def _start_tcp(self, host: str, port: int):
+        self._server = await asyncio.start_server(self._serve_conn, host, port)
+        sock = self._server.sockets[0]
+        self.address = sock.getsockname()[:2]
+
+    async def _start_unix(self, path: str):
+        self._server = await asyncio.start_unix_server(self._serve_conn, path)
+        self.address = path
+
+    def start(self, host: str = "127.0.0.1", port: int = 0):
+        self._io.run(self._start_tcp(host, port))
+        return self.address
+
+    def start_unix(self, path: str):
+        self._io.run(self._start_unix(path))
+        return self.address
+
+    def stop(self):
+        async def _stop():
+            if self._server is not None:
+                self._server.close()
+            for w in list(self._conns):
+                try:
+                    w.close()
+                except Exception:
+                    pass
+
+        try:
+            self._io.run(_stop(), timeout=5)
+        except Exception:
+            pass
+
+
+class RpcClient:
+    """Retrying RPC client; safe to call from any thread or from the IO loop."""
+
+    def __init__(self, address, label: str = "", connect_timeout: float | None = None):
+        from ray_tpu._private.config import get_config
+
+        cfg = get_config()
+        self.address = address
+        self.label = label or str(address)
+        self._io = EventLoopThread.get()
+        self._connect_timeout = connect_timeout or cfg.rpc_connect_timeout_s
+        self._retries = cfg.rpc_retries
+        self._retry_delay = cfg.rpc_retry_delay_s
+        self._lock = asyncio.Lock()
+        self._writer: asyncio.StreamWriter | None = None
+        self._reader_task: asyncio.Task | None = None
+        self._pending: dict[int, asyncio.Future] = {}
+        self._seq = 0
+        self._push_handler: Callable[[str, dict], None] | None = None
+        self._closed = False
+
+    # ---- connection management (runs on IO loop) ----
+
+    async def _ensure_connected(self):
+        if self._writer is not None and not self._writer.is_closing():
+            return
+        deadline = time.monotonic() + self._connect_timeout
+        last_err = None
+        while time.monotonic() < deadline:
+            try:
+                if isinstance(self.address, str):
+                    reader, writer = await asyncio.open_unix_connection(self.address)
+                else:
+                    reader, writer = await asyncio.open_connection(*self.address)
+                self._writer = writer
+                self._reader_task = asyncio.ensure_future(self._read_loop(reader))
+                return
+            except OSError as e:
+                last_err = e
+                await asyncio.sleep(0.05)
+        raise ConnectionLost(f"cannot connect to {self.label}: {last_err}")
+
+    async def _read_loop(self, reader):
+        try:
+            while True:
+                mtype, seq, method, payload = await _read_frame(reader)
+                if mtype in (RESPONSE, ERROR):
+                    fut = self._pending.pop(seq, None)
+                    if fut is not None and not fut.done():
+                        if mtype == RESPONSE:
+                            fut.set_result(payload)
+                        else:
+                            fut.set_exception(
+                                RpcError(
+                                    f"{self.label}.{method}: {payload['error']}\n"
+                                    + payload.get("traceback", "")
+                                )
+                            )
+                elif mtype == PUSH and self._push_handler is not None:
+                    try:
+                        self._push_handler(method, payload)
+                    except Exception:
+                        logger.exception("push handler failed")
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
+            pass
+        finally:
+            self._writer = None
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionLost(f"connection to {self.label} lost"))
+            self._pending.clear()
+
+    async def astart_call(self, method: str, payload: dict | None = None) -> "asyncio.Future":
+        """Send a request; return the response future without awaiting it.
+
+        Lets callers pipeline ordered calls: the send happens under the client
+        lock (FIFO), so two astart_call()s issued in order hit the wire in
+        order (the analog of the reference's SequentialActorSubmitQueue).
+        """
+        async with self._lock:
+            await self._ensure_connected()
+            self._seq += 1
+            seq = self._seq
+            fut = asyncio.get_event_loop().create_future()
+            self._pending[seq] = fut
+            self._writer.write(_pack([REQUEST, seq, method, payload or {}]))
+            await self._writer.drain()
+        return fut
+
+    async def acall(self, method: str, payload: dict | None = None, timeout: float | None = None):
+        """Async call from the IO loop."""
+        payload = payload or {}
+        attempt = 0
+        while True:
+            try:
+                fut = await self.astart_call(method, payload)
+                if timeout is not None:
+                    return await asyncio.wait_for(fut, timeout)
+                return await fut
+            except (ConnectionLost, asyncio.TimeoutError):
+                attempt += 1
+                if self._closed or attempt > self._retries:
+                    raise
+                await asyncio.sleep(self._retry_delay * attempt)
+
+    async def apush(self, method: str, payload: dict | None = None):
+        async with self._lock:
+            await self._ensure_connected()
+            self._seq += 1
+            self._writer.write(_pack([PUSH, self._seq, method, payload or {}]))
+            await self._writer.drain()
+
+    # ---- blocking API (from user threads) ----
+
+    def call(self, method: str, payload: dict | None = None, timeout: float | None = None):
+        return self._io.run(self.acall(method, payload, timeout=timeout))
+
+    def push(self, method: str, payload: dict | None = None):
+        return self._io.run(self.apush(method, payload))
+
+    def set_push_handler(self, handler: Callable[[str, dict], None]):
+        self._push_handler = handler
+
+    def close(self):
+        self._closed = True
+
+        async def _close():
+            if self._writer is not None:
+                try:
+                    self._writer.close()
+                except Exception:
+                    pass
+            if self._reader_task is not None:
+                self._reader_task.cancel()
+
+        try:
+            self._io.run(_close(), timeout=2)
+        except Exception:
+            pass

@@ -1,0 +1,235 @@
+"""Per-node shared-memory object store.
+
+TPU-native analog of the reference's Plasma store + object lifecycle manager
+(src/ray/object_manager/plasma/store.h:55, eviction_policy.h, and spilling in
+src/ray/raylet/local_object_manager.h:110):
+
+- ``StoreCore`` runs inside the raylet (the store daemon): owns allocation
+  metadata, seal states, per-object reference counts, LRU eviction and
+  disk spilling. All methods are asyncio-native (called from raylet handlers).
+- ``StoreClient`` lives in every worker/driver process on the node: it attaches
+  the node's shm arena directly (zero-copy data plane) and performs metadata
+  operations over the raylet's RPC server (control plane).
+
+Unlike plasma there is no fd-passing: the arena segment has a per-node name.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+from dataclasses import dataclass, field
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class ObjectEntry:
+    object_id: str  # hex
+    offset: int | None
+    size: int
+    sealed: bool = False
+    ref_count: int = 0  # client pins (get without release)
+    last_access: float = 0.0
+    spilled_path: str | None = None
+    sealed_event: asyncio.Event = field(default_factory=asyncio.Event)
+
+
+class StoreCore:
+    """Daemon-side store state. Single-threaded (asyncio) access."""
+
+    def __init__(self, arena, spill_dir: str):
+        self.arena = arena
+        self.spill_dir = spill_dir
+        os.makedirs(spill_dir, exist_ok=True)
+        self.objects: dict[str, ObjectEntry] = {}
+
+    # ---- creation / sealing ----
+
+    async def create(self, object_id: str, size: int) -> int | None:
+        """Allocate space; returns arena offset, or None if the object is
+        already sealed here (idempotent create — lineage reconstruction may
+        re-execute a task whose output still exists). Evicts/spills if needed.
+        """
+        if object_id in self.objects:
+            entry = self.objects[object_id]
+            if entry.sealed:
+                return None
+            return entry.offset
+        offset = self.arena.alloc(size)
+        if offset is None:
+            await self._make_space(size)
+            offset = self.arena.alloc(size)
+            if offset is None:
+                from ray_tpu.exceptions import ObjectStoreFullError
+
+                raise ObjectStoreFullError(
+                    f"cannot allocate {size} bytes "
+                    f"(used={self.arena.used()}, capacity={self.arena.capacity})"
+                )
+        self.objects[object_id] = ObjectEntry(
+            object_id=object_id, offset=offset, size=size, last_access=time.monotonic()
+        )
+        return offset
+
+    def seal(self, object_id: str):
+        entry = self.objects[object_id]
+        entry.sealed = True
+        entry.sealed_event.set()
+
+    def abort(self, object_id: str):
+        entry = self.objects.pop(object_id, None)
+        if entry is not None and entry.offset is not None:
+            self.arena.free(entry.offset)
+
+    # ---- access ----
+
+    def contains(self, object_id: str) -> bool:
+        e = self.objects.get(object_id)
+        return e is not None and e.sealed
+
+    async def get(self, object_id: str, timeout: float | None = None) -> tuple[int, int]:
+        """Block until sealed; returns (offset, size) and pins the object."""
+        entry = self.objects.get(object_id)
+        if entry is None:
+            raise KeyError(object_id)
+        if not entry.sealed:
+            await asyncio.wait_for(entry.sealed_event.wait(), timeout)
+        if entry.offset is None:
+            await self._restore(entry)
+        entry.ref_count += 1
+        entry.last_access = time.monotonic()
+        return entry.offset, entry.size
+
+    def release(self, object_id: str):
+        entry = self.objects.get(object_id)
+        if entry is not None and entry.ref_count > 0:
+            entry.ref_count -= 1
+
+    def delete(self, object_id: str):
+        entry = self.objects.pop(object_id, None)
+        if entry is None:
+            return
+        if entry.offset is not None:
+            self.arena.free(entry.offset)
+        if entry.spilled_path:
+            try:
+                os.unlink(entry.spilled_path)
+            except OSError:
+                pass
+
+    def object_ids(self) -> list[str]:
+        return [oid for oid, e in self.objects.items() if e.sealed]
+
+    def usage(self) -> dict:
+        return {
+            "capacity": self.arena.capacity,
+            "used": self.arena.used(),
+            "num_objects": len(self.objects),
+            "num_spilled": sum(1 for e in self.objects.values() if e.spilled_path),
+        }
+
+    # ---- eviction / spilling (reference: LocalObjectManager::SpillObjects) ----
+
+    async def _make_space(self, needed: int):
+        """Spill-then-evict LRU sealed, unpinned objects until `needed` fits."""
+        candidates = sorted(
+            (
+                e
+                for e in self.objects.values()
+                if e.sealed and e.ref_count == 0 and e.offset is not None
+            ),
+            key=lambda e: e.last_access,
+        )
+        for entry in candidates:
+            if self.arena.largest_free() >= needed:
+                return
+            await self._spill(entry)
+            self.arena.free(entry.offset)
+            entry.offset = None
+
+    async def _spill(self, entry: ObjectEntry):
+        if entry.spilled_path:
+            return
+        path = os.path.join(self.spill_dir, entry.object_id)
+        data = bytes(self.arena.read(entry.offset, entry.size))
+        loop = asyncio.get_event_loop()
+        await loop.run_in_executor(None, _write_file, path, data)
+        entry.spilled_path = path
+        logger.debug("spilled %s (%d bytes)", entry.object_id, entry.size)
+
+    async def _restore(self, entry: ObjectEntry):
+        if entry.spilled_path is None:
+            raise KeyError(entry.object_id)
+        loop = asyncio.get_event_loop()
+        data = await loop.run_in_executor(None, _read_file, entry.spilled_path)
+        offset = self.arena.alloc(entry.size)
+        if offset is None:
+            await self._make_space(entry.size)
+            offset = self.arena.alloc(entry.size)
+            if offset is None:
+                from ray_tpu.exceptions import ObjectStoreFullError
+
+                raise ObjectStoreFullError("cannot restore spilled object")
+        self.arena.write(offset, data)
+        entry.offset = offset
+
+    def close(self):
+        self.arena.close(unlink=True)
+
+
+def _write_file(path: str, data: bytes):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
+def _read_file(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+class StoreClient:
+    """Client-side view: direct arena mapping + RPC metadata ops to raylet."""
+
+    def __init__(self, arena_name: str, raylet_client):
+        from ray_tpu._private.store.arena import attach_arena
+
+        self.arena = attach_arena(arena_name)
+        self.raylet = raylet_client
+
+    def put_serialized(self, object_id_hex: str, serialized) -> None:
+        """create -> write payload zero-copy into arena -> seal."""
+        size = serialized.total_size
+        resp = self.raylet.call("store_create", {"object_id": object_id_hex, "size": size})
+        if resp.get("exists"):
+            return  # already sealed here (idempotent reconstruction)
+        offset = resp["offset"]
+        try:
+            serialized.write_to(self.arena.read(offset, size))
+        except BaseException:
+            self.raylet.call("store_abort", {"object_id": object_id_hex})
+            raise
+        self.raylet.call("store_seal", {"object_id": object_id_hex})
+
+    def get_view(self, object_id_hex: str, timeout: float | None = None) -> memoryview:
+        """Blocks until sealed locally; returns a zero-copy view (pinned)."""
+        resp = self.raylet.call(
+            "store_get", {"object_id": object_id_hex, "timeout": timeout}, timeout=timeout
+        )
+        return self.arena.read(resp["offset"], resp["size"])
+
+    def contains(self, object_id_hex: str) -> bool:
+        return self.raylet.call("store_contains", {"object_id": object_id_hex})["found"]
+
+    def release(self, object_id_hex: str):
+        try:
+            self.raylet.push("store_release", {"object_id": object_id_hex})
+        except Exception:
+            pass
+
+    def close(self):
+        self.arena.close(unlink=False)

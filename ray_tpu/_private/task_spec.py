@@ -1,0 +1,73 @@
+"""Task specification.
+
+TPU-native analog of the reference's TaskSpecification
+(src/ray/common/task/task_spec.h:186, built via TaskSpecBuilder
+task_util.h:102): a msgpack-able description of one task/actor-creation/
+actor-method invocation, carrying everything a remote worker needs to execute
+it — function (by GCS function-table key), serialized/reference args, return
+count, resource demand, retry policy, scheduling strategy, and owner address
+for result routing.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+NORMAL_TASK = 0
+ACTOR_CREATION_TASK = 1
+ACTOR_TASK = 2
+
+
+@dataclass
+class TaskSpec:
+    task_id: str  # hex
+    job_id: str
+    name: str
+    task_type: int = NORMAL_TASK
+    # Function table key in the GCS KV (see function_manager.py); workers
+    # fetch-and-cache by this key (reference: _private/function_manager.py).
+    function_key: str = ""
+    # Each arg is ("v", <serialized bytes>) inline or ("r", <oid hex>, <owner addr>).
+    args: list = field(default_factory=list)
+    num_returns: int = 1
+    resources: dict = field(default_factory=dict)
+    max_retries: int = 0
+    retry_exceptions: bool = False
+    # Owner (submitting process) core-worker RPC address, [host, port].
+    owner_addr: list | None = None
+    owner_worker_id: str = ""
+    # Actor fields.
+    actor_id: str = ""
+    method_name: str = ""
+    seq_no: int = -1  # per-caller ordering for actor tasks
+    max_restarts: int = 0
+    max_task_retries: int = 0
+    max_concurrency: int = 1
+    actor_name: str = ""  # named actor registration
+    namespace: str = ""
+    get_if_exists: bool = False
+    # Scheduling.
+    placement_group_id: str = ""
+    placement_group_bundle_index: int = -1
+    scheduling_strategy: str = "DEFAULT"  # DEFAULT | SPREAD | node:<id> | node:<id>:soft
+    runtime_env: dict = field(default_factory=dict)
+
+    def to_wire(self) -> dict:
+        return self.__dict__.copy()
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "TaskSpec":
+        return cls(**d)
+
+    def return_object_ids(self) -> list[str]:
+        from ray_tpu._private.ids import ObjectID, TaskID
+
+        tid = TaskID.from_hex(self.task_id)
+        return [ObjectID.for_return(tid, i).hex() for i in range(self.num_returns)]
+
+    def is_actor_task(self) -> bool:
+        return self.task_type == ACTOR_TASK
+
+    def is_actor_creation(self) -> bool:
+        return self.task_type == ACTOR_CREATION_TASK

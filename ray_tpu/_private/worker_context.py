@@ -1,0 +1,27 @@
+"""Process-global core-worker handle (analog of the reference's global_worker
+in python/ray/_private/worker.py:408)."""
+
+from __future__ import annotations
+
+import threading
+
+_lock = threading.Lock()
+_core_worker = None
+
+
+def set_core_worker(cw) -> None:
+    global _core_worker
+    with _lock:
+        _core_worker = cw
+
+
+def get_core_worker():
+    if _core_worker is None:
+        raise RuntimeError(
+            "ray_tpu has not been initialized; call ray_tpu.init() first."
+        )
+    return _core_worker
+
+
+def get_core_worker_if_initialized():
+    return _core_worker

@@ -1,0 +1,206 @@
+"""Worker process entry point.
+
+TPU-native analog of the reference's default_worker.py + the Cython task
+execution handler (python/ray/_private/workers/default_worker.py,
+_raylet.pyx:1791 task_execution_handler): spawned by the raylet's worker pool,
+registers back, then serves
+
+- ``push_task`` from the raylet (normal + actor-creation tasks)
+- ``actor_call`` directly from callers (the direct actor transport —
+  reference: direct_actor_task_submitter.h:67 server side,
+  actor_scheduling_queue.h:40 ordering)
+- ``kill_self`` for ray_tpu.kill / actor teardown.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import sys
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+logger = logging.getLogger(__name__)
+
+
+class WorkerExecutor:
+    def __init__(self, core_worker, raylet_client):
+        self.cw = core_worker
+        self.raylet = raylet_client
+        self._loop = core_worker._io.loop
+        self._actor_queue: asyncio.Queue | None = None
+        self._consumer_task = None
+        self._concurrency_pool: ThreadPoolExecutor | None = None
+        server = core_worker.server
+        server.register("push_task", self.rpc_push_task)
+        server.register("actor_call", self.rpc_actor_call)
+        server.register("kill_self", self.rpc_kill_self)
+
+    # ---- normal / actor-creation tasks ----
+
+    async def rpc_push_task(self, req):
+        from ray_tpu._private.task_spec import TaskSpec
+
+        spec = TaskSpec.from_wire(req["spec"])
+        asyncio.ensure_future(self._execute_pushed(spec))
+        return {"ok": True}
+
+    async def _execute_pushed(self, spec):
+        loop = asyncio.get_event_loop()
+        payload = await loop.run_in_executor(self.cw._executor, self.cw.execute_task, spec)
+        if spec.is_actor_creation():
+            await self._finish_actor_creation(spec, payload)
+        else:
+            # Report to owner, then free the lease.
+            await self._report_to_owner(spec, payload)
+            try:
+                await self.raylet.acall("task_finished", {"worker_id": self.cw.worker_id})
+            except Exception:
+                pass
+
+    async def _report_to_owner(self, spec, payload):
+        from ray_tpu._private.rpc import RpcClient
+
+        if spec.owner_addr is None:
+            return
+        try:
+            owner = RpcClient(tuple(spec.owner_addr), label="owner")
+            await owner.acall("task_done", payload)
+            owner.close()
+        except Exception:
+            logger.warning("could not report task %s to owner", spec.task_id[:8])
+
+    async def _finish_actor_creation(self, spec, payload):
+        if payload.get("error") is None:
+            if spec.max_concurrency > 1:
+                self._concurrency_pool = ThreadPoolExecutor(
+                    max_workers=spec.max_concurrency, thread_name_prefix="actor-cg"
+                )
+            else:
+                self._actor_queue = asyncio.Queue()
+                self._consumer_task = asyncio.ensure_future(self._actor_consumer())
+            await self.cw.gcs.acall(
+                "actor_alive",
+                {
+                    "actor_id": spec.actor_id,
+                    "address": list(self.cw.address),
+                    "node_id": self.cw.node_id,
+                    "worker_id": self.cw.worker_id,
+                },
+            )
+            await self.raylet.acall("actor_ready", {"worker_id": self.cw.worker_id})
+        else:
+            logger.error("actor %s __init__ failed", spec.actor_id[:8])
+            try:
+                await self.cw.gcs.acall(
+                    "report_worker_death",
+                    {"actor_ids": [spec.actor_id], "reason": "actor __init__ raised"},
+                )
+            finally:
+                os._exit(1)
+
+    # ---- direct actor calls ----
+
+    async def rpc_actor_call(self, req):
+        from ray_tpu._private.task_spec import TaskSpec
+
+        spec = TaskSpec.from_wire(req["spec"])
+        loop = asyncio.get_event_loop()
+        if self._concurrency_pool is not None:
+            # Threaded actor: concurrent execution, no ordering guarantee
+            # (reference: concurrency groups / max_concurrency > 1).
+            return await loop.run_in_executor(
+                self._concurrency_pool, self.cw.execute_task, spec
+            )
+        if self._actor_queue is None:
+            # Call raced actor initialisation; serialize behind creation.
+            return await loop.run_in_executor(self.cw._executor, self.cw.execute_task, spec)
+        fut = loop.create_future()
+        self._actor_queue.put_nowait((spec, fut))  # pre-await: preserves order
+        return await fut
+
+    async def _actor_consumer(self):
+        """Ordered execution queue (reference: actor_scheduling_queue.h:40)."""
+        loop = asyncio.get_event_loop()
+        while True:
+            spec, fut = await self._actor_queue.get()
+            try:
+                payload = await loop.run_in_executor(
+                    self.cw._executor, self.cw.execute_task, spec
+                )
+                if not fut.done():
+                    fut.set_result(payload)
+            except Exception as e:
+                if not fut.done():
+                    fut.set_exception(e)
+
+    async def rpc_kill_self(self, req):
+        def _die():
+            os._exit(0)
+
+        asyncio.get_event_loop().call_later(0.05, _die)
+        return {"ok": True}
+
+
+def main():
+    logging.basicConfig(
+        level=logging.INFO,
+        format=f"[worker %(process)d] %(levelname)s %(name)s: %(message)s",
+    )
+    worker_id = os.environ["RAY_TPU_WORKER_ID"]
+    node_id = os.environ["RAY_TPU_NODE_ID"]
+    raylet_addr = json.loads(os.environ["RAY_TPU_RAYLET_ADDR"])
+    gcs_addr = json.loads(os.environ["RAY_TPU_GCS_ADDR"])
+    arena_name = os.environ["RAY_TPU_ARENA_NAME"]
+    session_dir = os.environ["RAY_TPU_SESSION_DIR"]
+
+    # Test runs pin jax to CPU: a sitecustomize may force jax_platforms to a
+    # TPU plugin via jax.config.update, which only another config.update can
+    # override (see tests/conftest.py).
+    forced = os.environ.get("RAY_TPU_JAX_CONFIG_PLATFORMS")
+    if forced:
+        import jax
+
+        jax.config.update("jax_platforms", forced)
+
+    from ray_tpu._private import worker_context
+    from ray_tpu._private.core_worker import WORKER, CoreWorker
+    from ray_tpu._private.ids import JobID
+
+    cw = CoreWorker(
+        mode=WORKER,
+        gcs_address=gcs_addr,
+        raylet_address=raylet_addr,
+        arena_name=arena_name,
+        node_id=node_id,
+        session_dir=session_dir,
+        job_id=JobID.from_int(0),
+        worker_id=worker_id,
+    )
+    worker_context.set_core_worker(cw)
+    executor = WorkerExecutor(cw, cw.raylet)
+    cw.raylet.call(
+        "register_worker",
+        {"worker_id": worker_id, "address": list(cw.address), "pid": os.getpid()},
+    )
+    # Workers exit if their parent raylet dies (reference: core_worker.cc:926
+    # ExitIfParentRayletDies).
+    def _watch_raylet():
+        import time
+
+        while True:
+            time.sleep(2.0)
+            try:
+                cw.raylet.call("store_contains", {"object_id": "00" * 28}, timeout=5)
+            except Exception:
+                logger.warning("parent raylet unreachable; worker exiting")
+                os._exit(1)
+
+    threading.Thread(target=_watch_raylet, daemon=True).start()
+    threading.Event().wait()
+
+
+if __name__ == "__main__":
+    main()

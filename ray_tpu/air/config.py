@@ -1,0 +1,50 @@
+"""AIR configs (analog of python/ray/air/config.py: ScalingConfig:91,
+RunConfig:704, FailureConfig:523, CheckpointConfig:574) — TPU-first: the
+accelerator knob is ``use_tpu``/``tpu_per_worker`` and ScalingConfig can gang-
+reserve an ICI slice via a STRICT_PACK placement group."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ScalingConfig:
+    num_workers: int = 1
+    use_tpu: bool = False
+    tpu_per_worker: int = 1
+    resources_per_worker: dict | None = None
+    placement_strategy: str = "PACK"  # STRICT_PACK => one ICI domain
+
+    def worker_resources(self) -> dict:
+        res = dict(self.resources_per_worker or {})
+        if self.use_tpu:
+            res.setdefault("TPU", self.tpu_per_worker)
+        else:
+            res.setdefault("CPU", 1)
+        return res
+
+    def as_placement_group_bundles(self) -> list[dict]:
+        return [self.worker_resources() for _ in range(self.num_workers)]
+
+
+@dataclass
+class FailureConfig:
+    max_failures: int = 0  # -1 = infinite retries of the whole worker group
+
+
+@dataclass
+class CheckpointConfig:
+    num_to_keep: int | None = None
+    checkpoint_score_attribute: str | None = None
+    checkpoint_score_order: str = "max"
+
+
+@dataclass
+class RunConfig:
+    name: str | None = None
+    storage_path: str | None = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    stop: dict | None = None
+    verbose: int = 1

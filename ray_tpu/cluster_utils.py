@@ -1,0 +1,104 @@
+"""Multi-raylet single-host test cluster.
+
+Analog of the reference's cluster_utils.Cluster (python/ray/cluster_utils.py:99,
+add_node :165, remove_node :238): additional raylets on the same host, each
+pretending to be a distinct node (own resources, own shm arena, shared GCS) —
+the key multi-node-without-a-cluster trick the reference's failure tests rely
+on. ``remove_node`` simulates node death for chaos tests.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from ray_tpu._private.config import init_config
+from ray_tpu._private.gcs import GcsServer
+from ray_tpu._private.raylet import Raylet
+
+
+class Cluster:
+    def __init__(self, _system_config: dict | None = None):
+        init_config(_system_config)
+        self.gcs = GcsServer()
+        self.session_dir = os.path.join("/tmp/ray_tpu", f"cluster_{os.getpid()}_{int(time.time())}")
+        self.nodes: list[Raylet] = []
+        self._connected = False
+
+    @property
+    def gcs_address(self):
+        return self.gcs.address
+
+    def add_node(
+        self,
+        num_cpus: int = 1,
+        num_tpus: int = 0,
+        resources: dict | None = None,
+        labels: dict | None = None,
+        object_store_memory: int = 64 * 1024 * 1024,
+    ) -> Raylet:
+        node_resources = dict(resources or {})
+        node_resources.setdefault("CPU", num_cpus)
+        if num_tpus:
+            node_resources.setdefault("TPU", num_tpus)
+        raylet = Raylet(
+            self.gcs.address,
+            self.session_dir,
+            resources=node_resources,
+            labels=labels,
+            object_store_memory=object_store_memory,
+        )
+        self.nodes.append(raylet)
+        return raylet
+
+    def connect(self, namespace: str = ""):
+        """Attach the current process as a driver to the first node."""
+        from ray_tpu._private import worker_context
+        from ray_tpu._private.core_worker import DRIVER, CoreWorker
+
+        assert self.nodes, "add_node() first"
+        head = self.nodes[0]
+        cw = CoreWorker(
+            mode=DRIVER,
+            gcs_address=self.gcs.address,
+            raylet_address=head.address,
+            arena_name=head.arena_name,
+            node_id=head.node_id,
+            session_dir=self.session_dir,
+            namespace=namespace,
+        )
+        worker_context.set_core_worker(cw)
+        self._connected = True
+        return cw
+
+    def remove_node(self, raylet: Raylet):
+        """Simulate node death (reference: Cluster.remove_node for chaos tests)."""
+        self.nodes.remove(raylet)
+        raylet.stop()
+
+    def wait_for_nodes(self, timeout: float = 10.0):
+        deadline = time.monotonic() + timeout
+        want = len(self.nodes)
+        while time.monotonic() < deadline:
+            from ray_tpu._private.rpc import EventLoopThread
+
+            alive = sum(
+                1 for n in self.gcs.nodes.values() if n["state"] == "ALIVE"
+            )
+            if alive >= want:
+                return
+            time.sleep(0.05)
+        raise TimeoutError("cluster nodes did not come up")
+
+    def shutdown(self):
+        from ray_tpu._private import worker_context
+
+        if self._connected:
+            cw = worker_context.get_core_worker_if_initialized()
+            if cw is not None:
+                cw.shutdown()
+                worker_context.set_core_worker(None)
+        for raylet in self.nodes:
+            raylet.stop()
+        self.nodes.clear()
+        self.gcs.stop()

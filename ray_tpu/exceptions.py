@@ -1,0 +1,93 @@
+"""Public exception types.
+
+Analog of the reference's python/ray/exceptions.py: typed errors surfaced by
+``get``/task execution so user code can distinguish application errors from
+system failures.
+"""
+
+from __future__ import annotations
+
+import traceback
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A task raised an exception; re-raised at ray_tpu.get() on the caller.
+
+    Analog of the reference's RayTaskError (python/ray/exceptions.py): wraps
+    the remote exception plus its remote traceback.
+    """
+
+    def __init__(self, cause: BaseException | None = None, remote_traceback: str = "", task_name: str = ""):
+        self.cause = cause
+        self.remote_traceback = remote_traceback
+        self.task_name = task_name
+        super().__init__(str(cause) if cause else remote_traceback)
+
+    @classmethod
+    def from_exception(cls, exc: BaseException, task_name: str = "") -> "TaskError":
+        return cls(cause=exc, remote_traceback=traceback.format_exc(), task_name=task_name)
+
+    def __str__(self):
+        return (
+            f"Task {self.task_name or '<unknown>'} failed:\n{self.remote_traceback}"
+        )
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker process executing the task died unexpectedly."""
+
+
+class ActorError(RayTpuError):
+    pass
+
+
+class ActorDiedError(ActorError):
+    """The actor is dead: it crashed, was killed, or exhausted restarts."""
+
+    def __init__(self, msg: str = "The actor died.", actor_id=None):
+        super().__init__(msg)
+        self.actor_id = actor_id
+
+
+class ActorUnavailableError(ActorError):
+    """The actor is temporarily unreachable (e.g. restarting)."""
+
+
+class ObjectLostError(RayTpuError):
+    """Object was lost (all copies gone) and could not be reconstructed."""
+
+    def __init__(self, object_id_hex: str = "", msg: str = ""):
+        super().__init__(msg or f"Object {object_id_hex} was lost and could not be recovered.")
+        self.object_id_hex = object_id_hex
+
+
+class OwnerDiedError(ObjectLostError):
+    """The object's owner process died; the object's lineage is gone."""
+
+
+class ObjectStoreFullError(RayTpuError):
+    """The node's shared-memory arena is full even after spilling/eviction."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """ray_tpu.get() timed out."""
+
+
+class TaskCancelledError(RayTpuError):
+    """The task was cancelled before/while running."""
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    """Preparing the task/actor runtime environment failed."""
+
+
+class NodeDiedError(RayTpuError):
+    """The node hosting the computation died."""
+
+
+class PlacementGroupUnavailableError(RayTpuError):
+    """Placement group cannot be scheduled (infeasible or removed)."""

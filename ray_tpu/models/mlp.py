@@ -1,0 +1,32 @@
+"""MNIST-class MLP (BASELINE config #1: JaxTrainer MNIST MLP minimum slice)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def init_mlp(key, sizes=(784, 256, 128, 10), dtype=jnp.float32) -> dict:
+    params = {}
+    for i, (fan_in, fan_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+        k1, key = jax.random.split(key)
+        params[f"w{i}"] = (jax.random.normal(k1, (fan_in, fan_out)) * fan_in**-0.5).astype(dtype)
+        params[f"b{i}"] = jnp.zeros((fan_out,), dtype)
+    return params
+
+
+def mlp_forward(params: dict, x):
+    n = len(params) // 2
+    for i in range(n):
+        x = x @ params[f"w{i}"] + params[f"b{i}"]
+        if i < n - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+def mlp_loss(params, batch):
+    logits = mlp_forward(params, batch["x"])
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, batch["y"][:, None], axis=-1).mean()
+    acc = (logits.argmax(-1) == batch["y"]).mean()
+    return nll, acc

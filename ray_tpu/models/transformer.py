@@ -1,0 +1,279 @@
+"""Flagship model: decoder-only transformer (Llama-family architecture).
+
+Pure-JAX with explicit parameter pytrees and per-leaf logical sharding axes —
+the flagship for every parallelism strategy in parallel/ (dp/fsdp/tp/pp/sp/ep)
+and the model behind __graft_entry__.py and bench.py.
+
+TPU-first choices:
+- layer parameters are *stacked* [L, ...] so the layer loop is a lax.scan
+  (O(1) compile in depth) and pipeline parallelism is just sharding the stack
+  over the ``pp`` axis (parallel/pipeline.py)
+- attention runs the Pallas flash kernel on TPU (ops/attention.py), ring
+  attention over the ``sp`` axis for long context (parallel/ring_attention.py)
+- bf16 activations/params by default; f32 RMSNorm epsilon path and logits
+- rotary embeddings, GQA (n_kv_heads <= n_heads), SwiGLU MLP, optional
+  mixture-of-experts MLP (parallel/moe.py) sharded over ``ep``
+- remat (jax.checkpoint) around each layer: trades FLOPs for HBM, the standard
+  TPU fit knob.
+
+(The reference has no in-tree model zoo for LLMs — its Train/RLlib models are
+torch modules; SURVEY.md §2.3/§5.7. This module is the TPU-native equivalent
+of what it delegates to HF/DeepSpeed.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 8
+    d_ff: int = 1376  # ~8/3 * d_model rounded
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    # MoE: 0 = dense MLP; >0 = experts sharded over ep.
+    num_experts: int = 0
+    expert_capacity_factor: float = 1.25
+    remat: bool = True
+    tie_embeddings: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def init_params(key, cfg: TransformerConfig) -> dict:
+    ks = jax.random.split(key, 10)
+    D, H, KV, Dh, F, L, V = (
+        cfg.d_model,
+        cfg.n_heads,
+        cfg.n_kv_heads,
+        cfg.head_dim,
+        cfg.d_ff,
+        cfg.n_layers,
+        cfg.vocab_size,
+    )
+    dt = cfg.param_dtype
+    s = D**-0.5
+
+    def norm(k, shape, scale):
+        return (jax.random.normal(k, shape) * scale).astype(dt)
+
+    layers = {
+        "attn_norm": jnp.ones((L, D), dt),
+        "wq": norm(ks[0], (L, D, H * Dh), s),
+        "wk": norm(ks[1], (L, D, KV * Dh), s),
+        "wv": norm(ks[2], (L, D, KV * Dh), s),
+        "wo": norm(ks[3], (L, H * Dh, D), s * (2 * L) ** -0.5),
+        "mlp_norm": jnp.ones((L, D), dt),
+    }
+    if cfg.num_experts > 0:
+        E = cfg.num_experts
+        layers.update(
+            {
+                "gate": norm(ks[4], (L, D, E), s),
+                "wi_e": norm(ks[5], (L, E, D, F), s),
+                "wg_e": norm(ks[6], (L, E, D, F), s),
+                "wo_e": norm(ks[7], (L, E, F, D), F**-0.5 * (2 * L) ** -0.5),
+            }
+        )
+    else:
+        layers.update(
+            {
+                "wi": norm(ks[5], (L, D, F), s),
+                "wg": norm(ks[6], (L, D, F), s),
+                "wo_mlp": norm(ks[7], (L, F, D), F**-0.5 * (2 * L) ** -0.5),
+            }
+        )
+    params = {
+        "embed": norm(ks[8], (V, D), 1.0),
+        "layers": layers,
+        "norm_f": jnp.ones((D,), dt),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = norm(ks[9], (D, V), s)
+    return params
+
+
+def param_logical_axes(cfg: TransformerConfig) -> dict:
+    """Per-leaf logical axis names (mapped to mesh axes by
+    parallel/mesh.logical_to_spec)."""
+    layers = {
+        "attn_norm": ("layers", None),
+        "wq": ("layers", "embed", "heads"),
+        "wk": ("layers", "embed", "kv"),
+        "wv": ("layers", "embed", "kv"),
+        "wo": ("layers", "heads", "embed"),
+        "mlp_norm": ("layers", None),
+    }
+    if cfg.num_experts > 0:
+        layers.update(
+            {
+                "gate": ("layers", "embed", None),
+                "wi_e": ("layers", "expert", "embed", "mlp"),
+                "wg_e": ("layers", "expert", "embed", "mlp"),
+                "wo_e": ("layers", "expert", "mlp", "embed"),
+            }
+        )
+    else:
+        layers.update(
+            {
+                "wi": ("layers", "embed", "mlp"),
+                "wg": ("layers", "embed", "mlp"),
+                "wo_mlp": ("layers", "mlp", "embed"),
+            }
+        )
+    axes = {
+        "embed": ("vocab", "embed"),
+        "layers": layers,
+        "norm_f": (None,),
+    }
+    if not cfg.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+def _rms_norm(x, weight, eps):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight.astype(x.dtype)
+
+
+def _rope(x, positions, theta):
+    # x: [B, T, H, Dh]
+    Dh = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, Dh // 2, dtype=jnp.float32) / (Dh // 2))
+    angles = positions[:, :, None].astype(jnp.float32) * freqs[None, None, :]  # [B,T,Dh/2]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    rx1 = x1 * cos[:, :, None, :] - x2 * sin[:, :, None, :]
+    rx2 = x2 * cos[:, :, None, :] + x1 * sin[:, :, None, :]
+    return jnp.concatenate([rx1, rx2], axis=-1).astype(x.dtype)
+
+
+def _attention_block(lp, x, positions, cfg: TransformerConfig, mesh, attn_impl: str):
+    B, T, D = x.shape
+    H, KV, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    h = _rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ lp["wq"].astype(h.dtype)).reshape(B, T, H, Dh)
+    k = (h @ lp["wk"].astype(h.dtype)).reshape(B, T, KV, Dh)
+    v = (h @ lp["wv"].astype(h.dtype)).reshape(B, T, KV, Dh)
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    if KV != H:  # GQA: repeat kv heads
+        rep = H // KV
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    from ray_tpu.ops.attention import flash_attention
+
+    if attn_impl == "ring" and mesh is not None and mesh.shape.get("sp", 1) > 1:
+        from ray_tpu.parallel.ring_attention import ring_attention
+
+        o = ring_attention(q, k, v, mesh, causal=True)
+    elif attn_impl == "ulysses" and mesh is not None and mesh.shape.get("sp", 1) > 1:
+        from ray_tpu.parallel.ulysses import ulysses_attention
+
+        o = ulysses_attention(q, k, v, mesh, causal=True)
+    else:
+        o = flash_attention(q, k, v, causal=True)
+    o = o.reshape(B, T, H * Dh)
+    return x + o @ lp["wo"].astype(o.dtype)
+
+
+def _mlp_block(lp, x, cfg: TransformerConfig):
+    h = _rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    if cfg.num_experts > 0:
+        from ray_tpu.parallel.moe import moe_layer
+
+        out, aux = moe_layer(
+            {"gate": lp["gate"].astype(h.dtype), "wi": lp["wi_e"].astype(h.dtype), "wo": lp["wo_e"].astype(h.dtype)},
+            h,
+            capacity_factor=cfg.expert_capacity_factor,
+        )
+        # SwiGLU-ish gate path folded into experts (wg_e unused in moe path
+        # to keep dispatch einsums lean; kept in params for parity).
+        return x + out, aux
+    gate = jax.nn.silu(h @ lp["wg"].astype(h.dtype))
+    up = h @ lp["wi"].astype(h.dtype)
+    return x + (gate * up) @ lp["wo_mlp"].astype(h.dtype), 0.0
+
+
+def _layer(lp, x, positions, cfg: TransformerConfig, mesh, attn_impl: str):
+    x = _attention_block(lp, x, positions, cfg, mesh, attn_impl)
+    x, aux = _mlp_block(lp, x, cfg)
+    return x, aux
+
+
+def forward(
+    params: dict,
+    tokens,
+    cfg: TransformerConfig,
+    mesh=None,
+    attn_impl: str = "auto",
+):
+    """tokens [B, T] int32 -> logits [B, T, V] (f32)."""
+    B, T = tokens.shape
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
+
+    layer_fn = partial(_layer, cfg=cfg, mesh=mesh, attn_impl=attn_impl)
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn, static_argnums=())
+
+    def scan_body(carry, lp):
+        x, aux = carry
+        x, a = layer_fn(lp, x, positions)
+        return (x, aux + a), None
+
+    (x, aux), _ = lax.scan(scan_body, (x, 0.0), params["layers"])
+    x = _rms_norm(x, params["norm_f"], cfg.norm_eps)
+    head = params["lm_head"] if "lm_head" in params else params["embed"].T
+    logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
+    return logits, aux
+
+
+def loss_fn(params, batch, cfg: TransformerConfig, mesh=None, attn_impl: str = "auto"):
+    """batch: {"tokens": [B, T+1]} next-token LM loss."""
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits, aux = forward(params, inputs, cfg, mesh=mesh, attn_impl=attn_impl)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean() + 0.01 * aux
+
+
+def make_train_step(cfg: TransformerConfig, optimizer, mesh=None, attn_impl: str = "auto", donate: bool = True):
+    """Returns train_step(params, opt_state, batch) -> (params, opt_state, loss).
+
+    Pure function — callers jit it with in/out shardings (see
+    train/jax/ and __graft_entry__.py). Gradients are averaged over the batch;
+    under a dp/fsdp-sharded batch pjit inserts the psum automatically.
+    """
+
+    def train_step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg, mesh, attn_impl)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        import optax
+
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step
+
+
+def num_params(params) -> int:
+    return sum(int(p.size) for p in jax.tree.leaves(params))

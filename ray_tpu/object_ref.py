@@ -1,0 +1,74 @@
+"""ObjectRef — a future for a value in the distributed object store.
+
+Analog of the reference's ObjectRef (python/ray/_raylet.pyx:208): carries the
+28-byte object id plus the owner's core-worker RPC address so any borrower can
+reach the owner for inline values and ref-count bookkeeping
+(src/ray/core_worker/reference_count.h:61).
+"""
+
+from __future__ import annotations
+
+from ray_tpu._private.ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("id", "owner_addr", "_registered")
+
+    def __init__(self, object_id: ObjectID, owner_addr: tuple | None = None, *, _register: bool = True):
+        self.id = object_id
+        self.owner_addr = tuple(owner_addr) if owner_addr else None
+        self._registered = False
+        if _register:
+            from ray_tpu._private import worker_context
+
+            cw = worker_context.get_core_worker_if_initialized()
+            if cw is not None:
+                cw.register_ref(self)
+                self._registered = True
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def binary(self) -> bytes:
+        return self.id.binary()
+
+    def __reduce__(self):
+        from ray_tpu._private.serialization import record_contained_ref
+
+        record_contained_ref(self)
+        return (_deserialize_ref, (self.id.binary(), self.owner_addr))
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __repr__(self):
+        return f"ObjectRef({self.hex()})"
+
+    def __del__(self):
+        if self._registered:
+            try:
+                from ray_tpu._private import worker_context
+
+                cw = worker_context.get_core_worker_if_initialized()
+                if cw is not None:
+                    cw.deregister_ref(self)
+            except Exception:
+                pass
+
+    def future(self):
+        """Return a concurrent.futures.Future resolving to the value."""
+        from ray_tpu._private import worker_context
+
+        return worker_context.get_core_worker().as_future(self)
+
+    def __await__(self):
+        import asyncio
+
+        return asyncio.wrap_future(self.future()).__await__()
+
+
+def _deserialize_ref(binary: bytes, owner_addr):
+    return ObjectRef(ObjectID(binary), owner_addr)

@@ -1,0 +1,154 @@
+"""Attention kernels.
+
+The hot op of every transformer in models/: a Pallas TPU flash-attention
+kernel (blockwise online-softmax, VMEM-resident accumulators, MXU-shaped
+tiles) with a pure-XLA fallback for CPU/debug.
+
+The reference has no attention kernels at all (it delegates model math to
+torch; SURVEY.md §5.7) — this module is where the TPU-native build spends the
+FLOPs the reference hands to external frameworks.
+
+Design notes (per /opt/skills/guides/pallas_guide.md):
+- grid = (batch*heads, q_blocks); the k-loop runs inside the kernel as a
+  fori_loop so the running max/denominator stay in VMEM scratch.
+- block sizes default to (128, 128): MXU-shaped, and multiples of the
+  (8,128)/f32, (16,128)/bf16 tile constraints.
+- causal masking prunes fully-masked k-blocks via the loop upper bound
+  (no wasted MXU work past the diagonal).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def _xla_attention(q, k, v, causal: bool, sm_scale: float, bias=None):
+    """Reference implementation (XLA fuses this fine on CPU; used for
+    correctness tests and non-TPU fallback)."""
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * sm_scale
+    if bias is not None:
+        logits = logits + bias
+    if causal:
+        mask = jnp.tril(jnp.ones((Tq, Tk), dtype=bool), k=Tk - Tq)
+        logits = jnp.where(mask[None, None], logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, sm_scale: float, seq_k: int, block_q: int):
+    from jax.experimental import pallas as pl
+
+    q = q_ref[...]  # [block_q, d]
+    q_idx = pl.program_id(1)
+    d = q.shape[-1]
+
+    m0 = jnp.full((q.shape[0],), -jnp.inf, dtype=jnp.float32)
+    l0 = jnp.zeros((q.shape[0],), dtype=jnp.float32)
+    acc0 = jnp.zeros((q.shape[0], d), dtype=jnp.float32)
+
+    num_k_blocks = pl.cdiv(seq_k, block_k)
+    if causal:
+        # K blocks strictly after this Q block's last row are fully masked.
+        last_q_row = (q_idx + 1) * block_q - 1
+        num_k_blocks = jnp.minimum(num_k_blocks, (last_q_row // block_k) + 1)
+
+    def body(kb, carry):
+        m_prev, l_prev, acc_prev = carry
+        k_blk = k_ref[pl.ds(kb * block_k, block_k), :]
+        v_blk = v_ref[pl.ds(kb * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale  # [block_q, block_k]
+        if causal:
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        m_cur = jnp.maximum(m_prev, s.max(axis=-1))
+        correction = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur[:, None])
+        l_cur = l_prev * correction + p.sum(axis=-1)
+        pv = jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_cur = acc_prev * correction[:, None] + pv
+        return m_cur, l_cur, acc_cur
+
+    m, l, acc = jax.lax.fori_loop(0, num_k_blocks, body, (m0, l0, acc0))
+    o_ref[...] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def _pallas_flash(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: int, interpret: bool):
+    from jax.experimental import pallas as pl
+
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    # Fold batch and heads into the grid's first axis; layout [BH, T, D].
+    qf = q.transpose(0, 2, 1, 3).reshape(B * H, Tq, D)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
+
+    grid = (B * H, pl.cdiv(Tq, block_q))
+    kernel = functools.partial(
+        _flash_kernel,
+        block_k=block_k,
+        causal=causal,
+        sm_scale=sm_scale,
+        seq_k=Tk,
+        block_q=block_q,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda bh, qb: (bh, qb, 0)),
+            pl.BlockSpec((None, Tk, D), lambda bh, qb: (bh, 0, 0)),
+            pl.BlockSpec((None, Tk, D), lambda bh, qb: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, D), lambda bh, qb: (bh, qb, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    *,
+    causal: bool = False,
+    sm_scale: float | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    bias=None,
+    force_pallas: bool | None = None,
+    interpret: bool = False,
+):
+    """Multi-head attention, [B, T, H, D] layout.
+
+    Pallas on TPU; XLA reference elsewhere (or with a bias, which the kernel
+    does not support yet).
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    use_pallas = force_pallas if force_pallas is not None else (_on_tpu() or interpret)
+    if bias is not None or not use_pallas:
+        return _xla_attention(q, k, v, causal, sm_scale, bias)
+    Tq, Tk = q.shape[1], k.shape[1]
+    bq = min(block_q, Tq)
+    bk = min(block_k, Tk)
+    return _pallas_flash(q, k, v, causal, sm_scale, bq, bk, interpret)

@@ -1,0 +1,117 @@
+"""Ring attention — context parallelism over the ``sp`` mesh axis.
+
+Absent from the reference (SURVEY.md §5.7 confirms no SP/CP/ring attention
+in-tree); built natively here the TPU way: Q/K/V are sharded over the sequence
+dimension across the ``sp`` axis; each device computes blockwise attention of
+its local Q chunk against a K/V chunk that rotates around the ICI ring via
+``lax.ppermute``, maintaining flash-style online-softmax statistics so the
+result is exact. n_sp steps, each overlapping an MXU-bound block attention
+with a neighbour-to-neighbour ICI transfer — the classic ring schedule
+(Liu et al., Ring Attention; see PAPERS.md).
+
+Causal masking uses global positions derived from each chunk's ring offset;
+fully-masked chunk pairs contribute nothing but still rotate (static schedule,
+no data-dependent control flow — XLA-friendly).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def _shard_map():
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map
+
+
+def _block_attend(q, k, v, q_start, k_start, causal, sm_scale, m, l, acc):
+    """One Q-chunk x K-chunk blockwise attention step with online softmax.
+
+    q: [B, Tq, H, D] local; k/v: [B, Tc, H, D] rotating chunk.
+    m, l: [B, H, Tq] running max / denominator; acc: [B, Tq, H, D].
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * sm_scale
+    if causal:
+        Tq, Tc = q.shape[1], k.shape[1]
+        q_pos = q_start + lax.broadcasted_iota(jnp.int32, (Tq, Tc), 0)
+        k_pos = k_start + lax.broadcasted_iota(jnp.int32, (Tq, Tc), 1)
+        s = jnp.where((q_pos >= k_pos)[None, None], s, -jnp.inf)
+    m_cur = jnp.maximum(m, s.max(axis=-1))
+    # Guard fully-masked rows: exp(-inf - -inf) -> use safe max.
+    safe_m = jnp.where(jnp.isneginf(m_cur), 0.0, m_cur)
+    p = jnp.exp(s - safe_m[..., None])
+    p = jnp.where(jnp.isneginf(s), 0.0, p)
+    correction = jnp.exp(jnp.where(jnp.isneginf(m), 0.0, m) - safe_m)
+    correction = jnp.where(jnp.isneginf(m), 0.0, correction)
+    l_cur = l * correction + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    acc_cur = acc * correction.transpose(0, 2, 1)[..., None] + pv
+    return m_cur, l_cur, acc_cur
+
+
+def ring_attention(
+    q,
+    k,
+    v,
+    mesh,
+    *,
+    axis_name: str = "sp",
+    causal: bool = False,
+    sm_scale: float | None = None,
+):
+    """Exact attention over sequence-sharded Q/K/V.
+
+    Inputs are global arrays [B, T, H, D] sharded over axis_name on dim 1 (or
+    plain arrays, which shard_map will split). Returns output with the same
+    sharding.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    n = mesh.shape[axis_name]
+    shard_map = _shard_map()
+
+    def local_fn(q_loc, k_loc, v_loc):
+        # q_loc: [B, T/n, H, D] — this device's chunk.
+        B, Tq, H, D = q_loc.shape
+        idx = lax.axis_index(axis_name)
+        q_start = idx * Tq
+
+        m0 = jnp.full((B, H, Tq), -jnp.inf, dtype=jnp.float32)
+        l0 = jnp.zeros((B, H, Tq), dtype=jnp.float32)
+        acc0 = jnp.zeros((B, Tq, H, D), dtype=jnp.float32)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def step(i, carry):
+            kc, vc, m, l, acc = carry
+            # Chunk currently held arrived from rank (idx - i) mod n.
+            k_start = ((idx - i) % n) * Tq
+            m, l, acc = _block_attend(
+                q_loc, kc, vc, q_start, k_start, causal, sm_scale, m, l, acc
+            )
+            kc = lax.ppermute(kc, axis_name, perm)
+            vc = lax.ppermute(vc, axis_name, perm)
+            return kc, vc, m, l, acc
+
+        _, _, m, l, acc = lax.fori_loop(0, n, step, (k_loc, v_loc, m0, l0, acc0))
+        l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows (shouldn't occur)
+        out = acc / l.transpose(0, 2, 1)[..., None]
+        return out.astype(q_loc.dtype)
+
+    spec = P(None, axis_name, None, None)
+    fn = shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

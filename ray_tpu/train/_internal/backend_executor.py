@@ -1,0 +1,180 @@
+"""BackendExecutor — orchestrates the worker gang for one training run.
+
+Analog of the reference's BackendExecutor
+(python/ray/train/_internal/backend_executor.py: start:104,
+start_training:342) + the backend plugin protocol (train/torch/config.py:155):
+creates the WorkerGroup (under a placement group for TPU gangs), runs the
+backend's ``on_start`` (mesh/collective bootstrap — the reference's
+``dist.init_process_group`` moment, SURVEY.md §3.4 step 5), starts the user
+loop everywhere, polls reports, and restarts the whole gang from the last
+checkpoint on worker failure (an XLA collective world is static — membership
+change means rebuild, SURVEY.md §7 hard part 1).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+import ray_tpu
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import ScalingConfig
+from ray_tpu.train._internal.worker_group import WorkerGroup
+
+logger = logging.getLogger(__name__)
+
+
+class Backend:
+    """Backend plugin protocol (reference: train/_internal/backend.py)."""
+
+    def on_start(self, worker_group: WorkerGroup, scaling_config: ScalingConfig):
+        pass
+
+    def on_shutdown(self, worker_group: WorkerGroup):
+        pass
+
+
+class JaxBackend(Backend):
+    """Forms the collective plane: the worker gang materialises a Mesh.
+
+    Replaces the reference's `_TorchBackend.on_start` NCCL bootstrap
+    (train/torch/config.py:113 dist.init_process_group) with the TPU-native
+    equivalent: collective group init -> jax.distributed -> jax.sharding.Mesh.
+    """
+
+    def __init__(self, backend: str | None = None, group_name: str = "train"):
+        self.backend = backend
+        self.group_name = group_name
+
+    def on_start(self, worker_group: WorkerGroup, scaling_config: ScalingConfig):
+        n = worker_group.num_workers
+        if n == 1:
+            ray_tpu.get(worker_group.workers[0].build_local_mesh.remote(), timeout=300)
+            return
+        backend = self.backend or ("tpu" if scaling_config.use_tpu else "tpu")
+        refs = [
+            w.init_collective.remote(n, rank, backend, self.group_name)
+            for rank, w in enumerate(worker_group.workers)
+        ]
+        ray_tpu.get(refs, timeout=600)
+
+
+class BackendExecutor:
+    def __init__(
+        self,
+        backend: Backend,
+        scaling_config: ScalingConfig,
+        max_failures: int = 0,
+    ):
+        self.backend = backend
+        self.scaling_config = scaling_config
+        self.max_failures = max_failures
+        self.worker_group: WorkerGroup | None = None
+        self._pg = None
+
+    def start(self):
+        sc = self.scaling_config
+        if sc.use_tpu:
+            from ray_tpu.util.placement_group import placement_group
+
+            self._pg = placement_group(
+                sc.as_placement_group_bundles(), strategy=sc.placement_strategy
+            )
+            self._pg.ready(timeout=300)
+        self.worker_group = WorkerGroup(
+            sc.num_workers,
+            resources_per_worker=sc.worker_resources(),
+            placement_group=self._pg,
+        )
+        self.backend.on_start(self.worker_group, sc)
+
+    def run(
+        self,
+        train_fn,
+        config: dict | None = None,
+        dataset_shards_per_rank: list | None = None,
+        on_report=None,
+        checkpoint: Checkpoint | None = None,
+    ) -> list[dict]:
+        """Run the loop on all workers until completion; returns final
+        reports per rank. Restarts the gang on failure (whole-group restart
+        from the latest checkpoint)."""
+        failures_left = self.max_failures
+        latest_checkpoint = checkpoint
+        while True:
+            try:
+                return self._run_once(
+                    train_fn, config, dataset_shards_per_rank, on_report, latest_checkpoint
+                )
+            except _WorkerGroupError as e:
+                if failures_left == 0:
+                    raise TrainingFailedError(str(e)) from None
+                failures_left -= 1 if failures_left > 0 else 0
+                latest_checkpoint = e.latest_checkpoint or latest_checkpoint
+                logger.warning(
+                    "worker group failed (%s); restarting from %s",
+                    e,
+                    "checkpoint" if latest_checkpoint else "scratch",
+                )
+                self.worker_group.shutdown()
+                self.start()
+
+    def _run_once(self, train_fn, config, shards_per_rank, on_report, checkpoint):
+        wg = self.worker_group
+        final_reports: list[dict] = [{} for _ in wg.workers]
+        done = [False] * len(wg.workers)
+        latest_checkpoint = None
+        refs = []
+        for rank, worker in enumerate(wg.workers):
+            shards = shards_per_rank[rank] if shards_per_rank else None
+            refs.append(
+                worker.run_train_fn.remote(train_fn, config or {}, shards, checkpoint)
+            )
+        try:
+            ray_tpu.get(refs, timeout=600)
+        except ray_tpu.exceptions.RayTpuError as e:
+            raise _WorkerGroupError(str(e), None) from None
+        while not all(done):
+            time.sleep(0.1)
+            polls = []
+            try:
+                polls = ray_tpu.get(
+                    [w.poll.remote() for w in wg.workers], timeout=60
+                )
+            except ray_tpu.exceptions.RayTpuError as e:
+                raise _WorkerGroupError(str(e), latest_checkpoint) from None
+            for rank, p in enumerate(polls):
+                for metrics, ckpt_blob in p["reports"]:
+                    final_reports[rank] = metrics
+                    ckpt = Checkpoint.from_bytes(ckpt_blob) if ckpt_blob else None
+                    if rank == 0 and ckpt is not None:
+                        latest_checkpoint = ckpt
+                    if rank == 0 and on_report is not None:
+                        on_report(metrics, ckpt)
+                if p["error"]:
+                    raise _WorkerGroupError(
+                        f"rank {rank} failed: {p['error']}", latest_checkpoint
+                    )
+                done[rank] = p["done"]
+        return final_reports
+
+    def shutdown(self):
+        if self.worker_group is not None:
+            self.worker_group.shutdown()
+        if self._pg is not None:
+            from ray_tpu.util.placement_group import remove_placement_group
+
+            try:
+                remove_placement_group(self._pg)
+            except Exception:
+                pass
+
+
+class TrainingFailedError(RuntimeError):
+    """Analog of the reference's TrainingFailedError."""
+
+
+class _WorkerGroupError(RuntimeError):
+    def __init__(self, msg: str, latest_checkpoint=None):
+        super().__init__(msg)
+        self.latest_checkpoint = latest_checkpoint

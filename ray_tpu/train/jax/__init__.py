@@ -1,0 +1,4 @@
+from ray_tpu.train.jax.jax_trainer import JaxConfig, JaxTrainer
+from ray_tpu.train.jax.train_loop_utils import prepare_batch, shard_batch
+
+__all__ = ["JaxConfig", "JaxTrainer", "prepare_batch", "shard_batch"]

@@ -1,0 +1,28 @@
+"""Per-worker loop helpers (analog of train/torch/train_loop_utils.py's
+prepare_model/prepare_data_loader — but TPU-native: "preparing" data means
+placing host numpy shards onto the mesh as sharded jax.Arrays)."""
+
+from __future__ import annotations
+
+
+def shard_batch(batch: dict, mesh, axis: str = "dp"):
+    """Host batch dict -> jax.Arrays sharded over the mesh's data axes."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    axes = [a for a in (axis, "fsdp") if mesh.shape.get(a, 1) > 1] or [axis]
+    spec = P(tuple(axes))
+
+    def place(x):
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return {k: place(v) for k, v in batch.items()}
+
+
+def prepare_batch(batch: dict, mesh=None):
+    """device_put a host batch; sharded if a mesh is available."""
+    import jax
+
+    if mesh is None:
+        return {k: jax.device_put(v) for k, v in batch.items()}
+    return shard_batch(batch, mesh)

@@ -1,0 +1,32 @@
+from ray_tpu.util.collective.collective import (
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    create_collective_group,
+    destroy_collective_group,
+    get_group,
+    init_collective_group,
+    is_group_initialized,
+    reduce,
+    reducescatter,
+    send_recv,
+)
+from ray_tpu.util.collective.types import Backend, ReduceOp
+
+__all__ = [
+    "Backend",
+    "ReduceOp",
+    "allgather",
+    "allreduce",
+    "barrier",
+    "broadcast",
+    "create_collective_group",
+    "destroy_collective_group",
+    "get_group",
+    "init_collective_group",
+    "is_group_initialized",
+    "reduce",
+    "reducescatter",
+    "send_recv",
+]

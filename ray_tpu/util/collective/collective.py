@@ -1,0 +1,159 @@
+"""Collective API.
+
+Analog of the reference's ray.util.collective.collective
+(python/ray/util/collective/collective.py: init_collective_group:120,
+create_collective_group:151, allreduce:258, reduce:311, broadcast:373,
+allgather:423, reducescatter:472, send:531, recv:594) with the NCCL backend
+replaced by XLA collectives over ICI (tpu_group.py) and the GLOO backend by an
+object-store ring (cpu_group.py).
+
+Usage inside member actors (one per TPU host):
+
+    from ray_tpu.util import collective as col
+
+    class TrainWorker:
+        def setup(self, world_size, rank):
+            col.init_collective_group(world_size, rank, backend="tpu")
+        def step(self, grads):
+            return col.allreduce(grads)
+
+Driver side: ``create_collective_group(actors, ...)`` declares the group and
+invokes ``init`` on every member (gang init, all-or-nothing — an XLA world is
+static, SURVEY.md §7 hard part 1).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from ray_tpu.util.collective.types import Backend, ReduceOp
+
+logger = logging.getLogger(__name__)
+
+
+class GroupManager:
+    """Per-process registry (reference: GroupManager collective.py:40)."""
+
+    def __init__(self):
+        self._groups: dict = {}
+        self._lock = threading.Lock()
+
+    def create(self, group_name: str, world_size: int, rank: int, backend: str, coordinator=None):
+        backend = Backend.validate(backend)
+        with self._lock:
+            if group_name in self._groups:
+                raise ValueError(f"collective group {group_name!r} already exists")
+        from ray_tpu._private import worker_context
+
+        cw = worker_context.get_core_worker_if_initialized()
+        gcs = cw.gcs if cw is not None else None
+        if backend == Backend.TPU:
+            from ray_tpu.util.collective.tpu_group import TpuCollectiveGroup
+
+            group = TpuCollectiveGroup(group_name, world_size, rank, coordinator=coordinator, gcs=gcs)
+        else:
+            from ray_tpu.util.collective.cpu_group import CpuCollectiveGroup
+
+            group = CpuCollectiveGroup(group_name, world_size, rank, gcs=gcs)
+        with self._lock:
+            self._groups[group_name] = group
+        return group
+
+    def get(self, group_name: str):
+        group = self._groups.get(group_name)
+        if group is None:
+            raise ValueError(
+                f"no collective group {group_name!r} in this process; "
+                "call init_collective_group first"
+            )
+        return group
+
+    def destroy(self, group_name: str):
+        with self._lock:
+            group = self._groups.pop(group_name, None)
+        if group is not None:
+            group.destroy()
+
+
+_manager = GroupManager()
+
+
+def init_collective_group(
+    world_size: int,
+    rank: int,
+    backend: str = "tpu",
+    group_name: str = "default",
+    coordinator: str | None = None,
+):
+    """Member-side group init (reference: collective.py:120)."""
+    return _manager.create(group_name, world_size, rank, backend, coordinator)
+
+
+def create_collective_group(
+    actors: list,
+    world_size: int | None = None,
+    ranks: list[int] | None = None,
+    backend: str = "tpu",
+    group_name: str = "default",
+):
+    """Driver-side gang init (reference: collective.py:151): calls
+    ``init_collective_group`` in every member actor concurrently and waits for
+    all (the XLA world bootstrap requires all processes to join)."""
+    import ray_tpu
+
+    world_size = world_size or len(actors)
+    ranks = ranks or list(range(len(actors)))
+    # Convention: member actors expose
+    # ``init_collective(world_size, rank, backend, group_name)`` which calls
+    # init_collective_group (see module docstring).
+    refs = [
+        actor.init_collective.remote(world_size, r, backend, group_name)
+        for actor, r in zip(actors, ranks)
+    ]
+    return ray_tpu.get(refs, timeout=300)
+
+
+def get_group(group_name: str = "default"):
+    return _manager.get(group_name)
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    try:
+        _manager.get(group_name)
+        return True
+    except ValueError:
+        return False
+
+
+def destroy_collective_group(group_name: str = "default"):
+    _manager.destroy(group_name)
+
+
+def allreduce(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
+    return _manager.get(group_name).allreduce(tensor, op)
+
+
+def allgather(tensor, group_name: str = "default"):
+    return _manager.get(group_name).allgather(tensor)
+
+
+def reducescatter(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
+    return _manager.get(group_name).reducescatter(tensor, op)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    return _manager.get(group_name).broadcast(tensor, src_rank)
+
+
+def reduce(tensor, dst_rank: int = 0, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
+    return _manager.get(group_name).reduce(tensor, dst_rank, op)
+
+
+def barrier(group_name: str = "default"):
+    _manager.get(group_name).barrier()
+
+
+def send_recv(tensor, perm, group_name: str = "default"):
+    """Pairwise exchange (ppermute). The p2p primitive (reference send/recv)."""
+    return _manager.get(group_name).send_recv(tensor, perm)

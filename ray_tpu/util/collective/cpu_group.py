@@ -1,0 +1,106 @@
+"""CPU collective group over the GCS KV / object store.
+
+Analog of the reference's GLOOGroup
+(python/ray/util/collective/collective_group/gloo_collective_group.py): a
+pure-Python fallback for host-memory collectives, so collective code runs on
+nodes with no accelerator (and in unit tests) without any extra dependency.
+Data moves through the GCS KV (small control-plane scale); the TPU group is
+the performance path.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ray_tpu.util.collective.types import ReduceOp
+
+_REDUCE = {
+    ReduceOp.SUM: lambda stack: stack.sum(axis=0),
+    ReduceOp.PRODUCT: lambda stack: stack.prod(axis=0),
+    ReduceOp.MIN: lambda stack: stack.min(axis=0),
+    ReduceOp.MAX: lambda stack: stack.max(axis=0),
+    ReduceOp.MEAN: lambda stack: stack.mean(axis=0),
+}
+
+
+class CpuCollectiveGroup:
+    def __init__(self, group_name: str, world_size: int, rank: int, gcs=None):
+        from ray_tpu._private import worker_context
+
+        self.group_name = group_name
+        self.world_size = world_size
+        self.rank = rank
+        self.gcs = gcs or worker_context.get_core_worker().gcs
+        self._epoch = 0
+
+    def _key(self, step: str, rank: int) -> str:
+        return f"collective/{self.group_name}/{self._epoch}/{step}/{rank}"
+
+    def _post(self, step: str, arr: np.ndarray):
+        from ray_tpu._private import serialization
+
+        self.gcs.call(
+            "kv_put", {"key": self._key(step, self.rank), "value": serialization.dumps(arr)}
+        )
+
+    def _collect(self, step: str, timeout: float = 120.0) -> list[np.ndarray]:
+        from ray_tpu._private import serialization
+
+        out: list = [None] * self.world_size
+        deadline = time.monotonic() + timeout
+        remaining = set(range(self.world_size))
+        while remaining and time.monotonic() < deadline:
+            for r in list(remaining):
+                resp = self.gcs.call("kv_get", {"key": self._key(step, r)})
+                if resp.get("found"):
+                    out[r] = np.asarray(serialization.loads(resp["value"]))
+                    remaining.discard(r)
+            if remaining:
+                time.sleep(0.01)
+        if remaining:
+            raise TimeoutError(f"collective {step} timed out waiting for ranks {remaining}")
+        return out
+
+    def _sync(self, step: str, arr) -> list[np.ndarray]:
+        arr = np.asarray(arr)
+        self._post(step, arr)
+        stack = self._collect(step)
+        self._epoch += 1
+        return stack
+
+    def allreduce(self, x, op: ReduceOp = ReduceOp.SUM):
+        stack = self._sync("allreduce", x)
+        return _REDUCE[op](np.stack(stack))
+
+    def allgather(self, x):
+        return np.stack(self._sync("allgather", x))
+
+    def reducescatter(self, x, op: ReduceOp = ReduceOp.SUM):
+        x = np.asarray(x)
+        assert x.shape[0] == self.world_size
+        stack = self._sync("reducescatter", x)
+        return _REDUCE[op](np.stack(stack))[self.rank]
+
+    def broadcast(self, x, src_rank: int = 0):
+        stack = self._sync("broadcast", x)
+        return stack[src_rank]
+
+    def reduce(self, x, dst_rank: int = 0, op: ReduceOp = ReduceOp.SUM):
+        out = self.allreduce(x, op)
+        return out if self.rank == dst_rank else None
+
+    def barrier(self):
+        self._sync("barrier", np.zeros((1,)))
+
+    def send_recv(self, x, perm):
+        """Pairwise exchange: returns the tensor sent to this rank (or x)."""
+        stack = self._sync("sendrecv", x)
+        for src, dst in perm:
+            if dst == self.rank:
+                return stack[src]
+        return np.asarray(x)
+
+    def destroy(self):
+        pass

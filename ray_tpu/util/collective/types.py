@@ -1,0 +1,26 @@
+"""Collective types (analog of python/ray/util/collective/types.py)."""
+
+from __future__ import annotations
+
+import enum
+
+
+class ReduceOp(enum.Enum):
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+    MEAN = "mean"
+
+
+class Backend:
+    TPU = "tpu"  # XLA collectives over ICI (replaces the reference's NCCL)
+    CPU = "cpu"  # object-store ring (replaces the reference's pygloo/GLOO)
+
+    @staticmethod
+    def validate(backend: str) -> str:
+        if backend in ("tpu", "xla", "ici"):
+            return Backend.TPU
+        if backend in ("cpu", "gloo", "object_store"):
+            return Backend.CPU
+        raise ValueError(f"unknown collective backend {backend!r}; use 'tpu' or 'cpu'")

@@ -1,0 +1,54 @@
+"""Test fixtures.
+
+Analog of the reference's python/ray/tests/conftest.py: `ray_start_regular`
+boots a real one-process-tree cluster per test; `ray_start_cluster` yields a
+multi-raylet single-host Cluster (the reference's multi-node-without-a-cluster
+trick, cluster_utils.py:99).
+
+JAX is forced onto a virtual 8-device CPU mesh BEFORE first import so sharding
+tests exercise real multi-device paths without TPU hardware.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("RAY_TPU_NUM_TPUS", "0")
+# Worker subprocesses read this and re-apply it via jax.config.update — an
+# environment sitecustomize may force jax_platforms to a TPU plugin, and a
+# config update is the only override that wins (env vars are read before it).
+os.environ["RAY_TPU_JAX_CONFIG_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Pin this (test-runner) process to CPU before any test imports jax.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_regular():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    try:
+        yield
+    finally:
+        ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster()
+    try:
+        yield cluster
+    finally:
+        cluster.shutdown()

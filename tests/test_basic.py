@@ -1,0 +1,172 @@
+"""Core task/object API tests (analog of the reference's python/ray/tests/test_basic.py)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import GetTimeoutError, TaskError
+
+
+def test_put_get(ray_start_regular):
+    ref = ray_tpu.put(42)
+    assert ray_tpu.get(ref) == 42
+    ref2 = ray_tpu.put({"a": [1, 2, 3], "b": "x"})
+    assert ray_tpu.get(ref2) == {"a": [1, 2, 3], "b": "x"}
+
+
+def test_put_get_large_numpy(ray_start_regular):
+    arr = np.random.default_rng(0).standard_normal((512, 512)).astype(np.float32)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_simple_task(ray_start_regular):
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    assert ray_tpu.get(f.remote(1), timeout=60) == 2
+
+
+def test_task_kwargs_and_defaults(ray_start_regular):
+    @ray_tpu.remote
+    def f(a, b=10, *, c=100):
+        return a + b + c
+
+    assert ray_tpu.get(f.remote(1), timeout=60) == 111
+    assert ray_tpu.get(f.remote(1, b=2, c=3), timeout=60) == 6
+
+
+def test_many_parallel_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def sq(i):
+        return i * i
+
+    refs = [sq.remote(i) for i in range(20)]
+    assert ray_tpu.get(refs, timeout=120) == [i * i for i in range(20)]
+
+
+def test_task_with_ref_arg(ray_start_regular):
+    @ray_tpu.remote
+    def plus(x, y):
+        return x + y
+
+    a = ray_tpu.put(10)
+    b = plus.remote(a, 5)
+    c = plus.remote(b, a)
+    assert ray_tpu.get(c, timeout=60) == 25
+
+
+def test_nested_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def inner(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x), timeout=60) + 1
+
+    assert ray_tpu.get(outer.remote(10), timeout=120) == 21
+
+
+def test_num_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c], timeout=60) == [1, 2, 3]
+
+
+def test_options_override(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return "ok"
+
+    ref = f.options(name="custom", num_cpus=1).remote()
+    assert ray_tpu.get(ref, timeout=60) == "ok"
+
+
+def test_error_propagation(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    with pytest.raises(TaskError, match="kaboom"):
+        ray_tpu.get(boom.remote(), timeout=60)
+
+
+def test_error_through_dependency(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    @ray_tpu.remote
+    def consume(x):
+        return x
+
+    with pytest.raises(TaskError):
+        ray_tpu.get(consume.remote(boom.remote()), timeout=60)
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        import time
+
+        time.sleep(30)
+
+    with pytest.raises(GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.5)
+
+
+def test_wait(ray_start_regular):
+    import time
+
+    @ray_tpu.remote
+    def fast():
+        return "fast"
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(60)
+
+    f, s = fast.remote(), slow.remote()
+    ready, not_ready = ray_tpu.wait([f, s], num_returns=1, timeout=30)
+    assert ready == [f]
+    assert not_ready == [s]
+
+
+def test_direct_call_raises(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(TypeError):
+        f()
+
+
+def test_cluster_resources(ray_start_regular):
+    total = ray_tpu.cluster_resources()
+    assert total.get("CPU") == 4
+
+
+def test_large_arg_auto_plasma(ray_start_regular):
+    arr = np.ones((1024, 512), dtype=np.float32)  # 2 MB > inline cutoff
+
+    @ray_tpu.remote
+    def total(x):
+        return float(x.sum())
+
+    assert ray_tpu.get(total.remote(arr), timeout=60) == float(arr.sum())
+
+
+def test_object_ref_in_container(ray_start_regular):
+    inner_ref = ray_tpu.put(7)
+
+    @ray_tpu.remote
+    def unwrap(d):
+        return ray_tpu.get(d["ref"], timeout=30) + 1
+
+    assert ray_tpu.get(unwrap.remote({"ref": inner_ref}), timeout=60) == 8

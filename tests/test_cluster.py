@@ -1,0 +1,125 @@
+"""Multi-node (multi-raylet single-host) tests — the reference's
+cluster_utils.Cluster pattern (python/ray/tests/conftest.py:396)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util.placement_group import placement_group, remove_placement_group
+from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+
+def test_two_node_scheduling(ray_start_cluster):
+    cluster = ray_start_cluster
+    n1 = cluster.add_node(num_cpus=1, resources={"a": 1})
+    n2 = cluster.add_node(num_cpus=1, resources={"b": 1})
+    cluster.connect()
+    cluster.wait_for_nodes()
+
+    @ray_tpu.remote
+    def whoami():
+        import os
+
+        return os.environ.get("RAY_TPU_NODE_ID")
+
+    on_a = whoami.options(resources={"a": 1}).remote()
+    on_b = whoami.options(resources={"b": 1}).remote()
+    node_a, node_b = ray_tpu.get([on_a, on_b], timeout=120)
+    assert node_a == n1.node_id
+    assert node_b == n2.node_id
+
+
+def test_cross_node_object_transfer(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1, resources={"a": 1})
+    cluster.add_node(num_cpus=1, resources={"b": 1})
+    cluster.connect()
+    cluster.wait_for_nodes()
+
+    @ray_tpu.remote(resources={"a": 1})
+    def produce():
+        return np.full((512, 512), 7.0, dtype=np.float32)  # 1MB -> plasma
+
+    @ray_tpu.remote(resources={"b": 1})
+    def consume(x):
+        return float(x.sum())
+
+    ref = produce.remote()
+    out = ray_tpu.get(consume.remote(ref), timeout=120)
+    assert out == 7.0 * 512 * 512
+
+
+def test_node_affinity(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1)
+    n2 = cluster.add_node(num_cpus=1)
+    cluster.connect()
+    cluster.wait_for_nodes()
+
+    @ray_tpu.remote
+    def whoami():
+        import os
+
+        return os.environ.get("RAY_TPU_NODE_ID")
+
+    strategy = NodeAffinitySchedulingStrategy(node_id=n2.node_id)
+    ref = whoami.options(scheduling_strategy=strategy).remote()
+    assert ray_tpu.get(ref, timeout=120) == n2.node_id
+
+
+def test_placement_group_strict_spread(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    cluster.connect()
+    cluster.wait_for_nodes()
+
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert pg.ready(timeout=30)
+    nodes = {pg.bundle_node(0), pg.bundle_node(1)}
+    assert len(nodes) == 2
+
+    @ray_tpu.remote
+    def whoami():
+        import os
+
+        return os.environ.get("RAY_TPU_NODE_ID")
+
+    from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+    r0 = whoami.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(pg, 0)
+    ).remote()
+    r1 = whoami.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(pg, 1)
+    ).remote()
+    got = set(ray_tpu.get([r0, r1], timeout=120))
+    assert got == nodes
+    remove_placement_group(pg)
+
+
+def test_placement_group_strict_pack_tpu_slice(ray_start_cluster):
+    """STRICT_PACK = one ICI domain: all TPU bundles land on one node."""
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1, num_tpus=4, labels={"tpu_slice": "v5e-4"})
+    cluster.add_node(num_cpus=1, num_tpus=4, labels={"tpu_slice": "v5e-4"})
+    cluster.connect()
+    cluster.wait_for_nodes()
+
+    pg = placement_group([{"TPU": 2}, {"TPU": 2}], strategy="STRICT_PACK")
+    assert pg.ready(timeout=30)
+    assert pg.bundle_node(0) == pg.bundle_node(1)
+    remove_placement_group(pg)
+
+
+def test_infeasible_pg_pending(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1)
+    cluster.connect()
+    pg = placement_group([{"CPU": 64}], strategy="PACK")
+    from ray_tpu.exceptions import PlacementGroupUnavailableError
+
+    with pytest.raises(PlacementGroupUnavailableError):
+        pg.ready(timeout=1.0)

@@ -1,0 +1,122 @@
+"""Failure / fault-tolerance tests (analog of the reference's test_failure*.py,
+test_chaos.py with the NodeKillerActor fault injector, test_utils.py:1360)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import TaskError, WorkerCrashedError
+
+
+def test_task_retry_on_worker_crash(ray_start_regular):
+    """A task that kills its worker is retried (reference: task_manager.h:335)."""
+    marker = f"/tmp/rtpu_retry_{os.getpid()}"
+    if os.path.exists(marker):
+        os.unlink(marker)
+
+    @ray_tpu.remote(max_retries=2)
+    def flaky(path):
+        import os as _os
+
+        if not _os.path.exists(path):
+            with open(path, "w") as f:
+                f.write("1")
+            _os._exit(1)  # kill the worker on first attempt
+        return "recovered"
+
+    assert ray_tpu.get(flaky.remote(marker), timeout=120) == "recovered"
+    os.unlink(marker)
+
+
+def test_task_no_retry_exhausted(ray_start_regular):
+    @ray_tpu.remote(max_retries=0)
+    def die():
+        import os as _os
+
+        _os._exit(1)
+
+    with pytest.raises(WorkerCrashedError):
+        ray_tpu.get(die.remote(), timeout=120)
+
+
+def test_retry_exceptions(ray_start_regular):
+    marker = f"/tmp/rtpu_retryexc_{os.getpid()}"
+    if os.path.exists(marker):
+        os.unlink(marker)
+
+    @ray_tpu.remote(max_retries=2, retry_exceptions=True)
+    def flaky(path):
+        import os as _os
+
+        if not _os.path.exists(path):
+            with open(path, "w") as f:
+                f.write("1")
+            raise RuntimeError("transient")
+        return "ok"
+
+    assert ray_tpu.get(flaky.remote(marker), timeout=120) == "ok"
+    os.unlink(marker)
+
+
+def test_lineage_reconstruction(ray_start_cluster):
+    """A lost plasma object is rebuilt by re-executing its creating task
+    (reference: object_recovery_manager.h:90)."""
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1, resources={"head": 1})
+    victim = cluster.add_node(num_cpus=1, resources={"victim": 1})
+    cluster.connect()
+    cluster.wait_for_nodes()
+
+    @ray_tpu.remote(resources={"victim": 1}, max_retries=2)
+    def produce():
+        return np.ones((512, 512), dtype=np.float32)  # 1MB -> plasma on victim
+
+    ref = produce.remote()
+    ray_tpu.wait([ref], timeout=60)
+    # Kill the node holding the only copy.
+    cluster.remove_node(victim)
+    cluster.add_node(num_cpus=1, resources={"victim": 1})
+    time.sleep(1.0)
+    out = ray_tpu.get(ref, timeout=120)
+    assert out.shape == (512, 512)
+
+
+def test_node_death_detected(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1)
+    extra = cluster.add_node(num_cpus=1)
+    cluster.connect()
+    cluster.wait_for_nodes()
+    extra_id = extra.node_id
+    cluster.remove_node(extra)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        states = {n["node_id"]: n["state"] for n in ray_tpu.nodes()}
+        if states.get(extra_id) == "DEAD":
+            return
+        time.sleep(0.2)
+    pytest.fail("node death not detected")
+
+
+def test_chaos_task_retry(ray_start_cluster):
+    """Tasks survive a node being killed mid-workload (reference:
+    test_chaos.py:66 test_chaos_task_retry)."""
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2, resources={"stable": 2})
+    victim = cluster.add_node(num_cpus=2)
+    cluster.connect()
+    cluster.wait_for_nodes()
+
+    @ray_tpu.remote(max_retries=3)
+    def work(i):
+        time.sleep(0.1)
+        return i
+
+    refs = [work.remote(i) for i in range(12)]
+    time.sleep(0.3)
+    cluster.remove_node(victim)
+    out = ray_tpu.get(refs, timeout=180)
+    assert out == list(range(12))
