@@ -54,6 +54,9 @@ class WorkerHandle:
     proc: subprocess.Popen | None = None
     state: str = "starting"  # starting | idle | busy | actor | dead
     current_task: TaskSpec | None = None
+    # Creation spec of the actor living in this worker; actors hold their
+    # resources for life, so these are released only on worker death.
+    actor_spec: TaskSpec | None = None
     actor_id: str | None = None
     last_idle: float = field(default_factory=time.monotonic)
 
@@ -558,6 +561,7 @@ class Raylet:
         """Actor finished __init__; keep the worker dedicated but free to serve."""
         worker = self.workers.get(req["worker_id"])
         if worker is not None:
+            worker.actor_spec = worker.current_task
             worker.current_task = None
         return {"ok": True}
 
@@ -588,6 +592,13 @@ class Raylet:
         worker.state = "dead"
         spec = worker.current_task
         logger.warning("worker %s died: %s", worker.worker_id[:8], reason)
+        if worker.actor_spec is not None:
+            # Release the actor's lifetime resource hold.
+            pool = self._resource_pool(worker.actor_spec)
+            if pool is not None:
+                for k, v in worker.actor_spec.resources.items():
+                    pool[k] = pool.get(k, 0) + v
+            worker.actor_spec = None
         if spec is not None:
             pool = self._resource_pool(spec)
             if pool is not None:

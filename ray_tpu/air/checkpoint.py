@@ -21,6 +21,16 @@ class Checkpoint:
     def __init__(self, data: dict | None = None, directory: str | None = None):
         self._data = data
         self._directory = directory
+        # Small side-band info (e.g. training_iteration); travels with the
+        # object through the object store and as metadata.json in dir form.
+        self.metadata: dict = {}
+        if directory is not None:
+            meta_path = os.path.join(directory, "metadata.json")
+            if os.path.exists(meta_path):
+                import json
+
+                with open(meta_path) as f:
+                    self.metadata = json.load(f)
 
     # ---- constructors ----
 
@@ -51,13 +61,19 @@ class Checkpoint:
     def to_directory(self, path: str | None = None) -> str:
         path = path or tempfile.mkdtemp(prefix="rtpu_ckpt_")
         os.makedirs(path, exist_ok=True)
-        if self._directory is not None and self._directory != path:
-            shutil.copytree(self._directory, path, dirs_exist_ok=True)
-            return path
-        tmp = os.path.join(path, f".tmp.{os.getpid()}.{time.monotonic_ns()}")
-        with open(tmp, "wb") as f:
-            cloudpickle.dump(self._data, f)
-        os.replace(tmp, os.path.join(path, "checkpoint.pkl"))
+        if self._directory is not None:
+            if os.path.abspath(self._directory) != os.path.abspath(path):
+                shutil.copytree(self._directory, path, dirs_exist_ok=True)
+        else:
+            tmp = os.path.join(path, f".tmp.{os.getpid()}.{time.monotonic_ns()}")
+            with open(tmp, "wb") as f:
+                cloudpickle.dump(self._data, f)
+            os.replace(tmp, os.path.join(path, "checkpoint.pkl"))
+        if self.metadata:
+            import json
+
+            with open(os.path.join(path, "metadata.json"), "w") as f:
+                json.dump(self.metadata, f, default=str)
         return path
 
     def __repr__(self):

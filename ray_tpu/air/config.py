@@ -48,3 +48,13 @@ class RunConfig:
     checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
     stop: dict | None = None
     verbose: int = 1
+
+    def resolve_dir(self, default_name: str) -> str:
+        """Experiment/run directory: <storage_path>/<name> (single source of
+        the storage-path policy for Train and Tune)."""
+        import os
+        import time
+
+        root = self.storage_path or "/tmp/ray_tpu_results"
+        name = self.name or f"{default_name}_{time.strftime('%Y%m%d-%H%M%S')}"
+        return os.path.join(root, name)

@@ -40,9 +40,7 @@ class BaseTrainer:
         self.datasets = datasets or {}
 
     def _run_dir(self) -> str:
-        root = self.run_config.storage_path or os.path.expanduser("~/ray_tpu_results")
-        name = self.run_config.name or f"{type(self).__name__}_{time.strftime('%Y%m%d-%H%M%S')}"
-        return os.path.join(root, name)
+        return self.run_config.resolve_dir(type(self).__name__)
 
     def training_loop(self) -> None:
         raise NotImplementedError

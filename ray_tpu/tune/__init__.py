@@ -1,0 +1,29 @@
+"""ray_tpu.tune — the experiment runner (analog of python/ray/tune).
+
+Tuner/tune.run drive trials-as-actors through a TuneController with pluggable
+searchers (grid/random/model-based) and schedulers (FIFO/ASHA/median/PBT);
+every other library's .fit() can route through it like the reference
+(base_trainer.py:559)."""
+
+from ray_tpu.tune.sample import (  # noqa: F401
+    choice,
+    grid_search,
+    lograndint,
+    loguniform,
+    qloguniform,
+    qrandint,
+    quniform,
+    randint,
+    randn,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.trainable import (  # noqa: F401
+    FunctionTrainable,
+    Trainable,
+    get_checkpoint,
+    report,
+)
+from ray_tpu.tune.tune_config import TuneConfig  # noqa: F401
+from ray_tpu.tune.result_grid import ResultGrid  # noqa: F401
+from ray_tpu.tune.tuner import Tuner, run  # noqa: F401

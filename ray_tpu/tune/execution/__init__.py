@@ -1,0 +1,1 @@
+from ray_tpu.tune.execution.tune_controller import TuneController  # noqa: F401

@@ -1,0 +1,349 @@
+"""TuneController (analog of reference python/ray/tune/execution/
+tune_controller.py:49 + ray_trial_executor.py:188): the experiment step loop.
+
+Each trial runs in a dedicated **trial actor** (`_TrialActor`) holding one
+Trainable; the controller drives train/save/stop via actor calls and reacts to
+results with the searcher + scheduler. Failed trials are retried up to
+``max_failures`` by recreating the actor from the latest checkpoint — same
+gang-restart shape the JaxTrainer BackendExecutor uses.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import traceback
+
+import ray_tpu
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.tune.experiment.trial import (
+    ERROR,
+    PAUSED,
+    PENDING,
+    RUNNING,
+    TERMINATED,
+    Trial,
+)
+from ray_tpu.tune.schedulers.pbt import EXPLOIT, PopulationBasedTraining
+from ray_tpu.tune.schedulers.trial_scheduler import (
+    CONTINUE,
+    PAUSE,
+    STOP,
+    FIFOScheduler,
+    TrialScheduler,
+)
+from ray_tpu.tune.search.searcher import Searcher
+from ray_tpu.tune.trainable import RESULT_DONE, Trainable, wrap_function
+
+
+class _TrialActor:
+    """Actor hosting one Trainable instance (reference: the trainable-as-actor
+    pattern, ray_trial_executor.py:382 _setup_remote_runner)."""
+
+    def __init__(self, trainable_cls, config: dict, checkpoint=None):
+        self._trainable: Trainable = trainable_cls(config)
+        if checkpoint is not None:
+            self._trainable.restore(checkpoint)
+
+    def train(self) -> dict:
+        return self._trainable.train()
+
+    def save(self):
+        return self._trainable.save()
+
+    def restore(self, checkpoint) -> None:
+        self._trainable.restore(checkpoint)
+
+    def reset(self, new_config: dict, checkpoint=None) -> bool:
+        ok = self._trainable.reset_config(new_config)
+        if ok and checkpoint is not None:
+            self._trainable.restore(checkpoint)
+        return ok
+
+    def stop(self) -> None:
+        self._trainable.stop()
+
+
+class TuneController:
+    def __init__(
+        self,
+        trainable,
+        *,
+        param_space: dict | None = None,
+        searcher: Searcher,
+        scheduler: TrialScheduler | None = None,
+        metric: str | None = None,
+        mode: str = "max",
+        num_samples: int = 1,
+        max_concurrent: int | None = None,
+        stop: dict | None = None,
+        time_budget_s: float | None = None,
+        max_failures: int = 0,
+        resources_per_trial: dict | None = None,
+        experiment_dir: str | None = None,
+        experiment_name: str = "exp",
+        checkpoint_frequency: int = 1,
+    ):
+        if isinstance(trainable, type) and issubclass(trainable, Trainable):
+            self.trainable_cls = trainable
+        elif callable(trainable):
+            self.trainable_cls = wrap_function(trainable)
+        else:
+            raise TypeError(f"trainable must be a Trainable subclass or function, got {trainable!r}")
+        self.searcher = searcher
+        self.scheduler = scheduler or FIFOScheduler()
+        self.metric = metric
+        self.mode = mode
+        self.num_samples = num_samples
+        self.max_concurrent = max_concurrent
+        self.stop_criteria = stop or {}
+        self.time_budget_s = time_budget_s
+        self.max_failures = max_failures
+        self.resources_per_trial = resources_per_trial or {"CPU": 1}
+        self.experiment_dir = experiment_dir
+        self.experiment_name = experiment_name
+        self.checkpoint_frequency = checkpoint_frequency
+
+        self.trials: list[Trial] = []
+        self._searcher_done = False
+        self._start_time = time.time()
+        self._saved_ckpt_ids: dict[str, int] = {}
+
+        self.searcher.set_search_properties(metric, mode, param_space or {})
+        self.scheduler.set_search_properties(metric, mode)
+
+    # -- trial lifecycle ----------------------------------------------------
+
+    def _actor_options(self) -> dict:
+        res = dict(self.resources_per_trial)
+        opts: dict = {}
+        ncpu = res.pop("CPU", None)
+        ntpu = res.pop("TPU", None)
+        if ncpu:
+            opts["num_cpus"] = ncpu
+        if ntpu:
+            opts["num_tpus"] = ntpu
+        if res:
+            opts["resources"] = res
+        return opts
+
+    def _start_trial(self, trial: Trial, checkpoint=None, config: dict | None = None):
+        if config is not None:
+            trial.config = config
+        cls = ray_tpu.remote(_TrialActor)
+        trial.runner = cls.options(
+            max_restarts=0, **self._actor_options()
+        ).remote(self.trainable_cls, trial.config, checkpoint if checkpoint is not None else trial.checkpoint)
+        trial.status = RUNNING
+        trial.start_time = time.time()
+        trial.pending_future = trial.runner.train.remote()
+        trial.pending_action = "train"
+
+    def _stop_trial(self, trial: Trial, status: str = TERMINATED):
+        if trial.runner is not None:
+            try:
+                trial.runner.stop.remote()
+                ray_tpu.kill(trial.runner)
+            except Exception:
+                pass
+        trial.runner = None
+        trial.pending_future = None
+        trial.status = status
+
+    def _maybe_add_trial(self) -> bool:
+        """Ask the searcher for a new config; returns True if a trial was added."""
+        if self._searcher_done:
+            return False
+        total = self.searcher.total_samples
+        if total is not None and len(self.trials) >= total:
+            self._searcher_done = True
+            return False
+        if total is None and len(self.trials) >= self.num_samples:
+            self._searcher_done = True
+            return False
+        trial = Trial(config={})
+        cfg = self.searcher.suggest(trial.trial_id)
+        if cfg is None:
+            return False  # limiter saturated or exhausted; retry later
+        trial.config = cfg
+        self.trials.append(trial)
+        self.scheduler.on_trial_add(self, trial)
+        return True
+
+    def _live_trials(self) -> list[Trial]:
+        return [t for t in self.trials if t.status == RUNNING]
+
+    def _should_stop_trial(self, result: dict) -> bool:
+        if result.get(RESULT_DONE):
+            return True
+        # Stop criteria are always "stop once value reaches bound", regardless
+        # of optimisation mode (reference Ray semantics).
+        for key, bound in self.stop_criteria.items():
+            v = result.get(key)
+            if v is not None and v >= bound:
+                return True
+        return False
+
+    # -- result handling ----------------------------------------------------
+
+    def _on_result(self, trial: Trial, result: dict):
+        # merge so the final done-sentinel step doesn't erase reported metrics
+        trial.last_result = {**trial.last_result, **result}
+        result = trial.last_result
+        if self.metric and self.metric in result:
+            trial.metric_history.append(result[self.metric])
+        self.searcher.on_trial_result(trial.trial_id, result)
+
+        if self._should_stop_trial(result):
+            self._complete_trial(trial, result)
+            return
+
+        decision = self.scheduler.on_trial_result(self, trial, result)
+        if decision == STOP:
+            self._complete_trial(trial, result)
+        elif decision == PAUSE:
+            self._save_then(trial, next_action="pause")
+        elif decision == EXPLOIT:
+            donor, new_config = self.scheduler.pending_exploit.pop(trial.trial_id)
+            self._exploit(trial, donor, new_config)
+        else:  # CONTINUE
+            if self.checkpoint_frequency and trial.iteration % self.checkpoint_frequency == 0:
+                self._save_then(trial, next_action="train")
+            else:
+                trial.pending_future = trial.runner.train.remote()
+                trial.pending_action = "train"
+
+    def _save_then(self, trial: Trial, next_action: str):
+        trial.pending_future = trial.runner.save.remote()
+        trial.pending_action = f"save:{next_action}"
+
+    def _complete_trial(self, trial: Trial, result: dict):
+        self.searcher.on_trial_complete(trial.trial_id, result)
+        self.scheduler.on_trial_complete(self, trial, result)
+        # capture a final checkpoint before teardown
+        try:
+            ckpt = ray_tpu.get(trial.runner.save.remote(), timeout=30)
+            if ckpt is not None:
+                trial.checkpoint = ckpt
+        except Exception:
+            pass
+        self._stop_trial(trial, TERMINATED)
+
+    def _exploit(self, trial: Trial, donor: Trial, new_config: dict):
+        """PBT: restart `trial` from donor's checkpoint with a mutated config."""
+        self._stop_trial(trial, PENDING)
+        trial.checkpoint = donor.checkpoint
+        self._start_trial(trial, checkpoint=donor.checkpoint, config=new_config)
+
+    def _on_error(self, trial: Trial, err: Exception):
+        trial.num_failures += 1
+        trial.error_msg = f"{type(err).__name__}: {err}"
+        if trial.num_failures <= self.max_failures or self.max_failures < 0:
+            self._stop_trial(trial, PENDING)  # retried from latest checkpoint
+        else:
+            # Only tell the searcher once the trial is truly finished — a
+            # retried trial will complete (or exhaust retries) later.
+            self.searcher.on_trial_complete(trial.trial_id, error=True)
+            self.scheduler.on_trial_error(self, trial)
+            self._stop_trial(trial, ERROR)
+
+    # -- main loop ----------------------------------------------------------
+
+    def step(self):
+        """One controller iteration: top up trials, wait on one future, react."""
+        cap = self.max_concurrent or max(1, int(ray_tpu.cluster_resources().get("CPU", 1)))
+        while len(self._live_trials()) < cap:
+            pending = [t for t in self.trials if t.status in (PENDING, PAUSED)]
+            if pending:
+                t = self.scheduler.choose_trial_to_run(self) or pending[0]
+                self._start_trial(t)
+                continue
+            if not self._maybe_add_trial():
+                break
+
+        live = self._live_trials()
+        if not live:
+            return
+        futures = {t.pending_future: t for t in live if t.pending_future is not None}
+        if not futures:
+            return
+        ready, _ = ray_tpu.wait(list(futures), num_returns=1, timeout=10.0)
+        for ref in ready:
+            trial = futures[ref]
+            try:
+                value = ray_tpu.get(ref)
+            except Exception as e:
+                self._on_error(trial, e)
+                continue
+            action = trial.pending_action
+            if action == "train":
+                self._on_result(trial, value)
+            elif action.startswith("save"):
+                if value is not None:
+                    trial.checkpoint = value
+                nxt = action.split(":", 1)[1]
+                if nxt == "train":
+                    trial.pending_future = trial.runner.train.remote()
+                    trial.pending_action = "train"
+                else:  # pause
+                    self._stop_trial(trial, PAUSED)
+
+    def is_finished(self) -> bool:
+        if self.time_budget_s and time.time() - self._start_time > self.time_budget_s:
+            return True
+        active = [t for t in self.trials if t.status in (RUNNING, PENDING, PAUSED)]
+        return self._searcher_done and not active
+
+    def run(self):
+        try:
+            while not self.is_finished():
+                self.step()
+                self.save_experiment_state()
+        finally:
+            for t in self._live_trials():
+                self._stop_trial(t, TERMINATED)
+            self.save_experiment_state()
+        return self.trials
+
+    # -- persistence (reference: execution/experiment_state.py) -------------
+
+    def save_experiment_state(self):
+        if not self.experiment_dir:
+            return
+        os.makedirs(self.experiment_dir, exist_ok=True)
+        state = {
+            "experiment_name": self.experiment_name,
+            "metric": self.metric,
+            "mode": self.mode,
+            "trials": [t.summary() for t in self.trials],
+            "timestamp": time.time(),
+        }
+        path = os.path.join(self.experiment_dir, "experiment_state.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f, indent=1, default=str)
+        os.replace(tmp, path)
+        for t in self.trials:
+            # only re-serialise checkpoints that changed since the last save
+            if t.checkpoint is not None and self._saved_ckpt_ids.get(t.trial_id) != id(t.checkpoint):
+                try:
+                    t.checkpoint.to_directory(
+                        os.path.join(self.experiment_dir, f"checkpoint_{t.trial_id}")
+                    )
+                    self._saved_ckpt_ids[t.trial_id] = id(t.checkpoint)
+                except Exception:
+                    pass
+
+    @staticmethod
+    def load_experiment_state(experiment_dir: str) -> dict:
+        with open(os.path.join(experiment_dir, "experiment_state.json")) as f:
+            state = json.load(f)
+        for ts in state["trials"]:
+            ckpt_dir = os.path.join(experiment_dir, f"checkpoint_{ts['trial_id']}")
+            if os.path.isdir(ckpt_dir):
+                try:
+                    ts["checkpoint"] = Checkpoint.from_directory(ckpt_dir)
+                except Exception:
+                    ts["checkpoint"] = None
+        return state

@@ -1,0 +1,12 @@
+from ray_tpu.tune.schedulers.trial_scheduler import (  # noqa: F401
+    CONTINUE,
+    PAUSE,
+    STOP,
+    FIFOScheduler,
+    TrialScheduler,
+)
+from ray_tpu.tune.schedulers.asha import ASHAScheduler  # noqa: F401
+from ray_tpu.tune.schedulers.median_stopping import MedianStoppingRule  # noqa: F401
+from ray_tpu.tune.schedulers.pbt import PopulationBasedTraining  # noqa: F401
+
+AsyncHyperBandScheduler = ASHAScheduler

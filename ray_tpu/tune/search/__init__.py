@@ -1,0 +1,6 @@
+from ray_tpu.tune.search.searcher import (  # noqa: F401
+    ConcurrencyLimiter,
+    Searcher,
+)
+from ray_tpu.tune.search.basic_variant import BasicVariantGenerator  # noqa: F401
+from ray_tpu.tune.search.hyperopt_like import HyperOptLikeSearch  # noqa: F401

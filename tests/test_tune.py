@@ -1,0 +1,272 @@
+"""Tune tests (modeled on reference python/ray/tune/tests — controller loop,
+search/scheduler behavior, checkpoint/restore, trainer integration)."""
+
+import random
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import FailureConfig, RunConfig
+from ray_tpu.tune import sample as s
+from ray_tpu.tune.schedulers import ASHAScheduler, MedianStoppingRule, PopulationBasedTraining
+from ray_tpu.tune.search import BasicVariantGenerator, ConcurrencyLimiter, HyperOptLikeSearch
+
+
+# ---------- sampling (no cluster needed) ----------
+
+def test_grid_cross_product_times_samples():
+    space = {"a": tune.grid_search([1, 2, 3]), "b": tune.grid_search(["x", "y"])}
+    g = BasicVariantGenerator(space, num_samples=2)
+    assert g.total_samples == 12
+    configs = [g.suggest(str(i)) for i in range(12)]
+    assert all(c is not None for c in configs)
+    assert g.suggest("extra") is None
+    assert {(c["a"], c["b"]) for c in configs} == {(a, b) for a in (1, 2, 3) for b in ("x", "y")}
+
+
+def test_domains_sample_within_bounds():
+    rng = random.Random(0)
+    for _ in range(100):
+        assert 1e-4 <= s.loguniform(1e-4, 1e-1).sample(rng) <= 1e-1
+        assert s.randint(2, 8).sample(rng) in range(2, 8)
+        assert s.choice(["a", "b"]).sample(rng) in ("a", "b")
+        q = s.quniform(0, 1, 0.25).sample(rng)
+        assert abs(q / 0.25 - round(q / 0.25)) < 1e-9
+
+
+def test_sample_from_sees_resolved_config():
+    space = {"a": tune.choice([4]), "b": tune.sample_from(lambda spec: spec.config["a"] * 2)}
+    cfg = s.resolve(space, random.Random(0))
+    assert cfg == {"a": 4, "b": 8}
+
+
+def test_nested_spaces():
+    space = {"opt": {"lr": tune.loguniform(1e-4, 1e-2), "name": "adam"}, "n": tune.grid_search([1, 2])}
+    g = BasicVariantGenerator(space)
+    c = g.suggest("t")
+    assert c["opt"]["name"] == "adam" and 1e-4 <= c["opt"]["lr"] <= 1e-2 and c["n"] in (1, 2)
+
+
+def test_concurrency_limiter():
+    g = ConcurrencyLimiter(BasicVariantGenerator({"x": tune.uniform(0, 1)}, num_samples=5), 2)
+    a, b = g.suggest("t1"), g.suggest("t2")
+    assert a is not None and b is not None
+    assert g.suggest("t3") is None
+    g.on_trial_complete("t1", {"m": 1})
+    assert g.suggest("t3") is not None
+
+
+# ---------- experiments on a live cluster ----------
+
+def _quadratic(config):
+    # max of -(x-3)^2 at x=3
+    for i in range(5):
+        tune.report({"score": -((config["x"] - 3.0) ** 2) - 0.01 * (5 - i)})
+
+
+def test_tuner_random_search(ray_start_regular):
+    results = tune.Tuner(
+        _quadratic,
+        param_space={"x": tune.uniform(0, 6)},
+        tune_config=tune.TuneConfig(metric="score", mode="max", num_samples=6,
+                                    max_concurrent_trials=3),
+    ).fit()
+    assert len(results) == 6
+    best = results.get_best_result("score", "max")
+    assert best.metrics["score"] == max(r.metrics["score"] for r in results)
+
+
+def test_tune_run_grid(ray_start_regular):
+    results = tune.run(
+        _quadratic,
+        config={"x": tune.grid_search([1.0, 3.0, 5.0])},
+        metric="score",
+        mode="max",
+    )
+    assert len(results) == 3
+    assert abs(results.get_best_result("score", "max").metrics["score"] + 0.01) < 1e-6
+
+
+class _Counter(tune.Trainable):
+    def setup(self, config):
+        self.gain = config.get("gain", 1)
+        self.total = 0
+
+    def step(self):
+        self.total += self.gain
+        return {"total": self.total}
+
+    def save_checkpoint(self):
+        return Checkpoint.from_dict({"total": self.total})
+
+    def load_checkpoint(self, ckpt):
+        self.total = ckpt.to_dict()["total"]
+
+
+def test_class_trainable_stop_criteria(ray_start_regular):
+    results = tune.run(_Counter, config={"gain": 2}, stop={"training_iteration": 4})
+    assert results[0].metrics["training_iteration"] == 4
+    assert results[0].metrics["total"] == 8
+
+
+def test_class_trainable_checkpoints_kept(ray_start_regular):
+    results = tune.run(_Counter, config={"gain": 1}, stop={"training_iteration": 3})
+    ckpt = results[0].checkpoint
+    assert ckpt is not None and ckpt.to_dict()["total"] == 3
+
+
+def _report_iters(config):
+    for i in range(1, config.get("iters", 20) + 1):
+        tune.report({"acc": config["lr"] * i})
+
+
+def test_asha_stops_bad_trials_early(ray_start_regular):
+    scheduler = ASHAScheduler(metric="acc", mode="max", max_t=20, grace_period=2,
+                              reduction_factor=2)
+    # good trials first + limited concurrency => later bad trials hit rungs
+    # that already have recorded competitors and get cut (async ASHA only
+    # stops trials arriving after the quantile is established)
+    results = tune.Tuner(
+        _report_iters,
+        param_space={"lr": tune.grid_search([10.0, 1.0, 0.1, 0.01])},
+        tune_config=tune.TuneConfig(scheduler=scheduler, metric="acc", mode="max",
+                                    max_concurrent_trials=2),
+    ).fit()
+    iters = sorted(r.metrics.get("training_iteration", 0) for r in results)
+    assert iters[-1] >= 19  # best trial ran (nearly) to completion
+    assert iters[0] < 20  # at least one trial was cut early
+
+
+def test_median_stopping(ray_start_regular):
+    scheduler = MedianStoppingRule(metric="acc", mode="max", grace_period=2,
+                                   min_samples_required=2)
+    results = tune.Tuner(
+        _report_iters,
+        param_space={"lr": tune.grid_search([0.001, 0.001, 5.0, 5.0])},
+        tune_config=tune.TuneConfig(scheduler=scheduler, metric="acc", mode="max",
+                                    max_concurrent_trials=4),
+    ).fit()
+    assert len(results) == 4
+
+
+class _PBTTrainable(tune.Trainable):
+    """Score grows by `rate`; good rates dominate — exploited trials should
+    adopt winning rates + checkpoints."""
+
+    def setup(self, config):
+        self.score = 0.0
+
+    def step(self):
+        self.score += self.config["rate"]
+        return {"score": self.score}
+
+    def save_checkpoint(self):
+        return Checkpoint.from_dict({"score": self.score})
+
+    def load_checkpoint(self, ckpt):
+        self.score = ckpt.to_dict()["score"]
+
+    def reset_config(self, new_config):
+        self.config = new_config
+        return True
+
+
+def test_pbt_exploits(ray_start_regular):
+    pbt = PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=3,
+        hyperparam_mutations={"rate": tune.uniform(0.1, 10.0)}, seed=0,
+    )
+    results = tune.Tuner(
+        _PBTTrainable,
+        param_space={"rate": tune.grid_search([0.1, 0.1, 8.0, 8.0])},
+        tune_config=tune.TuneConfig(scheduler=pbt, metric="score", mode="max",
+                                    max_concurrent_trials=4),
+        run_config=RunConfig(stop={"training_iteration": 12}),
+    ).fit()
+    best = results.get_best_result("score", "max").metrics["score"]
+    assert best >= 8.0 * 10  # top performer kept running
+
+
+def _flaky(config, checkpoint=None):
+    start = 0
+    if checkpoint is not None:
+        start = checkpoint.to_dict()["i"] + 1
+    for i in range(start, 6):
+        if i == 3 and start == 0:
+            raise RuntimeError("boom")
+        tune.report({"i": i}, checkpoint=Checkpoint.from_dict({"i": i}))
+
+
+def test_trial_retry_from_checkpoint(ray_start_regular):
+    results = tune.run(_flaky, config={}, max_failures=2)
+    assert not results.errors
+    assert results[0].metrics["i"] == 5
+
+
+def test_trial_error_surfaces(ray_start_regular):
+    def bad(config):
+        raise ValueError("nope")
+
+    results = tune.run(bad, config={})
+    assert len(results.errors) == 1
+
+
+def test_hyperopt_like_beats_random_on_easy_quadratic(ray_start_regular):
+    searcher = HyperOptLikeSearch(
+        {"x": tune.uniform(0, 6)}, metric="score", mode="max",
+        n_initial_points=3, seed=0,
+    )
+    results = tune.Tuner(
+        _quadratic,
+        tune_config=tune.TuneConfig(metric="score", mode="max", num_samples=12,
+                                    search_alg=searcher, max_concurrent_trials=1),
+    ).fit()
+    best = results.get_best_result("score", "max")
+    assert best.metrics["score"] > -1.5  # found the region around x=3
+
+
+def test_tuner_restore(ray_start_regular, tmp_path):
+    run_config = RunConfig(name="restore_exp", storage_path=str(tmp_path),
+                           stop={"training_iteration": 4})
+    results = tune.Tuner(
+        _Counter,
+        param_space={"gain": tune.grid_search([1, 2])},
+        tune_config=tune.TuneConfig(metric="total", mode="max"),
+        run_config=run_config,
+    ).fit()
+    assert len(results) == 2
+    exp_dir = str(tmp_path / "restore_exp")
+    restored = tune.Tuner.restore(
+        exp_dir, _Counter,
+        tune_config=tune.TuneConfig(metric="total", mode="max"),
+        run_config=RunConfig(stop={"training_iteration": 6}),
+    ).fit()
+    assert len(restored) == 2
+    # restored trials resume from checkpoint (iteration 4) and run to 6
+    for r in restored:
+        assert r.metrics["training_iteration"] >= 4
+
+
+def test_trainer_via_tuner(ray_start_regular):
+    from ray_tpu.air.config import ScalingConfig
+    from ray_tpu.train.jax import JaxTrainer
+
+    def loop(config):
+        from ray_tpu.air import session
+
+        for i in range(3):
+            session.report({"loss": 1.0 / (config.get("lr", 1.0) * (i + 1))})
+
+    trainer = JaxTrainer(
+        train_loop_per_worker=loop,
+        scaling_config=ScalingConfig(num_workers=1),
+    )
+    results = tune.Tuner(
+        trainer,
+        param_space={"train_loop_config": {"lr": tune.grid_search([1.0, 2.0])}},
+        tune_config=tune.TuneConfig(metric="loss", mode="min"),
+    ).fit()
+    assert len(results) == 2
+    assert results.get_best_result("loss", "min").metrics["loss"] == pytest.approx(1.0 / 6.0)
