@@ -117,6 +117,7 @@ class CoreWorker:
         self.in_process_store: dict[str, dict] = {}  # oid -> {data | value}
         self.owned: dict[str, OwnedObject] = {}
         self._object_events: dict[str, asyncio.Event] = {}
+        self._owner_client_cache: dict[tuple, RpcClient] = {}
         self.pending_tasks: dict[str, PendingTask] = {}
         self.lineage: collections.OrderedDict[str, TaskSpec] = collections.OrderedDict()
         self._borrowed_decref_queue: list = []
@@ -210,11 +211,95 @@ class CoreWorker:
             runtime_env=opts.get("runtime_env") or {},
         )
         self._register_pending(spec, arg_refs)
-        self.raylet.call("submit_task", {"spec": spec.to_wire()})
+        self._submit_when_ready(spec, arg_refs)
         return [
             ObjectRef(ObjectID.for_return(task_id, i), self.address)
             for i in range(num_returns)
         ]
+
+    def _submit_when_ready(self, spec: TaskSpec, arg_refs: list):
+        """Submitter-side dependency resolution (reference:
+        dependency_resolver.h:29 LocalDependencyResolver): hold the task until
+        every ObjectRef argument is available, so leased workers never block
+        on unproduced inputs. Owned refs wait on completion events; borrowed
+        refs poll the owner."""
+        unready = [ref for ref in arg_refs if not self._arg_available(ref)]
+        if not unready:
+            self.raylet.call("submit_task", {"spec": spec.to_wire()})
+            return
+
+        async def _wait_and_submit():
+            # Runs ON the IO loop: only async RPC here — a blocking .call()
+            # would deadlock every socket in the process.
+            try:
+                for ref in unready:
+                    oid_hex = ref.hex()
+                    if self._is_own(ref):
+                        await self._wait_event(oid_hex, None)
+                    else:
+                        while not await self._arg_available_async(ref):
+                            await asyncio.sleep(0.02)
+                await self.raylet.acall("submit_task", {"spec": spec.to_wire()})
+            except Exception as e:
+                logger.exception("deferred submit of %s failed", spec.task_id[:8])
+                self._fail_task(spec.task_id, WorkerCrashedError(f"submit failed: {e!r}"))
+
+        self._io.spawn(_wait_and_submit())
+
+    async def _arg_available_async(self, ref) -> bool:
+        """Non-blocking (IO-loop-safe) version of _arg_available for
+        borrowed refs."""
+        oid_hex = ref.hex()
+        with self._lock:
+            if oid_hex in self.in_process_store:
+                return True
+        try:
+            resp = await self.raylet.acall("store_contains", {"object_id": oid_hex})
+            if resp.get("found"):
+                return True
+        except Exception:
+            pass
+        try:
+            client = self._owner_client(tuple(ref.owner_addr))
+            resp = await client.acall("get_inline", {"object_id": oid_hex, "wait": False}, timeout=2)
+            return resp.get("kind") in ("inline", "plasma")
+        except Exception:
+            return False
+
+    def _is_own(self, ref) -> bool:
+        return ref.owner_addr is None or tuple(ref.owner_addr) == tuple(self.address)
+
+    def _arg_available(self, ref) -> bool:
+        oid_hex = ref.hex()
+        with self._lock:
+            if oid_hex in self.in_process_store:
+                return True
+            if self._is_own(ref):
+                task_id = oid_hex[: TaskID.SIZE * 2]
+                if task_id in self.pending_tasks:
+                    return False
+                obj = self.owned.get(oid_hex)
+                return obj is not None and (obj.in_plasma or oid_hex in self.in_process_store)
+        # Borrowed: available once the owner reports it, or once a local copy
+        # exists (probe cheaply first to avoid an RPC storm).
+        if self.store.contains(oid_hex):
+            return True
+        try:
+            client = self._owner_client(tuple(ref.owner_addr))
+            resp = client.call("get_inline", {"object_id": oid_hex, "wait": False}, timeout=2)
+            return resp.get("kind") in ("inline", "plasma")
+        except Exception:
+            return False
+
+    def _owner_client(self, addr: tuple) -> RpcClient:
+        """Cached connection to another worker/driver (owner of a borrowed
+        ref). One connection per peer, reused across gets/probes/decrefs."""
+        with self._lock:
+            client = self._owner_client_cache.get(addr)
+            if client is None:
+                client = RpcClient(addr, label=f"owner-{addr}")
+                self._owner_client_cache[addr] = client
+            return client
 
     def _register_pending(self, spec: TaskSpec, arg_refs: list):
         with self._lock:
@@ -249,9 +334,8 @@ class CoreWorker:
     def _push_to_owner(self, ref, method: str):
         async def _push():
             try:
-                client = RpcClient(tuple(ref.owner_addr), label="owner")
+                client = self._owner_client(tuple(ref.owner_addr))
                 await client.apush(method, {"object_id": ref.hex()})
-                client.close()
             except Exception:
                 pass
 
@@ -358,22 +442,41 @@ class CoreWorker:
                 if self._try_reconstruct(oid_hex):
                     continue
                 raise ObjectLostError(oid_hex)
-            try:
-                rem = self._remaining(deadline)
-                view = self.store.get_view(oid_hex, timeout=min(rem, 2.0) if rem else 2.0)
+            # Local plasma fast path: only block in the store when the copy
+            # is already local, or when we know it lives in plasma somewhere
+            # (owner's in_plasma flag). Borrowers must NOT speculatively pull
+            # — small results live inline at the owner, not in any store.
+            local = self.store.contains(oid_hex)
+            if local or (is_owner and in_plasma):
                 try:
-                    return serialization.deserialize(view)
-                finally:
-                    self.store.release(oid_hex)
-            except GetTimeoutError:
-                raise
-            except Exception:
-                pass
-            # 4. Borrower path: ask the owner directly.
+                    rem = self._remaining(deadline)
+                    view = self.store.get_view(oid_hex, timeout=min(rem, 5.0) if rem else 5.0)
+                    try:
+                        return serialization.deserialize(view)
+                    finally:
+                        self.store.release(oid_hex)
+                except GetTimeoutError:
+                    raise
+                except Exception:
+                    pass
+            # 4. Borrower path: ask the owner directly (blocks until the task
+            # finishes; returns inline bytes or points us at plasma).
             if not is_owner:
                 result = self._fetch_from_owner(ref, deadline)
                 if result is not _MISSING:
                     return result
+                # Owner reports a plasma copy: pull it through our raylet.
+                try:
+                    rem = self._remaining(deadline)
+                    view = self.store.get_view(oid_hex, timeout=min(rem, 30.0) if rem else 30.0)
+                    try:
+                        return serialization.deserialize(view)
+                    finally:
+                        self.store.release(oid_hex)
+                except GetTimeoutError:
+                    raise
+                except Exception:
+                    pass
             else:
                 # Only reconstruct when no copy exists anywhere (a slow pull
                 # must not trigger a spurious re-execution).
@@ -392,16 +495,13 @@ class CoreWorker:
 
     def _fetch_from_owner(self, ref, deadline):
         try:
-            client = RpcClient(tuple(ref.owner_addr), label="owner-fetch")
-            try:
-                rem = self._remaining(deadline)
-                resp = client.call(
-                    "get_inline",
-                    {"object_id": ref.hex(), "wait": True},
-                    timeout=rem,
-                )
-            finally:
-                client.close()
+            client = self._owner_client(tuple(ref.owner_addr))
+            rem = self._remaining(deadline)
+            resp = client.call(
+                "get_inline",
+                {"object_id": ref.hex(), "wait": True},
+                timeout=rem,
+            )
         except GetTimeoutError:
             raise
         except Exception:
@@ -480,11 +580,8 @@ class CoreWorker:
             if self.store.contains(oid_hex):
                 return True
             try:
-                client = RpcClient(tuple(ref.owner_addr), label="owner-probe")
-                try:
-                    resp = client.call("get_inline", {"object_id": oid_hex, "wait": False}, timeout=2)
-                finally:
-                    client.close()
+                client = self._owner_client(tuple(ref.owner_addr))
+                resp = client.call("get_inline", {"object_id": oid_hex, "wait": False}, timeout=2)
                 return resp.get("kind") in ("inline", "plasma")
             except Exception:
                 return False
@@ -900,7 +997,9 @@ class CoreWorker:
 
     def shutdown(self):
         self._shutdown = True
-        for c in self._actor_clients.values():
+        for c in list(self._actor_clients.values()):
+            c.close()
+        for c in list(self._owner_client_cache.values()):
             c.close()
         self.server.stop()
         self.store.close()

@@ -93,6 +93,7 @@ class Raylet:
 
         self.workers: dict[str, WorkerHandle] = {}
         self.task_queue: deque[TaskSpec] = deque()
+        self._last_progress = time.monotonic()
         self.cluster_view: dict = {}
         self._pulls_inflight: dict[str, asyncio.Future] = {}
         self._peer_clients: dict[str, RpcClient] = {}
@@ -137,6 +138,8 @@ class Raylet:
                     os._exit(1)
                 self.cluster_view = resp.get("nodes", {})
                 await self._retry_pg_tasks()
+                if self.task_queue:
+                    await self._dispatch()  # periodic re-check (anti-starvation)
             except Exception:
                 pass
             await asyncio.sleep(self.cfg.heartbeat_interval_s)
@@ -188,7 +191,8 @@ class Raylet:
         object_id = req["object_id"]
         timeout = req.get("timeout")
         if object_id not in self.store.objects:
-            # Not local: pull from a remote copy (reference: PullManager).
+            # Not local yet: race local creation (a task on this node may be
+            # about to seal it) against a remote pull (reference: PullManager).
             await self._pull_object(object_id, timeout)
         offset, size = await self.store.get(object_id, timeout)
         return {"offset": offset, "size": size}
@@ -256,11 +260,17 @@ class Raylet:
         self._pulls_inflight[object_id] = fut
         try:
             deadline = time.monotonic() + (timeout if timeout is not None else 3600.0)
+            poll = 0.02
             while time.monotonic() < deadline:
+                if object_id in self.store.objects:
+                    # A local task produced it while we were looking remotely.
+                    fut.set_result(True)
+                    return
                 resp = await self.gcs.acall("get_object_locations", {"object_id": object_id})
                 locs = [l for l in resp["locations"] if l["node_id"] != self.node_id]
                 if not locs:
-                    await asyncio.sleep(0.05)
+                    await asyncio.sleep(poll)
+                    poll = min(poll * 1.5, 0.5)
                     continue
                 loc = locs[0]
                 peer = self._peer(loc["node_id"], loc["address"])
@@ -463,7 +473,32 @@ class Raylet:
                     continue
                 worker = self._pop_idle_worker()
                 if worker is None:
-                    if self._num_live_workers() < self.cfg.max_workers_per_node:
+                    # Start enough workers for the whole backlog at once
+                    # (reference prestarts workers too, worker_pool.cc:426);
+                    # spawning serially would add one startup latency per task.
+                    starting = sum(1 for w in self.workers.values() if w.state == "starting")
+                    # Workers dedicated to actors never come back to the pool;
+                    # only count pool workers against the CPU-sized target.
+                    pool_workers = sum(
+                        1 for w in self.workers.values() if w.state in ("starting", "idle", "busy")
+                    )
+                    cpu_cap = max(1, int(self.resources_total.get("CPU", 1)))
+                    deficit = min(
+                        len(self.task_queue) + 1 - starting,
+                        cpu_cap - pool_workers,
+                        self.cfg.max_workers_per_node - self._num_live_workers(),
+                    )
+                    if (
+                        deficit <= 0
+                        and starting == 0
+                        and self._num_live_workers() < self.cfg.max_workers_per_node
+                        and time.monotonic() - self._last_progress > 2.0
+                    ):
+                        # Anti-starvation: busy workers may themselves be
+                        # blocked on results of queued tasks (nested tasks);
+                        # after 2s without dispatch progress, oversubscribe.
+                        deficit = 1
+                    for _ in range(max(deficit, 0)):
                         self._start_worker()
                     self.task_queue.appendleft(spec)
                     return
@@ -474,6 +509,7 @@ class Raylet:
                 if spec.is_actor_creation():
                     worker.actor_id = spec.actor_id
                 made_progress = True
+                self._last_progress = time.monotonic()
                 asyncio.ensure_future(self._push_to_worker(worker, spec))
 
     async def _push_to_worker(self, worker: WorkerHandle, spec: TaskSpec):

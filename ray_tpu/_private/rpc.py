@@ -338,6 +338,11 @@ class RpcClient:
             if self._reader_task is not None:
                 self._reader_task.cancel()
 
+        # Never block the IO loop on itself: from the loop thread just
+        # schedule the close; from any other thread wait briefly.
+        if threading.current_thread() is self._io._thread:
+            asyncio.ensure_future(_close())
+            return
         try:
             self._io.run(_close(), timeout=2)
         except Exception:

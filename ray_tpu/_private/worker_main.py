@@ -61,14 +61,11 @@ class WorkerExecutor:
                 pass
 
     async def _report_to_owner(self, spec, payload):
-        from ray_tpu._private.rpc import RpcClient
-
         if spec.owner_addr is None:
             return
         try:
-            owner = RpcClient(tuple(spec.owner_addr), label="owner")
+            owner = self.cw._owner_client(tuple(spec.owner_addr))
             await owner.acall("task_done", payload)
-            owner.close()
         except Exception:
             logger.warning("could not report task %s to owner", spec.task_id[:8])
 

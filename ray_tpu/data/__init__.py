@@ -1,0 +1,66 @@
+"""ray_tpu.data — lazy, streaming, distributed datasets.
+
+Analog of the reference's Ray Data (python/ray/data/): blocks are arrow
+tables moved by ref through the object store; transforms build a lazy plan
+executed by a streaming task/actor-pool executor; iteration yields numpy /
+pandas / arrow / torch / device-sharded JAX batches.
+"""
+
+from ray_tpu.data import aggregate  # noqa: F401
+from ray_tpu.data._internal.executor import ActorPoolStrategy  # noqa: F401
+from ray_tpu.data.aggregate import AbsMax, AggregateFn, Count, Max, Mean, Min, Std, Sum  # noqa: F401
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata  # noqa: F401
+from ray_tpu.data.context import DataContext  # noqa: F401
+from ray_tpu.data.dataset import Dataset  # noqa: F401
+from ray_tpu.data.grouped_data import GroupedData  # noqa: F401
+from ray_tpu.data.iterator import DataIterator  # noqa: F401
+from ray_tpu.data.read_api import (  # noqa: F401
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    range_tensor,
+    read_binary_files,
+    read_csv,
+    read_datasource,
+    read_images,
+    read_json,
+    read_numpy,
+    read_parquet,
+    read_text,
+    read_tfrecords,
+)
+
+__all__ = [
+    "ActorPoolStrategy",
+    "AggregateFn",
+    "Block",
+    "BlockAccessor",
+    "BlockMetadata",
+    "Count",
+    "DataContext",
+    "DataIterator",
+    "Dataset",
+    "GroupedData",
+    "Max",
+    "Mean",
+    "Min",
+    "Std",
+    "Sum",
+    "from_arrow",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "range",
+    "range_tensor",
+    "read_binary_files",
+    "read_csv",
+    "read_datasource",
+    "read_images",
+    "read_json",
+    "read_numpy",
+    "read_parquet",
+    "read_text",
+    "read_tfrecords",
+]

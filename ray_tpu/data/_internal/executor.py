@@ -1,0 +1,349 @@
+"""Streaming executor for ray_tpu.data.
+
+Analog of the reference's StreamingExecutor
+(python/ray/data/_internal/execution/streaming_executor.py:48 and
+operators/{task_pool,actor_pool}_map_operator.py): the logical chain is
+lowered to physical operators; map stages run as ray_tpu tasks (or an actor
+pool) over block refs with bounded in-flight concurrency, and completed output
+bundles stream to the consumer in block order while upstream work continues.
+Barrier ops (shuffle/sort/union/zip) materialize their input first, like the
+reference's AllToAllOperator.
+
+A "bundle" is ``(block_ref, BlockMetadata)`` — the metadata travels eagerly on
+the driver while the block stays in the object store (reference: RefBundle).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Iterator, Optional
+
+import ray_tpu
+from ray_tpu.data._internal.logical_plan import (
+    AllToAll,
+    InputData,
+    Limit,
+    MapTransform,
+    Read,
+    Union,
+    Zip,
+    fuse_map_chain,
+    plan_to_chain,
+)
+from ray_tpu.data.block import BlockAccessor
+
+
+def _run_read_task(read_task):
+    """Execute a ReadTask: returns (block, metadata)."""
+    blocks = list(read_task())
+    block = BlockAccessor.concat([BlockAccessor.batch_to_block(b) for b in blocks])
+    acc = BlockAccessor.for_block(block)
+    return block, acc.get_metadata()
+
+
+def _run_map_task(fn, block):
+    out = fn(block)
+    out = BlockAccessor.batch_to_block(out)
+    return out, BlockAccessor.for_block(out).get_metadata()
+
+
+def _slice_block_task(block, start, end):
+    out = BlockAccessor.for_block(block).slice(start, end)
+    return out, BlockAccessor.for_block(out).get_metadata()
+
+
+def _zip_blocks_task(left, right):
+    import pyarrow as pa
+
+    la, ra = BlockAccessor.for_block(left), BlockAccessor.for_block(right)
+    if la.num_rows() != ra.num_rows():
+        raise ValueError(f"zip row mismatch: {la.num_rows()} vs {ra.num_rows()}")
+    cols = {name: left.column(name) for name in left.column_names}
+    for name in right.column_names:
+        out_name = name if name not in cols else name + "_1"
+        cols[out_name] = right.column(name)
+    out = pa.table(cols)
+    return out, BlockAccessor.for_block(out).get_metadata()
+
+
+class _MapWorker:
+    """Actor-pool map worker (reference: ActorPoolMapOperator._MapWorker)."""
+
+    def __init__(self, fn_constructor=None):
+        self._udf = fn_constructor() if fn_constructor is not None else None
+
+    def ready(self):
+        return True
+
+    def map_block(self, fn, block):
+        if self._udf is not None:
+            out = fn(block, self._udf)
+        else:
+            out = fn(block)
+        out = BlockAccessor.batch_to_block(out)
+        return out, BlockAccessor.for_block(out).get_metadata()
+
+
+class ActorPoolStrategy:
+    """Compute strategy selecting an autoscaling actor pool
+    (reference: data/_internal/compute.py ActorPoolStrategy)."""
+
+    def __init__(self, size: Optional[int] = None, min_size: int = 1, max_size: Optional[int] = None, num_tpus: float = 0, num_cpus: float = 1):
+        if size is not None:
+            min_size = max_size = size
+        self.min_size = min_size
+        self.max_size = max_size or max(min_size, 2)
+        self.num_tpus = num_tpus
+        self.num_cpus = num_cpus
+
+
+class ExecutionContext:
+    def __init__(self, max_tasks_in_flight: Optional[int] = None, preserve_order: bool = True):
+        if max_tasks_in_flight is None:
+            try:
+                max_tasks_in_flight = max(2, int(ray_tpu.cluster_resources().get("CPU", 4)))
+            except Exception:
+                max_tasks_in_flight = 4
+        self.max_tasks_in_flight = max_tasks_in_flight
+        self.preserve_order = preserve_order
+
+
+class _PhysicalMapOp:
+    """Task-pool (or actor-pool) map stage with bounded in-flight tasks."""
+
+    def __init__(self, logical: MapTransform, ctx: ExecutionContext):
+        self.logical = logical
+        self.ctx = ctx
+        self.input: collections.deque = collections.deque()
+        self.in_flight: dict = {}  # watch_ref -> (index, meta_ref_pair)
+        self.output: dict = {}  # index -> bundle
+        self.upstream_done = False
+        self._pool: list = []
+        self._pool_idx = 0
+        if isinstance(logical.compute, ActorPoolStrategy):
+            strat = logical.compute
+            actor_cls = ray_tpu.remote(
+                num_cpus=strat.num_cpus, num_tpus=strat.num_tpus or None
+            )(_MapWorker)
+            self._pool = [
+                actor_cls.remote(logical.fn_constructor) for _ in range(strat.min_size)
+            ]
+
+    @property
+    def capacity(self) -> int:
+        if self._pool:
+            return max(0, 2 * len(self._pool) - len(self.in_flight))
+        return max(0, self.ctx.max_tasks_in_flight - len(self.in_flight))
+
+    def dispatch(self):
+        while self.input and self.capacity > 0:
+            index, (block_ref, _meta) = self.input.popleft()
+            if self._pool:
+                actor = self._pool[self._pool_idx % len(self._pool)]
+                self._pool_idx += 1
+                refs = actor.map_block.options(num_returns=2).remote(
+                    self.logical.block_fn, block_ref
+                )
+            else:
+                remote_args = dict(self.logical.ray_remote_args)
+                refs = (
+                    ray_tpu.remote(num_returns=2, **remote_args)(_run_map_task)
+                    .remote(self.logical.block_fn, block_ref)
+                )
+            self.in_flight[refs[1]] = (index, refs)
+
+    def complete(self, watch_ref):
+        index, refs = self.in_flight.pop(watch_ref)
+        meta = ray_tpu.get(refs[1])
+        self.output[index] = (refs[0], meta)
+
+    @property
+    def done(self) -> bool:
+        return self.upstream_done and not self.input and not self.in_flight
+
+
+class _PhysicalReadOp:
+    def __init__(self, logical: Read, ctx: ExecutionContext):
+        self.logical = logical
+        self.ctx = ctx
+        self.input = collections.deque(enumerate(logical.read_tasks))
+        self.in_flight: dict = {}
+        self.output: dict = {}
+        self.upstream_done = True
+
+    @property
+    def capacity(self) -> int:
+        return max(0, self.ctx.max_tasks_in_flight - len(self.in_flight))
+
+    def dispatch(self):
+        while self.input and self.capacity > 0:
+            index, read_task = self.input.popleft()
+            refs = (
+                ray_tpu.remote(num_returns=2, **dict(self.logical.ray_remote_args))(_run_read_task)
+                .remote(read_task)
+            )
+            self.in_flight[refs[1]] = (index, refs)
+
+    def complete(self, watch_ref):
+        index, refs = self.in_flight.pop(watch_ref)
+        meta = ray_tpu.get(refs[1])
+        self.output[index] = (refs[0], meta)
+
+    @property
+    def done(self) -> bool:
+        return not self.input and not self.in_flight
+
+
+def execute_streaming(plan, ctx: Optional[ExecutionContext] = None) -> Iterator[tuple]:
+    """Execute the plan, yielding output bundles in block order as they
+    complete. The scheduling loop keeps all map stages saturated
+    (reference: streaming_executor_state.py:363 select_operator_to_run)."""
+    ctx = ctx or ExecutionContext()
+    plan = fuse_map_chain(plan)
+    chain = plan_to_chain(plan)
+
+    # Materialize any barrier prefix: everything up to the last non-streaming
+    # op runs first; the streaming suffix (reads + maps + limit) pipelines.
+    bundles: list = []
+    stream_ops: list = []
+    i = 0
+    while i < len(chain):
+        op = chain[i]
+        if isinstance(op, InputData):
+            bundles = list(op.bundles)
+        elif isinstance(op, Read):
+            stream_ops.append(_PhysicalReadOp(op, ctx))
+        elif isinstance(op, MapTransform):
+            stream_ops.append(_PhysicalMapOp(op, ctx))
+        elif isinstance(op, (AllToAll, Union, Zip, Limit)):
+            # Barrier: drain current streaming suffix into bundles first.
+            bundles = _drain(bundles, stream_ops, ctx)
+            stream_ops = []
+            if isinstance(op, AllToAll):
+                bundles = op.bulk_fn(bundles)
+            elif isinstance(op, Union):
+                for extra in op.extra_inputs:
+                    bundles = bundles + list(execute_streaming(extra, ctx))
+            elif isinstance(op, Zip):
+                other = list(execute_streaming(op.other, ctx))
+                bundles = _zip_bundles(bundles, other)
+            elif isinstance(op, Limit):
+                bundles = _apply_limit(bundles, op.limit)
+        else:
+            raise TypeError(f"unknown logical op {op}")
+        i += 1
+
+    if not stream_ops:
+        yield from bundles
+        return
+    yield from _pump(bundles, stream_ops, ctx)
+
+
+def _pump(seed_bundles, ops, ctx) -> Iterator[tuple]:
+    """Core scheduling loop over a chain of streaming ops: dispatch every op
+    with queued input and spare capacity, wait for any completion, forward
+    in-order outputs downstream, and yield the final op's outputs in order."""
+    if ops and isinstance(ops[0], _PhysicalMapOp):
+        for idx, b in enumerate(seed_bundles):
+            ops[0].input.append((idx, b))
+        ops[0].upstream_done = True
+    next_fwd = [0] * len(ops)  # next output index each op hands downstream
+    final = ops[-1]
+
+    def forward():
+        for k, op in enumerate(ops[:-1]):
+            while next_fwd[k] in op.output:
+                ops[k + 1].input.append((next_fwd[k], op.output.pop(next_fwd[k])))
+                next_fwd[k] += 1
+            if op.done:
+                ops[k + 1].upstream_done = True
+
+    while True:
+        forward()
+        for op in ops:
+            op.dispatch()
+        while next_fwd[-1] in final.output:
+            yield final.output.pop(next_fwd[-1])
+            next_fwd[-1] += 1
+        if all(op.done for op in ops) and not final.output:
+            return
+        watch = [r for op in ops for r in op.in_flight]
+        if not watch:
+            # No tasks in flight but not done: forwarding must unblock us.
+            continue
+        ready, _ = ray_tpu.wait(watch, num_returns=1, timeout=30.0, fetch_local=False)
+        for r in ready:
+            for op in ops:
+                if r in op.in_flight:
+                    op.complete(r)
+                    break
+
+
+def _drain(seed_bundles, ops, ctx) -> list:
+    if not ops:
+        return list(seed_bundles)
+    return list(_pump(seed_bundles, ops, ctx))
+
+
+def _apply_limit(bundles, limit) -> list:
+    out, count = [], 0
+    for ref, meta in bundles:
+        if count >= limit:
+            break
+        if count + meta.num_rows <= limit:
+            out.append((ref, meta))
+            count += meta.num_rows
+        else:
+            take = limit - count
+            refs = ray_tpu.remote(num_returns=2)(_slice_block_task).remote(ref, 0, take)
+            new_meta = ray_tpu.get(refs[1])
+            out.append((refs[0], new_meta))
+            count = limit
+    return out
+
+
+def _zip_bundles(left, right) -> list:
+    """Align row counts then zip pairwise. Requires equal total rows."""
+    lrows = sum(m.num_rows for _, m in left)
+    rrows = sum(m.num_rows for _, m in right)
+    if lrows != rrows:
+        raise ValueError(f"zip: datasets have different row counts ({lrows} vs {rrows})")
+    lsplit = _resplit(left, [m.num_rows for _, m in left])
+    rsplit = _resplit(right, [m.num_rows for _, m in left])
+    out = []
+    for (lref, _), (rref, _) in zip(lsplit, rsplit):
+        refs = ray_tpu.remote(num_returns=2)(_zip_blocks_task).remote(lref, rref)
+        out.append((refs[0], ray_tpu.get(refs[1])))
+    return out
+
+
+def _resplit(bundles, target_sizes) -> list:
+    """Re-chunk bundles into blocks of the given row counts."""
+    out = []
+    cur = list(bundles)
+    cur_off = 0
+    for size in target_sizes:
+        need = size
+        parts = []
+        while need > 0:
+            ref, meta = cur[0]
+            avail = meta.num_rows - cur_off
+            take = min(avail, need)
+            refs = ray_tpu.remote(num_returns=2)(_slice_block_task).remote(ref, cur_off, cur_off + take)
+            parts.append(refs[0])
+            need -= take
+            cur_off += take
+            if cur_off >= meta.num_rows:
+                cur.pop(0)
+                cur_off = 0
+        if len(parts) == 1:
+            block_ref = parts[0]
+        else:
+            block_ref = ray_tpu.remote(num_returns=1)(
+                lambda *bs: BlockAccessor.concat(list(bs))
+            ).remote(*parts)
+        nrows = size
+        from ray_tpu.data.block import BlockMetadata
+
+        out.append((block_ref, BlockMetadata(num_rows=nrows, size_bytes=0)))
+    return out

@@ -1,0 +1,213 @@
+"""Block model for ray_tpu.data.
+
+Analog of the reference's block layer (python/ray/data/block.py:255/276
+BlockAccessor/BlockMetadata and _internal/{arrow_block,pandas_block}.py), cut
+down to one canonical representation: a block is a ``pyarrow.Table``. Rows are
+plain dicts; batches are dicts of numpy arrays (the natural feed format for
+JAX). Pandas/pyarrow views are conversions at the accessor edge rather than
+parallel block implementations.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Iterator, Optional
+
+import numpy as np
+import pyarrow as pa
+
+Block = pa.Table
+
+
+@dataclasses.dataclass
+class BlockMetadata:
+    """Lightweight stats shipped next to each block ref (reference:
+    data/block.py:276 BlockMetadata)."""
+
+    num_rows: int
+    size_bytes: int
+    schema: Optional[pa.Schema] = None
+    input_files: Optional[list] = None
+
+    def merged_with(self, other: "BlockMetadata") -> "BlockMetadata":
+        return BlockMetadata(
+            num_rows=self.num_rows + other.num_rows,
+            size_bytes=self.size_bytes + other.size_bytes,
+            schema=self.schema or other.schema,
+            input_files=(self.input_files or []) + (other.input_files or []),
+        )
+
+
+def _normalize_column(values: Any) -> pa.Array:
+    if isinstance(values, pa.Array):
+        return values
+    if isinstance(values, np.ndarray) and values.ndim > 1:
+        # Tensor column: store as fixed-size-list of flattened rows.
+        flat = values.reshape(len(values), -1)
+        inner = pa.array(flat.ravel())
+        arr = pa.FixedSizeListArray.from_arrays(inner, flat.shape[1])
+        meta_shape = values.shape[1:]
+        return arr, meta_shape  # type: ignore[return-value]
+    return pa.array(values)
+
+
+class BlockAccessor:
+    """Uniform operations over a block (reference: data/block.py:255)."""
+
+    def __init__(self, block: Block):
+        self._table = block
+
+    @staticmethod
+    def for_block(block: Any) -> "BlockAccessor":
+        return BlockAccessor(BlockAccessor.batch_to_block(block))
+
+    # -- construction ------------------------------------------------------
+    @staticmethod
+    def batch_to_block(batch: Any) -> Block:
+        """Convert a user-produced batch (dict of arrays / pandas / arrow /
+        list of rows) into the canonical arrow block."""
+        import pandas as pd
+
+        if isinstance(batch, pa.Table):
+            return batch
+        if isinstance(batch, pd.DataFrame):
+            return pa.Table.from_pandas(batch, preserve_index=False)
+        if isinstance(batch, dict):
+            cols, fields, shapes = [], [], {}
+            for name, values in batch.items():
+                if isinstance(values, np.ndarray) and values.ndim > 1:
+                    flat = values.reshape(len(values), -1)
+                    inner = pa.array(flat.ravel())
+                    arr = pa.FixedSizeListArray.from_arrays(inner, flat.shape[1])
+                    shapes[name] = values.shape[1:]
+                else:
+                    arr = pa.array(np.asarray(values) if isinstance(values, (list, tuple)) else values)
+                cols.append(arr)
+                fields.append(name)
+            table = pa.table(dict(zip(fields, cols)))
+            if shapes:
+                meta = {b"ray_tpu.tensor_shapes": repr(shapes).encode()}
+                table = table.replace_schema_metadata({**(table.schema.metadata or {}), **meta})
+            return table
+        if isinstance(batch, list):  # list of row dicts
+            if not batch:
+                return pa.table({})
+            keys = batch[0].keys()
+            return BlockAccessor.batch_to_block({k: np.array([r[k] for r in batch]) for k in keys})
+        raise TypeError(f"cannot convert batch of type {type(batch)} to a block")
+
+    @staticmethod
+    def concat(blocks: list) -> Block:
+        blocks = [b for b in blocks if b.num_rows > 0] or blocks[:1]
+        if not blocks:
+            return pa.table({})
+        if len(blocks) == 1:
+            return blocks[0]
+        return pa.concat_tables(blocks, promote_options="default")
+
+    # -- stats -------------------------------------------------------------
+    def num_rows(self) -> int:
+        return self._table.num_rows
+
+    def size_bytes(self) -> int:
+        return self._table.nbytes
+
+    def schema(self) -> pa.Schema:
+        return self._table.schema
+
+    def get_metadata(self, input_files: Optional[list] = None) -> BlockMetadata:
+        return BlockMetadata(
+            num_rows=self.num_rows(),
+            size_bytes=self.size_bytes(),
+            schema=self.schema(),
+            input_files=input_files,
+        )
+
+    # -- conversion --------------------------------------------------------
+    def _tensor_shapes(self) -> dict:
+        meta = self._table.schema.metadata or {}
+        raw = meta.get(b"ray_tpu.tensor_shapes")
+        return eval(raw.decode()) if raw else {}  # noqa: S307 - our own repr
+
+    def to_numpy(self, columns: Optional[list] = None) -> dict:
+        shapes = self._tensor_shapes()
+        out = {}
+        for name in columns or self._table.column_names:
+            col = self._table.column(name)
+            if pa.types.is_fixed_size_list(col.type):
+                flat = col.combine_chunks().flatten().to_numpy(zero_copy_only=False)
+                n = self._table.num_rows
+                shape = shapes.get(name, (col.type.list_size,))
+                out[name] = flat.reshape((n,) + tuple(shape))
+            else:
+                out[name] = col.to_numpy(zero_copy_only=False)
+        return out
+
+    def to_pandas(self):
+        return self._table.to_pandas()
+
+    def to_arrow(self) -> pa.Table:
+        return self._table
+
+    def to_batch(self, batch_format: str):
+        if batch_format in ("numpy", "jax", "default"):
+            return self.to_numpy()
+        if batch_format == "pandas":
+            return self.to_pandas()
+        if batch_format in ("pyarrow", "arrow"):
+            return self._table
+        raise ValueError(f"unknown batch_format {batch_format!r}")
+
+    # -- row/slice ops -----------------------------------------------------
+    def iter_rows(self) -> Iterator[dict]:
+        numpy_cols = self.to_numpy()
+        for i in range(self.num_rows()):
+            yield {k: v[i] for k, v in numpy_cols.items()}
+
+    def slice(self, start: int, end: int) -> Block:
+        return self._table.slice(start, end - start)
+
+    def take_indices(self, indices) -> Block:
+        return self._table.take(pa.array(indices))
+
+    def random_shuffle(self, seed: Optional[int]) -> Block:
+        rng = np.random.default_rng(seed)
+        return self.take_indices(rng.permutation(self.num_rows()))
+
+    def sort(self, key: str, descending: bool = False) -> Block:
+        order = "descending" if descending else "ascending"
+        idx = pa.compute.sort_indices(self._table, sort_keys=[(key, order)])
+        return self._table.take(idx)
+
+    def filter_rows(self, predicate: Callable[[dict], bool]) -> Block:
+        keep = [i for i, row in enumerate(self.iter_rows()) if predicate(row)]
+        return self.take_indices(keep)
+
+    def select(self, columns: list) -> Block:
+        return self._table.select(columns)
+
+    def rename(self, mapping: dict) -> Block:
+        return self._table.rename_columns([mapping.get(c, c) for c in self._table.column_names])
+
+    def drop(self, columns: list) -> Block:
+        keep = [c for c in self._table.column_names if c not in columns]
+        return self._table.select(keep)
+
+    def hash_partition(self, key: str, num_partitions: int) -> list:
+        vals = self._table.column(key).to_pylist()
+        assignments = np.array([hash(v) % num_partitions for v in vals])
+        return [self.take_indices(np.nonzero(assignments == p)[0]) for p in range(num_partitions)]
+
+    def random_partition(self, num_partitions: int, seed: Optional[int]) -> list:
+        rng = np.random.default_rng(seed)
+        assignments = rng.integers(0, num_partitions, self.num_rows())
+        return [self.take_indices(np.nonzero(assignments == p)[0]) for p in range(num_partitions)]
+
+    def range_partition(self, key: str, boundaries: list) -> list:
+        """Split sorted-key values by boundary values (for sort-shuffle)."""
+        vals = np.asarray(self._table.column(key).to_pylist())
+        assignments = np.searchsorted(np.asarray(boundaries), vals, side="right")
+        return [
+            self.take_indices(np.nonzero(assignments == p)[0])
+            for p in range(len(boundaries) + 1)
+        ]

@@ -1,0 +1,14 @@
+from ray_tpu.data.datasource.datasource import (  # noqa: F401
+    BinaryDatasource,
+    CSVDatasource,
+    Datasource,
+    FileBasedDatasource,
+    ImageDatasource,
+    JSONDatasource,
+    NumpyDatasource,
+    ParquetDatasource,
+    RangeDatasource,
+    ReadTask,
+    TextDatasource,
+    TFRecordsDatasource,
+)

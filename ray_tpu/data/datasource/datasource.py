@@ -1,0 +1,288 @@
+"""Datasource protocol + file-based readers.
+
+Analog of the reference's datasource layer (python/ray/data/datasource/*.py):
+a ``Datasource.get_read_tasks(parallelism)`` returns serializable ``ReadTask``
+callables, each producing one or more blocks; file readers share path
+expansion + per-file task logic (file_based_datasource.py). Writers mirror
+``Dataset.write_*``.
+"""
+
+from __future__ import annotations
+
+import glob as globlib
+import io
+import os
+from typing import Callable, Iterable, List, Optional
+
+import numpy as np
+
+from ray_tpu.data.block import BlockAccessor, BlockMetadata
+
+
+class ReadTask:
+    """A serializable zero-arg callable yielding batches/blocks, carrying
+    advance metadata for scheduling (reference: datasource.py ReadTask)."""
+
+    def __init__(self, read_fn: Callable[[], Iterable], metadata: Optional[BlockMetadata] = None):
+        self._read_fn = read_fn
+        self.metadata = metadata
+
+    def __call__(self):
+        return self._read_fn()
+
+
+class Datasource:
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        raise NotImplementedError
+
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        return None
+
+
+class RangeDatasource(Datasource):
+    def __init__(self, n: int, column: str = "id"):
+        self._n = n
+        self._column = column
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        n, col = self._n, self._column
+        parallelism = max(1, min(parallelism, n)) if n else 1
+        tasks = []
+        chunk = (n + parallelism - 1) // parallelism if n else 0
+        for start in range(0, n, max(chunk, 1)):
+            end = min(start + chunk, n)
+
+            def read(start=start, end=end):
+                yield {col: np.arange(start, end)}
+
+            tasks.append(ReadTask(read, BlockMetadata(num_rows=end - start, size_bytes=8 * (end - start))))
+        return tasks or [ReadTask(lambda: iter([{col: np.array([], dtype=np.int64)}]), BlockMetadata(0, 0))]
+
+
+def _expand_paths(paths, suffixes=None) -> list:
+    if isinstance(paths, str):
+        paths = [paths]
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, files in os.walk(p):
+                out.extend(os.path.join(root, f) for f in sorted(files))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(globlib.glob(p)))
+        else:
+            out.append(p)
+    if suffixes:
+        out = [p for p in out if any(p.endswith(s) for s in suffixes)]
+    if not out:
+        raise ValueError(f"no files found for {paths}")
+    return out
+
+
+class FileBasedDatasource(Datasource):
+    _suffixes: Optional[list] = None
+
+    def __init__(self, paths, **reader_args):
+        self._paths = _expand_paths(paths, self._suffixes)
+        self._reader_args = reader_args
+
+    def _read_file(self, path: str, **kwargs):
+        raise NotImplementedError
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        groups = np.array_split(np.arange(len(self._paths)), max(1, min(parallelism, len(self._paths))))
+        read_file = self._read_file
+        args = self._reader_args
+        tasks = []
+        for g in groups:
+            if len(g) == 0:
+                continue
+            files = [self._paths[i] for i in g]
+
+            def read(files=files):
+                for f in files:
+                    yield from read_file(f, **args)
+
+            tasks.append(ReadTask(read, BlockMetadata(num_rows=-1, size_bytes=sum(os.path.getsize(f) for f in files), input_files=files)))
+        return tasks
+
+
+class ParquetDatasource(FileBasedDatasource):
+    _suffixes = [".parquet"]
+
+    def _read_file(self, path, columns=None, **kwargs):
+        import pyarrow.parquet as pq
+
+        yield pq.read_table(path, columns=columns, **kwargs)
+
+
+class CSVDatasource(FileBasedDatasource):
+    _suffixes = None
+
+    def _read_file(self, path, **kwargs):
+        import pyarrow.csv as pacsv
+
+        yield pacsv.read_csv(path, **kwargs)
+
+
+class JSONDatasource(FileBasedDatasource):
+    _suffixes = None
+
+    def _read_file(self, path, **kwargs):
+        import pandas as pd
+
+        yield BlockAccessor.batch_to_block(pd.read_json(path, lines=kwargs.get("lines", True)))
+
+
+class NumpyDatasource(FileBasedDatasource):
+    _suffixes = [".npy", ".npz"]
+
+    def _read_file(self, path, column: str = "data", **kwargs):
+        arr = np.load(path, allow_pickle=False)
+        if isinstance(arr, np.lib.npyio.NpzFile):
+            yield {k: arr[k] for k in arr.files}
+        else:
+            yield {column: arr}
+
+
+class BinaryDatasource(FileBasedDatasource):
+    def _read_file(self, path, include_paths: bool = False, **kwargs):
+        with open(path, "rb") as f:
+            data = f.read()
+        batch = {"bytes": np.array([data], dtype=object)}
+        if include_paths:
+            batch["path"] = np.array([path], dtype=object)
+        # object dtype can't go to arrow directly; use pyarrow binary
+        import pyarrow as pa
+
+        cols = {"bytes": pa.array([data], type=pa.binary())}
+        if include_paths:
+            cols["path"] = pa.array([path])
+        yield pa.table(cols)
+
+
+class TextDatasource(FileBasedDatasource):
+    def _read_file(self, path, encoding: str = "utf-8", drop_empty_lines: bool = True, **kwargs):
+        with open(path, "r", encoding=encoding) as f:
+            lines = [ln.rstrip("\n") for ln in f]
+        if drop_empty_lines:
+            lines = [ln for ln in lines if ln.strip()]
+        yield {"text": np.array(lines, dtype=object).astype(str)}
+
+
+class ImageDatasource(FileBasedDatasource):
+    _suffixes = [".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp"]
+
+    def _read_file(self, path, size: Optional[tuple] = None, mode: str = "RGB", include_paths: bool = False, **kwargs):
+        from PIL import Image
+
+        img = Image.open(path).convert(mode)
+        if size is not None:
+            img = img.resize(size)
+        arr = np.asarray(img)
+        batch = {"image": arr[None, ...]}
+        if include_paths:
+            import pyarrow as pa
+
+            block = BlockAccessor.batch_to_block(batch)
+            block = block.append_column("path", pa.array([path]))
+            yield block
+        else:
+            yield batch
+
+
+class TFRecordsDatasource(FileBasedDatasource):
+    """Minimal TFRecord reader (uncompressed) parsing tf.train.Example
+    protos without a TF dependency (reference: tfrecords_datasource.py)."""
+
+    _suffixes = None
+
+    def _read_file(self, path, **kwargs):
+        rows = []
+        with open(path, "rb") as f:
+            while True:
+                header = f.read(8)
+                if len(header) < 8:
+                    break
+                (length,) = np.frombuffer(header, dtype="<u8", count=1)
+                f.read(4)  # length crc
+                payload = f.read(int(length))
+                f.read(4)  # data crc
+                rows.append(_parse_tf_example(payload))
+        if rows:
+            keys = rows[0].keys()
+            yield {k: np.array([r[k] for r in rows]) for k in keys}
+
+
+def _parse_tf_example(payload: bytes) -> dict:
+    """Tiny protobuf wire-format parser for tf.train.Example."""
+    out = {}
+    feats = _pb_fields(payload).get(1)
+    if not feats:
+        return out
+    for fmap in feats:
+        for entry in _pb_fields(fmap).get(1, []):
+            fields = _pb_fields(entry)
+            name = fields[1][0].decode()
+            feature = fields[2][0]
+            ff = _pb_fields(feature)
+            if 1 in ff:  # bytes_list
+                vals = _pb_fields(ff[1][0]).get(1, [])
+                out[name] = vals[0] if len(vals) == 1 else vals
+            elif 2 in ff:  # float_list
+                raw = _pb_fields(ff[2][0]).get(1, [])
+                arr = np.concatenate([np.frombuffer(r, dtype="<f4") if isinstance(r, bytes) else np.array([r], dtype="<f4") for r in raw]) if raw else np.array([], "<f4")
+                out[name] = arr[0] if arr.size == 1 else arr
+            elif 3 in ff:  # int64_list
+                raw = _pb_fields(ff[3][0]).get(1, [])
+                vals = []
+                for r in raw:
+                    if isinstance(r, bytes):
+                        vals.extend(_decode_varints(r))
+                    else:
+                        vals.append(r)
+                out[name] = vals[0] if len(vals) == 1 else np.array(vals)
+    return out
+
+
+def _pb_fields(buf: bytes) -> dict:
+    """Parse one protobuf message into {field_number: [values]}."""
+    out: dict = {}
+    i = 0
+    while i < len(buf):
+        tag, i = _read_varint(buf, i)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:
+            val, i = _read_varint(buf, i)
+        elif wire == 2:
+            ln, i = _read_varint(buf, i)
+            val = buf[i : i + ln]
+            i += ln
+        elif wire == 5:
+            val = np.frombuffer(buf[i : i + 4], dtype="<f4")[0]
+            i += 4
+        elif wire == 1:
+            val = np.frombuffer(buf[i : i + 8], dtype="<f8")[0]
+            i += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        out.setdefault(field, []).append(val)
+    return out
+
+
+def _read_varint(buf: bytes, i: int):
+    shift = val = 0
+    while True:
+        b = buf[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, i
+        shift += 7
+
+
+def _decode_varints(buf: bytes) -> list:
+    out, i = [], 0
+    while i < len(buf):
+        v, i = _read_varint(buf, i)
+        out.append(v)
+    return out

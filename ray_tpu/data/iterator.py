@@ -1,0 +1,185 @@
+"""Batch iteration with prefetching (reference:
+python/ray/data/iterator.py DataIterator + _internal/block_batching/).
+
+``iter_batches_from_refs`` pulls the next block ref while slicing the current
+one into batches; ``DataIterator`` is the per-consumer view used by Train
+(`session.get_dataset_shard`), including the shared-shard state behind
+``streaming_split``.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, Iterator, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import BlockAccessor
+
+
+def iter_batches_from_refs(
+    ref_iter,
+    *,
+    batch_size: Optional[int],
+    batch_format: str = "numpy",
+    prefetch_batches: int = 1,
+    drop_last: bool = False,
+    local_shuffle_buffer_size: Optional[int] = None,
+    local_shuffle_seed: Optional[int] = None,
+) -> Iterator[Any]:
+    """Slice a stream of block refs into batches, prefetching blocks."""
+    rng = np.random.default_rng(local_shuffle_seed)
+
+    def fetch_blocks():
+        # Prefetch pipeline: keep up to prefetch_batches+1 gets in flight.
+        window: collections.deque = collections.deque()
+        for ref, _meta in ref_iter:
+            window.append(ref)
+            while len(window) > max(1, prefetch_batches):
+                yield ray_tpu.get(window.popleft())
+        while window:
+            yield ray_tpu.get(window.popleft())
+
+    carry: Optional[Any] = None  # leftover table slice
+    shuffle_buf: list = []
+
+    def emit(table):
+        acc = BlockAccessor.for_block(table)
+        return acc.to_batch(batch_format)
+
+    for block in fetch_blocks():
+        table = block if carry is None else BlockAccessor.concat([carry, block])
+        carry = None
+        if local_shuffle_buffer_size:
+            shuffle_buf.append(table)
+            buffered = sum(t.num_rows for t in shuffle_buf)
+            if buffered < local_shuffle_buffer_size:
+                continue
+            merged = BlockAccessor.concat(shuffle_buf)
+            table = BlockAccessor.for_block(merged).random_shuffle(int(rng.integers(2**31)))
+            shuffle_buf = []
+        if batch_size is None:
+            yield emit(table)
+            continue
+        acc = BlockAccessor.for_block(table)
+        n = acc.num_rows()
+        start = 0
+        while n - start >= batch_size:
+            yield emit(acc.slice(start, start + batch_size))
+            start += batch_size
+        if start < n:
+            carry = acc.slice(start, n)
+
+    if shuffle_buf:
+        merged = BlockAccessor.concat(shuffle_buf + ([carry] if carry is not None else []))
+        carry = BlockAccessor.for_block(merged).random_shuffle(int(rng.integers(2**31)))
+    if carry is not None and BlockAccessor.for_block(carry).num_rows() > 0:
+        if batch_size is None:
+            yield emit(carry)
+            return
+        acc = BlockAccessor.for_block(carry)
+        n = acc.num_rows()
+        start = 0
+        while n - start >= batch_size:
+            yield emit(acc.slice(start, start + batch_size))
+            start += batch_size
+        if start < n and not drop_last:
+            yield emit(acc.slice(start, n))
+
+
+class _ShardState:
+    """Shared execution state behind streaming_split: one executor run,
+    bundles dealt round-robin to n consumers (reference: OutputSplitter)."""
+
+    def __init__(self, dataset, n: int, equal: bool):
+        self._dataset = dataset
+        self._n = n
+        self._equal = equal
+        self._lock = threading.Lock()
+        self._queues = [collections.deque() for _ in range(n)]
+        self._source: Optional[Iterator] = None
+        self._exhausted = False
+        self._next_shard = 0
+
+    def next_bundle(self, shard: int):
+        while True:
+            with self._lock:
+                if self._queues[shard]:
+                    return self._queues[shard].popleft()
+                if self._exhausted:
+                    return None
+                if self._source is None:
+                    self._source = self._dataset.iter_internal_refs()
+                try:
+                    bundle = next(self._source)
+                except StopIteration:
+                    self._exhausted = True
+                    return None
+                self._queues[self._next_shard].append(bundle)
+                self._next_shard = (self._next_shard + 1) % self._n
+
+
+class DataIterator:
+    """Per-consumer iterator handle (reference: data/iterator.py)."""
+
+    def __init__(self, dataset=None, shard_state: Optional[_ShardState] = None, shard_index: int = 0):
+        self._dataset = dataset
+        self._shard_state = shard_state
+        self._shard_index = shard_index
+        # Bundles this shard has claimed from the shared state: replayed on
+        # re-iteration so count()/multiple epochs see the same shard.
+        self._claimed: list = []
+        self._drained = False
+
+    def _ref_iter(self):
+        if self._shard_state is not None:
+            yield from self._claimed
+            while not self._drained:
+                bundle = self._shard_state.next_bundle(self._shard_index)
+                if bundle is None:
+                    self._drained = True
+                    return
+                self._claimed.append(bundle)
+                yield bundle
+        else:
+            yield from self._dataset.iter_internal_refs()
+
+    def iter_batches(self, **kwargs) -> Iterator[Any]:
+        kwargs.setdefault("batch_size", 256)
+        kwargs.setdefault("batch_format", "numpy")
+        return iter_batches_from_refs(self._ref_iter(), **kwargs)
+
+    def iter_rows(self) -> Iterator[dict]:
+        for ref, _ in self._ref_iter():
+            yield from BlockAccessor.for_block(ray_tpu.get(ref)).iter_rows()
+
+    def iter_jax_batches(self, *, batch_size: int = 256, drop_last: bool = True, sharding=None, dtypes: Optional[dict] = None, **kwargs):
+        import jax
+
+        for batch in self.iter_batches(batch_size=batch_size, drop_last=drop_last, **kwargs):
+            out = {}
+            for k, v in batch.items():
+                if dtypes and k in dtypes:
+                    v = v.astype(dtypes[k])
+                out[k] = jax.device_put(v, sharding) if sharding is not None else jax.device_put(v)
+            yield out
+
+    def iter_torch_batches(self, *, batch_size: int = 256, device=None, **kwargs):
+        import torch
+
+        for batch in self.iter_batches(batch_size=batch_size, **kwargs):
+            yield {k: torch.as_tensor(np.ascontiguousarray(v)).to(device or "cpu") for k, v in batch.items()}
+
+    def materialize(self):
+        from ray_tpu.data._internal.logical_plan import InputData
+        from ray_tpu.data.dataset import Dataset
+
+        bundles = list(self._ref_iter())
+        ds = Dataset(InputData(name="InputData", input_op=None, bundles=bundles))
+        ds._cached_bundles = bundles
+        return ds
+
+    def count(self) -> int:
+        return sum(m.num_rows for _, m in self._ref_iter())

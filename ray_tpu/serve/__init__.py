@@ -1,0 +1,42 @@
+"""ray_tpu.serve — actor-based model serving with HTTP ingress.
+
+Analog of the reference's Ray Serve (python/ray/serve/): a singleton
+controller actor reconciles deployments into replica actors; per-node HTTP
+proxy actors route by prefix; Python handles route through a shared Router
+with queue-limit-aware round-robin; queue-depth autoscaling. TPU idiom:
+replicas pin chips and serve jit-compiled models; @serve.batch feeds the MXU
+efficient batch sizes.
+"""
+
+from ray_tpu.serve._private.common import AutoscalingConfig, DeploymentConfig  # noqa: F401
+from ray_tpu.serve.api import (  # noqa: F401
+    Application,
+    Deployment,
+    delete,
+    deployment,
+    get_deployment_handle,
+    http_address,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from ray_tpu.serve.batching import batch  # noqa: F401
+from ray_tpu.serve.handle import DeploymentHandle  # noqa: F401
+
+__all__ = [
+    "Application",
+    "AutoscalingConfig",
+    "Deployment",
+    "DeploymentConfig",
+    "DeploymentHandle",
+    "batch",
+    "delete",
+    "deployment",
+    "get_deployment_handle",
+    "http_address",
+    "run",
+    "shutdown",
+    "start",
+    "status",
+]

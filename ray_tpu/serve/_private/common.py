@@ -1,0 +1,56 @@
+"""Shared Serve types (reference: python/ray/serve/_private/common.py)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+
+@dataclasses.dataclass
+class DeploymentConfig:
+    """Per-deployment config (reference: serve/config.py DeploymentConfig +
+    autoscaling_policy.py AutoscalingConfig)."""
+
+    num_replicas: int = 1
+    max_concurrent_queries: int = 100
+    user_config: Any = None
+    ray_actor_options: dict = dataclasses.field(default_factory=dict)
+    health_check_period_s: float = 10.0
+    health_check_timeout_s: float = 30.0
+    graceful_shutdown_timeout_s: float = 20.0
+    autoscaling: Optional["AutoscalingConfig"] = None
+    version: str = "1"
+
+
+@dataclasses.dataclass
+class AutoscalingConfig:
+    """Queue-depth-driven autoscaling (reference:
+    serve/_private/autoscaling_policy.py:9 calculate_desired_num_replicas)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_num_ongoing_requests_per_replica: float = 2.0
+    upscale_delay_s: float = 3.0
+    downscale_delay_s: float = 30.0
+
+
+@dataclasses.dataclass
+class ReplicaInfo:
+    replica_id: str
+    deployment_name: str
+    actor_name: str
+    max_concurrent_queries: int
+    version: str
+
+
+@dataclasses.dataclass
+class DeploymentInfo:
+    name: str
+    app_name: str
+    import_spec: bytes  # pickled (cls_or_fn, init_args, init_kwargs)
+    config: DeploymentConfig
+    route_prefix: Optional[str] = None
+
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+PROXY_NAME = "SERVE_PROXY"

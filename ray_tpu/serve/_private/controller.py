@@ -1,0 +1,301 @@
+"""ServeController — the singleton control-plane actor.
+
+Reference: python/ray/serve/_private/controller.py:79 ServeController,
+deployment reconciliation in _private/deployment_state.py (DeploymentState
+:1115, _scale_deployment_replicas :1493, DeploymentStateManager :2073), config
+fan-out via long-poll (_private/long_poll.py), queue-depth autoscaling
+(autoscaling_policy.py:9,53).
+
+The controller actor holds target state (deployments + configs), runs a
+reconcile thread that starts/stops replica actors to match, health-checks
+replicas, collects queue metrics, and serves long-poll subscriptions from
+routers/proxies for the replica membership table.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import threading
+import time
+import uuid
+
+import ray_tpu
+from ray_tpu.serve._private.common import (
+    AutoscalingConfig,
+    DeploymentConfig,
+    DeploymentInfo,
+    ReplicaInfo,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class ServeController:
+    def __init__(self):
+        # name -> DeploymentInfo (target state)
+        self._deployments: dict[str, DeploymentInfo] = {}
+        # name -> list[ReplicaInfo] (RUNNING replicas, in the routing table)
+        self._replicas: dict[str, list[ReplicaInfo]] = {}
+        # name -> count of STARTING replicas (created, not yet healthy)
+        self._starting: dict[str, int] = {}
+        self._replica_handles: dict[str, object] = {}
+        # autoscaling bookkeeping
+        self._metrics: dict[str, dict] = {}
+        self._scale_marks: dict[str, float] = {}
+        self._lock = threading.RLock()
+        self._epoch = 0
+        self._epoch_cv = threading.Condition(self._lock)
+        self._shutdown = False
+        self._reconcile_thread = threading.Thread(
+            target=self._reconcile_loop, name="serve-reconcile", daemon=True
+        )
+        self._reconcile_thread.start()
+
+    # ------------------------------------------------------------------
+    # Target-state API (called by serve.run / serve.delete)
+    # ------------------------------------------------------------------
+    def deploy(self, infos: list) -> bool:
+        with self._lock:
+            for raw in infos:
+                info: DeploymentInfo = pickle.loads(raw) if isinstance(raw, bytes) else raw
+                prev = self._deployments.get(info.name)
+                self._deployments[info.name] = info
+                if prev is not None and prev.config.version != info.config.version:
+                    pass  # rolling update handled by reconcile (version mismatch)
+        self._reconcile_once()
+        return True
+
+    def delete_deployments(self, names: list) -> bool:
+        with self._lock:
+            for name in names:
+                self._deployments.pop(name, None)
+        self._reconcile_once()
+        return True
+
+    def get_deployments(self) -> dict:
+        with self._lock:
+            return {
+                name: {
+                    "num_replicas": len(self._replicas.get(name, [])),
+                    "target": self._target_replicas(info),
+                    "route_prefix": info.route_prefix,
+                    "version": info.config.version,
+                }
+                for name, info in self._deployments.items()
+            }
+
+    def graceful_shutdown(self):
+        with self._lock:
+            self._deployments.clear()
+        self._reconcile_once()
+        self._shutdown = True
+        return True
+
+    # ------------------------------------------------------------------
+    # Long-poll routing table (reference: long_poll.py LongPollHost)
+    # ------------------------------------------------------------------
+    def get_routing_table(self, known_epoch: int = -1, timeout_s: float = 30.0) -> dict:
+        """Block until the table changes from known_epoch (long poll)."""
+        deadline = time.time() + timeout_s
+        with self._epoch_cv:
+            while self._epoch == known_epoch and not self._shutdown:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    break
+                self._epoch_cv.wait(remaining)
+            table = {
+                name: {
+                    "replicas": [
+                        {
+                            "replica_id": r.replica_id,
+                            "actor_name": r.actor_name,
+                            "max_concurrent_queries": r.max_concurrent_queries,
+                        }
+                        for r in reps
+                    ],
+                    "route_prefix": self._deployments[name].route_prefix
+                    if name in self._deployments
+                    else None,
+                }
+                for name, reps in self._replicas.items()
+                if name in self._deployments
+            }
+            return {"epoch": self._epoch, "table": table}
+
+    def _bump_epoch_locked(self):
+        self._epoch += 1
+        self._epoch_cv.notify_all()
+
+    # ------------------------------------------------------------------
+    # Metrics ingest (replicas push; reference: autoscaling_metrics.py)
+    # ------------------------------------------------------------------
+    def record_metrics(self, deployment: str, replica_id: str, ongoing: int) -> bool:
+        with self._lock:
+            self._metrics.setdefault(deployment, {})[replica_id] = (ongoing, time.time())
+        return True
+
+    # ------------------------------------------------------------------
+    # Reconciliation
+    # ------------------------------------------------------------------
+    def _reconcile_loop(self):
+        while not self._shutdown:
+            try:
+                self._reconcile_once()
+            except Exception:
+                logger.exception("reconcile failed")
+            time.sleep(0.5)
+
+    def _target_replicas(self, info: DeploymentInfo) -> int:
+        auto = info.config.autoscaling
+        if auto is None:
+            return info.config.num_replicas
+        with self._lock:
+            metrics = self._metrics.get(info.name, {})
+            live = {r.replica_id for r in self._replicas.get(info.name, [])}
+            now = time.time()
+            vals = [m[0] for rid, m in metrics.items() if rid in live and now - m[1] < 5.0]
+        current = len(live) or 1
+        total_ongoing = sum(vals) if vals else 0
+        # reference: autoscaling_policy.py:9 calculate_desired_num_replicas
+        desired = int(-(-total_ongoing // max(auto.target_num_ongoing_requests_per_replica, 1e-9)))
+        desired = max(auto.min_replicas, min(auto.max_replicas, max(desired, 0) or auto.min_replicas))
+        key = info.name
+        prev = len(self._replicas.get(key, []))
+        if desired > prev:
+            mark = self._scale_marks.get(key + ":up")
+            if mark is None:
+                self._scale_marks[key + ":up"] = now
+                return prev
+            if now - mark < auto.upscale_delay_s:
+                return prev
+            self._scale_marks.pop(key + ":up", None)
+            return desired
+        if desired < prev:
+            mark = self._scale_marks.get(key + ":down")
+            if mark is None:
+                self._scale_marks[key + ":down"] = now
+                return prev
+            if now - mark < auto.downscale_delay_s:
+                return prev
+            self._scale_marks.pop(key + ":down", None)
+            return desired
+        self._scale_marks.pop(key + ":up", None)
+        self._scale_marks.pop(key + ":down", None)
+        return desired
+
+    def _reconcile_once(self):
+        with self._lock:
+            targets = dict(self._deployments)
+        changed = False
+        # Remove replicas of deleted deployments or stale versions.
+        with self._lock:
+            current = {k: list(v) for k, v in self._replicas.items()}
+        for name, reps in current.items():
+            info = targets.get(name)
+            for r in reps:
+                if info is None or r.version != info.config.version:
+                    self._stop_replica(name, r)
+                    changed = True
+        # Scale each deployment to target (STARTING replicas count toward the
+        # target so reconcile doesn't over-start while actors boot).
+        for name, info in targets.items():
+            with self._lock:
+                reps = list(self._replicas.get(name, []))
+                starting = self._starting.get(name, 0)
+            target = self._target_replicas(info)
+            if len(reps) + starting < target:
+                for _ in range(target - len(reps) - starting):
+                    self._start_replica(info)
+            elif len(reps) > target:
+                for r in reps[target:]:
+                    self._stop_replica(name, r)
+                changed = True
+        if changed:
+            with self._epoch_cv:
+                self._bump_epoch_locked()
+
+    def _start_replica(self, info: DeploymentInfo):
+        """Create the replica actor; enter the routing table only once its
+        first health check answers (reference: replica STARTING -> RUNNING
+        transition in deployment_state.py)."""
+        from ray_tpu.serve._private.replica import Replica
+
+        replica_id = uuid.uuid4().hex[:8]
+        actor_name = f"SERVE_REPLICA::{info.name}#{replica_id}"
+        opts = dict(info.config.ray_actor_options or {})
+        opts.setdefault("num_cpus", 1)
+        # Admit concurrent requests up to the routing limit so @serve.batch
+        # can actually form batches (reference: replicas are async actors).
+        opts.setdefault("max_concurrency", min(info.config.max_concurrent_queries, 32))
+        opts["name"] = actor_name
+        actor_cls = ray_tpu.remote(**opts)(Replica)
+        handle = actor_cls.remote(info.import_spec, info.config.user_config)
+        rinfo = ReplicaInfo(
+            replica_id=replica_id,
+            deployment_name=info.name,
+            actor_name=actor_name,
+            max_concurrent_queries=info.config.max_concurrent_queries,
+            version=info.config.version,
+        )
+        with self._lock:
+            self._starting[info.name] = self._starting.get(info.name, 0) + 1
+            self._replica_handles[replica_id] = handle
+
+        def _wait_ready():
+            ok = False
+            try:
+                ok = ray_tpu.get(handle.check_health.remote(), timeout=info.config.health_check_timeout_s)
+            except Exception:
+                logger.exception("replica %s of %s failed to start", replica_id, info.name)
+            with self._lock:
+                self._starting[info.name] = max(0, self._starting.get(info.name, 0) - 1)
+                if ok and info.name in self._deployments:
+                    self._replicas.setdefault(info.name, []).append(rinfo)
+                else:
+                    self._replica_handles.pop(replica_id, None)
+                    try:
+                        ray_tpu.kill(handle)
+                    except Exception:
+                        pass
+            if ok:
+                with self._epoch_cv:
+                    self._bump_epoch_locked()
+                logger.info("replica %s of %s is running", replica_id, info.name)
+
+        threading.Thread(target=_wait_ready, daemon=True).start()
+
+    def _stop_replica(self, name: str, rinfo: ReplicaInfo):
+        with self._lock:
+            reps = self._replicas.get(name, [])
+            if rinfo in reps:
+                reps.remove(rinfo)
+            handle = self._replica_handles.pop(rinfo.replica_id, None)
+        if handle is not None:
+            try:
+                ray_tpu.kill(handle)
+            except Exception:
+                pass
+        logger.info("stopped replica %s of %s", rinfo.replica_id, name)
+
+    # Health: prune replicas whose actors died (reference: health checks in
+    # deployment_state; the GCS actor-death path marks them for restart).
+    def check_replicas(self) -> int:
+        dead = []
+        with self._lock:
+            all_reps = [(n, r) for n, reps in self._replicas.items() for r in reps]
+        for name, rinfo in all_reps:
+            try:
+                ray_tpu.get_actor(rinfo.actor_name)
+            except Exception:
+                dead.append((name, rinfo))
+        for name, rinfo in dead:
+            with self._lock:
+                reps = self._replicas.get(name, [])
+                if rinfo in reps:
+                    reps.remove(rinfo)
+                self._replica_handles.pop(rinfo.replica_id, None)
+        if dead:
+            with self._epoch_cv:
+                self._bump_epoch_locked()
+        return len(dead)

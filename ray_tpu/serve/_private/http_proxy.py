@@ -1,0 +1,107 @@
+"""HTTP ingress proxy actor.
+
+Reference: python/ray/serve/_private/http_proxy.py:320 HTTPProxy (ASGI app),
+:553 HTTPProxyActor — one proxy actor per node, routing by longest prefix to
+deployment replicas. Here the ASGI stack is aiohttp running on a dedicated
+thread inside the proxy actor process; replica calls run in an executor so
+the HTTP loop never blocks on the object store.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import ray_tpu
+
+logger = logging.getLogger(__name__)
+
+
+class HTTPProxy:
+    def __init__(self, controller_name: str, host: str = "127.0.0.1", port: int = 8000):
+        from ray_tpu.serve._private.router import Router
+
+        controller = ray_tpu.get_actor(controller_name)
+        self._router = Router(controller)
+        self._host = host
+        self._port = port
+        self._pool = ThreadPoolExecutor(max_workers=32, thread_name_prefix="proxy-call")
+        self._ready = threading.Event()
+        self._actual_port = None
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        self._ready.wait(timeout=30)
+
+    def address(self) -> tuple:
+        return (self._host, self._actual_port)
+
+    def ready(self) -> bool:
+        return self._ready.is_set()
+
+    def _serve(self):
+        from aiohttp import web
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+
+        async def handler(request: "web.Request"):
+            path = request.path
+            if path == "/-/healthz":
+                return web.Response(text="ok")
+            if path == "/-/routes":
+                with self._router._lock:
+                    routes = {
+                        name: e.get("route_prefix")
+                        for name, e in self._router._table.items()
+                    }
+                return web.json_response(routes)
+            deployment = self._router.route_for_prefix(path)
+            if deployment is None:
+                return web.Response(status=404, text=f"no deployment for path {path}")
+            body = await request.read()
+            method = request.method
+            query = dict(request.query)
+            headers = dict(request.headers)
+
+            def call():
+                replica = self._router.assign_replica(deployment)
+                try:
+                    actor = self._router.handle_for(replica)
+                    ref = actor.handle_http_request.remote(method, path, query, body, headers)
+                    return ray_tpu.get(ref, timeout=120)
+                finally:
+                    self._router.release(replica)
+
+            try:
+                result = await loop.run_in_executor(self._pool, call)
+            except Exception as e:
+                logger.exception("request to %s failed", deployment)
+                return web.Response(status=500, text=f"{type(e).__name__}: {e}")
+            if isinstance(result, bytes):
+                return web.Response(body=result)
+            if isinstance(result, str):
+                return web.Response(text=result)
+            return web.json_response(result, dumps=lambda o: json.dumps(o, default=_np_default))
+
+        app = web.Application(client_max_size=1 << 30)
+        app.router.add_route("*", "/{tail:.*}", handler)
+        runner = web.AppRunner(app, access_log=None)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, self._host, self._port)
+        loop.run_until_complete(site.start())
+        self._actual_port = site._server.sockets[0].getsockname()[1]
+        self._ready.set()
+        loop.run_forever()
+
+
+def _np_default(o):
+    import numpy as np
+
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    if isinstance(o, np.generic):
+        return o.item()
+    raise TypeError(f"not JSON serializable: {type(o)}")

@@ -1,0 +1,91 @@
+"""Replica actor (reference: python/ray/serve/_private/replica.py:384
+RayServeReplica, handle_request at :639).
+
+Each replica is a dedicated actor process wrapping the user callable. On a
+TPU node a replica can pin the chip and hold a jit-compiled model — the
+TPU-native serving idiom: one replica per chip, XLA-compiled predict, queue
+depth reported to the controller for autoscaling.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+
+
+class Replica:
+    def __init__(self, import_spec: bytes, user_config=None):
+        cls_or_fn, init_args, init_kwargs = pickle.loads(import_spec)
+        if isinstance(cls_or_fn, type):
+            self._callable = cls_or_fn(*init_args, **init_kwargs)
+        else:
+            self._callable = cls_or_fn
+        self._is_function = not isinstance(cls_or_fn, type)
+        self._ongoing = 0
+        self._total = 0
+        self._lock = threading.Lock()
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    def reconfigure(self, user_config):
+        """Push a new user_config without restarting (reference:
+        deployment_state version/user_config rolling update)."""
+        fn = getattr(self._callable, "reconfigure", None)
+        if fn is not None:
+            fn(user_config)
+        return True
+
+    def handle_request(self, method_name: str, args: tuple, kwargs: dict):
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            if self._is_function or method_name == "__call__":
+                target = self._callable
+            else:
+                target = getattr(self._callable, method_name)
+            return target(*args, **kwargs)
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    def handle_http_request(self, method: str, path: str, query: dict, body: bytes, headers: dict):
+        """HTTP entry: the callable gets a lightweight Request object."""
+        request = HTTPRequest(method=method, path=path, query=query, body=body, headers=headers)
+        return self.handle_request("__call__", (request,), {})
+
+    def get_metrics(self) -> dict:
+        """Queue stats for autoscaling (reference: autoscaling_metrics.py)."""
+        with self._lock:
+            return {"ongoing": self._ongoing, "total": self._total, "ts": time.time()}
+
+    def check_health(self) -> bool:
+        fn = getattr(self._callable, "check_health", None)
+        if fn is not None:
+            fn()
+        return True
+
+    def prepare_for_shutdown(self):
+        fn = getattr(self._callable, "__del__", None)
+        return True
+
+
+class HTTPRequest:
+    """Minimal request object handed to deployments from the proxy
+    (stands in for the reference's starlette.requests.Request)."""
+
+    def __init__(self, method: str, path: str, query: dict, body: bytes, headers: dict):
+        self.method = method
+        self.path = path
+        self.query_params = query
+        self.body = body
+        self.headers = headers
+
+    def json(self):
+        import json as _json
+
+        return _json.loads(self.body or b"null")
+
+    def text(self) -> str:
+        return (self.body or b"").decode()

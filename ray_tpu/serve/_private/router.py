@@ -1,0 +1,132 @@
+"""Router — picks a replica for each request.
+
+Reference: python/ray/serve/_private/router.py:368 Router,
+ReplicaScheduler.assign_replica :76, round-robin skipping replicas at
+max_concurrent_queries :125,336; membership pushed from the controller via
+long-poll (long_poll.py:68 LongPollClient).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+import ray_tpu
+
+logger = logging.getLogger(__name__)
+
+
+class Router:
+    """One per handle/proxy process; tracks the routing table with a
+    background long-poll thread and round-robins requests."""
+
+    _instance = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self, controller_handle):
+        self._controller = controller_handle
+        self._table: dict = {}
+        self._epoch = -1
+        self._handles: dict[str, object] = {}  # actor_name -> handle
+        self._rr: dict[str, int] = {}
+        self._inflight: dict[str, int] = {}  # replica actor_name -> count
+        self._lock = threading.Lock()
+        self._update_event = threading.Event()
+        self._poll_thread = threading.Thread(target=self._poll_loop, daemon=True)
+        self._poll_thread.start()
+        # Synchronous first fetch so handles work immediately after run().
+        self._refresh(timeout_s=0.1)
+
+    @classmethod
+    def shared(cls, controller_handle) -> "Router":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = Router(controller_handle)
+            return cls._instance
+
+    @classmethod
+    def reset(cls):
+        with cls._instance_lock:
+            cls._instance = None
+
+    def _refresh(self, timeout_s: float = 30.0):
+        resp = ray_tpu.get(
+            self._controller.get_routing_table.remote(self._epoch, timeout_s)
+        )
+        with self._lock:
+            self._epoch = resp["epoch"]
+            self._table = resp["table"]
+        self._update_event.set()
+
+    def _poll_loop(self):
+        while True:
+            try:
+                self._refresh()
+            except Exception:
+                time.sleep(1.0)
+
+    def replicas_for(self, deployment: str) -> list:
+        with self._lock:
+            entry = self._table.get(deployment)
+            return list(entry["replicas"]) if entry else []
+
+    def route_for_prefix(self, path: str):
+        """Longest-prefix route match for HTTP (reference: proxy route table)."""
+        with self._lock:
+            best, best_len = None, -1
+            for name, entry in self._table.items():
+                prefix = entry.get("route_prefix")
+                if prefix is None:
+                    continue
+                if (path == prefix or path.startswith(prefix.rstrip("/") + "/") or prefix == "/") and len(prefix) > best_len:
+                    best, best_len = name, len(prefix)
+            return best
+
+    def wait_for_deployment(self, deployment: str, timeout_s: float = 30.0) -> bool:
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if self.replicas_for(deployment):
+                return True
+            time.sleep(0.05)
+        return False
+
+    def assign_replica(self, deployment: str, timeout_s: float = 30.0):
+        """Round-robin over replicas, skipping ones at their queue limit
+        (reference: router.py:125 RoundRobinReplicaScheduler)."""
+        deadline = time.time() + timeout_s
+        while True:
+            replicas = self.replicas_for(deployment)
+            if replicas:
+                with self._lock:
+                    start = self._rr.get(deployment, 0)
+                    n = len(replicas)
+                    for i in range(n):
+                        r = replicas[(start + i) % n]
+                        name = r["actor_name"]
+                        if self._inflight.get(name, 0) < r["max_concurrent_queries"]:
+                            self._rr[deployment] = (start + i + 1) % n
+                            self._inflight[name] = self._inflight.get(name, 0) + 1
+                            return r
+            if time.time() >= deadline:
+                raise TimeoutError(
+                    f"no available replica for deployment {deployment!r} "
+                    f"within {timeout_s}s"
+                )
+            time.sleep(0.01)
+
+    def release(self, replica):
+        with self._lock:
+            name = replica["actor_name"]
+            self._inflight[name] = max(0, self._inflight.get(name, 0) - 1)
+
+    def handle_for(self, replica) -> object:
+        name = replica["actor_name"]
+        handle = self._handles.get(name)
+        if handle is None:
+            handle = ray_tpu.get_actor(name)
+            self._handles[name] = handle
+        return handle
+
+    def invalidate_handle(self, replica):
+        self._handles.pop(replica["actor_name"], None)

@@ -1,0 +1,201 @@
+"""Public Serve API.
+
+Reference: python/ray/serve/api.py — serve.start :61, @serve.deployment :241,
+serve.run :413; Deployment in serve/deployment.py.
+
+Usage:
+    @serve.deployment(num_replicas=2)
+    class Model:
+        def __call__(self, request): ...
+
+    handle = serve.run(Model.bind(arg), route_prefix="/model")
+    ray_tpu.get(handle.remote(x))
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+
+import cloudpickle
+from typing import Any, Callable, Optional
+
+import ray_tpu
+from ray_tpu.serve._private.common import (
+    CONTROLLER_NAME,
+    PROXY_NAME,
+    AutoscalingConfig,
+    DeploymentConfig,
+    DeploymentInfo,
+)
+from ray_tpu.serve.handle import DeploymentHandle
+
+_started = False
+_http_port: Optional[int] = None
+
+
+class Application:
+    """A bound deployment (reference: serve's built Application via .bind())."""
+
+    def __init__(self, deployment: "Deployment", init_args: tuple, init_kwargs: dict):
+        self.deployment = deployment
+        self.init_args = init_args
+        self.init_kwargs = init_kwargs
+
+
+class Deployment:
+    def __init__(self, cls_or_fn: Callable, name: str, config: DeploymentConfig, route_prefix: Optional[str]):
+        self._cls_or_fn = cls_or_fn
+        self.name = name
+        self.config = config
+        self.route_prefix = route_prefix
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+    def options(self, *, num_replicas: Optional[int] = None, name: Optional[str] = None,
+                max_concurrent_queries: Optional[int] = None, user_config: Any = None,
+                ray_actor_options: Optional[dict] = None, autoscaling_config=None,
+                route_prefix: Optional[str] = "__unset__", version: Optional[str] = None) -> "Deployment":
+        import dataclasses
+
+        cfg = dataclasses.replace(self.config)
+        if num_replicas is not None:
+            cfg.num_replicas = num_replicas
+        if max_concurrent_queries is not None:
+            cfg.max_concurrent_queries = max_concurrent_queries
+        if user_config is not None:
+            cfg.user_config = user_config
+        if ray_actor_options is not None:
+            cfg.ray_actor_options = ray_actor_options
+        if autoscaling_config is not None:
+            cfg.autoscaling = _coerce_autoscaling(autoscaling_config)
+        if version is not None:
+            cfg.version = version
+        return Deployment(
+            self._cls_or_fn,
+            name or self.name,
+            cfg,
+            self.route_prefix if route_prefix == "__unset__" else route_prefix,
+        )
+
+
+def deployment(
+    _cls=None,
+    *,
+    name: Optional[str] = None,
+    num_replicas: int = 1,
+    max_concurrent_queries: int = 100,
+    user_config: Any = None,
+    ray_actor_options: Optional[dict] = None,
+    autoscaling_config=None,
+    route_prefix: Optional[str] = None,
+    version: str = "1",
+):
+    """``@serve.deployment`` decorator (reference: api.py:241)."""
+
+    def wrap(cls_or_fn):
+        cfg = DeploymentConfig(
+            num_replicas=num_replicas,
+            max_concurrent_queries=max_concurrent_queries,
+            user_config=user_config,
+            ray_actor_options=ray_actor_options or {},
+            autoscaling=_coerce_autoscaling(autoscaling_config),
+            version=version,
+        )
+        return Deployment(cls_or_fn, name or cls_or_fn.__name__, cfg, route_prefix)
+
+    if _cls is not None:
+        return wrap(_cls)
+    return wrap
+
+
+def _coerce_autoscaling(cfg) -> Optional[AutoscalingConfig]:
+    if cfg is None:
+        return None
+    if isinstance(cfg, AutoscalingConfig):
+        return cfg
+    return AutoscalingConfig(**cfg)
+
+
+def start(http_host: str = "127.0.0.1", http_port: int = 0, detached: bool = True):
+    """Start the Serve control plane: controller actor + HTTP proxy actor."""
+    global _started, _http_port
+    if _started:
+        return
+    from ray_tpu.serve._private.controller import ServeController
+    from ray_tpu.serve._private.http_proxy import HTTPProxy
+
+    controller_cls = ray_tpu.remote(num_cpus=0, name=CONTROLLER_NAME, max_concurrency=16)(ServeController)
+    controller_cls.remote()
+    proxy_cls = ray_tpu.remote(num_cpus=0, name=PROXY_NAME, max_concurrency=16)(HTTPProxy)
+    proxy = proxy_cls.remote(CONTROLLER_NAME, http_host, http_port)
+    addr = ray_tpu.get(proxy.address.remote())
+    _http_port = addr[1]
+    _started = True
+
+
+def http_address() -> tuple:
+    controller = ray_tpu.get_actor(PROXY_NAME)
+    return tuple(ray_tpu.get(controller.address.remote()))
+
+
+def run(app: Application, *, name: str = "default", route_prefix: Optional[str] = "__from_deployment__", _blocking: bool = True) -> DeploymentHandle:
+    """Deploy an application and return a handle (reference: api.py:413)."""
+    from ray_tpu.serve._private.router import Router
+
+    if not _started:
+        start()
+    dep = app.deployment
+    prefix = dep.route_prefix if route_prefix == "__from_deployment__" else route_prefix
+    info = DeploymentInfo(
+        name=dep.name,
+        app_name=name,
+        import_spec=cloudpickle.dumps((dep._cls_or_fn, app.init_args, app.init_kwargs)),
+        config=dep.config,
+        route_prefix=prefix,
+    )
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    ray_tpu.get(controller.deploy.remote([pickle.dumps(info)]))
+    router = Router.shared(controller)
+    if _blocking and not router.wait_for_deployment(dep.name, timeout_s=60):
+        raise TimeoutError(f"deployment {dep.name} did not become ready")
+    return DeploymentHandle(dep.name, router)
+
+
+def get_deployment_handle(deployment_name: str) -> DeploymentHandle:
+    from ray_tpu.serve._private.router import Router
+
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    return DeploymentHandle(deployment_name, Router.shared(controller))
+
+
+def status() -> dict:
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    return ray_tpu.get(controller.get_deployments.remote())
+
+
+def delete(deployment_name: str):
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    ray_tpu.get(controller.delete_deployments.remote([deployment_name]))
+
+
+def shutdown():
+    global _started
+    from ray_tpu.serve._private.router import Router
+
+    if not _started:
+        return
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        ray_tpu.get(controller.graceful_shutdown.remote())
+        time.sleep(0.2)
+        ray_tpu.kill(controller)
+    except Exception:
+        pass
+    try:
+        ray_tpu.kill(ray_tpu.get_actor(PROXY_NAME))
+    except Exception:
+        pass
+    Router.reset()
+    _started = False

@@ -1,0 +1,90 @@
+"""@serve.batch — transparent request batching.
+
+Reference: python/ray/serve/batching.py — queued requests are flushed to the
+wrapped method as a list when the batch fills or the wait timeout expires.
+The TPU angle: batching is how single-request traffic reaches MXU-efficient
+batch sizes; pair with a jit-compiled predictor padded to fixed batch shapes.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, List, Optional
+
+
+class _BatchQueue:
+    def __init__(self, fn: Callable, max_batch_size: int, batch_wait_timeout_s: float):
+        self._fn = fn
+        self._max = max_batch_size
+        self._wait = batch_wait_timeout_s
+        self._items: List[tuple] = []
+        self._lock = threading.Lock()
+        self._flusher: Optional[threading.Timer] = None
+
+    def submit(self, instance, item) -> Future:
+        fut: Future = Future()
+        flush_now = False
+        with self._lock:
+            self._items.append((instance, item, fut))
+            if len(self._items) >= self._max:
+                flush_now = True
+            elif self._flusher is None:
+                self._flusher = threading.Timer(self._wait, self._flush)
+                self._flusher.daemon = True
+                self._flusher.start()
+        if flush_now:
+            self._flush()
+        return fut
+
+    def _flush(self):
+        with self._lock:
+            if self._flusher is not None:
+                self._flusher.cancel()
+                self._flusher = None
+            items, self._items = self._items, []
+        if not items:
+            return
+        instance = items[0][0]
+        batch = [item for _, item, _ in items]
+        futures = [fut for _, _, fut in items]
+        try:
+            if instance is not None:
+                results = self._fn(instance, batch)
+            else:
+                results = self._fn(batch)
+            if len(results) != len(batch):
+                raise ValueError(
+                    f"@serve.batch function returned {len(results)} results "
+                    f"for a batch of {len(batch)}"
+                )
+            for fut, res in zip(futures, results):
+                fut.set_result(res)
+        except Exception as e:
+            for fut in futures:
+                if not fut.done():
+                    fut.set_exception(e)
+
+
+def batch(_fn=None, *, max_batch_size: int = 8, batch_wait_timeout_s: float = 0.01):
+    """Decorator: calls with single items are batched into list calls."""
+
+    def wrap(fn):
+        queue = _BatchQueue(fn, max_batch_size, batch_wait_timeout_s)
+
+        @functools.wraps(fn)
+        def wrapper(*args):
+            if len(args) == 2:  # bound method: (self, item)
+                instance, item = args
+            else:
+                instance, item = None, args[0]
+            return queue.submit(instance, item).result(timeout=60)
+
+        wrapper._batch_queue = queue
+        return wrapper
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap

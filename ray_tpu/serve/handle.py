@@ -1,0 +1,57 @@
+"""DeploymentHandle — Python-side calls into a deployment.
+
+Reference: python/ray/serve/handle.py (RayServeHandle / DeploymentHandle):
+``handle.remote(*args)`` routes through the shared Router to a replica actor
+and returns an ObjectRef; ``handle.method.remote(...)`` calls a specific
+method of a class deployment.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import ray_tpu
+
+
+class _MethodCaller:
+    def __init__(self, handle: "DeploymentHandle", method_name: str):
+        self._handle = handle
+        self._method = method_name
+
+    def remote(self, *args, **kwargs):
+        return self._handle._invoke(self._method, args, kwargs)
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, router):
+        self._deployment = deployment_name
+        self._router = router
+
+    def remote(self, *args, **kwargs):
+        return self._invoke("__call__", args, kwargs)
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return _MethodCaller(self, item)
+
+    def _invoke(self, method: str, args: tuple, kwargs: dict):
+        replica = self._router.assign_replica(self._deployment)
+        try:
+            actor = self._router.handle_for(replica)
+            ref = actor.handle_request.remote(method, args, kwargs)
+        except Exception:
+            self._router.release(replica)
+            self._router.invalidate_handle(replica)
+            raise
+        # Release the slot once the result lands (fire-and-forget waiter).
+        router = self._router
+
+        def _release():
+            try:
+                ray_tpu.wait([ref], num_returns=1, timeout=3600, fetch_local=False)
+            finally:
+                router.release(replica)
+
+        threading.Thread(target=_release, daemon=True).start()
+        return ref

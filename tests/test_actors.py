@@ -108,7 +108,10 @@ def test_actor_restart(ray_start_regular):
             break
         except Exception:
             time.sleep(0.2)
-    assert value == 1
+    # Retried actor tasks are at-least-once: an inc whose reply was lost to
+    # the kill may have executed on the new incarnation before our loop's
+    # attempt, so the counter restarts at 1 but may legitimately read 2.
+    assert value in (1, 2)
 
 
 def test_threaded_actor(ray_start_regular):
