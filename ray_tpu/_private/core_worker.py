@@ -280,16 +280,10 @@ class CoreWorker:
                     return False
                 obj = self.owned.get(oid_hex)
                 return obj is not None and (obj.in_plasma or oid_hex in self.in_process_store)
-        # Borrowed: available once the owner reports it, or once a local copy
-        # exists (probe cheaply first to avoid an RPC storm).
-        if self.store.contains(oid_hex):
-            return True
-        try:
-            client = self._owner_client(tuple(ref.owner_addr))
-            resp = client.call("get_inline", {"object_id": oid_hex, "wait": False}, timeout=2)
-            return resp.get("kind") in ("inline", "plasma")
-        except Exception:
-            return False
+        # Borrowed: only cheap local checks on the submit path — a remote
+        # owner probe here would block .remote() for seconds when the owner
+        # is slow; the deferred async waiter handles the remote case.
+        return self.store.contains(oid_hex)
 
     def _owner_client(self, addr: tuple) -> RpcClient:
         """Cached connection to another worker/driver (owner of a borrowed

@@ -194,8 +194,18 @@ class BlockAccessor:
         return self._table.select(keep)
 
     def hash_partition(self, key: str, num_partitions: int) -> list:
+        # Process-stable hash: builtin hash() is salted per process for
+        # str/bytes, which would scatter the same key across partitions when
+        # map tasks run in different workers.
+        import zlib
+
+        def stable_hash(v) -> int:
+            if isinstance(v, bytes):
+                return zlib.crc32(v)
+            return zlib.crc32(repr(v).encode())
+
         vals = self._table.column(key).to_pylist()
-        assignments = np.array([hash(v) % num_partitions for v in vals])
+        assignments = np.array([stable_hash(v) % num_partitions for v in vals])
         return [self.take_indices(np.nonzero(assignments == p)[0]) for p in range(num_partitions)]
 
     def random_partition(self, num_partitions: int, seed: Optional[int]) -> list:

@@ -431,10 +431,15 @@ class Dataset:
 
     def streaming_split(self, n: int, *, equal: bool = True, locality_hints=None) -> list:
         """Per-consumer iterators over disjoint shards (reference:
-        dataset.py streaming_split via OutputSplitter). Bundles are dealt
-        round-robin; with equal=True the tail is trimmed."""
+        dataset.py streaming_split via OutputSplitter). equal=True re-chunks
+        to exactly-equal row counts (SPMD consumers lockstep-iterate, so
+        uneven shards would desync collectives); equal=False deals bundles
+        round-robin without materializing."""
         from ray_tpu.data.iterator import DataIterator, _ShardState
 
+        if equal:
+            parts = self.split(n, equal=True)
+            return [DataIterator(dataset=p) for p in parts]
         state = _ShardState(self, n, equal)
         return [DataIterator(shard_state=state, shard_index=i) for i in range(n)]
 

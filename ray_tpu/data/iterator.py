@@ -33,14 +33,19 @@ def iter_batches_from_refs(
     rng = np.random.default_rng(local_shuffle_seed)
 
     def fetch_blocks():
-        # Prefetch pipeline: keep up to prefetch_batches+1 gets in flight.
-        window: collections.deque = collections.deque()
-        for ref, _meta in ref_iter:
-            window.append(ref)
-            while len(window) > max(1, prefetch_batches):
-                yield ray_tpu.get(window.popleft())
-        while window:
-            yield ray_tpu.get(window.popleft())
+        # Real prefetch: background-thread gets overlap block transfer with
+        # the consumer's compute (holding refs alone starts no fetch).
+        from concurrent.futures import ThreadPoolExecutor
+
+        depth = max(1, prefetch_batches)
+        with ThreadPoolExecutor(max_workers=depth, thread_name_prefix="data-prefetch") as pool:
+            window: collections.deque = collections.deque()
+            for ref, _meta in ref_iter:
+                window.append(pool.submit(ray_tpu.get, ref))
+                while len(window) > depth:
+                    yield window.popleft().result()
+            while window:
+                yield window.popleft().result()
 
     carry: Optional[Any] = None  # leftover table slice
     shuffle_buf: list = []

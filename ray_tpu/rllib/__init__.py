@@ -1,0 +1,12 @@
+"""ray_tpu.rllib — reinforcement learning on the ray_tpu runtime.
+
+Analog of the reference's RLlib (rllib/): CPU rollout-worker actors step
+vectorized gymnasium envs; a pure-JAX Learner (single-process or an actor
+gang with gradient allreduce over the collective plane) runs jitted SGD;
+Algorithm extends the Tune Trainable so algorithms drop into tune.Tuner.
+"""
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig  # noqa: F401
+from ray_tpu.rllib.policy.sample_batch import MultiAgentBatch, SampleBatch  # noqa: F401

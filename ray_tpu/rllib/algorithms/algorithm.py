@@ -1,0 +1,190 @@
+"""Algorithm — the RLlib trainer base, a Tune Trainable.
+
+Reference: rllib/algorithms/algorithm.py:149 (Algorithm extends Trainable,
+setup :510 builds WorkerSet + LearnerGroup, training_step :1347) and
+algorithm_config.py (fluent AlgorithmConfig builder).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, Type
+
+import numpy as np
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.rllib.core.learner import LearnerGroup
+from ray_tpu.rllib.core.rl_module import RLModuleSpec
+from ray_tpu.rllib.evaluation.rollout_worker import WorkerSet
+from ray_tpu.tune.trainable import Trainable
+
+
+class AlgorithmConfig:
+    """Fluent config builder (reference: algorithm_config.py)."""
+
+    def __init__(self, algo_class: Optional[Type["Algorithm"]] = None):
+        self.algo_class = algo_class
+        self.env = None
+        self.env_config: dict = {}
+        self.num_rollout_workers = 2
+        self.num_envs_per_worker = 1
+        self.rollout_fragment_length = 200
+        self.gamma = 0.99
+        self.lambda_ = 0.95
+        self.lr = 5e-5
+        self.train_batch_size = 4000
+        self.grad_clip: Optional[float] = None
+        self.model_hiddens = (64, 64)
+        self.seed = 0
+        self.num_learners = 0
+        self.num_tpus_per_learner = 0.0
+        self.explore = True
+        self.extra: dict = {}
+
+    # -- fluent sections (reference: .environment/.rollouts/.training) ----
+    def environment(self, env=None, *, env_config: Optional[dict] = None) -> "AlgorithmConfig":
+        if env is not None:
+            self.env = env
+        if env_config is not None:
+            self.env_config = env_config
+        return self
+
+    def rollouts(self, *, num_rollout_workers: Optional[int] = None, num_envs_per_worker: Optional[int] = None,
+                 rollout_fragment_length: Optional[int] = None) -> "AlgorithmConfig":
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        if num_envs_per_worker is not None:
+            self.num_envs_per_worker = num_envs_per_worker
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, *, lr: Optional[float] = None, gamma: Optional[float] = None,
+                 train_batch_size: Optional[int] = None, grad_clip: Optional[float] = None,
+                 model_hiddens=None, **extra) -> "AlgorithmConfig":
+        if lr is not None:
+            self.lr = lr
+        if gamma is not None:
+            self.gamma = gamma
+        if train_batch_size is not None:
+            self.train_batch_size = train_batch_size
+        if grad_clip is not None:
+            self.grad_clip = grad_clip
+        if model_hiddens is not None:
+            self.model_hiddens = tuple(model_hiddens)
+        self.extra.update(extra)
+        return self
+
+    def resources(self, *, num_learners: Optional[int] = None, num_tpus_per_learner: Optional[float] = None) -> "AlgorithmConfig":
+        if num_learners is not None:
+            self.num_learners = num_learners
+        if num_tpus_per_learner is not None:
+            self.num_tpus_per_learner = num_tpus_per_learner
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None) -> "AlgorithmConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def to_dict(self) -> dict:
+        return dict(self.__dict__)
+
+    def build(self) -> "Algorithm":
+        assert self.algo_class is not None, "config not bound to an algorithm"
+        return self.algo_class(config=self)
+
+
+class Algorithm(Trainable):
+    """Extends the Tune Trainable so `tune.Tuner(PPO, ...)` works the same
+    way as the reference (§3.6 of the survey)."""
+
+    _config_class = AlgorithmConfig
+
+    def __init__(self, config=None, **kwargs):
+        if isinstance(config, AlgorithmConfig):
+            self._algo_config = config
+        else:
+            self._algo_config = self.get_default_config()
+            for k, v in (config or {}).items():
+                if hasattr(self._algo_config, k):
+                    setattr(self._algo_config, k, v)
+                else:
+                    self._algo_config.extra[k] = v
+        super().__init__(config=self._algo_config.to_dict())
+
+    @classmethod
+    def get_default_config(cls) -> AlgorithmConfig:
+        return AlgorithmConfig(algo_class=cls)
+
+    # -- Trainable protocol -------------------------------------------------
+    def setup(self, config: dict) -> None:
+        cfg = self._algo_config
+        import gymnasium as gym
+
+        probe = gym.make(cfg.env) if isinstance(cfg.env, str) else cfg.env(dict(cfg.env_config))
+        self.module_spec = RLModuleSpec.from_spaces(
+            probe.observation_space, probe.action_space, cfg.model_hiddens
+        )
+        probe.close()
+        self.workers = WorkerSet(
+            cfg.env,
+            self.module_spec,
+            num_workers=cfg.num_rollout_workers,
+            num_envs_per_worker=cfg.num_envs_per_worker,
+            env_config=cfg.env_config,
+            gamma=cfg.gamma,
+            lambda_=cfg.lambda_,
+            seed=cfg.seed,
+        )
+        self.learner_group = self._build_learner_group(cfg)
+        self.workers.sync_weights(self.learner_group.get_weights())
+        self._episode_reward_window: list = []
+        self._timesteps_total = 0
+
+    def _build_learner_group(self, cfg: AlgorithmConfig) -> LearnerGroup:
+        raise NotImplementedError
+
+    def training_step(self) -> dict:
+        raise NotImplementedError
+
+    def step(self) -> dict:
+        t0 = time.time()
+        result = self.training_step()
+        stats = self.workers.episode_stats()
+        self._episode_reward_window += stats["episode_rewards"]
+        self._episode_reward_window = self._episode_reward_window[-100:]
+        result.setdefault("episode_reward_mean", float(np.mean(self._episode_reward_window)) if self._episode_reward_window else float("nan"))
+        result["episodes_this_iter"] = len(stats["episode_rewards"])
+        result["timesteps_total"] = self._timesteps_total
+        result["time_this_iter_s"] = time.time() - t0
+        return result
+
+    def save_checkpoint(self) -> Checkpoint:
+        return Checkpoint.from_dict({"weights": self.learner_group.get_weights(), "timesteps": self._timesteps_total})
+
+    def load_checkpoint(self, checkpoint: Checkpoint) -> None:
+        data = checkpoint.to_dict()
+        self.learner_group.set_weights(data["weights"])
+        self._timesteps_total = data.get("timesteps", 0)
+        self.workers.sync_weights(data["weights"])
+
+    def cleanup(self) -> None:
+        self.workers.stop()
+
+    # -- convenience (reference: Algorithm.compute_single_action) ----------
+    def compute_single_action(self, obs, explore: bool = False):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib.core import rl_module
+
+        params = jax.tree_util.tree_map(jnp.asarray, self.learner_group.get_weights())
+        actions, _, _ = rl_module.sample_actions(
+            params, jnp.asarray(np.asarray(obs, np.float32))[None], jax.random.PRNGKey(0), self.module_spec, explore
+        )
+        a = np.asarray(actions)[0]
+        return a.item() if self.module_spec.discrete else a
+
+    def get_policy_weights(self):
+        return self.learner_group.get_weights()

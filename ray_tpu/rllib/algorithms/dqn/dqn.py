@@ -1,0 +1,216 @@
+"""DQN — deep Q-learning with target network + prioritized replay.
+
+Reference: rllib/algorithms/dqn/dqn.py (+ dqn_torch_policy loss): epsilon-
+greedy rollouts into a replay buffer, double-Q TD targets against a
+periodically-synced target network, jitted TD update.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.core import rl_module
+from ray_tpu.rllib.core.learner import Learner, LearnerGroup
+from ray_tpu.rllib.env.vector_env import VectorEnv
+from ray_tpu.rllib.policy.sample_batch import (
+    ACTIONS,
+    DONES,
+    NEXT_OBS,
+    OBS,
+    REWARDS,
+    SampleBatch,
+)
+from ray_tpu.rllib.utils.replay_buffers import PrioritizedReplayBuffer, ReplayBuffer
+
+
+def q_forward(params, obs, spec):
+    """The pi head doubles as the Q head for DQN (logits == Q-values)."""
+    q, _ = rl_module.forward(params, obs, spec)
+    return q
+
+
+def dqn_loss(params, batch, spec, cfg):
+    import jax.numpy as jnp
+
+    q = q_forward(params, batch[OBS], spec)
+    q_taken = q[jnp.arange(q.shape[0]), batch[ACTIONS].astype(jnp.int32)]
+    td_target = batch["td_target"]
+    td_error = q_taken - td_target
+    weights = batch.get("weights", jnp.ones_like(td_error))
+    loss = jnp.mean(weights * jnp.square(td_error) * 0.5)
+    return loss, {"td_error_abs": jnp.abs(td_error).mean(), "q_mean": q_taken.mean()}
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or DQN)
+        self.lr = 5e-4
+        self.num_rollout_workers = 0  # DQN collects in-process by default
+        self.train_batch_size = 32
+        self.replay_buffer_capacity = 50_000
+        self.learning_starts = 1000
+        self.target_network_update_freq = 500
+        self.rollout_steps_per_iter = 1000
+        self.train_intensity = 4  # updates per env step / batch ratio
+        self.epsilon_timesteps = 10_000
+        self.initial_epsilon = 1.0
+        self.final_epsilon = 0.02
+        self.double_q = True
+        self.prioritized_replay = True
+
+    def training(self, *, replay_buffer_capacity=None, learning_starts=None,
+                 target_network_update_freq=None, epsilon_timesteps=None,
+                 final_epsilon=None, double_q=None, prioritized_replay=None,
+                 rollout_steps_per_iter=None, train_intensity=None, **kwargs) -> "DQNConfig":
+        super().training(**kwargs)
+        for name, val in (
+            ("replay_buffer_capacity", replay_buffer_capacity),
+            ("learning_starts", learning_starts),
+            ("target_network_update_freq", target_network_update_freq),
+            ("epsilon_timesteps", epsilon_timesteps),
+            ("final_epsilon", final_epsilon),
+            ("double_q", double_q),
+            ("prioritized_replay", prioritized_replay),
+            ("rollout_steps_per_iter", rollout_steps_per_iter),
+            ("train_intensity", train_intensity),
+        ):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+class DQN(Algorithm):
+    @classmethod
+    def get_default_config(cls) -> DQNConfig:
+        return DQNConfig(cls)
+
+    def setup(self, config: dict) -> None:
+        import jax
+
+        cfg: DQNConfig = self._algo_config
+        import gymnasium as gym
+
+        probe = gym.make(cfg.env) if isinstance(cfg.env, str) else cfg.env(dict(cfg.env_config))
+        from ray_tpu.rllib.core.rl_module import RLModuleSpec
+
+        self.module_spec = RLModuleSpec.from_spaces(probe.observation_space, probe.action_space, cfg.model_hiddens)
+        assert self.module_spec.discrete, "DQN requires a discrete action space"
+        probe.close()
+        self.env = VectorEnv(cfg.env, max(cfg.num_envs_per_worker, 1), cfg.env_config, 0, seed=cfg.seed)
+        self.learner = Learner(self.module_spec, dqn_loss, lr=cfg.lr, grad_clip=cfg.grad_clip, seed=cfg.seed)
+        self.target_params = self.learner.get_weights()
+        buf_cls = PrioritizedReplayBuffer if cfg.prioritized_replay else ReplayBuffer
+        self.buffer = buf_cls(cfg.replay_buffer_capacity, seed=cfg.seed)
+        self._timesteps_total = 0
+        self._updates = 0
+        self._episode_reward_window: list = []
+        self._rng = np.random.default_rng(cfg.seed)
+        self._q_fn = jax.jit(lambda p, o: q_forward(p, o, self.module_spec))
+
+    def _epsilon(self) -> float:
+        cfg = self._algo_config
+        frac = min(1.0, self._timesteps_total / max(cfg.epsilon_timesteps, 1))
+        return cfg.initial_epsilon + frac * (cfg.final_epsilon - cfg.initial_epsilon)
+
+    def training_step(self) -> dict:
+        import jax.numpy as jnp
+
+        cfg: DQNConfig = self._algo_config
+        metrics: dict = {}
+        for _ in range(cfg.rollout_steps_per_iter):
+            obs = self.env.current_obs().astype(np.float32)
+            # Live params: intra-iteration learner updates steer exploration.
+            q = np.asarray(self._q_fn(self.learner.params, jnp.asarray(obs)))
+            actions = q.argmax(axis=-1)
+            eps_mask = self._rng.random(len(actions)) < self._epsilon()
+            random_actions = self._rng.integers(0, self.module_spec.action_dim, len(actions))
+            actions = np.where(eps_mask, random_actions, actions)
+            next_obs, rewards, dones, _ = self.env.step(actions)
+            self.buffer.add(SampleBatch({
+                OBS: obs, ACTIONS: actions, REWARDS: rewards,
+                DONES: dones.astype(np.float32), NEXT_OBS: next_obs.astype(np.float32),
+            }))
+            self._timesteps_total += len(actions)
+            if self._timesteps_total >= cfg.learning_starts and self._timesteps_total % max(1, cfg.train_intensity) == 0:
+                metrics = self._train_once()
+        stats_r, _ = self.env.pop_episode_stats()
+        self._episode_reward_window += stats_r
+        self._episode_reward_window = self._episode_reward_window[-100:]
+        metrics["epsilon"] = self._epsilon()
+        return metrics
+
+    def _train_once(self) -> dict:
+        import jax.numpy as jnp
+
+        cfg: DQNConfig = self._algo_config
+        batch = self.buffer.sample(cfg.train_batch_size)
+        next_obs = jnp.asarray(batch[NEXT_OBS])
+        q_next_target = np.asarray(self._q_fn(self._as_jax(self.target_params), next_obs))
+        if cfg.double_q:
+            q_next_online = np.asarray(self._q_fn(self.learner.params, next_obs))
+            best = q_next_online.argmax(axis=-1)
+            q_next = q_next_target[np.arange(len(best)), best]
+        else:
+            q_next = q_next_target.max(axis=-1)
+        td_target = batch[REWARDS] + cfg.gamma * (1.0 - batch[DONES]) * q_next
+        train_batch = SampleBatch({
+            OBS: batch[OBS], ACTIONS: batch[ACTIONS], "td_target": td_target.astype(np.float32),
+        })
+        if "weights" in batch:
+            train_batch["weights"] = batch["weights"]
+        metrics = self.learner.update(train_batch, {})
+        if isinstance(self.buffer, PrioritizedReplayBuffer):
+            q = np.asarray(self._q_fn(self.learner.params, jnp.asarray(batch[OBS])))
+            td_err = q[np.arange(len(td_target)), batch[ACTIONS].astype(int)] - td_target
+            self.buffer.update_priorities(td_err)
+        self._updates += 1
+        if self._updates % cfg.target_network_update_freq == 0:
+            self.target_params = self.learner.get_weights()
+        return metrics
+
+    @staticmethod
+    def _as_jax(tree):
+        import jax
+        import jax.numpy as jnp
+
+        return jax.tree_util.tree_map(jnp.asarray, tree)
+
+    def step(self) -> dict:
+        import time
+
+        t0 = time.time()
+        result = self.training_step()
+        result["episode_reward_mean"] = (
+            float(np.mean(self._episode_reward_window)) if self._episode_reward_window else float("nan")
+        )
+        result["timesteps_total"] = self._timesteps_total
+        result["time_this_iter_s"] = time.time() - t0
+        return result
+
+    def save_checkpoint(self):
+        from ray_tpu.air.checkpoint import Checkpoint
+
+        return Checkpoint.from_dict({
+            "weights": self.learner.get_weights(),
+            "target": self.target_params,
+            "timesteps": self._timesteps_total,
+        })
+
+    def load_checkpoint(self, checkpoint) -> None:
+        data = checkpoint.to_dict()
+        self.learner.set_weights(data["weights"])
+        self.target_params = data["target"]
+        self._timesteps_total = data.get("timesteps", 0)
+
+    def cleanup(self) -> None:
+        self.env.close()
+
+    def compute_single_action(self, obs, explore: bool = False):
+        import jax.numpy as jnp
+
+        q = np.asarray(self._q_fn(self.learner.params, jnp.asarray(np.asarray(obs, np.float32))[None]))
+        return int(q.argmax())

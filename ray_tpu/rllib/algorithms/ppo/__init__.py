@@ -1,0 +1,1 @@
+from ray_tpu.rllib.algorithms.ppo.ppo import PPO, PPOConfig, ppo_loss  # noqa: F401

@@ -1,0 +1,2 @@
+from ray_tpu.rllib.core.learner import Learner, LearnerGroup  # noqa: F401
+from ray_tpu.rllib.core.rl_module import RLModuleSpec  # noqa: F401

@@ -1,0 +1,182 @@
+"""Learner + LearnerGroup — jitted SGD on rollout batches.
+
+Reference: rllib/core/learner/learner.py (Learner, compute_loss :900) and
+learner_group.py:61 (LearnerGroup of remote learner actors, DDP-wrapped in
+torch). TPU-native redesign: the loss is a pure function; the update is one
+jitted step (grad + optax apply). Data parallelism over learners is an
+allreduce of gradients through the collective plane (XLA psum over ICI when
+the group backend is "tpu"), not parameter-server averaging.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+logger = logging.getLogger(__name__)
+
+
+class Learner:
+    """Single-process learner: params + optimizer + jitted update."""
+
+    def __init__(self, spec, loss_fn: Callable, lr: float = 5e-5, grad_clip: Optional[float] = None, seed: int = 0, optimizer: str = "adam"):
+        import jax
+        import optax
+
+        from ray_tpu.rllib.core import rl_module
+
+        self.spec = spec
+        self.loss_fn = loss_fn
+        self.params = rl_module.init_params(jax.random.PRNGKey(seed), spec)
+        chain = []
+        if grad_clip:
+            chain.append(optax.clip_by_global_norm(grad_clip))
+        chain.append(optax.adam(lr) if optimizer == "adam" else optax.sgd(lr))
+        self.tx = optax.chain(*chain)
+        self.opt_state = self.tx.init(self.params)
+        self._update = None
+
+    def _build_update(self):
+        import jax
+        import optax
+
+        loss_fn = self.loss_fn
+        spec = self.spec
+        tx = self.tx
+
+        def update(params, opt_state, batch, loss_cfg):
+            (loss, metrics), grads = jax.value_and_grad(
+                lambda p: loss_fn(p, batch, spec, loss_cfg), has_aux=True
+            )(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+            metrics = dict(metrics)
+            metrics["total_loss"] = loss
+            metrics["grad_norm"] = optax.global_norm(grads)
+            return params, opt_state, metrics
+
+        self._update = jax.jit(update, static_argnames=())
+
+    def update(self, batch: SampleBatch, loss_cfg: dict) -> dict:
+        import jax.numpy as jnp
+
+        if self._update is None:
+            self._build_update()
+        jb = {k: jnp.asarray(v) for k, v in batch.items()}
+        self.params, self.opt_state, metrics = self._update(self.params, self.opt_state, jb, loss_cfg)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self):
+        import jax
+
+        return jax.tree_util.tree_map(np.asarray, self.params)
+
+    def set_weights(self, weights):
+        import jax.numpy as jnp
+        import jax
+
+        self.params = jax.tree_util.tree_map(jnp.asarray, weights)
+
+
+class _RemoteLearner:
+    """Learner living in its own actor; grads allreduced through the
+    collective plane before the optimizer step (reference: DDP learners)."""
+
+    def __init__(self, spec, loss_fn, lr, grad_clip, seed, rank, world_size, group_name):
+        self.rank = rank
+        self.world_size = world_size
+        self.group_name = group_name
+        self.learner = Learner(spec, loss_fn, lr, grad_clip, seed)
+
+    def init_collective(self, world, backend):
+        from ray_tpu.util import collective
+
+        collective.init_collective_group(
+            world_size=self.world_size, rank=self.rank, backend=backend, group_name=self.group_name
+        )
+        return True
+
+    def update(self, batch: SampleBatch, loss_cfg: dict) -> dict:
+        import jax
+
+        if self.world_size > 1:
+            # Data-parallel grad sync: compute grads, allreduce, then step.
+            from ray_tpu.util import collective
+
+            loss_fn, spec = self.learner.loss_fn, self.learner.spec
+
+            def total_loss(p, jb):
+                return loss_fn(p, jb, spec, loss_cfg)
+
+            import jax.numpy as jnp
+
+            jb = {k: jnp.asarray(v) for k, v in batch.items()}
+            (loss, metrics), grads = jax.value_and_grad(total_loss, has_aux=True)(self.learner.params, jb)
+            flat, treedef = jax.tree_util.tree_flatten(grads)
+            reduced = [collective.allreduce(np.asarray(g) / self.world_size, group_name=self.group_name) for g in flat]
+            grads = jax.tree_util.tree_unflatten(treedef, [jnp.asarray(g) for g in reduced])
+            updates, self.learner.opt_state = self.learner.tx.update(grads, self.learner.opt_state, self.learner.params)
+            self.learner.params = jax.tree_util.tree_map(lambda p, u: p + u, self.learner.params, updates)
+            out = {k: float(v) for k, v in dict(metrics).items()}
+            out["total_loss"] = float(loss)
+            return out
+        return self.learner.update(batch, loss_cfg)
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def set_weights(self, weights):
+        self.learner.set_weights(weights)
+        return True
+
+
+class LearnerGroup:
+    """Local learner or a gang of learner actors (reference:
+    learner_group.py:61). num_learners=0 -> in-process (the common
+    single-host case); >0 -> remote actors with grad allreduce."""
+
+    def __init__(self, spec, loss_fn, *, lr=5e-5, grad_clip=None, seed=0,
+                 num_learners: int = 0, num_tpus_per_learner: float = 0,
+                 collective_backend: str = "cpu", group_name: str = "rllib_learners"):
+        self._local: Optional[Learner] = None
+        self._actors: list = []
+        if num_learners <= 0:
+            self._local = Learner(spec, loss_fn, lr, grad_clip, seed)
+        else:
+            cls = ray_tpu.remote(
+                num_cpus=1, num_tpus=num_tpus_per_learner or None
+            )(_RemoteLearner)
+            self._actors = [
+                cls.remote(spec, loss_fn, lr, grad_clip, seed, rank, num_learners, group_name)
+                for rank in range(num_learners)
+            ]
+            if num_learners > 1:
+                ray_tpu.get([a.init_collective.remote(num_learners, collective_backend) for a in self._actors])
+
+    def update(self, batch: SampleBatch, loss_cfg: dict) -> dict:
+        if self._local is not None:
+            return self._local.update(batch, loss_cfg)
+        n = len(self._actors)
+        shard = max(1, batch.count // n)
+        refs = [
+            a.update.remote(batch.slice(i * shard, batch.count if i == n - 1 else (i + 1) * shard), loss_cfg)
+            for i, a in enumerate(self._actors)
+        ]
+        all_metrics = ray_tpu.get(refs)
+        return {k: float(np.mean([m[k] for m in all_metrics])) for k in all_metrics[0]}
+
+    def get_weights(self):
+        if self._local is not None:
+            return self._local.get_weights()
+        return ray_tpu.get(self._actors[0].get_weights.remote())
+
+    def set_weights(self, weights):
+        if self._local is not None:
+            self._local.set_weights(weights)
+        else:
+            ray_tpu.get([a.set_weights.remote(weights) for a in self._actors])

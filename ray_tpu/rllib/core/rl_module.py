@@ -1,0 +1,135 @@
+"""RLModule — the neural policy/value model, pure-JAX.
+
+Reference: rllib/core/rl_module/rl_module.py (new-stack RLModule with
+forward_exploration / forward_train). TPU-native design: params are a pytree,
+forwards are pure functions jitted once; discrete policies use categorical
+logits, continuous use tanh-squashed diagonal gaussians. The same module
+serves rollout actors (CPU forward) and learners (accelerator update) — only
+the params move.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class RLModuleSpec:
+    obs_dim: int
+    action_dim: int
+    discrete: bool
+    hiddens: Tuple[int, ...] = (64, 64)
+    activation: str = "tanh"
+    free_log_std: bool = True  # continuous: state-independent log_std
+
+    @staticmethod
+    def from_spaces(observation_space, action_space, hiddens=(64, 64)) -> "RLModuleSpec":
+        import gymnasium as gym
+
+        obs_dim = int(np.prod(observation_space.shape))
+        if isinstance(action_space, gym.spaces.Discrete):
+            return RLModuleSpec(obs_dim, int(action_space.n), True, tuple(hiddens))
+        return RLModuleSpec(obs_dim, int(np.prod(action_space.shape)), False, tuple(hiddens))
+
+
+def _act(name: str):
+    import jax.numpy as jnp
+    import jax
+
+    return {"tanh": jnp.tanh, "relu": jax.nn.relu, "swish": jax.nn.swish}[name]
+
+
+def init_params(rng, spec: RLModuleSpec):
+    """Orthogonal-init MLP torso + policy and value heads (the reference's
+    default FCNet, rllib/models/torch/fcnet.py, in functional form)."""
+    import jax
+    import jax.numpy as jnp
+
+    def dense(key, din, dout, scale):
+        w = jax.nn.initializers.orthogonal(scale)(key, (din, dout), jnp.float32)
+        return {"w": w, "b": jnp.zeros((dout,), jnp.float32)}
+
+    keys = jax.random.split(rng, len(spec.hiddens) * 2 + 3)
+    params = {"pi": [], "vf": []}
+    din = spec.obs_dim
+    for i, h in enumerate(spec.hiddens):
+        params["pi"].append(dense(keys[2 * i], din, h, np.sqrt(2)))
+        params["vf"].append(dense(keys[2 * i + 1], din, h, np.sqrt(2)))
+        din = h
+    params["pi_out"] = dense(keys[-3], din, spec.action_dim, 0.01)
+    params["vf_out"] = dense(keys[-2], din, 1, 1.0)
+    if not spec.discrete and spec.free_log_std:
+        params["log_std"] = jnp.zeros((spec.action_dim,), jnp.float32)
+    return params
+
+
+def _mlp(layers, x, act):
+    import jax.numpy as jnp
+
+    for layer in layers:
+        x = act(x @ layer["w"] + layer["b"])
+    return x
+
+
+def forward(params, obs, spec: RLModuleSpec):
+    """Returns (pi_out, value). pi_out: logits (discrete) or mean (cont)."""
+    import jax.numpy as jnp
+
+    act = _act(spec.activation)
+    obs = obs.reshape(obs.shape[0], -1)
+    hpi = _mlp(params["pi"], obs, act)
+    hvf = _mlp(params["vf"], obs, act)
+    pi_out = hpi @ params["pi_out"]["w"] + params["pi_out"]["b"]
+    value = (hvf @ params["vf_out"]["w"] + params["vf_out"]["b"])[:, 0]
+    return pi_out, value
+
+
+def sample_actions(params, obs, rng, spec: RLModuleSpec, explore: bool = True):
+    """Sample actions + logp + value in one jittable forward."""
+    import jax
+    import jax.numpy as jnp
+
+    pi_out, value = forward(params, obs, spec)
+    if spec.discrete:
+        if explore:
+            actions = jax.random.categorical(rng, pi_out, axis=-1)
+        else:
+            actions = jnp.argmax(pi_out, axis=-1)
+        logp = jax.nn.log_softmax(pi_out)[jnp.arange(pi_out.shape[0]), actions]
+        return actions, logp, value
+    log_std = params.get("log_std", jnp.zeros(pi_out.shape[-1]))
+    if explore:
+        noise = jax.random.normal(rng, pi_out.shape)
+        actions = pi_out + noise * jnp.exp(log_std)
+    else:
+        actions = pi_out
+    logp = gaussian_logp(actions, pi_out, log_std)
+    return actions, logp, value
+
+
+def gaussian_logp(x, mean, log_std):
+    import jax.numpy as jnp
+
+    return -0.5 * jnp.sum(
+        ((x - mean) / jnp.exp(log_std)) ** 2 + 2 * log_std + jnp.log(2 * jnp.pi), axis=-1
+    )
+
+
+def action_logp_and_entropy(params, obs, actions, spec: RLModuleSpec):
+    """Recompute logp/entropy/value for stored actions (training pass)."""
+    import jax
+    import jax.numpy as jnp
+
+    pi_out, value = forward(params, obs, spec)
+    if spec.discrete:
+        logits = jax.nn.log_softmax(pi_out)
+        logp = logits[jnp.arange(pi_out.shape[0]), actions.astype(jnp.int32)]
+        entropy = -jnp.sum(jnp.exp(logits) * logits, axis=-1)
+        return logp, entropy, value
+    log_std = params.get("log_std", jnp.zeros(pi_out.shape[-1]))
+    logp = gaussian_logp(actions, pi_out, log_std)
+    entropy = jnp.sum(log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e), axis=-1) * jnp.ones(pi_out.shape[0])
+    return logp, entropy, value

@@ -1,0 +1,1 @@
+from ray_tpu.rllib.env.vector_env import EnvContext, VectorEnv  # noqa: F401

@@ -1,0 +1,104 @@
+"""Vectorized environment layer.
+
+Reference: rllib/env/vector_env.py (VectorEnv / _VectorizedGymEnv) with the
+gymnasium API. Environments step on CPU rollout actors; the learner never
+touches them — the same split as the reference (env stepping on CPU actors,
+SGD on accelerator learners, §3.6 of the survey).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Tuple
+
+import numpy as np
+
+
+class EnvContext(dict):
+    """Env config dict + worker/vector indices (reference: env/env_context.py)."""
+
+    def __init__(self, config: dict, worker_index: int = 0, vector_index: int = 0):
+        super().__init__(config or {})
+        self.worker_index = worker_index
+        self.vector_index = vector_index
+
+
+def _make_env(env_spec, ctx: EnvContext):
+    if callable(env_spec):
+        return env_spec(ctx)
+    if isinstance(env_spec, str):
+        import gymnasium as gym
+
+        return gym.make(env_spec)
+    raise ValueError(f"cannot build env from {env_spec!r}")
+
+
+class VectorEnv:
+    """N sub-envs stepped as a batch, with auto-reset on termination."""
+
+    def __init__(self, env_spec, num_envs: int, config: Optional[dict] = None, worker_index: int = 0, seed: Optional[int] = None):
+        self.envs = [
+            _make_env(env_spec, EnvContext(config or {}, worker_index, i))
+            for i in range(num_envs)
+        ]
+        self.num_envs = num_envs
+        self._eps_ids = np.arange(num_envs, dtype=np.int64)
+        self._next_eps_id = num_envs
+        self._episode_rewards = np.zeros(num_envs, dtype=np.float64)
+        self._episode_lens = np.zeros(num_envs, dtype=np.int64)
+        self.completed_rewards: List[float] = []
+        self.completed_lens: List[int] = []
+        obs = []
+        for i, env in enumerate(self.envs):
+            o, _info = env.reset(seed=None if seed is None else seed + i)
+            obs.append(o)
+        self._obs = np.stack(obs)
+
+    @property
+    def observation_space(self):
+        return self.envs[0].observation_space
+
+    @property
+    def action_space(self):
+        return self.envs[0].action_space
+
+    def current_obs(self) -> np.ndarray:
+        return self._obs
+
+    def eps_ids(self) -> np.ndarray:
+        return self._eps_ids.copy()
+
+    def step(self, actions: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.ndarray, list]:
+        """Step every sub-env; returns (next_obs, rewards, dones, infos).
+        Terminated/truncated envs auto-reset; `dones` marks the boundary."""
+        next_obs, rewards, dones, infos = [], [], [], []
+        for i, env in enumerate(self.envs):
+            o, r, terminated, truncated, info = env.step(np.asarray(actions[i]))
+            done = bool(terminated or truncated)
+            self._episode_rewards[i] += float(r)
+            self._episode_lens[i] += 1
+            if done:
+                self.completed_rewards.append(float(self._episode_rewards[i]))
+                self.completed_lens.append(int(self._episode_lens[i]))
+                self._episode_rewards[i] = 0.0
+                self._episode_lens[i] = 0
+                self._eps_ids[i] = self._next_eps_id
+                self._next_eps_id += 1
+                o, _ = env.reset()
+            next_obs.append(o)
+            rewards.append(float(r))
+            dones.append(done)
+            infos.append(info)
+        self._obs = np.stack(next_obs)
+        return self._obs, np.asarray(rewards, np.float32), np.asarray(dones), infos
+
+    def pop_episode_stats(self) -> Tuple[List[float], List[int]]:
+        r, l = self.completed_rewards, self.completed_lens
+        self.completed_rewards, self.completed_lens = [], []
+        return r, l
+
+    def close(self):
+        for env in self.envs:
+            try:
+                env.close()
+            except Exception:
+                pass

@@ -1,0 +1,1 @@
+from ray_tpu.rllib.evaluation.rollout_worker import RolloutWorker, WorkerSet  # noqa: F401

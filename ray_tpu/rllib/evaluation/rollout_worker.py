@@ -1,0 +1,182 @@
+"""RolloutWorker + WorkerSet — CPU actors stepping vectorized envs.
+
+Reference: rllib/evaluation/rollout_worker.py:166 (RolloutWorker, sample
+:666), worker_set.py:80 (WorkerSet), utils/actor_manager.py:189
+(FaultTolerantActorManager — lost workers are respawned and the round
+continues with the survivors).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.core import rl_module
+from ray_tpu.rllib.env.vector_env import VectorEnv
+from ray_tpu.rllib.policy.sample_batch import (
+    ACTIONS,
+    DONES,
+    EPS_ID,
+    LOGPS,
+    NEXT_OBS,
+    OBS,
+    REWARDS,
+    VF_PREDS,
+    SampleBatch,
+    compute_gae,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class RolloutWorker:
+    """One actor: vector env + policy forward, producing GAE-postprocessed
+    SampleBatches."""
+
+    def __init__(self, env_spec, spec, worker_index: int = 0, num_envs: int = 1,
+                 env_config: Optional[dict] = None, gamma: float = 0.99,
+                 lambda_: float = 0.95, seed: int = 0):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")  # rollouts stay off-chip
+        self.env = VectorEnv(env_spec, num_envs, env_config, worker_index, seed=seed + worker_index * 1000)
+        self.spec = spec
+        self.gamma = gamma
+        self.lambda_ = lambda_
+        self._rng = jax.random.PRNGKey(seed + worker_index)
+        self._params = None
+        self._sample_fn = jax.jit(
+            lambda p, o, r, explore: rl_module.sample_actions(p, o, r, self.spec, explore),
+            static_argnames=("explore",),
+        )
+
+    def set_weights(self, weights) -> bool:
+        import jax.numpy as jnp
+        import jax
+
+        self._params = jax.tree_util.tree_map(jnp.asarray, weights)
+        return True
+
+    def sample(self, num_steps: int, explore: bool = True) -> SampleBatch:
+        """Collect `num_steps` per sub-env; GAE over each env's fragment."""
+        import jax
+
+        assert self._params is not None, "set_weights before sample"
+        n_envs = self.env.num_envs
+        cols: dict = {k: [] for k in (OBS, ACTIONS, REWARDS, DONES, LOGPS, VF_PREDS, EPS_ID)}
+        for _ in range(num_steps):
+            obs = self.env.current_obs().astype(np.float32)
+            self._rng, key = jax.random.split(self._rng)
+            actions, logp, value = self._sample_fn(self._params, obs, key, explore)
+            actions_np = np.asarray(actions)
+            cols[OBS].append(obs)
+            cols[EPS_ID].append(self.env.eps_ids())
+            _, rewards, dones, _ = self.env.step(actions_np)
+            cols[ACTIONS].append(actions_np)
+            cols[REWARDS].append(rewards)
+            cols[DONES].append(dones)
+            cols[LOGPS].append(np.asarray(logp))
+            cols[VF_PREDS].append(np.asarray(value))
+        # Bootstrap value for the final obs of each env.
+        self._rng, key = jax.random.split(self._rng)
+        _, _, last_values = self._sample_fn(
+            self._params, self.env.current_obs().astype(np.float32), key, False
+        )
+        last_values = np.asarray(last_values)
+        # [T, N, ...] -> per-env fragments -> GAE -> concat.
+        frags = []
+        for e in range(n_envs):
+            frag = SampleBatch({k: np.stack([step[e] for step in v]) for k, v in cols.items()})
+            frag = compute_gae(frag, last_values[e], self.gamma, self.lambda_)
+            frags.append(frag)
+        batch = SampleBatch.concat_samples(frags)
+        return batch
+
+    def episode_stats(self) -> dict:
+        rewards, lens = self.env.pop_episode_stats()
+        return {"episode_rewards": rewards, "episode_lens": lens}
+
+    def ping(self) -> bool:
+        return True
+
+    def stop(self):
+        self.env.close()
+        return True
+
+
+class WorkerSet:
+    """Fault-tolerant gang of rollout workers (reference: worker_set.py:80 +
+    FaultTolerantActorManager)."""
+
+    def __init__(self, env_spec, spec, *, num_workers: int, num_envs_per_worker: int = 1,
+                 env_config: Optional[dict] = None, gamma: float = 0.99, lambda_: float = 0.95,
+                 seed: int = 0, num_cpus_per_worker: float = 1):
+        self._make_worker = lambda idx: ray_tpu.remote(num_cpus=num_cpus_per_worker)(RolloutWorker).remote(
+            env_spec, spec, idx, num_envs_per_worker, env_config, gamma, lambda_, seed
+        )
+        self._workers = [self._make_worker(i + 1) for i in range(num_workers)]
+        self._indices = list(range(1, num_workers + 1))
+
+    @property
+    def num_workers(self) -> int:
+        return len(self._workers)
+
+    def sync_weights(self, weights):
+        for i, w in enumerate(list(self._workers)):
+            try:
+                ray_tpu.get(w.set_weights.remote(weights), timeout=120)
+            except Exception:
+                logger.warning("sync_weights: worker %d dead; respawning", i)
+                self._workers[i] = self._make_worker(self._indices[i])
+                ray_tpu.get(self._workers[i].set_weights.remote(weights), timeout=120)
+
+    def sample(self, steps_per_worker: int) -> List[SampleBatch]:
+        """Synchronous parallel sampling with fault tolerance: a worker that
+        dies mid-round is replaced and the round proceeds without it
+        (reference: execution/rollout_ops.py:21 + actor_manager probe)."""
+        refs: dict = {}
+        results: List[SampleBatch] = []
+        dead: list = []
+        for i, w in zip(self._indices, self._workers):
+            try:
+                refs[w.sample.remote(steps_per_worker)] = (i, w)
+            except Exception:
+                logger.warning("rollout worker %d unreachable at submit; respawning", i)
+                dead.append((i, w))
+        for ref, (idx, w) in refs.items():
+            try:
+                results.append(ray_tpu.get(ref, timeout=300))
+            except Exception:
+                logger.warning("rollout worker %d failed; respawning", idx)
+                dead.append((idx, w))
+        for idx, w in dead:
+            pos = self._workers.index(w)
+            self._workers[pos] = self._make_worker(idx)
+        return results
+
+    def episode_stats(self) -> dict:
+        stats = {"episode_rewards": [], "episode_lens": []}
+        for ref in [w.episode_stats.remote() for w in self._workers]:
+            try:
+                s = ray_tpu.get(ref, timeout=60)
+                stats["episode_rewards"] += s["episode_rewards"]
+                stats["episode_lens"] += s["episode_lens"]
+            except Exception:
+                pass
+        return stats
+
+    def stop(self):
+        for w in self._workers:
+            try:
+                w.stop.remote()
+            except Exception:
+                pass
+        for w in self._workers:
+            try:
+                ray_tpu.kill(w)  # release the actor's CPU hold
+            except Exception:
+                pass
+        self._workers = []

@@ -1,0 +1,1 @@
+from ray_tpu.rllib.policy.sample_batch import MultiAgentBatch, SampleBatch, compute_gae  # noqa: F401

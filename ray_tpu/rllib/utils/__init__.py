@@ -1,0 +1,1 @@
+from ray_tpu.rllib.utils.replay_buffers import PrioritizedReplayBuffer, ReplayBuffer  # noqa: F401

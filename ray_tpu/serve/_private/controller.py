@@ -78,7 +78,7 @@ class ServeController:
             return {
                 name: {
                     "num_replicas": len(self._replicas.get(name, [])),
-                    "target": self._target_replicas(info),
+                    "target": self._target_replicas(info, mutate=False),
                     "route_prefix": info.route_prefix,
                     "version": info.config.version,
                 }
@@ -146,7 +146,10 @@ class ServeController:
                 logger.exception("reconcile failed")
             time.sleep(0.5)
 
-    def _target_replicas(self, info: DeploymentInfo) -> int:
+    def _target_replicas(self, info: DeploymentInfo, mutate: bool = True) -> int:
+        """Desired replica count. Only the reconcile loop may pass
+        mutate=True — the delay-mark bookkeeping must not be perturbed by
+        read-only callers like serve.status()."""
         auto = info.config.autoscaling
         if auto is None:
             return info.config.num_replicas
@@ -155,13 +158,14 @@ class ServeController:
             live = {r.replica_id for r in self._replicas.get(info.name, [])}
             now = time.time()
             vals = [m[0] for rid, m in metrics.items() if rid in live and now - m[1] < 5.0]
-        current = len(live) or 1
         total_ongoing = sum(vals) if vals else 0
         # reference: autoscaling_policy.py:9 calculate_desired_num_replicas
         desired = int(-(-total_ongoing // max(auto.target_num_ongoing_requests_per_replica, 1e-9)))
         desired = max(auto.min_replicas, min(auto.max_replicas, max(desired, 0) or auto.min_replicas))
         key = info.name
         prev = len(self._replicas.get(key, []))
+        if not mutate:
+            return desired
         if desired > prev:
             mark = self._scale_marks.get(key + ":up")
             if mark is None:
@@ -188,28 +192,41 @@ class ServeController:
         with self._lock:
             targets = dict(self._deployments)
         changed = False
-        # Remove replicas of deleted deployments or stale versions.
+        # Remove replicas of deleted deployments. Stale-version replicas are
+        # NOT torn down here — the rolling update below retires them only as
+        # new-version replicas pass health checks (reference: versioned
+        # rolling updates in deployment_state.py / version.py).
         with self._lock:
             current = {k: list(v) for k, v in self._replicas.items()}
         for name, reps in current.items():
-            info = targets.get(name)
-            for r in reps:
-                if info is None or r.version != info.config.version:
+            if name not in targets:
+                for r in reps:
                     self._stop_replica(name, r)
                     changed = True
         # Scale each deployment to target (STARTING replicas count toward the
         # target so reconcile doesn't over-start while actors boot).
         for name, info in targets.items():
+            version = info.config.version
             with self._lock:
                 reps = list(self._replicas.get(name, []))
                 starting = self._starting.get(name, 0)
+            new_reps = [r for r in reps if r.version == version]
+            old_reps = [r for r in reps if r.version != version]
             target = self._target_replicas(info)
-            if len(reps) + starting < target:
-                for _ in range(target - len(reps) - starting):
+            if len(new_reps) + starting < target:
+                for _ in range(target - len(new_reps) - starting):
                     self._start_replica(info)
-            elif len(reps) > target:
-                for r in reps[target:]:
+            elif len(new_reps) > target:
+                for r in new_reps[target:]:
                     self._stop_replica(name, r)
+                changed = True
+            # Retire one old replica per healthy new one; drain the rest once
+            # the new version fully covers the target.
+            retire = len(old_reps) if len(new_reps) >= target else min(
+                len(old_reps), max(0, len(new_reps) + len(old_reps) - target)
+            )
+            for r in old_reps[:retire]:
+                self._stop_replica(name, r)
                 changed = True
         if changed:
             with self._epoch_cv:
@@ -272,6 +289,17 @@ class ServeController:
                 reps.remove(rinfo)
             handle = self._replica_handles.pop(rinfo.replica_id, None)
         if handle is not None:
+            try:
+                # Graceful drain: let the user callable release resources
+                # before the actor process is killed.
+                ray_tpu.get(
+                    handle.prepare_for_shutdown.remote(),
+                    timeout=min(5.0, self._deployments[name].config.graceful_shutdown_timeout_s)
+                    if name in self._deployments
+                    else 5.0,
+                )
+            except Exception:
+                pass
             try:
                 ray_tpu.kill(handle)
             except Exception:

@@ -67,7 +67,13 @@ class Replica:
         return True
 
     def prepare_for_shutdown(self):
-        fn = getattr(self._callable, "__del__", None)
+        """Invoke the user callable's shutdown hook, if any (reference:
+        replica graceful_shutdown path)."""
+        fn = getattr(self._callable, "prepare_for_shutdown", None) or getattr(
+            self._callable, "shutdown", None
+        )
+        if fn is not None and callable(fn):
+            fn()
         return True
 
 

@@ -72,7 +72,19 @@ def batch(_fn=None, *, max_batch_size: int = 8, batch_wait_timeout_s: float = 0.
     """Decorator: calls with single items are batched into list calls."""
 
     def wrap(fn):
-        queue = _BatchQueue(fn, max_batch_size, batch_wait_timeout_s)
+        # One queue per bound instance (keyed by id) — a single shared queue
+        # would flush instance B's items through instance A's method.
+        queues: dict = {}
+        queues_lock = threading.Lock()
+
+        def queue_for(instance) -> _BatchQueue:
+            key = id(instance)
+            with queues_lock:
+                q = queues.get(key)
+                if q is None:
+                    q = _BatchQueue(fn, max_batch_size, batch_wait_timeout_s)
+                    queues[key] = q
+                return q
 
         @functools.wraps(fn)
         def wrapper(*args):
@@ -80,9 +92,9 @@ def batch(_fn=None, *, max_batch_size: int = 8, batch_wait_timeout_s: float = 0.
                 instance, item = args
             else:
                 instance, item = None, args[0]
-            return queue.submit(instance, item).result(timeout=60)
+            return queue_for(instance).submit(instance, item).result(timeout=60)
 
-        wrapper._batch_queue = queue
+        wrapper._batch_queues = queues
         return wrapper
 
     if _fn is not None:
