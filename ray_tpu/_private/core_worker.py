@@ -555,7 +555,9 @@ class CoreWorker:
             if deadline is not None and time.monotonic() >= deadline:
                 break
             time.sleep(0.02)
-        return ready, pending
+        # reference semantics (worker.py:2587): at most num_returns in the
+        # ready list; ready-but-surplus refs stay in the remaining list
+        return ready[:num_returns], ready[num_returns:] + pending
 
     def _is_ready(self, ref, fetch_local: bool) -> bool:
         oid_hex = ref.hex()

@@ -139,6 +139,13 @@ class ActorClass:
             method_num_returns=self._method_num_returns(),
         )
 
+    def bind(self, *args, **kwargs):
+        """Build a lazy actor-construction DAG node (reference: ray.dag
+        class_node); method ``.bind`` on the result builds method nodes."""
+        from ray_tpu.dag.dag_node import ClassNode
+
+        return ClassNode(self, args, kwargs)
+
     def _method_num_returns(self) -> dict:
         out = {}
         for name in dir(self._cls):

@@ -1,0 +1,21 @@
+"""ray_tpu.dag — lazy task/actor DAGs (reference: python/ray/dag/)."""
+
+from ray_tpu.dag.dag_node import (  # noqa: F401
+    ClassMethodNode,
+    ClassNode,
+    DAGNode,
+    FunctionNode,
+    InputAttributeNode,
+    InputNode,
+    MultiOutputNode,
+)
+
+__all__ = [
+    "DAGNode",
+    "FunctionNode",
+    "ClassNode",
+    "ClassMethodNode",
+    "InputNode",
+    "InputAttributeNode",
+    "MultiOutputNode",
+]

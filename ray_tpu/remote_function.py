@@ -89,6 +89,13 @@ class RemoteFunction:
             return refs[0]
         return refs
 
+    def bind(self, *args, **kwargs):
+        """Build a lazy DAG node instead of executing (reference:
+        python/ray/dag — f.bind(x))."""
+        from ray_tpu.dag.dag_node import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     @property
     def underlying_function(self):
         return self._func

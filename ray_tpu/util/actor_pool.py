@@ -1,0 +1,89 @@
+"""ActorPool — load-balance tasks over a fixed set of actors.
+
+Analog of the reference's ray.util.ActorPool (python/ray/util/actor_pool.py):
+``map``/``map_unordered`` stream values through the pool; ``submit``/
+``get_next``/``get_next_unordered`` give manual control; idle actors can be
+popped/pushed for elastic pools.
+"""
+
+from __future__ import annotations
+
+import ray_tpu
+
+
+class ActorPool:
+    def __init__(self, actors):
+        self._idle = list(actors)
+        self._future_to_actor = {}
+        self._index_to_future = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending_submits = []
+
+    def submit(self, fn, value):
+        """fn is (actor, value) -> ObjectRef; queues if no actor is free."""
+        if self._idle:
+            actor = self._idle.pop()
+            future = fn(actor, value)
+            self._future_to_actor[future] = (self._next_task_index, actor)
+            self._index_to_future[self._next_task_index] = future
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(self._index_to_future)
+
+    def _return_actor(self, actor):
+        self._idle.append(actor)
+        if self._pending_submits:
+            self.submit(*self._pending_submits.pop(0))
+
+    def get_next(self, timeout: float | None = None):
+        """Next result in submission order."""
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        future = self._index_to_future[self._next_return_index]
+        ready, _ = ray_tpu.wait([future], num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("timed out waiting for result")
+        del self._index_to_future[self._next_return_index]
+        self._next_return_index += 1
+        _, actor = self._future_to_actor.pop(future)
+        self._return_actor(actor)
+        return ray_tpu.get(future)
+
+    def get_next_unordered(self, timeout: float | None = None):
+        """Next result in completion order."""
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        ready, _ = ray_tpu.wait(list(self._future_to_actor), num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("timed out waiting for result")
+        future = ready[0]
+        index, actor = self._future_to_actor.pop(future)
+        del self._index_to_future[index]
+        self._return_actor(actor)
+        return ray_tpu.get(future)
+
+    def map(self, fn, values):
+        """Ordered streaming map; yields results as they become available."""
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn, values):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    def has_free(self) -> bool:
+        return bool(self._idle) and not self._pending_submits
+
+    def pop_idle(self):
+        return self._idle.pop() if self.has_free() else None
+
+    def push(self, actor):
+        self._return_actor(actor)

@@ -1,0 +1,110 @@
+"""ParallelIterator — sharded iterators over actors.
+
+Analog of the reference's ray.util.iter: ``from_items``/``from_range`` shard
+a sequence across actor-held iterators; transforms (``for_each``/``filter``/
+``batch``/``flatten``) are recorded lazily and applied shard-local on the
+actors; ``gather_sync``/``gather_async`` pull results back.
+"""
+
+from __future__ import annotations
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class _ShardActor:
+    def __init__(self, items: list):
+        self._items = list(items)
+
+    def run(self, transforms: list) -> list:
+        it = iter(self._items)
+        for kind, fn in transforms:
+            if kind == "for_each":
+                it = map(fn, it)
+            elif kind == "filter":
+                it = filter(fn, it)
+            elif kind == "batch":
+                it = _batched(it, fn)
+            elif kind == "flatten":
+                it = (x for item in it for x in item)
+        return list(it)
+
+
+def _batched(it, n: int):
+    batch = []
+    for x in it:
+        batch.append(x)
+        if len(batch) == n:
+            yield batch
+            batch = []
+    if batch:
+        yield batch
+
+
+class ParallelIterator:
+    def __init__(self, actors: list, transforms: list | None = None):
+        self._actors = actors
+        self._transforms = list(transforms or [])
+
+    def num_shards(self) -> int:
+        return len(self._actors)
+
+    def _with(self, kind, fn):
+        return ParallelIterator(self._actors, self._transforms + [(kind, fn)])
+
+    def for_each(self, fn):
+        return self._with("for_each", fn)
+
+    def filter(self, fn):
+        return self._with("filter", fn)
+
+    def batch(self, n: int):
+        return self._with("batch", n)
+
+    def flatten(self):
+        return self._with("flatten", None)
+
+    def gather_sync(self):
+        """Round-robin merge across shards, in shard order."""
+        shard_results = ray_tpu.get([a.run.remote(self._transforms) for a in self._actors])
+        out = []
+        idx = [0] * len(shard_results)
+        remaining = sum(len(s) for s in shard_results)
+        while remaining:
+            for i, shard in enumerate(shard_results):
+                if idx[i] < len(shard):
+                    out.append(shard[idx[i]])
+                    idx[i] += 1
+                    remaining -= 1
+        return iter(out)
+
+    def gather_async(self):
+        """Yield per-shard results in completion order."""
+        pending = {a.run.remote(self._transforms): a for a in self._actors}
+        while pending:
+            ready, _ = ray_tpu.wait(list(pending), num_returns=1)
+            ref = ready[0]
+            del pending[ref]
+            yield from ray_tpu.get(ref)
+
+    def take(self, n: int) -> list:
+        out = []
+        for x in self.gather_sync():
+            out.append(x)
+            if len(out) >= n:
+                break
+        return out
+
+    def union(self, other: "ParallelIterator") -> "ParallelIterator":
+        if self._transforms != other._transforms:
+            raise ValueError("union requires identical transform chains")
+        return ParallelIterator(self._actors + other._actors, self._transforms)
+
+
+def from_items(items: list, num_shards: int = 2) -> ParallelIterator:
+    shards = [items[i::num_shards] for i in range(num_shards)]
+    return ParallelIterator([_ShardActor.remote(s) for s in shards])
+
+
+def from_range(n: int, num_shards: int = 2) -> ParallelIterator:
+    return from_items(list(range(n)), num_shards)

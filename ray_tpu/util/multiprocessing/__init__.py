@@ -1,0 +1,11 @@
+"""Drop-in multiprocessing.Pool on the distributed runtime.
+
+Analog of the reference's ray.util.multiprocessing (python/ray/util/
+multiprocessing/pool.py): ``Pool`` schedules chunks of work as tasks, so a
+pool "process" is any worker in the cluster. Supports apply/apply_async,
+map/map_async, imap/imap_unordered, starmap.
+"""
+
+from ray_tpu.util.multiprocessing.pool import AsyncResult, Pool, TimeoutError  # noqa: F401
+
+__all__ = ["Pool", "AsyncResult", "TimeoutError"]

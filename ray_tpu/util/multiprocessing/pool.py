@@ -1,0 +1,134 @@
+"""multiprocessing.Pool API over tasks (reference: python/ray/util/
+multiprocessing/pool.py — Pool class, chunking in _map_async)."""
+
+from __future__ import annotations
+
+import itertools
+
+import ray_tpu
+
+
+class TimeoutError(Exception):
+    pass
+
+
+def _run_chunk(fn, chunk, star: bool, initializer=None, initargs=()):
+    if initializer is not None:
+        initializer(*initargs)  # once per chunk (tasks are stateless)
+    if star:
+        return [fn(*item) for item in chunk]
+    return [fn(item) for item in chunk]
+
+
+_run_chunk_remote = ray_tpu.remote(_run_chunk)
+
+
+class AsyncResult:
+    def __init__(self, chunk_refs: list, single: bool = False):
+        self._chunk_refs = chunk_refs
+        self._single = single
+
+    def ready(self) -> bool:
+        ready, _ = ray_tpu.wait(list(self._chunk_refs), num_returns=len(self._chunk_refs), timeout=0)
+        return len(ready) == len(self._chunk_refs)
+
+    def successful(self) -> bool:
+        if not self.ready():
+            raise ValueError("result is not ready")
+        try:
+            self.get(timeout=0.001)
+            return True
+        except Exception:
+            return False
+
+    def wait(self, timeout: float | None = None):
+        ray_tpu.wait(list(self._chunk_refs), num_returns=len(self._chunk_refs), timeout=timeout)
+
+    def get(self, timeout: float | None = None):
+        ready, not_ready = ray_tpu.wait(
+            list(self._chunk_refs), num_returns=len(self._chunk_refs), timeout=timeout
+        )
+        if not_ready:
+            raise TimeoutError(f"{len(not_ready)} chunks still pending")
+        out = list(itertools.chain.from_iterable(ray_tpu.get(self._chunk_refs)))
+        if self._single:
+            return out[0]
+        return out
+
+
+class Pool:
+    """A task-backed process pool. ``processes`` bounds in-flight chunks."""
+
+    def __init__(self, processes: int | None = None, initializer=None, initargs=(), ray_remote_args: dict | None = None):
+        self._initializer, self._initargs = initializer, initargs
+        self._processes = processes or 8
+        self._remote_args = ray_remote_args or {}
+        self._closed = False
+
+    def _chunks(self, iterable, chunksize: int | None):
+        items = list(iterable)
+        if chunksize is None:
+            chunksize = max(1, len(items) // (self._processes * 4) or 1)
+        return [items[i : i + chunksize] for i in range(0, len(items), chunksize)], len(items)
+
+    def _submit_chunks(self, fn, chunks, star: bool):
+        if self._closed:
+            raise ValueError("Pool is closed")
+        task = _run_chunk_remote.options(**self._remote_args) if self._remote_args else _run_chunk_remote
+        return [
+            task.remote(fn, chunk, star, self._initializer, self._initargs) for chunk in chunks
+        ]
+
+    # -- apply -------------------------------------------------------------
+    def apply(self, fn, args=(), kwds=None):
+        return self.apply_async(fn, args, kwds).get()
+
+    def apply_async(self, fn, args=(), kwds=None):
+        kwds = kwds or {}
+        refs = self._submit_chunks(lambda: fn(*args, **kwds), [[()]], star=True)
+        return AsyncResult(refs, single=True)
+
+    # -- map ---------------------------------------------------------------
+    def map(self, fn, iterable, chunksize: int | None = None):
+        return self.map_async(fn, iterable, chunksize).get()
+
+    def map_async(self, fn, iterable, chunksize: int | None = None):
+        chunks, _ = self._chunks(iterable, chunksize)
+        return AsyncResult(self._submit_chunks(fn, chunks, star=False))
+
+    def starmap(self, fn, iterable, chunksize: int | None = None):
+        return self.starmap_async(fn, iterable, chunksize).get()
+
+    def starmap_async(self, fn, iterable, chunksize: int | None = None):
+        chunks, _ = self._chunks(iterable, chunksize)
+        return AsyncResult(self._submit_chunks(fn, chunks, star=True))
+
+    def imap(self, fn, iterable, chunksize: int | None = None):
+        chunks, _ = self._chunks(iterable, chunksize)
+        refs = self._submit_chunks(fn, chunks, star=False)
+        for ref in refs:
+            yield from ray_tpu.get(ref)
+
+    def imap_unordered(self, fn, iterable, chunksize: int | None = None):
+        chunks, _ = self._chunks(iterable, chunksize)
+        pending = self._submit_chunks(fn, chunks, star=False)
+        while pending:
+            ready, pending = ray_tpu.wait(pending, num_returns=1)
+            yield from ray_tpu.get(ready[0])
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self):
+        self._closed = True
+
+    def terminate(self):
+        self._closed = True
+
+    def join(self):
+        if not self._closed:
+            raise ValueError("Pool is still open")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate()

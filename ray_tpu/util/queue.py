@@ -1,0 +1,115 @@
+"""Distributed FIFO queue backed by an actor.
+
+Analog of the reference's ray.util.queue.Queue (python/ray/util/queue.py):
+a named actor holds the buffer; producers/consumers on any node share the
+handle. Blocking ``put``/``get`` with timeouts are client-side poll loops so
+the queue actor itself never blocks its scheduling queue (the reference uses
+an asyncio actor for the same reason).
+"""
+
+from __future__ import annotations
+
+import time
+
+import ray_tpu
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+@ray_tpu.remote
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        from collections import deque
+
+        self._maxsize = maxsize
+        self._buf = deque()
+
+    def qsize(self) -> int:
+        return len(self._buf)
+
+    def try_put(self, items: list, atomic: bool = False) -> int:
+        """Appends as many items as fit; returns how many were accepted.
+        With atomic=True, accepts all or none (batch puts must not leave a
+        half-written queue)."""
+        if atomic and self._maxsize > 0 and len(self._buf) + len(items) > self._maxsize:
+            return 0
+        accepted = 0
+        for item in items:
+            if self._maxsize > 0 and len(self._buf) >= self._maxsize:
+                break
+            self._buf.append(item)
+            accepted += 1
+        return accepted
+
+    def try_get(self, n: int = 1) -> list:
+        out = []
+        while self._buf and len(out) < n:
+            out.append(self._buf.popleft())
+        return out
+
+    def try_get_exact(self, n: int) -> list | None:
+        """Pops exactly n items, or nothing (None) if fewer are queued."""
+        if len(self._buf) < n:
+            return None
+        return [self._buf.popleft() for _ in range(n)]
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0, actor_options: dict | None = None):
+        self.maxsize = maxsize
+        cls = _QueueActor.options(**actor_options) if actor_options else _QueueActor
+        self.actor = cls.remote(maxsize)
+
+    def qsize(self) -> int:
+        return ray_tpu.get(self.actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def full(self) -> bool:
+        return self.maxsize > 0 and self.qsize() >= self.maxsize
+
+    def put(self, item, block: bool = True, timeout: float | None = None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if ray_tpu.get(self.actor.try_put.remote([item])) == 1:
+                return
+            if not block or (deadline is not None and time.monotonic() >= deadline):
+                raise Full
+            time.sleep(0.01)
+
+    def put_nowait(self, item):
+        self.put(item, block=False)
+
+    def put_nowait_batch(self, items: list):
+        accepted = ray_tpu.get(self.actor.try_put.remote(list(items), True))
+        if accepted != len(items):
+            raise Full(f"batch of {len(items)} does not fit (maxsize={self.maxsize})")
+
+    def get(self, block: bool = True, timeout: float | None = None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            got = ray_tpu.get(self.actor.try_get.remote(1))
+            if got:
+                return got[0]
+            if not block or (deadline is not None and time.monotonic() >= deadline):
+                raise Empty
+            time.sleep(0.01)
+
+    def get_nowait(self):
+        return self.get(block=False)
+
+    def get_nowait_batch(self, num_items: int) -> list:
+        got = ray_tpu.get(self.actor.try_get_exact.remote(num_items))
+        if got is None:
+            raise Empty(f"fewer than {num_items} items available")
+        return got
+
+    def shutdown(self):
+        ray_tpu.kill(self.actor)

@@ -1,0 +1,102 @@
+"""ray_tpu.workflow — durable DAG execution (reference: python/ray/workflow/).
+
+API analog of the reference (api.py:120 run, :232 resume): ``workflow.run``
+executes a ``ray_tpu.dag`` graph with every step result durably logged;
+``workflow.resume`` replays an interrupted workflow from the log, re-running
+only steps whose results were not persisted.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ray_tpu.workflow import workflow_storage as _storage_mod
+from ray_tpu.workflow.workflow_executor import execute_workflow
+from ray_tpu.workflow.workflow_storage import WorkflowStorage, list_workflows
+
+__all__ = [
+    "init",
+    "run",
+    "run_async",
+    "resume",
+    "get_status",
+    "get_output",
+    "list_all",
+    "delete",
+]
+
+_counter_lock = threading.Lock()
+_counter = [0]
+
+
+def init(storage: str | None = None):
+    """Set the durable storage root (default /tmp/ray_tpu/workflows or
+    $RAY_TPU_WORKFLOW_STORAGE)."""
+    _storage_mod.set_storage(storage)
+
+
+def _auto_id() -> str:
+    import time
+
+    with _counter_lock:
+        _counter[0] += 1
+        return f"workflow-{int(time.time())}-{_counter[0]}"
+
+
+def run(dag, *args, workflow_id: str | None = None, **kwargs):
+    """Execute the DAG durably and return its output."""
+    wid = workflow_id or _auto_id()
+    storage = WorkflowStorage(wid)
+    if storage.has_output():
+        # idempotent re-run of a finished workflow returns the stored output
+        return storage.load_output()
+    storage.save_dag((dag, args, kwargs))
+    storage.save_status("RUNNING")
+    try:
+        return execute_workflow(storage, dag, args, kwargs)
+    except BaseException:
+        storage.save_status("FAILED")
+        raise
+
+
+def run_async(dag, *args, workflow_id: str | None = None, **kwargs):
+    """Execute durably in a background thread; returns (workflow_id, thread)."""
+    wid = workflow_id or _auto_id()
+    t = threading.Thread(target=run, args=(dag, *args), kwargs={"workflow_id": wid, **kwargs}, daemon=True)
+    t.start()
+    return wid, t
+
+
+def resume(workflow_id: str):
+    """Resume an interrupted workflow from its durable log."""
+    storage = WorkflowStorage(workflow_id)
+    if storage.has_output():
+        return storage.load_output()
+    if not storage.has_dag():
+        raise ValueError(f"workflow '{workflow_id}' not found in storage")
+    dag, args, kwargs = storage.load_dag()
+    storage.save_status("RUNNING")
+    try:
+        return execute_workflow(storage, dag, args, kwargs)
+    except BaseException:
+        storage.save_status("FAILED")
+        raise
+
+
+def get_status(workflow_id: str) -> str:
+    return WorkflowStorage(workflow_id).load_status()["status"]
+
+
+def get_output(workflow_id: str):
+    storage = WorkflowStorage(workflow_id)
+    if not storage.has_output():
+        raise ValueError(f"workflow '{workflow_id}' has no output (status={get_status(workflow_id)})")
+    return storage.load_output()
+
+
+def list_all():
+    return list_workflows()
+
+
+def delete(workflow_id: str):
+    WorkflowStorage(workflow_id).delete()

@@ -1,0 +1,119 @@
+"""Durable workflow storage.
+
+Analog of the reference's workflow storage (python/ray/workflow/
+workflow_storage.py): every step result is durably persisted (atomic
+tmp+rename) under ``<storage_dir>/<workflow_id>/``, together with the pickled
+DAG and a status file, so an interrupted workflow can be resumed from the log
+by a fresh driver.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import tempfile
+
+_DEFAULT_STORAGE = os.path.join(tempfile.gettempdir(), "ray_tpu", "workflows")
+_storage_dir = None
+
+
+def set_storage(path: str | None):
+    global _storage_dir
+    _storage_dir = path
+
+
+def get_storage_dir() -> str:
+    d = _storage_dir or os.environ.get("RAY_TPU_WORKFLOW_STORAGE") or _DEFAULT_STORAGE
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _atomic_write(path: str, data: bytes):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+class WorkflowStorage:
+    def __init__(self, workflow_id: str, storage_dir: str | None = None):
+        self.workflow_id = workflow_id
+        self.root = os.path.join(storage_dir or get_storage_dir(), workflow_id)
+
+    # -- DAG ---------------------------------------------------------------
+    def save_dag(self, dag):
+        import cloudpickle  # vendored by the env's jax/flax deps
+
+        _atomic_write(os.path.join(self.root, "dag.pkl"), cloudpickle.dumps(dag))
+
+    def load_dag(self):
+        with open(os.path.join(self.root, "dag.pkl"), "rb") as f:
+            return pickle.load(f)
+
+    def has_dag(self) -> bool:
+        return os.path.exists(os.path.join(self.root, "dag.pkl"))
+
+    # -- status ------------------------------------------------------------
+    def save_status(self, status: str, extra: dict | None = None):
+        payload = {"status": status, **(extra or {})}
+        _atomic_write(os.path.join(self.root, "status.json"), json.dumps(payload).encode())
+
+    def load_status(self) -> dict:
+        p = os.path.join(self.root, "status.json")
+        if not os.path.exists(p):
+            return {"status": "NOT_FOUND"}
+        with open(p) as f:
+            return json.load(f)
+
+    # -- step results ------------------------------------------------------
+    def _step_path(self, step_id: str) -> str:
+        return os.path.join(self.root, "steps", f"{step_id}.pkl")
+
+    def save_step_result(self, step_id: str, value):
+        import cloudpickle
+
+        _atomic_write(self._step_path(step_id), cloudpickle.dumps(value))
+
+    def has_step_result(self, step_id: str) -> bool:
+        return os.path.exists(self._step_path(step_id))
+
+    def load_step_result(self, step_id: str):
+        with open(self._step_path(step_id), "rb") as f:
+            return pickle.load(f)
+
+    # -- output ------------------------------------------------------------
+    def save_output(self, value):
+        import cloudpickle
+
+        _atomic_write(os.path.join(self.root, "output.pkl"), cloudpickle.dumps(value))
+
+    def load_output(self):
+        with open(os.path.join(self.root, "output.pkl"), "rb") as f:
+            return pickle.load(f)
+
+    def has_output(self) -> bool:
+        return os.path.exists(os.path.join(self.root, "output.pkl"))
+
+    def delete(self):
+        import shutil
+
+        shutil.rmtree(self.root, ignore_errors=True)
+
+
+def list_workflows(storage_dir: str | None = None):
+    root = storage_dir or get_storage_dir()
+    out = []
+    if not os.path.isdir(root):
+        return out
+    for wid in sorted(os.listdir(root)):
+        st = WorkflowStorage(wid, root).load_status()
+        if st["status"] != "NOT_FOUND":
+            out.append((wid, st["status"]))
+    return out

@@ -1,0 +1,95 @@
+"""ray_tpu.dag tests (analog of the reference's python/ray/dag/tests/)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode, MultiOutputNode
+
+
+def test_function_dag(ray_start_regular):
+    @ray_tpu.remote
+    def a(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def b(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def combine(x, y):
+        return x + y
+
+    with InputNode() as inp:
+        dag = combine.bind(a.bind(inp), b.bind(inp))
+
+    # (5+1) + (5*2) = 16
+    assert ray_tpu.get(dag.execute(5)) == 16
+    # DAG is reusable
+    assert ray_tpu.get(dag.execute(1)) == 4
+
+
+def test_shared_upstream_node_runs_once(ray_start_regular):
+    @ray_tpu.remote
+    def source():
+        import os
+        import time
+
+        return (os.getpid(), time.time_ns())
+
+    @ray_tpu.remote
+    def ident(x):
+        return x
+
+    src = source.bind()
+    dag = MultiOutputNode([ident.bind(src), ident.bind(src)])
+    left, right = ray_tpu.get(dag.execute())
+    assert left == right  # one submission, shared ref
+
+
+def test_dag_input_attributes(ray_start_regular):
+    @ray_tpu.remote
+    def add(x, y):
+        return x + y
+
+    with InputNode() as inp:
+        dag = add.bind(inp["a"], inp["b"])
+
+    assert ray_tpu.get(dag.execute({"a": 3, "b": 4})) == 7
+
+
+def test_class_node_dag(ray_start_regular):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start):
+            self.v = start
+
+        def add(self, x):
+            self.v += x
+            return self.v
+
+    with InputNode() as inp:
+        counter = Counter.bind(10)
+        dag = counter.add.bind(inp)
+
+    assert ray_tpu.get(dag.execute(5)) == 15
+
+
+def test_multi_output(ray_start_regular):
+    @ray_tpu.remote
+    def f(x):
+        return x * 10
+
+    with InputNode() as inp:
+        dag = MultiOutputNode([f.bind(inp), f.bind(2)])
+
+    refs = dag.execute(1)
+    assert ray_tpu.get(refs) == [10, 20]
+
+
+def test_options_on_node(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return "ok"
+
+    dag = f.bind().options(name="dag-step")
+    assert ray_tpu.get(dag.execute()) == "ok"

@@ -1,0 +1,140 @@
+"""Tests for util shims: ActorPool, Queue, multiprocessing.Pool, iter
+(analog of the reference's test_actor_pool.py, test_queue.py,
+util/multiprocessing tests, test_iter.py)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Empty, Full, Queue
+
+
+@ray_tpu.remote
+class _Doubler:
+    def double(self, x):
+        return x * 2
+
+
+def test_actor_pool_map(ray_start_regular):
+    pool = ActorPool([_Doubler.remote() for _ in range(2)])
+    out = list(pool.map(lambda a, v: a.double.remote(v), [1, 2, 3, 4]))
+    assert out == [2, 4, 6, 8]
+
+
+def test_actor_pool_map_unordered(ray_start_regular):
+    pool = ActorPool([_Doubler.remote() for _ in range(2)])
+    out = list(pool.map_unordered(lambda a, v: a.double.remote(v), [1, 2, 3, 4]))
+    assert sorted(out) == [2, 4, 6, 8]
+
+
+def test_actor_pool_submit_get_next(ray_start_regular):
+    pool = ActorPool([_Doubler.remote()])
+    pool.submit(lambda a, v: a.double.remote(v), 1)
+    pool.submit(lambda a, v: a.double.remote(v), 2)  # queued: 1 actor
+    assert pool.has_next()
+    assert pool.get_next() == 2
+    assert pool.get_next() == 4
+    assert not pool.has_next()
+
+
+def test_actor_pool_pop_push(ray_start_regular):
+    actors = [_Doubler.remote() for _ in range(2)]
+    pool = ActorPool(actors)
+    a = pool.pop_idle()
+    assert a is not None
+    pool.push(a)
+    assert pool.has_free()
+
+
+def test_queue_basic(ray_start_regular):
+    q = Queue()
+    q.put(1)
+    q.put(2)
+    assert q.qsize() == 2
+    assert not q.empty()
+    assert q.get() == 1
+    assert q.get() == 2
+    assert q.empty()
+
+
+def test_queue_nowait_and_limits(ray_start_regular):
+    q = Queue(maxsize=2)
+    q.put_nowait(1)
+    q.put_nowait(2)
+    assert q.full()
+    with pytest.raises(Full):
+        q.put(3, block=False)
+    with pytest.raises(Empty):
+        Queue().get_nowait()
+
+
+def test_queue_batch(ray_start_regular):
+    q = Queue()
+    q.put_nowait_batch([1, 2, 3])
+    assert q.get_nowait_batch(2) == [1, 2]
+    with pytest.raises(Empty):
+        q.get_nowait_batch(5)
+    assert q.qsize() == 1  # failed batch get must not consume
+
+
+def test_queue_get_timeout(ray_start_regular):
+    q = Queue()
+    with pytest.raises(Empty):
+        q.get(timeout=0.2)
+
+
+def test_queue_shared_between_tasks(ray_start_regular):
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(queue, n):
+        for i in range(n):
+            queue.put(i)
+        return n
+
+    ray_tpu.get(producer.remote(q, 3))
+    assert [q.get(timeout=5) for _ in range(3)] == [0, 1, 2]
+
+
+def test_mp_pool_map(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=2) as pool:
+        assert pool.map(lambda x: x * x, range(6)) == [0, 1, 4, 9, 16, 25]
+
+
+def test_mp_pool_starmap_apply(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+
+    pool = Pool(processes=2)
+    assert pool.starmap(lambda a, b: a + b, [(1, 2), (3, 4)]) == [3, 7]
+    assert pool.apply(lambda a: a * 10, (4,)) == 40
+    res = pool.apply_async(lambda a: a + 1, (1,))
+    assert res.get(timeout=30) == 2
+    pool.close()
+    pool.join()
+
+
+def test_mp_pool_imap(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+
+    pool = Pool(processes=2)
+    assert list(pool.imap(lambda x: x + 1, range(5), chunksize=2)) == [1, 2, 3, 4, 5]
+    assert sorted(pool.imap_unordered(lambda x: x + 1, range(5), chunksize=2)) == [1, 2, 3, 4, 5]
+
+
+def test_parallel_iterator(ray_start_regular):
+    from ray_tpu.util import iter as par_iter
+
+    it = par_iter.from_range(8, num_shards=2)
+    assert it.num_shards() == 2
+    out = sorted(it.for_each(lambda x: x * 2).gather_sync())
+    assert out == [0, 2, 4, 6, 8, 10, 12, 14]
+
+    out2 = sorted(par_iter.from_items([1, 2, 3, 4], num_shards=2).filter(lambda x: x % 2 == 0).gather_async())
+    assert out2 == [2, 4]
+
+    batches = list(par_iter.from_range(4, num_shards=1).batch(2).gather_sync())
+    assert batches == [[0, 1], [2, 3]]
+
+    assert par_iter.from_range(10, num_shards=2).take(3) == [0, 1, 2]
