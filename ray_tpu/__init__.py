@@ -182,31 +182,29 @@ def nodes() -> list:
 
 
 def cluster_resources() -> dict:
-    out: dict = {}
-    for n in nodes():
-        if n["state"] != "ALIVE":
-            continue
-        for k, v in n["resources_total"].items():
-            out[k] = out.get(k, 0) + v
-    return out
+    from ray_tpu._private.state import GlobalState
+
+    return GlobalState().cluster_resources()
 
 
 def available_resources() -> dict:
-    out: dict = {}
-    for n in nodes():
-        if n["state"] != "ALIVE":
-            continue
-        for k, v in n["resources_available"].items():
-            out[k] = out.get(k, 0) + v
-    return out
+    from ray_tpu._private.state import GlobalState
+
+    return GlobalState().available_resources()
 
 
-def timeline() -> list:
-    """Task-event history (analog of `ray timeline`, chrome-trace entries)."""
-    from ray_tpu._private import worker_context
+def timeline(filename: str | None = None) -> list:
+    """Chrome-trace timeline of executed tasks (reference: ``ray.timeline``,
+    python/ray/_private/state.py:831); open the dump in chrome://tracing."""
+    from ray_tpu._private.state import timeline as _timeline
 
-    cw = worker_context.get_core_worker()
-    return cw.gcs.call("get_task_events")["events"]
+    return _timeline(filename)
+
+
+def get_runtime_context():
+    from ray_tpu.runtime_context import get_runtime_context as _grc
+
+    return _grc()
 
 
 __all__ = [
@@ -219,6 +217,7 @@ __all__ = [
     "exceptions",
     "get",
     "get_actor",
+    "get_runtime_context",
     "init",
     "is_initialized",
     "kill",

@@ -71,6 +71,11 @@ class Config:
     log_to_driver: bool = True
     event_stats: bool = True
     task_events_buffer_size: int = 10_000
+    task_events_enabled: bool = True
+    task_events_flush_interval_s: float = 1.0
+
+    # --- metrics ---
+    metrics_flush_interval_s: float = 5.0
 
     # --- collectives ---
     collective_rendezvous_timeout_s: float = 60.0

@@ -103,7 +103,11 @@ class CoreWorker:
             job_hex = self.gcs.call("next_job_id")["job_id"]
             job_id = JobID.from_hex(job_hex)
         self.job_id = job_id
-        self.current_task_id = TaskID.for_driver(job_id)
+        self._default_task_id = TaskID.for_driver(job_id)
+        # Per-execution-thread task context: threaded actors
+        # (max_concurrency > 1) run execute_task concurrently, so the current
+        # spec/id must not be shared process state.
+        self._exec_tls = threading.local()
         self._task_counter = 0
 
         # Own RPC server (the "core worker service").
@@ -142,6 +146,66 @@ class CoreWorker:
         self._actor_concurrency_pool: ThreadPoolExecutor | None = None
         self._actor_async_loop: asyncio.AbstractEventLoop | None = None
         self._shutdown = False
+
+        # Task-event buffer (reference: task_event_buffer.h:41 — periodically
+        # flushed to the GCS task manager; powers `ray timeline` / state API).
+        self._task_events: list[dict] = []
+        self._task_events_lock = threading.Lock()
+        self._task_events_flusher: threading.Thread | None = None
+
+    @property
+    def current_task_id(self) -> TaskID:
+        return getattr(self._exec_tls, "task_id", None) or self._default_task_id
+
+    @property
+    def current_task_spec(self) -> TaskSpec | None:
+        return getattr(self._exec_tls, "spec", None)
+
+    # ==================================================================
+    # Task events (reference: src/ray/core_worker/task_event_buffer.h:41)
+    # ==================================================================
+
+    def record_task_event(self, spec: TaskSpec, state: str, **extra):
+        """Buffer one task state transition; flushed in batches to GCS."""
+        if not self.cfg.task_events_enabled:
+            return
+        event = {
+            "task_id": spec.task_id,
+            "name": spec.name,
+            "job_id": spec.job_id,
+            "task_type": spec.task_type,
+            "actor_id": spec.actor_id or "",
+            "state": state,
+            "ts": time.time(),
+            "worker_id": self.worker_id,
+            "node_id": self.node_id,
+        }
+        event.update(extra)
+        with self._task_events_lock:
+            self._task_events.append(event)
+            if self._task_events_flusher is None:
+                self._task_events_flusher = threading.Thread(
+                    target=self._task_events_flush_loop,
+                    name="task-events-flush",
+                    daemon=True,
+                )
+                self._task_events_flusher.start()
+
+    def _task_events_flush_loop(self):
+        interval = self.cfg.task_events_flush_interval_s
+        while not self._shutdown:
+            time.sleep(interval)
+            self.flush_task_events()
+
+    def flush_task_events(self):
+        with self._task_events_lock:
+            batch, self._task_events = self._task_events, []
+        if not batch:
+            return
+        try:
+            self.gcs.call("record_task_events", {"events": batch})
+        except Exception:
+            logger.debug("task-event flush failed", exc_info=True)
 
     # ==================================================================
     # Submission-side API
@@ -211,6 +275,7 @@ class CoreWorker:
             runtime_env=opts.get("runtime_env") or {},
         )
         self._register_pending(spec, arg_refs)
+        self.record_task_event(spec, "PENDING_ARGS_AVAIL")
         self._submit_when_ready(spec, arg_refs)
         return [
             ObjectRef(ObjectID.for_return(task_id, i), self.address)
@@ -935,9 +1000,12 @@ class CoreWorker:
 
     def execute_task(self, spec: TaskSpec) -> dict:
         """Run one task; returns the task_done payload."""
-        prev_task_id = self.current_task_id
-        self.current_task_id = TaskID.from_hex(spec.task_id)
+        prev_task_id = getattr(self._exec_tls, "task_id", None)
+        prev_spec = getattr(self._exec_tls, "spec", None)
+        self._exec_tls.task_id = TaskID.from_hex(spec.task_id)
+        self._exec_tls.spec = spec
         start = time.time()
+        self.record_task_event(spec, "RUNNING", start_ts=start)
         try:
             if spec.is_actor_task():
                 fn = getattr(self._actor_instance, spec.method_name)
@@ -967,6 +1035,7 @@ class CoreWorker:
                         )
             results = self._package_results(spec, values)
             payload = {"task_id": spec.task_id, "results": results, "error": None}
+            self.record_task_event(spec, "FINISHED", start_ts=start, end_ts=time.time())
         except BaseException as e:  # noqa: BLE001 — errors ship to the caller
             logger.debug("task %s raised", spec.name, exc_info=True)
             err = TaskError.from_exception(e, task_name=spec.name)
@@ -975,8 +1044,12 @@ class CoreWorker:
                 "results": [],
                 "error": serialization.serialize(err).to_bytes(),
             }
+            self.record_task_event(
+                spec, "FAILED", start_ts=start, end_ts=time.time(), error_type=type(e).__name__
+            )
         finally:
-            self.current_task_id = prev_task_id
+            self._exec_tls.task_id = prev_task_id
+            self._exec_tls.spec = prev_spec
         payload["duration_s"] = time.time() - start
         return payload
 
@@ -993,6 +1066,18 @@ class CoreWorker:
 
     def shutdown(self):
         self._shutdown = True
+        try:
+            self.flush_task_events()
+        except Exception:
+            pass
+        if self.mode == DRIVER:
+            try:
+                self.gcs.call(
+                    "mark_job_finished",
+                    {"job_id": self.job_id.hex(), "state": "SUCCEEDED"},
+                )
+            except Exception:
+                pass
         for c in list(self._actor_clients.values()):
             c.close()
         for c in list(self._owner_client_cache.values()):

@@ -494,6 +494,24 @@ class GcsServer:
         self.jobs[job_id] = {"job_id": job_id, "state": "RUNNING", "start_time": time.time()}
         return {"job_id": job_id}
 
+    async def rpc_list_jobs(self, req):
+        return {"jobs": list(self.jobs.values())}
+
+    async def rpc_mark_job_finished(self, req):
+        job = self.jobs.get(req["job_id"])
+        if job is not None:
+            job["state"] = req.get("state", "SUCCEEDED")
+            job["end_time"] = time.time()
+        return {"ok": job is not None}
+
+    async def rpc_list_placement_groups(self, req):
+        out = []
+        for pg_id, pg in self.placement_groups.items():
+            entry = {k: v for k, v in pg.items() if k != "client"}
+            entry.setdefault("pg_id", pg_id)
+            out.append(entry)
+        return {"placement_groups": out}
+
     # ------------------------------------------------------------------
     # Task events (reference: gcs_task_manager.h; powers `ray timeline`)
     # ------------------------------------------------------------------

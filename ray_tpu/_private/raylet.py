@@ -678,7 +678,7 @@ class Raylet:
             "resources_available": self.resources_available,
             "num_workers": self._num_live_workers(),
             "queued_tasks": len(self.task_queue),
-            "store": self.store.usage(),
+            "store": {**self.store.usage(), "objects": self.store.objects_info()},
             "workers": {
                 wid: {"state": w.state, "pid": w.pid, "actor_id": w.actor_id}
                 for wid, w in self.workers.items()

@@ -1,0 +1,133 @@
+"""GlobalState — cluster introspection backed by the GCS.
+
+TPU-native analog of the reference's ``python/ray/_private/state.py``
+(GlobalStateAccessor-backed): node/actor/placement-group/job tables, the
+task-event log, and the Chrome-trace timeline dump
+(reference: state.py:416 ``chrome_tracing_dump``, API ``timeline()``).
+"""
+
+from __future__ import annotations
+
+import json
+
+from ray_tpu._private import worker_context
+from ray_tpu._private.rpc import RpcClient
+
+
+class GlobalState:
+    """Reads cluster state over GCS RPCs. Usable from a connected driver
+    (default) or standalone against an explicit GCS address."""
+
+    def __init__(self, gcs_address=None):
+        if gcs_address is not None:
+            self._gcs = RpcClient(tuple(gcs_address), label="state-gcs")
+            self._owns_client = True
+        else:
+            self._gcs = worker_context.get_core_worker().gcs
+            self._owns_client = False
+
+    # ---- tables ----
+
+    def nodes(self) -> list[dict]:
+        resp = self._gcs.call("get_nodes")
+        return list(resp["nodes"].values())
+
+    def actors(self) -> list[dict]:
+        return self._gcs.call("list_actors").get("actors", [])
+
+    def placement_groups(self) -> list[dict]:
+        return self._gcs.call("list_placement_groups").get("placement_groups", [])
+
+    def jobs(self) -> list[dict]:
+        return self._gcs.call("list_jobs").get("jobs", [])
+
+    def task_events(self, limit: int = 10_000) -> list[dict]:
+        return self._gcs.call("get_task_events", {"limit": limit}).get("events", [])
+
+    def node_state(self, node: dict) -> dict:
+        """Live per-raylet state (resources, workers, store usage)."""
+        client = RpcClient(tuple(node["address"]), label="state-raylet")
+        try:
+            return client.call("get_state")
+        finally:
+            client.close()
+
+    def cluster_resources(self) -> dict:
+        total: dict[str, float] = {}
+        for node in self.nodes():
+            if node.get("state") != "ALIVE":
+                continue
+            for k, v in (node.get("resources_total") or {}).items():
+                total[k] = total.get(k, 0) + v
+        return total
+
+    def available_resources(self) -> dict:
+        avail: dict[str, float] = {}
+        for node in self.nodes():
+            if node.get("state") != "ALIVE":
+                continue
+            for k, v in (node.get("resources_available") or {}).items():
+                avail[k] = avail.get(k, 0) + v
+        return avail
+
+    # ---- timeline ----
+
+    def chrome_tracing_dump(self, filename: str | None = None) -> list[dict]:
+        """Convert the GCS task-event log into Chrome trace-event JSON
+        (open in chrome://tracing or Perfetto)."""
+        events = self.task_events()
+        trace: list[dict] = []
+        seen_procs: set[tuple] = set()
+        for ev in events:
+            pid = ev.get("node_id", "?")[:8]
+            tid = ev.get("worker_id", "?")[:8]
+            if (pid, tid) not in seen_procs:
+                seen_procs.add((pid, tid))
+                trace.append(
+                    {
+                        "name": "thread_name",
+                        "ph": "M",
+                        "pid": pid,
+                        "tid": tid,
+                        "args": {"name": f"worker:{tid}"},
+                    }
+                )
+            state = ev.get("state")
+            if state in ("FINISHED", "FAILED") and "start_ts" in ev:
+                start = ev["start_ts"]
+                end = ev.get("end_ts", ev["ts"])
+                trace.append(
+                    {
+                        "name": ev.get("name", "task"),
+                        "cat": "task",
+                        "ph": "X",
+                        "ts": start * 1e6,
+                        "dur": max(end - start, 0) * 1e6,
+                        "pid": pid,
+                        "tid": tid,
+                        "cname": "thread_state_runnable"
+                        if state == "FINISHED"
+                        else "terrible",
+                        "args": {
+                            "task_id": ev.get("task_id"),
+                            "state": state,
+                            "job_id": ev.get("job_id"),
+                        },
+                    }
+                )
+        if filename:
+            with open(filename, "w") as f:
+                json.dump(trace, f)
+        return trace
+
+    def close(self):
+        if self._owns_client:
+            self._gcs.close()
+
+
+def timeline(filename: str | None = None) -> list[dict]:
+    """Dump a Chrome-trace timeline of executed tasks (reference:
+    ``ray.timeline``, python/ray/_private/state.py:831)."""
+    cw = worker_context.get_core_worker()
+    cw.flush_task_events()
+    return GlobalState().chrome_tracing_dump(filename)

@@ -124,11 +124,25 @@ class StoreCore:
         return [oid for oid, e in self.objects.items() if e.sealed]
 
     def usage(self) -> dict:
+        """Summary only — shipped in every raylet heartbeat, so it must stay
+        O(1); per-object metadata goes through objects_info()."""
         return {
             "capacity": self.arena.capacity,
             "used": self.arena.used(),
             "num_objects": len(self.objects),
             "num_spilled": sum(1 for e in self.objects.values() if e.spilled_path),
+        }
+
+    def objects_info(self) -> dict:
+        """Per-object metadata for the state API (list_objects)."""
+        return {
+            oid: {
+                "size": e.size,
+                "sealed": e.sealed,
+                "ref_count": e.ref_count,
+                "spilled": bool(e.spilled_path),
+            }
+            for oid, e in self.objects.items()
         }
 
     # ---- eviction / spilling (reference: LocalObjectManager::SpillObjects) ----

@@ -1,0 +1,218 @@
+"""User-defined application metrics.
+
+TPU-native analog of the reference's ``ray.util.metrics``
+(python/ray/util/metrics.py Counter/Gauge/Histogram) plus the per-node
+metrics-agent export path (_private/metrics_agent.py:46 →
+prometheus_exporter.py): metric instruments register in a process-local
+registry; a background thread pushes snapshots into the GCS KV under
+``metrics:<worker_id>``; ``prometheus_text()`` aggregates every process's
+snapshot into the Prometheus text exposition format (served by the dashboard
+at ``/metrics``).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+_REGISTRY_LOCK = threading.Lock()
+_REGISTRY: dict[str, "Metric"] = {}
+_FLUSHER: threading.Thread | None = None
+
+
+def _tag_key(tags: dict | None) -> tuple:
+    return tuple(sorted((tags or {}).items()))
+
+
+class Metric:
+    """Base instrument. Values are kept per tag-set."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, description: str = "", tag_keys: tuple = ()):
+        if not name or not name.replace("_", "a").replace(":", "a").isalnum():
+            raise ValueError(f"invalid metric name {name!r}")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._default_tags: dict = {}
+        self._lock = threading.Lock()
+        self._values: dict[tuple, float] = {}
+        with _REGISTRY_LOCK:
+            _REGISTRY[name] = self
+        _ensure_flusher()
+
+    def set_default_tags(self, tags: dict):
+        self._default_tags = dict(tags)
+        return self
+
+    def _merged(self, tags: dict | None) -> dict:
+        merged = dict(self._default_tags)
+        merged.update(tags or {})
+        extra = set(merged) - set(self.tag_keys)
+        if extra:
+            raise ValueError(f"unknown tag keys {extra} for metric {self.name}")
+        return merged
+
+    def _snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "kind": self.kind,
+                "description": self.description,
+                "values": [[list(k), v] for k, v in self._values.items()],
+            }
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0, tags: dict | None = None):
+        if value < 0:
+            raise ValueError("Counter.inc() requires value >= 0")
+        key = _tag_key(self._merged(tags))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+
+class Gauge(Metric):
+    kind = "gauge"
+
+    def set(self, value: float, tags: dict | None = None):
+        key = _tag_key(self._merged(tags))
+        with self._lock:
+            self._values[key] = float(value)
+
+
+class Histogram(Metric):
+    kind = "histogram"
+
+    def __init__(self, name, description="", boundaries=None, tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = sorted(boundaries or [0.01, 0.1, 1, 10, 100])
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = {}
+        self._totals: dict[tuple, int] = {}
+
+    def observe(self, value: float, tags: dict | None = None):
+        key = _tag_key(self._merged(tags))
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * (len(self.boundaries) + 1))
+            idx = len(self.boundaries)
+            for i, b in enumerate(self.boundaries):
+                if value <= b:
+                    idx = i
+                    break
+            counts[idx] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def _snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "kind": self.kind,
+                "description": self.description,
+                "boundaries": self.boundaries,
+                "hist": [
+                    [list(k), self._counts[k], self._sums.get(k, 0.0), self._totals.get(k, 0)]
+                    for k in self._counts
+                ],
+            }
+
+
+def _ensure_flusher():
+    global _FLUSHER
+    with _REGISTRY_LOCK:
+        if _FLUSHER is not None:
+            return
+        _FLUSHER = threading.Thread(target=_flush_loop, name="metrics-flush", daemon=True)
+        _FLUSHER.start()
+
+
+def _flush_loop():
+    from ray_tpu._private import worker_context
+    from ray_tpu._private.config import get_config
+
+    while True:
+        # Re-read each tick: init_config() may replace the Config after the
+        # first Metric (and thus this thread) was created.
+        time.sleep(get_config().metrics_flush_interval_s)
+        cw = worker_context.get_core_worker_if_initialized()
+        if cw is None:
+            continue
+        try:
+            flush_metrics(cw)
+        except Exception:
+            pass
+
+
+def flush_metrics(core_worker=None):
+    """Push this process's metric snapshots into the GCS KV (used by tests and
+    the background flusher)."""
+    from ray_tpu._private import worker_context
+
+    cw = core_worker or worker_context.get_core_worker()
+    with _REGISTRY_LOCK:
+        snap = {name: m._snapshot() for name, m in _REGISTRY.items()}
+    if not snap:
+        return
+    payload = json.dumps(
+        {"ts": time.time(), "node_id": cw.node_id, "metrics": snap}
+    ).encode()
+    cw.gcs.call(
+        "kv_put",
+        {"key": f"metrics:{cw.worker_id}", "value": payload, "overwrite": True},
+    )
+
+
+def _escape(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"')
+
+
+def prometheus_text(gcs_client, stale_after_s: float = 60.0) -> str:
+    """Aggregate all processes' snapshots from the GCS KV into Prometheus text
+    exposition format."""
+    keys = gcs_client.call("kv_keys", {"prefix": "metrics:"}).get("keys", [])
+    now = time.time()
+    merged: dict[str, dict] = {}
+    for key in keys:
+        resp = gcs_client.call("kv_get", {"key": key})
+        if not resp.get("found"):
+            continue
+        try:
+            snap = json.loads(resp["value"])
+        except Exception:
+            continue
+        if now - snap.get("ts", 0) > stale_after_s:
+            continue
+        wid = key.split(":", 1)[1][:8]
+        for name, m in snap.get("metrics", {}).items():
+            entry = merged.setdefault(name, {"kind": m["kind"], "description": m.get("description", ""), "series": []})
+            base_tags = [("WorkerId", wid), ("NodeId", snap.get("node_id", "")[:8])]
+            if m["kind"] == "histogram":
+                for tags, counts, total_sum, total in m.get("hist", []):
+                    entry["series"].append((base_tags + tags, {"counts": counts, "sum": total_sum, "count": total, "boundaries": m["boundaries"]}))
+            else:
+                for tags, value in m.get("values", []):
+                    entry["series"].append((base_tags + tags, value))
+    lines = []
+    for name, entry in sorted(merged.items()):
+        kind = entry["kind"]
+        lines.append(f"# HELP {name} {entry['description']}")
+        lines.append(f"# TYPE {name} {kind}")
+        for tags, value in entry["series"]:
+            label = ",".join(f'{k}="{_escape(str(v))}"' for k, v in tags)
+            if kind == "histogram":
+                cumulative = 0
+                for i, b in enumerate(value["boundaries"]):
+                    cumulative += value["counts"][i]
+                    le = f'le="{b}"'
+                    lab = ",".join(x for x in (label, le) if x)
+                    lines.append(f"{name}_bucket{{{lab}}} {cumulative}")
+                lab = ",".join(x for x in (label, 'le="+Inf"') if x)
+                lines.append(f"{name}_bucket{{{lab}}} {value['count']}")
+                lines.append(f"{name}_sum{{{label}}} {value['sum']}")
+                lines.append(f"{name}_count{{{label}}} {value['count']}")
+            else:
+                lines.append(f"{name}{{{label}}} {value}")
+    return "\n".join(lines) + "\n"

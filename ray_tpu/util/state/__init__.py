@@ -1,0 +1,25 @@
+"""Cluster-state introspection (reference: python/ray/util/state)."""
+
+from ray_tpu.util.state.api import (  # noqa: F401
+    StateApiOptions,
+    list_actors,
+    list_jobs,
+    list_nodes,
+    list_objects,
+    list_placement_groups,
+    list_tasks,
+    list_workers,
+    summarize_tasks,
+)
+
+__all__ = [
+    "StateApiOptions",
+    "list_actors",
+    "list_jobs",
+    "list_nodes",
+    "list_objects",
+    "list_placement_groups",
+    "list_tasks",
+    "list_workers",
+    "summarize_tasks",
+]

@@ -1,0 +1,193 @@
+"""Public cluster-state API.
+
+TPU-native analog of the reference's ``ray.util.state``
+(python/ray/util/state/api.py, aggregated by dashboard/state_aggregator.py):
+typed listings of nodes, actors, tasks, objects, workers, placement groups and
+jobs, plus task summaries. All reads go to the GCS (and live raylets for
+object/worker state) — there is no separate aggregator daemon.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from ray_tpu._private.state import GlobalState
+
+
+@dataclass
+class StateApiOptions:
+    limit: int = 10_000
+    filters: list[tuple[str, str, Any]] = field(default_factory=list)
+
+
+def _apply_filters(rows: list[dict], filters) -> list[dict]:
+    if not filters:
+        return rows
+    out = []
+    for row in rows:
+        ok = True
+        for key, op, value in filters:
+            have = row.get(key)
+            if op == "=":
+                ok = have == value
+            elif op == "!=":
+                ok = have != value
+            else:
+                raise ValueError(f"unsupported filter op {op!r}")
+            if not ok:
+                break
+        if ok:
+            out.append(row)
+    return out
+
+
+def _state(address=None) -> GlobalState:
+    return GlobalState(gcs_address=address)
+
+
+def list_nodes(address=None, filters=None, limit: int = 10_000) -> list[dict]:
+    state = _state(address)
+    try:
+        rows = [
+            {
+                "node_id": n.get("node_id"),
+                "state": n.get("state"),
+                "address": n.get("address"),
+                "resources_total": n.get("resources_total"),
+                "resources_available": n.get("resources_available"),
+                "labels": n.get("labels", {}),
+            }
+            for n in state.nodes()
+        ]
+        return _apply_filters(rows, filters)[:limit]
+    finally:
+        state.close()
+
+
+def list_actors(address=None, filters=None, limit: int = 10_000) -> list[dict]:
+    state = _state(address)
+    try:
+        return _apply_filters(state.actors(), filters)[:limit]
+    finally:
+        state.close()
+
+
+def list_placement_groups(address=None, filters=None, limit: int = 10_000) -> list[dict]:
+    state = _state(address)
+    try:
+        return _apply_filters(state.placement_groups(), filters)[:limit]
+    finally:
+        state.close()
+
+
+def list_jobs(address=None, filters=None, limit: int = 10_000) -> list[dict]:
+    state = _state(address)
+    try:
+        return _apply_filters(state.jobs(), filters)[:limit]
+    finally:
+        state.close()
+
+
+def list_tasks(address=None, filters=None, limit: int = 10_000) -> list[dict]:
+    """One row per task, reduced from the task-event log (latest state wins)."""
+    state = _state(address)
+    try:
+        by_task: dict[str, dict] = {}
+        # Events from different processes arrive at the GCS out of order
+        # (driver and worker flush on independent ticks) — reduce by event
+        # timestamp, not arrival order.
+        rank = {"PENDING_ARGS_AVAIL": 0, "RUNNING": 1, "FINISHED": 2, "FAILED": 2}
+        events = sorted(
+            state.task_events(limit=limit * 4),
+            key=lambda e: (e.get("ts", 0), rank.get(e.get("state"), 0)),
+        )
+        for ev in events:
+            tid = ev.get("task_id")
+            row = by_task.setdefault(
+                tid,
+                {
+                    "task_id": tid,
+                    "name": ev.get("name"),
+                    "job_id": ev.get("job_id"),
+                    "actor_id": ev.get("actor_id") or None,
+                    "state": ev.get("state"),
+                    "node_id": ev.get("node_id"),
+                    "worker_id": ev.get("worker_id"),
+                },
+            )
+            row["state"] = ev.get("state")
+            row["node_id"] = ev.get("node_id")
+            row["worker_id"] = ev.get("worker_id")
+            if "start_ts" in ev:
+                row["start_time"] = ev["start_ts"]
+            if "end_ts" in ev:
+                row["end_time"] = ev["end_ts"]
+            if "error_type" in ev:
+                row["error_type"] = ev["error_type"]
+        return _apply_filters(list(by_task.values()), filters)[:limit]
+    finally:
+        state.close()
+
+
+def list_workers(address=None, filters=None, limit: int = 10_000) -> list[dict]:
+    state = _state(address)
+    try:
+        rows = []
+        for node in state.nodes():
+            if node.get("state") != "ALIVE":
+                continue
+            try:
+                live = state.node_state(node)
+            except Exception:
+                continue
+            for wid, w in (live.get("workers") or {}).items():
+                rows.append(
+                    {
+                        "worker_id": wid,
+                        "node_id": node.get("node_id"),
+                        "state": w.get("state"),
+                        "pid": w.get("pid"),
+                        "actor_id": w.get("actor_id"),
+                    }
+                )
+        return _apply_filters(rows, filters)[:limit]
+    finally:
+        state.close()
+
+
+def list_objects(address=None, filters=None, limit: int = 10_000) -> list[dict]:
+    """Cluster-wide plasma object listing (per-node store contents)."""
+    state = _state(address)
+    try:
+        rows = []
+        for node in state.nodes():
+            if node.get("state") != "ALIVE":
+                continue
+            try:
+                live = state.node_state(node)
+            except Exception:
+                continue
+            store = live.get("store") or {}
+            for oid, meta in (store.get("objects") or {}).items():
+                entry = {"object_id": oid, "node_id": node.get("node_id")}
+                if isinstance(meta, dict):
+                    entry.update(meta)
+                rows.append(entry)
+        return _apply_filters(rows, filters)[:limit]
+    finally:
+        state.close()
+
+
+def summarize_tasks(address=None) -> dict:
+    """Counts of tasks per (name, state) — reference's task summary view."""
+    rows = list_tasks(address=address)
+    summary: dict[str, dict] = {}
+    for row in rows:
+        entry = summary.setdefault(
+            row.get("name") or "?", {"total": 0, "states": {}}
+        )
+        entry["total"] += 1
+        st = row.get("state") or "?"
+        entry["states"][st] = entry["states"].get(st, 0) + 1
+    return summary

@@ -1,0 +1,226 @@
+"""State API, runtime context, timeline, metrics.
+
+Models the reference's test_state_api*.py / test_metrics*.py / runtime-context
+coverage (python/ray/tests/)."""
+
+import time
+
+import pytest
+
+
+def test_runtime_context_driver(ray_start_regular):
+    import ray_tpu
+
+    ctx = ray_tpu.get_runtime_context()
+    assert len(ctx.get_job_id()) == 8
+    assert ctx.get_node_id()
+    assert ctx.get_task_id() is None
+    assert ctx.get_actor_id() is None
+    assert ctx.worker_mode == "driver"
+    assert ctx.to_dict()["job_id"] == ctx.get_job_id()
+
+
+def test_runtime_context_in_task(ray_start_regular):
+    import ray_tpu
+
+    @ray_tpu.remote
+    def whoami():
+        ctx = ray_tpu.get_runtime_context()
+        return ctx.get_task_id(), ctx.get_task_name(), ctx.get_assigned_resources()
+
+    task_id, name, resources = ray_tpu.get(whoami.remote())
+    assert task_id is not None
+    assert name == "whoami"
+    assert resources.get("CPU") == 1
+
+
+def test_runtime_context_in_actor(ray_start_regular):
+    import ray_tpu
+
+    @ray_tpu.remote
+    class A:
+        def ids(self):
+            ctx = ray_tpu.get_runtime_context()
+            return ctx.get_actor_id(), ctx.worker_mode
+
+    a = A.remote()
+    actor_id, mode = ray_tpu.get(a.ids.remote())
+    assert actor_id is not None
+    assert mode == "worker"
+
+
+def test_list_nodes_and_workers(ray_start_regular):
+    import ray_tpu
+    from ray_tpu.util import state
+
+    nodes = state.list_nodes()
+    assert len(nodes) == 1 and nodes[0]["state"] == "ALIVE"
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ray_tpu.get(f.remote())
+    workers = state.list_workers()
+    assert len(workers) >= 1
+
+
+def test_list_tasks_and_summary(ray_start_regular):
+    import ray_tpu
+    from ray_tpu._private import worker_context
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def tracked_task():
+        return 1
+
+    ray_tpu.get([tracked_task.remote() for _ in range(3)])
+    worker_context.get_core_worker().flush_task_events()
+    deadline = time.time() + 10
+    rows = []
+    while time.time() < deadline:
+        rows = [t for t in state.list_tasks() if t["name"] == "tracked_task"]
+        if len(rows) == 3 and all(r["state"] == "FINISHED" for r in rows):
+            break
+        time.sleep(0.2)
+    assert len(rows) == 3
+    assert all(r["state"] == "FINISHED" for r in rows)
+
+    summary = state.summarize_tasks()
+    assert summary["tracked_task"]["total"] == 3
+    assert summary["tracked_task"]["states"]["FINISHED"] == 3
+
+
+def test_list_tasks_failed_state(ray_start_regular):
+    import ray_tpu
+    from ray_tpu._private import worker_context
+    from ray_tpu.util import state
+
+    @ray_tpu.remote(max_retries=0)
+    def boom():
+        raise ValueError("x")
+
+    with pytest.raises(Exception):
+        ray_tpu.get(boom.remote())
+    worker_context.get_core_worker().flush_task_events()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        rows = [t for t in state.list_tasks() if t["name"] == "boom"]
+        if rows and rows[0]["state"] == "FAILED":
+            break
+        time.sleep(0.2)
+    assert rows and rows[0]["state"] == "FAILED"
+    assert rows[0].get("error_type") == "ValueError"
+
+
+def test_list_actors_and_pgs(ray_start_regular):
+    import ray_tpu
+    from ray_tpu.util import state
+    from ray_tpu.util.placement_group import placement_group
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.remote()
+    ray_tpu.get(a.ping.remote())
+    actors = state.list_actors()
+    assert len(actors) >= 1
+
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=10)
+    pgs = state.list_placement_groups()
+    assert len(pgs) == 1 and pgs[0]["state"] == "CREATED"
+
+
+def test_timeline_chrome_trace(ray_start_regular, tmp_path):
+    import json
+
+    import ray_tpu
+
+    @ray_tpu.remote
+    def traced():
+        time.sleep(0.01)
+        return 1
+
+    ray_tpu.get([traced.remote() for _ in range(2)])
+    out = tmp_path / "trace.json"
+    deadline = time.time() + 10
+    complete = []
+    while time.time() < deadline:
+        events = ray_tpu.timeline(str(out))
+        complete = [e for e in events if e.get("ph") == "X" and e["name"] == "traced"]
+        if len(complete) == 2:
+            break
+        time.sleep(0.2)
+    assert len(complete) == 2
+    assert all(e["dur"] > 0 for e in complete)
+    on_disk = json.loads(out.read_text())
+    assert len(on_disk) == len(events)
+
+
+def test_metrics_counter_gauge_histogram(ray_start_regular):
+    from ray_tpu._private import worker_context
+    from ray_tpu.util import metrics
+
+    c = metrics.Counter("test_requests_total", "reqs", tag_keys=("route",))
+    c.inc(tags={"route": "/a"})
+    c.inc(2.0, tags={"route": "/a"})
+    g = metrics.Gauge("test_inflight", "inflight")
+    g.set(5)
+    h = metrics.Histogram("test_latency_s", "lat", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+
+    cw = worker_context.get_core_worker()
+    metrics.flush_metrics(cw)
+    text = metrics.prometheus_text(cw.gcs)
+    assert 'test_requests_total{' in text
+    assert 'route="/a"' in text and "3.0" in text
+    assert "test_inflight{" in text
+    assert "test_latency_s_bucket" in text
+    assert "test_latency_s_count" in text
+
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    with pytest.raises(ValueError):
+        c.inc(tags={"bogus": "x"})
+
+
+def test_metrics_from_actor(ray_start_regular):
+    import ray_tpu
+    from ray_tpu._private import worker_context
+    from ray_tpu.util import metrics
+
+    @ray_tpu.remote
+    class M:
+        def __init__(self):
+            from ray_tpu.util.metrics import Counter
+
+            self.c = Counter("actor_side_counter", "x")
+
+        def bump(self):
+            from ray_tpu.util import metrics as m
+            from ray_tpu._private import worker_context as wc
+
+            self.c.inc()
+            m.flush_metrics(wc.get_core_worker())
+            return True
+
+    a = M.remote()
+    assert ray_tpu.get(a.bump.remote())
+    cw = worker_context.get_core_worker()
+    text = metrics.prometheus_text(cw.gcs)
+    assert "actor_side_counter" in text
+
+
+def test_global_state_resources(ray_start_regular):
+    from ray_tpu._private.state import GlobalState
+
+    state = GlobalState()
+    assert state.cluster_resources().get("CPU") == 4
+    assert len(state.nodes()) == 1
+    live = state.node_state(state.nodes()[0])
+    assert "store" in live and "workers" in live
