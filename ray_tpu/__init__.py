@@ -24,6 +24,29 @@ __version__ = "0.1.0"
 
 _init_lock = threading.Lock()
 _global_node = None
+# Set by the chained excepthook when an exception escapes the driver script;
+# shutdown() (usually via atexit) then records the job as FAILED.
+_uncaught_exception = False
+_hooks_installed = False
+
+
+def _install_driver_hooks():
+    global _hooks_installed
+    if _hooks_installed:
+        return
+    _hooks_installed = True
+    import atexit
+    import sys
+
+    prev_hook = sys.excepthook
+
+    def _excepthook(tp, value, tb):
+        global _uncaught_exception
+        _uncaught_exception = True
+        prev_hook(tp, value, tb)
+
+    sys.excepthook = _excepthook
+    atexit.register(shutdown)
 
 
 def init(
@@ -94,7 +117,8 @@ def init(
             namespace=namespace,
         )
         worker_context.set_core_worker(cw)
-        return cw
+    _install_driver_hooks()
+    return cw
 
 
 def _parse_addr(address: str) -> tuple:
@@ -109,7 +133,7 @@ def shutdown():
     with _init_lock:
         cw = worker_context.get_core_worker_if_initialized()
         if cw is not None:
-            cw.shutdown()
+            cw.shutdown(job_state="FAILED" if _uncaught_exception else "SUCCEEDED")
             worker_context.set_core_worker(None)
         if _global_node is not None:
             _global_node.stop()

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import asyncio
 import collections
+import contextvars
 import hashlib
 import logging
 import threading
@@ -54,6 +55,11 @@ from ray_tpu.exceptions import (
 )
 
 logger = logging.getLogger(__name__)
+
+# Current executing task: (TaskID, TaskSpec). A contextvar (not a
+# threading.local) so async actor methods — which hop to the shared
+# actor-async loop thread — keep their task attribution per asyncio Task.
+_exec_ctx: contextvars.ContextVar = contextvars.ContextVar("ray_tpu_exec_ctx", default=None)
 
 DRIVER = "driver"
 WORKER = "worker"
@@ -107,7 +113,9 @@ class CoreWorker:
         # Per-execution-thread task context: threaded actors
         # (max_concurrency > 1) run execute_task concurrently, so the current
         # spec/id must not be shared process state.
-        self._exec_tls = threading.local()
+        # Process-wide fallback for threads the user spawned inside a task
+        # (contextvars don't cross thread creation); last-started task wins.
+        self._exec_fallback: tuple | None = None
         self._task_counter = 0
 
         # Own RPC server (the "core worker service").
@@ -155,11 +163,19 @@ class CoreWorker:
 
     @property
     def current_task_id(self) -> TaskID:
-        return getattr(self._exec_tls, "task_id", None) or self._default_task_id
+        ctx = _exec_ctx.get()
+        if ctx is not None:
+            return ctx[0]
+        fb = self._exec_fallback
+        return fb[0] if fb is not None else self._default_task_id
 
     @property
     def current_task_spec(self) -> TaskSpec | None:
-        return getattr(self._exec_tls, "spec", None)
+        ctx = _exec_ctx.get()
+        if ctx is not None:
+            return ctx[1]
+        fb = self._exec_fallback
+        return fb[1] if fb is not None else None
 
     # ==================================================================
     # Task events (reference: src/ray/core_worker/task_event_buffer.h:41)
@@ -1000,10 +1016,10 @@ class CoreWorker:
 
     def execute_task(self, spec: TaskSpec) -> dict:
         """Run one task; returns the task_done payload."""
-        prev_task_id = getattr(self._exec_tls, "task_id", None)
-        prev_spec = getattr(self._exec_tls, "spec", None)
-        self._exec_tls.task_id = TaskID.from_hex(spec.task_id)
-        self._exec_tls.spec = spec
+        prev_fallback = self._exec_fallback
+        ctx = (TaskID.from_hex(spec.task_id), spec)
+        token = _exec_ctx.set(ctx)
+        self._exec_fallback = ctx
         start = time.time()
         self.record_task_event(spec, "RUNNING", start_ts=start)
         try:
@@ -1048,8 +1064,8 @@ class CoreWorker:
                 spec, "FAILED", start_ts=start, end_ts=time.time(), error_type=type(e).__name__
             )
         finally:
-            self._exec_tls.task_id = prev_task_id
-            self._exec_tls.spec = prev_spec
+            _exec_ctx.reset(token)
+            self._exec_fallback = prev_fallback
         payload["duration_s"] = time.time() - start
         return payload
 
@@ -1060,21 +1076,33 @@ class CoreWorker:
             t = threading.Thread(target=loop.run_forever, name="actor-async", daemon=True)
             t.start()
             self._actor_async_loop = loop
-        return asyncio.run_coroutine_threadsafe(coro, self._actor_async_loop).result()
+        # Propagate the task context onto the loop thread: each asyncio Task
+        # runs in its own contextvars Context, so setting inside the wrapper
+        # is task-local even when coroutines interleave on the shared loop.
+        ctx = _exec_ctx.get()
+
+        async def _with_ctx():
+            if ctx is not None:
+                _exec_ctx.set(ctx)
+            return await coro
+
+        return asyncio.run_coroutine_threadsafe(_with_ctx(), self._actor_async_loop).result()
 
     # ---- shutdown ----
 
-    def shutdown(self):
+    def shutdown(self, job_state: str | None = None):
         self._shutdown = True
         try:
             self.flush_task_events()
         except Exception:
             pass
         if self.mode == DRIVER:
+            if job_state is None:
+                job_state = "SUCCEEDED"
             try:
                 self.gcs.call(
                     "mark_job_finished",
-                    {"job_id": self.job_id.hex(), "state": "SUCCEEDED"},
+                    {"job_id": self.job_id.hex(), "state": job_state},
                 )
             except Exception:
                 pass

@@ -19,7 +19,10 @@ class DeploymentConfig:
     health_check_timeout_s: float = 30.0
     graceful_shutdown_timeout_s: float = 20.0
     autoscaling: Optional["AutoscalingConfig"] = None
-    version: str = "1"
+    # None = autogenerate from code + init args + user_config at deploy time
+    # (reference: unversioned deployments get a new version on every deploy,
+    # serve/_private/version.py DeploymentVersion).
+    version: Optional[str] = None
 
 
 @dataclasses.dataclass

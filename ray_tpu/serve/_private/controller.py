@@ -43,6 +43,15 @@ class ServeController:
         # autoscaling bookkeeping
         self._metrics: dict[str, dict] = {}
         self._scale_marks: dict[str, float] = {}
+        # name -> ts when the oldest currently-STARTING replica was created;
+        # cleared each time a replica becomes healthy. Drives forced
+        # retirement of old-version replicas when a rolling update can't make
+        # progress because the old version holds all the resources.
+        self._starting_since: dict[str, float] = {}
+        # name -> forced retires not yet matched by a new healthy replica.
+        # Caps the stall-breaker at maxUnavailable=1: a rollout whose new
+        # version never becomes healthy sacrifices at most one old replica.
+        self._forced_debt: dict[str, int] = {}
         self._lock = threading.RLock()
         self._epoch = 0
         self._epoch_cv = threading.Condition(self._lock)
@@ -78,6 +87,11 @@ class ServeController:
             return {
                 name: {
                     "num_replicas": len(self._replicas.get(name, [])),
+                    "num_replicas_current_version": sum(
+                        1
+                        for r in self._replicas.get(name, [])
+                        if r.version == info.config.version
+                    ),
                     "target": self._target_replicas(info, mutate=False),
                     "route_prefix": info.route_prefix,
                     "version": info.config.version,
@@ -225,6 +239,23 @@ class ServeController:
             retire = len(old_reps) if len(new_reps) >= target else min(
                 len(old_reps), max(0, len(new_reps) + len(old_reps) - target)
             )
+            if retire == 0 and old_reps and starting > 0:
+                # Rolling update stalled: new-version replicas are STARTING
+                # but none can come up (typically the old version holds all
+                # cluster resources). Force-retire ONE old replica to free
+                # resources — and only one outstanding at a time
+                # (maxUnavailable=1), so a rollout whose new version keeps
+                # crashing cannot drain the whole deployment.
+                with self._lock:
+                    since = self._starting_since.get(name)
+                    if (
+                        since is not None
+                        and time.time() - since > 3.0
+                        and self._forced_debt.get(name, 0) == 0
+                    ):
+                        retire = 1
+                        self._forced_debt[name] = 1
+                        self._starting_since[name] = time.time()
             for r in old_reps[:retire]:
                 self._stop_replica(name, r)
                 changed = True
@@ -257,6 +288,7 @@ class ServeController:
         )
         with self._lock:
             self._starting[info.name] = self._starting.get(info.name, 0) + 1
+            self._starting_since.setdefault(info.name, time.time())
             self._replica_handles[replica_id] = handle
 
         def _wait_ready():
@@ -267,6 +299,9 @@ class ServeController:
                 logger.exception("replica %s of %s failed to start", replica_id, info.name)
             with self._lock:
                 self._starting[info.name] = max(0, self._starting.get(info.name, 0) - 1)
+                self._starting_since.pop(info.name, None)
+                if ok:
+                    self._forced_debt.pop(info.name, None)
                 if ok and info.name in self._deployments:
                     self._replicas.setdefault(info.name, []).append(rinfo)
                 else:

@@ -14,6 +14,9 @@ Usage:
 
 from __future__ import annotations
 
+import dataclasses
+import hashlib
+import json
 import pickle
 import time
 
@@ -90,7 +93,7 @@ def deployment(
     ray_actor_options: Optional[dict] = None,
     autoscaling_config=None,
     route_prefix: Optional[str] = None,
-    version: str = "1",
+    version: Optional[str] = None,
 ):
     """``@serve.deployment`` decorator (reference: api.py:241)."""
 
@@ -148,18 +151,48 @@ def run(app: Application, *, name: str = "default", route_prefix: Optional[str] 
         start()
     dep = app.deployment
     prefix = dep.route_prefix if route_prefix == "__from_deployment__" else route_prefix
+    import_spec = cloudpickle.dumps((dep._cls_or_fn, app.init_args, app.init_kwargs))
+    cfg = dataclasses.replace(dep.config)
+    if cfg.version is None:
+        # Unversioned deployment: every change to code, init args, or
+        # user_config is a new version → rolling update (reference:
+        # serve/_private/version.py DeploymentVersion). JSON with sorted
+        # keys gives an order-insensitive digest; cloudpickle covers
+        # non-JSON user_configs (lambdas etc.).
+        try:
+            uc_bytes = json.dumps(cfg.user_config, sort_keys=True).encode()
+        except (TypeError, ValueError):
+            uc_bytes = cloudpickle.dumps(cfg.user_config)
+        cfg.version = hashlib.md5(import_spec + uc_bytes).hexdigest()[:10]
     info = DeploymentInfo(
         name=dep.name,
         app_name=name,
-        import_spec=cloudpickle.dumps((dep._cls_or_fn, app.init_args, app.init_kwargs)),
-        config=dep.config,
+        import_spec=import_spec,
+        config=cfg,
         route_prefix=prefix,
     )
     controller = ray_tpu.get_actor(CONTROLLER_NAME)
     ray_tpu.get(controller.deploy.remote([pickle.dumps(info)]))
     router = Router.shared(controller)
-    if _blocking and not router.wait_for_deployment(dep.name, timeout_s=60):
-        raise TimeoutError(f"deployment {dep.name} did not become ready")
+    if _blocking:
+        if not router.wait_for_deployment(dep.name, timeout_s=60):
+            raise TimeoutError(f"deployment {dep.name} did not become ready")
+        # Block until the full target replica count for this version is
+        # RUNNING (reference: serve.run waits for the application to reach
+        # RUNNING state, i.e. every target replica healthy — api.py:413).
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            st = ray_tpu.get(controller.get_deployments.remote()).get(dep.name)
+            if (
+                st is not None
+                and st["version"] == cfg.version
+                and st["num_replicas_current_version"] >= st["target"]
+                and st["num_replicas"] == st["num_replicas_current_version"]
+            ):
+                break
+            time.sleep(0.05)
+        else:
+            raise TimeoutError(f"deployment {dep.name} did not reach target replica count")
     return DeploymentHandle(dep.name, router)
 
 

@@ -13,6 +13,7 @@ at ``/metrics``).
 from __future__ import annotations
 
 import json
+import re
 import threading
 import time
 
@@ -31,7 +32,9 @@ class Metric:
     kind = "untyped"
 
     def __init__(self, name: str, description: str = "", tag_keys: tuple = ()):
-        if not name or not name.replace("_", "a").replace(":", "a").isalnum():
+        # Prometheus metric names: [a-zA-Z_:][a-zA-Z0-9_:]* — one bad name
+        # would make the whole exposition body unparseable to scrapers.
+        if not re.fullmatch(r"[a-zA-Z_:][a-zA-Z0-9_:]*", name or ""):
             raise ValueError(f"invalid metric name {name!r}")
         self.name = name
         self.description = description
@@ -40,8 +43,22 @@ class Metric:
         self._lock = threading.Lock()
         self._values: dict[tuple, float] = {}
         with _REGISTRY_LOCK:
+            existing = _REGISTRY.get(name)
+            if existing is not None:
+                if existing.kind != self.kind:
+                    raise ValueError(
+                        f"metric {name!r} already registered as {existing.kind}, "
+                        f"cannot re-register as {self.kind}"
+                    )
+                # Same name re-registered (e.g. two actors in one worker):
+                # share storage so updates through either instrument export.
+                self._share_from(existing)
             _REGISTRY[name] = self
         _ensure_flusher()
+
+    def _share_from(self, existing: "Metric"):
+        self._lock = existing._lock
+        self._values = existing._values
 
     def set_default_tags(self, tags: dict):
         self._default_tags = dict(tags)
@@ -88,11 +105,21 @@ class Histogram(Metric):
     kind = "histogram"
 
     def __init__(self, name, description="", boundaries=None, tag_keys=()):
-        super().__init__(name, description, tag_keys)
+        # Histogram storage must exist before super().__init__ publishes this
+        # instrument to the registry — a concurrent flush would otherwise
+        # snapshot a half-constructed object.
         self.boundaries = sorted(boundaries or [0.01, 0.1, 1, 10, 100])
         self._counts: dict[tuple, list[int]] = {}
         self._sums: dict[tuple, float] = {}
         self._totals: dict[tuple, int] = {}
+        super().__init__(name, description, tag_keys)
+
+    def _share_from(self, existing: "Histogram"):
+        super()._share_from(existing)
+        self.boundaries = existing.boundaries
+        self._counts = existing._counts
+        self._sums = existing._sums
+        self._totals = existing._totals
 
     def observe(self, value: float, tags: dict | None = None):
         key = _tag_key(self._merged(tags))
@@ -166,7 +193,7 @@ def flush_metrics(core_worker=None):
 
 
 def _escape(value: str) -> str:
-    return value.replace("\\", "\\\\").replace('"', '\\"')
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 
 def prometheus_text(gcs_client, stale_after_s: float = 60.0) -> str:
@@ -198,7 +225,8 @@ def prometheus_text(gcs_client, stale_after_s: float = 60.0) -> str:
     lines = []
     for name, entry in sorted(merged.items()):
         kind = entry["kind"]
-        lines.append(f"# HELP {name} {entry['description']}")
+        desc = entry["description"].replace("\\", "\\\\").replace("\n", "\\n")
+        lines.append(f"# HELP {name} {desc}")
         lines.append(f"# TYPE {name} {kind}")
         for tags, value in entry["series"]:
             label = ",".join(f'{k}="{_escape(str(v))}"' for k, v in tags)

@@ -95,12 +95,13 @@ def list_tasks(address=None, filters=None, limit: int = 10_000) -> list[dict]:
     try:
         by_task: dict[str, dict] = {}
         # Events from different processes arrive at the GCS out of order
-        # (driver and worker flush on independent ticks) — reduce by event
-        # timestamp, not arrival order.
+        # (driver and worker flush on independent ticks) — reduce by lifecycle
+        # rank first so a terminal state always wins, then by timestamp;
+        # cross-process clocks are not comparable enough to order states.
         rank = {"PENDING_ARGS_AVAIL": 0, "RUNNING": 1, "FINISHED": 2, "FAILED": 2}
         events = sorted(
             state.task_events(limit=limit * 4),
-            key=lambda e: (e.get("ts", 0), rank.get(e.get("state"), 0)),
+            key=lambda e: (rank.get(e.get("state"), 0), e.get("ts", 0)),
         )
         for ev in events:
             tid = ev.get("task_id")
