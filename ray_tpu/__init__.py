@@ -38,6 +38,8 @@ def _install_driver_hooks():
     import atexit
     import sys
 
+    import threading as _threading
+
     prev_hook = sys.excepthook
 
     def _excepthook(tp, value, tb):
@@ -46,6 +48,20 @@ def _install_driver_hooks():
         prev_hook(tp, value, tb)
 
     sys.excepthook = _excepthook
+
+    prev_thread_hook = _threading.excepthook
+
+    def _thread_excepthook(hook_args):
+        global _uncaught_exception
+        if hook_args.exc_type is not SystemExit:
+            _uncaught_exception = True
+        prev_thread_hook(hook_args)
+
+    _threading.excepthook = _thread_excepthook
+    # Known gap: `sys.exit(1)` raises SystemExit, which the interpreter
+    # handles without calling sys.excepthook — such drivers are recorded
+    # SUCCEEDED here; the job-submission layer (which sees the real exit
+    # code) is authoritative for submitted jobs.
     atexit.register(shutdown)
 
 
@@ -63,9 +79,30 @@ def init(
 ):
     """Start (or connect to) a cluster and attach this process as a driver."""
     global _global_node
+    import os
+
     from ray_tpu._private import worker_context
     from ray_tpu._private.core_worker import DRIVER, CoreWorker
     from ray_tpu._private.node import Node
+
+    if address is None and os.environ.get("RAY_TPU_ADDRESS"):
+        # Set by `ray_tpu job submit` driver subprocesses and operators —
+        # mirrors the reference's RAY_ADDRESS behavior.
+        address = os.environ["RAY_TPU_ADDRESS"]
+    if address == "auto":
+        address = os.environ.get("RAY_TPU_ADDRESS")
+        if address is None:
+            try:
+                with open("/tmp/ray_tpu/ray_current_cluster") as f:
+                    import json as _json
+
+                    info = _json.load(f)
+                address = "%s:%d" % tuple(info["gcs_address"])
+            except Exception:
+                raise ConnectionError(
+                    'init(address="auto") found no running cluster '
+                    "(no RAY_TPU_ADDRESS and no /tmp/ray_tpu/ray_current_cluster)"
+                ) from None
 
     with _init_lock:
         if worker_context.get_core_worker_if_initialized() is not None:

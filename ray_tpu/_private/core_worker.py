@@ -113,9 +113,13 @@ class CoreWorker:
         # Per-execution-thread task context: threaded actors
         # (max_concurrency > 1) run execute_task concurrently, so the current
         # spec/id must not be shared process state.
-        # Process-wide fallback for threads the user spawned inside a task
-        # (contextvars don't cross thread creation); last-started task wins.
-        self._exec_fallback: tuple | None = None
+        # Process-wide registry of currently-executing tasks, insertion
+        # ordered — the fallback for threads the user spawned inside a task
+        # (contextvars don't cross thread creation) is the most recently
+        # started still-running task.
+        self._active_exec: dict[int, tuple] = {}
+        self._active_exec_lock = threading.Lock()
+        self._active_exec_seq = 0
         self._task_counter = 0
 
         # Own RPC server (the "core worker service").
@@ -161,21 +165,21 @@ class CoreWorker:
         self._task_events_lock = threading.Lock()
         self._task_events_flusher: threading.Thread | None = None
 
+    def _fallback_ctx(self) -> tuple | None:
+        with self._active_exec_lock:
+            if not self._active_exec:
+                return None
+            return next(reversed(self._active_exec.values()))
+
     @property
     def current_task_id(self) -> TaskID:
-        ctx = _exec_ctx.get()
-        if ctx is not None:
-            return ctx[0]
-        fb = self._exec_fallback
-        return fb[0] if fb is not None else self._default_task_id
+        ctx = _exec_ctx.get() or self._fallback_ctx()
+        return ctx[0] if ctx is not None else self._default_task_id
 
     @property
     def current_task_spec(self) -> TaskSpec | None:
-        ctx = _exec_ctx.get()
-        if ctx is not None:
-            return ctx[1]
-        fb = self._exec_fallback
-        return fb[1] if fb is not None else None
+        ctx = _exec_ctx.get() or self._fallback_ctx()
+        return ctx[1] if ctx is not None else None
 
     # ==================================================================
     # Task events (reference: src/ray/core_worker/task_event_buffer.h:41)
@@ -1016,10 +1020,12 @@ class CoreWorker:
 
     def execute_task(self, spec: TaskSpec) -> dict:
         """Run one task; returns the task_done payload."""
-        prev_fallback = self._exec_fallback
         ctx = (TaskID.from_hex(spec.task_id), spec)
         token = _exec_ctx.set(ctx)
-        self._exec_fallback = ctx
+        with self._active_exec_lock:
+            self._active_exec_seq += 1
+            exec_key = self._active_exec_seq
+            self._active_exec[exec_key] = ctx
         start = time.time()
         self.record_task_event(spec, "RUNNING", start_ts=start)
         try:
@@ -1065,7 +1071,8 @@ class CoreWorker:
             )
         finally:
             _exec_ctx.reset(token)
-            self._exec_fallback = prev_fallback
+            with self._active_exec_lock:
+                self._active_exec.pop(exec_key, None)
         payload["duration_s"] = time.time() - start
         return payload
 

@@ -20,6 +20,9 @@ class GlobalState:
 
     def __init__(self, gcs_address=None):
         if gcs_address is not None:
+            if isinstance(gcs_address, str):
+                host, port = gcs_address.rsplit(":", 1)
+                gcs_address = (host, int(port))
             self._gcs = RpcClient(tuple(gcs_address), label="state-gcs")
             self._owns_client = True
         else:

@@ -1,0 +1,9 @@
+"""Dashboard head — HTTP API over cluster state + job submission.
+
+TPU-native analog of the reference's dashboard backend (dashboard/dashboard.py
+head process, dashboard/state_aggregator.py, dashboard/modules/{job,metrics}):
+a threaded HTTP server reading the GCS, serving the state API as REST, the
+Prometheus metrics exposition, and the job-submission REST endpoints.
+"""
+
+from ray_tpu.dashboard.head import DashboardHead  # noqa: F401

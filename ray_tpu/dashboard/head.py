@@ -1,0 +1,194 @@
+"""Dashboard head HTTP server.
+
+Routes (subset of the reference's dashboard REST surface, dashboard/head.py +
+dashboard/modules/{job/job_head.py,metrics}):
+
+- ``GET  /api/version``                 — version + ray address
+- ``GET  /api/cluster_status``          — nodes, resources, autoscaler summary
+- ``GET  /api/v0/<resource>``           — state API (tasks/actors/nodes/jobs/
+                                          placement_groups/workers/objects)
+- ``GET  /api/v0/tasks/summarize``      — task summary
+- ``GET  /metrics``                     — Prometheus text exposition
+- ``POST /api/jobs/``                   — submit job {entrypoint, ...}
+- ``GET  /api/jobs/``                   — list submitted jobs
+- ``GET  /api/jobs/<id>``               — job info
+- ``GET  /api/jobs/<id>/logs``          — job logs {"logs": "..."}
+- ``POST /api/jobs/<id>/stop``          — stop job
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import ray_tpu
+from ray_tpu._private.rpc import RpcClient
+from ray_tpu.dashboard.job_manager import JobManager
+
+logger = logging.getLogger(__name__)
+
+
+class DashboardHead:
+    def __init__(self, gcs_address, session_dir: str, host: str = "127.0.0.1", port: int = 0):
+        self._gcs_address = tuple(gcs_address)
+        self.job_manager = JobManager(gcs_address, session_dir)
+        head = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                logger.debug("dashboard: " + fmt, *args)
+
+            def _send(self, code: int, payload, content_type="application/json"):
+                body = (
+                    payload
+                    if isinstance(payload, bytes)
+                    else json.dumps(payload).encode()
+                    if content_type == "application/json"
+                    else str(payload).encode()
+                )
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                try:
+                    head._handle_get(self)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:
+                    logger.exception("dashboard GET %s failed", self.path)
+                    try:
+                        self._send(500, {"error": str(e)})
+                    except Exception:
+                        pass
+
+            def do_POST(self):
+                try:
+                    head._handle_post(self)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:
+                    logger.exception("dashboard POST %s failed", self.path)
+                    try:
+                        self._send(500, {"error": str(e)})
+                    except Exception:
+                        pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.address = (host, self._server.server_address[1])
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="dashboard-head", daemon=True
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+    def _state(self):
+        from ray_tpu._private.state import GlobalState
+
+        return GlobalState(gcs_address=self._gcs_address)
+
+    def _handle_get(self, req):
+        path = req.path.split("?", 1)[0].rstrip("/") or "/"
+        if path == "/api/version":
+            req._send(200, {"version": ray_tpu.__version__, "ray_address": "%s:%d" % self._gcs_address})
+            return
+        if path == "/api/cluster_status":
+            state = self._state()
+            try:
+                req._send(
+                    200,
+                    {
+                        "nodes": state.nodes(),
+                        "cluster_resources": state.cluster_resources(),
+                        "available_resources": state.available_resources(),
+                    },
+                )
+            finally:
+                state.close()
+            return
+        if path == "/metrics":
+            from ray_tpu.util.metrics import prometheus_text
+
+            gcs = RpcClient(self._gcs_address, label="dashboard-metrics")
+            try:
+                req._send(200, prometheus_text(gcs), content_type="text/plain; version=0.0.4")
+            finally:
+                gcs.close()
+            return
+        if path == "/api/v0/tasks/summarize":
+            from ray_tpu.util.state import summarize_tasks
+
+            req._send(200, summarize_tasks(address="%s:%d" % self._gcs_address))
+            return
+        if path.startswith("/api/v0/"):
+            from ray_tpu.util.state import api as state_api
+
+            resource = path[len("/api/v0/") :]
+            fn = getattr(state_api, f"list_{resource}", None)
+            if fn is None:
+                req._send(404, {"error": f"unknown resource {resource!r}"})
+                return
+            req._send(200, {"result": fn(address="%s:%d" % self._gcs_address)})
+            return
+        if path == "/api/jobs":
+            req._send(200, self.job_manager.list_jobs())
+            return
+        if path.startswith("/api/jobs/"):
+            rest = path[len("/api/jobs/") :]
+            if rest.endswith("/logs"):
+                sid = rest[: -len("/logs")]
+                try:
+                    req._send(200, {"logs": self.job_manager.get_job_logs(sid)})
+                except KeyError:
+                    req._send(404, {"error": f"no such job {sid}"})
+                return
+            info = self.job_manager.get_job_info(rest)
+            if info is None:
+                req._send(404, {"error": f"no such job {rest}"})
+            else:
+                req._send(200, info)
+            return
+        req._send(404, {"error": f"no route {path}"})
+
+    def _handle_post(self, req):
+        path = req.path.split("?", 1)[0].rstrip("/")
+        length = int(req.headers.get("Content-Length") or 0)
+        body = json.loads(req.rfile.read(length) or b"{}") if length else {}
+        if path == "/api/jobs":
+            try:
+                sid = self.job_manager.submit_job(
+                    entrypoint=body["entrypoint"],
+                    submission_id=body.get("submission_id"),
+                    runtime_env=body.get("runtime_env"),
+                    metadata=body.get("metadata"),
+                )
+            except KeyError:
+                req._send(400, {"error": "missing required field 'entrypoint'"})
+                return
+            except ValueError as e:
+                req._send(400, {"error": str(e)})
+                return
+            req._send(200, {"submission_id": sid})
+            return
+        if path.startswith("/api/jobs/") and path.endswith("/stop"):
+            sid = path[len("/api/jobs/") : -len("/stop")]
+            try:
+                stopped = self.job_manager.stop_job(sid)
+            except KeyError:
+                req._send(404, {"error": f"no such job {sid}"})
+                return
+            req._send(200, {"stopped": stopped})
+            return
+        req._send(404, {"error": f"no route {path}"})
+
+    def stop(self):
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except Exception:
+            pass

@@ -1,0 +1,208 @@
+"""Job manager — drives submitted jobs as driver subprocesses.
+
+Analog of the reference's dashboard/modules/job/job_manager.py: each submitted
+job runs its shell entrypoint in a subprocess whose environment points at the
+cluster (RAY_TPU_ADDRESS), with stdout/stderr captured to a per-job log file;
+job metadata and status live in the GCS KV under ``job_submission:<id>`` so
+any process (dashboard, CLI, SDK) can read them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import threading
+import time
+import uuid
+
+from ray_tpu._private.rpc import RpcClient
+
+# Terminal states mirror the reference's JobStatus (dashboard/modules/job/common.py).
+JOB_STATUSES = ("PENDING", "RUNNING", "SUCCEEDED", "FAILED", "STOPPED")
+
+
+def _kv_key(submission_id: str) -> str:
+    return f"job_submission:{submission_id}"
+
+
+class JobManager:
+    def __init__(self, gcs_address, session_dir: str):
+        self._gcs_address = tuple(gcs_address)
+        self._session_dir = session_dir
+        self._log_dir = os.path.join(session_dir, "job_logs")
+        os.makedirs(self._log_dir, exist_ok=True)
+        self._procs: dict[str, subprocess.Popen] = {}
+        self._lock = threading.Lock()
+
+    def _gcs(self) -> RpcClient:
+        return RpcClient(self._gcs_address, label="job-manager")
+
+    def _write_info(self, info: dict):
+        gcs = self._gcs()
+        try:
+            gcs.call(
+                "kv_put",
+                {
+                    "key": _kv_key(info["submission_id"]),
+                    "value": json.dumps(info).encode(),
+                    "overwrite": True,
+                },
+            )
+        finally:
+            gcs.close()
+
+    def _read_info(self, submission_id: str) -> dict | None:
+        gcs = self._gcs()
+        try:
+            resp = gcs.call("kv_get", {"key": _kv_key(submission_id)})
+        finally:
+            gcs.close()
+        if not resp.get("found"):
+            return None
+        return json.loads(resp["value"])
+
+    # ------------------------------------------------------------------
+    # Public API (mirrors the reference's JobManager surface)
+    # ------------------------------------------------------------------
+    def submit_job(
+        self,
+        entrypoint: str,
+        submission_id: str | None = None,
+        runtime_env: dict | None = None,
+        metadata: dict | None = None,
+        entrypoint_num_cpus: float | None = None,
+    ) -> str:
+        submission_id = submission_id or f"raysubmit_{uuid.uuid4().hex[:16]}"
+        if self._read_info(submission_id) is not None:
+            raise ValueError(f"job {submission_id} already exists")
+        log_path = os.path.join(self._log_dir, f"{submission_id}.log")
+        info = {
+            "submission_id": submission_id,
+            "entrypoint": entrypoint,
+            "status": "PENDING",
+            "message": "Job is queued.",
+            "runtime_env": runtime_env or {},
+            "metadata": metadata or {},
+            "start_time": time.time(),
+            "end_time": None,
+            "log_path": log_path,
+        }
+        self._write_info(info)
+        threading.Thread(
+            target=self._run_job, args=(info,), name=f"job-{submission_id}", daemon=True
+        ).start()
+        return submission_id
+
+    def _run_job(self, info: dict):
+        submission_id = info["submission_id"]
+        # stop_job may have raced submit: honor a STOPPED written before
+        # the entrypoint launched.
+        latest = self._read_info(submission_id)
+        if latest is not None and latest.get("status") == "STOPPED":
+            return
+        env = dict(os.environ)
+        host, port = self._gcs_address
+        env["RAY_TPU_ADDRESS"] = f"{host}:{port}"
+        env["RAY_TPU_JOB_SUBMISSION_ID"] = submission_id
+        renv = info.get("runtime_env") or {}
+        env.update({str(k): str(v) for k, v in (renv.get("env_vars") or {}).items()})
+        cwd = renv.get("working_dir") or None
+        log_f = open(info["log_path"], "wb")
+        try:
+            proc = subprocess.Popen(
+                info["entrypoint"],
+                shell=True,
+                stdout=log_f,
+                stderr=subprocess.STDOUT,
+                env=env,
+                cwd=cwd,
+                start_new_session=True,
+            )
+        except Exception as e:
+            log_f.close()
+            info.update(status="FAILED", message=f"failed to start: {e}", end_time=time.time())
+            self._write_info(info)
+            return
+        with self._lock:
+            self._procs[submission_id] = proc
+        # Re-check after launch: a stop that landed between the PENDING check
+        # and Popen must win, not leak a running entrypoint.
+        latest = self._read_info(submission_id)
+        if latest is not None and latest.get("status") == "STOPPED":
+            try:
+                os.killpg(os.getpgid(proc.pid), 15)
+            except Exception:
+                proc.terminate()
+            proc.wait()
+            with self._lock:
+                self._procs.pop(submission_id, None)
+            return
+        info.update(status="RUNNING", message="Job is running.")
+        self._write_info(info)
+        code = proc.wait()
+        log_f.close()
+        with self._lock:
+            self._procs.pop(submission_id, None)
+        # A stop_job SIGTERM surfaces as negative returncode; keep STOPPED if set.
+        latest = self._read_info(submission_id) or info
+        if latest.get("status") == "STOPPED":
+            return
+        if code == 0:
+            latest.update(status="SUCCEEDED", message="Job finished successfully.")
+        else:
+            latest.update(status="FAILED", message=f"Job exited with code {code}.")
+        latest["end_time"] = time.time()
+        self._write_info(latest)
+
+    def stop_job(self, submission_id: str) -> bool:
+        info = self._read_info(submission_id)
+        if info is None:
+            raise KeyError(f"no such job {submission_id}")
+        if info.get("status") in ("SUCCEEDED", "FAILED", "STOPPED"):
+            return False
+        info.update(status="STOPPED", message="Job was stopped.", end_time=time.time())
+        self._write_info(info)
+        with self._lock:
+            proc = self._procs.get(submission_id)
+        if proc is not None and proc.poll() is None:
+            try:
+                # Entrypoint ran with start_new_session — signal the whole group.
+                os.killpg(os.getpgid(proc.pid), 15)
+            except Exception:
+                proc.terminate()
+        # PENDING jobs (no proc yet) are stopped by the STOPPED status alone:
+        # _run_job re-checks it before and after launching the entrypoint.
+        return True
+
+    def get_job_info(self, submission_id: str) -> dict | None:
+        info = self._read_info(submission_id)
+        if info is not None:
+            # Internal head-node filesystem path; not part of the API surface.
+            info.pop("log_path", None)
+        return info
+
+    def list_jobs(self) -> list[dict]:
+        gcs = self._gcs()
+        try:
+            keys = gcs.call("kv_keys", {"prefix": "job_submission:"}).get("keys", [])
+            out = []
+            for key in keys:
+                resp = gcs.call("kv_get", {"key": key})
+                if resp.get("found"):
+                    info = json.loads(resp["value"])
+                    info.pop("log_path", None)
+                    out.append(info)
+            return sorted(out, key=lambda j: j.get("start_time") or 0)
+        finally:
+            gcs.close()
+
+    def get_job_logs(self, submission_id: str) -> str:
+        info = self._read_info(submission_id)
+        if info is None:
+            raise KeyError(f"no such job {submission_id}")
+        path = info.get("log_path")
+        if not path or not os.path.exists(path):
+            return ""
+        with open(path, "r", errors="replace") as f:
+            return f.read()

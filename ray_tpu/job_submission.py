@@ -1,0 +1,91 @@
+"""Job submission SDK.
+
+Analog of the reference's ``ray.job_submission.JobSubmissionClient``
+(dashboard/modules/job/sdk.py:40) — a thin REST client against the dashboard
+head's ``/api/jobs/`` endpoints.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+    TERMINAL = (SUCCEEDED, FAILED, STOPPED)
+
+
+class JobSubmissionClient:
+    def __init__(self, address: str):
+        """``address`` is the dashboard HTTP address, e.g. ``http://127.0.0.1:8265``."""
+        if not address.startswith("http"):
+            address = "http://" + address
+        self._base = address.rstrip("/")
+
+    def _request(self, method: str, path: str, payload: dict | None = None):
+        data = json.dumps(payload).encode() if payload is not None else None
+        req = urllib.request.Request(self._base + path, data=data, method=method)
+        if data is not None:
+            req.add_header("Content-Type", "application/json")
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return json.loads(resp.read() or b"null")
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")
+            try:
+                detail = json.loads(detail).get("error", detail)
+            except Exception:
+                pass
+            raise RuntimeError(f"{method} {path} failed ({e.code}): {detail}") from None
+
+    def submit_job(
+        self,
+        *,
+        entrypoint: str,
+        submission_id: str | None = None,
+        runtime_env: dict | None = None,
+        metadata: dict | None = None,
+    ) -> str:
+        resp = self._request(
+            "POST",
+            "/api/jobs/",
+            {
+                "entrypoint": entrypoint,
+                "submission_id": submission_id,
+                "runtime_env": runtime_env,
+                "metadata": metadata,
+            },
+        )
+        return resp["submission_id"]
+
+    def list_jobs(self) -> list[dict]:
+        return self._request("GET", "/api/jobs/")
+
+    def get_job_info(self, submission_id: str) -> dict:
+        return self._request("GET", f"/api/jobs/{submission_id}")
+
+    def get_job_status(self, submission_id: str) -> str:
+        return self.get_job_info(submission_id)["status"]
+
+    def get_job_logs(self, submission_id: str) -> str:
+        return self._request("GET", f"/api/jobs/{submission_id}/logs")["logs"]
+
+    def stop_job(self, submission_id: str) -> bool:
+        return self._request("POST", f"/api/jobs/{submission_id}/stop")["stopped"]
+
+    def wait_until_finished(self, submission_id: str, timeout: float = 300.0, poll_s: float = 0.5) -> str:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            status = self.get_job_status(submission_id)
+            if status in JobStatus.TERMINAL:
+                return status
+            time.sleep(poll_s)
+        raise TimeoutError(f"job {submission_id} still {status} after {timeout}s")

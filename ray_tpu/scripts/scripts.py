@@ -1,0 +1,496 @@
+"""``ray_tpu`` CLI.
+
+Analog of the reference's ``ray …`` commands (python/ray/scripts/scripts.py:
+start :537, stop :982, status, memory, timeline, microbenchmark :1818) plus the
+state CLI (python/ray/util/state/state_cli.py) and job CLI
+(dashboard/modules/job/cli.py). Run as ``python -m ray_tpu <command>``.
+
+``start`` daemonizes by re-exec'ing itself with ``--block`` in a detached
+session; the head writes its addresses to ``/tmp/ray_tpu/ray_current_cluster``
+(the reference's cluster-address file pattern) so later CLI calls and
+``ray_tpu.init(address="auto")`` can find it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+CLUSTER_FILE = "/tmp/ray_tpu/ray_current_cluster"
+NODES_DIR = "/tmp/ray_tpu/nodes"
+
+
+def _read_cluster_file() -> dict | None:
+    try:
+        with open(CLUSTER_FILE) as f:
+            return json.load(f)
+    except Exception:
+        return None
+
+
+def _dashboard_url(args_address: str | None = None) -> str:
+    if args_address:
+        return args_address
+    info = _read_cluster_file()
+    if info and info.get("dashboard_address"):
+        return "%s:%d" % tuple(info["dashboard_address"])
+    raise SystemExit("no running cluster found (is `ray_tpu start --head` up?)")
+
+
+def _gcs_address(explicit: str | None = None) -> str:
+    if explicit:
+        return explicit
+    env = os.environ.get("RAY_TPU_ADDRESS")
+    if env:
+        return env
+    info = _read_cluster_file()
+    if info and info.get("gcs_address"):
+        return "%s:%d" % tuple(info["gcs_address"])
+    raise SystemExit("no running cluster found (is `ray_tpu start --head` up?)")
+
+
+# ----------------------------------------------------------------------
+# start / stop
+# ----------------------------------------------------------------------
+
+
+def cmd_start(args):
+    if not args.block:
+        # Daemonize: re-exec with --block in a detached session. The child
+        # signals readiness by writing a unique ready-file we pass it, so a
+        # stale marker from an earlier node can never fake a success.
+        import uuid
+
+        os.makedirs(NODES_DIR, exist_ok=True)
+        if args.head and os.path.exists(CLUSTER_FILE):
+            info = _read_cluster_file()
+            if info and _pid_alive(info.get("pid")):
+                raise SystemExit(
+                    f"a cluster is already running (pid {info['pid']}); run `ray_tpu stop` first"
+                )
+            os.unlink(CLUSTER_FILE)
+        ready_file = os.path.join(NODES_DIR, f"ready_{uuid.uuid4().hex[:12]}")
+        cmd = (
+            [sys.executable, "-m", "ray_tpu.scripts.scripts"]
+            + sys.argv[1:]
+            + ["--block", "--ready-file", ready_file]
+        )
+        log_path = "/tmp/ray_tpu/node_daemon.log"
+        with open(log_path, "ab") as log_f:
+            proc = subprocess.Popen(
+                cmd, stdout=log_f, stderr=subprocess.STDOUT, start_new_session=True
+            )
+        deadline = time.time() + 60
+        try:
+            while time.time() < deadline:
+                if os.path.exists(ready_file):
+                    if args.head:
+                        info = _read_cluster_file()
+                        print("Started head node.")
+                        print("  GCS address:       %s:%d" % tuple(info["gcs_address"]))
+                        if info.get("dashboard_address"):
+                            print(
+                                "  Dashboard:         http://%s:%d"
+                                % tuple(info["dashboard_address"])
+                            )
+                        print('  Connect with:      ray_tpu.init(address="auto")')
+                    else:
+                        print("Started worker node.")
+                    return
+                if proc.poll() is not None:
+                    raise SystemExit(
+                        f"node process exited with code {proc.returncode}; see {log_path}"
+                    )
+                time.sleep(0.2)
+            raise SystemExit(f"node did not come up within 60s; see {log_path}")
+        finally:
+            try:
+                os.unlink(ready_file)
+            except OSError:
+                pass
+
+    # --block: actually run the node in this process.
+    import ray_tpu  # noqa: F401  (package import path check)
+    from ray_tpu._private.node import Node
+
+    resources = json.loads(args.resources) if args.resources else None
+    if args.head:
+        node = Node(
+            head=True,
+            num_cpus=args.num_cpus,
+            num_tpus=args.num_tpus,
+            resources=resources,
+            object_store_memory=args.object_store_memory,
+        )
+        dashboard = None
+        dashboard_addr = None
+        if not args.no_dashboard:
+            from ray_tpu.dashboard import DashboardHead
+
+            dashboard = DashboardHead(
+                node.gcs_address,
+                node.session_dir,
+                host=args.dashboard_host,
+                port=args.dashboard_port,
+            )
+            dashboard_addr = list(dashboard.address)
+        os.makedirs(os.path.dirname(CLUSTER_FILE), exist_ok=True)
+        with open(CLUSTER_FILE, "w") as f:
+            json.dump(
+                {
+                    "gcs_address": list(node.gcs_address),
+                    "dashboard_address": dashboard_addr,
+                    "pid": os.getpid(),
+                    "session_dir": node.session_dir,
+                },
+                f,
+            )
+        marker = CLUSTER_FILE
+        if args.ready_file:
+            with open(args.ready_file, "w") as f:
+                f.write(str(os.getpid()))
+    else:
+        gcs = _gcs_address(args.address)
+        host, port = gcs.rsplit(":", 1)
+        node = Node(
+            head=False,
+            gcs_address=(host, int(port)),
+            num_cpus=args.num_cpus,
+            num_tpus=args.num_tpus,
+            resources=resources,
+            object_store_memory=args.object_store_memory,
+        )
+        dashboard = None
+        os.makedirs(NODES_DIR, exist_ok=True)
+        marker = os.path.join(NODES_DIR, f"node_{os.getpid()}.json")
+        with open(marker, "w") as f:
+            json.dump({"pid": os.getpid(), "node_id": node.node_id}, f)
+        if args.ready_file:
+            with open(args.ready_file, "w") as f:
+                f.write(str(os.getpid()))
+
+    stop_evt = {"stop": False}
+
+    def _sig(_sig, _frm):
+        stop_evt["stop"] = True
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    try:
+        while not stop_evt["stop"]:
+            time.sleep(0.5)
+    finally:
+        if dashboard is not None:
+            dashboard.stop()
+        node.stop()
+        try:
+            os.unlink(marker)
+        except OSError:
+            pass
+
+
+def _node_files() -> list[str]:
+    try:
+        return os.listdir(NODES_DIR)
+    except OSError:
+        return []
+
+
+def _pid_alive(pid) -> bool:
+    if not pid:
+        return False
+    try:
+        os.kill(int(pid), 0)
+        return True
+    except OSError:
+        return False
+
+
+def cmd_stop(args):
+    killed = 0
+    for fname in _node_files():
+        path = os.path.join(NODES_DIR, fname)
+        try:
+            with open(path) as f:
+                pid = json.load(f).get("pid")
+        except Exception:
+            pid = None
+        if _pid_alive(pid):
+            os.kill(int(pid), signal.SIGTERM)
+            killed += 1
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+    info = _read_cluster_file()
+    if info and _pid_alive(info.get("pid")):
+        os.kill(int(info["pid"]), signal.SIGTERM)
+        killed += 1
+    try:
+        os.unlink(CLUSTER_FILE)
+    except OSError:
+        pass
+    print(f"Stopped {killed} node process(es).")
+
+
+# ----------------------------------------------------------------------
+# status / memory / timeline / state
+# ----------------------------------------------------------------------
+
+
+def cmd_status(args):
+    from ray_tpu._private.state import GlobalState
+
+    host, port = _gcs_address(args.address).rsplit(":", 1)
+    state = GlobalState(gcs_address=(host, int(port)))
+    try:
+        nodes = state.nodes()
+        total = state.cluster_resources()
+        avail = state.available_resources()
+    finally:
+        state.close()
+    alive = [n for n in nodes if n["state"] == "ALIVE"]
+    print(f"Nodes: {len(alive)} alive, {len(nodes) - len(alive)} dead")
+    for n in alive:
+        print(f"  {n['node_id'][:12]}  {n['address'][0]}:{n['address'][1]}")
+    print("Resources:")
+    for key in sorted(total):
+        used = total[key] - avail.get(key, 0)
+        print(f"  {used:g}/{total[key]:g} {key}")
+
+
+def cmd_memory(args):
+    from ray_tpu.util.state import list_objects
+
+    rows = list_objects(address=_gcs_address(args.address))
+    total = sum(r.get("size_bytes") or 0 for r in rows)
+    print(f"{len(rows)} objects, {total / (1024 * 1024):.1f} MiB total")
+    for r in rows[: args.limit]:
+        print(
+            f"  {r['object_id'][:16]}  {(r.get('size_bytes') or 0) / 1024:8.1f} KiB  "
+            f"node={str(r.get('node_id'))[:8]}"
+        )
+
+
+def cmd_timeline(args):
+    from ray_tpu._private.state import GlobalState
+
+    host, port = _gcs_address(args.address).rsplit(":", 1)
+    state = GlobalState(gcs_address=(host, int(port)))
+    try:
+        events = state.chrome_tracing_dump(filename=args.output)
+    finally:
+        state.close()
+    print(f"Wrote {len(events)} events to {args.output}")
+
+
+def cmd_list(args):
+    from ray_tpu.util.state import api as state_api
+
+    fn = getattr(state_api, f"list_{args.resource}", None)
+    if fn is None:
+        raise SystemExit(f"unknown resource {args.resource!r}")
+    rows = fn(address=_gcs_address(args.address), limit=args.limit)
+    print(json.dumps(rows, indent=2, default=str))
+
+
+def cmd_summary(args):
+    from ray_tpu.util.state import summarize_tasks
+
+    print(json.dumps(summarize_tasks(address=_gcs_address(args.address)), indent=2))
+
+
+# ----------------------------------------------------------------------
+# job
+# ----------------------------------------------------------------------
+
+
+def cmd_job(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(_dashboard_url(args.address))
+    if args.job_cmd == "submit":
+        runtime_env = json.loads(args.runtime_env_json) if args.runtime_env_json else None
+        entrypoint = list(args.entrypoint)
+        if entrypoint and entrypoint[0] == "--":
+            entrypoint = entrypoint[1:]
+        if not entrypoint:
+            raise SystemExit("job submit requires an entrypoint, e.g. `job submit -- python my.py`")
+        import shlex
+
+        # argv → shell string with each arg quoted, so `job submit -- python
+        # -c "code with spaces"` survives the round trip through `sh -c`.
+        sid = client.submit_job(
+            entrypoint=shlex.join(entrypoint), runtime_env=runtime_env, submission_id=args.submission_id
+        )
+        print(f"Submitted job {sid}")
+        if not args.no_wait:
+            status = client.wait_until_finished(sid, timeout=args.timeout)
+            print(client.get_job_logs(sid), end="")
+            print(f"Job {sid} finished: {status}")
+            if status != "SUCCEEDED":
+                sys.exit(1)
+    elif args.job_cmd == "list":
+        for j in client.list_jobs():
+            print(f"{j['submission_id']}  {j['status']:10}  {j['entrypoint']}")
+    elif args.job_cmd == "status":
+        print(client.get_job_status(args.submission_id))
+    elif args.job_cmd == "logs":
+        print(client.get_job_logs(args.submission_id), end="")
+    elif args.job_cmd == "stop":
+        print(client.stop_job(args.submission_id))
+
+
+# ----------------------------------------------------------------------
+# microbenchmark
+# ----------------------------------------------------------------------
+
+
+def cmd_microbenchmark(args):
+    """Single-node task/actor/object throughput suite (reference:
+    python/ray/_private/ray_perf.py:93)."""
+    import numpy as np
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=args.num_cpus, object_store_memory=256 * 1024 * 1024)
+
+    def timeit(name, fn, multiplier=1):
+        # warmup
+        fn()
+        start = time.time()
+        count = 0
+        while time.time() - start < args.duration:
+            fn()
+            count += 1
+        dt = time.time() - start
+        rate = count * multiplier / dt
+        print(f"{name:45s} {rate:12.1f} /s")
+
+    @ray_tpu.remote
+    def small():
+        return b"ok"
+
+    @ray_tpu.remote
+    class Actor:
+        def ping(self):
+            return b"ok"
+
+    a = Actor.remote()
+    ray_tpu.get(a.ping.remote())
+
+    timeit("single client task sync (submit+get)", lambda: ray_tpu.get(small.remote()))
+    timeit(
+        "single client task async (100 in flight)",
+        lambda: ray_tpu.get([small.remote() for _ in range(100)]),
+        multiplier=100,
+    )
+    timeit("single client actor call sync", lambda: ray_tpu.get(a.ping.remote()))
+    timeit(
+        "single client actor calls async (100)",
+        lambda: ray_tpu.get([a.ping.remote() for _ in range(100)]),
+        multiplier=100,
+    )
+    arr = np.zeros(1024 * 1024, dtype=np.uint8)
+    timeit("put 1MiB numpy", lambda: ray_tpu.put(arr))
+    ref_holder = {}
+
+    def put_get():
+        r = ray_tpu.put(arr)
+        ray_tpu.get(r)
+
+    timeit("put+get 1MiB numpy roundtrip", put_get)
+    ray_tpu.shutdown()
+
+
+# ----------------------------------------------------------------------
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="ray_tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("start", help="start a head or worker node")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--address", help="GCS address host:port (worker nodes)")
+    p.add_argument("--num-cpus", type=int, default=None)
+    p.add_argument("--num-tpus", type=int, default=None)
+    p.add_argument("--resources", help="JSON dict of custom resources")
+    p.add_argument("--object-store-memory", type=int, default=None)
+    p.add_argument("--dashboard-host", default="127.0.0.1")
+    p.add_argument("--dashboard-port", type=int, default=8265)
+    p.add_argument("--no-dashboard", action="store_true")
+    p.add_argument("--block", action="store_true", help="run in the foreground")
+    p.add_argument("--ready-file", default=None, help=argparse.SUPPRESS)
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("stop", help="stop all nodes started on this host")
+    p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("status", help="cluster nodes + resource usage")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("memory", help="object store contents")
+    p.add_argument("--address", default=None)
+    p.add_argument("--limit", type=int, default=50)
+    p.set_defaults(fn=cmd_memory)
+
+    p = sub.add_parser("timeline", help="dump Chrome trace of task events")
+    p.add_argument("--address", default=None)
+    p.add_argument("-o", "--output", default="timeline.json")
+    p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("list", help="state API listing")
+    p.add_argument(
+        "resource",
+        choices=["tasks", "actors", "nodes", "jobs", "objects", "workers", "placement_groups"],
+    )
+    p.add_argument("--address", default=None)
+    p.add_argument("--limit", type=int, default=100)
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("summary", help="task state summary")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_summary)
+
+    p = sub.add_parser("job", help="job submission")
+    jsub = p.add_subparsers(dest="job_cmd", required=True)
+    js = jsub.add_parser("submit")
+    js.add_argument("--address", default=None, help="dashboard http address")
+    js.add_argument("--runtime-env-json", default=None)
+    js.add_argument("--submission-id", default=None)
+    js.add_argument("--no-wait", action="store_true")
+    js.add_argument("--timeout", type=float, default=3600.0)
+    js.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    for name in ("list", "status", "logs", "stop"):
+        jp = jsub.add_parser(name)
+        jp.add_argument("--address", default=None)
+        if name != "list":
+            jp.add_argument("submission_id")
+    p.set_defaults(fn=cmd_job)
+
+    p = sub.add_parser("microbenchmark", help="task/actor/object throughput suite")
+    p.add_argument("--num-cpus", type=int, default=None)
+    p.add_argument("--duration", type=float, default=2.0, help="seconds per case")
+    p.set_defaults(fn=cmd_microbenchmark)
+
+    args = parser.parse_args(argv)
+    try:
+        args.fn(args)
+    except BrokenPipeError:
+        # stdout piped into e.g. `head` that exited — normal CLI etiquette.
+        try:
+            sys.stdout.close()
+        except Exception:
+            pass
+        sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -37,17 +37,14 @@ class ServeController:
         self._deployments: dict[str, DeploymentInfo] = {}
         # name -> list[ReplicaInfo] (RUNNING replicas, in the routing table)
         self._replicas: dict[str, list[ReplicaInfo]] = {}
-        # name -> count of STARTING replicas (created, not yet healthy)
-        self._starting: dict[str, int] = {}
+        # name -> {replica_id: created_ts} for STARTING replicas (created,
+        # not yet healthy); drives both the over-start guard and the
+        # rolling-update stall detector.
+        self._starting_births: dict[str, dict[str, float]] = {}
         self._replica_handles: dict[str, object] = {}
         # autoscaling bookkeeping
         self._metrics: dict[str, dict] = {}
         self._scale_marks: dict[str, float] = {}
-        # name -> ts when the oldest currently-STARTING replica was created;
-        # cleared each time a replica becomes healthy. Drives forced
-        # retirement of old-version replicas when a rolling update can't make
-        # progress because the old version holds all the resources.
-        self._starting_since: dict[str, float] = {}
         # name -> forced retires not yet matched by a new healthy replica.
         # Caps the stall-breaker at maxUnavailable=1: a rollout whose new
         # version never becomes healthy sacrifices at most one old replica.
@@ -223,7 +220,7 @@ class ServeController:
             version = info.config.version
             with self._lock:
                 reps = list(self._replicas.get(name, []))
-                starting = self._starting.get(name, 0)
+                starting = len(self._starting_births.get(name, {}))
             new_reps = [r for r in reps if r.version == version]
             old_reps = [r for r in reps if r.version != version]
             target = self._target_replicas(info)
@@ -247,15 +244,15 @@ class ServeController:
                 # (maxUnavailable=1), so a rollout whose new version keeps
                 # crashing cannot drain the whole deployment.
                 with self._lock:
-                    since = self._starting_since.get(name)
+                    births = self._starting_births.get(name, {})
+                    oldest = min(births.values()) if births else None
                     if (
-                        since is not None
-                        and time.time() - since > 3.0
+                        oldest is not None
+                        and time.time() - oldest > 3.0
                         and self._forced_debt.get(name, 0) == 0
                     ):
                         retire = 1
                         self._forced_debt[name] = 1
-                        self._starting_since[name] = time.time()
             for r in old_reps[:retire]:
                 self._stop_replica(name, r)
                 changed = True
@@ -287,8 +284,7 @@ class ServeController:
             version=info.config.version,
         )
         with self._lock:
-            self._starting[info.name] = self._starting.get(info.name, 0) + 1
-            self._starting_since.setdefault(info.name, time.time())
+            self._starting_births.setdefault(info.name, {})[replica_id] = time.time()
             self._replica_handles[replica_id] = handle
 
         def _wait_ready():
@@ -298,8 +294,7 @@ class ServeController:
             except Exception:
                 logger.exception("replica %s of %s failed to start", replica_id, info.name)
             with self._lock:
-                self._starting[info.name] = max(0, self._starting.get(info.name, 0) - 1)
-                self._starting_since.pop(info.name, None)
+                self._starting_births.get(info.name, {}).pop(replica_id, None)
                 if ok:
                     self._forced_debt.pop(info.name, None)
                 if ok and info.name in self._deployments:

@@ -115,8 +115,12 @@ class Histogram(Metric):
         super().__init__(name, description, tag_keys)
 
     def _share_from(self, existing: "Histogram"):
+        if self.boundaries != existing.boundaries:
+            raise ValueError(
+                f"histogram {self.name!r} already registered with boundaries "
+                f"{existing.boundaries}, cannot re-register with {self.boundaries}"
+            )
         super()._share_from(existing)
-        self.boundaries = existing.boundaries
         self._counts = existing._counts
         self._sums = existing._sums
         self._totals = existing._totals

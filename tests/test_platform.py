@@ -1,0 +1,159 @@
+"""Platform layer tests: dashboard REST, job submission, CLI.
+
+Modeled on the reference's dashboard/modules/job/tests/test_job_manager.py,
+dashboard/tests/, and python/ray/tests/test_cli.py: REST state endpoints, job
+lifecycle (submit/status/logs/stop), and the start/status/stop CLI flow.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def dashboard():
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    from ray_tpu.dashboard import DashboardHead
+
+    node = ray_tpu._global_node
+    head = DashboardHead(node.gcs_address, node.session_dir)
+    yield head
+    head.stop()
+    ray_tpu.shutdown()
+
+
+def _get(head, path):
+    url = "http://%s:%d%s" % (head.address[0], head.address[1], path)
+    with urllib.request.urlopen(url, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def test_dashboard_state_endpoints(dashboard):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    assert ray_tpu.get(f.remote()) == 1
+
+    ver = _get(dashboard, "/api/version")
+    assert ver["version"] == ray_tpu.__version__
+    status = _get(dashboard, "/api/cluster_status")
+    assert status["cluster_resources"]["CPU"] == 4
+    assert len([n for n in status["nodes"] if n["state"] == "ALIVE"]) == 1
+    nodes = _get(dashboard, "/api/v0/nodes")["result"]
+    assert len(nodes) == 1
+    tasks = _get(dashboard, "/api/v0/tasks")["result"]
+    assert any(t["name"] == "f" for t in tasks)
+
+
+def test_dashboard_metrics_endpoint(dashboard):
+    from ray_tpu.util import metrics
+
+    c = metrics.Counter("platform_test_total", tag_keys=("k",))
+    c.inc(2.0, tags={"k": "v"})
+    metrics.flush_metrics()
+    url = "http://%s:%d/metrics" % dashboard.address
+    with urllib.request.urlopen(url, timeout=30) as resp:
+        text = resp.read().decode()
+    assert "platform_test_total" in text
+    assert 'k="v"' in text
+
+
+def test_job_submission_end_to_end(dashboard):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient("http://%s:%d" % dashboard.address)
+    script = (
+        "import sys; sys.path.insert(0, %r); "
+        "import ray_tpu; ray_tpu.init(); "
+        "print('task says', ray_tpu.get(ray_tpu.remote(lambda: 40 + 2).remote()))"
+    ) % REPO
+    sid = client.submit_job(entrypoint=f"{sys.executable} -c \"{script}\"")
+    status = client.wait_until_finished(sid, timeout=120)
+    logs = client.get_job_logs(sid)
+    assert status == "SUCCEEDED", logs
+    assert "task says 42" in logs
+    jobs = client.list_jobs()
+    assert any(j["submission_id"] == sid for j in jobs)
+
+
+def test_job_stop(dashboard):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient("http://%s:%d" % dashboard.address)
+    sid = client.submit_job(entrypoint=f"{sys.executable} -c 'import time; time.sleep(600)'")
+    deadline = time.time() + 30
+    while client.get_job_status(sid) == "PENDING" and time.time() < deadline:
+        time.sleep(0.1)
+    assert client.stop_job(sid) is True
+    status = client.wait_until_finished(sid, timeout=30)
+    assert status == "STOPPED"
+
+
+def test_job_submit_missing_entrypoint_400(dashboard):
+    req = urllib.request.Request(
+        "http://%s:%d/api/jobs/" % dashboard.address,
+        data=json.dumps({}).encode(),
+        method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        urllib.request.urlopen(req, timeout=30)
+    assert exc_info.value.code == 400
+
+
+def test_cli_start_status_stop(tmp_path):
+    """Full CLI flow in subprocesses: start --head, status, connect a driver
+    via address="auto", stop."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    run = lambda *cmd, **kw: subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.scripts", *cmd],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=kw.pop("timeout", 120),
+    )
+    # Make sure no stale cluster file blocks the start.
+    subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.scripts", "stop"],
+        capture_output=True,
+        env=env,
+        timeout=60,
+    )
+    out = run("start", "--head", "--num-cpus", "2", "--no-dashboard")
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "Started head node" in out.stdout
+    try:
+        st = run("status")
+        assert st.returncode == 0, st.stdout + st.stderr
+        assert "1 alive" in st.stdout
+        assert "CPU" in st.stdout
+
+        driver = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import sys; sys.path.insert(0, %r); import ray_tpu; "
+                'ray_tpu.init(address="auto"); '
+                "print(ray_tpu.get(ray_tpu.remote(lambda: 'via-cli').remote()))" % REPO,
+            ],
+            capture_output=True,
+            text=True,
+            env=env,
+            timeout=120,
+        )
+        assert driver.returncode == 0, driver.stdout + driver.stderr
+        assert "via-cli" in driver.stdout
+    finally:
+        out = run("stop")
+        assert "Stopped" in out.stdout
