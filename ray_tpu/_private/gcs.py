@@ -93,6 +93,7 @@ class GcsServer:
         node["last_heartbeat"] = time.monotonic()
         node["resources_available"] = req.get("resources_available", node["resources_available"])
         node["store_usage"] = req.get("store_usage", node["store_usage"])
+        node["load"] = req.get("load", [])
         # Return the cluster resource view: this doubles as the resource
         # syncer (reference: src/ray/common/ray_syncer/ray_syncer.h:86).
         return {"ok": True, "nodes": self._cluster_view()}

@@ -131,6 +131,9 @@ class Raylet:
                         "node_id": self.node_id,
                         "resources_available": self.resources_available,
                         "store_usage": self.store.usage(),
+                        # Resource demand by shape (reference: resource load
+                        # reporting in ray_syncer / autoscaler demand input).
+                        "load": self._pending_load(),
                     },
                 )
                 if resp.get("dead"):
@@ -143,6 +146,14 @@ class Raylet:
             except Exception:
                 pass
             await asyncio.sleep(self.cfg.heartbeat_interval_s)
+
+    def _pending_load(self) -> list:
+        """Aggregate queued task resource shapes for the autoscaler."""
+        shapes: dict[tuple, int] = {}
+        for spec in self.task_queue:
+            key = tuple(sorted(spec.resources.items()))
+            shapes[key] = shapes.get(key, 0) + 1
+        return [{"resources": dict(k), "count": c} for k, c in shapes.items()]
 
     async def _retry_pg_tasks(self):
         """Re-route queued tasks that cannot run on this node: PG tasks whose

@@ -1,0 +1,23 @@
+"""Autoscaler — demand-driven node provisioning.
+
+TPU-native analog of the reference's autoscaler
+(python/ray/autoscaler/_private/autoscaler.py:172 StandardAutoscaler,
+resource_demand_scheduler.py:101 ResourceDemandScheduler, pluggable
+NodeProvider, fake_multi_node/ test provider): pending task shapes and
+unplaced placement-group bundles are read from the GCS, bin-packed onto
+configured node types, and nodes are launched/terminated through a provider.
+
+TPU-first: a node type can model an entire TPU pod slice (``TPU: 4`` +
+``tpu_accelerator_type`` label), so STRICT_PACK placement groups demanding a
+slice trigger a slice-sized node launch.
+"""
+
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler  # noqa: F401
+from ray_tpu.autoscaler.monitor import Monitor  # noqa: F401
+from ray_tpu.autoscaler.node_provider import (  # noqa: F401
+    FakeMultiNodeProvider,
+    NodeProvider,
+)
+from ray_tpu.autoscaler.resource_demand_scheduler import (  # noqa: F401
+    ResourceDemandScheduler,
+)

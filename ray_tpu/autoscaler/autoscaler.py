@@ -1,0 +1,199 @@
+"""StandardAutoscaler — the reconcile loop.
+
+Analog of the reference's StandardAutoscaler
+(autoscaler/_private/autoscaler.py:172 ``update()``): each tick reads cluster
+state from the GCS (alive nodes, per-node available resources, pending task
+shapes from raylet heartbeats, unplaced placement-group bundles), plans
+launches with the ResourceDemandScheduler, and terminates nodes idle longer
+than ``idle_timeout_s``.
+
+Config dict (YAML-equivalent of the reference's cluster config):
+
+    {
+      "cluster_name": "default",
+      "max_workers": 8,
+      "idle_timeout_s": 60,
+      "provider": {"type": "fake", "gcs_address": "host:port"},
+      "node_types": {
+        "cpu_worker": {"resources": {"CPU": 2}, "max_workers": 4},
+        "tpu_slice":  {"resources": {"TPU": 4, "CPU": 8}, "max_workers": 2},
+      },
+    }
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from ray_tpu._private.rpc import RpcClient
+from ray_tpu.autoscaler.node_provider import FakeMultiNodeProvider, NodeProvider
+from ray_tpu.autoscaler.resource_demand_scheduler import ResourceDemandScheduler
+
+logger = logging.getLogger(__name__)
+
+
+def _make_provider(config: dict) -> NodeProvider:
+    pconf = config.get("provider", {})
+    ptype = pconf.get("type", "fake")
+    if ptype == "fake":
+        return FakeMultiNodeProvider(pconf, config.get("cluster_name", "default"))
+    if ptype == "tpu":
+        from ray_tpu.autoscaler.node_provider import TPUPodProvider
+
+        return TPUPodProvider(pconf, config.get("cluster_name", "default"))
+    raise ValueError(f"unknown provider type {ptype!r}")
+
+
+class StandardAutoscaler:
+    def __init__(self, config: dict, provider: NodeProvider | None = None):
+        self.config = config
+        self.provider = provider or _make_provider(config)
+        host, port = config["provider"]["gcs_address"].rsplit(":", 1)
+        self._gcs_address = (host, int(port))
+        self.scheduler = ResourceDemandScheduler(
+            config.get("node_types", {}), config.get("max_workers", 8)
+        )
+        self.idle_timeout_s = config.get("idle_timeout_s", 60.0)
+        # provider node id -> node type
+        self._node_type_of: dict[str, str] = {}
+        # gcs node id -> first time seen fully idle
+        self._idle_since: dict[str, float] = {}
+        self._head_node_id: str | None = None
+
+    def _gcs(self) -> RpcClient:
+        return RpcClient(self._gcs_address, label="autoscaler")
+
+    def _read_state(self) -> tuple[list[dict], list[dict]]:
+        gcs = self._gcs()
+        try:
+            nodes = [
+                n
+                for n in gcs.call("get_nodes")["nodes"].values()
+                if n["state"] == "ALIVE"
+            ]
+            pgs = gcs.call("list_placement_groups").get("placement_groups", [])
+        finally:
+            gcs.close()
+        return nodes, pgs
+
+    def update(self):
+        """One reconcile tick. Safe to call from any thread/process."""
+        nodes, pgs = self._read_state()
+        if self._head_node_id is None and nodes:
+            # First-seen node is the head (started before the autoscaler);
+            # never terminate it.
+            self._head_node_id = nodes[0]["node_id"]
+
+        # ---- demand ----
+        demands: list[dict] = []
+        for n in nodes:
+            for entry in n.get("load", []) or []:
+                shape = entry.get("resources", {})
+                if not shape:
+                    continue
+                demands.extend([shape] * int(entry.get("count", 1)))
+        for pg in pgs:
+            if pg.get("state") == "PENDING":
+                strategy = pg.get("strategy", "PACK")
+                bundles = pg.get("bundles", [])
+                if strategy == "STRICT_PACK":
+                    # Gang demand: one node must hold every bundle — present
+                    # it as a single merged shape (a TPU slice request).
+                    merged: dict = {}
+                    for b in bundles:
+                        for k, v in b.items():
+                            merged[k] = merged.get(k, 0) + v
+                    if merged:
+                        demands.append(merged)
+                else:
+                    demands.extend([b for b in bundles if b])
+
+        # ---- launch ----
+        provider_nodes = self.provider.non_terminated_nodes()
+        counts_by_type: dict[str, int] = {}
+        booting_avail: list[dict] = []
+        registered = {(n.get("labels") or {}).get("provider_node_id") for n in nodes}
+        for nid in provider_nodes:
+            t = self._node_type_of.get(nid) or self.provider.node_tags(nid).get("node_type")
+            if t:
+                counts_by_type[t] = counts_by_type.get(t, 0) + 1
+            if nid not in registered and t in self.config.get("node_types", {}):
+                # Launched but not yet registered with the GCS: count its
+                # full capacity so the same demand doesn't re-launch a node
+                # on every tick while the first one boots.
+                booting_avail.append(dict(self.config["node_types"][t].get("resources", {})))
+        to_launch = self.scheduler.get_nodes_to_launch(
+            existing_avail=[n.get("resources_available", {}) for n in nodes] + booting_avail,
+            demands=demands,
+            counts_by_type=counts_by_type,
+            total_existing=len(provider_nodes),
+        )
+        for node_type, count in to_launch.items():
+            node_config = self.config["node_types"][node_type]
+            logger.info("autoscaler: launching %d x %s", count, node_type)
+            created = self.provider.create_node(
+                node_config, tags={"node_type": node_type}, count=count
+            )
+            for nid in created:
+                self._node_type_of[nid] = node_type
+
+        # ---- idle termination ----
+        now = time.time()
+        feasible_demand = bool(to_launch) or any(self._shape_feasible(s, nodes) for s in demands)
+        if feasible_demand:
+            # Busy cluster: reset idle clocks to avoid flapping. Demand no
+            # node type (or node) could ever satisfy must NOT pin the
+            # cluster at peak size forever.
+            self._idle_since.clear()
+            return
+        idle_gcs_nodes = []
+        for n in nodes:
+            if n["node_id"] == self._head_node_id:
+                continue
+            total, avail = n.get("resources_total", {}), n.get("resources_available", {})
+            if all(avail.get(k, 0) >= v for k, v in total.items()):
+                first = self._idle_since.setdefault(n["node_id"], now)
+                if now - first >= self.idle_timeout_s:
+                    idle_gcs_nodes.append(n)
+            else:
+                self._idle_since.pop(n["node_id"], None)
+        for n in idle_gcs_nodes:
+            pid = self._provider_node_for(n)
+            if pid is None:
+                continue
+            logger.info("autoscaler: terminating idle node %s", n["node_id"][:8])
+            gcs = self._gcs()
+            try:
+                gcs.call("drain_node", {"node_id": n["node_id"]})
+            except Exception:
+                pass
+            finally:
+                gcs.close()
+            self.provider.terminate_node(pid)
+            self._node_type_of.pop(pid, None)
+            self._idle_since.pop(n["node_id"], None)
+
+    def _shape_feasible(self, shape: dict, nodes: list[dict]) -> bool:
+        """Could this demand ever be satisfied — by a configured node type or
+        by the total capacity of an existing node?"""
+        for nt in self.config.get("node_types", {}).values():
+            res = nt.get("resources", {})
+            if all(res.get(k, 0) >= v for k, v in shape.items()):
+                return True
+        for n in nodes:
+            total = n.get("resources_total", {})
+            if all(total.get(k, 0) >= v for k, v in shape.items()):
+                return True
+        return False
+
+    def _provider_node_for(self, gcs_node: dict) -> str | None:
+        """Match a GCS node to its provider node via the provider_node_id
+        label the provider stamps on every node it launches."""
+        want = (gcs_node.get("labels", {}) or {}).get("provider_node_id")
+        if want and want in self.provider.non_terminated_nodes():
+            return want
+        return None
+
+    def shutdown(self):
+        self.provider.shutdown()

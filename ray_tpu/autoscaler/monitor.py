@@ -1,0 +1,38 @@
+"""Autoscaler monitor — the background reconcile loop.
+
+Analog of the reference's monitor process (autoscaler/_private/monitor.py):
+runs StandardAutoscaler.update() on a fixed tick. Runs as a thread next to
+the head node (this framework's daemons are in-process, see _private/node.py)
+rather than a separate OS process.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+logger = logging.getLogger(__name__)
+
+
+class Monitor:
+    def __init__(self, config: dict, interval_s: float = 5.0):
+        from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+
+        self.autoscaler = StandardAutoscaler(config)
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, name="autoscaler-monitor", daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.autoscaler.update()
+            except Exception:
+                logger.exception("autoscaler tick failed")
+
+    def stop(self, terminate_nodes: bool = True):
+        self._stop.set()
+        self._thread.join(timeout=10)
+        if terminate_nodes:
+            self.autoscaler.shutdown()

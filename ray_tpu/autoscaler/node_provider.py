@@ -1,0 +1,157 @@
+"""Node providers.
+
+Analog of the reference's pluggable NodeProvider
+(python/ray/autoscaler/node_provider.py; fake test provider
+autoscaler/_private/fake_multi_node/node_provider.py; GCP TPU provisioning
+autoscaler/_private/gcp/node_provider.py + tpu.yaml): providers own the
+machine lifecycle; the autoscaler only decides counts per node type.
+
+``FakeMultiNodeProvider`` launches real worker-node processes on this host
+(the multi-node-without-a-cluster trick) so autoscaling is testable
+end-to-end. ``TPUPodProvider`` documents the GCE/TPU-VM shape but is gated —
+this environment has no cloud egress.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import uuid
+
+
+class NodeProvider:
+    """Provider interface (create/terminate/list)."""
+
+    def __init__(self, provider_config: dict, cluster_name: str):
+        self.provider_config = provider_config
+        self.cluster_name = cluster_name
+
+    def non_terminated_nodes(self) -> list[str]:
+        raise NotImplementedError
+
+    def node_tags(self, node_id: str) -> dict:
+        raise NotImplementedError
+
+    def create_node(self, node_config: dict, tags: dict, count: int) -> list[str]:
+        raise NotImplementedError
+
+    def terminate_node(self, node_id: str):
+        raise NotImplementedError
+
+    def is_running(self, node_id: str) -> bool:
+        return node_id in self.non_terminated_nodes()
+
+    def shutdown(self):
+        for nid in list(self.non_terminated_nodes()):
+            self.terminate_node(nid)
+
+
+class FakeMultiNodeProvider(NodeProvider):
+    """Worker nodes as local subprocesses joining the head's GCS.
+
+    Each created node runs ``python -m ray_tpu.scripts.scripts start
+    --address <gcs> --block`` in its own session with the node type's
+    resources, so the autoscaled "machines" are real raylets with real worker
+    pools — exactly what the reference's fake_multi_node provider simulates.
+    """
+
+    def __init__(self, provider_config: dict, cluster_name: str):
+        super().__init__(provider_config, cluster_name)
+        self.gcs_address = provider_config["gcs_address"]  # "host:port"
+        self._nodes: dict[str, dict] = {}  # provider node id -> {proc, tags}
+        self._lock = threading.Lock()
+
+    def non_terminated_nodes(self) -> list[str]:
+        with self._lock:
+            dead = [nid for nid, n in self._nodes.items() if n["proc"].poll() is not None]
+            for nid in dead:
+                del self._nodes[nid]
+            return list(self._nodes)
+
+    def node_tags(self, node_id: str) -> dict:
+        with self._lock:
+            node = self._nodes.get(node_id)
+            return dict(node["tags"]) if node else {}
+
+    def create_node(self, node_config: dict, tags: dict, count: int) -> list[str]:
+        created = []
+        for _ in range(count):
+            nid = f"fake-{uuid.uuid4().hex[:8]}"
+            resources = dict(node_config.get("resources", {}))
+            num_cpus = resources.pop("CPU", 1)
+            num_tpus = resources.pop("TPU", 0)
+            cmd = [
+                sys.executable,
+                "-m",
+                "ray_tpu.scripts.scripts",
+                "start",
+                "--address",
+                self.gcs_address,
+                "--num-cpus",
+                str(int(num_cpus)),
+                "--num-tpus",
+                str(int(num_tpus)),
+                # The label lets the autoscaler match this provider node to
+                # its GCS node record exactly.
+                "--labels",
+                json.dumps({"provider_node_id": nid}),
+                "--block",
+            ]
+            if resources:
+                cmd += ["--resources", json.dumps(resources)]
+            env = dict(os.environ)
+            env["PYTHONPATH"] = (
+                os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+                + os.pathsep
+                + env.get("PYTHONPATH", "")
+            )
+            log_dir = "/tmp/ray_tpu/autoscaler_nodes"
+            os.makedirs(log_dir, exist_ok=True)
+            log_f = open(os.path.join(log_dir, f"{nid}.log"), "ab")
+            proc = subprocess.Popen(
+                cmd, stdout=log_f, stderr=subprocess.STDOUT, env=env, start_new_session=True
+            )
+            with self._lock:
+                self._nodes[nid] = {"proc": proc, "tags": dict(tags), "created": time.time()}
+            created.append(nid)
+        return created
+
+    def terminate_node(self, node_id: str):
+        with self._lock:
+            node = self._nodes.pop(node_id, None)
+        if node is None:
+            return
+        proc = node["proc"]
+        if proc.poll() is None:
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+            except Exception:
+                proc.terminate()
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+class TPUPodProvider(NodeProvider):
+    """TPU pod-slice provisioning via GCE TPU-VM API (reference:
+    autoscaler/_private/gcp/node_provider.py + autoscaler/gcp/tpu.yaml).
+
+    Each node type maps to an ``accelerator_type`` (e.g. ``v5e-8``) and one
+    created "node" is one TPU VM worker of a slice. Gated: requires cloud
+    credentials and network egress, neither of which exist in this
+    environment — instantiating raises with setup instructions.
+    """
+
+    def __init__(self, provider_config: dict, cluster_name: str):
+        raise RuntimeError(
+            "TPUPodProvider requires GCP credentials and network egress. "
+            "Configure provider.type=fake for local testing, or run on a GCP "
+            "project with the TPU API enabled (fields: project_id, zone, "
+            "accelerator_type, runtime_version)."
+        )

@@ -1,0 +1,89 @@
+"""Resource demand → node launch planning.
+
+Analog of the reference's ResourceDemandScheduler
+(autoscaler/_private/resource_demand_scheduler.py:101): first-fit bin-packing
+of pending resource shapes onto existing capacity, then greedy selection of
+new nodes from the configured node types for whatever doesn't fit.
+"""
+
+from __future__ import annotations
+
+
+def _fits(avail: dict, shape: dict) -> bool:
+    return all(avail.get(k, 0) >= v for k, v in shape.items())
+
+
+def _take(avail: dict, shape: dict):
+    for k, v in shape.items():
+        avail[k] = avail.get(k, 0) - v
+
+
+class ResourceDemandScheduler:
+    def __init__(self, node_types: dict[str, dict], max_workers: int):
+        """``node_types``: name -> {"resources": {...}, "max_workers": int}."""
+        self.node_types = node_types
+        self.max_workers = max_workers
+
+    def get_nodes_to_launch(
+        self,
+        existing_avail: list[dict],
+        demands: list[dict],
+        counts_by_type: dict[str, int],
+        total_existing: int,
+    ) -> dict[str, int]:
+        """Plan launches.
+
+        - ``existing_avail``: available-resource dicts of current nodes
+          (copies; consumed during planning).
+        - ``demands``: resource shapes, one entry per pending unit.
+        - ``counts_by_type``: current worker count per node type.
+        Returns {node_type: count_to_launch}.
+        """
+        avail = [dict(a) for a in existing_avail]
+        unmet: list[dict] = []
+        # Pack biggest demands first so small ones fill the gaps.
+        for shape in sorted(demands, key=lambda s: -sum(s.values())):
+            placed = False
+            for a in avail:
+                if _fits(a, shape):
+                    _take(a, shape)
+                    placed = True
+                    break
+            if not placed:
+                unmet.append(shape)
+        if not unmet:
+            return {}
+
+        to_launch: dict[str, int] = {}
+        counts = dict(counts_by_type)
+        total = total_existing
+        pending_new: list[tuple[str, dict]] = []  # (type, remaining avail)
+        for shape in unmet:
+            placed = False
+            for _, a in pending_new:
+                if _fits(a, shape):
+                    _take(a, shape)
+                    placed = True
+                    break
+            if placed:
+                continue
+            # Pick the cheapest node type that can hold the shape (fewest
+            # total resources — avoids launching a TPU pod for a CPU task).
+            candidates = []
+            for name, nt in self.node_types.items():
+                res = nt.get("resources", {})
+                if not _fits(dict(res), shape):
+                    continue
+                if counts.get(name, 0) >= nt.get("max_workers", self.max_workers):
+                    continue
+                candidates.append((sum(res.values()), name, res))
+            if not candidates or total >= self.max_workers:
+                continue  # infeasible or at cluster cap; demand stays unmet
+            _, name, res = min(candidates, key=lambda c: (c[0], c[1]))
+            a = dict(res)
+            _take(a, shape)
+            pending_new.append((name, a))
+            to_launch[name] = to_launch.get(name, 0) + 1
+            counts[name] = counts.get(name, 0) + 1
+            total += 1
+        return to_launch

@@ -119,12 +119,14 @@ def cmd_start(args):
     from ray_tpu._private.node import Node
 
     resources = json.loads(args.resources) if args.resources else None
+    labels = json.loads(args.labels) if args.labels else None
     if args.head:
         node = Node(
             head=True,
             num_cpus=args.num_cpus,
             num_tpus=args.num_tpus,
             resources=resources,
+            labels=labels,
             object_store_memory=args.object_store_memory,
         )
         dashboard = None
@@ -150,6 +152,16 @@ def cmd_start(args):
                 },
                 f,
             )
+        monitor = None
+        if args.autoscaling_config:
+            with open(args.autoscaling_config) as f:
+                as_config = json.load(f)
+            as_config.setdefault("provider", {})
+            as_config["provider"].setdefault("type", "fake")
+            as_config["provider"]["gcs_address"] = "%s:%d" % tuple(node.gcs_address)
+            from ray_tpu.autoscaler import Monitor
+
+            monitor = Monitor(as_config)
         marker = CLUSTER_FILE
         if args.ready_file:
             with open(args.ready_file, "w") as f:
@@ -163,9 +175,11 @@ def cmd_start(args):
             num_cpus=args.num_cpus,
             num_tpus=args.num_tpus,
             resources=resources,
+            labels=labels,
             object_store_memory=args.object_store_memory,
         )
         dashboard = None
+        monitor = None
         os.makedirs(NODES_DIR, exist_ok=True)
         marker = os.path.join(NODES_DIR, f"node_{os.getpid()}.json")
         with open(marker, "w") as f:
@@ -185,6 +199,8 @@ def cmd_start(args):
         while not stop_evt["stop"]:
             time.sleep(0.5)
     finally:
+        if monitor is not None:
+            monitor.stop()
         if dashboard is not None:
             dashboard.stop()
         node.stop()
@@ -421,10 +437,16 @@ def main(argv=None):
     p.add_argument("--num-cpus", type=int, default=None)
     p.add_argument("--num-tpus", type=int, default=None)
     p.add_argument("--resources", help="JSON dict of custom resources")
+    p.add_argument("--labels", help="JSON dict of node labels")
     p.add_argument("--object-store-memory", type=int, default=None)
     p.add_argument("--dashboard-host", default="127.0.0.1")
     p.add_argument("--dashboard-port", type=int, default=8265)
     p.add_argument("--no-dashboard", action="store_true")
+    p.add_argument(
+        "--autoscaling-config",
+        default=None,
+        help="JSON file with autoscaler config (node_types, max_workers, ...)",
+    )
     p.add_argument("--block", action="store_true", help="run in the foreground")
     p.add_argument("--ready-file", default=None, help=argparse.SUPPRESS)
     p.set_defaults(fn=cmd_start)

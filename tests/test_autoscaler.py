@@ -1,0 +1,214 @@
+"""Autoscaler tests.
+
+Modeled on the reference's test_resource_demand_scheduler.py and
+test_autoscaler_fake_multinode.py: pure planning-logic units plus an
+end-to-end scale-up/scale-down flow against a real head node with the fake
+multi-node provider launching real worker processes.
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import StandardAutoscaler
+from ray_tpu.autoscaler.resource_demand_scheduler import ResourceDemandScheduler
+
+
+class TestResourceDemandScheduler:
+    def test_no_launch_when_demand_fits(self):
+        s = ResourceDemandScheduler({"cpu": {"resources": {"CPU": 4}, "max_workers": 4}}, 8)
+        plan = s.get_nodes_to_launch(
+            existing_avail=[{"CPU": 4}],
+            demands=[{"CPU": 1}, {"CPU": 2}],
+            counts_by_type={},
+            total_existing=0,
+        )
+        assert plan == {}
+
+    def test_launch_for_unmet_demand(self):
+        s = ResourceDemandScheduler({"cpu": {"resources": {"CPU": 2}, "max_workers": 4}}, 8)
+        plan = s.get_nodes_to_launch(
+            existing_avail=[{"CPU": 0}],
+            demands=[{"CPU": 1}] * 5,
+            counts_by_type={},
+            total_existing=0,
+        )
+        assert plan == {"cpu": 3}  # 5 x CPU:1 onto CPU:2 nodes
+
+    def test_picks_cheapest_feasible_type(self):
+        s = ResourceDemandScheduler(
+            {
+                "cpu": {"resources": {"CPU": 2}, "max_workers": 4},
+                "tpu": {"resources": {"CPU": 8, "TPU": 4}, "max_workers": 2},
+            },
+            8,
+        )
+        plan = s.get_nodes_to_launch([], [{"CPU": 1}], {}, 0)
+        assert plan == {"cpu": 1}
+        plan = s.get_nodes_to_launch([], [{"TPU": 4}], {}, 0)
+        assert plan == {"tpu": 1}
+
+    def test_respects_max_workers(self):
+        s = ResourceDemandScheduler({"cpu": {"resources": {"CPU": 1}, "max_workers": 2}}, 8)
+        plan = s.get_nodes_to_launch([], [{"CPU": 1}] * 5, {"cpu": 1}, 1)
+        assert plan == {"cpu": 1}  # type cap 2, one already exists
+
+    def test_infeasible_demand_ignored(self):
+        s = ResourceDemandScheduler({"cpu": {"resources": {"CPU": 2}, "max_workers": 4}}, 8)
+        plan = s.get_nodes_to_launch([], [{"GPU": 1}], {}, 0)
+        assert plan == {}
+
+
+class _RecordingProvider:
+    """Provider stub recording create/terminate calls."""
+
+    def __init__(self):
+        self.created = []
+        self.terminated = []
+        self._alive = []
+
+    def non_terminated_nodes(self):
+        return list(self._alive)
+
+    def node_tags(self, nid):
+        return {}
+
+    def create_node(self, node_config, tags, count):
+        out = []
+        for i in range(count):
+            nid = f"stub-{len(self.created)}"
+            self.created.append((nid, node_config))
+            self._alive.append(nid)
+            out.append(nid)
+        return out
+
+    def terminate_node(self, nid):
+        self.terminated.append(nid)
+        self._alive.remove(nid)
+
+    def shutdown(self):
+        pass
+
+
+def test_autoscaler_launches_for_pending_pg(ray_start_regular):
+    """A PENDING STRICT_PACK placement group produces a merged gang demand."""
+    provider = _RecordingProvider()
+    node = ray_tpu._global_node
+    config = {
+        "cluster_name": "t",
+        "max_workers": 4,
+        "idle_timeout_s": 9999,
+        "provider": {"type": "fake", "gcs_address": "%s:%d" % tuple(node.gcs_address)},
+        "node_types": {"big": {"resources": {"CPU": 16}, "max_workers": 2}},
+    }
+    scaler = StandardAutoscaler(config, provider=provider)
+    from ray_tpu.util.placement_group import placement_group
+
+    pg = placement_group([{"CPU": 8}, {"CPU": 8}], strategy="STRICT_PACK")
+    # Head has only 4 CPUs -> PG stays PENDING -> autoscaler wants one `big`.
+    scaler.update()
+    assert len(provider.created) == 1
+    assert provider.created[0][1]["resources"] == {"CPU": 16}
+    # Second tick: demand still pending but a node of that type is already
+    # launching (counted), so no duplicate launch beyond the cap logic.
+    scaler.update()
+    assert len(provider.created) <= 2
+
+
+def test_no_relaunch_while_node_boots(ray_start_regular):
+    """A launched-but-unregistered node's capacity covers the demand, so the
+    same pending PG must not launch a second node on the next tick."""
+    provider = _RecordingProvider()
+    node = ray_tpu._global_node
+    config = {
+        "cluster_name": "t",
+        "max_workers": 8,
+        "idle_timeout_s": 9999,
+        "provider": {"type": "fake", "gcs_address": "%s:%d" % tuple(node.gcs_address)},
+        "node_types": {"big": {"resources": {"CPU": 16}, "max_workers": 8}},
+    }
+    scaler = StandardAutoscaler(config, provider=provider)
+    from ray_tpu.util.placement_group import placement_group
+
+    placement_group([{"CPU": 16}], strategy="STRICT_PACK")
+    for _ in range(3):
+        scaler.update()
+    # Stub nodes never register with the GCS, so they stay "booting";
+    # their capacity must still absorb the demand after the first launch.
+    assert len(provider.created) == 1
+
+
+def test_infeasible_demand_does_not_pin_idle_nodes(ray_start_regular):
+    """Demand no node type can satisfy must not block idle termination."""
+    provider = _RecordingProvider()
+    node = ray_tpu._global_node
+    config = {
+        "cluster_name": "t",
+        "max_workers": 4,
+        "idle_timeout_s": 9999,
+        "provider": {"type": "fake", "gcs_address": "%s:%d" % tuple(node.gcs_address)},
+        "node_types": {"cpu": {"resources": {"CPU": 2}, "max_workers": 4}},
+    }
+    scaler = StandardAutoscaler(config, provider=provider)
+    from ray_tpu.util.placement_group import placement_group
+
+    placement_group([{"GPU": 1}], strategy="PACK")  # never satisfiable
+    scaler.update()
+    assert provider.created == []
+    # Feasibility classifier: GPU demand matches no node type and no node;
+    # CPU demand matches the cpu node type. The idle-termination path only
+    # yields to feasible demand.
+    assert scaler._shape_feasible({"GPU": 1}, []) is False
+    assert scaler._shape_feasible({"CPU": 1}, []) is True
+    # update() must reach the idle-termination block (no early busy-return):
+    # with an infeasible pending PG the idle clock for a fake worker entry
+    # still advances.
+    scaler._idle_since["sentinel"] = 1.0
+    scaler.update()
+    assert "sentinel" in scaler._idle_since  # not cleared by infeasible demand
+
+
+def test_autoscaler_end_to_end_scale_up_down():
+    """Real flow: queued tasks -> fake provider launches a real worker node ->
+    tasks run -> node terminated after idling."""
+    ray_tpu.init(num_cpus=1, object_store_memory=64 * 1024 * 1024)
+    node = ray_tpu._global_node
+    config = {
+        "cluster_name": "e2e",
+        "max_workers": 1,
+        "idle_timeout_s": 3,
+        "provider": {"type": "fake", "gcs_address": "%s:%d" % tuple(node.gcs_address)},
+        "node_types": {"cpu_worker": {"resources": {"CPU": 2}, "max_workers": 1}},
+    }
+    scaler = StandardAutoscaler(config)
+    try:
+
+        @ray_tpu.remote(num_cpus=2)
+        def two_cpu_task():
+            return os.getpid()
+
+        ref = two_cpu_task.remote()  # needs 2 CPUs; head has 1 -> queued
+        deadline = time.time() + 90
+        launched = False
+        while time.time() < deadline:
+            scaler.update()
+            if scaler.provider.non_terminated_nodes():
+                launched = True
+                break
+            time.sleep(1)
+        assert launched, "autoscaler never launched a worker node"
+        # The task must complete on the new node.
+        assert isinstance(ray_tpu.get(ref, timeout=90), int)
+        # After going idle, the node is terminated.
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            scaler.update()
+            if not scaler.provider.non_terminated_nodes():
+                break
+            time.sleep(1)
+        assert not scaler.provider.non_terminated_nodes(), "idle node was not terminated"
+    finally:
+        scaler.shutdown()
+        ray_tpu.shutdown()
