@@ -74,6 +74,7 @@ def init(
     object_store_memory: int | None = None,
     namespace: str = "",
     labels: dict | None = None,
+    runtime_env: dict | None = None,
     ignore_reinit_error: bool = False,
     _system_config: dict | None = None,
 ):
@@ -152,6 +153,7 @@ def init(
             node_id=node_id,
             session_dir=session_dir,
             namespace=namespace,
+            job_runtime_env=runtime_env,
         )
         worker_context.set_core_worker(cw)
     _install_driver_hooks()

@@ -92,12 +92,16 @@ class CoreWorker:
         job_id: JobID | None = None,
         worker_id: str | None = None,
         namespace: str = "",
+        job_runtime_env: dict | None = None,
     ):
         self.mode = mode
         self.cfg = get_config()
         self.node_id = node_id
         self.session_dir = session_dir
         self.namespace = namespace
+        # Job-level runtime env (ray.init(runtime_env=...)): merged under
+        # every task/actor-level env at submit time (reference: job_config).
+        self.job_runtime_env = dict(job_runtime_env or {})
         self.worker_id = worker_id or WorkerID.from_random().hex()
         self._io = EventLoopThread.get()
 
@@ -292,7 +296,7 @@ class CoreWorker:
             placement_group_id=opts.get("placement_group_id", ""),
             placement_group_bundle_index=opts.get("placement_group_bundle_index", -1),
             scheduling_strategy=opts.get("scheduling_strategy", "DEFAULT"),
-            runtime_env=opts.get("runtime_env") or {},
+            runtime_env=self._merged_runtime_env(opts.get("runtime_env")),
         )
         self._register_pending(spec, arg_refs)
         self.record_task_event(spec, "PENDING_ARGS_AVAIL")
@@ -301,6 +305,42 @@ class CoreWorker:
             ObjectRef(ObjectID.for_return(task_id, i), self.address)
             for i in range(num_returns)
         ]
+
+    def _merged_runtime_env(self, task_env: dict | None) -> dict:
+        """Task/actor env over the job-level env; env_vars dicts merge."""
+        if not self.job_runtime_env:
+            merged = dict(task_env or {})
+        elif not task_env:
+            merged = dict(self.job_runtime_env)
+        else:
+            merged = dict(self.job_runtime_env)
+            for key, value in task_env.items():
+                if key == "env_vars" and isinstance(merged.get("env_vars"), dict):
+                    merged["env_vars"] = {**merged["env_vars"], **(value or {})}
+                else:
+                    merged[key] = value
+        from ray_tpu.runtime_env import UNSUPPORTED_FIELDS
+
+        unsupported = set(merged) & UNSUPPORTED_FIELDS
+        if unsupported:
+            # Fail at submission, not in a crash-looping worker: provisioning
+            # packages needs network access this environment doesn't have.
+            raise ValueError(
+                f"runtime_env fields {sorted(unsupported)} require package "
+                "installation, which is not supported; pre-install "
+                "dependencies on the node image instead"
+            )
+        # Validate paths here too — a worker that dies in env setup before
+        # registering would otherwise crash-loop while the task hangs.
+        import os as _os
+
+        wd = merged.get("working_dir")
+        if wd and not _os.path.isdir(wd):
+            raise ValueError(f"runtime_env working_dir {wd!r} is not a directory")
+        for p in merged.get("py_modules") or []:
+            if not _os.path.exists(p):
+                raise ValueError(f"runtime_env py_modules path {p!r} does not exist")
+        return merged
 
     def _submit_when_ready(self, spec: TaskSpec, arg_refs: list):
         """Submitter-side dependency resolution (reference:
@@ -710,6 +750,7 @@ class CoreWorker:
             placement_group_id=opts.get("placement_group_id", ""),
             placement_group_bundle_index=opts.get("placement_group_bundle_index", -1),
             scheduling_strategy=opts.get("scheduling_strategy", "DEFAULT"),
+            runtime_env=self._merged_runtime_env(opts.get("runtime_env")),
         )
         for ref in arg_refs:
             self._pin_arg(ref)

@@ -94,6 +94,7 @@ class GcsServer:
         node["resources_available"] = req.get("resources_available", node["resources_available"])
         node["store_usage"] = req.get("store_usage", node["store_usage"])
         node["load"] = req.get("load", [])
+        node["num_active_workers"] = req.get("num_active_workers", 0)
         # Return the cluster resource view: this doubles as the resource
         # syncer (reference: src/ray/common/ray_syncer/ray_syncer.h:86).
         return {"ok": True, "nodes": self._cluster_view()}

@@ -45,6 +45,15 @@ logger = logging.getLogger(__name__)
 CHUNK = 4 * 1024 * 1024
 
 
+def _runtime_env_hash(runtime_env: dict | None) -> str | None:
+    """Canonical hash for worker<->task runtime-env matching."""
+    if not runtime_env:
+        return None
+    import hashlib
+
+    return hashlib.md5(json.dumps(runtime_env, sort_keys=True).encode()).hexdigest()[:16]
+
+
 @dataclass
 class WorkerHandle:
     worker_id: str
@@ -53,6 +62,9 @@ class WorkerHandle:
     client: RpcClient | None = None
     proc: subprocess.Popen | None = None
     state: str = "starting"  # starting | idle | busy | actor | dead
+    # Workers are dedicated to one runtime env (reference: worker_pool.cc
+    # caches workers per runtime-env hash); None = plain environment.
+    runtime_env_hash: str | None = None
     current_task: TaskSpec | None = None
     # Creation spec of the actor living in this worker; actors hold their
     # resources for life, so these are released only on worker death.
@@ -134,6 +146,13 @@ class Raylet:
                         # Resource demand by shape (reference: resource load
                         # reporting in ray_syncer / autoscaler demand input).
                         "load": self._pending_load(),
+                        # Occupancy: actors may hold zero resources, so the
+                        # autoscaler must not treat resource-idle as idle.
+                        "num_active_workers": sum(
+                            1
+                            for w in self.workers.values()
+                            if w.state in ("busy", "actor")
+                        ),
                     },
                 )
                 if resp.get("dead"):
@@ -482,12 +501,18 @@ class Raylet:
                 if any(pool.get(k, 0) < v for k, v in spec.resources.items()):
                     self.task_queue.append(spec)
                     continue
-                worker = self._pop_idle_worker()
+                spec_env_hash = _runtime_env_hash(spec.runtime_env)
+                worker = self._pop_idle_worker(spec_env_hash)
                 if worker is None:
                     # Start enough workers for the whole backlog at once
                     # (reference prestarts workers too, worker_pool.cc:426);
                     # spawning serially would add one startup latency per task.
                     starting = sum(1 for w in self.workers.values() if w.state == "starting")
+                    starting_matching = sum(
+                        1
+                        for w in self.workers.values()
+                        if w.state == "starting" and w.runtime_env_hash == spec_env_hash
+                    )
                     # Workers dedicated to actors never come back to the pool;
                     # only count pool workers against the CPU-sized target.
                     pool_workers = sum(
@@ -499,6 +524,24 @@ class Raylet:
                         cpu_cap - pool_workers,
                         self.cfg.max_workers_per_node - self._num_live_workers(),
                     )
+                    if deficit <= 0 and starting_matching == 0:
+                        # Pool is full but no worker for THIS runtime env is
+                        # idle or coming: evict one surplus idle worker of a
+                        # different env to make room (reference: worker_pool
+                        # kills idle workers of other envs under pressure).
+                        victim = next(
+                            (
+                                w
+                                for w in self.workers.values()
+                                if w.state == "idle" and w.runtime_env_hash != spec_env_hash
+                            ),
+                            None,
+                        )
+                        if victim is not None:
+                            victim.state = "dead"
+                            if victim.proc is not None:
+                                victim.proc.terminate()
+                            deficit = 1
                     if (
                         deficit <= 0
                         and starting == 0
@@ -509,8 +552,15 @@ class Raylet:
                         # blocked on results of queued tasks (nested tasks);
                         # after 2s without dispatch progress, oversubscribe.
                         deficit = 1
-                    for _ in range(max(deficit, 0)):
-                        self._start_worker()
+                    # Start workers dedicated to the runtime envs of the
+                    # tasks actually waiting (head of queue first).
+                    pending_envs = [spec.runtime_env] + [
+                        s.runtime_env for s in list(self.task_queue)
+                    ]
+                    for i in range(max(deficit, 0)):
+                        self._start_worker(
+                            pending_envs[i] if i < len(pending_envs) else None
+                        )
                     self.task_queue.appendleft(spec)
                     return
                 for k, v in spec.resources.items():
@@ -533,9 +583,9 @@ class Raylet:
             logger.exception("push_task to worker %s failed", worker.worker_id[:8])
             await self._on_worker_death(worker, "push_task failed")
 
-    def _pop_idle_worker(self) -> WorkerHandle | None:
+    def _pop_idle_worker(self, runtime_env_hash: str | None = None) -> WorkerHandle | None:
         for w in self.workers.values():
-            if w.state == "idle":
+            if w.state == "idle" and w.runtime_env_hash == runtime_env_hash:
                 return w
         return None
 
@@ -544,9 +594,11 @@ class Raylet:
 
     # ---- worker pool (reference: worker_pool.cc) ----
 
-    def _start_worker(self):
+    def _start_worker(self, runtime_env: dict | None = None):
         worker_id = WorkerID.from_random().hex()
         env = os.environ.copy()
+        if runtime_env:
+            env["RAY_TPU_RUNTIME_ENV"] = json.dumps(runtime_env)
         env["RAY_TPU_WORKER_ID"] = worker_id
         env["RAY_TPU_NODE_ID"] = self.node_id
         env["RAY_TPU_RAYLET_ADDR"] = json.dumps(list(self.address))
@@ -571,7 +623,12 @@ class Raylet:
             stderr=stderr,
             cwd=os.getcwd(),
         )
-        self.workers[worker_id] = WorkerHandle(worker_id=worker_id, pid=proc.pid, proc=proc)
+        self.workers[worker_id] = WorkerHandle(
+            worker_id=worker_id,
+            pid=proc.pid,
+            proc=proc,
+            runtime_env_hash=_runtime_env_hash(runtime_env),
+        )
 
     async def rpc_register_worker(self, req):
         worker_id = req["worker_id"]

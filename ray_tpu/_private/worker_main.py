@@ -141,11 +141,42 @@ class WorkerExecutor:
         return {"ok": True}
 
 
+def _apply_runtime_env(raw: str | None):
+    """Apply this worker's runtime env before anything else imports.
+
+    Reference: _private/runtime_env/ plugins — env_vars, working_dir and
+    py_modules are fully supported; pip/conda/container provisioning needs
+    package installation (network) and is rejected up-front so tasks fail
+    with a clear error instead of silently running in the wrong env.
+    """
+    if not raw:
+        return
+    from ray_tpu.runtime_env import UNSUPPORTED_FIELDS
+
+    renv = json.loads(raw)
+    unsupported = set(renv) & UNSUPPORTED_FIELDS
+    if unsupported:
+        raise RuntimeError(
+            f"runtime_env fields {sorted(unsupported)} require package "
+            "installation, which this environment does not support; "
+            "pre-install dependencies on the node image instead"
+        )
+    for key, value in (renv.get("env_vars") or {}).items():
+        os.environ[str(key)] = str(value)
+    working_dir = renv.get("working_dir")
+    if working_dir:
+        os.chdir(working_dir)
+        sys.path.insert(0, working_dir)
+    for mod_path in renv.get("py_modules") or []:
+        sys.path.insert(0, mod_path)
+
+
 def main():
     logging.basicConfig(
         level=logging.INFO,
         format=f"[worker %(process)d] %(levelname)s %(name)s: %(message)s",
     )
+    _apply_runtime_env(os.environ.get("RAY_TPU_RUNTIME_ENV"))
     worker_id = os.environ["RAY_TPU_WORKER_ID"]
     node_id = os.environ["RAY_TPU_NODE_ID"]
     raylet_addr = json.loads(os.environ["RAY_TPU_RAYLET_ADDR"])
@@ -166,6 +197,7 @@ def main():
     from ray_tpu._private.core_worker import WORKER, CoreWorker
     from ray_tpu._private.ids import JobID
 
+    worker_env = os.environ.get("RAY_TPU_RUNTIME_ENV")
     cw = CoreWorker(
         mode=WORKER,
         gcs_address=gcs_addr,
@@ -175,6 +207,9 @@ def main():
         session_dir=session_dir,
         job_id=JobID.from_int(0),
         worker_id=worker_id,
+        # Nested tasks inherit this worker's runtime env by default
+        # (reference semantics: children inherit the parent's env).
+        job_runtime_env=json.loads(worker_env) if worker_env else None,
     )
     worker_context.set_core_worker(cw)
     executor = WorkerExecutor(cw, cw.raylet)

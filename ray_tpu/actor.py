@@ -130,6 +130,7 @@ class ActorClass:
             max_restarts=opts.get("max_restarts", 0),
             max_task_retries=opts.get("max_task_retries", 0),
             max_concurrency=opts.get("max_concurrency", 1),
+            runtime_env=opts.get("runtime_env"),
             **_scheduling_opts(opts),
         )
         return ActorHandle(

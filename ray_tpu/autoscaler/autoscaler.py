@@ -57,6 +57,10 @@ class StandardAutoscaler:
         self.idle_timeout_s = config.get("idle_timeout_s", 60.0)
         # provider node id -> node type
         self._node_type_of: dict[str, str] = {}
+        # provider node id -> launch ts; nodes that never register within
+        # boot_timeout_s are recycled so their demand can re-launch.
+        self._launch_time: dict[str, float] = {}
+        self.boot_timeout_s = config.get("boot_timeout_s", 120.0)
         # gcs node id -> first time seen fully idle
         self._idle_since: dict[str, float] = {}
         self._head_node_id: str | None = None
@@ -119,6 +123,16 @@ class StandardAutoscaler:
             if t:
                 counts_by_type[t] = counts_by_type.get(t, 0) + 1
             if nid not in registered and t in self.config.get("node_types", {}):
+                launched = self._launch_time.get(nid)
+                if launched is not None and time.time() - launched > self.boot_timeout_s:
+                    # Never registered within the boot timeout: recycle it so
+                    # the pending demand can launch a replacement.
+                    logger.warning("autoscaler: node %s failed to boot; recycling", nid)
+                    self.provider.terminate_node(nid)
+                    self._node_type_of.pop(nid, None)
+                    self._launch_time.pop(nid, None)
+                    counts_by_type[t] = counts_by_type.get(t, 1) - 1
+                    continue
                 # Launched but not yet registered with the GCS: count its
                 # full capacity so the same demand doesn't re-launch a node
                 # on every tick while the first one boots.
@@ -137,6 +151,7 @@ class StandardAutoscaler:
             )
             for nid in created:
                 self._node_type_of[nid] = node_type
+                self._launch_time[nid] = time.time()
 
         # ---- idle termination ----
         now = time.time()
@@ -152,7 +167,10 @@ class StandardAutoscaler:
             if n["node_id"] == self._head_node_id:
                 continue
             total, avail = n.get("resources_total", {}), n.get("resources_available", {})
-            if all(avail.get(k, 0) >= v for k, v in total.items()):
+            resources_idle = all(avail.get(k, 0) >= v for k, v in total.items())
+            # Zero-resource actors don't show in the ledger; never reap a
+            # node with active workers.
+            if resources_idle and n.get("num_active_workers", 0) == 0:
                 first = self._idle_since.setdefault(n["node_id"], now)
                 if now - first >= self.idle_timeout_s:
                     idle_gcs_nodes.append(n)

@@ -113,9 +113,14 @@ class FakeMultiNodeProvider(NodeProvider):
             log_dir = "/tmp/ray_tpu/autoscaler_nodes"
             os.makedirs(log_dir, exist_ok=True)
             log_f = open(os.path.join(log_dir, f"{nid}.log"), "ab")
-            proc = subprocess.Popen(
-                cmd, stdout=log_f, stderr=subprocess.STDOUT, env=env, start_new_session=True
-            )
+            try:
+                proc = subprocess.Popen(
+                    cmd, stdout=log_f, stderr=subprocess.STDOUT, env=env, start_new_session=True
+                )
+            finally:
+                # The child inherited the fd; keeping the parent copy open
+                # leaks one fd per launch in the monitor process.
+                log_f.close()
             with self._lock:
                 self._nodes[nid] = {"proc": proc, "tags": dict(tags), "created": time.time()}
             created.append(nid)

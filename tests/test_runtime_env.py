@@ -1,0 +1,154 @@
+"""Runtime-env tests.
+
+Modeled on the reference's python/ray/tests/test_runtime_env*.py: env_vars
+visible in tasks and actors, working_dir/py_modules imports, job-level env
+merging, dedicated workers per env, and unsupported-field rejection.
+"""
+
+import os
+import sys
+
+import pytest
+
+import ray_tpu
+from ray_tpu.runtime_env import RuntimeEnv
+
+
+def test_env_vars_in_task(ray_start_regular):
+    @ray_tpu.remote(runtime_env={"env_vars": {"RTENV_PROBE": "hello"}})
+    def read_env():
+        return os.environ.get("RTENV_PROBE")
+
+    assert ray_tpu.get(read_env.remote()) == "hello"
+
+    # A plain task must NOT see that env (dedicated workers per env).
+    @ray_tpu.remote
+    def read_plain():
+        return os.environ.get("RTENV_PROBE")
+
+    assert ray_tpu.get(read_plain.remote()) is None
+
+
+def test_env_vars_in_actor(ray_start_regular):
+    @ray_tpu.remote(runtime_env={"env_vars": {"RTENV_ACTOR": "yes"}})
+    class A:
+        def probe(self):
+            return os.environ.get("RTENV_ACTOR")
+
+    assert ray_tpu.get(A.remote().probe.remote()) == "yes"
+
+
+def test_py_modules_import(ray_start_regular, tmp_path):
+    mod_dir = tmp_path / "mods"
+    mod_dir.mkdir()
+    (mod_dir / "rtenv_probe_mod.py").write_text("VALUE = 'imported-from-py-modules'\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(mod_dir)]})
+    def use_module():
+        import rtenv_probe_mod
+
+        return rtenv_probe_mod.VALUE
+
+    assert ray_tpu.get(use_module.remote()) == "imported-from-py-modules"
+
+
+def test_working_dir(ray_start_regular, tmp_path):
+    (tmp_path / "data.txt").write_text("working-dir-content")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(tmp_path)})
+    def read_file():
+        with open("data.txt") as f:
+            return f.read()
+
+    assert ray_tpu.get(read_file.remote()) == "working-dir-content"
+
+
+def test_env_worker_evicts_idle_plain_worker():
+    """With the pool at the CPU cap and only plain idle workers, a task
+    needing a dedicated runtime env must still run promptly (the pool evicts
+    a surplus idle worker of another env)."""
+    import time
+
+    ray_tpu.init(num_cpus=1, object_store_memory=64 * 1024 * 1024)
+    try:
+
+        @ray_tpu.remote
+        def plain():
+            return "plain"
+
+        assert ray_tpu.get(plain.remote()) == "plain"  # pool now has 1 idle plain worker
+
+        @ray_tpu.remote(runtime_env={"env_vars": {"EVICT_PROBE": "v"}})
+        def dedicated():
+            return os.environ.get("EVICT_PROBE")
+
+        start = time.time()
+        assert ray_tpu.get(dedicated.remote(), timeout=60) == "v"
+        assert time.time() - start < 30
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_nested_task_inherits_env(ray_start_regular):
+    """A task submitted from inside a runtime-env task inherits that env."""
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"NEST_PROBE": "outer"}})
+    def outer():
+        @ray_tpu.remote
+        def inner():
+            return os.environ.get("NEST_PROBE")
+
+        return ray_tpu.get(inner.remote())
+
+    assert ray_tpu.get(outer.remote(), timeout=120) == "outer"
+
+
+def test_bad_working_dir_rejected_at_submission(ray_start_regular):
+    @ray_tpu.remote(runtime_env={"working_dir": "/no/such/dir"})
+    def f():
+        return 1
+
+    with pytest.raises(ValueError, match="working_dir"):
+        f.remote()
+
+
+def test_pip_rejected_at_submission(ray_start_regular):
+    @ray_tpu.remote(runtime_env={"pip": ["requests"]})
+    def f():
+        return 1
+
+    with pytest.raises(ValueError, match="pip"):
+        f.remote()
+
+
+def test_job_level_runtime_env_merges():
+    ray_tpu.init(
+        num_cpus=2,
+        object_store_memory=64 * 1024 * 1024,
+        runtime_env={"env_vars": {"JOB_LEVEL": "j", "BOTH": "job"}},
+    )
+    try:
+
+        @ray_tpu.remote
+        def inherits():
+            return os.environ.get("JOB_LEVEL"), os.environ.get("BOTH")
+
+        assert ray_tpu.get(inherits.remote()) == ("j", "job")
+
+        @ray_tpu.remote(runtime_env={"env_vars": {"BOTH": "task"}})
+        def overrides():
+            return os.environ.get("JOB_LEVEL"), os.environ.get("BOTH")
+
+        # task env_vars merge over job env_vars
+        assert ray_tpu.get(overrides.remote()) == ("j", "task")
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_runtime_env_class_validation():
+    r = RuntimeEnv(env_vars={"A": "1"}, py_modules=["/x"])
+    assert r == {"env_vars": {"A": "1"}, "py_modules": ["/x"]}
+    with pytest.raises(ValueError):
+        RuntimeEnv(bogus_field=1)
+    with pytest.raises(TypeError):
+        RuntimeEnv(env_vars={"A": 1})
