@@ -120,13 +120,14 @@ class _PhysicalMapOp:
         self.upstream_done = False
         self._pool: list = []
         self._pool_idx = 0
+        self._actor_cls = None
         if isinstance(logical.compute, ActorPoolStrategy):
             strat = logical.compute
-            actor_cls = ray_tpu.remote(
+            self._actor_cls = ray_tpu.remote(
                 num_cpus=strat.num_cpus, num_tpus=strat.num_tpus or None
             )(_MapWorker)
             self._pool = [
-                actor_cls.remote(logical.fn_constructor) for _ in range(strat.min_size)
+                self._actor_cls.remote(logical.fn_constructor) for _ in range(strat.min_size)
             ]
 
     @property
@@ -136,6 +137,14 @@ class _PhysicalMapOp:
         return max(0, self.ctx.max_tasks_in_flight - len(self.in_flight))
 
     def dispatch(self):
+        if self._pool and self.input:
+            # Autoscale the pool toward max_size while a backlog exists
+            # (reference: ActorPoolMapOperator's autoscaling actor pool).
+            strat = self.logical.compute
+            backlog = max(0, len(self.input) - self.capacity)
+            grow = min(backlog, strat.max_size - len(self._pool))
+            for _ in range(grow):
+                self._pool.append(self._actor_cls.remote(self.logical.fn_constructor))
         while self.input and self.capacity > 0:
             index, (block_ref, _meta) = self.input.popleft()
             if self._pool:

@@ -1,0 +1,5 @@
+"""ray_tpu.train — distributed training (reference: python/ray/train)."""
+
+from ray_tpu.train.base_trainer import BaseTrainer  # noqa: F401
+from ray_tpu.train.data_parallel_trainer import DataParallelTrainer  # noqa: F401
+from ray_tpu.train.predictor import BatchPredictor, JaxPredictor, Predictor  # noqa: F401

@@ -1,0 +1,148 @@
+"""Predictors — checkpoint → inference callable.
+
+Analog of the reference's ray.train.predictor.Predictor +
+batch_predictor.BatchPredictor (python/ray/train/predictor.py,
+batch_predictor.py): a Predictor wraps a checkpoint (+ optional fitted
+preprocessor) and maps batches to predictions; BatchPredictor scales one over
+a Dataset with an actor pool so jit-compiled models stay resident per actor.
+
+TPU-first: JaxPredictor holds params as a device-resident pytree and a jitted
+apply function — one compile per actor process, then every batch is a pure
+device call.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from ray_tpu.air.checkpoint import Checkpoint
+
+
+class Predictor:
+    def __init__(self, preprocessor=None):
+        self._preprocessor = preprocessor
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint: Checkpoint, **kwargs) -> "Predictor":
+        raise NotImplementedError
+
+    def get_preprocessor(self):
+        return self._preprocessor
+
+    def predict(self, batch: dict) -> dict:
+        if self._preprocessor is not None:
+            batch = self._preprocessor.transform_batch(batch)
+        return self._predict(batch)
+
+    def _predict(self, batch: dict) -> dict:
+        raise NotImplementedError
+
+
+class JaxPredictor(Predictor):
+    """Predictor over a jitted apply fn + params pytree.
+
+    ``apply_fn(params, inputs) -> outputs``; inputs are taken from
+    ``input_column`` (default: the whole batch if it has one column).
+    """
+
+    def __init__(
+        self,
+        params,
+        apply_fn: Callable,
+        preprocessor=None,
+        input_column: Optional[str] = None,
+    ):
+        super().__init__(preprocessor)
+        import jax
+
+        self.params = params
+        self.apply_fn = jax.jit(apply_fn)
+        self.input_column = input_column
+
+    @classmethod
+    def from_checkpoint(
+        cls,
+        checkpoint: Checkpoint,
+        apply_fn: Callable | None = None,
+        input_column: Optional[str] = None,
+    ) -> "JaxPredictor":
+        data = checkpoint.to_dict()
+        params = data.get("params", data.get("pytree"))
+        if params is None:
+            raise ValueError("checkpoint has no 'params' (or 'pytree') entry")
+        fn = apply_fn or data.get("apply_fn")
+        if fn is None:
+            raise ValueError("pass apply_fn= or store one in the checkpoint")
+        return cls(params, fn, preprocessor=data.get("preprocessor"), input_column=input_column)
+
+    def _predict(self, batch: dict) -> dict:
+        import jax.numpy as jnp
+
+        if self.input_column is not None:
+            inputs = jnp.asarray(batch[self.input_column])
+        elif len(batch) == 1:
+            inputs = jnp.asarray(next(iter(batch.values())))
+        else:
+            raise ValueError(
+                f"batch has columns {sorted(batch)}; pass input_column= to pick one"
+            )
+        out = self.apply_fn(self.params, inputs)
+        return {"predictions": np.asarray(out)}
+
+
+class BatchPredictor:
+    """Scale a Predictor over a Dataset (reference: batch_predictor.py).
+
+    One predictor instance per map actor: the checkpoint is deserialized and
+    the model jitted once per actor, then reused across batches.
+    """
+
+    def __init__(self, checkpoint: Checkpoint, predictor_cls, **predictor_kwargs):
+        self.checkpoint = checkpoint
+        self.predictor_cls = predictor_cls
+        self.predictor_kwargs = predictor_kwargs
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint: Checkpoint, predictor_cls, **kwargs) -> "BatchPredictor":
+        return cls(checkpoint, predictor_cls, **kwargs)
+
+    def predict(
+        self,
+        ds,
+        *,
+        batch_size: int = 4096,
+        min_scoring_workers: int = 1,
+        max_scoring_workers: int = 2,
+        num_tpus_per_worker: int = 0,
+        keep_columns: Optional[list] = None,
+    ):
+        from ray_tpu.data import ActorPoolStrategy
+
+        checkpoint_blob = self.checkpoint.to_bytes()
+        predictor_cls = self.predictor_cls
+        predictor_kwargs = self.predictor_kwargs
+
+        class ScoringActor:
+            def __init__(self):
+                self.predictor = predictor_cls.from_checkpoint(
+                    Checkpoint.from_bytes(checkpoint_blob), **predictor_kwargs
+                )
+
+            def __call__(self, batch: dict) -> dict:
+                out = self.predictor.predict(dict(batch))
+                for col in keep_columns or []:
+                    out[col] = batch[col]
+                return out
+
+        return ds.map_batches(
+            ScoringActor,
+            batch_size=batch_size,
+            # Actor-pool resources come from the strategy, not ray_remote_args.
+            compute=ActorPoolStrategy(
+                min_size=min_scoring_workers,
+                max_size=max_scoring_workers,
+                num_tpus=num_tpus_per_worker,
+            ),
+        )

@@ -1,0 +1,108 @@
+"""Preprocessor + predictor tests.
+
+Modeled on the reference's python/ray/data/tests/test_preprocessors.py and
+python/ray/train/tests/test_batch_predictor.py.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rdata
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.data.preprocessor import PreprocessorNotFittedError
+from ray_tpu.data.preprocessors import (
+    BatchMapper,
+    Chain,
+    Concatenator,
+    LabelEncoder,
+    MinMaxScaler,
+    OneHotEncoder,
+    SimpleImputer,
+    StandardScaler,
+)
+from ray_tpu.train import BatchPredictor, JaxPredictor
+
+
+@pytest.fixture(scope="module")
+def ray_cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+def _ds(rows):
+    return rdata.from_items(rows)
+
+
+def test_standard_scaler(ray_cluster):
+    ds = _ds([{"a": 1.0}, {"a": 2.0}, {"a": 3.0}])
+    s = StandardScaler(["a"])
+    out = s.fit_transform(ds).take_all()
+    vals = [r["a"] for r in out]
+    assert abs(np.mean(vals)) < 1e-9
+    # transform_batch matches dataset transform
+    b = s.transform_batch({"a": np.array([2.0])})
+    assert abs(b["a"][0]) < 1e-9
+
+
+def test_min_max_scaler(ray_cluster):
+    ds = _ds([{"x": 0.0}, {"x": 5.0}, {"x": 10.0}])
+    out = MinMaxScaler(["x"]).fit_transform(ds).take_all()
+    assert [r["x"] for r in out] == [0.0, 0.5, 1.0]
+
+
+def test_label_and_onehot_encoders(ray_cluster):
+    ds = _ds([{"c": "red", "y": "no"}, {"c": "blue", "y": "yes"}, {"c": "red", "y": "yes"}])
+    le = LabelEncoder("y").fit(ds)
+    assert le.classes_ == ["no", "yes"]
+    assert [r["y"] for r in le.transform(ds).take_all()] == [0, 1, 1]
+    oh = OneHotEncoder(["c"]).fit(ds)
+    rows = oh.transform(ds).take_all()
+    assert rows[0]["c_red"] == 1 and rows[0]["c_blue"] == 0
+    assert "c" not in rows[0]
+
+
+def test_imputer(ray_cluster):
+    ds = _ds([{"v": 1.0}, {"v": float("nan")}, {"v": 3.0}])
+    rows = SimpleImputer(["v"]).fit_transform(ds).take_all()
+    assert [r["v"] for r in rows] == [1.0, 2.0, 3.0]
+
+
+def test_concatenator_and_batch_mapper(ray_cluster):
+    ds = _ds([{"a": 1.0, "b": 2.0}, {"a": 3.0, "b": 4.0}])
+    rows = Concatenator(columns=["a", "b"], output_column_name="feat").transform(ds).take_all()
+    assert np.allclose(rows[0]["feat"], [1.0, 2.0])
+    doubled = BatchMapper(lambda b: {"a": np.asarray(b["a"]) * 2, "b": b["b"]}).transform(ds)
+    assert [r["a"] for r in doubled.take_all()] == [2.0, 6.0]
+
+
+def test_chain_and_not_fitted(ray_cluster):
+    ds = _ds([{"a": 1.0, "b": 10.0}, {"a": 3.0, "b": 30.0}])
+    chain = Chain(StandardScaler(["a"]), MinMaxScaler(["b"]))
+    with pytest.raises(PreprocessorNotFittedError):
+        chain.transform(ds)
+    rows = chain.fit_transform(ds).take_all()
+    assert rows[0]["b"] == 0.0 and rows[1]["b"] == 1.0
+
+
+def test_jax_predictor_and_batch_predictor(ray_cluster):
+    import jax.numpy as jnp
+
+    # "model": y = x @ w with w = [[2.],[3.]]
+    params = {"w": np.array([[2.0], [3.0]], dtype=np.float32)}
+
+    def apply_fn(params, x):
+        return jnp.asarray(x) @ jnp.asarray(params["w"])
+
+    ckpt = Checkpoint.from_dict({"params": params, "apply_fn": apply_fn})
+    pred = JaxPredictor.from_checkpoint(ckpt, input_column="feat")
+    out = pred.predict({"feat": np.array([[1.0, 1.0]], dtype=np.float32)})
+    assert np.allclose(out["predictions"], [[5.0]])
+
+    ds = rdata.from_items([{"a": float(i), "b": float(i)} for i in range(8)])
+    ds = Concatenator(columns=["a", "b"], output_column_name="feat", dtype=np.float32).transform(ds)
+    bp = BatchPredictor.from_checkpoint(ckpt, JaxPredictor, input_column="feat")
+    scored = bp.predict(ds, batch_size=4, max_scoring_workers=2)
+    preds = [float(np.ravel(r["predictions"])[0]) for r in scored.take_all()]
+    assert preds == [5.0 * i for i in range(8)]
