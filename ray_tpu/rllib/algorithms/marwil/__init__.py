@@ -1,0 +1,1 @@
+from ray_tpu.rllib.algorithms.marwil.marwil import MARWIL, BC, BCConfig, MARWILConfig  # noqa: F401

@@ -1,0 +1,155 @@
+"""MARWIL (advantage-weighted behavior cloning) and BC.
+
+Reference: rllib/algorithms/marwil/marwil.py (+ marwil_torch_policy loss) and
+rllib/algorithms/bc/ (BC = MARWIL with beta=0). Offline algorithms: the
+training batch comes from a JsonReader/DatasetReader instead of rollout
+workers; rollout workers are kept only for evaluation.
+
+Loss (jitted on the learner): policy term -E[exp(beta * A / c) * logp(a|s)]
+with A = (return-to-go - V(s)) and c a running norm; value term regresses
+V(s) on return-to-go. beta = 0 drops the value influence on the policy term
+entirely (pure behavior cloning).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.core.learner import LearnerGroup
+from ray_tpu.rllib.policy.sample_batch import ACTIONS, OBS, VALUE_TARGETS
+
+
+def marwil_loss(params, batch, spec, cfg):
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.core import rl_module
+
+    logp, entropy, value = rl_module.action_logp_and_entropy(
+        params, batch[OBS], batch[ACTIONS], spec
+    )
+    beta = cfg["beta"]
+    targets = batch[VALUE_TARGETS]
+    adv = targets - value
+    # exp-weighted imitation; advantage normalized by its batch RMS
+    # (reference uses a moving average — batch RMS is the jit-friendly form).
+    c = jnp.sqrt(jnp.mean(adv**2) + 1e-8)
+    weights = jnp.where(beta > 0, jnp.exp(beta * jax.lax.stop_gradient(adv / c)), 1.0)
+    policy_loss = -jnp.mean(weights * logp)
+    vf_loss = jnp.mean(adv**2)
+    total = (
+        policy_loss
+        + cfg["vf_coeff"] * jnp.where(beta > 0, vf_loss, 0.0)
+        - cfg["entropy_coeff"] * entropy.mean()
+    )
+    return total, {
+        "policy_loss": policy_loss,
+        "vf_loss": vf_loss,
+        "bc_logp": logp.mean(),
+        "entropy": entropy.mean(),
+    }
+
+
+class MARWILConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or MARWIL)
+        self.beta = 1.0
+        self.vf_coeff = 1.0
+        self.entropy_coeff = 0.0
+        self.grad_clip = 40.0
+        self.lr = 1e-4
+        self.train_batch_size = 2000
+        self.input_ = None  # path / glob / list of files / Dataset
+        self.num_rollout_workers = 0  # offline: workers only for evaluation
+        self.evaluation_interval = 5
+        self.evaluation_duration_steps = 500
+
+    def offline_data(self, *, input_=None) -> "MARWILConfig":
+        if input_ is not None:
+            self.input_ = input_
+        return self
+
+    def training(self, *, beta: Optional[float] = None, vf_coeff: Optional[float] = None,
+                 entropy_coeff: Optional[float] = None, **kwargs) -> "MARWILConfig":
+        super().training(**kwargs)
+        if beta is not None:
+            self.beta = beta
+        if vf_coeff is not None:
+            self.vf_coeff = vf_coeff
+        if entropy_coeff is not None:
+            self.entropy_coeff = entropy_coeff
+        return self
+
+    def evaluation(self, *, evaluation_interval: Optional[int] = None,
+                   evaluation_duration_steps: Optional[int] = None) -> "MARWILConfig":
+        if evaluation_interval is not None:
+            self.evaluation_interval = evaluation_interval
+        if evaluation_duration_steps is not None:
+            self.evaluation_duration_steps = evaluation_duration_steps
+        return self
+
+
+class MARWIL(Algorithm):
+    @classmethod
+    def get_default_config(cls) -> MARWILConfig:
+        return MARWILConfig(cls)
+
+    def setup(self, config: dict) -> None:
+        super().setup(config)
+        cfg: MARWILConfig = self._algo_config
+        if cfg.input_ is None:
+            raise ValueError(f"{type(self).__name__} requires config.offline_data(input_=...)")
+        from ray_tpu.rllib.offline import DatasetReader, JsonReader
+
+        if hasattr(cfg.input_, "take_all"):  # a ray_tpu.data Dataset
+            self.reader = DatasetReader(cfg.input_, gamma=cfg.gamma, seed=cfg.seed)
+        else:
+            self.reader = JsonReader(cfg.input_, gamma=cfg.gamma, seed=cfg.seed)
+
+    def _build_learner_group(self, cfg: MARWILConfig) -> LearnerGroup:
+        return LearnerGroup(
+            self.module_spec,
+            marwil_loss,
+            lr=cfg.lr,
+            grad_clip=cfg.grad_clip,
+            seed=cfg.seed,
+            num_learners=cfg.num_learners,
+            num_tpus_per_learner=cfg.num_tpus_per_learner,
+        )
+
+    def training_step(self) -> dict:
+        cfg: MARWILConfig = self._algo_config
+        batch = self.reader.next(cfg.train_batch_size)
+        self._timesteps_total += len(batch)
+        loss_cfg = {
+            "beta": cfg.beta,
+            "vf_coeff": cfg.vf_coeff,
+            "entropy_coeff": cfg.entropy_coeff,
+        }
+        metrics = self.learner_group.update(batch, loss_cfg)
+        # Periodic evaluation rollouts (the only online interaction).
+        if (
+            self.workers.num_workers > 0
+            and cfg.evaluation_interval
+            and self.iteration % cfg.evaluation_interval == 0
+        ):
+            self.workers.sync_weights(self.learner_group.get_weights())
+            per_worker = max(1, cfg.evaluation_duration_steps // self.workers.num_workers)
+            self.workers.sample(per_worker)
+        return dict(metrics)
+
+
+class BCConfig(MARWILConfig):
+    """BC = MARWIL with beta=0 (reference: rllib/algorithms/bc/bc.py)."""
+
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or BC)
+        self.beta = 0.0
+        self.vf_coeff = 0.0
+
+
+class BC(MARWIL):
+    @classmethod
+    def get_default_config(cls) -> BCConfig:
+        return BCConfig(cls)

@@ -1,0 +1,142 @@
+"""Offline RL IO.
+
+Analog of the reference's rllib/offline/ (json_writer.py, json_reader.py,
+dataset_reader.py): write rollouts as JSON-lines episode rows; read them back
+as SampleBatches with discounted return-to-go targets for offline losses
+(BC/MARWIL); or read from a ray_tpu.data Dataset.
+"""
+
+from __future__ import annotations
+
+import glob as glob_mod
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+from ray_tpu.rllib.policy.sample_batch import (
+    ACTIONS,
+    DONES,
+    EPS_ID,
+    OBS,
+    REWARDS,
+    VALUE_TARGETS,
+    SampleBatch,
+)
+
+
+class JsonWriter:
+    """Append SampleBatches to JSON-lines files (reference: json_writer.py)."""
+
+    def __init__(self, path: str, max_file_size_rows: int = 100_000):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        # Continue numbering past existing files so a second writer on the
+        # same directory creates new files instead of appending duplicates.
+        existing = sorted(glob_mod.glob(os.path.join(path, "output-*.json")))
+        self._file_idx = (
+            max(int(os.path.basename(f)[len("output-") : -len(".json")]) for f in existing) + 1
+            if existing
+            else 0
+        )
+        self._rows_in_file = 0
+        self._max_rows = max_file_size_rows
+        self._f = None
+
+    def _ensure_file(self):
+        if self._f is None or self._rows_in_file >= self._max_rows:
+            if self._f is not None:
+                self._f.close()
+            self._f = open(
+                os.path.join(self.path, f"output-{self._file_idx:05d}.json"), "a"
+            )
+            self._file_idx += 1
+            self._rows_in_file = 0
+
+    def write(self, batch: SampleBatch):
+        self._ensure_file()
+        n = len(batch)
+        keys = list(batch.keys())
+        for i in range(n):
+            row = {}
+            for k in keys:
+                v = batch[k][i]
+                row[k] = v.tolist() if hasattr(v, "tolist") else v
+            self._f.write(json.dumps(row) + "\n")
+            self._rows_in_file += 1
+        self._f.flush()
+
+    def close(self):
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+def _rows_to_batch(rows: list[dict]) -> SampleBatch:
+    if not rows:
+        return SampleBatch()
+    keys = rows[0].keys()
+    return SampleBatch({k: np.asarray([r[k] for r in rows]) for k in keys})
+
+
+def _add_return_targets(batch: SampleBatch, gamma: float) -> SampleBatch:
+    """Discounted return-to-go per episode → VALUE_TARGETS (what offline
+    losses regress the value head on)."""
+    if VALUE_TARGETS in batch or REWARDS not in batch:
+        return batch
+    rewards = np.asarray(batch[REWARDS], dtype=np.float64)
+    dones = np.asarray(batch.get(DONES, np.zeros(len(rewards), bool)), dtype=bool)
+    returns = np.zeros_like(rewards)
+    acc = 0.0
+    for i in range(len(rewards) - 1, -1, -1):
+        if dones[i]:
+            acc = 0.0
+        acc = rewards[i] + gamma * acc
+        returns[i] = acc
+    batch[VALUE_TARGETS] = returns.astype(np.float32)
+    return batch
+
+
+class JsonReader:
+    """Load JSON-lines rollout files; serve shuffled minibatches
+    (reference: json_reader.py)."""
+
+    def __init__(self, inputs, gamma: float = 0.99, seed: int = 0):
+        paths = [inputs] if isinstance(inputs, str) else list(inputs)
+        files: list[str] = []
+        for p in paths:
+            if os.path.isdir(p):
+                files += sorted(glob_mod.glob(os.path.join(p, "*.json")))
+            else:
+                files += sorted(glob_mod.glob(p))
+        if not files:
+            raise FileNotFoundError(f"no offline data files under {paths}")
+        rows: list[dict] = []
+        for fpath in files:
+            with open(fpath) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        rows.append(json.loads(line))
+        self.batch = _add_return_targets(_rows_to_batch(rows), gamma)
+        self._rng = np.random.default_rng(seed)
+
+    def next(self, batch_size: Optional[int] = None) -> SampleBatch:
+        n = len(self.batch)
+        if batch_size is None or batch_size >= n:
+            return self.batch
+        idx = self._rng.choice(n, size=batch_size, replace=False)
+        return SampleBatch({k: np.asarray(v)[idx] for k, v in self.batch.items()})
+
+
+class DatasetReader:
+    """Offline data from a ray_tpu.data Dataset of row dicts
+    (reference: offline/dataset_reader.py)."""
+
+    def __init__(self, dataset, gamma: float = 0.99, seed: int = 0):
+        rows = dataset.take_all()
+        self.batch = _add_return_targets(_rows_to_batch(rows), gamma)
+        self._rng = np.random.default_rng(seed)
+
+    next = JsonReader.next

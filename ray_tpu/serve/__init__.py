@@ -23,6 +23,7 @@ from ray_tpu.serve.api import (  # noqa: F401
 )
 from ray_tpu.serve.batching import batch  # noqa: F401
 from ray_tpu.serve.handle import DeploymentHandle  # noqa: F401
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed  # noqa: F401
 
 __all__ = [
     "Application",
@@ -34,6 +35,8 @@ __all__ = [
     "delete",
     "deployment",
     "get_deployment_handle",
+    "get_multiplexed_model_id",
+    "multiplexed",
     "http_address",
     "run",
     "shutdown",

@@ -57,3 +57,19 @@ class DeploymentInfo:
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 PROXY_NAME = "SERVE_PROXY"
+
+# HTTP header / handle option carrying the multiplexed model id
+# (reference: serve/_private/constants.py SERVE_MULTIPLEXED_MODEL_ID).
+MULTIPLEXED_MODEL_ID_HEADER = "serve_multiplexed_model_id"
+
+
+class HandleMarker:
+    """Placeholder for a DeploymentHandle inside pickled init args —
+    deployment composition (reference: deployment graphs / DeploymentNode
+    bound as an argument). Replicas materialize it at construction."""
+
+    def __init__(self, deployment_name: str):
+        self.deployment_name = deployment_name
+
+    def __repr__(self):
+        return f"HandleMarker({self.deployment_name!r})"

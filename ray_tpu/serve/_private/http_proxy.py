@@ -67,7 +67,15 @@ class HTTPProxy:
             headers = dict(request.headers)
 
             def call():
-                replica = self._router.assign_replica(deployment)
+                from ray_tpu.serve._private.common import MULTIPLEXED_MODEL_ID_HEADER
+
+                # Case-insensitive header lookup without mutating the header
+                # dict user deployments receive.
+                model_id = next(
+                    (v for k, v in headers.items() if k.lower() == MULTIPLEXED_MODEL_ID_HEADER),
+                    "",
+                )
+                replica = self._router.assign_replica(deployment, model_id=model_id)
                 try:
                     actor = self._router.handle_for(replica)
                     ref = actor.handle_http_request.remote(method, path, query, body, headers)

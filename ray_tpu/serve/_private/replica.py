@@ -16,7 +16,20 @@ import time
 
 class Replica:
     def __init__(self, import_spec: bytes, user_config=None):
+        from ray_tpu.serve._private.common import HandleMarker
+
         cls_or_fn, init_args, init_kwargs = pickle.loads(import_spec)
+
+        def materialize(v):
+            if isinstance(v, HandleMarker):
+                # Composition: a bound child deployment becomes a live handle.
+                from ray_tpu.serve.api import get_deployment_handle
+
+                return get_deployment_handle(v.deployment_name)
+            return v
+
+        init_args = tuple(materialize(a) for a in init_args)
+        init_kwargs = {k: materialize(v) for k, v in init_kwargs.items()}
         if isinstance(cls_or_fn, type):
             self._callable = cls_or_fn(*init_args, **init_kwargs)
         else:
@@ -36,11 +49,16 @@ class Replica:
             fn(user_config)
         return True
 
-    def handle_request(self, method_name: str, args: tuple, kwargs: dict):
+    def handle_request(
+        self, method_name: str, args: tuple, kwargs: dict, multiplexed_model_id: str = ""
+    ):
+        from ray_tpu.serve.multiplex import _set_multiplexed_model_id
+
         with self._lock:
             self._ongoing += 1
             self._total += 1
         try:
+            _set_multiplexed_model_id(multiplexed_model_id)
             if self._is_function or method_name == "__call__":
                 target = self._callable
             else:
@@ -52,8 +70,14 @@ class Replica:
 
     def handle_http_request(self, method: str, path: str, query: dict, body: bytes, headers: dict):
         """HTTP entry: the callable gets a lightweight Request object."""
+        from ray_tpu.serve._private.common import MULTIPLEXED_MODEL_ID_HEADER
+
         request = HTTPRequest(method=method, path=path, query=query, body=body, headers=headers)
-        return self.handle_request("__call__", (request,), {})
+        model_id = next(
+            (v for k, v in (headers or {}).items() if k.lower() == MULTIPLEXED_MODEL_ID_HEADER),
+            "",
+        )
+        return self.handle_request("__call__", (request,), {}, multiplexed_model_id=model_id)
 
     def get_metrics(self) -> dict:
         """Queue stats for autoscaling (reference: autoscaling_metrics.py)."""

@@ -91,21 +91,30 @@ class Router:
             time.sleep(0.05)
         return False
 
-    def assign_replica(self, deployment: str, timeout_s: float = 30.0):
+    def assign_replica(self, deployment: str, timeout_s: float = 30.0, model_id: str = ""):
         """Round-robin over replicas, skipping ones at their queue limit
-        (reference: router.py:125 RoundRobinReplicaScheduler)."""
+        (reference: router.py:125 RoundRobinReplicaScheduler). A multiplexed
+        model id pins to a stable replica (warm model cache on TPU) with
+        round-robin fallback when that replica is saturated."""
         deadline = time.time() + timeout_s
         while True:
             replicas = self.replicas_for(deployment)
             if replicas:
                 with self._lock:
-                    start = self._rr.get(deployment, 0)
                     n = len(replicas)
+                    if model_id:
+                        # Stable affinity: same model id -> same replica.
+                        import zlib
+
+                        start = zlib.crc32(model_id.encode()) % n
+                    else:
+                        start = self._rr.get(deployment, 0)
                     for i in range(n):
                         r = replicas[(start + i) % n]
                         name = r["actor_name"]
                         if self._inflight.get(name, 0) < r["max_concurrent_queries"]:
-                            self._rr[deployment] = (start + i + 1) % n
+                            if not model_id:
+                                self._rr[deployment] = (start + i + 1) % n
                             self._inflight[name] = self._inflight.get(name, 0) + 1
                             return r
             if time.time() >= deadline:

@@ -30,6 +30,7 @@ from ray_tpu.serve._private.common import (
     AutoscalingConfig,
     DeploymentConfig,
     DeploymentInfo,
+    HandleMarker,
 )
 from ray_tpu.serve.handle import DeploymentHandle
 
@@ -149,9 +150,75 @@ def run(app: Application, *, name: str = "default", route_prefix: Optional[str] 
 
     if not _started:
         start()
+    # Deployment composition: Applications bound as init args become child
+    # deployments, replaced by HandleMarkers the replicas materialize into
+    # DeploymentHandles (reference: deployment graphs / DeploymentNode args).
+    infos: dict[str, DeploymentInfo] = {}
+    root_name = _build_app_tree(app, name, infos, root_route_prefix=route_prefix)
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    ray_tpu.get(controller.deploy.remote([pickle.dumps(i) for i in infos.values()]))
+    router = Router.shared(controller)
+    if _blocking:
+        for dep_name, info in infos.items():
+            if not router.wait_for_deployment(dep_name, timeout_s=60):
+                raise TimeoutError(f"deployment {dep_name} did not become ready")
+            # Block until the full target replica count for this version is
+            # RUNNING and stale-version replicas are retired (reference:
+            # serve.run waits for the application to reach RUNNING state).
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                st = ray_tpu.get(controller.get_deployments.remote()).get(dep_name)
+                if (
+                    st is not None
+                    and st["version"] == info.config.version
+                    and st["num_replicas_current_version"] >= st["target"]
+                    and st["num_replicas"] == st["num_replicas_current_version"]
+                ):
+                    break
+                time.sleep(0.05)
+            else:
+                raise TimeoutError(
+                    f"deployment {dep_name} did not reach target replica count"
+                )
+    return DeploymentHandle(root_name, router)
+
+
+def _build_app_tree(
+    app: Application,
+    app_name: str,
+    infos: dict,
+    root_route_prefix="__from_deployment__",
+) -> str:
+    """Depth-first build of DeploymentInfos for an application graph.
+    Children keep their own deployment names; only the root gets the
+    requested route prefix."""
     dep = app.deployment
-    prefix = dep.route_prefix if route_prefix == "__from_deployment__" else route_prefix
-    import_spec = cloudpickle.dumps((dep._cls_or_fn, app.init_args, app.init_kwargs))
+    existing = infos.get(dep.name)
+    if existing is not None:
+        # The same Application object bound in two places is a legitimate
+        # diamond; two different bindings under one deployment name would
+        # silently drop the second one's init args — refuse.
+        if existing._source_app_id != id(app):
+            raise ValueError(
+                f"deployment name {dep.name!r} is bound more than once with "
+                "different arguments; give each binding a distinct name via "
+                ".options(name=...)"
+            )
+        return dep.name
+
+    def subst(value):
+        if isinstance(value, Application):
+            return HandleMarker(_build_app_tree(value, app_name, infos))
+        return value
+
+    init_args = tuple(subst(a) for a in app.init_args)
+    init_kwargs = {k: subst(v) for k, v in app.init_kwargs.items()}
+    prefix = (
+        dep.route_prefix
+        if root_route_prefix == "__from_deployment__"
+        else root_route_prefix
+    )
+    import_spec = cloudpickle.dumps((dep._cls_or_fn, init_args, init_kwargs))
     cfg = dataclasses.replace(dep.config)
     if cfg.version is None:
         # Unversioned deployment: every change to code, init args, or
@@ -166,34 +233,14 @@ def run(app: Application, *, name: str = "default", route_prefix: Optional[str] 
         cfg.version = hashlib.md5(import_spec + uc_bytes).hexdigest()[:10]
     info = DeploymentInfo(
         name=dep.name,
-        app_name=name,
+        app_name=app_name,
         import_spec=import_spec,
         config=cfg,
         route_prefix=prefix,
     )
-    controller = ray_tpu.get_actor(CONTROLLER_NAME)
-    ray_tpu.get(controller.deploy.remote([pickle.dumps(info)]))
-    router = Router.shared(controller)
-    if _blocking:
-        if not router.wait_for_deployment(dep.name, timeout_s=60):
-            raise TimeoutError(f"deployment {dep.name} did not become ready")
-        # Block until the full target replica count for this version is
-        # RUNNING (reference: serve.run waits for the application to reach
-        # RUNNING state, i.e. every target replica healthy — api.py:413).
-        deadline = time.time() + 60
-        while time.time() < deadline:
-            st = ray_tpu.get(controller.get_deployments.remote()).get(dep.name)
-            if (
-                st is not None
-                and st["version"] == cfg.version
-                and st["num_replicas_current_version"] >= st["target"]
-                and st["num_replicas"] == st["num_replicas_current_version"]
-            ):
-                break
-            time.sleep(0.05)
-        else:
-            raise TimeoutError(f"deployment {dep.name} did not reach target replica count")
-    return DeploymentHandle(dep.name, router)
+    info._source_app_id = id(app)
+    infos[dep.name] = info
+    return dep.name
 
 
 def get_deployment_handle(deployment_name: str) -> DeploymentHandle:

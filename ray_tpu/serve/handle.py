@@ -23,9 +23,16 @@ class _MethodCaller:
 
 
 class DeploymentHandle:
-    def __init__(self, deployment_name: str, router):
+    def __init__(self, deployment_name: str, router, multiplexed_model_id: str = ""):
         self._deployment = deployment_name
         self._router = router
+        self._multiplexed_model_id = multiplexed_model_id
+
+    def options(self, *, multiplexed_model_id: str = "") -> "DeploymentHandle":
+        """Per-call options (reference: handle.options(multiplexed_model_id=…))."""
+        return DeploymentHandle(
+            self._deployment, self._router, multiplexed_model_id=multiplexed_model_id
+        )
 
     def remote(self, *args, **kwargs):
         return self._invoke("__call__", args, kwargs)
@@ -36,10 +43,13 @@ class DeploymentHandle:
         return _MethodCaller(self, item)
 
     def _invoke(self, method: str, args: tuple, kwargs: dict):
-        replica = self._router.assign_replica(self._deployment)
+        model_id = self._multiplexed_model_id
+        replica = self._router.assign_replica(self._deployment, model_id=model_id)
         try:
             actor = self._router.handle_for(replica)
-            ref = actor.handle_request.remote(method, args, kwargs)
+            ref = actor.handle_request.remote(
+                method, args, kwargs, multiplexed_model_id=model_id
+            )
         except Exception:
             self._router.release(replica)
             self._router.invalidate_handle(replica)
