@@ -90,6 +90,20 @@ def init(
         # Set by `ray_tpu job submit` driver subprocesses and operators —
         # mirrors the reference's RAY_ADDRESS behavior.
         address = os.environ["RAY_TPU_ADDRESS"]
+    if isinstance(address, str) and address.startswith("ray_tpu://"):
+        # Thin-client mode (reference: ray.init("ray://...") Ray Client).
+        from ray_tpu.util.client import connect as _client_connect
+
+        with _init_lock:
+            if worker_context.get_core_worker_if_initialized() is not None:
+                if ignore_reinit_error:
+                    return worker_context.get_core_worker()
+                raise RuntimeError(
+                    "ray_tpu.init() called twice; pass ignore_reinit_error=True"
+                )
+            _client_connect(address, namespace=namespace)
+        _install_driver_hooks()
+        return worker_context.get_core_worker()
     if address == "auto":
         address = os.environ.get("RAY_TPU_ADDRESS")
         if address is None:

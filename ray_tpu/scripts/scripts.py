@@ -98,6 +98,11 @@ def cmd_start(args):
                                 "  Dashboard:         http://%s:%d"
                                 % tuple(info["dashboard_address"])
                             )
+                        if info.get("client_server_address"):
+                            host_, port_ = info["client_server_address"]
+                            if host_ == "0.0.0.0":  # bind-all: show a dialable host
+                                host_ = info["gcs_address"][0]
+                            print(f"  Ray client:        ray_tpu://{host_}:{port_}")
                         print('  Connect with:      ray_tpu.init(address="auto")')
                     else:
                         print("Started worker node.")
@@ -141,12 +146,32 @@ def cmd_start(args):
                 port=args.dashboard_port,
             )
             dashboard_addr = list(dashboard.address)
+        client_server = None
+        client_server_addr = None
+        driver_cw = None
+        if not args.no_ray_client_server:
+            from ray_tpu._private.core_worker import DRIVER, CoreWorker
+            from ray_tpu.util.client import ClientServer
+
+            driver_cw = CoreWorker(
+                mode=DRIVER,
+                gcs_address=node.gcs_address,
+                raylet_address=node.raylet.address,
+                arena_name=node.raylet.arena_name,
+                node_id=node.node_id,
+                session_dir=node.session_dir,
+            )
+            client_server = ClientServer(
+                driver_cw, host="0.0.0.0", port=args.ray_client_server_port
+            )
+            client_server_addr = list(client_server.address)
         os.makedirs(os.path.dirname(CLUSTER_FILE), exist_ok=True)
         with open(CLUSTER_FILE, "w") as f:
             json.dump(
                 {
                     "gcs_address": list(node.gcs_address),
                     "dashboard_address": dashboard_addr,
+                    "client_server_address": client_server_addr,
                     "pid": os.getpid(),
                     "session_dir": node.session_dir,
                 },
@@ -167,6 +192,8 @@ def cmd_start(args):
             with open(args.ready_file, "w") as f:
                 f.write(str(os.getpid()))
     else:
+        client_server = None
+        driver_cw = None
         gcs = _gcs_address(args.address)
         host, port = gcs.rsplit(":", 1)
         node = Node(
@@ -201,6 +228,13 @@ def cmd_start(args):
     finally:
         if monitor is not None:
             monitor.stop()
+        if client_server is not None:
+            client_server.stop()
+        if driver_cw is not None:
+            try:
+                driver_cw.shutdown()
+            except Exception:
+                pass
         if dashboard is not None:
             dashboard.stop()
         node.stop()
@@ -442,6 +476,8 @@ def main(argv=None):
     p.add_argument("--dashboard-host", default="127.0.0.1")
     p.add_argument("--dashboard-port", type=int, default=8265)
     p.add_argument("--no-dashboard", action="store_true")
+    p.add_argument("--ray-client-server-port", type=int, default=0, help="0 = ephemeral")
+    p.add_argument("--no-ray-client-server", action="store_true")
     p.add_argument(
         "--autoscaling-config",
         default=None,

@@ -78,7 +78,9 @@ class HTTPProxy:
                 replica = self._router.assign_replica(deployment, model_id=model_id)
                 try:
                     actor = self._router.handle_for(replica)
-                    ref = actor.handle_http_request.remote(method, path, query, body, headers)
+                    ref = actor.handle_http_request.remote(
+                        method, path, query, body, headers, model_id
+                    )
                     return ray_tpu.get(ref, timeout=120)
                 finally:
                     self._router.release(replica)

@@ -26,6 +26,12 @@ class Replica:
                 from ray_tpu.serve.api import get_deployment_handle
 
                 return get_deployment_handle(v.deployment_name)
+            if isinstance(v, list):
+                return [materialize(x) for x in v]
+            if isinstance(v, tuple):
+                return tuple(materialize(x) for x in v)
+            if isinstance(v, dict):
+                return {k: materialize(x) for k, x in v.items()}
             return v
 
         init_args = tuple(materialize(a) for a in init_args)
@@ -68,16 +74,22 @@ class Replica:
             with self._lock:
                 self._ongoing -= 1
 
-    def handle_http_request(self, method: str, path: str, query: dict, body: bytes, headers: dict):
-        """HTTP entry: the callable gets a lightweight Request object."""
-        from ray_tpu.serve._private.common import MULTIPLEXED_MODEL_ID_HEADER
-
+    def handle_http_request(
+        self,
+        method: str,
+        path: str,
+        query: dict,
+        body: bytes,
+        headers: dict,
+        multiplexed_model_id: str = "",
+    ):
+        """HTTP entry: the callable gets a lightweight Request object. The
+        proxy passes the multiplexed model id it already extracted for
+        routing — one extraction, no divergence."""
         request = HTTPRequest(method=method, path=path, query=query, body=body, headers=headers)
-        model_id = next(
-            (v for k, v in (headers or {}).items() if k.lower() == MULTIPLEXED_MODEL_ID_HEADER),
-            "",
+        return self.handle_request(
+            "__call__", (request,), {}, multiplexed_model_id=multiplexed_model_id
         )
-        return self.handle_request("__call__", (request,), {}, multiplexed_model_id=model_id)
 
     def get_metrics(self) -> dict:
         """Queue stats for autoscaling (reference: autoscaling_metrics.py)."""

@@ -209,6 +209,14 @@ def _build_app_tree(
     def subst(value):
         if isinstance(value, Application):
             return HandleMarker(_build_app_tree(value, app_name, infos))
+        # Recurse into containers so e.g. Ingress.bind([A.bind(), B.bind()])
+        # or {"a": A.bind()} also deploy their children.
+        if isinstance(value, list):
+            return [subst(v) for v in value]
+        if isinstance(value, tuple):
+            return tuple(subst(v) for v in value)
+        if isinstance(value, dict):
+            return {k: subst(v) for k, v in value.items()}
         return value
 
     init_args = tuple(subst(a) for a in app.init_args)

@@ -21,6 +21,11 @@ _current_model_id: contextvars.ContextVar = contextvars.ContextVar(
     "serve_multiplexed_model_id", default=""
 )
 
+# Guards lazy wrapper creation. Module-level so deployment classes carrying
+# the descriptor stay picklable (a closure-captured lock would be serialized
+# by value with the class and locks cannot be pickled).
+_CREATION_LOCK = threading.Lock()
+
 
 def get_multiplexed_model_id() -> str:
     """Inside a request: the model id this request was routed with
@@ -92,8 +97,17 @@ def multiplexed(fn=None, *, max_num_models_per_replica: int = 3):
                 cache_attr = f"__multiplex_{loader.__name__}"
                 wrapper = getattr(instance, cache_attr, None)
                 if wrapper is None:
-                    wrapper = _MultiplexWrapper(loader, instance, max_num_models_per_replica)
-                    setattr(instance, cache_attr, wrapper)
+                    # Serialized creation: concurrent first requests must
+                    # share ONE wrapper/cache, or models load twice.
+                    from ray_tpu.serve import multiplex as _mx
+
+                    with _mx._CREATION_LOCK:
+                        wrapper = getattr(instance, cache_attr, None)
+                        if wrapper is None:
+                            wrapper = _MultiplexWrapper(
+                                loader, instance, max_num_models_per_replica
+                            )
+                            setattr(instance, cache_attr, wrapper)
                 return wrapper
 
         return _Descriptor()

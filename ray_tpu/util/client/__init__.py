@@ -1,0 +1,18 @@
+"""Ray-Client-style remote driver.
+
+Analog of the reference's Ray Client (python/ray/util/client/: worker.py:81
+thin client, util/client/server/ proxy): ``ray_tpu.init(address=
+"ray_tpu://host:port")`` (or ``util.client.connect``) attaches this process
+as a THIN client — no local raylet, no shared-memory arena; every API call is
+proxied over one TCP connection to a client server on the head node, which
+executes it in a real driver attached to the cluster.
+
+Use when the driver machine is not a cluster node (laptop → TPU pod). The
+public API (`remote/get/put/wait/actors/kill/get_actor/nodes`, the GCS-backed
+state/placement-group helpers) works unchanged; anything needing local shm
+(zero-copy plasma reads) transparently falls back to value shipping over the
+connection.
+"""
+
+from ray_tpu.util.client.client import ClientContext, ClientCoreWorker, connect  # noqa: F401
+from ray_tpu.util.client.server import ClientServer  # noqa: F401

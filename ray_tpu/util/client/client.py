@@ -1,0 +1,205 @@
+"""Thin-client CoreWorker.
+
+Implements the slice of the CoreWorker surface the public API touches
+(submit_task / create_actor / submit_actor_task / get / put / wait /
+register_ref / gcs.call) by proxying every call to a ClientServer on the head
+node. Installed into worker_context so `ray_tpu.remote/get/put/...` work
+unchanged (reference: util/client/worker.py:81 + client-mode API swap).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ray_tpu._private import serialization
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.rpc import RpcClient
+from ray_tpu.object_ref import ObjectRef
+
+
+class _GcsProxy:
+    def __init__(self, client: "ClientCoreWorker"):
+        self._client = client
+
+    def call(self, method: str, payload: dict | None = None) -> dict:
+        return self._client._rpc.call(
+            "client_gcs_call", {"method": method, "payload": payload or {}}
+        )
+
+
+class ClientCoreWorker:
+    mode = "CLIENT"
+
+    def __init__(self, address: tuple, namespace: str = ""):
+        self._rpc = RpcClient(tuple(address), label="ray-client")
+        self.namespace = namespace
+        self.gcs = _GcsProxy(self)
+        self._released: list[str] = []
+        self._release_lock = threading.Lock()
+
+    # -- serialization helpers -----------------------------------------
+    @staticmethod
+    def _pack_args(args, kwargs) -> bytes:
+        return serialization.dumps((tuple(args), dict(kwargs or {})))
+
+    def _refs_from_ids(self, ids: list[str]) -> list[ObjectRef]:
+        return [ObjectRef(ObjectID.from_hex(i)) for i in ids]
+
+    def _flush_releases(self):
+        """Send any pending ref releases (piggybacked on every API call so
+        dropped refs don't stay pinned server-side)."""
+        with self._release_lock:
+            batch, self._released = self._released, []
+        if batch:
+            try:
+                self._rpc.call("client_release", {"ids": batch})
+            except Exception:
+                with self._release_lock:
+                    self._released = batch + self._released
+
+    # -- task / actor API ----------------------------------------------
+    def submit_task(self, func, args, kwargs, **opts):
+        self._flush_releases()
+        resp = self._rpc.call(
+            "client_task",
+            {
+                "func": serialization.dumps(func),
+                "args": self._pack_args(args, kwargs),
+                "opts": _plain_opts(opts),
+            },
+        )
+        return self._refs_from_ids(resp["ids"])
+
+    def create_actor(self, cls, args, kwargs, **opts):
+        self._flush_releases()
+        resp = self._rpc.call(
+            "client_create_actor",
+            {
+                "cls": serialization.dumps(cls),
+                "args": self._pack_args(args, kwargs),
+                "opts": _plain_opts(opts),
+            },
+        )
+        return resp["info"]
+
+    def submit_actor_task(self, actor_id, method_name, args, kwargs, num_returns=1, max_task_retries=0):
+        resp = self._rpc.call(
+            "client_actor_call",
+            {
+                "actor_id": actor_id,
+                "method": method_name,
+                "args": self._pack_args(args, kwargs),
+                "num_returns": num_returns,
+                "max_task_retries": max_task_retries,
+            },
+        )
+        return self._refs_from_ids(resp["ids"])
+
+    # -- object API -----------------------------------------------------
+    def get(self, refs, timeout=None):
+        self._flush_releases()
+        single = isinstance(refs, ObjectRef)
+        ref_list = [refs] if single else list(refs)
+        resp = self._rpc.call(
+            "client_get",
+            {"ids": [r.hex() for r in ref_list], "timeout": timeout},
+            timeout=(timeout + 30) if timeout else None,
+        )
+        if resp.get("error") is not None:
+            raise serialization.loads(resp["error"])
+        values = serialization.loads(resp["values"])
+        return values[0] if single else values
+
+    def put(self, value) -> ObjectRef:
+        self._flush_releases()
+        resp = self._rpc.call("client_put", {"value": serialization.dumps(value)})
+        return self._refs_from_ids([resp["id"]])[0]
+
+    def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        by_id = {r.hex(): r for r in refs}
+        resp = self._rpc.call(
+            "client_wait",
+            {
+                "ids": list(by_id),
+                "num_returns": num_returns,
+                "timeout": timeout,
+                "fetch_local": fetch_local,
+            },
+            timeout=(timeout + 30) if timeout else None,
+        )
+        return (
+            [by_id[i] for i in resp["ready"]],
+            [by_id[i] for i in resp["not_ready"]],
+        )
+
+    # -- ref bookkeeping (ObjectRef.__init__/__del__ hooks) -------------
+    def register_ref(self, ref: ObjectRef):
+        pass  # the server pins ids until we release them
+
+    def deregister_ref(self, ref: ObjectRef):
+        # Queue the release; flushed on the next API call (or immediately
+        # once a large batch accumulates) — __del__ must not block on RPC.
+        flush_now = False
+        with self._release_lock:
+            self._released.append(ref.hex())
+            flush_now = len(self._released) >= 100
+        if flush_now:
+            self._flush_releases()
+
+    def as_future(self, ref: ObjectRef):
+        import concurrent.futures
+
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def _resolve():
+            try:
+                fut.set_result(self.get(ref))
+            except Exception as e:
+                fut.set_exception(e)
+
+        threading.Thread(target=_resolve, daemon=True).start()
+        return fut
+
+    def shutdown(self, job_state: str | None = None):
+        with self._release_lock:
+            batch, self._released = self._released, []
+        try:
+            if batch:
+                self._rpc.call("client_release", {"ids": batch})
+        except Exception:
+            pass
+        self._rpc.close()
+
+
+def _plain_opts(opts: dict) -> dict:
+    """Options must be msgpack-able; drop Nones."""
+    return {k: v for k, v in opts.items() if v is not None}
+
+
+class ClientContext:
+    def __init__(self, core_worker: ClientCoreWorker):
+        self._cw = core_worker
+
+    def disconnect(self):
+        from ray_tpu._private import worker_context
+
+        self._cw.shutdown()
+        worker_context.set_core_worker(None)
+
+
+def connect(address: str, namespace: str = "") -> ClientContext:
+    """Attach this process as a thin client. ``address`` is
+    ``host:port`` of the head's client server (also accepts the
+    ``ray_tpu://host:port`` form)."""
+    from ray_tpu._private import worker_context
+
+    if address.startswith("ray_tpu://"):
+        address = address[len("ray_tpu://") :]
+    host, port = address.rsplit(":", 1)
+    if worker_context.get_core_worker_if_initialized() is not None:
+        raise RuntimeError("already connected; call ray_tpu.shutdown() first")
+    cw = ClientCoreWorker((host, int(port)), namespace=namespace)
+    # Probe the connection early for a clear error.
+    cw.gcs.call("get_nodes")
+    worker_context.set_core_worker(cw)
+    return ClientContext(cw)

@@ -1,0 +1,124 @@
+"""Client server — runs on the head node, executes for thin clients.
+
+Analog of the reference's util/client/server/server.py: holds a real driver
+CoreWorker connected to the cluster; every RPC maps 1:1 to a driver-side API
+call. Returned ObjectRefs are pinned in a registry keyed by id so the
+cluster-side refcount stays >0 while any client holds the id; clients release
+ids explicitly (ObjectRef.__del__ → client_release)."""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from ray_tpu._private import serialization
+from ray_tpu._private.rpc import RpcServer
+
+logger = logging.getLogger(__name__)
+
+
+class ClientServer:
+    def __init__(self, core_worker, host: str = "0.0.0.0", port: int = 0):
+        """``core_worker`` is a DRIVER-mode CoreWorker already connected."""
+        self.cw = core_worker
+        self._refs: dict[str, object] = {}  # id hex -> ObjectRef (pin)
+        self._lock = threading.Lock()
+        self.server = RpcServer(name="client-server")
+        self.server.register_all(self, prefix="client_")
+        self.server.start(host=host, port=port)
+        self.address = self.server.address
+
+    # -- helpers --------------------------------------------------------
+    def _pin(self, refs) -> list[str]:
+        out = []
+        with self._lock:
+            for r in refs:
+                self._refs[r.hex()] = r
+                out.append(r.hex())
+        return out
+
+    def _lookup(self, ids: list[str]) -> list:
+        with self._lock:
+            missing = [i for i in ids if i not in self._refs]
+            if missing:
+                raise KeyError(f"unknown/released object ids {missing}")
+            return [self._refs[i] for i in ids]
+
+    @staticmethod
+    async def _off_loop(fn):
+        """Every CoreWorker entry point here is synchronous and may itself
+        issue blocking RPCs — running it on the IO loop would deadlock the
+        process's sockets. Always hop to a worker thread."""
+        import asyncio
+
+        return await asyncio.get_event_loop().run_in_executor(None, fn)
+
+    # -- RPC methods ----------------------------------------------------
+    async def rpc_task(self, req):
+        func = serialization.loads(req["func"])
+        args, kwargs = serialization.loads(req["args"])
+        opts = req.get("opts") or {}
+        refs = await self._off_loop(lambda: self.cw.submit_task(func, args, kwargs, **opts))
+        return {"ids": self._pin(refs)}
+
+    async def rpc_create_actor(self, req):
+        cls = serialization.loads(req["cls"])
+        args, kwargs = serialization.loads(req["args"])
+        opts = req.get("opts") or {}
+        info = await self._off_loop(lambda: self.cw.create_actor(cls, args, kwargs, **opts))
+        return {"info": info}
+
+    async def rpc_actor_call(self, req):
+        args, kwargs = serialization.loads(req["args"])
+        refs = await self._off_loop(
+            lambda: self.cw.submit_actor_task(
+                req["actor_id"],
+                req["method"],
+                args,
+                kwargs,
+                num_returns=req.get("num_returns", 1),
+                max_task_retries=req.get("max_task_retries", 0),
+            )
+        )
+        return {"ids": self._pin(refs)}
+
+    async def rpc_get(self, req):
+        refs = self._lookup(req["ids"])
+        try:
+            values = await self._off_loop(
+                lambda: self.cw.get(refs, timeout=req.get("timeout"))
+            )
+        except Exception as e:
+            return {"error": serialization.dumps(e)}
+        return {"values": serialization.dumps(values)}
+
+    async def rpc_put(self, req):
+        value = serialization.loads(req["value"])
+        ref = await self._off_loop(lambda: self.cw.put(value))
+        return {"id": self._pin([ref])[0]}
+
+    async def rpc_wait(self, req):
+        refs = self._lookup(req["ids"])
+        ready, not_ready = await self._off_loop(
+            lambda: self.cw.wait(
+                refs,
+                num_returns=req.get("num_returns", 1),
+                timeout=req.get("timeout"),
+                fetch_local=req.get("fetch_local", True),
+            )
+        )
+        return {"ready": [r.hex() for r in ready], "not_ready": [r.hex() for r in not_ready]}
+
+    async def rpc_release(self, req):
+        with self._lock:
+            for i in req.get("ids", []):
+                self._refs.pop(i, None)
+        return {"ok": True}
+
+    async def rpc_gcs_call(self, req):
+        return await self._off_loop(
+            lambda: self.cw.gcs.call(req["method"], req.get("payload") or {})
+        )
+
+    def stop(self):
+        self.server.stop()
