@@ -78,6 +78,10 @@ class OwnedObject:
     pinned: int = 0  # pins from in-flight tasks that use this object as an arg
     in_plasma: bool = False
     location_hint: str | None = None
+    # Refs nested inside this object's value (reference: nested-ref borrow
+    # handoff, reference_count.h). The producer increfs each on our behalf;
+    # we decref them when this object itself is freed.
+    contained: list = field(default_factory=list)  # [(oid hex, owner addr)]
 
 
 class CoreWorker:
@@ -471,8 +475,10 @@ class CoreWorker:
 
         oid = ObjectID.for_put(self.current_task_id)
         oid_hex = oid.hex()
+        contained = self._incref_contained(ser.contained_refs)
         with self._lock:
-            self.owned.setdefault(oid_hex, OwnedObject())
+            entry = self.owned.setdefault(oid_hex, OwnedObject())
+            entry.contained = contained
         if ser.total_size > self.cfg.max_direct_call_object_size:
             self.store.put_serialized(oid_hex, ser)
             with self._lock:
@@ -897,11 +903,15 @@ class CoreWorker:
             return
         with self._lock:
             self.pending_tasks.pop(task_id, None)
-            for oid, kind, data in payload.get("results", []):
+            for result in payload.get("results", []):
+                oid, kind, data = result[0], result[1], result[2]
+                contained = result[3] if len(result) > 3 else []
+                obj = self.owned.setdefault(oid, OwnedObject())
+                if contained:
+                    obj.contained = contained
                 if kind == "inline":
                     self.in_process_store[oid] = {"data": data}
                 else:  # plasma
-                    obj = self.owned.setdefault(oid, OwnedObject())
                     obj.in_plasma = True
                     obj.location_hint = data
             if error is not None:
@@ -996,6 +1006,33 @@ class CoreWorker:
         else:
             self._push_to_owner(ref, "decref")
 
+    def _incref_contained(self, refs) -> list:
+        """Incref nested refs on behalf of a containing object; returns the
+        (id, owner) list to store on the container's OwnedObject."""
+        contained = []
+        for ref in refs or []:
+            owner = tuple(ref.owner_addr) if ref.owner_addr else tuple(self.address)
+            contained.append((ref.hex(), list(owner)))
+            if owner == tuple(self.address):
+                with self._lock:
+                    self.owned.setdefault(ref.hex(), OwnedObject()).ref_count += 1
+            else:
+                self._push_to_owner(ref, "incref")
+        return contained
+
+    def _decref_contained(self, contained: list):
+        from ray_tpu.object_ref import ObjectRef as _Ref
+
+        for cid, owner in contained:
+            if tuple(owner) == tuple(self.address):
+                with self._lock:
+                    obj = self.owned.get(cid)
+                    if obj is not None:
+                        obj.ref_count -= 1
+                        self._maybe_free_locked(cid, obj)
+            else:
+                self._push_to_owner(_Ref(ObjectID.from_hex(cid), owner, _register=False), "decref")
+
     def _maybe_free_locked(self, oid: str, obj: OwnedObject):
         """Free the object once all refs + pins are gone. Caller holds _lock."""
         if obj.ref_count > 0 or obj.pinned > 0:
@@ -1006,6 +1043,11 @@ class CoreWorker:
         self.in_process_store.pop(oid, None)
         self.owned.pop(oid, None)
         self._object_events.pop(oid, None)
+        if obj.contained:
+            contained, obj.contained = obj.contained, []
+            # Decref outside any recursion concerns via the same thread; the
+            # inner call re-takes the lock per entry.
+            self._io.loop.call_soon_threadsafe(self._decref_contained, contained)
         if obj.in_plasma:
             async def _free():
                 try:
@@ -1047,16 +1089,20 @@ class CoreWorker:
         return args, kwargs
 
     def _package_results(self, spec: TaskSpec, values: list) -> list:
-        """Serialize return values; small inline, large to plasma."""
+        """Serialize return values; small inline, large to plasma. Refs
+        nested in a result are incref'd here on the result's behalf and
+        shipped so the caller (the result's owner) holds them until the
+        result itself is freed (reference: nested-ref borrow handoff)."""
         results = []
         for i, value in enumerate(values):
             oid = spec.return_object_ids()[i]
             ser = serialization.serialize(value)
+            contained = self._incref_contained(ser.contained_refs)
             if ser.total_size > self.cfg.max_direct_call_object_size:
                 self.store.put_serialized(oid, ser)
-                results.append([oid, "plasma", self.node_id])
+                results.append([oid, "plasma", self.node_id, contained])
             else:
-                results.append([oid, "inline", ser.to_bytes()])
+                results.append([oid, "inline", ser.to_bytes(), contained])
         return results
 
     def execute_task(self, spec: TaskSpec) -> dict:

@@ -92,8 +92,13 @@ class Raylet:
         self.arena_name = f"/rtpu_{self.node_id[:12]}"
         capacity = object_store_memory or self.cfg.object_store_memory
         self.arena = create_arena(self.arena_name, capacity)
+        from ray_tpu._private.store.index import create_index
+
+        # Native object index: local-get fast path for every client process
+        # on this node (skipped automatically if the native build failed).
+        self.object_index = create_index(self.arena_name + "_idx")
         spill_dir = self.cfg.object_spill_dir or os.path.join(session_dir, "spill", self.node_id[:8])
-        self.store = StoreCore(self.arena, spill_dir)
+        self.store = StoreCore(self.arena, spill_dir, index=self.object_index)
 
         self.resources_total = dict(resources or {"CPU": os.cpu_count() or 1})
         self.resources_total.setdefault("memory", 4 * 1024 * 1024 * 1024)

@@ -40,11 +40,40 @@ class ObjectEntry:
 class StoreCore:
     """Daemon-side store state. Single-threaded (asyncio) access."""
 
-    def __init__(self, arena, spill_dir: str):
+    def __init__(self, arena, spill_dir: str, index=None):
         self.arena = arena
         self.spill_dir = spill_dir
         os.makedirs(spill_dir, exist_ok=True)
         self.objects: dict[str, ObjectEntry] = {}
+        # Native shm index: clients resolve local sealed objects without RPC.
+        self.index = index
+        # Arena blocks whose index slot still has client pins: freed once the
+        # readers drain (list of (object_id, offset)).
+        self._deferred_frees: list[tuple[str, int]] = []
+
+    def _index_remove_then_free(self, object_id: str, offset: int | None):
+        """Tombstone the index entry; free the arena block now if no client
+        pins it, else defer (drained opportunistically on later calls)."""
+        busy = False
+        if self.index is not None:
+            busy = self.index.remove(object_id) == 1
+        if offset is None:
+            return
+        if busy:
+            self._deferred_frees.append((object_id, offset))
+        else:
+            self.arena.free(offset)
+
+    def drain_deferred_frees(self):
+        if not self._deferred_frees or self.index is None:
+            return
+        still = []
+        for object_id, offset in self._deferred_frees:
+            if self.index.readers(object_id) == 0:
+                self.arena.free(offset)
+            else:
+                still.append((object_id, offset))
+        self._deferred_frees = still
 
     # ---- creation / sealing ----
 
@@ -58,6 +87,7 @@ class StoreCore:
             if entry.sealed:
                 return None
             return entry.offset
+        self.drain_deferred_frees()
         offset = self.arena.alloc(size)
         if offset is None:
             await self._make_space(size)
@@ -72,17 +102,21 @@ class StoreCore:
         self.objects[object_id] = ObjectEntry(
             object_id=object_id, offset=offset, size=size, last_access=time.monotonic()
         )
+        if self.index is not None:
+            self.index.put(object_id, offset, size)
         return offset
 
     def seal(self, object_id: str):
         entry = self.objects[object_id]
         entry.sealed = True
         entry.sealed_event.set()
+        if self.index is not None:
+            self.index.seal(object_id)
 
     def abort(self, object_id: str):
         entry = self.objects.pop(object_id, None)
-        if entry is not None and entry.offset is not None:
-            self.arena.free(entry.offset)
+        if entry is not None:
+            self._index_remove_then_free(object_id, entry.offset)
 
     # ---- access ----
 
@@ -112,8 +146,7 @@ class StoreCore:
         entry = self.objects.pop(object_id, None)
         if entry is None:
             return
-        if entry.offset is not None:
-            self.arena.free(entry.offset)
+        self._index_remove_then_free(object_id, entry.offset)
         if entry.spilled_path:
             try:
                 os.unlink(entry.spilled_path)
@@ -160,8 +193,10 @@ class StoreCore:
         for entry in candidates:
             if self.arena.largest_free() >= needed:
                 return
+            if self.index is not None and self.index.readers(entry.object_id) > 0:
+                continue  # a client is reading it via the index right now
             await self._spill(entry)
-            self.arena.free(entry.offset)
+            self._index_remove_then_free(entry.object_id, entry.offset)
             entry.offset = None
 
     async def _spill(self, entry: ObjectEntry):
@@ -189,8 +224,13 @@ class StoreCore:
                 raise ObjectStoreFullError("cannot restore spilled object")
         self.arena.write(offset, data)
         entry.offset = offset
+        if self.index is not None:
+            self.index.put(entry.object_id, offset, entry.size)
+            self.index.seal(entry.object_id)
 
     def close(self):
+        if self.index is not None:
+            self.index.close(unlink=True)
         self.arena.close(unlink=True)
 
 
@@ -207,13 +247,24 @@ def _read_file(path: str) -> bytes:
 
 
 class StoreClient:
-    """Client-side view: direct arena mapping + RPC metadata ops to raylet."""
+    """Client-side view: direct arena mapping + RPC metadata ops to raylet.
+
+    Local sealed objects resolve through the native shm index (two atomic
+    loads + a pin) with no RPC; everything else — unsealed waits, remote
+    pulls, spilled restores — falls back to the raylet RPC path."""
 
     def __init__(self, arena_name: str, raylet_client):
+        import threading as _threading
+
         from ray_tpu._private.store.arena import attach_arena
+        from ray_tpu._private.store.index import attach_index
 
         self.arena = attach_arena(arena_name)
+        self.index = attach_index(arena_name + "_idx")
         self.raylet = raylet_client
+        # object_id -> stack of pins: ("idx", version) | ("rpc", None)
+        self._pins: dict[str, list] = {}
+        self._pins_lock = _threading.Lock()
 
     def put_serialized(self, object_id_hex: str, serialized) -> None:
         """create -> write payload zero-copy into arena -> seal."""
@@ -231,19 +282,47 @@ class StoreClient:
 
     def get_view(self, object_id_hex: str, timeout: float | None = None) -> memoryview:
         """Blocks until sealed locally; returns a zero-copy view (pinned)."""
+        if self.index is not None:
+            hit = self.index.get_pinned(object_id_hex)
+            if hit is not None:
+                offset, size, token = hit
+                with self._pins_lock:
+                    self._pins.setdefault(object_id_hex, []).append(("idx", token))
+                return self.arena.read(offset, size)
         resp = self.raylet.call(
             "store_get", {"object_id": object_id_hex, "timeout": timeout}, timeout=timeout
         )
+        with self._pins_lock:
+            self._pins.setdefault(object_id_hex, []).append(("rpc", None))
         return self.arena.read(resp["offset"], resp["size"])
 
     def contains(self, object_id_hex: str) -> bool:
+        if self.index is not None:
+            hit = self.index.get_pinned(object_id_hex)
+            if hit is not None:
+                # Probe only: release the pin we just took.
+                self.index.release(hit[2])
+                return True
+            # Miss is authoritative only for sealed-local; spilled objects
+            # have no index entry but still "exist" — ask the daemon.
         return self.raylet.call("store_contains", {"object_id": object_id_hex})["found"]
 
     def release(self, object_id_hex: str):
+        with self._pins_lock:
+            stack = self._pins.get(object_id_hex)
+            pin = stack.pop() if stack else None
+            if stack is not None and not stack:
+                self._pins.pop(object_id_hex, None)
+        if pin is not None and pin[0] == "idx":
+            if self.index is not None:
+                self.index.release(pin[1])
+            return
         try:
             self.raylet.push("store_release", {"object_id": object_id_hex})
         except Exception:
             pass
 
     def close(self):
+        if self.index is not None:
+            self.index.close(unlink=False)
         self.arena.close(unlink=False)

@@ -5,11 +5,17 @@ Implements the slice of the CoreWorker surface the public API touches
 register_ref / gcs.call) by proxying every call to a ClientServer on the head
 node. Installed into worker_context so `ray_tpu.remote/get/put/...` work
 unchanged (reference: util/client/worker.py:81 + client-mode API swap).
+
+Ref lifetime: the server holds one pin per (client, object id). The client
+counts its local ObjectRef instances per id; when the LAST local instance for
+an id is GC'd the id is queued for release, and queued releases ride along
+with the next API call — ``__del__`` never blocks on the network.
 """
 
 from __future__ import annotations
 
 import threading
+import uuid
 
 from ray_tpu._private import serialization
 from ray_tpu._private.ids import ObjectID
@@ -22,9 +28,7 @@ class _GcsProxy:
         self._client = client
 
     def call(self, method: str, payload: dict | None = None) -> dict:
-        return self._client._rpc.call(
-            "client_gcs_call", {"method": method, "payload": payload or {}}
-        )
+        return self._client._call("client_gcs_call", {"method": method, "payload": payload or {}})
 
 
 class ClientCoreWorker:
@@ -32,35 +36,39 @@ class ClientCoreWorker:
 
     def __init__(self, address: tuple, namespace: str = ""):
         self._rpc = RpcClient(tuple(address), label="ray-client")
+        self._client_id = uuid.uuid4().hex
         self.namespace = namespace
         self.gcs = _GcsProxy(self)
         self._released: list[str] = []
+        self._local_counts: dict[str, int] = {}
         self._release_lock = threading.Lock()
 
-    # -- serialization helpers -----------------------------------------
+    # -- plumbing -------------------------------------------------------
+    def _call(self, method: str, payload: dict, timeout: float | None = None):
+        """RPC with the client id and any queued ref releases piggybacked."""
+        with self._release_lock:
+            batch, self._released = self._released, []
+        payload["client_id"] = self._client_id
+        if batch:
+            try:
+                self._rpc.call("client_release", {"client_id": self._client_id, "ids": batch})
+            except Exception:
+                with self._release_lock:
+                    self._released = batch + self._released
+        return self._rpc.call(method, payload, timeout=timeout)
+
     @staticmethod
     def _pack_args(args, kwargs) -> bytes:
         return serialization.dumps((tuple(args), dict(kwargs or {})))
 
     def _refs_from_ids(self, ids: list[str]) -> list[ObjectRef]:
+        # No owner addr: these ids are pinned in the server's registry for as
+        # long as we hold them, so the server never needs owner resolution.
         return [ObjectRef(ObjectID.from_hex(i)) for i in ids]
-
-    def _flush_releases(self):
-        """Send any pending ref releases (piggybacked on every API call so
-        dropped refs don't stay pinned server-side)."""
-        with self._release_lock:
-            batch, self._released = self._released, []
-        if batch:
-            try:
-                self._rpc.call("client_release", {"ids": batch})
-            except Exception:
-                with self._release_lock:
-                    self._released = batch + self._released
 
     # -- task / actor API ----------------------------------------------
     def submit_task(self, func, args, kwargs, **opts):
-        self._flush_releases()
-        resp = self._rpc.call(
+        resp = self._call(
             "client_task",
             {
                 "func": serialization.dumps(func),
@@ -71,8 +79,7 @@ class ClientCoreWorker:
         return self._refs_from_ids(resp["ids"])
 
     def create_actor(self, cls, args, kwargs, **opts):
-        self._flush_releases()
-        resp = self._rpc.call(
+        resp = self._call(
             "client_create_actor",
             {
                 "cls": serialization.dumps(cls),
@@ -83,7 +90,7 @@ class ClientCoreWorker:
         return resp["info"]
 
     def submit_actor_task(self, actor_id, method_name, args, kwargs, num_returns=1, max_task_retries=0):
-        resp = self._rpc.call(
+        resp = self._call(
             "client_actor_call",
             {
                 "actor_id": actor_id,
@@ -97,12 +104,15 @@ class ClientCoreWorker:
 
     # -- object API -----------------------------------------------------
     def get(self, refs, timeout=None):
-        self._flush_releases()
         single = isinstance(refs, ObjectRef)
         ref_list = [refs] if single else list(refs)
-        resp = self._rpc.call(
+        resp = self._call(
             "client_get",
-            {"ids": [r.hex() for r in ref_list], "timeout": timeout},
+            {
+                "ids": [r.hex() for r in ref_list],
+                "owners": [r.owner_addr for r in ref_list],
+                "timeout": timeout,
+            },
             timeout=(timeout + 30) if timeout else None,
         )
         if resp.get("error") is not None:
@@ -111,16 +121,16 @@ class ClientCoreWorker:
         return values[0] if single else values
 
     def put(self, value) -> ObjectRef:
-        self._flush_releases()
-        resp = self._rpc.call("client_put", {"value": serialization.dumps(value)})
+        resp = self._call("client_put", {"value": serialization.dumps(value)})
         return self._refs_from_ids([resp["id"]])[0]
 
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
         by_id = {r.hex(): r for r in refs}
-        resp = self._rpc.call(
+        resp = self._call(
             "client_wait",
             {
                 "ids": list(by_id),
+                "owners": [by_id[i].owner_addr for i in by_id],
                 "num_returns": num_returns,
                 "timeout": timeout,
                 "fetch_local": fetch_local,
@@ -134,17 +144,20 @@ class ClientCoreWorker:
 
     # -- ref bookkeeping (ObjectRef.__init__/__del__ hooks) -------------
     def register_ref(self, ref: ObjectRef):
-        pass  # the server pins ids until we release them
+        with self._release_lock:
+            self._local_counts[ref.hex()] = self._local_counts.get(ref.hex(), 0) + 1
 
     def deregister_ref(self, ref: ObjectRef):
-        # Queue the release; flushed on the next API call (or immediately
-        # once a large batch accumulates) — __del__ must not block on RPC.
-        flush_now = False
+        # Queue-only (no RPC): __del__ can fire on any thread, including the
+        # IO loop thread, where a blocking call would deadlock the process.
         with self._release_lock:
-            self._released.append(ref.hex())
-            flush_now = len(self._released) >= 100
-        if flush_now:
-            self._flush_releases()
+            i = ref.hex()
+            n = self._local_counts.get(i, 0) - 1
+            if n > 0:
+                self._local_counts[i] = n
+            else:
+                self._local_counts.pop(i, None)
+                self._released.append(i)
 
     def as_future(self, ref: ObjectRef):
         import concurrent.futures
@@ -165,7 +178,7 @@ class ClientCoreWorker:
             batch, self._released = self._released, []
         try:
             if batch:
-                self._rpc.call("client_release", {"ids": batch})
+                self._rpc.call("client_release", {"client_id": self._client_id, "ids": batch})
         except Exception:
             pass
         self._rpc.close()

@@ -21,7 +21,10 @@ class ClientServer:
     def __init__(self, core_worker, host: str = "0.0.0.0", port: int = 0):
         """``core_worker`` is a DRIVER-mode CoreWorker already connected."""
         self.cw = core_worker
-        self._refs: dict[str, object] = {}  # id hex -> ObjectRef (pin)
+        # client_id -> {id hex -> ObjectRef}. One pin per (client, id); the
+        # client releases when its LAST local ref for the id dies, so a
+        # release from one client can never unpin another's objects.
+        self._refs: dict[str, dict[str, object]] = {}
         self._lock = threading.Lock()
         self.server = RpcServer(name="client-server")
         self.server.register_all(self, prefix="client_")
@@ -29,29 +32,50 @@ class ClientServer:
         self.address = self.server.address
 
     # -- helpers --------------------------------------------------------
-    def _pin(self, refs) -> list[str]:
+    def _pin(self, client_id: str, refs) -> list[str]:
         out = []
         with self._lock:
+            table = self._refs.setdefault(client_id or "", {})
             for r in refs:
-                self._refs[r.hex()] = r
+                table.setdefault(r.hex(), r)
                 out.append(r.hex())
         return out
 
-    def _lookup(self, ids: list[str]) -> list:
-        with self._lock:
-            missing = [i for i in ids if i not in self._refs]
-            if missing:
-                raise KeyError(f"unknown/released object ids {missing}")
-            return [self._refs[i] for i in ids]
+    def _lookup(self, client_id: str, ids: list[str], owners: list | None = None) -> list:
+        """Resolve ids to refs. Ids the server never pinned (e.g. ObjectRefs
+        nested inside returned values, deserialized client-side) are rebuilt
+        from id + owner address and registered with the driver."""
+        from ray_tpu._private.ids import ObjectID
+        from ray_tpu.object_ref import ObjectRef
 
-    @staticmethod
-    async def _off_loop(fn):
+        out = []
+        with self._lock:
+            table = self._refs.setdefault(client_id or "", {})
+            for pos, i in enumerate(ids):
+                ref = table.get(i)
+                if ref is None:
+                    owner = owners[pos] if owners and pos < len(owners) else None
+                    ref = ObjectRef(ObjectID.from_hex(i), owner, _register=False)
+                    self.cw.register_ref(ref)
+                    table[i] = ref
+                out.append(ref)
+        return out
+
+    async def _off_loop(self, fn):
         """Every CoreWorker entry point here is synchronous and may itself
         issue blocking RPCs — running it on the IO loop would deadlock the
-        process's sockets. Always hop to a worker thread."""
+        process's sockets. Always hop to a worker thread, with worker_context
+        bound to the server's driver so (de)serialization hooks (ObjectRef
+        borrow registration in particular) land on the right core worker."""
         import asyncio
 
-        return await asyncio.get_event_loop().run_in_executor(None, fn)
+        from ray_tpu._private import worker_context
+
+        def run():
+            with worker_context.override(self.cw):
+                return fn()
+
+        return await asyncio.get_event_loop().run_in_executor(None, run)
 
     # -- RPC methods ----------------------------------------------------
     async def rpc_task(self, req):
@@ -59,7 +83,7 @@ class ClientServer:
         args, kwargs = serialization.loads(req["args"])
         opts = req.get("opts") or {}
         refs = await self._off_loop(lambda: self.cw.submit_task(func, args, kwargs, **opts))
-        return {"ids": self._pin(refs)}
+        return {"ids": self._pin(req.get("client_id", ""), refs)}
 
     async def rpc_create_actor(self, req):
         cls = serialization.loads(req["cls"])
@@ -80,11 +104,11 @@ class ClientServer:
                 max_task_retries=req.get("max_task_retries", 0),
             )
         )
-        return {"ids": self._pin(refs)}
+        return {"ids": self._pin(req.get("client_id", ""), refs)}
 
     async def rpc_get(self, req):
-        refs = self._lookup(req["ids"])
         try:
+            refs = self._lookup(req.get("client_id", ""), req["ids"], req.get("owners"))
             values = await self._off_loop(
                 lambda: self.cw.get(refs, timeout=req.get("timeout"))
             )
@@ -95,10 +119,10 @@ class ClientServer:
     async def rpc_put(self, req):
         value = serialization.loads(req["value"])
         ref = await self._off_loop(lambda: self.cw.put(value))
-        return {"id": self._pin([ref])[0]}
+        return {"id": self._pin(req.get("client_id", ""), [ref])[0]}
 
     async def rpc_wait(self, req):
-        refs = self._lookup(req["ids"])
+        refs = self._lookup(req.get("client_id", ""), req["ids"], req.get("owners"))
         ready, not_ready = await self._off_loop(
             lambda: self.cw.wait(
                 refs,
@@ -111,8 +135,9 @@ class ClientServer:
 
     async def rpc_release(self, req):
         with self._lock:
+            table = self._refs.get(req.get("client_id", ""), {})
             for i in req.get("ids", []):
-                self._refs.pop(i, None)
+                table.pop(i, None)
         return {"ok": True}
 
     async def rpc_gcs_call(self, req):

@@ -77,6 +77,27 @@ def test_client_named_actor_and_nodes(client_connection):
     assert ray_tpu.cluster_resources()["CPU"] == 4
 
 
+def test_client_nested_refs(client_connection):
+    """ObjectRefs nested inside returned values are fetchable client-side,
+    and releasing a deserialized copy never unpins a live original."""
+
+    @ray_tpu.remote
+    def make_refs():
+        return [ray_tpu.put(41), ray_tpu.put(43)]
+
+    inner = ray_tpu.get(make_refs.remote())
+    assert [ray_tpu.get(r) for r in inner] == [41, 43]
+    # Copy + drop: the original must stay fetchable.
+    import copy
+
+    dup = copy.copy(inner[0])
+    del dup
+    import gc
+
+    gc.collect()
+    assert ray_tpu.get(inner[0]) == 41
+
+
 def test_client_task_error_propagates(client_connection):
     @ray_tpu.remote
     def boom():

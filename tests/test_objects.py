@@ -109,3 +109,20 @@ def test_owner_serves_borrower(ray_start_regular):
         return ray_tpu.get(r, timeout=30)
 
     assert ray_tpu.get(fetch.remote([ref]), timeout=60) == ["inline-value"]
+
+
+def test_task_returning_refs_keeps_them_alive(ray_start_regular):
+    """Refs nested in a returned value survive the producing worker's local
+    refs dying (nested-ref borrow handoff; reference: reference_count.h)."""
+    import gc
+    import time
+
+    @ray_tpu.remote
+    def make_refs():
+        return {"a": ray_tpu.put("alpha"), "b": [ray_tpu.put(np.arange(50_000))]}
+
+    out = ray_tpu.get(make_refs.remote(), timeout=60)
+    gc.collect()
+    time.sleep(1.0)  # give any erroneous free a chance to land
+    assert ray_tpu.get(out["a"], timeout=30) == "alpha"
+    assert ray_tpu.get(out["b"][0], timeout=30).shape == (50_000,)

@@ -1,0 +1,115 @@
+"""Native shm object-index tests.
+
+Modeled on the reference's plasma client/store tests
+(src/ray/object_manager/plasma/test/): put/seal/lookup/pin/remove protocol,
+deferred frees under pins, and the client fast path end-to-end.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.store.index import attach_index, create_index
+
+
+@pytest.fixture
+def index():
+    name = f"/rtpu_test_idx_{os.getpid()}"
+    ix = create_index(name, nslots=64)
+    if ix is None:
+        pytest.skip("native index unavailable")
+    yield ix
+    ix.close(unlink=True)
+
+
+KEY1 = "aa" * 28
+KEY2 = "bb" * 28
+
+
+def test_put_seal_lookup(index):
+    assert index.put(KEY1, 128, 4096)
+    # Unsealed: clients must miss.
+    assert index.get_pinned(KEY1) is None
+    assert index.seal(KEY1)
+    hit = index.get_pinned(KEY1)
+    assert hit is not None
+    offset, size, token = hit
+    assert (offset, size) == (128, 4096)
+    index.release(token)
+
+
+def test_attacher_sees_owner_writes(index):
+    other = attach_index(index.name)
+    assert other is not None
+    index.put(KEY1, 64, 100)
+    index.seal(KEY1)
+    hit = other.get_pinned(KEY1)
+    assert hit is not None and hit[0] == 64
+    other.release(hit[2])
+    other.close()
+
+
+def test_remove_defers_under_pin(index):
+    index.put(KEY1, 0, 10)
+    index.seal(KEY1)
+    hit = index.get_pinned(KEY1)
+    assert hit is not None
+    # Pinned: remove reports busy (1), readers visible.
+    assert index.remove(KEY1) == 1
+    assert index.readers(KEY1) == 1
+    # Tombstoned: new lookups miss.
+    assert index.get_pinned(KEY1) is None
+    index.release(hit[2])
+    assert index.readers(KEY1) == 0
+
+
+def test_slot_reuse_bumps_version(index):
+    index.put(KEY1, 0, 10)
+    index.seal(KEY1)
+    h1 = index.get_pinned(KEY1)
+    index.release(h1[2])
+    assert index.remove(KEY1) == 0
+    # Same key re-created (reconstruction): version must differ.
+    index.put(KEY1, 640, 20)
+    index.seal(KEY1)
+    h2 = index.get_pinned(KEY1)
+    assert h2 is not None
+    assert h2[2] != h1[2]  # (slot, version) token differs on re-create
+    assert h2[0] == 640
+    index.release(h2[2])
+
+
+def test_many_keys_no_collision_loss(index):
+    keys = [("%02x" % i) * 28 for i in range(40)]  # 40 keys in 64 slots
+    for i, k in enumerate(keys):
+        assert index.put(k, i * 64, 64)
+        assert index.seal(k)
+    for i, k in enumerate(keys):
+        hit = index.get_pinned(k)
+        assert hit is not None and hit[0] == i * 64, k
+        index.release(hit[2])
+
+
+def test_local_get_uses_index_fast_path():
+    """End-to-end: a large object put through the framework is readable in
+    the driver via the index (no RPC), and the data is correct."""
+    ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+    try:
+        from ray_tpu._private import worker_context
+
+        cw = worker_context.get_core_worker()
+        store = cw.store
+        if store.index is None:
+            pytest.skip("native index unavailable")
+        arr = np.random.default_rng(0).standard_normal(200_000)
+        ref = ray_tpu.put(arr)  # > inline threshold -> plasma
+        # The index must resolve the object locally.
+        hit = store.index.get_pinned(ref.hex())
+        assert hit is not None
+        store.index.release(hit[2])
+        out = ray_tpu.get(ref)
+        assert np.array_equal(out, arr)
+    finally:
+        ray_tpu.shutdown()
