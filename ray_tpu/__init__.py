@@ -170,6 +170,10 @@ def init(
             job_runtime_env=runtime_env,
         )
         worker_context.set_core_worker(cw)
+    from ray_tpu.util import tracing as _tracing
+
+    if _tracing.tracing_enabled():
+        _tracing._publish_flag_if_connected()
     _install_driver_hooks()
     return cw
 

@@ -30,6 +30,7 @@ import collections
 import contextvars
 import hashlib
 import logging
+import os
 import threading
 import time
 from concurrent.futures import Future as ConcurrentFuture
@@ -173,6 +174,20 @@ class CoreWorker:
         self._task_events_lock = threading.Lock()
         self._task_events_flusher: threading.Thread | None = None
 
+        # Log pipeline: drivers subscribe to worker stdout/stderr lines
+        # published by each raylet's LogMonitor (reference: print_logs in
+        # _private/worker.py; disable with RAY_TPU_LOG_TO_DRIVER=0).
+        self.log_to_driver = (
+            mode == DRIVER and os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0"
+        )
+        if self.log_to_driver:
+            try:
+                self.gcs.call(
+                    "subscribe", {"channel": "worker_logs", "address": list(self.address)}
+                )
+            except Exception:
+                self.log_to_driver = False
+
     def _fallback_ctx(self) -> tuple | None:
         with self._active_exec_lock:
             if not self._active_exec:
@@ -208,6 +223,8 @@ class CoreWorker:
             "worker_id": self.worker_id,
             "node_id": self.node_id,
         }
+        if spec.trace_ctx:
+            event["trace_ctx"] = spec.trace_ctx
         event.update(extra)
         with self._task_events_lock:
             self._task_events.append(event)
@@ -301,6 +318,7 @@ class CoreWorker:
             placement_group_bundle_index=opts.get("placement_group_bundle_index", -1),
             scheduling_strategy=opts.get("scheduling_strategy", "DEFAULT"),
             runtime_env=self._merged_runtime_env(opts.get("runtime_env")),
+            trace_ctx=self._trace_ctx(),
         )
         self._register_pending(spec, arg_refs)
         self.record_task_event(spec, "PENDING_ARGS_AVAIL")
@@ -309,6 +327,12 @@ class CoreWorker:
             ObjectRef(ObjectID.for_return(task_id, i), self.address)
             for i in range(num_returns)
         ]
+
+    @staticmethod
+    def _trace_ctx() -> dict:
+        from ray_tpu.util import tracing
+
+        return tracing.child_span_context() if tracing.tracing_enabled() else {}
 
     def _merged_runtime_env(self, task_env: dict | None) -> dict:
         """Task/actor env over the job-level env; env_vars dicts merge."""
@@ -757,6 +781,7 @@ class CoreWorker:
             placement_group_bundle_index=opts.get("placement_group_bundle_index", -1),
             scheduling_strategy=opts.get("scheduling_strategy", "DEFAULT"),
             runtime_env=self._merged_runtime_env(opts.get("runtime_env")),
+            trace_ctx=self._trace_ctx(),
         )
         for ref in arg_refs:
             self._pin_arg(ref)
@@ -824,6 +849,7 @@ class CoreWorker:
             method_name=method_name,
             seq_no=self._actor_seq[actor_id],
             max_task_retries=max_task_retries,
+            trace_ctx=self._trace_ctx(),
         )
         self._register_pending(spec, arg_refs)
         self._actor_pending[actor_id].add(spec.task_id)
@@ -971,6 +997,14 @@ class CoreWorker:
                 return {"kind": "plasma", "location": obj.location_hint}
         return {"kind": "missing"}
 
+    async def rpc_pubsub(self, req):
+        """GCS pubsub push (driver: worker_logs echo)."""
+        if req.get("channel") == "worker_logs" and self.log_to_driver:
+            from ray_tpu._private.log_monitor import print_worker_logs
+
+            print_worker_logs(req.get("message") or {}, self.job_id.hex())
+        return {"ok": True}
+
     async def rpc_incref(self, req):
         with self._lock:
             self.owned.setdefault(req["object_id"], OwnedObject()).ref_count += 1
@@ -1113,6 +1147,9 @@ class CoreWorker:
             self._active_exec_seq += 1
             exec_key = self._active_exec_seq
             self._active_exec[exec_key] = ctx
+        from ray_tpu.util import tracing
+
+        trace_token = tracing.set_task_context(spec.trace_ctx)
         start = time.time()
         self.record_task_event(spec, "RUNNING", start_ts=start)
         try:
@@ -1158,6 +1195,7 @@ class CoreWorker:
             )
         finally:
             _exec_ctx.reset(token)
+            tracing.reset_task_context(trace_token)
             with self._active_exec_lock:
                 self._active_exec.pop(exec_key, None)
         payload["duration_s"] = time.time() - start
@@ -1174,10 +1212,15 @@ class CoreWorker:
         # runs in its own contextvars Context, so setting inside the wrapper
         # is task-local even when coroutines interleave on the shared loop.
         ctx = _exec_ctx.get()
+        spec = ctx[1] if ctx is not None else None
 
         async def _with_ctx():
             if ctx is not None:
                 _exec_ctx.set(ctx)
+            if spec is not None and spec.trace_ctx:
+                from ray_tpu.util import tracing
+
+                tracing.set_task_context(spec.trace_ctx)
             return await coro
 
         return asyncio.run_coroutine_threadsafe(_with_ctx(), self._actor_async_loop).result()
@@ -1191,6 +1234,9 @@ class CoreWorker:
         except Exception:
             pass
         if self.mode == DRIVER:
+            from ray_tpu._private.usage_stats import write_usage_stats
+
+            write_usage_stats(self)
             if job_state is None:
                 job_state = "SUCCEEDED"
             try:

@@ -1,0 +1,121 @@
+"""Log pipeline: per-worker log files → GCS pubsub → driver stdout.
+
+Analog of the reference's LogMonitor (python/ray/_private/log_monitor.py:102)
++ the driver-side print redirection (_private/worker.py print_logs): the
+raylet tails every worker's stdout/stderr file and publishes new lines on the
+``worker_logs`` channel; each driver subscribes and echoes lines belonging to
+its job, prefixed ``({name} pid=..., node=...)`` like the reference.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import glob
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+MAX_LINES_PER_TICK = 200
+MAX_LINE_LEN = 20_000
+
+
+class LogMonitor:
+    """Raylet-side tailer. Runs as an asyncio task on the raylet loop."""
+
+    def __init__(self, raylet):
+        self.raylet = raylet
+        self.log_dir = os.path.join(raylet.session_dir, "logs")
+        # path -> read offset
+        self._offsets: dict[str, int] = {}
+
+    def _worker_for(self, path: str):
+        """Map worker-<wid8>.out/.err to the raylet's worker handle."""
+        base = os.path.basename(path)
+        if not base.startswith("worker-"):
+            return None
+        wid8 = base[len("worker-") :].split(".")[0]
+        for wid, w in self.raylet.workers.items():
+            if wid.startswith(wid8):
+                return w
+        return None
+
+    async def run(self):
+        while True:
+            try:
+                await self._tick()
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                logger.debug("log monitor tick failed", exc_info=True)
+            await asyncio.sleep(0.3)
+
+    async def _tick(self):
+        for path in glob.glob(os.path.join(self.log_dir, "worker-*.out")) + glob.glob(
+            os.path.join(self.log_dir, "worker-*.err")
+        ):
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            # Attribution snapshot BEFORE reading: lines already in the file
+            # were written under the job active up to now; a task dispatched
+            # mid-tick must not claim them.
+            worker = self._worker_for(path)
+            offset = self._offsets.get(path, 0)
+            if size <= offset:
+                continue
+            with open(path, "rb") as f:
+                f.seek(offset)
+                chunk = f.read(min(size - offset, 1 << 20))
+            # Only consume complete lines; partial tail re-read next tick.
+            last_nl = chunk.rfind(b"\n")
+            if last_nl < 0:
+                if len(chunk) < MAX_LINE_LEN:
+                    continue
+                last_nl = len(chunk) - 1
+            raw_lines = chunk[: last_nl + 1].splitlines(keepends=True)
+            if len(raw_lines) > MAX_LINES_PER_TICK:
+                # Publish a bounded batch and only advance the offset past
+                # what was published — the rest is re-read next tick, never
+                # silently dropped.
+                raw_lines = raw_lines[:MAX_LINES_PER_TICK]
+                consumed = sum(len(l) for l in raw_lines)
+            else:
+                consumed = last_nl + 1
+            self._offsets[path] = offset + consumed
+            lines = [l.decode(errors="replace").rstrip("\r\n")[:MAX_LINE_LEN] for l in raw_lines]
+            if not lines:
+                continue
+            message = {
+                "lines": lines,
+                "is_err": path.endswith(".err"),
+                "pid": worker.pid if worker else 0,
+                "node_id": self.raylet.node_id,
+                "job_id": getattr(worker, "last_job_id", None) if worker else None,
+                "name": getattr(worker, "last_task_name", None) if worker else None,
+            }
+            try:
+                await self.raylet.gcs.acall(
+                    "publish", {"channel": "worker_logs", "message": message}
+                )
+            except Exception:
+                pass
+
+
+def print_worker_logs(message: dict, own_job_id: str):
+    """Driver-side: echo a worker_logs message if it belongs to this job."""
+    import sys
+
+    job = message.get("job_id")
+    if job is not None and job != own_job_id:
+        return
+    name = message.get("name") or "worker"
+    prefix = f"({name} pid={message.get('pid')}, node={str(message.get('node_id'))[:8]})"
+    stream = sys.stderr if message.get("is_err") else sys.stdout
+    for line in message.get("lines", []):
+        print(f"{prefix} {line}", file=stream)
+    try:
+        stream.flush()
+    except Exception:
+        pass

@@ -62,8 +62,12 @@ class Node:
     ):
         cfg = init_config(_system_config) if head else get_config()
         ts = time.strftime("%Y%m%d-%H%M%S")
+        import uuid as _uuid
+
+        # uuid suffix: two inits in the same process+second (common in test
+        # suites) must not share a session directory.
         self.session_dir = session_dir or os.path.join(
-            cfg.session_dir_root, f"session_{ts}_{os.getpid()}"
+            cfg.session_dir_root, f"session_{ts}_{os.getpid()}_{_uuid.uuid4().hex[:6]}"
         )
         os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
 

@@ -71,6 +71,9 @@ class WorkerHandle:
     actor_spec: TaskSpec | None = None
     actor_id: str | None = None
     last_idle: float = field(default_factory=time.monotonic)
+    # Log-pipeline attribution (reference: LogMonitor tags lines by job).
+    last_job_id: str | None = None
+    last_task_name: str | None = None
 
 
 class Raylet:
@@ -125,6 +128,9 @@ class Raylet:
         self._io.run(self._register())
         self._hb_task = self._io.spawn(self._heartbeat_loop())
         self._reap_task = self._io.spawn(self._reap_loop())
+        from ray_tpu._private.log_monitor import LogMonitor
+
+        self._log_monitor_task = self._io.spawn(LogMonitor(self).run())
         self._stopped = False
 
     async def _register(self):
@@ -572,6 +578,8 @@ class Raylet:
                     pool[k] = pool.get(k, 0) - v
                 worker.state = "actor" if spec.is_actor_creation() else "busy"
                 worker.current_task = spec
+                worker.last_job_id = spec.job_id
+                worker.last_task_name = spec.name
                 if spec.is_actor_creation():
                     worker.actor_id = spec.actor_id
                 made_progress = True
@@ -764,6 +772,7 @@ class Raylet:
         self._stopped = True
         self._hb_task.cancel()
         self._reap_task.cancel()
+        self._log_monitor_task.cancel()
         for w in self.workers.values():
             if w.proc is not None and w.proc.poll() is None:
                 w.proc.terminate()

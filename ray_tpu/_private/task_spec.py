@@ -51,6 +51,9 @@ class TaskSpec:
     placement_group_id: str = ""
     placement_group_bundle_index: int = -1
     scheduling_strategy: str = "DEFAULT"  # DEFAULT | SPREAD | node:<id> | node:<id>:soft
+    # Tracing span context propagated across process boundaries (reference:
+    # util/tracing/tracing_helper.py — span context rides task metadata).
+    trace_ctx: dict = field(default_factory=dict)
     runtime_env: dict = field(default_factory=dict)
 
     def to_wire(self) -> dict:

@@ -1,0 +1,68 @@
+"""Usage stats collection.
+
+Analog of the reference's opt-out telemetry (_private/usage/usage_lib.py:93):
+cluster/runtime metadata is collected at shutdown. This deployment has no
+egress, so the report is only written to ``<session_dir>/usage_stats.json``
+(the reference uploads to a collector URL when enabled). Opt out with
+``RAY_TPU_USAGE_STATS_ENABLED=0``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import platform
+import sys
+import time
+
+
+def usage_stats_enabled() -> bool:
+    return os.environ.get("RAY_TPU_USAGE_STATS_ENABLED", "1") != "0"
+
+
+def _library_usages() -> list[str]:
+    used = []
+    for lib in ("train", "tune", "data", "serve", "rllib", "workflow", "dag"):
+        if f"ray_tpu.{lib}" in sys.modules:
+            used.append(lib)
+    return used
+
+
+def collect_usage_stats(core_worker) -> dict:
+    import ray_tpu
+
+    report = {
+        "schema_version": "0.1",
+        "ray_tpu_version": ray_tpu.__version__,
+        "python_version": platform.python_version(),
+        "os": platform.system().lower(),
+        "collected_at": time.time(),
+        "libraries_used": _library_usages(),
+    }
+    try:
+        nodes = core_worker.gcs.call("get_nodes")["nodes"]
+        alive = [n for n in nodes.values() if n["state"] == "ALIVE"]
+        report["num_nodes"] = len(alive)
+        total: dict = {}
+        for n in alive:
+            for k, v in n.get("resources_total", {}).items():
+                total[k] = total.get(k, 0) + v
+        report["total_num_cpus"] = total.get("CPU", 0)
+        report["total_num_tpus"] = total.get("TPU", 0)
+    except Exception:
+        pass
+    return report
+
+
+def write_usage_stats(core_worker):
+    """Called from driver shutdown; never raises."""
+    if not usage_stats_enabled():
+        return
+    try:
+        report = collect_usage_stats(core_worker)
+        path = os.path.join(core_worker.session_dir, "usage_stats.json")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(report, f, indent=2)
+    except Exception:
+        pass

@@ -120,6 +120,8 @@ def list_tasks(address=None, filters=None, limit: int = 10_000) -> list[dict]:
             row["state"] = ev.get("state")
             row["node_id"] = ev.get("node_id")
             row["worker_id"] = ev.get("worker_id")
+            if "trace_ctx" in ev:
+                row["trace_ctx"] = ev["trace_ctx"]
             if "start_ts" in ev:
                 row["start_time"] = ev["start_ts"]
             if "end_ts" in ev:

@@ -1,0 +1,52 @@
+"""Log pipeline tests (reference: python/ray/tests/test_output.py — worker
+prints stream back to the driver)."""
+
+import sys
+import time
+
+import ray_tpu
+
+
+def test_worker_prints_reach_driver(capfd):
+    ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+    try:
+
+        @ray_tpu.remote
+        def chatty():
+            print("hello-from-worker-stdout")
+            print("warn-from-worker-stderr", file=sys.stderr)
+            return 1
+
+        assert ray_tpu.get(chatty.remote()) == 1
+        deadline = time.time() + 15
+        out = err = ""
+        while time.time() < deadline:
+            captured = capfd.readouterr()
+            out += captured.out
+            err += captured.err
+            if "hello-from-worker-stdout" in out and "warn-from-worker-stderr" in err:
+                break
+            time.sleep(0.3)
+        assert "hello-from-worker-stdout" in out
+        assert "(chatty pid=" in out  # reference-style prefix
+        assert "warn-from-worker-stderr" in err
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_log_to_driver_disabled(capfd, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_LOG_TO_DRIVER", "0")
+    ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+    try:
+
+        @ray_tpu.remote
+        def quiet():
+            print("should-not-appear")
+            return 1
+
+        assert ray_tpu.get(quiet.remote()) == 1
+        time.sleep(2.0)
+        captured = capfd.readouterr()
+        assert "should-not-appear" not in captured.out
+    finally:
+        ray_tpu.shutdown()

@@ -1,0 +1,86 @@
+"""Tracing + usage-stats tests (reference: python/ray/tests/test_tracing.py,
+test_usage_stats.py)."""
+
+import json
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import tracing
+
+
+def test_span_propagation_across_tasks(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_TRACING", "1")
+    tracing._enabled = None  # re-read env
+    ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+    try:
+
+        @ray_tpu.remote
+        def child():
+            return "leaf"
+
+        @ray_tpu.remote
+        def parent():
+            return ray_tpu.get(child.remote())
+
+        assert ray_tpu.get(parent.remote(), timeout=60) == "leaf"
+        # Flush events and reconstruct spans.
+        deadline = time.time() + 20
+        by_name = {}
+        while time.time() < deadline:
+            spans = tracing.export_spans()
+            by_name = {s["name"]: s for s in spans}
+            if "parent" in by_name and "child" in by_name:
+                break
+            time.sleep(0.3)
+        assert "parent" in by_name and "child" in by_name, by_name.keys()
+        p, c = by_name["parent"], by_name["child"]
+        assert p["trace_id"] == c["trace_id"], "child must join the parent's trace"
+        assert c["parent_id"] == p["span_id"], "child's parent span is the parent task"
+        assert p["parent_id"] is None  # root span from the driver
+    finally:
+        ray_tpu.shutdown()
+        tracing._enabled = None
+
+
+def test_tracing_disabled_no_ctx(monkeypatch):
+    monkeypatch.delenv("RAY_TPU_TRACING", raising=False)
+    tracing._enabled = None
+    ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+    try:
+
+        @ray_tpu.remote
+        def f():
+            return 1
+
+        assert ray_tpu.get(f.remote()) == 1
+        assert tracing.export_spans() == []
+    finally:
+        ray_tpu.shutdown()
+        tracing._enabled = None
+
+
+def test_usage_stats_written_on_shutdown():
+    ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+    from ray_tpu._private import worker_context
+
+    session_dir = worker_context.get_core_worker().session_dir
+    ray_tpu.shutdown()
+    path = os.path.join(session_dir, "usage_stats.json")
+    assert os.path.exists(path)
+    report = json.load(open(path))
+    assert report["num_nodes"] == 1
+    assert report["total_num_cpus"] == 2
+    assert report["ray_tpu_version"]
+
+
+def test_usage_stats_opt_out(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_USAGE_STATS_ENABLED", "0")
+    ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+    from ray_tpu._private import worker_context
+
+    session_dir = worker_context.get_core_worker().session_dir
+    ray_tpu.shutdown()
+    assert not os.path.exists(os.path.join(session_dir, "usage_stats.json"))
