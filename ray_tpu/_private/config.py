@@ -57,6 +57,14 @@ class Config:
     node_death_timeout_s: float = 5.0
     health_check_failure_threshold: int = 5
 
+    # --- memory monitor (reference: memory_monitor.py:94 + raylet worker
+    # killing policies worker_killing_policy*.h) ---
+    memory_monitor_enabled: bool = True
+    # Node memory fraction above which the raylet kills a task worker to
+    # relieve pressure; the killed task retries elsewhere/later.
+    memory_usage_threshold: float = 0.95
+    memory_monitor_interval_s: float = 1.0
+
     # --- RPC ---
     rpc_connect_timeout_s: float = 10.0
     rpc_retries: int = 3

@@ -49,6 +49,7 @@ from ray_tpu.exceptions import (
     ActorDiedError,
     GetTimeoutError,
     ObjectLostError,
+    OutOfMemoryError,
     OwnerDiedError,
     TaskCancelledError,
     TaskError,
@@ -185,8 +186,26 @@ class CoreWorker:
                 self.gcs.call(
                     "subscribe", {"channel": "worker_logs", "address": list(self.address)}
                 )
+                # Periodic re-subscribe: subscription state is not persisted
+                # by the GCS, so a restarted GCS regains subscribers within
+                # one period (subscribe is idempotent per address).
+                threading.Thread(
+                    target=self._resubscribe_loop, name="log-resubscribe", daemon=True
+                ).start()
             except Exception:
                 self.log_to_driver = False
+
+    def _resubscribe_loop(self):
+        while not self._shutdown:
+            time.sleep(10.0)
+            if self._shutdown:
+                return
+            try:
+                self.gcs.call(
+                    "subscribe", {"channel": "worker_logs", "address": list(self.address)}
+                )
+            except Exception:
+                pass
 
     def _fallback_ctx(self) -> tuple | None:
         with self._active_exec_lock:
@@ -549,7 +568,10 @@ class CoreWorker:
                 if isinstance(v.cause, (TaskCancelledError, ActorDiedError)):
                     raise v.cause
                 raise v
-            if isinstance(v, (ObjectLostError, WorkerCrashedError, ActorDiedError, TaskCancelledError)):
+            if isinstance(
+                v,
+                (ObjectLostError, WorkerCrashedError, ActorDiedError, TaskCancelledError, OutOfMemoryError),
+            ):
                 raise v
         return values[0] if single else values
 
@@ -970,7 +992,11 @@ class CoreWorker:
             )
             await self.raylet.acall("submit_task", {"spec": pending.spec.to_wire()})
         else:
-            self._fail_task(task_id, WorkerCrashedError(req.get("message", "worker crashed")))
+            message = req.get("message", "worker crashed")
+            if req.get("error") == "OutOfMemoryError":
+                self._fail_task(task_id, OutOfMemoryError(message))
+            else:
+                self._fail_task(task_id, WorkerCrashedError(message))
         return {"ok": True}
 
     async def rpc_get_inline(self, req):

@@ -65,6 +65,10 @@ class GcsServer:
         self._health_task = self._io.spawn(self._health_check_loop())
         if persist_path and os.path.exists(persist_path):
             self._load_snapshot()
+            self._io.spawn(self._recover_loaded_actors())
+        self._persist_task = (
+            self._io.spawn(self._persist_loop()) if persist_path else None
+        )
 
     # ------------------------------------------------------------------
     # Nodes & health
@@ -88,7 +92,12 @@ class GcsServer:
 
     async def rpc_heartbeat(self, req):
         node = self.nodes.get(req["node_id"])
-        if node is None or node["state"] == "DEAD":
+        if node is None:
+            # Not "dead" — we may have restarted and lost the (non-persisted)
+            # node table; the raylet re-registers and carries on (reference:
+            # HandleRayletNotifyGCSRestart, core_worker.cc:3149).
+            return {"ok": False, "unknown": True}
+        if node["state"] == "DEAD":
             return {"ok": False, "dead": True}
         node["last_heartbeat"] = time.monotonic()
         node["resources_available"] = req.get("resources_available", node["resources_available"])
@@ -97,7 +106,11 @@ class GcsServer:
         node["num_active_workers"] = req.get("num_active_workers", 0)
         # Return the cluster resource view: this doubles as the resource
         # syncer (reference: src/ray/common/ray_syncer/ray_syncer.h:86).
-        return {"ok": True, "nodes": self._cluster_view()}
+        return {
+            "ok": True,
+            "nodes": self._cluster_view(),
+            "tracing": bool(self.kv.get("tracing:enabled")),
+        }
 
     def _cluster_view(self):
         return {
@@ -540,9 +553,16 @@ class GcsServer:
         address and we connect back (long-poll-free push).
         """
         channel = req["channel"]
-        addr = req["address"]
-        client = RpcClient(tuple(addr) if isinstance(addr, list) else addr, label=f"sub-{channel}")
-        self._subscribers.setdefault(channel, []).append(client)
+        addr = tuple(req["address"]) if isinstance(req["address"], list) else req["address"]
+        subs = self._subscribers.setdefault(channel, [])
+        # Idempotent per (channel, address): subscribers periodically
+        # re-subscribe so a restarted GCS regains them without duplicates.
+        for existing in list(subs):
+            if getattr(existing, "address", None) == addr:
+                subs.remove(existing)
+                existing.close()
+        client = RpcClient(addr, label=f"sub-{channel}")
+        subs.append(client)
         return {"ok": True}
 
     async def _publish(self, channel: str, message: dict):
@@ -565,26 +585,87 @@ class GcsServer:
     # ------------------------------------------------------------------
 
     def _snapshot(self) -> dict:
+        # Actor/PG/job tables reload on restart (reference: gcs_init_data.h
+        # repopulates managers from Redis). Per-actor RPC clients and the
+        # node table are rebuilt live as raylets re-register. Pickled, not
+        # JSON: actor specs embed serialized (bytes) arguments.
         return {
-            "kv": {k: v.hex() if isinstance(v, bytes) else v for k, v in self.kv.items()},
-            "named_actors": {f"{ns}\x00{name}": aid for (ns, name), aid in self.named_actors.items()},
+            "kv": dict(self.kv),
+            "named_actors": dict(self.named_actors),
             "job_counter": self._job_counter,
+            "actors": dict(self.actors),
+            "placement_groups": self.placement_groups,
+            "jobs": self.jobs,
         }
 
-    def save_snapshot(self):
+    async def _recover_loaded_actors(self):
+        """Re-drive creation of actors snapshotted mid-flight: an actor
+        persisted as PENDING_CREATION/RESTARTING has no worker yet and nothing
+        else will ever schedule it after a restart. Waits for raylets to
+        re-register first."""
+        pending = [
+            aid
+            for aid, a in self.actors.items()
+            if a.get("state") in (PENDING_CREATION, RESTARTING)
+        ]
+        if not pending:
+            return
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if any(n["state"] == "ALIVE" for n in self.nodes.values()):
+                break
+            await asyncio.sleep(0.2)
+        for aid in pending:
+            info = self.actors.get(aid)
+            if info is None or info.get("state") not in (PENDING_CREATION, RESTARTING):
+                continue
+            try:
+                await self._schedule_actor_creation(aid)
+            except Exception:
+                logger.exception("recovery scheduling of actor %s failed", aid[:8])
+
+    async def _persist_loop(self):
+        while True:
+            await asyncio.sleep(2.0)
+            try:
+                self._do_save()
+            except Exception:
+                logger.debug("gcs snapshot failed", exc_info=True)
+
+    def _do_save(self):
+        """Write the snapshot. MUST run on the IO loop thread — tables are
+        mutated by RPC handlers on that loop, so this is the only thread from
+        which pickling them is race-free."""
         if not self.persist_path:
             return
-        with open(self.persist_path, "w") as f:
-            json.dump(self._snapshot(), f)
+        import pickle
+
+        tmp = self.persist_path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(self._snapshot(), f)
+        os.replace(tmp, self.persist_path)
+
+    def save_snapshot(self):
+        """Thread-safe snapshot: marshals onto the IO loop."""
+        if not self.persist_path:
+            return
+
+        async def _save():
+            self._do_save()
+
+        self._io.run(_save())
 
     def _load_snapshot(self):
-        with open(self.persist_path) as f:
-            snap = json.load(f)
-        self.kv = {k: bytes.fromhex(v) for k, v in snap.get("kv", {}).items()}
-        for key, aid in snap.get("named_actors", {}).items():
-            ns, name = key.split("\x00", 1)
-            self.named_actors[(ns, name)] = aid
+        import pickle
+
+        with open(self.persist_path, "rb") as f:
+            snap = pickle.load(f)
+        self.kv = dict(snap.get("kv", {}))
+        self.named_actors.update(snap.get("named_actors", {}))
         self._job_counter = snap.get("job_counter", 0)
+        self.actors.update(snap.get("actors", {}))
+        self.placement_groups.update(snap.get("placement_groups", {}))
+        self.jobs.update(snap.get("jobs", {}))
 
     def _raylet_client(self, node_id: str) -> RpcClient:
         client = self._raylet_clients.get(node_id)
@@ -596,6 +677,8 @@ class GcsServer:
 
     def stop(self):
         self._health_task.cancel()
+        if self._persist_task is not None:
+            self._persist_task.cancel()
         self.save_snapshot()
         for c in self._raylet_clients.values():
             c.close()

@@ -110,6 +110,10 @@ def print_worker_logs(message: dict, own_job_id: str):
     job = message.get("job_id")
     if job is not None and job != own_job_id:
         return
+    if job is None and not message.get("is_err"):
+        # Unattributed stdout (e.g. prestarted worker chatter) would leak to
+        # every driver; only unattributed STDERR (startup crashes) fans out.
+        return
     name = message.get("name") or "worker"
     prefix = f"({name} pid={message.get('pid')}, node={str(message.get('node_id'))[:8]})"
     stream = sys.stderr if message.get("is_err") else sys.stdout

@@ -74,6 +74,10 @@ class WorkerHandle:
     # Log-pipeline attribution (reference: LogMonitor tags lines by job).
     last_job_id: str | None = None
     last_task_name: str | None = None
+    # Set when the memory monitor killed this worker (OOM error surfacing).
+    oom_killed: bool = False
+    # When the current task was dispatched (OOM victim policy: newest first).
+    dispatch_ts: float = 0.0
 
 
 class Raylet:
@@ -131,6 +135,11 @@ class Raylet:
         from ray_tpu._private.log_monitor import LogMonitor
 
         self._log_monitor_task = self._io.spawn(LogMonitor(self).run())
+        from ray_tpu._private.memory_monitor import MemoryMonitor
+
+        self._memory_monitor = MemoryMonitor(self)
+        self._last_memory_check = 0.0
+        self._tracing_enabled = False
         self._stopped = False
 
     async def _register(self):
@@ -169,7 +178,22 @@ class Raylet:
                 if resp.get("dead"):
                     logger.error("raylet %s: GCS declared us dead; exiting", self.node_id[:8])
                     os._exit(1)
+                if resp.get("unknown"):
+                    # GCS restarted and lost its node table: re-register and
+                    # republish our sealed objects' locations.
+                    logger.warning("raylet %s: GCS restarted; re-registering", self.node_id[:8])
+                    await self._register()
+                    for oid in self.store.object_ids():
+                        try:
+                            await self.gcs.acall(
+                                "add_object_location",
+                                {"object_id": oid, "node_id": self.node_id},
+                            )
+                        except Exception:
+                            pass
+                    continue
                 self.cluster_view = resp.get("nodes", {})
+                self._tracing_enabled = bool(resp.get("tracing"))
                 await self._retry_pg_tasks()
                 if self.task_queue:
                     await self._dispatch()  # periodic re-check (anti-starvation)
@@ -578,6 +602,7 @@ class Raylet:
                     pool[k] = pool.get(k, 0) - v
                 worker.state = "actor" if spec.is_actor_creation() else "busy"
                 worker.current_task = spec
+                worker.dispatch_ts = time.monotonic()
                 worker.last_job_id = spec.job_id
                 worker.last_task_name = spec.name
                 if spec.is_actor_creation():
@@ -612,6 +637,8 @@ class Raylet:
         env = os.environ.copy()
         if runtime_env:
             env["RAY_TPU_RUNTIME_ENV"] = json.dumps(runtime_env)
+        if self._tracing_enabled:
+            env["RAY_TPU_TRACING"] = "1"
         env["RAY_TPU_WORKER_ID"] = worker_id
         env["RAY_TPU_NODE_ID"] = self.node_id
         env["RAY_TPU_RAYLET_ADDR"] = json.dumps(list(self.address))
@@ -691,8 +718,21 @@ class Raylet:
                     continue
                 if worker.proc is not None and worker.proc.poll() is not None:
                     await self._on_worker_death(
-                        worker, f"worker process exited with code {worker.proc.returncode}"
+                        worker,
+                        "worker killed by the node memory monitor (node memory "
+                        "usage exceeded the threshold)"
+                        if worker.oom_killed
+                        else f"worker process exited with code {worker.proc.returncode}",
+                        oom=worker.oom_killed,
                     )
+            # Memory pressure: kill a task worker if the node is over the
+            # threshold (reference: memory_monitor + worker killing policy).
+            if time.monotonic() - self._last_memory_check >= self.cfg.memory_monitor_interval_s:
+                self._last_memory_check = time.monotonic()
+                try:
+                    self._memory_monitor.tick()
+                except Exception:
+                    logger.debug("memory monitor tick failed", exc_info=True)
             # Scale down long-idle workers beyond the prestart floor.
             now = time.monotonic()
             idle = [w for w in self.workers.values() if w.state == "idle"]
@@ -702,7 +742,7 @@ class Raylet:
                     if w.proc is not None:
                         w.proc.terminate()
 
-    async def _on_worker_death(self, worker: WorkerHandle, reason: str):
+    async def _on_worker_death(self, worker: WorkerHandle, reason: str, oom: bool = False):
         if worker.state == "dead":
             return
         prev_state = worker.state
@@ -729,7 +769,7 @@ class Raylet:
                         "task_failed",
                         {
                             "task_id": spec.task_id,
-                            "error": "WorkerCrashedError",
+                            "error": "OutOfMemoryError" if oom else "WorkerCrashedError",
                             "message": reason,
                             "retriable": True,
                         },

@@ -197,20 +197,6 @@ def main():
     from ray_tpu._private.core_worker import WORKER, CoreWorker
     from ray_tpu._private.ids import JobID
 
-    # Cluster-wide tracing flag (set by tracing.enable_tracing via GCS KV;
-    # a driver env var would not reach workers on other nodes).
-    if os.environ.get("RAY_TPU_TRACING") != "1":
-        try:
-            from ray_tpu._private.rpc import RpcClient
-
-            probe = RpcClient(tuple(gcs_addr), label="tracing-probe")
-            resp = probe.call("kv_get", {"key": "tracing:enabled"}, timeout=5)
-            probe.close()
-            if resp.get("found"):
-                os.environ["RAY_TPU_TRACING"] = "1"
-        except Exception:
-            pass
-
     worker_env = os.environ.get("RAY_TPU_RUNTIME_ENV")
     cw = CoreWorker(
         mode=WORKER,

@@ -69,6 +69,11 @@ class OwnerDiedError(ObjectLostError):
     """The object's owner process died; the object's lineage is gone."""
 
 
+class OutOfMemoryError(RayTpuError):
+    """A task's worker was killed by the node memory monitor (reference:
+    ray.exceptions.OutOfMemoryError + worker_killing_policy)."""
+
+
 class ObjectStoreFullError(RayTpuError):
     """The node's shared-memory arena is full even after spilling/eviction."""
 

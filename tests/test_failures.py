@@ -120,3 +120,53 @@ def test_chaos_task_retry(ray_start_cluster):
     cluster.remove_node(victim)
     out = ray_tpu.get(refs, timeout=180)
     assert out == list(range(12))
+
+
+def test_memory_monitor_kills_and_surfaces_oom():
+    """With the threshold forced to 0, any running task worker is killed by
+    the memory monitor and the error surfaces as OutOfMemoryError after
+    retries are exhausted (reference: test_memory_pressure / worker killing
+    policy)."""
+    import time as _time
+
+    from ray_tpu.exceptions import OutOfMemoryError
+
+    ray_tpu.init(
+        num_cpus=2,
+        object_store_memory=64 * 1024 * 1024,
+        _system_config={
+            "memory_usage_threshold": 0.0,  # everything is "over threshold"
+            "memory_monitor_interval_s": 0.2,
+        },
+    )
+    try:
+
+        @ray_tpu.remote(max_retries=1)
+        def hog():
+            _time.sleep(30)
+            return "finished"
+
+        with pytest.raises(OutOfMemoryError):
+            ray_tpu.get(hog.remote(), timeout=120)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_memory_monitor_disabled_by_config():
+    ray_tpu.init(
+        num_cpus=2,
+        object_store_memory=64 * 1024 * 1024,
+        _system_config={
+            "memory_usage_threshold": 0.0,
+            "memory_monitor_enabled": False,
+        },
+    )
+    try:
+
+        @ray_tpu.remote
+        def quick():
+            return "ok"
+
+        assert ray_tpu.get(quick.remote(), timeout=60) == "ok"
+    finally:
+        ray_tpu.shutdown()

@@ -1,0 +1,95 @@
+"""GCS fault-tolerance tests.
+
+Modeled on the reference's python/ray/tests/test_gcs_fault_tolerance.py: the
+GCS restarts from its persisted snapshot on the same address; raylets detect
+the restart, re-register, and republish object locations; named actors and
+the KV survive; the cluster keeps executing tasks.
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import worker_context
+from ray_tpu._private.config import init_config
+from ray_tpu._private.core_worker import DRIVER, CoreWorker
+from ray_tpu._private.gcs import GcsServer
+from ray_tpu._private.raylet import Raylet
+
+
+def test_gcs_restart_preserves_state(tmp_path):
+    init_config(None)
+    persist = str(tmp_path / "gcs_snapshot.pkl")
+    session_dir = str(tmp_path / "session")
+    os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
+
+    gcs = GcsServer(persist_path=persist)
+    host, port = gcs.address
+    raylet = Raylet(gcs.address, session_dir, resources={"CPU": 2})
+    cw = CoreWorker(
+        mode=DRIVER,
+        gcs_address=gcs.address,
+        raylet_address=raylet.address,
+        arena_name=raylet.arena_name,
+        node_id=raylet.node_id,
+        session_dir=session_dir,
+    )
+    worker_context.set_core_worker(cw)
+    try:
+
+        @ray_tpu.remote(name="ft-actor")
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def inc(self):
+                self.n += 1
+                return self.n
+
+        c = Counter.remote()
+        assert ray_tpu.get(c.inc.remote(), timeout=60) == 1
+        cw.gcs.call("kv_put", {"key": "ft:probe", "value": b"survives", "overwrite": True})
+        # Ensure the state is in the snapshot before the "crash".
+        gcs.save_snapshot()
+        gcs.stop()
+
+        # Restart the GCS on the SAME address from the snapshot.
+        gcs2 = GcsServer(host=host, port=port, persist_path=persist)
+        try:
+            # Raylet heartbeats hit "unknown", re-register, and come back.
+            deadline = time.time() + 30
+            alive = False
+            while time.time() < deadline:
+                nodes = gcs2.nodes
+                if any(n.get("state") == "ALIVE" for n in nodes.values()):
+                    alive = True
+                    break
+                time.sleep(0.2)
+            assert alive, "raylet did not re-register after GCS restart"
+
+            # KV survived.
+            resp = cw.gcs.call("kv_get", {"key": "ft:probe"})
+            assert resp.get("found") and bytes(resp["value"]) == b"survives"
+
+            # Named actor survived (table restored) and still serves calls
+            # (the actor process never died; calls are direct transport).
+            h = ray_tpu.get_actor("ft-actor")
+            assert ray_tpu.get(h.inc.remote(), timeout=60) == 2
+
+            # New tasks still schedule.
+            @ray_tpu.remote
+            def f():
+                return "post-restart"
+
+            assert ray_tpu.get(f.remote(), timeout=60) == "post-restart"
+        finally:
+            gcs2.stop()
+    finally:
+        worker_context.set_core_worker(None)
+        try:
+            cw.shutdown()
+        except Exception:
+            pass
+        raylet.stop()
