@@ -74,6 +74,9 @@ class Config:
     default_max_retries: int = 3
     default_actor_max_restarts: int = 0
     actor_call_queue_depth: int = 10_000
+    # Calls to an actor still being created buffer this long (creation =
+    # worker spawn + user __init__, slow under load) before giving up.
+    actor_creation_timeout_s: float = 180.0
 
     # --- logging / events ---
     log_to_driver: bool = True

@@ -351,7 +351,13 @@ class CoreWorker:
     def _trace_ctx() -> dict:
         from ray_tpu.util import tracing
 
-        return tracing.child_span_context() if tracing.tracing_enabled() else {}
+        # Chain spans when tracing is enabled locally OR when the currently
+        # executing task arrived with a span (a worker spawned before the
+        # cluster-wide flag propagated must still not break its parent's
+        # trace).
+        if tracing.tracing_enabled() or tracing.get_current_span_context() is not None:
+            return tracing.child_span_context()
+        return {}
 
     def _merged_runtime_env(self, task_env: dict | None) -> dict:
         """Task/actor env over the job-level env; env_vars dicts merge."""
@@ -817,8 +823,14 @@ class CoreWorker:
         }
 
     def _resolve_actor(self, actor_id: str, timeout: float | None = None) -> tuple:
+        """Wait for the actor's address. Reference semantics: calls to an
+        actor still being created BUFFER until it is ready (creation can
+        legitimately take long under load — worker spawn + heavy imports), so
+        the timeout clock only runs while the actor is NOT progressing
+        through PENDING_CREATION/RESTARTING."""
         timeout = timeout if timeout is not None else self.cfg.worker_lease_timeout_s
         deadline = time.monotonic() + timeout
+        creation_deadline = time.monotonic() + self.cfg.actor_creation_timeout_s
         while True:
             addr = self._actor_addrs.get(actor_id)
             if addr is not None:
@@ -836,8 +848,12 @@ class CoreWorker:
                     f"actor {actor_id[:8]} is dead: {info.get('death_cause', '')}",
                     actor_id=actor_id,
                 )
-            if time.monotonic() > deadline:
-                raise ActorDiedError(f"timed out resolving actor {actor_id[:8]}")
+            in_creation = info["state"] in ("PENDING_CREATION", "RESTARTING")
+            limit = creation_deadline if in_creation else deadline
+            if time.monotonic() > limit:
+                raise ActorDiedError(
+                    f"timed out resolving actor {actor_id[:8]} (state {info['state']})"
+                )
             time.sleep(0.05)
 
     def _actor_client(self, actor_id: str) -> RpcClient:

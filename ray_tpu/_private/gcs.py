@@ -59,6 +59,8 @@ class GcsServer:
         self.jobs: dict[str, dict] = {}
         self.task_events: list[dict] = []
         self._job_counter = 0
+        # Bumped by mutating handlers; the persist loop skips unchanged state.
+        self._mutations = 0
         self._subscribers: dict[str, list] = {}  # channel -> [writer]
         self._raylet_clients: dict[str, RpcClient] = {}
         self._io = EventLoopThread.get()
@@ -167,6 +169,7 @@ class GcsServer:
     # ------------------------------------------------------------------
 
     async def rpc_register_actor(self, req):
+        self._mutations += 1
         spec = TaskSpec.from_wire(req["spec"])
         actor_id = spec.actor_id
         if spec.actor_name:
@@ -229,6 +232,12 @@ class GcsServer:
         info = self.actors.get(req["actor_id"])
         if info is None:
             return {"ok": False}
+        if info.get("state") == ALIVE and info.get("worker_id") not in (None, req.get("worker_id")):
+            # A second worker created the same actor (e.g. restart-recovery
+            # raced an in-flight creation): the incumbent wins, the duplicate
+            # process must exit.
+            return {"ok": False, "duplicate": True}
+        self._mutations += 1
         info.update(
             state=ALIVE,
             address=req["address"],
@@ -239,6 +248,7 @@ class GcsServer:
         return {"ok": True}
 
     async def rpc_report_worker_death(self, req):
+        self._mutations += 1
         """Raylet reports a dead worker and any actor it hosted."""
         for actor_id in req.get("actor_ids", []):
             await self._handle_actor_failure(actor_id, req.get("reason", "worker died"))
@@ -311,6 +321,7 @@ class GcsServer:
     # ------------------------------------------------------------------
 
     async def rpc_kv_put(self, req):
+        self._mutations += 1
         overwrite = req.get("overwrite", True)
         key = req["key"]
         if not overwrite and key in self.kv:
@@ -323,6 +334,7 @@ class GcsServer:
         return {"found": value is not None, "value": value}
 
     async def rpc_kv_del(self, req):
+        self._mutations += 1
         existed = self.kv.pop(req["key"], None) is not None
         return {"ok": True, "existed": existed}
 
@@ -362,6 +374,7 @@ class GcsServer:
     # ------------------------------------------------------------------
 
     async def rpc_create_placement_group(self, req):
+        self._mutations += 1
         pg_id = req["pg_id"]
         bundles = req["bundles"]  # list[dict resource->qty]
         strategy = req.get("strategy", "PACK")
@@ -478,6 +491,7 @@ class GcsServer:
         return plan
 
     async def rpc_remove_placement_group(self, req):
+        self._mutations += 1
         pg = self.placement_groups.get(req["pg_id"])
         if pg is None:
             return {"ok": False}
@@ -504,6 +518,7 @@ class GcsServer:
     # ------------------------------------------------------------------
 
     async def rpc_next_job_id(self, req):
+        self._mutations += 1
         self._job_counter += 1
         job_id = f"{self._job_counter:08x}"
         self.jobs[job_id] = {"job_id": job_id, "state": "RUNNING", "start_time": time.time()}
@@ -513,6 +528,7 @@ class GcsServer:
         return {"jobs": list(self.jobs.values())}
 
     async def rpc_mark_job_finished(self, req):
+        self._mutations += 1
         job = self.jobs.get(req["job_id"])
         if job is not None:
             job["state"] = req.get("state", "SUCCEEDED")
@@ -568,13 +584,17 @@ class GcsServer:
     async def _publish(self, channel: str, message: dict):
         subs = self._subscribers.get(channel, [])
         dead = []
-        for client in subs:
+        # Snapshot: rpc_subscribe may mutate the list between awaits.
+        for client in list(subs):
             try:
                 await client.apush("pubsub", {"channel": channel, "message": message})
             except Exception:
                 dead.append(client)
         for d in dead:
-            subs.remove(d)
+            try:
+                subs.remove(d)
+            except ValueError:
+                pass  # a concurrent re-subscribe already replaced it
 
     async def rpc_publish(self, req):
         await self._publish(req["channel"], req["message"])
@@ -615,6 +635,10 @@ class GcsServer:
             if any(n["state"] == "ALIVE" for n in self.nodes.values()):
                 break
             await asyncio.sleep(0.2)
+        # Grace period: an in-flight creation on a surviving raylet may still
+        # land (worker spawn takes seconds); only resubmit actors that remain
+        # PENDING after it. rpc_actor_alive also rejects duplicates.
+        await asyncio.sleep(5.0)
         for aid in pending:
             info = self.actors.get(aid)
             if info is None or info.get("state") not in (PENDING_CREATION, RESTARTING):
@@ -625,9 +649,13 @@ class GcsServer:
                 logger.exception("recovery scheduling of actor %s failed", aid[:8])
 
     async def _persist_loop(self):
+        saved_at = -1
         while True:
             await asyncio.sleep(2.0)
+            if self._mutations == saved_at:
+                continue  # nothing changed since the last snapshot
             try:
+                saved_at = self._mutations
                 self._do_save()
             except Exception:
                 logger.debug("gcs snapshot failed", exc_info=True)
@@ -658,8 +686,26 @@ class GcsServer:
     def _load_snapshot(self):
         import pickle
 
-        with open(self.persist_path, "rb") as f:
-            snap = pickle.load(f)
+        try:
+            with open(self.persist_path, "rb") as f:
+                snap = pickle.load(f)
+        except Exception:
+            # Legacy JSON snapshot (or corruption): best-effort partial load;
+            # never block GCS startup on an unreadable snapshot.
+            try:
+                with open(self.persist_path) as f:
+                    legacy = json.load(f)
+                snap = {
+                    "kv": {k: bytes.fromhex(v) for k, v in legacy.get("kv", {}).items()},
+                    "named_actors": {
+                        tuple(k.split("\x00", 1)): a
+                        for k, a in legacy.get("named_actors", {}).items()
+                    },
+                    "job_counter": legacy.get("job_counter", 0),
+                }
+            except Exception:
+                logger.warning("unreadable GCS snapshot %s; starting fresh", self.persist_path)
+                return
         self.kv = dict(snap.get("kv", {}))
         self.named_actors.update(snap.get("named_actors", {}))
         self._job_counter = snap.get("job_counter", 0)

@@ -78,7 +78,7 @@ class WorkerExecutor:
             else:
                 self._actor_queue = asyncio.Queue()
                 self._consumer_task = asyncio.ensure_future(self._actor_consumer())
-            await self.cw.gcs.acall(
+            resp = await self.cw.gcs.acall(
                 "actor_alive",
                 {
                     "actor_id": spec.actor_id,
@@ -87,6 +87,11 @@ class WorkerExecutor:
                     "worker_id": self.cw.worker_id,
                 },
             )
+            if resp.get("duplicate"):
+                # Another worker already owns this actor (e.g. GCS-restart
+                # recovery raced an in-flight creation); the incumbent wins.
+                logger.warning("duplicate actor %s; exiting", spec.actor_id[:8])
+                os._exit(0)
             await self.raylet.acall("actor_ready", {"worker_id": self.cw.worker_id})
         else:
             logger.error("actor %s __init__ failed", spec.actor_id[:8])

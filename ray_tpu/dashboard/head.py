@@ -94,6 +94,11 @@ class DashboardHead:
 
     def _handle_get(self, req):
         path = req.path.split("?", 1)[0].rstrip("/") or "/"
+        if path == "/":
+            from ray_tpu.dashboard.static_page import INDEX_HTML
+
+            req._send(200, INDEX_HTML, content_type="text/html; charset=utf-8")
+            return
         if path == "/api/version":
             req._send(200, {"version": ray_tpu.__version__, "ray_address": "%s:%d" % self._gcs_address})
             return

@@ -1,0 +1,80 @@
+"""Embedded dashboard page.
+
+Stand-in for the reference's React frontend (dashboard/client/): one
+self-contained HTML page (no build step, no external assets) that polls the
+head's REST API and renders nodes/resources, actors, jobs, and task summary.
+"""
+
+INDEX_HTML = """<!doctype html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>ray_tpu dashboard</title>
+<style>
+  body { font-family: -apple-system, system-ui, sans-serif; margin: 2rem; color: #222; }
+  h1 { font-size: 1.3rem; }  h2 { font-size: 1.05rem; margin-top: 1.6rem; }
+  table { border-collapse: collapse; width: 100%; font-size: 0.85rem; }
+  th, td { text-align: left; padding: 4px 10px; border-bottom: 1px solid #e5e5e5; }
+  th { color: #666; font-weight: 600; }
+  .pill { display: inline-block; padding: 1px 8px; border-radius: 10px; font-size: 0.75rem; }
+  .ALIVE, .RUNNING, .SUCCEEDED, .FINISHED { background: #e6f4ea; color: #137333; }
+  .DEAD, .FAILED { background: #fce8e6; color: #c5221f; }
+  .PENDING, .PENDING_CREATION, .STOPPED { background: #fef7e0; color: #b06000; }
+  .muted { color: #999; }
+  #updated { font-size: 0.75rem; color: #999; }
+</style>
+</head>
+<body>
+<h1>ray_tpu dashboard <span id="updated"></span></h1>
+<h2>Cluster</h2><div id="cluster"></div>
+<h2>Nodes</h2><table id="nodes"></table>
+<h2>Actors</h2><table id="actors"></table>
+<h2>Jobs (submitted)</h2><table id="jobs"></table>
+<h2>Tasks</h2><div id="tasks"></div>
+<script>
+const fmt = (n) => typeof n === "number" ? (Number.isInteger(n) ? n : n.toFixed(2)) : n;
+const pill = (s) => `<span class="pill ${s}">${s}</span>`;
+async function j(path) { const r = await fetch(path); return r.json(); }
+function table(el, headers, rows) {
+  el.innerHTML = "<tr>" + headers.map(h => `<th>${h}</th>`).join("") + "</tr>" +
+    (rows.length ? rows.map(r => "<tr>" + r.map(c => `<td>${c ?? '<span class=muted>—</span>'}</td>`).join("") + "</tr>").join("")
+                 : `<tr><td colspan=${headers.length} class=muted>none</td></tr>`);
+}
+async function refresh() {
+  try {
+    const status = await j("/api/cluster_status");
+    const res = status.cluster_resources || {}, avail = status.available_resources || {};
+    document.getElementById("cluster").innerHTML =
+      Object.keys(res).sort().map(k =>
+        `<b>${k}</b>: ${fmt(res[k] - (avail[k] ?? 0))}/${fmt(res[k])} used`).join(" &nbsp;·&nbsp; ");
+    table(document.getElementById("nodes"),
+      ["node", "state", "address", "active workers"],
+      (status.nodes || []).map(n => [n.node_id.slice(0,12), pill(n.state),
+        (n.address || []).join(":"), n.num_active_workers ?? 0]));
+    const actors = (await j("/api/v0/actors")).result || [];
+    table(document.getElementById("actors"),
+      ["actor", "name", "state", "node", "restarts"],
+      actors.map(a => [a.actor_id.slice(0,12), a.name, pill(a.state),
+        (a.node_id || "").slice(0,8), a.num_restarts ?? 0]));
+    const jobs = await j("/api/jobs/");
+    table(document.getElementById("jobs"),
+      ["id", "status", "entrypoint"],
+      (jobs || []).map(x => [x.submission_id, pill(x.status), x.entrypoint]));
+    const summary = await j("/api/v0/tasks/summarize");
+    document.getElementById("tasks").innerHTML =
+      "<table>" + "<tr><th>task</th><th>total</th><th>states</th></tr>" +
+      Object.entries(summary).map(([name, e]) =>
+        `<tr><td>${name}</td><td>${e.total}</td><td>` +
+        Object.entries(e.states || {}).map(([s, c]) => `${pill(s)} ${c}`).join(" ") +
+        `</td></tr>`).join("") + "</table>";
+    document.getElementById("updated").textContent =
+      "updated " + new Date().toLocaleTimeString();
+  } catch (e) {
+    document.getElementById("updated").textContent = "refresh failed: " + e;
+  }
+}
+refresh(); setInterval(refresh, 3000);
+</script>
+</body>
+</html>
+"""

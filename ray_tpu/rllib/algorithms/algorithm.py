@@ -119,6 +119,12 @@ class Algorithm(Trainable):
 
     # -- Trainable protocol -------------------------------------------------
     def setup(self, config: dict) -> None:
+        # Trainable.__init__ already ran setup; a second explicit setup()
+        # (common in user code and tests) must not orphan the first worker
+        # set — leaked rollout actors hold CPU reservations forever.
+        existing = getattr(self, "workers", None)
+        if existing is not None:
+            existing.stop()
         cfg = self._algo_config
         import gymnasium as gym
 

@@ -1,0 +1,1 @@
+from ray_tpu.rllib.algorithms.impala.impala import IMPALA, IMPALAConfig  # noqa: F401

@@ -1,0 +1,167 @@
+"""IMPALA — importance-weighted actor-learner with V-trace.
+
+Reference: rllib/algorithms/impala/ (+ vtrace_tf/torch). Architecturally the
+TPU shape differs from the reference's async queues: rollout workers sample
+with whatever weights they last received (behavior policy), the learner
+corrects the off-policyness with V-trace importance weights inside one jitted
+loss, and weight broadcast happens once per iteration — decoupled
+actors/learner without a Python-side queue, matching how an XLA-friendly
+learner wants its input: one big batch, one compiled step.
+
+V-trace (Espeholt et al. 2018):
+    rho_t = min(rho_bar, pi(a|s)/mu(a|s));  c_t = min(c_bar, rho_t)
+    delta_t = rho_t (r_t + gamma V(s_{t+1}) - V(s_t))
+    vs_t = V(s_t) + delta_t + gamma c_t (vs_{t+1} - V(s_{t+1}))
+    pg_adv_t = rho_t (r_t + gamma vs_{t+1} - V(s_t))
+computed with a reverse lax.scan; episode ends reset the recursion via the
+dones mask. Bootstrap values ride in the batch (NEXT_VF_PREDS).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.core.learner import LearnerGroup
+from ray_tpu.rllib.policy.sample_batch import (
+    ACTIONS,
+    DONES,
+    FRAG_CUT,
+    LOGPS,
+    NEXT_VF_PREDS,
+    OBS,
+    REWARDS,
+    SampleBatch,
+)
+
+
+def impala_loss(params, batch, spec, cfg):
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.core import rl_module
+
+    logp, entropy, values = rl_module.action_logp_and_entropy(
+        params, batch[OBS], batch[ACTIONS], spec
+    )
+    gamma = cfg["gamma"]
+    rewards = batch[REWARDS]
+    nonterminal = 1.0 - batch[DONES].astype(values.dtype)
+    # Fragment cuts: the batch is a concatenation of per-env rollout
+    # fragments; the recursion must reset at each fragment's last row (the
+    # bootstrap value there already carries the tail's contribution).
+    cuts = batch[FRAG_CUT].astype(values.dtype)
+    carry_mask = nonterminal * (1.0 - cuts)
+    # Behavior values for the recursion's V(s_{t+1}) (stop-grad bootstrap).
+    next_values = batch[NEXT_VF_PREDS]
+    rho = jnp.minimum(cfg["rho_bar"], jnp.exp(logp - batch[LOGPS]))
+    rho = jax.lax.stop_gradient(rho)
+    c = jnp.minimum(cfg["c_bar"], rho)
+    v_sg = jax.lax.stop_gradient(values)
+    deltas = rho * (rewards + gamma * next_values - v_sg)
+
+    # Reverse scan for vs_t - V(s_t); episode ends / fragment cuts reset it.
+    def back(carry, inp):
+        delta_t, c_t, mask = inp
+        acc = delta_t + gamma * c_t * mask * carry
+        return acc, acc
+
+    _, vs_minus_v_rev = jax.lax.scan(
+        back,
+        jnp.zeros((), values.dtype),
+        (deltas[::-1], c[::-1], carry_mask[::-1]),
+    )
+    vs_minus_v = vs_minus_v_rev[::-1]
+    vs = v_sg + vs_minus_v
+    # vs_{t+1}: next row's vs inside a fragment; the bootstrap value at a
+    # fragment cut; 0 past a terminal.
+    vs_shift = jnp.concatenate([vs[1:], vs[-1:]])
+    vs_next = jnp.where(cuts > 0, next_values, vs_shift) * nonterminal
+    pg_adv = rho * (rewards + gamma * vs_next - v_sg)
+    policy_loss = -jnp.mean(logp * pg_adv)
+    vf_loss = 0.5 * jnp.mean((vs - values) ** 2)
+    entropy_mean = entropy.mean()
+    total = policy_loss + cfg["vf_loss_coeff"] * vf_loss - cfg["entropy_coeff"] * entropy_mean
+    return total, {
+        "policy_loss": policy_loss,
+        "vf_loss": vf_loss,
+        "entropy": entropy_mean,
+        "mean_rho": rho.mean(),
+    }
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or IMPALA)
+        self.lr = 5e-4
+        self.train_batch_size = 2000
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.grad_clip = 40.0
+        self.rho_bar = 1.0
+        self.c_bar = 1.0
+        self.minibatch_size = 512
+        self.num_sgd_iter = 1
+        # Broadcast weights every N iterations (staleness is what V-trace
+        # corrects; >1 models the reference's async actors).
+        self.broadcast_interval = 1
+
+    def training(self, *, vf_loss_coeff: Optional[float] = None,
+                 entropy_coeff: Optional[float] = None, rho_bar: Optional[float] = None,
+                 c_bar: Optional[float] = None, minibatch_size: Optional[int] = None,
+                 num_sgd_iter: Optional[int] = None, broadcast_interval: Optional[int] = None,
+                 **kwargs) -> "IMPALAConfig":
+        super().training(**kwargs)
+        for name, value in (
+            ("vf_loss_coeff", vf_loss_coeff),
+            ("entropy_coeff", entropy_coeff),
+            ("rho_bar", rho_bar),
+            ("c_bar", c_bar),
+            ("minibatch_size", minibatch_size),
+            ("num_sgd_iter", num_sgd_iter),
+            ("broadcast_interval", broadcast_interval),
+        ):
+            if value is not None:
+                setattr(self, name, value)
+        return self
+
+
+class IMPALA(Algorithm):
+    @classmethod
+    def get_default_config(cls) -> IMPALAConfig:
+        return IMPALAConfig(cls)
+
+    def _build_learner_group(self, cfg: IMPALAConfig) -> LearnerGroup:
+        return LearnerGroup(
+            self.module_spec,
+            impala_loss,
+            lr=cfg.lr,
+            grad_clip=cfg.grad_clip,
+            seed=cfg.seed,
+            num_learners=cfg.num_learners,
+            num_tpus_per_learner=cfg.num_tpus_per_learner,
+        )
+
+    def training_step(self) -> dict:
+        cfg: IMPALAConfig = self._algo_config
+        per_worker = max(
+            1, cfg.train_batch_size // max(self.workers.num_workers, 1) // cfg.num_envs_per_worker
+        )
+        batches = self.workers.sample(per_worker)
+        batch = SampleBatch.concat_samples(batches)
+        self._timesteps_total += batch.count
+        loss_cfg = {
+            "gamma": cfg.gamma,
+            "rho_bar": cfg.rho_bar,
+            "c_bar": cfg.c_bar,
+            "vf_loss_coeff": cfg.vf_loss_coeff,
+            "entropy_coeff": cfg.entropy_coeff,
+        }
+        # V-trace needs contiguous time order — update on the WHOLE batch
+        # (no shuffled minibatches like PPO).
+        metrics = {}
+        for _ in range(cfg.num_sgd_iter):
+            metrics = self.learner_group.update(batch, loss_cfg)
+        if self.iteration % max(cfg.broadcast_interval, 1) == 0:
+            self.workers.sync_weights(self.learner_group.get_weights())
+        return dict(metrics)

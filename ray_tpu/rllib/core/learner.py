@@ -162,13 +162,42 @@ class LearnerGroup:
         if self._local is not None:
             return self._local.update(batch, loss_cfg)
         n = len(self._actors)
-        shard = max(1, batch.count // n)
+        bounds = self._shard_bounds(batch, n)
         refs = [
-            a.update.remote(batch.slice(i * shard, batch.count if i == n - 1 else (i + 1) * shard), loss_cfg)
-            for i, a in enumerate(self._actors)
+            a.update.remote(batch.slice(lo, hi), loss_cfg)
+            for a, (lo, hi) in zip(self._actors, bounds)
         ]
         all_metrics = ray_tpu.get(refs)
         return {k: float(np.mean([m[k] for m in all_metrics])) for k in all_metrics[0]}
+
+    @staticmethod
+    def _shard_bounds(batch: SampleBatch, n: int) -> list:
+        """Split points for n shards. Sequence-structured batches (FRAG_CUT
+        present, e.g. IMPALA's V-trace input) must split only at fragment
+        boundaries, or time recursions would leak across shards."""
+        from ray_tpu.rllib.policy.sample_batch import FRAG_CUT
+
+        total = batch.count
+        if FRAG_CUT not in batch:
+            shard = max(1, total // n)
+            return [
+                (i * shard, total if i == n - 1 else (i + 1) * shard) for i in range(n)
+            ]
+        cut_ends = [i + 1 for i, c in enumerate(np.asarray(batch[FRAG_CUT])) if c]
+        if not cut_ends or cut_ends[-1] != total:
+            cut_ends.append(total)
+        bounds = []
+        lo = 0
+        for i in range(n):
+            if i == n - 1:
+                bounds.append((lo, total))
+                break
+            target = (i + 1) * total // n
+            # Nearest fragment boundary at or after the even split point.
+            hi = next((c for c in cut_ends if c >= max(target, lo + 1)), total)
+            bounds.append((lo, hi))
+            lo = hi
+        return bounds
 
     def get_weights(self):
         if self._local is not None:

@@ -124,14 +124,26 @@ class WorkerSet:
     def num_workers(self) -> int:
         return len(self._workers)
 
+    def _replace_worker(self, pos: int):
+        """Respawn the worker at list position `pos`. The old actor MUST be
+        killed first: a merely-slow actor that we abandoned would keep its
+        CPU reservation forever and starve future creations."""
+        old = self._workers[pos]
+        try:
+            ray_tpu.kill(old)
+        except Exception:
+            pass
+        self._workers[pos] = self._make_worker(self._indices[pos])
+        return self._workers[pos]
+
     def sync_weights(self, weights):
         for i, w in enumerate(list(self._workers)):
             try:
                 ray_tpu.get(w.set_weights.remote(weights), timeout=120)
             except Exception:
                 logger.warning("sync_weights: worker %d dead; respawning", i)
-                self._workers[i] = self._make_worker(self._indices[i])
-                ray_tpu.get(self._workers[i].set_weights.remote(weights), timeout=120)
+                replacement = self._replace_worker(i)
+                ray_tpu.get(replacement.set_weights.remote(weights), timeout=120)
 
     def sample(self, steps_per_worker: int) -> List[SampleBatch]:
         """Synchronous parallel sampling with fault tolerance: a worker that
@@ -153,8 +165,7 @@ class WorkerSet:
                 logger.warning("rollout worker %d failed; respawning", idx)
                 dead.append((idx, w))
         for idx, w in dead:
-            pos = self._workers.index(w)
-            self._workers[pos] = self._make_worker(idx)
+            self._replace_worker(self._workers.index(w))
         return results
 
     def episode_stats(self) -> dict:

@@ -21,6 +21,8 @@ VF_PREDS = "vf_preds"
 ADVANTAGES = "advantages"
 VALUE_TARGETS = "value_targets"
 EPS_ID = "eps_id"
+NEXT_VF_PREDS = "next_vf_preds"
+FRAG_CUT = "frag_cut"  # 1 on the last row of a rollout fragment
 
 
 class SampleBatch(dict):
@@ -98,6 +100,18 @@ def compute_gae(
         next_value = values[t]
     batch[ADVANTAGES] = adv
     batch[VALUE_TARGETS] = adv + values
+    # Bootstrap values for V-trace-style off-policy corrections (IMPALA):
+    # next state's value within the fragment, last_value at the cut, 0 at
+    # episode ends.
+    next_vf = np.empty(n, dtype=np.float32)
+    cuts = np.zeros(n, dtype=np.float32)
+    if n:
+        next_vf[:-1] = values[1:]
+        next_vf[-1] = float(last_value)
+        next_vf *= 1.0 - dones
+        cuts[-1] = 1.0
+    batch[NEXT_VF_PREDS] = next_vf
+    batch[FRAG_CUT] = cuts
     return batch
 
 

@@ -157,3 +157,13 @@ def test_cli_start_status_stop(tmp_path):
     finally:
         out = run("stop")
         assert "Stopped" in out.stdout
+
+
+def test_dashboard_index_page(dashboard):
+    url = "http://%s:%d/" % dashboard.address
+    with urllib.request.urlopen(url, timeout=30) as resp:
+        body = resp.read().decode()
+        ctype = resp.headers.get("Content-Type", "")
+    assert "text/html" in ctype
+    assert "ray_tpu dashboard" in body
+    assert "/api/cluster_status" in body  # the page polls the REST API

@@ -383,3 +383,36 @@ def test_marwil_prefers_high_return_actions(ray_cluster, tmp_path):
         assert correct >= 40, f"MARWIL matched expert on only {correct}/50"
     finally:
         algo.cleanup()
+
+
+def test_impala_learns_cartpole(ray_cluster):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from ray_tpu.rllib import IMPALAConfig
+
+    cfg = (
+        IMPALAConfig()
+        .environment("CartPole-v1")
+        .rollouts(num_rollout_workers=2, num_envs_per_worker=4)
+        .training(
+            lr=1e-3,
+            train_batch_size=2048,
+            entropy_coeff=0.01,
+            num_sgd_iter=2,
+            broadcast_interval=1,
+        )
+        .debugging(seed=0)
+    )
+    algo = cfg.build()
+    algo.setup(cfg.to_dict())
+    best = 0.0
+    try:
+        for _ in range(40):
+            r = algo.step()
+            best = max(best, r["episode_reward_mean"])
+            if best >= 100:
+                break
+        assert best >= 100, f"IMPALA failed to learn CartPole (best={best})"
+    finally:
+        algo.cleanup()
