@@ -57,6 +57,10 @@ class Config:
     node_death_timeout_s: float = 5.0
     health_check_failure_threshold: int = 5
 
+    # After a GCS restart, wait this long for in-flight actor creations on
+    # surviving raylets to land before re-driving PENDING creations.
+    gcs_actor_recovery_grace_s: float = 2.0
+
     # --- memory monitor (reference: memory_monitor.py:94 + raylet worker
     # killing policies worker_killing_policy*.h) ---
     memory_monitor_enabled: bool = True

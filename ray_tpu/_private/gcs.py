@@ -77,6 +77,7 @@ class GcsServer:
     # ------------------------------------------------------------------
 
     async def rpc_register_node(self, req):
+        self._mutations += 1
         node_id = req["node_id"]
         self.nodes[node_id] = {
             "node_id": node_id,
@@ -248,9 +249,22 @@ class GcsServer:
         return {"ok": True}
 
     async def rpc_report_worker_death(self, req):
-        self._mutations += 1
         """Raylet reports a dead worker and any actor it hosted."""
+        self._mutations += 1
+        reporter = req.get("worker_id")
         for actor_id in req.get("actor_ids", []):
+            info = self.actors.get(actor_id)
+            if (
+                info is not None
+                and info.get("state") == ALIVE
+                and reporter
+                and info.get("worker_id")
+                and info["worker_id"] != reporter
+            ):
+                # A different worker than the actor's registered host died —
+                # e.g. a rejected duplicate creation exiting (worker_main
+                # duplicate path). The incumbent is healthy; ignore.
+                continue
             await self._handle_actor_failure(actor_id, req.get("reason", "worker died"))
         return {"ok": True}
 
@@ -258,6 +272,7 @@ class GcsServer:
         info = self.actors.get(actor_id)
         if info is None or info["state"] == DEAD:
             return
+        self._mutations += 1
         max_restarts = info["max_restarts"]
         if max_restarts == -1 or info["num_restarts"] < max_restarts:
             info["num_restarts"] += 1
@@ -274,6 +289,7 @@ class GcsServer:
         await self._publish("actor_updates", {"actor_id": actor_id, "state": DEAD, "reason": reason})
 
     async def rpc_kill_actor(self, req):
+        self._mutations += 1
         actor_id = req["actor_id"]
         info = self.actors.get(actor_id)
         if info is None:
@@ -638,7 +654,7 @@ class GcsServer:
         # Grace period: an in-flight creation on a surviving raylet may still
         # land (worker spawn takes seconds); only resubmit actors that remain
         # PENDING after it. rpc_actor_alive also rejects duplicates.
-        await asyncio.sleep(5.0)
+        await asyncio.sleep(self.cfg.gcs_actor_recovery_grace_s)
         for aid in pending:
             info = self.actors.get(aid)
             if info is None or info.get("state") not in (PENDING_CREATION, RESTARTING):

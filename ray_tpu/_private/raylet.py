@@ -781,7 +781,11 @@ class Raylet:
             try:
                 await self.gcs.acall(
                     "report_worker_death",
-                    {"actor_ids": [worker.actor_id], "reason": reason},
+                    {
+                        "actor_ids": [worker.actor_id],
+                        "reason": reason,
+                        "worker_id": worker.worker_id,
+                    },
                 )
             except Exception:
                 pass

@@ -32,12 +32,18 @@ INDEX_HTML = """<!doctype html>
 <h2>Jobs (submitted)</h2><table id="jobs"></table>
 <h2>Tasks</h2><div id="tasks"></div>
 <script>
+const esc = (v) => String(v).replace(/[&<>"']/g,
+  (c) => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));
 const fmt = (n) => typeof n === "number" ? (Number.isInteger(n) ? n : n.toFixed(2)) : n;
-const pill = (s) => `<span class="pill ${s}">${s}</span>`;
+// User-controlled strings (actor names, job entrypoints) flow into these
+// templates — escape everything; `pill` output is marked pre-escaped.
+const pill = (s) => ({__html: `<span class="pill ${esc(s)}">${esc(s)}</span>`});
+const cell = (c) => c === null || c === undefined ? '<span class=muted>—</span>'
+  : (c && c.__html) ? c.__html : esc(c);
 async function j(path) { const r = await fetch(path); return r.json(); }
 function table(el, headers, rows) {
-  el.innerHTML = "<tr>" + headers.map(h => `<th>${h}</th>`).join("") + "</tr>" +
-    (rows.length ? rows.map(r => "<tr>" + r.map(c => `<td>${c ?? '<span class=muted>—</span>'}</td>`).join("") + "</tr>").join("")
+  el.innerHTML = "<tr>" + headers.map(h => `<th>${esc(h)}</th>`).join("") + "</tr>" +
+    (rows.length ? rows.map(r => "<tr>" + r.map(c => `<td>${cell(c)}</td>`).join("") + "</tr>").join("")
                  : `<tr><td colspan=${headers.length} class=muted>none</td></tr>`);
 }
 async function refresh() {
@@ -46,7 +52,7 @@ async function refresh() {
     const res = status.cluster_resources || {}, avail = status.available_resources || {};
     document.getElementById("cluster").innerHTML =
       Object.keys(res).sort().map(k =>
-        `<b>${k}</b>: ${fmt(res[k] - (avail[k] ?? 0))}/${fmt(res[k])} used`).join(" &nbsp;·&nbsp; ");
+        `<b>${esc(k)}</b>: ${fmt(res[k] - (avail[k] ?? 0))}/${fmt(res[k])} used`).join(" &nbsp;·&nbsp; ");
     table(document.getElementById("nodes"),
       ["node", "state", "address", "active workers"],
       (status.nodes || []).map(n => [n.node_id.slice(0,12), pill(n.state),
@@ -64,8 +70,8 @@ async function refresh() {
     document.getElementById("tasks").innerHTML =
       "<table>" + "<tr><th>task</th><th>total</th><th>states</th></tr>" +
       Object.entries(summary).map(([name, e]) =>
-        `<tr><td>${name}</td><td>${e.total}</td><td>` +
-        Object.entries(e.states || {}).map(([s, c]) => `${pill(s)} ${c}`).join(" ") +
+        `<tr><td>${esc(name)}</td><td>${esc(e.total)}</td><td>` +
+        Object.entries(e.states || {}).map(([s, c]) => `${pill(s).__html} ${esc(c)}`).join(" ") +
         `</td></tr>`).join("") + "</table>";
     document.getElementById("updated").textContent =
       "updated " + new Date().toLocaleTimeString();

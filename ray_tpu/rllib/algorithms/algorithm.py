@@ -29,6 +29,7 @@ class AlgorithmConfig:
         self.num_rollout_workers = 2
         self.num_envs_per_worker = 1
         self.rollout_fragment_length = 200
+        self.observation_filter: Optional[str] = None
         self.gamma = 0.99
         self.lambda_ = 0.95
         self.lr = 5e-5
@@ -50,13 +51,16 @@ class AlgorithmConfig:
         return self
 
     def rollouts(self, *, num_rollout_workers: Optional[int] = None, num_envs_per_worker: Optional[int] = None,
-                 rollout_fragment_length: Optional[int] = None) -> "AlgorithmConfig":
+                 rollout_fragment_length: Optional[int] = None,
+                 observation_filter: Optional[str] = None) -> "AlgorithmConfig":
         if num_rollout_workers is not None:
             self.num_rollout_workers = num_rollout_workers
         if num_envs_per_worker is not None:
             self.num_envs_per_worker = num_envs_per_worker
         if rollout_fragment_length is not None:
             self.rollout_fragment_length = rollout_fragment_length
+        if observation_filter is not None:
+            self.observation_filter = observation_filter
         return self
 
     def training(self, *, lr: Optional[float] = None, gamma: Optional[float] = None,
@@ -125,6 +129,9 @@ class Algorithm(Trainable):
         existing = getattr(self, "workers", None)
         if existing is not None:
             existing.stop()
+        existing_lg = getattr(self, "learner_group", None)
+        if existing_lg is not None and hasattr(existing_lg, "stop"):
+            existing_lg.stop()
         cfg = self._algo_config
         import gymnasium as gym
 
@@ -142,6 +149,7 @@ class Algorithm(Trainable):
             gamma=cfg.gamma,
             lambda_=cfg.lambda_,
             seed=cfg.seed,
+            observation_filter=getattr(cfg, "observation_filter", None),
         )
         self.learner_group = self._build_learner_group(cfg)
         self.workers.sync_weights(self.learner_group.get_weights())
@@ -157,6 +165,10 @@ class Algorithm(Trainable):
     def step(self) -> dict:
         t0 = time.time()
         result = self.training_step()
+        # Keep observation-filter statistics consistent across workers
+        # (reference: FilterManager.synchronize each iteration).
+        if getattr(self.workers, "observation_filter", None):
+            self.workers.sync_filters()
         stats = self.workers.episode_stats()
         self._episode_reward_window += stats["episode_rewards"]
         self._episode_reward_window = self._episode_reward_window[-100:]
@@ -177,6 +189,8 @@ class Algorithm(Trainable):
 
     def cleanup(self) -> None:
         self.workers.stop()
+        if hasattr(self.learner_group, "stop"):
+            self.learner_group.stop()
 
     # -- convenience (reference: Algorithm.compute_single_action) ----------
     def compute_single_action(self, obs, explore: bool = False):

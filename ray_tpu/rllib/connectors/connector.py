@@ -1,0 +1,139 @@
+"""Connector implementations (reference: rllib/connectors/connector.py base +
+agent/{mean_std_filter,clip,flatten}.py, action/clip.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class AgentConnector:
+    """obs batch [N, ...] -> obs batch. Override __call__ (+ state hooks for
+    stateful connectors)."""
+
+    def __call__(self, obs: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    # Stateful connectors override these; stateless return None / ignore.
+    def get_state(self):
+        return None
+
+    def set_state(self, state):
+        pass
+
+    def merge_states(self, states: list):
+        """Combine per-worker states (driver-side reduce)."""
+        pass
+
+
+class ActionConnector:
+    def __call__(self, actions: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+
+class ClipObservations(AgentConnector):
+    def __init__(self, low: float = -10.0, high: float = 10.0):
+        self.low, self.high = low, high
+
+    def __call__(self, obs):
+        return np.clip(obs, self.low, self.high)
+
+
+class FlattenObservations(AgentConnector):
+    def __call__(self, obs):
+        return np.asarray(obs).reshape(len(obs), -1)
+
+
+class MeanStdFilter(AgentConnector):
+    """Running per-feature normalization (reference:
+    rllib/utils/filter.py MeanStdFilter as an agent connector): Welford
+    accumulation per worker, merged across workers with the Chan formula when
+    weights sync."""
+
+    def __init__(self, clip: float = 10.0):
+        self.clip = clip
+        self._count = 0
+        self._mean: np.ndarray | None = None
+        self._m2: np.ndarray | None = None
+
+    def __call__(self, obs):
+        obs = np.asarray(obs, np.float64)
+        for row in obs:
+            self._count += 1
+            if self._mean is None:
+                self._mean = np.array(row, np.float64)
+                self._m2 = np.zeros_like(self._mean)
+            else:
+                delta = row - self._mean
+                self._mean += delta / self._count
+                self._m2 += delta * (row - self._mean)
+        return self.transform(obs)
+
+    def transform(self, obs):
+        """Normalize WITHOUT updating statistics (evaluation path)."""
+        if self._mean is None or self._count < 2:
+            return np.asarray(obs, np.float32)
+        std = np.sqrt(self._m2 / (self._count - 1)) + 1e-8
+        out = (np.asarray(obs, np.float64) - self._mean) / std
+        return np.clip(out, -self.clip, self.clip).astype(np.float32)
+
+    def get_state(self):
+        return {
+            "count": self._count,
+            "mean": None if self._mean is None else self._mean.copy(),
+            "m2": None if self._m2 is None else self._m2.copy(),
+        }
+
+    def set_state(self, state):
+        self._count = state["count"]
+        self._mean = None if state["mean"] is None else np.array(state["mean"])
+        self._m2 = None if state["m2"] is None else np.array(state["m2"])
+
+    def merge_states(self, states: list):
+        """Chan parallel-variance merge of per-worker accumulations."""
+        count, mean, m2 = 0, None, None
+        for st in states:
+            if not st or st["count"] == 0 or st["mean"] is None:
+                continue
+            if mean is None:
+                count, mean, m2 = st["count"], np.array(st["mean"]), np.array(st["m2"])
+                continue
+            n2 = st["count"]
+            delta = st["mean"] - mean
+            total = count + n2
+            mean = mean + delta * n2 / total
+            m2 = m2 + st["m2"] + delta * delta * count * n2 / total
+            count = total
+        self._count, self._mean, self._m2 = count, mean, m2
+
+
+class ClipActions(ActionConnector):
+    def __init__(self, low, high):
+        self.low, self.high = np.asarray(low), np.asarray(high)
+
+    def __call__(self, actions):
+        return np.clip(actions, self.low, self.high)
+
+
+class ConnectorPipeline:
+    """Ordered list of connectors applied in sequence."""
+
+    def __init__(self, connectors: list):
+        self.connectors = list(connectors)
+
+    def __call__(self, x):
+        for c in self.connectors:
+            x = c(x)
+        return x
+
+    def transform(self, x):
+        for c in self.connectors:
+            x = c.transform(x) if hasattr(c, "transform") else c(x)
+        return x
+
+    def get_state(self):
+        return [c.get_state() if isinstance(c, AgentConnector) else None for c in self.connectors]
+
+    def set_state(self, states):
+        for c, st in zip(self.connectors, states):
+            if isinstance(c, AgentConnector) and st is not None:
+                c.set_state(st)

@@ -197,7 +197,24 @@ class LearnerGroup:
             hi = next((c for c in cut_ends if c >= max(target, lo + 1)), total)
             bounds.append((lo, hi))
             lo = hi
+        if any(hi <= lo for lo, hi in bounds):
+            # Fewer fragments than learners (or shuffled minibatches whose
+            # cut rows landed badly): empty shards would feed NaN-producing
+            # zero-length updates — fall back to an even row split.
+            shard = max(1, total // n)
+            return [
+                (i * shard, total if i == n - 1 else (i + 1) * shard) for i in range(n)
+            ]
         return bounds
+
+    def stop(self):
+        """Kill remote learner actors (they hold TPU/CPU reservations)."""
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+        self._actors = []
 
     def get_weights(self):
         if self._local is not None:

@@ -38,12 +38,22 @@ class RolloutWorker:
 
     def __init__(self, env_spec, spec, worker_index: int = 0, num_envs: int = 1,
                  env_config: Optional[dict] = None, gamma: float = 0.99,
-                 lambda_: float = 0.95, seed: int = 0):
+                 lambda_: float = 0.95, seed: int = 0, observation_filter: Optional[str] = None):
         import jax
 
         jax.config.update("jax_platforms", "cpu")  # rollouts stay off-chip
         self.env = VectorEnv(env_spec, num_envs, env_config, worker_index, seed=seed + worker_index * 1000)
         self.spec = spec
+        self.obs_filter = None
+        self._filter_delta = None
+        if observation_filter in ("MeanStdFilter", "mean_std"):
+            from ray_tpu.rllib.connectors import MeanStdFilter
+
+            self.obs_filter = MeanStdFilter()
+            # Local-only accumulation since the last sync; the driver merges
+            # DELTAS (reference: FilterManager flushes buffers), because
+            # re-merging full states would double-count shared history.
+            self._filter_delta = MeanStdFilter()
         self.gamma = gamma
         self.lambda_ = lambda_
         self._rng = jax.random.PRNGKey(seed + worker_index)
@@ -69,6 +79,12 @@ class RolloutWorker:
         cols: dict = {k: [] for k in (OBS, ACTIONS, REWARDS, DONES, LOGPS, VF_PREDS, EPS_ID)}
         for _ in range(num_steps):
             obs = self.env.current_obs().astype(np.float32)
+            if self.obs_filter is not None:
+                if explore:
+                    self._filter_delta(obs)  # stats only; result unused
+                    obs = self.obs_filter(obs)
+                else:
+                    obs = self.obs_filter.transform(obs)
             self._rng, key = jax.random.split(self._rng)
             actions, logp, value = self._sample_fn(self._params, obs, key, explore)
             actions_np = np.asarray(actions)
@@ -82,9 +98,10 @@ class RolloutWorker:
             cols[VF_PREDS].append(np.asarray(value))
         # Bootstrap value for the final obs of each env.
         self._rng, key = jax.random.split(self._rng)
-        _, _, last_values = self._sample_fn(
-            self._params, self.env.current_obs().astype(np.float32), key, False
-        )
+        final_obs = self.env.current_obs().astype(np.float32)
+        if self.obs_filter is not None:
+            final_obs = self.obs_filter.transform(final_obs)
+        _, _, last_values = self._sample_fn(self._params, final_obs, key, False)
         last_values = np.asarray(last_values)
         # [T, N, ...] -> per-env fragments -> GAE -> concat.
         frags = []
@@ -98,6 +115,24 @@ class RolloutWorker:
     def episode_stats(self) -> dict:
         rewards, lens = self.env.pop_episode_stats()
         return {"episode_rewards": rewards, "episode_lens": lens}
+
+    def get_filter_state(self):
+        return self.obs_filter.get_state() if self.obs_filter is not None else None
+
+    def pop_filter_delta(self):
+        """Return accumulation since the last sync and reset it."""
+        if self._filter_delta is None:
+            return None
+        from ray_tpu.rllib.connectors import MeanStdFilter
+
+        state = self._filter_delta.get_state()
+        self._filter_delta = MeanStdFilter()
+        return state
+
+    def set_filter_state(self, state) -> bool:
+        if self.obs_filter is not None and state is not None:
+            self.obs_filter.set_state(state)
+        return True
 
     def ping(self) -> bool:
         return True
@@ -113,9 +148,13 @@ class WorkerSet:
 
     def __init__(self, env_spec, spec, *, num_workers: int, num_envs_per_worker: int = 1,
                  env_config: Optional[dict] = None, gamma: float = 0.99, lambda_: float = 0.95,
-                 seed: int = 0, num_cpus_per_worker: float = 1):
+                 seed: int = 0, num_cpus_per_worker: float = 1,
+                 observation_filter: Optional[str] = None):
+        self.observation_filter = observation_filter
+        self._filter_base = None  # merged filter history (driver-side)
         self._make_worker = lambda idx: ray_tpu.remote(num_cpus=num_cpus_per_worker)(RolloutWorker).remote(
-            env_spec, spec, idx, num_envs_per_worker, env_config, gamma, lambda_, seed
+            env_spec, spec, idx, num_envs_per_worker, env_config, gamma, lambda_, seed,
+            observation_filter
         )
         self._workers = [self._make_worker(i + 1) for i in range(num_workers)]
         self._indices = list(range(1, num_workers + 1))
@@ -167,6 +206,30 @@ class WorkerSet:
         for idx, w in dead:
             self._replace_worker(self._workers.index(w))
         return results
+
+    def sync_filters(self):
+        """Merge per-worker filter DELTAS into the shared base and
+        redistribute (reference: FilterManager.synchronize — deltas, not full
+        states, so shared history is never double-counted)."""
+        if not self.observation_filter or not self._workers:
+            return
+        from ray_tpu.rllib.connectors import MeanStdFilter
+
+        deltas = []
+        for w in self._workers:
+            try:
+                deltas.append(ray_tpu.get(w.pop_filter_delta.remote(), timeout=60))
+            except Exception:
+                pass
+        merger = MeanStdFilter()
+        states = [self._filter_base] + [d for d in deltas if d]
+        merger.merge_states([st for st in states if st])
+        self._filter_base = merger.get_state()
+        for w in self._workers:
+            try:
+                ray_tpu.get(w.set_filter_state.remote(self._filter_base), timeout=60)
+            except Exception:
+                pass
 
     def episode_stats(self) -> dict:
         stats = {"episode_rewards": [], "episode_lens": []}

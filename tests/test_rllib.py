@@ -416,3 +416,75 @@ def test_impala_learns_cartpole(ray_cluster):
         assert best >= 100, f"IMPALA failed to learn CartPole (best={best})"
     finally:
         algo.cleanup()
+
+
+def test_connectors_mean_std_filter():
+    """MeanStdFilter: running normalization + Chan merge across workers
+    (reference: rllib/utils/filter.py + connector pipelines)."""
+    from ray_tpu.rllib.connectors import (
+        ClipActions,
+        ConnectorPipeline,
+        FlattenObservations,
+        MeanStdFilter,
+    )
+
+    rng = np.random.default_rng(0)
+    data = rng.normal(loc=5.0, scale=3.0, size=(500, 4))
+    f = MeanStdFilter()
+    out = f(data)
+    assert abs(float(np.mean(out))) < 0.2
+    assert abs(float(np.std(out)) - 1.0) < 0.2
+    # transform() does not update stats
+    st = f.get_state()
+    f.transform(rng.normal(size=(100, 4)))
+    assert f.get_state()["count"] == st["count"]
+    # Chan merge of two shards == one filter over all data
+    f1, f2, fall = MeanStdFilter(), MeanStdFilter(), MeanStdFilter()
+    a, b = data[:200], data[200:]
+    f1(a)
+    f2(b)
+    fall(data)
+    merged = MeanStdFilter()
+    merged.merge_states([f1.get_state(), f2.get_state()])
+    np.testing.assert_allclose(merged.get_state()["mean"], fall.get_state()["mean"], rtol=1e-9)
+    np.testing.assert_allclose(merged.get_state()["m2"], fall.get_state()["m2"], rtol=1e-9)
+    # pipeline composes
+    pipe = ConnectorPipeline([FlattenObservations(), MeanStdFilter()])
+    assert pipe(rng.normal(size=(10, 2, 2))).shape == (10, 4)
+    clip = ClipActions(low=-1.0, high=1.0)
+    assert np.all(np.abs(clip(np.array([-5.0, 0.2, 9.0]))) <= 1.0)
+
+
+def test_ppo_with_observation_filter(ray_cluster):
+    """End-to-end: PPO with MeanStdFilter connectors still learns and the
+    filter stats synchronize across workers."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from ray_tpu.rllib import PPOConfig
+
+    cfg = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .rollouts(num_rollout_workers=2, num_envs_per_worker=2, observation_filter="MeanStdFilter")
+        .training(lr=3e-4, train_batch_size=1024, sgd_minibatch_size=128, num_sgd_iter=4)
+        .debugging(seed=0)
+    )
+    algo = cfg.build()
+    try:
+        r = None
+        for _ in range(4):
+            r = algo.step()
+        assert np.isfinite(r["policy_loss"])
+        # Both workers hold identical (merged) filter stats after sync.
+        states = [
+            ray_tpu.get(w.get_filter_state.remote()) for w in algo.workers._workers
+        ]
+        assert states[0]["count"] == states[1]["count"] > 0
+        np.testing.assert_allclose(states[0]["mean"], states[1]["mean"])
+        # Delta-sync accounting: the merged count equals real samples seen
+        # (full-state re-merging would compound ~2x per iteration).
+        total_sampled = 4 * 1024  # iterations * train_batch_size
+        assert states[0]["count"] <= total_sampled * 1.2, states[0]["count"]
+    finally:
+        algo.cleanup()
