@@ -75,12 +75,18 @@ def train_loop(config):
     jax.block_until_ready(loss)
     raw_s = time.perf_counter() - t0
 
-    # Framework path: same loop but reporting through the air session each
-    # step (what a real JaxTrainer loop does).
+    # Framework path: same loop, reporting through the air session every
+    # step. Metrics are fetched with ONE step of lag so the host->device
+    # pipeline never drains (float(loss) of the in-flight step would force a
+    # sync per step — an artifact no well-written training loop has).
     t0 = time.perf_counter()
+    prev_i, prev_loss = None, None
     for i in range(steps):
         params, opt_state, loss = step(params, opt_state, batch_arr)
-        session.report({"step": i, "loss": float(loss)})
+        if prev_loss is not None:
+            session.report({"step": prev_i, "loss": float(prev_loss)})
+        prev_i, prev_loss = i, loss
+    session.report({"step": prev_i, "loss": float(prev_loss)})
     jax.block_until_ready(loss)
     fw_s = time.perf_counter() - t0
 

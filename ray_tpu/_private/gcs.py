@@ -236,7 +236,9 @@ class GcsServer:
         if info.get("state") == ALIVE and info.get("worker_id") not in (None, req.get("worker_id")):
             # A second worker created the same actor (e.g. restart-recovery
             # raced an in-flight creation): the incumbent wins, the duplicate
-            # process must exit.
+            # process must exit. Remember it so its death report is ignored
+            # even if the incumbent's state changes before the report lands.
+            info.setdefault("rejected_workers", []).append(req.get("worker_id"))
             return {"ok": False, "duplicate": True}
         self._mutations += 1
         info.update(
@@ -254,17 +256,21 @@ class GcsServer:
         reporter = req.get("worker_id")
         for actor_id in req.get("actor_ids", []):
             info = self.actors.get(actor_id)
-            if (
-                info is not None
-                and info.get("state") == ALIVE
-                and reporter
-                and info.get("worker_id")
-                and info["worker_id"] != reporter
-            ):
-                # A different worker than the actor's registered host died —
-                # e.g. a rejected duplicate creation exiting (worker_main
-                # duplicate path). The incumbent is healthy; ignore.
-                continue
+            if info is not None and reporter:
+                rejected = info.get("rejected_workers") or []
+                if reporter in rejected:
+                    # A rejected duplicate exiting — expected, regardless of
+                    # the incumbent's current state.
+                    rejected.remove(reporter)
+                    continue
+                if (
+                    info.get("state") == ALIVE
+                    and info.get("worker_id")
+                    and info["worker_id"] != reporter
+                ):
+                    # A different worker than the actor's registered host
+                    # died; the incumbent is healthy — ignore.
+                    continue
             await self._handle_actor_failure(actor_id, req.get("reason", "worker died"))
         return {"ok": True}
 

@@ -188,9 +188,12 @@ class Algorithm(Trainable):
         self.workers.sync_weights(data["weights"])
 
     def cleanup(self) -> None:
-        self.workers.stop()
-        if hasattr(self.learner_group, "stop"):
-            self.learner_group.stop()
+        workers = getattr(self, "workers", None)
+        if workers is not None:
+            workers.stop()
+        lg = getattr(self, "learner_group", None)
+        if lg is not None and hasattr(lg, "stop"):
+            lg.stop()
 
     # -- convenience (reference: Algorithm.compute_single_action) ----------
     def compute_single_action(self, obs, explore: bool = False):
@@ -199,6 +202,16 @@ class Algorithm(Trainable):
 
         from ray_tpu.rllib.core import rl_module
 
+        obs = np.asarray(obs, np.float32)
+        # Policies trained behind an observation filter must see filtered
+        # observations at inference too.
+        base = getattr(self.workers, "_filter_base", None)
+        if base is not None:
+            from ray_tpu.rllib.connectors import MeanStdFilter
+
+            f = MeanStdFilter()
+            f.set_state(base)
+            obs = f.transform(obs[None])[0]
         params = jax.tree_util.tree_map(jnp.asarray, self.learner_group.get_weights())
         actions, _, _ = rl_module.sample_actions(
             params, jnp.asarray(np.asarray(obs, np.float32))[None], jax.random.PRNGKey(0), self.module_spec, explore

@@ -57,15 +57,21 @@ class MeanStdFilter(AgentConnector):
 
     def __call__(self, obs):
         obs = np.asarray(obs, np.float64)
-        for row in obs:
-            self._count += 1
+        n = obs.shape[0]
+        if n:
+            # Vectorized batch statistics folded in with the Chan formula —
+            # this runs on every env step, a per-row Python loop would
+            # dominate rollout cost.
+            b_mean = obs.mean(axis=0)
+            b_m2 = ((obs - b_mean) ** 2).sum(axis=0)
             if self._mean is None:
-                self._mean = np.array(row, np.float64)
-                self._m2 = np.zeros_like(self._mean)
+                self._count, self._mean, self._m2 = n, b_mean, b_m2
             else:
-                delta = row - self._mean
-                self._mean += delta / self._count
-                self._m2 += delta * (row - self._mean)
+                total = self._count + n
+                delta = b_mean - self._mean
+                self._mean = self._mean + delta * n / total
+                self._m2 = self._m2 + b_m2 + delta * delta * self._count * n / total
+                self._count = total
         return self.transform(obs)
 
     def transform(self, obs):

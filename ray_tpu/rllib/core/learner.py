@@ -178,11 +178,14 @@ class LearnerGroup:
         from ray_tpu.rllib.policy.sample_batch import FRAG_CUT
 
         total = batch.count
+        if total < n:
+            # Fewer rows than learners: every rank gets the whole batch —
+            # identical grads allreduce to themselves, and every rank MUST
+            # participate (an empty shard would NaN, a missing one would
+            # hang the collective).
+            return [(0, total)] * n
         if FRAG_CUT not in batch:
-            shard = max(1, total // n)
-            return [
-                (i * shard, total if i == n - 1 else (i + 1) * shard) for i in range(n)
-            ]
+            return [(i * total // n, (i + 1) * total // n) for i in range(n)]
         cut_ends = [i + 1 for i, c in enumerate(np.asarray(batch[FRAG_CUT])) if c]
         if not cut_ends or cut_ends[-1] != total:
             cut_ends.append(total)
@@ -200,11 +203,8 @@ class LearnerGroup:
         if any(hi <= lo for lo, hi in bounds):
             # Fewer fragments than learners (or shuffled minibatches whose
             # cut rows landed badly): empty shards would feed NaN-producing
-            # zero-length updates — fall back to an even row split.
-            shard = max(1, total // n)
-            return [
-                (i * shard, total if i == n - 1 else (i + 1) * shard) for i in range(n)
-            ]
+            # zero-length updates — fall back to a balanced row split.
+            return [(i * total // n, (i + 1) * total // n) for i in range(n)]
         return bounds
 
     def stop(self):

@@ -215,19 +215,22 @@ class WorkerSet:
             return
         from ray_tpu.rllib.connectors import MeanStdFilter
 
+        # Fan out, then gather (a slow worker must not serialize the sync).
+        pop_refs = [w.pop_filter_delta.remote() for w in self._workers]
         deltas = []
-        for w in self._workers:
+        for ref in pop_refs:
             try:
-                deltas.append(ray_tpu.get(w.pop_filter_delta.remote(), timeout=60))
+                deltas.append(ray_tpu.get(ref, timeout=60))
             except Exception:
                 pass
         merger = MeanStdFilter()
         states = [self._filter_base] + [d for d in deltas if d]
         merger.merge_states([st for st in states if st])
         self._filter_base = merger.get_state()
-        for w in self._workers:
+        set_refs = [w.set_filter_state.remote(self._filter_base) for w in self._workers]
+        for ref in set_refs:
             try:
-                ray_tpu.get(w.set_filter_state.remote(self._filter_base), timeout=60)
+                ray_tpu.get(ref, timeout=60)
             except Exception:
                 pass
 
