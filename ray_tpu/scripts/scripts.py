@@ -397,6 +397,80 @@ def cmd_job(args):
 
 
 # ----------------------------------------------------------------------
+# serve (reference: serve/scripts.py — serve run/status/shutdown)
+# ----------------------------------------------------------------------
+
+
+def cmd_serve(args):
+    import importlib
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(address=_gcs_address(args.address))
+    if args.serve_cmd == "run":
+        # import_path "module:app" where app is a bound Application.
+        mod_name, _, attr = args.import_path.partition(":")
+        sys.path.insert(0, os.getcwd())
+        app = getattr(importlib.import_module(mod_name), attr or "app")
+        serve.run(app, route_prefix=args.route_prefix or "__from_deployment__")
+        host, port = serve.http_address()
+        print(f"Serving at http://{host}:{port} (ctrl-c to stop)")
+        try:
+            while True:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            # Honor the promise: interrupt tears the application down
+            # (reference: `serve run` shuts down on interrupt).
+            print("Shutting down serve...")
+            serve.shutdown()
+    elif args.serve_cmd == "status":
+        for name, st in serve.status().items():
+            print(
+                f"{name:24} replicas={st['num_replicas']}/{st['target']} "
+                f"version={st['version']} route={st['route_prefix']}"
+            )
+    elif args.serve_cmd == "shutdown":
+        serve.shutdown()
+        print("Serve shut down.")
+
+
+# ----------------------------------------------------------------------
+# chaos (reference: `ray kill-random-node`, scripts.py:1337)
+# ----------------------------------------------------------------------
+
+
+def cmd_kill_random_node(args):
+    import random
+
+    # Candidates = local worker-node processes that are actually alive and
+    # killable (GCS may still list a just-killed node as ALIVE until the
+    # heartbeat timeout; a chaos loop must land one kill per round). The
+    # head is never among these markers — only worker nodes write them.
+    candidates = []
+    for fname in _node_files():
+        path = os.path.join(NODES_DIR, fname)
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            node_id, pid = rec.get("node_id"), rec.get("pid")
+        except Exception:
+            continue
+        if node_id and _pid_alive(pid):
+            candidates.append((path, node_id, int(pid)))
+    if not candidates:
+        print("no killable worker-node processes on this host")
+        return
+    path, node_id, pid = random.choice(candidates)
+    os.kill(pid, signal.SIGKILL)
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+    print(f"killed node {node_id[:12]} (pid {pid})")
+
+
+# ----------------------------------------------------------------------
 # microbenchmark
 # ----------------------------------------------------------------------
 
@@ -532,6 +606,20 @@ def main(argv=None):
         if name != "list":
             jp.add_argument("submission_id")
     p.set_defaults(fn=cmd_job)
+
+    p = sub.add_parser("serve", help="model serving")
+    ssub = p.add_subparsers(dest="serve_cmd", required=True)
+    sr = ssub.add_parser("run")
+    sr.add_argument("import_path", help="module:bound_app, e.g. my_app:app")
+    sr.add_argument("--address", default=None)
+    sr.add_argument("--route-prefix", default=None)
+    for name in ("status", "shutdown"):
+        sp2 = ssub.add_parser(name)
+        sp2.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser("kill-random-node", help="chaos: SIGKILL a random local worker node (never the head)")
+    p.set_defaults(fn=cmd_kill_random_node)
 
     p = sub.add_parser("microbenchmark", help="task/actor/object throughput suite")
     p.add_argument("--num-cpus", type=int, default=None)

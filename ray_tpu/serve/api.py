@@ -272,10 +272,17 @@ def shutdown():
     global _started
     from ray_tpu.serve._private.router import Router
 
-    if not _started:
-        return
+    # Another driver (e.g. the CLI) may shut down a running Serve instance:
+    # resolve the controller once; absent controller + not started = no-op.
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        if not _started:
+            return
+        controller = None
+    try:
+        if controller is None:
+            raise RuntimeError("no controller")
         ray_tpu.get(controller.graceful_shutdown.remote())
         time.sleep(0.2)
         ray_tpu.kill(controller)
