@@ -1,0 +1,111 @@
+"""joblib backend over ray_tpu tasks.
+
+Analog of the reference's ray.util.joblib (register_ray backend): scikit-learn
+style ``Parallel(...)`` fan-outs run as ray_tpu tasks instead of local
+processes, so a cluster's CPUs serve joblib workloads unchanged:
+
+    from ray_tpu.util.joblib import register_ray
+    import joblib
+    register_ray()
+    with joblib.parallel_backend("ray_tpu"):
+        results = joblib.Parallel()(joblib.delayed(f)(x) for x in data)
+"""
+
+from __future__ import annotations
+
+
+def register_ray():
+    """Register the 'ray_tpu' joblib parallel backend."""
+    from joblib.parallel import register_parallel_backend
+
+    register_parallel_backend("ray_tpu", _RayTpuBackend)
+
+
+def _make_backend():
+    from joblib._parallel_backends import ParallelBackendBase
+
+    class RayTpuBackend(ParallelBackendBase):
+        """Each joblib batch becomes one ray_tpu task; effective_n_jobs maps
+        to the cluster's CPU count (reference: RayBackend in
+        util/joblib/ray_backend.py)."""
+
+        supports_timeout = True
+        uses_threads = False
+        supports_sharedmem = False
+
+        def effective_n_jobs(self, n_jobs):
+            import ray_tpu
+
+            if not ray_tpu.is_initialized():
+                ray_tpu.init()
+            if n_jobs is None or n_jobs == -1:
+                try:
+                    return max(1, int(ray_tpu.cluster_resources().get("CPU", 1)))
+                except Exception:
+                    return 1
+            return max(1, int(n_jobs))
+
+        def submit(self, func, callback=None):
+            import cloudpickle
+
+            import ray_tpu
+
+            @ray_tpu.remote
+            def _run_joblib_batch(payload):
+                import cloudpickle as _cp
+
+                return _cp.loads(payload)()
+
+            ref = _run_joblib_batch.remote(cloudpickle.dumps(func))
+            return _RayFuture(ref, callback)
+
+        # Older joblib calls apply_async; same semantics.
+        apply_async = submit
+
+        def retrieve_result(self, out, timeout=None):
+            return out.get(timeout=timeout)
+
+        def configure(self, n_jobs=1, parallel=None, prefer=None, require=None, **kwargs):
+            self.parallel = parallel
+            return self.effective_n_jobs(n_jobs)
+
+        def terminate(self):
+            pass
+
+        def abort_everything(self, ensure_ready=True):
+            if ensure_ready:
+                self.configure(n_jobs=self.parallel.n_jobs, parallel=self.parallel)
+
+    return RayTpuBackend
+
+
+class _RayFuture:
+    """joblib future protocol over an ObjectRef."""
+
+    def __init__(self, ref, callback):
+        self._ref = ref
+        self._callback = callback
+        self._result = None
+        self._done = False
+        if callback is not None:
+            import threading
+
+            threading.Thread(target=self._wait_and_callback, daemon=True).start()
+
+    def _wait_and_callback(self):
+        try:
+            result = self.get()
+        except Exception:
+            return
+        self._callback(result)
+
+    def get(self, timeout=None):
+        import ray_tpu
+
+        if not self._done:
+            self._result = ray_tpu.get(self._ref, timeout=timeout)
+            self._done = True
+        return self._result
+
+
+_RayTpuBackend = _make_backend()

@@ -138,3 +138,15 @@ def test_parallel_iterator(ray_start_regular):
     assert batches == [[0, 1], [2, 3]]
 
     assert par_iter.from_range(10, num_shards=2).take(3) == [0, 1, 2]
+
+
+def test_joblib_backend(ray_start_regular):
+    """joblib Parallel over ray_tpu tasks (reference: util/joblib)."""
+    import joblib
+
+    from ray_tpu.util.joblib import register_ray
+
+    register_ray()
+    with joblib.parallel_backend("ray_tpu", n_jobs=4):
+        out = joblib.Parallel()(joblib.delayed(lambda x: x * x)(i) for i in range(12))
+    assert out == [i * i for i in range(12)]
