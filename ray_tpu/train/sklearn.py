@@ -1,0 +1,117 @@
+"""SklearnTrainer + gated GBDT trainers.
+
+Analog of the reference's train/sklearn/sklearn_trainer.py (fit an estimator
+remotely on Ray Data) and train/{xgboost,lightgbm} GBDTTrainers. Sklearn fits
+are single-process (the library is not distributed); the trainer runs the fit
+in a cluster task so the driver stays responsive, materializes the Dataset to
+a feature matrix, scores on validation datasets, and returns an AIR
+checkpoint holding the fitted estimator (loadable by SklearnPredictor-style
+code via Checkpoint.to_dict()["estimator"]).
+
+XGBoostTrainer / LightGBMTrainer are declared but gated: those libraries are
+not in this image; constructing them raises with install guidance (reference
+behavior when an optional integration is missing).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.train.base_trainer import BaseTrainer, Result
+
+
+def _to_xy(ds, label_column: str, feature_columns: Optional[list]):
+    rows = ds.take_all()
+    if not rows:
+        raise ValueError("empty dataset")
+    cols = feature_columns or [c for c in rows[0] if c != label_column]
+    X = np.asarray([[r[c] for c in cols] for r in rows], dtype=np.float64)
+    y = np.asarray([r[label_column] for r in rows])
+    return X, y, cols
+
+
+class SklearnTrainer(BaseTrainer):
+    def __init__(
+        self,
+        *,
+        estimator,
+        label_column: str,
+        datasets: dict,
+        feature_columns: Optional[list] = None,
+        scoring: Optional[str] = None,
+        **kwargs,
+    ):
+        super().__init__(datasets=datasets, **kwargs)
+        self.estimator = estimator
+        self.label_column = label_column
+        self.feature_columns = feature_columns
+        self.scoring = scoring
+
+    def _fit_direct(self) -> Result:
+        import ray_tpu
+
+        train_ds = self.datasets.get("train")
+        if train_ds is None:
+            raise ValueError('datasets must include a "train" Dataset')
+        X, y, cols = _to_xy(train_ds, self.label_column, self.feature_columns)
+        valid_sets = {
+            name: _to_xy(ds, self.label_column, cols)[:2]
+            for name, ds in self.datasets.items()
+            if name != "train"
+        }
+
+        @ray_tpu.remote
+        def _fit(estimator, X, y, valid_sets, scoring):
+            estimator.fit(X, y)
+            metrics = {"train_score": float(estimator.score(X, y))}
+            if scoring:
+                from sklearn import metrics as skm
+
+                scorer = skm.get_scorer(scoring)
+                metrics[f"train_{scoring}"] = float(scorer(estimator, X, y))
+            for name, (Xv, yv) in valid_sets.items():
+                metrics[f"{name}_score"] = float(estimator.score(Xv, yv))
+            return estimator, metrics
+
+        run_dir = self._run_dir()
+        try:
+            # No fit deadline: long estimator fits are legitimate (the
+            # reference imposes none either).
+            fitted, metrics = ray_tpu.get(
+                _fit.remote(self.estimator, X, y, valid_sets, self.scoring)
+            )
+        except Exception as e:
+            return Result(metrics={}, error=str(e), path=run_dir)
+        ckpt = Checkpoint.from_dict(
+            {"estimator": fitted, "feature_columns": cols, "label_column": self.label_column}
+        )
+        return Result(metrics=metrics, checkpoint=ckpt, path=run_dir)
+
+    def training_loop(self) -> None:  # Trainable-path entry
+        from ray_tpu.air import session
+
+        result = self._fit_direct()
+        if session.in_session():
+            session.report(dict(result.metrics), checkpoint=result.checkpoint)
+
+
+def _gated(name: str, package: str):
+    class _Gated(BaseTrainer):
+        def __init__(self, *a, **k):
+            raise ImportError(
+                f"{name} requires the '{package}' package, which is not "
+                "installed in this environment. Install it on the node image "
+                f"(pip install {package}) to use this trainer."
+            )
+
+    _Gated.__name__ = name
+    return _Gated
+
+
+# Gated in this environment (no xgboost/lightgbm in the image); a build
+# against the real libraries would replace these with full GBDT trainers.
+XGBoostTrainer = _gated("XGBoostTrainer", "xgboost")
+LightGBMTrainer = _gated("LightGBMTrainer", "lightgbm")

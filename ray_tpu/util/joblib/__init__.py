@@ -38,25 +38,21 @@ def _make_backend():
 
             if not ray_tpu.is_initialized():
                 ray_tpu.init()
+            try:
+                cpus = max(1, int(ray_tpu.cluster_resources().get("CPU", 1)))
+            except Exception:
+                cpus = 1
             if n_jobs is None or n_jobs == -1:
-                try:
-                    return max(1, int(ray_tpu.cluster_resources().get("CPU", 1)))
-                except Exception:
-                    return 1
+                return cpus
+            if n_jobs < 0:
+                # joblib semantics: -2 = all CPUs but one, etc.
+                return max(1, cpus + 1 + int(n_jobs))
             return max(1, int(n_jobs))
 
         def submit(self, func, callback=None):
             import cloudpickle
 
-            import ray_tpu
-
-            @ray_tpu.remote
-            def _run_joblib_batch(payload):
-                import cloudpickle as _cp
-
-                return _cp.loads(payload)()
-
-            ref = _run_joblib_batch.remote(cloudpickle.dumps(func))
+            ref = _remote_batch_fn().remote(cloudpickle.dumps(func))
             return _RayFuture(ref, callback)
 
         # Older joblib calls apply_async; same semantics.
@@ -106,6 +102,26 @@ class _RayFuture:
             self._result = ray_tpu.get(self._ref, timeout=timeout)
             self._done = True
         return self._result
+
+
+_batch_fn = None
+
+
+def _remote_batch_fn():
+    """One shared remote function for all batches (constructing a fresh
+    RemoteFunction per submit would pay export cost per task)."""
+    global _batch_fn
+    if _batch_fn is None:
+        import ray_tpu
+
+        @ray_tpu.remote
+        def _run_joblib_batch(payload):
+            import cloudpickle as _cp
+
+            return _cp.loads(payload)()
+
+        _batch_fn = _run_joblib_batch
+    return _batch_fn
 
 
 _RayTpuBackend = _make_backend()

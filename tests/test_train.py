@@ -145,3 +145,40 @@ def test_trainer_failure_restart(ray_start_regular):
     assert result.metrics["epoch"] == 3
     assert result.metrics["resumed"] is True
     os.unlink(marker)
+
+
+def test_sklearn_trainer(ray_start_regular):
+    """SklearnTrainer fits remotely on a Dataset and checkpoints the
+    estimator (reference: train/sklearn/sklearn_trainer.py)."""
+    from sklearn.linear_model import LogisticRegression
+
+    from ray_tpu import data as rdata
+    from ray_tpu.train import SklearnTrainer
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(200, 3))
+    y = (X[:, 0] + X[:, 1] > 0).astype(int)
+    rows = [{"a": X[i, 0], "b": X[i, 1], "c": X[i, 2], "label": int(y[i])} for i in range(200)]
+    train_ds = rdata.from_items(rows[:150])
+    valid_ds = rdata.from_items(rows[150:])
+    trainer = SklearnTrainer(
+        estimator=LogisticRegression(),
+        label_column="label",
+        datasets={"train": train_ds, "valid": valid_ds},
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics["train_score"] > 0.85
+    assert result.metrics["valid_score"] > 0.75
+    est = result.checkpoint.to_dict()["estimator"]
+    pred = est.predict(X[:5])
+    assert pred.shape == (5,)
+
+
+def test_gbdt_trainers_gated():
+    from ray_tpu.train import LightGBMTrainer, XGBoostTrainer
+
+    with pytest.raises(ImportError, match="xgboost"):
+        XGBoostTrainer(datasets={})
+    with pytest.raises(ImportError, match="lightgbm"):
+        LightGBMTrainer(datasets={})
