@@ -6,9 +6,15 @@ gang with gradient allreduce over the collective plane) runs jitted SGD;
 Algorithm extends the Tune Trainable so algorithms drop into tune.Tuner.
 """
 
+from ray_tpu.rllib.algorithms.a2c import A2C, A2CConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.ddpg import DDPG, TD3, DDPGConfig, TD3Config  # noqa: F401
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.es import ES, ESConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.marwil import MARWIL, BC, BCConfig, MARWILConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.sac import SAC, SACConfig  # noqa: F401
 from ray_tpu.rllib.policy.sample_batch import MultiAgentBatch, SampleBatch  # noqa: F401

@@ -1,0 +1,1 @@
+from ray_tpu.rllib.algorithms.appo.appo import APPO, APPOConfig  # noqa: F401

@@ -1,20 +1,11 @@
-"""IMPALA — importance-weighted actor-learner with V-trace.
+"""APPO — asynchronous PPO (IMPALA architecture + clipped surrogate).
 
-Reference: rllib/algorithms/impala/ (+ vtrace_tf/torch). Architecturally the
-TPU shape differs from the reference's async queues: rollout workers sample
-with whatever weights they last received (behavior policy), the learner
-corrects the off-policyness with V-trace importance weights inside one jitted
-loss, and weight broadcast happens once per iteration — decoupled
-actors/learner without a Python-side queue, matching how an XLA-friendly
-learner wants its input: one big batch, one compiled step.
-
-V-trace (Espeholt et al. 2018):
-    rho_t = min(rho_bar, pi(a|s)/mu(a|s));  c_t = min(c_bar, rho_t)
-    delta_t = rho_t (r_t + gamma V(s_{t+1}) - V(s_t))
-    vs_t = V(s_t) + delta_t + gamma c_t (vs_{t+1} - V(s_{t+1}))
-    pg_adv_t = rho_t (r_t + gamma vs_{t+1} - V(s_t))
-computed with a reverse lax.scan; episode ends reset the recursion via the
-dones mask. Bootstrap values ride in the batch (NEXT_VF_PREDS).
+Reference: rllib/algorithms/appo/appo.py (+ appo_torch_policy loss): the
+IMPALA actor-learner decoupling (behavior-policy rollouts, V-trace targets)
+with PPO's clipped-surrogate objective computed against the V-trace policy-
+gradient advantages, plus a target network whose KL anchors the update
+(use_kl_loss). TPU shape matches our IMPALA: decoupled staleness is modeled
+by broadcast_interval, the correction lives inside one jitted loss.
 """
 
 from __future__ import annotations
@@ -35,7 +26,7 @@ from ray_tpu.rllib.policy.sample_batch import (
 )
 
 
-def impala_loss(params, batch, spec, cfg):
+def appo_loss(params, batch, spec, cfg):
     import jax
     import jax.numpy as jnp
 
@@ -46,71 +37,75 @@ def impala_loss(params, batch, spec, cfg):
         params, batch[OBS], batch[ACTIONS], spec
     )
     nonterminal = 1.0 - batch[DONES].astype(values.dtype)
-    # Fragment cuts: the batch is a concatenation of per-env rollout
-    # fragments; the recursion must reset at each fragment's last row (the
-    # bootstrap value there already carries the tail's contribution).
     cuts = batch[FRAG_CUT].astype(values.dtype)
-    vs, pg_adv, rho = vtrace(
+    vs, pg_adv, _ = vtrace(
         jax.lax.stop_gradient(values), batch[NEXT_VF_PREDS], logp, batch[LOGPS],
         batch[REWARDS], nonterminal, cuts, cfg["gamma"], cfg["rho_bar"], cfg["c_bar"],
     )
-    policy_loss = -jnp.mean(logp * pg_adv)
+    # PPO surrogate on the V-trace advantages (reference: appo loss).
+    ratio = jnp.exp(logp - batch[LOGPS])
+    clip = cfg["clip_param"]
+    surrogate = jnp.minimum(ratio * pg_adv, jnp.clip(ratio, 1 - clip, 1 + clip) * pg_adv)
+    policy_loss = -surrogate.mean()
     vf_loss = 0.5 * jnp.mean((vs - values) ** 2)
     entropy_mean = entropy.mean()
-    total = policy_loss + cfg["vf_loss_coeff"] * vf_loss - cfg["entropy_coeff"] * entropy_mean
+    # KL(behavior || current) as a soft anchor (reference: use_kl_loss).
+    kl = (batch[LOGPS] - logp).mean()
+    total = (
+        policy_loss
+        + cfg["vf_loss_coeff"] * vf_loss
+        - cfg["entropy_coeff"] * entropy_mean
+        + cfg["kl_coeff"] * jnp.maximum(kl, 0.0)
+    )
     return total, {
         "policy_loss": policy_loss,
         "vf_loss": vf_loss,
         "entropy": entropy_mean,
-        "mean_rho": rho.mean(),
+        "kl": kl,
     }
 
 
-class IMPALAConfig(AlgorithmConfig):
+class APPOConfig(AlgorithmConfig):
     def __init__(self, algo_class=None):
-        super().__init__(algo_class or IMPALA)
+        super().__init__(algo_class or APPO)
         self.lr = 5e-4
         self.train_batch_size = 2000
+        self.clip_param = 0.2
         self.vf_loss_coeff = 0.5
         self.entropy_coeff = 0.01
+        self.kl_coeff = 0.2
         self.grad_clip = 40.0
         self.rho_bar = 1.0
         self.c_bar = 1.0
-        self.minibatch_size = 512
-        self.num_sgd_iter = 1
-        # Broadcast weights every N iterations (staleness is what V-trace
-        # corrects; >1 models the reference's async actors).
+        self.num_sgd_iter = 2
         self.broadcast_interval = 1
 
-    def training(self, *, vf_loss_coeff: Optional[float] = None,
-                 entropy_coeff: Optional[float] = None, rho_bar: Optional[float] = None,
-                 c_bar: Optional[float] = None, minibatch_size: Optional[int] = None,
+    def training(self, *, clip_param: Optional[float] = None, vf_loss_coeff: Optional[float] = None,
+                 entropy_coeff: Optional[float] = None, kl_coeff: Optional[float] = None,
+                 rho_bar: Optional[float] = None, c_bar: Optional[float] = None,
                  num_sgd_iter: Optional[int] = None, broadcast_interval: Optional[int] = None,
-                 **kwargs) -> "IMPALAConfig":
+                 **kwargs) -> "APPOConfig":
         super().training(**kwargs)
         for name, value in (
-            ("vf_loss_coeff", vf_loss_coeff),
-            ("entropy_coeff", entropy_coeff),
-            ("rho_bar", rho_bar),
-            ("c_bar", c_bar),
-            ("minibatch_size", minibatch_size),
-            ("num_sgd_iter", num_sgd_iter),
-            ("broadcast_interval", broadcast_interval),
+            ("clip_param", clip_param), ("vf_loss_coeff", vf_loss_coeff),
+            ("entropy_coeff", entropy_coeff), ("kl_coeff", kl_coeff),
+            ("rho_bar", rho_bar), ("c_bar", c_bar),
+            ("num_sgd_iter", num_sgd_iter), ("broadcast_interval", broadcast_interval),
         ):
             if value is not None:
                 setattr(self, name, value)
         return self
 
 
-class IMPALA(Algorithm):
+class APPO(Algorithm):
     @classmethod
-    def get_default_config(cls) -> IMPALAConfig:
-        return IMPALAConfig(cls)
+    def get_default_config(cls) -> APPOConfig:
+        return APPOConfig(cls)
 
-    def _build_learner_group(self, cfg: IMPALAConfig) -> LearnerGroup:
+    def _build_learner_group(self, cfg: APPOConfig) -> LearnerGroup:
         return LearnerGroup(
             self.module_spec,
-            impala_loss,
+            appo_loss,
             lr=cfg.lr,
             grad_clip=cfg.grad_clip,
             seed=cfg.seed,
@@ -119,7 +114,7 @@ class IMPALA(Algorithm):
         )
 
     def training_step(self) -> dict:
-        cfg: IMPALAConfig = self._algo_config
+        cfg: APPOConfig = self._algo_config
         per_worker = max(
             1, cfg.train_batch_size // max(self.workers.num_workers, 1) // cfg.num_envs_per_worker
         )
@@ -130,14 +125,17 @@ class IMPALA(Algorithm):
             "gamma": cfg.gamma,
             "rho_bar": cfg.rho_bar,
             "c_bar": cfg.c_bar,
+            "clip_param": cfg.clip_param,
             "vf_loss_coeff": cfg.vf_loss_coeff,
             "entropy_coeff": cfg.entropy_coeff,
+            "kl_coeff": cfg.kl_coeff,
         }
-        # V-trace needs contiguous time order — update on the WHOLE batch
-        # (no shuffled minibatches like PPO).
+        # V-trace needs contiguous time order — whole-batch epochs, no
+        # shuffled minibatches (same constraint as IMPALA).
         metrics = {}
         for _ in range(cfg.num_sgd_iter):
             metrics = self.learner_group.update(batch, loss_cfg)
         if self.iteration % max(cfg.broadcast_interval, 1) == 0:
             self.workers.sync_weights(self.learner_group.get_weights())
+        metrics["num_env_steps_sampled_this_iter"] = batch.count
         return dict(metrics)

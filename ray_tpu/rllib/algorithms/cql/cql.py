@@ -1,0 +1,286 @@
+"""CQL — conservative Q-learning (offline RL).
+
+Reference: rllib/algorithms/cql/ (cql.py, cql_torch_policy.py): SAC's
+actor-critic updated purely from a fixed dataset, with the CQL(H) regularizer
+pushing down Q on out-of-distribution actions (logsumexp over sampled
+actions) and up on dataset actions. Reuses SAC's networks and squashed
+policy; data comes from the offline readers (rllib/offline), never an env.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.sac.sac import (
+    _mlp_apply,
+    _squashed_sample,
+    init_sac_params,
+)
+from ray_tpu.rllib.offline import DatasetReader, JsonReader
+from ray_tpu.rllib.policy.sample_batch import (
+    ACTIONS,
+    DONES,
+    NEXT_OBS,
+    OBS,
+    REWARDS,
+)
+
+
+class CQLConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or CQL)
+        self.lr = 3e-4
+        self.num_rollout_workers = 0
+        self.train_batch_size = 256
+        self.tau = 5e-3
+        self.initial_alpha = 1.0
+        self.cql_alpha = 1.0  # conservative penalty weight
+        self.num_cql_actions = 4  # sampled actions for the logsumexp
+        self.updates_per_iter = 200
+        self.input_: Optional[object] = None  # path / list / Dataset
+        self.model_hiddens = (256, 256)
+
+    def offline_data(self, *, input_=None) -> "CQLConfig":
+        if input_ is not None:
+            self.input_ = input_
+        return self
+
+    def training(self, *, tau=None, initial_alpha=None, cql_alpha=None,
+                 num_cql_actions=None, updates_per_iter=None, **kwargs) -> "CQLConfig":
+        super().training(**kwargs)
+        for name, val in (
+            ("tau", tau), ("initial_alpha", initial_alpha), ("cql_alpha", cql_alpha),
+            ("num_cql_actions", num_cql_actions), ("updates_per_iter", updates_per_iter),
+        ):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+class CQL(Algorithm):
+    @classmethod
+    def get_default_config(cls) -> CQLConfig:
+        return CQLConfig(cls)
+
+    def setup(self, config: dict) -> None:
+        import gymnasium as gym
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg: CQLConfig = self._algo_config
+        assert cfg.input_ is not None, "CQL needs offline data: config.offline_data(input_=...)"
+        probe = gym.make(cfg.env) if isinstance(cfg.env, str) else cfg.env(dict(cfg.env_config))
+        self.discrete = isinstance(probe.action_space, gym.spaces.Discrete)
+        self.obs_dim = int(np.prod(probe.observation_space.shape))
+        if self.discrete:
+            self.action_dim = int(probe.action_space.n)
+            self._act_scale = self._act_offset = None
+        else:
+            # Dataset actions are in env units; the squashed policy and the
+            # CQL logsumexp both live in [-1,1] — normalize at the data edge.
+            self.action_dim = int(np.prod(probe.action_space.shape))
+            low = np.asarray(probe.action_space.low, np.float32)
+            high = np.asarray(probe.action_space.high, np.float32)
+            self._act_scale = (high - low) / 2.0
+            self._act_offset = (high + low) / 2.0
+        probe.close()
+        if hasattr(cfg.input_, "take_all"):
+            self.reader = DatasetReader(cfg.input_, gamma=cfg.gamma, seed=cfg.seed)
+        else:
+            self.reader = JsonReader(cfg.input_, gamma=cfg.gamma, seed=cfg.seed)
+        self.params = init_sac_params(
+            jax.random.PRNGKey(cfg.seed), self.obs_dim, self.action_dim, self.discrete, cfg.model_hiddens
+        )
+        self.params["log_alpha"] = jnp.log(jnp.asarray(cfg.initial_alpha, jnp.float32))
+        self.target = {"q1": self.params["q1"], "q2": self.params["q2"]}
+        self.target_entropy = (
+            0.98 * float(np.log(self.action_dim)) if self.discrete else -float(self.action_dim)
+        )
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.params)
+        self._rng = jax.random.PRNGKey(cfg.seed + 1)
+        self._timesteps_total = 0
+        self._episode_reward_window: list = []
+        self._build_fns(cfg)
+
+    def _build_fns(self, cfg: CQLConfig):
+        import jax
+        import jax.numpy as jnp
+
+        discrete, action_dim = self.discrete, self.action_dim
+        gamma, tau = cfg.gamma, cfg.tau
+        cql_alpha, n_cql = cfg.cql_alpha, cfg.num_cql_actions
+        target_entropy = self.target_entropy
+        tx = self.tx
+
+        def loss_fn(params, target, batch, key):
+            obs, next_obs = batch[OBS], batch[NEXT_OBS]
+            rewards, dones = batch[REWARDS], batch[DONES]
+            alpha = jax.lax.stop_gradient(jnp.exp(params["log_alpha"]))
+            if discrete:
+                # SAC-discrete backup + exact logsumexp penalty.
+                next_logpi = jax.nn.log_softmax(_mlp_apply(params["actor"], next_obs))
+                next_pi = jnp.exp(next_logpi)
+                tq = jnp.minimum(_mlp_apply(target["q1"], next_obs), _mlp_apply(target["q2"], next_obs))
+                next_v = jnp.sum(next_pi * (tq - alpha * next_logpi), axis=-1)
+                td_target = jax.lax.stop_gradient(rewards + gamma * (1 - dones) * next_v)
+                idx = batch[ACTIONS].astype(jnp.int32)
+                q1_all = _mlp_apply(params["q1"], obs)
+                q2_all = _mlp_apply(params["q2"], obs)
+                rows = jnp.arange(obs.shape[0])
+                q1, q2 = q1_all[rows, idx], q2_all[rows, idx]
+                bellman = 0.5 * (jnp.mean((q1 - td_target) ** 2) + jnp.mean((q2 - td_target) ** 2))
+                cql_term = (
+                    jnp.mean(jax.scipy.special.logsumexp(q1_all, axis=-1) - q1)
+                    + jnp.mean(jax.scipy.special.logsumexp(q2_all, axis=-1) - q2)
+                )
+                logpi = jax.nn.log_softmax(_mlp_apply(params["actor"], obs))
+                pi = jnp.exp(logpi)
+                q_min = jax.lax.stop_gradient(jnp.minimum(q1_all, q2_all))
+                actor_loss = jnp.mean(jnp.sum(pi * (alpha * logpi - q_min), axis=-1))
+                entropy = -jnp.sum(pi * logpi, axis=-1).mean()
+            else:
+                k1, k2, k3, k4 = jax.random.split(key, 4)
+                next_a, next_logp, _ = _squashed_sample(params["actor"], next_obs, k1, action_dim)
+                tq1 = _mlp_apply(target["q1"], jnp.concatenate([next_obs, next_a], -1))[:, 0]
+                tq2 = _mlp_apply(target["q2"], jnp.concatenate([next_obs, next_a], -1))[:, 0]
+                td_target = jax.lax.stop_gradient(
+                    rewards + gamma * (1 - dones) * (jnp.minimum(tq1, tq2) - alpha * next_logp)
+                )
+                sa = jnp.concatenate([obs, batch[ACTIONS]], -1)
+                q1 = _mlp_apply(params["q1"], sa)[:, 0]
+                q2 = _mlp_apply(params["q2"], sa)[:, 0]
+                bellman = 0.5 * (jnp.mean((q1 - td_target) ** 2) + jnp.mean((q2 - td_target) ** 2))
+
+                # CQL(H): logsumexp over uniform + policy actions with
+                # importance weights (reference: cql_torch_policy.py).
+                B = obs.shape[0]
+
+                def q_of(qp, o, a):
+                    rep = jnp.repeat(o, a.shape[1], axis=0)
+                    flat = a.reshape(-1, action_dim)
+                    return _mlp_apply(qp, jnp.concatenate([rep, flat], -1))[:, 0].reshape(B, -1)
+
+                rand_a = jax.random.uniform(k2, (B, n_cql, action_dim), minval=-1.0, maxval=1.0)
+                pol_a, pol_logp, _ = _squashed_sample(
+                    params["actor"], jnp.repeat(obs, n_cql, axis=0), k3, action_dim
+                )
+                pol_a = pol_a.reshape(B, n_cql, action_dim)
+                pol_logp = pol_logp.reshape(B, n_cql)
+                log_u = -action_dim * jnp.log(2.0)  # uniform density on [-1,1]^d
+                cql_term = 0.0
+                for qp, qd in ((params["q1"], q1), (params["q2"], q2)):
+                    cat = jnp.concatenate(
+                        [q_of(qp, obs, rand_a) - log_u, q_of(qp, obs, pol_a) - jax.lax.stop_gradient(pol_logp)],
+                        axis=1,
+                    )
+                    cql_term = cql_term + jnp.mean(
+                        jax.scipy.special.logsumexp(cat, axis=1) - jnp.log(2.0 * n_cql) - qd
+                    )
+                a_pi, logp_pi, _ = _squashed_sample(params["actor"], obs, k4, action_dim)
+                q_pi = jnp.minimum(
+                    _mlp_apply(params["q1"], jnp.concatenate([obs, a_pi], -1))[:, 0],
+                    _mlp_apply(params["q2"], jnp.concatenate([obs, a_pi], -1))[:, 0],
+                )
+                actor_loss = jnp.mean(alpha * logp_pi - q_pi)
+                entropy = -logp_pi.mean()
+            alpha_loss = params["log_alpha"] * jax.lax.stop_gradient(entropy - target_entropy)
+            total = bellman + cql_alpha * cql_term + actor_loss + alpha_loss
+            return total, {
+                "bellman_loss": bellman,
+                "cql_term": cql_term,
+                "actor_loss": actor_loss,
+                "alpha": alpha,
+            }
+
+        def train_step(params, target, opt_state, batch, key):
+            (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, target, batch, key)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+            target = jax.tree_util.tree_map(
+                lambda t, p: (1 - tau) * t + tau * p,
+                target,
+                {"q1": params["q1"], "q2": params["q2"]},
+            )
+            return params, target, opt_state, metrics
+
+        self._train_step = jax.jit(train_step)
+
+    def training_step(self) -> dict:
+        import jax
+        import jax.numpy as jnp
+
+        cfg: CQLConfig = self._algo_config
+        metrics: dict = {}
+        for _ in range(cfg.updates_per_iter):
+            batch = self.reader.next(cfg.train_batch_size)
+            actions = np.asarray(batch[ACTIONS])
+            if not self.discrete:
+                actions = np.clip(
+                    (actions.reshape(len(actions), -1).astype(np.float32) - self._act_offset)
+                    / np.maximum(self._act_scale, 1e-8),
+                    -1.0,
+                    1.0,
+                )
+            jb = {
+                OBS: jnp.asarray(np.asarray(batch[OBS], np.float32)),
+                ACTIONS: jnp.asarray(actions),
+                REWARDS: jnp.asarray(np.asarray(batch[REWARDS], np.float32)),
+                DONES: jnp.asarray(np.asarray(batch.get(DONES, np.zeros(len(batch))), np.float32)),
+                NEXT_OBS: jnp.asarray(np.asarray(batch[NEXT_OBS], np.float32)),
+            }
+            self._rng, key = jax.random.split(self._rng)
+            self.params, self.target, self.opt_state, m = self._train_step(
+                self.params, self.target, self.opt_state, jb, key
+            )
+            metrics = {k: float(v) for k, v in m.items()}
+            self._timesteps_total += cfg.train_batch_size
+        return metrics
+
+    def step(self) -> dict:
+        import time
+
+        t0 = time.time()
+        result = self.training_step()
+        result["timesteps_total"] = self._timesteps_total
+        result["time_this_iter_s"] = time.time() - t0
+        return result
+
+    def compute_single_action(self, obs, explore: bool = False):
+        import jax
+        import jax.numpy as jnp
+
+        obs = jnp.asarray(np.asarray(obs, np.float32).reshape(1, -1))
+        if self.discrete:
+            logits = _mlp_apply(self.params["actor"], obs)
+            return int(np.asarray(jnp.argmax(logits, -1))[0])
+        self._rng, key = jax.random.split(self._rng)
+        a, _, det = _squashed_sample(self.params["actor"], obs, key, self.action_dim)
+        return np.asarray(a if explore else det)[0] * self._act_scale + self._act_offset
+
+    def save_checkpoint(self):
+        import jax
+
+        from ray_tpu.air.checkpoint import Checkpoint
+
+        return Checkpoint.from_dict({
+            "params": jax.tree_util.tree_map(np.asarray, self.params),
+            "target": jax.tree_util.tree_map(np.asarray, self.target),
+            "timesteps": self._timesteps_total,
+        })
+
+    def load_checkpoint(self, checkpoint) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        data = checkpoint.to_dict()
+        self.params = jax.tree_util.tree_map(jnp.asarray, data["params"])
+        self.target = jax.tree_util.tree_map(jnp.asarray, data["target"])
+        self._timesteps_total = data.get("timesteps", 0)
+
+    def cleanup(self) -> None:
+        pass

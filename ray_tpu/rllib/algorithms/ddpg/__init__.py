@@ -1,0 +1,1 @@
+from ray_tpu.rllib.algorithms.ddpg.ddpg import DDPG, TD3, DDPGConfig, TD3Config  # noqa: F401

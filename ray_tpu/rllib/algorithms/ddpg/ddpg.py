@@ -1,0 +1,278 @@
+"""DDPG / TD3 — deterministic policy gradient for continuous control.
+
+Reference: rllib/algorithms/ddpg/ (ddpg.py, ddpg_torch_policy.py) and
+rllib/algorithms/td3/td3.py (TD3 = DDPG with twin critics, delayed policy
+updates, and target-policy smoothing). One jitted step updates critics and
+(on delayed steps) the actor, plus Polyak-averaged targets — the TD3 switches
+are static jit arguments so each variant compiles to its own XLA program.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.sac.sac import _mlp_apply, _mlp_params, _true_transition
+from ray_tpu.rllib.env.vector_env import VectorEnv
+from ray_tpu.rllib.policy.sample_batch import (
+    ACTIONS,
+    DONES,
+    NEXT_OBS,
+    OBS,
+    REWARDS,
+    SampleBatch,
+)
+from ray_tpu.rllib.utils.replay_buffers import ReplayBuffer
+
+
+def init_ddpg_params(rng, obs_dim, action_dim, hiddens, twin_q):
+    import jax
+
+    ka, k1, k2 = jax.random.split(rng, 3)
+    params = {
+        "actor": _mlp_params(ka, obs_dim, hiddens, action_dim),
+        "q1": _mlp_params(k1, obs_dim + action_dim, hiddens, 1),
+    }
+    if twin_q:
+        params["q2"] = _mlp_params(k2, obs_dim + action_dim, hiddens, 1)
+    return params
+
+
+class DDPGConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or DDPG)
+        self.lr = 1e-3
+        self.num_rollout_workers = 0
+        self.train_batch_size = 256
+        self.replay_buffer_capacity = 100_000
+        self.learning_starts = 1500
+        self.tau = 5e-3
+        self.rollout_steps_per_iter = 1000
+        self.train_intensity = 1
+        self.exploration_noise = 0.1  # gaussian action noise (in [-1,1] units)
+        self.model_hiddens = (256, 256)
+        # TD3 switches (reference: td3.py flips these on DDPGConfig):
+        self.twin_q = False
+        self.policy_delay = 1
+        self.smooth_target_policy = False
+        self.target_noise = 0.2
+        self.target_noise_clip = 0.5
+
+    def training(self, *, replay_buffer_capacity=None, learning_starts=None, tau=None,
+                 rollout_steps_per_iter=None, train_intensity=None, exploration_noise=None,
+                 twin_q=None, policy_delay=None, smooth_target_policy=None,
+                 target_noise=None, target_noise_clip=None, **kwargs) -> "DDPGConfig":
+        super().training(**kwargs)
+        for name, val in (
+            ("replay_buffer_capacity", replay_buffer_capacity),
+            ("learning_starts", learning_starts),
+            ("tau", tau),
+            ("rollout_steps_per_iter", rollout_steps_per_iter),
+            ("train_intensity", train_intensity),
+            ("exploration_noise", exploration_noise),
+            ("twin_q", twin_q),
+            ("policy_delay", policy_delay),
+            ("smooth_target_policy", smooth_target_policy),
+            ("target_noise", target_noise),
+            ("target_noise_clip", target_noise_clip),
+        ):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+class TD3Config(DDPGConfig):
+    """TD3 defaults (reference: td3.py — twin critics, delayed actor,
+    smoothed targets)."""
+
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or TD3)
+        self.twin_q = True
+        self.policy_delay = 2
+        self.smooth_target_policy = True
+
+
+class DDPG(Algorithm):
+    @classmethod
+    def get_default_config(cls) -> DDPGConfig:
+        return DDPGConfig(cls)
+
+    def setup(self, config: dict) -> None:
+        import gymnasium as gym
+        import jax
+        import optax
+
+        self.cleanup()  # re-setup: close any previous env
+        cfg: DDPGConfig = self._algo_config
+        probe = gym.make(cfg.env) if isinstance(cfg.env, str) else cfg.env(dict(cfg.env_config))
+        assert not isinstance(probe.action_space, gym.spaces.Discrete), "DDPG/TD3 need continuous actions"
+        self.obs_dim = int(np.prod(probe.observation_space.shape))
+        self.action_dim = int(np.prod(probe.action_space.shape))
+        low = np.asarray(probe.action_space.low, np.float32)
+        high = np.asarray(probe.action_space.high, np.float32)
+        self._act_scale = (high - low) / 2.0
+        self._act_offset = (high + low) / 2.0
+        probe.close()
+        self.env = VectorEnv(cfg.env, max(cfg.num_envs_per_worker, 1), cfg.env_config, 0, seed=cfg.seed)
+        self.params = init_ddpg_params(
+            jax.random.PRNGKey(cfg.seed), self.obs_dim, self.action_dim, cfg.model_hiddens, cfg.twin_q
+        )
+        self.target = jax.tree_util.tree_map(lambda x: x, self.params)
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.params)
+        self.buffer = ReplayBuffer(cfg.replay_buffer_capacity, seed=cfg.seed)
+        self._rng = jax.random.PRNGKey(cfg.seed + 1)
+        self._np_rng = np.random.default_rng(cfg.seed)
+        self._timesteps_total = 0
+        self._updates = 0
+        self._episode_reward_window: list = []
+        self._build_fns(cfg)
+
+    def _build_fns(self, cfg: DDPGConfig):
+        import jax
+        import jax.numpy as jnp
+
+        gamma, tau = cfg.gamma, cfg.tau
+        twin_q, smooth = cfg.twin_q, cfg.smooth_target_policy
+        noise, noise_clip = cfg.target_noise, cfg.target_noise_clip
+        tx = self.tx
+
+        def q_val(q, obs, a):
+            return _mlp_apply(q, jnp.concatenate([obs, a], -1))[:, 0]
+
+        def loss_fn(params, target, batch, key, update_actor):
+            obs, next_obs = batch[OBS], batch[NEXT_OBS]
+            next_a = jnp.tanh(_mlp_apply(target["actor"], next_obs))
+            if smooth:
+                eps = jnp.clip(jax.random.normal(key, next_a.shape) * noise, -noise_clip, noise_clip)
+                next_a = jnp.clip(next_a + eps, -1.0, 1.0)
+            tq = q_val(target["q1"], next_obs, next_a)
+            if twin_q:
+                tq = jnp.minimum(tq, q_val(target["q2"], next_obs, next_a))
+            td_target = jax.lax.stop_gradient(
+                batch[REWARDS] + gamma * (1 - batch[DONES]) * tq
+            )
+            q1 = q_val(params["q1"], obs, batch[ACTIONS])
+            critic_loss = jnp.mean((q1 - td_target) ** 2)
+            if twin_q:
+                q2 = q_val(params["q2"], obs, batch[ACTIONS])
+                critic_loss = critic_loss + jnp.mean((q2 - td_target) ** 2)
+            a_pi = jnp.tanh(_mlp_apply(params["actor"], obs))
+            # Actor ascends Q1; frozen critics via stop_gradient on their
+            # params is unnecessary — grads to q1 params from actor_loss are
+            # masked by update_actor scaling into the same total (delayed
+            # updates zero the actor term entirely).
+            actor_loss = -jnp.mean(
+                q_val(jax.lax.stop_gradient(params["q1"]), obs, a_pi)
+            )
+            total = critic_loss + update_actor * actor_loss
+            return total, {"critic_loss": critic_loss, "actor_loss": actor_loss, "mean_q": q1.mean()}
+
+        def train_step(params, target, opt_state, batch, key, update_actor):
+            (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, target, batch, key, update_actor
+            )
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+            target = jax.tree_util.tree_map(
+                lambda t, p: (1 - tau) * t + tau * p, target, params
+            )
+            return params, target, opt_state, metrics
+
+        self._train_step = jax.jit(train_step)
+        self._policy = jax.jit(lambda p, o: jnp.tanh(_mlp_apply(p["actor"], o)))
+
+    def _env_action(self, a):
+        return np.asarray(a) * self._act_scale + self._act_offset
+
+    def training_step(self) -> dict:
+        import jax
+        import jax.numpy as jnp
+
+        cfg: DDPGConfig = self._algo_config
+        metrics: dict = {}
+        for _ in range(cfg.rollout_steps_per_iter):
+            obs = self.env.current_obs().astype(np.float32).reshape(self.env.num_envs, -1)
+            if self._timesteps_total < cfg.learning_starts:
+                a = self._np_rng.uniform(-1, 1, (self.env.num_envs, self.action_dim)).astype(np.float32)
+            else:
+                a = np.asarray(self._policy(self.params, jnp.asarray(obs)))
+                a = np.clip(a + self._np_rng.normal(0, cfg.exploration_noise, a.shape), -1, 1).astype(np.float32)
+            _, rewards, dones, infos = self.env.step(self._env_action(a))
+            next_obs, terminateds = _true_transition(self.env, dones, infos)
+            self.buffer.add(SampleBatch({
+                OBS: obs, ACTIONS: a, REWARDS: rewards,
+                DONES: terminateds, NEXT_OBS: next_obs,
+            }))
+            self._timesteps_total += self.env.num_envs
+            if self._timesteps_total >= cfg.learning_starts:
+                for _ in range(cfg.train_intensity):
+                    batch = self.buffer.sample(cfg.train_batch_size)
+                    jb = {k: jnp.asarray(v) for k, v in batch.items()}
+                    self._rng, key = jax.random.split(self._rng)
+                    self._updates += 1
+                    update_actor = jnp.asarray(
+                        1.0 if self._updates % max(cfg.policy_delay, 1) == 0 else 0.0, jnp.float32
+                    )
+                    self.params, self.target, self.opt_state, m = self._train_step(
+                        self.params, self.target, self.opt_state, jb, key, update_actor
+                    )
+                    metrics = {k: float(v) for k, v in m.items()}
+        stats_r, _ = self.env.pop_episode_stats()
+        self._episode_reward_window += stats_r
+        self._episode_reward_window = self._episode_reward_window[-100:]
+        return metrics
+
+    def step(self) -> dict:
+        import time
+
+        t0 = time.time()
+        result = self.training_step()
+        result["episode_reward_mean"] = (
+            float(np.mean(self._episode_reward_window)) if self._episode_reward_window else float("nan")
+        )
+        result["timesteps_total"] = self._timesteps_total
+        result["time_this_iter_s"] = time.time() - t0
+        return result
+
+    def compute_single_action(self, obs, explore: bool = False):
+        import jax.numpy as jnp
+
+        obs = np.asarray(obs, np.float32).reshape(1, -1)
+        a = np.asarray(self._policy(self.params, jnp.asarray(obs)))[0]
+        if explore:
+            a = np.clip(a + self._np_rng.normal(0, self._algo_config.exploration_noise, a.shape), -1, 1)
+        return self._env_action(a)
+
+    def save_checkpoint(self):
+        import jax
+
+        from ray_tpu.air.checkpoint import Checkpoint
+
+        return Checkpoint.from_dict({
+            "params": jax.tree_util.tree_map(np.asarray, self.params),
+            "target": jax.tree_util.tree_map(np.asarray, self.target),
+            "timesteps": self._timesteps_total,
+        })
+
+    def load_checkpoint(self, checkpoint) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        data = checkpoint.to_dict()
+        self.params = jax.tree_util.tree_map(jnp.asarray, data["params"])
+        self.target = jax.tree_util.tree_map(jnp.asarray, data["target"])
+        self._timesteps_total = data.get("timesteps", 0)
+
+    def cleanup(self) -> None:
+        env = getattr(self, "env", None)
+        if env is not None:
+            env.close()
+
+
+class TD3(DDPG):
+    @classmethod
+    def get_default_config(cls) -> TD3Config:
+        return TD3Config(cls)

@@ -1,0 +1,266 @@
+"""ES — evolution strategies (OpenAI-ES style).
+
+Reference: rllib/algorithms/es/ (es.py, es_tf_policy.py, optimizers.py,
+utils.py): black-box optimization — worker actors evaluate antithetic
+parameter perturbations for whole episodes; the driver combines
+centered-rank-weighted noise into a gradient estimate. The shared-noise-table
+trick of the reference becomes shared *seeds*: workers regenerate each
+perturbation from its integer seed, so only (seed, return) pairs cross the
+object store, never parameter-sized noise.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.core.rl_module import RLModuleSpec
+
+
+def _flatten(tree):
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    flat = np.concatenate([np.asarray(l).ravel() for l in leaves])
+    shapes = [np.asarray(l).shape for l in leaves]
+    return flat.astype(np.float32), treedef, shapes
+
+
+def _unflatten(flat, treedef, shapes):
+    import jax
+
+    leaves, off = [], 0
+    for s in shapes:
+        n = int(np.prod(s)) if s else 1
+        leaves.append(np.asarray(flat[off : off + n]).reshape(s))
+        off += n
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _centered_ranks(x: np.ndarray) -> np.ndarray:
+    """Map returns to centered ranks in [-0.5, 0.5] (reference: utils.py)."""
+    ranks = np.empty(len(x), dtype=np.float32)
+    ranks[x.argsort()] = np.arange(len(x), dtype=np.float32)
+    return ranks / (len(x) - 1) - 0.5
+
+
+class _ESWorker:
+    """Evaluates perturbed policies for whole episodes on CPU."""
+
+    def __init__(self, env_spec, spec: RLModuleSpec, env_config, shapes, seed):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from ray_tpu.rllib.core import rl_module
+        from ray_tpu.rllib.env.vector_env import EnvContext, _make_env
+
+        self.env = _make_env(env_spec, EnvContext(env_config or {}, 0, 0))
+        self.spec = spec
+        self.shapes = shapes
+        # Rebuild the treedef worker-side from a params template (treedefs
+        # don't pickle portably across processes).
+        params = rl_module.init_params(jax.random.PRNGKey(0), spec)
+        _, self.treedef, _ = _flatten(params)
+        self._forward = jax.jit(lambda p, o: rl_module.forward(p, o, spec)[0])
+        self._np_rng = np.random.default_rng(seed)
+
+    def _episode_return(self, flat, episode_horizon: int) -> float:
+        import jax.numpy as jnp
+
+        params = _unflatten(flat, self.treedef, self.shapes)
+        obs, _ = self.env.reset(seed=int(self._np_rng.integers(1 << 31)))
+        total, steps = 0.0, 0
+        while steps < episode_horizon:
+            out = np.asarray(self._forward(params, jnp.asarray(np.asarray(obs, np.float32).reshape(1, -1))))[0]
+            action = int(out.argmax()) if self.spec.discrete else np.tanh(out)
+            obs, r, terminated, truncated, _ = self.env.step(action)
+            total += float(r)
+            steps += 1
+            if terminated or truncated:
+                break
+        return total
+
+    def rollout(self, flat_params: np.ndarray, seeds: list, sigma: float, episode_horizon: int):
+        """Antithetic evaluation: for each seed return (R+, R-)."""
+        out = []
+        for s in seeds:
+            noise = np.random.default_rng(int(s)).standard_normal(len(flat_params)).astype(np.float32)
+            r_pos = self._episode_return(flat_params + sigma * noise, episode_horizon)
+            r_neg = self._episode_return(flat_params - sigma * noise, episode_horizon)
+            out.append((r_pos, r_neg))
+        return out
+
+    def evaluate(self, flat_params: np.ndarray, episodes: int, episode_horizon: int) -> list:
+        return [self._episode_return(flat_params, episode_horizon) for _ in range(episodes)]
+
+    def stop(self):
+        try:
+            self.env.close()
+        except Exception:
+            pass
+        return True
+
+
+class ESConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or ES)
+        self.num_rollout_workers = 4
+        self.episodes_per_batch = 40  # perturbation pairs per iteration
+        self.noise_stdev = 0.02
+        self.stepsize = 0.01
+        self.l2_coeff = 0.005
+        self.episode_horizon = 1000
+        self.eval_episodes = 5
+
+    def training(self, *, episodes_per_batch=None, noise_stdev=None, stepsize=None,
+                 l2_coeff=None, episode_horizon=None, eval_episodes=None, **kwargs) -> "ESConfig":
+        super().training(**kwargs)
+        for name, val in (
+            ("episodes_per_batch", episodes_per_batch),
+            ("noise_stdev", noise_stdev),
+            ("stepsize", stepsize),
+            ("l2_coeff", l2_coeff),
+            ("episode_horizon", episode_horizon),
+            ("eval_episodes", eval_episodes),
+        ):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+class ES(Algorithm):
+    @classmethod
+    def get_default_config(cls) -> ESConfig:
+        return ESConfig(cls)
+
+    def setup(self, config: dict) -> None:
+        import gymnasium as gym
+        import jax
+
+        # Re-setup must not orphan the previous worker gang (same guard as
+        # base Algorithm.setup — leaked actors hold CPU reservations).
+        self.cleanup()
+        cfg: ESConfig = self._algo_config
+        probe = gym.make(cfg.env) if isinstance(cfg.env, str) else cfg.env(dict(cfg.env_config))
+        self.module_spec = RLModuleSpec.from_spaces(probe.observation_space, probe.action_space, cfg.model_hiddens)
+        probe.close()
+        from ray_tpu.rllib.core import rl_module
+
+        params = rl_module.init_params(jax.random.PRNGKey(cfg.seed), self.module_spec)
+        self.flat, self._treedef, self._shapes = _flatten(params)
+        # Adam state for the ES gradient estimate (reference: optimizers.py).
+        self._m = np.zeros_like(self.flat)
+        self._v = np.zeros_like(self.flat)
+        self._t = 0
+        self._np_rng = np.random.default_rng(cfg.seed)
+        make = ray_tpu.remote(num_cpus=1)(_ESWorker).remote
+        self._workers = [
+            make(cfg.env, self.module_spec, cfg.env_config, self._shapes, cfg.seed + i)
+            for i in range(max(cfg.num_rollout_workers, 1))
+        ]
+        self._timesteps_total = 0
+        self._episode_reward_window: list = []
+
+    def training_step(self) -> dict:
+        cfg: ESConfig = self._algo_config
+        n_pairs = cfg.episodes_per_batch
+        seeds = self._np_rng.integers(0, 1 << 31, n_pairs)
+        per_worker = np.array_split(seeds, len(self._workers))
+        refs = [
+            w.rollout.remote(self.flat, list(map(int, chunk)), cfg.noise_stdev, cfg.episode_horizon)
+            for w, chunk in zip(self._workers, per_worker)
+            if len(chunk)
+        ]
+        pairs: list = []
+        used_seeds: list = []
+        for ref, chunk in zip(refs, [c for c in per_worker if len(c)]):
+            try:
+                res = ray_tpu.get(ref, timeout=600)
+                pairs += res
+                used_seeds += list(chunk)
+            except Exception:
+                pass  # lost worker: proceed with the survivors' episodes
+        if not pairs:
+            return {"es_update_skipped": 1.0}
+        returns = np.asarray(pairs, np.float32)  # [n, 2] = (R+, R-)
+        # Centered-rank transform over ALL evaluations, antithetic pairing.
+        ranks = _centered_ranks(returns.ravel()).reshape(returns.shape)
+        weights = ranks[:, 0] - ranks[:, 1]
+        grad = np.zeros_like(self.flat)
+        for w, s in zip(weights, used_seeds):
+            noise = np.random.default_rng(int(s)).standard_normal(len(self.flat)).astype(np.float32)
+            grad += w * noise
+        grad /= len(weights) * cfg.noise_stdev
+        grad -= cfg.l2_coeff * self.flat  # weight decay
+        # Adam ascent.
+        self._t += 1
+        b1, b2, eps = 0.9, 0.999, 1e-8
+        self._m = b1 * self._m + (1 - b1) * grad
+        self._v = b2 * self._v + (1 - b2) * grad * grad
+        mhat = self._m / (1 - b1**self._t)
+        vhat = self._v / (1 - b2**self._t)
+        self.flat = self.flat + cfg.stepsize * mhat / (np.sqrt(vhat) + eps)
+        self._timesteps_total += int(returns.size) * cfg.episode_horizon // 10  # approx
+        # Evaluate the unperturbed policy for the reported reward.
+        eval_refs = [self._workers[0].evaluate.remote(self.flat, cfg.eval_episodes, cfg.episode_horizon)]
+        try:
+            rewards = ray_tpu.get(eval_refs[0], timeout=600)
+        except Exception:
+            rewards = []
+        self._episode_reward_window += rewards
+        self._episode_reward_window = self._episode_reward_window[-100:]
+        return {
+            "episode_reward_mean": float(np.mean(rewards)) if rewards else float("nan"),
+            "grad_norm": float(np.linalg.norm(grad)),
+            "perturbations_this_iter": float(len(weights) * 2),
+        }
+
+    def step(self) -> dict:
+        import time
+
+        t0 = time.time()
+        result = self.training_step()
+        result.setdefault(
+            "episode_reward_mean",
+            float(np.mean(self._episode_reward_window)) if self._episode_reward_window else float("nan"),
+        )
+        result["timesteps_total"] = self._timesteps_total
+        result["time_this_iter_s"] = time.time() - t0
+        return result
+
+    def compute_single_action(self, obs, explore: bool = False):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib.core import rl_module
+
+        params = _unflatten(self.flat, self._treedef, self._shapes)
+        out = np.asarray(
+            rl_module.forward(
+                jax.tree_util.tree_map(jnp.asarray, params),
+                jnp.asarray(np.asarray(obs, np.float32).reshape(1, -1)),
+                self.module_spec,
+            )[0]
+        )[0]
+        return int(out.argmax()) if self.module_spec.discrete else np.tanh(out)
+
+    def save_checkpoint(self):
+        from ray_tpu.air.checkpoint import Checkpoint
+
+        return Checkpoint.from_dict({"flat": self.flat, "timesteps": self._timesteps_total})
+
+    def load_checkpoint(self, checkpoint) -> None:
+        data = checkpoint.to_dict()
+        self.flat = np.asarray(data["flat"], np.float32)
+        self._timesteps_total = data.get("timesteps", 0)
+
+    def cleanup(self) -> None:
+        for w in getattr(self, "_workers", []):
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self._workers = []

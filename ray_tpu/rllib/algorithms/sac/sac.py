@@ -1,0 +1,361 @@
+"""SAC — soft actor-critic (continuous and discrete action spaces).
+
+Reference: rllib/algorithms/sac/ (sac.py, sac_torch_policy.py,
+sac_torch_model.py): off-policy replay, twin Q networks with Polyak-averaged
+targets, tanh-squashed gaussian policy (continuous) or categorical policy with
+exact expectations (discrete), and automatic entropy-temperature tuning.
+TPU-native design: actor, twin critics, targets, and the alpha update are one
+pytree stepped by a single jitted function — the three optimizer updates fuse
+into one XLA program instead of three sequential torch backward passes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env.vector_env import VectorEnv
+from ray_tpu.rllib.policy.sample_batch import (
+    ACTIONS,
+    DONES,
+    NEXT_OBS,
+    OBS,
+    REWARDS,
+    SampleBatch,
+)
+from ray_tpu.rllib.utils.replay_buffers import ReplayBuffer
+
+LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
+
+
+def _true_transition(env, dones, infos):
+    """(next_obs, terminated-mask) for replay: at episode boundaries the true
+    s' is the PRE-reset observation, and only real terminations (not
+    time-limit truncations) zero the TD bootstrap."""
+    next_obs = env.current_obs().astype(np.float32).reshape(env.num_envs, -1)
+    terminateds = np.zeros(env.num_envs, np.float32)
+    for i, (d, info) in enumerate(zip(dones, infos)):
+        if d:
+            next_obs[i] = np.asarray(info["final_observation"], np.float32).reshape(-1)
+            terminateds[i] = float(info.get("terminated", True))
+    return next_obs, terminateds
+
+
+def _dense(key, din, dout):
+    import jax
+    import jax.numpy as jnp
+
+    w = jax.nn.initializers.glorot_uniform()(key, (din, dout), jnp.float32)
+    return {"w": w, "b": jnp.zeros((dout,), jnp.float32)}
+
+
+def _mlp_params(key, din, hiddens, dout):
+    import jax
+
+    keys = jax.random.split(key, len(hiddens) + 1)
+    layers = []
+    for i, h in enumerate(hiddens):
+        layers.append(_dense(keys[i], din, h))
+        din = h
+    layers.append(_dense(keys[-1], din, dout))
+    return layers
+
+
+def _mlp_apply(layers, x):
+    import jax
+
+    for layer in layers[:-1]:
+        x = jax.nn.relu(x @ layer["w"] + layer["b"])
+    return x @ layers[-1]["w"] + layers[-1]["b"]
+
+
+def init_sac_params(rng, obs_dim, action_dim, discrete, hiddens):
+    import jax
+
+    ka, k1, k2 = jax.random.split(rng, 3)
+    if discrete:
+        actor = _mlp_params(ka, obs_dim, hiddens, action_dim)
+        q1 = _mlp_params(k1, obs_dim, hiddens, action_dim)
+        q2 = _mlp_params(k2, obs_dim, hiddens, action_dim)
+    else:
+        actor = _mlp_params(ka, obs_dim, hiddens, 2 * action_dim)
+        q1 = _mlp_params(k1, obs_dim + action_dim, hiddens, 1)
+        q2 = _mlp_params(k2, obs_dim + action_dim, hiddens, 1)
+    import jax.numpy as jnp
+
+    return {"actor": actor, "q1": q1, "q2": q2, "log_alpha": jnp.zeros(())}
+
+
+def _squashed_sample(actor, obs, key, action_dim):
+    """tanh-squashed gaussian: sample, logp with the tanh jacobian term."""
+    import jax
+    import jax.numpy as jnp
+
+    out = _mlp_apply(actor, obs)
+    mean, log_std = out[:, :action_dim], out[:, action_dim:]
+    log_std = jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+    std = jnp.exp(log_std)
+    u = mean + std * jax.random.normal(key, mean.shape)
+    a = jnp.tanh(u)
+    logp = -0.5 * jnp.sum(((u - mean) / std) ** 2 + 2 * log_std + jnp.log(2 * jnp.pi), axis=-1)
+    logp -= jnp.sum(2.0 * (jnp.log(2.0) - u - jax.nn.softplus(-2.0 * u)), axis=-1)
+    return a, logp, jnp.tanh(mean)
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or SAC)
+        self.lr = 3e-4
+        self.num_rollout_workers = 0  # off-policy: collect in-process
+        self.train_batch_size = 256
+        self.replay_buffer_capacity = 100_000
+        self.learning_starts = 1500
+        self.tau = 5e-3
+        self.initial_alpha = 1.0
+        self.target_entropy: Optional[float] = None  # None -> auto
+        self.rollout_steps_per_iter = 1000
+        self.train_intensity = 1  # gradient steps per env step
+        self.model_hiddens = (256, 256)
+
+    def training(self, *, replay_buffer_capacity=None, learning_starts=None,
+                 tau=None, initial_alpha=None, target_entropy=None,
+                 rollout_steps_per_iter=None, train_intensity=None, **kwargs) -> "SACConfig":
+        super().training(**kwargs)
+        for name, val in (
+            ("replay_buffer_capacity", replay_buffer_capacity),
+            ("learning_starts", learning_starts),
+            ("tau", tau),
+            ("initial_alpha", initial_alpha),
+            ("target_entropy", target_entropy),
+            ("rollout_steps_per_iter", rollout_steps_per_iter),
+            ("train_intensity", train_intensity),
+        ):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+class SAC(Algorithm):
+    @classmethod
+    def get_default_config(cls) -> SACConfig:
+        return SACConfig(cls)
+
+    def setup(self, config: dict) -> None:
+        import gymnasium as gym
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.cleanup()  # re-setup: close any previous env
+        cfg: SACConfig = self._algo_config
+        probe = gym.make(cfg.env) if isinstance(cfg.env, str) else cfg.env(dict(cfg.env_config))
+        self.discrete = isinstance(probe.action_space, gym.spaces.Discrete)
+        self.obs_dim = int(np.prod(probe.observation_space.shape))
+        if self.discrete:
+            self.action_dim = int(probe.action_space.n)
+            self._act_scale = self._act_offset = None
+        else:
+            self.action_dim = int(np.prod(probe.action_space.shape))
+            low = np.asarray(probe.action_space.low, np.float32)
+            high = np.asarray(probe.action_space.high, np.float32)
+            self._act_scale = (high - low) / 2.0
+            self._act_offset = (high + low) / 2.0
+        probe.close()
+        self.env = VectorEnv(cfg.env, max(cfg.num_envs_per_worker, 1), cfg.env_config, 0, seed=cfg.seed)
+        self.params = init_sac_params(
+            jax.random.PRNGKey(cfg.seed), self.obs_dim, self.action_dim, self.discrete, cfg.model_hiddens
+        )
+        self.params["log_alpha"] = jnp.log(jnp.asarray(cfg.initial_alpha, jnp.float32))
+        self.target = {"q1": self.params["q1"], "q2": self.params["q2"]}
+        if cfg.target_entropy is not None:
+            self.target_entropy = float(cfg.target_entropy)
+        elif self.discrete:
+            self.target_entropy = 0.98 * float(np.log(self.action_dim))
+        else:
+            self.target_entropy = -float(self.action_dim)
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.params)
+        self.buffer = ReplayBuffer(cfg.replay_buffer_capacity, seed=cfg.seed)
+        self._rng = jax.random.PRNGKey(cfg.seed + 1)
+        self._np_rng = np.random.default_rng(cfg.seed)
+        self._timesteps_total = 0
+        self._episode_reward_window: list = []
+        self._build_fns(cfg)
+
+    def _build_fns(self, cfg: SACConfig):
+        import jax
+        import jax.numpy as jnp
+
+        discrete, action_dim = self.discrete, self.action_dim
+        gamma, tau, target_entropy = cfg.gamma, cfg.tau, self.target_entropy
+        tx = self.tx
+
+        def loss_fn(params, target, batch, key):
+            obs, next_obs = batch[OBS], batch[NEXT_OBS]
+            rewards, dones = batch[REWARDS], batch[DONES]
+            alpha = jax.lax.stop_gradient(jnp.exp(params["log_alpha"]))
+            if discrete:
+                logits = _mlp_apply(params["actor"], obs)
+                logpi = jax.nn.log_softmax(logits)
+                pi = jnp.exp(logpi)
+                next_logits = _mlp_apply(params["actor"], next_obs)
+                next_logpi = jax.nn.log_softmax(next_logits)
+                next_pi = jnp.exp(next_logpi)
+                tq = jnp.minimum(_mlp_apply(target["q1"], next_obs), _mlp_apply(target["q2"], next_obs))
+                next_v = jnp.sum(next_pi * (tq - alpha * next_logpi), axis=-1)
+                td_target = jax.lax.stop_gradient(rewards + gamma * (1 - dones) * next_v)
+                idx = batch[ACTIONS].astype(jnp.int32)
+                q1 = _mlp_apply(params["q1"], obs)[jnp.arange(obs.shape[0]), idx]
+                q2 = _mlp_apply(params["q2"], obs)[jnp.arange(obs.shape[0]), idx]
+                critic_loss = 0.5 * (jnp.mean((q1 - td_target) ** 2) + jnp.mean((q2 - td_target) ** 2))
+                q_min = jax.lax.stop_gradient(
+                    jnp.minimum(_mlp_apply(params["q1"], obs), _mlp_apply(params["q2"], obs))
+                )
+                actor_loss = jnp.mean(jnp.sum(pi * (alpha * logpi - q_min), axis=-1))
+                entropy = -jnp.sum(pi * logpi, axis=-1).mean()
+                alpha_loss = params["log_alpha"] * jax.lax.stop_gradient(entropy - target_entropy)
+            else:
+                k1, k2 = jax.random.split(key)
+                next_a, next_logp, _ = _squashed_sample(params["actor"], next_obs, k1, action_dim)
+                tq1 = _mlp_apply(target["q1"], jnp.concatenate([next_obs, next_a], -1))[:, 0]
+                tq2 = _mlp_apply(target["q2"], jnp.concatenate([next_obs, next_a], -1))[:, 0]
+                next_v = jnp.minimum(tq1, tq2) - alpha * next_logp
+                td_target = jax.lax.stop_gradient(rewards + gamma * (1 - dones) * next_v)
+                sa = jnp.concatenate([obs, batch[ACTIONS]], -1)
+                q1 = _mlp_apply(params["q1"], sa)[:, 0]
+                q2 = _mlp_apply(params["q2"], sa)[:, 0]
+                critic_loss = 0.5 * (jnp.mean((q1 - td_target) ** 2) + jnp.mean((q2 - td_target) ** 2))
+                a, logp, _ = _squashed_sample(params["actor"], obs, k2, action_dim)
+                q_pi = jnp.minimum(
+                    _mlp_apply(params["q1"], jnp.concatenate([obs, a], -1))[:, 0],
+                    _mlp_apply(params["q2"], jnp.concatenate([obs, a], -1))[:, 0],
+                )
+                actor_loss = jnp.mean(alpha * logp - q_pi)
+                entropy = -logp.mean()
+                alpha_loss = params["log_alpha"] * jax.lax.stop_gradient(entropy - target_entropy)
+            total = critic_loss + actor_loss + alpha_loss
+            return total, {
+                "critic_loss": critic_loss,
+                "actor_loss": actor_loss,
+                "alpha": alpha,
+                "entropy": entropy,
+                "mean_q": q1.mean(),
+            }
+
+        def train_step(params, target, opt_state, batch, key):
+            (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, target, batch, key)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+            target = jax.tree_util.tree_map(
+                lambda t, p: (1 - tau) * t + tau * p,
+                target,
+                {"q1": params["q1"], "q2": params["q2"]},
+            )
+            return params, target, opt_state, metrics
+
+        self._train_step = jax.jit(train_step)
+
+        def act(params, obs, key, explore):
+            if discrete:
+                logits = _mlp_apply(params["actor"], obs)
+                return jax.lax.cond(
+                    explore,
+                    lambda: jax.random.categorical(key, logits, axis=-1),
+                    lambda: jnp.argmax(logits, axis=-1),
+                )
+            a, _, det = _squashed_sample(params["actor"], obs, key, action_dim)
+            return jnp.where(explore, a, det)
+
+        self._act = jax.jit(act, static_argnames=()) if discrete else jax.jit(act)
+
+    def _env_action(self, a):
+        if self.discrete:
+            return np.asarray(a)
+        return np.asarray(a) * self._act_scale + self._act_offset
+
+    def training_step(self) -> dict:
+        import jax
+        import jax.numpy as jnp
+
+        cfg: SACConfig = self._algo_config
+        metrics: dict = {}
+        for _ in range(cfg.rollout_steps_per_iter):
+            obs = self.env.current_obs().astype(np.float32).reshape(self.env.num_envs, -1)
+            if self._timesteps_total < cfg.learning_starts:
+                if self.discrete:
+                    a = self._np_rng.integers(0, self.action_dim, self.env.num_envs)
+                else:
+                    a = self._np_rng.uniform(-1, 1, (self.env.num_envs, self.action_dim)).astype(np.float32)
+            else:
+                self._rng, key = jax.random.split(self._rng)
+                a = np.asarray(self._act(self.params, jnp.asarray(obs), key, True))
+            _, rewards, dones, infos = self.env.step(self._env_action(a))
+            next_obs, terminateds = _true_transition(self.env, dones, infos)
+            self.buffer.add(SampleBatch({
+                OBS: obs, ACTIONS: a, REWARDS: rewards,
+                DONES: terminateds, NEXT_OBS: next_obs,
+            }))
+            self._timesteps_total += self.env.num_envs
+            if self._timesteps_total >= cfg.learning_starts:
+                for _ in range(cfg.train_intensity):
+                    batch = self.buffer.sample(cfg.train_batch_size)
+                    jb = {k: jnp.asarray(v) for k, v in batch.items()}
+                    self._rng, key = jax.random.split(self._rng)
+                    self.params, self.target, self.opt_state, m = self._train_step(
+                        self.params, self.target, self.opt_state, jb, key
+                    )
+                    metrics = {k: float(v) for k, v in m.items()}
+        stats_r, _ = self.env.pop_episode_stats()
+        self._episode_reward_window += stats_r
+        self._episode_reward_window = self._episode_reward_window[-100:]
+        return metrics
+
+    def step(self) -> dict:
+        import time
+
+        t0 = time.time()
+        result = self.training_step()
+        result["episode_reward_mean"] = (
+            float(np.mean(self._episode_reward_window)) if self._episode_reward_window else float("nan")
+        )
+        result["timesteps_total"] = self._timesteps_total
+        result["time_this_iter_s"] = time.time() - t0
+        return result
+
+    def compute_single_action(self, obs, explore: bool = False):
+        import jax
+        import jax.numpy as jnp
+
+        obs = np.asarray(obs, np.float32).reshape(1, -1)
+        self._rng, key = jax.random.split(self._rng)
+        a = np.asarray(self._act(self.params, jnp.asarray(obs), key, explore))[0]
+        if self.discrete:
+            return int(a)
+        return self._env_action(a)
+
+    def save_checkpoint(self):
+        import jax
+
+        from ray_tpu.air.checkpoint import Checkpoint
+
+        return Checkpoint.from_dict({
+            "params": jax.tree_util.tree_map(np.asarray, self.params),
+            "target": jax.tree_util.tree_map(np.asarray, self.target),
+            "timesteps": self._timesteps_total,
+        })
+
+    def load_checkpoint(self, checkpoint) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        data = checkpoint.to_dict()
+        self.params = jax.tree_util.tree_map(jnp.asarray, data["params"])
+        self.target = jax.tree_util.tree_map(jnp.asarray, data["target"])
+        self._timesteps_total = data.get("timesteps", 0)
+
+    def cleanup(self) -> None:
+        env = getattr(self, "env", None)
+        if env is not None:
+            env.close()

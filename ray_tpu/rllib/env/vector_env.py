@@ -74,9 +74,16 @@ class VectorEnv:
         for i, env in enumerate(self.envs):
             o, r, terminated, truncated, info = env.step(np.asarray(actions[i]))
             done = bool(terminated or truncated)
+            # Truncation vs termination matters to off-policy bootstrapping
+            # (a time-limit cut must still bootstrap V/Q(s')), so the split
+            # flags and the pre-reset observation ride in the info dict.
+            info = dict(info)
+            info["terminated"] = bool(terminated)
+            info["truncated"] = bool(truncated)
             self._episode_rewards[i] += float(r)
             self._episode_lens[i] += 1
             if done:
+                info["final_observation"] = o
                 self.completed_rewards.append(float(self._episode_rewards[i]))
                 self.completed_lens.append(int(self._episode_lens[i]))
                 self._episode_rewards[i] = 0.0

@@ -1,0 +1,44 @@
+"""V-trace targets (Espeholt et al. 2018) — shared by IMPALA and APPO.
+
+Reference: rllib/algorithms/impala/vtrace_torch.py (the reference keeps
+per-framework copies; here one jax implementation serves both algorithms):
+    rho_t = min(rho_bar, pi(a|s)/mu(a|s));  c_t = min(c_bar, rho_t)
+    delta_t = rho_t (r_t + gamma V(s_{t+1}) - V(s_t))
+    vs_t = V(s_t) + delta_t + gamma c_t (vs_{t+1} - V(s_{t+1}))
+    pg_adv_t = rho_t (r_t + gamma vs_{t+1} - V(s_t))
+computed with a reverse lax.scan over a flat batch of concatenated rollout
+fragments; episode ends (dones) and fragment cuts reset the recursion, with
+bootstrap values riding in the batch (NEXT_VF_PREDS).
+"""
+
+from __future__ import annotations
+
+
+def vtrace(values_sg, next_values, logp, behavior_logp, rewards, nonterminal, cuts,
+           gamma: float, rho_bar: float, c_bar: float):
+    """Returns (vs, pg_adv, rho); vs carries no gradient into values_sg
+    (pass stop_gradient'ed values), pg_adv is stop-gradient'ed."""
+    import jax
+    import jax.numpy as jnp
+
+    carry_mask = nonterminal * (1.0 - cuts)
+    rho = jnp.minimum(rho_bar, jnp.exp(logp - behavior_logp))
+    rho = jax.lax.stop_gradient(rho)
+    c = jnp.minimum(c_bar, rho)
+    deltas = rho * (rewards + gamma * next_values - values_sg)
+
+    def back(carry, inp):
+        delta_t, c_t, mask = inp
+        acc = delta_t + gamma * c_t * mask * carry
+        return acc, acc
+
+    _, vs_minus_v_rev = jax.lax.scan(
+        back, jnp.zeros((), values_sg.dtype), (deltas[::-1], c[::-1], carry_mask[::-1])
+    )
+    vs = values_sg + vs_minus_v_rev[::-1]
+    # vs_{t+1}: next row's vs inside a fragment; the bootstrap value at a
+    # fragment cut; 0 past a terminal.
+    vs_shift = jnp.concatenate([vs[1:], vs[-1:]])
+    vs_next = jnp.where(cuts > 0, next_values, vs_shift) * nonterminal
+    pg_adv = rho * (rewards + gamma * vs_next - values_sg)
+    return vs, jax.lax.stop_gradient(pg_adv), rho

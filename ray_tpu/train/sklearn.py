@@ -94,6 +94,10 @@ class SklearnTrainer(BaseTrainer):
         from ray_tpu.air import session
 
         result = self._fit_direct()
+        if result.error:
+            # Surface the remote fit failure instead of reporting an empty
+            # successful trial.
+            raise RuntimeError(f"SklearnTrainer fit failed: {result.error}")
         if session.in_session():
             session.report(dict(result.metrics), checkpoint=result.checkpoint)
 

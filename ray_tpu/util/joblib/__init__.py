@@ -34,6 +34,10 @@ def _make_backend():
         supports_sharedmem = False
 
         def effective_n_jobs(self, n_jobs):
+            if n_jobs is not None and n_jobs > 0:
+                # Explicit positive n_jobs: no cluster-state RPC needed
+                # (joblib calls this repeatedly per dispatch).
+                return int(n_jobs)
             import ray_tpu
 
             if not ray_tpu.is_initialized():
@@ -44,10 +48,8 @@ def _make_backend():
                 cpus = 1
             if n_jobs is None or n_jobs == -1:
                 return cpus
-            if n_jobs < 0:
-                # joblib semantics: -2 = all CPUs but one, etc.
-                return max(1, cpus + 1 + int(n_jobs))
-            return max(1, int(n_jobs))
+            # joblib semantics: -2 = all CPUs but one, etc.
+            return max(1, cpus + 1 + int(n_jobs))
 
         def submit(self, func, callback=None):
             import cloudpickle
