@@ -14,6 +14,7 @@ from typing import Optional
 import numpy as np
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.off_policy import OffPolicyTraining, floats
 from ray_tpu.rllib.algorithms.sac.sac import (
     _mlp_apply,
     _squashed_sample,
@@ -60,7 +61,7 @@ class CQLConfig(AlgorithmConfig):
         return self
 
 
-class CQL(Algorithm):
+class CQL(OffPolicyTraining, Algorithm):
     @classmethod
     def get_default_config(cls) -> CQLConfig:
         return CQLConfig(cls)
@@ -104,7 +105,6 @@ class CQL(Algorithm):
         self.opt_state = self.tx.init(self.params)
         self._rng = jax.random.PRNGKey(cfg.seed + 1)
         self._timesteps_total = 0
-        self._episode_reward_window: list = []
         self._build_fns(cfg)
 
     def _build_fns(self, cfg: CQLConfig):
@@ -215,7 +215,7 @@ class CQL(Algorithm):
         import jax.numpy as jnp
 
         cfg: CQLConfig = self._algo_config
-        metrics: dict = {}
+        last_m = None
         for _ in range(cfg.updates_per_iter):
             batch = self.reader.next(cfg.train_batch_size)
             actions = np.asarray(batch[ACTIONS])
@@ -234,21 +234,11 @@ class CQL(Algorithm):
                 NEXT_OBS: jnp.asarray(np.asarray(batch[NEXT_OBS], np.float32)),
             }
             self._rng, key = jax.random.split(self._rng)
-            self.params, self.target, self.opt_state, m = self._train_step(
+            self.params, self.target, self.opt_state, last_m = self._train_step(
                 self.params, self.target, self.opt_state, jb, key
             )
-            metrics = {k: float(v) for k, v in m.items()}
             self._timesteps_total += cfg.train_batch_size
-        return metrics
-
-    def step(self) -> dict:
-        import time
-
-        t0 = time.time()
-        result = self.training_step()
-        result["timesteps_total"] = self._timesteps_total
-        result["time_this_iter_s"] = time.time() - t0
-        return result
+        return floats(last_m) if last_m is not None else {}
 
     def compute_single_action(self, obs, explore: bool = False):
         import jax
@@ -261,26 +251,3 @@ class CQL(Algorithm):
         self._rng, key = jax.random.split(self._rng)
         a, _, det = _squashed_sample(self.params["actor"], obs, key, self.action_dim)
         return np.asarray(a if explore else det)[0] * self._act_scale + self._act_offset
-
-    def save_checkpoint(self):
-        import jax
-
-        from ray_tpu.air.checkpoint import Checkpoint
-
-        return Checkpoint.from_dict({
-            "params": jax.tree_util.tree_map(np.asarray, self.params),
-            "target": jax.tree_util.tree_map(np.asarray, self.target),
-            "timesteps": self._timesteps_total,
-        })
-
-    def load_checkpoint(self, checkpoint) -> None:
-        import jax
-        import jax.numpy as jnp
-
-        data = checkpoint.to_dict()
-        self.params = jax.tree_util.tree_map(jnp.asarray, data["params"])
-        self.target = jax.tree_util.tree_map(jnp.asarray, data["target"])
-        self._timesteps_total = data.get("timesteps", 0)
-
-    def cleanup(self) -> None:
-        pass

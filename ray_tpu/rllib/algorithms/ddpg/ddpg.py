@@ -14,6 +14,7 @@ from typing import Optional
 import numpy as np
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.off_policy import OffPolicyTraining, floats
 from ray_tpu.rllib.algorithms.sac.sac import _mlp_apply, _mlp_params, _true_transition
 from ray_tpu.rllib.env.vector_env import VectorEnv
 from ray_tpu.rllib.policy.sample_batch import (
@@ -94,7 +95,7 @@ class TD3Config(DDPGConfig):
         self.smooth_target_policy = True
 
 
-class DDPG(Algorithm):
+class DDPG(OffPolicyTraining, Algorithm):
     @classmethod
     def get_default_config(cls) -> DDPGConfig:
         return DDPGConfig(cls)
@@ -120,8 +121,16 @@ class DDPG(Algorithm):
             jax.random.PRNGKey(cfg.seed), self.obs_dim, self.action_dim, cfg.model_hiddens, cfg.twin_q
         )
         self.target = jax.tree_util.tree_map(lambda x: x, self.params)
-        self.tx = optax.adam(cfg.lr)
-        self.opt_state = self.tx.init(self.params)
+        self._critic_keys = tuple(k for k in ("q1", "q2") if k in self.params)
+        # Separate optimizers: the delayed (TD3) actor update must skip BOTH
+        # the gradient and the Adam moment update — a zeroed gradient through
+        # a shared optimizer would still move the actor via momentum.
+        self.actor_tx = optax.adam(cfg.lr)
+        self.critic_tx = optax.adam(cfg.lr)
+        self.opt_state = {
+            "actor": self.actor_tx.init(self.params["actor"]),
+            "critic": self.critic_tx.init({k: self.params[k] for k in self._critic_keys}),
+        }
         self.buffer = ReplayBuffer(cfg.replay_buffer_capacity, seed=cfg.seed)
         self._rng = jax.random.PRNGKey(cfg.seed + 1)
         self._np_rng = np.random.default_rng(cfg.seed)
@@ -137,12 +146,13 @@ class DDPG(Algorithm):
         gamma, tau = cfg.gamma, cfg.tau
         twin_q, smooth = cfg.twin_q, cfg.smooth_target_policy
         noise, noise_clip = cfg.target_noise, cfg.target_noise_clip
-        tx = self.tx
+        critic_keys = self._critic_keys
+        actor_tx, critic_tx = self.actor_tx, self.critic_tx
 
         def q_val(q, obs, a):
             return _mlp_apply(q, jnp.concatenate([obs, a], -1))[:, 0]
 
-        def loss_fn(params, target, batch, key, update_actor):
+        def critic_loss_fn(critic, target, batch, key):
             obs, next_obs = batch[OBS], batch[NEXT_OBS]
             next_a = jnp.tanh(_mlp_apply(target["actor"], next_obs))
             if smooth:
@@ -154,31 +164,50 @@ class DDPG(Algorithm):
             td_target = jax.lax.stop_gradient(
                 batch[REWARDS] + gamma * (1 - batch[DONES]) * tq
             )
-            q1 = q_val(params["q1"], obs, batch[ACTIONS])
-            critic_loss = jnp.mean((q1 - td_target) ** 2)
+            q1 = q_val(critic["q1"], obs, batch[ACTIONS])
+            loss = jnp.mean((q1 - td_target) ** 2)
             if twin_q:
-                q2 = q_val(params["q2"], obs, batch[ACTIONS])
-                critic_loss = critic_loss + jnp.mean((q2 - td_target) ** 2)
-            a_pi = jnp.tanh(_mlp_apply(params["actor"], obs))
-            # Actor ascends Q1; frozen critics via stop_gradient on their
-            # params is unnecessary — grads to q1 params from actor_loss are
-            # masked by update_actor scaling into the same total (delayed
-            # updates zero the actor term entirely).
-            actor_loss = -jnp.mean(
-                q_val(jax.lax.stop_gradient(params["q1"]), obs, a_pi)
-            )
-            total = critic_loss + update_actor * actor_loss
-            return total, {"critic_loss": critic_loss, "actor_loss": actor_loss, "mean_q": q1.mean()}
+                q2 = q_val(critic["q2"], obs, batch[ACTIONS])
+                loss = loss + jnp.mean((q2 - td_target) ** 2)
+            return loss, q1.mean()
+
+        def actor_loss_fn(actor, critic, batch):
+            obs = batch[OBS]
+            a_pi = jnp.tanh(_mlp_apply(actor, obs))
+            return -jnp.mean(q_val(critic["q1"], obs, a_pi))
 
         def train_step(params, target, opt_state, batch, key, update_actor):
-            (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                params, target, batch, key, update_actor
+            critic = {k: params[k] for k in critic_keys}
+            (closs, mean_q), cgrads = jax.value_and_grad(critic_loss_fn, has_aux=True)(
+                critic, target, batch, key
             )
-            updates, opt_state = tx.update(grads, opt_state, params)
-            params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
-            target = jax.tree_util.tree_map(
-                lambda t, p: (1 - tau) * t + tau * p, target, params
+            cupd, c_opt = critic_tx.update(cgrads, opt_state["critic"], critic)
+            critic = jax.tree_util.tree_map(lambda p, u: p + u, critic, cupd)
+
+            # Delayed policy + target updates (TD3): the skipped branch
+            # leaves actor params, actor Adam moments, AND targets untouched.
+            def do_actor(op):
+                actor, a_opt, tgt = op
+                aloss, agrads = jax.value_and_grad(actor_loss_fn)(actor, critic, batch)
+                aupd, a_opt = actor_tx.update(agrads, a_opt, actor)
+                actor = jax.tree_util.tree_map(lambda p, u: p + u, actor, aupd)
+                new_params = {**critic, "actor": actor}
+                tgt = jax.tree_util.tree_map(
+                    lambda t, p: (1 - tau) * t + tau * p, tgt, new_params
+                )
+                return actor, a_opt, tgt, aloss
+
+            def skip_actor(op):
+                actor, a_opt, tgt = op
+                return actor, a_opt, tgt, jnp.zeros(())
+
+            actor, a_opt, target, aloss = jax.lax.cond(
+                update_actor > 0, do_actor, skip_actor,
+                (params["actor"], opt_state["actor"], target),
             )
+            params = {**critic, "actor": actor}
+            opt_state = {"actor": a_opt, "critic": c_opt}
+            metrics = {"critic_loss": closs, "actor_loss": aloss, "mean_q": mean_q}
             return params, target, opt_state, metrics
 
         self._train_step = jax.jit(train_step)
@@ -192,7 +221,7 @@ class DDPG(Algorithm):
         import jax.numpy as jnp
 
         cfg: DDPGConfig = self._algo_config
-        metrics: dict = {}
+        last_m = None
         for _ in range(cfg.rollout_steps_per_iter):
             obs = self.env.current_obs().astype(np.float32).reshape(self.env.num_envs, -1)
             if self._timesteps_total < cfg.learning_starts:
@@ -216,26 +245,13 @@ class DDPG(Algorithm):
                     update_actor = jnp.asarray(
                         1.0 if self._updates % max(cfg.policy_delay, 1) == 0 else 0.0, jnp.float32
                     )
-                    self.params, self.target, self.opt_state, m = self._train_step(
+                    self.params, self.target, self.opt_state, last_m = self._train_step(
                         self.params, self.target, self.opt_state, jb, key, update_actor
                     )
-                    metrics = {k: float(v) for k, v in m.items()}
         stats_r, _ = self.env.pop_episode_stats()
         self._episode_reward_window += stats_r
         self._episode_reward_window = self._episode_reward_window[-100:]
-        return metrics
-
-    def step(self) -> dict:
-        import time
-
-        t0 = time.time()
-        result = self.training_step()
-        result["episode_reward_mean"] = (
-            float(np.mean(self._episode_reward_window)) if self._episode_reward_window else float("nan")
-        )
-        result["timesteps_total"] = self._timesteps_total
-        result["time_this_iter_s"] = time.time() - t0
-        return result
+        return floats(last_m) if last_m is not None else {}
 
     def compute_single_action(self, obs, explore: bool = False):
         import jax.numpy as jnp
@@ -245,31 +261,6 @@ class DDPG(Algorithm):
         if explore:
             a = np.clip(a + self._np_rng.normal(0, self._algo_config.exploration_noise, a.shape), -1, 1)
         return self._env_action(a)
-
-    def save_checkpoint(self):
-        import jax
-
-        from ray_tpu.air.checkpoint import Checkpoint
-
-        return Checkpoint.from_dict({
-            "params": jax.tree_util.tree_map(np.asarray, self.params),
-            "target": jax.tree_util.tree_map(np.asarray, self.target),
-            "timesteps": self._timesteps_total,
-        })
-
-    def load_checkpoint(self, checkpoint) -> None:
-        import jax
-        import jax.numpy as jnp
-
-        data = checkpoint.to_dict()
-        self.params = jax.tree_util.tree_map(jnp.asarray, data["params"])
-        self.target = jax.tree_util.tree_map(jnp.asarray, data["target"])
-        self._timesteps_total = data.get("timesteps", 0)
-
-    def cleanup(self) -> None:
-        env = getattr(self, "env", None)
-        if env is not None:
-            env.close()
 
 
 class TD3(DDPG):

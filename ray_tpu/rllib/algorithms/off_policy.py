@@ -1,0 +1,58 @@
+"""Shared plumbing for the replay-based algorithms (SAC/DDPG/TD3/CQL).
+
+These all hold `self.params` / `self.target` pytrees and a timestep counter;
+step timing, the 100-episode reward window, and params/target checkpointing
+are identical — one mixin instead of three copies (the reference similarly
+shares via Algorithm + build_policy hooks).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+class OffPolicyTraining:
+    def step(self) -> dict:
+        t0 = time.time()
+        result = self.training_step()
+        window = getattr(self, "_episode_reward_window", [])
+        result["episode_reward_mean"] = (
+            float(np.mean(window)) if window else float("nan")
+        )
+        result["timesteps_total"] = self._timesteps_total
+        result["time_this_iter_s"] = time.time() - t0
+        return result
+
+    def save_checkpoint(self):
+        import jax
+
+        from ray_tpu.air.checkpoint import Checkpoint
+
+        return Checkpoint.from_dict({
+            "params": jax.tree_util.tree_map(np.asarray, self.params),
+            "target": jax.tree_util.tree_map(np.asarray, self.target),
+            "timesteps": self._timesteps_total,
+        })
+
+    def load_checkpoint(self, checkpoint) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        data = checkpoint.to_dict()
+        self.params = jax.tree_util.tree_map(jnp.asarray, data["params"])
+        self.target = jax.tree_util.tree_map(jnp.asarray, data["target"])
+        self._timesteps_total = data.get("timesteps", 0)
+
+    def cleanup(self) -> None:
+        env = getattr(self, "env", None)
+        if env is not None:
+            env.close()
+
+
+def floats(metric_tree) -> dict:
+    """Convert a jitted step's metric pytree to host floats — call ONCE per
+    iteration after the update loop, not per gradient step (each conversion
+    blocks on the device and would defeat async dispatch in the hot loop)."""
+    return {k: float(v) for k, v in dict(metric_tree).items()}

@@ -16,6 +16,7 @@ from typing import Optional
 import numpy as np
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.off_policy import OffPolicyTraining, floats
 from ray_tpu.rllib.env.vector_env import VectorEnv
 from ray_tpu.rllib.policy.sample_batch import (
     ACTIONS,
@@ -137,7 +138,7 @@ class SACConfig(AlgorithmConfig):
         return self
 
 
-class SAC(Algorithm):
+class SAC(OffPolicyTraining, Algorithm):
     @classmethod
     def get_default_config(cls) -> SACConfig:
         return SACConfig(cls)
@@ -268,7 +269,7 @@ class SAC(Algorithm):
             a, _, det = _squashed_sample(params["actor"], obs, key, action_dim)
             return jnp.where(explore, a, det)
 
-        self._act = jax.jit(act, static_argnames=()) if discrete else jax.jit(act)
+        self._act = jax.jit(act)
 
     def _env_action(self, a):
         if self.discrete:
@@ -280,7 +281,7 @@ class SAC(Algorithm):
         import jax.numpy as jnp
 
         cfg: SACConfig = self._algo_config
-        metrics: dict = {}
+        last_m = None
         for _ in range(cfg.rollout_steps_per_iter):
             obs = self.env.current_obs().astype(np.float32).reshape(self.env.num_envs, -1)
             if self._timesteps_total < cfg.learning_starts:
@@ -303,26 +304,13 @@ class SAC(Algorithm):
                     batch = self.buffer.sample(cfg.train_batch_size)
                     jb = {k: jnp.asarray(v) for k, v in batch.items()}
                     self._rng, key = jax.random.split(self._rng)
-                    self.params, self.target, self.opt_state, m = self._train_step(
+                    self.params, self.target, self.opt_state, last_m = self._train_step(
                         self.params, self.target, self.opt_state, jb, key
                     )
-                    metrics = {k: float(v) for k, v in m.items()}
         stats_r, _ = self.env.pop_episode_stats()
         self._episode_reward_window += stats_r
         self._episode_reward_window = self._episode_reward_window[-100:]
-        return metrics
-
-    def step(self) -> dict:
-        import time
-
-        t0 = time.time()
-        result = self.training_step()
-        result["episode_reward_mean"] = (
-            float(np.mean(self._episode_reward_window)) if self._episode_reward_window else float("nan")
-        )
-        result["timesteps_total"] = self._timesteps_total
-        result["time_this_iter_s"] = time.time() - t0
-        return result
+        return floats(last_m) if last_m is not None else {}
 
     def compute_single_action(self, obs, explore: bool = False):
         import jax
@@ -334,28 +322,3 @@ class SAC(Algorithm):
         if self.discrete:
             return int(a)
         return self._env_action(a)
-
-    def save_checkpoint(self):
-        import jax
-
-        from ray_tpu.air.checkpoint import Checkpoint
-
-        return Checkpoint.from_dict({
-            "params": jax.tree_util.tree_map(np.asarray, self.params),
-            "target": jax.tree_util.tree_map(np.asarray, self.target),
-            "timesteps": self._timesteps_total,
-        })
-
-    def load_checkpoint(self, checkpoint) -> None:
-        import jax
-        import jax.numpy as jnp
-
-        data = checkpoint.to_dict()
-        self.params = jax.tree_util.tree_map(jnp.asarray, data["params"])
-        self.target = jax.tree_util.tree_map(jnp.asarray, data["target"])
-        self._timesteps_total = data.get("timesteps", 0)
-
-    def cleanup(self) -> None:
-        env = getattr(self, "env", None)
-        if env is not None:
-            env.close()

@@ -3,4 +3,12 @@
 from ray_tpu.train.base_trainer import BaseTrainer  # noqa: F401
 from ray_tpu.train.data_parallel_trainer import DataParallelTrainer  # noqa: F401
 from ray_tpu.train.predictor import BatchPredictor, JaxPredictor, Predictor  # noqa: F401
-from ray_tpu.train.sklearn import LightGBMTrainer, SklearnTrainer, XGBoostTrainer  # noqa: F401
+from ray_tpu.train.sklearn import (  # noqa: F401
+    HorovodTrainer,
+    LightGBMTrainer,
+    LightningTrainer,
+    MosaicTrainer,
+    SklearnTrainer,
+    TensorflowTrainer,
+    XGBoostTrainer,
+)

@@ -23,6 +23,7 @@ class Result:
     error: str | None = None
     path: str | None = None
     metrics_dataframe: object | None = None
+    config: dict = field(default_factory=dict)  # the trial's resolved config
 
 
 class BaseTrainer:
@@ -65,6 +66,11 @@ class BaseTrainer:
 
             merged = trainer._with_config_overrides(config)
             result = merged._fit_direct()
+            if result.error:
+                # A failed fit must fail the trial, not complete it with
+                # empty metrics (trainers that catch-and-return errors,
+                # e.g. SklearnTrainer, land here).
+                raise RuntimeError(f"trainer fit failed: {result.error}")
             tune_report(result.metrics, checkpoint=result.checkpoint)
 
         return _train_fn

@@ -1,0 +1,1 @@
+from ray_tpu.train.huggingface.huggingface_trainer import HuggingFaceTrainer  # noqa: F401

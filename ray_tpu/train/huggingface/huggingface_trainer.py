@@ -1,0 +1,104 @@
+"""HuggingFaceTrainer — transformers.Trainer on the distributed gang.
+
+Reference: python/ray/train/huggingface/huggingface_trainer.py: a
+DataParallelTrainer (torch backend) whose per-worker loop materialises the
+user's `transformers.Trainer` via `trainer_init_per_worker`, bridges HF
+logging into session.report, and checkpoints rank-0's model. The torch
+process group the backend formed is what HF's Trainer picks up for DDP
+(WORLD_SIZE/RANK env vars are already exported by _init_dist).
+"""
+
+from __future__ import annotations
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import RunConfig, ScalingConfig
+from ray_tpu.train.torch.config import TorchConfig
+from ray_tpu.train.torch.torch_trainer import TorchTrainer
+
+
+class _RowListDataset:
+    """torch-map-style dataset over materialised ray_tpu.data rows."""
+
+    def __init__(self, rows: list):
+        self.rows = rows
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+    def __getitem__(self, i: int):
+        return self.rows[i]
+
+
+def _to_torch_dataset(shard):
+    if shard is None:
+        return None
+    if hasattr(shard, "take_all"):
+        return _RowListDataset(shard.take_all())
+    return shard  # already a torch/HF dataset
+
+
+def _hf_train_loop(config: dict):
+    import transformers
+
+    from ray_tpu.air import session
+
+    trainer_init = config["_trainer_init_per_worker"]
+    init_config = config.get("_trainer_init_config") or {}
+    train_ds = _to_torch_dataset(session.get_dataset_shard("train"))
+    eval_ds = _to_torch_dataset(session.get_dataset_shard("evaluation"))
+    trainer: transformers.Trainer = trainer_init(train_ds, eval_ds, **init_config)
+
+    class _ReportCallback(transformers.TrainerCallback):
+        def on_log(self, args, state, control, logs=None, **kwargs):
+            if logs and state.is_world_process_zero:
+                metrics = {k: v for k, v in logs.items() if isinstance(v, (int, float))}
+                metrics["step"] = state.global_step
+                metrics["epoch"] = float(state.epoch or 0)
+                session.report(metrics)
+
+    trainer.add_callback(_ReportCallback())
+    result = trainer.train()
+    final = dict(result.metrics or {})
+    if session.get_world_rank() == 0:
+        import io
+
+        import torch
+
+        buf = io.BytesIO()
+        torch.save(trainer.model.state_dict(), buf)
+        ckpt = Checkpoint.from_dict({
+            "model_state": buf.getvalue(),
+            "config": getattr(getattr(trainer.model, "config", None), "to_dict", dict)(),
+        })
+        session.report(final, checkpoint=ckpt)
+    else:
+        session.report(final)
+
+
+class HuggingFaceTrainer(TorchTrainer):
+    """`trainer_init_per_worker(train_dataset, eval_dataset, **config)` must
+    return a `transformers.Trainer` (same contract as the reference)."""
+
+    def __init__(
+        self,
+        trainer_init_per_worker,
+        *,
+        trainer_init_config: dict | None = None,
+        torch_config: TorchConfig | None = None,
+        scaling_config: ScalingConfig | None = None,
+        run_config: RunConfig | None = None,
+        datasets: dict | None = None,
+        resume_from_checkpoint=None,
+    ):
+        super().__init__(
+            _hf_train_loop,
+            train_loop_config={
+                "_trainer_init_per_worker": trainer_init_per_worker,
+                "_trainer_init_config": trainer_init_config,
+            },
+            torch_config=torch_config,
+            scaling_config=scaling_config,
+            run_config=run_config,
+            datasets=datasets,
+            resume_from_checkpoint=resume_from_checkpoint,
+        )

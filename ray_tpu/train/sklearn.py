@@ -119,3 +119,7 @@ def _gated(name: str, package: str):
 # against the real libraries would replace these with full GBDT trainers.
 XGBoostTrainer = _gated("XGBoostTrainer", "xgboost")
 LightGBMTrainer = _gated("LightGBMTrainer", "lightgbm")
+LightningTrainer = _gated("LightningTrainer", "pytorch_lightning")
+MosaicTrainer = _gated("MosaicTrainer", "mosaicml")
+HorovodTrainer = _gated("HorovodTrainer", "horovod")
+TensorflowTrainer = _gated("TensorflowTrainer", "tensorflow")

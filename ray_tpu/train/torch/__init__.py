@@ -1,0 +1,7 @@
+from ray_tpu.train.torch.config import TorchConfig  # noqa: F401
+from ray_tpu.train.torch.torch_trainer import TorchTrainer  # noqa: F401
+from ray_tpu.train.torch.train_loop_utils import (  # noqa: F401
+    get_device,
+    prepare_data_loader,
+    prepare_model,
+)

@@ -1,0 +1,69 @@
+"""Per-worker torch conveniences (reference: train/torch/train_loop_utils.py
+prepare_model / prepare_data_loader / prepare_optimizer)."""
+
+from __future__ import annotations
+
+from ray_tpu.air import session
+
+
+def get_device():
+    """CPU in this image (torch CPU wheel); kept for API parity."""
+    import torch
+
+    return torch.device("cpu")
+
+
+def prepare_model(model, *, ddp_kwargs: dict | None = None):
+    """Wrap in DistributedDataParallel when a process group is live
+    (reference: prepare_model, minus GPU move/amp)."""
+    import torch.distributed as dist
+    from torch.nn.parallel import DistributedDataParallel
+
+    if dist.is_available() and dist.is_initialized() and dist.get_world_size() > 1:
+        return DistributedDataParallel(model, **(ddp_kwargs or {}))
+    return model
+
+
+def prepare_data_loader(data_loader, *, add_dist_sampler: bool = True):
+    """Re-create the DataLoader with a DistributedSampler so each rank sees
+    its shard (reference: prepare_data_loader)."""
+    import torch.distributed as dist
+    from torch.utils.data import DataLoader, SequentialSampler
+    from torch.utils.data.distributed import DistributedSampler
+
+    if not (dist.is_available() and dist.is_initialized() and dist.get_world_size() > 1):
+        return data_loader
+    if not add_dist_sampler or isinstance(data_loader.sampler, DistributedSampler):
+        return data_loader
+    sampler = DistributedSampler(
+        data_loader.dataset,
+        num_replicas=dist.get_world_size(),
+        rank=dist.get_rank(),
+        shuffle=not isinstance(data_loader.sampler, SequentialSampler),
+    )
+    return DataLoader(
+        data_loader.dataset,
+        batch_size=data_loader.batch_size,
+        sampler=sampler,
+        num_workers=data_loader.num_workers,
+        collate_fn=data_loader.collate_fn,
+        pin_memory=data_loader.pin_memory,
+        drop_last=data_loader.drop_last,
+    )
+
+
+def accelerate_ready() -> bool:
+    """True when HF accelerate can form its state from the env vars the
+    torch backend exported (reference: AccelerateTrainer's premise)."""
+    try:
+        import accelerate  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def report(metrics: dict, checkpoint=None) -> None:
+    """Alias for air.session.report, for torch loops written against the
+    reference's `ray.train.report`."""
+    session.report(metrics, checkpoint=checkpoint)

@@ -230,6 +230,14 @@ class TuneController:
             pass
         self._stop_trial(trial, TERMINATED)
 
+    def stop_trial(self, trial: Trial):
+        """Scheduler-initiated termination of a trial other than the one
+        being processed (e.g. HyperBand halving losers). The scheduler has
+        already accounted for it — only the searcher needs the completion."""
+        if trial.status in (RUNNING, PENDING, PAUSED):
+            self.searcher.on_trial_complete(trial.trial_id, trial.last_result)
+            self._stop_trial(trial, TERMINATED)
+
     def _exploit(self, trial: Trial, donor: Trial, new_config: dict):
         """PBT: restart `trial` from donor's checkpoint with a mutated config."""
         self._stop_trial(trial, PENDING)

@@ -6,7 +6,9 @@ from ray_tpu.tune.schedulers.trial_scheduler import (  # noqa: F401
     TrialScheduler,
 )
 from ray_tpu.tune.schedulers.asha import ASHAScheduler  # noqa: F401
+from ray_tpu.tune.schedulers.hyperband import HyperBandScheduler  # noqa: F401
 from ray_tpu.tune.schedulers.median_stopping import MedianStoppingRule  # noqa: F401
+from ray_tpu.tune.schedulers.pb2 import PB2  # noqa: F401
 from ray_tpu.tune.schedulers.pbt import PopulationBasedTraining  # noqa: F401
 
 AsyncHyperBandScheduler = ASHAScheduler

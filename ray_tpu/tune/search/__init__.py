@@ -3,4 +3,18 @@ from ray_tpu.tune.search.searcher import (  # noqa: F401
     Searcher,
 )
 from ray_tpu.tune.search.basic_variant import BasicVariantGenerator  # noqa: F401
+from ray_tpu.tune.search.bayesopt import BayesOptSearch  # noqa: F401
+from ray_tpu.tune.search.gated import (  # noqa: F401
+    AxSearch,
+    DragonflySearch,
+    HEBOSearch,
+    HyperOptSearch,
+    NevergradSearch,
+    OptunaSearch,
+    SigOptSearch,
+    SkOptSearch,
+    TuneBOHB,
+    ZOOptSearch,
+)
 from ray_tpu.tune.search.hyperopt_like import HyperOptLikeSearch  # noqa: F401
+from ray_tpu.tune.search.repeater import Repeater  # noqa: F401

@@ -1,0 +1,39 @@
+"""Gated external-searcher wrappers.
+
+The reference wraps a dozen third-party optimizers (python/ray/tune/search/
+{optuna,hyperopt,ax,bohb,dragonfly,flaml,hebo,nevergrad,sigopt,skopt,zoopt});
+none of those packages are in this image, so each name constructs with a
+clear install message (same behavior the reference shows when the backing
+package is missing). BayesOptSearch (sklearn-GP) and HyperOptLikeSearch are
+the in-image alternatives.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.tune.search.searcher import Searcher
+
+
+def _gated(name: str, package: str):
+    class _Gated(Searcher):
+        def __init__(self, *a, **k):
+            raise ImportError(
+                f"{name} requires the '{package}' package, which is not "
+                f"installed in this environment (pip install {package}). "
+                "In-image alternatives: BayesOptSearch (sklearn GP) or "
+                "HyperOptLikeSearch."
+            )
+
+    _Gated.__name__ = name
+    return _Gated
+
+
+OptunaSearch = _gated("OptunaSearch", "optuna")
+HyperOptSearch = _gated("HyperOptSearch", "hyperopt")
+AxSearch = _gated("AxSearch", "ax-platform")
+TuneBOHB = _gated("TuneBOHB", "hpbandster")
+DragonflySearch = _gated("DragonflySearch", "dragonfly-opt")
+NevergradSearch = _gated("NevergradSearch", "nevergrad")
+SigOptSearch = _gated("SigOptSearch", "sigopt")
+SkOptSearch = _gated("SkOptSearch", "scikit-optimize")
+ZOOptSearch = _gated("ZOOptSearch", "zoopt")
+HEBOSearch = _gated("HEBOSearch", "HEBO")

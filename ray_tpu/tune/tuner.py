@@ -101,6 +101,7 @@ class Tuner:
                 checkpoint=t.checkpoint,
                 error=t.error_msg if t.status == ERROR else None,
                 path=exp_dir,
+                config=dict(t.config),
             )
             for t in trials
         ]

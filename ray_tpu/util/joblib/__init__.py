@@ -34,6 +34,8 @@ def _make_backend():
         supports_sharedmem = False
 
         def effective_n_jobs(self, n_jobs):
+            if n_jobs == 0:
+                raise ValueError("n_jobs == 0 has no meaning")  # joblib semantics
             if n_jobs is not None and n_jobs > 0:
                 # Explicit positive n_jobs: no cluster-state RPC needed
                 # (joblib calls this repeatedly per dispatch).

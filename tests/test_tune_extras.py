@@ -1,0 +1,153 @@
+"""Tests for the wider Tune surface: HyperBand (sync), PB2, BayesOptSearch,
+Repeater, gated external searchers.
+
+Reference analogs: python/ray/tune/tests/test_trial_scheduler.py (HyperBand
+halving), test_trial_scheduler_pbt.py (PB2), test_searchers.py.
+"""
+
+import random
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air.config import RunConfig
+
+
+@pytest.fixture
+def ray_start_regular():
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    try:
+        yield
+    finally:
+        ray_tpu.shutdown()
+
+
+def _report_iters(config):
+    for i in range(1, config.get("iters", 30) + 1):
+        tune.report({"acc": config["lr"] * i, "training_iteration": i})
+
+
+def test_hyperband_halves_brackets(ray_start_regular):
+    from ray_tpu.tune.schedulers import HyperBandScheduler
+
+    scheduler = HyperBandScheduler(metric="acc", mode="max", max_t=9, reduction_factor=3)
+    results = tune.Tuner(
+        _report_iters,
+        param_space={"lr": tune.grid_search([9.0, 3.0, 1.0, 0.3, 0.1, 0.03])},
+        tune_config=tune.TuneConfig(
+            scheduler=scheduler, metric="acc", mode="max", max_concurrent_trials=3
+        ),
+    ).fit()
+    iters = sorted(r.metrics.get("training_iteration", 0) for r in results)
+    # Synchronous halving: some trials cut at an early rung, at least one
+    # survivor runs to the bracket budget.
+    assert iters[0] < 9, f"no trial was halved: {iters}"
+    assert iters[-1] >= 9, f"no trial reached max_t: {iters}"
+    best = max(r.metrics.get("acc", 0) for r in results)
+    assert best >= 9.0 * 9  # the lr=9 trial survived to the end
+
+
+class _GrowTrainable(tune.Trainable):
+    def setup(self, config):
+        self.score = 0.0
+
+    def step(self):
+        self.score += self.config["rate"]
+        return {"score": self.score}
+
+    def save_checkpoint(self):
+        from ray_tpu.air.checkpoint import Checkpoint
+
+        return Checkpoint.from_dict({"score": self.score})
+
+    def load_checkpoint(self, checkpoint):
+        self.score = checkpoint.to_dict()["score"]
+
+
+def test_pb2_exploits_with_gp(ray_start_regular):
+    from ray_tpu.tune.schedulers import PB2
+
+    pb2 = PB2(
+        metric="score", mode="max", perturbation_interval=3,
+        hyperparam_mutations={"rate": tune.uniform(0.1, 10.0)}, seed=0,
+    )
+    results = tune.Tuner(
+        _GrowTrainable,
+        param_space={"rate": tune.grid_search([0.1, 0.1, 8.0, 8.0])},
+        tune_config=tune.TuneConfig(scheduler=pb2, metric="score", mode="max",
+                                    max_concurrent_trials=4),
+        run_config=RunConfig(stop={"training_iteration": 12}),
+    ).fit()
+    best = results.get_best_result("score", "max").metrics["score"]
+    assert best >= 8.0 * 10  # top performer kept running
+    # GP-guided explore keeps mutated rates inside the declared box.
+    for r in results:
+        assert 0.05 <= r.config["rate"] <= 10.5
+
+
+def _quadratic(config):
+    tune.report({"score": -((config["x"] - 3.0) ** 2)})
+
+
+def test_bayesopt_finds_quadratic_max(ray_start_regular):
+    from ray_tpu.tune.search import BayesOptSearch
+
+    searcher = BayesOptSearch(
+        {"x": tune.uniform(0.0, 6.0)}, metric="score", mode="max",
+        random_startup_trials=4, seed=0,
+    )
+    results = tune.Tuner(
+        _quadratic,
+        tune_config=tune.TuneConfig(metric="score", mode="max", num_samples=15,
+                                    search_alg=searcher, max_concurrent_trials=1),
+    ).fit()
+    best = results.get_best_result("score", "max")
+    assert best.metrics["score"] > -0.5, f"BO missed the optimum: {best.metrics}"
+
+
+def test_bayesopt_handles_mixed_domains():
+    """Unit-cube mapping roundtrips ints / categoricals / log floats."""
+    from ray_tpu.tune.search.bayesopt import _Dim
+
+    d = _Dim("lr", tune.loguniform(1e-4, 1e-1))
+    assert abs(d.to_unit(1e-4)) < 1e-9 and abs(d.to_unit(1e-1) - 1) < 1e-9
+    assert 1e-4 <= d.from_unit(0.37) <= 1e-1
+    c = _Dim("act", tune.choice(["relu", "tanh", "gelu"]))
+    assert c.from_unit(c.to_unit("tanh")) == "tanh"
+    i = _Dim("n", tune.randint(2, 10))
+    assert i.from_unit(i.to_unit(7)) == 7
+
+
+def test_repeater_averages_noisy_trials(ray_start_regular):
+    from ray_tpu.tune.search import Repeater
+    from ray_tpu.tune.search.hyperopt_like import HyperOptLikeSearch
+
+    rng = random.Random(0)
+
+    def noisy(config):
+        tune.report({"score": -((config["x"] - 3.0) ** 2) + rng.gauss(0, 0.5)})
+
+    inner = HyperOptLikeSearch({"x": tune.uniform(0, 6)}, metric="score", mode="max",
+                               n_initial_points=2, seed=0)
+    searcher = Repeater(inner, repeat=3)
+    results = tune.Tuner(
+        noisy,
+        tune_config=tune.TuneConfig(metric="score", mode="max", num_samples=12,
+                                    search_alg=searcher, max_concurrent_trials=1),
+    ).fit()
+    assert len(results) == 12
+    # Every group of 3 shares the same x (the repeated config).
+    xs = [round(r.config["x"], 6) for r in results]
+    assert len(set(xs)) <= 4
+    # __trial_index__ marks the repeat index inside each group.
+    idxs = sorted(r.config.get("__trial_index__") for r in results)
+    assert idxs.count(0) == 4 and idxs.count(2) == 4
+
+
+def test_gated_searchers_raise_with_guidance():
+    from ray_tpu.tune.search import AxSearch, OptunaSearch, TuneBOHB
+
+    for cls, pkg in ((OptunaSearch, "optuna"), (AxSearch, "ax-platform"), (TuneBOHB, "hpbandster")):
+        with pytest.raises(ImportError, match=pkg):
+            cls()
