@@ -1,0 +1,3 @@
+from ray_tpu.rllib.train import main
+
+raise SystemExit(main())

@@ -36,6 +36,7 @@ class AlgorithmConfig:
         self.train_batch_size = 4000
         self.grad_clip: Optional[float] = None
         self.model_hiddens = (64, 64)
+        self.model_conv_filters = None  # [(out_ch, kernel, stride), ...] for image obs
         self.seed = 0
         self.num_learners = 0
         self.num_tpus_per_learner = 0.0
@@ -65,7 +66,7 @@ class AlgorithmConfig:
 
     def training(self, *, lr: Optional[float] = None, gamma: Optional[float] = None,
                  train_batch_size: Optional[int] = None, grad_clip: Optional[float] = None,
-                 model_hiddens=None, **extra) -> "AlgorithmConfig":
+                 model_hiddens=None, model_conv_filters=None, **extra) -> "AlgorithmConfig":
         if lr is not None:
             self.lr = lr
         if gamma is not None:
@@ -76,6 +77,8 @@ class AlgorithmConfig:
             self.grad_clip = grad_clip
         if model_hiddens is not None:
             self.model_hiddens = tuple(model_hiddens)
+        if model_conv_filters is not None:
+            self.model_conv_filters = tuple(tuple(f) for f in model_conv_filters)
         self.extra.update(extra)
         return self
 
@@ -137,7 +140,8 @@ class Algorithm(Trainable):
 
         probe = gym.make(cfg.env) if isinstance(cfg.env, str) else cfg.env(dict(cfg.env_config))
         self.module_spec = RLModuleSpec.from_spaces(
-            probe.observation_space, probe.action_space, cfg.model_hiddens
+            probe.observation_space, probe.action_space, cfg.model_hiddens,
+            conv_filters=cfg.model_conv_filters,
         )
         probe.close()
         self.workers = WorkerSet(

@@ -24,15 +24,46 @@ class RLModuleSpec:
     hiddens: Tuple[int, ...] = (64, 64)
     activation: str = "tanh"
     free_log_std: bool = True  # continuous: state-independent log_std
+    # Image observations: HWC shape + conv torso spec [(out_ch, kernel,
+    # stride), ...] (reference: ModelCatalog VisionNet filters,
+    # rllib/models/catalog.py). Empty = flat MLP.
+    obs_shape: Tuple[int, ...] = ()
+    conv_filters: Tuple[Tuple[int, int, int], ...] = ()
 
     @staticmethod
-    def from_spaces(observation_space, action_space, hiddens=(64, 64)) -> "RLModuleSpec":
+    def from_spaces(observation_space, action_space, hiddens=(64, 64),
+                    conv_filters=None) -> "RLModuleSpec":
         import gymnasium as gym
 
         obs_dim = int(np.prod(observation_space.shape))
+        shape = tuple(observation_space.shape)
+        convs: Tuple = ()
+        if len(shape) == 3:
+            convs = tuple(conv_filters) if conv_filters else default_conv_filters(shape)
+        elif conv_filters:
+            raise ValueError("conv_filters requires a 3D (H, W, C) observation space")
         if isinstance(action_space, gym.spaces.Discrete):
-            return RLModuleSpec(obs_dim, int(action_space.n), True, tuple(hiddens))
-        return RLModuleSpec(obs_dim, int(np.prod(action_space.shape)), False, tuple(hiddens))
+            return RLModuleSpec(obs_dim, int(action_space.n), True, tuple(hiddens),
+                                obs_shape=shape if convs else (), conv_filters=convs)
+        return RLModuleSpec(obs_dim, int(np.prod(action_space.shape)), False, tuple(hiddens),
+                            obs_shape=shape if convs else (), conv_filters=convs)
+
+
+def default_conv_filters(shape: Tuple[int, ...]) -> Tuple[Tuple[int, int, int], ...]:
+    """Default conv stacks by input size (reference: catalog.py
+    _get_filter_config — 84x84 Atari stack, smaller stacks otherwise).
+    Tiny spatial dims get NO convs (flat MLP) rather than a stack that
+    collapses to zero — a (4,4,1) gridworld must keep training."""
+    h = min(shape[0], shape[1])
+    if h >= 84:
+        return ((16, 8, 4), (32, 4, 2), (64, 3, 1))
+    if h >= 42:
+        return ((16, 4, 2), (32, 4, 2), (64, 3, 1))
+    if h >= 7:
+        return ((16, 3, 2), (32, 3, 2))
+    if h >= 3:
+        return ((16, 3, 1),)
+    return ()
 
 
 def _act(name: str):
@@ -42,9 +73,23 @@ def _act(name: str):
     return {"tanh": jnp.tanh, "relu": jax.nn.relu, "swish": jax.nn.swish}[name]
 
 
+def _conv_out_dim(spec: RLModuleSpec) -> int:
+    h, w, _ = spec.obs_shape
+    c = spec.obs_shape[2]
+    for out_ch, k, s in spec.conv_filters:
+        h = (h - k) // s + 1
+        w = (w - k) // s + 1
+        c = out_ch
+    if h <= 0 or w <= 0:
+        raise ValueError(
+            f"conv_filters {spec.conv_filters} collapse a {spec.obs_shape} input"
+        )
+    return h * w * c
+
+
 def init_params(rng, spec: RLModuleSpec):
-    """Orthogonal-init MLP torso + policy and value heads (the reference's
-    default FCNet, rllib/models/torch/fcnet.py, in functional form)."""
+    """Orthogonal-init torso (conv stack for image obs, reference VisionNet;
+    MLP otherwise, reference FCNet) + policy and value heads, functional."""
     import jax
     import jax.numpy as jnp
 
@@ -52,9 +97,23 @@ def init_params(rng, spec: RLModuleSpec):
         w = jax.nn.initializers.orthogonal(scale)(key, (din, dout), jnp.float32)
         return {"w": w, "b": jnp.zeros((dout,), jnp.float32)}
 
-    keys = jax.random.split(rng, len(spec.hiddens) * 2 + 3)
+    def conv(key, cin, cout, k):
+        w = jax.nn.initializers.orthogonal(np.sqrt(2))(key, (k, k, cin, cout), jnp.float32)
+        return {"w": w, "b": jnp.zeros((cout,), jnp.float32)}
+
+    n_conv = len(spec.conv_filters)
+    keys = jax.random.split(rng, (len(spec.hiddens) + n_conv) * 2 + 3)
     params = {"pi": [], "vf": []}
-    din = spec.obs_dim
+    if n_conv:
+        params["pi_conv"], params["vf_conv"] = [], []
+        cin = spec.obs_shape[2]
+        for i, (cout, k, _s) in enumerate(spec.conv_filters):
+            params["pi_conv"].append(conv(keys[2 * (len(spec.hiddens) + i)], cin, cout, k))
+            params["vf_conv"].append(conv(keys[2 * (len(spec.hiddens) + i) + 1], cin, cout, k))
+            cin = cout
+        din = _conv_out_dim(spec)
+    else:
+        din = spec.obs_dim
     for i, h in enumerate(spec.hiddens):
         params["pi"].append(dense(keys[2 * i], din, h, np.sqrt(2)))
         params["vf"].append(dense(keys[2 * i + 1], din, h, np.sqrt(2)))
@@ -74,14 +133,32 @@ def _mlp(layers, x, act):
     return x
 
 
+def _conv_torso(layers, x, spec: RLModuleSpec, act):
+    """NHWC conv stack -> flat features (VALID padding, per-filter stride)."""
+    import jax
+
+    x = x.reshape((x.shape[0],) + spec.obs_shape)
+    for layer, (_cout, _k, s) in zip(layers, spec.conv_filters):
+        x = jax.lax.conv_general_dilated(
+            x, layer["w"], window_strides=(s, s), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        x = act(x + layer["b"])
+    return x.reshape(x.shape[0], -1)
+
+
 def forward(params, obs, spec: RLModuleSpec):
     """Returns (pi_out, value). pi_out: logits (discrete) or mean (cont)."""
     import jax.numpy as jnp
 
     act = _act(spec.activation)
-    obs = obs.reshape(obs.shape[0], -1)
-    hpi = _mlp(params["pi"], obs, act)
-    hvf = _mlp(params["vf"], obs, act)
+    if spec.conv_filters:
+        hpi = _conv_torso(params["pi_conv"], obs, spec, act)
+        hvf = _conv_torso(params["vf_conv"], obs, spec, act)
+    else:
+        hpi = hvf = obs.reshape(obs.shape[0], -1)
+    hpi = _mlp(params["pi"], hpi, act)
+    hvf = _mlp(params["vf"], hvf, act)
     pi_out = hpi @ params["pi_out"]["w"] + params["pi_out"]["b"]
     value = (hvf @ params["vf_out"]["w"] + params["vf_out"]["b"])[:, 0]
     return pi_out, value

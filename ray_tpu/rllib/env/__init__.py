@@ -1,1 +1,8 @@
-from ray_tpu.rllib.env.vector_env import EnvContext, VectorEnv  # noqa: F401
+from ray_tpu.rllib.env.multi_agent_env import MultiAgentEnv, make_multi_agent  # noqa: F401
+from ray_tpu.rllib.env.vector_env import (  # noqa: F401
+    EnvContext,
+    MultiAgentVectorEnv,
+    VectorEnv,
+    make_vector_env,
+)
+from ray_tpu.rllib.env.policy_server import PolicyClient, PolicyServerInput  # noqa: F401

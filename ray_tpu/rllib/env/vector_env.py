@@ -109,3 +109,129 @@ class VectorEnv:
                 env.close()
             except Exception:
                 pass
+
+
+class MultiAgentVectorEnv:
+    """Slot-flattened multi-agent stepping: every (env, agent) pair is one
+    vector slot, so the single-policy rollout path (GAE over fragments,
+    shared parameters — the reference's default policy mapping) works
+    unchanged on MultiAgentEnvs.
+
+    Two supported termination shapes (see multi_agent_env.py):
+    - ``agent_auto_reset`` envs keep every agent live (independent copies);
+    - lockstep envs end all agents together via ``terminateds["__all__"]``.
+    Envs where agents die at different times without auto-reset are not
+    representable as fixed slots; use lockstep design or the wrapper.
+    """
+
+    def __init__(self, env_spec, num_envs: int, config: Optional[dict] = None,
+                 worker_index: int = 0, seed: Optional[int] = None):
+        self.envs = [
+            _make_env(env_spec, EnvContext(config or {}, worker_index, i))
+            for i in range(num_envs)
+        ]
+        self.agents = list(self.envs[0].possible_agents)
+        self.n_agents = len(self.agents)
+        self.num_envs = num_envs * self.n_agents  # slots
+        self._auto = bool(getattr(self.envs[0], "agent_auto_reset", False))
+        self._eps_ids = np.arange(self.num_envs, dtype=np.int64)
+        self._next_eps_id = self.num_envs
+        self._episode_rewards = np.zeros(self.num_envs, dtype=np.float64)
+        self._episode_lens = np.zeros(self.num_envs, dtype=np.int64)
+        self.completed_rewards: List[float] = []
+        self.completed_lens: List[int] = []
+        obs = []
+        for i, env in enumerate(self.envs):
+            # Stride env seeds so per-agent offsets inside one env can't
+            # collide with a sibling env's agents.
+            od, _ = env.reset(seed=None if seed is None else seed + i * 1000003)
+            obs += [od[a] for a in self.agents]
+        self._obs = np.stack(obs)
+
+    @property
+    def observation_space(self):
+        return self.envs[0].observation_space
+
+    @property
+    def action_space(self):
+        return self.envs[0].action_space
+
+    def current_obs(self) -> np.ndarray:
+        return self._obs
+
+    def eps_ids(self) -> np.ndarray:
+        return self._eps_ids.copy()
+
+    def _slot(self, env_i: int, agent_i: int) -> int:
+        return env_i * self.n_agents + agent_i
+
+    def step(self, actions: np.ndarray):
+        next_obs = [None] * self.num_envs
+        rewards = np.zeros(self.num_envs, np.float32)
+        dones = np.zeros(self.num_envs, bool)
+        infos: list = [{} for _ in range(self.num_envs)]
+        for e, env in enumerate(self.envs):
+            action_dict = {
+                a: np.asarray(actions[self._slot(e, i)]) for i, a in enumerate(self.agents)
+            }
+            od, rd, td, cd, infod = env.step(action_dict)
+            all_done = bool(td.get("__all__", False) or cd.get("__all__", False))
+            if all_done and not self._auto:
+                reset_obs, _ = env.reset()
+            for i, a in enumerate(self.agents):
+                s = self._slot(e, i)
+                r = float(rd.get(a, 0.0))
+                done = bool(td.get(a, False) or cd.get(a, False) or all_done)
+                rewards[s] = r
+                dones[s] = done
+                info = dict(infod.get(a, {}))
+                info["terminated"] = bool(td.get(a, False) or (all_done and not cd.get(a, False)))
+                info["truncated"] = bool(cd.get(a, False))
+                self._episode_rewards[s] += r
+                self._episode_lens[s] += 1
+                if done:
+                    # Prefer the env-provided terminal obs (auto-resetting
+                    # envs already replaced od[a] with the fresh episode's
+                    # first obs).
+                    info.setdefault("final_observation", od.get(a, self._obs[s]))
+                    self.completed_rewards.append(float(self._episode_rewards[s]))
+                    self.completed_lens.append(int(self._episode_lens[s]))
+                    self._episode_rewards[s] = 0.0
+                    self._episode_lens[s] = 0
+                    self._eps_ids[s] = self._next_eps_id
+                    self._next_eps_id += 1
+                if all_done and not self._auto:
+                    next_obs[s] = reset_obs[a]
+                else:
+                    next_obs[s] = od.get(a, self._obs[s])
+                infos[s] = info
+        self._obs = np.stack(next_obs)
+        return self._obs, rewards, dones, infos
+
+    def pop_episode_stats(self):
+        r, l = self.completed_rewards, self.completed_lens
+        self.completed_rewards, self.completed_lens = [], []
+        return r, l
+
+    def close(self):
+        for env in self.envs:
+            try:
+                env.close()
+            except Exception:
+                pass
+
+
+def make_vector_env(env_spec, num_envs: int, config: Optional[dict] = None,
+                    worker_index: int = 0, seed: Optional[int] = None):
+    """VectorEnv for gym envs, MultiAgentVectorEnv for MultiAgentEnvs
+    (probed by building one instance)."""
+    from ray_tpu.rllib.env.multi_agent_env import MultiAgentEnv
+
+    probe = _make_env(env_spec, EnvContext(config or {}, worker_index, 0))
+    is_multi = isinstance(probe, MultiAgentEnv)
+    try:
+        probe.close()
+    except Exception:
+        pass
+    cls = MultiAgentVectorEnv if is_multi else VectorEnv
+    return cls(env_spec, num_envs, config, worker_index, seed=seed)

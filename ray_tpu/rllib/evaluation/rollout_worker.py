@@ -15,7 +15,7 @@ import numpy as np
 
 import ray_tpu
 from ray_tpu.rllib.core import rl_module
-from ray_tpu.rllib.env.vector_env import VectorEnv
+from ray_tpu.rllib.env.vector_env import make_vector_env
 from ray_tpu.rllib.policy.sample_batch import (
     ACTIONS,
     DONES,
@@ -42,7 +42,9 @@ class RolloutWorker:
         import jax
 
         jax.config.update("jax_platforms", "cpu")  # rollouts stay off-chip
-        self.env = VectorEnv(env_spec, num_envs, env_config, worker_index, seed=seed + worker_index * 1000)
+        # make_vector_env flattens MultiAgentEnvs into per-agent slots
+        # (shared-policy training, reference's default policy mapping).
+        self.env = make_vector_env(env_spec, num_envs, env_config, worker_index, seed=seed + worker_index * 1000)
         self.spec = spec
         self.obs_filter = None
         self._filter_delta = None

@@ -1,0 +1,114 @@
+"""`rllib train` CLI (reference: rllib/train.py + rllib/scripts.py).
+
+    python -m ray_tpu.rllib train --run PPO --env CartPole-v1 \
+        --stop-reward 150 --stop-iters 50 --config '{"lr": 3e-4}'
+    python -m ray_tpu.rllib evaluate --run PPO --env CartPole-v1 \
+        --checkpoint /path/to/ckpt --episodes 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _algo_class(name: str):
+    import ray_tpu.rllib as rllib
+
+    cls = getattr(rllib, name.upper(), None) or getattr(rllib, name, None)
+    if cls is None:
+        raise SystemExit(f"unknown algorithm {name!r}; available: "
+                         "PPO, APPO, IMPALA, A2C, DQN, SAC, DDPG, TD3, ES, BC, MARWIL, CQL")
+    return cls
+
+
+def _build(args) -> tuple:
+    import ray_tpu
+
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    cls = _algo_class(args.run)
+    cfg = cls.get_default_config().environment(args.env)
+    for key, value in (json.loads(args.config) if args.config else {}).items():
+        if hasattr(cfg, key):
+            setattr(cfg, key, value)
+        else:
+            cfg.extra[key] = value
+    algo = cfg.build()  # Trainable.__init__ runs setup()
+    return algo, cfg
+
+
+def cmd_train(args) -> int:
+    algo, _ = _build(args)
+    try:
+        for i in range(args.stop_iters):
+            result = algo.step()
+            reward = result.get("episode_reward_mean", float("nan"))
+            print(f"iter {i + 1}: reward={reward:.2f} "
+                  f"timesteps={result.get('timesteps_total', 0)}")
+            if args.stop_reward is not None and reward >= args.stop_reward:
+                print(f"stop-reward {args.stop_reward} reached")
+                break
+            if args.stop_timesteps and result.get("timesteps_total", 0) >= args.stop_timesteps:
+                break
+        if args.checkpoint_out:
+            ckpt = algo.save_checkpoint()
+            ckpt.to_directory(args.checkpoint_out)
+            print(f"checkpoint written to {args.checkpoint_out}")
+    finally:
+        algo.cleanup()
+    return 0
+
+
+def cmd_evaluate(args) -> int:
+    import gymnasium as gym
+    import numpy as np
+
+    algo, _ = _build(args)
+    try:
+        if args.checkpoint:
+            from ray_tpu.air.checkpoint import Checkpoint
+
+            algo.load_checkpoint(Checkpoint.from_directory(args.checkpoint))
+        env = gym.make(args.env)
+        rewards = []
+        for ep in range(args.episodes):
+            obs, _ = env.reset(seed=ep)
+            total, done = 0.0, False
+            while not done:
+                action = algo.compute_single_action(obs, explore=False)
+                obs, r, term, trunc, _ = env.step(action)
+                total += float(r)
+                done = term or trunc
+            rewards.append(total)
+            print(f"episode {ep + 1}: reward={total:.2f}")
+        print(f"mean reward over {len(rewards)} episodes: {np.mean(rewards):.2f}")
+        env.close()
+    finally:
+        algo.cleanup()
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="rllib", description=__doc__)
+    sub = parser.add_subparsers(dest="command", required=True)
+    for name in ("train", "evaluate"):
+        p = sub.add_parser(name)
+        p.add_argument("--run", required=True, help="algorithm name, e.g. PPO")
+        p.add_argument("--env", required=True, help="gym env id or registered env")
+        p.add_argument("--config", default=None, help="JSON config overrides")
+    t = sub.choices["train"]
+    t.add_argument("--stop-iters", type=int, default=100)
+    t.add_argument("--stop-reward", type=float, default=None)
+    t.add_argument("--stop-timesteps", type=int, default=None)
+    t.add_argument("--checkpoint-out", default=None)
+    e = sub.choices["evaluate"]
+    e.add_argument("--checkpoint", default=None)
+    e.add_argument("--episodes", type=int, default=5)
+    args = parser.parse_args(argv)
+    return cmd_train(args) if args.command == "train" else cmd_evaluate(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -264,7 +264,17 @@ class TuneController:
         while len(self._live_trials()) < cap:
             pending = [t for t in self.trials if t.status in (PENDING, PAUSED)]
             if pending:
-                t = self.scheduler.choose_trial_to_run(self) or pending[0]
+                t = self.scheduler.choose_trial_to_run(self)
+                if t is None:
+                    # Scheduler is gating the paused trials (e.g. sync
+                    # HyperBand mid-rung). Try topping up with a fresh trial;
+                    # otherwise respect the gate while work is running, but
+                    # with nothing running force progress to avoid deadlock.
+                    if self._maybe_add_trial():
+                        continue
+                    if self._live_trials():
+                        break
+                    t = pending[0]
                 self._start_trial(t)
                 continue
             if not self._maybe_add_trial():

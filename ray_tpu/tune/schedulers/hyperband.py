@@ -135,3 +135,23 @@ class HyperBandScheduler(TrialScheduler):
 
     def on_trial_error(self, controller, trial):
         self.on_trial_complete(controller, trial, {})
+
+    def choose_trial_to_run(self, controller):
+        """PENDING trials fill brackets; a PAUSED trial is resumable ONLY
+        after its rung halved (its id left bracket.results) — resuming
+        earlier would run it past the milestone while rung-mates are still
+        below it, breaking the synchronous halving invariant."""
+        from ray_tpu.tune.experiment.trial import PAUSED, PENDING
+
+        for t in controller.trials:
+            if t.status == PENDING:
+                return t
+        for t in controller.trials:
+            if t.status != PAUSED:
+                continue
+            b = self._trial_bracket.get(t.trial_id)
+            if b is None:
+                return t
+            if t.trial_id not in b.dropped and t.trial_id not in b.results:
+                return t
+        return None

@@ -63,9 +63,12 @@ class PB2(PopulationBasedTraining):
                 v = math.exp(math.log(lo) + float(u) * (math.log(hi) - math.log(lo)))
             else:
                 v = lo + float(u) * (hi - lo)
+            v = min(max(v, lo), hi)  # clamp to the declared box, nothing else
             spec = self.mutations[k]
-            if isinstance(spec, s.Integer) or isinstance(new.get(k), int) and not isinstance(new.get(k), bool):
-                v = max(1, int(round(v)))
+            if isinstance(spec, s.Integer) or (
+                isinstance(new.get(k), int) and not isinstance(new.get(k), bool)
+            ):
+                v = min(max(int(round(v)), int(math.ceil(lo))), int(math.floor(hi)))
             new[k] = v
         return new
 
