@@ -181,6 +181,16 @@ def main():
         level=logging.INFO,
         format=f"[worker %(process)d] %(levelname)s %(name)s: %(message)s",
     )
+    # `ray_tpu stack` sends SIGUSR1; the dump lands in this worker's .err log
+    # (the reference shells out to py-spy from the dashboard agent — not in
+    # this image, so workers self-report via faulthandler).
+    try:
+        import faulthandler
+        import signal
+
+        faulthandler.register(signal.SIGUSR1, file=sys.stderr, all_threads=True)
+    except Exception:
+        pass
     _apply_runtime_env(os.environ.get("RAY_TPU_RUNTIME_ENV"))
     worker_id = os.environ["RAY_TPU_WORKER_ID"]
     node_id = os.environ["RAY_TPU_NODE_ID"]

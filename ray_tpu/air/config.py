@@ -48,6 +48,7 @@ class RunConfig:
     checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
     stop: dict | None = None
     verbose: int = 1
+    sync_config: object | None = None  # tune.syncer.SyncConfig (kept untyped: air must not import tune)
 
     def resolve_dir(self, default_name: str) -> str:
         """Experiment/run directory: <storage_path>/<name> (single source of

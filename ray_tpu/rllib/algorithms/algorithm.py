@@ -94,6 +94,13 @@ class AlgorithmConfig:
             self.seed = seed
         return self
 
+    def model_config(self) -> dict:
+        """Catalog-shaped model config (reference: config.model dict)."""
+        return {
+            "fcnet_hiddens": self.model_hiddens,
+            "conv_filters": self.model_conv_filters,
+        }
+
     def to_dict(self) -> dict:
         return dict(self.__dict__)
 
@@ -139,9 +146,10 @@ class Algorithm(Trainable):
         import gymnasium as gym
 
         probe = gym.make(cfg.env) if isinstance(cfg.env, str) else cfg.env(dict(cfg.env_config))
-        self.module_spec = RLModuleSpec.from_spaces(
-            probe.observation_space, probe.action_space, cfg.model_hiddens,
-            conv_filters=cfg.model_conv_filters,
+        from ray_tpu.rllib.models import ModelCatalog
+
+        self.module_spec = ModelCatalog.get_model_spec(
+            probe.observation_space, probe.action_space, cfg.model_config()
         )
         probe.close()
         self.workers = WorkerSet(

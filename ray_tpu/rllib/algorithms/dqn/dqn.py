@@ -95,9 +95,11 @@ class DQN(Algorithm):
         import gymnasium as gym
 
         probe = gym.make(cfg.env) if isinstance(cfg.env, str) else cfg.env(dict(cfg.env_config))
-        from ray_tpu.rllib.core.rl_module import RLModuleSpec
+        from ray_tpu.rllib.models import ModelCatalog
 
-        self.module_spec = RLModuleSpec.from_spaces(probe.observation_space, probe.action_space, cfg.model_hiddens)
+        self.module_spec = ModelCatalog.get_model_spec(
+            probe.observation_space, probe.action_space, cfg.model_config()
+        )
         assert self.module_spec.discrete, "DQN requires a discrete action space"
         probe.close()
         self.env = VectorEnv(cfg.env, max(cfg.num_envs_per_worker, 1), cfg.env_config, 0, seed=cfg.seed)

@@ -145,7 +145,11 @@ class ES(Algorithm):
         self.cleanup()
         cfg: ESConfig = self._algo_config
         probe = gym.make(cfg.env) if isinstance(cfg.env, str) else cfg.env(dict(cfg.env_config))
-        self.module_spec = RLModuleSpec.from_spaces(probe.observation_space, probe.action_space, cfg.model_hiddens)
+        from ray_tpu.rllib.models import ModelCatalog
+
+        self.module_spec = ModelCatalog.get_model_spec(
+            probe.observation_space, probe.action_space, cfg.model_config()
+        )
         probe.close()
         from ray_tpu.rllib.core import rl_module
 

@@ -79,6 +79,19 @@ class PolicyServerInput:
         self._thread.start()
 
     def _dispatch(self, path: str, payload: dict) -> dict:
+        if path == "/get_action":
+            # Policy forward OUTSIDE the lock — it can take milliseconds and
+            # must not serialize unrelated clients/episodes.
+            obs = np.asarray(payload["observation"], np.float32)
+            action = self.compute_action(obs, bool(payload.get("explore", True)))
+            with self._lock:
+                ep = self._episodes.get(payload.get("episode_id", ""))
+                if ep is None:
+                    raise KeyError(f"unknown episode {payload.get('episode_id')!r}")
+                ep.obs.append(obs)
+                ep.actions.append(np.asarray(action))
+                ep.rewards.append(0.0)  # accumulated by log_returns
+            return {"action": np.asarray(action).tolist()}
         with self._lock:
             if path == "/start_episode":
                 eid = payload.get("episode_id") or uuid.uuid4().hex[:12]
@@ -88,13 +101,6 @@ class PolicyServerInput:
             ep = self._episodes.get(payload.get("episode_id", ""))
             if ep is None:
                 raise KeyError(f"unknown episode {payload.get('episode_id')!r}")
-            if path == "/get_action":
-                obs = np.asarray(payload["observation"], np.float32)
-                action = self.compute_action(obs, bool(payload.get("explore", True)))
-                ep.obs.append(obs)
-                ep.actions.append(np.asarray(action))
-                ep.rewards.append(0.0)  # accumulated by log_returns
-                return {"action": np.asarray(action).tolist()}
             if path == "/log_action":
                 # Client-side action (off-policy logging).
                 ep.obs.append(np.asarray(payload["observation"], np.float32))

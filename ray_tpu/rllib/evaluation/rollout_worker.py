@@ -45,6 +45,10 @@ class RolloutWorker:
         # make_vector_env flattens MultiAgentEnvs into per-agent slots
         # (shared-policy training, reference's default policy mapping).
         self.env = make_vector_env(env_spec, num_envs, env_config, worker_index, seed=seed + worker_index * 1000)
+        # Slot multiplier (n_agents for multi-agent envs): sample() divides
+        # requested steps by it so the row count an algorithm asked for via
+        # train_batch_size stays agent-count-invariant.
+        self._rows_per_step = max(1, self.env.num_envs // max(num_envs, 1))
         self.spec = spec
         self.obs_filter = None
         self._filter_delta = None
@@ -77,6 +81,7 @@ class RolloutWorker:
         import jax
 
         assert self._params is not None, "set_weights before sample"
+        num_steps = max(1, num_steps // self._rows_per_step)
         n_envs = self.env.num_envs
         cols: dict = {k: [] for k in (OBS, ACTIONS, REWARDS, DONES, LOGPS, VF_PREDS, EPS_ID)}
         for _ in range(num_steps):

@@ -424,6 +424,17 @@ def cmd_serve(args):
             # (reference: `serve run` shuts down on interrupt).
             print("Shutting down serve...")
             serve.shutdown()
+    elif args.serve_cmd == "deploy":
+        from ray_tpu.serve.schema import apply_config, load_config
+
+        config = load_config(args.config_file)
+        routes = apply_config(config)
+        host, port = serve.http_address()
+        for name, route in routes.items():
+            if route:
+                print(f"deployed application {name!r} at http://{host}:{port}{route}")
+            else:
+                print(f"deployed application {name!r} (no HTTP route; use a deployment handle)")
     elif args.serve_cmd == "status":
         for name, st in serve.status().items():
             print(
@@ -438,6 +449,59 @@ def cmd_serve(args):
 # ----------------------------------------------------------------------
 # chaos (reference: `ray kill-random-node`, scripts.py:1337)
 # ----------------------------------------------------------------------
+
+
+def cmd_stack(args):
+    """Dump Python stacks of every live local worker (reference: `ray stack`,
+    scripts.py:1786, which shells out to py-spy; here workers self-report via
+    a faulthandler SIGUSR1 handler into their .err logs)."""
+    import glob
+
+    import psutil
+
+    workers = [
+        p for p in psutil.process_iter(["pid", "cmdline"])
+        if any("ray_tpu._private.worker_main" in (c or "") for c in (p.info["cmdline"] or []))
+    ]
+    if not workers:
+        print("no live ray_tpu workers on this host")
+        return
+    session_dirs = sorted(glob.glob("/tmp/ray_tpu/session_*/logs"), reverse=True)
+    err_files = (
+        sorted(glob.glob(os.path.join(session_dirs[0], "worker-*.err"))) if session_dirs else []
+    )
+    # Snapshot sizes BEFORE signalling so only freshly-appended dumps are
+    # shown — stale blocks from an earlier `stack` run must not masquerade
+    # as live stacks.
+    offsets = {}
+    for err in err_files:
+        try:
+            offsets[err] = os.path.getsize(err)
+        except OSError:
+            offsets[err] = 0
+    signalled = 0
+    for p in workers:
+        try:
+            p.send_signal(signal.SIGUSR1)
+            signalled += 1
+        except psutil.Error:
+            pass
+    time.sleep(0.5)  # let faulthandler flush
+    shown = 0
+    for err in err_files:
+        try:
+            with open(err, "rb") as f:
+                f.seek(offsets.get(err, 0))
+                fresh = f.read().decode(errors="replace")
+        except OSError:
+            continue
+        if "Thread 0x" not in fresh and "Current thread" not in fresh:
+            continue
+        print(f"=== {os.path.basename(err)} ===")
+        print(fresh.strip())
+        print()
+        shown += 1
+    print(f"stacks from {shown} workers ({signalled} signalled)")
 
 
 def cmd_kill_random_node(args):
@@ -613,10 +677,16 @@ def main(argv=None):
     sr.add_argument("import_path", help="module:bound_app, e.g. my_app:app")
     sr.add_argument("--address", default=None)
     sr.add_argument("--route-prefix", default=None)
+    sd = ssub.add_parser("deploy", help="deploy applications from a YAML/JSON config")
+    sd.add_argument("config_file")
+    sd.add_argument("--address", default=None)
     for name in ("status", "shutdown"):
         sp2 = ssub.add_parser(name)
         sp2.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser("stack", help="dump Python stacks of local workers")
+    p.set_defaults(fn=cmd_stack)
 
     p = sub.add_parser("kill-random-node", help="chaos: SIGKILL a random local worker node (never the head)")
     p.set_defaults(fn=cmd_kill_random_node)

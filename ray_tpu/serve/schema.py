@@ -1,0 +1,156 @@
+"""Serve config schema (analog of reference python/ray/serve/schema.py).
+
+Pydantic models for the declarative multi-application deploy config that
+`serve deploy <file>` consumes (the reference posts the same shape to the
+dashboard's REST API; here the CLI — already a driver — applies it
+directly, and the dashboard exposes read-only serve state).
+
+Example config (YAML or JSON):
+
+    applications:
+      - name: default
+        import_path: my_module:app
+        route_prefix: /
+        deployments:
+          - name: Model
+            num_replicas: 2
+            max_concurrent_queries: 16
+            autoscaling_config:
+              min_replicas: 1
+              max_replicas: 4
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from pydantic import BaseModel, Field, field_validator
+
+
+class AutoscalingConfigSchema(BaseModel):
+    min_replicas: int = 1
+    max_replicas: int = 1
+    target_num_ongoing_requests_per_replica: float = 1.0
+    upscale_delay_s: float = 0.0
+    downscale_delay_s: float = 0.0
+
+
+class DeploymentSchema(BaseModel):
+    name: str
+    num_replicas: Optional[int] = None
+    max_concurrent_queries: Optional[int] = None
+    user_config: Optional[Any] = None
+    ray_actor_options: Optional[dict] = None
+    autoscaling_config: Optional[AutoscalingConfigSchema] = None
+
+
+class ServeApplicationSchema(BaseModel):
+    name: str = "default"
+    import_path: str
+    route_prefix: Optional[str] = None
+    args: dict = Field(default_factory=dict)
+    deployments: list[DeploymentSchema] = Field(default_factory=list)
+
+    @field_validator("import_path")
+    @classmethod
+    def _check_import_path(cls, v: str) -> str:
+        if ":" not in v and "." not in v:
+            raise ValueError(
+                f"import_path {v!r} must look like 'module:attribute'"
+            )
+        return v
+
+
+class ServeDeploySchema(BaseModel):
+    applications: list[ServeApplicationSchema]
+
+    @field_validator("applications")
+    @classmethod
+    def _unique_names(cls, v):
+        names = [a.name for a in v]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate application names in config: {names}")
+        return v
+
+
+def load_config(path: str) -> ServeDeploySchema:
+    """Parse + validate a YAML/JSON deploy config file."""
+    import json
+
+    with open(path) as f:
+        text = f.read()
+    if path.endswith((".yaml", ".yml")):
+        import yaml
+
+        data = yaml.safe_load(text)
+    else:
+        data = json.loads(text)
+    return ServeDeploySchema(**data)
+
+
+def _apply_overrides(app, overrides: dict, used: set):
+    """Rebuild the Application tree with config overrides applied — bound
+    deployments can nest inside init args (Ingress.bind(Model.bind()))."""
+    from ray_tpu import serve
+
+    def rebuild(node):
+        if isinstance(node, serve.Application):
+            dep = node.deployment
+            override = overrides.get(dep.name)
+            if override is not None:
+                used.add(dep.name)
+                dep = dep.options(
+                    num_replicas=override.num_replicas,
+                    max_concurrent_queries=override.max_concurrent_queries,
+                    user_config=override.user_config,
+                    ray_actor_options=override.ray_actor_options,
+                    autoscaling_config=(
+                        override.autoscaling_config.model_dump()
+                        if override.autoscaling_config is not None
+                        else None
+                    ),
+                )
+            return serve.Application(
+                dep,
+                tuple(rebuild(a) for a in node.init_args),
+                {k: rebuild(v) for k, v in node.init_kwargs.items()},
+            )
+        return node
+
+    return rebuild(app)
+
+
+def apply_config(config: ServeDeploySchema) -> dict:
+    """Deploy every application in the config (CLI-side analog of the
+    reference controller's deploy_apps). Returns {app_name: route_prefix};
+    a None route means the app is handle-only (no HTTP route registered)."""
+    import importlib
+    import os
+    import sys
+
+    from ray_tpu import serve
+
+    routes = {}
+    if os.getcwd() not in sys.path:
+        sys.path.insert(0, os.getcwd())
+    for app_schema in config.applications:
+        mod_name, _, attr = app_schema.import_path.partition(":")
+        app = getattr(importlib.import_module(mod_name), attr or "app")
+        overrides = {d.name: d for d in app_schema.deployments}
+        used: set = set()
+        app = _apply_overrides(app, overrides, used)
+        unknown = set(overrides) - used
+        if unknown:
+            raise ValueError(
+                f"config for app {app_schema.name!r} overrides deployments "
+                f"{sorted(unknown)} that do not exist in the application"
+            )
+        serve.run(
+            app,
+            name=app_schema.name,
+            route_prefix=app_schema.route_prefix or "__from_deployment__",
+            _blocking=True,
+        )
+        # Report only routes that were actually registered.
+        routes[app_schema.name] = app_schema.route_prefix or app.deployment.route_prefix
+    return routes

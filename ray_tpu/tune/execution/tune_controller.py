@@ -84,6 +84,7 @@ class TuneController:
         experiment_dir: str | None = None,
         experiment_name: str = "exp",
         checkpoint_frequency: int = 1,
+        sync_config=None,
     ):
         if isinstance(trainable, type) and issubclass(trainable, Trainable):
             self.trainable_cls = trainable
@@ -104,6 +105,11 @@ class TuneController:
         self.experiment_dir = experiment_dir
         self.experiment_name = experiment_name
         self.checkpoint_frequency = checkpoint_frequency
+        self._sync_manager = None
+        if sync_config is not None and experiment_dir:
+            from ray_tpu.tune.syncer import SyncManager
+
+            self._sync_manager = SyncManager(sync_config, experiment_dir, experiment_name)
 
         self.trials: list[Trial] = []
         self._searcher_done = False
@@ -318,10 +324,14 @@ class TuneController:
             while not self.is_finished():
                 self.step()
                 self.save_experiment_state()
+                if self._sync_manager is not None:
+                    self._sync_manager.maybe_sync_up()
         finally:
             for t in self._live_trials():
                 self._stop_trial(t, TERMINATED)
             self.save_experiment_state()
+            if self._sync_manager is not None:
+                self._sync_manager.maybe_sync_up(force=True)
         return self.trials
 
     # -- persistence (reference: execution/experiment_state.py) -------------

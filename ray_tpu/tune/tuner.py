@@ -91,6 +91,7 @@ class Tuner:
             resources_per_trial=self._resources_per_trial,
             experiment_dir=exp_dir,
             experiment_name=self.run_config.name or "exp",
+            sync_config=self.run_config.sync_config,
         )
         if self._restore_state is not None:
             self._seed_from_restore(controller)

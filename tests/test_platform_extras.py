@@ -1,0 +1,188 @@
+"""Tests: serve deploy config schema, tune syncer, dask-graph scheduler,
+ray stack CLI.
+
+Reference analogs: serve/tests/test_schema.py + test_cli.py,
+tune/tests/test_syncer.py, util/dask tests, `ray stack`.
+"""
+
+import json
+import os
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def ray_start_regular():
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    try:
+        yield
+    finally:
+        ray_tpu.shutdown()
+
+
+# ---------- serve schema + deploy ----------
+
+def test_serve_schema_validation(tmp_path):
+    from ray_tpu.serve.schema import ServeDeploySchema, load_config
+
+    cfg = ServeDeploySchema(applications=[
+        {"name": "a", "import_path": "mod:app"},
+        {"name": "b", "import_path": "mod2:app",
+         "deployments": [{"name": "D", "num_replicas": 2,
+                          "autoscaling_config": {"min_replicas": 1, "max_replicas": 3}}]},
+    ])
+    assert cfg.applications[1].deployments[0].autoscaling_config.max_replicas == 3
+    with pytest.raises(Exception):
+        ServeDeploySchema(applications=[
+            {"name": "x", "import_path": "m:app"},
+            {"name": "x", "import_path": "m2:app"},
+        ])
+    with pytest.raises(Exception):
+        ServeDeploySchema(applications=[{"name": "a", "import_path": "noseparator"}])
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps({"applications": [{"name": "a", "import_path": "m:app"}]}))
+    assert load_config(str(p)).applications[0].name == "a"
+
+
+def test_serve_deploy_from_config(ray_start_regular, tmp_path):
+    from ray_tpu.serve.schema import apply_config, load_config
+
+    app_mod = tmp_path / "my_serve_app.py"
+    app_mod.write_text(textwrap.dedent("""
+        from ray_tpu import serve
+
+        @serve.deployment(route_prefix="/echo")
+        class Echo:
+            def __call__(self, request):
+                return {"echo": request.query_params.get("q", "")}
+
+        app = Echo.bind()
+    """))
+    cfg_file = tmp_path / "deploy.json"
+    cfg_file.write_text(json.dumps({
+        "applications": [{
+            "name": "echo_app",
+            "import_path": "my_serve_app:app",
+            "route_prefix": "/echo",
+            "deployments": [{"name": "Echo", "num_replicas": 2}],
+        }]
+    }))
+    sys.path.insert(0, str(tmp_path))
+    try:
+        from ray_tpu import serve
+
+        routes = apply_config(load_config(str(cfg_file)))
+        assert routes == {"echo_app": "/echo"}
+        st = serve.status()
+        assert st["Echo"]["num_replicas"] == 2
+        import urllib.request
+
+        host, port = serve.http_address()
+        with urllib.request.urlopen(f"http://{host}:{port}/echo?q=hi", timeout=10) as r:
+            assert json.loads(r.read())["echo"] == "hi"
+        serve.shutdown()
+    finally:
+        sys.path.remove(str(tmp_path))
+
+
+# ---------- tune syncer ----------
+
+def test_syncer_local_roundtrip(tmp_path):
+    from ray_tpu.tune.syncer import SyncConfig, SyncManager, get_syncer
+
+    src = tmp_path / "exp"
+    (src / "sub").mkdir(parents=True)
+    (src / "state.json").write_text("{}")
+    (src / "sub" / "ckpt.bin").write_bytes(b"\x00" * 64)
+    mgr = SyncManager(SyncConfig(upload_dir=str(tmp_path / "remote"), sync_period_s=0),
+                      str(src), "exp1")
+    assert mgr.enabled and mgr.maybe_sync_up(force=True)
+    assert (tmp_path / "remote" / "exp1" / "state.json").exists()
+    assert (tmp_path / "remote" / "exp1" / "sub" / "ckpt.bin").read_bytes() == b"\x00" * 64
+    # Cloud schemes are gated with guidance.
+    with pytest.raises(ValueError, match="cloud"):
+        get_syncer("s3://bucket/path")
+
+
+def test_tuner_syncs_experiment_dir(ray_start_regular, tmp_path):
+    from ray_tpu import tune
+    from ray_tpu.air.config import RunConfig
+    from ray_tpu.tune.syncer import SyncConfig
+
+    def trainable(config):
+        tune.report({"score": config["x"]})
+
+    results = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 2])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(
+            name="sync_exp", storage_path=str(tmp_path / "local"),
+            sync_config=SyncConfig(upload_dir=str(tmp_path / "up"), sync_period_s=0),
+        ),
+    ).fit()
+    assert len(results) == 2
+    assert (tmp_path / "up" / "sync_exp" / "experiment_state.json").exists()
+
+
+# ---------- dask-on-ray_tpu ----------
+
+def test_dask_graph_scheduler(ray_start_regular):
+    from operator import add, mul
+
+    from ray_tpu.util.dask import ray_tpu_dask_get
+
+    dsk = {
+        "a": 1,
+        "b": (add, "a", 2),          # 3
+        "c": (mul, "b", "b"),        # 9
+        "d": (sum, ["a", "b", "c"]),  # 13 — list of keys
+        "e": (add, (mul, "a", 10), "b"),  # nested inline task: 13
+    }
+    assert ray_tpu_dask_get(dsk, "c") == 9
+    assert ray_tpu_dask_get(dsk, ["c", "d", "e"]) == [9, 13, 13]
+    with pytest.raises(ValueError, match="cycle|missing"):
+        ray_tpu_dask_get({"x": (add, "y", 1), "y": (add, "x", 1)}, "x")
+
+
+def test_dask_scheduler_moves_arrays_through_store(ray_start_regular):
+    from ray_tpu.util.dask import ray_tpu_dask_get
+
+    def make(n):
+        return np.ones(n)
+
+    def total(x, y):
+        return float(x.sum() + y.sum())
+
+    dsk = {
+        "x": (make, 200_000),
+        "y": (make, 100_000),
+        "t": (total, "x", "y"),
+    }
+    assert ray_tpu_dask_get(dsk, "t") == 300_000.0
+
+
+# ---------- ray stack ----------
+
+def test_ray_stack_cli(ray_start_regular, capsys):
+    from ray_tpu.scripts.scripts import cmd_stack
+
+    @ray_tpu.remote
+    class Sleeper:
+        def spin(self):
+            time.sleep(5)
+            return True
+
+    s = Sleeper.remote()
+    ref = s.spin.remote()
+    time.sleep(1.5)  # worker is inside spin()
+    cmd_stack(None)
+    out = capsys.readouterr().out
+    assert "signalled" in out
+    assert ray_tpu.get(ref, timeout=30) is True
