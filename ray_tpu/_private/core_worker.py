@@ -151,6 +151,9 @@ class CoreWorker:
         # Function table cache (reference: _private/function_manager.py).
         self._function_cache: dict[str, object] = {}
         self._exported_functions: set[str] = set()
+        import weakref
+
+        self._fn_key_by_obj: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
         # Actor-call transport state.
         self._actor_clients: dict[str, RpcClient] = {}
@@ -280,12 +283,25 @@ class CoreWorker:
         return TaskID.for_task(ActorID(self.current_task_id.binary()[:16]))
 
     def _export_function(self, func) -> str:
+        # Hot path: @ray_tpu.remote functions are submitted thousands of
+        # times — cache the pickle/hash per function object (weak so
+        # dynamically-created functions don't leak).
+        try:
+            cached = self._fn_key_by_obj.get(func)
+        except TypeError:  # unhashable/unweakrefable callables
+            cached = None
+        if cached is not None:
+            return cached
         pickled = cloudpickle.dumps(func)
         key = "fn:" + hashlib.sha1(pickled).hexdigest()
         if key not in self._exported_functions:
             self.gcs.call("kv_put", {"key": key, "value": pickled, "overwrite": False})
             self._exported_functions.add(key)
             self._function_cache[key] = func
+        try:
+            self._fn_key_by_obj[func] = key
+        except TypeError:
+            pass
         return key
 
     def _prepare_args(self, args: tuple, kwargs: dict) -> tuple[list, list]:
@@ -403,7 +419,19 @@ class CoreWorker:
         refs poll the owner."""
         unready = [ref for ref in arg_refs if not self._arg_available(ref)]
         if not unready:
-            self.raylet.call("submit_task", {"spec": spec.to_wire()})
+            # Fire-and-forget: the ObjectRef already exists and results flow
+            # back through completion events — blocking on the raylet's ack
+            # here would serialize every submission on an RPC round-trip
+            # (the reference's SubmitTask is asynchronous for the same
+            # reason, core_worker.cc:1893). Errors fail the task instead.
+            async def _submit_async():
+                try:
+                    await self.raylet.acall("submit_task", {"spec": spec.to_wire()})
+                except Exception as e:
+                    logger.exception("async submit of %s failed", spec.task_id[:8])
+                    self._fail_task(spec.task_id, WorkerCrashedError(f"submit failed: {e!r}"))
+
+            self._io.spawn(_submit_async())
             return
 
         async def _wait_and_submit():

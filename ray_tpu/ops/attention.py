@@ -41,7 +41,7 @@ def _xla_attention(q, k, v, causal: bool, sm_scale: float, bias=None):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, sm_scale: float, seq_k: int, block_q: int):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bool, sm_scale: float, seq_k: int, block_q: int):
     from jax.experimental import pallas as pl
 
     q = q_ref[...]  # [block_q, d]
@@ -53,10 +53,16 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, sm_
     acc0 = jnp.zeros((q.shape[0], d), dtype=jnp.float32)
 
     num_k_blocks = pl.cdiv(seq_k, block_k)
+    # Bottom-right-aligned causal mask (matches _xla_attention's
+    # tril(k=Tk-Tq)): query row i sees keys 0..i+(Tk-Tq). Identical to the
+    # usual mask when Tq == Tk; for Tq < Tk (decode with cache) the tail of
+    # the keys is what's visible.
+    causal_offset = seq_k - block_q * pl.num_programs(1)
     if causal:
-        # K blocks strictly after this Q block's last row are fully masked.
-        last_q_row = (q_idx + 1) * block_q - 1
+        # K blocks strictly after this Q block's last visible key are masked.
+        last_q_row = (q_idx + 1) * block_q - 1 + causal_offset
         num_k_blocks = jnp.minimum(num_k_blocks, (last_q_row // block_k) + 1)
+        num_k_blocks = jnp.maximum(num_k_blocks, 0)
 
     def body(kb, carry):
         m_prev, l_prev, acc_prev = carry
@@ -66,7 +72,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, sm_
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale  # [block_q, block_k]
         if causal:
-            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            q_pos = q_idx * block_q + causal_offset + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
         m_cur = jnp.maximum(m_prev, s.max(axis=-1))
@@ -82,9 +88,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, sm_
 
     m, l, acc = jax.lax.fori_loop(0, num_k_blocks, body, (m0, l0, acc0))
     o_ref[...] = (acc / l[:, None]).astype(o_ref.dtype)
+    # Log-sum-exp per row: the residual the backward pass needs to
+    # reconstruct P = exp(S - lse) blockwise without re-running the online
+    # softmax.
+    lse_ref[...] = (m + jnp.log(l)).astype(lse_ref.dtype)
 
 
-def _pallas_flash(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: int, interpret: bool):
+def _pallas_flash_with_lse(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: int, interpret: bool):
     from jax.experimental import pallas as pl
 
     B, Tq, H, D = q.shape
@@ -103,7 +113,7 @@ def _pallas_flash(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k:
         seq_k=Tk,
         block_q=block_q,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -111,11 +121,90 @@ def _pallas_flash(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k:
             pl.BlockSpec((None, Tk, D), lambda bh, qb: (bh, 0, 0)),
             pl.BlockSpec((None, Tk, D), lambda bh, qb: (bh, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, D), lambda bh, qb: (bh, qb, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((None, block_q, D), lambda bh, qb: (bh, qb, 0)),
+            pl.BlockSpec((None, block_q), lambda bh, qb: (bh, qb)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Tq), jnp.float32),
+        ],
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
+    return (
+        out.reshape(B, H, Tq, D).transpose(0, 2, 1, 3),
+        lse.reshape(B, H, Tq),
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _pallas_flash(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: int, interpret: bool):
+    out, _ = _pallas_flash_with_lse(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    return out
+
+
+def _pallas_flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    out, lse = _pallas_flash_with_lse(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _pallas_flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, dout):
+    """Memory-efficient flash backward, expressed in XLA (lax.fori_loop over
+    K blocks — the compiler tiles the matmuls onto the MXU; peak memory is
+    one [B,H,Tq,block_k] logits block instead of the full [Tq,Tk] matrix).
+
+    Standard flash-attention backward (Dao et al. 2022):
+        D  = rowsum(dO * O)
+        P  = exp(S - lse)
+        dV = P^T dO;  dP = dO V^T;  dS = P * (dP - D) * sm_scale
+        dQ = dS K;    dK = dS^T Q
+    """
+    q, k, v, out, lse = res
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    qT = q.transpose(0, 2, 1, 3).astype(jnp.float32)   # [B,H,Tq,D]
+    kT = k.transpose(0, 2, 1, 3).astype(jnp.float32)   # [B,H,Tk,D]
+    vT = v.transpose(0, 2, 1, 3).astype(jnp.float32)
+    oT = out.transpose(0, 2, 1, 3).astype(jnp.float32)
+    doT = dout.transpose(0, 2, 1, 3).astype(jnp.float32)
+    delta = jnp.sum(doT * oT, axis=-1)                 # [B,H,Tq]
+
+    bk = min(block_k, Tk)
+    num_kb = (Tk + bk - 1) // bk
+    # Same bottom-right causal alignment as forward kernel/_xla_attention.
+    q_pos = (Tk - Tq) + jax.lax.broadcasted_iota(jnp.int32, (Tq, bk), 0)
+
+    def body(kb, carry):
+        dq_acc, dk_acc, dv_acc = carry
+        start = kb * bk
+        ks = jax.lax.dynamic_slice_in_dim(kT, start, bk, axis=2)   # [B,H,bk,D]
+        vs = jax.lax.dynamic_slice_in_dim(vT, start, bk, axis=2)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qT, ks) * sm_scale
+        if causal:
+            k_pos = start + jax.lax.broadcasted_iota(jnp.int32, (Tq, bk), 1)
+            s = jnp.where((q_pos >= k_pos)[None, None], s, -jnp.inf)
+        p = jnp.exp(s - lse[..., None])                 # masked rows -> 0
+        dp = jnp.einsum("bhqd,bhkd->bhqk", doT, vs)
+        ds = p * (dp - delta[..., None]) * sm_scale
+        dq_acc = dq_acc + jnp.einsum("bhqk,bhkd->bhqd", ds, ks)
+        dk_b = jnp.einsum("bhqk,bhqd->bhkd", ds, qT)
+        dv_b = jnp.einsum("bhqk,bhqd->bhkd", p, doT)
+        dk_acc = jax.lax.dynamic_update_slice_in_dim(dk_acc, dk_b, start, axis=2)
+        dv_acc = jax.lax.dynamic_update_slice_in_dim(dv_acc, dv_b, start, axis=2)
+        return dq_acc, dk_acc, dv_acc
+
+    dq0 = jnp.zeros_like(qT)
+    dk0 = jnp.zeros_like(kT)
+    dv0 = jnp.zeros_like(vT)
+    dq, dk, dv = jax.lax.fori_loop(0, num_kb, body, (dq0, dk0, dv0))
+    return (
+        dq.transpose(0, 2, 1, 3).astype(q.dtype),
+        dk.transpose(0, 2, 1, 3).astype(k.dtype),
+        dv.transpose(0, 2, 1, 3).astype(v.dtype),
+    )
+
+
+_pallas_flash.defvjp(_pallas_flash_fwd, _pallas_flash_bwd)
 
 
 def _on_tpu() -> bool:
@@ -146,9 +235,11 @@ def flash_attention(
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     use_pallas = force_pallas if force_pallas is not None else (_on_tpu() or interpret)
-    if bias is not None or not use_pallas:
-        return _xla_attention(q, k, v, causal, sm_scale, bias)
     Tq, Tk = q.shape[1], k.shape[1]
     bq = min(block_q, Tq)
     bk = min(block_k, Tk)
+    # Block sizes must tile the sequence exactly: a clamped tail slice would
+    # read overlapping rows (and the backward would double-count them).
+    if bias is not None or not use_pallas or Tq % bq or Tk % bk:
+        return _xla_attention(q, k, v, causal, sm_scale, bias)
     return _pallas_flash(q, k, v, causal, sm_scale, bq, bk, interpret)

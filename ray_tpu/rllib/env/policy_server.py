@@ -52,6 +52,10 @@ class PolicyServerInput:
         self._next_idx = 0
         self._completed: list[_Episode] = []
         self._lock = threading.Lock()
+        # Policy calls get their own lock: compute_action typically mutates
+        # algorithm RNG state (not thread-safe), but it must not serialize
+        # unrelated episode bookkeeping.
+        self._policy_lock = threading.Lock()
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -80,10 +84,12 @@ class PolicyServerInput:
 
     def _dispatch(self, path: str, payload: dict) -> dict:
         if path == "/get_action":
-            # Policy forward OUTSIDE the lock — it can take milliseconds and
-            # must not serialize unrelated clients/episodes.
+            # Policy forward outside the episode lock (it can take
+            # milliseconds), but serialized against other policy calls —
+            # compute_action mutates shared RNG state.
             obs = np.asarray(payload["observation"], np.float32)
-            action = self.compute_action(obs, bool(payload.get("explore", True)))
+            with self._policy_lock:
+                action = self.compute_action(obs, bool(payload.get("explore", True)))
             with self._lock:
                 ep = self._episodes.get(payload.get("episode_id", ""))
                 if ep is None:

@@ -466,10 +466,9 @@ def cmd_stack(args):
     if not workers:
         print("no live ray_tpu workers on this host")
         return
-    session_dirs = sorted(glob.glob("/tmp/ray_tpu/session_*/logs"), reverse=True)
-    err_files = (
-        sorted(glob.glob(os.path.join(session_dirs[0], "worker-*.err"))) if session_dirs else []
-    )
+    # Every live session on the host — a local multi-node cluster runs one
+    # session dir per node and all their workers get signalled below.
+    err_files = sorted(glob.glob("/tmp/ray_tpu/session_*/logs/worker-*.err"))
     # Snapshot sizes BEFORE signalling so only freshly-appended dumps are
     # shown — stale blocks from an earlier `stack` run must not masquerade
     # as live stacks.

@@ -90,11 +90,21 @@ def load_config(path: str) -> ServeDeploySchema:
 
 def _apply_overrides(app, overrides: dict, used: set):
     """Rebuild the Application tree with config overrides applied — bound
-    deployments can nest inside init args (Ingress.bind(Model.bind()))."""
+    deployments can nest inside init args, including containers
+    (Ingress.bind([A.bind(), B.bind()], cfg={"m": C.bind()})). Shared
+    bindings (the same Application object bound twice) stay shared: the
+    rebuild is memoized by node identity so serve.run's diamond detection
+    keeps working."""
     from ray_tpu import serve
+
+    if not overrides:
+        return app  # nothing to change — keep the exact object graph
+    memo: dict[int, object] = {}
 
     def rebuild(node):
         if isinstance(node, serve.Application):
+            if id(node) in memo:
+                return memo[id(node)]
             dep = node.deployment
             override = overrides.get(dep.name)
             if override is not None:
@@ -110,11 +120,17 @@ def _apply_overrides(app, overrides: dict, used: set):
                         else None
                     ),
                 )
-            return serve.Application(
+            out = serve.Application(
                 dep,
                 tuple(rebuild(a) for a in node.init_args),
                 {k: rebuild(v) for k, v in node.init_kwargs.items()},
             )
+            memo[id(node)] = out
+            return out
+        if isinstance(node, (list, tuple)):
+            return type(node)(rebuild(v) for v in node)
+        if isinstance(node, dict):
+            return {k: rebuild(v) for k, v in node.items()}
         return node
 
     return rebuild(app)

@@ -133,3 +133,75 @@ def test_transformer_ring_attention_path():
     dense, _ = forward(params, tokens, cfg)
     ring, _ = forward(params, tokens, cfg, mesh=mesh, attn_impl="ring")
     np.testing.assert_allclose(np.asarray(dense), np.asarray(ring), atol=2e-4)
+
+
+def test_pallas_flash_attention_matches_xla_fwd_bwd():
+    """The pallas kernel (interpret mode on CPU) must match the XLA
+    reference in BOTH forward and gradients — the training loss
+    differentiates through flash_attention on TPU, so a missing/wrong VJP
+    would crash or corrupt every TPU train step."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.ops.attention import _xla_attention, flash_attention
+
+    rng = np.random.default_rng(0)
+    B, T, H, D = 2, 256, 2, 32
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32) for _ in range(3)
+    )
+    for causal in (False, True):
+        ref = _xla_attention(q, k, v, causal, 0.125)
+        out = flash_attention(
+            q, k, v, causal=causal, sm_scale=0.125,
+            force_pallas=True, interpret=True, block_q=128, block_k=128,
+        )
+        assert float(jnp.abs(out - ref).max()) < 1e-5
+
+        def loss_p(q, k, v, _c=causal):
+            return (flash_attention(q, k, v, causal=_c, sm_scale=0.125,
+                                    force_pallas=True, interpret=True) ** 2).sum()
+
+        def loss_x(q, k, v, _c=causal):
+            return (_xla_attention(q, k, v, _c, 0.125) ** 2).sum()
+
+        gp = jax.grad(loss_p, argnums=(0, 1, 2))(q, k, v)
+        gx = jax.grad(loss_x, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gp, gx):
+            rel = float(jnp.abs(a - b).max() / (jnp.abs(b).max() + 1e-9))
+            assert rel < 1e-4, f"causal={causal} grad mismatch {rel}"
+
+
+def test_flash_attention_odd_lengths_fall_back():
+    """Non-tileable sequence lengths must route to the XLA path (a clamped
+    tail block would double-count rows)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.ops.attention import _xla_attention, flash_attention
+
+    rng = np.random.default_rng(1)
+    q = jnp.asarray(rng.standard_normal((1, 100, 2, 16)), jnp.float32)
+    out = flash_attention(q, q, q, causal=True, force_pallas=True, interpret=True)
+    ref = _xla_attention(q, q, q, True, 0.25)
+    assert float(jnp.abs(out - ref).max()) < 1e-5
+
+
+def test_flash_attention_cross_length_causal_alignment():
+    """Tq != Tk causal: both paths must use the same (bottom-right) mask
+    alignment — query row i sees keys 0..i+(Tk-Tq), the kv-cache decode
+    convention."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.ops.attention import _xla_attention, flash_attention
+
+    rng = np.random.default_rng(2)
+    q = jnp.asarray(rng.standard_normal((1, 128, 2, 32)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 256, 2, 32)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 256, 2, 32)), jnp.float32)
+    ref = _xla_attention(q, k, v, True, 0.125)
+    out = flash_attention(q, k, v, causal=True, sm_scale=0.125,
+                          force_pallas=True, interpret=True, block_q=64, block_k=64)
+    assert float(jnp.abs(out - ref).max()) < 1e-5

@@ -186,3 +186,33 @@ def test_ray_stack_cli(ray_start_regular, capsys):
     out = capsys.readouterr().out
     assert "signalled" in out
     assert ray_tpu.get(ref, timeout=30) is True
+
+
+def test_apply_overrides_handles_containers_and_sharing():
+    from ray_tpu import serve
+    from ray_tpu.serve.schema import DeploymentSchema, _apply_overrides
+
+    @serve.deployment
+    class Inner:
+        pass
+
+    @serve.deployment
+    class Outer:
+        def __init__(self, models, cfg):
+            pass
+
+    shared = Inner.bind()
+    app = Outer.bind([shared, shared], {"extra": Inner.bind()})
+    overrides = {"Inner": DeploymentSchema(name="Inner", num_replicas=3)}
+    used: set = set()
+    rebuilt = _apply_overrides(app, overrides, used)
+    assert used == {"Inner"}
+    models, cfg = rebuilt.init_args
+    # Container nesting: override reached the list and dict elements.
+    assert models[0].deployment.config.num_replicas == 3
+    assert cfg["extra"].deployment.config.num_replicas == 3
+    # Shared bindings stay the SAME object after rebuild (diamond detection).
+    assert models[0] is models[1]
+    # No overrides -> object graph untouched.
+    untouched = _apply_overrides(app, {}, set())
+    assert untouched is app
