@@ -176,10 +176,22 @@ class StandardAutoscaler:
                     idle_gcs_nodes.append(n)
             else:
                 self._idle_since.pop(n["node_id"], None)
+        # Never scale a node type below its configured min_workers baseline.
+        live_counts: dict[str, int] = {}
+        for nid in self.provider.non_terminated_nodes():
+            t = self._node_type_of.get(nid) or self.provider.node_tags(nid).get("node_type")
+            if t:
+                live_counts[t] = live_counts.get(t, 0) + 1
         for n in idle_gcs_nodes:
             pid = self._provider_node_for(n)
             if pid is None:
                 continue
+            node_type = self._node_type_of.get(pid) or self.provider.node_tags(pid).get("node_type")
+            if node_type:
+                floor = int(self.config.get("node_types", {}).get(node_type, {}).get("min_workers", 0))
+                if live_counts.get(node_type, 0) <= floor:
+                    continue
+                live_counts[node_type] -= 1
             logger.info("autoscaler: terminating idle node %s", n["node_id"][:8])
             gcs = self._gcs()
             try:

@@ -36,3 +36,38 @@ class Monitor:
         self._thread.join(timeout=10)
         if terminate_nodes:
             self.autoscaler.shutdown()
+
+
+def main():
+    """Standalone monitor process for `ray_tpu up` (the reference's
+    monitor.py process)."""
+    import argparse
+    import json
+    import signal
+    import time
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config-file", required=True)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO, format="[monitor] %(levelname)s %(message)s")
+    with open(args.config_file) as f:
+        config = json.load(f)
+    monitor = Monitor(config)
+    stopping = {"done": False}
+
+    def _term(signum, frame):
+        if not stopping["done"]:
+            stopping["done"] = True
+            # Terminate provider nodes here: this process holds the only
+            # in-memory handles for subprocess-backed providers (fake) —
+            # `ray_tpu down` keeps a provider-rebuild fallback for providers
+            # with external state (TPU pods) in case the monitor died early.
+            monitor.stop(terminate_nodes=True)
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    while not stopping["done"]:
+        time.sleep(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -51,13 +51,25 @@ class ResourceDemandScheduler:
                     break
             if not placed:
                 unmet.append(shape)
-        if not unmet:
-            return {}
 
         to_launch: dict[str, int] = {}
         counts = dict(counts_by_type)
         total = total_existing
         pending_new: list[tuple[str, dict]] = []  # (type, remaining avail)
+        # Baseline workers first (reference: min_workers in
+        # available_node_types) — held up regardless of demand; their
+        # capacity joins the pool so demand packs into them before
+        # launching more.
+        for name, nt in self.node_types.items():
+            deficit = int(nt.get("min_workers", 0)) - counts.get(name, 0)
+            while deficit > 0 and total < self.max_workers:
+                to_launch[name] = to_launch.get(name, 0) + 1
+                counts[name] = counts.get(name, 0) + 1
+                pending_new.append((name, dict(nt.get("resources", {}))))
+                total += 1
+                deficit -= 1
+        if not unmet:
+            return to_launch
         for shape in unmet:
             placed = False
             for _, a in pending_new:

@@ -9,6 +9,7 @@ Algorithm extends the Tune Trainable so algorithms drop into tune.Tuner.
 from ray_tpu.rllib.algorithms.a2c import A2C, A2CConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.bandits import BanditConfig, BanditLinTS, BanditLinUCB  # noqa: F401
 from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.ddpg import DDPG, TD3, DDPGConfig, TD3Config  # noqa: F401
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig  # noqa: F401

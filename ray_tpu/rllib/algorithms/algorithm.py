@@ -94,6 +94,16 @@ class AlgorithmConfig:
             self.seed = seed
         return self
 
+    def update_from_dict(self, overrides: dict) -> "AlgorithmConfig":
+        """Apply {attr: value} overrides; unknown keys land in .extra
+        (shared by the CLI, tuned-example runner, and __init__)."""
+        for key, value in (overrides or {}).items():
+            if hasattr(self, key):
+                setattr(self, key, value)
+            else:
+                self.extra[key] = value
+        return self
+
     def model_config(self) -> dict:
         """Catalog-shaped model config (reference: config.model dict)."""
         return {
@@ -119,12 +129,7 @@ class Algorithm(Trainable):
         if isinstance(config, AlgorithmConfig):
             self._algo_config = config
         else:
-            self._algo_config = self.get_default_config()
-            for k, v in (config or {}).items():
-                if hasattr(self._algo_config, k):
-                    setattr(self._algo_config, k, v)
-                else:
-                    self._algo_config.extra[k] = v
+            self._algo_config = self.get_default_config().update_from_dict(config or {})
         super().__init__(config=self._algo_config.to_dict())
 
     @classmethod

@@ -1,0 +1,5 @@
+from ray_tpu.rllib.algorithms.bandits.bandits import (  # noqa: F401
+    BanditConfig,
+    BanditLinTS,
+    BanditLinUCB,
+)

@@ -30,16 +30,71 @@ def _build(args) -> tuple:
         ray_tpu.init()
     cls = _algo_class(args.run)
     cfg = cls.get_default_config().environment(args.env)
-    for key, value in (json.loads(args.config) if args.config else {}).items():
-        if hasattr(cfg, key):
-            setattr(cfg, key, value)
-        else:
-            cfg.extra[key] = value
+    cfg.update_from_dict(json.loads(args.config) if args.config else {})
     algo = cfg.build()  # Trainable.__init__ runs setup()
     return algo, cfg
 
 
+def run_tuned_example(path: str, max_iters_override: int | None = None) -> dict:
+    """Run experiments from a tuned-example YAML (reference:
+    rllib/tuned_examples/*.yaml driven by `rllib train file`). Returns
+    {experiment_name: last_result}; raises if a stop criterion names a
+    metric the algorithm never reports."""
+    import yaml
+
+    import ray_tpu
+
+    with open(path) as f:
+        experiments = yaml.safe_load(f)
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    out = {}
+    for name, exp in experiments.items():
+        cls = _algo_class(exp["run"])
+        cfg = cls.get_default_config().environment(exp["env"])
+        cfg.update_from_dict(exp.get("config") or {})
+        stop = exp.get("stop") or {}
+        max_iters = max_iters_override or int(stop.get("training_iteration", 100))
+        algo = cfg.build()
+        result: dict = {}
+        try:
+            for i in range(max_iters):
+                result = algo.step()
+                result["training_iteration"] = i + 1
+                if i == 0:
+                    # Typo'd stop keys would otherwise silently burn the full
+                    # iteration budget.
+                    missing = [k for k in stop if k not in result]
+                    if missing:
+                        raise ValueError(
+                            f"experiment {name!r}: stop criteria {missing} name "
+                            f"metrics the algorithm never reports "
+                            f"(reported: {sorted(result)})"
+                        )
+                reward = result.get("episode_reward_mean", float("nan"))
+                print(f"[{name}] iter {i + 1}: reward={reward:.2f}")
+                if _stop_met(stop, result):
+                    break
+        finally:
+            algo.cleanup()
+        out[name] = result
+    return out
+
+
+def _stop_met(stop: dict, result: dict) -> bool:
+    for key, bound in stop.items():
+        v = result.get(key)
+        if v is not None and v == v and v >= bound:  # v==v filters NaN
+            return True
+    return False
+
+
 def cmd_train(args) -> int:
+    if args.file:
+        run_tuned_example(args.file)
+        return 0
+    if not (args.run and args.env):
+        raise SystemExit("train needs either -f <tuned.yaml> or --run + --env")
     algo, _ = _build(args)
     try:
         for i in range(args.stop_iters):
@@ -95,10 +150,14 @@ def main(argv=None) -> int:
     sub = parser.add_subparsers(dest="command", required=True)
     for name in ("train", "evaluate"):
         p = sub.add_parser(name)
-        p.add_argument("--run", required=True, help="algorithm name, e.g. PPO")
-        p.add_argument("--env", required=True, help="gym env id or registered env")
+        p.add_argument("--run", required=(name == "evaluate"), default=None,
+                       help="algorithm name, e.g. PPO")
+        p.add_argument("--env", required=(name == "evaluate"), default=None,
+                       help="gym env id or registered env")
         p.add_argument("--config", default=None, help="JSON config overrides")
     t = sub.choices["train"]
+    t.add_argument("-f", "--file", default=None,
+                   help="tuned-example YAML (rllib/tuned_examples/*.yaml)")
     t.add_argument("--stop-iters", type=int, default=100)
     t.add_argument("--stop-reward", type=float, default=None)
     t.add_argument("--stop-timesteps", type=int, default=None)

@@ -451,6 +451,149 @@ def cmd_serve(args):
 # ----------------------------------------------------------------------
 
 
+LAUNCHER_DIR = "/tmp/ray_tpu/clusters"
+
+
+def _load_cluster_yaml(path: str) -> dict:
+    import yaml
+
+    with open(path) as f:
+        config = yaml.safe_load(f)
+    config.setdefault("cluster_name", "default")
+    config.setdefault("provider", {"type": "fake"})
+    # Reference configs use available_node_types; the autoscaler's native
+    # key is node_types — accept both.
+    if "available_node_types" in config and "node_types" not in config:
+        config["node_types"] = {
+            name: {
+                "resources": nt.get("resources", {}),
+                "max_workers": nt.get("max_workers", config.get("max_workers", 8)),
+                "min_workers": nt.get("min_workers", 0),
+            }
+            for name, nt in config["available_node_types"].items()
+        }
+    return config
+
+
+def _launcher_file(name: str) -> str:
+    return os.path.join(LAUNCHER_DIR, f"{name}.json")
+
+
+def cmd_up(args):
+    """Launch a cluster from a YAML config (reference: `ray up`,
+    scripts.py:1235). The head starts on this machine; worker nodes come
+    from the config's provider (fake = local raylet subprocesses, tpu = TPU
+    pods) driven by a detached autoscaler monitor process."""
+    config = _load_cluster_yaml(args.cluster_config)
+    name = config["cluster_name"]
+    os.makedirs(LAUNCHER_DIR, exist_ok=True)
+    if os.path.exists(_launcher_file(name)):
+        with open(_launcher_file(name)) as f:
+            existing = json.load(f)
+        if _pid_alive(existing.get("monitor_pid")):
+            raise SystemExit(f"cluster {name!r} is already up; run `ray_tpu down {args.cluster_config}` first")
+    head = config.get("head_node", {})
+    head_res = dict(head.get("resources", {}))
+    start_args = [
+        sys.executable, "-m", "ray_tpu.scripts.scripts", "start", "--head",
+        "--num-cpus", str(int(head_res.get("CPU", os.cpu_count() or 1))),
+        "--num-tpus", str(int(head_res.get("TPU", 0))),
+    ]
+    subprocess.run(start_args, check=True)
+    info = _read_cluster_file()
+    gcs_address = "%s:%d" % tuple(info["gcs_address"])
+    config.setdefault("provider", {})["gcs_address"] = gcs_address
+    cfg_path = os.path.join(LAUNCHER_DIR, f"{name}_autoscaler.json")
+    with open(cfg_path, "w") as f:
+        json.dump(config, f)
+    log_path = os.path.join(LAUNCHER_DIR, f"{name}_monitor.log")
+    with open(log_path, "ab") as log_f:
+        monitor = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.autoscaler.monitor", "--config-file", cfg_path],
+            stdout=log_f, stderr=subprocess.STDOUT, start_new_session=True,
+        )
+    with open(_launcher_file(name), "w") as f:
+        json.dump({
+            "cluster_name": name,
+            "gcs_address": gcs_address,
+            "monitor_pid": monitor.pid,
+            "config_file": cfg_path,
+        }, f)
+    print(f"cluster {name!r} is up: address {gcs_address}, autoscaler pid {monitor.pid}")
+    print(f"connect with ray_tpu.init(address='{gcs_address}')")
+
+
+def cmd_down(args):
+    """Tear down a launched cluster (reference: `ray down`)."""
+    config = _load_cluster_yaml(args.cluster_config)
+    name = config["cluster_name"]
+    path = _launcher_file(name)
+    if not os.path.exists(path):
+        raise SystemExit(f"no launched cluster {name!r} (missing {path})")
+    with open(path) as f:
+        info = json.load(f)
+    if _pid_alive(info.get("monitor_pid")):
+        try:
+            os.kill(info["monitor_pid"], signal.SIGTERM)
+        except OSError:
+            pass
+        # The monitor terminates its nodes on SIGTERM (it holds the Popen
+        # handles); wait for it before the fallback below.
+        deadline = time.time() + 20
+        while time.time() < deadline and _pid_alive(info["monitor_pid"]):
+            time.sleep(0.2)
+    # Fallback for providers with external node state (or a dead monitor),
+    # then stop every local node process.
+    from ray_tpu.autoscaler.autoscaler import _make_provider
+
+    with open(info["config_file"]) as f:
+        as_config = json.load(f)
+    provider = _make_provider(as_config)
+    for nid in provider.non_terminated_nodes():
+        provider.terminate_node(nid)
+    provider.shutdown()
+    subprocess.run([sys.executable, "-m", "ray_tpu.scripts.scripts", "stop"], check=False)
+    os.unlink(path)
+    print(f"cluster {name!r} is down")
+
+
+def _cluster_env(args) -> dict:
+    config = _load_cluster_yaml(args.cluster_config)
+    path = _launcher_file(config["cluster_name"])
+    if not os.path.exists(path):
+        raise SystemExit(f"cluster {config['cluster_name']!r} is not up")
+    with open(path) as f:
+        info = json.load(f)
+    env = dict(os.environ)
+    env["RAY_TPU_ADDRESS"] = info["gcs_address"]
+    return env
+
+
+def cmd_exec(args):
+    """Run a shell command against the cluster (reference: `ray exec`) —
+    local-provider analog: the command runs here with RAY_TPU_ADDRESS set."""
+    rc = subprocess.run(args.command, shell=True, env=_cluster_env(args)).returncode
+    raise SystemExit(rc)
+
+
+def cmd_submit(args):
+    """Run a python script as a driver on the cluster (reference:
+    `ray submit`)."""
+    rc = subprocess.run(
+        [sys.executable, args.script] + args.script_args, env=_cluster_env(args)
+    ).returncode
+    raise SystemExit(rc)
+
+
+def cmd_attach(args):
+    """Open an interactive shell wired to the cluster (reference:
+    `ray attach`)."""
+    shell = os.environ.get("SHELL", "/bin/bash")
+    print(f"attached to cluster (RAY_TPU_ADDRESS set); exit the shell to detach")
+    rc = subprocess.run([shell], env=_cluster_env(args)).returncode
+    raise SystemExit(rc)
+
+
 def cmd_stack(args):
     """Dump Python stacks of every live local worker (reference: `ray stack`,
     scripts.py:1786, which shells out to py-spy; here workers self-report via
@@ -683,6 +826,29 @@ def main(argv=None):
         sp2 = ssub.add_parser(name)
         sp2.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser("up", help="launch a cluster from a YAML config")
+    p.add_argument("cluster_config")
+    p.set_defaults(fn=cmd_up)
+
+    p = sub.add_parser("down", help="tear down a launched cluster")
+    p.add_argument("cluster_config")
+    p.set_defaults(fn=cmd_down)
+
+    p = sub.add_parser("exec", help="run a shell command against the cluster")
+    p.add_argument("cluster_config")
+    p.add_argument("command")
+    p.set_defaults(fn=cmd_exec)
+
+    p = sub.add_parser("submit", help="run a python script as a cluster driver")
+    p.add_argument("cluster_config")
+    p.add_argument("script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    p.set_defaults(fn=cmd_submit)
+
+    p = sub.add_parser("attach", help="interactive shell wired to the cluster")
+    p.add_argument("cluster_config")
+    p.set_defaults(fn=cmd_attach)
 
     p = sub.add_parser("stack", help="dump Python stacks of local workers")
     p.set_defaults(fn=cmd_stack)

@@ -127,9 +127,11 @@ def _apply_overrides(app, overrides: dict, used: set):
             )
             memo[id(node)] = out
             return out
-        if isinstance(node, (list, tuple)):
+        # Exact list/tuple/dict only — a namedtuple or tuple subclass has a
+        # different constructor signature and passes through untouched.
+        if type(node) in (list, tuple):
             return type(node)(rebuild(v) for v in node)
-        if isinstance(node, dict):
+        if type(node) is dict:
             return {k: rebuild(v) for k, v in node.items()}
         return node
 
