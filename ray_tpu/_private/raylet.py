@@ -109,8 +109,17 @@ class Raylet:
 
         self.resources_total = dict(resources or {"CPU": os.cpu_count() or 1})
         self.resources_total.setdefault("memory", 4 * 1024 * 1024 * 1024)
-        self.resources_available = dict(self.resources_total)
-        # Placement-group bundle pools: (pg_id, idx) -> {resource: available}.
+        # Resource accounting lives in the native scheduler core (C++
+        # fixed-point ledger, _native/sched_core.cc — the reference keeps
+        # this math in src/ray/raylet/scheduling/); resources_available is a
+        # derived property over it.
+        from ray_tpu._private.sched_core import create_sched_core
+
+        self._sched = create_sched_core()
+        self._sched.node_upsert(self.node_id, self.resources_total, self.resources_total)
+        self._res_keys: set[str] = set(self.resources_total)
+        # Placement-group bundle CAPACITIES (metadata/view); live availability
+        # is the core's pool state.
         self.bundles: dict[tuple, dict] = {}
         self.bundle_reserved: dict[tuple, dict] = {}
         self.labels = dict(labels or {})
@@ -119,6 +128,7 @@ class Raylet:
         self.task_queue: deque[TaskSpec] = deque()
         self._last_progress = time.monotonic()
         self.cluster_view: dict = {}
+        self._synced_peers: set[str] = set()
         self._pulls_inflight: dict[str, asyncio.Future] = {}
         self._peer_clients: dict[str, RpcClient] = {}
 
@@ -193,6 +203,20 @@ class Raylet:
                             pass
                     continue
                 self.cluster_view = resp.get("nodes", {})
+                # Mirror peers into the scheduler core (never self — the
+                # local ledger is authoritative, a stale heartbeat echo
+                # would clobber in-flight acquires).
+                for nid, node in self.cluster_view.items():
+                    if nid != self.node_id:
+                        self._sched.node_upsert(
+                            nid,
+                            node.get("resources_total", {}),
+                            node.get("resources_available", {}),
+                        )
+                for nid in self._synced_peers - set(self.cluster_view):
+                    if nid != self.node_id:
+                        self._sched.node_remove(nid)
+                self._synced_peers = set(self.cluster_view)
                 self._tracing_enabled = bool(resp.get("tracing"))
                 await self._retry_pg_tasks()
                 if self.task_queue:
@@ -221,7 +245,7 @@ class Raylet:
 
     def _must_reroute(self, spec: TaskSpec) -> bool:
         if spec.placement_group_id:
-            return self._resource_pool(spec) is None
+            return not self._has_pool(spec)
         strategy = spec.scheduling_strategy or "DEFAULT"
         if strategy.startswith("node:"):
             parts = strategy.split(":")
@@ -386,13 +410,23 @@ class Raylet:
     # Placement-group bundles (2PC; reference: placement_group_resource_manager.h)
     # ------------------------------------------------------------------
 
+    @property
+    def resources_available(self) -> dict:
+        """Derived view over the scheduler core's ledger."""
+        return {k: self._sched.node_avail(self.node_id, k) for k in self._res_keys}
+
+    @staticmethod
+    def _bundle_pool_key(pg_id: str, idx: int) -> str:
+        return f"{pg_id}:{max(idx, 0)}"
+
     async def rpc_prepare_bundle(self, req):
+        # 2PC prepare (reference: gcs_placement_group_scheduler.h): the
+        # bundle's resources move from the main pool into a reservation.
         key = (req["pg_id"], req["bundle_index"])
         res = req["resources"]
-        if any(self.resources_available.get(k, 0) < v for k, v in res.items()):
+        self._res_keys.update(res)
+        if not self._sched.try_acquire(self.node_id, res):
             return {"ok": False}
-        for k, v in res.items():
-            self.resources_available[k] -= v
         self.bundle_reserved[key] = dict(res)
         return {"ok": True}
 
@@ -402,14 +436,18 @@ class Raylet:
         if res is None:
             return {"ok": False}
         self.bundles[key] = dict(res)
+        self._sched.pool_upsert(self._bundle_pool_key(*key), res)
         return {"ok": True}
 
     async def rpc_return_bundle(self, req):
         key = (req["pg_id"], req["bundle_index"])
-        res = self.bundle_reserved.pop(key, None) or self.bundles.pop(key, None)
+        res = self.bundle_reserved.pop(key, None)
+        committed = self.bundles.pop(key, None)
+        if committed is not None:
+            self._sched.pool_remove(self._bundle_pool_key(*key))
+            res = committed
         if res:
-            for k, v in res.items():
-                self.resources_available[k] = self.resources_available.get(k, 0) + v
+            self._sched.release(self.node_id, res)
         return {"ok": True}
 
     # ------------------------------------------------------------------
@@ -422,7 +460,7 @@ class Raylet:
         return {"ok": True}
 
     async def _queue_and_schedule(self, spec: TaskSpec):
-        if spec.placement_group_id and self._resource_pool(spec) is None:
+        if spec.placement_group_id and not self._has_pool(spec):
             # Bundle lives elsewhere: ask GCS for its node and forward there.
             resp = await self.gcs.acall(
                 "get_placement_group", {"pg_id": spec.placement_group_id}
@@ -455,23 +493,55 @@ class Raylet:
         self.task_queue.append(spec)
         await self._dispatch()
 
-    def _feasible_local(self, spec: TaskSpec) -> bool:
-        pool = self._resource_pool(spec)
-        total = self.resources_total if pool is self.resources_available else pool
-        return all(total.get(k, 0) >= v for k, v in spec.resources.items())
-
-    def _resource_pool(self, spec: TaskSpec):
+    def _has_pool(self, spec: TaskSpec) -> bool:
+        """Does the pool this task draws from exist locally?"""
         if spec.placement_group_id:
-            key = (spec.placement_group_id, max(spec.placement_group_bundle_index, 0))
-            return self.bundles.get(key)
-        return self.resources_available
+            return self._sched.pool_exists(
+                self._bundle_pool_key(
+                    spec.placement_group_id, spec.placement_group_bundle_index
+                )
+            )
+        return True
+
+    def _fits_now(self, spec: TaskSpec) -> bool:
+        """Non-mutating fit check (the event loop is single-threaded, so
+        check-then-acquire cannot race)."""
+        if spec.placement_group_id:
+            key = self._bundle_pool_key(
+                spec.placement_group_id, spec.placement_group_bundle_index
+            )
+            get = lambda k: self._sched.pool_avail(key, k)  # noqa: E731
+        else:
+            get = lambda k: self._sched.node_avail(self.node_id, k)  # noqa: E731
+        return all(get(k) >= v - 1e-9 for k, v in spec.resources.items())
+
+    def _acquire_for(self, spec: TaskSpec) -> bool:
+        self._res_keys.update(spec.resources)
+        if spec.placement_group_id:
+            return self._sched.pool_try_acquire(
+                self._bundle_pool_key(
+                    spec.placement_group_id, spec.placement_group_bundle_index
+                ),
+                spec.resources,
+            )
+        return self._sched.try_acquire(self.node_id, spec.resources)
+
+    def _release_for(self, spec: TaskSpec):
+        if spec.placement_group_id:
+            key = self._bundle_pool_key(
+                spec.placement_group_id, spec.placement_group_bundle_index
+            )
+            if self._sched.pool_exists(key):
+                self._sched.pool_release(key, spec.resources)
+        else:
+            self._sched.release(self.node_id, spec.resources)
 
     def _pick_node(self, spec: TaskSpec) -> str | None:
         """Cluster-level placement: hybrid pack-then-spread policy
         (reference: policy/hybrid_scheduling_policy.h:50)."""
         strategy = spec.scheduling_strategy or "DEFAULT"
         if spec.placement_group_id:
-            return self.node_id if self._resource_pool(spec) is not None else self._pg_bundle_node(spec)
+            return self.node_id if self._has_pool(spec) else self._pg_bundle_node(spec)
         if strategy.startswith("node:"):
             parts = strategy.split(":")
             node_id = parts[1]
@@ -482,20 +552,11 @@ class Raylet:
         feasible_here = all(
             self.resources_total.get(k, 0) >= v for k, v in spec.resources.items()
         )
-        fits_now = all(
-            self.resources_available.get(k, 0) >= v for k, v in spec.resources.items()
-        )
+        fits_now = self._fits_now(spec)
         if strategy == "SPREAD":
-            # Round-robin across feasible nodes by lowest utilisation.
-            best, best_score = None, None
-            for nid, node in {**self.cluster_view, self.node_id: self._self_view()}.items():
-                total, avail = node["resources_total"], node["resources_available"]
-                if any(total.get(k, 0) < v for k, v in spec.resources.items()):
-                    continue
-                score = sum(avail.get(k, 0) / max(total.get(k, 1), 1) for k in total)
-                if best_score is None or score > best_score:
-                    best, best_score = nid, score
-            return best
+            # Highest free-fraction among feasible nodes — scored by the
+            # native core over the heartbeat-synced cluster view.
+            return self._sched.best_node(spec.resources, 1, self.node_id)
         if fits_now or feasible_here:
             return self.node_id
         # Infeasible here: find a feasible peer.
@@ -529,11 +590,7 @@ class Raylet:
                     # once the cluster view / PG placement catches up.
                     self.task_queue.append(spec)
                     continue
-                pool = self._resource_pool(spec)
-                if pool is None:
-                    self.task_queue.append(spec)
-                    continue
-                if any(pool.get(k, 0) < v for k, v in spec.resources.items()):
+                if not self._has_pool(spec) or not self._fits_now(spec):
                     self.task_queue.append(spec)
                     continue
                 spec_env_hash = _runtime_env_hash(spec.runtime_env)
@@ -598,8 +655,12 @@ class Raylet:
                         )
                     self.task_queue.appendleft(spec)
                     return
-                for k, v in spec.resources.items():
-                    pool[k] = pool.get(k, 0) - v
+                if not self._acquire_for(spec):
+                    # Should not happen (single-threaded loop; _fits_now was
+                    # true) — requeue defensively rather than leak a worker.
+                    worker.state = "idle"
+                    self.task_queue.append(spec)
+                    continue
                 worker.state = "actor" if spec.is_actor_creation() else "busy"
                 worker.current_task = spec
                 worker.dispatch_ts = time.monotonic()
@@ -690,10 +751,7 @@ class Raylet:
             return {"ok": False}
         spec = worker.current_task
         if spec is not None:
-            pool = self._resource_pool(spec)
-            if pool is not None:
-                for k, v in spec.resources.items():
-                    pool[k] = pool.get(k, 0) + v
+            self._release_for(spec)
         worker.current_task = None
         if worker.state == "busy":
             worker.state = "idle"
@@ -751,16 +809,10 @@ class Raylet:
         logger.warning("worker %s died: %s", worker.worker_id[:8], reason)
         if worker.actor_spec is not None:
             # Release the actor's lifetime resource hold.
-            pool = self._resource_pool(worker.actor_spec)
-            if pool is not None:
-                for k, v in worker.actor_spec.resources.items():
-                    pool[k] = pool.get(k, 0) + v
+            self._release_for(worker.actor_spec)
             worker.actor_spec = None
         if spec is not None:
-            pool = self._resource_pool(spec)
-            if pool is not None:
-                for k, v in spec.resources.items():
-                    pool[k] = pool.get(k, 0) + v
+            self._release_for(spec)
             # Tell the owner so it can retry (reference: task_manager.h:335).
             if spec.owner_addr:
                 try:
@@ -831,6 +883,7 @@ class Raylet:
         for c in self._peer_clients.values():
             c.close()
         self.store.close()
+        self._sched.close()
 
 
 def main():

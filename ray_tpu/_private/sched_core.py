@@ -1,0 +1,319 @@
+"""ctypes binding for the native scheduler core (_native/sched_core.cc).
+
+TPU-native analog of the reference's C++ scheduling substrate
+(src/ray/raylet/scheduling/cluster_resource_scheduler.cc + fixed_point.h):
+the raylet delegates per-task resource acquire/release, bundle pools, and
+placement scoring here. Arithmetic is integer milli-units, so thousands of
+fractional acquire/release cycles stay exact (float dicts drift).
+
+A pure-Python ``_PySchedCore`` with identical semantics is the fallback when
+no compiler is available, and the differential test target.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+logger = logging.getLogger(__name__)
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "_native"
+)
+_SRC = os.path.join(_NATIVE_DIR, "sched_core.cc")
+_SO = os.path.join(_NATIVE_DIR, "build", "libsched_core.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_SCALE = 1000
+
+
+def _build_native() -> str | None:
+    os.makedirs(os.path.dirname(_SO), exist_ok=True)
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    tmp = _SO + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", _SRC, "-o", tmp, "-lpthread"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
+        return _SO
+    except Exception as e:
+        logger.warning("native sched core build failed (%s); using Python fallback", e)
+        return None
+
+
+def _load_lib():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        so = _build_native()
+        if so is None:
+            return None
+        lib = ctypes.CDLL(so)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        f64p = ctypes.POINTER(ctypes.c_double)
+        lib.sc_create.restype = ctypes.c_int
+        lib.sc_destroy.argtypes = [ctypes.c_int]
+        lib.sc_intern.argtypes = [ctypes.c_int, ctypes.c_char_p]
+        lib.sc_intern.restype = ctypes.c_uint32
+        lib.sc_node_upsert.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_int, u32p, f64p, f64p]
+        lib.sc_node_remove.argtypes = [ctypes.c_int, ctypes.c_char_p]
+        lib.sc_try_acquire.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_int, u32p, f64p]
+        lib.sc_try_acquire.restype = ctypes.c_int
+        lib.sc_release.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_int, u32p, f64p]
+        lib.sc_pool_upsert.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_int, u32p, f64p]
+        lib.sc_pool_remove.argtypes = [ctypes.c_int, ctypes.c_char_p]
+        lib.sc_pool_exists.argtypes = [ctypes.c_int, ctypes.c_char_p]
+        lib.sc_pool_exists.restype = ctypes.c_int
+        lib.sc_pool_try_acquire.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_int, u32p, f64p]
+        lib.sc_pool_try_acquire.restype = ctypes.c_int
+        lib.sc_pool_release.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_int, u32p, f64p]
+        lib.sc_node_avail.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_uint32]
+        lib.sc_node_avail.restype = ctypes.c_double
+        lib.sc_pool_avail.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_uint32]
+        lib.sc_pool_avail.restype = ctypes.c_double
+        lib.sc_cluster_feasibility.argtypes = [ctypes.c_int, ctypes.c_int, u32p, f64p]
+        lib.sc_cluster_feasibility.restype = ctypes.c_int
+        lib.sc_best_node.argtypes = [
+            ctypes.c_int, ctypes.c_int, u32p, f64p, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.sc_best_node.restype = ctypes.c_int
+        _lib = lib
+        return _lib
+
+
+HYBRID, SPREAD = 0, 1
+
+
+class _NativeSchedCore:
+    def __init__(self, lib):
+        self._lib = lib
+        self._h = lib.sc_create()
+        self._interned: dict[str, int] = {}
+
+    def _vec(self, resources: dict):
+        n = len(resources)
+        idx = (ctypes.c_uint32 * n)()
+        vals = (ctypes.c_double * n)()
+        for i, (name, v) in enumerate(resources.items()):
+            j = self._interned.get(name)
+            if j is None:
+                j = self._lib.sc_intern(self._h, name.encode())
+                self._interned[name] = j
+            idx[i] = j
+            vals[i] = float(v)
+        return n, idx, vals
+
+    def node_upsert(self, node_id: str, total: dict, avail: dict):
+        keys = {**total, **avail}
+        n, idx, _ = self._vec(keys)
+        tot = (ctypes.c_double * n)(*[float(total.get(k, 0)) for k in keys])
+        av = (ctypes.c_double * n)(*[float(avail.get(k, 0)) for k in keys])
+        self._lib.sc_node_upsert(self._h, node_id.encode(), n, idx, tot, av)
+
+    def node_remove(self, node_id: str):
+        self._lib.sc_node_remove(self._h, node_id.encode())
+
+    def try_acquire(self, node_id: str, demand: dict) -> bool:
+        n, idx, vals = self._vec(demand)
+        return bool(self._lib.sc_try_acquire(self._h, node_id.encode(), n, idx, vals))
+
+    def release(self, node_id: str, demand: dict):
+        n, idx, vals = self._vec(demand)
+        self._lib.sc_release(self._h, node_id.encode(), n, idx, vals)
+
+    def pool_upsert(self, pool_key: str, caps: dict):
+        n, idx, vals = self._vec(caps)
+        self._lib.sc_pool_upsert(self._h, pool_key.encode(), n, idx, vals)
+
+    def pool_remove(self, pool_key: str):
+        self._lib.sc_pool_remove(self._h, pool_key.encode())
+
+    def pool_exists(self, pool_key: str) -> bool:
+        return bool(self._lib.sc_pool_exists(self._h, pool_key.encode()))
+
+    def pool_try_acquire(self, pool_key: str, demand: dict) -> bool:
+        n, idx, vals = self._vec(demand)
+        return bool(self._lib.sc_pool_try_acquire(self._h, pool_key.encode(), n, idx, vals))
+
+    def pool_release(self, pool_key: str, demand: dict):
+        n, idx, vals = self._vec(demand)
+        self._lib.sc_pool_release(self._h, pool_key.encode(), n, idx, vals)
+
+    def node_avail(self, node_id: str, name: str) -> float:
+        j = self._interned.get(name)
+        if j is None:
+            j = self._lib.sc_intern(self._h, name.encode())
+            self._interned[name] = j
+        return float(self._lib.sc_node_avail(self._h, node_id.encode(), j))
+
+    def pool_avail(self, pool_key: str, name: str) -> float:
+        j = self._interned.get(name)
+        if j is None:
+            j = self._lib.sc_intern(self._h, name.encode())
+            self._interned[name] = j
+        return float(self._lib.sc_pool_avail(self._h, pool_key.encode(), j))
+
+    def cluster_feasibility(self, demand: dict) -> int:
+        n, idx, vals = self._vec(demand)
+        return int(self._lib.sc_cluster_feasibility(self._h, n, idx, vals))
+
+    def best_node(self, demand: dict, strategy: int, local_node: str) -> str | None:
+        n, idx, vals = self._vec(demand)
+        out = ctypes.create_string_buffer(128)
+        ok = self._lib.sc_best_node(
+            self._h, n, idx, vals, strategy, local_node.encode(), out, 128
+        )
+        return out.value.decode() if ok else None
+
+    def close(self):
+        self._lib.sc_destroy(self._h)
+
+    @property
+    def is_native(self) -> bool:
+        return True
+
+
+def _fp(v: float) -> int:
+    return int(round(v * _SCALE))
+
+
+class _PySchedCore:
+    """Reference semantics in Python (same milli-unit fixed point)."""
+
+    is_native = False
+
+    def __init__(self):
+        self._nodes: dict[str, tuple[dict, dict]] = {}  # id -> (total, avail) in fp
+        self._pools: dict[str, dict] = {}
+        self._pool_caps: dict[str, dict] = {}
+
+    @staticmethod
+    def _to_fp(d: dict) -> dict:
+        return {k: _fp(v) for k, v in d.items()}
+
+    def node_upsert(self, node_id, total, avail):
+        self._nodes[node_id] = (self._to_fp(total), self._to_fp(avail))
+
+    def node_remove(self, node_id):
+        self._nodes.pop(node_id, None)
+
+    @staticmethod
+    def _fits(avail: dict, demand: dict) -> bool:
+        return all(amt <= 0 or avail.get(k, 0) >= amt for k, amt in demand.items())
+
+    def try_acquire(self, node_id, demand) -> bool:
+        node = self._nodes.get(node_id)
+        if node is None:
+            return False
+        d = self._to_fp(demand)
+        if not self._fits(node[1], d):
+            return False
+        for k, v in d.items():
+            node[1][k] = node[1].get(k, 0) - v
+        return True
+
+    def release(self, node_id, demand):
+        node = self._nodes.get(node_id)
+        if node is None:
+            return
+        for k, v in self._to_fp(demand).items():
+            node[1][k] = min(node[1].get(k, 0) + v, node[0].get(k, 0))
+
+    def pool_upsert(self, pool_key, caps):
+        fp = self._to_fp(caps)
+        self._pool_caps[pool_key] = dict(fp)
+        self._pools[pool_key] = dict(fp)
+
+    def pool_remove(self, pool_key):
+        self._pools.pop(pool_key, None)
+        self._pool_caps.pop(pool_key, None)
+
+    def pool_exists(self, pool_key) -> bool:
+        return pool_key in self._pools
+
+    def pool_try_acquire(self, pool_key, demand) -> bool:
+        pool = self._pools.get(pool_key)
+        if pool is None:
+            return False
+        d = self._to_fp(demand)
+        if not self._fits(pool, d):
+            return False
+        for k, v in d.items():
+            pool[k] = pool.get(k, 0) - v
+        return True
+
+    def pool_release(self, pool_key, demand):
+        pool = self._pools.get(pool_key)
+        if pool is None:
+            return
+        caps = self._pool_caps.get(pool_key, {})
+        for k, v in self._to_fp(demand).items():
+            pool[k] = min(pool.get(k, 0) + v, caps.get(k, 0))
+
+    def node_avail(self, node_id, name) -> float:
+        node = self._nodes.get(node_id)
+        return node[1].get(name, 0) / _SCALE if node else 0.0
+
+    def pool_avail(self, pool_key, name) -> float:
+        pool = self._pools.get(pool_key)
+        return pool.get(name, 0) / _SCALE if pool else 0.0
+
+    def cluster_feasibility(self, demand) -> int:
+        d = self._to_fp(demand)
+        best = 0
+        for total, avail in self._nodes.values():
+            if self._fits(avail, d):
+                return 2
+            if self._fits(total, d):
+                best = 1
+        return best
+
+    def best_node(self, demand, strategy, local_node) -> str | None:
+        d = self._to_fp(demand)
+        if strategy == SPREAD:
+            best, best_score = None, -1.0
+            for nid in sorted(self._nodes):
+                total, avail = self._nodes[nid]
+                if not self._fits(total, d):
+                    continue
+                score = sum(
+                    avail.get(k, 0) / t for k, t in total.items() if t > 0
+                )
+                if score > best_score:
+                    best, best_score = nid, score
+            return best
+        local = self._nodes.get(local_node)
+        if local is not None and self._fits(local[1], d):
+            return local_node
+        feasible_peer = None
+        for nid in sorted(self._nodes):
+            if nid == local_node:
+                continue
+            total, avail = self._nodes[nid]
+            if self._fits(avail, d):
+                return nid
+            if feasible_peer is None and self._fits(total, d):
+                feasible_peer = nid
+        if local is not None and self._fits(local[0], d):
+            return local_node
+        return feasible_peer
+
+    def close(self):
+        pass
+
+
+def create_sched_core():
+    """Native core when the toolchain allows, Python fallback otherwise."""
+    if os.environ.get("RAY_TPU_DISABLE_NATIVE_SCHED"):
+        return _PySchedCore()
+    lib = _load_lib()
+    if lib is None:
+        return _PySchedCore()
+    return _NativeSchedCore(lib)

@@ -176,12 +176,10 @@ class StandardAutoscaler:
                     idle_gcs_nodes.append(n)
             else:
                 self._idle_since.pop(n["node_id"], None)
-        # Never scale a node type below its configured min_workers baseline.
-        live_counts: dict[str, int] = {}
-        for nid in self.provider.non_terminated_nodes():
-            t = self._node_type_of.get(nid) or self.provider.node_tags(nid).get("node_type")
-            if t:
-                live_counts[t] = live_counts.get(t, 0) + 1
+        # Never scale a node type below its configured min_workers baseline
+        # (counts_by_type from the launch phase is current: reaching here
+        # means feasible_demand was false, so nothing launched this tick).
+        live_counts = dict(counts_by_type)
         for n in idle_gcs_nodes:
             pid = self._provider_node_for(n)
             if pid is None:

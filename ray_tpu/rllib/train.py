@@ -91,13 +91,14 @@ def _stop_met(stop: dict, result: dict) -> bool:
 
 def cmd_train(args) -> int:
     if args.file:
-        run_tuned_example(args.file)
+        # Explicit --stop-iters bounds the YAML's own budget too.
+        run_tuned_example(args.file, max_iters_override=args.stop_iters)
         return 0
     if not (args.run and args.env):
         raise SystemExit("train needs either -f <tuned.yaml> or --run + --env")
     algo, _ = _build(args)
     try:
-        for i in range(args.stop_iters):
+        for i in range(args.stop_iters or 100):
             result = algo.step()
             reward = result.get("episode_reward_mean", float("nan"))
             print(f"iter {i + 1}: reward={reward:.2f} "
@@ -158,7 +159,8 @@ def main(argv=None) -> int:
     t = sub.choices["train"]
     t.add_argument("-f", "--file", default=None,
                    help="tuned-example YAML (rllib/tuned_examples/*.yaml)")
-    t.add_argument("--stop-iters", type=int, default=100)
+    t.add_argument("--stop-iters", type=int, default=None,
+                   help="iteration cap (default: YAML stop / 100)")
     t.add_argument("--stop-reward", type=float, default=None)
     t.add_argument("--stop-timesteps", type=int, default=None)
     t.add_argument("--checkpoint-out", default=None)

@@ -494,11 +494,14 @@ def cmd_up(args):
             raise SystemExit(f"cluster {name!r} is already up; run `ray_tpu down {args.cluster_config}` first")
     head = config.get("head_node", {})
     head_res = dict(head.get("resources", {}))
+    custom = {k: v for k, v in head_res.items() if k not in ("CPU", "TPU")}
     start_args = [
         sys.executable, "-m", "ray_tpu.scripts.scripts", "start", "--head",
         "--num-cpus", str(int(head_res.get("CPU", os.cpu_count() or 1))),
         "--num-tpus", str(int(head_res.get("TPU", 0))),
     ]
+    if custom:
+        start_args += ["--resources", json.dumps(custom)]
     subprocess.run(start_args, check=True)
     info = _read_cluster_file()
     gcs_address = "%s:%d" % tuple(info["gcs_address"])

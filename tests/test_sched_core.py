@@ -1,0 +1,133 @@
+"""Native scheduler core tests (analog of the reference's C++ scheduler unit
+tests: cluster_resource_scheduler_test.cc, fixed_point semantics,
+hybrid/spread policy tests) — plus a native-vs-Python differential fuzz."""
+
+import numpy as np
+import pytest
+
+from ray_tpu._private.sched_core import (
+    HYBRID,
+    SPREAD,
+    _PySchedCore,
+    create_sched_core,
+)
+
+
+@pytest.fixture(params=["native", "python"])
+def core(request):
+    if request.param == "native":
+        c = create_sched_core()
+        if not c.is_native:
+            pytest.skip("native sched core unavailable")
+    else:
+        c = _PySchedCore()
+    yield c
+    c.close()
+
+
+def test_acquire_release_exact_fixed_point(core):
+    core.node_upsert("n1", {"CPU": 4, "TPU": 1}, {"CPU": 4, "TPU": 1})
+    # 0.1 is inexact in binary floats: 40 x 0.1-CPU acquires must empty the
+    # node EXACTLY (the reference uses FixedPoint for the same reason).
+    for _ in range(40):
+        assert core.try_acquire("n1", {"CPU": 0.1})
+    assert core.node_avail("n1", "CPU") == 0.0
+    assert not core.try_acquire("n1", {"CPU": 0.1})
+    for _ in range(40):
+        core.release("n1", {"CPU": 0.1})
+    assert core.node_avail("n1", "CPU") == 4.0
+    # Release never inflates past the total.
+    core.release("n1", {"CPU": 5})
+    assert core.node_avail("n1", "CPU") == 4.0
+
+
+def test_pool_lifecycle(core):
+    core.pool_upsert("pg1:0", {"CPU": 2, "TPU": 4})
+    assert core.pool_exists("pg1:0")
+    assert core.pool_try_acquire("pg1:0", {"TPU": 4})
+    assert not core.pool_try_acquire("pg1:0", {"TPU": 1})
+    core.pool_release("pg1:0", {"TPU": 4})
+    assert core.pool_avail("pg1:0", "TPU") == 4.0
+    core.pool_remove("pg1:0")
+    assert not core.pool_exists("pg1:0")
+    assert not core.pool_try_acquire("pg1:0", {"CPU": 1})
+
+
+def test_cluster_feasibility_levels(core):
+    core.node_upsert("a", {"CPU": 8}, {"CPU": 0})
+    assert core.cluster_feasibility({"CPU": 4}) == 1  # feasible, not now
+    core.node_upsert("b", {"CPU": 8}, {"CPU": 8})
+    assert core.cluster_feasibility({"CPU": 4}) == 2  # fits now
+    assert core.cluster_feasibility({"CPU": 100}) == 0  # nowhere
+    assert core.cluster_feasibility({"GPU": 1}) == 0  # unknown resource
+
+
+def test_hybrid_prefers_local_then_spills(core):
+    core.node_upsert("local", {"CPU": 4}, {"CPU": 4})
+    core.node_upsert("peer", {"CPU": 16}, {"CPU": 16})
+    # Local fits now -> stay local (pack).
+    assert core.best_node({"CPU": 2}, HYBRID, "local") == "local"
+    # Local full but feasible; a peer fits now -> spill to the peer.
+    assert core.try_acquire("local", {"CPU": 4})
+    assert core.best_node({"CPU": 2}, HYBRID, "local") == "peer"
+    # Only feasible-by-total anywhere: local is preferred when feasible.
+    assert core.best_node({"CPU": 3}, HYBRID, "local") == "peer"  # peer fits now
+    assert core.try_acquire("peer", {"CPU": 16})
+    assert core.best_node({"CPU": 3}, HYBRID, "local") == "local"  # queue locally
+    # Infeasible locally, feasible on the (full) peer -> peer.
+    assert core.best_node({"CPU": 10}, HYBRID, "local") == "peer"
+    assert core.best_node({"CPU": 64}, HYBRID, "local") is None
+
+
+def test_spread_picks_emptiest(core):
+    core.node_upsert("a", {"CPU": 8}, {"CPU": 2})
+    core.node_upsert("b", {"CPU": 8}, {"CPU": 7})
+    core.node_upsert("c", {"CPU": 2}, {"CPU": 2})
+    assert core.best_node({"CPU": 1}, SPREAD, "a") in ("b", "c")
+    # Feasibility still filters: a 4-CPU shape can't go to the 2-CPU node.
+    assert core.best_node({"CPU": 4}, SPREAD, "a") == "b"
+
+
+def test_native_python_differential_fuzz():
+    native = create_sched_core()
+    if not native.is_native:
+        pytest.skip("native sched core unavailable")
+    py = _PySchedCore()
+    rng = np.random.default_rng(0)
+    names = ["CPU", "TPU", "mem", "custom_x"]
+    nodes = [f"n{i}" for i in range(4)]
+    for c in (native, py):
+        for n in nodes:
+            c.node_upsert(n, {"CPU": 8, "TPU": 4, "mem": 100}, {"CPU": 8, "TPU": 4, "mem": 100})
+        c.pool_upsert("pg:0", {"CPU": 3, "custom_x": 1.5})
+    try:
+        for step in range(3000):
+            op = rng.integers(0, 5)
+            node = nodes[rng.integers(0, len(nodes))]
+            demand = {
+                names[j]: float(rng.integers(1, 30)) / 10
+                for j in rng.choice(len(names), rng.integers(1, 3), replace=False)
+            }
+            if op == 0:
+                assert native.try_acquire(node, demand) == py.try_acquire(node, demand), (step, demand)
+            elif op == 1:
+                native.release(node, demand)
+                py.release(node, demand)
+            elif op == 2:
+                assert native.pool_try_acquire("pg:0", demand) == py.pool_try_acquire("pg:0", demand)
+            elif op == 3:
+                native.pool_release("pg:0", demand)
+                py.pool_release("pg:0", demand)
+            else:
+                assert native.cluster_feasibility(demand) == py.cluster_feasibility(demand)
+                for strat in (HYBRID, SPREAD):
+                    b_n = native.best_node(demand, strat, "n0")
+                    b_p = py.best_node(demand, strat, "n0")
+                    # Tie-breaking order may differ; both must agree on
+                    # feasibility and on the fits-now property of the pick.
+                    assert (b_n is None) == (b_p is None), (step, demand, strat, b_n, b_p)
+            for n in nodes:
+                for name in names:
+                    assert native.node_avail(n, name) == pytest.approx(py.node_avail(n, name)), (step, n, name)
+    finally:
+        native.close()
