@@ -549,23 +549,15 @@ class Raylet:
             if node_id == self.node_id or node_id in self.cluster_view:
                 return node_id
             return self.node_id if soft else None
-        feasible_here = all(
-            self.resources_total.get(k, 0) >= v for k, v in spec.resources.items()
-        )
-        fits_now = self._fits_now(spec)
-        if strategy == "SPREAD":
-            # Highest free-fraction among feasible nodes — scored by the
-            # native core over the heartbeat-synced cluster view.
-            return self._sched.best_node(spec.resources, 1, self.node_id)
-        if fits_now or feasible_here:
-            return self.node_id
-        # Infeasible here: find a feasible peer.
-        for nid, node in self.cluster_view.items():
-            if nid == self.node_id:
-                continue
-            if all(node["resources_total"].get(k, 0) >= v for k, v in spec.resources.items()):
-                return nid
-        return self.node_id if feasible_here else None
+        from ray_tpu._private.sched_core import HYBRID, SPREAD
+
+        # Both policies score over the core's cluster view (local ledger is
+        # live; peers mirrored from heartbeats). Hybrid = pack the local node
+        # while it fits now, spill to a fits-now peer, else queue wherever
+        # the shape is at least feasible by totals (local preferred) —
+        # reference policy/hybrid_scheduling_policy.h:50.
+        policy = SPREAD if strategy == "SPREAD" else HYBRID
+        return self._sched.best_node(spec.resources, policy, self.node_id)
 
     def _pg_bundle_node(self, spec: TaskSpec) -> str | None:
         # Bundle lives on another node; ask GCS which.

@@ -54,7 +54,13 @@ def _load_lib():
         so = _build_native()
         if so is None:
             return None
-        lib = ctypes.CDLL(so)
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError as e:
+            # A stale/foreign-arch cached .so must degrade to the Python
+            # fallback, not crash raylet startup.
+            logger.warning("native sched core load failed (%s); using Python fallback", e)
+            return None
         u32p = ctypes.POINTER(ctypes.c_uint32)
         f64p = ctypes.POINTER(ctypes.c_double)
         lib.sc_create.restype = ctypes.c_int
@@ -181,7 +187,10 @@ class _NativeSchedCore:
 
 
 def _fp(v: float) -> int:
-    return int(round(v * _SCALE))
+    # Match the C++ core bit-for-bit: half-away-from-zero, truncated cast
+    # (round() would use banker's rounding and disagree at exact halves).
+    x = v * _SCALE
+    return int(x + 0.5) if x >= 0 else int(x - 0.5)
 
 
 class _PySchedCore:

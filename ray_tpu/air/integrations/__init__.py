@@ -1,0 +1,27 @@
+"""Experiment-tracker integrations (analog of reference python/ray/air/
+integrations/{wandb,mlflow,comet}.py).
+
+None of the tracker SDKs ship in this image, so each setup function raises
+with install guidance — the same seam the reference exposes. The in-image
+alternative is the Tune logger stack (tune/logger.py: CSV/JSON/TensorBoard).
+"""
+
+from __future__ import annotations
+
+
+def _gated(name: str, package: str):
+    def _setup(*args, **kwargs):
+        raise ImportError(
+            f"{name} requires the '{package}' package, which is not installed "
+            f"in this environment (pip install {package}). The built-in "
+            "CSV/JSON/TensorBoard loggers (ray_tpu.tune.logger) need no "
+            "external service."
+        )
+
+    _setup.__name__ = name
+    return _setup
+
+
+setup_wandb = _gated("setup_wandb", "wandb")
+setup_mlflow = _gated("setup_mlflow", "mlflow")
+setup_comet = _gated("setup_comet", "comet-ml")

@@ -54,7 +54,11 @@ def run_tuned_example(path: str, max_iters_override: int | None = None) -> dict:
         cfg = cls.get_default_config().environment(exp["env"])
         cfg.update_from_dict(exp.get("config") or {})
         stop = exp.get("stop") or {}
-        max_iters = max_iters_override or int(stop.get("training_iteration", 100))
+        max_iters = (
+            max_iters_override
+            if max_iters_override is not None
+            else int(stop.get("training_iteration", 100))
+        )
         algo = cfg.build()
         result: dict = {}
         try:
@@ -98,7 +102,7 @@ def cmd_train(args) -> int:
         raise SystemExit("train needs either -f <tuned.yaml> or --run + --env")
     algo, _ = _build(args)
     try:
-        for i in range(args.stop_iters or 100):
+        for i in range(100 if args.stop_iters is None else args.stop_iters):
             result = algo.step()
             reward = result.get("episode_reward_mean", float("nan"))
             print(f"iter {i + 1}: reward={reward:.2f} "

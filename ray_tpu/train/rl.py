@@ -1,0 +1,98 @@
+"""RLTrainer — RLlib algorithms behind the Train API.
+
+Reference: python/ray/train/rl/rl_trainer.py (RLTrainer wraps an RLlib
+algorithm as a Trainer so RL drops into the same fit()/Result/checkpoint
+workflow as supervised trainers, and rl_predictor.py serves the trained
+policy as a Predictor).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import RunConfig, ScalingConfig
+from ray_tpu.train.base_trainer import BaseTrainer, Result
+
+
+class RLTrainer(BaseTrainer):
+    """``algorithm`` is an Algorithm class (or name, e.g. "PPO");
+    ``config`` maps onto its AlgorithmConfig (env included)."""
+
+    def __init__(
+        self,
+        *,
+        algorithm,
+        config: dict,
+        stop: dict | None = None,
+        scaling_config: ScalingConfig | None = None,
+        run_config: RunConfig | None = None,
+        **kwargs,
+    ):
+        super().__init__(scaling_config=scaling_config, run_config=run_config, **kwargs)
+        if isinstance(algorithm, str):
+            import ray_tpu.rllib as rllib
+
+            algorithm = getattr(rllib, algorithm.upper(), None) or getattr(rllib, algorithm)
+        self.algorithm_cls = algorithm
+        self.algo_config = dict(config)
+        self.stop = dict(stop or {})
+        if run_config is not None and run_config.stop:
+            self.stop.update(run_config.stop)
+
+    def _fit_direct(self) -> Result:
+        run_dir = self._run_dir()
+        algo = self.algorithm_cls(config=self.algo_config)
+        last: dict = {}
+        history: list[dict] = []
+        try:
+            max_iters = int(self.stop.get("training_iteration", 100))
+            for i in range(max_iters):
+                last = algo.step()
+                last["training_iteration"] = i + 1
+                history.append(dict(last))
+                if any(
+                    (v := last.get(k)) is not None and v == v and v >= bound
+                    for k, bound in self.stop.items()
+                ):
+                    break
+            ckpt = algo.save_checkpoint()
+            ckpt.metadata["algorithm"] = self.algorithm_cls.__name__
+            result = Result(metrics=last, checkpoint=ckpt, path=run_dir)
+        except Exception as e:
+            return Result(metrics=last, error=f"{type(e).__name__}: {e}", path=run_dir)
+        finally:
+            algo.cleanup()
+        try:
+            import pandas as pd
+
+            result.metrics_dataframe = pd.DataFrame(history)
+        except Exception:
+            pass
+        return result
+
+
+class RLPredictor:
+    """Serve a trained policy from an RLTrainer checkpoint (reference:
+    train/rl/rl_predictor.py)."""
+
+    def __init__(self, algorithm_cls, config: dict, checkpoint: Checkpoint):
+        self.algo = algorithm_cls(config=config)
+        self.algo.load_checkpoint(checkpoint)
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint: Checkpoint, *, algorithm, config: dict) -> "RLPredictor":
+        if isinstance(algorithm, str):
+            import ray_tpu.rllib as rllib
+
+            algorithm = getattr(rllib, algorithm.upper(), None) or getattr(rllib, algorithm)
+        return cls(algorithm, config, checkpoint)
+
+    def predict(self, obs_batch) -> np.ndarray:
+        obs_batch = np.asarray(obs_batch)
+        return np.asarray([
+            self.algo.compute_single_action(obs, explore=False) for obs in obs_batch
+        ])
+
+    def close(self):
+        self.algo.cleanup()

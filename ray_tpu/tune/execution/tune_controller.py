@@ -110,6 +110,11 @@ class TuneController:
             from ray_tpu.tune.syncer import SyncManager
 
             self._sync_manager = SyncManager(sync_config, experiment_dir, experiment_name)
+        self._logger_manager = None
+        if experiment_dir:
+            from ray_tpu.tune.logger import LoggerManager
+
+            self._logger_manager = LoggerManager(experiment_dir)
 
         self.trials: list[Trial] = []
         self._searcher_done = False
@@ -194,11 +199,22 @@ class TuneController:
     # -- result handling ----------------------------------------------------
 
     def _on_result(self, trial: Trial, result: dict):
+        # A bare done sentinel (function trainable ending) carries no new
+        # metrics — logging it would duplicate the last row. Trainable.train
+        # decorates every result with iteration/timing bookkeeping, so only
+        # non-bookkeeping keys count; a final step reporting real metrics
+        # together with done is still logged.
+        raw_has_metrics = any(
+            k not in (RESULT_DONE, "training_iteration", "time_total_s", "time_this_iter_s")
+            for k in result
+        )
         # merge so the final done-sentinel step doesn't erase reported metrics
         trial.last_result = {**trial.last_result, **result}
         result = trial.last_result
         if self.metric and self.metric in result:
             trial.metric_history.append(result[self.metric])
+        if self._logger_manager is not None and raw_has_metrics:
+            self._logger_manager.on_result(trial, result)
         self.searcher.on_trial_result(trial.trial_id, result)
 
         if self._should_stop_trial(result):
@@ -330,6 +346,8 @@ class TuneController:
             for t in self._live_trials():
                 self._stop_trial(t, TERMINATED)
             self.save_experiment_state()
+            if self._logger_manager is not None:
+                self._logger_manager.close()
             if self._sync_manager is not None:
                 self._sync_manager.maybe_sync_up(force=True)
         return self.trials

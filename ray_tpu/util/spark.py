@@ -1,0 +1,21 @@
+"""Spark-on-ray_tpu shim (analog of reference python/ray/util/spark/ —
+RayDP-style cluster startup). PySpark is not in this image; the entry points
+raise with install guidance, keeping the reference's API surface."""
+
+from __future__ import annotations
+
+
+def _gated(name: str):
+    def _fn(*args, **kwargs):
+        raise ImportError(
+            f"{name} requires the 'pyspark' package, which is not installed "
+            "in this environment (pip install pyspark). Dataset interop "
+            "(ray_tpu.data.from_pandas/from_arrow) works without Spark."
+        )
+
+    _fn.__name__ = name
+    return _fn
+
+
+setup_ray_cluster = _gated("setup_ray_cluster")
+shutdown_ray_cluster = _gated("shutdown_ray_cluster")

@@ -1,0 +1,99 @@
+"""Tests: Tune logger stack (CSV/JSON/TBX), RLTrainer/RLPredictor bridge,
+gated integrations/spark shim.
+
+Reference analogs: tune/tests/test_logger.py, train/tests/test_rl_trainer.py.
+"""
+
+import csv
+import json
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air.config import RunConfig
+
+
+@pytest.fixture
+def ray_start_regular():
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    try:
+        yield
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_trial_dirs_get_csv_json_tbx(ray_start_regular, tmp_path):
+    def trainable(config):
+        for i in range(3):
+            tune.report({"score": config["x"] * (i + 1), "note": "text-skipped-in-csv"})
+
+    results = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1.0, 2.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="logexp", storage_path=str(tmp_path)),
+    ).fit()
+    assert len(results) == 2
+    exp_dir = tmp_path / "logexp"
+    trial_dirs = [d for d in exp_dir.iterdir() if d.is_dir()]
+    assert len(trial_dirs) == 2
+    for td in trial_dirs:
+        with open(td / "progress.csv") as f:
+            rows = list(csv.DictReader(f))
+        assert len(rows) == 3
+        assert float(rows[1]["score"]) == 2 * float(rows[0]["score"])
+        with open(td / "result.json") as f:
+            lines = [json.loads(l) for l in f if l.strip()]
+        assert lines[0]["note"] == "text-skipped-in-csv"
+        assert json.load(open(td / "params.json"))["x"] in (1.0, 2.0)
+        # TensorBoard event file from tensorboardX.
+        assert any(name.startswith("events.out") for name in os.listdir(td))
+
+
+def test_rl_trainer_fit_and_predict(ray_start_regular):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from ray_tpu.train.rl import RLPredictor, RLTrainer
+
+    trainer = RLTrainer(
+        algorithm="PPO",
+        config={
+            "env": "CartPole-v1",
+            "num_rollout_workers": 1,
+            "num_envs_per_worker": 2,
+            "train_batch_size": 400,
+            "sgd_minibatch_size": 128,
+            "num_sgd_iter": 2,
+        },
+        stop={"training_iteration": 2},
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["training_iteration"] == 2
+    assert result.checkpoint is not None
+    assert result.checkpoint.metadata["algorithm"] == "PPO"
+    assert result.metrics_dataframe is not None and len(result.metrics_dataframe) == 2
+    predictor = RLPredictor.from_checkpoint(
+        result.checkpoint,
+        algorithm="PPO",
+        config={"env": "CartPole-v1", "num_rollout_workers": 0},
+    )
+    try:
+        actions = predictor.predict(np.zeros((3, 4), np.float32))
+        assert actions.shape == (3,)
+        assert set(actions.tolist()) <= {0, 1}
+    finally:
+        predictor.close()
+
+
+def test_gated_shims_raise_with_guidance():
+    from ray_tpu.air.integrations import setup_mlflow, setup_wandb
+    from ray_tpu.util.spark import setup_ray_cluster
+
+    for fn, pkg in ((setup_wandb, "wandb"), (setup_mlflow, "mlflow"), (setup_ray_cluster, "pyspark")):
+        with pytest.raises(ImportError, match=pkg):
+            fn()
