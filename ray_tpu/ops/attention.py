@@ -41,15 +41,23 @@ def _xla_attention(q, k, v, causal: bool, sm_scale: float, bias=None):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bool, sm_scale: float, seq_k: int, block_q: int):
+# LSE (and the in-kernel running max/denominator) carry a replicated
+# 128-lane trailing dim: Mosaic tiles the last two dims as (8, 128), so a
+# 1-D [block_q] vector (or a [BH, Tq] output with a squeezed block dim)
+# cannot be laid out. Same layout as jax's reference TPU flash kernel
+# (jax/experimental/pallas/ops/tpu/flash_attention.py, MIN_BLOCK_SIZE).
+_LSE_LANES = 128
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_k: int, causal: bool, sm_scale: float, seq_k: int, block_q: int):
     from jax.experimental import pallas as pl
 
     q = q_ref[...]  # [block_q, d]
     q_idx = pl.program_id(1)
     d = q.shape[-1]
 
-    m0 = jnp.full((q.shape[0],), -jnp.inf, dtype=jnp.float32)
-    l0 = jnp.zeros((q.shape[0],), dtype=jnp.float32)
+    m0 = jnp.full((q.shape[0], 1), -jnp.inf, dtype=jnp.float32)
+    l0 = jnp.zeros((q.shape[0], 1), dtype=jnp.float32)
     acc0 = jnp.zeros((q.shape[0], d), dtype=jnp.float32)
 
     num_k_blocks = pl.cdiv(seq_k, block_k)
@@ -75,26 +83,29 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: 
             q_pos = q_idx * block_q + causal_offset + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
-        m_cur = jnp.maximum(m_prev, s.max(axis=-1))
+        m_cur = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
         correction = jnp.exp(m_prev - m_cur)
-        p = jnp.exp(s - m_cur[:, None])
-        l_cur = l_prev * correction + p.sum(axis=-1)
+        p = jnp.exp(s - m_cur)
+        l_cur = l_prev * correction + p.sum(axis=-1, keepdims=True)
         pv = jax.lax.dot_general(
             p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        acc_cur = acc_prev * correction[:, None] + pv
+        acc_cur = acc_prev * correction + pv
         return m_cur, l_cur, acc_cur
 
     m, l, acc = jax.lax.fori_loop(0, num_k_blocks, body, (m0, l0, acc0))
-    o_ref[...] = (acc / l[:, None]).astype(o_ref.dtype)
-    # Log-sum-exp per row: the residual the backward pass needs to
-    # reconstruct P = exp(S - lse) blockwise without re-running the online
-    # softmax.
-    lse_ref[...] = (m + jnp.log(l)).astype(lse_ref.dtype)
+    o_ref[...] = (acc / l).astype(o_ref.dtype)
+    if lse_ref is not None:
+        # Log-sum-exp per row: the residual the backward pass needs to
+        # reconstruct P = exp(S - lse) blockwise without re-running the
+        # online softmax. Replicated across the lane dim (see _LSE_LANES).
+        # Only materialized on the VJP forward — the primal path skips the
+        # HBM write entirely.
+        lse_ref[...] = jnp.broadcast_to(m + jnp.log(l), lse_ref.shape).astype(lse_ref.dtype)
 
 
-def _pallas_flash_with_lse(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: int, interpret: bool):
+def _pallas_flash_with_lse(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: int, interpret: bool, save_lse: bool = True):
     from jax.experimental import pallas as pl
 
     B, Tq, H, D = q.shape
@@ -113,7 +124,12 @@ def _pallas_flash_with_lse(q, k, v, causal: bool, sm_scale: float, block_q: int,
         seq_k=Tk,
         block_q=block_q,
     )
-    out, lse = pl.pallas_call(
+    out_specs = [pl.BlockSpec((None, block_q, D), lambda bh, qb: (bh, qb, 0))]
+    out_shape = [jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype)]
+    if save_lse:
+        out_specs.append(pl.BlockSpec((None, block_q, _LSE_LANES), lambda bh, qb: (bh, qb, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((B * H, Tq, _LSE_LANES), jnp.float32))
+    res = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -121,25 +137,18 @@ def _pallas_flash_with_lse(q, k, v, causal: bool, sm_scale: float, block_q: int,
             pl.BlockSpec((None, Tk, D), lambda bh, qb: (bh, 0, 0)),
             pl.BlockSpec((None, Tk, D), lambda bh, qb: (bh, 0, 0)),
         ],
-        out_specs=[
-            pl.BlockSpec((None, block_q, D), lambda bh, qb: (bh, qb, 0)),
-            pl.BlockSpec((None, block_q), lambda bh, qb: (bh, qb)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, Tq), jnp.float32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         interpret=interpret,
     )(qf, kf, vf)
-    return (
-        out.reshape(B, H, Tq, D).transpose(0, 2, 1, 3),
-        lse.reshape(B, H, Tq),
-    )
+    out = res[0].reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
+    lse = res[1][..., 0].reshape(B, H, Tq) if save_lse else None
+    return out, lse
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _pallas_flash(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: int, interpret: bool):
-    out, _ = _pallas_flash_with_lse(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    out, _ = _pallas_flash_with_lse(q, k, v, causal, sm_scale, block_q, block_k, interpret, save_lse=False)
     return out
 
 

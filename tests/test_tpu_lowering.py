@@ -1,0 +1,60 @@
+"""Mosaic lowering gate for the Pallas kernels.
+
+Round-1 lesson (VERDICT.md Weak #1): every kernel test ran interpret=True on
+CPU, so the suite stayed green while the TPU lowering was broken (the LSE
+BlockSpec violated the (8, 128) tile constraint and bench.py crashed on
+hardware). This test compiles the kernels for the real TPU backend — no
+interpret — so a Mosaic lowering regression fails CI whenever a TPU is
+reachable.
+
+The suite-wide conftest pins this process to CPU before jax import, so the
+probe runs in a subprocess with the CPU pins stripped; it skips (not passes)
+when no TPU backend comes up.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_PROBE = r"""
+import sys
+import jax
+if jax.default_backend() not in ("tpu", "axon"):
+    print("NO_TPU_BACKEND:" + jax.default_backend())
+    sys.exit(42)
+import jax.numpy as jnp
+from ray_tpu.ops.attention import flash_attention
+
+B, T, H, D = 2, 512, 4, 128
+q = jax.ShapeDtypeStruct((B, T, H, D), jnp.bfloat16)
+
+for causal in (False, True):
+    fwd = jax.jit(lambda q, k, v, c=causal: flash_attention(q, k, v, causal=c, force_pallas=True))
+    fwd.lower(q, q, q).compile()
+    bwd = jax.jit(jax.grad(
+        lambda q, k, v, c=causal: flash_attention(q, k, v, causal=c, force_pallas=True).astype(jnp.float32).sum(),
+        argnums=(0, 1, 2)))
+    bwd.lower(q, q, q).compile()
+print("LOWERED_OK")
+"""
+
+
+def test_flash_attention_lowers_on_tpu():
+    env = dict(os.environ)
+    # Strip the suite's CPU pins so the subprocess sees the real backend.
+    for k in ("JAX_PLATFORMS", "RAY_TPU_JAX_CONFIG_PLATFORMS", "RAY_TPU_NUM_TPUS", "XLA_FLAGS"):
+        env.pop(k, None)
+    proc = subprocess.run(
+        [sys.executable, "-c", _PROBE],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=580,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    if proc.returncode == 42:
+        pytest.skip(f"no TPU backend in subprocess: {proc.stdout.strip()}")
+    assert proc.returncode == 0, f"TPU lowering failed:\n{proc.stdout}\n{proc.stderr[-4000:]}"
+    assert "LOWERED_OK" in proc.stdout
