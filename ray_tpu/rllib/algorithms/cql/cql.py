@@ -169,6 +169,11 @@ class CQL(OffPolicyTraining, Algorithm):
                 pol_a, pol_logp, _ = _squashed_sample(
                     params["actor"], jnp.repeat(obs, n_cql, axis=0), k3, action_dim
                 )
+                # The conservative penalty must not train the actor: without
+                # this stop_gradient, minimizing logsumexp Q(s, pi(s)) drives
+                # the policy toward low-Q actions through pol_a (same shared-
+                # optimizer leak class as the q_pi term below).
+                pol_a = jax.lax.stop_gradient(pol_a)
                 pol_a = pol_a.reshape(B, n_cql, action_dim)
                 pol_logp = pol_logp.reshape(B, n_cql)
                 log_u = -action_dim * jnp.log(2.0)  # uniform density on [-1,1]^d
@@ -182,9 +187,12 @@ class CQL(OffPolicyTraining, Algorithm):
                         jax.scipy.special.logsumexp(cat, axis=1) - jnp.log(2.0 * n_cql) - qd
                     )
                 a_pi, logp_pi, _ = _squashed_sample(params["actor"], obs, k4, action_dim)
+                # Stop-gradient the critics in the actor term: the shared
+                # optimizer would otherwise push Q UP on policy actions,
+                # directly fighting the CQL conservative penalty above.
                 q_pi = jnp.minimum(
-                    _mlp_apply(params["q1"], jnp.concatenate([obs, a_pi], -1))[:, 0],
-                    _mlp_apply(params["q2"], jnp.concatenate([obs, a_pi], -1))[:, 0],
+                    _mlp_apply(jax.lax.stop_gradient(params["q1"]), jnp.concatenate([obs, a_pi], -1))[:, 0],
+                    _mlp_apply(jax.lax.stop_gradient(params["q2"]), jnp.concatenate([obs, a_pi], -1))[:, 0],
                 )
                 actor_loss = jnp.mean(alpha * logp_pi - q_pi)
                 entropy = -logp_pi.mean()

@@ -67,7 +67,7 @@ class _ESWorker:
         self._forward = jax.jit(lambda p, o: rl_module.forward(p, o, spec)[0])
         self._np_rng = np.random.default_rng(seed)
 
-    def _episode_return(self, flat, episode_horizon: int) -> float:
+    def _episode_return(self, flat, episode_horizon: int) -> tuple:
         import jax.numpy as jnp
 
         params = _unflatten(flat, self.treedef, self.shapes)
@@ -81,19 +81,20 @@ class _ESWorker:
             steps += 1
             if terminated or truncated:
                 break
-        return total
+        return total, steps
 
     def rollout(self, flat_params: np.ndarray, seeds: list, sigma: float, episode_horizon: int):
-        """Antithetic evaluation: for each seed return (R+, R-)."""
+        """Antithetic evaluation: for each seed return (R+, R-, env steps)."""
         out = []
         for s in seeds:
             noise = np.random.default_rng(int(s)).standard_normal(len(flat_params)).astype(np.float32)
-            r_pos = self._episode_return(flat_params + sigma * noise, episode_horizon)
-            r_neg = self._episode_return(flat_params - sigma * noise, episode_horizon)
-            out.append((r_pos, r_neg))
+            r_pos, n_pos = self._episode_return(flat_params + sigma * noise, episode_horizon)
+            r_neg, n_neg = self._episode_return(flat_params - sigma * noise, episode_horizon)
+            out.append((r_pos, r_neg, n_pos + n_neg))
         return out
 
     def evaluate(self, flat_params: np.ndarray, episodes: int, episode_horizon: int) -> list:
+        """Returns (reward, env steps) per episode."""
         return [self._episode_return(flat_params, episode_horizon) for _ in range(episodes)]
 
     def stop(self):
@@ -180,10 +181,12 @@ class ES(Algorithm):
         ]
         pairs: list = []
         used_seeds: list = []
+        steps_this_iter = 0
         for ref, chunk in zip(refs, [c for c in per_worker if len(c)]):
             try:
                 res = ray_tpu.get(ref, timeout=600)
-                pairs += res
+                pairs += [(rp, rn) for rp, rn, _ in res]
+                steps_this_iter += sum(n for _, _, n in res)
                 used_seeds += list(chunk)
             except Exception:
                 pass  # lost worker: proceed with the survivors' episodes
@@ -207,13 +210,17 @@ class ES(Algorithm):
         mhat = self._m / (1 - b1**self._t)
         vhat = self._v / (1 - b2**self._t)
         self.flat = self.flat + cfg.stepsize * mhat / (np.sqrt(vhat) + eps)
-        self._timesteps_total += int(returns.size) * cfg.episode_horizon // 10  # approx
         # Evaluate the unperturbed policy for the reported reward.
         eval_refs = [self._workers[0].evaluate.remote(self.flat, cfg.eval_episodes, cfg.episode_horizon)]
         try:
-            rewards = ray_tpu.get(eval_refs[0], timeout=600)
+            evals = ray_tpu.get(eval_refs[0], timeout=600)
         except Exception:
-            rewards = []
+            evals = []
+        rewards = [r for r, _ in evals]
+        steps_this_iter += sum(n for _, n in evals)
+        # Real env-step counts from the workers (an estimate here would leak
+        # into stop criteria like stop_timesteps).
+        self._timesteps_total += steps_this_iter
         self._episode_reward_window += rewards
         self._episode_reward_window = self._episode_reward_window[-100:]
         return {
@@ -254,12 +261,29 @@ class ES(Algorithm):
     def save_checkpoint(self):
         from ray_tpu.air.checkpoint import Checkpoint
 
-        return Checkpoint.from_dict({"flat": self.flat, "timesteps": self._timesteps_total})
+        # Adam moments and the seed stream are training state: without them a
+        # pause/resume (routine under sync HyperBand) spikes the step size
+        # (fresh bias correction) and replays the same noise directions.
+        return Checkpoint.from_dict({
+            "flat": self.flat,
+            "timesteps": self._timesteps_total,
+            "adam_m": np.asarray(self._m),
+            "adam_v": np.asarray(self._v),
+            "adam_t": self._t,
+            "np_rng_state": self._np_rng.bit_generator.state,
+        })
 
     def load_checkpoint(self, checkpoint) -> None:
         data = checkpoint.to_dict()
         self.flat = np.asarray(data["flat"], np.float32)
         self._timesteps_total = data.get("timesteps", 0)
+        if "adam_m" in data:
+            self._m = np.asarray(data["adam_m"], np.float32)
+            self._v = np.asarray(data["adam_v"], np.float32)
+            self._t = int(data["adam_t"])
+        if data.get("np_rng_state") is not None:
+            self._np_rng = np.random.default_rng()
+            self._np_rng.bit_generator.state = data["np_rng_state"]
 
     def cleanup(self) -> None:
         for w in getattr(self, "_workers", []):

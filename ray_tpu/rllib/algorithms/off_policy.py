@@ -30,11 +30,27 @@ class OffPolicyTraining:
 
         from ray_tpu.air.checkpoint import Checkpoint
 
-        return Checkpoint.from_dict({
+        # Optimizer state, RNGs, and the policy-delay counter are part of the
+        # training state: dropping them silently resets Adam moments and
+        # DDPG/TD3's delayed-actor phase on restore (reference policy state
+        # includes optimizer variables).
+        state = {
             "params": jax.tree_util.tree_map(np.asarray, self.params),
             "target": jax.tree_util.tree_map(np.asarray, self.target),
+            "opt_state": jax.tree_util.tree_map(np.asarray, self.opt_state),
+            "rng": np.asarray(self._rng),
+            # Snapshot the bit-generator state dict, not the live Generator:
+            # the object would keep mutating after save (and aliasing it on
+            # load would share one stream between algorithms).
+            # Offline algos (CQL) have no exploration rng.
+            "np_rng_state": (
+                self._np_rng.bit_generator.state if hasattr(self, "_np_rng") else None
+            ),
             "timesteps": self._timesteps_total,
-        })
+        }
+        if hasattr(self, "_updates"):
+            state["updates"] = self._updates
+        return Checkpoint.from_dict(state)
 
     def load_checkpoint(self, checkpoint) -> None:
         import jax
@@ -43,6 +59,15 @@ class OffPolicyTraining:
         data = checkpoint.to_dict()
         self.params = jax.tree_util.tree_map(jnp.asarray, data["params"])
         self.target = jax.tree_util.tree_map(jnp.asarray, data["target"])
+        if "opt_state" in data:
+            self.opt_state = jax.tree_util.tree_map(jnp.asarray, data["opt_state"])
+        if "rng" in data:
+            self._rng = jnp.asarray(data["rng"])
+        if data.get("np_rng_state") is not None:
+            self._np_rng = np.random.default_rng()
+            self._np_rng.bit_generator.state = data["np_rng_state"]
+        if "updates" in data:
+            self._updates = data["updates"]
         self._timesteps_total = data.get("timesteps", 0)
 
     def cleanup(self) -> None:

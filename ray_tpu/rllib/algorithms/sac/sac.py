@@ -229,9 +229,13 @@ class SAC(OffPolicyTraining, Algorithm):
                 q2 = _mlp_apply(params["q2"], sa)[:, 0]
                 critic_loss = 0.5 * (jnp.mean((q1 - td_target) ** 2) + jnp.mean((q2 - td_target) ** 2))
                 a, logp, _ = _squashed_sample(params["actor"], obs, k2, action_dim)
+                # Critic params are stop-gradiented in the actor term: with a
+                # single optimizer over the whole tree, -q_pi would otherwise
+                # train q1/q2 to inflate Q on policy actions (the discrete
+                # branch's q_min stop_gradient is the same guard).
                 q_pi = jnp.minimum(
-                    _mlp_apply(params["q1"], jnp.concatenate([obs, a], -1))[:, 0],
-                    _mlp_apply(params["q2"], jnp.concatenate([obs, a], -1))[:, 0],
+                    _mlp_apply(jax.lax.stop_gradient(params["q1"]), jnp.concatenate([obs, a], -1))[:, 0],
+                    _mlp_apply(jax.lax.stop_gradient(params["q2"]), jnp.concatenate([obs, a], -1))[:, 0],
                 )
                 actor_loss = jnp.mean(alpha * logp - q_pi)
                 entropy = -logp.mean()

@@ -290,13 +290,23 @@ class TuneController:
                 if t is None:
                     # Scheduler is gating the paused trials (e.g. sync
                     # HyperBand mid-rung). Try topping up with a fresh trial;
-                    # otherwise respect the gate while work is running, but
-                    # with nothing running force progress to avoid deadlock.
+                    # otherwise respect the gate while work is running. With
+                    # nothing running, ask the scheduler to release its gates
+                    # consistently (finalize/halve incomplete rungs) and
+                    # re-ask; only force a PENDING trial as a last resort —
+                    # force-resuming a gated PAUSED trial would run it past
+                    # its milestone and break sync-halving invariants.
                     if self._maybe_add_trial():
                         continue
                     if self._live_trials():
                         break
-                    t = pending[0]
+                    self.scheduler.on_no_available_trials(self)
+                    t = self.scheduler.choose_trial_to_run(self)
+                    if t is None:
+                        pending = [x for x in self.trials if x.status in (PENDING, PAUSED)]
+                        if not pending:
+                            break
+                        t = next((x for x in pending if x.status == PENDING), pending[0])
                 self._start_trial(t)
                 continue
             if not self._maybe_add_trial():

@@ -136,6 +136,20 @@ class HyperBandScheduler(TrialScheduler):
     def on_trial_error(self, controller, trial):
         self.on_trial_complete(controller, trial, {})
 
+    def on_no_available_trials(self, controller):
+        """Deadlock release: members that can no longer report (terminated
+        outside the bracket's bookkeeping) must not hold a rung open — drop
+        them and finalize the halving so PAUSED winners become resumable."""
+        from ray_tpu.tune.experiment.trial import PAUSED, PENDING, RUNNING
+
+        for b in self._brackets:
+            for t in b.live():
+                if t.status not in (RUNNING, PAUSED, PENDING):
+                    b.dropped.add(t.trial_id)
+            _, stopped = b.try_halve()
+            for t in stopped:
+                controller.stop_trial(t)
+
     def choose_trial_to_run(self, controller):
         """PENDING trials fill brackets; a PAUSED trial is resumable ONLY
         after its rung halved (its id left bracket.results) — resuming

@@ -43,6 +43,14 @@ class TrialScheduler:
                 return t
         return None
 
+    def on_no_available_trials(self, controller) -> None:
+        """Called when the experiment would otherwise deadlock: nothing is
+        running and choose_trial_to_run returned None while gated trials
+        remain. Schedulers holding synchronization state (e.g. sync
+        HyperBand rungs) release their gates consistently here — the
+        controller re-asks choose_trial_to_run afterwards instead of
+        force-starting a gated trial past its milestone."""
+
 
 class FIFOScheduler(TrialScheduler):
     pass
