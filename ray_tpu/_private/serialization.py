@@ -93,10 +93,118 @@ class SerializedObject:
         return bytes(out[:n])
 
 
-def _reduce_jax_array(arr):
-    import numpy as np
+def _encode_index(index, shape):
+    """Shard index (tuple of slices into the global array) -> plain tuples."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append((start, stop))
+    return tuple(out)
 
-    return (np.asarray, (np.asarray(arr),))
+
+def _encode_pspec(spec):
+    return tuple(tuple(p) if isinstance(p, (tuple, list)) else p for p in spec)
+
+
+def _rebuild_sharded(global_shape, axis_names, mesh_ids, mesh_shape, pspec, uniq_bufs, shard_meta):
+    """Reconstructor for a NamedSharding'ed jax.Array: device_put each unique
+    host shard to its device(s) and reassemble WITHOUT a host gather.
+
+    If this process cannot see the original device set (e.g. the object
+    crossed to a host with a different topology), fall back to host-side
+    assembly of the full array from the shards — still a jax.Array, default
+    sharding.
+    """
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    # Device identity is (platform, id): bare ids collide across backends
+    # (a cpu:0 array must not land on tpu:0 just because id 0 exists).
+    devmap = {(d.platform, d.id): d for d in jax.devices()}
+    if all((p, int(i)) in devmap for p, i in mesh_ids):
+        mesh_devs = np.array([devmap[(p, int(i))] for p, i in mesh_ids]).reshape(mesh_shape)
+        sharding = NamedSharding(Mesh(mesh_devs, tuple(axis_names)), PartitionSpec(*pspec))
+        singles = [
+            jax.device_put(uniq_bufs[buf_idx][0], devmap[(p, int(i))])
+            for (p, i), buf_idx in shard_meta
+        ]
+        return jax.make_array_from_single_device_arrays(tuple(global_shape), sharding, singles)
+    # Topology mismatch: host-side reassembly from the unique shards.
+    full = np.zeros(tuple(global_shape), dtype=np.asarray(uniq_bufs[0][0]).dtype)
+    for buf, idx in uniq_bufs:
+        full[tuple(slice(a, b) for a, b in idx)] = buf
+    return jnp.asarray(full)
+
+
+def _rebuild_single(host_arr, device_key):
+    import jax
+    import jax.numpy as jnp
+
+    dev = {(d.platform, d.id): d for d in jax.devices()}.get(tuple(device_key))
+    if dev is not None:
+        return jax.device_put(host_arr, dev)
+    return jnp.asarray(host_arr)
+
+
+def _reduce_jax_array(arr):
+    """Device arrays keep their type and sharding across the object store
+    (SURVEY §2.3 object-plane row: device->host DMA on put, device_put with
+    the original sharding on get — the round-1 np.asarray reduction silently
+    returned numpy and lost the layout).
+
+    Layout metadata (mesh device ids/axes + PartitionSpec + per-shard
+    indices) travels with the object; replicated shards are deduped by index
+    so a fully-replicated array costs 1x its size, not num_devices x.
+    """
+    import numpy as np
+    import jax
+    from jax.sharding import NamedSharding, SingleDeviceSharding
+
+    sharding = arr.sharding
+    if isinstance(sharding, SingleDeviceSharding):
+        (dev,) = arr.devices()
+        return (_rebuild_single, (np.asarray(arr), (dev.platform, dev.id)))
+    if isinstance(sharding, NamedSharding) and arr.is_fully_addressable:
+        mesh = sharding.mesh
+        mesh_devs = mesh.devices
+        mesh_ids = [(d.platform, int(d.id)) for d in mesh_devs.flat]
+        uniq: dict = {}   # encoded index -> slot in uniq_bufs
+        uniq_bufs: list = []  # (host array, encoded index)
+        shard_meta: list = []  # ((platform, id), buffer slot) per addressable shard
+        for s in arr.addressable_shards:
+            idx = _encode_index(s.index, arr.shape)
+            slot = uniq.get(idx)
+            if slot is None:
+                slot = len(uniq_bufs)
+                uniq[idx] = slot
+                uniq_bufs.append((np.asarray(s.data), idx))
+            shard_meta.append(((s.device.platform, int(s.device.id)), slot))
+        return (
+            _rebuild_sharded,
+            (
+                tuple(arr.shape),
+                tuple(mesh.axis_names),
+                mesh_ids,
+                tuple(mesh_devs.shape),
+                _encode_pspec(sharding.spec),
+                uniq_bufs,
+                shard_meta,
+            ),
+        )
+    if not arr.is_fully_addressable:
+        raise TypeError(
+            "cannot put() a multi-host jax.Array: this process only holds "
+            f"{len(arr.addressable_shards)} of its shards. Put per-host shards "
+            "as separate objects (e.g. put(arr.addressable_shards[i].data)) or "
+            "move the value over the collective plane instead."
+        )
+    # Exotic shardings (Positional/GSPMD): host gather, still a jax.Array on get.
+    import jax.numpy as jnp
+
+    return (jnp.asarray, (np.asarray(arr),))
 
 
 class _Pickler(cloudpickle.CloudPickler):

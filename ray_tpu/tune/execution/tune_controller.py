@@ -293,9 +293,12 @@ class TuneController:
                     # otherwise respect the gate while work is running. With
                     # nothing running, ask the scheduler to release its gates
                     # consistently (finalize/halve incomplete rungs) and
-                    # re-ask; only force a PENDING trial as a last resort —
-                    # force-resuming a gated PAUSED trial would run it past
-                    # its milestone and break sync-halving invariants.
+                    # re-ask. As an absolute last resort prefer forcing a
+                    # PENDING trial (safe); if only gated PAUSED trials
+                    # remain, one IS forced past its milestone — a scheduler
+                    # that must never allow that has to release the gate in
+                    # its on_no_available_trials hook (livelock is worse
+                    # than an invariant break we can't see from here).
                     if self._maybe_add_trial():
                         continue
                     if self._live_trials():

@@ -140,8 +140,6 @@ class HyperBandScheduler(TrialScheduler):
         """Deadlock release: members that can no longer report (terminated
         outside the bracket's bookkeeping) must not hold a rung open — drop
         them and finalize the halving so PAUSED winners become resumable."""
-        from ray_tpu.tune.experiment.trial import PAUSED, PENDING, RUNNING
-
         for b in self._brackets:
             for t in b.live():
                 if t.status not in (RUNNING, PAUSED, PENDING):

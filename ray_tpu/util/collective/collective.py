@@ -51,7 +51,21 @@ class GroupManager:
         if backend == Backend.TPU:
             from ray_tpu.util.collective.tpu_group import TpuCollectiveGroup
 
-            group = TpuCollectiveGroup(group_name, world_size, rank, coordinator=coordinator, gcs=gcs)
+            # This node's GCS-registered address: the coordinator must be
+            # dialable from member actors on OTHER hosts, so loopback (the
+            # round-1 bug) is structurally wrong on a real cluster.
+            node_ip = None
+            if cw is not None and rank == 0:
+                try:
+                    nodes = gcs.call("get_nodes").get("nodes", {})
+                    addr = nodes.get(cw.node_id, {}).get("address")
+                    if addr:
+                        node_ip = addr[0]
+                except Exception:
+                    logger.warning("could not resolve node IP from GCS; using interface IP")
+            group = TpuCollectiveGroup(
+                group_name, world_size, rank, coordinator=coordinator, gcs=gcs, node_ip=node_ip
+            )
         else:
             from ray_tpu.util.collective.cpu_group import CpuCollectiveGroup
 

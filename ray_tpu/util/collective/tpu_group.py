@@ -31,13 +31,32 @@ from ray_tpu.util.collective.types import ReduceOp
 
 logger = logging.getLogger(__name__)
 
+# Highest collective-group epoch this process has participated in, per group
+# name: a member re-forming a group after destroy must not accept the dead
+# epoch's coordinator from the KV (fresh processes start at 0 and accept the
+# current epoch).
+_last_epochs: dict = {}
+
 
 def _free_port() -> int:
     s = socket.socket()
-    s.bind(("127.0.0.1", 0))
+    s.bind(("", 0))  # any-interface: the coordinator must be reachable from other hosts
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+def _routable_ip() -> str:
+    """Best-effort primary-interface IP (UDP-connect trick; no packet sent)."""
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except Exception:
+        return "127.0.0.1"
 
 
 def _shard_map():
@@ -60,16 +79,36 @@ class TpuCollectiveGroup:
         rank: int,
         coordinator: str | None = None,
         gcs=None,
+        node_ip: str | None = None,
     ):
         import jax
 
         self.group_name = group_name
         self.world_size = world_size
         self.rank = rank
+        self.epoch = 0
+        self._gcs = gcs
+        self._node_ip = node_ip
         self._op_cache: dict = {}
 
         if world_size > 1:
             coordinator = coordinator or self._rendezvous(gcs)
+            # jax.distributed.initialize refuses to run once the XLA backend
+            # has been touched (e.g. a previous epoch of this group, or any
+            # local jax work). Reset the backends HERE, at re-form time,
+            # rather than in destroy(): live jax.Arrays and world_size=1
+            # local-mesh groups in this process survive a destroy and only
+            # die when a new multi-process world actually has to be built
+            # (one process can host at most one such world).
+            try:
+                from jax._src import xla_bridge
+
+                if xla_bridge.backends_are_initialized():
+                    from jax.extend.backend import clear_backends
+
+                    clear_backends()
+            except Exception as e:
+                logger.debug("backend reset before initialize: %s", e)
             jax.distributed.initialize(
                 coordinator_address=coordinator,
                 num_processes=world_size,
@@ -94,19 +133,59 @@ class TpuCollectiveGroup:
     # nccl_collective_group.py:28, unique id in a named store actor) ----
 
     def _rendezvous(self, gcs) -> str:
+        """Rank 0 advertises ``<routable-ip>:<port>`` under an epoch-scoped
+        KV key; members poll the epoch counter, then the coordinator for
+        that epoch. The epoch bump is what lets a destroyed group re-form
+        under the same name (a member of a dead epoch can't accidentally
+        dial a stale coordinator: re-init always publishes a fresh epoch,
+        so a member that raced a stale read fails its connect, and the
+        gang retry reads the new epoch)."""
         from ray_tpu._private.config import get_config
 
         assert gcs is not None, "GCS client required for multi-process rendezvous"
-        key = f"collective/{self.group_name}/coordinator"
+        epoch_key = f"collective/{self.group_name}/epoch"
         if self.rank == 0:
-            coordinator = f"127.0.0.1:{_free_port()}"
-            gcs.call("kv_put", {"key": key, "value": coordinator.encode()})
+            resp = gcs.call("kv_get", {"key": epoch_key})
+            epoch = int(bytes(resp["value"]).decode()) + 1 if resp.get("found") else 1
+            # The node's GCS-registered address, NOT loopback: a rank on
+            # another host must be able to dial this (reference advertises
+            # ncclUniqueId the same way, nccl_collective_group.py:28).
+            ip = self._node_ip or _routable_ip()
+            if ip in ("0.0.0.0", ""):
+                ip = _routable_ip()
+            coordinator = f"{ip}:{_free_port()}"
+            gcs.call("kv_put", {"key": f"collective/{self.group_name}/coord/{epoch}", "value": coordinator.encode()})
+            gcs.call("kv_put", {"key": epoch_key, "value": str(epoch).encode()})
+            self.epoch = epoch
+            _last_epochs[self.group_name] = epoch
             return coordinator
         deadline = time.monotonic() + get_config().collective_rendezvous_timeout_s
+        last_seen = _last_epochs.get(self.group_name, 0)
+        candidate = None  # (epoch, address)
         while time.monotonic() < deadline:
-            resp = gcs.call("kv_get", {"key": key})
+            resp = gcs.call("kv_get", {"key": epoch_key})
             if resp.get("found"):
-                return bytes(resp["value"]).decode()
+                epoch = int(bytes(resp["value"]).decode())
+                if epoch > (candidate[0] if candidate else last_seen):
+                    coord = gcs.call("kv_get", {"key": f"collective/{self.group_name}/coord/{epoch}"})
+                    if coord.get("found"):
+                        candidate = (epoch, bytes(coord["value"]).decode())
+            if candidate is not None:
+                # Liveness probe before handing the address to
+                # jax.distributed.initialize: a stale key from a crashed
+                # rank 0 (whose destroy never ran) would otherwise block the
+                # whole init on a dead endpoint. The live rank 0 only starts
+                # listening once IT calls initialize, so a refused connect
+                # just means "keep polling" — a newer epoch supersedes.
+                host, port = candidate[1].rsplit(":", 1)
+                try:
+                    s = socket.create_connection((host, int(port)), timeout=0.25)
+                    s.close()
+                    self.epoch = candidate[0]
+                    _last_epochs[self.group_name] = candidate[0]
+                    return candidate[1]
+                except OSError:
+                    pass
             time.sleep(0.05)
         raise TimeoutError(f"collective rendezvous for group {self.group_name} timed out")
 
@@ -324,4 +403,21 @@ class TpuCollectiveGroup:
         return self._local(out)[0]
 
     def destroy(self):
+        """Tear down the XLA world so the group can re-form (gang restart):
+        drops the compiled-op cache, shuts down jax.distributed (releasing
+        the coordinator connection), and best-effort clears this epoch's
+        coordinator key. The next init under the same name bumps the epoch
+        (SURVEY.md hard part #1: group epochs + restart-the-group recovery)."""
+        import jax
+
         self._op_cache.clear()
+        if self.world_size > 1:
+            try:
+                jax.distributed.shutdown()
+            except Exception as e:  # already down / never initialized
+                logger.debug("jax.distributed.shutdown: %s", e)
+            if self.rank == 0 and self._gcs is not None:
+                try:
+                    self._gcs.call("kv_del", {"key": f"collective/{self.group_name}/coord/{self.epoch}"})
+                except Exception:
+                    pass

@@ -101,3 +101,81 @@ def test_multiprocess_tpu_backend_psum(ray_start_regular):
     outs = ray_tpu.get([m.do_allreduce.remote() for m in members], timeout=300)
     for out in outs:
         np.testing.assert_allclose(out, np.full((4,), 3.0, dtype=np.float32))
+
+
+def test_tpu_group_destroy_and_reform(ray_start_regular):
+    """Gang-restart lifecycle (SURVEY hard part #1): a 2-process XLA world
+    forms, allreduces, is destroyed (jax.distributed.shutdown + epoch bump),
+    and re-forms under the SAME group name with a fresh epoch."""
+
+    @ray_tpu.remote
+    class XlaMember:
+        def init_collective(self, world, rank, backend, group_name):
+            from ray_tpu.util import collective as col
+
+            col.init_collective_group(world, rank, backend=backend, group_name=group_name)
+            self.rank = rank
+            return col.get_group(group_name).epoch
+
+        def do_allreduce(self):
+            from ray_tpu.util import collective as col
+
+            return np.asarray(
+                col.allreduce(np.full((4,), float(self.rank + 1), dtype=np.float32), group_name="reform")
+            )
+
+        def destroy(self, group_name):
+            from ray_tpu.util import collective as col
+
+            col.destroy_collective_group(group_name)
+            return True
+
+    from ray_tpu.util import collective as col
+
+    members = [XlaMember.remote() for _ in range(2)]
+    epochs = col.create_collective_group(members, backend="tpu", group_name="reform")
+    assert len(set(epochs)) == 1
+    outs = ray_tpu.get([m.do_allreduce.remote() for m in members], timeout=300)
+    for out in outs:
+        np.testing.assert_allclose(out, np.full((4,), 3.0, dtype=np.float32))
+
+    ray_tpu.get([m.destroy.remote("reform") for m in members], timeout=120)
+
+    epochs2 = col.create_collective_group(members, backend="tpu", group_name="reform")
+    assert len(set(epochs2)) == 1 and epochs2[0] == epochs[0] + 1
+    outs = ray_tpu.get([m.do_allreduce.remote() for m in members], timeout=300)
+    for out in outs:
+        np.testing.assert_allclose(out, np.full((4,), 3.0, dtype=np.float32))
+
+
+def test_rendezvous_advertises_node_ip(ray_start_regular):
+    """The coordinator address published in the KV must carry the node's
+    GCS-registered IP (round-1 bug: hardwired 127.0.0.1 cannot span hosts).
+    On this single-host fixture the registered address IS loopback, so
+    instead assert the epoch-scoped key layout and that the IP equals the
+    node's registered address rather than a constant."""
+
+    @ray_tpu.remote
+    class XlaMember:
+        def init_collective(self, world, rank, backend, group_name):
+            from ray_tpu.util import collective as col
+
+            col.init_collective_group(world, rank, backend=backend, group_name=group_name)
+            return True
+
+        def coordinator_in_kv(self, group_name):
+            from ray_tpu._private import worker_context
+
+            cw = worker_context.get_core_worker_if_initialized()
+            epoch = int(bytes(cw.gcs.call("kv_get", {"key": f"collective/{group_name}/epoch"})["value"]).decode())
+            resp = cw.gcs.call("kv_get", {"key": f"collective/{group_name}/coord/{epoch}"})
+            nodes = cw.gcs.call("get_nodes")["nodes"]
+            my_ip = nodes[cw.node_id]["address"][0]
+            return bytes(resp["value"]).decode(), my_ip
+
+    from ray_tpu.util import collective as col
+
+    members = [XlaMember.remote() for _ in range(2)]
+    col.create_collective_group(members, backend="tpu", group_name="ipcheck")
+    coord, node_ip = ray_tpu.get(members[0].coordinator_in_kv.remote("ipcheck"), timeout=120)
+    assert coord.split(":")[0] == node_ip

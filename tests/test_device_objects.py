@@ -1,0 +1,127 @@
+"""Device-array object plane (SURVEY §2.3 object-plane row; VERDICT r1 #3).
+
+ray.put/get of a jax.Array must preserve the type AND the sharding layout:
+put does one device->host DMA per unique shard, get reassembles with
+jax.make_array_from_single_device_arrays — never a host gather of the global
+array. The test process runs on the 8-virtual-device CPU mesh from conftest.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def _mesh(shape, names):
+    import jax
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices()[: int(np.prod(shape))]).reshape(shape)
+    return Mesh(devs, names)
+
+
+def test_sharded_array_roundtrip_preserves_sharding(ray_start_regular):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _mesh((4, 2), ("dp", "tp"))
+    x = jnp.arange(64.0, dtype=jnp.float32).reshape(8, 8)
+    sharding = NamedSharding(mesh, P("dp", "tp"))
+    x = jax.device_put(x, sharding)
+
+    out = ray_tpu.get(ray_tpu.put(x))
+    assert isinstance(out, jax.Array)
+    assert out.sharding == x.sharding
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+    # Same per-device placement, shard for shard.
+    got = {s.device.id: np.asarray(s.data) for s in out.addressable_shards}
+    for s in x.addressable_shards:
+        np.testing.assert_array_equal(got[s.device.id], np.asarray(s.data))
+
+
+def test_replicated_array_dedupes_shards(ray_start_regular):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu._private.serialization import serialize
+
+    mesh = _mesh((8,), ("dp",))
+    x = jax.device_put(jnp.ones((256, 256), jnp.float32), NamedSharding(mesh, P()))
+    ser = serialize(x)
+    # Fully replicated: ~1x the array, not 8x.
+    assert ser.total_size < 2 * x.nbytes
+    out = ray_tpu.get(ray_tpu.put(x))
+    assert isinstance(out, jax.Array)
+    assert out.sharding == x.sharding
+
+
+def test_single_device_array_keeps_type_and_device(ray_start_regular):
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[3]
+    x = jax.device_put(jnp.arange(16.0), dev)
+    out = ray_tpu.get(ray_tpu.put(x))
+    assert isinstance(out, jax.Array)
+    assert out.devices() == {dev}
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+
+def test_sharded_array_through_task(ray_start_regular):
+    """A worker process (same virtual topology) returns a sharded array; the
+    driver's get sees the same layout."""
+    import jax
+
+    @ray_tpu.remote
+    def make():
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        devs = np.array(jax.devices()[:4]).reshape(2, 2)
+        mesh = Mesh(devs, ("a", "b"))
+        return jax.device_put(
+            jnp.arange(16.0, dtype=jnp.float32).reshape(4, 4), NamedSharding(mesh, P("a", "b"))
+        )
+
+    out = ray_tpu.get(make.remote(), timeout=120)
+    assert isinstance(out, jax.Array)
+    assert set(out.sharding.mesh.axis_names) == {"a", "b"}
+    np.testing.assert_array_equal(np.asarray(out), np.arange(16.0).reshape(4, 4))
+
+
+def test_multihost_array_put_raises():
+    """A non-fully-addressable array can't ride the object store; the error
+    must say so (not a silent gather)."""
+
+    class _FakeShard:
+        pass
+
+    from ray_tpu._private import serialization
+
+    class _FakeArr:
+        is_fully_addressable = False
+        addressable_shards = [_FakeShard()]
+        sharding = object()
+
+    with pytest.raises(TypeError, match="multi-host"):
+        serialization._reduce_jax_array(_FakeArr())
+
+
+def test_pytree_of_sharded_arrays(ray_start_regular):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _mesh((8,), ("dp",))
+    tree = {
+        "w": jax.device_put(jnp.ones((16, 4)), NamedSharding(mesh, P("dp"))),
+        "b": jax.device_put(jnp.zeros((4,)), NamedSharding(mesh, P())),
+        "step": 7,
+    }
+    out = ray_tpu.get(ray_tpu.put(tree))
+    assert out["step"] == 7
+    assert isinstance(out["w"], jax.Array) and out["w"].sharding == tree["w"].sharding
+    assert isinstance(out["b"], jax.Array) and out["b"].sharding == tree["b"].sharding
