@@ -1,0 +1,185 @@
+"""Scheduling/object-plane envelope microbenchmark.
+
+Analog of `ray microbenchmark` (reference: python/ray/_private/ray_perf.py:93)
+plus envelope stresses from release/benchmarks (queued-task depth, actor
+count, object broadcast). Run per round; results land in MICROBENCH_r{N}.json
+so the envelope is tracked across rounds (VERDICT r1 #5).
+
+Usage: python microbench.py [--round N] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("RAY_TPU_JAX_CONFIG_PLATFORMS", "cpu")
+os.environ.setdefault("RAY_TPU_NUM_TPUS", "0")
+
+
+def timeit(fn, duration=2.0, multiplier=1, warmup=1):
+    for _ in range(warmup):
+        fn()
+    start = time.perf_counter()
+    count = 0
+    while time.perf_counter() - start < duration:
+        fn()
+        count += 1
+    dt = time.perf_counter() - start
+    return count * multiplier / dt
+
+
+def basic_suite(results, duration):
+    import numpy as np
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+
+    @ray_tpu.remote
+    def small():
+        return b"ok"
+
+    @ray_tpu.remote
+    class Actor:
+        def ping(self):
+            return b"ok"
+
+    a = Actor.remote()
+    ray_tpu.get(a.ping.remote())
+
+    results["task_sync_per_s"] = round(timeit(lambda: ray_tpu.get(small.remote()), duration), 1)
+    results["task_async100_per_s"] = round(
+        timeit(lambda: ray_tpu.get([small.remote() for _ in range(100)]), duration, 100), 1
+    )
+    results["actor_call_sync_per_s"] = round(timeit(lambda: ray_tpu.get(a.ping.remote()), duration), 1)
+    results["actor_call_async100_per_s"] = round(
+        timeit(lambda: ray_tpu.get([a.ping.remote() for _ in range(100)]), duration, 100), 1
+    )
+    arr = np.zeros(1024 * 1024, dtype=np.uint8)
+    results["put_1mib_per_s"] = round(timeit(lambda: ray_tpu.put(arr), duration), 1)
+    results["putget_1mib_per_s"] = round(
+        timeit(lambda: ray_tpu.get(ray_tpu.put(arr)), duration), 1
+    )
+    ray_tpu.shutdown()
+
+
+def queued_tasks_stress(results, n_tasks):
+    """Queue-depth envelope (reference table: 1M+ tasks queued on one node).
+    Submission throughput with the queue far beyond execution capacity, then
+    a liveness check that the node still schedules."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=1, object_store_memory=128 * 1024 * 1024)
+
+    @ray_tpu.remote
+    def noop():
+        return 1
+
+    t0 = time.perf_counter()
+    refs = [noop.remote() for _ in range(n_tasks)]
+    submit_s = time.perf_counter() - t0
+    results["queued_tasks"] = n_tasks
+    results["queued_submit_per_s"] = round(n_tasks / submit_s, 1)
+    # Liveness under a deep queue: the LAST submitted task still completes
+    # (FIFO drain would take ages; we get() one early ref instead).
+    t0 = time.perf_counter()
+    assert ray_tpu.get(refs[0], timeout=120) == 1
+    results["queued_first_result_s"] = round(time.perf_counter() - t0, 3)
+    ray_tpu.shutdown()
+
+
+def actor_swarm_stress(results, n_actors):
+    """Actor-count envelope, sized to this host (reference: 40k across a
+    2000-node cluster; one core here). Measures creation + fan-out ping."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=max(4, n_actors), object_store_memory=128 * 1024 * 1024)
+
+    @ray_tpu.remote(num_cpus=0.01)
+    class Swarm:
+        def ping(self):
+            return os.getpid()
+
+    t0 = time.perf_counter()
+    actors = [Swarm.remote() for _ in range(n_actors)]
+    pids = ray_tpu.get([a.ping.remote() for a in actors], timeout=1200)
+    create_s = time.perf_counter() - t0
+    results["actors_created"] = n_actors
+    results["actor_processes"] = len(set(pids))
+    results["actor_create_per_s"] = round(n_actors / create_s, 2)
+    t0 = time.perf_counter()
+    ray_tpu.get([a.ping.remote() for a in actors], timeout=600)
+    results["actor_fanout_ping_s"] = round(time.perf_counter() - t0, 3)
+    ray_tpu.shutdown()
+
+
+def broadcast_stress(results, mib, n_nodes):
+    """100 MiB broadcast across simulated nodes (reference envelope: 1 GiB to
+    50+ nodes; binomial-tree push plane)."""
+    import numpy as np
+
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.object_transfer import broadcast_object
+
+    cluster = Cluster()
+    try:
+        for _ in range(n_nodes):
+            cluster.add_node(num_cpus=1, object_store_memory=(mib + 32) * 1024 * 1024)
+        cluster.connect()
+        cluster.wait_for_nodes()
+        import ray_tpu
+
+        data = np.random.default_rng(0).integers(0, 255, mib * 1024 * 1024, dtype=np.uint8)
+        ref = ray_tpu.put(data)
+        t0 = time.perf_counter()
+        pushed = broadcast_object(ref, timeout=1200)
+        dt = time.perf_counter() - t0
+        results["broadcast_mib"] = mib
+        results["broadcast_nodes"] = n_nodes
+        results["broadcast_pushed"] = pushed
+        results["broadcast_s"] = round(dt, 3)
+        results["broadcast_aggregate_mib_per_s"] = round(mib * pushed / dt, 1)
+    finally:
+        cluster.shutdown()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--round", type=int, default=int(os.environ.get("GRAFT_ROUND", "2")))
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    duration = 1.0 if args.quick else 3.0
+    n_tasks = 10_000 if args.quick else 100_000
+    n_actors = 8 if args.quick else 64
+    mib = 16 if args.quick else 100
+    n_nodes = 4 if args.quick else 8
+
+    results: dict = {"host_cpus": os.cpu_count()}
+    for name, fn in [
+        ("basic", lambda: basic_suite(results, duration)),
+        ("queued", lambda: queued_tasks_stress(results, n_tasks)),
+        ("actors", lambda: actor_swarm_stress(results, n_actors)),
+        ("broadcast", lambda: broadcast_stress(results, mib, n_nodes)),
+    ]:
+        t0 = time.perf_counter()
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001
+            results[f"{name}_error"] = f"{type(e).__name__}: {e}"
+        results[f"{name}_wall_s"] = round(time.perf_counter() - t0, 1)
+
+    out = args.out or f"MICROBENCH_r{args.round}.json"
+    with open(out, "w") as f:
+        json.dump(results, f, indent=1)
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

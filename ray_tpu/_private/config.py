@@ -43,6 +43,12 @@ class Config:
     object_transfer_chunk_bytes: int = 4 * 1024 * 1024
     object_spill_dir: str = ""  # empty -> <session_dir>/spill
     object_spill_threshold: float = 0.8  # arena fullness ratio triggering spill
+    # push-side transfer (reference: push_manager.h in-flight caps,
+    # pull_manager.h admission control)
+    push_pipeline_depth: int = 4        # concurrent chunk RPCs per push
+    push_max_concurrent_per_dest: int = 2
+    push_max_inbound: int = 8           # receiver-side concurrent push sessions
+    push_admission_retries: int = 50    # sender retries while receiver is saturated
 
     # --- scheduling / raylet ---
     worker_lease_timeout_s: float = 30.0

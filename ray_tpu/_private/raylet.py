@@ -126,11 +126,20 @@ class Raylet:
 
         self.workers: dict[str, WorkerHandle] = {}
         self.task_queue: deque[TaskSpec] = deque()
+        # Tasks whose resources/pool/placement can't currently be satisfied
+        # park here instead of rotating through task_queue (reference keeps a
+        # separate infeasible queue too, cluster_task_manager.h). They are
+        # spliced back whenever capacity or the cluster view changes.
+        self._infeasible: deque[TaskSpec] = deque()
         self._last_progress = time.monotonic()
         self.cluster_view: dict = {}
         self._synced_peers: set[str] = set()
         self._pulls_inflight: dict[str, asyncio.Future] = {}
         self._peer_clients: dict[str, RpcClient] = {}
+        self._inbound_pushes: dict[str, int] = {}  # object_id -> arena offset
+        from ray_tpu._private.push_manager import PushManager
+
+        self.push_manager = PushManager(self)
 
         self.server = RpcServer(f"raylet-{self.node_id[:8]}")
         self.server.register_all(self)
@@ -218,6 +227,7 @@ class Raylet:
                         self._sched.node_remove(nid)
                 self._synced_peers = set(self.cluster_view)
                 self._tracing_enabled = bool(resp.get("tracing"))
+                self._requeue_infeasible()  # cluster view refreshed
                 await self._retry_pg_tasks()
                 if self.task_queue:
                     await self._dispatch()  # periodic re-check (anti-starvation)
@@ -226,12 +236,23 @@ class Raylet:
             await asyncio.sleep(self.cfg.heartbeat_interval_s)
 
     def _pending_load(self) -> list:
-        """Aggregate queued task resource shapes for the autoscaler."""
+        """Aggregate queued task resource shapes for the autoscaler. Parked
+        infeasible tasks are the demand that matters most (they're what new
+        nodes would satisfy). The scan is EXACT — a head-only sample would
+        hide resource shapes concentrated in the queue tail and starve them
+        of autoscaling — but cached: at most one full walk per 5s, and only
+        when the depth changed."""
+        cached = getattr(self, "_load_cache", None)
+        now = time.monotonic()
+        if cached is not None and now - cached[0] < 5.0:
+            return cached[1]
         shapes: dict[tuple, int] = {}
-        for spec in self.task_queue:
+        for spec in list(self._infeasible) + list(self.task_queue):
             key = tuple(sorted(spec.resources.items()))
             shapes[key] = shapes.get(key, 0) + 1
-        return [{"resources": dict(k), "count": c} for k, c in shapes.items()]
+        load = [{"resources": dict(k), "count": c} for k, c in shapes.items()]
+        self._load_cache = (now, load)
+        return load
 
     async def _retry_pg_tasks(self):
         """Re-route queued tasks that cannot run on this node: PG tasks whose
@@ -340,6 +361,120 @@ class Raylet:
         finally:
             self.store.release(object_id)
 
+    # ---- push-side transfer (reference: push_manager.h:29 sender pacing,
+    # pull_manager.h:52 admission control) ----
+
+    async def rpc_push_begin(self, req):
+        """Receiver-side admission: open a push session or refuse (saturated /
+        already present / no arena space). The pusher backs off and retries."""
+        from ray_tpu.exceptions import ObjectStoreFullError
+
+        object_id, size = req["object_id"], req["size"]
+        entry = self.store.objects.get(object_id)
+        if entry is not None:
+            if entry.sealed:
+                return {"accepted": False, "already": True}
+            # Unsealed: an in-flight pull or rival push is filling it. NOT
+            # "already" — the sender must not report success (a broadcast
+            # relay would then wedge on the unsealed object); it retries
+            # until the entry seals or vanishes.
+            return {"accepted": False, "retry_after": 0.1}
+        if object_id in self._inbound_pushes:
+            return {"accepted": False, "retry_after": 0.1}
+        if len(self._inbound_pushes) >= self.cfg.push_max_inbound:
+            return {"accepted": False, "retry_after": 0.2}
+        try:
+            offset = await self.store.create(object_id, size)
+        except ObjectStoreFullError:
+            # No arena space even after evict/spill: back-pressure the
+            # sender instead of failing its push outright.
+            return {"accepted": False, "retry_after": 1.0}
+        if offset is None:
+            # A rival creator won during create's await: sealed -> done;
+            # unsealed -> let the rival finish, sender retries.
+            if self.store.contains(object_id):
+                return {"accepted": False, "already": True}
+            return {"accepted": False, "retry_after": 0.2}
+        self._inbound_pushes[object_id] = {
+            "offset": offset, "size": size, "ts": time.monotonic()
+        }
+        return {"accepted": True}
+
+    async def rpc_push_chunk(self, req):
+        sess = self._inbound_pushes.get(req["object_id"])
+        if sess is None:
+            return {"ok": False}
+        start, data = req["start"], req["data"]
+        if start < 0 or start + len(data) > sess["size"]:
+            # Out-of-range write would corrupt the neighboring arena object.
+            return {"ok": False, "error": "chunk out of range"}
+        self.arena.write(sess["offset"] + start, data)
+        sess["ts"] = time.monotonic()
+        return {"ok": True}
+
+    async def rpc_push_commit(self, req):
+        object_id = req["object_id"]
+        if self._inbound_pushes.pop(object_id, None) is None:
+            # Session lost (abort raced the commit); present iff sealed earlier.
+            return {"ok": self.store.contains(object_id)}
+        self.store.seal(object_id)
+        await self.gcs.acall(
+            "add_object_location", {"object_id": object_id, "node_id": self.node_id}
+        )
+        return {"ok": True}
+
+    async def rpc_push_abort(self, req):
+        if self._inbound_pushes.pop(req["object_id"], None) is not None:
+            self.store.abort(req["object_id"])
+        return {"ok": True}
+
+    def _reap_stale_push_sessions(self):
+        """A sender that died between push_begin and commit/abort must not
+        leak its admission slot + unsealed arena allocation forever (8 leaks
+        would wedge the node's whole inbound push plane)."""
+        now = time.monotonic()
+        for oid, sess in list(self._inbound_pushes.items()):
+            if now - sess["ts"] > 60.0:
+                self._inbound_pushes.pop(oid, None)
+                self.store.abort(oid)
+                logger.warning("reaped stale inbound push session for %s", oid[:8])
+
+    async def rpc_broadcast_object(self, req):
+        """Fan an object out to `targets` over a binomial tree: this node
+        pushes to O(log N) children, each child relays to its subtree. The
+        1-GiB-to-50-nodes envelope (BASELINE.md) needs this — a flat push
+        loop would serialize on the root's NIC."""
+        object_id = req["object_id"]
+        targets = list(req.get("targets", []))
+        if not self.store.contains(object_id):
+            # contains() is sealed-only on purpose: an unsealed entry (a
+            # rival inbound session that may yet be aborted) must not make
+            # us skip the pull and then block forever in push's store.get.
+            await self._pull_object(object_id, timeout=req.get("timeout", 300.0))
+
+        async def relay(child, subtree):
+            ok = await self.push_manager.push(object_id, child["node_id"], child["address"])
+            if not ok:
+                raise RuntimeError(f"push to {child['node_id'][:8]} failed")
+            if subtree:
+                resp = await self._peer(child["node_id"], child["address"]).acall(
+                    "broadcast_object",
+                    {"object_id": object_id, "targets": subtree},
+                    timeout=req.get("timeout", 300.0),
+                )
+                if not resp.get("ok"):
+                    raise RuntimeError(f"relay via {child['node_id'][:8]}: {resp.get('failed')}")
+
+        tasks = []
+        rest = targets
+        while rest:
+            child, rest = rest[0], rest[1:]
+            subtree, rest = rest[: len(rest) // 2], rest[len(rest) // 2 :]
+            tasks.append(relay(child, subtree))
+        results = await asyncio.gather(*tasks, return_exceptions=True)
+        failed = [str(r) for r in results if isinstance(r, Exception)]
+        return {"ok": not failed, "failed": failed}
+
     async def _pull_object(self, object_id: str, timeout: float | None):
         fut = self._pulls_inflight.get(object_id)
         if fut is not None:
@@ -351,8 +486,10 @@ class Raylet:
             deadline = time.monotonic() + (timeout if timeout is not None else 3600.0)
             poll = 0.02
             while time.monotonic() < deadline:
-                if object_id in self.store.objects:
-                    # A local task produced it while we were looking remotely.
+                if self.store.contains(object_id):
+                    # A local task (or inbound push) produced AND SEALED it
+                    # while we were looking remotely; an unsealed rival
+                    # session doesn't count — it may still be aborted.
                     fut.set_result(True)
                     return
                 resp = await self.gcs.acall("get_object_locations", {"object_id": object_id})
@@ -370,6 +507,11 @@ class Raylet:
                         continue
                     size = info["size"]
                     offset = await self.store.create(object_id, size)
+                    if offset is None:
+                        # Rival creator appeared during create: loop back and
+                        # wait for it to seal (or vanish).
+                        await asyncio.sleep(0.05)
+                        continue
                     pos = 0
                     while pos < size:
                         chunk = await peer.acall(
@@ -437,6 +579,8 @@ class Raylet:
             return {"ok": False}
         self.bundles[key] = dict(res)
         self._sched.pool_upsert(self._bundle_pool_key(*key), res)
+        self._requeue_infeasible()  # tasks waiting on this bundle's pool
+        await self._dispatch()
         return {"ok": True}
 
     async def rpc_return_bundle(self, req):
@@ -459,7 +603,26 @@ class Raylet:
         await self._queue_and_schedule(spec)
         return {"ok": True}
 
-    async def _queue_and_schedule(self, spec: TaskSpec):
+    async def rpc_submit_tasks(self, req):
+        """Batched submission: one RPC for a burst of specs (client-side
+        coalescing in core_worker._flush_submits). Dispatch runs ONCE for
+        the whole batch, and the loop yields periodically so a deep burst
+        can't starve heartbeats. Failures are PER SPEC — earlier specs are
+        already queued and will run, so failing the whole batch client-side
+        would report errors for tasks that execute anyway."""
+        failed = []
+        for i, wire in enumerate(req["specs"]):
+            try:
+                spec = TaskSpec.from_wire(wire)
+                await self._queue_and_schedule(spec, dispatch=False)
+            except Exception as e:  # noqa: BLE001
+                failed.append({"task_id": wire.get("task_id"), "error": repr(e)})
+            if i % 200 == 199:
+                await asyncio.sleep(0)
+        await self._dispatch()
+        return {"ok": True, "failed": failed}
+
+    async def _queue_and_schedule(self, spec: TaskSpec, dispatch: bool = True):
         if spec.placement_group_id and not self._has_pool(spec):
             # Bundle lives elsewhere: ask GCS for its node and forward there.
             resp = await self.gcs.acall(
@@ -478,7 +641,8 @@ class Raylet:
                         return
             # Bundle not placed yet: queue; dispatch retries as views update.
             self.task_queue.append(spec)
-            await self._dispatch()
+            if dispatch:
+                await self._dispatch()
             return
         target = self._pick_node(spec)
         if target is not None and target != self.node_id:
@@ -491,7 +655,8 @@ class Raylet:
                 except Exception:
                     pass
         self.task_queue.append(spec)
-        await self._dispatch()
+        if dispatch:
+            await self._dispatch()
 
     def _has_pool(self, spec: TaskSpec) -> bool:
         """Does the pool this task draws from exist locally?"""
@@ -526,6 +691,13 @@ class Raylet:
             )
         return self._sched.try_acquire(self.node_id, spec.resources)
 
+    def _requeue_infeasible(self):
+        """Move parked tasks back into the dispatch queue (capacity or the
+        cluster view changed, so their fit must be re-evaluated)."""
+        if self._infeasible:
+            self.task_queue.extend(self._infeasible)
+            self._infeasible.clear()
+
     def _release_for(self, spec: TaskSpec):
         if spec.placement_group_id:
             key = self._bundle_pool_key(
@@ -535,6 +707,7 @@ class Raylet:
                 self._sched.pool_release(key, spec.resources)
         else:
             self._sched.release(self.node_id, spec.resources)
+        self._requeue_infeasible()
 
     def _pick_node(self, spec: TaskSpec) -> str | None:
         """Cluster-level placement: hybrid pack-then-spread policy
@@ -571,19 +744,29 @@ class Raylet:
         }
 
     async def _dispatch(self):
-        """Local dispatch loop (reference: local_task_manager.cc:101)."""
+        """Local dispatch loop (reference: local_task_manager.cc:101).
+
+        The inner scan is CAPPED per call: with a deep backlog (the
+        100k+-queued-tasks envelope) an uncapped pass would walk the whole
+        deque on every submission — O(n) per submit, O(n^2) for a burst —
+        starving the event loop until the GCS health checker declares the
+        node dead. Tasks that can't run yet move to self._infeasible (not
+        back into the scan window), so repeated capped calls make monotonic
+        progress through the queue; _requeue_infeasible() splices them back
+        when capacity or the cluster view changes.
+        """
         made_progress = True
         while made_progress and self.task_queue:
             made_progress = False
-            for _ in range(len(self.task_queue)):
+            for _ in range(min(len(self.task_queue), 128)):
                 spec = self.task_queue.popleft()
                 if self._must_reroute(spec):
                     # Wrong node for this task; the heartbeat loop re-routes it
                     # once the cluster view / PG placement catches up.
-                    self.task_queue.append(spec)
+                    self._infeasible.append(spec)
                     continue
                 if not self._has_pool(spec) or not self._fits_now(spec):
-                    self.task_queue.append(spec)
+                    self._infeasible.append(spec)
                     continue
                 spec_env_hash = _runtime_env_hash(spec.runtime_env)
                 worker = self._pop_idle_worker(spec_env_hash)
@@ -637,9 +820,14 @@ class Raylet:
                         # after 2s without dispatch progress, oversubscribe.
                         deficit = 1
                     # Start workers dedicated to the runtime envs of the
-                    # tasks actually waiting (head of queue first).
+                    # tasks actually waiting (head of queue first). Only the
+                    # first `deficit` entries are needed — materializing the
+                    # whole queue here cost O(n) per submission at depth.
+                    import itertools
+
                     pending_envs = [spec.runtime_env] + [
-                        s.runtime_env for s in list(self.task_queue)
+                        s.runtime_env
+                        for s in itertools.islice(self.task_queue, max(deficit, 0))
                     ]
                     for i in range(max(deficit, 0)):
                         self._start_worker(
@@ -688,6 +876,11 @@ class Raylet:
     def _start_worker(self, runtime_env: dict | None = None):
         worker_id = WorkerID.from_random().hex()
         env = os.environ.copy()
+        if not self.resources_total.get("TPU"):
+            # On a TPU host a sitecustomize hook dials the TPU plugin during
+            # interpreter start (~2s); workers on CPU-only nodes never touch
+            # the chip, so skip it — worker spawn drops ~10x.
+            env.pop("PALLAS_AXON_POOL_IPS", None)
         if runtime_env:
             env["RAY_TPU_RUNTIME_ENV"] = json.dumps(runtime_env)
         if self._tracing_enabled:
@@ -763,6 +956,7 @@ class Raylet:
         """Monitor worker processes; report deaths (reference: worker failure path)."""
         while True:
             await asyncio.sleep(0.2)
+            self._reap_stale_push_sessions()
             for worker in list(self.workers.values()):
                 if worker.state == "dead":
                     continue
@@ -846,7 +1040,7 @@ class Raylet:
             "resources_total": self.resources_total,
             "resources_available": self.resources_available,
             "num_workers": self._num_live_workers(),
-            "queued_tasks": len(self.task_queue),
+            "queued_tasks": len(self.task_queue) + len(self._infeasible),
             "store": {**self.store.usage(), "objects": self.store.objects_info()},
             "workers": {
                 wid: {"state": w.state, "pid": w.pid, "actor_id": w.actor_id}

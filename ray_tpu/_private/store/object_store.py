@@ -91,6 +91,13 @@ class StoreCore:
         offset = self.arena.alloc(size)
         if offset is None:
             await self._make_space(size)
+            # A concurrent creator (pull racing push is routine) may have
+            # inserted the entry during the await — clobbering it would leak
+            # its arena block and let OUR empty allocation be sealed by THEIR
+            # writer. Defer to the winner: None tells the caller to re-check
+            # (sealed -> use it; unsealed -> someone else is filling it).
+            if object_id in self.objects:
+                return None
             offset = self.arena.alloc(size)
             if offset is None:
                 from ray_tpu.exceptions import ObjectStoreFullError
@@ -117,6 +124,10 @@ class StoreCore:
         entry = self.objects.pop(object_id, None)
         if entry is not None:
             self._index_remove_then_free(object_id, entry.offset)
+            # Wake any get() blocked on the seal; they re-check the table and
+            # fail fast instead of waiting out their (possibly infinite)
+            # timeout on an entry that will never seal.
+            entry.sealed_event.set()
 
     # ---- access ----
 
@@ -131,6 +142,9 @@ class StoreCore:
             raise KeyError(object_id)
         if not entry.sealed:
             await asyncio.wait_for(entry.sealed_event.wait(), timeout)
+            if self.objects.get(object_id) is not entry or not entry.sealed:
+                # Aborted while we waited (failed push/pull session).
+                raise KeyError(object_id)
         if entry.offset is None:
             await self._restore(entry)
         entry.ref_count += 1

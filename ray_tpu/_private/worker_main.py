@@ -201,12 +201,17 @@ def main():
 
     # Test runs pin jax to CPU: a sitecustomize may force jax_platforms to a
     # TPU plugin via jax.config.update, which only another config.update can
-    # override (see tests/conftest.py).
+    # override (see tests/conftest.py). If no sitecustomize imported jax into
+    # this process, the env var governs the (lazy) first import instead —
+    # eagerly importing jax here cost ~2s on EVERY worker spawn, dominating
+    # the actor-creation envelope.
     forced = os.environ.get("RAY_TPU_JAX_CONFIG_PLATFORMS")
     if forced:
-        import jax
+        os.environ["JAX_PLATFORMS"] = forced
+        if "jax" in sys.modules:
+            import jax
 
-        jax.config.update("jax_platforms", forced)
+            jax.config.update("jax_platforms", forced)
 
     from ray_tpu._private import worker_context
     from ray_tpu._private.core_worker import WORKER, CoreWorker

@@ -98,7 +98,12 @@ class ActorPoolStrategy:
 
 
 class ExecutionContext:
-    def __init__(self, max_tasks_in_flight: Optional[int] = None, preserve_order: bool = True):
+    def __init__(
+        self,
+        max_tasks_in_flight: Optional[int] = None,
+        preserve_order: bool = True,
+        per_op_budget_blocks: Optional[int] = None,
+    ):
         if max_tasks_in_flight is None:
             try:
                 max_tasks_in_flight = max(2, int(ray_tpu.cluster_resources().get("CPU", 4)))
@@ -106,6 +111,14 @@ class ExecutionContext:
                 max_tasks_in_flight = 4
         self.max_tasks_in_flight = max_tasks_in_flight
         self.preserve_order = preserve_order
+        # Per-op output budget (reference: streaming_executor_state.py
+        # under_output_budget / select_operator_to_run): an op may not run
+        # further ahead than this many unconsumed downstream blocks, so a
+        # fast upstream can't materialize the whole dataset in the object
+        # store while a slow downstream lags.
+        self.per_op_budget_blocks = per_op_budget_blocks or 2 * max_tasks_in_flight
+        # Observability for tests/stats: high-water marks per run.
+        self.stats = {"max_inter_op_queued": 0, "max_inflight": 0}
 
 
 class _PhysicalMapOp:
@@ -136,7 +149,7 @@ class _PhysicalMapOp:
             return max(0, 2 * len(self._pool) - len(self.in_flight))
         return max(0, self.ctx.max_tasks_in_flight - len(self.in_flight))
 
-    def dispatch(self):
+    def dispatch(self, limit: Optional[int] = None):
         if self._pool and self.input:
             # Autoscale the pool toward max_size while a backlog exists
             # (reference: ActorPoolMapOperator's autoscaling actor pool).
@@ -145,7 +158,8 @@ class _PhysicalMapOp:
             grow = min(backlog, strat.max_size - len(self._pool))
             for _ in range(grow):
                 self._pool.append(self._actor_cls.remote(self.logical.fn_constructor))
-        while self.input and self.capacity > 0:
+        n = 0
+        while self.input and self.capacity > 0 and (limit is None or n < limit):
             index, (block_ref, _meta) = self.input.popleft()
             if self._pool:
                 actor = self._pool[self._pool_idx % len(self._pool)]
@@ -160,6 +174,7 @@ class _PhysicalMapOp:
                     .remote(self.logical.block_fn, block_ref)
                 )
             self.in_flight[refs[1]] = (index, refs)
+            n += 1
 
     def complete(self, watch_ref):
         index, refs = self.in_flight.pop(watch_ref)
@@ -184,14 +199,16 @@ class _PhysicalReadOp:
     def capacity(self) -> int:
         return max(0, self.ctx.max_tasks_in_flight - len(self.in_flight))
 
-    def dispatch(self):
-        while self.input and self.capacity > 0:
+    def dispatch(self, limit: Optional[int] = None):
+        n = 0
+        while self.input and self.capacity > 0 and (limit is None or n < limit):
             index, read_task = self.input.popleft()
             refs = (
                 ray_tpu.remote(num_returns=2, **dict(self.logical.ray_remote_args))(_run_read_task)
                 .remote(read_task)
             )
             self.in_flight[refs[1]] = (index, refs)
+            n += 1
 
     def complete(self, watch_ref):
         index, refs = self.in_flight.pop(watch_ref)
@@ -249,28 +266,54 @@ def execute_streaming(plan, ctx: Optional[ExecutionContext] = None) -> Iterator[
 
 
 def _pump(seed_bundles, ops, ctx) -> Iterator[tuple]:
-    """Core scheduling loop over a chain of streaming ops: dispatch every op
-    with queued input and spare capacity, wait for any completion, forward
-    in-order outputs downstream, and yield the final op's outputs in order."""
+    """Core scheduling loop over a chain of streaming ops (reference:
+    streaming_executor_state.py:363 select_operator_to_run).
+
+    Backpressure: forwarding into a downstream op's input queue and each
+    op's dispatch are both gated on ctx.per_op_budget_blocks of unconsumed
+    downstream work, and dispatch allowances are granted downstream-first —
+    so a fast producer ahead of a slow consumer parks at the budget instead
+    of materializing every intermediate block in the object store at once.
+    """
     if ops and isinstance(ops[0], _PhysicalMapOp):
         for idx, b in enumerate(seed_bundles):
             ops[0].input.append((idx, b))
         ops[0].upstream_done = True
     next_fwd = [0] * len(ops)  # next output index each op hands downstream
     final = ops[-1]
+    budget = max(2, ctx.per_op_budget_blocks)
 
     def forward():
         for k, op in enumerate(ops[:-1]):
-            while next_fwd[k] in op.output:
-                ops[k + 1].input.append((next_fwd[k], op.output.pop(next_fwd[k])))
+            nxt = ops[k + 1]
+            while next_fwd[k] in op.output and len(nxt.input) < budget:
+                nxt.input.append((next_fwd[k], op.output.pop(next_fwd[k])))
                 next_fwd[k] += 1
-            if op.done:
-                ops[k + 1].upstream_done = True
+            if op.done and not op.output:
+                nxt.upstream_done = True
+            ctx.stats["max_inter_op_queued"] = max(
+                ctx.stats["max_inter_op_queued"], len(nxt.input)
+            )
+
+    def select_and_dispatch():
+        # Downstream ops first: draining them frees budget for upstream.
+        for k in range(len(ops) - 1, -1, -1):
+            op = ops[k]
+            # Unconsumed work this op is responsible for: its buffered
+            # outputs, its in-flight tasks, and what it already handed the
+            # next op but that op hasn't consumed. For the final op the
+            # buffered output IS op.output — counting it again would halve
+            # its effective budget.
+            downstream_q = len(ops[k + 1].input) if k + 1 < len(ops) else 0
+            pressure = len(op.output) + len(op.in_flight) + downstream_q
+            allowance = budget - pressure
+            if allowance > 0:
+                op.dispatch(limit=allowance)
+            ctx.stats["max_inflight"] = max(ctx.stats["max_inflight"], len(op.in_flight))
 
     while True:
         forward()
-        for op in ops:
-            op.dispatch()
+        select_and_dispatch()
         while next_fwd[-1] in final.output:
             yield final.output.pop(next_fwd[-1])
             next_fwd[-1] += 1

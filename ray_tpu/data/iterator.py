@@ -96,7 +96,13 @@ def iter_batches_from_refs(
 
 class _ShardState:
     """Shared execution state behind streaming_split: one executor run,
-    bundles dealt round-robin to n consumers (reference: OutputSplitter)."""
+    bundles dealt to n consumers (reference: OutputSplitter).
+
+    equal=True matters for SPMD gangs: if one rank sees more rows than
+    another, a pjit training gang deadlocks at the shorter rank's epoch end.
+    Bundles are dealt to the least-loaded shard (imbalance bounded by one
+    block) and, at exhaustion, still-queued surplus is trimmed to the
+    minimum assigned row count via remote slice tasks."""
 
     def __init__(self, dataset, n: int, equal: bool):
         self._dataset = dataset
@@ -104,9 +110,71 @@ class _ShardState:
         self._equal = equal
         self._lock = threading.Lock()
         self._queues = [collections.deque() for _ in range(n)]
+        self._assigned_rows = [0] * n
         self._source: Optional[Iterator] = None
         self._exhausted = False
         self._next_shard = 0
+        self._trimmed = False
+        self._trim_event = threading.Event()
+
+    def _deal_one(self) -> bool:
+        """Pull one bundle from the source and assign it. Lock held."""
+        if self._source is None:
+            self._source = self._dataset.iter_internal_refs()
+        try:
+            bundle = next(self._source)
+        except StopIteration:
+            self._exhausted = True
+            return False
+        if self._equal:
+            target = min(range(self._n), key=lambda i: self._assigned_rows[i])
+        else:
+            target = self._next_shard
+            self._next_shard = (self._next_shard + 1) % self._n
+        self._queues[target].append(bundle)
+        self._assigned_rows[target] += bundle[1].num_rows
+        return True
+
+    def _trim_to_equal(self):
+        """Equalize assigned rows across shards at exhaustion. The remote
+        slice round-trips run with the lock RELEASED (the plan — which
+        bundles to drop/slice — is made and applied under the lock; only
+        the slicing itself happens outside), so sibling consumers aren't
+        stalled behind object-store calls."""
+        from ray_tpu.data._internal.executor import _slice_block_task
+
+        slice_jobs = []  # (shard, ref, keep)
+        with self._lock:
+            if self._trimmed:
+                return
+            self._trimmed = True
+            floor = min(self._assigned_rows)
+            for i in range(self._n):
+                excess = self._assigned_rows[i] - floor
+                while excess > 0 and self._queues[i]:
+                    ref, meta = self._queues[i].pop()
+                    if meta.num_rows <= excess:
+                        excess -= meta.num_rows
+                        self._assigned_rows[i] -= meta.num_rows
+                        continue
+                    slice_jobs.append((i, ref, meta.num_rows - excess))
+                    self._assigned_rows[i] -= excess
+                    excess = 0
+                # Rows a shard already consumed beyond the floor can't be
+                # clawed back; least-loaded dealing bounds that to < one
+                # block when consumers pull concurrently.
+        if not slice_jobs:
+            self._trim_event.set()
+            return
+        pairs = [
+            (i, ray_tpu.remote(num_returns=2)(_slice_block_task).remote(ref, 0, keep))
+            for i, ref, keep in slice_jobs
+        ]
+        resolved = [(i, refs[0], ray_tpu.get(refs[1])) for i, refs in pairs]
+        with self._lock:
+            for i, ref, meta in resolved:
+                self._queues[i].append((ref, meta))
+        self._trim_event.set()
 
     def next_bundle(self, shard: int):
         while True:
@@ -114,16 +182,20 @@ class _ShardState:
                 if self._queues[shard]:
                     return self._queues[shard].popleft()
                 if self._exhausted:
-                    return None
-                if self._source is None:
-                    self._source = self._dataset.iter_internal_refs()
-                try:
-                    bundle = next(self._source)
-                except StopIteration:
-                    self._exhausted = True
-                    return None
-                self._queues[self._next_shard].append(bundle)
-                self._next_shard = (self._next_shard + 1) % self._n
+                    if not self._equal or self._trim_event.is_set():
+                        if self._queues[shard]:
+                            continue
+                        return None
+                    need_trim = not self._trimmed
+                else:
+                    self._deal_one()
+                    continue
+            # Exhausted, equal-split: first consumer here runs the trim
+            # (outside the lock); the rest wait for it to finish.
+            if need_trim:
+                self._trim_to_equal()
+            else:
+                self._trim_event.wait(timeout=300)
 
 
 class DataIterator:
@@ -161,15 +233,28 @@ class DataIterator:
             yield from BlockAccessor.for_block(ray_tpu.get(ref)).iter_rows()
 
     def iter_jax_batches(self, *, batch_size: int = 256, drop_last: bool = True, sharding=None, dtypes: Optional[dict] = None, **kwargs):
+        """Device-fed batches with one batch of transfer lookahead: batch
+        i+1's host->device DMA is issued (async under jit workloads) while
+        the consumer computes on batch i (reference feeds accelerators via
+        the prefetching block batcher; lookahead is the TPU-idiomatic part)."""
         import jax
 
-        for batch in self.iter_batches(batch_size=batch_size, drop_last=drop_last, **kwargs):
+        def to_device(batch):
             out = {}
             for k, v in batch.items():
                 if dtypes and k in dtypes:
                     v = v.astype(dtypes[k])
                 out[k] = jax.device_put(v, sharding) if sharding is not None else jax.device_put(v)
-            yield out
+            return out
+
+        prev = None
+        for batch in self.iter_batches(batch_size=batch_size, drop_last=drop_last, **kwargs):
+            cur = to_device(batch)
+            if prev is not None:
+                yield prev
+            prev = cur
+        if prev is not None:
+            yield prev
 
     def iter_torch_batches(self, *, batch_size: int = 256, device=None, **kwargs):
         import torch

@@ -246,3 +246,48 @@ def test_unique_and_stats(ray_cluster):
     ds = rd.from_items([{"x": i % 5} for i in range(25)])
     assert ds.unique("x") == [0, 1, 2, 3, 4]
     assert "blocks" in ds.stats()
+
+
+def test_streaming_backpressure_bounded(ray_cluster):
+    """Budget gating (reference: streaming_executor_state select_operator_to_run
+    + under_output_budget): with 10x more blocks than max_tasks_in_flight, no
+    op runs further ahead than the per-op block budget — a fast read can't
+    materialize the whole dataset while the map stage lags."""
+    from ray_tpu.data._internal.executor import ExecutionContext, execute_streaming
+    import ray_tpu.data as rdata
+
+    ds = rdata.range(400, parallelism=40).map_batches(lambda b: b)
+    ctx = ExecutionContext(max_tasks_in_flight=2)
+    out = list(execute_streaming(ds._plan, ctx))
+    assert sum(m.num_rows for _, m in out) == 400
+    budget = ctx.per_op_budget_blocks
+    assert ctx.stats["max_inter_op_queued"] <= budget, ctx.stats
+    assert ctx.stats["max_inflight"] <= budget, ctx.stats
+
+
+def test_shuffle_blocks_stay_off_driver(ray_cluster):
+    """random_shuffle moves blocks peer-to-peer via refs; the driver sees
+    only metadata. Guard: the result bundles are refs, and the total rows
+    survive the shuffle."""
+    import ray_tpu.data as rdata
+    from ray_tpu.object_ref import ObjectRef
+
+    ds = rdata.range(1000, parallelism=8).random_shuffle(seed=7)
+    bundles = ds._execute()
+    assert all(isinstance(ref, ObjectRef) for ref, _ in bundles)
+    assert sum(m.num_rows for _, m in bundles) == 1000
+    vals = sorted(r["id"] for r in ds.take_all())
+    assert vals == list(range(1000))
+
+
+def test_streaming_split_equal_rows(ray_cluster):
+    """streaming_split(equal=True): every shard sees the same number of rows
+    even with ragged blocks (SPMD gang safety — reference: OutputSplitter
+    with equal=True)."""
+    ds = rd.from_items([{"x": i} for i in range(103)])  # ragged vs 4 shards
+    shards = ds.streaming_split(4, equal=True)
+    counts = []
+    for it in shards:
+        counts.append(sum(len(b["x"]) for b in it.iter_batches(batch_size=10)))
+    assert len(set(counts)) == 1, counts
+    assert counts[0] >= 20

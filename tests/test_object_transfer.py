@@ -1,0 +1,87 @@
+"""Push-side object transfer + broadcast (reference: push_manager.h:29,
+pull_manager.h:52; VERDICT r1 #4)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util.object_transfer import broadcast_object
+
+
+def _locations(oid_hex):
+    from ray_tpu._private import worker_context
+
+    cw = worker_context.get_core_worker()
+    locs = cw.gcs.call("get_object_locations", {"object_id": oid_hex})["locations"]
+    return {loc["node_id"] for loc in locs}
+
+
+def test_broadcast_reaches_all_nodes(ray_start_cluster):
+    cluster = ray_start_cluster
+    for _ in range(4):
+        cluster.add_node(num_cpus=1, object_store_memory=64 * 1024 * 1024)
+    cluster.connect()
+    cluster.wait_for_nodes()
+
+    data = np.arange(8 * 1024 * 1024, dtype=np.uint8)  # 8 MiB -> multiple chunks
+    ref = ray_tpu.put(data)
+    n = broadcast_object(ref)
+    assert n == 3  # pushed to every node except the one already holding it
+    assert len(_locations(ref.hex())) == 4
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(np.asarray(out), data)
+
+
+def test_broadcast_subset_and_idempotent(ray_start_cluster):
+    cluster = ray_start_cluster
+    nodes = [cluster.add_node(num_cpus=1, object_store_memory=64 * 1024 * 1024) for _ in range(3)]
+    cluster.connect()
+    cluster.wait_for_nodes()
+
+    ref = ray_tpu.put(np.ones(512 * 1024, dtype=np.float32))
+    have = _locations(ref.hex())
+    target = next(n.node_id for n in nodes if n.node_id not in have)
+    assert broadcast_object(ref, node_ids=[target]) == 1
+    assert target in _locations(ref.hex())
+    # Re-broadcast: target already holds it, nothing pushed.
+    assert broadcast_object(ref, node_ids=[target]) == 0
+
+
+def test_broadcast_small_object_raises(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1)
+    cluster.connect()
+    cluster.wait_for_nodes()
+
+    ref = ray_tpu.put(42)  # in-process store, no plasma copy
+    with pytest.raises(ValueError, match="plasma"):
+        broadcast_object(ref)
+
+
+def test_concurrent_broadcasts(ray_start_cluster):
+    cluster = ray_start_cluster
+    for _ in range(3):
+        cluster.add_node(num_cpus=1, object_store_memory=64 * 1024 * 1024)
+    cluster.connect()
+    cluster.wait_for_nodes()
+
+    refs = [ray_tpu.put(np.full(256 * 1024, i, dtype=np.int32)) for i in range(4)]
+    import threading
+
+    errs = []
+
+    def bc(r):
+        try:
+            broadcast_object(r)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=bc, args=(r,)) for r in refs]
+    [t.start() for t in ts]
+    [t.join(timeout=300) for t in ts]
+    assert not errs
+    for i, r in enumerate(refs):
+        assert len(_locations(r.hex())) == 3
+        np.testing.assert_array_equal(
+            np.asarray(ray_tpu.get(r)), np.full(256 * 1024, i, dtype=np.int32)
+        )

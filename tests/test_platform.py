@@ -51,7 +51,14 @@ def test_dashboard_state_endpoints(dashboard):
     assert len([n for n in status["nodes"] if n["state"] == "ALIVE"]) == 1
     nodes = _get(dashboard, "/api/v0/nodes")["result"]
     assert len(nodes) == 1
-    tasks = _get(dashboard, "/api/v0/tasks")["result"]
+    # Task events flush to the GCS asynchronously (task_event_buffer
+    # analog); poll briefly instead of racing the flush interval.
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        tasks = _get(dashboard, "/api/v0/tasks")["result"]
+        if any(t["name"] == "f" for t in tasks):
+            break
+        time.sleep(0.25)
     assert any(t["name"] == "f" for t in tasks)
 
 
