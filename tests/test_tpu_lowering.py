@@ -41,13 +41,14 @@ print("LOWERED_OK")
 """
 
 
-def test_flash_attention_lowers_on_tpu():
+def _run_tpu_probe(probe_src: str):
+    """Run a probe in a subprocess with the suite's CPU pins stripped;
+    returns the CompletedProcess, or None if no TPU backend came up."""
     env = dict(os.environ)
-    # Strip the suite's CPU pins so the subprocess sees the real backend.
     for k in ("JAX_PLATFORMS", "RAY_TPU_JAX_CONFIG_PLATFORMS", "RAY_TPU_NUM_TPUS", "XLA_FLAGS"):
         env.pop(k, None)
     proc = subprocess.run(
-        [sys.executable, "-c", _PROBE],
+        [sys.executable, "-c", probe_src],
         env=env,
         capture_output=True,
         text=True,
@@ -56,5 +57,40 @@ def test_flash_attention_lowers_on_tpu():
     )
     if proc.returncode == 42:
         pytest.skip(f"no TPU backend in subprocess: {proc.stdout.strip()}")
+    return proc
+
+
+def _assert_lowered(proc):
     assert proc.returncode == 0, f"TPU lowering failed:\n{proc.stdout}\n{proc.stderr[-4000:]}"
     assert "LOWERED_OK" in proc.stdout
+
+
+def test_flash_attention_lowers_on_tpu():
+    _assert_lowered(_run_tpu_probe(_PROBE))
+
+
+_RING_PROBE = r"""
+import sys
+import jax
+if jax.default_backend() not in ("tpu", "axon"):
+    print("NO_TPU_BACKEND:" + jax.default_backend())
+    sys.exit(42)
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh
+from ray_tpu.parallel.ring_attention import ring_attention
+
+mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
+x = jax.ShapeDtypeStruct((2, 1024, 4, 128), jnp.bfloat16)
+fwd = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh, causal=True, impl="pallas"))
+fwd.lower(x, x, x).compile()
+bwd = jax.jit(jax.grad(
+    lambda q, k, v: ring_attention(q, k, v, mesh, causal=True, impl="pallas").astype(jnp.float32).sum(),
+    argnums=(0, 1, 2)))
+bwd.lower(x, x, x).compile()
+print("LOWERED_OK")
+"""
+
+
+def test_ring_attention_pallas_lowers_on_tpu():
+    _assert_lowered(_run_tpu_probe(_RING_PROBE))
