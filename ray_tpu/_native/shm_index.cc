@@ -37,17 +37,23 @@ constexpr uint32_t kPending = 1;
 constexpr uint32_t kSealed = 2;
 constexpr uint32_t kTombstone = 3;
 
+// All cross-process-shared fields are atomics: the pin/version protocol makes
+// stale reads harmless, but plain fields would still be formal data races
+// (and TSAN reports) — payload reads are relaxed, ordered by the
+// release-store of `state` (seal) / acquire-load on the reader side.
 struct Slot {
   std::atomic<uint32_t> state;
   std::atomic<uint32_t> version;
   std::atomic<uint32_t> readers;
   uint32_t pad;
-  uint64_t offset;
-  uint64_t size;
-  uint8_t key[kKeySize];
-  uint8_t pad2[4];
+  std::atomic<uint64_t> offset;
+  std::atomic<uint64_t> size;
+  std::atomic<uint64_t> key0, key1, key2;  // 24 bytes of key
+  std::atomic<uint32_t> key3;              // + 4 = kKeySize (28)
+  uint32_t pad2;
 };
 static_assert(sizeof(Slot) == 64, "slot must be one cache line");
+static_assert(std::atomic<uint64_t>::is_always_lock_free, "need lock-free u64");
 
 struct Header {
   uint64_t magic;
@@ -89,8 +95,31 @@ uint64_t fnv1a(const uint8_t* key) {
   return h;
 }
 
+void key_split(const uint8_t* key, uint64_t& a, uint64_t& b, uint64_t& c, uint32_t& d) {
+  std::memcpy(&a, key, 8);
+  std::memcpy(&b, key + 8, 8);
+  std::memcpy(&c, key + 16, 8);
+  std::memcpy(&d, key + 24, 4);
+}
+
 bool key_eq(const Slot& s, const uint8_t* key) {
-  return std::memcmp(s.key, key, kKeySize) == 0;
+  uint64_t a, b, c;
+  uint32_t d;
+  key_split(key, a, b, c, d);
+  return s.key0.load(std::memory_order_relaxed) == a &&
+         s.key1.load(std::memory_order_relaxed) == b &&
+         s.key2.load(std::memory_order_relaxed) == c &&
+         s.key3.load(std::memory_order_relaxed) == d;
+}
+
+void key_store(Slot& s, const uint8_t* key) {
+  uint64_t a, b, c;
+  uint32_t d;
+  key_split(key, a, b, c, d);
+  s.key0.store(a, std::memory_order_relaxed);
+  s.key1.store(b, std::memory_order_relaxed);
+  s.key2.store(c, std::memory_order_relaxed);
+  s.key3.store(d, std::memory_order_relaxed);
 }
 
 // Find the LIVE (pending/sealed) slot holding `key`, or nullptr. Probe stops
@@ -194,8 +223,17 @@ int idx_put(int handle, const uint8_t* key, uint64_t offset, uint64_t size) {
     if (key_eq(s, key)) {
       if (st == kPending || st == kSealed) {
         // Re-create (idempotent). Refuse while pinned: bumping the version
-        // under a live pin would orphan that reader's release.
-        if (s.readers.load(std::memory_order_acquire) != 0) return -1;
+        // under a live pin would orphan that reader's release. The pin
+        // window must be CLOSED before the readers check (same store-load
+        // seq_cst pairing as idx_remove): demote to kPending first so no
+        // new pin can succeed its re-validation, then check readers — a
+        // plain check while state stayed kSealed would race a concurrent
+        // pin and hand that reader a torn offset/size pair mid-overwrite.
+        s.state.store(kPending, std::memory_order_seq_cst);
+        if (s.readers.load(std::memory_order_seq_cst) != 0) {
+          s.state.store(st, std::memory_order_release);  // payload untouched
+          return -1;
+        }
         target = &s;
         break;
       }
@@ -216,9 +254,9 @@ int idx_put(int handle, const uint8_t* key, uint64_t offset, uint64_t size) {
   // Order matters for concurrent readers: bump version first (invalidates
   // stale pins), write payload fields, then flip state last with release.
   target->version.fetch_add(1, std::memory_order_acq_rel);
-  std::memcpy(target->key, key, kKeySize);
-  target->offset = offset;
-  target->size = size;
+  key_store(*target, key);
+  target->offset.store(offset, std::memory_order_relaxed);
+  target->size.store(size, std::memory_order_relaxed);
   target->state.store(kPending, std::memory_order_release);
   return 0;
 }
@@ -284,8 +322,8 @@ int idx_get_pinned(int handle, const uint8_t* key, uint64_t* offset,
     s->readers.fetch_sub(1, std::memory_order_acq_rel);
     return 0;
   }
-  *offset = s->offset;
-  *size = s->size;
+  *offset = s->offset.load(std::memory_order_relaxed);
+  *size = s->size.load(std::memory_order_relaxed);
   *version = v;
   *slot = static_cast<uint64_t>(s - ix->slots);
   return 1;

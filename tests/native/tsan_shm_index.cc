@@ -1,0 +1,98 @@
+// In-process TSAN hammer for the shm_index reader-pin/tombstone protocol.
+//
+// The daemon (writer thread) cycles put/seal/remove with key reuse while
+// reader threads pin/validate/release through a second attached handle —
+// the exact interleavings where a protocol bug would free memory under a
+// reader or let a stale release unpin someone else's object. Built with
+// -fsanitize=thread by tests/test_native_races.py; any data race aborts the
+// run (halt_on_error=1).
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+extern "C" {
+int idx_create(const char*, uint64_t);
+int idx_attach(const char*);
+int idx_put(int, const uint8_t*, uint64_t, uint64_t);
+int idx_seal(int, const uint8_t*);
+int idx_remove(int, const uint8_t*);
+uint32_t idx_readers(int, const uint8_t*);
+int idx_get_pinned(int, const uint8_t*, uint64_t*, uint64_t*, uint32_t*, uint64_t*);
+int idx_release(int, uint64_t, uint32_t);
+int idx_close(int, int);
+}
+
+static void key_of(int i, uint8_t* k) {
+  memset(k, 0, 28);
+  k[0] = (uint8_t)i;
+  k[1] = (uint8_t)(i * 37);
+}
+
+int main(int argc, char** argv) {
+  int seconds = argc > 1 ? atoi(argv[1]) : 3;
+  const char* name = "/tsan_idx_test";
+  int daemon = idx_create(name, 64);  // small table -> probe collisions + reuse
+  if (daemon < 0) { printf("create failed\n"); return 2; }
+  int reader_h = idx_attach(name);
+  if (reader_h < 0) { printf("attach failed\n"); return 2; }
+
+  std::atomic<bool> stop{false};
+  std::atomic<uint64_t> hits{0};
+  const int NKEYS = 24;
+
+  std::thread writer([&] {
+    uint64_t gen = 0;
+    while (!stop.load(std::memory_order_relaxed)) {
+      for (int i = 0; i < NKEYS; ++i) {
+        uint8_t k[28];
+        key_of(i, k);
+        // Size encodes the key so readers can detect a torn/misrouted hit.
+        if (idx_put(daemon, k, gen * 4096 + i, 1000 + i) == 0) idx_seal(daemon, k);
+      }
+      for (int i = 0; i < NKEYS; i += 2) {
+        uint8_t k[28];
+        key_of(i, k);
+        idx_remove(daemon, k);  // 0 or 1 (deferred free) both legal
+      }
+      ++gen;
+    }
+  });
+
+  std::vector<std::thread> readers;
+  for (int t = 0; t < 4; ++t) {
+    readers.emplace_back([&] {
+      uint64_t off, sz, slot;
+      uint32_t ver;
+      while (!stop.load(std::memory_order_relaxed)) {
+        for (int i = 0; i < NKEYS; ++i) {
+          uint8_t k[28];
+          key_of(i, k);
+          if (idx_get_pinned(reader_h, k, &off, &sz, &ver, &slot)) {
+            if (sz != (uint64_t)(1000 + i)) {
+              printf("BAD PAYLOAD key=%d size=%llu\n", i, (unsigned long long)sz);
+              fflush(stdout);
+              _exit(3);
+            }
+            idx_release(reader_h, slot, ver);
+            hits.fetch_add(1, std::memory_order_relaxed);
+          }
+        }
+      }
+    });
+  }
+
+  std::this_thread::sleep_for(std::chrono::seconds(seconds));
+  stop.store(true);
+  writer.join();
+  for (auto& r : readers) r.join();
+  printf("HAMMER_OK hits=%llu\n", (unsigned long long)hits.load());
+  idx_close(reader_h, 0);
+  idx_close(daemon, 1);
+  return 0;
+}
