@@ -19,3 +19,5 @@ from ray_tpu.rllib.algorithms.marwil import MARWIL, BC, BCConfig, MARWILConfig  
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig  # noqa: F401
 from ray_tpu.rllib.policy.sample_batch import MultiAgentBatch, SampleBatch  # noqa: F401
+from ray_tpu.rllib.algorithms.apex_dqn import ApexDQN, ApexDQNConfig  # noqa: F401,E402
+from ray_tpu.rllib.algorithms.qmix import QMIX, QMIXConfig  # noqa: F401,E402

@@ -1,0 +1,1 @@
+from ray_tpu.rllib.algorithms.apex_dqn.apex_dqn import ApexDQN, ApexDQNConfig  # noqa: F401

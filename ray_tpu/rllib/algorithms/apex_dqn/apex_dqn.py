@@ -1,0 +1,307 @@
+"""Ape-X DQN — distributed prioritized experience replay.
+
+Reference: rllib/algorithms/apex_dqn/apex_dqn.py (Horgan et al. 2018): many
+rollout-worker actors explore with a per-worker epsilon ladder and feed
+actor-sharded prioritized replay buffers; the learner samples shards
+round-robin, trains the double-Q TD loss, pushes updated priorities back to
+the owning shard, and broadcasts weights periodically. The replay memory
+therefore scales horizontally with shard actors instead of living in the
+learner process (VERDICT r1 #9: distributed replay).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.dqn.dqn import DQNConfig, dqn_loss, q_forward
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.policy.sample_batch import (
+    ACTIONS,
+    DONES,
+    NEXT_OBS,
+    OBS,
+    REWARDS,
+    SampleBatch,
+)
+from ray_tpu.rllib.utils.replay_buffers import PrioritizedReplayBuffer
+
+
+class _ApexWorker:
+    """Rollout actor: explores with its own fixed epsilon (Ape-X ladder
+    eps_i = 0.4^(1 + 7 i/(N-1))) against the latest broadcast weights."""
+
+    def __init__(self, env, env_config, spec, worker_index, num_workers, num_envs, seed):
+        import jax
+
+        # Rollouts stay off-chip (same rule as rollout_worker.py): on a TPU
+        # host an unpinned jax init would contend with the learner's chip.
+        jax.config.update("jax_platforms", "cpu")
+        from ray_tpu.rllib.env.vector_env import VectorEnv
+
+        self.spec = spec
+        self.env = VectorEnv(env, num_envs, env_config, worker_index, seed=seed + worker_index)
+        self._q = jax.jit(lambda p, o: q_forward(p, o, spec))
+        self.params = None
+        denom = max(num_workers - 1, 1)
+        self.epsilon = 0.4 ** (1 + 7 * worker_index / denom)
+        self._rng = np.random.default_rng(seed * 9973 + worker_index)
+
+    def set_weights(self, weights):
+        import jax
+        import jax.numpy as jnp
+
+        self.params = jax.tree_util.tree_map(jnp.asarray, weights)
+        return True
+
+    def sample(self, n_steps: int):
+        import jax.numpy as jnp
+
+        cols = {OBS: [], ACTIONS: [], REWARDS: [], DONES: [], NEXT_OBS: []}
+        for _ in range(n_steps):
+            obs = self.env.current_obs().astype(np.float32)
+            q = np.asarray(self._q(self.params, jnp.asarray(obs)))
+            actions = q.argmax(axis=-1)
+            mask = self._rng.random(len(actions)) < self.epsilon
+            actions = np.where(
+                mask, self._rng.integers(0, self.spec.action_dim, len(actions)), actions
+            )
+            next_obs, rewards, dones, _ = self.env.step(actions)
+            cols[OBS].append(obs)
+            cols[ACTIONS].append(actions)
+            cols[REWARDS].append(rewards)
+            cols[DONES].append(dones.astype(np.float32))
+            cols[NEXT_OBS].append(next_obs.astype(np.float32))
+        out = {k: np.concatenate(v) for k, v in cols.items()}
+        rews, lens = self.env.pop_episode_stats()
+        return out, rews, len(out[OBS])
+
+    def stop(self):
+        self.env.close()
+        return True
+
+
+class _ReplayShard:
+    """One shard of the distributed prioritized replay memory."""
+
+    def __init__(self, capacity: int, seed: int):
+        self.buf = PrioritizedReplayBuffer(capacity, seed=seed)
+
+    def add(self, cols: dict):
+        self.buf.add(SampleBatch({k: np.asarray(v) for k, v in cols.items()}))
+        return len(self.buf)
+
+    def sample_with_idx(self, n: int):
+        if len(self.buf) < n:
+            return None
+        out, idx = self.buf.sample_with_indices(n)
+        return dict(out), idx
+
+    def update_priorities(self, idx, td_errors):
+        # Addressed by explicit indices: other learner rounds may have
+        # sampled in between (the implicit last-idx protocol doesn't
+        # survive interleaving).
+        self.buf.update_priorities_at(idx, td_errors)
+        return True
+
+    def size(self) -> int:
+        return len(self.buf)
+
+
+class ApexDQNConfig(DQNConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or ApexDQN)
+        self.num_rollout_workers = 2
+        self.num_replay_shards = 2
+        self.rollout_fragment_length = 50
+        self.weight_sync_period_updates = 16
+        self.train_rounds_per_iter = 8
+        self.updates_per_round = 4
+
+    def training(self, *, num_replay_shards=None, rollout_fragment_length=None,
+                 weight_sync_period_updates=None, train_rounds_per_iter=None,
+                 updates_per_round=None, **kwargs) -> "ApexDQNConfig":
+        super().training(**kwargs)
+        for name, val in (
+            ("num_replay_shards", num_replay_shards),
+            ("rollout_fragment_length", rollout_fragment_length),
+            ("weight_sync_period_updates", weight_sync_period_updates),
+            ("train_rounds_per_iter", train_rounds_per_iter),
+            ("updates_per_round", updates_per_round),
+        ):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+class ApexDQN(Algorithm):
+    @classmethod
+    def get_default_config(cls) -> ApexDQNConfig:
+        return ApexDQNConfig(cls)
+
+    def setup(self, config: dict) -> None:
+        import gymnasium as gym
+        import jax
+
+        cfg: ApexDQNConfig = self._algo_config
+        # Re-setup (Trainable.__init__ already ran setup once) must not leak
+        # the previous actor fleet's CPU reservations.
+        self.cleanup()
+        probe = gym.make(cfg.env) if isinstance(cfg.env, str) else cfg.env(dict(cfg.env_config))
+        from ray_tpu.rllib.models import ModelCatalog
+
+        self.module_spec = ModelCatalog.get_model_spec(
+            probe.observation_space, probe.action_space, cfg.model_config()
+        )
+        assert self.module_spec.discrete, "ApexDQN requires a discrete action space"
+        probe.close()
+        self.learner = Learner(
+            self.module_spec, dqn_loss, lr=cfg.lr, grad_clip=cfg.grad_clip, seed=cfg.seed
+        )
+        self.target_params = self.learner.get_weights()
+        self._q_fn = jax.jit(lambda p, o: q_forward(p, o, self.module_spec))
+
+        n_workers = max(cfg.num_rollout_workers, 1)
+        worker_cls = ray_tpu.remote(num_cpus=getattr(cfg, "num_cpus_per_worker", None) or 1)(_ApexWorker)
+        self.workers = [
+            worker_cls.remote(
+                cfg.env, dict(cfg.env_config), self.module_spec,
+                i, n_workers, max(cfg.num_envs_per_worker, 1), cfg.seed,
+            )
+            for i in range(n_workers)
+        ]
+        shard_cls = ray_tpu.remote(num_cpus=0.1)(_ReplayShard)
+        shard_cap = max(1, cfg.replay_buffer_capacity // max(cfg.num_replay_shards, 1))
+        self.shards = [
+            shard_cls.remote(shard_cap, cfg.seed + 31 * i) for i in range(cfg.num_replay_shards)
+        ]
+        self._shard_sizes = {i: 0 for i in range(len(self.shards))}
+        weights = self.learner.get_weights()
+        ray_tpu.get([w.set_weights.remote(weights) for w in self.workers], timeout=300)
+        self._timesteps_total = 0
+        self._updates = 0
+        self._last_sync = 0
+        self._add_rr = 0
+        self._sample_rr = 0
+        self._replay_size = 0
+        self._episode_reward_window: list = []
+
+    def training_step(self) -> dict:
+        cfg: ApexDQNConfig = self._algo_config
+        metrics: dict = {}
+        for _ in range(cfg.train_rounds_per_iter):
+            # Fan the rollout actors out; route each fragment to a shard.
+            refs = [w.sample.remote(cfg.rollout_fragment_length) for w in self.workers]
+            add_refs = []
+            add_shards = []
+            for cols, rews, count in ray_tpu.get(refs, timeout=600):
+                shard_i = self._add_rr % len(self.shards)
+                self._add_rr += 1
+                add_refs.append(self.shards[shard_i].add.remote(cols))
+                add_shards.append(shard_i)
+                self._timesteps_total += count
+                self._episode_reward_window += rews
+            # shard.add returns the shard's new size; track the latest per
+            # shard instead of a second size() fan-out every round.
+            for ref, shard in zip(add_refs, add_shards):
+                self._shard_sizes[shard] = ray_tpu.get(ref, timeout=300)
+            self._replay_size = sum(self._shard_sizes.values())
+            self._episode_reward_window = self._episode_reward_window[-100:]
+            if self._replay_size < cfg.learning_starts:
+                continue
+            for _ in range(cfg.updates_per_round):
+                metrics = self._train_once() or metrics
+            if self._updates - self._last_sync >= cfg.weight_sync_period_updates:
+                self._last_sync = self._updates
+                weights = self.learner.get_weights()
+                ray_tpu.get(
+                    [w.set_weights.remote(weights) for w in self.workers], timeout=300
+                )
+        metrics["replay_size"] = self._replay_size
+        return metrics
+
+    def _train_once(self):
+        import jax
+        import jax.numpy as jnp
+
+        cfg: ApexDQNConfig = self._algo_config
+        shard = self.shards[self._sample_rr % len(self.shards)]
+        self._sample_rr += 1
+        res = ray_tpu.get(shard.sample_with_idx.remote(cfg.train_batch_size), timeout=300)
+        if res is None:
+            return None
+        batch, idx = res
+        next_obs = jnp.asarray(batch[NEXT_OBS])
+        target = jax.tree_util.tree_map(jnp.asarray, self.target_params)
+        q_next_target = np.asarray(self._q_fn(target, next_obs))
+        if cfg.double_q:
+            q_next_online = np.asarray(self._q_fn(self.learner.params, next_obs))
+            best = q_next_online.argmax(axis=-1)
+            q_next = q_next_target[np.arange(len(best)), best]
+        else:
+            q_next = q_next_target.max(axis=-1)
+        td_target = batch[REWARDS] + cfg.gamma * (1.0 - batch[DONES]) * q_next
+        train_batch = SampleBatch({
+            OBS: batch[OBS],
+            ACTIONS: batch[ACTIONS],
+            "td_target": td_target.astype(np.float32),
+            "weights": batch["weights"],
+        })
+        metrics = self.learner.update(train_batch, {})
+        q = np.asarray(self._q_fn(self.learner.params, jnp.asarray(batch[OBS])))
+        td_err = q[np.arange(len(td_target)), batch[ACTIONS].astype(int)] - td_target
+        shard.update_priorities.remote(idx, td_err)
+        self._updates += 1
+        if self._updates % cfg.target_network_update_freq == 0:
+            self.target_params = self.learner.get_weights()
+        return metrics
+
+    def step(self) -> dict:
+        import time
+
+        t0 = time.time()
+        result = self.training_step()
+        result["episode_reward_mean"] = (
+            float(np.mean(self._episode_reward_window))
+            if self._episode_reward_window
+            else float("nan")
+        )
+        result["timesteps_total"] = self._timesteps_total
+        result["time_this_iter_s"] = time.time() - t0
+        return result
+
+    def save_checkpoint(self):
+        from ray_tpu.air.checkpoint import Checkpoint
+
+        return Checkpoint.from_dict({
+            "weights": self.learner.get_weights(),
+            "target": self.target_params,
+            "timesteps": self._timesteps_total,
+            "updates": self._updates,
+        })
+
+    def load_checkpoint(self, checkpoint) -> None:
+        data = checkpoint.to_dict()
+        self.learner.set_weights(data["weights"])
+        self.target_params = data["target"]
+        self._timesteps_total = data.get("timesteps", 0)
+        self._updates = data.get("updates", 0)
+        weights = self.learner.get_weights()
+        ray_tpu.get([w.set_weights.remote(weights) for w in self.workers], timeout=300)
+
+    def cleanup(self) -> None:
+        for w in getattr(self, "workers", []):
+            try:
+                ray_tpu.get(w.stop.remote(), timeout=10)
+            except Exception:
+                pass
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        for s in getattr(self, "shards", []):
+            try:
+                ray_tpu.kill(s)
+            except Exception:
+                pass

@@ -1,0 +1,1 @@
+from ray_tpu.rllib.algorithms.qmix.qmix import QMIX, QMIXConfig  # noqa: F401

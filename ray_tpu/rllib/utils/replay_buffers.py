@@ -76,6 +76,16 @@ class PrioritizedReplayBuffer(ReplayBuffer):
 
     def update_priorities(self, td_errors: np.ndarray, eps: float = 1e-6):
         assert self._last_idx is not None
-        prios = np.abs(td_errors) + eps
-        self._priorities[self._last_idx] = prios
+        self.update_priorities_at(self._last_idx, td_errors, eps)
+
+    # Explicit-index variants: distributed consumers (Ape-X replay shards)
+    # interleave sampling rounds, so the implicit last-sample protocol above
+    # cannot be relied on across calls.
+    def sample_with_indices(self, num_items: int):
+        out = self.sample(num_items)
+        return out, np.asarray(self._last_idx)
+
+    def update_priorities_at(self, idx: np.ndarray, td_errors: np.ndarray, eps: float = 1e-6):
+        prios = np.abs(np.asarray(td_errors)) + eps
+        self._priorities[np.asarray(idx)] = prios
         self._max_priority = max(self._max_priority, float(prios.max()))
