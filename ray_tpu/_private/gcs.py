@@ -131,6 +131,15 @@ class GcsServer:
     async def rpc_get_nodes(self, req):
         return {"nodes": self.nodes}
 
+    async def rpc_report_node_stats(self, req):
+        """Per-node dashboard agent report (dashboard/agent.py): host CPU/mem,
+        per-worker process stats, accelerator presence."""
+        node = self.nodes.get(req["node_id"])
+        if node is None:
+            return {"ok": False}
+        node["stats"] = req.get("stats", {})
+        return {"ok": True}
+
     async def rpc_drain_node(self, req):
         node = self.nodes.get(req["node_id"])
         if node is not None:

@@ -157,6 +157,12 @@ class Raylet:
         from ray_tpu._private.memory_monitor import MemoryMonitor
 
         self._memory_monitor = MemoryMonitor(self)
+        from ray_tpu.dashboard.agent import NodeStatsAgent
+
+        # Per-node stats reporter (reference runs dashboard/agent.py as its
+        # own process per node; here it shares the raylet's IO loop by
+        # default and is also runnable standalone — see dashboard/agent.py).
+        self._stats_agent_task = self._io.spawn(NodeStatsAgent(self).run())
         self._last_memory_check = 0.0
         self._tracing_enabled = False
         self._stopped = False
@@ -1055,6 +1061,7 @@ class Raylet:
         self._hb_task.cancel()
         self._reap_task.cancel()
         self._log_monitor_task.cancel()
+        self._stats_agent_task.cancel()
         for w in self.workers.values():
             if w.proc is not None and w.proc.poll() is None:
                 w.proc.terminate()

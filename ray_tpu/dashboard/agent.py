@@ -1,0 +1,137 @@
+"""Per-node dashboard agent.
+
+Analog of the reference's dashboard/agent.py: a per-node stats reporter that
+samples host-level metrics (CPU/memory/disk via psutil), per-worker process
+stats (RSS, cpu%), and accelerator presence, and ships them to the GCS where
+the dashboard head's REST API and UI read them (reference: reporter module
+dashboard/modules/reporter/).
+
+Runs in two modes:
+- in-raylet asyncio task (default — the raylet spawns ``NodeStatsAgent.run``
+  alongside its heartbeat loop; one fewer process per node on small hosts)
+- standalone process: ``python -m ray_tpu.dashboard.agent --gcs host:port
+  --node-id <id>`` (the reference's layout; useful when the raylet must stay
+  minimal or stats sampling needs isolation).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+REPORT_INTERVAL_S = 5.0
+
+
+def _sample_node_stats(session_dir: str, worker_pids: dict) -> dict:
+    """One stats sample. worker_pids: {worker_id: pid}."""
+    try:
+        import psutil
+    except ImportError:
+        return {}
+    stats: dict = {}
+    try:
+        stats["cpu_percent"] = psutil.cpu_percent(interval=None)
+        vm = psutil.virtual_memory()
+        stats["mem_used"] = int(vm.used)
+        stats["mem_total"] = int(vm.total)
+        try:
+            du = psutil.disk_usage(session_dir or "/")
+            stats["disk_used"] = int(du.used)
+            stats["disk_total"] = int(du.total)
+        except OSError:
+            pass
+        workers = {}
+        for wid, pid in worker_pids.items():
+            try:
+                p = psutil.Process(pid)
+                with p.oneshot():
+                    workers[wid] = {
+                        "pid": pid,
+                        "rss": int(p.memory_info().rss),
+                        "cpu_percent": p.cpu_percent(interval=None),
+                        "status": p.status(),
+                    }
+            except psutil.Error:
+                continue
+        stats["workers"] = workers
+        # Accelerator presence: chip count advertised by the node's resource
+        # set is authoritative; /dev/accel* confirms local hardware.
+        stats["tpu_devices"] = len(
+            [d for d in os.listdir("/dev") if d.startswith("accel")]
+        ) if os.path.isdir("/dev") else 0
+    except Exception:
+        logger.debug("stats sample failed", exc_info=True)
+    return stats
+
+
+class NodeStatsAgent:
+    """In-raylet agent: samples and reports to the GCS on an interval."""
+
+    def __init__(self, raylet):
+        self.raylet = raylet
+
+    async def run(self):
+        # First cpu_percent call primes psutil's delta bookkeeping.
+        _sample_node_stats(self.raylet.session_dir, {})
+        while True:
+            try:
+                pids = {
+                    wid: w.pid
+                    for wid, w in self.raylet.workers.items()
+                    if w.state != "dead"
+                }
+                stats = _sample_node_stats(self.raylet.session_dir, pids)
+                if stats:
+                    await self.raylet.gcs.acall(
+                        "report_node_stats",
+                        {"node_id": self.raylet.node_id, "stats": stats},
+                    )
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.debug("node stats report failed", exc_info=True)
+            await asyncio.sleep(REPORT_INTERVAL_S)
+
+
+def main(argv=None):
+    """Standalone agent process (reference: dashboard/agent.py entry)."""
+    import argparse
+    import time
+
+    from ray_tpu._private.rpc import RpcClient
+
+    ap = argparse.ArgumentParser(prog="ray_tpu-dashboard-agent")
+    ap.add_argument("--gcs", required=True, help="GCS address host:port")
+    ap.add_argument("--node-id", required=True)
+    ap.add_argument("--session-dir", default="/tmp/ray_tpu")
+    args = ap.parse_args(argv)
+    host, port = args.gcs.rsplit(":", 1)
+    gcs = RpcClient((host, int(port)), label="dashboard-agent")
+    _sample_node_stats(args.session_dir, {})
+    while True:
+        # Standalone mode discovers worker processes on this host by their
+        # command line (the GCS node record carries only worker counts).
+        pids = {}
+        try:
+            import psutil
+
+            for p in psutil.process_iter(["pid", "cmdline"]):
+                cmd = " ".join(p.info.get("cmdline") or [])
+                if "ray_tpu._private.worker_main" in cmd:
+                    pids[f"pid-{p.info['pid']}"] = p.info["pid"]
+        except Exception:
+            pass
+        try:
+            stats = _sample_node_stats(args.session_dir, pids)
+            if stats:
+                gcs.call("report_node_stats", {"node_id": args.node_id, "stats": stats})
+        except Exception:
+            logger.debug("standalone stats report failed", exc_info=True)
+        time.sleep(REPORT_INTERVAL_S)
+
+
+if __name__ == "__main__":
+    main()

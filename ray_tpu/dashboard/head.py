@@ -33,6 +33,7 @@ logger = logging.getLogger(__name__)
 class DashboardHead:
     def __init__(self, gcs_address, session_dir: str, host: str = "127.0.0.1", port: int = 0):
         self._gcs_address = tuple(gcs_address)
+        self._session_dir = session_dir
         self.job_manager = JobManager(gcs_address, session_dir)
         head = self
 
@@ -129,6 +130,47 @@ class DashboardHead:
             from ray_tpu.util.state import summarize_tasks
 
             req._send(200, summarize_tasks(address="%s:%d" % self._gcs_address))
+            return
+        if path == "/api/v0/logs":
+            # Log-file listing (reference: dashboard/modules/log/): on this
+            # single-session-dir layout every node's worker logs land here.
+            import os
+
+            logdir = os.path.join(self._session_dir, "logs")
+            files = []
+            if os.path.isdir(logdir):
+                for root, _dirs, names in os.walk(logdir):
+                    for name in names:
+                        full = os.path.join(root, name)
+                        files.append({
+                            "file": os.path.relpath(full, logdir),
+                            "size": os.path.getsize(full),
+                        })
+            req._send(200, {"result": sorted(files, key=lambda f: f["file"])})
+            return
+        if path == "/api/v0/logs/tail":
+            import os
+            from urllib.parse import parse_qs, urlparse
+
+            q = parse_qs(urlparse(req.path).query)
+            rel = (q.get("file") or [""])[0]
+            try:
+                lines = max(1, min(int((q.get("lines") or ["200"])[0]), 10_000))
+            except ValueError:
+                req._send(400, {"error": "lines must be an integer"})
+                return
+            logdir = os.path.realpath(os.path.join(self._session_dir, "logs"))
+            full = os.path.realpath(os.path.join(logdir, rel))
+            # Path-traversal guard: the file must stay inside the log dir.
+            if not full.startswith(logdir + os.sep) or not os.path.isfile(full):
+                req._send(404, {"error": f"no such log file {rel!r}"})
+                return
+            with open(full, "rb") as f:
+                f.seek(0, 2)
+                size = f.tell()
+                f.seek(max(0, size - 256 * 1024))
+                data = f.read().decode("utf-8", "replace")
+            req._send(200, {"lines": data.splitlines()[-lines:]})
             return
         if path.startswith("/api/v0/"):
             from ray_tpu.util.state import api as state_api

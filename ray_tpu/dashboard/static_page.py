@@ -29,8 +29,12 @@ INDEX_HTML = """<!doctype html>
 <h2>Cluster</h2><div id="cluster"></div>
 <h2>Nodes</h2><table id="nodes"></table>
 <h2>Actors</h2><table id="actors"></table>
+<h2>Placement groups</h2><table id="pgs"></table>
 <h2>Jobs (submitted)</h2><table id="jobs"></table>
 <h2>Tasks</h2><div id="tasks"></div>
+<h2>Logs</h2>
+<select id="logsel"><option value="">— pick a log file —</option></select>
+<pre id="logview" style="background:#f7f7f7;padding:8px;max-height:320px;overflow:auto;font-size:0.75rem"></pre>
 <script>
 const esc = (v) => String(v).replace(/[&<>"']/g,
   (c) => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));
@@ -53,15 +57,28 @@ async function refresh() {
     document.getElementById("cluster").innerHTML =
       Object.keys(res).sort().map(k =>
         `<b>${esc(k)}</b>: ${fmt(res[k] - (avail[k] ?? 0))}/${fmt(res[k])} used`).join(" &nbsp;·&nbsp; ");
+    const gb = (n) => n == null ? null : (n / 1073741824).toFixed(1) + "G";
     table(document.getElementById("nodes"),
-      ["node", "state", "address", "active workers"],
-      (status.nodes || []).map(n => [n.node_id.slice(0,12), pill(n.state),
-        (n.address || []).join(":"), n.num_active_workers ?? 0]));
+      ["node", "state", "address", "active workers", "cpu %", "mem", "workers rss"],
+      (status.nodes || []).map(n => {
+        const s = n.stats || {};
+        const wrss = Object.values(s.workers || {}).reduce((a, w) => a + (w.rss || 0), 0);
+        return [n.node_id.slice(0,12), pill(n.state),
+          (n.address || []).join(":"), n.num_active_workers ?? 0,
+          s.cpu_percent != null ? fmt(s.cpu_percent) : null,
+          s.mem_total ? `${gb(s.mem_used)}/${gb(s.mem_total)}` : null,
+          wrss ? gb(wrss) : null];
+      }));
     const actors = (await j("/api/v0/actors")).result || [];
     table(document.getElementById("actors"),
       ["actor", "name", "state", "node", "restarts"],
       actors.map(a => [a.actor_id.slice(0,12), a.name, pill(a.state),
         (a.node_id || "").slice(0,8), a.num_restarts ?? 0]));
+    const pgs = (await j("/api/v0/placement_groups")).result || [];
+    table(document.getElementById("pgs"),
+      ["id", "state", "strategy", "bundles"],
+      pgs.map(p => [String(p.placement_group_id || p.id || "").slice(0,12), pill(p.state || "?"),
+        p.strategy, JSON.stringify(p.bundles || []).slice(0, 80)]));
     const jobs = await j("/api/jobs/");
     table(document.getElementById("jobs"),
       ["id", "status", "entrypoint"],
@@ -79,7 +96,23 @@ async function refresh() {
     document.getElementById("updated").textContent = "refresh failed: " + e;
   }
 }
-refresh(); setInterval(refresh, 3000);
+async function refreshLogs() {
+  try {
+    const files = (await j("/api/v0/logs")).result || [];
+    const sel = document.getElementById("logsel");
+    const cur = sel.value;
+    sel.innerHTML = '<option value="">— pick a log file —</option>' +
+      files.map(f => `<option value="${esc(f.file)}">${esc(f.file)} (${f.size}b)</option>`).join("");
+    sel.value = cur;
+  } catch (e) {}
+}
+document.getElementById("logsel").addEventListener("change", async (ev) => {
+  const f = ev.target.value;
+  if (!f) return;
+  const r = await j("/api/v0/logs/tail?file=" + encodeURIComponent(f) + "&lines=200");
+  document.getElementById("logview").textContent = (r.lines || []).join("\n");
+});
+refresh(); refreshLogs(); setInterval(refresh, 3000); setInterval(refreshLogs, 10000);
 </script>
 </body>
 </html>

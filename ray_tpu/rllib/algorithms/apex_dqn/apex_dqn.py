@@ -204,8 +204,8 @@ class ApexDQN(Algorithm):
                 self._episode_reward_window += rews
             # shard.add returns the shard's new size; track the latest per
             # shard instead of a second size() fan-out every round.
-            for ref, shard in zip(add_refs, add_shards):
-                self._shard_sizes[shard] = ray_tpu.get(ref, timeout=300)
+            for size, shard in zip(ray_tpu.get(add_refs, timeout=300), add_shards):
+                self._shard_sizes[shard] = size
             self._replay_size = sum(self._shard_sizes.values())
             self._episode_reward_window = self._episode_reward_window[-100:]
             if self._replay_size < cfg.learning_starts:

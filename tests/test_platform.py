@@ -10,6 +10,8 @@ import os
 import subprocess
 import sys
 import time
+import urllib.error
+import urllib.parse
 import urllib.request
 
 import pytest
@@ -174,3 +176,58 @@ def test_dashboard_index_page(dashboard):
     assert "text/html" in ctype
     assert "ray_tpu dashboard" in body
     assert "/api/cluster_status" in body  # the page polls the REST API
+
+
+def test_node_stats_agent_reports(dashboard):
+    """Per-node agent (dashboard/agent.py) ships host + per-worker stats to
+    the GCS; the head's cluster_status carries them (reference:
+    dashboard/agent.py + reporter module)."""
+
+    @ray_tpu.remote
+    class Holder:
+        def pid(self):
+            return os.getpid()
+
+    h = Holder.remote()
+    wpid = ray_tpu.get(h.pid.remote())
+    deadline = time.monotonic() + 30
+    stats = {}
+    while time.monotonic() < deadline:
+        status = _get(dashboard, "/api/cluster_status")
+        nodes = [n for n in status["nodes"] if n["state"] == "ALIVE"]
+        stats = nodes[0].get("stats") or {}
+        if stats.get("workers") and any(
+            w.get("pid") == wpid for w in stats["workers"].values()
+        ):
+            break
+        time.sleep(1.0)
+    assert stats.get("mem_total", 0) > 0
+    assert "cpu_percent" in stats
+    assert any(w.get("pid") == wpid and w.get("rss", 0) > 0 for w in stats.get("workers", {}).values())
+
+
+def test_dashboard_log_endpoints(dashboard):
+    @ray_tpu.remote
+    def chatty():
+        print("hello-from-worker-log")
+        return 1
+
+    assert ray_tpu.get(chatty.remote()) == 1
+    deadline = time.monotonic() + 20
+    files = []
+    while time.monotonic() < deadline:
+        files = _get(dashboard, "/api/v0/logs")["result"]
+        if files:
+            break
+        time.sleep(0.5)
+    assert files, "no log files listed"
+    target = next((f["file"] for f in files if f["file"].endswith(".out") and f["size"] > 0), None)
+    if target is not None:
+        tail = _get(dashboard, "/api/v0/logs/tail?file=" + urllib.parse.quote(target) + "&lines=50")
+        assert isinstance(tail["lines"], list)
+    # Path traversal must 404.
+    try:
+        _get(dashboard, "/api/v0/logs/tail?file=..%2F..%2Fetc%2Fpasswd")
+        raise AssertionError("traversal not rejected")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
