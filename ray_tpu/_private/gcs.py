@@ -28,7 +28,7 @@ import os
 import time
 
 from ray_tpu._private.config import get_config
-from ray_tpu._private.rpc import EventLoopThread, RpcClient, RpcServer
+from ray_tpu._private.rpc import EventLoopThread, RpcClient, RpcServer, schema
 from ray_tpu._private.task_spec import TaskSpec
 
 logger = logging.getLogger(__name__)
@@ -76,6 +76,7 @@ class GcsServer:
     # Nodes & health
     # ------------------------------------------------------------------
 
+    @schema(node_id=str, address=list, resources=dict)
     async def rpc_register_node(self, req):
         self._mutations += 1
         node_id = req["node_id"]
@@ -93,6 +94,7 @@ class GcsServer:
         await self._publish("node_updates", {"node_id": node_id, "state": "ALIVE"})
         return {"ok": True}
 
+    @schema(node_id=str)
     async def rpc_heartbeat(self, req):
         node = self.nodes.get(req["node_id"])
         if node is None:
@@ -131,6 +133,7 @@ class GcsServer:
     async def rpc_get_nodes(self, req):
         return {"nodes": self.nodes}
 
+    @schema(node_id=str, stats=dict)
     async def rpc_report_node_stats(self, req):
         """Per-node dashboard agent report (dashboard/agent.py): host CPU/mem,
         per-worker process stats, accelerator presence."""
@@ -351,6 +354,7 @@ class GcsServer:
     # KV store (reference: gcs_kv_manager.h; function table rides on this)
     # ------------------------------------------------------------------
 
+    @schema(key=str, value=bytes)
     async def rpc_kv_put(self, req):
         self._mutations += 1
         overwrite = req.get("overwrite", True)
@@ -360,10 +364,12 @@ class GcsServer:
         self.kv[key] = req["value"]
         return {"ok": True, "added": True}
 
+    @schema(key=str)
     async def rpc_kv_get(self, req):
         value = self.kv.get(req["key"])
         return {"found": value is not None, "value": value}
 
+    @schema(key=str)
     async def rpc_kv_del(self, req):
         self._mutations += 1
         existed = self.kv.pop(req["key"], None) is not None
@@ -377,10 +383,12 @@ class GcsServer:
     # Object directory
     # ------------------------------------------------------------------
 
+    @schema(object_id=str, node_id=str)
     async def rpc_add_object_location(self, req):
         self.object_locations.setdefault(req["object_id"], set()).add(req["node_id"])
         return {"ok": True}
 
+    @schema(object_id=str, node_id=str)
     async def rpc_remove_object_location(self, req):
         locs = self.object_locations.get(req["object_id"])
         if locs:
@@ -389,6 +397,7 @@ class GcsServer:
                 del self.object_locations[req["object_id"]]
         return {"ok": True}
 
+    @schema(object_id=str)
     async def rpc_get_object_locations(self, req):
         locs = self.object_locations.get(req["object_id"], set())
         out = []
@@ -578,6 +587,7 @@ class GcsServer:
     # Task events (reference: gcs_task_manager.h; powers `ray timeline`)
     # ------------------------------------------------------------------
 
+    @schema(events=list)
     async def rpc_record_task_events(self, req):
         self.task_events.extend(req["events"])
         overflow = len(self.task_events) - self.cfg.task_events_buffer_size
@@ -592,6 +602,7 @@ class GcsServer:
     # Pub/sub (reference: src/ray/pubsub/publisher.h:307)
     # ------------------------------------------------------------------
 
+    @schema(channel=str)
     async def rpc_subscribe(self, req):
         """Register the requesting connection for pushes on a channel.
 
@@ -627,6 +638,7 @@ class GcsServer:
             except ValueError:
                 pass  # a concurrent re-subscribe already replaced it
 
+    @schema(channel=str, message=None)
     async def rpc_publish(self, req):
         await self._publish(req["channel"], req["message"])
         return {"ok": True}

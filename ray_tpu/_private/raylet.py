@@ -35,7 +35,7 @@ from dataclasses import dataclass, field
 
 from ray_tpu._private.config import get_config
 from ray_tpu._private.ids import NodeID, WorkerID
-from ray_tpu._private.rpc import EventLoopThread, RpcClient, RpcServer
+from ray_tpu._private.rpc import EventLoopThread, RpcClient, RpcServer, schema
 from ray_tpu._private.store.arena import create_arena
 from ray_tpu._private.store.object_store import StoreCore
 from ray_tpu._private.task_spec import TaskSpec
@@ -313,13 +313,16 @@ class Raylet:
         offset, size = await self.store.get(object_id, timeout)
         return {"offset": offset, "size": size}
 
+    @schema(object_id=str)
     async def rpc_store_contains(self, req):
         return {"found": self.store.contains(req["object_id"])}
 
+    @schema(object_id=str)
     async def rpc_store_release(self, req):
         self.store.release(req["object_id"])
         return {"ok": True}
 
+    @schema(object_id=str)
     async def rpc_free_object(self, req):
         """Owner frees an object cluster-wide (ref count hit zero)."""
         object_id = req["object_id"]
@@ -339,6 +342,7 @@ class Raylet:
                     pass
         return {"ok": True}
 
+    @schema(object_id=str)
     async def rpc_delete_local_object(self, req):
         self.store.delete(req["object_id"])
         await self.gcs.acall(
@@ -356,6 +360,7 @@ class Raylet:
         self.store.release(object_id)
         return {"found": True, "size": size}
 
+    @schema(object_id=str, start=int, length=int)
     async def rpc_fetch_object_chunk(self, req):
         object_id = req["object_id"]
         offset, size = await self.store.get(object_id)
@@ -370,6 +375,7 @@ class Raylet:
     # ---- push-side transfer (reference: push_manager.h:29 sender pacing,
     # pull_manager.h:52 admission control) ----
 
+    @schema(object_id=str, size=int)
     async def rpc_push_begin(self, req):
         """Receiver-side admission: open a push session or refuse (saturated /
         already present / no arena space). The pusher backs off and retries."""
@@ -406,6 +412,7 @@ class Raylet:
         }
         return {"accepted": True}
 
+    @schema(object_id=str, start=int, data=bytes)
     async def rpc_push_chunk(self, req):
         sess = self._inbound_pushes.get(req["object_id"])
         if sess is None:
@@ -418,6 +425,7 @@ class Raylet:
         sess["ts"] = time.monotonic()
         return {"ok": True}
 
+    @schema(object_id=str)
     async def rpc_push_commit(self, req):
         object_id = req["object_id"]
         if self._inbound_pushes.pop(object_id, None) is None:
@@ -429,6 +437,7 @@ class Raylet:
         )
         return {"ok": True}
 
+    @schema(object_id=str)
     async def rpc_push_abort(self, req):
         if self._inbound_pushes.pop(req["object_id"], None) is not None:
             self.store.abort(req["object_id"])
@@ -445,6 +454,7 @@ class Raylet:
                 self.store.abort(oid)
                 logger.warning("reaped stale inbound push session for %s", oid[:8])
 
+    @schema(object_id=str, targets=[list])
     async def rpc_broadcast_object(self, req):
         """Fan an object out to `targets` over a binomial tree: this node
         pushes to O(log N) children, each child relays to its subtree. The
@@ -604,11 +614,13 @@ class Raylet:
     # Scheduling (reference: ClusterTaskManager + LocalTaskManager)
     # ------------------------------------------------------------------
 
+    @schema(spec=dict)
     async def rpc_submit_task(self, req):
         spec = TaskSpec.from_wire(req["spec"])
         await self._queue_and_schedule(spec)
         return {"ok": True}
 
+    @schema(specs=list)
     async def rpc_submit_tasks(self, req):
         """Batched submission: one RPC for a burst of specs (client-side
         coalescing in core_worker._flush_submits). Dispatch runs ONCE for
@@ -922,6 +934,7 @@ class Raylet:
             runtime_env_hash=_runtime_env_hash(runtime_env),
         )
 
+    @schema(worker_id=str, pid=int, address=list)
     async def rpc_register_worker(self, req):
         worker_id = req["worker_id"]
         handle = self.workers.get(worker_id)
@@ -935,6 +948,7 @@ class Raylet:
         await self._dispatch()
         return {"ok": True, "node_id": self.node_id}
 
+    @schema(worker_id=str)
     async def rpc_task_finished(self, req):
         """Worker reports completion; release resources + lease for reuse."""
         worker = self.workers.get(req["worker_id"])

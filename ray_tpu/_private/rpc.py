@@ -31,6 +31,47 @@ REQUEST, RESPONSE, ERROR, PUSH = 0, 1, 2, 3
 _MAX_FRAME = 1 << 31
 
 
+def schema(**fields):
+    """Declare a wire schema for an ``rpc_`` handler (N4 analog of the
+    reference's protobuf message types: the transport is schemaless msgpack,
+    so required-field/type validation happens at dispatch).
+
+    Field spec: name=type (required), name=(type, ...) for alternatives,
+    name=None for required-any; prefix the name with ``_`` is not supported —
+    mark OPTIONAL fields by wrapping the spec in a list: name=[type].
+    Unknown payload keys are allowed (forward compatibility, like proto3).
+    """
+
+    def deco(fn):
+        fn._rpc_schema = fields
+        return fn
+
+    return deco
+
+
+def validate_payload(payload, fields) -> str | None:
+    """Returns a problem description, or None if the payload conforms."""
+    if not isinstance(payload, dict):
+        return f"payload must be a map, got {type(payload).__name__}"
+    for name, spec in fields.items():
+        optional = isinstance(spec, list)
+        if optional:
+            spec = spec[0] if spec else None
+        if name not in payload:
+            if optional:
+                continue
+            return f"missing required field {name!r}"
+        if spec is None:
+            continue
+        value = payload[name]
+        if optional and value is None:
+            continue
+        if not isinstance(value, spec):
+            want = getattr(spec, "__name__", spec)
+            return f"field {name!r} must be {want}, got {type(value).__name__}"
+    return None
+
+
 class RpcError(Exception):
     pass
 
@@ -110,6 +151,7 @@ class RpcServer:
     def __init__(self, name: str = "server"):
         self.name = name
         self._handlers: dict[str, Handler] = {}
+        self._schemas: dict[str, dict] = {}
         self._server: asyncio.Server | None = None
         self._conns: set[asyncio.StreamWriter] = set()
         self.address: tuple[str, int] | str | None = None
@@ -122,7 +164,11 @@ class RpcServer:
         """Register every ``rpc_<name>`` coroutine method of obj as <name>."""
         for attr in dir(obj):
             if attr.startswith("rpc_"):
-                self._handlers[prefix + attr[4:]] = getattr(obj, attr)
+                handler = getattr(obj, attr)
+                self._handlers[prefix + attr[4:]] = handler
+                schema = getattr(handler, "_rpc_schema", None)
+                if schema is not None:
+                    self._schemas[prefix + attr[4:]] = schema
 
     async def _serve_conn(self, reader, writer):
         self._conns.add(writer)
@@ -151,6 +197,11 @@ class RpcServer:
         try:
             if handler is None:
                 raise RpcError(f"no handler for method {method!r} on {self.name}")
+            schema = self._schemas.get(method)
+            if schema is not None:
+                problem = validate_payload(payload, schema)
+                if problem:
+                    raise RpcError(f"schema violation in {method!r}: {problem}")
             result = await handler(payload)
             if writer is not None:
                 writer.write(_pack([RESPONSE, seq, method, result]))

@@ -170,3 +170,29 @@ def test_object_ref_in_container(ray_start_regular):
         return ray_tpu.get(d["ref"], timeout=30) + 1
 
     assert ray_tpu.get(unwrap.remote({"ref": inner_ref}), timeout=60) == 8
+
+
+def test_rpc_wire_schema_validation(ray_start_regular):
+    """N4 analog of protobuf message types: msgpack payloads are validated
+    against per-handler schemas at dispatch — malformed frames get a typed
+    schema-violation error instead of a handler stack trace, and unknown
+    extra keys pass (proto3-style forward compatibility)."""
+    from ray_tpu._private import worker_context
+    from ray_tpu._private.rpc import validate_payload
+
+    cw = worker_context.get_core_worker()
+    # Well-formed call passes.
+    assert cw.gcs.call("kv_put", {"key": "schema/x", "value": b"1"})["ok"]
+    # Missing required field -> schema violation, not a KeyError traceback.
+    import pytest as _pytest
+
+    with _pytest.raises(Exception, match="schema violation"):
+        cw.gcs.call("kv_put", {"value": b"1"})
+    # Wrong type.
+    with _pytest.raises(Exception, match="schema violation"):
+        cw.gcs.call("kv_put", {"key": 42, "value": b"1"})
+    # Extra keys tolerated.
+    assert cw.gcs.call("kv_put", {"key": "schema/y", "value": b"2", "future_field": 1})["ok"]
+    # Validator unit behavior: optional fields.
+    assert validate_payload({}, {"a": [int]}) is None
+    assert validate_payload({"a": "x"}, {"a": [int]}) is not None
