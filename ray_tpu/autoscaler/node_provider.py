@@ -176,6 +176,18 @@ class TPUPodProvider(NodeProvider):
         # boot-timeout recycling handles stuck creations, so a tick must not
         # freeze for minutes inside the provider.
         self.wait_for_ready = provider_config.get("wait_for_ready", False)
+        self._tags_cache: dict[str, dict] = {}
+        # Bootstrap: without a startup script the created VM never runs
+        # `ray_tpu start` and can never register — the autoscaler would then
+        # recycle (billable) slices forever on boot timeout. Template fields:
+        # {node_id}, {gcs_address}.
+        self.startup_script_template = provider_config.get(
+            "startup_script_template",
+            "#! /bin/bash\n"
+            "python -m ray_tpu.scripts.scripts start --address {gcs_address} "
+            "--labels '{{\"provider_node_id\": \"{node_id}\"}}' --block\n",
+        )
+        self.gcs_address_for_workers = provider_config.get("gcs_address", "")
         if self.endpoint == "https://tpu.googleapis.com" and not (self._token or self._token_provider):
             raise RuntimeError(
                 "TPUPodProvider against the real TPU API needs credentials: "
@@ -228,11 +240,15 @@ class TPUPodProvider(NodeProvider):
 
     def _list_nodes(self) -> list[dict]:
         resp = self._request("GET", "/nodes")
-        nodes = resp.get("nodes", [])
-        return [
-            n for n in nodes
+        nodes = [
+            n for n in resp.get("nodes", [])
             if n.get("labels", {}).get("ray-cluster-name") == self.cluster_name
         ]
+        # Labels are immutable after create: cache them from the list call so
+        # node_tags doesn't add an N+1 GET per node per autoscaler tick.
+        for n in nodes:
+            self._tags_cache[n["name"].rsplit("/", 1)[-1]] = dict(n.get("labels", {}))
+        return nodes
 
     def non_terminated_nodes(self) -> list[str]:
         return [
@@ -242,8 +258,20 @@ class TPUPodProvider(NodeProvider):
         ]
 
     def node_tags(self, node_id: str) -> dict:
-        n = self._request("GET", f"/nodes/{node_id}")
-        return dict(n.get("labels", {}))
+        import urllib.error
+
+        cached = self._tags_cache.get(node_id)
+        if cached is not None:
+            return dict(cached)
+        try:
+            n = self._request("GET", f"/nodes/{node_id}")
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return {}  # deleted out-of-band mid-tick; don't abort the tick
+            raise
+        tags = dict(n.get("labels", {}))
+        self._tags_cache[node_id] = tags
+        return tags
 
     def create_node(self, node_config: dict, tags: dict, count: int) -> list[str]:
         import uuid
@@ -260,11 +288,18 @@ class TPUPodProvider(NodeProvider):
             node_id = f"{self.cluster_name}-{node_type}-{uuid.uuid4().hex[:8]}"
             labels = {k.replace(":", "_"): v for k, v in tags.items()}
             labels["ray-cluster-name"] = self.cluster_name
+            labels["provider_node_id"] = node_id  # autoscaler matches on this
             body = {
                 "acceleratorType": conf.get("accelerator_type", "v5e-8"),
                 "runtimeVersion": conf.get("runtime_version", "tpu-ubuntu2204-base"),
                 "labels": labels,
             }
+            if self.gcs_address_for_workers:
+                body["metadata"] = {
+                    "startup-script": self.startup_script_template.format(
+                        node_id=node_id, gcs_address=self.gcs_address_for_workers
+                    )
+                }
             if conf.get("network_config"):
                 body["networkConfig"] = conf["network_config"]
             ops.append(self._request("POST", f"/nodes?nodeId={node_id}", body))
