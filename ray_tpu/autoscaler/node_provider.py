@@ -245,9 +245,12 @@ class TPUPodProvider(NodeProvider):
             if n.get("labels", {}).get("ray-cluster-name") == self.cluster_name
         ]
         # Labels are immutable after create: cache them from the list call so
-        # node_tags doesn't add an N+1 GET per node per autoscaler tick.
-        for n in nodes:
-            self._tags_cache[n["name"].rsplit("/", 1)[-1]] = dict(n.get("labels", {}))
+        # node_tags doesn't add an N+1 GET per node per autoscaler tick. The
+        # cache is REPLACED wholesale — deleted nodes drop out instead of
+        # accumulating (and serving stale tags) forever.
+        self._tags_cache = {
+            n["name"].rsplit("/", 1)[-1]: dict(n.get("labels", {})) for n in nodes
+        }
         return nodes
 
     def non_terminated_nodes(self) -> list[str]:
@@ -295,11 +298,14 @@ class TPUPodProvider(NodeProvider):
                 "labels": labels,
             }
             if self.gcs_address_for_workers:
-                body["metadata"] = {
-                    "startup-script": self.startup_script_template.format(
-                        node_id=node_id, gcs_address=self.gcs_address_for_workers
-                    )
-                }
+                # Literal replacement, not str.format: shell scripts are full
+                # of braces (${VAR}, $(...){...}) that .format would choke on.
+                script = (
+                    self.startup_script_template
+                    .replace("{node_id}", node_id)
+                    .replace("{gcs_address}", self.gcs_address_for_workers)
+                )
+                body["metadata"] = {"startup-script": script}
             if conf.get("network_config"):
                 body["networkConfig"] = conf["network_config"]
             ops.append(self._request("POST", f"/nodes?nodeId={node_id}", body))
@@ -311,6 +317,7 @@ class TPUPodProvider(NodeProvider):
     def terminate_node(self, node_id: str):
         import urllib.error
 
+        self._tags_cache.pop(node_id, None)
         try:
             op = self._request("DELETE", f"/nodes/{node_id}")
         except urllib.error.HTTPError as e:

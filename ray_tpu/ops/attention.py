@@ -260,9 +260,14 @@ def flash_attention(
 def _fit_block(want: int, t: int) -> int:
     """Largest 128-multiple <= want that divides t (so a sequence divisible
     by 128 but not by the preferred block still rides the kernel at a
-    smaller block instead of falling back to full-materialization XLA).
-    Returns min(want, t) when t itself is shorter than one block."""
+    smaller block). For t <= 128 the block is t itself (block == full dim is
+    Mosaic-legal); for larger non-128-multiple t the result is 128, and the
+    caller's divisibility guard then routes to the XLA fallback — a 136-wide
+    block would violate the (8, 128) tile constraint."""
+    if t <= 128:
+        return min(want, t)
     b = min(want, t)
+    b -= b % 128
     while b > 128 and t % b:
         b -= 128
-    return b
+    return max(b, 128)

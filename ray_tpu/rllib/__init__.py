@@ -21,3 +21,5 @@ from ray_tpu.rllib.algorithms.sac import SAC, SACConfig  # noqa: F401
 from ray_tpu.rllib.policy.sample_batch import MultiAgentBatch, SampleBatch  # noqa: F401
 from ray_tpu.rllib.algorithms.apex_dqn import ApexDQN, ApexDQNConfig  # noqa: F401,E402
 from ray_tpu.rllib.algorithms.qmix import QMIX, QMIXConfig  # noqa: F401,E402
+from ray_tpu.rllib.algorithms.pg import PG, PGConfig  # noqa: F401,E402
+from ray_tpu.rllib.algorithms.dt import DT, DTConfig  # noqa: F401,E402

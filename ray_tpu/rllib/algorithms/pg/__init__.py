@@ -1,0 +1,1 @@
+from ray_tpu.rllib.algorithms.pg.pg import PG, PGConfig  # noqa: F401
