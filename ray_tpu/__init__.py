@@ -17,7 +17,7 @@ import threading
 
 from ray_tpu import exceptions  # noqa: F401
 from ray_tpu.actor import ActorClass, ActorHandle, method  # noqa: F401
-from ray_tpu.object_ref import ObjectRef  # noqa: F401
+from ray_tpu.object_ref import ObjectRef, ObjectRefGenerator  # noqa: F401
 from ray_tpu.remote_function import RemoteFunction
 
 __version__ = "0.1.0"

@@ -143,6 +143,10 @@ class CoreWorker:
         self._submit_lock = threading.Lock()
         self._submit_buf: list = []
         self._submit_flush_scheduled = False
+        # Streaming generator returns (reference: StreamingObjectRefGenerator,
+        # _raylet.pyx:227): task_id -> {"items": {index: oid}, "count": int|None,
+        # "error": bytes|None, "cond": threading.Condition}
+        self._streams: dict[str, dict] = {}
         self.in_process_store: dict[str, dict] = {}  # oid -> {data | value}
         self.owned: dict[str, OwnedObject] = {}
         self._object_events: dict[str, asyncio.Event] = {}
@@ -358,9 +362,27 @@ class CoreWorker:
             runtime_env=self._merged_runtime_env(opts.get("runtime_env")),
             trace_ctx=self._trace_ctx(),
         )
+        if spec.is_streaming():
+            with self._lock:
+                # Bound the registry like lineage: prune oldest COMPLETED
+                # streams (never-consumed generators would otherwise leak
+                # their state forever in a long-lived driver).
+                if len(self._streams) > 1000:
+                    for tid in [
+                        t for t, s in self._streams.items() if s["count"] is not None
+                    ][: len(self._streams) - 1000]:
+                        self._streams.pop(tid, None)
+                self._streams[spec.task_id] = {
+                    "items": {}, "count": None, "error": None,
+                    "cond": threading.Condition(),
+                }
         self._register_pending(spec, arg_refs)
         self.record_task_event(spec, "PENDING_ARGS_AVAIL")
         self._submit_when_ready(spec, arg_refs)
+        if spec.is_streaming():
+            from ray_tpu.object_ref import ObjectRefGenerator
+
+            return ObjectRefGenerator(self, spec.task_id)
         return [
             ObjectRef(ObjectID.for_return(task_id, i), self.address)
             for i in range(num_returns)
@@ -933,6 +955,11 @@ class CoreWorker:
     def submit_actor_task(self, actor_id: str, method_name: str, args, kwargs, num_returns=1, max_task_retries=0):
         from ray_tpu.object_ref import ObjectRef
 
+        if not isinstance(num_returns, int):
+            raise ValueError(
+                "num_returns='streaming' is not supported for actor tasks yet; "
+                "use a normal @ray_tpu.remote task"
+            )
         task_id = self._next_task_id()
         wire_args, arg_refs = self._prepare_args(args, kwargs or {})
         self._actor_seq[actor_id] += 1
@@ -1000,8 +1027,13 @@ class CoreWorker:
             return
         ser = serialization.serialize(error).to_bytes()
         with self._lock:
+            stream = self._streams.get(task_id)
             for oid in pending.spec.return_object_ids():
                 self.in_process_store[oid] = {"data": ser, "value": error}
+        if stream is not None:
+            with stream["cond"]:
+                stream["error"] = ser
+                stream["cond"].notify_all()
         for oid in pending.spec.return_object_ids():
             self._set_event(oid)
         if pending.spec.actor_id:
@@ -1016,6 +1048,72 @@ class CoreWorker:
         self._handle_task_done(req["task_id"], req)
         return {"ok": True}
 
+    async def rpc_stream_item(self, req):
+        self._record_stream_item(req["task_id"], req["index"], req["result"])
+        return {"ok": True}
+
+    def _record_stream_item(self, task_id: str, index: int, result: list):
+        oid, kind, data = result[0], result[1], result[2]
+        contained = result[3] if len(result) > 3 else []
+        with self._lock:
+            obj = self.owned.setdefault(oid, OwnedObject())
+            if contained:
+                obj.contained = contained
+            if kind == "inline":
+                self.in_process_store[oid] = {"data": data}
+            else:
+                obj.in_plasma = True
+                obj.location_hint = data
+            stream = self._streams.get(task_id)
+        self._set_event(oid)
+        if stream is not None:
+            # Index-keyed (not append): item delivery is pipelined, so
+            # robustness can't depend on arrival order.
+            with stream["cond"]:
+                stream["items"][index] = oid
+                stream["cond"].notify_all()
+
+    def _reset_stream_for_retry(self, task_id: str):
+        """A retried streaming task re-yields from index 0: clear delivered
+        items so the re-execution's (same-oid) items replace them instead of
+        duplicating, and the consumer just blocks until re-production
+        catches up with its position."""
+        with self._lock:
+            stream = self._streams.get(task_id)
+        if stream is not None:
+            with stream["cond"]:
+                stream["items"].clear()
+                stream["error"] = None
+                stream["count"] = None
+
+    def stream_next(self, task_id: str, index: int, timeout: float | None = None):
+        """Block until stream item `index` exists; returns its oid hex.
+        Raises StopIteration past the end and re-raises task errors."""
+        from ray_tpu.exceptions import GetTimeoutError
+
+        with self._lock:
+            stream = self._streams.get(task_id)
+        if stream is None:
+            raise StopIteration
+        deadline = time.monotonic() + timeout if timeout is not None else None
+        with stream["cond"]:
+            while True:
+                if index in stream["items"]:
+                    return stream["items"][index]
+                if stream["error"] is not None:
+                    err = serialization.loads(stream["error"])
+                    with self._lock:
+                        self._streams.pop(task_id, None)  # single consumption
+                    raise err
+                if stream["count"] is not None and index >= stream["count"]:
+                    with self._lock:
+                        self._streams.pop(task_id, None)  # exhausted: free state
+                    raise StopIteration
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise GetTimeoutError(f"stream item {index} of {task_id[:8]} timed out")
+                stream["cond"].wait(timeout=min(remaining, 1.0) if remaining else 1.0)
+
     def _handle_task_done(self, task_id: str, payload: dict):
         with self._lock:
             pending = self.pending_tasks.get(task_id)
@@ -1024,11 +1122,21 @@ class CoreWorker:
         error = payload.get("error")
         if error is not None and pending.spec.retry_exceptions and pending.retries_left > 0:
             pending.retries_left -= 1
+            self._reset_stream_for_retry(task_id)
             # May run on the IO loop (rpc handler) — must not block.
             self._io.spawn(self.raylet.acall("submit_task", {"spec": pending.spec.to_wire()}))
             return
         with self._lock:
             self.pending_tasks.pop(task_id, None)
+            stream = self._streams.get(task_id)
+        if stream is not None:
+            with stream["cond"]:
+                if error is not None:
+                    stream["error"] = bytes(error)
+                else:
+                    stream["count"] = payload.get("stream_count", len(stream["items"]))
+                stream["cond"].notify_all()
+        with self._lock:
             for result in payload.get("results", []):
                 oid, kind, data = result[0], result[1], result[2]
                 contained = result[3] if len(result) > 3 else []
@@ -1068,6 +1176,7 @@ class CoreWorker:
                 req.get("message", ""),
                 pending.retries_left,
             )
+            self._reset_stream_for_retry(pending.spec.task_id)
             await self.raylet.acall("submit_task", {"spec": pending.spec.to_wire()})
         else:
             message = req.get("message", "worker crashed")
@@ -1226,22 +1335,24 @@ class CoreWorker:
                 args.append(value)
         return args, kwargs
 
+    def _package_one(self, spec: TaskSpec, value, index: int) -> list:
+        """Package a single indexed return (shared by fixed and streaming)."""
+        from ray_tpu._private.ids import ObjectID, TaskID
+
+        oid = ObjectID.for_return(TaskID.from_hex(spec.task_id), index).hex()
+        ser = serialization.serialize(value)
+        contained = self._incref_contained(ser.contained_refs)
+        if ser.total_size > self.cfg.max_direct_call_object_size:
+            self.store.put_serialized(oid, ser)
+            return [oid, "plasma", self.node_id, contained]
+        return [oid, "inline", ser.to_bytes(), contained]
+
     def _package_results(self, spec: TaskSpec, values: list) -> list:
         """Serialize return values; small inline, large to plasma. Refs
         nested in a result are incref'd here on the result's behalf and
         shipped so the caller (the result's owner) holds them until the
         result itself is freed (reference: nested-ref borrow handoff)."""
-        results = []
-        for i, value in enumerate(values):
-            oid = spec.return_object_ids()[i]
-            ser = serialization.serialize(value)
-            contained = self._incref_contained(ser.contained_refs)
-            if ser.total_size > self.cfg.max_direct_call_object_size:
-                self.store.put_serialized(oid, ser)
-                results.append([oid, "plasma", self.node_id, contained])
-            else:
-                results.append([oid, "inline", ser.to_bytes(), contained])
-        return results
+        return [self._package_one(spec, value, i) for i, value in enumerate(values)]
 
     def execute_task(self, spec: TaskSpec) -> dict:
         """Run one task; returns the task_done payload."""
@@ -1272,7 +1383,33 @@ class CoreWorker:
                 out = fn(*args, **kwargs)
                 if asyncio.iscoroutine(out):
                     out = self._run_actor_coroutine(out)
-                if spec.num_returns == 0:
+                if spec.is_streaming():
+                    import inspect
+
+                    if not inspect.isgenerator(out) and not hasattr(out, "__iter__"):
+                        raise TypeError(
+                            f"num_returns='streaming' task {spec.name} must "
+                            f"return a generator/iterable, got {type(out).__name__}"
+                        )
+                    # Each yielded value ships to the owner AS PRODUCED — the
+                    # caller iterates while this task is still running
+                    # (reference: StreamingObjectRefGenerator). Sends are
+                    # pipelined (fire-and-forget on the IO loop) so producer
+                    # throughput isn't one item per network round trip; the
+                    # final task_done travels the same client/connection, so
+                    # it serializes after every item write.
+                    owner = self._owner_client(tuple(spec.owner_addr))
+                    n = 0
+                    for value in out:
+                        item = self._package_one(spec, value, n)
+                        self._io.spawn(owner.acall(
+                            "stream_item",
+                            {"task_id": spec.task_id, "index": n, "result": item},
+                        ))
+                        n += 1
+                    values = []
+                    stream_count = n
+                elif spec.num_returns == 0:
                     values = []
                 elif spec.num_returns == 1:
                     values = [out]
@@ -1285,6 +1422,8 @@ class CoreWorker:
                         )
             results = self._package_results(spec, values)
             payload = {"task_id": spec.task_id, "results": results, "error": None}
+            if spec.is_streaming() and not spec.is_actor_creation():
+                payload["stream_count"] = stream_count
             self.record_task_event(spec, "FINISHED", start_ts=start, end_ts=time.time())
         except BaseException as e:  # noqa: BLE001 — errors ship to the caller
             logger.debug("task %s raised", spec.name, exc_info=True)

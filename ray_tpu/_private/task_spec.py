@@ -66,8 +66,13 @@ class TaskSpec:
     def return_object_ids(self) -> list[str]:
         from ray_tpu._private.ids import ObjectID, TaskID
 
+        if not isinstance(self.num_returns, int):
+            return []  # streaming: return ids are dynamic (yielded one by one)
         tid = TaskID.from_hex(self.task_id)
         return [ObjectID.for_return(tid, i).hex() for i in range(self.num_returns)]
+
+    def is_streaming(self) -> bool:
+        return self.num_returns == "streaming"
 
     def is_actor_task(self) -> bool:
         return self.task_type == ACTOR_TASK

@@ -72,3 +72,34 @@ class ObjectRef:
 
 def _deserialize_ref(binary: bytes, owner_addr):
     return ObjectRef(ObjectID(binary), owner_addr)
+
+
+class ObjectRefGenerator:
+    """Iterator over a streaming task's dynamically-yielded returns
+    (reference: StreamingObjectRefGenerator, _raylet.pyx:227). Yields
+    ObjectRefs AS the running task produces them — iteration overlaps with
+    the producer; ray_tpu.get each ref (or next_ready()) for the values."""
+
+    def __init__(self, core_worker, task_id: str):
+        self._cw = core_worker
+        self._task_id = task_id
+        self._index = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        oid_hex = self._cw.stream_next(self._task_id, self._index)
+        self._index += 1
+        return ObjectRef(ObjectID.from_hex(oid_hex), self._cw.address)
+
+    def next_with_timeout(self, timeout: float):
+        """Like next() but raises GetTimeoutError instead of blocking
+        indefinitely when the producer stalls."""
+        oid_hex = self._cw.stream_next(self._task_id, self._index, timeout=timeout)
+        self._index += 1
+        return ObjectRef(ObjectID.from_hex(oid_hex), self._cw.address)
+
+    @property
+    def task_id(self) -> str:
+        return self._task_id
