@@ -368,12 +368,15 @@ class CoreWorker:
                 # streams (never-consumed generators would otherwise leak
                 # their state forever in a long-lived driver).
                 if len(self._streams) > 1000:
+                    now = time.monotonic()
                     for tid in [
-                        t for t, s in self._streams.items() if s["count"] is not None
+                        t for t, s in self._streams.items()
+                        if s["count"] is not None and now - s["created"] > 600.0
                     ][: len(self._streams) - 1000]:
-                        self._streams.pop(tid, None)
+                        self._drop_stream_locked(tid)
                 self._streams[spec.task_id] = {
                     "items": {}, "count": None, "error": None,
+                    "created": time.monotonic(),
                     "cond": threading.Condition(),
                 }
         self._register_pending(spec, arg_refs)
@@ -1073,6 +1076,18 @@ class CoreWorker:
                 stream["items"][index] = oid
                 stream["cond"].notify_all()
 
+    def _drop_stream_locked(self, task_id: str):
+        """Remove stream state and free its never-wrapped items (oids the
+        consumer never turned into ObjectRefs sit at ref_count 0 and would
+        otherwise leak in the owner forever). Caller holds self._lock."""
+        stream = self._streams.pop(task_id, None)
+        if stream is None:
+            return
+        for oid in stream["items"].values():
+            obj = self.owned.get(oid)
+            if obj is not None and obj.ref_count == 0 and obj.pinned == 0:
+                self._maybe_free_locked(oid, obj)
+
     def _reset_stream_for_retry(self, task_id: str):
         """A retried streaming task re-yields from index 0: clear delivered
         items so the re-execution's (same-oid) items replace them instead of
@@ -1094,8 +1109,15 @@ class CoreWorker:
         with self._lock:
             stream = self._streams.get(task_id)
         if stream is None:
-            raise StopIteration
+            if index == 0:
+                raise StopIteration  # unknown/never-streamed task
+            # Mid-iteration loss (state evicted or re-iteration of a
+            # consumed stream): an explicit error beats silent truncation.
+            raise ObjectLostError(
+                f"stream state for task {task_id[:8]} is gone (consumed or evicted)"
+            )
         deadline = time.monotonic() + timeout if timeout is not None else None
+        complete_since = None  # when count became known with this item missing
         with stream["cond"]:
             while True:
                 if index in stream["items"]:
@@ -1103,12 +1125,23 @@ class CoreWorker:
                 if stream["error"] is not None:
                     err = serialization.loads(stream["error"])
                     with self._lock:
-                        self._streams.pop(task_id, None)  # single consumption
+                        self._drop_stream_locked(task_id)  # single consumption
                     raise err
-                if stream["count"] is not None and index >= stream["count"]:
-                    with self._lock:
-                        self._streams.pop(task_id, None)  # exhausted: free state
-                    raise StopIteration
+                if stream["count"] is not None:
+                    if index >= stream["count"]:
+                        with self._lock:
+                            self._drop_stream_locked(task_id)  # exhausted
+                        raise StopIteration
+                    # Task finished but this item never arrived (its
+                    # fire-and-forget delivery was lost): bounded wait, then
+                    # a typed error instead of hanging forever.
+                    if complete_since is None:
+                        complete_since = time.monotonic()
+                    elif time.monotonic() - complete_since > 60.0:
+                        raise ObjectLostError(
+                            f"stream item {index} of task {task_id[:8]} was "
+                            "never delivered (producer finished)"
+                        )
                 remaining = None if deadline is None else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
                     raise GetTimeoutError(f"stream item {index} of {task_id[:8]} timed out")
@@ -1400,12 +1433,22 @@ class CoreWorker:
                     # it serializes after every item write.
                     owner = self._owner_client(tuple(spec.owner_addr))
                     n = 0
+
+                    def _log_lost(fut, idx):
+                        exc = fut.exception()
+                        if exc is not None:
+                            logger.warning(
+                                "stream item %d of %s failed to deliver: %r",
+                                idx, spec.task_id[:8], exc,
+                            )
+
                     for value in out:
                         item = self._package_one(spec, value, n)
-                        self._io.spawn(owner.acall(
+                        fut = self._io.spawn(owner.acall(
                             "stream_item",
                             {"task_id": spec.task_id, "index": n, "result": item},
                         ))
+                        fut.add_done_callback(lambda f, i=n: _log_lost(f, i))
                         n += 1
                     values = []
                     stream_count = n

@@ -24,6 +24,7 @@ from ray_tpu.serve.api import (  # noqa: F401
 from ray_tpu.serve.batching import batch  # noqa: F401
 from ray_tpu.serve.handle import DeploymentHandle  # noqa: F401
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed  # noqa: F401
+from ray_tpu.serve.api import StreamingResponse  # noqa: F401
 
 __all__ = [
     "Application",
@@ -42,4 +43,5 @@ __all__ = [
     "shutdown",
     "start",
     "status",
+    "StreamingResponse",
 ]

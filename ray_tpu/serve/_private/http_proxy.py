@@ -81,15 +81,49 @@ class HTTPProxy:
                     ref = actor.handle_http_request.remote(
                         method, path, query, body, headers, model_id
                     )
-                    return ray_tpu.get(ref, timeout=120)
-                finally:
+                    result = ray_tpu.get(ref, timeout=120)
+                except BaseException:
                     self._router.release(replica)
+                    raise
+                if isinstance(result, dict) and "__serve_stream__" in result:
+                    # Streaming: the replica stays assigned (queue metrics +
+                    # its generator lives there) until the pump finishes.
+                    return replica, result
+                self._router.release(replica)
+                return None, result
 
             try:
-                result = await loop.run_in_executor(self._pool, call)
+                replica, result = await loop.run_in_executor(self._pool, call)
             except Exception as e:
                 logger.exception("request to %s failed", deployment)
                 return web.Response(status=500, text=f"{type(e).__name__}: {e}")
+            if replica is not None:
+                sid = result["__serve_stream__"]
+                resp = web.StreamResponse(
+                    headers={"Content-Type": result.get("content_type", "application/octet-stream")}
+                )
+                await resp.prepare(request)
+                actor = self._router.handle_for(replica)
+                try:
+                    while True:
+                        batch = await loop.run_in_executor(
+                            self._pool,
+                            lambda: ray_tpu.get(
+                                actor.next_stream_chunk.remote(sid), timeout=120
+                            ),
+                        )
+                        if batch is None:
+                            break
+                        for chunk in batch["chunks"]:
+                            await resp.write(chunk)
+                        if batch["done"]:
+                            break
+                except Exception:
+                    logger.exception("stream from %s aborted", deployment)
+                finally:
+                    self._router.release(replica)
+                await resp.write_eof()
+                return resp
             if isinstance(result, bytes):
                 return web.Response(body=result)
             if isinstance(result, str):

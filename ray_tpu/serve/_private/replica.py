@@ -44,6 +44,8 @@ class Replica:
         self._ongoing = 0
         self._total = 0
         self._lock = threading.Lock()
+        self._streams: dict = {}
+        self._stream_counter = 0
         if user_config is not None:
             self.reconfigure(user_config)
 
@@ -87,9 +89,78 @@ class Replica:
         proxy passes the multiplexed model id it already extracted for
         routing — one extraction, no divergence."""
         request = HTTPRequest(method=method, path=path, query=query, body=body, headers=headers)
-        return self.handle_request(
+        result = self.handle_request(
             "__call__", (request,), {}, multiplexed_model_id=multiplexed_model_id
         )
+        import inspect
+
+        from ray_tpu.serve.api import StreamingResponse
+
+        if isinstance(result, StreamingResponse) or inspect.isgenerator(result):
+            # Chunked/SSE responses (reference: serve streaming responses):
+            # the generator stays alive here; the proxy pumps it via
+            # next_stream_chunk and writes chunks to the socket as produced.
+            if isinstance(result, StreamingResponse):
+                gen, ctype = iter(result.iterator), result.content_type
+            else:
+                gen, ctype = result, "application/octet-stream"
+            with self._lock:
+                self._reap_idle_streams_locked()
+                self._stream_counter += 1
+                sid = str(self._stream_counter)
+                self._streams[sid] = {
+                    "gen": gen,
+                    "model_id": multiplexed_model_id,
+                    "last_pump": time.time(),
+                }
+            return {"__serve_stream__": sid, "content_type": ctype}
+        return result
+
+    def _reap_idle_streams_locked(self):
+        """A client that disconnected mid-stream stops the proxy's pump with
+        no cancel RPC; close + drop generators nobody pumped for 5 minutes
+        so their finalizers run and state doesn't accumulate."""
+        now = time.time()
+        for sid, st in list(self._streams.items()):
+            if now - st["last_pump"] > 300.0:
+                self._streams.pop(sid, None)
+                try:
+                    st["gen"].close()
+                except Exception:
+                    pass
+
+    def next_stream_chunk(self, sid: str):
+        """Pump ONE item from a live response stream — returning on the
+        first produced item keeps time-to-first-byte at one-item latency (a
+        batch pump would buffer a slow producer's output into bursts).
+        Returns {"chunks": [bytes], "done": bool} or None for unknown
+        streams."""
+        from ray_tpu.serve.multiplex import _set_multiplexed_model_id
+
+        with self._lock:
+            st = self._streams.get(sid)
+            if st is not None:
+                st["last_pump"] = time.time()
+        if st is None:
+            return None
+        # The generator body runs HERE, not in handle_request: re-scope the
+        # multiplexed model id so concurrent requests on this replica can't
+        # bleed their id into this stream's continuation.
+        _set_multiplexed_model_id(st["model_id"])
+        chunks = []
+        done = False
+        try:
+            chunks.append(_encode_chunk(next(st["gen"])))
+        except StopIteration:
+            done = True
+        except Exception:
+            with self._lock:
+                self._streams.pop(sid, None)
+            raise
+        if done:
+            with self._lock:
+                self._streams.pop(sid, None)
+        return {"chunks": chunks, "done": done}
 
     def get_metrics(self) -> dict:
         """Queue stats for autoscaling (reference: autoscaling_metrics.py)."""
@@ -131,3 +202,13 @@ class HTTPRequest:
 
     def text(self) -> str:
         return (self.body or b"").decode()
+
+
+def _encode_chunk(item) -> bytes:
+    if isinstance(item, bytes):
+        return item
+    if isinstance(item, str):
+        return item.encode()
+    import json as _json
+
+    return (_json.dumps(item) + "\n").encode()

@@ -294,3 +294,20 @@ def shutdown():
         pass
     Router.reset()
     _started = False
+
+
+class StreamingResponse:
+    """Wrap a generator/iterable to stream the HTTP response body chunk by
+    chunk (reference: serve streaming responses). Yielded bytes/str pass
+    through; other values are JSON-encoded one per line (SSE-style payloads
+    are just str chunks like "data: ...\n\n").
+
+        @serve.deployment
+        class Tokens:
+            def __call__(self, request):
+                return StreamingResponse(self.generate(), content_type="text/plain")
+    """
+
+    def __init__(self, iterator, content_type: str = "application/octet-stream"):
+        self.iterator = iterator
+        self.content_type = content_type

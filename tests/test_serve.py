@@ -250,3 +250,39 @@ def test_multiplexing(serve_instance):
         assert total_loads <= 4
     finally:
         serve.delete("MultiModel")
+
+
+def test_streaming_response(serve_instance):
+    """Generator handlers stream the HTTP body chunk by chunk (reference:
+    serve streaming responses); bytes pass through, other values are
+    JSON-lines."""
+    import urllib.request
+
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Streamer:
+        def __call__(self, request):
+            def gen():
+                for i in range(5):
+                    yield f"tok{i} "
+
+            return serve.StreamingResponse(gen(), content_type="text/plain")
+
+    serve.run(Streamer.bind(), name="streamer", route_prefix="/stream")
+    url = "http://%s:%d/stream" % serve.http_address()
+    with urllib.request.urlopen(url, timeout=60) as resp:
+        assert resp.headers.get("Content-Type", "").startswith("text/plain")
+        body = resp.read().decode()
+    assert body == "tok0 tok1 tok2 tok3 tok4 "
+
+    @serve.deployment
+    class BareGen:
+        def __call__(self, request):
+            yield {"n": 1}
+            yield {"n": 2}
+
+    serve.run(BareGen.bind(), name="baregen", route_prefix="/baregen")
+    with urllib.request.urlopen("http://%s:%d/baregen" % serve.http_address(), timeout=60) as resp:
+        lines = [l for l in resp.read().decode().splitlines() if l]
+    assert [json.loads(l)["n"] for l in lines] == [1, 2]
