@@ -123,3 +123,31 @@ def test_infeasible_pg_pending(ray_start_cluster):
 
     with pytest.raises(PlacementGroupUnavailableError):
         pg.ready(timeout=1.0)
+
+
+def test_infeasible_tasks_dont_block_runnable_ones(ray_start_cluster):
+    """Tasks whose resources don't exist yet park in the infeasible queue
+    (reference keeps one too) — a block of them ahead of runnable CPU tasks
+    must not delay the runnable ones."""
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    cluster.connect()
+    cluster.wait_for_nodes()
+
+    @ray_tpu.remote(resources={"phantom_accel": 1})
+    def needs_phantom():
+        return "never"
+
+    @ray_tpu.remote
+    def runnable(x):
+        return x * 2
+
+    blocked = [needs_phantom.remote() for _ in range(50)]
+    t0 = time.monotonic()
+    out = ray_tpu.get([runnable.remote(i) for i in range(8)], timeout=30)
+    elapsed = time.monotonic() - t0
+    assert out == [i * 2 for i in range(8)]
+    assert elapsed < 30, f"runnable tasks starved behind infeasible ones ({elapsed:.1f}s)"
+    # The infeasible tasks are still pending (not failed, not run).
+    ready, _ = ray_tpu.wait(blocked, num_returns=1, timeout=0.5)
+    assert not ready
