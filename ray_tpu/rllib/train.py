@@ -13,13 +13,23 @@ import json
 import sys
 
 
+# Names that don't round-trip through .upper() (hyphens normalize to _).
+_ALGO_ALIASES = {"APEXDQN": "ApexDQN", "APEX_DQN": "ApexDQN"}
+
+
 def _algo_class(name: str):
     import ray_tpu.rllib as rllib
 
-    cls = getattr(rllib, name.upper(), None) or getattr(rllib, name, None)
+    canonical = _ALGO_ALIASES.get(name.upper().replace("-", "_"), None)
+    cls = (
+        (getattr(rllib, canonical, None) if canonical else None)
+        or getattr(rllib, name.upper(), None)
+        or getattr(rllib, name, None)
+    )
     if cls is None:
         raise SystemExit(f"unknown algorithm {name!r}; available: "
-                         "PPO, APPO, IMPALA, A2C, DQN, SAC, DDPG, TD3, ES, BC, MARWIL, CQL")
+                         "PPO, APPO, IMPALA, A2C, DQN, ApexDQN, SAC, DDPG, TD3, "
+                         "ES, PG, BC, MARWIL, CQL, QMIX, DT")
     return cls
 
 

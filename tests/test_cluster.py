@@ -143,11 +143,10 @@ def test_infeasible_tasks_dont_block_runnable_ones(ray_start_cluster):
         return x * 2
 
     blocked = [needs_phantom.remote() for _ in range(50)]
-    t0 = time.monotonic()
+    # Starvation shows up as this get timing out (the queue scan would only
+    # revisit the runnable tasks on slow heartbeat-paced rotation).
     out = ray_tpu.get([runnable.remote(i) for i in range(8)], timeout=30)
-    elapsed = time.monotonic() - t0
     assert out == [i * 2 for i in range(8)]
-    assert elapsed < 30, f"runnable tasks starved behind infeasible ones ({elapsed:.1f}s)"
     # The infeasible tasks are still pending (not failed, not run).
     ready, _ = ray_tpu.wait(blocked, num_returns=1, timeout=0.5)
     assert not ready
