@@ -85,11 +85,18 @@ def queued_tasks_stress(results, n_tasks):
     submit_s = time.perf_counter() - t0
     results["queued_tasks"] = n_tasks
     results["queued_submit_per_s"] = round(n_tasks / submit_s, 1)
-    # Liveness under a deep queue: the LAST submitted task still completes
-    # (FIFO drain would take ages; we get() one early ref instead).
+    # refs[0] has usually already finished by the end of submission — its
+    # latency measures result availability, not liveness.
     t0 = time.perf_counter()
     assert ray_tpu.get(refs[0], timeout=120) == 1
     results["queued_first_result_s"] = round(time.perf_counter() - t0, 3)
+    # Liveness under depth: the node must still be scheduling with the queue
+    # ~full, proven by draining through the 1000th submitted task (full-queue
+    # FIFO drain would take ages; a mid-queue probe shows forward progress).
+    probe = min(n_tasks, 1000) - 1
+    t0 = time.perf_counter()
+    assert ray_tpu.get(refs[probe], timeout=600) == 1
+    results["queued_probe_result_s"] = round(time.perf_counter() - t0, 3)
     ray_tpu.shutdown()
 
 
@@ -148,6 +155,62 @@ def broadcast_stress(results, mib, n_nodes):
         cluster.shutdown()
 
 
+def many_args_stress(results, n_args):
+    """Reference envelope: 10,000+ object args to a single task
+    (release/benchmarks/single_node/test_single_node.py test_many_args)."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2, object_store_memory=512 * 1024 * 1024)
+
+    @ray_tpu.remote
+    def consume(*args):
+        return len(args)
+
+    refs = [ray_tpu.put(i) for i in range(n_args)]
+    t0 = time.perf_counter()
+    assert ray_tpu.get(consume.remote(*refs), timeout=600) == n_args
+    results["many_args"] = n_args
+    results["many_args_s"] = round(time.perf_counter() - t0, 3)
+    ray_tpu.shutdown()
+
+
+def many_returns_stress(results, n_returns):
+    """Reference envelope: 3,000+ returns from a single task
+    (test_single_node.py test_many_returns)."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2, object_store_memory=512 * 1024 * 1024)
+
+    @ray_tpu.remote
+    def produce(n):
+        return list(range(n))
+
+    t0 = time.perf_counter()
+    refs = produce.options(num_returns=n_returns).remote(n_returns)
+    values = ray_tpu.get(refs, timeout=600)
+    assert values == list(range(n_returns))
+    results["many_returns"] = n_returns
+    results["many_returns_s"] = round(time.perf_counter() - t0, 3)
+    ray_tpu.shutdown()
+
+
+def get_many_objects_stress(results, n_objects):
+    """Reference envelope: ray.get on 10,000+ store objects in one call
+    (test_single_node.py test_ray_get_args)."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2, object_store_memory=512 * 1024 * 1024)
+    refs = [ray_tpu.put(i) for i in range(n_objects)]
+    t0 = time.perf_counter()
+    values = ray_tpu.get(refs, timeout=600)
+    dt = time.perf_counter() - t0
+    assert values == list(range(n_objects))
+    results["get_many_objects"] = n_objects
+    results["get_many_objects_s"] = round(dt, 3)
+    results["get_many_objects_per_s"] = round(n_objects / dt, 1)
+    ray_tpu.shutdown()
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--round", type=int, default=int(os.environ.get("GRAFT_ROUND", "2")))
@@ -155,17 +218,26 @@ def main():
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
+    # Reference envelope shapes (release/benchmarks/README.md:21-31), scaled
+    # to this host in --quick mode: 1M queued / 10k args / 3k returns /
+    # 10k-object get / 32 simulated nodes.
     duration = 1.0 if args.quick else 3.0
-    n_tasks = 10_000 if args.quick else 100_000
+    n_tasks = 10_000 if args.quick else 1_000_000
     n_actors = 8 if args.quick else 64
     mib = 16 if args.quick else 100
-    n_nodes = 4 if args.quick else 8
+    n_nodes = 4 if args.quick else 32
+    n_args = 1_000 if args.quick else 10_000
+    n_returns = 300 if args.quick else 3_000
+    n_get = 1_000 if args.quick else 10_000
 
     results: dict = {"host_cpus": os.cpu_count()}
     for name, fn in [
         ("basic", lambda: basic_suite(results, duration)),
         ("queued", lambda: queued_tasks_stress(results, n_tasks)),
         ("actors", lambda: actor_swarm_stress(results, n_actors)),
+        ("many_args", lambda: many_args_stress(results, n_args)),
+        ("many_returns", lambda: many_returns_stress(results, n_returns)),
+        ("get_many", lambda: get_many_objects_stress(results, n_get)),
         ("broadcast", lambda: broadcast_stress(results, mib, n_nodes)),
     ]:
         t0 = time.perf_counter()

@@ -52,11 +52,22 @@ class Config:
 
     # --- scheduling / raylet ---
     worker_lease_timeout_s: float = 30.0
+    # Direct task transport (lease_manager.py): owners lease workers and ship
+    # normal tasks straight to them, bypassing per-task raylet round trips
+    # (reference: direct_task_transport.cc lease pipelining).
+    direct_task_leases: bool = True
+    lease_max_inflight: int = 32   # specs in flight per leased worker
+    lease_max_per_shape: int = 8   # concurrent leases per (env, resources)
+    lease_idle_release_s: float = 0.5  # linger before returning an idle lease
     worker_idle_timeout_s: float = 300.0  # idle workers kept warm for reuse
     max_workers_per_node: int = 64
     worker_startup_timeout_s: float = 60.0
     scheduler_spread_threshold: float = 0.5  # hybrid policy pack->spread knob
     prestart_workers: int = 0
+    # Fork-server worker spawn (zygote.py): turns per-worker interpreter boot
+    # (~200ms of CPU) into a few-ms fork. Auto-disabled on nodes holding a
+    # TPU resource (forking after a TPU-plugin dial is unsafe).
+    worker_zygote_enabled: bool = True
 
     # --- health / failure detection ---
     heartbeat_interval_s: float = 0.5

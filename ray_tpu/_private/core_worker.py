@@ -109,11 +109,25 @@ class CoreWorker:
         # every task/actor-level env at submit time (reference: job_config).
         self.job_runtime_env = dict(job_runtime_env or {})
         self.worker_id = worker_id or WorkerID.from_random().hex()
+        _bt = os.environ.get("RAY_TPU_BOOT_TRACE")
+        _t0 = time.monotonic()
+
+        def _mark(label):
+            if _bt:
+                import sys as _sys
+
+                print(
+                    f"[cw-trace {os.getpid()}] {label} +{(time.monotonic() - _t0) * 1e3:.1f}ms",
+                    file=_sys.stderr, flush=True,
+                )
+
         self._io = EventLoopThread.get()
+        _mark("io-loop")
 
         self.gcs = RpcClient(tuple(gcs_address), label="gcs")
         self.raylet = RpcClient(tuple(raylet_address), label="raylet")
         self.store = StoreClient(arena_name, self.raylet)
+        _mark("store-attach")
 
         if job_id is None:
             job_hex = self.gcs.call("next_job_id")["job_id"]
@@ -135,8 +149,10 @@ class CoreWorker:
         # Own RPC server (the "core worker service").
         self.server = RpcServer(f"core-{self.worker_id[:8]}")
         self.server.register_all(self)
+        _mark("register_all")
         self.server.start("127.0.0.1", 0)
         self.address = self.server.address
+        _mark("server-start")
 
         # Object bookkeeping (all guarded by _lock; events live on the IO loop).
         self._lock = threading.Lock()
@@ -161,6 +177,10 @@ class CoreWorker:
         import weakref
 
         self._fn_key_by_obj: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+        # Direct task transport (lease_manager.py), created on first
+        # eligible submit.
+        self._lease_mgr = None
 
         # Actor-call transport state.
         self._actor_clients: dict[str, RpcClient] = {}
@@ -469,14 +489,41 @@ class CoreWorker:
                     else:
                         while not await self._arg_available_async(ref):
                             await asyncio.sleep(0.02)
-                await self.raylet.acall("submit_task", {"spec": spec.to_wire()})
+                self._enqueue_submit(spec)
             except Exception as e:
                 logger.exception("deferred submit of %s failed", spec.task_id[:8])
                 self._fail_task(spec.task_id, WorkerCrashedError(f"submit failed: {e!r}"))
 
         self._io.spawn(_wait_and_submit())
 
+    def _lease_eligible(self, spec: TaskSpec) -> bool:
+        """Normal tasks with default placement ride the direct lease
+        transport (lease_manager.py); everything placement-sensitive (PGs,
+        node affinity, SPREAD) and streaming generators keep the classic
+        raylet submit path."""
+        return (
+            self.cfg.direct_task_leases
+            and spec.task_type == NORMAL_TASK
+            and not spec.is_streaming()
+            and (spec.scheduling_strategy or "DEFAULT") == "DEFAULT"
+            and not spec.placement_group_id
+        )
+
+    def _get_lease_manager(self):
+        lm = self._lease_mgr
+        if lm is None:
+            from ray_tpu._private.lease_manager import LeaseManager
+
+            with self._lock:
+                if self._lease_mgr is None:
+                    self._lease_mgr = LeaseManager(self)
+                lm = self._lease_mgr
+        return lm
+
     def _enqueue_submit(self, spec: TaskSpec) -> None:
+        if self._lease_eligible(spec):
+            self._get_lease_manager().submit(spec)
+            return
         with self._submit_lock:
             self._submit_buf.append(spec)
             if self._submit_flush_scheduled:
@@ -1051,6 +1098,27 @@ class CoreWorker:
         self._handle_task_done(req["task_id"], req)
         return {"ok": True}
 
+    async def rpc_tasks_done(self, req):
+        """Batched completions from a leased worker (lease_manager.py)."""
+        lm = self._lease_mgr
+        shapes = set()
+        for payload in req["batch"]:
+            if lm is not None:
+                shapes.add(lm.on_task_done(payload["task_id"], payload.get("duration_s")))
+            self._handle_task_done(payload["task_id"], payload)
+        if lm is not None:
+            lm.topup(shapes)
+        return {"ok": True}
+
+    async def rpc_lease_revoked(self, req):
+        if self._lease_mgr is not None:
+            self._lease_mgr.on_lease_revoked(
+                req["lease_id"],
+                oom=bool(req.get("oom")),
+                reason=req.get("reason") or "revoked by raylet",
+            )
+        return {"ok": True}
+
     async def rpc_stream_item(self, req):
         self._record_stream_item(req["task_id"], req["index"], req["result"])
         return {"ok": True}
@@ -1515,6 +1583,11 @@ class CoreWorker:
 
     def shutdown(self, job_state: str | None = None):
         self._shutdown = True
+        if self._lease_mgr is not None:
+            try:
+                self._lease_mgr.close()
+            except Exception:
+                pass
         try:
             self.flush_task_events()
         except Exception:

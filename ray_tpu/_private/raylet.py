@@ -166,6 +166,12 @@ class Raylet:
         self._last_memory_check = 0.0
         self._tracing_enabled = False
         self._stopped = False
+        # Direct task transport (reference: direct_task_transport.cc): lease
+        # requests awaiting a worker grant, granted leases by id, and
+        # owner-reported backlog per (owner, shape) for autoscaler demand.
+        self._lease_futures: dict[str, asyncio.Future] = {}
+        self._leases: dict[str, dict] = {}
+        self._lease_demand: dict[tuple, tuple] = {}
 
     async def _register(self):
         await self.gcs.acall(
@@ -246,18 +252,28 @@ class Raylet:
         infeasible tasks are the demand that matters most (they're what new
         nodes would satisfy). The scan is EXACT — a head-only sample would
         hide resource shapes concentrated in the queue tail and starve them
-        of autoscaling — but cached: at most one full walk per 5s, and only
-        when the depth changed."""
+        of autoscaling — but cached: at most one full walk per 5s, except
+        that a queue-depth change (e.g. a freshly-parked infeasible shape)
+        invalidates immediately so the autoscaler never acts on stale
+        demand."""
         cached = getattr(self, "_load_cache", None)
         now = time.monotonic()
-        if cached is not None and now - cached[0] < 5.0:
+        depth = (len(self._infeasible), len(self.task_queue))
+        if cached is not None and now - cached[0] < 5.0 and cached[2] == depth:
             return cached[1]
         shapes: dict[tuple, int] = {}
         for spec in list(self._infeasible) + list(self.task_queue):
             key = tuple(sorted(spec.resources.items()))
             shapes[key] = shapes.get(key, 0) + 1
+        # Owner-side lease backlogs (fresh ones only): under the direct task
+        # transport the deep queue lives in the owner, not here.
+        for (owner, key), (count, ts) in list(self._lease_demand.items()):
+            if now - ts > 30.0:
+                self._lease_demand.pop((owner, key), None)
+            elif count > 0:
+                shapes[key] = shapes.get(key, 0) + count
         load = [{"resources": dict(k), "count": c} for k, c in shapes.items()]
-        self._load_cache = (now, load)
+        self._load_cache = (now, load, depth)
         return load
 
     async def _retry_pg_tasks(self):
@@ -287,10 +303,38 @@ class Raylet:
     # ------------------------------------------------------------------
 
     async def rpc_store_create(self, req):
-        offset = await self.store.create(req["object_id"], req["size"])
+        object_id = req["object_id"]
+        entry = self.store.objects.get(object_id)
+        if entry is not None:
+            # Sealed -> idempotent no-op. Unsealed -> an in-flight pull/push
+            # session owns the buffer; the producer must wait for its
+            # seal-or-abort rather than co-write a buffer that can be freed
+            # under it (the session's abort would pop the entry and make the
+            # producer's seal fail).
+            return {"offset": 0, "exists": True, "sealed": entry.sealed}
+        offset = await self.store.create(object_id, req["size"])
         if offset is None:
-            return {"offset": 0, "exists": True}
+            entry = self.store.objects.get(object_id)
+            return {"offset": 0, "exists": True, "sealed": entry is not None and entry.sealed}
         return {"offset": offset, "exists": False}
+
+    @schema(object_id=str)
+    async def rpc_store_wait_seal(self, req):
+        """Block until the object's in-flight entry seals or aborts.
+
+        Used by local producers that lost the create race to a pull/push
+        session: sealed=True means the bytes are in the store; False means
+        the session aborted (or no entry exists) and the producer should
+        retry its create."""
+        entry = self.store.objects.get(req["object_id"])
+        if entry is None:
+            return {"sealed": False}
+        try:
+            await asyncio.wait_for(entry.sealed_event.wait(), req.get("timeout") or 30.0)
+        except asyncio.TimeoutError:
+            return {"sealed": False}
+        cur = self.store.objects.get(req["object_id"])
+        return {"sealed": cur is entry and entry.sealed}
 
     async def rpc_store_seal(self, req):
         self.store.seal(req["object_id"])
@@ -859,6 +903,11 @@ class Raylet:
                     worker.state = "idle"
                     self.task_queue.append(spec)
                     continue
+                if spec.lease_id:
+                    self._grant_lease(worker, spec)
+                    made_progress = True
+                    self._last_progress = time.monotonic()
+                    continue
                 worker.state = "actor" if spec.is_actor_creation() else "busy"
                 worker.current_task = spec
                 worker.dispatch_ts = time.monotonic()
@@ -880,6 +929,126 @@ class Raylet:
             logger.exception("push_task to worker %s failed", worker.worker_id[:8])
             await self._on_worker_death(worker, "push_task failed")
 
+    # ---- worker leases (reference: direct_task_transport.cc:304) ----
+
+    def _grant_lease(self, worker: WorkerHandle, spec: TaskSpec):
+        fut = self._lease_futures.pop(spec.lease_id, None)
+        if fut is None or fut.done():
+            # Requester gave up (cancel or timeout) before we could grant.
+            self._release_for(spec)
+            worker.state = "idle"
+            worker.last_idle = time.monotonic()
+            return
+        worker.state = "busy"
+        worker.current_task = spec
+        worker.dispatch_ts = time.monotonic()
+        worker.last_job_id = spec.job_id
+        worker.last_task_name = "__lease__"
+        self._leases[spec.lease_id] = {
+            "worker_id": worker.worker_id,
+            "spec": spec,
+            "renewed": time.monotonic(),
+        }
+        fut.set_result(
+            {
+                "granted": True,
+                "worker_id": worker.worker_id,
+                "address": list(worker.address),
+                # Spilled grants come from a PEER raylet: renew/return must
+                # target the raylet that actually holds the lease record.
+                "raylet_address": list(self.address),
+            }
+        )
+
+    @schema(spec=dict)
+    async def rpc_request_worker_lease(self, req):
+        spec = TaskSpec.from_wire(req["spec"])
+        if not spec.lease_id:
+            return {"granted": False, "error": "spec.lease_id missing"}
+        # Cluster-level placement for the lease itself (reference: the lease
+        # request is what spills back, cluster_task_manager.cc:44): forward
+        # the whole request — the granted worker address is globally
+        # routable, so the owner talks straight to the remote worker.
+        target = self._pick_node(spec)
+        if target is not None and target != self.node_id:
+            node = self.cluster_view.get(target)
+            if node is not None:
+                try:
+                    return await self._peer(target, node["address"]).acall(
+                        "request_worker_lease",
+                        req,
+                        timeout=self.cfg.worker_lease_timeout_s + 5,
+                    )
+                except Exception:
+                    pass
+        # Owner-side queue depth as autoscaler demand (the owner's shape
+        # queue replaces the raylet task queue under the lease transport).
+        self._lease_demand[(spec.owner_worker_id, tuple(sorted(spec.resources.items())))] = (
+            int(req.get("backlog", 0)),
+            time.monotonic(),
+        )
+        fut = asyncio.get_event_loop().create_future()
+        self._lease_futures[spec.lease_id] = fut
+        self.task_queue.append(spec)
+        await self._dispatch()
+        try:
+            return await asyncio.wait_for(fut, self.cfg.worker_lease_timeout_s)
+        except asyncio.TimeoutError:
+            self._lease_futures.pop(spec.lease_id, None)
+            self._remove_queued_lease(spec.lease_id)
+            return {"granted": False}
+
+    def _remove_queued_lease(self, lease_id: str):
+        """Best-effort: at envelope queue depths (1M+) an O(n) walk per
+        abandoned lease request would stall the loop; the dispatch path
+        already frees workers granted to a vanished requester
+        (_grant_lease's missing-future branch), so deep queues self-heal."""
+        if len(self.task_queue) + len(self._infeasible) > 10_000:
+            return
+        for q in (self.task_queue, self._infeasible):
+            for s in list(q):
+                if s.lease_id == lease_id:
+                    q.remove(s)
+
+    @schema(lease_id=str)
+    async def rpc_cancel_lease_request(self, req):
+        fut = self._lease_futures.pop(req["lease_id"], None)
+        if fut is not None and not fut.done():
+            fut.set_result({"granted": False})
+        self._remove_queued_lease(req["lease_id"])
+        return {"ok": True}
+
+    @schema(lease_id=str)
+    async def rpc_return_worker_lease(self, req):
+        lease = self._leases.pop(req["lease_id"], None)
+        if lease is None:
+            return {"ok": False}
+        worker = self.workers.get(lease["worker_id"])
+        spec = lease["spec"]
+        # A returned lease means the owner's queue for this shape drained.
+        self._lease_demand.pop(
+            (spec.owner_worker_id, tuple(sorted(spec.resources.items()))), None
+        )
+        self._release_for(spec)
+        if worker is not None and worker.state == "busy":
+            worker.state = "idle"
+            worker.current_task = None
+            worker.last_idle = time.monotonic()
+        await self._dispatch()
+        return {"ok": True}
+
+    @schema(lease_ids=list)
+    async def rpc_renew_worker_leases(self, req):
+        now = time.monotonic()
+        revoked = []
+        for lid in req["lease_ids"]:
+            lease = self._leases.get(lid)
+            if lease is None:
+                revoked.append(lid)
+            else:
+                lease["renewed"] = now
+        return {"revoked": revoked}
+
     def _pop_idle_worker(self, runtime_env_hash: str | None = None) -> WorkerHandle | None:
         for w in self.workers.values():
             if w.state == "idle" and w.runtime_env_hash == runtime_env_hash:
@@ -891,33 +1060,87 @@ class Raylet:
 
     # ---- worker pool (reference: worker_pool.cc) ----
 
+    def _worker_env_delta(self, worker_id: str, runtime_env: dict | None) -> dict:
+        """The env vars a worker needs on top of this raylet's environment."""
+        delta = {
+            "RAY_TPU_WORKER_ID": worker_id,
+            "RAY_TPU_NODE_ID": self.node_id,
+            "RAY_TPU_RAYLET_ADDR": json.dumps(list(self.address)),
+            "RAY_TPU_GCS_ADDR": json.dumps(list(self.gcs.address)),
+            "RAY_TPU_ARENA_NAME": self.arena_name,
+            "RAY_TPU_SESSION_DIR": self.session_dir,
+        }
+        if runtime_env:
+            delta["RAY_TPU_RUNTIME_ENV"] = json.dumps(runtime_env)
+        if self._tracing_enabled:
+            delta["RAY_TPU_TRACING"] = "1"
+        # Workers must import the same modules the driver pickles by reference
+        # (cloudpickle serializes importable functions by name); ship the
+        # driver-side sys.path (reference: runtime-env py_modules/working_dir).
+        extra_path = os.pathsep.join(p for p in sys.path if p)
+        base = os.environ.get("PYTHONPATH")
+        delta["PYTHONPATH"] = extra_path + os.pathsep + base if base else extra_path
+        return delta
+
+    def _zygote_client(self):
+        """Lazy fork-server handle (zygote.py). None when disabled or on TPU
+        nodes — forking a process after a TPU-plugin dial is unsafe, and TPU
+        workers are few and long-lived anyway."""
+        if not self.cfg.worker_zygote_enabled or self.resources_total.get("TPU"):
+            return None
+        if getattr(self, "_zygote", None) is None:
+            from ray_tpu._private.zygote import ZygoteClient
+
+            base_env = os.environ.copy()
+            base_env.pop("PALLAS_AXON_POOL_IPS", None)
+            # The zygote imports ray_tpu at startup: it needs the driver's
+            # sys.path just like workers do (the driver may have added the
+            # package root via sys.path.insert, not PYTHONPATH).
+            extra_path = os.pathsep.join(p for p in sys.path if p)
+            base_env["PYTHONPATH"] = (
+                extra_path + os.pathsep + base_env["PYTHONPATH"]
+                if base_env.get("PYTHONPATH")
+                else extra_path
+            )
+            self._zygote = ZygoteClient(
+                self.session_dir, base_env, self._on_zygote_worker_exit
+            )
+        return self._zygote
+
+    def _on_zygote_worker_exit(self, pid: int, returncode: int):
+        from ray_tpu._private.zygote import ZygoteWorkerProc
+
+        for w in self.workers.values():
+            if w.pid == pid and isinstance(w.proc, ZygoteWorkerProc):
+                w.proc.returncode = returncode
+
     def _start_worker(self, runtime_env: dict | None = None):
         worker_id = WorkerID.from_random().hex()
+        delta = self._worker_env_delta(worker_id, runtime_env)
+        log_path = os.path.join(self.session_dir, "logs", f"worker-{worker_id[:8]}")
+        os.makedirs(os.path.dirname(log_path), exist_ok=True)
+        handle = WorkerHandle(
+            worker_id=worker_id,
+            pid=0,
+            runtime_env_hash=_runtime_env_hash(runtime_env),
+        )
+        self.workers[worker_id] = handle
+        zygote = self._zygote_client()
+        if zygote is not None:
+            asyncio.ensure_future(
+                self._spawn_via_zygote(zygote, handle, delta, log_path)
+            )
+        else:
+            self._popen_worker(handle, delta, log_path)
+
+    def _popen_worker(self, handle: WorkerHandle, delta: dict, log_path: str):
         env = os.environ.copy()
         if not self.resources_total.get("TPU"):
             # On a TPU host a sitecustomize hook dials the TPU plugin during
             # interpreter start (~2s); workers on CPU-only nodes never touch
             # the chip, so skip it — worker spawn drops ~10x.
             env.pop("PALLAS_AXON_POOL_IPS", None)
-        if runtime_env:
-            env["RAY_TPU_RUNTIME_ENV"] = json.dumps(runtime_env)
-        if self._tracing_enabled:
-            env["RAY_TPU_TRACING"] = "1"
-        env["RAY_TPU_WORKER_ID"] = worker_id
-        env["RAY_TPU_NODE_ID"] = self.node_id
-        env["RAY_TPU_RAYLET_ADDR"] = json.dumps(list(self.address))
-        env["RAY_TPU_GCS_ADDR"] = json.dumps(list(self.gcs.address))
-        env["RAY_TPU_ARENA_NAME"] = self.arena_name
-        env["RAY_TPU_SESSION_DIR"] = self.session_dir
-        # Workers must import the same modules the driver pickles by reference
-        # (cloudpickle serializes importable functions by name); ship the
-        # driver-side sys.path (reference: runtime-env py_modules/working_dir).
-        extra_path = os.pathsep.join(p for p in sys.path if p)
-        env["PYTHONPATH"] = (
-            extra_path + os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else extra_path
-        )
-        log_path = os.path.join(self.session_dir, "logs", f"worker-{worker_id[:8]}")
-        os.makedirs(os.path.dirname(log_path), exist_ok=True)
+        env.update(delta)
         stdout = open(log_path + ".out", "ab")
         stderr = open(log_path + ".err", "ab")
         proc = subprocess.Popen(
@@ -927,12 +1150,24 @@ class Raylet:
             stderr=stderr,
             cwd=os.getcwd(),
         )
-        self.workers[worker_id] = WorkerHandle(
-            worker_id=worker_id,
-            pid=proc.pid,
-            proc=proc,
-            runtime_env_hash=_runtime_env_hash(runtime_env),
-        )
+        handle.proc = proc
+        handle.pid = proc.pid
+
+    async def _spawn_via_zygote(self, zygote, handle: WorkerHandle, delta: dict, log_path: str):
+        from ray_tpu._private.zygote import ZygoteWorkerProc
+
+        try:
+            pid = await zygote.spawn(delta, log_path + ".out", log_path + ".err")
+        except Exception:
+            logger.exception("zygote spawn failed; falling back to subprocess")
+            if handle.state != "dead":
+                self._popen_worker(handle, delta, log_path)
+            return
+        handle.pid = pid
+        handle.proc = ZygoteWorkerProc(pid)
+        if handle.state == "dead":
+            # Killed while the fork was in flight (eviction/stop).
+            handle.proc.kill()
 
     @schema(worker_id=str, pid=int, address=list)
     async def rpc_register_worker(self, req):
@@ -989,6 +1224,25 @@ class Raylet:
                         else f"worker process exited with code {worker.proc.returncode}",
                         oom=worker.oom_killed,
                     )
+            # Abort unsealed store entries orphaned by a producer killed
+            # between create and seal (active push/pull sessions exempt).
+            try:
+                self.store.reap_orphaned_unsealed(
+                    60.0, exclude=set(self._inbound_pushes) | set(self._pulls_inflight)
+                )
+            except Exception:
+                pass
+            # Expire leases whose owner stopped renewing (owner process died
+            # without returning them): reclaim the worker via the death path
+            # so resource release and owner notification stay in one place.
+            now = time.monotonic()
+            for lid, lease in list(self._leases.items()):
+                if now - lease["renewed"] > self.cfg.worker_lease_timeout_s + 15:
+                    worker = self.workers.get(lease["worker_id"])
+                    logger.warning("lease %s expired; reclaiming worker", lid[:8])
+                    self._leases.pop(lid, None)
+                    if worker is not None and worker.proc is not None:
+                        worker.proc.kill()
             # Memory pressure: kill a task worker if the node is over the
             # threshold (reference: memory_monitor + worker killing policy).
             if time.monotonic() - self._last_memory_check >= self.cfg.memory_monitor_interval_s:
@@ -1017,7 +1271,22 @@ class Raylet:
             # Release the actor's lifetime resource hold.
             self._release_for(worker.actor_spec)
             worker.actor_spec = None
-        if spec is not None:
+        if spec is not None and spec.lease_id:
+            # Leased worker: the owner tracks which specs were in flight on
+            # it — revoke so it fails them over (lease_manager._lease_failed).
+            self._release_for(spec)
+            self._leases.pop(spec.lease_id, None)
+            if spec.owner_addr:
+                try:
+                    owner = RpcClient(tuple(spec.owner_addr), label="lease-owner")
+                    await owner.acall(
+                        "lease_revoked",
+                        {"lease_id": spec.lease_id, "oom": bool(oom), "reason": reason},
+                    )
+                    owner.close()
+                except Exception:
+                    pass
+        elif spec is not None:
             self._release_for(spec)
             # Tell the owner so it can retry (reference: task_manager.h:335).
             if spec.owner_addr:
@@ -1085,6 +1354,8 @@ class Raylet:
                     w.proc.wait(timeout=2)
                 except Exception:
                     w.proc.kill()
+        if getattr(self, "_zygote", None) is not None:
+            self._zygote.close()
         self.server.stop()
         self.gcs.close()
         for c in self._peer_clients.values():

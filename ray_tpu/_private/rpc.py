@@ -80,6 +80,19 @@ class ConnectionLost(RpcError):
     pass
 
 
+def _set_nodelay(writer: "asyncio.StreamWriter"):
+    """Request/response frames are small; Nagle coalescing only adds
+    latency (the reference's gRPC channels disable it too)."""
+    import socket as _socket
+
+    sock = writer.get_extra_info("socket")
+    if sock is not None and sock.family in (_socket.AF_INET, _socket.AF_INET6):
+        try:
+            sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+
+
 def _pack(msg) -> bytes:
     body = msgpack.packb(msg, use_bin_type=True)
     return len(body).to_bytes(4, "big") + body
@@ -171,12 +184,13 @@ class RpcServer:
                     self._schemas[prefix + attr[4:]] = schema
 
     async def _serve_conn(self, reader, writer):
+        _set_nodelay(writer)
         self._conns.add(writer)
         try:
             while True:
                 try:
                     mtype, seq, method, payload = await _read_frame(reader)
-                except (asyncio.IncompleteReadError, ConnectionResetError):
+                except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError, OSError):
                     return
                 if mtype == REQUEST:
                     asyncio.ensure_future(
@@ -206,6 +220,8 @@ class RpcServer:
             if writer is not None:
                 writer.write(_pack([RESPONSE, seq, method, result]))
                 await writer.drain()
+        except (ConnectionResetError, BrokenPipeError):
+            pass  # peer went away mid-response (routine at shutdown)
         except Exception as e:
             if writer is not None:
                 err = {"error": repr(e), "traceback": traceback.format_exc()}
@@ -288,6 +304,7 @@ class RpcClient:
                     reader, writer = await asyncio.open_unix_connection(self.address)
                 else:
                     reader, writer = await asyncio.open_connection(*self.address)
+                _set_nodelay(writer)
                 self._writer = writer
                 self._reader_task = asyncio.ensure_future(self._read_loop(reader))
                 return

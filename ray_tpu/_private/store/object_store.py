@@ -35,6 +35,7 @@ class ObjectEntry:
     last_access: float = 0.0
     spilled_path: str | None = None
     sealed_event: asyncio.Event = field(default_factory=asyncio.Event)
+    created_ts: float = field(default_factory=time.monotonic)
 
 
 class StoreCore:
@@ -170,6 +171,26 @@ class StoreCore:
     def object_ids(self) -> list[str]:
         return [oid for oid, e in self.objects.items() if e.sealed]
 
+    def reap_orphaned_unsealed(self, max_age_s: float = 60.0, exclude=()) -> int:
+        """Abort unsealed entries nobody is filling anymore: a producer
+        SIGKILLed between create and seal (memory-monitor kills do exactly
+        this) leaves an entry that would otherwise block any re-producer's
+        put_serialized forever. Active transfer sessions (caller passes
+        their ids in `exclude`) are exempt — big chunked pulls can
+        legitimately run long."""
+        now = time.monotonic()
+        reaped = 0
+        for oid, entry in list(self.objects.items()):
+            if (
+                not entry.sealed
+                and oid not in exclude
+                and now - entry.created_ts > max_age_s
+            ):
+                logger.warning("aborting orphaned unsealed object %s", oid[:12])
+                self.abort(oid)
+                reaped += 1
+        return reaped
+
     def usage(self) -> dict:
         """Summary only — shipped in every raylet heartbeat, so it must stay
         O(1); per-object metadata goes through objects_info()."""
@@ -283,9 +304,30 @@ class StoreClient:
     def put_serialized(self, object_id_hex: str, serialized) -> None:
         """create -> write payload zero-copy into arena -> seal."""
         size = serialized.total_size
-        resp = self.raylet.call("store_create", {"object_id": object_id_hex, "size": size})
-        if resp.get("exists"):
-            return  # already sealed here (idempotent reconstruction)
+        for _ in range(20):  # bounded: the raylet reaps orphaned unsealed
+            # entries within ~60s, so a handful of wait+retry rounds always
+            # terminates; 20 rounds of 60s wait_seal is pathological.
+            resp = self.raylet.call(
+                "store_create", {"object_id": object_id_hex, "size": size}
+            )
+            if resp.get("exists"):
+                if resp.get("sealed", True):
+                    return  # already sealed here (idempotent reconstruction)
+                # An in-flight pull/push session owns the buffer. Wait for it
+                # to seal (object materialized -> done) or abort (retry our
+                # own create so the result cannot be silently dropped).
+                wait = self.raylet.call(
+                    "store_wait_seal", {"object_id": object_id_hex}, timeout=60
+                )
+                if wait.get("sealed"):
+                    return
+                continue
+            break
+        else:
+            raise RuntimeError(
+                f"object {object_id_hex[:12]} stuck unsealed: a rival "
+                "session never sealed or aborted within the retry budget"
+            )
         offset = resp["offset"]
         try:
             serialized.write_to(self.arena.read(offset, size))

@@ -55,9 +55,20 @@ class TaskSpec:
     # util/tracing/tracing_helper.py — span context rides task metadata).
     trace_ctx: dict = field(default_factory=dict)
     runtime_env: dict = field(default_factory=dict)
+    # Non-empty marks this spec as a WORKER-LEASE REQUEST (reference:
+    # direct_task_transport.cc lease requests ride the task scheduler): it
+    # flows through the raylet queue like a task, but dispatch grants the
+    # worker to the owner instead of pushing a task onto it.
+    lease_id: str = ""
 
     def to_wire(self) -> dict:
-        return self.__dict__.copy()
+        """Delta-encoded against field defaults: a typical no-frills task
+        ships ~8 keys instead of 26, which matters at 1k+ tasks/s — wire
+        size and msgpack time are on the submit hot path (the reference gets
+        the same effect from protobuf default-field elision)."""
+        return {
+            k: v for k, v in self.__dict__.items() if _WIRE_DEFAULTS.get(k, _MISSING) != v
+        }
 
     @classmethod
     def from_wire(cls, d: dict) -> "TaskSpec":
@@ -79,3 +90,14 @@ class TaskSpec:
 
     def is_actor_creation(self) -> bool:
         return self.task_type == ACTOR_CREATION_TASK
+
+
+import dataclasses as _dataclasses
+
+_MISSING = object()
+_WIRE_DEFAULTS = {}
+for _f in _dataclasses.fields(TaskSpec):
+    if _f.default is not _dataclasses.MISSING:
+        _WIRE_DEFAULTS[_f.name] = _f.default
+    elif _f.default_factory is not _dataclasses.MISSING:  # type: ignore[misc]
+        _WIRE_DEFAULTS[_f.name] = _f.default_factory()  # type: ignore[misc]

@@ -37,6 +37,17 @@ class WorkerExecutor:
         server.register("push_task", self.rpc_push_task)
         server.register("actor_call", self.rpc_actor_call)
         server.register("kill_self", self.rpc_kill_self)
+        server.register("lease_exec", self.rpc_lease_exec)
+        server.register("lease_ping", self.rpc_lease_ping)
+        # Leased-task pipeline (reference: direct task transport worker side,
+        # core_worker.cc task receiver): owners ship batches of specs; we
+        # execute FIFO and push completion payloads back, coalescing results
+        # that finish while a previous report RPC is still in flight.
+        self._lease_buf: list = []
+        self._lease_event: asyncio.Event | None = None
+        self._lease_task = None
+        self._done_buf: list = []
+        self._done_flushing = False
 
     # ---- normal / actor-creation tasks ----
 
@@ -102,6 +113,81 @@ class WorkerExecutor:
                 )
             finally:
                 os._exit(1)
+
+    # ---- leased normal tasks (reference: direct_task_transport worker side) ----
+
+    async def rpc_lease_ping(self, req):
+        return {"ok": True}
+
+    async def rpc_lease_exec(self, req):
+        from ray_tpu._private.task_spec import TaskSpec
+
+        if self._lease_event is None:
+            self._lease_event = asyncio.Event()
+        for wire in req["specs"]:
+            self._lease_buf.append(TaskSpec.from_wire(wire))
+        self._lease_event.set()
+        if self._lease_task is None or self._lease_task.done():
+            self._lease_task = asyncio.ensure_future(self._lease_consumer())
+        # Ack = accepted-into-queue, not executed: the owner's flow control
+        # is per-task (tasks_done), so the ack must not wait on execution.
+        return {"accepted": len(req["specs"])}
+
+    async def _lease_consumer(self):
+        loop = asyncio.get_event_loop()
+        while True:
+            while not self._lease_buf:
+                self._lease_event.clear()
+                await self._lease_event.wait()
+            spec = self._lease_buf.pop(0)
+            payload = await loop.run_in_executor(self.cw._executor, self.cw.execute_task, spec)
+            self._done_buf.append((tuple(spec.owner_addr), payload))
+            if not self._done_flushing:
+                self._done_flushing = True
+                asyncio.ensure_future(self._flush_done())
+
+    async def _flush_done(self):
+        """Deliver completion payloads, re-queuing on failure: dropping a
+        batch would leave the owner's get() hanging forever — its lease
+        probe only pings THIS worker, which is alive. Bounded retries: a
+        permanently unreachable owner is dead, and dead owners' results
+        are garbage."""
+        try:
+            attempts = 0
+            while self._done_buf:
+                batch, self._done_buf = self._done_buf, []
+                by_owner: dict = {}
+                for owner_addr, payload in batch:
+                    by_owner.setdefault(owner_addr, []).append(payload)
+                failed: list = []
+                for owner_addr, payloads in by_owner.items():
+                    try:
+                        owner = self.cw._owner_client(owner_addr)
+                        await owner.acall("tasks_done", {"batch": payloads})
+                    except Exception:
+                        logger.warning(
+                            "lease result delivery to %s failed (%d results)",
+                            owner_addr, len(payloads),
+                        )
+                        failed.extend((owner_addr, p) for p in payloads)
+                if failed:
+                    attempts += 1
+                    if attempts >= 12:  # ~60s of owner unreachability
+                        # Dropping silently would hang a still-alive owner
+                        # forever (its probe pings US, and we're healthy).
+                        # Dying converts the situation into worker-death:
+                        # the raylet revokes the lease and the owner's
+                        # failover re-runs the tasks (or, if the owner is
+                        # truly dead, nothing is lost).
+                        logger.error(
+                            "exiting: %d lease results undeliverable to owner",
+                            len(failed),
+                        )
+                        os._exit(1)
+                    self._done_buf = failed + self._done_buf
+                    await asyncio.sleep(min(5.0, 0.5 * attempts))
+        finally:
+            self._done_flushing = False
 
     # ---- direct actor calls ----
 
@@ -177,6 +263,16 @@ def _apply_runtime_env(raw: str | None):
 
 
 def main():
+    import time as _time
+
+    _boot_t0 = _time.monotonic()
+    _trace = os.environ.get("RAY_TPU_BOOT_TRACE")
+
+    def _mark(label):
+        if _trace:
+            print(f"[boot-trace {os.getpid()}] {label} +{(_time.monotonic() - _boot_t0) * 1e3:.1f}ms",
+                  file=sys.stderr, flush=True)
+
     logging.basicConfig(
         level=logging.INFO,
         format=f"[worker %(process)d] %(levelname)s %(name)s: %(message)s",
@@ -217,6 +313,7 @@ def main():
     from ray_tpu._private.core_worker import WORKER, CoreWorker
     from ray_tpu._private.ids import JobID
 
+    _mark("imports")
     worker_env = os.environ.get("RAY_TPU_RUNTIME_ENV")
     cw = CoreWorker(
         mode=WORKER,
@@ -232,11 +329,13 @@ def main():
         job_runtime_env=json.loads(worker_env) if worker_env else None,
     )
     worker_context.set_core_worker(cw)
+    _mark("core_worker")
     executor = WorkerExecutor(cw, cw.raylet)
     cw.raylet.call(
         "register_worker",
         {"worker_id": worker_id, "address": list(cw.address), "pid": os.getpid()},
     )
+    _mark("registered")
     # Workers exit if their parent raylet dies (reference: core_worker.cc:926
     # ExitIfParentRayletDies).
     def _watch_raylet():

@@ -23,3 +23,6 @@ from ray_tpu.rllib.algorithms.apex_dqn import ApexDQN, ApexDQNConfig  # noqa: F4
 from ray_tpu.rllib.algorithms.qmix import QMIX, QMIXConfig  # noqa: F401,E402
 from ray_tpu.rllib.algorithms.pg import PG, PGConfig  # noqa: F401,E402
 from ray_tpu.rllib.algorithms.dt import DT, DTConfig  # noqa: F401,E402
+from ray_tpu.rllib.algorithms.r2d2 import R2D2, R2D2Config  # noqa: F401,E402
+from ray_tpu.rllib.algorithms.maddpg import MADDPG, MADDPGConfig  # noqa: F401,E402
+from ray_tpu.rllib.env.external_env import ExternalEnv, ExternalEnvRunner  # noqa: F401,E402

@@ -122,6 +122,19 @@ class ApexDQNConfig(DQNConfig):
     def training(self, *, num_replay_shards=None, rollout_fragment_length=None,
                  weight_sync_period_updates=None, train_rounds_per_iter=None,
                  updates_per_round=None, **kwargs) -> "ApexDQNConfig":
+        if "epsilon_timesteps" in kwargs or "final_epsilon" in kwargs:
+            # Ape-X never anneals: workers use the fixed per-worker ladder
+            # eps_i = 0.4^(1+7i/(N-1)). Accepting a schedule silently would
+            # imply annealing that doesn't happen.
+            import warnings
+
+            warnings.warn(
+                "ApexDQN ignores epsilon schedule fields (epsilon_timesteps/"
+                "final_epsilon): exploration uses the fixed per-worker "
+                "epsilon ladder", stacklevel=2,
+            )
+            kwargs.pop("epsilon_timesteps", None)
+            kwargs.pop("final_epsilon", None)
         super().training(**kwargs)
         for name, val in (
             ("num_replay_shards", num_replay_shards),

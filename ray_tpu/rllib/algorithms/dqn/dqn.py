@@ -214,5 +214,9 @@ class DQN(Algorithm):
     def compute_single_action(self, obs, explore: bool = False):
         import jax.numpy as jnp
 
+        if explore and self._rng.random() < self._epsilon():
+            # Epsilon-greedy for external/inverted-control callers
+            # (ExternalEnv serves actions through this entry point).
+            return int(self._rng.integers(0, self.module_spec.action_dim))
         q = np.asarray(self._q_fn(self.learner.params, jnp.asarray(np.asarray(obs, np.float32))[None]))
         return int(q.argmax())

@@ -1,0 +1,325 @@
+"""MADDPG — Multi-Agent DDPG with centralized critics.
+
+Reference: rllib/algorithms/maddpg/maddpg.py (Lowe et al. 2017):
+decentralized actors ``a_i = mu_i(o_i)`` with CENTRALIZED critics
+``Q_i(o_1..o_N, a_1..a_N)`` — each agent's critic sees every agent's
+observation and action during training, which removes the non-stationarity
+that breaks independent DDPG in multi-agent settings. Execution stays
+decentralized (actors only need their own observation).
+
+TPU-native shape: all agents share one architecture, so per-agent
+parameters are STACKED along a leading axis and every forward/backward is
+``jax.vmap`` over that axis — one jitted update trains all N agents'
+actors and critics as a single XLA program (batched matmuls on the MXU),
+instead of the reference's N separate torch modules.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.sac.sac import _mlp_apply, _mlp_params
+from ray_tpu.rllib.env.multi_agent_env import MultiAgentEnv
+
+
+class MADDPGConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or MADDPG)
+        self.lr = 1e-3
+        self.critic_lr = 1e-3
+        self.num_rollout_workers = 0
+        self.train_batch_size = 256
+        self.replay_buffer_capacity = 100_000
+        self.learning_starts = 1000
+        self.tau = 1e-2
+        self.rollout_steps_per_iter = 500
+        self.train_intensity = 4      # env steps per gradient update
+        self.exploration_noise = 0.2  # gaussian, in [-1,1] action units
+        self.model_hiddens = (64, 64)
+
+    def training(self, *, critic_lr=None, replay_buffer_capacity=None,
+                 learning_starts=None, tau=None, rollout_steps_per_iter=None,
+                 train_intensity=None, exploration_noise=None,
+                 model_hiddens=None, **kwargs) -> "MADDPGConfig":
+        super().training(**kwargs)
+        for name, val in (
+            ("critic_lr", critic_lr),
+            ("replay_buffer_capacity", replay_buffer_capacity),
+            ("learning_starts", learning_starts),
+            ("tau", tau),
+            ("rollout_steps_per_iter", rollout_steps_per_iter),
+            ("train_intensity", train_intensity),
+            ("exploration_noise", exploration_noise),
+            ("model_hiddens", model_hiddens),
+        ):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+class _Replay:
+    """Flat multi-agent transition store: joint arrays, uniform sampling."""
+
+    def __init__(self, capacity: int, seed: int):
+        self.capacity = capacity
+        self._data: dict | None = None
+        self._n = 0
+        self._pos = 0
+        self._rng = np.random.default_rng(seed)
+
+    def add(self, item: dict):
+        if self._data is None:
+            self._data = {
+                k: np.zeros((self.capacity,) + np.asarray(v).shape, np.float32)
+                for k, v in item.items()
+            }
+        for k, v in item.items():
+            self._data[k][self._pos] = v
+        self._pos = (self._pos + 1) % self.capacity
+        self._n = min(self._n + 1, self.capacity)
+
+    def __len__(self):
+        return self._n
+
+    def sample(self, n: int) -> dict:
+        idx = self._rng.integers(0, self._n, n)
+        return {k: v[idx] for k, v in self._data.items()}
+
+
+class MADDPG(Algorithm):
+    @classmethod
+    def get_default_config(cls) -> MADDPGConfig:
+        return MADDPGConfig(cls)
+
+    def setup(self, config: dict) -> None:
+        import jax
+        import optax
+
+        cfg: MADDPGConfig = self._algo_config
+        env = cfg.env(dict(cfg.env_config)) if callable(cfg.env) else cfg.env
+        assert isinstance(env, MultiAgentEnv), "MADDPG requires a MultiAgentEnv"
+        self.env = env
+        self.agents = list(env.possible_agents)
+        self.n_agents = len(self.agents)
+        self.obs_dim = int(np.prod(env.observation_space.shape))
+        space = env.action_space
+        assert hasattr(space, "shape") and space.shape, "MADDPG needs continuous actions"
+        self.act_dim = int(np.prod(space.shape))
+        low = np.asarray(space.low, np.float32)
+        high = np.asarray(space.high, np.float32)
+        self._act_scale = (high - low) / 2.0
+        self._act_offset = (high + low) / 2.0
+
+        N, H = self.n_agents, cfg.model_hiddens
+        global_dim = N * (self.obs_dim + self.act_dim)
+        keys = jax.random.split(jax.random.PRNGKey(cfg.seed), 2 * N)
+        # Stacked per-agent params: tree leaves have leading axis N.
+        actor = [ _mlp_params(keys[i], self.obs_dim, H, self.act_dim) for i in range(N)]
+        critic = [_mlp_params(keys[N + i], global_dim, H, 1) for i in range(N)]
+        stack = lambda trees: jax.tree_util.tree_map(lambda *xs: np.stack(xs), *trees)  # noqa: E731
+        self.params = {"actor": stack(actor), "critic": stack(critic)}
+        self.target_params = jax.tree_util.tree_map(np.copy, self.params)
+        self.tx = optax.chain(optax.clip_by_global_norm(0.5), optax.adam(cfg.lr))
+        self.opt_state = self.tx.init(self.params)
+        self.buffer = _Replay(cfg.replay_buffer_capacity, cfg.seed)
+        self._timesteps_total = 0
+        self._updates = 0
+        self._episode_reward_window: list = []
+        self._rng = np.random.default_rng(cfg.seed)
+        self._obs = self._obs_dict_to_array(self.env.reset(seed=cfg.seed)[0])
+        self._ep_reward = 0.0
+
+        def actor_fwd(aparams, obs):  # single agent
+            return jax.numpy.tanh(_mlp_apply(aparams, obs))
+
+        self._actors_fwd = jax.jit(
+            lambda p, obs: jax.vmap(actor_fwd)(p["actor"], obs)  # [N,obs]->[N,act]
+        )
+
+        gamma = cfg.gamma
+        tau = cfg.tau
+
+        def update_fn(params, target_params, opt_state, batch):
+            import jax.numpy as jnp
+
+            B = batch["obs"].shape[0]
+            obs = batch["obs"]            # [B,N,obs]
+            acts = batch["actions"]       # [B,N,act]
+            rews = batch["rewards"]       # [B,N]
+            dones = batch["dones"]        # [B]
+            next_obs = batch["next_obs"]  # [B,N,obs]
+
+            # Target joint action: each agent's target actor on ITS obs.
+            next_a = jax.vmap(
+                lambda ap, o: jnp.tanh(_mlp_apply(ap, o)),
+                in_axes=(0, 1), out_axes=1,
+            )(target_params["actor"], next_obs)  # [B,N,act]
+            next_global = jnp.concatenate(
+                [next_obs.reshape(B, -1), next_a.reshape(B, -1)], axis=-1
+            )
+            q_next = jax.vmap(
+                lambda cp: _mlp_apply(cp, next_global)[..., 0], in_axes=0, out_axes=1
+            )(target_params["critic"])  # [B,N]
+            y = rews + gamma * (1.0 - dones[:, None]) * q_next
+            y = jax.lax.stop_gradient(y)
+
+            def loss_fn(p):
+                global_in = jnp.concatenate(
+                    [obs.reshape(B, -1), acts.reshape(B, -1)], axis=-1
+                )
+                q = jax.vmap(
+                    lambda cp: _mlp_apply(cp, global_in)[..., 0], in_axes=0, out_axes=1
+                )(p["critic"])  # [B,N]
+                critic_loss = jnp.mean(jnp.square(q - y))
+
+                # Actor i maximizes Q_i with ITS action replaced by mu_i(o_i)
+                # and the other agents' actions from the batch (stop-grad
+                # through them is implicit: they are data).
+                mu = jax.vmap(
+                    lambda ap, o: jnp.tanh(_mlp_apply(ap, o)), in_axes=(0, 1), out_axes=1
+                )(p["actor"], obs)  # [B,N,act]
+                eye = jnp.eye(self.n_agents)[None, :, :, None]  # [1,N,N,1]
+                # joint_i: batch actions with column i swapped for mu_i.
+                joint = acts[:, None, :, :] * (1.0 - eye) + mu[:, :, None, :].transpose(0, 2, 1, 3) * eye
+                # joint[b, i, j, :] = action of agent j in agent i's critic input
+                global_a = jnp.concatenate(
+                    [
+                        jnp.broadcast_to(obs.reshape(B, 1, -1), (B, self.n_agents, self.n_agents * self.obs_dim)),
+                        joint.reshape(B, self.n_agents, -1),
+                    ],
+                    axis=-1,
+                )  # [B,N,global]
+                # Critic params are FROZEN in the actor term — without the
+                # stop_gradient the actor objective would "improve" by
+                # inflating the critic's Q estimates instead of the policy.
+                q_pi = jax.vmap(
+                    lambda cp, gi: _mlp_apply(cp, gi)[..., 0],
+                    in_axes=(0, 1), out_axes=1,
+                )(jax.lax.stop_gradient(p["critic"]), global_a)  # [B,N]
+                actor_loss = -jnp.mean(q_pi)
+                return critic_loss + actor_loss, {
+                    "critic_loss": critic_loss,
+                    "actor_loss": actor_loss,
+                    "q_mean": q.mean(),
+                }
+
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            target_params = jax.tree_util.tree_map(
+                lambda t, o: (1.0 - tau) * t + tau * o, target_params, params
+            )
+            aux = dict(aux)
+            aux["total_loss"] = loss
+            return params, target_params, opt_state, aux
+
+        self._update_fn = jax.jit(update_fn)
+
+    # -- helpers ---------------------------------------------------------
+
+    def _obs_dict_to_array(self, obs_dict: dict) -> np.ndarray:
+        return np.stack(
+            [np.asarray(obs_dict[a], np.float32).reshape(-1) for a in self.agents]
+        )
+
+    def _scale(self, a: np.ndarray) -> np.ndarray:
+        return a * self._act_scale + self._act_offset
+
+    # -- training --------------------------------------------------------
+
+    def training_step(self) -> dict:
+        import jax.numpy as jnp
+
+        cfg: MADDPGConfig = self._algo_config
+        metrics: dict = {}
+        for _ in range(cfg.rollout_steps_per_iter):
+            a = np.array(self._actors_fwd(self._as_jax(self.params), jnp.asarray(self._obs)))
+            a = np.clip(a + self._rng.normal(0, cfg.exploration_noise, a.shape), -1, 1)
+            action_dict = {ag: self._scale(a[i]) for i, ag in enumerate(self.agents)}
+            obs_d, rew_d, term_d, trunc_d, _ = self.env.step(action_dict)
+            done = bool(term_d.get("__all__")) or bool(trunc_d.get("__all__"))
+            rewards = np.asarray([rew_d.get(ag, 0.0) for ag in self.agents], np.float32)
+            next_obs = (
+                self._obs_dict_to_array(obs_d)
+                if obs_d
+                else np.zeros_like(self._obs)
+            )
+            self.buffer.add({
+                "obs": self._obs, "actions": a.astype(np.float32),
+                "rewards": rewards, "dones": np.float32(done),
+                "next_obs": next_obs,
+            })
+            self._ep_reward += float(rewards.sum())
+            self._timesteps_total += 1
+            if done:
+                self._episode_reward_window.append(self._ep_reward)
+                self._episode_reward_window = self._episode_reward_window[-100:]
+                self._ep_reward = 0.0
+                self._obs = self._obs_dict_to_array(self.env.reset()[0])
+            else:
+                self._obs = next_obs
+            if (
+                len(self.buffer) >= cfg.learning_starts
+                and self._timesteps_total % max(1, cfg.train_intensity) == 0
+            ):
+                metrics = self._train_once()
+        return metrics
+
+    def _train_once(self) -> dict:
+        batch = self.buffer.sample(self._algo_config.train_batch_size)
+        self.params, self.target_params, self.opt_state, aux = self._update_fn(
+            self.params, self.target_params, self.opt_state, batch
+        )
+        self._updates += 1
+        return {k: float(v) for k, v in aux.items()}
+
+    @staticmethod
+    def _as_jax(tree):
+        import jax
+        import jax.numpy as jnp
+
+        return jax.tree_util.tree_map(jnp.asarray, tree)
+
+    def step(self) -> dict:
+        import time
+
+        t0 = time.time()
+        result = self.training_step()
+        result["episode_reward_mean"] = (
+            float(np.mean(self._episode_reward_window))
+            if self._episode_reward_window
+            else float("nan")
+        )
+        result["timesteps_total"] = self._timesteps_total
+        result["time_this_iter_s"] = time.time() - t0
+        return result
+
+    def compute_actions(self, obs_dict: dict) -> dict:
+        """Decentralized execution: each agent acts from its own obs."""
+        import jax.numpy as jnp
+
+        obs = self._obs_dict_to_array(obs_dict)
+        a = np.array(self._actors_fwd(self._as_jax(self.params), jnp.asarray(obs)))
+        return {ag: self._scale(a[i]) for i, ag in enumerate(self.agents)}
+
+    def save_checkpoint(self):
+        from ray_tpu.air.checkpoint import Checkpoint
+
+        return Checkpoint.from_dict({
+            "params": self.params,
+            "target": self.target_params,
+            "opt_state": self.opt_state,
+            "timesteps": self._timesteps_total,
+        })
+
+    def load_checkpoint(self, checkpoint) -> None:
+        data = checkpoint.to_dict()
+        self.params = data["params"]
+        self.target_params = data["target"]
+        self.opt_state = data["opt_state"]
+        self._timesteps_total = data.get("timesteps", 0)
+
+    def cleanup(self) -> None:
+        if getattr(self, "env", None) is not None:
+            self.env.close()

@@ -1,0 +1,3 @@
+from ray_tpu.rllib.algorithms.r2d2.r2d2 import R2D2, R2D2Config
+
+__all__ = ["R2D2", "R2D2Config"]

@@ -1,0 +1,400 @@
+"""R2D2 — Recurrent Replay Distributed DQN.
+
+Reference: rllib/algorithms/r2d2/r2d2.py (+ r2d2_torch_policy.py): a
+recurrent Q-network trained from a replay buffer of fixed-length
+SEQUENCES, each stored with the hidden state the network had when the
+sequence began. Training replays a burn-in prefix to refresh the hidden
+state (stored states go stale as parameters move), computes double-Q TD
+targets only on the post-burn-in steps, and uses the invertible value
+rescaling h(x) from the R2D2 paper for reward-scale robustness.
+
+TPU-native shape: the recurrent core is a GRU unrolled with ``lax.scan``
+(static sequence length -> one compiled XLA while-loop on the MXU-friendly
+batched matmuls), and the whole TD update — burn-in, double-Q argmax,
+rescaled targets, masked Huber loss — is a single jitted function over a
+[B, T, ...] batch. No per-step Python in the hot path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env.vector_env import VectorEnv
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+
+# ---------------------------------------------------------------------------
+# Recurrent Q-network: encoder MLP -> GRU -> dueling Q head
+# ---------------------------------------------------------------------------
+
+
+def _dense(key, n_in, n_out):
+    import jax
+
+    scale = np.sqrt(2.0 / (n_in + n_out))
+    return {
+        "w": jax.random.normal(key, (n_in, n_out)) * scale,
+        "b": np.zeros((n_out,), np.float32),
+    }
+
+
+def init_params(rng, obs_dim: int, action_dim: int, hidden: int):
+    import jax
+
+    k = jax.random.split(rng, 6)
+    return {
+        "enc": _dense(k[0], obs_dim, hidden),
+        # GRU: update/reset/candidate gates over [x, h]
+        "gru_z": _dense(k[1], hidden * 2, hidden),
+        "gru_r": _dense(k[2], hidden * 2, hidden),
+        "gru_h": _dense(k[3], hidden * 2, hidden),
+        "val": _dense(k[4], hidden, 1),
+        "adv": _dense(k[5], hidden, action_dim),
+    }
+
+
+def _apply(layer, x):
+    return x @ layer["w"] + layer["b"]
+
+
+def gru_cell(params, h, x):
+    import jax
+    import jax.numpy as jnp
+
+    hx = jnp.concatenate([x, h], axis=-1)
+    z = jax.nn.sigmoid(_apply(params["gru_z"], hx))
+    r = jax.nn.sigmoid(_apply(params["gru_r"], hx))
+    cand = jnp.tanh(_apply(params["gru_h"], jnp.concatenate([x, r * h], axis=-1)))
+    return (1.0 - z) * h + z * cand
+
+
+def q_scan(params, obs_seq, h0):
+    """obs_seq [B, T, obs] + h0 [B, H] -> q [B, T, A], h_T [B, H]."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.tanh(_apply(params["enc"], obs_seq))  # [B, T, H]
+
+    def step(h, xt):
+        h = gru_cell(params, h, xt)
+        return h, h
+
+    h_last, hs = jax.lax.scan(step, h0, jnp.swapaxes(x, 0, 1))
+    hs = jnp.swapaxes(hs, 0, 1)  # [B, T, H]
+    val = _apply(params["val"], hs)  # [B, T, 1]
+    adv = _apply(params["adv"], hs)  # [B, T, A]
+    q = val + adv - adv.mean(axis=-1, keepdims=True)  # dueling combine
+    return q, h_last
+
+
+def h_rescale(x, eps=1e-3):
+    import jax.numpy as jnp
+
+    return jnp.sign(x) * (jnp.sqrt(jnp.abs(x) + 1.0) - 1.0) + eps * x
+
+
+def h_inverse(x, eps=1e-3):
+    import jax.numpy as jnp
+
+    s = jnp.sign(x)
+    a = jnp.abs(x)
+    return s * (jnp.square((jnp.sqrt(1.0 + 4.0 * eps * (a + 1.0 + eps)) - 1.0) / (2.0 * eps)) - 1.0)
+
+
+# ---------------------------------------------------------------------------
+# Sequence replay buffer (reference: replay stores fixed-length sequences
+# with the recurrent state at sequence start)
+# ---------------------------------------------------------------------------
+
+
+class SequenceReplayBuffer:
+    def __init__(self, capacity: int, seed: int = 0):
+        self.capacity = capacity
+        self._items: list = []
+        self._pos = 0
+        self._rng = np.random.default_rng(seed)
+
+    def add(self, seq: dict):
+        if len(self._items) < self.capacity:
+            self._items.append(seq)
+        else:
+            self._items[self._pos] = seq
+            self._pos = (self._pos + 1) % self.capacity
+
+    def __len__(self):
+        return len(self._items)
+
+    def sample(self, n: int) -> dict:
+        idx = self._rng.integers(0, len(self._items), n)
+        seqs = [self._items[i] for i in idx]
+        return {k: np.stack([s[k] for s in seqs]) for k in seqs[0]}
+
+
+# ---------------------------------------------------------------------------
+# Config / algorithm
+# ---------------------------------------------------------------------------
+
+
+class R2D2Config(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or R2D2)
+        self.lr = 1e-3
+        self.num_rollout_workers = 0
+        self.train_batch_size = 32          # sequences per update
+        self.replay_buffer_capacity = 4000  # sequences
+        self.learning_starts = 200          # sequences buffered before training
+        self.target_network_update_freq = 200
+        self.rollout_steps_per_iter = 1000
+        self.train_intensity = 40           # env steps per update
+        self.burn_in = 4
+        self.seq_len = 20                   # training steps after burn-in
+        self.hidden_size = 64
+        self.epsilon_timesteps = 10_000
+        self.initial_epsilon = 1.0
+        self.final_epsilon = 0.02
+        self.use_h_rescale = True
+
+    def training(self, *, replay_buffer_capacity=None, learning_starts=None,
+                 target_network_update_freq=None, rollout_steps_per_iter=None,
+                 train_intensity=None, burn_in=None, seq_len=None,
+                 hidden_size=None, epsilon_timesteps=None, final_epsilon=None,
+                 use_h_rescale=None, **kwargs) -> "R2D2Config":
+        super().training(**kwargs)
+        for name, val in (
+            ("replay_buffer_capacity", replay_buffer_capacity),
+            ("learning_starts", learning_starts),
+            ("target_network_update_freq", target_network_update_freq),
+            ("rollout_steps_per_iter", rollout_steps_per_iter),
+            ("train_intensity", train_intensity),
+            ("burn_in", burn_in),
+            ("seq_len", seq_len),
+            ("hidden_size", hidden_size),
+            ("epsilon_timesteps", epsilon_timesteps),
+            ("final_epsilon", final_epsilon),
+            ("use_h_rescale", use_h_rescale),
+        ):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+class R2D2(Algorithm):
+    @classmethod
+    def get_default_config(cls) -> R2D2Config:
+        return R2D2Config(cls)
+
+    def setup(self, config: dict) -> None:
+        import gymnasium as gym
+        import jax
+        import optax
+
+        cfg: R2D2Config = self._algo_config
+        probe = gym.make(cfg.env) if isinstance(cfg.env, str) else cfg.env(dict(cfg.env_config))
+        assert hasattr(probe.action_space, "n"), "R2D2 requires a discrete action space"
+        self.obs_dim = int(np.prod(probe.observation_space.shape))
+        self.action_dim = int(probe.action_space.n)
+        probe.close()
+
+        self.env = VectorEnv(cfg.env, max(cfg.num_envs_per_worker, 1), cfg.env_config, 0, seed=cfg.seed)
+        self.n_envs = max(cfg.num_envs_per_worker, 1)
+        self.params = init_params(
+            jax.random.PRNGKey(cfg.seed), self.obs_dim, self.action_dim, cfg.hidden_size
+        )
+        self.target_params = jax.tree_util.tree_map(np.asarray, self.params)
+        self.tx = optax.chain(optax.clip_by_global_norm(10.0), optax.adam(cfg.lr))
+        self.opt_state = self.tx.init(self.params)
+        self.buffer = SequenceReplayBuffer(cfg.replay_buffer_capacity, seed=cfg.seed)
+        self._timesteps_total = 0
+        self._updates = 0
+        self._episode_reward_window: list = []
+        self._rng = np.random.default_rng(cfg.seed)
+
+        # Per-env recurrent state + open sequence builders.
+        self._hidden = np.zeros((self.n_envs, cfg.hidden_size), np.float32)
+        self._seq_open = [self._new_seq(self._hidden[i]) for i in range(self.n_envs)]
+
+        T = cfg.burn_in + cfg.seq_len
+
+        def act_fn(params, obs, h):
+            q, h2 = q_scan(params, obs[:, None, :], h)
+            return q[:, 0, :], h2
+
+        self._act = jax.jit(act_fn)
+
+        def update_fn(params, target_params, opt_state, batch):
+            import jax.numpy as jnp
+
+            def loss_fn(p):
+                q_all, _ = q_scan(p, batch["obs"], batch["h0"])          # [B,T,A]
+                qt_all, _ = q_scan(target_params, batch["obs"], batch["h0"])
+                acts = batch["actions"].astype(jnp.int32)                 # [B,T]
+                q_taken = jnp.take_along_axis(q_all, acts[..., None], -1)[..., 0]
+                # Double-Q over the NEXT in-sequence step.
+                best_next = jnp.argmax(q_all[:, 1:, :], axis=-1)          # [B,T-1]
+                q_next = jnp.take_along_axis(qt_all[:, 1:, :], best_next[..., None], -1)[..., 0]
+                if cfg.use_h_rescale:
+                    q_next = h_inverse(q_next)
+                target = batch["rewards"][:, :-1] + cfg.gamma * (
+                    1.0 - batch["dones"][:, :-1]
+                ) * q_next
+                if cfg.use_h_rescale:
+                    target = h_rescale(target)
+                td = q_taken[:, :-1] - jax.lax.stop_gradient(target)
+                # Mask: valid steps only, and burn-in excluded from loss
+                # (the prefix exists to refresh the hidden state).
+                mask = batch["mask"][:, :-1]
+                mask = mask.at[:, : cfg.burn_in].set(0.0)
+                huber = jnp.where(jnp.abs(td) < 1.0, 0.5 * td * td, jnp.abs(td) - 0.5)
+                loss = jnp.sum(huber * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+                return loss, {"td_abs": jnp.sum(jnp.abs(td) * mask) / jnp.maximum(jnp.sum(mask), 1.0)}
+
+            import jax
+
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            aux = dict(aux)
+            aux["total_loss"] = loss
+            return params, opt_state, aux
+
+        self._update_fn = jax.jit(update_fn)
+        self._T = T
+
+    def _new_seq(self, h0):
+        return {"h0": np.array(h0), "obs": [], "actions": [], "rewards": [], "dones": []}
+
+    def _epsilon(self) -> float:
+        cfg = self._algo_config
+        frac = min(1.0, self._timesteps_total / max(cfg.epsilon_timesteps, 1))
+        return cfg.initial_epsilon + frac * (cfg.final_epsilon - cfg.initial_epsilon)
+
+    def _finish_seq(self, i: int):
+        """Pad the open sequence to T and push it to replay."""
+        cfg = self._algo_config
+        seq = self._seq_open[i]
+        n = len(seq["obs"])
+        if n == 0:
+            return
+        T = self._T
+        pad = T - n
+        obs = np.asarray(seq["obs"], np.float32)
+        if pad:
+            obs = np.concatenate([obs, np.zeros((pad, self.obs_dim), np.float32)])
+        item = {
+            "h0": seq["h0"],
+            "obs": obs,
+            "actions": np.pad(np.asarray(seq["actions"], np.int32), (0, pad)),
+            "rewards": np.pad(np.asarray(seq["rewards"], np.float32), (0, pad)),
+            "dones": np.pad(np.asarray(seq["dones"], np.float32), (0, pad), constant_values=1.0),
+            "mask": np.pad(np.ones(n, np.float32), (0, pad)),
+        }
+        self.buffer.add(item)
+        self._seq_open[i] = self._new_seq(self._hidden[i])
+
+    def training_step(self) -> dict:
+        import jax.numpy as jnp
+
+        cfg: R2D2Config = self._algo_config
+        metrics: dict = {}
+        for _ in range(cfg.rollout_steps_per_iter // self.n_envs):
+            obs = self.env.current_obs().astype(np.float32)
+            q, h_next = self._act(self.params, jnp.asarray(obs), jnp.asarray(self._hidden))
+            q = np.asarray(q)
+            actions = q.argmax(axis=-1)
+            eps_mask = self._rng.random(len(actions)) < self._epsilon()
+            actions = np.where(
+                eps_mask, self._rng.integers(0, self.action_dim, len(actions)), actions
+            )
+            next_obs, rewards, dones, _ = self.env.step(actions)
+            h_next = np.array(h_next)  # mutable copy (jax arrays are read-only)
+            for i in range(self.n_envs):
+                seq = self._seq_open[i]
+                seq["obs"].append(obs[i])
+                seq["actions"].append(actions[i])
+                seq["rewards"].append(rewards[i])
+                seq["dones"].append(float(dones[i]))
+                if dones[i]:
+                    h_next[i] = 0.0  # recurrent state resets with the episode
+                    self._hidden[i] = 0.0
+                    self._finish_seq(i)
+                elif len(seq["obs"]) >= self._T:
+                    self._hidden[i] = h_next[i]
+                    self._finish_seq(i)
+            self._hidden = h_next
+            self._timesteps_total += self.n_envs
+            if (
+                len(self.buffer) >= max(1, cfg.learning_starts // self._T)
+                and self._timesteps_total % max(1, cfg.train_intensity) < self.n_envs
+            ):
+                metrics = self._train_once()
+        stats_r, _ = self.env.pop_episode_stats()
+        self._episode_reward_window += stats_r
+        self._episode_reward_window = self._episode_reward_window[-100:]
+        metrics["epsilon"] = self._epsilon()
+        metrics["replay_sequences"] = len(self.buffer)
+        return metrics
+
+    def _train_once(self) -> dict:
+        import jax
+
+        cfg = self._algo_config
+        batch = self.buffer.sample(cfg.train_batch_size)
+        self.params, self.opt_state, aux = self._update_fn(
+            self.params, self._as_jax(self.target_params), self.opt_state, batch
+        )
+        self._updates += 1
+        if self._updates % cfg.target_network_update_freq == 0:
+            self.target_params = jax.tree_util.tree_map(np.asarray, self.params)
+        return {k: float(v) for k, v in aux.items()}
+
+    @staticmethod
+    def _as_jax(tree):
+        import jax
+        import jax.numpy as jnp
+
+        return jax.tree_util.tree_map(jnp.asarray, tree)
+
+    def step(self) -> dict:
+        import time
+
+        t0 = time.time()
+        result = self.training_step()
+        result["episode_reward_mean"] = (
+            float(np.mean(self._episode_reward_window)) if self._episode_reward_window else float("nan")
+        )
+        result["timesteps_total"] = self._timesteps_total
+        result["time_this_iter_s"] = time.time() - t0
+        return result
+
+    def compute_single_action(self, obs, explore: bool = False, state=None):
+        import jax.numpy as jnp
+
+        h = state if state is not None else np.zeros((1, self._algo_config.hidden_size), np.float32)
+        q, h2 = self._act(self.params, jnp.asarray(np.asarray(obs, np.float32))[None], jnp.asarray(h))
+        action = int(np.asarray(q)[0].argmax())
+        if state is not None:
+            return action, np.asarray(h2)
+        return action
+
+    def save_checkpoint(self):
+        from ray_tpu.air.checkpoint import Checkpoint
+
+        return Checkpoint.from_dict({
+            "params": self.params,
+            "target": self.target_params,
+            "opt_state": self.opt_state,
+            "timesteps": self._timesteps_total,
+            "updates": self._updates,
+        })
+
+    def load_checkpoint(self, checkpoint) -> None:
+        data = checkpoint.to_dict()
+        self.params = data["params"]
+        self.target_params = data["target"]
+        self.opt_state = data["opt_state"]
+        self._timesteps_total = data.get("timesteps", 0)
+        self._updates = data.get("updates", 0)
+
+    def cleanup(self) -> None:
+        self.env.close()

@@ -16,6 +16,7 @@ from ray_tpu.serve.api import (  # noqa: F401
     deployment,
     get_deployment_handle,
     http_address,
+    http_addresses,
     run,
     shutdown,
     start,
@@ -39,6 +40,7 @@ __all__ = [
     "get_multiplexed_model_id",
     "multiplexed",
     "http_address",
+    "http_addresses",
     "run",
     "shutdown",
     "start",

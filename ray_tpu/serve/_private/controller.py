@@ -53,6 +53,14 @@ class ServeController:
         self._epoch = 0
         self._epoch_cv = threading.Condition(self._lock)
         self._shutdown = False
+        # Proxy fleet (reference: _private/http_state.py HTTPProxyState
+        # manager): one ingress proxy actor per ALIVE node, health-checked
+        # and restarted on a DEDICATED thread — proxy starts/health probes
+        # block for seconds and must not stall replica reconciliation.
+        self._proxies: dict[str, dict] = {}
+        self._proxy_starting: set[str] = set()
+        self._http_cfg: tuple | None = None
+        self._proxy_thread: threading.Thread | None = None
         self._reconcile_thread = threading.Thread(
             target=self._reconcile_loop, name="serve-reconcile", daemon=True
         )
@@ -137,6 +145,142 @@ class ServeController:
     def _bump_epoch_locked(self):
         self._epoch += 1
         self._epoch_cv.notify_all()
+
+    # ------------------------------------------------------------------
+    # Proxy fleet (reference: _private/http_state.py:32 HTTPProxyStateManager
+    # + http_proxy.py:553 — one HTTPProxyActor per node, controller-managed)
+    # ------------------------------------------------------------------
+    def ensure_http(self, host: str = "127.0.0.1", port: int = 0) -> dict:
+        """Enable per-node ingress; returns node_id -> [host, port] once at
+        least one proxy is serving."""
+        with self._lock:
+            self._http_cfg = (host, port)
+            if self._proxy_thread is None or not self._proxy_thread.is_alive():
+                self._proxy_thread = threading.Thread(
+                    target=self._proxy_loop, name="serve-proxy-fleet", daemon=True
+                )
+                self._proxy_thread.start()
+        # First call waits for the initial proxy so serve.start() can hand
+        # back a usable address.
+        deadline = time.time() + 60
+        while time.time() < deadline and not self.proxy_addresses():
+            time.sleep(0.1)
+        return self.proxy_addresses()
+
+    def _proxy_loop(self):
+        while not self._shutdown:
+            try:
+                self._reconcile_proxies()
+            except Exception:
+                logger.exception("proxy reconcile failed")
+            time.sleep(1.0)
+
+    def proxy_addresses(self) -> dict:
+        with self._lock:
+            return {
+                nid: list(p["address"])
+                for nid, p in self._proxies.items()
+                if p.get("address") is not None
+            }
+
+    def _reconcile_proxies(self):
+        with self._lock:
+            cfg = self._http_cfg
+        if cfg is None:
+            return
+        host, port = cfg
+        try:
+            nodes = ray_tpu.nodes()
+        except Exception:
+            return
+        alive = {
+            n["node_id"] for n in nodes if str(n.get("state", "ALIVE")).upper() == "ALIVE"
+        }
+        with self._lock:
+            proxies = dict(self._proxies)
+        # Ingress on a dead node is gone with the node: forget it so routing
+        # (and http_address()) only ever names live proxies.
+        for nid in list(proxies):
+            if nid not in alive:
+                with self._lock:
+                    self._proxies.pop(nid, None)
+                try:
+                    ray_tpu.kill(proxies[nid]["handle"])
+                except Exception:
+                    pass
+        for nid in alive:
+            with self._lock:
+                if nid in self._proxy_starting:
+                    continue  # a start for this node is already in flight
+            rec = proxies.get(nid)
+            if rec is not None:
+                if time.time() - rec.get("checked", 0) < 5.0:
+                    continue
+                try:
+                    ray_tpu.get(rec["handle"].ready.remote(), timeout=5)
+                    with self._lock:
+                        if nid in self._proxies:
+                            self._proxies[nid]["checked"] = time.time()
+                    continue
+                except Exception:
+                    logger.warning("serve proxy on node %s failed health check", nid[:8])
+                    with self._lock:
+                        self._proxies.pop(nid, None)
+                    try:
+                        ray_tpu.kill(rec["handle"])
+                    except Exception:
+                        pass
+            self._start_proxy(nid, host, port)
+
+    def _start_proxy(self, node_id: str, host: str, port: int):
+        from ray_tpu.serve._private.common import CONTROLLER_NAME, PROXY_NAME
+        from ray_tpu.serve._private.http_proxy import HTTPProxy
+
+        # Unique name per incarnation: a dead proxy's name can linger in the
+        # GCS registry until death propagation completes.
+        name = f"{PROXY_NAME}:{node_id[:12]}:{uuid.uuid4().hex[:6]}"
+        handle = None
+        with self._lock:
+            if node_id in self._proxy_starting:
+                return
+            self._proxy_starting.add(node_id)
+        try:
+            cls = ray_tpu.remote(
+                num_cpus=0,
+                name=name,
+                max_concurrency=16,
+                scheduling_strategy=f"node:{node_id}",
+            )(HTTPProxy)
+            handle = cls.remote(CONTROLLER_NAME, host, port)
+            addr = ray_tpu.get(handle.address.remote(), timeout=30)
+            with self._lock:
+                self._proxies[node_id] = {
+                    "handle": handle,
+                    "address": tuple(addr),
+                    "checked": time.time(),
+                }
+            logger.info("serve proxy up on node %s at %s", node_id[:8], addr)
+        except Exception:
+            logger.exception("failed to start serve proxy on node %s", node_id[:8])
+            if handle is not None:
+                try:
+                    ray_tpu.kill(handle)  # don't leak a half-started proxy
+                except Exception:
+                    pass
+        finally:
+            with self._lock:
+                self._proxy_starting.discard(node_id)
+
+    def shutdown_proxies(self):
+        with self._lock:
+            proxies, self._proxies = dict(self._proxies), {}
+            self._http_cfg = None
+        for rec in proxies.values():
+            try:
+                ray_tpu.kill(rec["handle"])
+            except Exception:
+                pass
+        return True
 
     # ------------------------------------------------------------------
     # Metrics ingest (replicas push; reference: autoscaling_metrics.py)

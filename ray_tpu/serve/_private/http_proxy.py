@@ -104,6 +104,7 @@ class HTTPProxy:
                 )
                 await resp.prepare(request)
                 actor = self._router.handle_for(replica)
+                finished = False
                 try:
                     while True:
                         batch = await loop.run_in_executor(
@@ -113,14 +114,24 @@ class HTTPProxy:
                             ),
                         )
                         if batch is None:
+                            finished = True
                             break
                         for chunk in batch["chunks"]:
                             await resp.write(chunk)
                         if batch["done"]:
+                            finished = True
                             break
                 except Exception:
                     logger.exception("stream from %s aborted", deployment)
                 finally:
+                    if not finished:
+                        # Client disconnect / pump error: tear the stream
+                        # down now rather than leaving its generator to the
+                        # replica's 5-minute idle reaper.
+                        try:
+                            actor.cancel_stream.remote(sid)
+                        except Exception:
+                            pass
                     self._router.release(replica)
                 await resp.write_eof()
                 return resp

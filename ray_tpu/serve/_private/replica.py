@@ -10,8 +10,58 @@ depth reported to the controller for autoscaling.
 from __future__ import annotations
 
 import pickle
+import queue as _queue
 import threading
 import time
+
+
+class _StreamPump:
+    """Runs one response stream's generator on a dedicated thread,
+    prefetching into a bounded queue. The replica's RPC surface only ever
+    drains the queue with a short timeout, so a producer that stalls inside
+    its generator cannot head-of-line-block the replica's task slots (and a
+    disconnected client's pump dies on cancel, not the 5-minute reap)."""
+
+    def __init__(self, gen, model_id: str):
+        self.gen = gen
+        self.model_id = model_id
+        self.q: _queue.Queue = _queue.Queue(maxsize=8)  # backpressure bound
+        self.cancelled = threading.Event()
+        self.last_pump = time.time()
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+
+    def _put(self, item) -> bool:
+        while not self.cancelled.is_set():
+            try:
+                self.q.put(item, timeout=0.25)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def _run(self):
+        from ray_tpu.serve.multiplex import _set_multiplexed_model_id
+
+        # The generator body runs HERE: scope the multiplexed model id to
+        # this thread so concurrent requests can't bleed theirs in.
+        _set_multiplexed_model_id(self.model_id)
+        try:
+            for item in self.gen:
+                if not self._put(("chunk", _encode_chunk(item))):
+                    break
+            else:
+                self._put(("done", None))
+        except BaseException as e:  # delivered to the consumer, then re-raised
+            self._put(("error", e))
+        finally:
+            try:
+                self.gen.close()
+            except Exception:
+                pass
+
+    def cancel(self):
+        self.cancelled.set()
 
 
 class Replica:
@@ -108,59 +158,71 @@ class Replica:
                 self._reap_idle_streams_locked()
                 self._stream_counter += 1
                 sid = str(self._stream_counter)
-                self._streams[sid] = {
-                    "gen": gen,
-                    "model_id": multiplexed_model_id,
-                    "last_pump": time.time(),
-                }
+                self._streams[sid] = _StreamPump(gen, multiplexed_model_id)
             return {"__serve_stream__": sid, "content_type": ctype}
         return result
 
     def _reap_idle_streams_locked(self):
-        """A client that disconnected mid-stream stops the proxy's pump with
-        no cancel RPC; close + drop generators nobody pumped for 5 minutes
-        so their finalizers run and state doesn't accumulate."""
+        """Backstop for proxies that died mid-stream (normal disconnects
+        send cancel_stream): cancel pumps nobody drained for 5 minutes so
+        generator finalizers run and state doesn't accumulate."""
         now = time.time()
-        for sid, st in list(self._streams.items()):
-            if now - st["last_pump"] > 300.0:
+        for sid, pump in list(self._streams.items()):
+            if now - pump.last_pump > 300.0:
                 self._streams.pop(sid, None)
-                try:
-                    st["gen"].close()
-                except Exception:
-                    pass
+                pump.cancel()
 
     def next_stream_chunk(self, sid: str):
-        """Pump ONE item from a live response stream — returning on the
-        first produced item keeps time-to-first-byte at one-item latency (a
-        batch pump would buffer a slow producer's output into bursts).
-        Returns {"chunks": [bytes], "done": bool} or None for unknown
-        streams."""
-        from ray_tpu.serve.multiplex import _set_multiplexed_model_id
-
+        """Drain the stream's prefetch queue: block briefly for the first
+        chunk (one-item latency for time-to-first-byte), then sweep whatever
+        else is already buffered into the same response. Returns
+        {"chunks": [bytes], "done": bool} — empty chunks + done=False means
+        "nothing yet, poll again" — or None for unknown streams."""
         with self._lock:
-            st = self._streams.get(sid)
-            if st is not None:
-                st["last_pump"] = time.time()
-        if st is None:
+            pump = self._streams.get(sid)
+            if pump is not None:
+                pump.last_pump = time.time()
+        if pump is None:
             return None
-        # The generator body runs HERE, not in handle_request: re-scope the
-        # multiplexed model id so concurrent requests on this replica can't
-        # bleed their id into this stream's continuation.
-        _set_multiplexed_model_id(st["model_id"])
-        chunks = []
+        chunks: list[bytes] = []
         done = False
-        try:
-            chunks.append(_encode_chunk(next(st["gen"])))
-        except StopIteration:
-            done = True
-        except Exception:
+        error = None
+        block = True
+        while True:
+            try:
+                kind, payload = pump.q.get(timeout=0.5) if block else pump.q.get_nowait()
+            except _queue.Empty:
+                break
+            block = False
+            if kind == "chunk":
+                chunks.append(payload)
+            elif kind == "done":
+                done = True
+                break
+            else:  # error
+                error = payload
+                break
+        if error is not None and chunks:
+            # Deliver what the producer yielded BEFORE it raised; the error
+            # surfaces on the next poll (parity with the old per-item pump).
+            pump.q.put(("error", error))
+            return {"chunks": chunks, "done": False}
+        if done or error is not None:
             with self._lock:
                 self._streams.pop(sid, None)
-            raise
-        if done:
-            with self._lock:
-                self._streams.pop(sid, None)
+        if error is not None:
+            raise error
         return {"chunks": chunks, "done": done}
+
+    def cancel_stream(self, sid: str):
+        """Proxy-initiated teardown on client disconnect (reference: ASGI
+        disconnect -> request cancellation): stop the pump thread now
+        instead of waiting out the idle reaper."""
+        with self._lock:
+            pump = self._streams.pop(sid, None)
+        if pump is not None:
+            pump.cancel()
+        return True
 
     def get_metrics(self) -> dict:
         """Queue stats for autoscaling (reference: autoscaling_metrics.py)."""

@@ -26,7 +26,6 @@ from typing import Any, Callable, Optional
 import ray_tpu
 from ray_tpu.serve._private.common import (
     CONTROLLER_NAME,
-    PROXY_NAME,
     AutoscalingConfig,
     DeploymentConfig,
     DeploymentInfo,
@@ -123,25 +122,45 @@ def _coerce_autoscaling(cfg) -> Optional[AutoscalingConfig]:
 
 
 def start(http_host: str = "127.0.0.1", http_port: int = 0, detached: bool = True):
-    """Start the Serve control plane: controller actor + HTTP proxy actor."""
+    """Start the Serve control plane: controller actor + one HTTP proxy per
+    node (reference: http_state.py proxy fleet). The controller's reconcile
+    loop keeps a proxy on every ALIVE node and replaces unhealthy ones, so
+    ingress survives losing the node a proxy lives on."""
     global _started, _http_port
     if _started:
         return
     from ray_tpu.serve._private.controller import ServeController
-    from ray_tpu.serve._private.http_proxy import HTTPProxy
 
     controller_cls = ray_tpu.remote(num_cpus=0, name=CONTROLLER_NAME, max_concurrency=16)(ServeController)
     controller_cls.remote()
-    proxy_cls = ray_tpu.remote(num_cpus=0, name=PROXY_NAME, max_concurrency=16)(HTTPProxy)
-    proxy = proxy_cls.remote(CONTROLLER_NAME, http_host, http_port)
-    addr = ray_tpu.get(proxy.address.remote())
-    _http_port = addr[1]
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    addrs = ray_tpu.get(controller.ensure_http.remote(http_host, http_port), timeout=120)
+    deadline = time.time() + 60
+    while not addrs and time.time() < deadline:
+        time.sleep(0.5)
+        addrs = ray_tpu.get(controller.proxy_addresses.remote())
+    if not addrs:
+        raise RuntimeError("no serve proxy came up on any node")
+    _http_port = next(iter(addrs.values()))[1]
     _started = True
 
 
 def http_address() -> tuple:
-    controller = ray_tpu.get_actor(PROXY_NAME)
-    return tuple(ray_tpu.get(controller.address.remote()))
+    """Address of one live ingress proxy (prefer this node's)."""
+    from ray_tpu._private.worker_context import get_core_worker
+
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    addrs = ray_tpu.get(controller.proxy_addresses.remote())
+    if not addrs:
+        raise RuntimeError("no live serve proxies")
+    local = addrs.get(get_core_worker().node_id)
+    return tuple(local if local is not None else next(iter(addrs.values())))
+
+
+def http_addresses() -> dict:
+    """All live ingress proxies, node_id -> (host, port)."""
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    return {k: tuple(v) for k, v in ray_tpu.get(controller.proxy_addresses.remote()).items()}
 
 
 def run(app: Application, *, name: str = "default", route_prefix: Optional[str] = "__from_deployment__", _blocking: bool = True) -> DeploymentHandle:
@@ -283,13 +302,10 @@ def shutdown():
     try:
         if controller is None:
             raise RuntimeError("no controller")
+        ray_tpu.get(controller.shutdown_proxies.remote())
         ray_tpu.get(controller.graceful_shutdown.remote())
         time.sleep(0.2)
         ray_tpu.kill(controller)
-    except Exception:
-        pass
-    try:
-        ray_tpu.kill(ray_tpu.get_actor(PROXY_NAME))
     except Exception:
         pass
     Router.reset()

@@ -43,17 +43,31 @@ def _auto_id() -> str:
         return f"workflow-{int(time.time())}-{_counter[0]}"
 
 
-def run(dag, *args, workflow_id: str | None = None, **kwargs):
-    """Execute the DAG durably and return its output."""
+def run(
+    dag,
+    *args,
+    workflow_id: str | None = None,
+    max_retries: int = 0,
+    catch_exceptions: bool = False,
+    **kwargs,
+):
+    """Execute the DAG durably and return its output.
+
+    ``max_retries``/``catch_exceptions`` are run-level defaults for every
+    step; per-step values via ``node.options(max_retries=...,
+    catch_exceptions=...)`` win (reference: workflow.options)."""
     wid = workflow_id or _auto_id()
     storage = WorkflowStorage(wid)
     if storage.has_output():
         # idempotent re-run of a finished workflow returns the stored output
         return storage.load_output()
-    storage.save_dag((dag, args, kwargs))
+    storage.save_dag((dag, args, kwargs, {"max_retries": max_retries, "catch_exceptions": catch_exceptions}))
     storage.save_status("RUNNING")
     try:
-        return execute_workflow(storage, dag, args, kwargs)
+        return execute_workflow(
+            storage, dag, args, kwargs,
+            max_retries=max_retries, catch_exceptions=catch_exceptions,
+        )
     except BaseException:
         storage.save_status("FAILED")
         raise
@@ -74,10 +88,16 @@ def resume(workflow_id: str):
         return storage.load_output()
     if not storage.has_dag():
         raise ValueError(f"workflow '{workflow_id}' not found in storage")
-    dag, args, kwargs = storage.load_dag()
+    loaded = storage.load_dag()
+    # Older logs stored (dag, args, kwargs); newer ones append run options.
+    if len(loaded) == 4:
+        dag, args, kwargs, opts = loaded
+    else:
+        dag, args, kwargs = loaded
+        opts = {}
     storage.save_status("RUNNING")
     try:
-        return execute_workflow(storage, dag, args, kwargs)
+        return execute_workflow(storage, dag, args, kwargs, **opts)
     except BaseException:
         storage.save_status("FAILED")
         raise

@@ -1,31 +1,130 @@
 """Durable DAG executor.
 
 Analog of the reference's WorkflowExecutor (python/ray/workflow/
-workflow_executor.py:32): walks a ``ray_tpu.dag`` graph in deterministic
-topological order, submits each FunctionNode as a task, materializes and
-persists every step result before its dependents consume it, and skips steps
-whose results are already in storage — which is exactly what makes
-``workflow.resume`` a replay of the log.
+workflow_executor.py:32): submits every unfinished FunctionNode eagerly
+(independent branches run CONCURRENTLY), persists each step result the
+moment it completes (completion order, not submission order — a crash
+mid-run loses only unfinished steps), and skips steps whose results are
+already in storage, which is what makes ``workflow.resume`` a replay of
+the log.
 
-Step identity is (topological index, function name): stable for the same DAG
-because ``DAGNode.topological_order`` is a deterministic post-order.
+Step identity is CONTENT-DERIVED (reference: workflow step names +
+checkpoint identity): a hash of the step function's source, its static
+arguments, its options, and its dependencies' step ids. Editing the DAG
+(different code, args, or wiring) therefore changes the id and re-runs the
+step — a positional id would silently replay a stale result into new code.
+
+Failure semantics (reference: workflow error handling, api.py options):
+- ``max_retries`` — application exceptions re-run the step N times (rides
+  the task layer's retry_exceptions machinery);
+- ``catch_exceptions`` — the step's consumers receive ``(result, None)``
+  or ``(None, exception)`` instead of the workflow failing.
+Both are per-step via ``node.options(...)`` with run-level defaults.
 """
 
 from __future__ import annotations
 
-from ray_tpu.dag.dag_node import ClassMethodNode, ClassNode, FunctionNode, InputNode
+import hashlib
+import inspect
+
+from ray_tpu.dag.dag_node import ClassMethodNode, ClassNode, DAGNode, FunctionNode, InputNode
 from ray_tpu.workflow.workflow_storage import WorkflowStorage
 
-
-def _step_id(index: int, node) -> str:
-    if isinstance(node, FunctionNode):
-        name = node._remote_fn.underlying_function.__name__
-    else:
-        name = type(node).__name__
-    return f"{index}_{name}"
+_catch_task = None
 
 
-def execute_workflow(storage: WorkflowStorage, dag, input_args, input_kwargs):
+def _get_catch_task():
+    """A tiny task that boxes a step's outcome as (result, error)."""
+    global _catch_task
+    if _catch_task is None:
+        import ray_tpu
+
+        @ray_tpu.remote(num_cpus=0)
+        def __workflow_catch__(boxed):
+            import ray_tpu as _r
+            from ray_tpu.exceptions import TaskError
+
+            try:
+                return (_r.get(boxed[0]), None)
+            except TaskError as e:
+                # The consumer wants the APPLICATION exception (reference:
+                # catch_exceptions yields the original error).
+                return (None, e.cause if e.cause is not None else e)
+            except Exception as e:  # noqa: BLE001 — the caught value IS the product
+                return (None, e)
+
+        _catch_task = __workflow_catch__
+    return _catch_task
+
+
+def _fingerprint(value, ids: dict) -> bytes:
+    """Stable-ish bytes for a bound argument. DAGNodes — INCLUDING nodes
+    nested inside lists/tuples/dicts, which _resolved_args supports — map
+    to their step ids so a changed dependency propagates into every
+    consumer's id. Leaves pickle (not repr: default reprs embed object
+    ADDRESSES and truncate arrays); unpicklable leaves fall back to the
+    type name — coarse, but deterministic."""
+    if isinstance(value, DAGNode):
+        return b"node:" + ids[id(value)].encode()
+    if isinstance(value, (list, tuple)):
+        return (
+            type(value).__name__.encode()
+            + b"["
+            + b",".join(_fingerprint(v, ids) for v in value)
+            + b"]"
+        )
+    if isinstance(value, dict):
+        return b"{" + b",".join(
+            _fingerprint(k, ids) + b":" + _fingerprint(v, ids)
+            for k, v in sorted(value.items(), key=lambda kv: repr(kv[0]))
+        ) + b"}"
+    import cloudpickle
+
+    try:
+        return cloudpickle.dumps(value)
+    except Exception:
+        return f"<{type(value).__module__}.{type(value).__qualname__}>".encode()
+
+
+def _content_ids(order: list) -> dict:
+    """id(node) -> content-derived step id, deterministic for a given DAG."""
+    ids: dict[int, str] = {}
+    seen: dict[str, int] = {}
+    for node in order:
+        h = hashlib.sha1()
+        if isinstance(node, FunctionNode):
+            fn = node._remote_fn.underlying_function
+            try:
+                h.update(inspect.getsource(fn).encode())
+            except (OSError, TypeError):
+                h.update(getattr(fn, "__qualname__", "fn").encode())
+            name = fn.__name__
+        else:
+            name = type(node).__name__
+            h.update(name.encode())
+        for value in node._bound_args:
+            h.update(_fingerprint(value, ids))
+        for key, value in sorted(node._bound_kwargs.items()):
+            h.update(f"{key}=".encode() + _fingerprint(value, ids))
+        for key, value in sorted(getattr(node, "_options", {}).items()):
+            h.update(f"opt:{key}={value!r}".encode())
+        base = f"{name}-{h.hexdigest()[:12]}"
+        # Two textually identical steps are distinct executions: suffix by
+        # occurrence so both run (and both checkpoint).
+        n = seen.get(base, 0)
+        seen[base] = n + 1
+        ids[id(node)] = base if n == 0 else f"{base}-{n}"
+    return ids
+
+
+def execute_workflow(
+    storage: WorkflowStorage,
+    dag,
+    input_args,
+    input_kwargs,
+    max_retries: int = 0,
+    catch_exceptions: bool = False,
+):
     """Run (or resume) the DAG durably; returns the final output."""
     import ray_tpu
 
@@ -36,31 +135,57 @@ def execute_workflow(storage: WorkflowStorage, dag, input_args, input_kwargs):
                 "workflows support function nodes only (durable replay of "
                 "actor state is not defined); got " + type(node).__name__
             )
+    step_ids = _content_ids(order)
 
     ctx = {"input_args": tuple(input_args), "input_kwargs": dict(input_kwargs)}
     results = {}
     ctx["_results"] = results
     # Pass 1: submit every unfinished step eagerly, passing ObjectRefs of
-    # earlier steps straight through — independent branches run concurrently
-    # (a crash loses only results not yet persisted; resume re-runs those,
-    # i.e. at-least-once execution, same as the reference).
-    submitted = []
-    for idx, node in enumerate(order):
-        sid = _step_id(idx, node)
-        if isinstance(node, FunctionNode) and storage.has_step_result(sid):
-            results[id(node)] = storage.load_step_result(sid)
-            continue
-        args, kwargs = node._resolved_args(results)
-        value = node._execute_impl(args, kwargs, ctx)
+    # earlier steps straight through — independent branches run concurrently.
+    pending: dict = {}  # ref -> (sid, node)
+    for node in order:
         if isinstance(node, FunctionNode):
-            submitted.append((sid, node, value))
-        results[id(node)] = value
+            sid = step_ids[id(node)]
+            if storage.has_step_result(sid):
+                results[id(node)] = storage.load_step_result(sid)
+                continue
+            args, kwargs = node._resolved_args(results)
+            opts = {k: v for k, v in node._options.items() if k != "catch_exceptions"}
+            catch = bool(node._options.get("catch_exceptions", catch_exceptions))
+            retries = opts.get("max_retries", max_retries)
+            if retries:
+                opts["max_retries"] = retries
+                opts.setdefault("retry_exceptions", True)
+            fn = node._remote_fn.options(**opts) if opts else node._remote_fn
+            ref = fn.remote(*args, **kwargs)
+            if catch:
+                # Consumers see (result, error); boxing the ref defers its
+                # materialization into the catch task itself.
+                ref = _get_catch_task().remote([ref])
+            pending[ref] = (sid, node)
+            results[id(node)] = ref
+        else:
+            args, kwargs = node._resolved_args(results)
+            results[id(node)] = node._execute_impl(args, kwargs, ctx)
 
-    # Pass 2: materialize + persist each step result in submission order.
-    for sid, node, ref in submitted:
-        value = ray_tpu.get(ref)
+    # Pass 2: persist step results in COMPLETION order — a crash mid-run
+    # keeps every step that finished, whatever branch it was on.
+    first_error = None
+    remaining = dict(pending)
+    while remaining:
+        done, _ = ray_tpu.wait(list(remaining.keys()), num_returns=1)
+        ref = done[0]
+        sid, node = remaining.pop(ref)
+        try:
+            value = ray_tpu.get(ref)
+        except Exception as e:  # noqa: BLE001 — recorded, then re-raised below
+            if first_error is None:
+                first_error = e
+            continue
         storage.save_step_result(sid, value)
         results[id(node)] = value
+    if first_error is not None:
+        raise first_error
 
     # Pass 3: non-function nodes (input projections, MultiOutput) captured
     # refs during pass 1; recompute them over materialized values (pure).

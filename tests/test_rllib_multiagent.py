@@ -35,7 +35,6 @@ def test_apex_dqn_learns_cartpole(ray_cluster):
             train_rounds_per_iter=10,
             updates_per_round=8,
             weight_sync_period_updates=16,
-            epsilon_timesteps=4000,
         )
         .debugging(seed=0)
     )
