@@ -181,6 +181,9 @@ class CoreWorker:
         # Direct task transport (lease_manager.py), created on first
         # eligible submit.
         self._lease_mgr = None
+        # Last (job, task name) announced to the log pipeline (in-band
+        # attribution).
+        self._log_attr_name: tuple | None = None
 
         # Actor-call transport state.
         self._actor_clients: dict[str, RpcClient] = {}
@@ -1457,6 +1460,19 @@ class CoreWorker:
 
     def execute_task(self, spec: TaskSpec) -> dict:
         """Run one task; returns the task_done payload."""
+        if (
+            self.mode == WORKER
+            and spec.task_type == NORMAL_TASK
+            and (spec.job_id, spec.name) != self._log_attr_name
+        ):
+            # In-band log attribution for the driver's log pipeline: leased
+            # tasks never pass through the raylet, so the "(name pid=...)"
+            # prefix source must travel with the stdout stream itself
+            # (log_monitor.py parses and strips this control line). Keyed by
+            # (job, name): a reused worker crossing jobs must re-announce
+            # even when the task name repeats.
+            self._log_attr_name = (spec.job_id, spec.name)
+            print(f"\x01attr:{spec.job_id}:{spec.name}", flush=True)
         ctx = (TaskID.from_hex(spec.task_id), spec)
         token = _exec_ctx.set(ctx)
         with self._active_exec_lock:

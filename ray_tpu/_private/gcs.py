@@ -68,6 +68,7 @@ class GcsServer:
         if persist_path and os.path.exists(persist_path):
             self._load_snapshot()
             self._io.spawn(self._recover_loaded_actors())
+            self._io.spawn(self._recover_loaded_pgs())
         self._persist_task = (
             self._io.spawn(self._persist_loop()) if persist_path else None
         )
@@ -92,6 +93,8 @@ class GcsServer:
             "store_usage": {},
         }
         await self._publish("node_updates", {"node_id": node_id, "state": "ALIVE"})
+        # New capacity may make parked placement groups feasible.
+        asyncio.ensure_future(self._retry_pending_pgs())
         return {"ok": True}
 
     @schema(node_id=str)
@@ -430,6 +433,22 @@ class GcsServer:
         return {"ok": ok, "state": self.placement_groups[pg_id]["state"]}
 
     async def _schedule_placement_group(self, pg_id: str) -> bool:
+        # In-flight guard: concurrent retries (two nodes registering in the
+        # same window both kick _retry_pending_pgs) must not run the 2PC
+        # twice — prepare_bundle is not idempotent and a double
+        # prepare+commit double-acquires the bundle's resources.
+        inflight = getattr(self, "_pg_scheduling", None)
+        if inflight is None:
+            inflight = self._pg_scheduling = set()
+        if pg_id in inflight:
+            return False
+        inflight.add(pg_id)
+        try:
+            return await self._schedule_placement_group_inner(pg_id)
+        finally:
+            inflight.discard(pg_id)
+
+    async def _schedule_placement_group_inner(self, pg_id: str) -> bool:
         pg = self.placement_groups[pg_id]
         bundles, strategy = pg["bundles"], pg["strategy"]
         alive = [(nid, n) for nid, n in self.nodes.items() if n["state"] == "ALIVE"]
@@ -691,12 +710,45 @@ class GcsServer:
             except Exception:
                 logger.exception("recovery scheduling of actor %s failed", aid[:8])
 
+    async def _retry_pending_pgs(self):
+        """Drive parked (infeasible) placement groups; called on node join
+        and after a restore (reference: GcsPlacementGroupManager retries
+        pending PGs on node add, gcs_placement_group_manager.cc)."""
+        for pg_id, pg in list(self.placement_groups.items()):
+            if pg.get("state") == "PENDING":
+                try:
+                    await self._schedule_placement_group(pg_id)
+                except Exception:
+                    logger.exception("pending PG %s retry failed", pg_id[:8])
+
+    async def _recover_loaded_pgs(self):
+        """Re-drive placement groups snapshotted mid-creation: a PG restored
+        as PENDING would otherwise wait for a node JOIN that may never come
+        (the raylets merely re-register). CREATED PGs need nothing — their
+        bundles live on the surviving raylets, which keep their node ids."""
+        if not any(pg.get("state") == "PENDING" for pg in self.placement_groups.values()):
+            return
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if any(n["state"] == "ALIVE" for n in self.nodes.values()):
+                break
+            await asyncio.sleep(0.2)
+        await asyncio.sleep(self.cfg.gcs_actor_recovery_grace_s)
+        await self._retry_pending_pgs()
+
     async def _persist_loop(self):
+        """Mutation-triggered snapshots with a short debounce (the analog of
+        the reference's write-through Redis store, gcs_table_storage.h:
+        every committed mutation is durable). Heartbeats don't bump
+        _mutations, so the steady-state cost is one integer compare per
+        tick; a mutation burst coalesces into one snapshot ~150ms later —
+        the crash-loss window is that debounce, not a fixed 2s period."""
         saved_at = -1
         while True:
-            await asyncio.sleep(2.0)
+            await asyncio.sleep(0.1)
             if self._mutations == saved_at:
                 continue  # nothing changed since the last snapshot
+            await asyncio.sleep(0.05)  # coalesce the rest of the burst
             try:
                 saved_at = self._mutations
                 self._do_save()

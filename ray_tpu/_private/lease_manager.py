@@ -156,7 +156,11 @@ class LeaseManager:
         for lease in list(shape.leases.values()):
             if not shape.queue:
                 break
-            await self._feed(lease)
+            # Fire-and-forget: _feed pops its chunk synchronously (single
+            # loop, no race) and then awaits the worker RPC — awaiting it
+            # HERE would let one dead worker's 15s timeout head-of-line
+            # block every other shape/lease in the batch.
+            _bg(self._feed(lease))
         want = min(len(shape.queue), self.cfg.lease_max_per_shape) - (
             len(shape.leases) + len(shape.pending_requests)
         )

@@ -87,20 +87,44 @@ class LogMonitor:
             lines = [l.decode(errors="replace").rstrip("\r\n")[:MAX_LINE_LEN] for l in raw_lines]
             if not lines:
                 continue
-            message = {
-                "lines": lines,
-                "is_err": path.endswith(".err"),
-                "pid": worker.pid if worker else 0,
-                "node_id": self.raylet.node_id,
-                "job_id": getattr(worker, "last_job_id", None) if worker else None,
-                "name": getattr(worker, "last_task_name", None) if worker else None,
-            }
-            try:
-                await self.raylet.gcs.acall(
-                    "publish", {"channel": "worker_logs", "message": message}
-                )
-            except Exception:
-                pass
+            # Leased workers execute tasks the raylet never sees
+            # individually, so attribution rides IN-BAND: the worker emits
+            # "\x01attr:<job>:<task-name>" when its current task changes
+            # (core_worker.execute_task) and the batch splits there.
+            cur_name = getattr(worker, "last_task_name", None) if worker else None
+            cur_job = getattr(worker, "last_job_id", None) if worker else None
+            segments: list = []
+            cur: list = []
+            for line in lines:
+                if line.startswith("\x01attr:"):
+                    if cur:
+                        segments.append((cur, cur_name, cur_job))
+                        cur = []
+                    parts = line[len("\x01attr:"):].split(":", 1)
+                    if len(parts) == 2:
+                        cur_job, cur_name = parts[0] or cur_job, parts[1]
+                        if worker is not None:
+                            worker.last_job_id = cur_job
+                            worker.last_task_name = cur_name
+                    continue
+                cur.append(line)
+            if cur:
+                segments.append((cur, cur_name, cur_job))
+            for seg_lines, name, job in segments:
+                message = {
+                    "lines": seg_lines,
+                    "is_err": path.endswith(".err"),
+                    "pid": worker.pid if worker else 0,
+                    "node_id": self.raylet.node_id,
+                    "job_id": job,
+                    "name": name,
+                }
+                try:
+                    await self.raylet.gcs.acall(
+                        "publish", {"channel": "worker_logs", "message": message}
+                    )
+                except Exception:
+                    pass
 
 
 def print_worker_logs(message: dict, own_job_id: str):

@@ -120,7 +120,17 @@ class MADDPG(Algorithm):
         stack = lambda trees: jax.tree_util.tree_map(lambda *xs: np.stack(xs), *trees)  # noqa: E731
         self.params = {"actor": stack(actor), "critic": stack(critic)}
         self.target_params = jax.tree_util.tree_map(np.copy, self.params)
-        self.tx = optax.chain(optax.clip_by_global_norm(0.5), optax.adam(cfg.lr))
+        # Split learning rates (standard MADDPG: critics usually train
+        # faster than actors) via per-subtree transforms.
+        self.tx = optax.multi_transform(
+            {
+                "actor": optax.chain(optax.clip_by_global_norm(0.5), optax.adam(cfg.lr)),
+                "critic": optax.chain(
+                    optax.clip_by_global_norm(0.5), optax.adam(cfg.critic_lr)
+                ),
+            },
+            param_labels={"actor": "actor", "critic": "critic"},
+        )
         self.opt_state = self.tx.init(self.params)
         self.buffer = _Replay(cfg.replay_buffer_capacity, cfg.seed)
         self._timesteps_total = 0

@@ -143,7 +143,7 @@ class R2D2Config(AlgorithmConfig):
         self.num_rollout_workers = 0
         self.train_batch_size = 32          # sequences per update
         self.replay_buffer_capacity = 4000  # sequences
-        self.learning_starts = 200          # sequences buffered before training
+        self.learning_starts = 500          # env STEPS buffered before training
         self.target_network_update_freq = 200
         self.rollout_steps_per_iter = 1000
         self.train_intensity = 40           # env steps per update
@@ -324,7 +324,9 @@ class R2D2(Algorithm):
             self._hidden = h_next
             self._timesteps_total += self.n_envs
             if (
-                len(self.buffer) >= max(1, cfg.learning_starts // self._T)
+                # learning_starts counts ENV STEPS (reference semantics);
+                # the buffer stores sequences of up to T steps each.
+                len(self.buffer) * self._T >= max(self._T, cfg.learning_starts)
                 and self._timesteps_total % max(1, cfg.train_intensity) < self.n_envs
             ):
                 metrics = self._train_once()

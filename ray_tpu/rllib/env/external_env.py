@@ -170,7 +170,11 @@ class ExternalEnvRunner:
             batch = self.env.poll_batch(timeout=0.5)
             if batch is None:
                 continue
-            self.algorithm.buffer.add(batch)
+            # The DQN-family replay stores transition columns only; EPS_ID
+            # (kept on poll_batch() for offline-dataset consumers) would
+            # diverge from a buffer initialized by internal rollouts.
+            replay = SampleBatch({k: v for k, v in batch.items() if k != EPS_ID})
+            self.algorithm.buffer.add(replay)
             n = len(batch[REWARDS])
             steps += n
             self.algorithm._timesteps_total += n

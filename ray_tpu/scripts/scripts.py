@@ -605,10 +605,24 @@ def cmd_stack(args):
 
     import psutil
 
-    workers = [
-        p for p in psutil.process_iter(["pid", "cmdline"])
-        if any("ray_tpu._private.worker_main" in (c or "") for c in (p.info["cmdline"] or []))
-    ]
+    def _is_worker(p):
+        cmd = " ".join(p.info["cmdline"] or [])
+        if "ray_tpu._private.worker_main" in cmd:
+            return True
+        if "ray_tpu._private.zygote" in cmd:
+            # Fork-server children keep the zygote's cmdline: a WORKER is a
+            # process whose parent is also a zygote process (the fork-server
+            # listener itself is a child of the raylet, not of a zygote).
+            try:
+                parent = p.parent()
+                return parent is not None and "ray_tpu._private.zygote" in " ".join(
+                    parent.cmdline()
+                )
+            except Exception:
+                return False
+        return False
+
+    workers = [p for p in psutil.process_iter(["pid", "cmdline"]) if _is_worker(p)]
     if not workers:
         print("no live ray_tpu workers on this host")
         return
