@@ -211,6 +211,33 @@ def get_many_objects_stress(results, n_objects):
     ray_tpu.shutdown()
 
 
+def shuffle_stress(results, n_rows, n_blocks):
+    """Dataset shuffle throughput, pull-based vs push-based (reference:
+    push_based_shuffle.py + shuffle nightly suites)."""
+    import ray_tpu
+    from ray_tpu import data
+    from ray_tpu.data.context import DataContext
+
+    ray_tpu.init(num_cpus=4, object_store_memory=512 * 1024 * 1024)
+    ctx = DataContext.get_current()
+    try:
+        # Warmup: spawn the worker pool so the first timed mode doesn't pay
+        # cluster cold-start.
+        data.range(1000, parallelism=4).random_shuffle(seed=0).count()
+        for label, flag in (("pull", False), ("push", True)):
+            ctx.use_push_based_shuffle = flag
+            t0 = time.perf_counter()
+            ds = data.range(n_rows, parallelism=n_blocks).random_shuffle(seed=0)
+            assert ds.count() == n_rows
+            dt = time.perf_counter() - t0
+            results[f"shuffle_{label}_rows_per_s"] = round(n_rows / dt, 1)
+        results["shuffle_rows"] = n_rows
+        results["shuffle_blocks"] = n_blocks
+    finally:
+        ctx.use_push_based_shuffle = None
+        ray_tpu.shutdown()
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--round", type=int, default=int(os.environ.get("GRAFT_ROUND", "2")))
@@ -238,6 +265,8 @@ def main():
         ("many_args", lambda: many_args_stress(results, n_args)),
         ("many_returns", lambda: many_returns_stress(results, n_returns)),
         ("get_many", lambda: get_many_objects_stress(results, n_get)),
+        ("shuffle", lambda: shuffle_stress(
+            results, 50_000 if args.quick else 500_000, 8 if args.quick else 32)),
         ("broadcast", lambda: broadcast_stress(results, mib, n_nodes)),
     ]:
         t0 = time.perf_counter()

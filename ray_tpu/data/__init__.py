@@ -28,6 +28,7 @@ from ray_tpu.data.read_api import (  # noqa: F401
     read_json,
     read_numpy,
     read_parquet,
+    read_sql,
     read_text,
     read_tfrecords,
 )
@@ -61,6 +62,7 @@ __all__ = [
     "read_json",
     "read_numpy",
     "read_parquet",
+    "read_sql",
     "read_text",
     "read_tfrecords",
 ]

@@ -119,14 +119,22 @@ class ExecutionContext:
         self.per_op_budget_blocks = per_op_budget_blocks or 2 * max_tasks_in_flight
         # Observability for tests/stats: high-water marks per run.
         self.stats = {"max_inter_op_queued": 0, "max_inflight": 0}
+        # Per-op execution stats (reference: DatasetStats, stats.py:117).
+        from ray_tpu.data._internal.stats import DatasetStats
+
+        self.dataset_stats = DatasetStats()
 
 
 class _PhysicalMapOp:
     """Task-pool (or actor-pool) map stage with bounded in-flight tasks."""
 
     def __init__(self, logical: MapTransform, ctx: ExecutionContext):
+        from ray_tpu.data._internal.stats import OpStats
+
         self.logical = logical
         self.ctx = ctx
+        self.op_stats = OpStats(name=logical.name)
+        ctx.dataset_stats.op_stats.append(self.op_stats)
         self.input: collections.deque = collections.deque()
         self.in_flight: dict = {}  # watch_ref -> (index, meta_ref_pair)
         self.output: dict = {}  # index -> bundle
@@ -174,11 +182,14 @@ class _PhysicalMapOp:
                     .remote(self.logical.block_fn, block_ref)
                 )
             self.in_flight[refs[1]] = (index, refs)
+            self.op_stats.mark_start()
+            self.op_stats.num_tasks += 1
             n += 1
 
     def complete(self, watch_ref):
         index, refs = self.in_flight.pop(watch_ref)
         meta = ray_tpu.get(refs[1])
+        self.op_stats.record_output(meta)
         self.output[index] = (refs[0], meta)
 
     @property
@@ -188,8 +199,12 @@ class _PhysicalMapOp:
 
 class _PhysicalReadOp:
     def __init__(self, logical: Read, ctx: ExecutionContext):
+        from ray_tpu.data._internal.stats import OpStats
+
         self.logical = logical
         self.ctx = ctx
+        self.op_stats = OpStats(name=logical.name)
+        ctx.dataset_stats.op_stats.append(self.op_stats)
         self.input = collections.deque(enumerate(logical.read_tasks))
         self.in_flight: dict = {}
         self.output: dict = {}
@@ -208,11 +223,14 @@ class _PhysicalReadOp:
                 .remote(read_task)
             )
             self.in_flight[refs[1]] = (index, refs)
+            self.op_stats.mark_start()
+            self.op_stats.num_tasks += 1
             n += 1
 
     def complete(self, watch_ref):
         index, refs = self.in_flight.pop(watch_ref)
         meta = ray_tpu.get(refs[1])
+        self.op_stats.record_output(meta)
         self.output[index] = (refs[0], meta)
 
     @property
@@ -245,6 +263,11 @@ def execute_streaming(plan, ctx: Optional[ExecutionContext] = None) -> Iterator[
             # Barrier: drain current streaming suffix into bundles first.
             bundles = _drain(bundles, stream_ops, ctx)
             stream_ops = []
+            from ray_tpu.data._internal.stats import OpStats
+
+            op_stats = OpStats(name=op.name)
+            ctx.dataset_stats.op_stats.append(op_stats)
+            op_stats.mark_start()
             if isinstance(op, AllToAll):
                 bundles = op.bulk_fn(bundles)
             elif isinstance(op, Union):
@@ -255,6 +278,8 @@ def execute_streaming(plan, ctx: Optional[ExecutionContext] = None) -> Iterator[
                 bundles = _zip_bundles(bundles, other)
             elif isinstance(op, Limit):
                 bundles = _apply_limit(bundles, op.limit)
+            for _, meta in bundles:
+                op_stats.record_output(meta)
         else:
             raise TypeError(f"unknown logical op {op}")
         i += 1

@@ -74,9 +74,71 @@ def _shuffle(bundles, map_fn, map_args, reduce_fn, reduce_args, num_outputs) -> 
     return [(refs[0], ray_tpu.get(refs[1])) for refs in out]
 
 
+def _merge_parts(*parts):
+    """Merge-stage combine (push-based shuffle): concat one round's shards
+    of one output partition."""
+    return BlockAccessor.concat(list(parts))
+
+
+def push_based_shuffle(
+    bundles,
+    num_outputs: Optional[int] = None,
+    seed: Optional[int] = None,
+    merge_factor: Optional[int] = None,
+) -> list:
+    """Three-stage map -> merge -> reduce shuffle (reference:
+    data/_internal/push_based_shuffle.py:1).
+
+    The plain 2-stage shuffle gives every reducer fan-in = num_maps: at M
+    map blocks each reducer concatenates M tiny shards, and the object
+    store holds M*N intermediate objects at once. Here map outputs are
+    combined by INTERMEDIATE merge tasks in rounds of ``merge_factor``
+    (default ~sqrt(M)), so reducer fan-in drops to ceil(M/merge_factor)
+    and merging pipelines with mapping — a merge round only depends on its
+    own round's maps, so it starts while later rounds still run (our
+    submitter-side dependency resolution provides the reference's
+    pipelined scheduling for free)."""
+    if not bundles:
+        return []
+    n = num_outputs or max(1, len(bundles))
+    num_maps = len(bundles)
+    factor = merge_factor or max(2, int(np.sqrt(num_maps)))
+    if n == 1:
+        map_tasks = [
+            [ray_tpu.remote(num_returns=1)(_map_single).remote(ref, _map_random, n, seed)]
+            for ref, _ in bundles
+        ]
+    else:
+        map_tasks = [
+            ray_tpu.remote(num_returns=n)(_map_random).remote(ref, n, seed)
+            for ref, _ in bundles
+        ]
+    rounds = [map_tasks[i : i + factor] for i in range(0, num_maps, factor)]
+    out = []
+    sub = seed if seed is not None else None
+    for p in range(n):
+        merged = [
+            ray_tpu.remote(num_returns=1)(_merge_parts).remote(*[m[p] for m in rnd])
+            for rnd in rounds
+        ]
+        refs = ray_tpu.remote(num_returns=2)(_reduce_concat).remote(sub, *merged)
+        out.append(refs)
+    return [(refs[0], ray_tpu.get(refs[1])) for refs in out]
+
+
 def random_shuffle(bundles, num_outputs: Optional[int] = None, seed: Optional[int] = None) -> list:
+    from ray_tpu.data.context import DataContext
+
     n = num_outputs or max(1, len(bundles))
     sub = seed if seed is not None else None
+    ctx = DataContext.get_current()
+    # Default OFF, like the reference (RAY_DATA_PUSH_BASED_SHUFFLE): the
+    # merge stage adds R*N tasks, which only pays for itself when reducer
+    # fan-in would otherwise pressure the object store / network — i.e.
+    # wide multi-node shuffles, not single-host runs (microbench tracks the
+    # crossover as shuffle_{pull,push}_rows_per_s).
+    if ctx.use_push_based_shuffle:
+        return push_based_shuffle(bundles, num_outputs, seed)
     return _shuffle(bundles, _map_random, (n, seed), _reduce_concat, (sub,), n)
 
 

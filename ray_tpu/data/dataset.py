@@ -199,7 +199,11 @@ class Dataset:
     # ------------------------------------------------------------------
     def _execute(self) -> list:
         if self._cached_bundles is None:
-            self._cached_bundles = list(execute_streaming(self._plan))
+            from ray_tpu.data._internal.executor import ExecutionContext
+
+            ctx = ExecutionContext()
+            self._cached_bundles = list(execute_streaming(self._plan, ctx))
+            self._last_stats = ctx.dataset_stats
         return self._cached_bundles
 
     def iter_internal_refs(self) -> Iterator[tuple]:
@@ -212,13 +216,21 @@ class Dataset:
         bundles = self._execute()
         out = Dataset(InputData(name="InputData", input_op=None, bundles=bundles))
         out._cached_bundles = bundles
+        # ds.materialize().stats() must show the execution that produced it.
+        out._last_stats = getattr(self, "_last_stats", None)
         return out
 
     def stats(self) -> str:
+        """Per-operator execution summary (reference: DatasetStats,
+        data/_internal/stats.py:117)."""
         bundles = self._execute()
         total = sum(m.num_rows for _, m in bundles)
         sz = sum(m.size_bytes for _, m in bundles)
-        return f"Dataset: {len(bundles)} blocks, {total} rows, {sz} bytes"
+        totals = f"Dataset: {len(bundles)} blocks, {total} rows, {sz} bytes"
+        last = getattr(self, "_last_stats", None)
+        if last is None or not last.op_stats:
+            return totals
+        return last.summary_string(totals)
 
     # ------------------------------------------------------------------
     # Consumption
@@ -494,6 +506,22 @@ class Dataset:
             np.save(fname, BlockAccessor.for_block(block).to_numpy([column])[column])
 
         return self._write(path, write_one, "npy")
+
+    def write_sql(self, table: str, connection_factory: Callable) -> int:
+        """Insert every row into a SQL table via DB-API 2.0 connections
+        (reference: Dataset.write_sql). Connections are created INSIDE the
+        write tasks — pass a factory, not a live handle. SQLite note: its
+        writer lock serializes concurrent INSERTs, so blocks write from
+        parallel tasks but commit sequentially."""
+        from ray_tpu.data.datasource.sql_datasource import write_sql_block
+
+        refs = [
+            ray_tpu.remote(num_returns=1)(write_sql_block).remote(
+                ref, table, connection_factory
+            )
+            for ref, _ in self.iter_internal_refs()
+        ]
+        return sum(ray_tpu.get(refs))
 
     def __repr__(self):
         return f"Dataset(plan={self._plan.name})"

@@ -48,6 +48,26 @@ def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001 - referenc
     return read_datasource(RangeDatasource(n), parallelism=parallelism)
 
 
+def read_sql(
+    sql: str,
+    connection_factory,
+    *,
+    parallelism: int = -1,
+    shard_column: Optional[str] = None,
+    shard_bounds: Optional[tuple] = None,
+) -> Dataset:
+    """Load a SQL query's results (reference: read_api.read_sql over DB-API
+    connections; sqlite3 works out of the box). With ``shard_column`` (an
+    integer column) the query is range-partitioned into parallel read
+    tasks; otherwise it runs as one task."""
+    from ray_tpu.data.datasource.sql_datasource import SQLDatasource
+
+    return read_datasource(
+        SQLDatasource(sql, connection_factory, shard_column, shard_bounds),
+        parallelism=parallelism if shard_column is not None else 1,
+    )
+
+
 def range_tensor(n: int, *, shape: tuple = (1,), parallelism: int = -1) -> Dataset:
     ds = range(n, parallelism=parallelism)
 

@@ -109,6 +109,34 @@ class TpuCollectiveGroup:
                     clear_backends()
             except Exception as e:
                 logger.debug("backend reset before initialize: %s", e)
+            # A SURVIVOR of a killed gang still holds the previous epoch's
+            # distributed world (graceful destroy() shuts it down; a peer
+            # SIGKILL doesn't). initialize() refuses to run twice per
+            # process, so tear the stale world down here — bounded, because
+            # shutdown() against a DEAD coordinator can hang in its
+            # coordination-service handshake rather than raise. On timeout,
+            # fail fast: this process cannot host a new world, and the gang
+            # restart path (BackendExecutor) replaces it with a fresh one.
+            import threading as _threading
+
+            shut_done = _threading.Event()
+
+            def _shutdown_stale():
+                try:
+                    jax.distributed.shutdown()
+                except Exception as e:
+                    logger.debug("stale distributed world shutdown: %s", e)
+                finally:
+                    shut_done.set()
+
+            _threading.Thread(target=_shutdown_stale, daemon=True).start()
+            if not shut_done.wait(15.0):
+                raise RuntimeError(
+                    "stale multi-process XLA world did not shut down "
+                    "(previous epoch's coordinator dead?); this process "
+                    "cannot host a new collective world — restart the gang "
+                    "with fresh workers"
+                )
             jax.distributed.initialize(
                 coordinator_address=coordinator,
                 num_processes=world_size,

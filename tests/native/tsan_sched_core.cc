@@ -1,0 +1,120 @@
+// In-process TSAN hammer for the native scheduler core's resource ledger.
+//
+// Many threads race try_acquire/release against heartbeat-style
+// node_upsert view resets, node add/remove, and placement-group pool
+// prepare/return — the interleavings the raylet + GCS drive concurrently
+// in production. ThreadSanitizer proves the locking; the hammer itself
+// asserts the ledger's safety invariant: availability stays within
+// [0, total] at every observation (the clamp path in sc_release exists
+// exactly for the release-after-view-reset interleaving). Built with
+// -fsanitize=thread by tests/test_native_races.py.
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+int sc_create();
+void sc_destroy(int);
+uint32_t sc_intern(int, const char*);
+void sc_node_upsert(int, const char*, int, const uint32_t*, const double*, const double*);
+void sc_node_remove(int, const char*);
+int sc_try_acquire(int, const char*, int, const uint32_t*, const double*);
+void sc_release(int, const char*, int, const uint32_t*, const double*);
+void sc_pool_upsert(int, const char*, int, const uint32_t*, const double*);
+void sc_pool_remove(int, const char*);
+int sc_pool_exists(int, const char*);
+int sc_pool_try_acquire(int, const char*, int, const uint32_t*, const double*);
+void sc_pool_release(int, const char*, int, const uint32_t*, const double*);
+double sc_node_avail(int, const char*, uint32_t);
+int sc_cluster_feasibility(int, int, const uint32_t*, const double*);
+}
+
+static std::atomic<bool> g_stop{false};
+static std::atomic<long> g_failures{0};
+static std::atomic<long> g_acquires{0};
+
+static const int kNodes = 4;
+static char g_node_names[kNodes][8];
+
+static void acquirer(int h, uint32_t cpu_idx, unsigned seed) {
+  unsigned s = seed;
+  double one = 1.0;
+  while (!g_stop.load(std::memory_order_relaxed)) {
+    s = s * 1664525u + 1013904223u;
+    const char* node = g_node_names[s % kNodes];
+    if (sc_try_acquire(h, node, 1, &cpu_idx, &one)) {
+      g_acquires++;
+      // Hold briefly, then release (task lifetime).
+      if ((s >> 4) & 3) sc_release(h, node, 1, &cpu_idx, &one);
+      // else: leak-on-purpose path exercises the upsert clamp later.
+    }
+    double avail = sc_node_avail(h, node, cpu_idx);
+    if (avail < -1e-9 || avail > 8.0 + 1e-9) {
+      fprintf(stderr, "LEDGER OUT OF RANGE: %f\n", avail);
+      g_failures++;
+    }
+  }
+}
+
+static void heartbeat(int h, uint32_t cpu_idx) {
+  // View resets + node churn (GCS restart / node death paths).
+  double total = 8.0;
+  int i = 0;
+  while (!g_stop.load(std::memory_order_relaxed)) {
+    const char* node = g_node_names[i % kNodes];
+    sc_node_upsert(h, node, 1, &cpu_idx, &total, &total);
+    if (i % 7 == 6) {
+      sc_node_remove(h, node);
+      sc_node_upsert(h, node, 1, &cpu_idx, &total, &total);
+    }
+    (void)sc_cluster_feasibility(h, 1, &cpu_idx, &total);
+    i++;
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  }
+}
+
+static void pool_churner(int h, uint32_t cpu_idx, unsigned seed) {
+  unsigned s = seed;
+  double two = 2.0, one = 1.0;
+  while (!g_stop.load(std::memory_order_relaxed)) {
+    s = s * 1664525u + 1013904223u;
+    char key[16];
+    snprintf(key, sizeof(key), "pg%u", s % 3);
+    sc_pool_upsert(h, key, 1, &cpu_idx, &two);
+    if (sc_pool_try_acquire(h, key, 1, &cpu_idx, &one)) {
+      sc_pool_release(h, key, 1, &cpu_idx, &one);
+    }
+    (void)sc_pool_exists(h, key);
+    if ((s >> 6) & 1) sc_pool_remove(h, key);
+  }
+}
+
+int main(int argc, char** argv) {
+  int seconds = argc > 1 ? atoi(argv[1]) : 3;
+  int h = sc_create();
+  uint32_t cpu = sc_intern(h, "CPU");
+  double total = 8.0;
+  for (int i = 0; i < kNodes; i++) {
+    snprintf(g_node_names[i], sizeof(g_node_names[i]), "n%d", i);
+    sc_node_upsert(h, g_node_names[i], 1, &cpu, &total, &total);
+  }
+  std::vector<std::thread> threads;
+  for (int t = 0; t < 4; t++) threads.emplace_back(acquirer, h, cpu, 99u * (t + 1));
+  threads.emplace_back(heartbeat, h, cpu);
+  threads.emplace_back(pool_churner, h, cpu, 7u);
+  std::this_thread::sleep_for(std::chrono::seconds(seconds));
+  g_stop = true;
+  for (auto& th : threads) th.join();
+  sc_destroy(h);
+  if (g_failures.load() != 0) {
+    fprintf(stderr, "failures=%ld\n", g_failures.load());
+    return 1;
+  }
+  printf("HAMMER_OK acquires=%ld\n", g_acquires.load());
+  return 0;
+}

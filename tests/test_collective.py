@@ -179,3 +179,51 @@ def test_rendezvous_advertises_node_ip(ray_start_regular):
     col.create_collective_group(members, backend="tpu", group_name="ipcheck")
     coord, node_ip = ray_tpu.get(members[0].coordinator_in_kv.remote("ipcheck"), timeout=120)
     assert coord.split(":")[0] == node_ip
+
+
+def test_tpu_group_member_kill_and_reform(ray_start_regular):
+    """Gang-restart drill: a collective member is KILLED (no graceful
+    destroy — worker death mid-step) and the group re-forms under the same
+    name with a survivor + a replacement. The epoch bump is what makes the
+    stale epoch's state unreachable (tpu_group.py _rendezvous)."""
+
+    @ray_tpu.remote
+    class XlaMember:
+        def do_allreduce(self, group_name):
+            from ray_tpu.util import collective as col
+
+            return np.asarray(
+                col.allreduce(
+                    np.full((4,), float(self.rank + 1), dtype=np.float32),
+                    group_name=group_name,
+                )
+            )
+
+        def init_collective(self, world, rank, backend, group_name):
+            from ray_tpu.util import collective as col
+
+            col.init_collective_group(world, rank, backend=backend, group_name=group_name)
+            self.rank = rank
+            return col.get_group(group_name).epoch
+
+    from ray_tpu.util import collective as col
+
+    members = [XlaMember.remote() for _ in range(2)]
+    epochs = col.create_collective_group(members, backend="tpu", group_name="drill")
+    outs = ray_tpu.get([m.do_allreduce.remote("drill") for m in members], timeout=300)
+    for out in outs:
+        np.testing.assert_allclose(out, np.full((4,), 3.0, dtype=np.float32))
+
+    # Kill a member outright mid-lifecycle: no destroy, no epoch cleanup.
+    # Whole-gang restart follows (BackendExecutor semantics: a dead member
+    # invalidates the world, so every survivor is torn down too — one
+    # process can host at most one multi-process XLA world, and a dead
+    # peer's coordination service state cannot be re-joined).
+    ray_tpu.kill(members[1])
+    ray_tpu.kill(members[0])
+    gang = [XlaMember.remote() for _ in range(2)]
+    epochs2 = col.create_collective_group(gang, backend="tpu", group_name="drill")
+    assert len(set(epochs2)) == 1 and epochs2[0] > epochs[0]
+    outs = ray_tpu.get([m.do_allreduce.remote("drill") for m in gang], timeout=300)
+    for out in outs:
+        np.testing.assert_allclose(out, np.full((4,), 3.0, dtype=np.float32))
