@@ -40,8 +40,11 @@ def train_loop(config):
             max_seq_len=1024,
             dtype=jnp.bfloat16,
             remat=False,
+            # Single chip, no pp: full unroll lets XLA schedule across layer
+            # boundaries (+12% measured on v5e — see TransformerConfig).
+            scan_unroll=8,
         )
-        batch, seq, steps = 8, 1024, 20
+        batch, seq, steps = 8, 1024, 30
     else:
         cfg = TransformerConfig(
             vocab_size=1024,
@@ -81,22 +84,31 @@ def train_loop(config):
     raw_s = time.perf_counter() - t0
 
     # Framework path: same loop, reporting through the air session every
-    # step. Losses are copied host-side asynchronously and fetched with ONE
-    # step of lag so the host->device pipeline never drains (a synchronous
-    # float(loss) of the in-flight step would stall dispatch per step — an
-    # artifact no well-written training loop has).
+    # step. Losses are copied host-side asynchronously and fetched K steps
+    # LATE: a synchronous float() of a recent step pays the device->host
+    # round trip per iteration (under the axon remote-TPU tunnel that RTT
+    # is milliseconds, and it throttles dispatch depth), while a K-deep lag
+    # gives every async copy K full steps to land before it is read — the
+    # shape of any well-written async metrics logger. Every loss is still
+    # reported, in order.
+    import collections
+
+    lag = 4
+    pending: collections.deque = collections.deque()
     t0 = time.perf_counter()
-    prev_i, prev_loss = None, None
     for i in range(steps):
         params, opt_state, loss = step(params, opt_state, batch_arr)
         try:
             loss.copy_to_host_async()
         except Exception:
             pass
-        if prev_loss is not None:
-            session.report({"step": prev_i, "loss": float(prev_loss)})
-        prev_i, prev_loss = i, loss
-    session.report({"step": prev_i, "loss": float(prev_loss)})
+        pending.append((i, loss))
+        if len(pending) > lag:
+            pi, pl = pending.popleft()
+            session.report({"step": pi, "loss": float(pl)})
+    while pending:
+        pi, pl = pending.popleft()
+        session.report({"step": pi, "loss": float(pl)})
     fw_s = time.perf_counter() - t0
 
     tok = batch * seq * steps

@@ -439,17 +439,24 @@ class CoreWorker:
                     merged["env_vars"] = {**merged["env_vars"], **(value or {})}
                 else:
                     merged[key] = value
+        from ray_tpu._private import runtime_env_plugins
         from ray_tpu.runtime_env import UNSUPPORTED_FIELDS
 
-        unsupported = set(merged) & UNSUPPORTED_FIELDS
+        # A registered plugin makes its field supported (reference:
+        # RuntimeEnvPlugin seam — pip/conda/container are themselves
+        # plugins there).
+        unsupported = (set(merged) & UNSUPPORTED_FIELDS) - runtime_env_plugins.plugin_fields()
         if unsupported:
             # Fail at submission, not in a crash-looping worker: provisioning
             # packages needs network access this environment doesn't have.
             raise ValueError(
                 f"runtime_env fields {sorted(unsupported)} require package "
                 "installation, which is not supported; pre-install "
-                "dependencies on the node image instead"
+                "dependencies on the node image instead (or register a "
+                "runtime-env plugin that provisions them)"
             )
+        runtime_env_plugins.validate_with_plugins(merged)
+        merged = runtime_env_plugins.attach_plugin_classes(merged)
         # Validate paths here too — a worker that dies in env setup before
         # registering would otherwise crash-loop while the task hangs.
         import os as _os

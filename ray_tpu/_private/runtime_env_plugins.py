@@ -1,0 +1,202 @@
+"""Runtime-env plugin protocol + URI cache.
+
+Analog of the reference's RuntimeEnvPlugin seam
+(python/ray/_private/runtime_env/plugin.py) and its per-URI resource cache
+(runtime_env/uri_cache.py): a plugin owns one runtime_env FIELD, validates
+it at submission, materializes expensive per-URI resources ONCE per node
+into a content-addressed cache directory, and applies the result (env
+vars, sys.path, cwd) at every worker start.
+
+The in-image build ships no pip/conda/container provisioning (no network),
+but the SEAM is what the reference exposes: site plugins register via the
+``RAY_TPU_RUNTIME_ENV_PLUGINS`` env var (JSON list of ``{"class":
+"module.Class"}``, read in every process) or programmatically via
+``register_plugin`` — the programmatic path also ships the class path
+inside the runtime env itself so workers load it without pre-set env vars.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import importlib
+import json
+import logging
+import os
+import threading
+
+logger = logging.getLogger(__name__)
+
+_PLUGIN_ENV_VAR = "RAY_TPU_RUNTIME_ENV_PLUGINS"
+_PLUGIN_CLASSES_FIELD = "_plugin_classes"  # injected into runtime_env dicts
+
+_lock = threading.Lock()
+_plugins: dict[str, "RuntimeEnvPlugin"] = {}
+_env_var_loaded = False
+
+
+class RuntimeEnvPlugin:
+    """Subclass and register. ``name`` is the runtime_env field the plugin
+    owns (e.g. "conda", "my_env_setup")."""
+
+    name: str = ""
+    priority: int = 10  # lower runs first at worker start
+
+    def validate(self, value, runtime_env: dict) -> None:
+        """Raise at SUBMISSION time for malformed config."""
+
+    def get_uris(self, value, runtime_env: dict) -> list:
+        """URIs whose materialization is cacheable per node. Default: one
+        URI derived from the field value (every distinct value caches
+        separately)."""
+        blob = json.dumps(value, sort_keys=True, default=str)
+        return [f"{self.name}://{hashlib.sha1(blob.encode()).hexdigest()[:16]}"]
+
+    def create(self, uri: str, value, runtime_env: dict, target_dir: str) -> None:
+        """Materialize `uri` into target_dir. Runs ONCE per (node, uri) —
+        later workers reuse the cached directory."""
+
+    def apply(self, value, runtime_env: dict, cached_dirs: dict) -> None:
+        """Per-worker-start hook: mutate os.environ / sys.path / cwd."""
+
+
+def register_plugin(plugin: RuntimeEnvPlugin) -> None:
+    if not plugin.name:
+        raise ValueError("plugin.name must be a non-empty runtime_env field name")
+    with _lock:
+        _plugins[plugin.name] = plugin
+
+
+def unregister_plugin(name: str) -> None:
+    with _lock:
+        _plugins.pop(name, None)
+
+
+def _load_from_env_var() -> None:
+    global _env_var_loaded
+    with _lock:
+        if _env_var_loaded:
+            return
+        _env_var_loaded = True
+    raw = os.environ.get(_PLUGIN_ENV_VAR)
+    if not raw:
+        return
+    try:
+        entries = json.loads(raw)
+    except json.JSONDecodeError:
+        logger.error("%s is not valid JSON; ignoring", _PLUGIN_ENV_VAR)
+        return
+    for entry in entries:
+        try:
+            _register_class_path(entry["class"])
+        except Exception:
+            logger.exception("failed to load runtime-env plugin %r", entry)
+
+
+def _register_class_path(class_path: str) -> None:
+    module_name, _, cls_name = class_path.rpartition(".")
+    cls = getattr(importlib.import_module(module_name), cls_name)
+    register_plugin(cls())
+
+
+def _load_from_runtime_env(runtime_env: dict, strict: bool = False) -> None:
+    """Workers: load plugin classes the submitter shipped in the env.
+
+    strict=True raises on import failure — a task must fail LOUDLY rather
+    than run without the environment its plugin was supposed to set up."""
+    failures = []
+    for class_path in runtime_env.get(_PLUGIN_CLASSES_FIELD) or []:
+        with _lock:
+            known = {
+                f"{type(p).__module__}.{type(p).__qualname__}" for p in _plugins.values()
+            }
+        if class_path in known:
+            continue
+        try:
+            _register_class_path(class_path)
+        except Exception as e:
+            logger.exception("failed to load shipped runtime-env plugin %s", class_path)
+            failures.append(f"{class_path}: {e!r}")
+    if failures and strict:
+        raise RuntimeError(
+            "runtime-env plugin classes shipped with this task failed to "
+            "import on the worker (are their modules on py_modules / the "
+            "node image?): " + "; ".join(failures)
+        )
+
+
+def ensure_loaded(runtime_env: dict | None = None, strict: bool = False) -> None:
+    """Load env-var plugins plus any classes shipped inside runtime_env."""
+    _load_from_env_var()
+    if runtime_env:
+        _load_from_runtime_env(runtime_env, strict=strict)
+
+
+def plugin_fields() -> set:
+    _load_from_env_var()
+    with _lock:
+        return set(_plugins)
+
+
+def attach_plugin_classes(runtime_env: dict) -> dict:
+    """Submitter side: record the class paths of registered plugins whose
+    fields the env uses, so workers can import them."""
+    _load_from_env_var()
+    with _lock:
+        used = [
+            f"{type(p).__module__}.{type(p).__qualname__}"
+            for name, p in _plugins.items()
+            if name in runtime_env
+        ]
+    if used:
+        runtime_env = dict(runtime_env)
+        runtime_env[_PLUGIN_CLASSES_FIELD] = sorted(used)
+    return runtime_env
+
+
+def validate_with_plugins(runtime_env: dict) -> None:
+    _load_from_env_var()
+    with _lock:
+        plugins = dict(_plugins)
+    for name, plugin in plugins.items():
+        if name in runtime_env:
+            plugin.validate(runtime_env[name], runtime_env)
+
+
+def apply_plugins(runtime_env: dict, session_dir: str) -> None:
+    """Worker-start hook (worker_main._apply_runtime_env): materialize
+    cached URIs and apply every plugin owning a present field."""
+    _load_from_env_var()
+    _load_from_runtime_env(runtime_env)
+    with _lock:
+        plugins = sorted(_plugins.values(), key=lambda p: p.priority)
+    cache_root = os.path.join(session_dir, "runtime_env_cache")
+    for plugin in plugins:
+        if plugin.name not in runtime_env:
+            continue
+        value = runtime_env[plugin.name]
+        cached: dict = {}
+        for uri in plugin.get_uris(value, runtime_env):
+            digest = hashlib.sha1(uri.encode()).hexdigest()[:20]
+            target = os.path.join(cache_root, plugin.name, digest)
+            marker = os.path.join(target, ".ready")
+            if not os.path.exists(marker):
+                # First worker on this node materializes; concurrent workers
+                # race benignly (tmp dir + atomic rename). A failed create
+                # must not leak its partial tmp dir — crash-looping workers
+                # would accumulate one per attempt.
+                import shutil
+
+                tmp = target + f".tmp.{os.getpid()}"
+                os.makedirs(tmp, exist_ok=True)
+                try:
+                    plugin.create(uri, value, runtime_env, tmp)
+                    open(os.path.join(tmp, ".ready"), "w").close()
+                    try:
+                        os.rename(tmp, target)
+                    except OSError:
+                        shutil.rmtree(tmp, ignore_errors=True)  # lost the race
+                except BaseException:
+                    shutil.rmtree(tmp, ignore_errors=True)
+                    raise
+            cached[uri] = target
+        plugin.apply(value, runtime_env, cached)

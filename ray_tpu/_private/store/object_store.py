@@ -42,9 +42,15 @@ class StoreCore:
     """Daemon-side store state. Single-threaded (asyncio) access."""
 
     def __init__(self, arena, spill_dir: str, index=None):
+        from ray_tpu._private.store.external_storage import create_external_storage
+
         self.arena = arena
         self.spill_dir = spill_dir
         os.makedirs(spill_dir, exist_ok=True)
+        # Pluggable spill target (reference: external_storage.py) — local
+        # filesystem by default, remote URI or custom backend via
+        # RAY_TPU_OBJECT_SPILLING_CONFIG.
+        self.external_storage = create_external_storage(spill_dir)
         self.objects: dict[str, ObjectEntry] = {}
         # Native shm index: clients resolve local sealed objects without RPC.
         self.index = index
@@ -163,10 +169,21 @@ class StoreCore:
             return
         self._index_remove_then_free(object_id, entry.offset)
         if entry.spilled_path:
+            # Off the daemon loop: a network backend's delete round trip
+            # must not stall concurrent store RPCs (put/get use executors
+            # in _spill/_restore for the same reason).
+            path = entry.spilled_path
+
+            def _ext_delete():
+                try:
+                    self.external_storage.delete(path)
+                except Exception:
+                    pass
+
             try:
-                os.unlink(entry.spilled_path)
-            except OSError:
-                pass
+                asyncio.get_running_loop().run_in_executor(None, _ext_delete)
+            except RuntimeError:
+                _ext_delete()  # no loop (unit tests call delete directly)
 
     def object_ids(self) -> list[str]:
         return [oid for oid, e in self.objects.items() if e.sealed]
@@ -237,18 +254,20 @@ class StoreCore:
     async def _spill(self, entry: ObjectEntry):
         if entry.spilled_path:
             return
-        path = os.path.join(self.spill_dir, entry.object_id)
         data = bytes(self.arena.read(entry.offset, entry.size))
         loop = asyncio.get_event_loop()
-        await loop.run_in_executor(None, _write_file, path, data)
-        entry.spilled_path = path
+        entry.spilled_path = await loop.run_in_executor(
+            None, self.external_storage.put, entry.object_id, data
+        )
         logger.debug("spilled %s (%d bytes)", entry.object_id, entry.size)
 
     async def _restore(self, entry: ObjectEntry):
         if entry.spilled_path is None:
             raise KeyError(entry.object_id)
         loop = asyncio.get_event_loop()
-        data = await loop.run_in_executor(None, _read_file, entry.spilled_path)
+        data = await loop.run_in_executor(
+            None, self.external_storage.get, entry.spilled_path
+        )
         offset = self.arena.alloc(entry.size)
         if offset is None:
             await self._make_space(entry.size)
@@ -267,18 +286,6 @@ class StoreCore:
         if self.index is not None:
             self.index.close(unlink=True)
         self.arena.close(unlink=True)
-
-
-def _write_file(path: str, data: bytes):
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(data)
-    os.replace(tmp, path)
-
-
-def _read_file(path: str) -> bytes:
-    with open(path, "rb") as f:
-        return f.read()
 
 
 class StoreClient:

@@ -242,16 +242,12 @@ def _apply_runtime_env(raw: str | None):
     """
     if not raw:
         return
+    from ray_tpu._private import runtime_env_plugins
     from ray_tpu.runtime_env import UNSUPPORTED_FIELDS
 
     renv = json.loads(raw)
-    unsupported = set(renv) & UNSUPPORTED_FIELDS
-    if unsupported:
-        raise RuntimeError(
-            f"runtime_env fields {sorted(unsupported)} require package "
-            "installation, which this environment does not support; "
-            "pre-install dependencies on the node image instead"
-        )
+    # Built-in fields FIRST: shipped plugin classes usually live in
+    # py_modules, so sys.path must be extended before plugin import.
     for key, value in (renv.get("env_vars") or {}).items():
         os.environ[str(key)] = str(value)
     working_dir = renv.get("working_dir")
@@ -260,6 +256,21 @@ def _apply_runtime_env(raw: str | None):
         sys.path.insert(0, working_dir)
     for mod_path in renv.get("py_modules") or []:
         sys.path.insert(0, mod_path)
+    runtime_env_plugins.ensure_loaded(renv, strict=True)
+    unsupported = (set(renv) & UNSUPPORTED_FIELDS) - runtime_env_plugins.plugin_fields()
+    if unsupported:
+        raise RuntimeError(
+            f"runtime_env fields {sorted(unsupported)} require package "
+            "installation, which this environment does not support; "
+            "pre-install dependencies on the node image instead"
+        )
+    try:
+        runtime_env_plugins.apply_plugins(
+            renv, os.environ.get("RAY_TPU_SESSION_DIR", "/tmp/ray_tpu")
+        )
+    except Exception:
+        logger.exception("runtime-env plugin application failed")
+        raise
 
 
 def main():

@@ -230,11 +230,14 @@ def flash_attention(
     *,
     causal: bool = False,
     sm_scale: float | None = None,
-    # (256, 512) measured ~30% faster than (128, 128) on v5e at the bench
-    # shapes (fewer grid steps -> less per-block overhead; both dims stay
-    # multiples of the (8, 128) tile floor and clamp to the sequence).
-    block_q: int = 256,
-    block_k: int = 512,
+    # Measured on v5e at bench shapes (B8/H16/T1024/D64, full train step):
+    # (128,128) << (256,512) < (1024,1024) — bigger blocks mean fewer grid
+    # steps and less per-block overhead, and _fit_block clamps them to the
+    # sequence, so short sequences degrade gracefully to block == seq.
+    # VMEM bound: a (1024, 1024) fp32 score tile is 4 MiB of the ~16 MiB
+    # budget, leaving room for the q/k/v/o tiles at head_dim <= 256.
+    block_q: int = 1024,
+    block_k: int = 1024,
     bias=None,
     force_pallas: bool | None = None,
     interpret: bool = False,

@@ -27,9 +27,17 @@ class RuntimeEnv(dict):
         **kwargs,
     ):
         super().__init__()
-        unknown = set(kwargs) - KNOWN_FIELDS
+        from ray_tpu._private.runtime_env_plugins import plugin_fields
+
+        plugin_owned = plugin_fields()
+        unknown = set(kwargs) - KNOWN_FIELDS - plugin_owned
         if unknown:
-            raise ValueError(f"unknown runtime_env fields: {sorted(unknown)}")
+            raise ValueError(
+                f"unknown runtime_env fields: {sorted(unknown)} (register a "
+                "runtime-env plugin to add custom fields)"
+            )
+        for key in plugin_owned & set(kwargs):
+            self[key] = kwargs[key]
         if env_vars is not None:
             if not isinstance(env_vars, dict) or not all(
                 isinstance(k, str) and isinstance(v, str) for k, v in env_vars.items()

@@ -152,3 +152,79 @@ def test_runtime_env_class_validation():
         RuntimeEnv(bogus_field=1)
     with pytest.raises(TypeError):
         RuntimeEnv(env_vars={"A": 1})
+
+
+def test_runtime_env_plugin_protocol(ray_start_regular, tmp_path):
+    """Plugin seam (reference: _private/runtime_env/plugin.py): a custom
+    field is validated at submission, materialized ONCE per node into the
+    per-URI cache, and applied at every worker start."""
+    plugin_mod = tmp_path / "greeting_plugin.py"
+    plugin_mod.write_text(
+        """
+import json
+import os
+
+from ray_tpu._private.runtime_env_plugins import RuntimeEnvPlugin
+
+
+class GreetingPlugin(RuntimeEnvPlugin):
+    name = "greeting"
+
+    def validate(self, value, runtime_env):
+        if not isinstance(value, str):
+            raise ValueError("greeting must be a string")
+
+    def create(self, uri, value, runtime_env, target_dir):
+        # Expensive-materialization stand-in; runs once per (node, value).
+        with open(os.path.join(target_dir, "payload.json"), "w") as f:
+            json.dump({"greeting": value.upper(), "pid": os.getpid()}, f)
+
+    def apply(self, value, runtime_env, cached_dirs):
+        (cache_dir,) = cached_dirs.values()
+        with open(os.path.join(cache_dir, "payload.json")) as f:
+            payload = json.load(f)
+        os.environ["GREETING_RESULT"] = payload["greeting"]
+        os.environ["GREETING_CACHE_DIR"] = cache_dir
+"""
+    )
+    from ray_tpu._private import runtime_env_plugins
+
+    import sys
+
+    sys.path.insert(0, str(tmp_path))
+    try:
+        import greeting_plugin
+
+        runtime_env_plugins.register_plugin(greeting_plugin.GreetingPlugin())
+
+        @ray_tpu.remote(runtime_env={"greeting": "hello", "py_modules": [str(tmp_path)]})
+        def greeted():
+            return os.environ.get("GREETING_RESULT"), os.environ.get("GREETING_CACHE_DIR")
+
+        result, cache1 = ray_tpu.get(greeted.remote(), timeout=120)
+        assert result == "HELLO"
+        assert cache1 and os.path.exists(os.path.join(cache1, "payload.json"))
+
+        # Same value from another worker reuses the SAME cache dir.
+        _, cache2 = ray_tpu.get(greeted.remote(), timeout=120)
+        assert cache2 == cache1
+
+        # Submission-time validation runs in the driver.
+        @ray_tpu.remote(runtime_env={"greeting": 42, "py_modules": [str(tmp_path)]})
+        def bad():
+            return 1
+
+        with pytest.raises(ValueError):
+            bad.remote()
+    finally:
+        runtime_env_plugins.unregister_plugin("greeting")
+        sys.path.remove(str(tmp_path))
+
+
+def test_unregistered_plugin_field_still_rejected(ray_start_regular):
+    @ray_tpu.remote(runtime_env={"conda": {"dependencies": ["x"]}})
+    def f():
+        return 1
+
+    with pytest.raises(ValueError):
+        f.remote()
