@@ -29,7 +29,10 @@ logger = logging.getLogger(__name__)
 _PLUGIN_ENV_VAR = "RAY_TPU_RUNTIME_ENV_PLUGINS"
 _PLUGIN_CLASSES_FIELD = "_plugin_classes"  # injected into runtime_env dicts
 
-_lock = threading.Lock()
+# RLock: the env-var loader registers plugins while holding the lock, so
+# registration must be re-entrant (and fully complete before any other
+# thread can observe the loaded flag).
+_lock = threading.RLock()
 _plugins: dict[str, "RuntimeEnvPlugin"] = {}
 _env_var_loaded = False
 
@@ -76,20 +79,22 @@ def _load_from_env_var() -> None:
     with _lock:
         if _env_var_loaded:
             return
+        # Load COMPLETELY under the lock: a concurrent plugin_fields() must
+        # never observe loaded=True with registrations still in flight.
         _env_var_loaded = True
-    raw = os.environ.get(_PLUGIN_ENV_VAR)
-    if not raw:
-        return
-    try:
-        entries = json.loads(raw)
-    except json.JSONDecodeError:
-        logger.error("%s is not valid JSON; ignoring", _PLUGIN_ENV_VAR)
-        return
-    for entry in entries:
+        raw = os.environ.get(_PLUGIN_ENV_VAR)
+        if not raw:
+            return
         try:
-            _register_class_path(entry["class"])
-        except Exception:
-            logger.exception("failed to load runtime-env plugin %r", entry)
+            entries = json.loads(raw)
+        except json.JSONDecodeError:
+            logger.error("%s is not valid JSON; ignoring", _PLUGIN_ENV_VAR)
+            return
+        for entry in entries:
+            try:
+                _register_class_path(entry["class"])
+            except Exception:
+                logger.exception("failed to load runtime-env plugin %r", entry)
 
 
 def _register_class_path(class_path: str) -> None:

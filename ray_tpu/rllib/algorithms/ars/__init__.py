@@ -1,0 +1,3 @@
+from ray_tpu.rllib.algorithms.ars.ars import ARS, ARSConfig
+
+__all__ = ["ARS", "ARSConfig"]

@@ -1,0 +1,3 @@
+from ray_tpu.rllib.algorithms.crr.crr import CRR, CRRConfig
+
+__all__ = ["CRR", "CRRConfig"]

@@ -1,0 +1,287 @@
+"""CRR — Critic Regularized Regression (offline RL; Wang et al. 2020).
+
+Reference: rllib/algorithms/crr/ (crr.py, torch policy): purely offline
+actor-critic where the actor is trained by ADVANTAGE-FILTERED behavior
+cloning on dataset actions:
+
+    L_actor = -f(A(s, a)) * log pi(a | s),   A(s,a) = Q(s,a) - E_{a'~pi} Q(s,a')
+
+with f either ``exp`` (exp(A / beta), clipped — CRR-exp) or ``binary``
+(1[A > 0] — CRR-binary/"max"). The critic is plain TD against a Polyak
+target with the expectation over the CURRENT policy for the bootstrap (no
+max — avoids offline overestimation). Unlike CQL there is no explicit
+OOD-action penalty: staying near the data comes from the regression form
+itself.
+
+One jitted update trains critic + actor; data flows from the offline
+readers (rllib/offline), never an env. Discrete spaces take exact
+expectations over actions; continuous ones sample from a squashed
+Gaussian (SAC's machinery).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.off_policy import OffPolicyTraining
+from ray_tpu.rllib.algorithms.sac.sac import (
+    _mlp_apply,
+    _mlp_params,
+    _squashed_sample,
+)
+from ray_tpu.rllib.offline import DatasetReader, JsonReader
+from ray_tpu.rllib.policy.sample_batch import ACTIONS, DONES, NEXT_OBS, OBS, REWARDS
+
+
+class CRRConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or CRR)
+        self.lr = 3e-4
+        self.num_rollout_workers = 0
+        self.train_batch_size = 256
+        self.tau = 5e-3
+        self.weight_type = "exp"   # "exp" | "binary" (reference: weight_type)
+        self.temperature = 1.0      # beta for exp weights
+        self.max_weight = 20.0      # exp-weight clip (reference: max_weight)
+        self.n_action_samples = 4   # continuous: samples for E_pi[Q]
+        self.updates_per_iter = 200
+        self.input_: Optional[object] = None
+        self.model_hiddens = (256, 256)
+
+    def offline_data(self, *, input_=None) -> "CRRConfig":
+        if input_ is not None:
+            self.input_ = input_
+        return self
+
+    def training(self, *, tau=None, weight_type=None, temperature=None,
+                 max_weight=None, n_action_samples=None, updates_per_iter=None,
+                 **kwargs) -> "CRRConfig":
+        super().training(**kwargs)
+        for name, val in (
+            ("tau", tau), ("weight_type", weight_type), ("temperature", temperature),
+            ("max_weight", max_weight), ("n_action_samples", n_action_samples),
+            ("updates_per_iter", updates_per_iter),
+        ):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+class CRR(OffPolicyTraining, Algorithm):
+    @classmethod
+    def get_default_config(cls) -> CRRConfig:
+        return CRRConfig(cls)
+
+    def setup(self, config: dict) -> None:
+        import gymnasium as gym
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg: CRRConfig = self._algo_config
+        assert cfg.input_ is not None, "CRR needs offline data: config.offline_data(input_=...)"
+        assert cfg.weight_type in ("exp", "binary")
+        probe = gym.make(cfg.env) if isinstance(cfg.env, str) else cfg.env(dict(cfg.env_config))
+        self.discrete = isinstance(probe.action_space, gym.spaces.Discrete)
+        self.obs_dim = int(np.prod(probe.observation_space.shape))
+        if self.discrete:
+            self.action_dim = int(probe.action_space.n)
+            self._act_scale = self._act_offset = None
+        else:
+            self.action_dim = int(np.prod(probe.action_space.shape))
+            low = np.asarray(probe.action_space.low, np.float32)
+            high = np.asarray(probe.action_space.high, np.float32)
+            self._act_scale = (high - low) / 2.0
+            self._act_offset = (high + low) / 2.0
+        probe.close()
+        if hasattr(cfg.input_, "take_all"):
+            self.reader = DatasetReader(cfg.input_, gamma=cfg.gamma, seed=cfg.seed)
+        else:
+            self.reader = JsonReader(cfg.input_, gamma=cfg.gamma, seed=cfg.seed)
+
+        keys = jax.random.split(jax.random.PRNGKey(cfg.seed), 3)
+        H = cfg.model_hiddens
+        if self.discrete:
+            self.params = {
+                "actor": _mlp_params(keys[0], self.obs_dim, H, self.action_dim),
+                "q": _mlp_params(keys[1], self.obs_dim, H, self.action_dim),
+            }
+        else:
+            self.params = {
+                # Squashed Gaussian head: mean + log_std.
+                "actor": _mlp_params(keys[0], self.obs_dim, H, 2 * self.action_dim),
+                "q": _mlp_params(keys[1], self.obs_dim + self.action_dim, H, 1),
+            }
+        self.target_q = jax.tree_util.tree_map(np.asarray, self.params["q"])
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.params)
+        self._rng = jax.random.PRNGKey(cfg.seed + 1)
+        self._timesteps_total = 0
+        self._build_update(cfg)
+
+    def _build_update(self, cfg: CRRConfig):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        discrete = self.discrete
+        gamma, tau = cfg.gamma, cfg.tau
+        beta, wmax = cfg.temperature, cfg.max_weight
+        n_samples = cfg.n_action_samples
+        binary = cfg.weight_type == "binary"
+        tx = self.tx
+
+        def policy_logp_and_expq(params, q_params, obs, key):
+            """Returns (log-prob fn inputs, E_{a~pi} Q(s, a))."""
+            if discrete:
+                logits = _mlp_apply(params["actor"], obs)
+                pi = jax.nn.softmax(logits)
+                q_all = _mlp_apply(q_params, obs)          # [B, A]
+                expq = jnp.sum(pi * q_all, axis=-1)        # [B]
+                return logits, expq
+            # Continuous: sample n actions from the squashed Gaussian.
+            out = _mlp_apply(params["actor"], obs)
+            action_dim = out.shape[-1] // 2
+            mean, log_std = out[:, :action_dim], out[:, action_dim:]
+            log_std = jnp.clip(log_std, -10.0, 2.0)
+            qs = []
+            for i in range(n_samples):
+                a, _, _ = _squashed_sample(
+                    params["actor"], obs, jax.random.fold_in(key, i), action_dim
+                )
+                qs.append(_mlp_apply(q_params, jnp.concatenate([obs, a], -1))[..., 0])
+            return (mean, log_std), jnp.mean(jnp.stack(qs), axis=0)
+
+        def update(params, target_q, opt_state, batch, key):
+            obs = batch[OBS]
+            acts = batch[ACTIONS]
+            rew = batch[REWARDS]
+            dones = batch[DONES]
+            next_obs = batch[NEXT_OBS]
+
+            def loss_fn(p):
+                # ---- critic: TD with E_pi[Q_target] bootstrap (no max) ----
+                _, expq_next = policy_logp_and_expq(
+                    jax.lax.stop_gradient(p), target_q, next_obs, jax.random.fold_in(key, 1)
+                )
+                y = rew + gamma * (1.0 - dones) * expq_next
+                y = jax.lax.stop_gradient(y)
+                if discrete:
+                    q_all = _mlp_apply(p["q"], obs)
+                    q_sa = jnp.take_along_axis(q_all, acts.astype(jnp.int32)[:, None], -1)[:, 0]
+                else:
+                    q_sa = _mlp_apply(p["q"], jnp.concatenate([obs, acts], -1))[..., 0]
+                critic_loss = jnp.mean(jnp.square(q_sa - y))
+
+                # ---- actor: advantage-filtered regression on dataset a ----
+                head, expq = policy_logp_and_expq(
+                    p, jax.lax.stop_gradient(p["q"]), obs, jax.random.fold_in(key, 2)
+                )
+                adv = jax.lax.stop_gradient(q_sa) - expq
+                adv = jax.lax.stop_gradient(adv)
+                if binary:
+                    w = (adv > 0).astype(jnp.float32)
+                else:
+                    w = jnp.minimum(jnp.exp(adv / beta), wmax)
+                if discrete:
+                    logits = head
+                    logp = jax.nn.log_softmax(logits)
+                    logp_a = jnp.take_along_axis(logp, acts.astype(jnp.int32)[:, None], -1)[:, 0]
+                else:
+                    mean, log_std = head
+                    # Invert tanh squash for dataset actions (in [-1,1]).
+                    a = jnp.clip(acts, -1 + 1e-6, 1 - 1e-6)
+                    pre = jnp.arctanh(a)
+                    var = jnp.exp(2 * log_std)
+                    logp_a = jnp.sum(
+                        -0.5 * (jnp.square(pre - mean) / var + 2 * log_std + jnp.log(2 * jnp.pi))
+                        - jnp.log(1 - jnp.square(a) + 1e-6),
+                        axis=-1,
+                    )
+                actor_loss = -jnp.mean(w * logp_a)
+                return critic_loss + actor_loss, {
+                    "critic_loss": critic_loss,
+                    "actor_loss": actor_loss,
+                    "mean_weight": w.mean(),
+                    "q_mean": q_sa.mean(),
+                }
+
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            target_q = jax.tree_util.tree_map(
+                lambda t, o: (1.0 - tau) * t + tau * o, target_q, params["q"]
+            )
+            aux = dict(aux)
+            aux["total_loss"] = loss
+            return params, target_q, opt_state, aux
+
+        self._update = jax.jit(update)
+
+    def training_step(self) -> dict:
+        import jax
+        import jax.numpy as jnp
+
+        cfg: CRRConfig = self._algo_config
+        aux = {}
+        for _ in range(cfg.updates_per_iter):
+            batch = self.reader.next(cfg.train_batch_size)
+            jb = {k: jnp.asarray(np.asarray(batch[k], np.float32)) for k in (OBS, ACTIONS, REWARDS, DONES, NEXT_OBS)}
+            if not self.discrete and self._act_scale is not None:
+                jb[ACTIONS] = (jb[ACTIONS] - self._act_offset) / self._act_scale
+            self._rng, key = jax.random.split(self._rng)
+            self.params, self.target_q, self.opt_state, aux = self._update(
+                self.params, self.target_q, self.opt_state, jb, key
+            )
+            self._timesteps_total += cfg.train_batch_size
+        return {k: float(v) for k, v in aux.items()}
+
+    def step(self) -> dict:
+        import time
+
+        t0 = time.time()
+        result = self.training_step()
+        result["timesteps_total"] = self._timesteps_total
+        result["time_this_iter_s"] = time.time() - t0
+        return result
+
+    def compute_single_action(self, obs, explore: bool = False):
+        import jax.numpy as jnp
+
+        obs = jnp.asarray(np.asarray(obs, np.float32).reshape(1, -1))
+        if self.discrete:
+            logits = np.asarray(_mlp_apply(self.params["actor"], obs))[0]
+            return int(logits.argmax())
+        out = np.asarray(_mlp_apply(self.params["actor"], obs))[0]
+        mean = np.tanh(out[: self.action_dim])
+        return mean * self._act_scale + self._act_offset
+
+    def save_checkpoint(self):
+        from ray_tpu.air.checkpoint import Checkpoint
+
+        return Checkpoint.from_dict({
+            "params": self.params,
+            "target_q": self.target_q,
+            "opt_state": self.opt_state,
+            "timesteps": self._timesteps_total,
+            # The action-sampling stream must not replay pre-save draws
+            # after a restore.
+            "rng": np.asarray(self._rng),
+        })
+
+    def load_checkpoint(self, checkpoint) -> None:
+        import jax.numpy as jnp
+
+        data = checkpoint.to_dict()
+        self.params = data["params"]
+        self.target_q = data["target_q"]
+        self.opt_state = data["opt_state"]
+        self._timesteps_total = data.get("timesteps", 0)
+        if "rng" in data:
+            self._rng = jnp.asarray(data["rng"])
+
+    def cleanup(self) -> None:
+        pass

@@ -133,6 +133,9 @@ class ESConfig(AlgorithmConfig):
 
 
 class ES(Algorithm):
+    # Subclasses (ARS) substitute their own worker actor class.
+    _worker_cls = _ESWorker
+
     @classmethod
     def get_default_config(cls) -> ESConfig:
         return ESConfig(cls)
@@ -161,7 +164,7 @@ class ES(Algorithm):
         self._v = np.zeros_like(self.flat)
         self._t = 0
         self._np_rng = np.random.default_rng(cfg.seed)
-        make = ray_tpu.remote(num_cpus=1)(_ESWorker).remote
+        make = ray_tpu.remote(num_cpus=1)(self._worker_cls).remote
         self._workers = [
             make(cfg.env, self.module_spec, cfg.env_config, self._shapes, cfg.seed + i)
             for i in range(max(cfg.num_rollout_workers, 1))
