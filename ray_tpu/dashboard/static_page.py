@@ -1,118 +1,364 @@
-"""Embedded dashboard page.
+"""Dashboard single-page UI.
 
-Stand-in for the reference's React frontend (dashboard/client/): one
-self-contained HTML page (no build step, no external assets) that polls the
-head's REST API and renders nodes/resources, actors, jobs, and task summary.
+Analog of the reference's dashboard client (dashboard/client/ — a built
+React app): this image has no node/npm toolchain, so the UI is a
+dependency-free vanilla-JS SPA served inline. It consumes the same REST
+surface (head.py): live-polling stat tiles, sortable/filterable tables for
+nodes/actors/tasks/placement groups/objects/workers, a task summary, job
+submission + per-job logs, a log-file browser with tailing, and the raw
+Prometheus exposition.
 """
 
-INDEX_HTML = """<!doctype html>
+INDEX_HTML = r"""<!doctype html>
 <html>
 <head>
 <meta charset="utf-8">
 <title>ray_tpu dashboard</title>
 <style>
-  body { font-family: -apple-system, system-ui, sans-serif; margin: 2rem; color: #222; }
-  h1 { font-size: 1.3rem; }  h2 { font-size: 1.05rem; margin-top: 1.6rem; }
-  table { border-collapse: collapse; width: 100%; font-size: 0.85rem; }
-  th, td { text-align: left; padding: 4px 10px; border-bottom: 1px solid #e5e5e5; }
-  th { color: #666; font-weight: 600; }
-  .pill { display: inline-block; padding: 1px 8px; border-radius: 10px; font-size: 0.75rem; }
-  .ALIVE, .RUNNING, .SUCCEEDED, .FINISHED { background: #e6f4ea; color: #137333; }
-  .DEAD, .FAILED { background: #fce8e6; color: #c5221f; }
-  .PENDING, .PENDING_CREATION, .STOPPED { background: #fef7e0; color: #b06000; }
-  .muted { color: #999; }
-  #updated { font-size: 0.75rem; color: #999; }
+  :root {
+    --bg: #0f1419; --panel: #171d24; --panel2: #1e2630; --text: #d6dde6;
+    --dim: #8494a6; --accent: #4fa3ff; --ok: #3fb97f; --warn: #e0a63d;
+    --err: #e06c5b; --border: #2a3442;
+  }
+  * { box-sizing: border-box; }
+  body { margin: 0; background: var(--bg); color: var(--text);
+         font: 13px/1.5 system-ui, -apple-system, "Segoe UI", sans-serif; }
+  header { display: flex; align-items: center; gap: 16px; padding: 10px 18px;
+           background: var(--panel); border-bottom: 1px solid var(--border); }
+  header h1 { font-size: 15px; margin: 0; font-weight: 600; }
+  header .addr { color: var(--dim); font-size: 12px; }
+  header .right { margin-left: auto; display: flex; gap: 8px; align-items: center; }
+  select, input, button, textarea {
+    background: var(--panel2); color: var(--text); border: 1px solid var(--border);
+    border-radius: 4px; padding: 4px 8px; font: inherit; }
+  button { cursor: pointer; }
+  button:hover { border-color: var(--accent); }
+  nav { display: flex; gap: 2px; padding: 0 12px; background: var(--panel);
+        border-bottom: 1px solid var(--border); }
+  nav a { padding: 8px 14px; color: var(--dim); text-decoration: none;
+          border-bottom: 2px solid transparent; }
+  nav a.active { color: var(--text); border-bottom-color: var(--accent); }
+  main { padding: 16px 18px; }
+  .tiles { display: flex; flex-wrap: wrap; gap: 12px; margin-bottom: 16px; }
+  .tile { background: var(--panel); border: 1px solid var(--border);
+          border-radius: 6px; padding: 10px 16px; min-width: 130px; }
+  .tile .label { color: var(--dim); font-size: 11px; text-transform: uppercase;
+                 letter-spacing: .04em; }
+  .tile .value { font-size: 20px; font-weight: 600; margin-top: 2px; }
+  .tile .sub { color: var(--dim); font-size: 11px; }
+  .bar { height: 4px; background: var(--panel2); border-radius: 2px;
+         margin-top: 6px; overflow: hidden; }
+  .bar i { display: block; height: 100%; background: var(--accent); }
+  .toolbar { display: flex; gap: 8px; margin-bottom: 10px; align-items: center; }
+  table { border-collapse: collapse; width: 100%; background: var(--panel);
+          border: 1px solid var(--border); border-radius: 6px; overflow: hidden; }
+  th, td { text-align: left; padding: 6px 10px; border-bottom: 1px solid var(--border);
+           font-size: 12px; max-width: 420px; overflow: hidden;
+           text-overflow: ellipsis; white-space: nowrap; }
+  th { background: var(--panel2); color: var(--dim); cursor: pointer;
+       user-select: none; position: sticky; top: 0; }
+  th .dir { color: var(--accent); }
+  tr:hover td { background: var(--panel2); }
+  .pill { display: inline-block; padding: 1px 8px; border-radius: 8px;
+          font-size: 11px; }
+  .pill.ok { background: rgba(63,185,127,.15); color: var(--ok); }
+  .pill.warn { background: rgba(224,166,61,.15); color: var(--warn); }
+  .pill.err { background: rgba(224,108,91,.15); color: var(--err); }
+  .pill.dim { background: rgba(132,148,166,.15); color: var(--dim); }
+  pre.logbox { background: var(--panel); border: 1px solid var(--border);
+               border-radius: 6px; padding: 12px; max-height: 480px;
+               overflow: auto; font-size: 12px; white-space: pre-wrap; }
+  .split { display: flex; gap: 16px; align-items: flex-start; }
+  .split > div { flex: 1; min-width: 0; }
+  .muted { color: var(--dim); }
+  .error-banner { background: rgba(224,108,91,.12); color: var(--err);
+                  border: 1px solid var(--err); border-radius: 4px;
+                  padding: 6px 12px; margin-bottom: 10px; display: none; }
+  form.jobform { display: flex; gap: 8px; margin-bottom: 12px; }
+  form.jobform input[name=entrypoint] { flex: 1; }
+  h3 { margin: 14px 0 8px; font-size: 13px; color: var(--dim);
+       text-transform: uppercase; letter-spacing: .04em; }
 </style>
 </head>
 <body>
-<h1>ray_tpu dashboard <span id="updated"></span></h1>
-<h2>Cluster</h2><div id="cluster"></div>
-<h2>Nodes</h2><table id="nodes"></table>
-<h2>Actors</h2><table id="actors"></table>
-<h2>Placement groups</h2><table id="pgs"></table>
-<h2>Jobs (submitted)</h2><table id="jobs"></table>
-<h2>Tasks</h2><div id="tasks"></div>
-<h2>Logs</h2>
-<select id="logsel"><option value="">— pick a log file —</option></select>
-<pre id="logview" style="background:#f7f7f7;padding:8px;max-height:320px;overflow:auto;font-size:0.75rem"></pre>
+<header>
+  <h1>ray_tpu</h1>
+  <span class="addr" id="addr"></span>
+  <div class="right">
+    <span class="muted" id="updated"></span>
+    <label class="muted">refresh
+      <select id="interval">
+        <option value="2000">2s</option>
+        <option value="5000" selected>5s</option>
+        <option value="15000">15s</option>
+        <option value="0">off</option>
+      </select>
+    </label>
+    <button onclick="refresh()">refresh now</button>
+  </div>
+</header>
+<nav id="nav"></nav>
+<main>
+  <div class="error-banner" id="errbox"></div>
+  <div class="tiles" id="tiles"></div>
+  <div id="content"></div>
+</main>
 <script>
-const esc = (v) => String(v).replace(/[&<>"']/g,
-  (c) => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));
-const fmt = (n) => typeof n === "number" ? (Number.isInteger(n) ? n : n.toFixed(2)) : n;
-// User-controlled strings (actor names, job entrypoints) flow into these
-// templates — escape everything; `pill` output is marked pre-escaped.
-const pill = (s) => ({__html: `<span class="pill ${esc(s)}">${esc(s)}</span>`});
-const cell = (c) => c === null || c === undefined ? '<span class=muted>—</span>'
-  : (c && c.__html) ? c.__html : esc(c);
-async function j(path) { const r = await fetch(path); return r.json(); }
-function table(el, headers, rows) {
-  el.innerHTML = "<tr>" + headers.map(h => `<th>${esc(h)}</th>`).join("") + "</tr>" +
-    (rows.length ? rows.map(r => "<tr>" + r.map(c => `<td>${cell(c)}</td>`).join("") + "</tr>").join("")
-                 : `<tr><td colspan=${headers.length} class=muted>none</td></tr>`);
+"use strict";
+const TABS = ["overview","actors","tasks","placement_groups","objects","workers","jobs","logs","metrics"];
+let tab = location.hash.replace("#","") || "overview";
+if (!TABS.includes(tab)) tab = "overview";
+let sortKey = null, sortDir = 1, filterText = "";
+let timer = null;
+
+const $ = (id) => document.getElementById(id);
+const esc = (s) => String(s).replace(/[&<>"']/g, c =>
+  ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));
+
+async function jget(url) {
+  const r = await fetch(url);
+  if (!r.ok) throw new Error(url + " -> " + r.status);
+  return r.json();
 }
-async function refresh() {
-  try {
-    const status = await j("/api/cluster_status");
-    const res = status.cluster_resources || {}, avail = status.available_resources || {};
-    document.getElementById("cluster").innerHTML =
-      Object.keys(res).sort().map(k =>
-        `<b>${esc(k)}</b>: ${fmt(res[k] - (avail[k] ?? 0))}/${fmt(res[k])} used`).join(" &nbsp;·&nbsp; ");
-    const gb = (n) => n == null ? null : (n / 1073741824).toFixed(1) + "G";
-    table(document.getElementById("nodes"),
-      ["node", "state", "address", "active workers", "cpu %", "mem", "workers rss"],
-      (status.nodes || []).map(n => {
-        const s = n.stats || {};
-        const wrss = Object.values(s.workers || {}).reduce((a, w) => a + (w.rss || 0), 0);
-        return [n.node_id.slice(0,12), pill(n.state),
-          (n.address || []).join(":"), n.num_active_workers ?? 0,
-          s.cpu_percent != null ? fmt(s.cpu_percent) : null,
-          s.mem_total ? `${gb(s.mem_used)}/${gb(s.mem_total)}` : null,
-          wrss ? gb(wrss) : null];
-      }));
-    const actors = (await j("/api/v0/actors")).result || [];
-    table(document.getElementById("actors"),
-      ["actor", "name", "state", "node", "restarts"],
-      actors.map(a => [a.actor_id.slice(0,12), a.name, pill(a.state),
-        (a.node_id || "").slice(0,8), a.num_restarts ?? 0]));
-    const pgs = (await j("/api/v0/placement_groups")).result || [];
-    table(document.getElementById("pgs"),
-      ["id", "state", "strategy", "bundles"],
-      pgs.map(p => [String(p.placement_group_id || p.id || "").slice(0,12), pill(p.state || "?"),
-        p.strategy, JSON.stringify(p.bundles || []).slice(0, 80)]));
-    const jobs = await j("/api/jobs/");
-    table(document.getElementById("jobs"),
-      ["id", "status", "entrypoint"],
-      (jobs || []).map(x => [x.submission_id, pill(x.status), x.entrypoint]));
-    const summary = await j("/api/v0/tasks/summarize");
-    document.getElementById("tasks").innerHTML =
-      "<table>" + "<tr><th>task</th><th>total</th><th>states</th></tr>" +
-      Object.entries(summary).map(([name, e]) =>
-        `<tr><td>${esc(name)}</td><td>${esc(e.total)}</td><td>` +
-        Object.entries(e.states || {}).map(([s, c]) => `${pill(s).__html} ${esc(c)}`).join(" ") +
-        `</td></tr>`).join("") + "</table>";
-    document.getElementById("updated").textContent =
-      "updated " + new Date().toLocaleTimeString();
-  } catch (e) {
-    document.getElementById("updated").textContent = "refresh failed: " + e;
+
+function setError(msg) {
+  const box = $("errbox");
+  if (!msg) { box.style.display = "none"; return; }
+  box.textContent = msg; box.style.display = "block";
+}
+
+function drawNav() {
+  $("nav").innerHTML = TABS.map(t =>
+    `<a href="#${t}" class="${t===tab?"active":""}" onclick="switchTab('${t}')">${t.replace("_"," ")}</a>`
+  ).join("");
+}
+function switchTab(t) { tab = t; sortKey = null; filterText = ""; drawNav(); refresh(); }
+
+function pill(v) {
+  const s = String(v).toUpperCase();
+  if (["ALIVE","RUNNING","FINISHED","SUCCEEDED","CREATED","OK","TRUE"].includes(s)) return `<span class="pill ok">${esc(v)}</span>`;
+  if (["PENDING","PENDING_CREATION","RESTARTING","STARTING","QUEUED"].includes(s)) return `<span class="pill warn">${esc(v)}</span>`;
+  if (["DEAD","FAILED","STOPPED","ERROR"].includes(s)) return `<span class="pill err">${esc(v)}</span>`;
+  return `<span class="pill dim">${esc(v)}</span>`;
+}
+
+function fmtBytes(n) {
+  if (typeof n !== "number" || !isFinite(n)) return n;
+  const u = ["B","KiB","MiB","GiB","TiB"]; let i = 0;
+  while (n >= 1024 && i < u.length-1) { n /= 1024; i++; }
+  return n.toFixed(i ? 1 : 0) + " " + u[i];
+}
+
+function cell(k, v) {
+  if (v === null || v === undefined) return "<span class='muted'>—</span>";
+  if (k.includes("state") || k === "status") return pill(v);
+  if ((k.includes("bytes") || k.includes("memory") || k === "size") && typeof v === "number") return fmtBytes(v);
+  if (typeof v === "object") return `<code>${esc(JSON.stringify(v))}</code>`;
+  return esc(v);
+}
+
+// rawCols values are inserted as-is (pre-built button HTML).
+function table(rows, rawCols) {
+  rawCols = rawCols || [];
+  if (!rows || !rows.length) return "<p class='muted'>none</p>";
+  const cols = Object.keys(rows[0]);
+  let data = rows;
+  if (filterText) {
+    const f = filterText.toLowerCase();
+    data = data.filter(r => JSON.stringify(r).toLowerCase().includes(f));
   }
+  if (sortKey) {
+    data = [...data].sort((a, b) => {
+      const x = a[sortKey], y = b[sortKey];
+      if (x === y) return 0;
+      if (x === null || x === undefined) return 1;
+      if (y === null || y === undefined) return -1;
+      return (x < y ? -1 : 1) * sortDir;
+    });
+  }
+  const head = cols.map(c =>
+    `<th data-sort="${esc(c)}">${esc(c)}${sortKey===c ? `<span class="dir"> ${sortDir>0?"▲":"▼"}</span>` : ""}</th>`
+  ).join("");
+  const body = data.slice(0, 500).map(r =>
+    "<tr>" + cols.map(c =>
+      rawCols.includes(c) ? `<td>${r[c]}</td>`
+                          : `<td title="${esc(r[c] ?? "")}">${cell(c, r[c])}</td>`
+    ).join("") + "</tr>"
+  ).join("");
+  const more = data.length > 500 ? `<p class="muted">showing 500 of ${data.length}</p>` : "";
+  return `<table><thead><tr>${head}</tr></thead><tbody>${body}</tbody></table>${more}`;
 }
-async function refreshLogs() {
+function setSort(c) { if (sortKey === c) sortDir = -sortDir; else { sortKey = c; sortDir = 1; } refresh(); }
+function toolbar() {
+  return `<div class="toolbar">
+    <input placeholder="filter…" value="${esc(filterText)}"
+           oninput="filterText=this.value" onchange="refresh()">
+  </div>`;
+}
+
+async function drawTiles() {
   try {
-    const files = (await j("/api/v0/logs")).result || [];
-    const sel = document.getElementById("logsel");
-    const cur = sel.value;
-    sel.innerHTML = '<option value="">— pick a log file —</option>' +
-      files.map(f => `<option value="${esc(f.file)}">${esc(f.file)} (${f.size}b)</option>`).join("");
-    sel.value = cur;
-  } catch (e) {}
+    const s = await jget("/api/cluster_status");
+    const nodes = s.nodes || [];
+    const alive = nodes.filter(n => (n.state||"").toUpperCase() === "ALIVE").length;
+    const cr = s.cluster_resources || {}, ar = s.available_resources || {};
+    const cpuT = cr.CPU || 0, cpuU = cpuT - (ar.CPU || 0);
+    const tpuT = cr.TPU || 0, tpuU = tpuT - (ar.TPU || 0);
+    let storeUsed = 0, storeCap = 0;
+    nodes.forEach(n => { const su = n.store_usage || {}; storeUsed += su.used||0; storeCap += su.capacity||0; });
+    const tiles = [
+      {label: "nodes alive", value: `${alive} / ${nodes.length}`},
+      {label: "CPUs in use", value: `${cpuU.toFixed(1)} / ${cpuT}`, frac: cpuT ? cpuU/cpuT : 0},
+      ...(tpuT ? [{label: "TPUs in use", value: `${tpuU.toFixed(1)} / ${tpuT}`, frac: tpuU/tpuT}] : []),
+      {label: "object store", value: fmtBytes(storeUsed), sub: "of " + fmtBytes(storeCap),
+       frac: storeCap ? storeUsed/storeCap : 0},
+    ];
+    $("tiles").innerHTML = tiles.map(t => `
+      <div class="tile"><div class="label">${t.label}</div>
+        <div class="value">${t.value}</div>
+        ${t.sub ? `<div class="sub">${t.sub}</div>` : ""}
+        ${t.frac !== undefined ? `<div class="bar"><i style="width:${Math.min(100, t.frac*100).toFixed(0)}%"></i></div>` : ""}
+      </div>`).join("");
+  } catch (e) { setError("cluster status unavailable: " + e.message); }
 }
-document.getElementById("logsel").addEventListener("change", async (ev) => {
-  const f = ev.target.value;
-  if (!f) return;
-  const r = await j("/api/v0/logs/tail?file=" + encodeURIComponent(f) + "&lines=200");
-  document.getElementById("logview").textContent = (r.lines || []).join("\n");
+
+const DRAW = {
+  async overview() {
+    const s = await jget("/api/cluster_status");
+    return toolbar() + "<h3>Nodes</h3>" + table(s.nodes || []);
+  },
+  async actors()   { return toolbar() + table((await jget("/api/v0/actors")).result); },
+  async tasks() {
+    const [summary, tasks] = await Promise.all([
+      jget("/api/v0/tasks/summarize").catch(() => null),
+      jget("/api/v0/tasks"),
+    ]);
+    let out = "";
+    if (summary && typeof summary === "object" && Object.keys(summary).length) {
+      out += "<h3>Summary</h3>" + table(Object.entries(summary).map(
+        ([name, info]) => Object.assign({func_or_class_name: name},
+                                        typeof info === "object" ? info : {value: info})));
+    }
+    return toolbar() + out + "<h3>Tasks</h3>" + table(tasks.result);
+  },
+  async placement_groups() { return toolbar() + table((await jget("/api/v0/placement_groups")).result); },
+  async objects()  { return toolbar() + table((await jget("/api/v0/objects")).result); },
+  async workers()  { return toolbar() + table((await jget("/api/v0/workers")).result); },
+  async jobs() {
+    const jobs = await jget("/api/jobs");
+    const rows = (Array.isArray(jobs) ? jobs : (jobs.result || jobs.jobs || [])).map(r => {
+      const id = r.submission_id || r.job_id || "";
+      return Object.assign({}, r, {
+        actions: `<button data-act="joblogs" data-id="${esc(id)}">logs</button> ` +
+                 `<button data-act="jobstop" data-id="${esc(id)}">stop</button>`,
+      });
+    });
+    const logHtml = window._joblog
+      ? `<h3>Logs: ${esc(window._joblog.id)}</h3><pre class="logbox">${esc(window._joblog.text)}</pre>`
+      : "";
+    return `
+      <form class="jobform" onsubmit="submitJob(event)">
+        <input name="entrypoint" placeholder='entrypoint, e.g. python -c "print(42)"' required>
+        <button>submit job</button>
+      </form>` + table(rows, ["actions"]) + logHtml;
+  },
+  async logs() {
+    const files = (await jget("/api/v0/logs")).result || [];
+    const body = files.map(f =>
+      `<tr><td><button data-act="tail" data-file="${esc(f.file)}">${esc(f.file)}</button></td>` +
+      `<td>${fmtBytes(f.size)}</td></tr>`).join("");
+    const tbl = files.length
+      ? `<table><thead><tr><th>file</th><th>size</th></tr></thead><tbody>${body}</tbody></table>`
+      : "<p class='muted'>no log files</p>";
+    const tail = window._logtail
+      ? `<div><h3>${esc(window._logtail.file)}</h3><pre class="logbox">${esc(window._logtail.text)}</pre></div>`
+      : "<div><p class='muted'>select a file to tail</p></div>";
+    return `<div class="split"><div>${tbl}</div>${tail}</div>`;
+  },
+  async metrics() {
+    const r = await fetch("/metrics");
+    return `<pre class="logbox">${esc(await r.text())}</pre>`;
+  },
+};
+
+async function showJobLogs(id) {
+  try {
+    const r = await jget("/api/jobs/" + encodeURIComponent(id) + "/logs");
+    window._joblog = {id, text: r.logs || "(empty)"};
+  } catch (e) { window._joblog = {id, text: "error: " + e.message}; }
+  refresh();
+}
+async function stopJob(id) {
+  try {
+    const r = await fetch("/api/jobs/" + encodeURIComponent(id) + "/stop", {method: "POST"});
+    if (!r.ok) setError("stop failed: " + ((await r.json()).error || r.status));
+  } catch (e) { setError("stop failed: " + e.message); }
+  refresh();
+}
+async function tailLog(file) {
+  try {
+    const r = await jget("/api/v0/logs/tail?file=" + encodeURIComponent(file) + "&lines=400");
+    window._logtail = {file, text: (r.lines || []).join("\n") || "(empty)"};
+  } catch (e) { window._logtail = {file, text: "error: " + e.message}; }
+  refresh();
+}
+async function submitJob(ev) {
+  ev.preventDefault();
+  const entry = ev.target.entrypoint.value;
+  try {
+    const r = await fetch("/api/jobs", {method: "POST", headers: {"Content-Type": "application/json"},
+                                        body: JSON.stringify({entrypoint: entry})});
+    if (!r.ok) { setError("job submit failed: " + ((await r.json()).error || r.status)); }
+    else ev.target.entrypoint.value = "";
+  } catch (e) { setError("job submit failed: " + e.message); }
+  refresh();
+}
+
+async function refresh() {
+  drawTiles();
+  // Never clobber in-progress typing: if an input inside the content area
+  // has focus, skip this re-render (tiles still update).
+  const ae = document.activeElement;
+  if (ae && $("content").contains(ae) && ["INPUT","TEXTAREA"].includes(ae.tagName)) {
+    $("updated").textContent = "paused (editing)";
+    return;
+  }
+  try {
+    $("content").innerHTML = await DRAW[tab]();
+    setError(null);
+  } catch (e) {
+    $("content").innerHTML = "";
+    setError(tab + " unavailable: " + e.message);
+  }
+  $("updated").textContent = "updated " + new Date().toLocaleTimeString();
+}
+
+// Delegated actions: ids/filenames are user- or job-influenced, so they
+// ride data-* attributes (HTML-attr escaping is sufficient there) instead
+// of being spliced into inline JS strings (where entity decoding would
+// reopen script injection).
+$("content").addEventListener("click", (ev) => {
+  const el = ev.target.closest("[data-act],[data-sort]");
+  if (!el) return;
+  if (el.dataset.sort !== undefined) return setSort(el.dataset.sort);
+  if (el.dataset.act === "joblogs") return showJobLogs(el.dataset.id);
+  if (el.dataset.act === "jobstop") return stopJob(el.dataset.id);
+  if (el.dataset.act === "tail") return tailLog(el.dataset.file);
 });
-refresh(); refreshLogs(); setInterval(refresh, 3000); setInterval(refreshLogs, 10000);
+
+function schedule() {
+  if (timer) clearInterval(timer);
+  const ms = parseInt($("interval").value, 10);
+  if (ms > 0) timer = setInterval(refresh, ms);
+}
+$("interval").addEventListener("change", schedule);
+
+jget("/api/version").then(v => {
+  $("addr").textContent = "v" + v.version + " · " + v.ray_address;
+}).catch(() => {});
+drawNav();
+refresh();
+schedule();
 </script>
 </body>
 </html>

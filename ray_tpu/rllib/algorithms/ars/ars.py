@@ -209,3 +209,32 @@ class ARS(ES, Algorithm):
                 self._obs_std_cur + 1e-8
             )
         return super().compute_single_action(obs, explore=explore)
+
+    def save_checkpoint(self):
+        from ray_tpu.air.checkpoint import Checkpoint
+
+        ckpt = super().save_checkpoint().to_dict()
+        # The observation filter is part of the POLICY: weights are fit to
+        # normalized observations, so restoring them without the filter
+        # stats feeds raw obs to a normalized-obs policy.
+        ckpt["obs_filter"] = {
+            "count": self._obs_count,
+            "sum": None if self._obs_sum is None else np.asarray(self._obs_sum),
+            "sumsq": None if self._obs_sumsq is None else np.asarray(self._obs_sumsq),
+            "mean": getattr(self, "_obs_mean_cur", None),
+            "std": getattr(self, "_obs_std_cur", None),
+        }
+        return Checkpoint.from_dict(ckpt)
+
+    def load_checkpoint(self, checkpoint) -> None:
+        super().load_checkpoint(checkpoint)
+        flt = checkpoint.to_dict().get("obs_filter")
+        if flt:
+            self._obs_count = flt.get("count", 0)
+            self._obs_sum = flt.get("sum")
+            self._obs_sumsq = flt.get("sumsq")
+            if flt.get("mean") is not None:
+                self._obs_mean_cur = np.asarray(flt["mean"], np.float32)
+                self._obs_std_cur = np.asarray(flt["std"], np.float32)
+                for w in self._workers:
+                    w.set_obs_stats.remote(self._obs_mean_cur, self._obs_std_cur)

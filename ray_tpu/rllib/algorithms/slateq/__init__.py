@@ -1,0 +1,3 @@
+from ray_tpu.rllib.algorithms.slateq.slateq import SlateQ, SlateQConfig
+
+__all__ = ["SlateQ", "SlateQConfig"]

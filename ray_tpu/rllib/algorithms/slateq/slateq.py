@@ -1,0 +1,341 @@
+"""SlateQ — Q-learning for slate recommendation (Ie et al. 2019).
+
+Reference: rllib/algorithms/slateq/ (slateq.py, slateq_torch_policy.py):
+the combinatorial slate action space is made tractable by SlateQ's
+DECOMPOSITION under a conditional-logistic user choice model:
+
+    Q(s, slate) = sum_i P(click i | s, slate) * q(s, d_i)
+
+where q(s, d) is a learned per-DOCUMENT Q-value and the click
+probabilities come from a choice model with learned user/doc affinity
+scores. The TD target bootstraps with the best next slate, found by the
+reference's default greedy optimizer (top-k by v(s,d)*q(s,d) score — exact
+for this choice-model family). Both the per-item q-network and the choice
+model's affinity head train jointly: q by SARSA-style decomposed TD on
+clicked items, the choice model by maximum likelihood on observed clicks.
+
+TPU-native shape: candidates are a [C, F] tensor; per-item q and affinity
+are batched matmuls over all candidates at once, and the greedy slate is a
+top-k — no per-item Python, one jitted update.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.off_policy import OffPolicyTraining
+from ray_tpu.rllib.algorithms.sac.sac import _mlp_apply, _mlp_params
+from ray_tpu.rllib.env.recsys import SlateRecEnv
+
+
+class SlateQConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or SlateQ)
+        self.lr = 1e-3
+        self.choice_lr = 1e-3
+        self.num_rollout_workers = 0
+        self.train_batch_size = 64
+        self.replay_buffer_capacity = 50_000
+        self.learning_starts = 500
+        self.target_network_update_freq = 100
+        self.rollout_steps_per_iter = 400
+        self.train_intensity = 4
+        self.epsilon_timesteps = 6000
+        self.initial_epsilon = 1.0
+        self.final_epsilon = 0.05
+        self.model_hiddens = (64, 64)
+
+    def training(self, *, choice_lr=None, replay_buffer_capacity=None,
+                 learning_starts=None, target_network_update_freq=None,
+                 rollout_steps_per_iter=None, train_intensity=None,
+                 epsilon_timesteps=None, final_epsilon=None, **kwargs) -> "SlateQConfig":
+        super().training(**kwargs)
+        for name, val in (
+            ("choice_lr", choice_lr),
+            ("replay_buffer_capacity", replay_buffer_capacity),
+            ("learning_starts", learning_starts),
+            ("target_network_update_freq", target_network_update_freq),
+            ("rollout_steps_per_iter", rollout_steps_per_iter),
+            ("train_intensity", train_intensity),
+            ("epsilon_timesteps", epsilon_timesteps),
+            ("final_epsilon", final_epsilon),
+        ):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+class _Replay:
+    def __init__(self, capacity, seed):
+        self.capacity = capacity
+        self._data: dict | None = None
+        self._n = 0
+        self._pos = 0
+        self._rng = np.random.default_rng(seed)
+
+    def add(self, item: dict):
+        if self._data is None:
+            self._data = {
+                k: np.zeros((self.capacity,) + np.asarray(v).shape, np.asarray(v).dtype)
+                for k, v in item.items()
+            }
+        for k, v in item.items():
+            self._data[k][self._pos] = v
+        self._pos = (self._pos + 1) % self.capacity
+        self._n = min(self._n + 1, self.capacity)
+
+    def __len__(self):
+        return self._n
+
+    def sample(self, n):
+        idx = self._rng.integers(0, self._n, n)
+        return {k: v[idx] for k, v in self._data.items()}
+
+
+class SlateQ(OffPolicyTraining, Algorithm):
+    @classmethod
+    def get_default_config(cls) -> SlateQConfig:
+        return SlateQConfig(cls)
+
+    def setup(self, config: dict) -> None:
+        import jax
+        import optax
+
+        cfg: SlateQConfig = self._algo_config
+        env = cfg.env(dict(cfg.env_config)) if callable(cfg.env) else cfg.env
+        assert isinstance(env, SlateRecEnv), (
+            "SlateQ requires a SlateRecEnv-style slate environment "
+            "(user state + candidate docs + slate actions)"
+        )
+        self.env = env
+        self.C = env.num_candidates
+        self.K = env.slate_size
+        self.F = env.num_topics + 1  # doc features + quality
+        self.user_dim = env.num_topics
+        self.no_click_mass = env.no_click_mass
+
+        keys = jax.random.split(jax.random.PRNGKey(cfg.seed), 2)
+        H = cfg.model_hiddens
+        # Per-item q(s, d) and choice-affinity v(s, d): both take
+        # [user_state, doc_features] and emit a scalar.
+        self.params = {
+            "q": _mlp_params(keys[0], self.user_dim + self.F, H, 1),
+            "choice": _mlp_params(keys[1], self.user_dim + self.F, H, 1),
+        }
+        self.target_params = jax.tree_util.tree_map(np.asarray, self.params)
+        self.tx = optax.multi_transform(
+            {
+                "q": optax.adam(cfg.lr),
+                "choice": optax.adam(cfg.choice_lr),
+            },
+            param_labels={"q": "q", "choice": "choice"},
+        )
+        self.opt_state = self.tx.init(self.params)
+        self.buffer = _Replay(cfg.replay_buffer_capacity, cfg.seed)
+        self._timesteps_total = 0
+        self._updates = 0
+        self._episode_reward_window: list = []
+        self._ep_reward = 0.0
+        self._rng = np.random.default_rng(cfg.seed)
+        self._obs, _ = env.reset(seed=cfg.seed)
+        self._build_fns(cfg)
+
+    # -- obs helpers ----------------------------------------------------
+
+    def _split_obs(self, obs):
+        user = obs[..., : self.user_dim]
+        docs = obs[..., self.user_dim :].reshape(*obs.shape[:-1], self.C, self.F)
+        return user, docs
+
+    def _build_fns(self, cfg: SlateQConfig):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        K, C = self.K, self.C
+        gamma = cfg.gamma
+        no_click = self.no_click_mass
+        user_dim = self.user_dim
+        F = self.F
+        tx = self.tx
+
+        def per_item(params_head, user, docs):
+            """[B,user] x [B,C,F] -> [B,C] scalars."""
+            B = user.shape[0]
+            inp = jnp.concatenate(
+                [jnp.broadcast_to(user[:, None, :], (B, C, user_dim)), docs], -1
+            )
+            return _mlp_apply(params_head, inp.reshape(B * C, user_dim + F)).reshape(B, C)
+
+        def greedy_slate_value(params, user, docs):
+            """Decomposed value of the greedy slate (reference: greedy slate
+            optimizer — for conditional-logistic choice, top-k by v*q score
+            is the optimizer's default)."""
+            q = per_item(params["q"], user, docs)        # [B,C]
+            v = per_item(params["choice"], user, docs)   # [B,C] affinities
+            score = jnp.exp(v) * q
+            top = jax.lax.top_k(score, K)[1]             # [B,K]
+            v_top = jnp.take_along_axis(v, top, 1)
+            q_top = jnp.take_along_axis(q, top, 1)
+            w = jnp.exp(v_top)
+            denom = w.sum(1) + no_click
+            return (w * q_top).sum(1) / denom, top
+
+        self._greedy = jax.jit(lambda p, u, d: greedy_slate_value(p, u, d)[1])
+
+        def update(params, target_params, opt_state, batch):
+            user, docs = batch["user"], batch["docs"]
+            nuser, ndocs = batch["next_user"], batch["next_docs"]
+            slate = batch["slate"].astype(jnp.int32)      # [B,K]
+            clicked = batch["clicked"].astype(jnp.int32)  # [B] index into slate or -1
+            rew = batch["reward"]
+            dones = batch["done"]
+
+            next_val, _ = greedy_slate_value(target_params, nuser, ndocs)
+            y = rew + gamma * (1.0 - dones) * next_val
+            y = jax.lax.stop_gradient(y)
+
+            def loss_fn(p):
+                q_all = per_item(p["q"], user, docs)
+                v_all = per_item(p["choice"], user, docs)
+                q_slate = jnp.take_along_axis(q_all, slate, 1)  # [B,K]
+                v_slate = jnp.take_along_axis(v_all, slate, 1)
+                # --- decomposed TD: regress the CLICKED item's q to y ---
+                did_click = clicked >= 0
+                safe_click = jnp.maximum(clicked, 0)
+                q_clicked = jnp.take_along_axis(q_slate, safe_click[:, None], 1)[:, 0]
+                td = jnp.where(did_click, q_clicked - y, 0.0)
+                q_loss = jnp.sum(jnp.square(td)) / jnp.maximum(did_click.sum(), 1)
+                # --- choice model: MLE of the observed click/no-click ---
+                logits = jnp.concatenate(
+                    [v_slate, jnp.full((v_slate.shape[0], 1), jnp.log(no_click))], 1
+                )
+                logp = jax.nn.log_softmax(logits, -1)
+                choice_idx = jnp.where(did_click, safe_click, K)  # K = no-click slot
+                nll = -jnp.take_along_axis(logp, choice_idx[:, None], 1)[:, 0]
+                choice_loss = nll.mean()
+                return q_loss + choice_loss, {
+                    "q_loss": q_loss,
+                    "choice_loss": choice_loss,
+                    "click_rate": did_click.mean(),
+                }
+
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            aux = dict(aux)
+            aux["total_loss"] = loss
+            return params, opt_state, aux
+
+        self._update = jax.jit(update)
+
+    def _epsilon(self) -> float:
+        cfg = self._algo_config
+        frac = min(1.0, self._timesteps_total / max(cfg.epsilon_timesteps, 1))
+        return cfg.initial_epsilon + frac * (cfg.final_epsilon - cfg.initial_epsilon)
+
+    def _pick_slate(self, obs, explore: bool):
+        import jax.numpy as jnp
+
+        if explore and self._rng.random() < self._epsilon():
+            return self._rng.choice(self.C, self.K, replace=False)
+        user, docs = self._split_obs(np.asarray(obs, np.float32))
+        slate = np.asarray(
+            self._greedy(
+                self._as_jax(self.params), jnp.asarray(user[None]), jnp.asarray(docs[None])
+            )
+        )[0]
+        return slate
+
+    def training_step(self) -> dict:
+        cfg: SlateQConfig = self._algo_config
+        metrics: dict = {}
+        for _ in range(cfg.rollout_steps_per_iter):
+            obs = self._obs
+            slate = self._pick_slate(obs, explore=True)
+            nobs, reward, done, _trunc, info = self.env.step(slate)
+            user, docs = self._split_obs(np.asarray(obs, np.float32))
+            nuser, ndocs = self._split_obs(np.asarray(nobs, np.float32))
+            clicked_doc = info.get("clicked", -1)
+            clicked_pos = -1
+            for pos, doc in enumerate(slate):
+                if doc == clicked_doc:
+                    clicked_pos = pos
+                    break
+            self.buffer.add({
+                "user": user, "docs": docs, "next_user": nuser, "next_docs": ndocs,
+                "slate": np.asarray(slate, np.int32),
+                "clicked": np.int32(clicked_pos),
+                "reward": np.float32(reward), "done": np.float32(done),
+            })
+            self._ep_reward += reward
+            self._timesteps_total += 1
+            if done:
+                self._episode_reward_window.append(self._ep_reward)
+                self._episode_reward_window = self._episode_reward_window[-100:]
+                self._ep_reward = 0.0
+                self._obs, _ = self.env.reset()
+            else:
+                self._obs = nobs
+            if (
+                len(self.buffer) >= cfg.learning_starts
+                and self._timesteps_total % max(1, cfg.train_intensity) == 0
+            ):
+                metrics = self._train_once()
+        metrics["epsilon"] = self._epsilon()
+        return metrics
+
+    def _train_once(self) -> dict:
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self._algo_config
+        batch = {k: jnp.asarray(v) for k, v in self.buffer.sample(cfg.train_batch_size).items()}
+        self.params, self.opt_state, aux = self._update(
+            self.params, self._as_jax(self.target_params), self.opt_state, batch
+        )
+        self._updates += 1
+        if self._updates % cfg.target_network_update_freq == 0:
+            self.target_params = jax.tree_util.tree_map(np.asarray, self.params)
+        return {k: float(v) for k, v in aux.items()}
+
+    @staticmethod
+    def _as_jax(tree):
+        import jax
+        import jax.numpy as jnp
+
+        return jax.tree_util.tree_map(jnp.asarray, tree)
+
+    def compute_single_action(self, obs, explore: bool = False):
+        return self._pick_slate(obs, explore=explore)
+
+    def save_checkpoint(self):
+        from ray_tpu.air.checkpoint import Checkpoint
+
+        return Checkpoint.from_dict({
+            "params": self.params,
+            "target": self.target_params,
+            "opt_state": self.opt_state,
+            "timesteps": self._timesteps_total,
+            # Training state a resume must not silently reset: the target-
+            # sync phase and the epsilon-greedy exploration stream.
+            "updates": self._updates,
+            "np_rng_state": self._rng.bit_generator.state,
+        })
+
+    def load_checkpoint(self, checkpoint) -> None:
+        data = checkpoint.to_dict()
+        self.params = data["params"]
+        self.target_params = data["target"]
+        self.opt_state = data["opt_state"]
+        self._timesteps_total = data.get("timesteps", 0)
+        self._updates = data.get("updates", 0)
+        if "np_rng_state" in data:
+            self._rng.bit_generator.state = data["np_rng_state"]
+
+    def cleanup(self) -> None:
+        if getattr(self, "env", None) is not None:
+            self.env.close()
