@@ -151,9 +151,9 @@ function fmtBytes(n) {
 
 function cell(k, v) {
   if (v === null || v === undefined) return "<span class='muted'>—</span>";
+  if (typeof v === "object") return `<code>${esc(JSON.stringify(v))}</code>`;
   if (k.includes("state") || k === "status") return pill(v);
   if ((k.includes("bytes") || k.includes("memory") || k === "size") && typeof v === "number") return fmtBytes(v);
-  if (typeof v === "object") return `<code>${esc(JSON.stringify(v))}</code>`;
   return esc(v);
 }
 
@@ -192,13 +192,15 @@ function setSort(c) { if (sortKey === c) sortDir = -sortDir; else { sortKey = c;
 function toolbar() {
   return `<div class="toolbar">
     <input placeholder="filter…" value="${esc(filterText)}"
-           oninput="filterText=this.value" onchange="refresh()">
+           oninput="filterText=this.value" onchange="this.blur(); refresh()">
   </div>`;
 }
 
+let statusPromise = null;
+
 async function drawTiles() {
   try {
-    const s = await jget("/api/cluster_status");
+    const s = await statusPromise;
     const nodes = s.nodes || [];
     const alive = nodes.filter(n => (n.state||"").toUpperCase() === "ALIVE").length;
     const cr = s.cluster_resources || {}, ar = s.available_resources || {};
@@ -224,7 +226,7 @@ async function drawTiles() {
 
 const DRAW = {
   async overview() {
-    const s = await jget("/api/cluster_status");
+    const s = await statusPromise;
     return toolbar() + "<h3>Nodes</h3>" + table(s.nodes || []);
   },
   async actors()   { return toolbar() + table((await jget("/api/v0/actors")).result); },
@@ -315,6 +317,7 @@ async function submitJob(ev) {
 }
 
 async function refresh() {
+  statusPromise = jget("/api/cluster_status");
   drawTiles();
   // Never clobber in-progress typing: if an input inside the content area
   // has focus, skip this re-render (tiles still update).

@@ -1,0 +1,7 @@
+from ray_tpu.rllib.algorithms.alpha_zero.alpha_zero import (
+    AlphaZero,
+    AlphaZeroConfig,
+    StateCloneWrapper,
+)
+
+__all__ = ["AlphaZero", "AlphaZeroConfig", "StateCloneWrapper"]

@@ -21,6 +21,7 @@ import numpy as np
 from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.algorithms.sac.sac import _mlp_apply, _mlp_params
 from ray_tpu.rllib.env.multi_agent_env import MultiAgentEnv
+from ray_tpu.rllib.utils.replay_buffers import ColumnReplayBuffer
 
 
 class MADDPGConfig(AlgorithmConfig):
@@ -56,35 +57,6 @@ class MADDPGConfig(AlgorithmConfig):
             if val is not None:
                 setattr(self, name, val)
         return self
-
-
-class _Replay:
-    """Flat multi-agent transition store: joint arrays, uniform sampling."""
-
-    def __init__(self, capacity: int, seed: int):
-        self.capacity = capacity
-        self._data: dict | None = None
-        self._n = 0
-        self._pos = 0
-        self._rng = np.random.default_rng(seed)
-
-    def add(self, item: dict):
-        if self._data is None:
-            self._data = {
-                k: np.zeros((self.capacity,) + np.asarray(v).shape, np.float32)
-                for k, v in item.items()
-            }
-        for k, v in item.items():
-            self._data[k][self._pos] = v
-        self._pos = (self._pos + 1) % self.capacity
-        self._n = min(self._n + 1, self.capacity)
-
-    def __len__(self):
-        return self._n
-
-    def sample(self, n: int) -> dict:
-        idx = self._rng.integers(0, self._n, n)
-        return {k: v[idx] for k, v in self._data.items()}
 
 
 class MADDPG(Algorithm):
@@ -132,7 +104,7 @@ class MADDPG(Algorithm):
             param_labels={"actor": "actor", "critic": "critic"},
         )
         self.opt_state = self.tx.init(self.params)
-        self.buffer = _Replay(cfg.replay_buffer_capacity, cfg.seed)
+        self.buffer = ColumnReplayBuffer(cfg.replay_buffer_capacity, cfg.seed)
         self._timesteps_total = 0
         self._updates = 0
         self._episode_reward_window: list = []

@@ -29,6 +29,7 @@ from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.algorithms.off_policy import OffPolicyTraining
 from ray_tpu.rllib.algorithms.sac.sac import _mlp_apply, _mlp_params
 from ray_tpu.rllib.env.recsys import SlateRecEnv
+from ray_tpu.rllib.utils.replay_buffers import ColumnReplayBuffer
 
 
 class SlateQConfig(AlgorithmConfig):
@@ -68,33 +69,6 @@ class SlateQConfig(AlgorithmConfig):
         return self
 
 
-class _Replay:
-    def __init__(self, capacity, seed):
-        self.capacity = capacity
-        self._data: dict | None = None
-        self._n = 0
-        self._pos = 0
-        self._rng = np.random.default_rng(seed)
-
-    def add(self, item: dict):
-        if self._data is None:
-            self._data = {
-                k: np.zeros((self.capacity,) + np.asarray(v).shape, np.asarray(v).dtype)
-                for k, v in item.items()
-            }
-        for k, v in item.items():
-            self._data[k][self._pos] = v
-        self._pos = (self._pos + 1) % self.capacity
-        self._n = min(self._n + 1, self.capacity)
-
-    def __len__(self):
-        return self._n
-
-    def sample(self, n):
-        idx = self._rng.integers(0, self._n, n)
-        return {k: v[idx] for k, v in self._data.items()}
-
-
 class SlateQ(OffPolicyTraining, Algorithm):
     @classmethod
     def get_default_config(cls) -> SlateQConfig:
@@ -125,7 +99,9 @@ class SlateQ(OffPolicyTraining, Algorithm):
             "q": _mlp_params(keys[0], self.user_dim + self.F, H, 1),
             "choice": _mlp_params(keys[1], self.user_dim + self.F, H, 1),
         }
-        self.target_params = jax.tree_util.tree_map(np.asarray, self.params)
+        # Target tree stays DEVICE-side: converting per update would
+        # re-upload both MLPs on every gradient step.
+        self.target_params = self.params
         self.tx = optax.multi_transform(
             {
                 "q": optax.adam(cfg.lr),
@@ -134,7 +110,7 @@ class SlateQ(OffPolicyTraining, Algorithm):
             param_labels={"q": "q", "choice": "choice"},
         )
         self.opt_state = self.tx.init(self.params)
-        self.buffer = _Replay(cfg.replay_buffer_capacity, cfg.seed)
+        self.buffer = ColumnReplayBuffer(cfg.replay_buffer_capacity, cfg.seed)
         self._timesteps_total = 0
         self._updates = 0
         self._episode_reward_window: list = []
@@ -295,11 +271,13 @@ class SlateQ(OffPolicyTraining, Algorithm):
         cfg = self._algo_config
         batch = {k: jnp.asarray(v) for k, v in self.buffer.sample(cfg.train_batch_size).items()}
         self.params, self.opt_state, aux = self._update(
-            self.params, self._as_jax(self.target_params), self.opt_state, batch
+            self.params, self.target_params, self.opt_state, batch
         )
         self._updates += 1
         if self._updates % cfg.target_network_update_freq == 0:
-            self.target_params = jax.tree_util.tree_map(np.asarray, self.params)
+            # Hard sync: the params tree is immutable (updates build new
+            # trees), so aliasing is a correct snapshot.
+            self.target_params = self.params
         return {k: float(v) for k, v in aux.items()}
 
     @staticmethod

@@ -89,3 +89,35 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         prios = np.abs(np.asarray(td_errors)) + eps
         self._priorities[np.asarray(idx)] = prios
         self._max_priority = max(self._max_priority, float(prios.max()))
+
+
+class ColumnReplayBuffer:
+    """Flat columnar ring buffer for dict transitions: arrays are allocated
+    lazily from the first item's shapes/dtypes, writes wrap around, sampling
+    is uniform. Shared by MADDPG and SlateQ (their transitions are nested
+    fixed-shape dicts rather than SampleBatch rows)."""
+
+    def __init__(self, capacity: int, seed: int = 0):
+        self.capacity = capacity
+        self._data: dict | None = None
+        self._n = 0
+        self._pos = 0
+        self._rng = np.random.default_rng(seed)
+
+    def add(self, item: dict):
+        if self._data is None:
+            self._data = {
+                k: np.zeros((self.capacity,) + np.asarray(v).shape, np.asarray(v).dtype)
+                for k, v in item.items()
+            }
+        for k, v in item.items():
+            self._data[k][self._pos] = v
+        self._pos = (self._pos + 1) % self.capacity
+        self._n = min(self._n + 1, self.capacity)
+
+    def __len__(self):
+        return self._n
+
+    def sample(self, n: int) -> dict:
+        idx = self._rng.integers(0, self._n, n)
+        return {k: v[idx] for k, v in self._data.items()}
