@@ -140,3 +140,13 @@ class DatasetReader:
         self._rng = np.random.default_rng(seed)
 
     next = JsonReader.next
+
+
+from ray_tpu.rllib.offline.estimators import (  # noqa: F401,E402
+    AlgorithmPolicyAdapter,
+    DirectMethod,
+    DoublyRobust,
+    ImportanceSampling,
+    OffPolicyEstimator,
+    WeightedImportanceSampling,
+)

@@ -1,0 +1,284 @@
+"""Off-policy estimators (OPE) — evaluate a target policy from logged data.
+
+Reference: rllib/offline/estimators/ (off_policy_estimator.py,
+importance_sampling.py, weighted_importance_sampling.py, direct_method.py,
+doubly_robust.py): given behavior-policy episodes (SampleBatches carrying
+``action_prob``), estimate the TARGET policy's value without running it:
+
+- ``ImportanceSampling``  — per-episode product of likelihood ratios times
+  the discounted return (unbiased, high variance);
+- ``WeightedImportanceSampling`` — ratios self-normalized across episodes
+  (biased, much lower variance);
+- ``DirectMethod``        — fitted-Q evaluation: a Q-model trained on the
+  logged transitions by TD under the target policy, evaluated at the
+  episode starts;
+- ``DoublyRobust``        — DM baseline plus importance-corrected TD
+  residuals (unbiased if EITHER the ratios or the Q-model are right).
+
+The target policy is anything exposing ``action_probs(obs_batch) ->
+[B, A]`` (discrete); helpers adapt our Algorithm objects. The Q-model for
+DM/DR is a small jitted JAX MLP (the reference uses a torch FQE model).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+from ray_tpu.rllib.policy.sample_batch import (
+    ACTIONS,
+    DONES,
+    EPS_ID,
+    NEXT_OBS,
+    OBS,
+    REWARDS,
+    SampleBatch,
+)
+
+ACTION_PROB = "action_prob"
+
+
+def _split_episodes(batch: SampleBatch) -> list[dict]:
+    """Split a flat batch into per-episode column dicts (EPS_ID order).
+    One pass: a per-row full-mask scan would be O(episodes * rows)."""
+    if EPS_ID in batch:
+        ids = np.asarray(batch[EPS_ID])
+        index_groups: dict = {}
+        for i, eid in enumerate(ids.tolist()):
+            index_groups.setdefault(eid, []).append(i)
+        cols = {k: np.asarray(v) for k, v in batch.items()}
+        return [
+            {k: v[idx] for k, v in cols.items()}
+            for idx in (np.asarray(g) for g in index_groups.values())
+        ]
+    # No episode ids: split on DONES.
+    dones = np.asarray(batch[DONES]).astype(bool)
+    bounds = np.flatnonzero(dones) + 1
+    episodes = []
+    start = 0
+    for end in list(bounds) + ([len(dones)] if not dones[-1] else []):
+        if end > start:
+            episodes.append({k: np.asarray(v)[start:end] for k, v in batch.items()})
+        start = end
+    return episodes
+
+
+def _ratios(policy, ep: dict) -> np.ndarray:
+    """Per-step target/behavior likelihood ratios."""
+    probs = np.asarray(policy.action_probs(np.asarray(ep[OBS], np.float32)))
+    acts = np.asarray(ep[ACTIONS]).astype(int)
+    target_p = probs[np.arange(len(acts)), acts]
+    behavior_p = np.asarray(ep[ACTION_PROB], np.float64)
+    return target_p / np.maximum(behavior_p, 1e-8)
+
+
+def _discounted_return(rewards: np.ndarray, gamma: float) -> float:
+    g = 0.0
+    for r in reversed(np.asarray(rewards, np.float64)):
+        g = r + gamma * g
+    return float(g)
+
+
+class OffPolicyEstimator:
+    def __init__(self, policy, gamma: float = 0.99):
+        self.policy = policy
+        self.gamma = gamma
+
+    def estimate(self, batch: SampleBatch) -> dict:
+        raise NotImplementedError
+
+
+class ImportanceSampling(OffPolicyEstimator):
+    """Trajectory-wise IS (reference: importance_sampling.py)."""
+
+    def estimate(self, batch: SampleBatch) -> dict:
+        episodes = _split_episodes(batch)
+        values, behavior = [], []
+        for ep in episodes:
+            rho = float(np.prod(_ratios(self.policy, ep)))
+            g = _discounted_return(ep[REWARDS], self.gamma)
+            values.append(rho * g)
+            behavior.append(g)
+        return {
+            "v_target": float(np.mean(values)),
+            "v_behavior": float(np.mean(behavior)),
+            "num_episodes": len(values),
+        }
+
+
+class WeightedImportanceSampling(OffPolicyEstimator):
+    """Self-normalized IS (reference: weighted_importance_sampling.py)."""
+
+    def estimate(self, batch: SampleBatch) -> dict:
+        weights, returns = [], []
+        for ep in _split_episodes(batch):
+            weights.append(float(np.prod(_ratios(self.policy, ep))))
+            returns.append(_discounted_return(ep[REWARDS], self.gamma))
+        weights = np.asarray(weights, np.float64)
+        returns = np.asarray(returns, np.float64)
+        denom = max(weights.sum(), 1e-8)
+        return {
+            "v_target": float((weights * returns).sum() / denom),
+            "v_behavior": float(returns.mean()),
+            "num_episodes": len(returns),
+        }
+
+
+class _FQEModel:
+    """Minimal fitted-Q evaluation model: jitted MLP trained by TD under
+    the TARGET policy (reference: fqe_torch_model.py)."""
+
+    def __init__(self, obs_dim: int, n_actions: int, policy, gamma: float,
+                 lr: float = 1e-3, hiddens=(64, 64), seed: int = 0):
+        import jax
+        import optax
+
+        from ray_tpu.rllib.algorithms.sac.sac import _mlp_apply, _mlp_params
+
+        self._apply = _mlp_apply
+        self.policy = policy
+        self.gamma = gamma
+        self.n_actions = n_actions
+        self.params = _mlp_params(jax.random.PRNGKey(seed), obs_dim, tuple(hiddens), n_actions)
+        self.tx = optax.adam(lr)
+        self.opt_state = self.tx.init(self.params)
+
+        def update(params, opt_state, obs, acts, rew, dones, next_obs, next_pi):
+            import jax.numpy as jnp
+
+            q_next = _mlp_apply(jax.lax.stop_gradient(params), next_obs)
+            v_next = jnp.sum(next_pi * q_next, axis=-1)
+            y = rew + gamma * (1.0 - dones) * v_next
+            y = jax.lax.stop_gradient(y)
+
+            def loss_fn(p):
+                q = _mlp_apply(p, obs)
+                q_sa = jnp.take_along_axis(q, acts[:, None], 1)[:, 0]
+                return jnp.mean(jnp.square(q_sa - y))
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        self._update = jax.jit(update)
+
+    def train(self, batch: SampleBatch, iterations: int = 200, batch_size: int = 256, seed: int = 0):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(seed)
+        obs = np.asarray(batch[OBS], np.float32)
+        acts = np.asarray(batch[ACTIONS]).astype(np.int32)
+        rew = np.asarray(batch[REWARDS], np.float32)
+        dones = np.asarray(batch[DONES], np.float32)
+        nobs = np.asarray(batch[NEXT_OBS], np.float32)
+        next_pi = np.asarray(self.policy.action_probs(nobs), np.float32)
+        n = len(obs)
+        loss = None
+        for _ in range(iterations):
+            idx = rng.integers(0, n, min(batch_size, n))
+            self.params, self.opt_state, loss = self._update(
+                self.params, self.opt_state,
+                jnp.asarray(obs[idx]), jnp.asarray(acts[idx]), jnp.asarray(rew[idx]),
+                jnp.asarray(dones[idx]), jnp.asarray(nobs[idx]), jnp.asarray(next_pi[idx]),
+            )
+        return float(loss) if loss is not None else float("nan")
+
+    def v(self, obs: np.ndarray) -> np.ndarray:
+        import jax.numpy as jnp
+
+        q = np.asarray(self._apply(self.params, jnp.asarray(np.asarray(obs, np.float32))))
+        pi = np.asarray(self.policy.action_probs(obs))
+        return (pi * q).sum(-1)
+
+    def q(self, obs: np.ndarray, acts: np.ndarray) -> np.ndarray:
+        import jax.numpy as jnp
+
+        qv = np.asarray(self._apply(self.params, jnp.asarray(np.asarray(obs, np.float32))))
+        return qv[np.arange(len(acts)), np.asarray(acts).astype(int)]
+
+
+def _episode_folds(episodes: list, n_splits: int):
+    """Yield (train_batch, eval_episodes) per fold — the reference trains
+    the FQE model on a DISJOINT split (k-fold) so the estimate is not
+    optimistically biased by the model memorizing the evaluated rewards."""
+    n_splits = max(1, min(n_splits, len(episodes)))
+    folds = [episodes[i::n_splits] for i in range(n_splits)]
+    for i, eval_eps in enumerate(folds):
+        train_eps = [ep for j, fold in enumerate(folds) if j != i for ep in fold]
+        if not train_eps:  # n_splits == 1: degenerate, train == eval
+            train_eps = eval_eps
+        train = SampleBatch({
+            k: np.concatenate([ep[k] for ep in train_eps])
+            for k in train_eps[0]
+        })
+        yield train, eval_eps
+
+
+class DirectMethod(OffPolicyEstimator):
+    """FQE value of the episode-start states, k-fold: each fold is scored
+    by a Q-model trained on the OTHER folds (reference: direct_method.py +
+    ope_utils train/test splits)."""
+
+    def __init__(self, policy, gamma: float = 0.99, fqe_iterations: int = 300,
+                 n_splits: int = 2):
+        super().__init__(policy, gamma)
+        self.fqe_iterations = fqe_iterations
+        self.n_splits = n_splits
+        self.model: _FQEModel | None = None  # last fold's model (introspection)
+
+    def _fit_fold(self, train: SampleBatch, seed: int) -> "_FQEModel":
+        obs = np.asarray(train[OBS], np.float32)
+        n_actions = int(np.asarray(self.policy.action_probs(obs[:1])).shape[-1])
+        model = _FQEModel(obs.shape[-1], n_actions, self.policy, self.gamma, seed=seed)
+        model.train(train, iterations=self.fqe_iterations, seed=seed)
+        self.model = model
+        return model
+
+    def _fold_values(self, model: "_FQEModel", eval_eps: list) -> list:
+        starts = np.stack([ep[OBS][0] for ep in eval_eps])
+        return list(model.v(starts))
+
+    def estimate(self, batch: SampleBatch) -> dict:
+        episodes = _split_episodes(batch)
+        values: list = []
+        for fold_i, (train, eval_eps) in enumerate(_episode_folds(episodes, self.n_splits)):
+            model = self._fit_fold(train, seed=fold_i)
+            values += self._fold_values(model, eval_eps)
+        return {
+            "v_target": float(np.mean(values)),
+            "num_episodes": len(values),
+        }
+
+
+class DoublyRobust(DirectMethod):
+    """DR = DM baseline + per-step importance-corrected TD residuals
+    (reference: doubly_robust.py, Jiang & Li 2016); k-fold like DM."""
+
+    def _fold_values(self, model: "_FQEModel", eval_eps: list) -> list:
+        values = []
+        for ep in eval_eps:
+            obs = np.asarray(ep[OBS], np.float32)
+            acts = np.asarray(ep[ACTIONS]).astype(int)
+            rew = np.asarray(ep[REWARDS], np.float64)
+            ratios = _ratios(self.policy, ep)
+            v_hat = model.v(obs)
+            q_hat = model.q(obs, acts)
+            # Backward recursion: V_DR(t) = v(s) + rho_t (r + gamma V_DR(t+1) - q(s,a))
+            v_dr = 0.0
+            for t in reversed(range(len(obs))):
+                v_dr = v_hat[t] + ratios[t] * (rew[t] + self.gamma * v_dr - q_hat[t])
+            values.append(float(v_dr))
+        return values
+
+
+class AlgorithmPolicyAdapter:
+    """Adapt a trained discrete Algorithm (DQN family etc.) or a logits fn
+    to the ``action_probs`` protocol the estimators expect."""
+
+    def __init__(self, probs_fn: Callable):
+        self._fn = probs_fn
+
+    def action_probs(self, obs_batch) -> np.ndarray:
+        return np.asarray(self._fn(np.asarray(obs_batch, np.float32)))
