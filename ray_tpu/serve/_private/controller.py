@@ -45,6 +45,8 @@ class ServeController:
         # autoscaling bookkeeping
         self._metrics: dict[str, dict] = {}
         self._scale_marks: dict[str, float] = {}
+        # replica_id -> last health-check timestamp (RUNNING replicas)
+        self._health_marks: dict[str, float] = {}
         # name -> forced retires not yet matched by a new healthy replica.
         # Caps the stall-breaker at maxUnavailable=1: a rollout whose new
         # version never becomes healthy sacrifices at most one old replica.
@@ -290,16 +292,105 @@ class ServeController:
             self._metrics.setdefault(deployment, {})[replica_id] = (ongoing, time.time())
         return True
 
+    def get_autoscaling_metrics(self) -> dict:
+        """Current per-replica queue depths (observability + tests)."""
+        with self._lock:
+            return {
+                name: {rid: m[0] for rid, m in reps.items()}
+                for name, reps in self._metrics.items()
+            }
+
     # ------------------------------------------------------------------
     # Reconciliation
     # ------------------------------------------------------------------
     def _reconcile_loop(self):
         while not self._shutdown:
             try:
+                self._health_check_replicas()
+            except Exception:
+                logger.exception("replica health checks failed")
+            try:
                 self._reconcile_once()
             except Exception:
                 logger.exception("reconcile failed")
             time.sleep(0.5)
+
+    def _health_check_replicas(self):
+        """Periodically health-check RUNNING replicas and retire dead ones
+        (reference: deployment_state.py check_health loop — start-up checks
+        alone leave a crashed replica in the routing table forever; the
+        reconcile pass then replaces the removed replica).
+
+        Liveness signal #1 is the replica's own metrics PUSH recency: the
+        push thread runs OUTSIDE the request pool, so a saturated-but-
+        healthy replica (every slot busy with long requests) still proves
+        it is alive without an actor call that would queue behind those
+        requests and time out. The check_health actor call is the fallback
+        for replicas with no recent push."""
+        now = time.time()
+        with self._lock:
+            due = []
+            for name, reps in self._replicas.items():
+                info = self._deployments.get(name)
+                if info is None:
+                    continue
+                period = info.config.health_check_period_s
+                for r in reps:
+                    if now - self._health_marks.get(r.replica_id, 0.0) < period:
+                        continue
+                    self._health_marks[r.replica_id] = now
+                    push_ts = self._metrics.get(name, {}).get(r.replica_id, (0, 0.0))[1]
+                    if now - push_ts < 5.0:
+                        continue  # fresh push == alive
+                    due.append((name, r, info.config.health_check_timeout_s))
+        # Fan out ALL probes, then collect under one shared deadline: a node
+        # death with N replicas must cost one timeout, not N.
+        refs = []
+        max_timeout = 0.0
+        for name, r, timeout_s in due:
+            handle = self._replica_handles.get(r.replica_id)
+            max_timeout = max(max_timeout, timeout_s)
+            if handle is None:
+                refs.append((name, r, None))
+                continue
+            try:
+                refs.append((name, r, handle.check_health.remote()))
+            except Exception:
+                refs.append((name, r, None))
+        deadline = time.time() + max_timeout
+        for name, r, ref in refs:
+            ok = False
+            try:
+                remaining = max(0.1, deadline - time.time())
+                ok = ref is not None and bool(ray_tpu.get(ref, timeout=remaining))
+            except Exception:
+                ok = False
+            if not ok:
+                self._retire_unhealthy_replica(name, r)
+
+    def _retire_unhealthy_replica(self, name: str, r):
+        with self._lock:
+            reps = self._replicas.get(name, [])
+            present = r in reps
+            if present:
+                reps.remove(r)
+                self._bump_epoch_locked()
+            handle = self._replica_handles.pop(r.replica_id, None)
+            self._health_marks.pop(r.replica_id, None)
+            self._metrics.get(name, {}).pop(r.replica_id, None)
+        if not present:
+            return  # raced a deliberate stop (downscale/rollout) — no-op
+        logger.warning(
+            "replica %s of %s failed its health check; removing and killing",
+            r.replica_id, name,
+        )
+        # Kill the actor too: a hung replica left alive would hold its CPU
+        # reservation and starve the replacement on a full cluster.
+        if handle is not None:
+            try:
+                ray_tpu.kill(handle)
+            except Exception:
+                pass
 
     def _target_replicas(self, info: DeploymentInfo, mutate: bool = True) -> int:
         """Desired replica count. Only the reconcile loop may pass
@@ -418,8 +509,16 @@ class ServeController:
         # can actually form batches (reference: replicas are async actors).
         opts.setdefault("max_concurrency", min(info.config.max_concurrent_queries, 32))
         opts["name"] = actor_name
+        from ray_tpu.serve._private.common import CONTROLLER_NAME
+
         actor_cls = ray_tpu.remote(**opts)(Replica)
-        handle = actor_cls.remote(info.import_spec, info.config.user_config)
+        handle = actor_cls.remote(
+            info.import_spec,
+            info.config.user_config,
+            deployment_name=info.name,
+            replica_id=replica_id,
+            controller_name=CONTROLLER_NAME,
+        )
         rinfo = ReplicaInfo(
             replica_id=replica_id,
             deployment_name=info.name,
@@ -462,6 +561,10 @@ class ServeController:
             if rinfo in reps:
                 reps.remove(rinfo)
             handle = self._replica_handles.pop(rinfo.replica_id, None)
+            # Prune per-replica bookkeeping: under autoscaling churn these
+            # maps would otherwise grow one entry per retired replica forever.
+            self._health_marks.pop(rinfo.replica_id, None)
+            self._metrics.get(name, {}).pop(rinfo.replica_id, None)
         if handle is not None:
             try:
                 # Graceful drain: let the user callable release resources

@@ -65,7 +65,14 @@ class _StreamPump:
 
 
 class Replica:
-    def __init__(self, import_spec: bytes, user_config=None):
+    def __init__(
+        self,
+        import_spec: bytes,
+        user_config=None,
+        deployment_name: str = "",
+        replica_id: str = "",
+        controller_name: str = "",
+    ):
         from ray_tpu.serve._private.common import HandleMarker
 
         cls_or_fn, init_args, init_kwargs = pickle.loads(import_spec)
@@ -98,6 +105,31 @@ class Replica:
         self._stream_counter = 0
         if user_config is not None:
             self.reconfigure(user_config)
+        # Autoscaling metrics PUSH (reference: autoscaling_metrics.py —
+        # replicas report their own queue depth). A dedicated daemon thread,
+        # NOT an actor method: actor calls share the request thread pool, so
+        # a polled metric could only run when a slot freed — biased low by
+        # construction.
+        if deployment_name and controller_name:
+            self._metrics_stop = threading.Event()
+
+            def _push_loop():
+                import ray_tpu
+
+                controller = None
+                while not self._metrics_stop.wait(1.0):
+                    try:
+                        if controller is None:
+                            controller = ray_tpu.get_actor(controller_name)
+                        controller.record_metrics.remote(
+                            deployment_name, replica_id, self._ongoing
+                        )
+                    except Exception:
+                        controller = None  # controller restarting; re-resolve
+
+            threading.Thread(
+                target=_push_loop, name="replica-metrics", daemon=True
+            ).start()
 
     def reconfigure(self, user_config):
         """Push a new user_config without restarting (reference:
@@ -238,6 +270,9 @@ class Replica:
     def prepare_for_shutdown(self):
         """Invoke the user callable's shutdown hook, if any (reference:
         replica graceful_shutdown path)."""
+        stop = getattr(self, "_metrics_stop", None)
+        if stop is not None:
+            stop.set()  # retired replicas must not keep pushing metrics
         fn = getattr(self._callable, "prepare_for_shutdown", None) or getattr(
             self._callable, "shutdown", None
         )
