@@ -125,6 +125,10 @@ class Raylet:
         self.labels = dict(labels or {})
 
         self.workers: dict[str, WorkerHandle] = {}
+        # Worker ids abandoned after a zygote spawn fallback (the fork may
+        # have produced an orphan that registers late) — registration under
+        # these is refused and the orphan reaped.
+        self._retired_worker_ids: set[str] = set()
         self.task_queue: deque[TaskSpec] = deque()
         # Tasks whose resources/pool/placement can't currently be satisfied
         # park here instead of rotating through task_queue (reference keeps a
@@ -1160,8 +1164,22 @@ class Raylet:
             pid = await zygote.spawn(delta, log_path + ".out", log_path + ".err")
         except Exception:
             logger.exception("zygote spawn failed; falling back to subprocess")
-            if handle.state != "dead":
-                self._popen_worker(handle, delta, log_path)
+            if handle.state == "dead":
+                return
+            # The fork may have succeeded with the reply lost or late (zygote
+            # died post-fork, wait timeout): retire this worker id and give
+            # the Popen replacement a fresh one, so an orphan child that
+            # registers late can't collide with the replacement. A late
+            # spawn reply for the abandoned req_id kills the orphan pid
+            # (ZygoteClient._read_loop).
+            self.workers.pop(handle.worker_id, None)
+            self._retired_worker_ids.add(handle.worker_id)
+            fresh_id = WorkerID.from_random().hex()
+            handle.worker_id = fresh_id
+            self.workers[fresh_id] = handle
+            self._popen_worker(
+                handle, dict(delta, RAY_TPU_WORKER_ID=fresh_id), log_path
+            )
             return
         handle.pid = pid
         handle.proc = ZygoteWorkerProc(pid)
@@ -1172,6 +1190,20 @@ class Raylet:
     @schema(worker_id=str, pid=int, address=list)
     async def rpc_register_worker(self, req):
         worker_id = req["worker_id"]
+        if worker_id in self._retired_worker_ids:
+            # An orphan from an abandoned zygote spawn (we already Popen'd a
+            # replacement under a fresh id): tell it to exit, and reap it
+            # shortly after in case it doesn't (it is a local process).
+            pid = req["pid"]
+
+            def _reap():
+                try:
+                    os.kill(pid, 9)
+                except (ProcessLookupError, PermissionError):
+                    pass
+
+            asyncio.get_event_loop().call_later(2.0, _reap)
+            return {"ok": False, "reason": "retired worker id"}
         handle = self.workers.get(worker_id)
         if handle is None:
             handle = WorkerHandle(worker_id=worker_id, pid=req["pid"])

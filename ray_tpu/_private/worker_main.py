@@ -342,10 +342,14 @@ def main():
     worker_context.set_core_worker(cw)
     _mark("core_worker")
     executor = WorkerExecutor(cw, cw.raylet)
-    cw.raylet.call(
+    reply = cw.raylet.call(
         "register_worker",
         {"worker_id": worker_id, "address": list(cw.address), "pid": os.getpid()},
     )
+    if not (reply or {}).get("ok", True):
+        # The raylet retired this worker id (e.g. a zygote spawn it gave up
+        # on and replaced) — we're an orphan; exit instead of double-serving.
+        sys.exit(0)
     _mark("registered")
     # Workers exit if their parent raylet dies (reference: core_worker.cc:926
     # ExitIfParentRayletDies).

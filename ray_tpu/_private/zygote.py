@@ -296,6 +296,14 @@ class ZygoteClient:
                     fut = self._pending.pop(frame["req_id"], None)
                     if fut is not None and not fut.done():
                         fut.set_result(frame["pid"])
+                    else:
+                        # Reply for an abandoned spawn (caller timed out and
+                        # fell back to Popen): the forked child is an
+                        # untracked orphan — reap it.
+                        try:
+                            os.kill(frame["pid"], 9)
+                        except (ProcessLookupError, PermissionError):
+                            pass
                 elif "exit" in frame:
                     try:
                         self.on_exit(frame["exit"], frame["returncode"])
@@ -336,7 +344,14 @@ class ZygoteClient:
             await self._writer.drain()
         import asyncio as _a
 
-        return await _a.wait_for(fut, timeout)
+        try:
+            return await _a.wait_for(fut, timeout)
+        except BaseException:
+            # Leave no pending entry behind: a late reply for this req_id
+            # must be treated as an orphan (killed in _read_loop), not
+            # delivered to a future nobody awaits.
+            self._pending.pop(rid, None)
+            raise
 
     def close(self):
         if self._read_task is not None:

@@ -152,7 +152,14 @@ class SlateQ(OffPolicyTraining, Algorithm):
             is the optimizer's default)."""
             q = per_item(params["q"], user, docs)        # [B,C]
             v = per_item(params["choice"], user, docs)   # [B,C] affinities
-            score = jnp.exp(v) * q
+            # Ie et al.'s exactness proof for top-k-by-exp(v)*q assumes
+            # q >= 0. For q <= 0 the affinity weight inverts the ordering
+            # (high-v bad items score MORE negative than low-v worse items),
+            # and a bare max(q,0) clamp ties all negative items at 0 so
+            # top_k seats them by index. Rank positives by the proven score
+            # and negatives by raw q (least harmful first, no ties): every
+            # positive item still outranks every negative one.
+            score = jnp.where(q > 0, jnp.exp(v) * q, q)
             top = jax.lax.top_k(score, K)[1]             # [B,K]
             v_top = jnp.take_along_axis(v, top, 1)
             q_top = jnp.take_along_axis(q, top, 1)

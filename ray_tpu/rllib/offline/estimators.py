@@ -42,6 +42,13 @@ ACTION_PROB = "action_prob"
 def _split_episodes(batch: SampleBatch) -> list[dict]:
     """Split a flat batch into per-episode column dicts (EPS_ID order).
     One pass: a per-row full-mask scan would be O(episodes * rows)."""
+    if len(batch) == 0:
+        return []
+    if EPS_ID not in batch and DONES not in batch:
+        raise ValueError(
+            "off-policy estimation needs either EPS_ID or DONES columns to "
+            f"split episodes; batch has {sorted(batch.keys())}"
+        )
     if EPS_ID in batch:
         ids = np.asarray(batch[EPS_ID])
         index_groups: dict = {}

@@ -119,5 +119,10 @@ class ColumnReplayBuffer:
         return self._n
 
     def sample(self, n: int) -> dict:
+        if self._n == 0:
+            raise ValueError(
+                "ColumnReplayBuffer.sample() on an empty buffer; add() at "
+                "least one item first (callers usually gate on learning_starts)"
+            )
         idx = self._rng.integers(0, self._n, n)
         return {k: v[idx] for k, v in self._data.items()}

@@ -61,6 +61,11 @@ class ServeController:
         # block for seconds and must not stall replica reconciliation.
         self._proxies: dict[str, dict] = {}
         self._proxy_starting: set[str] = set()
+        # node_id -> (consecutive start failures, monotonic next-retry time).
+        # With a fixed http_port and several raylets sharing one host (the
+        # simulated-cluster topology) all but one bind fails with EADDRINUSE;
+        # exponential backoff keeps the reconciler from retrying every tick.
+        self._proxy_backoff: dict[str, tuple[int, float]] = {}
         self._http_cfg: tuple | None = None
         self._proxy_thread: threading.Thread | None = None
         self._reconcile_thread = threading.Thread(
@@ -206,14 +211,28 @@ class ServeController:
             if nid not in alive:
                 with self._lock:
                     self._proxies.pop(nid, None)
+                    self._proxy_backoff.pop(nid, None)
                 try:
                     ray_tpu.kill(proxies[nid]["handle"])
                 except Exception:
                     pass
+        with self._lock:
+            # Backoff entries can exist for nodes that never got a proxy up
+            # (every start failed) — purge those for departed nodes too.
+            for nid in list(self._proxy_backoff):
+                if nid not in alive:
+                    self._proxy_backoff.pop(nid, None)
         for nid in alive:
             with self._lock:
                 if nid in self._proxy_starting:
                     continue  # a start for this node is already in flight
+                backoff = self._proxy_backoff.get(nid)
+            if (
+                backoff is not None
+                and nid not in proxies
+                and time.monotonic() < backoff[1]
+            ):
+                continue  # recent start failure: wait out the backoff
             rec = proxies.get(nid)
             if rec is not None:
                 if time.time() - rec.get("checked", 0) < 5.0:
@@ -262,8 +281,19 @@ class ServeController:
                     "checked": time.time(),
                 }
             logger.info("serve proxy up on node %s at %s", node_id[:8], addr)
-        except Exception:
-            logger.exception("failed to start serve proxy on node %s", node_id[:8])
+            with self._lock:
+                self._proxy_backoff.pop(node_id, None)
+        except Exception as e:
+            with self._lock:
+                fails = self._proxy_backoff.get(node_id, (0, 0.0))[0] + 1
+                delay = min(2.0 * (2 ** (fails - 1)), 60.0)
+                self._proxy_backoff[node_id] = (fails, time.monotonic() + delay)
+            if fails == 1 or fails % 5 == 0:
+                logger.exception(
+                    "failed to start serve proxy on node %s "
+                    "(attempt %d, next retry in %.0fs): %s",
+                    node_id[:8], fails, delay, e,
+                )
             if handle is not None:
                 try:
                     ray_tpu.kill(handle)  # don't leak a half-started proxy
