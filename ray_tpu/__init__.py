@@ -238,6 +238,29 @@ def wait(refs, *, num_returns: int = 1, timeout: float | None = None, fetch_loca
     )
 
 
+def cancel(object_ref: ObjectRef, *, force: bool = False, recursive: bool = True):
+    """Cancel the task producing ``object_ref`` (reference: ``ray.cancel``,
+    python/ray/_private/worker.py:2773 / core_worker.cc CancelTask).
+
+    Best-effort and asynchronous: pending tasks are dequeued (at the raylet,
+    the owner's lease staging, or the actor's call queue), a running task is
+    interrupted with :class:`~ray_tpu.exceptions.TaskCancelledError` at its
+    next Python bytecode boundary, and ``force=True`` kills the executing
+    worker process outright. ``recursive=True`` also cancels the task's
+    children. ``ray_tpu.get`` on the task's returns raises
+    ``TaskCancelledError`` once the cancel lands; a task that already
+    finished is unaffected. ``force=True`` on an actor task raises
+    ``ValueError`` (kill the actor instead), matching the reference.
+    """
+    from ray_tpu._private import worker_context
+
+    if not isinstance(object_ref, ObjectRef):
+        raise TypeError(
+            f"ray_tpu.cancel() expects an ObjectRef, got {type(object_ref).__name__}"
+        )
+    worker_context.get_core_worker().cancel(object_ref, force=force, recursive=recursive)
+
+
 def kill(actor: ActorHandle, *, no_restart: bool = True):
     from ray_tpu._private import worker_context
 
@@ -294,6 +317,7 @@ __all__ = [
     "ObjectRef",
     "RemoteFunction",
     "available_resources",
+    "cancel",
     "cluster_resources",
     "exceptions",
     "get",

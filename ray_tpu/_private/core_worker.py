@@ -41,7 +41,7 @@ import cloudpickle
 
 from ray_tpu._private import serialization
 from ray_tpu._private.config import get_config
-from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.ids import ActorID, BoundedIdSet, JobID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.rpc import ConnectionLost, EventLoopThread, RpcClient, RpcError, RpcServer
 from ray_tpu._private.store.object_store import StoreClient
 from ray_tpu._private.task_spec import ACTOR_CREATION_TASK, ACTOR_TASK, NORMAL_TASK, TaskSpec
@@ -72,6 +72,16 @@ class PendingTask:
     spec: TaskSpec
     retries_left: int
     arg_refs: list = field(default_factory=list)
+    # Cancellation state (reference: task_manager.cc MarkTaskCanceled):
+    # a cancel-requested task is never retried, and completion payloads
+    # arriving later are folded into TaskCancelledError.
+    cancel_requested: bool = False
+    # "resolving" = still owner-local (waiting on ObjectRef args);
+    # "submitted" = handed to the raylet / lease transport / actor.
+    phase: str = "resolving"
+    # Task that submitted this one (the executing task's id when submitted
+    # from inside a worker) — drives recursive cancellation.
+    parent_task_id: str = ""
 
 
 @dataclass
@@ -168,6 +178,10 @@ class CoreWorker:
         self._object_events: dict[str, asyncio.Event] = {}
         self._owner_client_cache: dict[tuple, RpcClient] = {}
         self.pending_tasks: dict[str, PendingTask] = {}
+        # Tombstones for cancelled tasks that may not have reached this
+        # process yet (cancel racing submission); checked at execution
+        # entry. Bounded FIFO — cancellation is rare.
+        self._cancelled_tasks = BoundedIdSet()
         self.lineage: collections.OrderedDict[str, TaskSpec] = collections.OrderedDict()
         self._borrowed_decref_queue: list = []
 
@@ -194,6 +208,18 @@ class CoreWorker:
 
         # Execution state (worker mode).
         self._executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="task-exec")
+        # Worker processes execute tasks on the process MAIN thread
+        # (worker_main.main() swaps _executor for a main-thread drain loop
+        # and records its ident here). Running on the main thread is what
+        # lets a non-force cancel interrupt C-blocked calls like
+        # time.sleep: CPython only runs signal handlers on the main thread,
+        # and a raising handler aborts the blocking call (PEP 475). The
+        # reference executes tasks on the worker main thread and interrupts
+        # with KeyboardInterrupt for the same reason (core_worker.cc
+        # CancelTask → PyErr_SetInterrupt path in _raylet.pyx).
+        self._main_thread_ident: int | None = None
+        self._main_task_id: str | None = None  # task now running on main thread
+        self._main_cancel_target: str | None = None  # read by SIGUSR2 handler
         self._actor_instance = None
         self._actor_id: str | None = None
         self._actor_creation_spec: TaskSpec | None = None
@@ -499,6 +525,23 @@ class CoreWorker:
                     else:
                         while not await self._arg_available_async(ref):
                             await asyncio.sleep(0.02)
+                with self._lock:
+                    p = self.pending_tasks.get(spec.task_id)
+                    # A missing entry means the task was already failed out
+                    # of pending_tasks — for a not-yet-submitted task the
+                    # only path that does that is cancel. Treating it as
+                    # "not cancelled" would submit (and execute) a task
+                    # whose get() already raised TaskCancelledError.
+                    cancelled = p is None or p.cancel_requested
+                if cancelled:
+                    self._fail_task(
+                        spec.task_id,
+                        TaskCancelledError(
+                            f"task {spec.name} ({spec.task_id[:8]}) was cancelled "
+                            "before submission"
+                        ),
+                    )
+                    return
                 self._enqueue_submit(spec)
             except Exception as e:
                 logger.exception("deferred submit of %s failed", spec.task_id[:8])
@@ -531,6 +574,18 @@ class CoreWorker:
         return lm
 
     def _enqueue_submit(self, spec: TaskSpec) -> None:
+        with self._lock:
+            p = self.pending_tasks.get(spec.task_id)
+            if p is None or p.cancel_requested:
+                # Cancelled between registration and submission: the
+                # resolving-phase cancel branch already failed the task
+                # (get() raises TaskCancelledError) — shipping it now would
+                # execute it anyway, unreachable by any further cancel.
+                # Checked under the same lock that flips phase so the
+                # cancel driver sees either "resolving" (we skip here) or
+                # "submitted" (it recalls from the transport).
+                return
+            p.phase = "submitted"
         if self._lease_eligible(spec):
             self._get_lease_manager().submit(spec)
             return
@@ -618,9 +673,14 @@ class CoreWorker:
             return client
 
     def _register_pending(self, spec: TaskSpec, arg_refs: list):
+        ctx = _exec_ctx.get()
+        parent = ctx[1].task_id if ctx is not None else ""
         with self._lock:
             self.pending_tasks[spec.task_id] = PendingTask(
-                spec=spec, retries_left=spec.max_retries, arg_refs=list(arg_refs)
+                spec=spec,
+                retries_left=spec.max_retries,
+                arg_refs=list(arg_refs),
+                parent_task_id=parent,
             )
             for oid in spec.return_object_ids():
                 self.owned.setdefault(oid, OwnedObject())
@@ -1080,6 +1140,219 @@ class CoreWorker:
                 attempts_left -= 1
                 await asyncio.sleep(0.1)
 
+    # ==================================================================
+    # Cancellation (reference: worker.py:2773 ray.cancel +
+    # core_worker.cc CancelTask / task_manager.cc MarkTaskCanceled)
+    # ==================================================================
+
+    def cancel(self, ref, force: bool = False, recursive: bool = True):
+        """Cancel the task that produces ``ref``. Best-effort and async like
+        the reference: returns immediately; a successful cancel surfaces as
+        TaskCancelledError from ``get`` on the task's returns."""
+        task_id = ref.id.task_id().hex()
+        if (
+            ref.owner_addr is not None
+            and tuple(ref.owner_addr) != tuple(self.address)
+        ):
+            # Borrowed ref: only the owner tracks the producing task —
+            # forward (reference: RemoteCancelTask to the owner).
+            msg = {"task_id": task_id, "force": force, "recursive": recursive}
+            if force:
+                # force=True can be invalid (actor tasks) and the reference
+                # surfaces that as ValueError at the call site — so this one
+                # path is synchronous: wait for the owner's verdict instead
+                # of discarding it in a fire-and-forget coroutine.
+                resp = self._owner_client(tuple(ref.owner_addr)).call(
+                    "cancel_task", msg, timeout=30
+                )
+                if (resp or {}).get("error"):
+                    raise ValueError(resp["error"])
+                return
+
+            async def _fwd():
+                try:
+                    resp = await self._owner_client(tuple(ref.owner_addr)).acall(
+                        "cancel_task", msg, timeout=30
+                    )
+                    if (resp or {}).get("error"):
+                        logger.warning(
+                            "cancel of %s rejected by owner: %s",
+                            task_id[:8], resp["error"],
+                        )
+                except Exception:
+                    logger.warning("forwarding cancel of %s to owner failed", task_id[:8])
+
+            self._io.spawn(_fwd())
+            return
+        self.cancel_owned(task_id, force=force, recursive=recursive)
+
+    def cancel_owned(self, task_id: str, force: bool = False, recursive: bool = True) -> bool:
+        """Owner-side cancel. Returns False if the task already finished."""
+        with self._lock:
+            pending = self.pending_tasks.get(task_id)
+        if pending is None:
+            return False
+        if pending.spec.is_actor_task() and force:
+            raise ValueError(
+                "force=True is not supported for actor tasks (reference "
+                "semantics: kill the actor with ray_tpu.kill instead)"
+            )
+        pending.cancel_requested = True
+        self._io.spawn(self._drive_cancel(pending, force, recursive))
+        return True
+
+    def _cancel_error(self, spec: TaskSpec) -> TaskCancelledError:
+        return TaskCancelledError(
+            f"task {spec.name} ({spec.task_id[:8]}) was cancelled"
+        )
+
+    async def _drive_cancel(self, pending: PendingTask, force: bool, recursive: bool):
+        spec = pending.spec
+        task_id = spec.task_id
+        msg = {"task_id": task_id, "force": bool(force), "recursive": bool(recursive)}
+        loop = asyncio.get_event_loop()
+        try:
+            if spec.is_actor_task():
+                # Queued or running at the actor process: its executor
+                # dequeues pre-dispatch calls and interrupts the running one.
+                try:
+                    client = await loop.run_in_executor(None, self._actor_client, spec.actor_id)
+                    await client.acall("cancel_exec", msg, timeout=30)
+                except Exception:
+                    # Actor unreachable (dead/restarting): the call will fail
+                    # through the normal actor-death path; nothing to recall.
+                    pass
+                return
+            if pending.phase == "resolving":
+                # Still owner-local, waiting on args: the deferred submitter
+                # checks cancel_requested and aborts; fail the task now.
+                self._fail_task(task_id, self._cancel_error(spec))
+                return
+            # Drain owner-local submit buffers (classic path).
+            with self._submit_lock:
+                for s in self._submit_buf:
+                    if s.task_id == task_id:
+                        self._submit_buf.remove(s)
+                        self._fail_task(task_id, self._cancel_error(spec))
+                        return
+            lm = self._lease_mgr
+            if lm is not None and self._lease_eligible(spec):
+                if lm.cancel_queued(task_id):
+                    # Recalled from owner-side lease staging, never shipped.
+                    self._fail_task(task_id, self._cancel_error(spec))
+                    return
+                lease = lm.lease_for(task_id)
+                if lease is not None:
+                    try:
+                        await lease.client.acall("cancel_exec", msg, timeout=30)
+                    except Exception:
+                        pass  # worker death → lease failover sees cancel_requested
+                    return
+                # Not staged, not in flight: completion raced us; if still
+                # pending, fall through to the raylet probe below.
+            resp = {}
+            try:
+                resp = await self.raylet.acall("cancel_task", msg, timeout=30)
+            except Exception:
+                pass
+            with self._lock:
+                still_pending = task_id in self.pending_tasks
+            if still_pending and (resp.get("dequeued") or not resp.get("found")):
+                # Dequeued before dispatch, or nowhere in the cluster
+                # (pre-arrival tombstones drop it if it shows up late).
+                self._fail_task(task_id, self._cancel_error(spec))
+        except Exception:
+            logger.exception("cancel of task %s failed", task_id[:8])
+
+    def mark_cancelled(self, task_id: str):
+        """Tombstone: drop this task if it arrives for execution later."""
+        self._cancelled_tasks.add(task_id)
+
+    def cancelled_payload(self, spec: TaskSpec) -> dict:
+        err = self._cancel_error(spec)
+        return {
+            "task_id": spec.task_id,
+            "results": [],
+            "error": serialization.serialize(err).to_bytes(),
+            "cancelled": True,
+            "duration_s": 0.0,
+        }
+
+    def interrupt_running_task(self, task_id: str, force: bool = False) -> bool:
+        """Interrupt the thread currently executing ``task_id``. Non-force
+        raises TaskCancelledError at the next bytecode boundary (analog of
+        the reference's KeyboardInterrupt into the executing thread); force
+        kills the worker process like the reference's force-kill."""
+        with self._active_exec_lock:
+            ident = None
+            for entry in self._active_exec.values():
+                if len(entry) > 2 and entry[1].task_id == task_id:
+                    ident = entry[2]
+                    break
+            if ident is None:
+                return False
+            self.mark_cancelled(task_id)  # lets execute_task tag the payload
+            if force:
+                import signal as _signal
+
+                os.kill(os.getpid(), _signal.SIGKILL)
+                return True  # unreachable
+            if ident == self._main_thread_ident:
+                # Main-thread task: deliver via SIGUSR2 so the raising
+                # handler (installed by worker_main) aborts even C-blocked
+                # calls — time.sleep, socket waits — per PEP 475. An
+                # async-exc alone only lands on a bytecode boundary, which a
+                # C-level block never reaches. The handler re-checks that
+                # _main_task_id still equals the target so a late signal
+                # can't cancel a subsequent task.
+                import signal as _signal
+
+                self._main_cancel_target = task_id
+                try:
+                    _signal.pthread_kill(ident, _signal.SIGUSR2)
+                    return True
+                except Exception:
+                    pass  # handler unavailable: fall back to async-exc
+            import ctypes
+
+            # Fired while holding _active_exec_lock: execute_task's finally
+            # must take this lock before the thread can move on to another
+            # task, so the async-exc cannot land inside an unrelated task's
+            # body (the reference re-checks the executing task id the same
+            # way before raising into the thread).
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(ident), ctypes.py_object(TaskCancelledError)
+            )
+        return True
+
+    def cancel_children_of(self, parent_task_id: str, force: bool, recursive: bool):
+        """Cancel every pending task THIS process owns that was submitted by
+        ``parent_task_id`` (recursive cancellation: children of a task are
+        owned by the worker that executed it)."""
+        with self._lock:
+            children = [
+                tid
+                for tid, p in self.pending_tasks.items()
+                if p.parent_task_id == parent_task_id
+            ]
+        for tid in children:
+            try:
+                self.cancel_owned(tid, force=force, recursive=recursive)
+            except ValueError:
+                pass  # force on an actor-task child: skip, cancel the rest
+
+    async def rpc_cancel_task(self, req):
+        """Owner-side handler for forwarded cancels (borrower → owner)."""
+        try:
+            found = self.cancel_owned(
+                req["task_id"],
+                force=bool(req.get("force")),
+                recursive=req.get("recursive", True),
+            )
+        except ValueError as e:
+            return {"found": True, "error": str(e)}
+        return {"found": found}
+
     def _fail_task(self, task_id: str, error: BaseException):
         with self._lock:
             pending = self.pending_tasks.pop(task_id, None)
@@ -1231,7 +1504,13 @@ class CoreWorker:
         if pending is None:
             return
         error = payload.get("error")
-        if error is not None and pending.spec.retry_exceptions and pending.retries_left > 0:
+        if (
+            error is not None
+            and pending.spec.retry_exceptions
+            and pending.retries_left > 0
+            and not pending.cancel_requested
+            and not payload.get("cancelled")
+        ):
             pending.retries_left -= 1
             self._reset_stream_for_retry(task_id)
             # May run on the IO loop (rpc handler) — must not block.
@@ -1278,6 +1557,11 @@ class CoreWorker:
         with self._lock:
             pending = self.pending_tasks.get(task_id)
         if pending is None:
+            return {"ok": True}
+        if pending.cancel_requested:
+            # Worker died while (or because) this task was being cancelled —
+            # e.g. force-kill. Surface cancellation, never retry.
+            self._fail_task(task_id, self._cancel_error(pending.spec))
             return {"ok": True}
         if req.get("retriable", True) and pending.retries_left > 0:
             pending.retries_left -= 1
@@ -1480,12 +1764,24 @@ class CoreWorker:
             # even when the task name repeats.
             self._log_attr_name = (spec.job_id, spec.name)
             print(f"\x01attr:{spec.job_id}:{spec.name}", flush=True)
+        if spec.task_id in self._cancelled_tasks:
+            # Cancelled before execution started (cancel raced delivery).
+            self._cancelled_tasks.discard(spec.task_id)
+            self.record_task_event(spec, "CANCELLED")
+            return self.cancelled_payload(spec)
         ctx = (TaskID.from_hex(spec.task_id), spec)
         token = _exec_ctx.set(ctx)
+        on_main = threading.get_ident() == self._main_thread_ident
+        if on_main:
+            # Single writer (the main thread itself); read lock-free by the
+            # SIGUSR2 cancel handler to decide whether to raise.
+            self._main_task_id = spec.task_id
         with self._active_exec_lock:
             self._active_exec_seq += 1
             exec_key = self._active_exec_seq
-            self._active_exec[exec_key] = ctx
+            # Thread ident rides along so cancellation can interrupt the
+            # executing thread (interrupt_running_task).
+            self._active_exec[exec_key] = (ctx[0], ctx[1], threading.get_ident())
         from ray_tpu.util import tracing
 
         trace_token = tracing.set_task_context(spec.trace_ctx)
@@ -1560,21 +1856,56 @@ class CoreWorker:
                 payload["stream_count"] = stream_count
             self.record_task_event(spec, "FINISHED", start_ts=start, end_ts=time.time())
         except BaseException as e:  # noqa: BLE001 — errors ship to the caller
-            logger.debug("task %s raised", spec.name, exc_info=True)
-            err = TaskError.from_exception(e, task_name=spec.name)
-            payload = {
-                "task_id": spec.task_id,
-                "results": [],
-                "error": serialization.serialize(err).to_bytes(),
-            }
-            self.record_task_event(
-                spec, "FAILED", start_ts=start, end_ts=time.time(), error_type=type(e).__name__
-            )
+            # CANCELLED only when THIS task was the target of a cancel
+            # (interrupt_running_task tombstones before firing). A bare
+            # isinstance check would also swallow a stray late async-exc
+            # aimed at a previous task on this thread, or user code
+            # re-raising a child's TaskCancelledError — both of those are
+            # ordinary task failures (retries still apply).
+            cancelled = spec.task_id in self._cancelled_tasks
+            if cancelled:
+                # Interrupted by cancel (or raised it itself): ship the bare
+                # TaskCancelledError — owners must not retry it.
+                self._cancelled_tasks.discard(spec.task_id)
+                payload = self.cancelled_payload(spec)
+                self.record_task_event(
+                    spec, "CANCELLED", start_ts=start, end_ts=time.time()
+                )
+            else:
+                logger.debug("task %s raised", spec.name, exc_info=True)
+                err = TaskError.from_exception(e, task_name=spec.name)
+                payload = {
+                    "task_id": spec.task_id,
+                    "results": [],
+                    "error": serialization.serialize(err).to_bytes(),
+                }
+                self.record_task_event(
+                    spec, "FAILED", start_ts=start, end_ts=time.time(), error_type=type(e).__name__
+                )
         finally:
-            _exec_ctx.reset(token)
-            tracing.reset_task_context(trace_token)
-            with self._active_exec_lock:
-                self._active_exec.pop(exec_key, None)
+            # A late cancel (SIGUSR2 handler raise, or the async-exc landing
+            # after the body already exited) can fire INSIDE this finally and
+            # would skip the remaining statements, leaking the _active_exec
+            # entry and the context tokens. Each step is idempotent-guarded,
+            # so retrying until all have run is safe; the pending cancel
+            # exception is consumed by the first retry (the SIGUSR2 handler
+            # won't re-raise once _main_task_id clears, and an async-exc is
+            # delivered at most once).
+            while True:
+                try:
+                    if on_main:
+                        self._main_task_id = None
+                    if token is not None:
+                        _exec_ctx.reset(token)
+                        token = None
+                    if trace_token is not None:
+                        tracing.reset_task_context(trace_token)
+                        trace_token = None
+                    with self._active_exec_lock:
+                        self._active_exec.pop(exec_key, None)
+                    break
+                except BaseException:  # noqa: BLE001 — late cancel mid-cleanup
+                    continue
         payload["duration_s"] = time.time() - start
         return payload
 

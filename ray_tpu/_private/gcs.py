@@ -15,8 +15,13 @@ wiring per-domain managers over one RPC server:
 - task-event history (gcs_task_manager.h) powering the state API and timeline
 - job table
 
-Storage is in-memory (reference default) with an optional JSON snapshot for
-GCS fault-tolerance tests (reference: redis_store_client.h).
+Storage is in-memory (reference default) with snapshot + write-ahead-log
+durability (reference: redis_store_client.h — every committed mutation is
+durable before it is acknowledged). Mutating handlers append the changed
+table entry to an append-only WAL and flush BEFORE replying; the debounced
+snapshot acts as WAL compaction (each snapshot truncates the log). On
+restart: load snapshot, then replay the WAL tail — so an acknowledged
+mutation survives a GCS kill at any point after the reply.
 """
 
 from __future__ import annotations
@@ -45,8 +50,6 @@ class GcsServer:
         self.cfg = get_config()
         self.server = RpcServer("gcs")
         self.server.register_all(self)
-        self.server.start(host, port)
-        self.address = self.server.address
         self.persist_path = persist_path
 
         # Tables.
@@ -64,9 +67,28 @@ class GcsServer:
         self._subscribers: dict[str, list] = {}  # channel -> [writer]
         self._raylet_clients: dict[str, RpcClient] = {}
         self._io = EventLoopThread.get()
-        self._health_task = self._io.spawn(self._health_check_loop())
+        # Write-ahead log (reference durability bar: redis_store_client.h).
+        # Restore + open the WAL BEFORE the server starts accepting: a
+        # mutation acknowledged while _wal_file were still None would skip
+        # logging, and replay racing live handlers could clobber fresh
+        # entries with stale values — both break the "acknowledged means
+        # durable" contract documented above.
+        self._wal_path = persist_path + ".wal" if persist_path else None
+        self._wal_file = None
+        self._wal_records = 0
+        restored = False
         if persist_path and os.path.exists(persist_path):
             self._load_snapshot()
+            restored = True
+        if self._wal_path:
+            restored = self._replay_wal() or restored
+            # Append mode: replayed records stay until the next snapshot
+            # truncates them (replay is idempotent — records are full values).
+            self._wal_file = open(self._wal_path, "ab")
+        self.server.start(host, port)
+        self.address = self.server.address
+        self._health_task = self._io.spawn(self._health_check_loop())
+        if restored:
             self._io.spawn(self._recover_loaded_actors())
             self._io.spawn(self._recover_loaded_pgs())
         self._persist_task = (
@@ -209,6 +231,9 @@ class GcsServer:
             "max_restarts": spec.max_restarts,
             "death_cause": "",
         }
+        self._wal("actors", actor_id)
+        if spec.actor_name:
+            self._wal("named_actors", (spec.namespace, spec.actor_name))
         ok = await self._schedule_actor_creation(actor_id)
         if not ok:
             return {"ok": False, "error": "no feasible node for actor"}
@@ -262,6 +287,7 @@ class GcsServer:
             node_id=req["node_id"],
             worker_id=req.get("worker_id"),
         )
+        self._wal("actors", req["actor_id"])
         await self._publish("actor_updates", {"actor_id": req["actor_id"], "state": ALIVE, "address": req["address"]})
         return {"ok": True}
 
@@ -299,6 +325,7 @@ class GcsServer:
             info["num_restarts"] += 1
             info["state"] = RESTARTING
             info["address"] = None
+            self._wal("actors", actor_id)
             await self._publish("actor_updates", {"actor_id": actor_id, "state": RESTARTING})
             ok = await self._schedule_actor_creation(actor_id)
             if ok:
@@ -307,6 +334,7 @@ class GcsServer:
         info["state"] = DEAD
         info["death_cause"] = reason
         info["address"] = None
+        self._wal("actors", actor_id)
         await self._publish("actor_updates", {"actor_id": actor_id, "state": DEAD, "reason": reason})
 
     async def rpc_kill_actor(self, req):
@@ -320,8 +348,10 @@ class GcsServer:
         if no_restart:
             info["state"] = DEAD
             info["death_cause"] = "ray_tpu.kill"
+            self._wal("actors", actor_id)
             if info.get("name"):
                 self.named_actors.pop((info["namespace"], info["name"]), None)
+                self._wal("named_actors", (info["namespace"], info["name"]))
         if addr:
             try:
                 client = RpcClient(tuple(addr), label="actor-worker")
@@ -365,6 +395,7 @@ class GcsServer:
         if not overwrite and key in self.kv:
             return {"ok": False, "added": False}
         self.kv[key] = req["value"]
+        self._wal("kv", key)
         return {"ok": True, "added": True}
 
     @schema(key=str)
@@ -376,6 +407,8 @@ class GcsServer:
     async def rpc_kv_del(self, req):
         self._mutations += 1
         existed = self.kv.pop(req["key"], None) is not None
+        if existed:
+            self._wal("kv", req["key"])
         return {"ok": True, "existed": existed}
 
     async def rpc_kv_keys(self, req):
@@ -429,6 +462,7 @@ class GcsServer:
             "bundle_nodes": [None] * len(bundles),
             "name": req.get("name", ""),
         }
+        self._wal("placement_groups", pg_id)
         ok = await self._schedule_placement_group(pg_id)
         return {"ok": ok, "state": self.placement_groups[pg_id]["state"]}
 
@@ -484,6 +518,7 @@ class GcsServer:
             return False
         pg["bundle_nodes"] = list(plan)
         pg["state"] = "CREATED"
+        self._wal("placement_groups", pg_id)
         await self._publish("pg_updates", {"pg_id": pg_id, "state": "CREATED"})
         return True
 
@@ -564,6 +599,7 @@ class GcsServer:
             except Exception:
                 pass
         pg["state"] = "REMOVED"
+        self._wal("placement_groups", req["pg_id"])
         return {"ok": True}
 
     async def rpc_get_placement_group(self, req):
@@ -581,6 +617,8 @@ class GcsServer:
         self._job_counter += 1
         job_id = f"{self._job_counter:08x}"
         self.jobs[job_id] = {"job_id": job_id, "state": "RUNNING", "start_time": time.time()}
+        self._wal("job_counter")
+        self._wal("jobs", job_id)
         return {"job_id": job_id}
 
     async def rpc_list_jobs(self, req):
@@ -592,6 +630,7 @@ class GcsServer:
         if job is not None:
             job["state"] = req.get("state", "SUCCEEDED")
             job["end_time"] = time.time()
+            self._wal("jobs", req["job_id"])
         return {"ok": job is not None}
 
     async def rpc_list_placement_groups(self, req):
@@ -755,10 +794,72 @@ class GcsServer:
             except Exception:
                 logger.debug("gcs snapshot failed", exc_info=True)
 
+    # ---- write-ahead log ----
+
+    def _wal(self, table: str, key=None):
+        """Append one table entry's NEW value (None = deleted) to the WAL and
+        flush, BEFORE the mutating handler replies: an acknowledged mutation
+        survives a GCS kill at any later instant (the debounced snapshot
+        alone had a ~150ms loss window). Runs on the IO loop thread only."""
+        f = self._wal_file
+        if f is None:
+            return
+        import pickle
+
+        if table == "job_counter":
+            rec = ("job_counter", None, self._job_counter)
+        else:
+            rec = (table, key, getattr(self, table).get(key))
+        try:
+            data = pickle.dumps(rec, protocol=pickle.HIGHEST_PROTOCOL)
+            f.write(len(data).to_bytes(4, "big") + data)
+            f.flush()  # page cache: survives process kill (fsync would also
+            # survive machine crash; the reference's Redis default is
+            # everysec fsync — same durability class)
+            self._wal_records += 1
+        except Exception:
+            logger.debug("wal append failed", exc_info=True)
+
+    def _replay_wal(self) -> bool:
+        """Apply the WAL tail over the loaded snapshot. Torn trailing record
+        (crash mid-append, pre-ack) is discarded — it was never acknowledged."""
+        if not self._wal_path or not os.path.exists(self._wal_path):
+            return False
+        import pickle
+
+        try:
+            with open(self._wal_path, "rb") as f:
+                buf = f.read()
+        except OSError:
+            return False
+        pos, applied = 0, 0
+        while pos + 4 <= len(buf):
+            length = int.from_bytes(buf[pos : pos + 4], "big")
+            if pos + 4 + length > len(buf):
+                break  # torn tail
+            try:
+                table, key, value = pickle.loads(buf[pos + 4 : pos + 4 + length])
+            except Exception:
+                break  # corrupt tail
+            pos += 4 + length
+            if table == "job_counter":
+                self._job_counter = max(self._job_counter, value)
+            elif table in ("actors", "named_actors", "kv", "placement_groups", "jobs"):
+                tbl = getattr(self, table)
+                if value is None:
+                    tbl.pop(key, None)
+                else:
+                    tbl[key] = value
+            applied += 1
+        if applied:
+            logger.info("replayed %d WAL records over the GCS snapshot", applied)
+        return applied > 0
+
     def _do_save(self):
         """Write the snapshot. MUST run on the IO loop thread — tables are
         mutated by RPC handlers on that loop, so this is the only thread from
-        which pickling them is race-free."""
+        which pickling them is race-free. Doubles as WAL compaction: state up
+        to this instant is in the snapshot, so the log restarts empty."""
         if not self.persist_path:
             return
         import pickle
@@ -767,6 +868,10 @@ class GcsServer:
         with open(tmp, "wb") as f:
             pickle.dump(self._snapshot(), f)
         os.replace(tmp, self.persist_path)
+        if self._wal_file is not None:
+            self._wal_file.close()
+            self._wal_file = open(self._wal_path, "wb")
+            self._wal_records = 0
 
     def save_snapshot(self):
         """Thread-safe snapshot: marshals onto the IO loop."""
@@ -821,6 +926,12 @@ class GcsServer:
         if self._persist_task is not None:
             self._persist_task.cancel()
         self.save_snapshot()
+        if self._wal_file is not None:
+            try:
+                self._wal_file.close()
+            except Exception:
+                pass
+            self._wal_file = None
         for c in self._raylet_clients.values():
             c.close()
         self.server.stop()

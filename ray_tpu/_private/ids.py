@@ -142,3 +142,36 @@ class ObjectID(BaseID):
 
     def return_index(self) -> int:
         return struct.unpack(">I", self._bytes[TASK_ID_SIZE:])[0]
+
+
+class BoundedIdSet:
+    """Insertion-ordered bounded set of id strings (cancel tombstones —
+    reference: CoreWorker's cancelled-task bookkeeping in CancelTask).
+    O(1) membership; evicts oldest-first past ``cap``. The trim walks an
+    unbounded order deque on purpose: a maxlen deque would silently drop
+    the true oldest id on append (stranding it in the set forever) while
+    a manual pop then discarded a newer, still-needed entry."""
+
+    def __init__(self, cap: int = 4096):
+        import collections
+
+        self._cap = cap
+        self._set: set = set()
+        self._order = collections.deque()
+
+    def add(self, item) -> None:
+        if item in self._set:
+            return
+        self._set.add(item)
+        self._order.append(item)
+        while len(self._order) > self._cap:
+            self._set.discard(self._order.popleft())
+
+    def discard(self, item) -> None:
+        self._set.discard(item)
+
+    def __contains__(self, item) -> bool:
+        return item in self._set
+
+    def __len__(self) -> int:
+        return len(self._set)

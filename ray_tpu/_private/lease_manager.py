@@ -250,6 +250,25 @@ class LeaseManager:
 
     # ---- completion / failure ----
 
+    def cancel_queued(self, task_id: str) -> bool:
+        """Recall a spec still staged owner-side (pre-ship). IO-loop only."""
+        with self._submit_lock:
+            for s in self._submit_buf:
+                if s.task_id == task_id:
+                    self._submit_buf.remove(s)
+                    return True
+        for shape in self._shapes.values():
+            for s in shape.queue:
+                if s.task_id == task_id:
+                    shape.queue.remove(s)
+                    self._attempts.pop(task_id, None)
+                    return True
+        return False
+
+    def lease_for(self, task_id: str):
+        """The lease (worker) a shipped task is in flight on, if any."""
+        return self._task_lease.get(task_id)
+
     def on_task_done(self, task_id: str, duration_s: float | None = None):
         """Bookkeeping on result arrival (the payload itself is handled by
         CoreWorker._handle_task_done). Returns the shape to top up."""
@@ -293,6 +312,13 @@ class LeaseManager:
             "return_worker_lease", {"lease_id": lease.lease_id}))
         for s in respecs:
             self._task_lease.pop(s.task_id, None)
+            pending = self.cw.pending_tasks.get(s.task_id)
+            if pending is not None and pending.cancel_requested:
+                # Cancelled task caught in the failover (e.g. force-kill of
+                # the leased worker): surface cancellation, never resubmit.
+                self._attempts.pop(s.task_id, None)
+                self.cw._fail_task(s.task_id, self.cw._cancel_error(s))
+                continue
             attempts = self._attempts.get(s.task_id, 0)
             if attempts < s.max_retries:
                 self._attempts[s.task_id] = attempts + 1

@@ -34,7 +34,7 @@ from collections import deque
 from dataclasses import dataclass, field
 
 from ray_tpu._private.config import get_config
-from ray_tpu._private.ids import NodeID, WorkerID
+from ray_tpu._private.ids import BoundedIdSet, NodeID, WorkerID
 from ray_tpu._private.rpc import EventLoopThread, RpcClient, RpcServer, schema
 from ray_tpu._private.store.arena import create_arena
 from ray_tpu._private.store.object_store import StoreCore
@@ -135,6 +135,9 @@ class Raylet:
         # separate infeasible queue too, cluster_task_manager.h). They are
         # spliced back whenever capacity or the cluster view changes.
         self._infeasible: deque[TaskSpec] = deque()
+        # Cancelled-before-arrival tombstones (cancel racing a spillback or
+        # an in-flight submit): matching specs are dropped at dispatch.
+        self._cancelled_tasks = BoundedIdSet()
         self._last_progress = time.monotonic()
         self.cluster_view: dict = {}
         self._synced_peers: set[str] = set()
@@ -668,6 +671,66 @@ class Raylet:
         await self._queue_and_schedule(spec)
         return {"ok": True}
 
+    # ---- task cancellation (reference: node_manager.cc HandleCancelTask +
+    # cluster_task_manager.cc CancelTask) ----
+
+    @schema(task_id=str)
+    async def rpc_cancel_task(self, req):
+        """Cancel a task wherever this raylet can see it: dequeue if queued
+        locally, forward to the executing worker if dispatched, else
+        tombstone (drop on late arrival) and fan out to peers once — a
+        spillback may have moved the task off this node."""
+        task_id = req["task_id"]
+        for q in (self.task_queue, self._infeasible):
+            for spec in q:
+                if spec.task_id == task_id:
+                    q.remove(spec)
+                    return {"found": True, "dequeued": True}
+        for worker in self.workers.values():
+            spec = worker.current_task
+            if spec is not None and spec.task_id == task_id and worker.client is not None:
+                try:
+                    await worker.client.acall(
+                        "cancel_exec",
+                        {
+                            "task_id": task_id,
+                            "force": bool(req.get("force")),
+                            "recursive": req.get("recursive", True),
+                        },
+                        timeout=10,
+                    )
+                except Exception:
+                    pass  # worker death surfaces via the normal failure path
+                return {"found": True, "dequeued": False}
+        self._tombstone_cancel(task_id)
+        if req.get("fanout", True):
+            # Probe all peers CONCURRENTLY: sequential probes with a 10s
+            # timeout each could exceed the owner's single 30s cancel
+            # budget as soon as a few peers are unreachable — gather bounds
+            # the whole fan-out to ~one timeout.
+            peers = [
+                (nid, node)
+                for nid, node in list(self.cluster_view.items())
+                if nid != self.node_id  # already searched locally above
+            ]
+            if peers:
+                results = await asyncio.gather(
+                    *(
+                        self._peer(nid, node["address"]).acall(
+                            "cancel_task", dict(req, fanout=False), timeout=10
+                        )
+                        for nid, node in peers
+                    ),
+                    return_exceptions=True,
+                )
+                for resp in results:
+                    if isinstance(resp, dict) and resp.get("found"):
+                        return resp
+        return {"found": False, "dequeued": False}
+
+    def _tombstone_cancel(self, task_id: str):
+        self._cancelled_tasks.add(task_id)
+
     @schema(specs=list)
     async def rpc_submit_tasks(self, req):
         """Batched submission: one RPC for a burst of specs (client-side
@@ -826,6 +889,12 @@ class Raylet:
             made_progress = False
             for _ in range(min(len(self.task_queue), 128)):
                 spec = self.task_queue.popleft()
+                if spec.task_id in self._cancelled_tasks:
+                    # Cancelled before it arrived here; the owner already
+                    # failed it with TaskCancelledError.
+                    self._cancelled_tasks.discard(spec.task_id)
+                    made_progress = True
+                    continue
                 if self._must_reroute(spec):
                     # Wrong node for this task; the heartbeat loop re-routes it
                     # once the cluster view / PG placement catches up.

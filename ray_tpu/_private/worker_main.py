@@ -25,6 +25,53 @@ from concurrent.futures import ThreadPoolExecutor
 logger = logging.getLogger(__name__)
 
 
+class _MainThreadExecutor:
+    """Executor-protocol shim that runs submitted callables on the worker's
+    MAIN thread (worker_main.main() drains the queue in run_forever).
+
+    Tasks must execute on the main thread so that non-force
+    ray_tpu.cancel() can interrupt C-blocked calls: CPython delivers signal
+    handlers only to the main thread, and a handler that raises aborts the
+    in-flight blocking call (PEP 475). The reference runs tasks on the
+    worker main thread and cancels via KeyboardInterrupt for exactly this
+    reason (_raylet.pyx task_execution_handler + CancelTask).
+
+    Duck-types concurrent.futures.Executor far enough for
+    loop.run_in_executor (submit) and CoreWorker teardown (shutdown)."""
+
+    def __init__(self):
+        import queue
+
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._stopped = False
+
+    def submit(self, fn, *args, **kwargs):
+        import concurrent.futures
+
+        fut = concurrent.futures.Future()
+        self._q.put((fut, fn, args, kwargs))
+        return fut
+
+    def run_forever(self):
+        while not self._stopped:
+            item = self._q.get()
+            if item is None:
+                break
+            fut, fn, args, kwargs = item
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                result = fn(*args, **kwargs)
+            except BaseException as e:  # noqa: BLE001 — ship to the waiter
+                fut.set_exception(e)
+            else:
+                fut.set_result(result)
+
+    def shutdown(self, wait=True, cancel_futures=False):
+        self._stopped = True
+        self._q.put(None)
+
+
 class WorkerExecutor:
     def __init__(self, core_worker, raylet_client):
         self.cw = core_worker
@@ -39,6 +86,7 @@ class WorkerExecutor:
         server.register("kill_self", self.rpc_kill_self)
         server.register("lease_exec", self.rpc_lease_exec)
         server.register("lease_ping", self.rpc_lease_ping)
+        server.register("cancel_exec", self.rpc_cancel_exec)
         # Leased-task pipeline (reference: direct task transport worker side,
         # core_worker.cc task receiver): owners ship batches of specs; we
         # execute FIFO and push completion payloads back, coalescing results
@@ -48,6 +96,35 @@ class WorkerExecutor:
         self._lease_task = None
         self._done_buf: list = []
         self._done_flushing = False
+
+    def _safe_execute(self, spec):
+        """execute_task catches everything inside its own try; anything that
+        escapes is either a cancellation async-exc that landed a few
+        bytecodes late (after the task body returned — the tombstone for
+        spec.task_id is still set because the FINISHED path never consumes
+        it) or a genuine internal error. Only the former becomes a
+        cancelled payload; misreporting an internal error as CANCELLED
+        would suppress the owner's retries and hide the real failure."""
+        from ray_tpu._private import serialization
+        from ray_tpu.exceptions import TaskCancelledError, TaskError
+
+        try:
+            return self.cw.execute_task(spec)
+        except BaseException as e:  # noqa: BLE001 — must not kill the loop
+            if (
+                isinstance(e, TaskCancelledError)
+                and spec.task_id in self.cw._cancelled_tasks
+            ):
+                self.cw._cancelled_tasks.discard(spec.task_id)
+                return self.cw.cancelled_payload(spec)
+            logger.exception("task %s escaped execute_task", spec.task_id[:8])
+            err = TaskError.from_exception(e, task_name=spec.name)
+            return {
+                "task_id": spec.task_id,
+                "results": [],
+                "error": serialization.serialize(err).to_bytes(),
+                "duration_s": 0.0,
+            }
 
     # ---- normal / actor-creation tasks ----
 
@@ -60,7 +137,7 @@ class WorkerExecutor:
 
     async def _execute_pushed(self, spec):
         loop = asyncio.get_event_loop()
-        payload = await loop.run_in_executor(self.cw._executor, self.cw.execute_task, spec)
+        payload = await loop.run_in_executor(self.cw._executor, self._safe_execute, spec)
         if spec.is_actor_creation():
             await self._finish_actor_creation(spec, payload)
         else:
@@ -140,7 +217,7 @@ class WorkerExecutor:
                 self._lease_event.clear()
                 await self._lease_event.wait()
             spec = self._lease_buf.pop(0)
-            payload = await loop.run_in_executor(self.cw._executor, self.cw.execute_task, spec)
+            payload = await loop.run_in_executor(self.cw._executor, self._safe_execute, spec)
             self._done_buf.append((tuple(spec.owner_addr), payload))
             if not self._done_flushing:
                 self._done_flushing = True
@@ -200,11 +277,11 @@ class WorkerExecutor:
             # Threaded actor: concurrent execution, no ordering guarantee
             # (reference: concurrency groups / max_concurrency > 1).
             return await loop.run_in_executor(
-                self._concurrency_pool, self.cw.execute_task, spec
+                self._concurrency_pool, self._safe_execute, spec
             )
         if self._actor_queue is None:
             # Call raced actor initialisation; serialize behind creation.
-            return await loop.run_in_executor(self.cw._executor, self.cw.execute_task, spec)
+            return await loop.run_in_executor(self.cw._executor, self._safe_execute, spec)
         fut = loop.create_future()
         self._actor_queue.put_nowait((spec, fut))  # pre-await: preserves order
         return await fut
@@ -216,13 +293,61 @@ class WorkerExecutor:
             spec, fut = await self._actor_queue.get()
             try:
                 payload = await loop.run_in_executor(
-                    self.cw._executor, self.cw.execute_task, spec
+                    self.cw._executor, self._safe_execute, spec
                 )
                 if not fut.done():
                     fut.set_result(payload)
             except Exception as e:
                 if not fut.done():
                     fut.set_exception(e)
+
+    # ---- cancellation (reference: core_worker.cc HandleCancelTask) ----
+
+    async def rpc_cancel_exec(self, req):
+        """Recall a task delivered to this worker: dequeue if still queued
+        (lease buffer / actor queue), interrupt if running, tombstone if it
+        has not arrived yet; recursively cancel children this worker owns."""
+        task_id = req["task_id"]
+        force = bool(req.get("force"))
+        recursive = req.get("recursive", True)
+        handled = False
+        # Queued leased task, not yet started.
+        for i, s in enumerate(self._lease_buf):
+            if s.task_id == task_id:
+                spec = self._lease_buf.pop(i)
+                self._done_buf.append((tuple(spec.owner_addr), self.cw.cancelled_payload(spec)))
+                if not self._done_flushing:
+                    self._done_flushing = True
+                    asyncio.ensure_future(self._flush_done())
+                handled = True
+                break
+        # Queued actor call, not yet dispatched (reference: pre-dispatch
+        # actor-task cancellation).
+        if not handled and self._actor_queue is not None:
+            kept, target = [], None
+            while not self._actor_queue.empty():
+                item = self._actor_queue.get_nowait()
+                if item[0].task_id == task_id:
+                    target = item
+                else:
+                    kept.append(item)
+            for item in kept:
+                self._actor_queue.put_nowait(item)
+            if target is not None:
+                spec, fut = target
+                if not fut.done():
+                    fut.set_result(self.cw.cancelled_payload(spec))
+                handled = True
+        # Running right now.
+        if not handled:
+            handled = self.cw.interrupt_running_task(task_id, force=force)
+        if not handled:
+            # Not here (yet): tombstone so a late arrival is dropped at
+            # execution entry and reported as cancelled.
+            self.cw.mark_cancelled(task_id)
+        if recursive:
+            self.cw.cancel_children_of(task_id, force, recursive)
+        return {"found": handled}
 
     async def rpc_kill_self(self, req):
         def _die():
@@ -341,6 +466,28 @@ def main():
     )
     worker_context.set_core_worker(cw)
     _mark("core_worker")
+    # Tasks run on THIS (main) thread: swap the default pool executor for
+    # the main-thread drain loop and install the cancel signal handler —
+    # both before register_worker, after which tasks may arrive.
+    from ray_tpu.exceptions import TaskCancelledError
+
+    cw._executor.shutdown(wait=False)
+    cw._executor = _MainThreadExecutor()
+    cw._main_thread_ident = threading.get_ident()
+
+    def _cancel_handler(signum, frame):
+        # Raise ONLY if the cancel target is still the task running on this
+        # thread — a signal that lands after the task finished (or while
+        # idle in the queue) is a no-op and the interrupted blocking call
+        # is retried per PEP 475.
+        target = cw._main_cancel_target
+        if target is not None and target == cw._main_task_id:
+            cw._main_cancel_target = None
+            raise TaskCancelledError("task was cancelled by ray_tpu.cancel()")
+
+    import signal
+
+    signal.signal(signal.SIGUSR2, _cancel_handler)
     executor = WorkerExecutor(cw, cw.raylet)
     reply = cw.raylet.call(
         "register_worker",
@@ -365,7 +512,7 @@ def main():
                 os._exit(1)
 
     threading.Thread(target=_watch_raylet, daemon=True).start()
-    threading.Event().wait()
+    cw._executor.run_forever()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,231 @@
+"""ray_tpu.cancel() semantics (analog of the reference's cancellation tests
+in python/ray/tests/test_cancel.py; semantics per _private/worker.py:2773 and
+core_worker.cc CancelTask)."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import TaskCancelledError
+
+
+def _interruptible(seconds):
+    # Many short sleeps: the cancellation async-exc lands on a bytecode
+    # boundary between iterations.
+    end = time.monotonic() + seconds
+    while time.monotonic() < end:
+        time.sleep(0.01)
+
+
+def test_cancel_running_task(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        _interruptible(60)
+        return "done"
+
+    ref = slow.remote()
+    time.sleep(1.5)  # let it start
+    ray_tpu.cancel(ref)
+    start = time.monotonic()
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+    assert time.monotonic() - start < 25  # interrupted, not run to completion
+
+
+def test_cancel_interrupts_c_blocked_sleep(ray_start_regular):
+    # ONE long time.sleep: blocks in C, so an async-exc alone would never
+    # land (no bytecode boundary for 60s). Tasks run on the worker's main
+    # thread and cancel delivers SIGUSR2 whose handler raises — PEP 475
+    # aborts the in-flight sleep (reference: KeyboardInterrupt into the
+    # worker main thread via PyErr_SetInterrupt).
+    @ray_tpu.remote
+    def c_blocked():
+        time.sleep(60)
+        return "done"
+
+    ref = c_blocked.remote()
+    time.sleep(1.5)  # let it start
+    ray_tpu.cancel(ref)
+    start = time.monotonic()
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+    assert time.monotonic() - start < 20  # sleep aborted, not run out
+
+
+def test_cancel_pending_task_lease_path(ray_start_regular):
+    # Saturate the CPUs so extra tasks stay queued owner-side/raylet-side.
+    @ray_tpu.remote
+    def hog():
+        _interruptible(8)
+        return "hogged"
+
+    @ray_tpu.remote
+    def queued():
+        return "ran"
+
+    hogs = [hog.remote() for i in range(4)]
+    time.sleep(0.5)
+    ref = queued.remote()
+    time.sleep(0.2)
+    ray_tpu.cancel(ref)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+    # The hogs are unaffected.
+    assert ray_tpu.get(hogs, timeout=60) == ["hogged"] * 4
+
+
+def test_cancel_pending_task_classic_path(ray_start_regular):
+    @ray_tpu.remote
+    def hog():
+        _interruptible(8)
+
+    @ray_tpu.remote
+    def queued():
+        return "ran"
+
+    hogs = [hog.options(scheduling_strategy="SPREAD").remote() for i in range(4)]
+    time.sleep(0.5)
+    # SPREAD keeps this off the direct-lease transport (classic raylet queue).
+    ref = queued.options(scheduling_strategy="SPREAD").remote()
+    time.sleep(0.2)
+    ray_tpu.cancel(ref)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+    ray_tpu.get(hogs, timeout=60)
+
+
+def test_cancel_finished_task_is_noop(ray_start_regular):
+    @ray_tpu.remote
+    def fast():
+        return 7
+
+    ref = fast.remote()
+    assert ray_tpu.get(ref, timeout=30) == 7
+    ray_tpu.cancel(ref)  # no-op
+    assert ray_tpu.get(ref, timeout=30) == 7
+
+
+def test_cancel_force_kills_worker(ray_start_regular):
+    @ray_tpu.remote(max_retries=2)
+    def stubborn():
+        # Swallows the graceful interrupt — only force gets it.
+        while True:
+            try:
+                _interruptible(60)
+            except TaskCancelledError:
+                pass
+
+    ref = stubborn.remote()
+    time.sleep(1.5)
+    ray_tpu.cancel(ref, force=True)
+    # Force-kill must surface as cancellation, not retry (despite max_retries).
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+
+
+def test_cancel_task_waiting_on_args(ray_start_regular):
+    @ray_tpu.remote
+    def slow_producer():
+        _interruptible(8)
+        return 1
+
+    @ray_tpu.remote
+    def consumer(x):
+        return x + 1
+
+    dep = slow_producer.remote()
+    ref = consumer.remote(dep)
+    time.sleep(0.2)
+    ray_tpu.cancel(ref)  # still owner-local, resolving args
+    start = time.monotonic()
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+    assert time.monotonic() - start < 5  # failed locally, didn't wait for dep
+    assert ray_tpu.get(dep, timeout=60) == 1
+
+
+def test_cancel_queued_actor_task(ray_start_regular):
+    @ray_tpu.remote
+    class Worker:
+        def slow(self):
+            _interruptible(6)
+            return "slow"
+
+        def fast(self):
+            return "fast"
+
+    w = Worker.remote()
+    slow_ref = w.slow.remote()
+    time.sleep(0.5)
+    queued_ref = w.fast.remote()  # queued behind slow() at the actor
+    ray_tpu.cancel(queued_ref)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(queued_ref, timeout=30)
+    assert ray_tpu.get(slow_ref, timeout=60) == "slow"
+    # The actor survives and serves later calls.
+    assert ray_tpu.get(w.fast.remote(), timeout=30) == "fast"
+
+
+def test_cancel_running_actor_task(ray_start_regular):
+    @ray_tpu.remote
+    class Worker:
+        def slow(self):
+            _interruptible(60)
+            return "slow"
+
+        def ping(self):
+            return "pong"
+
+    w = Worker.remote()
+    ref = w.slow.remote()
+    time.sleep(1.5)
+    ray_tpu.cancel(ref)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+    assert ray_tpu.get(w.ping.remote(), timeout=30) == "pong"
+
+
+def test_cancel_actor_task_force_raises(ray_start_regular):
+    @ray_tpu.remote
+    class Worker:
+        def slow(self):
+            _interruptible(30)
+
+    w = Worker.remote()
+    ref = w.slow.remote()
+    time.sleep(0.5)
+    with pytest.raises(ValueError):
+        ray_tpu.cancel(ref, force=True)
+    ray_tpu.cancel(ref)  # clean up
+
+
+def test_cancel_recursive(ray_start_regular, tmp_path):
+    marker = str(tmp_path / "child_finished")
+
+    @ray_tpu.remote
+    def child(path):
+        _interruptible(5)
+        with open(path, "w") as f:
+            f.write("done")
+        return "child"
+
+    @ray_tpu.remote
+    def parent(path):
+        ref = child.remote(path)
+        return ray_tpu.get(ref, timeout=60)
+
+    ref = parent.remote(marker)
+    time.sleep(2.0)  # parent started and submitted the child
+    ray_tpu.cancel(ref, recursive=True)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+    # The child was cancelled too: its completion marker never appears.
+    time.sleep(6.0)
+    assert not os.path.exists(marker)
+
+
+def test_cancel_wrong_type_raises(ray_start_regular):
+    with pytest.raises(TypeError):
+        ray_tpu.cancel("not a ref")
