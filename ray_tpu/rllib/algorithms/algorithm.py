@@ -41,6 +41,12 @@ class AlgorithmConfig:
         self.num_learners = 0
         self.num_tpus_per_learner = 0.0
         self.explore = True
+        # Evaluation (reference: algorithm_config.py:383 .evaluation()):
+        # None = never evaluate; N = every N training iterations.
+        self.evaluation_interval: Optional[int] = None
+        self.evaluation_num_workers = 1
+        self.evaluation_duration = 10
+        self.evaluation_duration_unit = "episodes"  # or "timesteps"
         self.extra: dict = {}
 
     # -- fluent sections (reference: .environment/.rollouts/.training) ----
@@ -87,6 +93,26 @@ class AlgorithmConfig:
             self.num_learners = num_learners
         if num_tpus_per_learner is not None:
             self.num_tpus_per_learner = num_tpus_per_learner
+        return self
+
+    def evaluation(self, *, evaluation_interval: Optional[int] = None,
+                   evaluation_num_workers: Optional[int] = None,
+                   evaluation_duration: Optional[int] = None,
+                   evaluation_duration_unit: Optional[str] = None) -> "AlgorithmConfig":
+        """Dedicated greedy evaluation every ``evaluation_interval`` training
+        iterations (reference: algorithm_config.py:383). Eval rollouts use
+        explore=False on a separate worker set (or a driver-local env for
+        algorithms without the standard rollout stack) so exploration noise
+        and training episode stats are never mixed into eval metrics."""
+        if evaluation_interval is not None:
+            self.evaluation_interval = evaluation_interval
+        if evaluation_num_workers is not None:
+            self.evaluation_num_workers = evaluation_num_workers
+        if evaluation_duration is not None:
+            self.evaluation_duration = evaluation_duration
+        if evaluation_duration_unit is not None:
+            assert evaluation_duration_unit in ("episodes", "timesteps")
+            self.evaluation_duration_unit = evaluation_duration_unit
         return self
 
     def debugging(self, *, seed: Optional[int] = None) -> "AlgorithmConfig":
@@ -144,6 +170,10 @@ class Algorithm(Trainable):
         existing = getattr(self, "workers", None)
         if existing is not None:
             existing.stop()
+        existing_eval = getattr(self, "_eval_workers", None)
+        if existing_eval is not None:
+            existing_eval.stop()
+            self._eval_workers = None
         existing_lg = getattr(self, "learner_group", None)
         if existing_lg is not None and hasattr(existing_lg, "stop"):
             existing_lg.stop()
@@ -172,6 +202,145 @@ class Algorithm(Trainable):
         self.workers.sync_weights(self.learner_group.get_weights())
         self._episode_reward_window: list = []
         self._timesteps_total = 0
+
+    # -- evaluation (reference: Algorithm.evaluate, algorithm.py:850) ------
+    @property
+    def eval_workers(self):
+        """Dedicated evaluation WorkerSet, built lazily on first use so
+        algorithms that never evaluate pay nothing (reference: setup builds
+        evaluation_workers only when evaluation_interval is set)."""
+        ws = getattr(self, "_eval_workers", None)
+        if ws is None:
+            cfg = self._algo_config
+            ws = WorkerSet(
+                cfg.env,
+                self.module_spec,
+                num_workers=max(1, cfg.evaluation_num_workers),
+                num_envs_per_worker=cfg.num_envs_per_worker,
+                env_config=cfg.env_config,
+                gamma=cfg.gamma,
+                lambda_=cfg.lambda_,
+                # Offset so eval envs never mirror training-env seeds.
+                seed=cfg.seed + 100_000,
+                observation_filter=getattr(cfg, "observation_filter", None),
+            )
+            self._eval_workers = ws
+        return ws
+
+    def _has_rollout_stack(self) -> bool:
+        """True when this algorithm uses the standard WorkerSet+LearnerGroup
+        stack (base setup); custom-stack algorithms evaluate driver-locally
+        through their compute_single_action."""
+        return (
+            getattr(self, "learner_group", None) is not None
+            and isinstance(getattr(self, "workers", None), WorkerSet)
+            and getattr(self, "module_spec", None) is not None
+        )
+
+    def evaluate(self) -> dict:
+        """Run one evaluation round with explore=False and return
+        ``{"evaluation": {...metrics...}}``. Eval rollouts happen on a
+        dedicated worker set (or a driver-local env for custom-stack
+        algorithms), so exploration noise and training episode stats never
+        leak into the reported numbers."""
+        cfg = self._algo_config
+        duration = int(cfg.evaluation_duration)
+        by_episodes = cfg.evaluation_duration_unit != "timesteps"
+        if self._has_rollout_stack():
+            rewards, lens = self._evaluate_with_workers(duration, by_episodes)
+        else:
+            rewards, lens = self._evaluate_local(duration, by_episodes)
+        metrics = {
+            "episode_reward_mean": float(np.mean(rewards)) if rewards else float("nan"),
+            "episode_reward_min": float(np.min(rewards)) if rewards else float("nan"),
+            "episode_reward_max": float(np.max(rewards)) if rewards else float("nan"),
+            "episode_len_mean": float(np.mean(lens)) if lens else float("nan"),
+            "episodes_this_iter": len(rewards),
+        }
+        return {"evaluation": metrics}
+
+    def _evaluate_with_workers(self, duration: int, by_episodes: bool):
+        ws = self.eval_workers
+        ws.sync_weights(self.get_policy_weights())
+        if getattr(ws, "observation_filter", None):
+            # Eval policies must see the same filtered observations as
+            # training; copy the training filter base across.
+            ws._filter_base = getattr(self.workers, "_filter_base", None)
+            ws.sync_filters()
+        rewards: list = []
+        lens: list = []
+        steps = 0
+        fragment = max(16, self._algo_config.rollout_fragment_length)
+        # Cap rounds so an env that never terminates can't spin forever.
+        for _ in range(64):
+            batches = ws.sample(fragment, explore=False)
+            steps += sum(len(b) for b in batches)
+            stats = ws.episode_stats()
+            rewards += stats["episode_rewards"]
+            lens += stats["episode_lens"]
+            if (by_episodes and len(rewards) >= duration) or (
+                not by_episodes and steps >= duration
+            ):
+                break
+        return rewards, lens
+
+    def _make_eval_env(self):
+        """Fresh driver-local env for one evaluation round. Created per
+        evaluate() call and closed right after (cheap for gym envs) —
+        caching it would leak through the custom-stack algorithms' cleanup
+        overrides and go stale across re-setup with a new env config."""
+        import gymnasium as gym
+
+        cfg = self._algo_config
+        return (
+            gym.make(cfg.env)
+            if isinstance(cfg.env, str)
+            else cfg.env(dict(cfg.env_config))
+        )
+
+    def _evaluate_local(self, duration: int, by_episodes: bool):
+        """Greedy episodes on a driver-local env via compute_single_action
+        (used by algorithms with custom learner stacks — DQN family, ES/ARS,
+        offline algos — which all expose compute_single_action)."""
+        env = self._make_eval_env()
+        rewards: list = []
+        lens: list = []
+        steps = 0
+        budget = duration if by_episodes else 64
+        try:
+            for _ in range(budget):
+                obs, _ = env.reset()
+                total, length = 0.0, 0
+                for _ in range(10_000):
+                    action = self.compute_single_action(obs, explore=False)
+                    obs, r, terminated, truncated, _ = env.step(action)
+                    total += float(r)
+                    length += 1
+                    steps += 1
+                    if terminated or truncated:
+                        break
+                    if not by_episodes and steps >= duration:
+                        break
+                rewards.append(total)
+                lens.append(length)
+                if not by_episodes and steps >= duration:
+                    break
+        finally:
+            try:
+                env.close()
+            except Exception:
+                pass
+        return rewards, lens
+
+    def train(self) -> dict:
+        """One training iteration + (when due) an evaluation round attached
+        under result["evaluation"] (reference: Algorithm.step wiring
+        evaluate() by evaluation_interval)."""
+        result = super().train()
+        interval = getattr(self._algo_config, "evaluation_interval", None)
+        if interval and self.iteration % int(interval) == 0:
+            result.update(self.evaluate())
+        return result
 
     def _build_learner_group(self, cfg: AlgorithmConfig) -> LearnerGroup:
         raise NotImplementedError
@@ -208,6 +377,10 @@ class Algorithm(Trainable):
         workers = getattr(self, "workers", None)
         if workers is not None:
             workers.stop()
+        eval_ws = getattr(self, "_eval_workers", None)
+        if eval_ws is not None:
+            eval_ws.stop()
+            self._eval_workers = None
         lg = getattr(self, "learner_group", None)
         if lg is not None and hasattr(lg, "stop"):
             lg.stop()

@@ -284,6 +284,18 @@ class ApexDQN(Algorithm):
         result["time_this_iter_s"] = time.time() - t0
         return result
 
+    def compute_single_action(self, obs, explore: bool = False):
+        """Greedy argmax-Q (evaluation / external callers); exploration is
+        the rollout workers' per-worker epsilon, not reproduced here."""
+        import jax.numpy as jnp
+
+        q = np.asarray(
+            self._q_fn(
+                self.learner.params, jnp.asarray(np.asarray(obs, np.float32))[None]
+            )
+        )
+        return int(q.argmax())
+
     def save_checkpoint(self):
         from ray_tpu.air.checkpoint import Checkpoint
 

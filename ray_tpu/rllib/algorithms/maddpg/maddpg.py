@@ -285,6 +285,45 @@ class MADDPG(Algorithm):
         a = np.array(self._actors_fwd(self._as_jax(self.params), jnp.asarray(obs)))
         return {ag: self._scale(a[i]) for i, ag in enumerate(self.agents)}
 
+    def _evaluate_local(self, duration: int, by_episodes: bool):
+        """Greedy (noise-free) multi-agent episodes; team reward per episode.
+        Overrides the base single-agent eval loop — MADDPG envs take action
+        DICTS and report per-agent rewards."""
+        cfg = self._algo_config
+        shared = not callable(cfg.env)
+        # Fresh env per round (closed below); instance-config borrows the
+        # training env since a second instance can't be constructed.
+        env = self.env if shared else cfg.env(dict(cfg.env_config))
+        rewards, lens, steps = [], [], 0
+        try:
+            for _ in range(duration if by_episodes else 64):
+                obs_d, _ = env.reset()
+                total, length = 0.0, 0
+                for _ in range(10_000):
+                    obs_d, rew_d, term_d, trunc_d, _ = env.step(self.compute_actions(obs_d))
+                    total += float(sum(rew_d.get(ag, 0.0) for ag in self.agents))
+                    length += 1
+                    steps += 1
+                    done = bool(term_d.get("__all__")) or bool(trunc_d.get("__all__"))
+                    if done or (not by_episodes and steps >= duration):
+                        break
+                rewards.append(total)
+                lens.append(length)
+                if not by_episodes and steps >= duration:
+                    break
+        finally:
+            if shared:
+                # Re-seat the training rollout on a fresh episode: eval
+                # stepped the shared env, so the cached obs is stale.
+                self._obs = self._obs_dict_to_array(env.reset()[0])
+                self._ep_reward = 0.0
+            else:
+                try:
+                    env.close()
+                except Exception:
+                    pass
+        return rewards, lens
+
     def save_checkpoint(self):
         from ray_tpu.air.checkpoint import Checkpoint
 

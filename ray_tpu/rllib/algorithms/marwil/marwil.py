@@ -61,9 +61,12 @@ class MARWILConfig(AlgorithmConfig):
         self.lr = 1e-4
         self.train_batch_size = 2000
         self.input_ = None  # path / glob / list of files / Dataset
-        self.num_rollout_workers = 0  # offline: workers only for evaluation
-        self.evaluation_interval = 5
-        self.evaluation_duration_steps = 500
+        # Offline: no training rollouts; online interaction happens only
+        # when the user opts into evaluation via .evaluation(...) — the
+        # base Algorithm then runs greedy episodes on a dedicated eval
+        # WorkerSet (reference: offline algos default to no online eval).
+        self.num_rollout_workers = 0
+        self.evaluation_interval = None
 
     def offline_data(self, *, input_=None) -> "MARWILConfig":
         if input_ is not None:
@@ -79,14 +82,6 @@ class MARWILConfig(AlgorithmConfig):
             self.vf_coeff = vf_coeff
         if entropy_coeff is not None:
             self.entropy_coeff = entropy_coeff
-        return self
-
-    def evaluation(self, *, evaluation_interval: Optional[int] = None,
-                   evaluation_duration_steps: Optional[int] = None) -> "MARWILConfig":
-        if evaluation_interval is not None:
-            self.evaluation_interval = evaluation_interval
-        if evaluation_duration_steps is not None:
-            self.evaluation_duration_steps = evaluation_duration_steps
         return self
 
 
@@ -128,15 +123,9 @@ class MARWIL(Algorithm):
             "entropy_coeff": cfg.entropy_coeff,
         }
         metrics = self.learner_group.update(batch, loss_cfg)
-        # Periodic evaluation rollouts (the only online interaction).
-        if (
-            self.workers.num_workers > 0
-            and cfg.evaluation_interval
-            and self.iteration % cfg.evaluation_interval == 0
-        ):
-            self.workers.sync_weights(self.learner_group.get_weights())
-            per_worker = max(1, cfg.evaluation_duration_steps // self.workers.num_workers)
-            self.workers.sample(per_worker)
+        # Evaluation rollouts (the only online interaction) ride the base
+        # Algorithm.evaluate() machinery: train() runs greedy episodes on a
+        # dedicated eval WorkerSet every evaluation_interval iterations.
         return dict(metrics)
 
 

@@ -369,6 +369,41 @@ class R2D2(Algorithm):
         result["time_this_iter_s"] = time.time() - t0
         return result
 
+    def _evaluate_local(self, duration: int, by_episodes: bool):
+        """Recurrent eval must THREAD the GRU state across steps — the base
+        loop's stateless compute_single_action would wipe the memory the
+        policy was trained to use, scoring a memoryless policy instead."""
+        env = self._make_eval_env()
+        rewards, lens, steps = [], [], 0
+        hidden_size = self._algo_config.hidden_size
+        try:
+            for _ in range(duration if by_episodes else 64):
+                obs, _ = env.reset()
+                state = np.zeros((1, hidden_size), np.float32)
+                total, length = 0.0, 0
+                for _ in range(10_000):
+                    action, state = self.compute_single_action(
+                        obs, explore=False, state=state
+                    )
+                    obs, r, terminated, truncated, _ = env.step(action)
+                    total += float(r)
+                    length += 1
+                    steps += 1
+                    if terminated or truncated:
+                        break
+                    if not by_episodes and steps >= duration:
+                        break
+                rewards.append(total)
+                lens.append(length)
+                if not by_episodes and steps >= duration:
+                    break
+        finally:
+            try:
+                env.close()
+            except Exception:
+                pass
+        return rewards, lens
+
     def compute_single_action(self, obs, explore: bool = False, state=None):
         import jax.numpy as jnp
 

@@ -191,16 +191,17 @@ class WorkerSet:
                 replacement = self._replace_worker(i)
                 ray_tpu.get(replacement.set_weights.remote(weights), timeout=120)
 
-    def sample(self, steps_per_worker: int) -> List[SampleBatch]:
+    def sample(self, steps_per_worker: int, explore: bool = True) -> List[SampleBatch]:
         """Synchronous parallel sampling with fault tolerance: a worker that
         dies mid-round is replaced and the round proceeds without it
-        (reference: execution/rollout_ops.py:21 + actor_manager probe)."""
+        (reference: execution/rollout_ops.py:21 + actor_manager probe).
+        ``explore=False`` samples greedily (evaluation rollouts)."""
         refs: dict = {}
         results: List[SampleBatch] = []
         dead: list = []
         for i, w in zip(self._indices, self._workers):
             try:
-                refs[w.sample.remote(steps_per_worker)] = (i, w)
+                refs[w.sample.remote(steps_per_worker, explore)] = (i, w)
             except Exception:
                 logger.warning("rollout worker %d unreachable at submit; respawning", i)
                 dead.append((i, w))
