@@ -345,6 +345,29 @@ class Algorithm(Trainable):
     def _build_learner_group(self, cfg: AlgorithmConfig) -> LearnerGroup:
         raise NotImplementedError
 
+    def _gather_rollouts(self, train_batch_size: int, async_sampling: bool = False):
+        """Shared sampling front-end (IMPALA/APPO): sync parallel rounds, or
+        draining the background env-runners. May return [] in async mode
+        (nothing ready yet) — callers should skip the update for that
+        iteration."""
+        cfg = self._algo_config
+        if async_sampling:
+            if not self.workers.is_async:
+                self.workers.start_async(cfg.rollout_fragment_length)
+            batches = self.workers.sample_async(train_batch_size)
+            if not batches:
+                # Mass worker failure respawns runners WITHOUT weights; they
+                # idle until the next broadcast, which the empty-batch early
+                # return would skip — re-broadcast here or the trainer
+                # livelocks in async_waiting forever.
+                self.workers.sync_weights(self.get_policy_weights())
+            return batches
+        per_worker = max(
+            1,
+            train_batch_size // max(self.workers.num_workers, 1) // cfg.num_envs_per_worker,
+        )
+        return self.workers.sample(per_worker)
+
     def training_step(self) -> dict:
         raise NotImplementedError
 

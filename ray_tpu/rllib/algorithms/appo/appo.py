@@ -79,11 +79,15 @@ class APPOConfig(AlgorithmConfig):
         self.c_bar = 1.0
         self.num_sgd_iter = 2
         self.broadcast_interval = 1
+        # Background-thread actors (see IMPALAConfig.async_sampling): the
+        # v-trace + PPO-clip loss absorbs the added staleness.
+        self.async_sampling = False
 
     def training(self, *, clip_param: Optional[float] = None, vf_loss_coeff: Optional[float] = None,
                  entropy_coeff: Optional[float] = None, kl_coeff: Optional[float] = None,
                  rho_bar: Optional[float] = None, c_bar: Optional[float] = None,
                  num_sgd_iter: Optional[int] = None, broadcast_interval: Optional[int] = None,
+                 async_sampling: Optional[bool] = None,
                  **kwargs) -> "APPOConfig":
         super().training(**kwargs)
         for name, value in (
@@ -91,6 +95,7 @@ class APPOConfig(AlgorithmConfig):
             ("entropy_coeff", entropy_coeff), ("kl_coeff", kl_coeff),
             ("rho_bar", rho_bar), ("c_bar", c_bar),
             ("num_sgd_iter", num_sgd_iter), ("broadcast_interval", broadcast_interval),
+            ("async_sampling", async_sampling),
         ):
             if value is not None:
                 setattr(self, name, value)
@@ -115,10 +120,9 @@ class APPO(Algorithm):
 
     def training_step(self) -> dict:
         cfg: APPOConfig = self._algo_config
-        per_worker = max(
-            1, cfg.train_batch_size // max(self.workers.num_workers, 1) // cfg.num_envs_per_worker
-        )
-        batches = self.workers.sample(per_worker)
+        batches = self._gather_rollouts(cfg.train_batch_size, cfg.async_sampling)
+        if not batches:
+            return {"async_waiting": 1.0}
         batch = SampleBatch.concat_samples(batches)
         self._timesteps_total += batch.count
         loss_cfg = {

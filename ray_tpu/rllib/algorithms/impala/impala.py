@@ -81,11 +81,17 @@ class IMPALAConfig(AlgorithmConfig):
         # Broadcast weights every N iterations (staleness is what V-trace
         # corrects; >1 models the reference's async actors).
         self.broadcast_interval = 1
+        # True async actors (reference: AsyncSampler/EnvRunnerV2): workers
+        # keep stepping in a background thread while the learner updates;
+        # the learner drains whatever fragments are ready. V-trace absorbs
+        # the extra staleness this introduces.
+        self.async_sampling = False
 
     def training(self, *, vf_loss_coeff: Optional[float] = None,
                  entropy_coeff: Optional[float] = None, rho_bar: Optional[float] = None,
                  c_bar: Optional[float] = None, minibatch_size: Optional[int] = None,
                  num_sgd_iter: Optional[int] = None, broadcast_interval: Optional[int] = None,
+                 async_sampling: Optional[bool] = None,
                  **kwargs) -> "IMPALAConfig":
         super().training(**kwargs)
         for name, value in (
@@ -96,6 +102,7 @@ class IMPALAConfig(AlgorithmConfig):
             ("minibatch_size", minibatch_size),
             ("num_sgd_iter", num_sgd_iter),
             ("broadcast_interval", broadcast_interval),
+            ("async_sampling", async_sampling),
         ):
             if value is not None:
                 setattr(self, name, value)
@@ -120,10 +127,9 @@ class IMPALA(Algorithm):
 
     def training_step(self) -> dict:
         cfg: IMPALAConfig = self._algo_config
-        per_worker = max(
-            1, cfg.train_batch_size // max(self.workers.num_workers, 1) // cfg.num_envs_per_worker
-        )
-        batches = self.workers.sample(per_worker)
+        batches = self._gather_rollouts(cfg.train_batch_size, cfg.async_sampling)
+        if not batches:
+            return {"async_waiting": 1.0}
         batch = SampleBatch.concat_samples(batches)
         self._timesteps_total += batch.count
         loss_cfg = {

@@ -3,12 +3,19 @@
 Reference: rllib/evaluation/rollout_worker.py:166 (RolloutWorker, sample
 :666), worker_set.py:80 (WorkerSet), utils/actor_manager.py:189
 (FaultTolerantActorManager — lost workers are respawned and the round
-continues with the survivors).
+continues with the survivors). The async mode (start_async/get_async) is
+the analog of AsyncSampler/EnvRunnerV2 (rllib/evaluation/sampler.py:309,
+env_runner_v2.py:199): a background thread keeps stepping the vector env
+into a bounded fragment queue while the learner consumes and updates —
+V-trace/IS corrections in IMPALA/APPO absorb the policy staleness this
+introduces.
 """
 
 from __future__ import annotations
 
 import logging
+import queue as _queue
+import threading
 from typing import Callable, List, Optional
 
 import numpy as np
@@ -38,13 +45,35 @@ class RolloutWorker:
 
     def __init__(self, env_spec, spec, worker_index: int = 0, num_envs: int = 1,
                  env_config: Optional[dict] = None, gamma: float = 0.99,
-                 lambda_: float = 0.95, seed: int = 0, observation_filter: Optional[str] = None):
+                 lambda_: float = 0.95, seed: int = 0, observation_filter: Optional[str] = None,
+                 agent_connectors=None, clip_actions: bool = True):
         import jax
 
         jax.config.update("jax_platforms", "cpu")  # rollouts stay off-chip
         # make_vector_env flattens MultiAgentEnvs into per-agent slots
         # (shared-policy training, reference's default policy mapping).
         self.env = make_vector_env(env_spec, num_envs, env_config, worker_index, seed=seed + worker_index * 1000)
+        # Connector pipelines (reference: rllib/connectors/{agent,action}):
+        # agent connectors shape observations before the policy forward;
+        # action connectors shape sampled actions before env.step — Box
+        # spaces get automatic action clipping (the policy's gaussian sample
+        # is unbounded).
+        from ray_tpu.rllib.connectors import ClipActions, ConnectorPipeline
+
+        self.agent_connectors = ConnectorPipeline(list(agent_connectors or []))
+        action_stages = []
+        space = getattr(self.env, "action_space", None)
+        if clip_actions and space is not None and hasattr(space, "low"):
+            action_stages.append(ClipActions(space.low, space.high))
+        self.action_connectors = ConnectorPipeline(action_stages)
+        # Async env-runner state (started on demand by start_async).
+        self._async_thread: Optional[threading.Thread] = None
+        self._async_stop: Optional[threading.Event] = None
+        self._async_q: Optional[_queue.Queue] = None
+        # Guards the stateful obs filter: in async mode the runner thread
+        # updates it mid-sample while filter-sync RPCs (pop_filter_delta /
+        # set_filter_state) run on the actor main thread.
+        self._filter_lock = threading.Lock()
         # Slot multiplier (n_agents for multi-agent envs): sample() divides
         # requested steps by it so the row count an algorithm asked for via
         # train_batch_size stays agent-count-invariant.
@@ -76,6 +105,26 @@ class RolloutWorker:
         self._params = jax.tree_util.tree_map(jnp.asarray, weights)
         return True
 
+    def _shape_obs(self, obs: np.ndarray, explore: bool) -> np.ndarray:
+        """Observation pipeline: stateful filter (stats update only while
+        exploring), then the agent connectors (transform-only when not
+        exploring, so stateful connectors never learn from eval/bootstrap
+        observations)."""
+        if self.obs_filter is not None:
+            with self._filter_lock:
+                if explore:
+                    self._filter_delta(obs)  # stats only; result unused
+                    obs = self.obs_filter(obs)
+                else:
+                    obs = self.obs_filter.transform(obs)
+        if self.agent_connectors.connectors:
+            obs = (
+                self.agent_connectors(obs)
+                if explore
+                else self.agent_connectors.transform(obs)
+            )
+        return obs
+
     def sample(self, num_steps: int, explore: bool = True) -> SampleBatch:
         """Collect `num_steps` per sub-env; GAE over each env's fragment."""
         import jax
@@ -85,19 +134,22 @@ class RolloutWorker:
         n_envs = self.env.num_envs
         cols: dict = {k: [] for k in (OBS, ACTIONS, REWARDS, DONES, LOGPS, VF_PREDS, EPS_ID)}
         for _ in range(num_steps):
-            obs = self.env.current_obs().astype(np.float32)
-            if self.obs_filter is not None:
-                if explore:
-                    self._filter_delta(obs)  # stats only; result unused
-                    obs = self.obs_filter(obs)
-                else:
-                    obs = self.obs_filter.transform(obs)
+            obs = self._shape_obs(self.env.current_obs().astype(np.float32), explore)
             self._rng, key = jax.random.split(self._rng)
             actions, logp, value = self._sample_fn(self._params, obs, key, explore)
             actions_np = np.asarray(actions)
+            env_actions = (
+                self.action_connectors(actions_np)
+                if self.action_connectors.connectors
+                else actions_np
+            )
             cols[OBS].append(obs)
             cols[EPS_ID].append(self.env.eps_ids())
-            _, rewards, dones, _ = self.env.step(actions_np)
+            _, rewards, dones, _ = self.env.step(env_actions)
+            # The TRAINING batch keeps the raw sampled action: logp was
+            # computed for it, and training on the clipped action would
+            # bias the policy gradient at the clip boundary (reference
+            # clips only on the env side for the same reason).
             cols[ACTIONS].append(actions_np)
             cols[REWARDS].append(rewards)
             cols[DONES].append(dones)
@@ -105,9 +157,7 @@ class RolloutWorker:
             cols[VF_PREDS].append(np.asarray(value))
         # Bootstrap value for the final obs of each env.
         self._rng, key = jax.random.split(self._rng)
-        final_obs = self.env.current_obs().astype(np.float32)
-        if self.obs_filter is not None:
-            final_obs = self.obs_filter.transform(final_obs)
+        final_obs = self._shape_obs(self.env.current_obs().astype(np.float32), False)
         _, _, last_values = self._sample_fn(self._params, final_obs, key, False)
         last_values = np.asarray(last_values)
         # [T, N, ...] -> per-env fragments -> GAE -> concat.
@@ -118,6 +168,96 @@ class RolloutWorker:
             frags.append(frag)
         batch = SampleBatch.concat_samples(frags)
         return batch
+
+    # -- async env-runner (reference: AsyncSampler sampler.py:309 /
+    # EnvRunnerV2 env_runner_v2.py:199) ---------------------------------
+    def start_async(self, fragment_len: int, queue_size: int = 4) -> bool:
+        """Launch the background fragment producer: steps the vector env
+        continuously with the latest weights, queueing GAE-postprocessed
+        fragments. The bounded queue gives backpressure — when the learner
+        lags, the producer blocks instead of growing stale sample memory."""
+        if self._async_thread is not None:
+            if self._async_thread.is_alive():
+                return True
+            # Previous runner finished dying after a timed-out stop_async;
+            # safe to replace it now.
+            self._async_thread = None
+        q = _queue.Queue(maxsize=queue_size)
+        stop = threading.Event()
+        self._async_q = q
+        self._async_stop = stop
+        self._async_thread = threading.Thread(
+            target=self._async_loop, args=(fragment_len, q, stop), daemon=True,
+            name="env-runner",
+        )
+        self._async_thread.start()
+        return True
+
+    def _async_loop(self, fragment_len: int, q: "_queue.Queue", stop: threading.Event):
+        # q/stop are captured locals: stop_async may null the instance
+        # attributes while this thread is mid-fragment.
+        import time as _time
+
+        while not stop.is_set():
+            if self._params is None:
+                _time.sleep(0.02)
+                continue
+            try:
+                batch = self.sample(fragment_len, explore=True)
+            except Exception:
+                logger.exception("async env-runner sampling failed")
+                _time.sleep(0.5)
+                continue
+            rewards, lens = self.env.pop_episode_stats()
+            item = {"batch": batch, "episode_rewards": rewards, "episode_lens": lens}
+            # Blocking put = backpressure; wake periodically to honor stop.
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.25)
+                    break
+                except _queue.Full:
+                    continue
+
+    def get_async(self, max_items: int = 8, timeout: float = 10.0) -> list:
+        """Drain ready fragments (blocking for at least one, up to timeout).
+        Returns [] when the runner isn't started or nothing arrived."""
+        if self._async_q is None:
+            return []
+        items = []
+        try:
+            items.append(self._async_q.get(timeout=timeout))
+        except _queue.Empty:
+            return []
+        while len(items) < max_items:
+            try:
+                items.append(self._async_q.get_nowait())
+            except _queue.Empty:
+                break
+        return items
+
+    def async_queue_depth(self) -> int:
+        return -1 if self._async_q is None else self._async_q.qsize()
+
+    def stop_async(self) -> bool:
+        if self._async_thread is None:
+            return False
+        self._async_stop.set()
+        # Unblock a producer stuck on a full queue.
+        try:
+            while True:
+                self._async_q.get_nowait()
+        except _queue.Empty:
+            pass
+        self._async_thread.join(timeout=10)
+        if self._async_thread.is_alive():
+            # Mid-fragment on a slow env: leave the fields in place so
+            # start_async won't spawn a SECOND runner over the same env —
+            # the stop event is set, so this one exits after its fragment.
+            logger.warning("async env-runner still draining; restart deferred")
+            return False
+        self._async_thread = None
+        self._async_q = None
+        return True
 
     def episode_stats(self) -> dict:
         rewards, lens = self.env.pop_episode_stats()
@@ -132,13 +272,15 @@ class RolloutWorker:
             return None
         from ray_tpu.rllib.connectors import MeanStdFilter
 
-        state = self._filter_delta.get_state()
-        self._filter_delta = MeanStdFilter()
+        with self._filter_lock:
+            state = self._filter_delta.get_state()
+            self._filter_delta = MeanStdFilter()
         return state
 
     def set_filter_state(self, state) -> bool:
         if self.obs_filter is not None and state is not None:
-            self.obs_filter.set_state(state)
+            with self._filter_lock:
+                self.obs_filter.set_state(state)
         return True
 
     def ping(self) -> bool:
@@ -156,15 +298,20 @@ class WorkerSet:
     def __init__(self, env_spec, spec, *, num_workers: int, num_envs_per_worker: int = 1,
                  env_config: Optional[dict] = None, gamma: float = 0.99, lambda_: float = 0.95,
                  seed: int = 0, num_cpus_per_worker: float = 1,
-                 observation_filter: Optional[str] = None):
+                 observation_filter: Optional[str] = None, agent_connectors=None,
+                 clip_actions: bool = True):
         self.observation_filter = observation_filter
         self._filter_base = None  # merged filter history (driver-side)
         self._make_worker = lambda idx: ray_tpu.remote(num_cpus=num_cpus_per_worker)(RolloutWorker).remote(
             env_spec, spec, idx, num_envs_per_worker, env_config, gamma, lambda_, seed,
-            observation_filter
+            observation_filter, agent_connectors, clip_actions
         )
         self._workers = [self._make_worker(i + 1) for i in range(num_workers)]
         self._indices = list(range(1, num_workers + 1))
+        # Async env-runner mode (None = sync). Set by start_async; replaced
+        # workers are restarted into the same mode.
+        self._async_fragment_len: Optional[int] = None
+        self._pending_stats = {"episode_rewards": [], "episode_lens": []}
 
     @property
     def num_workers(self) -> int:
@@ -180,6 +327,13 @@ class WorkerSet:
         except Exception:
             pass
         self._workers[pos] = self._make_worker(self._indices[pos])
+        if self._async_fragment_len is not None:
+            # Restarted into async mode; its runner idles until the next
+            # weight broadcast delivers params.
+            try:
+                self._workers[pos].start_async.remote(self._async_fragment_len)
+            except Exception:
+                pass
         return self._workers[pos]
 
     def sync_weights(self, weights):
@@ -215,6 +369,63 @@ class WorkerSet:
             self._replace_worker(self._workers.index(w))
         return results
 
+    # -- async env-runner orchestration (reference: AsyncSampler) --------
+    @property
+    def is_async(self) -> bool:
+        return self._async_fragment_len is not None
+
+    def start_async(self, fragment_len: int):
+        """Flip every worker into continuous background sampling."""
+        self._async_fragment_len = fragment_len
+        refs = [w.start_async.remote(fragment_len) for w in self._workers]
+        for ref in refs:
+            try:
+                ray_tpu.get(ref, timeout=60)
+            except Exception:
+                pass  # dead worker surfaces at the next gather
+
+    def sample_async(self, min_steps: int, timeout: float = 60.0) -> List[SampleBatch]:
+        """Gather fragments from the background runners until ``min_steps``
+        rows arrive (or timeout). Episode stats ride with the fragments —
+        they are accumulated here and served by episode_stats(), because in
+        async mode the env belongs to the runner thread."""
+        import time as _time
+
+        assert self._async_fragment_len is not None, "start_async first"
+        batches: List[SampleBatch] = []
+        total = 0
+        deadline = _time.monotonic() + timeout
+        while total < min_steps and _time.monotonic() < deadline:
+            refs = {}
+            for i, w in enumerate(list(self._workers)):
+                try:
+                    refs[w.get_async.remote(timeout=5.0)] = i
+                except Exception:
+                    self._replace_worker(i)
+            for ref, i in refs.items():
+                try:
+                    items = ray_tpu.get(ref, timeout=120)
+                except Exception:
+                    logger.warning("async rollout worker %d failed; respawning", i)
+                    self._replace_worker(i)
+                    continue
+                for item in items:
+                    batches.append(item["batch"])
+                    total += len(item["batch"])
+                    self._pending_stats["episode_rewards"] += item["episode_rewards"]
+                    self._pending_stats["episode_lens"] += item["episode_lens"]
+        return batches
+
+    def stop_async(self):
+        if self._async_fragment_len is None:
+            return
+        self._async_fragment_len = None
+        for w in self._workers:
+            try:
+                w.stop_async.remote()
+            except Exception:
+                pass
+
     def sync_filters(self):
         """Merge per-worker filter DELTAS into the shared base and
         redistribute (reference: FilterManager.synchronize — deltas, not full
@@ -243,6 +454,14 @@ class WorkerSet:
                 pass
 
     def episode_stats(self) -> dict:
+        if self._async_fragment_len is not None:
+            # Async mode: the env belongs to the runner thread, so stats
+            # travel WITH the fragments and were accumulated by
+            # sample_async — polling the workers would race the runner.
+            stats, self._pending_stats = self._pending_stats, {
+                "episode_rewards": [], "episode_lens": [],
+            }
+            return stats
         stats = {"episode_rewards": [], "episode_lens": []}
         for ref in [w.episode_stats.remote() for w in self._workers]:
             try:
