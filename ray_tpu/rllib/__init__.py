@@ -29,4 +29,5 @@ from ray_tpu.rllib.algorithms.ars import ARS, ARSConfig  # noqa: F401,E402
 from ray_tpu.rllib.algorithms.crr import CRR, CRRConfig  # noqa: F401,E402
 from ray_tpu.rllib.algorithms.slateq import SlateQ, SlateQConfig  # noqa: F401,E402
 from ray_tpu.rllib.algorithms.alpha_zero import AlphaZero, AlphaZeroConfig  # noqa: F401,E402
+from ray_tpu.rllib.algorithms.dreamerv3 import DreamerV3, DreamerV3Config  # noqa: F401,E402
 from ray_tpu.rllib.env.external_env import ExternalEnv, ExternalEnvRunner  # noqa: F401,E402

@@ -1,0 +1,4 @@
+from ray_tpu.rllib.algorithms.dreamerv3.dreamerv3 import (  # noqa: F401
+    DreamerV3,
+    DreamerV3Config,
+)
