@@ -26,11 +26,20 @@ from ray_tpu.data.read_api import (  # noqa: F401
     read_datasource,
     read_images,
     read_json,
+    read_mongo,
     read_numpy,
     read_parquet,
     read_sql,
     read_text,
     read_tfrecords,
+    read_webdataset,
+)
+from ray_tpu.data.datasource.partitioning import (  # noqa: F401
+    DefaultFileMetadataProvider,
+    FastFileMetadataProvider,
+    FileMetadataProvider,
+    ParquetMetadataProvider,
+    Partitioning,
 )
 
 __all__ = [

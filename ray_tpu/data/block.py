@@ -75,11 +75,16 @@ class BlockAccessor:
         if isinstance(batch, dict):
             cols, fields, shapes = [], [], {}
             for name, values in batch.items():
-                if isinstance(values, np.ndarray) and values.ndim > 1:
+                if isinstance(values, np.ndarray) and values.ndim > 1 and values.shape[1:].count(0) == 0:
                     flat = values.reshape(len(values), -1)
                     inner = pa.array(flat.ravel())
                     arr = pa.FixedSizeListArray.from_arrays(inner, flat.shape[1])
                     shapes[name] = values.shape[1:]
+                elif isinstance(values, np.ndarray) and values.ndim > 1:
+                    # Zero-width tensor column (e.g. a block of all-empty
+                    # lists): FixedSizeListArray rejects size 0 — store as
+                    # variable-length lists instead.
+                    arr = pa.array([list(row) for row in values])
                 else:
                     arr = pa.array(np.asarray(values) if isinstance(values, (list, tuple)) else values)
                 cols.append(arr)

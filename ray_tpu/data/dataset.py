@@ -369,7 +369,12 @@ class Dataset:
         out = shuffle_mod.hash_aggregate(bundles, None, list(aggs))
         rows = list(BlockAccessor.for_block(ray_tpu.get(out[0][0])).iter_rows())
         row = rows[0] if rows else {}
-        return {k: (v.item() if hasattr(v, "item") else v) for k, v in row.items()}
+        # Unwrap numpy SCALARS only — an aggregate may legitimately return
+        # an array/list (e.g. a reservoir sample), where .item() throws.
+        return {
+            k: (v.item() if hasattr(v, "item") and getattr(v, "size", 1) == 1 else v)
+            for k, v in row.items()
+        }
 
     def sum(self, on: str):
         return self.aggregate(agg_mod.Sum(on))[f"sum({on})"]
@@ -486,6 +491,22 @@ class Dataset:
             pq.write_table(block, fname)
 
         return self._write(path, write_one, "parquet")
+
+    def write_webdataset(self, path: str):
+        """One .tar shard per block; rows become key-prefixed files decoded
+        back by read_webdataset (reference: write_webdataset)."""
+        def write_one(block, fname):
+            import tarfile
+
+            from ray_tpu.data.block import BlockAccessor
+            from ray_tpu.data.datasource.webdataset_datasource import write_sample
+
+            with tarfile.open(fname, "w") as tf:
+                for n, row in enumerate(BlockAccessor.for_block(block).iter_rows()):
+                    key = str(row.get("__key__", f"{n:08d}"))
+                    write_sample(tf, key, row)
+
+        return self._write(path, write_one, "tar")
 
     def write_csv(self, path: str):
         def write_one(block, fname):

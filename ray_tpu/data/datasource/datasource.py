@@ -78,20 +78,83 @@ def _expand_paths(paths, suffixes=None) -> list:
     return out
 
 
+def _attach_partition_cols(block, fields: dict):
+    """Append constant partition columns to one block (arrow / dict /
+    pandas), skipping names the data already carries."""
+    if not fields:
+        return block
+    try:
+        import pyarrow as pa
+
+        if isinstance(block, pa.Table):
+            for k, v in fields.items():
+                if k not in block.column_names:
+                    block = block.append_column(k, pa.array([v] * block.num_rows))
+            return block
+    except ImportError:
+        pass
+    try:
+        import pandas as pd
+
+        if isinstance(block, pd.DataFrame):
+            for k, v in fields.items():
+                if k not in block.columns:
+                    block = block.assign(**{k: v})
+            return block
+    except ImportError:
+        pass
+    if isinstance(block, dict):
+        n = len(next(iter(block.values()))) if block else 0
+        out = dict(block)
+        for k, v in fields.items():
+            if k not in out:
+                out[k] = np.full(n, v)
+        return out
+    return block
+
+
 class FileBasedDatasource(Datasource):
     _suffixes: Optional[list] = None
 
-    def __init__(self, paths, **reader_args):
-        self._paths = _expand_paths(paths, self._suffixes)
+    def __init__(self, paths, partitioning=None, partition_filter=None,
+                 meta_provider=None, **reader_args):
+        """``partitioning``: a Partitioning describing how fields encode in
+        paths — parsed values become extra columns on every block.
+        ``partition_filter``: dict -> bool predicate; files whose partition
+        fields fail it are PRUNED before any byte is read (reference:
+        partitioning.py PathPartitionFilter). ``meta_provider``: a
+        FileMetadataProvider supplying size/row metadata without reading
+        data (reference: file_meta_provider.py:20)."""
+        all_paths = _expand_paths(paths, self._suffixes)
+        self._partitions: dict = {}
+        if partitioning is not None:
+            self._partitions = {p: partitioning.parse(p) for p in all_paths}
+            if partition_filter is not None:
+                all_paths = [p for p in all_paths if partition_filter(self._partitions[p])]
+                if not all_paths:
+                    raise ValueError("partition_filter pruned every input file")
+        elif partition_filter is not None:
+            raise ValueError("partition_filter requires partitioning=")
+        self._paths = all_paths
+        if meta_provider is None:
+            from ray_tpu.data.datasource.partitioning import DefaultFileMetadataProvider
+
+            meta_provider = DefaultFileMetadataProvider()
+        self._meta_provider = meta_provider
         self._reader_args = reader_args
 
     def _read_file(self, path: str, **kwargs):
         raise NotImplementedError
 
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        size = self._meta_provider.get_metadata(self._paths).size_bytes
+        return None if size is None or size < 0 else size
+
     def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
         groups = np.array_split(np.arange(len(self._paths)), max(1, min(parallelism, len(self._paths))))
         read_file = self._read_file
         args = self._reader_args
+        partitions = self._partitions
         tasks = []
         for g in groups:
             if len(g) == 0:
@@ -100,9 +163,11 @@ class FileBasedDatasource(Datasource):
 
             def read(files=files):
                 for f in files:
-                    yield from read_file(f, **args)
+                    fields = partitions.get(f)
+                    for block in read_file(f, **args):
+                        yield _attach_partition_cols(block, fields)
 
-            tasks.append(ReadTask(read, BlockMetadata(num_rows=-1, size_bytes=sum(os.path.getsize(f) for f in files), input_files=files)))
+            tasks.append(ReadTask(read, self._meta_provider.get_metadata(files)))
         return tasks
 
 

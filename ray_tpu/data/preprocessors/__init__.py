@@ -241,3 +241,22 @@ class Chain(Preprocessor):
     def _check_fitted(self):
         for p in self.preprocessors:
             p._check_fitted()
+
+
+# Extended families (discretizers, hashers, vectorizers, tokenizer, extra
+# scalers/encoders, row normalizer, power transform) live in their own
+# module; imported last so they can use this module's helpers.
+from ray_tpu.data.preprocessors.extended import (  # noqa: E402,F401
+    CountVectorizer,
+    CustomKBinsDiscretizer,
+    FeatureHasher,
+    HashingVectorizer,
+    MaxAbsScaler,
+    MultiHotEncoder,
+    Normalizer,
+    OrdinalEncoder,
+    PowerTransformer,
+    RobustScaler,
+    Tokenizer,
+    UniformKBinsDiscretizer,
+)

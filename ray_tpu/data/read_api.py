@@ -124,6 +124,12 @@ def from_arrow(tables) -> Dataset:
 
 
 def read_parquet(paths, *, columns: Optional[list] = None, parallelism: int = -1, **kwargs) -> Dataset:
+    if "meta_provider" not in kwargs:
+        from ray_tpu.data.datasource.partitioning import ParquetMetadataProvider
+
+        # Footer-only row counts/sizes: exact progress + memory accounting
+        # without reading data pages.
+        kwargs["meta_provider"] = ParquetMetadataProvider()
     return read_datasource(ParquetDatasource(paths, columns=columns, **kwargs), parallelism=parallelism)
 
 
@@ -153,3 +159,24 @@ def read_images(paths, *, size: Optional[tuple] = None, mode: str = "RGB", inclu
 
 def read_tfrecords(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
     return read_datasource(TFRecordsDatasource(paths, **kwargs), parallelism=parallelism)
+
+
+def read_webdataset(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
+    """Read WebDataset .tar shards (reference: read_webdataset): samples
+    are key-prefixed file groups inside each shard, decoded by extension."""
+    from ray_tpu.data.datasource.webdataset_datasource import WebDatasetDatasource
+
+    return read_datasource(WebDatasetDatasource(paths, **kwargs), parallelism=parallelism)
+
+
+def read_mongo(uri: str, database: str, collection: str, *, pipeline=None,
+               parallelism: int = -1, **kwargs) -> Dataset:
+    """Read a MongoDB collection, range-partitioned into parallel tasks
+    (reference: read_mongo; requires pymongo unless a collection_factory
+    is injected)."""
+    from ray_tpu.data.datasource.mongo_datasource import MongoDatasource
+
+    return read_datasource(
+        MongoDatasource(uri, database, collection, pipeline=pipeline, **kwargs),
+        parallelism=parallelism,
+    )

@@ -1,0 +1,153 @@
+"""Partitioned reads, file-metadata providers, webdataset + mongo sources.
+
+Reference: python/ray/data/datasource/partitioning.py:34 (Partitioning),
+file_meta_provider.py:20 (FileMetadataProvider), webdataset_datasource.py,
+mongo_datasource.py.
+"""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rd
+from ray_tpu.data import Partitioning
+
+
+@pytest.fixture(scope="module")
+def ray_cluster():
+    ray_tpu.init(num_cpus=6, object_store_memory=256 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+def _write_hive_tree(base):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rows = 0
+    for year in (2023, 2024):
+        for country in ("fr", "de"):
+            d = os.path.join(base, f"year={year}", f"country={country}")
+            os.makedirs(d)
+            n = 5 if year == 2023 else 3
+            pq.write_table(
+                pa.table({"x": list(range(n))}), os.path.join(d, "part-0.parquet")
+            )
+            rows += n
+    return rows
+
+
+def test_hive_partitioning_adds_columns(ray_cluster, tmp_path):
+    base = str(tmp_path / "tree")
+    total = _write_hive_tree(base)
+    part = Partitioning("hive", base_dir=base, field_types={"year": int})
+    ds = rd.read_parquet(base, partitioning=part)
+    df = ds.to_pandas()
+    assert len(df) == total
+    assert set(df.columns) >= {"x", "year", "country"}
+    assert set(df["year"].unique()) == {2023, 2024}  # cast by field_types
+    assert set(df["country"].unique()) == {"fr", "de"}
+    assert len(df[df["year"] == 2023]) == 10
+
+
+def test_partition_filter_prunes_before_read(ray_cluster, tmp_path):
+    base = str(tmp_path / "tree")
+    _write_hive_tree(base)
+    part = Partitioning("hive", base_dir=base)
+    ds = rd.read_parquet(
+        base, partitioning=part,
+        partition_filter=lambda f: f["year"] == "2024" and f["country"] == "fr",
+    )
+    df = ds.to_pandas()
+    assert len(df) == 3
+    assert set(df["country"].unique()) == {"fr"}
+    # Pruning everything is an explicit error, not an empty dataset.
+    with pytest.raises(ValueError):
+        rd.read_parquet(base, partitioning=part, partition_filter=lambda f: False)
+
+
+def test_dir_partitioning(ray_cluster, tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    base = str(tmp_path / "dirtree")
+    for split in ("train", "test"):
+        d = os.path.join(base, split, "v1")
+        os.makedirs(d)
+        pq.write_table(pa.table({"x": [1, 2]}), os.path.join(d, "f.parquet"))
+    part = Partitioning("dir", base_dir=base, field_names=["split", "version"])
+    df = rd.read_parquet(base, partitioning=part).to_pandas()
+    assert set(df["split"].unique()) == {"train", "test"}
+    assert set(df["version"].unique()) == {"v1"}
+
+
+def test_parquet_metadata_provider_exact_rows(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from ray_tpu.data import DefaultFileMetadataProvider, ParquetMetadataProvider
+
+    f = str(tmp_path / "f.parquet")
+    pq.write_table(pa.table({"x": list(range(42))}), f)
+    meta = ParquetMetadataProvider().get_metadata([f])
+    assert meta.num_rows == 42  # footer-only, no data pages read
+    assert meta.size_bytes > 0
+    d = DefaultFileMetadataProvider().get_metadata([f])
+    assert d.num_rows == -1 and d.size_bytes == os.path.getsize(f)
+
+
+def test_webdataset_roundtrip(ray_cluster, tmp_path):
+    out = str(tmp_path / "shards")
+    items = [
+        {"__key__": f"sample{i:04d}", "txt": f"hello {i}", "cls": i % 3,
+         "meta": {"idx": i}}
+        for i in range(20)
+    ]
+    ds = rd.from_items(items, parallelism=2)
+    files = ds.write_webdataset(out)
+    assert files and all(f.endswith(".tar") for f in files)
+    back = rd.read_webdataset(out).to_pandas().sort_values("__key__").reset_index(drop=True)
+    assert len(back) == 20
+    assert back.loc[5, "txt"] == "hello 5"
+    assert int(back.loc[5, "cls"]) == 5 % 3
+    assert back.loc[5, "meta"]["idx"] == 5
+
+
+def test_mongo_datasource_partitions_with_injected_client(ray_cluster):
+    docs = [{"_id": i, "v": i * i} for i in range(30)]
+
+    class FakeCollection:
+        def count_documents(self, q):
+            return len(docs)
+
+        def aggregate(self, stages):
+            out = list(docs)
+            for st in stages:
+                if "$sort" in st:
+                    for key, direction in reversed(list(st["$sort"].items())):
+                        out = sorted(out, key=lambda d: d[key], reverse=direction < 0)
+                elif "$skip" in st:
+                    out = out[st["$skip"]:]
+                elif "$limit" in st:
+                    out = out[: st["$limit"]]
+                elif "$match" in st:
+                    kv = st["$match"]
+                    out = [d for d in out if all(d.get(k) == v for k, v in kv.items())]
+            return iter(out)
+
+    ds = rd.read_mongo(
+        "mongodb://unused", "db", "coll",
+        collection_factory=FakeCollection, parallelism=4,
+    )
+    df = ds.to_pandas()
+    assert len(df) == 30
+    assert sorted(df["v"]) == [i * i for i in range(30)]
+    assert "_id" not in df.columns
+
+
+def test_mongo_requires_pymongo_without_factory(ray_cluster):
+    with pytest.raises(ImportError):
+        rd.read_mongo("mongodb://x", "db", "coll")

@@ -106,3 +106,94 @@ def test_jax_predictor_and_batch_predictor(ray_cluster):
     scored = bp.predict(ds, batch_size=4, max_scoring_workers=2)
     preds = [float(np.ravel(r["predictions"])[0]) for r in scored.take_all()]
     assert preds == [5.0 * i for i in range(8)]
+
+
+def test_extended_scalers_and_discretizers(ray_cluster):
+    from ray_tpu.data.preprocessors import (
+        CustomKBinsDiscretizer,
+        MaxAbsScaler,
+        RobustScaler,
+        UniformKBinsDiscretizer,
+    )
+
+    rows = [{"x": float(i)} for i in range(100)]
+    ds = _ds(rows)
+    out = MaxAbsScaler(["x"]).fit_transform(ds).to_pandas()
+    assert abs(out["x"].max() - 1.0) < 1e-9
+
+    out = RobustScaler(["x"]).fit_transform(ds).to_pandas()
+    # median maps to ~0, IQR to ~1 (reservoir covers all 100 values).
+    assert abs(np.median(out["x"])) < 0.1
+    assert 0.8 < (np.quantile(out["x"], 0.75) - np.quantile(out["x"], 0.25)) < 1.2
+
+    out = UniformKBinsDiscretizer(["x"], bins=4).fit_transform(ds).to_pandas()
+    assert set(out["x"].unique()) == {0, 1, 2, 3}
+    assert out["x"].iloc[0] == 0 and out["x"].iloc[99] == 3
+
+    out = CustomKBinsDiscretizer(["x"], bin_edges=[25.0, 50.0]).transform(ds).to_pandas()
+    assert set(out["x"].unique()) == {0, 1, 2}
+
+
+def test_normalizer_and_power_transform(ray_cluster):
+    from ray_tpu.data.preprocessors import Normalizer, PowerTransformer
+
+    ds = _ds([{"a": 3.0, "b": 4.0}, {"a": 0.0, "b": 0.0}])
+    out = Normalizer(["a", "b"], norm="l2").transform(ds).to_pandas()
+    assert abs(out.loc[0, "a"] - 0.6) < 1e-9 and abs(out.loc[0, "b"] - 0.8) < 1e-9
+    assert out.loc[1, "a"] == 0.0  # zero-norm row passes through
+
+    ds = _ds([{"x": 3.0}])
+    out = PowerTransformer(["x"], power=0.0, method="box-cox").transform(ds).to_pandas()
+    assert abs(out.loc[0, "x"] - np.log(3.0)) < 1e-9
+    out = PowerTransformer(["x"], power=1.0, method="yeo-johnson").transform(ds).to_pandas()
+    assert abs(out.loc[0, "x"] - 3.0) < 1e-9
+
+
+def test_ordinal_and_multihot_encoders(ray_cluster):
+    from ray_tpu.data.preprocessors import MultiHotEncoder, OrdinalEncoder
+
+    ds = _ds([{"c": "red"}, {"c": "blue"}, {"c": "red"}])
+    enc = OrdinalEncoder(["c"])
+    out = enc.fit_transform(ds).to_pandas()
+    assert list(out["c"]) == [1, 0, 1]  # sorted categories: blue=0, red=1
+    # Unseen value: the ValueError surfaces wrapped by the remote map task.
+    from ray_tpu.exceptions import TaskError
+
+    with pytest.raises((ValueError, TaskError), match="unseen value"):
+        enc.transform(_ds([{"c": "green"}])).to_pandas()
+
+    ds = _ds([{"tags": ["a", "b"]}, {"tags": ["b"]}, {"tags": []}])
+    out = MultiHotEncoder(["tags"]).fit_transform(ds).to_pandas()
+    mat = np.stack(out["tags"].to_numpy())
+    np.testing.assert_array_equal(mat, [[1, 1], [0, 1], [0, 0]])
+
+
+def test_tokenizer_and_vectorizers(ray_cluster):
+    from ray_tpu.data.preprocessors import (
+        CountVectorizer,
+        FeatureHasher,
+        HashingVectorizer,
+        Tokenizer,
+    )
+
+    ds = _ds([{"t": "The cat and the hat"}, {"t": "a cat"}])
+    out = Tokenizer(["t"]).transform(ds).to_pandas()
+    assert list(out["t"].iloc[0]) == ["the", "cat", "and", "the", "hat"]
+
+    out = CountVectorizer(["t"], max_features=3).fit_transform(ds).to_pandas()
+    # top-3 by frequency: the(2), cat(2), then tie broken alphabetically -> a or and
+    assert out["t_cat"].tolist() == [1, 1]
+    assert out["t_the"].tolist() == [2, 0]
+    assert "t" not in out.columns
+
+    out = HashingVectorizer(["t"], num_features=8).transform(ds).to_pandas()
+    hashed_cols = [c for c in out.columns if c.startswith("t_hash_")]
+    assert len(hashed_cols) == 8
+    assert out[hashed_cols].to_numpy().sum() == 7  # 5 + 2 tokens total
+
+    ds = _ds([{"u": "x", "v": 1}, {"u": "y", "v": 1}])
+    out = FeatureHasher(["u", "v"], num_features=16).transform(ds).to_pandas()
+    mat = np.stack(out["hashed_features"].to_numpy())
+    assert mat.shape == (2, 16) and mat.sum() == 4  # 2 features per row
+    # Same (col, value) pair lands in the same bucket across rows.
+    assert (mat[0] != mat[1]).any()
