@@ -41,8 +41,12 @@ class _TrialActor:
     """Actor hosting one Trainable instance (reference: the trainable-as-actor
     pattern, ray_trial_executor.py:382 _setup_remote_runner)."""
 
-    def __init__(self, trainable_cls, config: dict, checkpoint=None):
+    def __init__(self, trainable_cls, config: dict, checkpoint=None, trial_resources: dict | None = None):
         self._trainable: Trainable = trainable_cls(config)
+        # Current trial resources (reference: Trainable.trial_resources) —
+        # updated on every (re)start so ResourceChangingScheduler resizes
+        # are visible to the training code.
+        self._trainable._trial_resources = dict(trial_resources or {})
         if checkpoint is not None:
             self._trainable.restore(checkpoint)
 
@@ -126,8 +130,14 @@ class TuneController:
 
     # -- trial lifecycle ----------------------------------------------------
 
-    def _actor_options(self) -> dict:
-        res = dict(self.resources_per_trial)
+    def _actor_options(self, trial: Trial | None = None) -> dict:
+        # Per-trial override (ResourceChangingScheduler) wins over the
+        # experiment-wide default.
+        res = dict(
+            trial.resources
+            if trial is not None and trial.resources
+            else self.resources_per_trial
+        )
         opts: dict = {}
         ncpu = res.pop("CPU", None)
         ntpu = res.pop("TPU", None)
@@ -144,8 +154,12 @@ class TuneController:
             trial.config = config
         cls = ray_tpu.remote(_TrialActor)
         trial.runner = cls.options(
-            max_restarts=0, **self._actor_options()
-        ).remote(self.trainable_cls, trial.config, checkpoint if checkpoint is not None else trial.checkpoint)
+            max_restarts=0, **self._actor_options(trial)
+        ).remote(
+            self.trainable_cls, trial.config,
+            checkpoint if checkpoint is not None else trial.checkpoint,
+            trial.resources or self.resources_per_trial,
+        )
         trial.status = RUNNING
         trial.start_time = time.time()
         trial.pending_future = trial.runner.train.remote()

@@ -30,6 +30,9 @@ class Trial:
     num_failures: int = 0
     checkpoint: Any = None  # latest air.Checkpoint
     start_time: float = 0.0
+    # Per-trial resource override (ResourceChangingScheduler); None means
+    # the experiment-wide resources_per_trial applies.
+    resources: dict | None = None
     # runtime handles (not persisted)
     runner: Any = None  # trial actor handle
     pending_future: Any = None  # in-flight train() ObjectRef

@@ -10,5 +10,13 @@ from ray_tpu.tune.schedulers.hyperband import HyperBandScheduler  # noqa: F401
 from ray_tpu.tune.schedulers.median_stopping import MedianStoppingRule  # noqa: F401
 from ray_tpu.tune.schedulers.pb2 import PB2  # noqa: F401
 from ray_tpu.tune.schedulers.pbt import PopulationBasedTraining  # noqa: F401
+from ray_tpu.tune.schedulers.resource_changing import (  # noqa: F401
+    DistributeResources,
+    ResourceChangingScheduler,
+)
 
 AsyncHyperBandScheduler = ASHAScheduler
+# BOHB pairs the TuneBOHB searcher with synchronous HyperBand rungs
+# (reference: hb_bohb.py) — our sync HyperBand already pauses at
+# milestones, which is the behavior HyperBandForBOHB adds there.
+HyperBandForBOHB = HyperBandScheduler

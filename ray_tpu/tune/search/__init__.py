@@ -13,8 +13,8 @@ from ray_tpu.tune.search.gated import (  # noqa: F401
     OptunaSearch,
     SigOptSearch,
     SkOptSearch,
-    TuneBOHB,
     ZOOptSearch,
 )
+from ray_tpu.tune.search.bohb import TuneBOHB  # noqa: F401
 from ray_tpu.tune.search.hyperopt_like import HyperOptLikeSearch  # noqa: F401
 from ray_tpu.tune.search.repeater import Repeater  # noqa: F401

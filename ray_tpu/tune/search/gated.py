@@ -30,7 +30,6 @@ def _gated(name: str, package: str):
 OptunaSearch = _gated("OptunaSearch", "optuna")
 HyperOptSearch = _gated("HyperOptSearch", "hyperopt")
 AxSearch = _gated("AxSearch", "ax-platform")
-TuneBOHB = _gated("TuneBOHB", "hpbandster")
 DragonflySearch = _gated("DragonflySearch", "dragonfly-opt")
 NevergradSearch = _gated("NevergradSearch", "nevergrad")
 SigOptSearch = _gated("SigOptSearch", "sigopt")

@@ -80,7 +80,16 @@ class Trainable:
         self.config = config or {}
         self.iteration = 0
         self._start = time.time()
+        self._trial_resources: dict = {}
         self.setup(self.config)
+
+    @property
+    def trial_resources(self) -> dict:
+        """Resources currently allocated to this trial (reference:
+        Trainable.trial_resources). Updated by the controller on every
+        actor (re)start, so a ResourceChangingScheduler resize is visible
+        from step() after the restart — read it there, not in setup()."""
+        return self._trial_resources
 
     # -- subclass surface ---------------------------------------------------
     def setup(self, config: dict) -> None:

@@ -1,0 +1,152 @@
+"""TuneBOHB (KDE density-ratio searcher) + ResourceChangingScheduler.
+
+Reference: python/ray/tune/search/bohb/ (TuneBOHB), schedulers/hb_bohb.py,
+schedulers/resource_changing_scheduler.py:590.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+
+
+@pytest.fixture(scope="module")
+def ray_start_regular():
+    ray_tpu.init(num_cpus=6, object_store_memory=128 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+def _objective(config):
+    # Deterministic bowl with optimum at (2, 3); best value 0.
+    score = -((config["x"] - 2.0) ** 2) - ((config["y"] - 3.0) ** 2)
+    tune.report({"score": score})
+
+
+SPACE = {"x": tune.uniform(0.0, 6.0), "y": tune.uniform(0.0, 6.0)}
+
+
+def _best_with(search_alg, num_samples):
+    results = tune.Tuner(
+        _objective,
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", num_samples=num_samples,
+            search_alg=search_alg, max_concurrent_trials=1,
+        ),
+    ).fit()
+    return results.get_best_result("score", "max").metrics["score"]
+
+
+def test_bohb_beats_random_search(ray_start_regular):
+    from ray_tpu.tune.search import TuneBOHB
+    from ray_tpu.tune.search.basic_variant import BasicVariantGenerator
+
+    budget = 24
+    bohb_best = _best_with(
+        TuneBOHB(dict(SPACE), metric="score", mode="max", min_points=6,
+                 random_fraction=0.15, seed=0),
+        budget,
+    )
+    random_best = _best_with(BasicVariantGenerator(dict(SPACE), seed=0), budget)
+    # Same seeded budget on a deterministic objective: the model must home
+    # in on the bowl while random stays scattershot.
+    assert bohb_best >= random_best, (bohb_best, random_best)
+    assert bohb_best > -1.0, f"BOHB best {bohb_best} is far from the optimum"
+
+
+def test_bohb_model_prefers_good_region():
+    from ray_tpu.tune.search import TuneBOHB
+
+    searcher = TuneBOHB(
+        {"x": tune.uniform(0.0, 1.0)}, metric="score", mode="max",
+        min_points=6, random_fraction=0.0, seed=1,
+    )
+    # Seed observations: high scores cluster at x ~ 0.8 (recorded through
+    # on_trial_result — the budget-tagged observation path).
+    for i in range(20):
+        tid = f"seed{i}"
+        x = 0.8 + 0.02 * (i % 3) if i % 2 == 0 else 0.15 + 0.02 * (i % 5)
+        searcher._live[tid] = [x]
+        searcher.on_trial_result(
+            tid, {"score": -abs(x - 0.8) * 10, "training_iteration": 1}
+        )
+        searcher.on_trial_complete(tid)
+    picks = [searcher.suggest(f"t{i}")["x"] for i in range(8)]
+    # The density-ratio acquisition concentrates suggestions near the mode.
+    assert np.mean([0.6 <= p <= 1.0 for p in picks]) >= 0.75, picks
+
+
+def test_bohb_uses_largest_budget_with_data():
+    from ray_tpu.tune.search import TuneBOHB
+
+    searcher = TuneBOHB({"x": tune.uniform(0.0, 1.0)}, metric="score",
+                        mode="max", min_points=3)
+    for i in range(6):
+        searcher._live[f"a{i}"] = [i / 10]
+        searcher.on_trial_result(f"a{i}", {"score": 1.0, "training_iteration": 1})
+    for i in range(3):
+        searcher._live[f"b{i}"] = [i / 10]
+        searcher.on_trial_result(f"b{i}", {"score": 1.0, "training_iteration": 4})
+    assert searcher._model_budget() == 4  # highest fidelity with >= min_points
+    for i in range(2):
+        searcher._live[f"c{i}"] = [i / 10]
+        searcher.on_trial_result(f"c{i}", {"score": 1.0, "training_iteration": 9})
+    assert searcher._model_budget() == 4  # budget 9 has too few points
+
+
+def test_hyperband_for_bohb_alias():
+    from ray_tpu.tune.schedulers import HyperBandForBOHB, HyperBandScheduler
+
+    assert issubclass(HyperBandForBOHB, HyperBandScheduler) or (
+        HyperBandForBOHB is HyperBandScheduler
+    )
+
+
+class _ResourceReporter(tune.Trainable):
+    def setup(self, config):
+        self.steps_done = 0
+
+    def step(self):
+        self.steps_done += 1
+        return {
+            "score": float(self.iteration),
+            "cpus": self.trial_resources.get("CPU", 0),
+            "steps_in_this_actor": self.steps_done,
+        }
+
+    def save_checkpoint(self):
+        from ray_tpu.air.checkpoint import Checkpoint
+
+        return Checkpoint.from_dict({"steps": self.steps_done})
+
+    def load_checkpoint(self, checkpoint):
+        self.steps_done = checkpoint.to_dict()["steps"]
+
+
+def test_resource_changing_scheduler_resizes_running_trial(ray_start_regular):
+    from ray_tpu.tune.schedulers import ResourceChangingScheduler
+
+    def grow_after_two(controller, trial, result, scheduler):
+        if result.get("training_iteration", 0) >= 2:
+            return {"CPU": 2}
+        return None
+
+    scheduler = ResourceChangingScheduler(resources_allocation_function=grow_after_two)
+    results = tune.Tuner(
+        _ResourceReporter,
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", num_samples=1,
+            scheduler=scheduler, max_concurrent_trials=1,
+        ),
+        run_config=tune.RunConfig(stop={"training_iteration": 6}),
+    ).fit()
+    r = results.get_best_result("score", "max")
+    # The trial started on the 1-CPU default and finished on 2 CPUs after
+    # the mid-run pause/restart.
+    assert r.metrics["cpus"] == 2, r.metrics
+    assert r.metrics["training_iteration"] >= 6
+    # The checkpoint carried progress across the resize: the replacement
+    # actor continued from the saved step count instead of redoing work.
+    assert r.metrics["steps_in_this_actor"] >= 6
+    assert scheduler.reallocated  # exactly the resize we requested

@@ -146,8 +146,10 @@ def test_repeater_averages_noisy_trials(ray_start_regular):
 
 
 def test_gated_searchers_raise_with_guidance():
-    from ray_tpu.tune.search import AxSearch, OptunaSearch, TuneBOHB
+    # TuneBOHB is no longer gated — it has a self-contained KDE
+    # implementation (see test_tune_bohb_rcs.py).
+    from ray_tpu.tune.search import AxSearch, OptunaSearch
 
-    for cls, pkg in ((OptunaSearch, "optuna"), (AxSearch, "ax-platform"), (TuneBOHB, "hpbandster")):
+    for cls, pkg in ((OptunaSearch, "optuna"), (AxSearch, "ax-platform")):
         with pytest.raises(ImportError, match=pkg):
             cls()
