@@ -30,6 +30,7 @@ from ray_tpu.serve.api import StreamingResponse  # noqa: F401
 __all__ = [
     "Application",
     "AutoscalingConfig",
+    "DAGDriver",
     "Deployment",
     "DeploymentConfig",
     "DeploymentHandle",
@@ -47,3 +48,5 @@ __all__ = [
     "status",
     "StreamingResponse",
 ]
+from ray_tpu.serve.drivers import DAGDriver  # noqa: F401,E402
+from ray_tpu.serve import http_adapters  # noqa: F401,E402

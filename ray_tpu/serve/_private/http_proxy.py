@@ -58,7 +58,7 @@ class HTTPProxy:
                         for name, e in self._router._table.items()
                     }
                 return web.json_response(routes)
-            deployment = self._router.route_for_prefix(path)
+            deployment, matched_prefix = self._router.route_and_prefix_for(path)
             if deployment is None:
                 return web.Response(status=404, text=f"no deployment for path {path}")
             body = await request.read()
@@ -79,7 +79,8 @@ class HTTPProxy:
                 try:
                     actor = self._router.handle_for(replica)
                     ref = actor.handle_http_request.remote(
-                        method, path, query, body, headers, model_id
+                        method, path, query, body, headers, model_id,
+                        matched_prefix,
                     )
                     result = ray_tpu.get(ref, timeout=120)
                 except BaseException:

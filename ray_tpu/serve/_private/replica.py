@@ -166,11 +166,16 @@ class Replica:
         body: bytes,
         headers: dict,
         multiplexed_model_id: str = "",
+        route_prefix: str | None = None,
     ):
         """HTTP entry: the callable gets a lightweight Request object. The
         proxy passes the multiplexed model id it already extracted for
-        routing — one extraction, no divergence."""
-        request = HTTPRequest(method=method, path=path, query=query, body=body, headers=headers)
+        routing — one extraction, no divergence — and the matched route
+        prefix so sub-route dispatch (DAGDriver) works under any mount."""
+        request = HTTPRequest(
+            method=method, path=path, query=query, body=body, headers=headers,
+            route_prefix=route_prefix,
+        )
         result = self.handle_request(
             "__call__", (request,), {}, multiplexed_model_id=multiplexed_model_id
         )
@@ -285,12 +290,24 @@ class HTTPRequest:
     """Minimal request object handed to deployments from the proxy
     (stands in for the reference's starlette.requests.Request)."""
 
-    def __init__(self, method: str, path: str, query: dict, body: bytes, headers: dict):
+    def __init__(self, method: str, path: str, query: dict, body: bytes, headers: dict,
+                 route_prefix: str | None = None):
         self.method = method
         self.path = path
         self.query_params = query
         self.body = body
         self.headers = headers
+        self.route_prefix = route_prefix
+
+    @property
+    def sub_path(self) -> str:
+        """Path RELATIVE to the deployment's matched route prefix — what
+        sub-route dispatch (DAGDriver) should match on, valid under any
+        mount point."""
+        if not self.route_prefix or self.route_prefix == "/":
+            return self.path
+        rest = self.path[len(self.route_prefix.rstrip("/")):]
+        return rest if rest.startswith("/") else "/" + rest if rest else "/"
 
     def json(self):
         import json as _json

@@ -73,15 +73,21 @@ class Router:
 
     def route_for_prefix(self, path: str):
         """Longest-prefix route match for HTTP (reference: proxy route table)."""
+        return self.route_and_prefix_for(path)[0]
+
+    def route_and_prefix_for(self, path: str):
+        """(deployment, matched route prefix) — the proxy forwards the
+        prefix so replicas can resolve request.sub_path without knowing
+        their own mount point."""
         with self._lock:
-            best, best_len = None, -1
+            best, best_prefix, best_len = None, None, -1
             for name, entry in self._table.items():
                 prefix = entry.get("route_prefix")
                 if prefix is None:
                     continue
                 if (path == prefix or path.startswith(prefix.rstrip("/") + "/") or prefix == "/") and len(prefix) > best_len:
-                    best, best_len = name, len(prefix)
-            return best
+                    best, best_prefix, best_len = name, prefix, len(prefix)
+            return best, best_prefix
 
     def wait_for_deployment(self, deployment: str, timeout_s: float = 30.0) -> bool:
         deadline = time.time() + timeout_s

@@ -1,0 +1,80 @@
+"""DAGDriver — multi-route graph ingress.
+
+Analog of the reference's python/ray/serve/drivers.py:31: ONE driver
+deployment fronts several independently-deployed (and independently
+autoscaled) graph branches, dispatching HTTP requests by sub-route and
+shaping inputs with an http_adapter. Bind it like any deployment:
+
+    serve.run(DAGDriver.bind({"/a": BranchA.bind(), "/b": BranchB.bind()},
+                             http_adapter="ray_tpu.serve.http_adapters.json_request"),
+              route_prefix="/")
+
+The bound branch Applications become child deployments whose handles the
+replica materializes (the HandleMarker path used by all nested binds).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import ray_tpu
+from ray_tpu.serve.api import deployment
+from ray_tpu.serve.http_adapters import load_http_adapter
+
+
+@deployment
+class DAGDriver:
+    MATCH_ALL_ROUTE_PREFIX = "/"
+
+    def __init__(self, dags, http_adapter: Optional[Union[str, Callable]] = None):
+        """``dags``: one handle, or {route: handle} for multi-route apps —
+        by construction the values arrive as DeploymentHandles (bound
+        Applications are materialized by the replica)."""
+        if not isinstance(dags, dict):
+            dags = {self.MATCH_ALL_ROUTE_PREFIX: dags}
+        self.dags = dict(dags)
+        self.http_adapter = load_http_adapter(http_adapter)
+
+    def _match_route(self, path: str) -> Optional[str]:
+        """Exact match first, then longest matching prefix at a path
+        boundary (mirrors the proxy's longest-prefix deployment routing
+        one level down)."""
+        if path in self.dags:
+            return path
+        best = None
+        for route in self.dags:
+            if path.startswith(route.rstrip("/") + "/") or route == "/":
+                if best is None or len(route) > len(best):
+                    best = route
+        return best
+
+    def __call__(self, request):
+        # Dispatch on the path RELATIVE to this driver's mount point, so a
+        # driver at route_prefix="/api" still serves {"/a": ...} at /api/a.
+        path = getattr(request, "sub_path", None) or request.path
+        route = self._match_route(path)
+        if route is None:
+            raise ValueError(f"no DAG route matches path {path!r}")
+        inp = self.http_adapter(request)
+        return ray_tpu.get(self.dags[route].remote(inp), timeout=120)
+
+    # Python-side entry points (reference: DAGDriver.predict/_with_route).
+    def predict(self, *args, **kwargs):
+        if self.MATCH_ALL_ROUTE_PREFIX in self.dags:
+            route = self.MATCH_ALL_ROUTE_PREFIX
+        elif len(self.dags) == 1:
+            route = next(iter(self.dags))
+        else:
+            raise ValueError(
+                f"predict() is ambiguous with routes {sorted(self.dags)}; "
+                "use predict_with_route()"
+            )
+        return ray_tpu.get(self.dags[route].remote(*args, **kwargs), timeout=120)
+
+    def predict_with_route(self, route: str, *args, **kwargs):
+        if route not in self.dags:
+            raise ValueError(f"unknown DAG route {route!r} (routes: {sorted(self.dags)})")
+        return ray_tpu.get(self.dags[route].remote(*args, **kwargs), timeout=120)
+
+    def get_routes(self) -> list:
+        return sorted(self.dags)

@@ -1,0 +1,52 @@
+"""HTTP adapters — convert ingress requests into deployment inputs.
+
+Analog of the reference's ray.serve.http_adapters (python/ray/serve/
+http_adapters.py): small callables the DAGDriver applies to the incoming
+request before invoking a graph branch. Accepts either the callable itself
+or its import string (e.g. ``"ray_tpu.serve.http_adapters.json_request"``).
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Callable, Optional, Union
+
+
+def json_request(request):
+    """Parse the body as JSON (the reference's default adapter)."""
+    return request.json()
+
+
+def text_request(request):
+    return request.text()  # None-body-safe (HTTPRequest.text guards)
+
+
+def bytes_request(request):
+    return request.body
+
+
+def query_params(request):
+    """Pass the query-string parameters through as a dict."""
+    return dict(request.query_params)
+
+
+def json_to_ndarray(request):
+    """JSON body -> numpy array (reference: json_to_ndarray)."""
+    import numpy as np
+
+    return np.asarray(request.json())
+
+
+def load_http_adapter(adapter: Optional[Union[str, Callable]]) -> Callable:
+    """Resolve an adapter: None -> json_request, import string -> callable."""
+    if adapter is None:
+        return json_request
+    if callable(adapter):
+        return adapter
+    module, _, attr = str(adapter).rpartition(".")
+    if not module:
+        raise ValueError(f"invalid http_adapter import string {adapter!r}")
+    fn = getattr(importlib.import_module(module), attr)
+    if not callable(fn):
+        raise TypeError(f"http_adapter {adapter!r} is not callable")
+    return fn
