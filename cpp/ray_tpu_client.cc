@@ -1,0 +1,397 @@
+// ray_tpu C++ client — minimal native driver for an existing cluster.
+//
+// The down-payment on the reference's C++ user API (cpp/include/ray/api.h):
+// a standalone program that speaks the framework's control plane (the
+// length-prefixed msgpack RPC of _private/rpc.py) and data plane (the shm
+// arena + lock-free index C APIs in _native/) with NO Python in process:
+//
+//   1. GCS KV put/get round trip            (control plane)
+//   2. node-table listing                   (cluster introspection)
+//   3. task submission to a raylet by
+//      function-table key + result poll     (task plane)
+//   4. zero-copy shared-memory object read
+//      via arena_attach + idx_get_pinned    (data plane)
+//
+// Build:  g++ -O2 -std=c++17 -o ray_tpu_cclient cpp/ray_tpu_client.cc -ldl
+// Usage:  ray_tpu_cclient GCS_HOST GCS_PORT RAYLET_HOST RAYLET_PORT \
+//             FUNCTION_KEY JOB_ID [NATIVE_DIR ARENA_NAME INDEX_NAME OID_HEX]
+
+#include <arpa/inet.h>
+#include <dlfcn.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <random>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+// ---------------------------------------------------------------------------
+// Minimal msgpack encoder (maps/arrays/str/bin/uint/int/bool/nil).
+// ---------------------------------------------------------------------------
+struct Packer {
+  std::string out;
+  void raw(const void* p, size_t n) { out.append((const char*)p, n); }
+  void u8(uint8_t b) { out.push_back((char)b); }
+  void be16(uint16_t v) { uint16_t x = htons(v); raw(&x, 2); }
+  void be32(uint32_t v) { uint32_t x = htonl(v); raw(&x, 4); }
+  void be64(uint64_t v) {
+    for (int i = 7; i >= 0; --i) u8((v >> (8 * i)) & 0xff);
+  }
+  void nil() { u8(0xc0); }
+  void boolean(bool b) { u8(b ? 0xc3 : 0xc2); }
+  void integer(int64_t v) {
+    if (v >= 0) {
+      if (v < 128) u8((uint8_t)v);
+      else if (v <= 0xff) { u8(0xcc); u8((uint8_t)v); }
+      else if (v <= 0xffff) { u8(0xcd); be16((uint16_t)v); }
+      else if (v <= 0xffffffffLL) { u8(0xce); be32((uint32_t)v); }
+      else { u8(0xcf); be64((uint64_t)v); }
+    } else {
+      if (v >= -32) u8((uint8_t)(0xe0 | (v + 32)));
+      else { u8(0xd3); be64((uint64_t)v); }
+    }
+  }
+  void str(const std::string& s) {
+    size_t n = s.size();
+    if (n < 32) u8(0xa0 | (uint8_t)n);
+    else if (n <= 0xff) { u8(0xd9); u8((uint8_t)n); }
+    else if (n <= 0xffff) { u8(0xda); be16((uint16_t)n); }
+    else { u8(0xdb); be32((uint32_t)n); }
+    raw(s.data(), n);
+  }
+  void bin(const std::string& b) {
+    size_t n = b.size();
+    if (n <= 0xff) { u8(0xc4); u8((uint8_t)n); }
+    else if (n <= 0xffff) { u8(0xc5); be16((uint16_t)n); }
+    else { u8(0xc6); be32((uint32_t)n); }
+    raw(b.data(), n);
+  }
+  void array_header(uint32_t n) {
+    if (n < 16) u8(0x90 | (uint8_t)n);
+    else if (n <= 0xffff) { u8(0xdc); be16((uint16_t)n); }
+    else { u8(0xdd); be32(n); }
+  }
+  void map_header(uint32_t n) {
+    if (n < 16) u8(0x80 | (uint8_t)n);
+    else if (n <= 0xffff) { u8(0xde); be16((uint16_t)n); }
+    else { u8(0xdf); be32(n); }
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Minimal msgpack value + decoder.
+// ---------------------------------------------------------------------------
+struct Value {
+  enum Kind { NIL, BOOL, INT, FLOAT, STR, BIN, ARR, MAP } kind = NIL;
+  bool b = false;
+  int64_t i = 0;
+  double f = 0;
+  std::string s;  // STR and BIN payloads
+  std::vector<Value> arr;
+  std::map<std::string, Value> map;  // string-keyed maps only (our wire shape)
+
+  const Value* get(const std::string& key) const {
+    auto it = map.find(key);
+    return it == map.end() ? nullptr : &it->second;
+  }
+  bool truthy() const {
+    switch (kind) {
+      case BOOL: return b;
+      case INT: return i != 0;
+      case NIL: return false;
+      default: return true;
+    }
+  }
+};
+
+struct Unpacker {
+  const uint8_t* p;
+  const uint8_t* end;
+  explicit Unpacker(const std::string& buf)
+      : p((const uint8_t*)buf.data()), end(p + buf.size()) {}
+  uint8_t u8() { need(1); return *p++; }
+  void need(size_t n) {
+    if ((size_t)(end - p) < n) throw std::runtime_error("msgpack truncated");
+  }
+  uint64_t be(int n) {
+    need(n);
+    uint64_t v = 0;
+    for (int i = 0; i < n; ++i) v = (v << 8) | *p++;
+    return v;
+  }
+  std::string bytes(size_t n) {
+    need(n);
+    std::string s((const char*)p, n);
+    p += n;
+    return s;
+  }
+  Value decode() {
+    uint8_t t = u8();
+    Value v;
+    if (t < 0x80) { v.kind = Value::INT; v.i = t; return v; }
+    if (t >= 0xe0) { v.kind = Value::INT; v.i = (int8_t)t; return v; }
+    if ((t & 0xf0) == 0x80) return map_body(t & 0x0f);
+    if ((t & 0xf0) == 0x90) return arr_body(t & 0x0f);
+    if ((t & 0xe0) == 0xa0) { v.kind = Value::STR; v.s = bytes(t & 0x1f); return v; }
+    switch (t) {
+      case 0xc0: return v;
+      case 0xc2: v.kind = Value::BOOL; v.b = false; return v;
+      case 0xc3: v.kind = Value::BOOL; v.b = true; return v;
+      case 0xc4: v.kind = Value::BIN; v.s = bytes(be(1)); return v;
+      case 0xc5: v.kind = Value::BIN; v.s = bytes(be(2)); return v;
+      case 0xc6: v.kind = Value::BIN; v.s = bytes(be(4)); return v;
+      case 0xca: { v.kind = Value::FLOAT; uint32_t raw = (uint32_t)be(4);
+                   float f; memcpy(&f, &raw, 4); v.f = f; return v; }
+      case 0xcb: { v.kind = Value::FLOAT; uint64_t raw = be(8);
+                   memcpy(&v.f, &raw, 8); return v; }
+      case 0xcc: v.kind = Value::INT; v.i = (int64_t)be(1); return v;
+      case 0xcd: v.kind = Value::INT; v.i = (int64_t)be(2); return v;
+      case 0xce: v.kind = Value::INT; v.i = (int64_t)be(4); return v;
+      case 0xcf: v.kind = Value::INT; v.i = (int64_t)be(8); return v;
+      case 0xd0: v.kind = Value::INT; v.i = (int8_t)be(1); return v;
+      case 0xd1: v.kind = Value::INT; v.i = (int16_t)be(2); return v;
+      case 0xd2: v.kind = Value::INT; v.i = (int32_t)be(4); return v;
+      case 0xd3: v.kind = Value::INT; v.i = (int64_t)be(8); return v;
+      case 0xd9: v.kind = Value::STR; v.s = bytes(be(1)); return v;
+      case 0xda: v.kind = Value::STR; v.s = bytes(be(2)); return v;
+      case 0xdb: v.kind = Value::STR; v.s = bytes(be(4)); return v;
+      case 0xdc: return arr_body(be(2));
+      case 0xdd: return arr_body(be(4));
+      case 0xde: return map_body(be(2));
+      case 0xdf: return map_body(be(4));
+      default: throw std::runtime_error("msgpack type not handled");
+    }
+  }
+  Value arr_body(uint64_t n) {
+    Value v; v.kind = Value::ARR;
+    for (uint64_t i = 0; i < n; ++i) v.arr.push_back(decode());
+    return v;
+  }
+  Value map_body(uint64_t n) {
+    Value v; v.kind = Value::MAP;
+    for (uint64_t i = 0; i < n; ++i) {
+      Value k = decode();
+      v.map[k.s] = decode();  // keys are strings on this wire
+    }
+    return v;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// RPC client: 4-byte BE length + msgpack [type, seq, method, payload].
+// ---------------------------------------------------------------------------
+struct RpcClient {
+  int fd = -1;
+  uint32_t seq = 0;
+
+  RpcClient(const std::string& host, int port) {
+    fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) throw std::runtime_error("socket() failed");
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons((uint16_t)port);
+    if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1)
+      throw std::runtime_error("bad host " + host);
+    if (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0)
+      throw std::runtime_error("connect to " + host + " failed");
+  }
+  ~RpcClient() { if (fd >= 0) close(fd); }
+
+  void send_all(const std::string& buf) {
+    size_t off = 0;
+    while (off < buf.size()) {
+      ssize_t n = write(fd, buf.data() + off, buf.size() - off);
+      if (n <= 0) throw std::runtime_error("write failed");
+      off += (size_t)n;
+    }
+  }
+  std::string read_exact(size_t n) {
+    std::string buf(n, '\0');
+    size_t off = 0;
+    while (off < n) {
+      ssize_t got = read(fd, &buf[off], n - off);
+      if (got <= 0) throw std::runtime_error("read failed");
+      off += (size_t)got;
+    }
+    return buf;
+  }
+
+  // payload_body: pre-packed msgpack for the payload element.
+  Value call(const std::string& method, const std::string& payload_body) {
+    Packer pk;
+    pk.array_header(4);
+    pk.integer(0);  // REQUEST
+    pk.integer(++seq);
+    pk.str(method);
+    pk.out += payload_body;
+    std::string frame;
+    uint32_t len = htonl((uint32_t)pk.out.size());
+    frame.append((const char*)&len, 4);
+    frame += pk.out;
+    send_all(frame);
+    for (;;) {
+      std::string hdr = read_exact(4);
+      uint32_t blen = ntohl(*(const uint32_t*)hdr.data());
+      std::string body = read_exact(blen);
+      Unpacker up(body);
+      Value msg = up.decode();
+      int64_t mtype = msg.arr.at(0).i;
+      if (mtype == 3) continue;  // PUSH frames are not ours to handle
+      if ((uint32_t)msg.arr.at(1).i != seq) continue;  // stale response
+      if (mtype == 2) {  // ERROR payload is {"error": ..., "traceback": ...}
+        const Value& pl = msg.arr.at(3);
+        const Value* detail = pl.get("error");
+        throw std::runtime_error("rpc error from " + method + ": " +
+                                 (detail ? detail->s : pl.s));
+      }
+      return msg.arr.at(3);
+    }
+  }
+};
+
+static std::string random_hex(size_t nbytes) {
+  static const char* digits = "0123456789abcdef";
+  std::random_device rd;
+  std::mt19937_64 gen(rd());
+  std::string out;
+  for (size_t i = 0; i < nbytes; ++i) {
+    uint8_t b = (uint8_t)(gen() & 0xff);
+    out.push_back(digits[b >> 4]);
+    out.push_back(digits[b & 0x0f]);
+  }
+  return out;
+}
+
+static std::string from_hex(const std::string& hex) {
+  std::string out;
+  for (size_t i = 0; i + 1 < hex.size(); i += 2)
+    out.push_back((char)strtol(hex.substr(i, 2).c_str(), nullptr, 16));
+  return out;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 7) {
+    fprintf(stderr,
+            "usage: %s GCS_HOST GCS_PORT RAYLET_HOST RAYLET_PORT "
+            "FUNCTION_KEY JOB_ID [NATIVE_DIR ARENA_NAME INDEX_NAME OID_HEX]\n",
+            argv[0]);
+    return 2;
+  }
+  try {
+    RpcClient gcs(argv[1], atoi(argv[2]));
+
+    // 1. Control plane: KV round trip.
+    {
+      Packer p;
+      p.map_header(3);
+      p.str("key"); p.str("cclient:ping");
+      p.str("value"); p.bin("hello-from-c");
+      p.str("overwrite"); p.boolean(true);
+      Value r = gcs.call("kv_put", p.out);
+      Packer g;
+      g.map_header(1);
+      g.str("key"); g.str("cclient:ping");
+      Value got = gcs.call("kv_get", g.out);
+      const Value* val = got.get("value");
+      if (!(r.get("ok") && r.get("ok")->truthy() && val && val->s == "hello-from-c"))
+        throw std::runtime_error("KV round trip mismatch");
+      printf("KV_OK\n");
+    }
+
+    // 2. Cluster introspection: node table.
+    {
+      Packer p;
+      p.map_header(0);
+      Value r = gcs.call("get_nodes", p.out);
+      const Value* nodes = r.get("nodes");
+      printf("NODES %zu\n", nodes ? nodes->map.size() : 0);
+    }
+
+    // 3. Task plane: submit a no-arg task by function key; the task writes
+    //    its result into the GCS KV, which we poll (a C driver has no
+    //    in-process object store to receive owner pushes).
+    {
+      std::string task_id = random_hex(24);
+      RpcClient raylet(argv[3], atoi(argv[4]));
+      Packer p;
+      p.map_header(1);
+      p.str("spec");
+      p.map_header(5);
+      p.str("task_id"); p.str(task_id);
+      p.str("job_id"); p.str(argv[6]);
+      p.str("name"); p.str("c_client_task");
+      p.str("function_key"); p.str(argv[5]);
+      p.str("num_returns"); p.integer(0);
+      Value r = raylet.call("submit_task", p.out);
+      if (!(r.get("ok") && r.get("ok")->truthy()))
+        throw std::runtime_error("submit_task rejected");
+      printf("TASK_SUBMITTED %s\n", task_id.c_str());
+      // Poll a TASK-ID-namespaced key (the task echoes its own id into the
+      // key): a stale value from a previous run cannot satisfy this poll.
+      std::string result_key = "cclient:result:" + task_id;
+      std::string result;
+      for (int attempt = 0; attempt < 300; ++attempt) {
+        Packer g;
+        g.map_header(1);
+        g.str("key"); g.str(result_key);
+        Value got = gcs.call("kv_get", g.out);
+        if (got.get("found") && got.get("found")->truthy()) {
+          result = got.get("value")->s;
+          break;
+        }
+        usleep(100 * 1000);
+      }
+      if (result.empty()) throw std::runtime_error("task result never appeared");
+      printf("TASK_RESULT %s\n", result.c_str());
+    }
+
+    // 4. Data plane: zero-copy read of a shared-memory object through the
+    //    same C APIs the Python runtime binds (arena_attach/idx_get_pinned).
+    if (argc >= 11) {
+      std::string dir = argv[7];
+      void* arena_lib = dlopen((dir + "/libshm_arena.so").c_str(), RTLD_NOW);
+      void* index_lib = dlopen((dir + "/libshm_index.so").c_str(), RTLD_NOW);
+      if (!arena_lib || !index_lib)
+        throw std::runtime_error("dlopen native libs failed");
+      auto arena_attach = (int (*)(const char*))dlsym(arena_lib, "arena_attach");
+      auto arena_base = (void* (*)(int))dlsym(arena_lib, "arena_base");
+      auto idx_attach = (int (*)(const char*))dlsym(index_lib, "idx_attach");
+      auto idx_get_pinned =
+          (int (*)(int, const uint8_t*, uint64_t*, uint64_t*, uint32_t*, uint64_t*))
+              dlsym(index_lib, "idx_get_pinned");
+      auto idx_release = (int (*)(int, uint64_t, uint32_t))dlsym(index_lib, "idx_release");
+      if (!arena_attach || !arena_base || !idx_attach || !idx_get_pinned || !idx_release)
+        throw std::runtime_error("dlsym native symbols failed");
+
+      int ah = arena_attach(argv[8]);
+      int ih = idx_attach(argv[9]);
+      if (ah < 0 || ih < 0) throw std::runtime_error("shm attach failed");
+      std::string key = from_hex(argv[10]);
+      uint64_t off = 0, size = 0, slot = 0;
+      uint32_t ver = 0;
+      if (!idx_get_pinned(ih, (const uint8_t*)key.data(), &off, &size, &ver, &slot))
+        throw std::runtime_error("object not found in shm index");
+      const uint8_t* data = (const uint8_t*)arena_base(ah) + off;
+      uint64_t checksum = 1469598103934665603ULL;  // FNV-1a over the payload
+      for (uint64_t i = 0; i < size; ++i) {
+        checksum ^= data[i];
+        checksum *= 1099511628211ULL;
+      }
+      idx_release(ih, slot, ver);
+      printf("SHM_READ %llu %016llx\n", (unsigned long long)size,
+             (unsigned long long)checksum);
+    }
+    printf("C_CLIENT_PASS\n");
+    return 0;
+  } catch (const std::exception& e) {
+    fprintf(stderr, "C_CLIENT_FAIL: %s\n", e.what());
+    return 1;
+  }
+}
