@@ -1,0 +1,1 @@
+from ray_tpu.rllib.algorithms.a3c.a3c import A3C, A3CConfig  # noqa: F401

@@ -1,0 +1,246 @@
+"""A3C — asynchronous advantage actor-critic.
+
+Reference: rllib/algorithms/a3c/a3c.py (Mnih et al. 2016): each rollout
+worker computes policy gradients on its OWN fragment and ships gradients
+(not samples) to the driver, which applies them to the central weights as
+they arrive — no synchronization barrier across workers — and returns fresh
+weights to just that worker (training_step :190: `async_parallel_requests`
+over `sample_and_compute_grads`).
+
+TPU-native shape: the gradient computation is the jitted A2C loss running on
+the worker's CPU device (rollouts stay off-chip, rollout_worker.py:52); the
+driver holds params + optax state and applies each incoming gradient in
+arrival order. The asynchrony is real — the driver waits on whichever worker
+finishes first (`ray_tpu.wait(num_returns=1)`), so a slow worker never gates
+the others, at the cost of gradient staleness exactly like the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.a2c.a2c import a2c_loss
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.evaluation.rollout_worker import RolloutWorker
+
+
+class _A3CWorker(RolloutWorker):
+    """RolloutWorker that also computes the A2C gradient on its fragment."""
+
+    def __init__(self, *args, loss_cfg=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        import jax
+
+        cfg = dict(loss_cfg or {})
+        spec = self.spec
+
+        def grads_fn(params, batch):
+            (loss, metrics), grads = jax.value_and_grad(
+                lambda p: a2c_loss(p, batch, spec, cfg), has_aux=True
+            )(params)
+            metrics = dict(metrics)
+            metrics["total_loss"] = loss
+            return grads, metrics
+
+        self._grads_fn = jax.jit(grads_fn)
+
+    def sample_and_grad(self, num_steps: int):
+        import jax
+        import jax.numpy as jnp
+
+        batch = self.sample(num_steps, explore=True)
+        jb = {k: jnp.asarray(v) for k, v in batch.items()}
+        grads, metrics = self._grads_fn(self._params, jb)
+        rewards, lens = self.env.pop_episode_stats()
+        return (
+            jax.tree_util.tree_map(np.asarray, grads),
+            {k: float(v) for k, v in metrics.items()},
+            batch.count,
+            rewards,
+        )
+
+
+class A3CConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or A3C)
+        self.lr = 1e-4
+        self.grad_clip = 40.0
+        self.rollout_fragment_length = 50
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        # Gradient applications per training_step() call (iteration sizing
+        # only — the update stream itself is barrier-free).
+        self.grads_per_step = 16
+
+    def training(self, *, vf_loss_coeff: Optional[float] = None,
+                 entropy_coeff: Optional[float] = None,
+                 grads_per_step: Optional[int] = None, **kwargs) -> "A3CConfig":
+        super().training(**kwargs)
+        if vf_loss_coeff is not None:
+            self.vf_loss_coeff = vf_loss_coeff
+        if entropy_coeff is not None:
+            self.entropy_coeff = entropy_coeff
+        if grads_per_step is not None:
+            self.grads_per_step = grads_per_step
+        return self
+
+
+class A3C(Algorithm):
+    @classmethod
+    def get_default_config(cls) -> A3CConfig:
+        return A3CConfig(cls)
+
+    def setup(self, config: dict) -> None:
+        import gymnasium as gym
+        import jax
+        import optax
+
+        self.cleanup()
+        cfg: A3CConfig = self._algo_config
+        probe = gym.make(cfg.env) if isinstance(cfg.env, str) else cfg.env(dict(cfg.env_config))
+        from ray_tpu.rllib.models import ModelCatalog
+
+        self.module_spec = ModelCatalog.get_model_spec(
+            probe.observation_space, probe.action_space, cfg.model_config()
+        )
+        probe.close()
+        from ray_tpu.rllib.core import rl_module
+
+        self.params = rl_module.init_params(jax.random.PRNGKey(cfg.seed), self.module_spec)
+        chain = []
+        if cfg.grad_clip:
+            chain.append(optax.clip_by_global_norm(cfg.grad_clip))
+        chain.append(optax.adam(cfg.lr))
+        self.tx = optax.chain(*chain)
+        self.opt_state = self.tx.init(self.params)
+        self._apply = jax.jit(
+            lambda params, opt_state, grads: self._apply_impl(params, opt_state, grads)
+        )
+        loss_cfg = {"vf_loss_coeff": cfg.vf_loss_coeff, "entropy_coeff": cfg.entropy_coeff}
+        n = max(cfg.num_rollout_workers, 1)
+        worker_cls = ray_tpu.remote(num_cpus=1)(_A3CWorker)
+        self.workers = [
+            worker_cls.remote(
+                cfg.env, self.module_spec, i, max(cfg.num_envs_per_worker, 1),
+                dict(cfg.env_config), cfg.gamma, cfg.lambda_, cfg.seed,
+                cfg.observation_filter, loss_cfg=loss_cfg,
+            )
+            for i in range(n)
+        ]
+        weights = self.get_policy_weights()
+        ray_tpu.get([w.set_weights.remote(weights) for w in self.workers], timeout=300)
+        # One in-flight gradient task per worker, resubmitted as each lands.
+        self._inflight = {
+            w.sample_and_grad.remote(cfg.rollout_fragment_length): w for w in self.workers
+        }
+        self._timesteps_total = 0
+        self._episode_reward_window: list = []
+
+    def _apply_impl(self, params, opt_state, grads):
+        updates, opt_state = self.tx.update(grads, opt_state, params)
+        import jax
+
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        return params, opt_state
+
+    def get_policy_weights(self):
+        import jax
+
+        return jax.tree_util.tree_map(np.asarray, self.params)
+
+    def training_step(self) -> dict:
+        import jax.numpy as jnp
+        import jax
+
+        cfg: A3CConfig = self._algo_config
+        metrics: dict = {}
+        for _ in range(cfg.grads_per_step):
+            # Apply whichever worker's gradient lands first; only THAT
+            # worker gets fresh weights and a new task — no barrier.
+            done, _ = ray_tpu.wait(list(self._inflight), num_returns=1, timeout=120)
+            if not done:
+                break
+            ref = done[0]
+            worker = self._inflight.pop(ref)
+            try:
+                grads, m, count, rewards = ray_tpu.get(ref, timeout=60)
+            except Exception:
+                # Worker died mid-fragment: drop its task; respawn-free
+                # degradation (remaining workers keep the stream alive).
+                self.workers = [w for w in self.workers if w is not worker]
+                if not self.workers:
+                    raise
+                continue
+            jgrads = jax.tree_util.tree_map(jnp.asarray, grads)
+            self.params, self.opt_state = self._apply(self.params, self.opt_state, jgrads)
+            metrics = m
+            self._timesteps_total += count
+            self._episode_reward_window += rewards
+            worker.set_weights.remote(self.get_policy_weights())
+            self._inflight[
+                worker.sample_and_grad.remote(cfg.rollout_fragment_length)
+            ] = worker
+        self._episode_reward_window = self._episode_reward_window[-100:]
+        return metrics
+
+    def step(self) -> dict:
+        import time
+
+        t0 = time.time()
+        result = self.training_step()
+        result["episode_reward_mean"] = (
+            float(np.mean(self._episode_reward_window))
+            if self._episode_reward_window
+            else float("nan")
+        )
+        result["timesteps_total"] = self._timesteps_total
+        result["time_this_iter_s"] = time.time() - t0
+        return result
+
+    def compute_single_action(self, obs, explore: bool = False):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib.core import rl_module
+
+        actions, _, _ = rl_module.sample_actions(
+            self.params, jnp.asarray(np.asarray(obs, np.float32))[None],
+            jax.random.PRNGKey(0), self.module_spec, explore,
+        )
+        a = np.asarray(actions)[0]
+        return a.item() if self.module_spec.discrete else a
+
+    def save_checkpoint(self):
+        from ray_tpu.air.checkpoint import Checkpoint
+
+        return Checkpoint.from_dict(
+            {"weights": self.get_policy_weights(), "timesteps": self._timesteps_total}
+        )
+
+    def load_checkpoint(self, checkpoint) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        data = checkpoint.to_dict()
+        self.params = jax.tree_util.tree_map(jnp.asarray, data["weights"])
+        self._timesteps_total = data.get("timesteps", 0)
+        ray_tpu.get(
+            [w.set_weights.remote(self.get_policy_weights()) for w in self.workers],
+            timeout=300,
+        )
+
+    def cleanup(self) -> None:
+        for w in getattr(self, "workers", []):
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self.workers = []
+        self._inflight = {}
+        eval_ws = getattr(self, "_eval_workers", None)
+        if eval_ws is not None:
+            eval_ws.stop()
+            self._eval_workers = None

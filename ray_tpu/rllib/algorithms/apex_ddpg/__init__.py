@@ -1,0 +1,1 @@
+from ray_tpu.rllib.algorithms.apex_ddpg.apex_ddpg import ApexDDPG, ApexDDPGConfig  # noqa: F401

@@ -1,0 +1,353 @@
+"""Ape-X DDPG — distributed prioritized replay for continuous control.
+
+Reference: rllib/algorithms/apex_ddpg/apex_ddpg.py (Horgan et al. 2018
+applied to DDPG): the Ape-X architecture of apex_dqn — many exploration
+actors on a per-worker noise ladder feeding actor-sharded prioritized
+replay, a central learner pushing priorities back and broadcasting weights
+periodically — with DDPG's deterministic-policy TD learner instead of the
+Q-network. The exploration ladder uses per-worker Gaussian ACTION noise
+(sigma_i = 0.4^(1 + 7 i/(N-1)), the continuous analog of the epsilon
+ladder apex_dqn.py:48 uses).
+
+The learner is a single jitted step: importance-weighted critic TD loss
+(per-sample weights from the prioritized shards), actor update through the
+critic, Polyak targets — and it returns the TD errors so the driver can
+push fresh priorities back to the owning shard.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.apex_dqn.apex_dqn import _ReplayShard
+from ray_tpu.rllib.algorithms.ddpg.ddpg import DDPGConfig, init_ddpg_params
+from ray_tpu.rllib.algorithms.sac.sac import _mlp_apply, _true_transition
+from ray_tpu.rllib.policy.sample_batch import (
+    ACTIONS,
+    DONES,
+    NEXT_OBS,
+    OBS,
+    REWARDS,
+    SampleBatch,
+)
+
+
+class _ApexDDPGWorker:
+    """Exploration actor: deterministic policy + fixed per-worker Gaussian
+    action noise against the latest broadcast weights."""
+
+    def __init__(self, env, env_config, hiddens, act_scale, act_offset,
+                 worker_index, num_workers, num_envs, seed):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")  # rollouts stay off-chip
+        from ray_tpu.rllib.env.vector_env import VectorEnv
+
+        self.env = VectorEnv(env, num_envs, env_config, worker_index, seed=seed + worker_index)
+        self._policy = jax.jit(lambda p, o: jax.numpy.tanh(_mlp_apply(p["actor"], o)))
+        self.params = None
+        self._act_scale = np.asarray(act_scale, np.float32)
+        self._act_offset = np.asarray(act_offset, np.float32)
+        denom = max(num_workers - 1, 1)
+        self.sigma = 0.4 ** (1 + 7 * worker_index / denom)
+        self._rng = np.random.default_rng(seed * 9973 + worker_index)
+
+    def set_weights(self, weights):
+        import jax
+        import jax.numpy as jnp
+
+        self.params = jax.tree_util.tree_map(jnp.asarray, weights)
+        return True
+
+    def sample(self, n_steps: int):
+        import jax.numpy as jnp
+
+        cols = {OBS: [], ACTIONS: [], REWARDS: [], DONES: [], NEXT_OBS: []}
+        for _ in range(n_steps):
+            obs = self.env.current_obs().astype(np.float32).reshape(self.env.num_envs, -1)
+            a = np.asarray(self._policy(self.params, jnp.asarray(obs)))
+            a = np.clip(a + self._rng.normal(0, self.sigma, a.shape), -1, 1).astype(np.float32)
+            _, rewards, dones, infos = self.env.step(a * self._act_scale + self._act_offset)
+            next_obs, terminateds = _true_transition(self.env, dones, infos)
+            cols[OBS].append(obs)
+            cols[ACTIONS].append(a)
+            cols[REWARDS].append(rewards)
+            cols[DONES].append(terminateds)
+            cols[NEXT_OBS].append(next_obs)
+        out = {k: np.concatenate(v) for k, v in cols.items()}
+        rews, _ = self.env.pop_episode_stats()
+        return out, rews, len(out[OBS])
+
+    def stop(self):
+        self.env.close()
+        return True
+
+
+class ApexDDPGConfig(DDPGConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or ApexDDPG)
+        self.num_rollout_workers = 2
+        self.num_replay_shards = 2
+        self.rollout_fragment_length = 50
+        self.weight_sync_period_updates = 16
+        self.train_rounds_per_iter = 8
+        self.updates_per_round = 4
+        self.learning_starts = 500
+
+    def training(self, *, num_replay_shards=None, rollout_fragment_length=None,
+                 weight_sync_period_updates=None, train_rounds_per_iter=None,
+                 updates_per_round=None, **kwargs) -> "ApexDDPGConfig":
+        super().training(**kwargs)
+        for name, val in (
+            ("num_replay_shards", num_replay_shards),
+            ("rollout_fragment_length", rollout_fragment_length),
+            ("weight_sync_period_updates", weight_sync_period_updates),
+            ("train_rounds_per_iter", train_rounds_per_iter),
+            ("updates_per_round", updates_per_round),
+        ):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+class ApexDDPG(Algorithm):
+    @classmethod
+    def get_default_config(cls) -> ApexDDPGConfig:
+        return ApexDDPGConfig(cls)
+
+    def setup(self, config: dict) -> None:
+        import gymnasium as gym
+        import jax
+        import optax
+
+        self.cleanup()
+        cfg: ApexDDPGConfig = self._algo_config
+        probe = gym.make(cfg.env) if isinstance(cfg.env, str) else cfg.env(dict(cfg.env_config))
+        assert not isinstance(probe.action_space, gym.spaces.Discrete), "ApexDDPG needs continuous actions"
+        self.obs_dim = int(np.prod(probe.observation_space.shape))
+        self.action_dim = int(np.prod(probe.action_space.shape))
+        low = np.asarray(probe.action_space.low, np.float32)
+        high = np.asarray(probe.action_space.high, np.float32)
+        self._act_scale = (high - low) / 2.0
+        self._act_offset = (high + low) / 2.0
+        probe.close()
+
+        self.params = init_ddpg_params(
+            jax.random.PRNGKey(cfg.seed), self.obs_dim, self.action_dim,
+            cfg.model_hiddens, cfg.twin_q,
+        )
+        self.target = jax.tree_util.tree_map(lambda x: x, self.params)
+        self._critic_keys = tuple(k for k in ("q1", "q2") if k in self.params)
+        self.actor_tx = optax.adam(cfg.lr)
+        self.critic_tx = optax.adam(cfg.lr)
+        self.opt_state = {
+            "actor": self.actor_tx.init(self.params["actor"]),
+            "critic": self.critic_tx.init({k: self.params[k] for k in self._critic_keys}),
+        }
+        self._build_train_step(cfg)
+
+        n_workers = max(cfg.num_rollout_workers, 1)
+        worker_cls = ray_tpu.remote(num_cpus=1)(_ApexDDPGWorker)
+        self.workers = [
+            worker_cls.remote(
+                cfg.env, dict(cfg.env_config), cfg.model_hiddens,
+                self._act_scale, self._act_offset,
+                i, n_workers, max(cfg.num_envs_per_worker, 1), cfg.seed,
+            )
+            for i in range(n_workers)
+        ]
+        shard_cls = ray_tpu.remote(num_cpus=0.1)(_ReplayShard)
+        shard_cap = max(1, cfg.replay_buffer_capacity // max(cfg.num_replay_shards, 1))
+        self.shards = [
+            shard_cls.remote(shard_cap, cfg.seed + 31 * i) for i in range(cfg.num_replay_shards)
+        ]
+        self._shard_sizes = {i: 0 for i in range(len(self.shards))}
+        ray_tpu.get(
+            [w.set_weights.remote(self._np_weights()) for w in self.workers], timeout=300
+        )
+        self._timesteps_total = 0
+        self._updates = 0
+        self._last_sync = 0
+        self._add_rr = 0
+        self._sample_rr = 0
+        self._replay_size = 0
+        self._episode_reward_window: list = []
+
+    def _np_weights(self):
+        import jax
+
+        return jax.tree_util.tree_map(np.asarray, self.params)
+
+    def _build_train_step(self, cfg: ApexDDPGConfig):
+        import jax
+        import jax.numpy as jnp
+
+        gamma, tau = cfg.gamma, cfg.tau
+        twin_q = cfg.twin_q
+        critic_keys = self._critic_keys
+        actor_tx, critic_tx = self.actor_tx, self.critic_tx
+
+        def q_val(q, obs, a):
+            return _mlp_apply(q, jnp.concatenate([obs, a], -1))[:, 0]
+
+        def critic_loss_fn(critic, target, batch):
+            obs, next_obs = batch[OBS], batch[NEXT_OBS]
+            next_a = jnp.tanh(_mlp_apply(target["actor"], next_obs))
+            tq = q_val(target["q1"], next_obs, next_a)
+            if twin_q:
+                tq = jnp.minimum(tq, q_val(target["q2"], next_obs, next_a))
+            td_target = jax.lax.stop_gradient(
+                batch[REWARDS] + gamma * (1 - batch[DONES]) * tq
+            )
+            q1 = q_val(critic["q1"], obs, batch[ACTIONS])
+            td_error = q1 - td_target
+            # Importance weights from the prioritized shards correct the
+            # non-uniform sampling distribution (Ape-X keeps PER's IS step).
+            loss = jnp.mean(batch["weights"] * td_error**2)
+            if twin_q:
+                q2 = q_val(critic["q2"], obs, batch[ACTIONS])
+                loss = loss + jnp.mean(batch["weights"] * (q2 - td_target) ** 2)
+            return loss, td_error
+
+        def actor_loss_fn(actor, critic, batch):
+            obs = batch[OBS]
+            a_pi = jnp.tanh(_mlp_apply(actor, obs))
+            return -jnp.mean(q_val(critic["q1"], obs, a_pi))
+
+        def train_step(params, target, opt_state, batch):
+            critic = {k: params[k] for k in critic_keys}
+            (closs, td_error), cgrads = jax.value_and_grad(critic_loss_fn, has_aux=True)(
+                critic, target, batch
+            )
+            cupd, c_opt = critic_tx.update(cgrads, opt_state["critic"], critic)
+            critic = jax.tree_util.tree_map(lambda p, u: p + u, critic, cupd)
+            aloss, agrads = jax.value_and_grad(actor_loss_fn)(params["actor"], critic, batch)
+            aupd, a_opt = actor_tx.update(agrads, opt_state["actor"], params["actor"])
+            actor = jax.tree_util.tree_map(lambda p, u: p + u, params["actor"], aupd)
+            params = {**critic, "actor": actor}
+            target = jax.tree_util.tree_map(
+                lambda t, p: (1 - tau) * t + tau * p, target, params
+            )
+            opt_state = {"actor": a_opt, "critic": c_opt}
+            metrics = {"critic_loss": closs, "actor_loss": aloss}
+            return params, target, opt_state, td_error, metrics
+
+        self._train_step = jax.jit(train_step)
+        self._policy = jax.jit(lambda p, o: jnp.tanh(_mlp_apply(p["actor"], o)))
+
+    def training_step(self) -> dict:
+        cfg: ApexDDPGConfig = self._algo_config
+        metrics: dict = {}
+        for _ in range(cfg.train_rounds_per_iter):
+            refs = [w.sample.remote(cfg.rollout_fragment_length) for w in self.workers]
+            add_refs, add_shards = [], []
+            for cols, rews, count in ray_tpu.get(refs, timeout=600):
+                shard_i = self._add_rr % len(self.shards)
+                self._add_rr += 1
+                add_refs.append(self.shards[shard_i].add.remote(cols))
+                add_shards.append(shard_i)
+                self._timesteps_total += count
+                self._episode_reward_window += rews
+            for size, shard in zip(ray_tpu.get(add_refs, timeout=300), add_shards):
+                self._shard_sizes[shard] = size
+            self._replay_size = sum(self._shard_sizes.values())
+            self._episode_reward_window = self._episode_reward_window[-100:]
+            if self._replay_size < cfg.learning_starts:
+                continue
+            for _ in range(cfg.updates_per_round):
+                metrics = self._train_once() or metrics
+            if self._updates - self._last_sync >= cfg.weight_sync_period_updates:
+                self._last_sync = self._updates
+                ray_tpu.get(
+                    [w.set_weights.remote(self._np_weights()) for w in self.workers],
+                    timeout=300,
+                )
+        metrics["replay_size"] = self._replay_size
+        return metrics
+
+    def _train_once(self):
+        import jax.numpy as jnp
+
+        cfg: ApexDDPGConfig = self._algo_config
+        shard = self.shards[self._sample_rr % len(self.shards)]
+        self._sample_rr += 1
+        res = ray_tpu.get(shard.sample_with_idx.remote(cfg.train_batch_size), timeout=300)
+        if res is None:
+            return None
+        batch, idx = res
+        jb = {k: jnp.asarray(v) for k, v in batch.items()}
+        self.params, self.target, self.opt_state, td_error, metrics = self._train_step(
+            self.params, self.target, self.opt_state, jb
+        )
+        shard.update_priorities.remote(idx, np.asarray(td_error))
+        self._updates += 1
+        return {k: float(v) for k, v in metrics.items()}
+
+    def step(self) -> dict:
+        import time
+
+        t0 = time.time()
+        result = self.training_step()
+        result["episode_reward_mean"] = (
+            float(np.mean(self._episode_reward_window))
+            if self._episode_reward_window
+            else float("nan")
+        )
+        result["timesteps_total"] = self._timesteps_total
+        result["time_this_iter_s"] = time.time() - t0
+        return result
+
+    def compute_single_action(self, obs, explore: bool = False):
+        import jax.numpy as jnp
+
+        obs = np.asarray(obs, np.float32).reshape(1, -1)
+        a = np.asarray(self._policy(self.params, jnp.asarray(obs)))[0]
+        return np.asarray(a) * self._act_scale + self._act_offset
+
+    def save_checkpoint(self):
+        from ray_tpu.air.checkpoint import Checkpoint
+        import jax
+
+        return Checkpoint.from_dict({
+            "weights": self._np_weights(),
+            "target": jax.tree_util.tree_map(np.asarray, self.target),
+            "timesteps": self._timesteps_total,
+            "updates": self._updates,
+        })
+
+    def load_checkpoint(self, checkpoint) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        data = checkpoint.to_dict()
+        self.params = jax.tree_util.tree_map(jnp.asarray, data["weights"])
+        self.target = jax.tree_util.tree_map(jnp.asarray, data["target"])
+        self._timesteps_total = data.get("timesteps", 0)
+        self._updates = data.get("updates", 0)
+        ray_tpu.get(
+            [w.set_weights.remote(self._np_weights()) for w in self.workers], timeout=300
+        )
+
+    def cleanup(self) -> None:
+        for w in getattr(self, "workers", []):
+            try:
+                ray_tpu.get(w.stop.remote(), timeout=10)
+            except Exception:
+                pass
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        for s in getattr(self, "shards", []):
+            try:
+                ray_tpu.kill(s)
+            except Exception:
+                pass
+        self.workers = []
+        self.shards = []
+        eval_ws = getattr(self, "_eval_workers", None)
+        if eval_ws is not None:
+            eval_ws.stop()
+            self._eval_workers = None

@@ -1,0 +1,1 @@
+from ray_tpu.rllib.algorithms.ddppo.ddppo import DDPPO, DDPPOConfig  # noqa: F401

@@ -1,0 +1,276 @@
+"""DDPPO — decentralized distributed PPO.
+
+Reference: rllib/algorithms/ddppo/ddppo.py (Wijmans et al. 2019): sampling
+AND SGD both happen inside the rollout workers; gradients are averaged
+worker-to-worker with an allreduce (torch DDP over gloo/nccl in the
+reference) and each worker applies them locally, so parameters never
+transit the driver — it only coordinates rounds and aggregates metrics
+(`ddppo.py:90`: "gradients are computed on the workers ... all-reduce").
+
+TPU-native shape: the allreduce rides ray_tpu's collective plane
+(util/collective — XLA collectives over ICI when the group backend is
+"tpu", the CPU ring otherwise), the same plane the LearnerGroup uses. Every
+worker seeds the same params + optax state, and identical averaged
+gradients keep them bit-identical thereafter — asserted cheaply via a
+weight-digest check each round.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.ppo.ppo import PPOConfig, ppo_loss
+from ray_tpu.rllib.evaluation.rollout_worker import RolloutWorker
+
+
+class _DDPPOWorker(RolloutWorker):
+    """Rollout worker that also runs the PPO SGD locally, allreducing
+    gradients with its peers each minibatch."""
+
+    def __init__(self, *args, lr=3e-4, grad_clip=0.5, opt_seed=0, **kwargs):
+        super().__init__(*args, **kwargs)
+        import jax
+        import optax
+
+        from ray_tpu.rllib.core import rl_module
+
+        chain = []
+        if grad_clip:
+            chain.append(optax.clip_by_global_norm(grad_clip))
+        chain.append(optax.adam(lr))
+        self._tx = optax.chain(*chain)
+        # Same opt_seed everywhere -> identical initial params on every
+        # worker; identical averaged grads keep them in lockstep.
+        self._params = rl_module.init_params(jax.random.PRNGKey(opt_seed), self.spec)
+        self._opt_state = self._tx.init(self._params)
+        self._world = 1
+        self._group = None
+        spec = self.spec
+
+        def grads_fn(params, batch, cfg):
+            (loss, metrics), grads = jax.value_and_grad(
+                lambda p: ppo_loss(p, batch, spec, cfg), has_aux=True
+            )(params)
+            metrics = dict(metrics)
+            metrics["total_loss"] = loss
+            return grads, metrics
+
+        self._grads_fn = jax.jit(grads_fn)
+
+        def apply_fn(params, opt_state, grads):
+            updates, opt_state = self._tx.update(grads, opt_state, params)
+            params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+            return params, opt_state
+
+        self._apply_fn = jax.jit(apply_fn)
+
+    def init_collective(self, world_size: int, rank: int, backend: str, group_name: str):
+        from ray_tpu.util import collective
+
+        self._world = world_size
+        self._group = group_name
+        if world_size > 1:
+            collective.init_collective_group(
+                world_size=world_size, rank=rank, backend=backend, group_name=group_name
+            )
+        return True
+
+    def train_round(self, fragment_len: int, minibatch_size: int, num_sgd_iter: int,
+                    loss_cfg: dict, seed: int):
+        """One DDPPO round: sample locally, SGD locally, allreduce grads.
+
+        Every peer calls allreduce the same number of times per round
+        (identical fragment/minibatch geometry), which the collective plane
+        requires — minibatches() pads/trims identically on every worker.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        batch = self.sample(fragment_len, explore=True)
+        metrics: dict = {}
+        for epoch in range(num_sgd_iter):
+            for mb in batch.minibatches(min(minibatch_size, batch.count), seed=seed + epoch):
+                jb = {k: jnp.asarray(v) for k, v in mb.items()}
+                grads, metrics = self._grads_fn(self._params, jb, loss_cfg)
+                if self._world > 1:
+                    from ray_tpu.util import collective
+
+                    flat, treedef = jax.tree_util.tree_flatten(grads)
+                    reduced = [
+                        collective.allreduce(
+                            np.asarray(g) / self._world, group_name=self._group
+                        )
+                        for g in flat
+                    ]
+                    grads = jax.tree_util.tree_unflatten(
+                        treedef, [jnp.asarray(g) for g in reduced]
+                    )
+                self._params, self._opt_state = self._apply_fn(
+                    self._params, self._opt_state, grads
+                )
+        rewards, lens = self.env.pop_episode_stats()
+        digest = float(
+            sum(np.abs(np.asarray(x)).sum() for x in jax.tree_util.tree_leaves(self._params))
+        )
+        return (
+            {k: float(v) for k, v in metrics.items()},
+            batch.count,
+            rewards,
+            digest,
+        )
+
+    def get_weights(self):
+        import jax
+
+        return jax.tree_util.tree_map(np.asarray, self._params)
+
+
+class DDPPOConfig(PPOConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or DDPPO)
+        self.num_rollout_workers = 2
+        # Per-worker fragment per round (reference: rollout_fragment_length
+        # drives the per-worker batch; there is no global train_batch_size).
+        self.rollout_fragment_length = 100
+        self.sgd_minibatch_size = 64
+        self.num_sgd_iter = 4
+        self.collective_backend = "cpu"
+
+    def training(self, *, collective_backend: Optional[str] = None, **kwargs) -> "DDPPOConfig":
+        super().training(**kwargs)
+        if collective_backend is not None:
+            self.collective_backend = collective_backend
+        return self
+
+
+class DDPPO(Algorithm):
+    @classmethod
+    def get_default_config(cls) -> DDPPOConfig:
+        return DDPPOConfig(cls)
+
+    def setup(self, config: dict) -> None:
+        import gymnasium as gym
+
+        self.cleanup()
+        cfg: DDPPOConfig = self._algo_config
+        probe = gym.make(cfg.env) if isinstance(cfg.env, str) else cfg.env(dict(cfg.env_config))
+        from ray_tpu.rllib.models import ModelCatalog
+
+        self.module_spec = ModelCatalog.get_model_spec(
+            probe.observation_space, probe.action_space, cfg.model_config()
+        )
+        probe.close()
+        n = max(cfg.num_rollout_workers, 1)
+        worker_cls = ray_tpu.remote(num_cpus=1)(_DDPPOWorker)
+        self.workers = [
+            worker_cls.remote(
+                cfg.env, self.module_spec, i, max(cfg.num_envs_per_worker, 1),
+                dict(cfg.env_config), cfg.gamma, cfg.lambda_, cfg.seed,
+                cfg.observation_filter,
+                lr=cfg.lr, grad_clip=cfg.grad_clip, opt_seed=cfg.seed,
+            )
+            for i in range(n)
+        ]
+        group = f"ddppo_{id(self)}"
+        ray_tpu.get(
+            [
+                w.init_collective.remote(n, rank, cfg.collective_backend, group)
+                for rank, w in enumerate(self.workers)
+            ],
+            timeout=300,
+        )
+        self._timesteps_total = 0
+        self._round = 0
+        self._episode_reward_window: list = []
+
+    def training_step(self) -> dict:
+        cfg: DDPPOConfig = self._algo_config
+        loss_cfg = {
+            "clip_param": cfg.clip_param,
+            "vf_clip_param": cfg.vf_clip_param,
+            "vf_loss_coeff": cfg.vf_loss_coeff,
+            "entropy_coeff": cfg.entropy_coeff,
+        }
+        self._round += 1
+        refs = [
+            w.train_round.remote(
+                cfg.rollout_fragment_length, cfg.sgd_minibatch_size,
+                cfg.num_sgd_iter, loss_cfg, self._round * 10_000,
+            )
+            for w in self.workers
+        ]
+        results = ray_tpu.get(refs, timeout=600)
+        digests = [r[3] for r in results]
+        # Lockstep invariant: decentralized updates must agree bit-for-bit
+        # (they started identical and applied identical averaged grads).
+        if max(digests) - min(digests) > 1e-4 * max(1.0, abs(digests[0])):
+            raise RuntimeError(f"DDPPO workers diverged: digests={digests}")
+        metrics: dict = {}
+        for m, count, rewards, _ in results:
+            metrics = m
+            self._timesteps_total += count
+            self._episode_reward_window += rewards
+        self._episode_reward_window = self._episode_reward_window[-100:]
+        metrics["num_env_steps_sampled_this_iter"] = sum(r[1] for r in results)
+        return metrics
+
+    def step(self) -> dict:
+        import time
+
+        t0 = time.time()
+        result = self.training_step()
+        result["episode_reward_mean"] = (
+            float(np.mean(self._episode_reward_window))
+            if self._episode_reward_window
+            else float("nan")
+        )
+        result["timesteps_total"] = self._timesteps_total
+        result["time_this_iter_s"] = time.time() - t0
+        return result
+
+    def get_policy_weights(self):
+        return ray_tpu.get(self.workers[0].get_weights.remote(), timeout=60)
+
+    def compute_single_action(self, obs, explore: bool = False):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib.core import rl_module
+
+        params = jax.tree_util.tree_map(jnp.asarray, self.get_policy_weights())
+        actions, _, _ = rl_module.sample_actions(
+            params, jnp.asarray(np.asarray(obs, np.float32))[None],
+            jax.random.PRNGKey(0), self.module_spec, explore,
+        )
+        a = np.asarray(actions)[0]
+        return a.item() if self.module_spec.discrete else a
+
+    def save_checkpoint(self):
+        from ray_tpu.air.checkpoint import Checkpoint
+
+        return Checkpoint.from_dict(
+            {"weights": self.get_policy_weights(), "timesteps": self._timesteps_total}
+        )
+
+    def load_checkpoint(self, checkpoint) -> None:
+        data = checkpoint.to_dict()
+        ray_tpu.get(
+            [w.set_weights.remote(data["weights"]) for w in self.workers], timeout=300
+        )
+        self._timesteps_total = data.get("timesteps", 0)
+
+    def cleanup(self) -> None:
+        for w in getattr(self, "workers", []):
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self.workers = []
+        eval_ws = getattr(self, "_eval_workers", None)
+        if eval_ws is not None:
+            eval_ws.stop()
+            self._eval_workers = None

@@ -1,0 +1,1 @@
+from ray_tpu.rllib.algorithms.simple_q.simple_q import SimpleQ, SimpleQConfig  # noqa: F401
