@@ -1,0 +1,1 @@
+from ray_tpu.rllib.algorithms.maml.maml import MAML, MAMLConfig  # noqa: F401

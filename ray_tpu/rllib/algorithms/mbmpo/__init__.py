@@ -1,0 +1,1 @@
+from ray_tpu.rllib.algorithms.mbmpo.mbmpo import MBMPO, MBMPOConfig  # noqa: F401
