@@ -1,0 +1,1 @@
+from ray_tpu.rllib.algorithms.alpha_star.alpha_star import AlphaStar, AlphaStarConfig  # noqa: F401

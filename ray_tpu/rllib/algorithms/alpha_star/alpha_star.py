@@ -1,0 +1,385 @@
+"""AlphaStar — league-based self-play training.
+
+Reference: rllib/algorithms/alpha_star/ (alpha_star.py, league_builder.py;
+Vinyals et al. 2019): a LEAGUE of policies trains concurrently —
+* the MAIN agent, trained with prioritized fictitious self-play (PFSP)
+  against frozen league snapshots (hard opponents weighted up) mixed with
+  self-play against its live self;
+* MAIN EXPLOITERS, trained only against the live main agent to find its
+  weaknesses;
+* LEAGUE EXPLOITERS, trained PFSP against the whole league;
+and the main agent is periodically FROZEN into the league as a new
+snapshot (league_builder.py AlphaStarLeagueBuilder: the same three slot
+kinds, snapshot-on-winrate). Win-rates drive both matchmaking and
+snapshotting.
+
+This is the league ARCHITECTURE on simultaneous-move zero-sum envs
+(env/two_player.py protocol); the reference binds the same machinery to
+StarCraft II. Policy updates are jitted A2C steps on the learner side of
+each match; opponents act frozen. Scripted opponents can be seeded into
+the league (tests anchor on exploiting a biased rock-paper-scissors
+player).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.policy.sample_batch import (
+    ACTIONS,
+    ADVANTAGES,
+    DONES,
+    LOGPS,
+    OBS,
+    REWARDS,
+    VALUE_TARGETS,
+    VF_PREDS,
+    SampleBatch,
+    compute_gae,
+)
+
+
+class _LeagueMember:
+    """One frozen league entry: a parameter snapshot or a scripted actor."""
+
+    def __init__(self, name: str, params=None, scripted: Optional[Callable] = None):
+        self.name = name
+        self.params = params
+        self.scripted = scripted
+        # Per-learner win-rate bookkeeping: learner name -> [wins, games].
+        self.results: Dict[str, List[float]] = {}
+
+    def record(self, learner: str, win: float):
+        w, g = self.results.get(learner, [0.0, 0.0])
+        self.results[learner] = [w + win, g + 1.0]
+
+    def winrate_of(self, learner: str) -> float:
+        w, g = self.results.get(learner, [0.0, 0.0])
+        return w / g if g else 0.5
+
+
+class AlphaStarConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or AlphaStar)
+        self.lr = 5e-3
+        self.entropy_coeff = 0.01
+        self.vf_loss_coeff = 0.5
+        self.grad_clip = 1.0
+        self.num_main_exploiters = 1
+        self.num_league_exploiters = 1
+        self.episodes_per_slot = 8
+        # Main-agent matchmaking mix (reference league_builder defaults:
+        # 35% self-play / PFSP for the rest; we fold old-main PFSP in).
+        self.self_play_fraction = 0.35
+        self.snapshot_interval = 10       # iterations between league freezes
+        self.snapshot_min_winrate = 0.6   # freeze only a main that's winning
+        self.pfsp_power = 2.0             # (1 - winrate)^power weighting
+        # Scripted league seeds: list of (name, callable(obs)->action).
+        self.scripted_league_seeds: list = []
+
+    def training(self, *, entropy_coeff=None, vf_loss_coeff=None,
+                 num_main_exploiters=None, num_league_exploiters=None,
+                 episodes_per_slot=None, self_play_fraction=None,
+                 snapshot_interval=None, snapshot_min_winrate=None,
+                 pfsp_power=None, scripted_league_seeds=None, **kwargs) -> "AlphaStarConfig":
+        super().training(**kwargs)
+        for name, val in (
+            ("entropy_coeff", entropy_coeff),
+            ("vf_loss_coeff", vf_loss_coeff),
+            ("num_main_exploiters", num_main_exploiters),
+            ("num_league_exploiters", num_league_exploiters),
+            ("episodes_per_slot", episodes_per_slot),
+            ("self_play_fraction", self_play_fraction),
+            ("snapshot_interval", snapshot_interval),
+            ("snapshot_min_winrate", snapshot_min_winrate),
+            ("pfsp_power", pfsp_power),
+            ("scripted_league_seeds", scripted_league_seeds),
+        ):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+class AlphaStar(Algorithm):
+    @classmethod
+    def get_default_config(cls) -> AlphaStarConfig:
+        return AlphaStarConfig(cls)
+
+    def setup(self, config: dict) -> None:
+        import jax
+        import optax
+
+        self.cleanup()
+        cfg: AlphaStarConfig = self._algo_config
+        self.env = cfg.env(dict(cfg.env_config)) if callable(cfg.env) else cfg.env
+        assert hasattr(self.env, "step") and hasattr(self.env, "reset"), "two-player env required"
+        import gymnasium as gym
+
+        assert isinstance(self.env.action_space, gym.spaces.Discrete), (
+            "AlphaStar league (this build) supports discrete simultaneous-move envs"
+        )
+        from ray_tpu.rllib.models import ModelCatalog
+
+        self.module_spec = ModelCatalog.get_model_spec(
+            self.env.observation_space, self.env.action_space, cfg.model_config()
+        )
+        from ray_tpu.rllib.core import rl_module
+
+        # Learning slots: main + exploiters, each with its own optimizer.
+        self._tx = optax.chain(
+            optax.clip_by_global_norm(cfg.grad_clip or 1e9), optax.adam(cfg.lr)
+        )
+        self.slots: Dict[str, dict] = {}
+        names = (
+            ["main"]
+            + [f"main_exploiter_{i}" for i in range(cfg.num_main_exploiters)]
+            + [f"league_exploiter_{i}" for i in range(cfg.num_league_exploiters)]
+        )
+        for i, name in enumerate(names):
+            params = rl_module.init_params(jax.random.PRNGKey(cfg.seed + i), self.module_spec)
+            self.slots[name] = {"params": params, "opt": self._tx.init(params)}
+        # League of frozen members; scripted seeds join immediately.
+        self.league: List[_LeagueMember] = [
+            _LeagueMember(name, scripted=fn) for name, fn in cfg.scripted_league_seeds
+        ]
+        self._snapshots = 0
+        spec = self.module_spec
+
+        def a2c_step(params, opt_state, batch, cfg_):
+            def loss_fn(p):
+                logp, entropy, value = rl_module.action_logp_and_entropy(
+                    p, batch[OBS], batch[ACTIONS], spec
+                )
+                adv = batch[ADVANTAGES]
+                adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+                pl = -(logp * adv).mean()
+                vl = ((value - batch[VALUE_TARGETS]) ** 2).mean()
+                ent = entropy.mean()
+                return pl + cfg_["vf"] * vl - cfg_["ent"] * ent, (pl, vl, ent)
+
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            updates, opt_state = self._tx.update(grads, opt_state, params)
+            params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+            return params, opt_state, loss
+
+        self._a2c_step = jax.jit(a2c_step)
+        self._sample_fn = jax.jit(
+            lambda p, o, k: rl_module.sample_actions(p, o, k, spec, True)
+        )
+        self._rng = jax.random.PRNGKey(cfg.seed + 99)
+        self._np_rng = np.random.default_rng(cfg.seed)
+        self._timesteps_total = 0
+        self._episode_reward_window: list = []
+        self._iter = 0
+
+    # -- matchmaking (reference: league_builder PFSP) ----------------------
+    def _pfsp_pick(self, learner: str, candidates: List[_LeagueMember]) -> _LeagueMember:
+        cfg: AlphaStarConfig = self._algo_config
+        if not candidates:
+            return None
+        # Hard opponents (low learner win-rate) weighted up.
+        w = np.array([
+            (1.0 - m.winrate_of(learner)) ** cfg.pfsp_power + 1e-3 for m in candidates
+        ])
+        return candidates[self._np_rng.choice(len(candidates), p=w / w.sum())]
+
+    def _choose_opponent(self, slot_name: str):
+        """Returns (kind, member_or_params): per-slot matchmaking rules."""
+        cfg: AlphaStarConfig = self._algo_config
+        if slot_name.startswith("main_exploiter"):
+            return "live_main", None
+        if slot_name.startswith("league_exploiter"):
+            m = self._pfsp_pick(slot_name, self.league)
+            return ("league", m) if m is not None else ("live_main", None)
+        # Main agent: self-play fraction vs live self, else PFSP league.
+        if not self.league or self._np_rng.random() < cfg.self_play_fraction:
+            return "self", None
+        return "league", self._pfsp_pick(slot_name, self.league)
+
+    # -- match execution ---------------------------------------------------
+    def _opponent_actor(self, kind, member):
+        import jax.numpy as jnp
+        import jax
+
+        if kind in ("self", "live_main"):
+            params = self.slots["main"]["params"]
+        elif member.scripted is not None:
+            fn = member.scripted
+            return lambda obs: int(fn(obs))
+        else:
+            params = member.params
+
+        def act(obs):
+            self._rng, key = jax.random.split(self._rng)
+            a, _, _ = self._sample_fn(params, jnp.asarray(obs, jnp.float32)[None], key)
+            return int(np.asarray(a)[0])
+
+        return act
+
+    def _play_episode(self, learner_params, opponent_act):
+        """One episode; returns (learner fragment cols, learner return)."""
+        import jax
+        import jax.numpy as jnp
+
+        obs_a, obs_b = self.env.reset()
+        cols = {k: [] for k in (OBS, ACTIONS, REWARDS, DONES, LOGPS, VF_PREDS)}
+        total = 0.0
+        while True:
+            o = np.asarray(obs_a, np.float32)
+            self._rng, key = jax.random.split(self._rng)
+            a, logp, v = self._sample_fn(learner_params, jnp.asarray(o)[None], key)
+            act_a = int(np.asarray(a)[0])
+            act_b = opponent_act(np.asarray(obs_b, np.float32))
+            obs_a, obs_b, r_a, _, done = self.env.step(act_a, act_b)
+            total += r_a
+            cols[OBS].append(o)
+            cols[ACTIONS].append(np.int32(act_a))
+            cols[REWARDS].append(np.float32(r_a))
+            cols[DONES].append(np.float32(done))
+            cols[LOGPS].append(np.asarray(logp)[0])
+            cols[VF_PREDS].append(np.asarray(v)[0])
+            self._timesteps_total += 1
+            if done:
+                break
+        frag = SampleBatch({k: np.stack(v) for k, v in cols.items()})
+        cfg = self._algo_config
+        frag = compute_gae(frag, 0.0, cfg.gamma, cfg.lambda_)
+        return frag, total
+
+    def training_step(self) -> dict:
+        import jax.numpy as jnp
+
+        cfg: AlphaStarConfig = self._algo_config
+        self._iter += 1
+        loss_cfg = {"vf": cfg.vf_loss_coeff, "ent": cfg.entropy_coeff}
+        metrics: dict = {}
+        for name, slot in self.slots.items():
+            frags, wins, games = [], 0.0, 0
+            for _ in range(cfg.episodes_per_slot):
+                kind, member = self._choose_opponent(name)
+                opponent = self._opponent_actor(kind, member)
+                frag, ret = self._play_episode(slot["params"], opponent)
+                frags.append(frag)
+                win = 1.0 if ret > 0 else (0.5 if ret == 0 else 0.0)
+                wins += win
+                games += 1
+                if kind == "league" and member is not None:
+                    member.record(name, win)
+                if name == "main":
+                    self._episode_reward_window.append(ret)
+            batch = SampleBatch.concat_samples(frags)
+            jb = {k: jnp.asarray(v) for k, v in batch.items()}
+            slot["params"], slot["opt"], loss = self._a2c_step(
+                slot["params"], slot["opt"], jb, loss_cfg
+            )
+            metrics[f"{name}/winrate"] = wins / max(games, 1)
+            metrics[f"{name}/loss"] = float(loss)
+        # League building: freeze a winning main (reference: snapshot when
+        # the main agent's league win-rate clears the bar).
+        if (
+            self._iter % cfg.snapshot_interval == 0
+            and metrics.get("main/winrate", 0.0) >= cfg.snapshot_min_winrate
+        ):
+            self._freeze("main")
+        self._episode_reward_window = self._episode_reward_window[-100:]
+        metrics["league_size"] = len(self.league)
+        return metrics
+
+    def _freeze(self, slot_name: str):
+        import jax
+
+        self._snapshots += 1
+        self.league.append(
+            _LeagueMember(
+                f"{slot_name}_snap_{self._snapshots}",
+                params=jax.tree_util.tree_map(lambda x: x, self.slots[slot_name]["params"]),
+            )
+        )
+
+    def winrate_vs(self, member_name: str, learner: str = "main",
+                   episodes: int = 20) -> float:
+        """Evaluation probe: fresh matches of `learner` against a named
+        league member (bypasses the PFSP bookkeeping)."""
+        member = next(m for m in self.league if m.name == member_name)
+        opponent = self._opponent_actor("league", member)
+        wins = 0.0
+        for _ in range(episodes):
+            _, ret = self._play_episode(self.slots[learner]["params"], opponent)
+            wins += 1.0 if ret > 0 else (0.5 if ret == 0 else 0.0)
+        return wins / episodes
+
+    def step(self) -> dict:
+        import time
+
+        t0 = time.time()
+        result = self.training_step()
+        result["episode_reward_mean"] = (
+            float(np.mean(self._episode_reward_window))
+            if self._episode_reward_window
+            else float("nan")
+        )
+        result["timesteps_total"] = self._timesteps_total
+        result["time_this_iter_s"] = time.time() - t0
+        return result
+
+    def compute_single_action(self, obs, explore: bool = False):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib.core import rl_module
+
+        actions, _, _ = rl_module.sample_actions(
+            self.slots["main"]["params"],
+            jnp.asarray(np.asarray(obs, np.float32))[None],
+            jax.random.PRNGKey(0), self.module_spec, explore,
+        )
+        return int(np.asarray(actions)[0])
+
+    def save_checkpoint(self):
+        import jax
+
+        from ray_tpu.air.checkpoint import Checkpoint
+
+        return Checkpoint.from_dict({
+            "slots": {
+                n: jax.tree_util.tree_map(np.asarray, s["params"])
+                for n, s in self.slots.items()
+            },
+            "league": [
+                (m.name, jax.tree_util.tree_map(np.asarray, m.params))
+                for m in self.league
+                if m.params is not None
+            ],
+            "timesteps": self._timesteps_total,
+        })
+
+    def load_checkpoint(self, checkpoint) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        data = checkpoint.to_dict()
+        for n, w in data["slots"].items():
+            if n in self.slots:
+                self.slots[n]["params"] = jax.tree_util.tree_map(jnp.asarray, w)
+        # Scripted seeds persist via config; param snapshots reload here.
+        self.league = [m for m in self.league if m.scripted is not None] + [
+            _LeagueMember(name, params=jax.tree_util.tree_map(jnp.asarray, w))
+            for name, w in data.get("league", [])
+        ]
+        self._timesteps_total = data.get("timesteps", 0)
+
+    def cleanup(self) -> None:
+        env = getattr(self, "env", None)
+        if env is not None:
+            try:
+                env.close()
+            except Exception:
+                pass
+            self.env = None
+        eval_ws = getattr(self, "_eval_workers", None)
+        if eval_ws is not None:
+            eval_ws.stop()
+            self._eval_workers = None

@@ -1,0 +1,4 @@
+from ray_tpu.rllib.algorithms.leela_chess_zero.leela_chess_zero import (  # noqa: F401
+    LeelaChessZero,
+    LeelaChessZeroConfig,
+)

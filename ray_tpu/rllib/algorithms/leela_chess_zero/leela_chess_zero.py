@@ -1,0 +1,393 @@
+"""LeelaChessZero — two-player zero-sum AlphaZero with the Lc0 network heads.
+
+Reference: rllib/algorithms/leela_chess_zero/ (leela_chess_zero.py,
+lc0_mcts.py, lc0_model.py): AlphaZero-style self-play for alternating-move
+zero-sum board games, with the Lc0 network additions over plain AlphaZero —
+a POLICY head masked to legal moves, a VALUE head (tanh, mover's
+perspective), and a MOVES-LEFT head (Lc0's MLH, regressing remaining game
+length; used as a training auxiliary that sharpens endgame play). The
+reference binds it to chess through python-chess; here the algorithm runs
+on any env/board_env.BoardGameEnv (TicTacToe in-tree — the image carries
+no chess move-generator), which is the same separation the reference draws
+between algorithm and MultiAgentEnv board wrapper.
+
+Differences from the in-tree single-player AlphaZero (alpha_zero/):
+* search values SIGN-FLIP between plies (zero-sum, alternating moves);
+* no ranked-rewards transform — outcomes are already ±1/0;
+* legal-action masks gate both the network policy and the search;
+* the extra moves-left head, trained on |remaining plies|.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+
+
+# ---------------------------------------------------------------------------
+# Lc0-style network: shared torso, policy/value/moves-left heads.
+# ---------------------------------------------------------------------------
+
+def init_lc0_params(key, obs_dim: int, n_actions: int, hiddens):
+    import jax
+
+    dims = (obs_dim,) + tuple(hiddens)
+    ks = jax.random.split(key, len(dims) + 2)
+    torso = [
+        {
+            "w": jax.random.normal(k, (din, dout)) * (2.0 / din) ** 0.5,
+            "b": jax.numpy.zeros(dout),
+        }
+        for k, din, dout in zip(ks[:-3], dims[:-1], dims[1:])
+    ]
+    h = dims[-1]
+    s = h**-0.5
+
+    def head(k, dout):
+        return {"w": jax.random.normal(k, (h, dout)) * s, "b": jax.numpy.zeros(dout)}
+
+    return {
+        "torso": torso,
+        "policy": head(ks[-3], n_actions),
+        "value": head(ks[-2], 1),
+        "mlh": head(ks[-1], 1),
+    }
+
+
+def lc0_forward(params, obs, legal_mask):
+    """Returns (masked log-policy, value in [-1,1], moves_left >= 0)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = obs
+    for layer in params["torso"]:
+        x = jnp.tanh(x @ layer["w"] + layer["b"])
+    logits = x @ params["policy"]["w"] + params["policy"]["b"]
+    logits = jnp.where(legal_mask, logits, -1e9)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    value = jnp.tanh(x @ params["value"]["w"] + params["value"]["b"])[..., 0]
+    moves_left = jax.nn.softplus(x @ params["mlh"]["w"] + params["mlh"]["b"])[..., 0]
+    return logp, value, moves_left
+
+
+# ---------------------------------------------------------------------------
+# Zero-sum PUCT search (lc0_mcts.py analog).
+# ---------------------------------------------------------------------------
+
+class _Node:
+    __slots__ = ("state", "obs", "legal", "done", "reward", "children", "N", "W", "P")
+
+    def __init__(self, state, obs, legal, done, reward):
+        self.state = state
+        self.obs = obs
+        self.legal = legal
+        self.done = done
+        self.reward = reward  # terminal reward to the player who JUST moved
+        self.children = {}
+        n = len(legal)
+        self.N = np.zeros(n, np.float32)
+        self.W = np.zeros(n, np.float32)
+        self.P = np.zeros(n, np.float32)
+
+
+class ZeroSumMCTS:
+    """PUCT over a cloneable BoardGameEnv; values flip sign per ply."""
+
+    def __init__(self, env, predict, *, num_sims=50, c_puct=1.5,
+                 dirichlet_alpha=0.6, dirichlet_eps=0.25, rng=None):
+        self.env = env
+        self.predict = predict  # obs, legal -> (prior probs, value)
+        self.num_sims = num_sims
+        self.c_puct = c_puct
+        self.alpha = dirichlet_alpha
+        self.eps = dirichlet_eps
+        self.rng = rng or np.random.default_rng()
+
+    def search(self, temperature: float = 1.0):
+        root_state = self.env.get_state()
+        root_obs = self.env.observe()
+        root = _Node(root_state, root_obs, self.env.legal_actions(), False, 0.0)
+        priors, _ = self.predict(root_obs, root.legal)
+        noise = self.rng.dirichlet([self.alpha] * int(root.legal.sum()))
+        p = priors.copy()
+        p[root.legal] = (1 - self.eps) * p[root.legal] + self.eps * noise
+        root.P = p
+
+        for _ in range(self.num_sims):
+            node = root
+            path = []
+            self.env.set_state(node.state)
+            # -- selection --
+            while True:
+                if node.done:
+                    value = 0.0 if node.reward == 0 else -node.reward
+                    # value is from the perspective of the player to move at
+                    # `node` (who just lost if reward=1 for the mover).
+                    break
+                a = self._select(node)
+                path.append((node, a))
+                if a not in node.children:
+                    # -- expansion --
+                    self.env.set_state(node.state)
+                    obs, reward, done = self.env.step(a)
+                    child = _Node(
+                        self.env.get_state(), obs,
+                        self.env.legal_actions() if not done else np.zeros_like(node.legal),
+                        done, reward,
+                    )
+                    node.children[a] = child
+                    if done:
+                        value = 0.0 if reward == 0 else -reward
+                    else:
+                        probs, v = self.predict(obs, child.legal)
+                        child.P = probs
+                        value = v
+                    node = child
+                    break
+                node = node.children[a]
+
+            # -- backup with sign flip per ply --
+            for parent, a in reversed(path):
+                value = -value  # child's perspective -> parent's
+                parent.N[a] += 1.0
+                parent.W[a] += value
+
+        visits = root.N
+        if temperature <= 1e-6:
+            pi = np.zeros_like(visits)
+            pi[visits.argmax()] = 1.0
+        else:
+            v = visits ** (1.0 / temperature)
+            pi = v / v.sum() if v.sum() > 0 else root.legal / root.legal.sum()
+        self.env.set_state(root_state)
+        q_root = float((root.W.sum() / max(root.N.sum(), 1.0)))
+        return pi, q_root
+
+    def _select(self, node: _Node) -> int:
+        total = node.N.sum()
+        q = np.where(node.N > 0, node.W / np.maximum(node.N, 1), 0.0)
+        u = self.c_puct * node.P * math.sqrt(total + 1.0) / (1.0 + node.N)
+        score = np.where(node.legal, q + u, -np.inf)
+        return int(score.argmax())
+
+
+class LeelaChessZeroConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or LeelaChessZero)
+        self.lr = 2e-3
+        self.num_sims = 60
+        self.c_puct = 1.5
+        self.dirichlet_alpha = 0.6
+        self.dirichlet_eps = 0.25
+        self.games_per_iter = 12
+        self.temperature_moves = 4   # sample by visits for the first k plies
+        self.train_batch_size = 256
+        self.sgd_iters = 4
+        self.replay_games = 400
+        self.mlh_loss_coeff = 0.1
+        self.model_hiddens = (128, 128)
+
+    def training(self, *, num_sims=None, c_puct=None, dirichlet_alpha=None,
+                 dirichlet_eps=None, games_per_iter=None, temperature_moves=None,
+                 sgd_iters=None, replay_games=None, mlh_loss_coeff=None, **kwargs):
+        super().training(**kwargs)
+        for name, val in (
+            ("num_sims", num_sims), ("c_puct", c_puct),
+            ("dirichlet_alpha", dirichlet_alpha), ("dirichlet_eps", dirichlet_eps),
+            ("games_per_iter", games_per_iter), ("temperature_moves", temperature_moves),
+            ("sgd_iters", sgd_iters), ("replay_games", replay_games),
+            ("mlh_loss_coeff", mlh_loss_coeff),
+        ):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+class LeelaChessZero(Algorithm):
+    @classmethod
+    def get_default_config(cls) -> LeelaChessZeroConfig:
+        return LeelaChessZeroConfig(cls)
+
+    def setup(self, config: dict) -> None:
+        import jax
+        import optax
+
+        self.cleanup()
+        cfg: LeelaChessZeroConfig = self._algo_config
+        self.env = cfg.env(dict(cfg.env_config)) if callable(cfg.env) else cfg.env
+        assert hasattr(self.env, "legal_actions") and hasattr(self.env, "get_state"), (
+            "LeelaChessZero needs a BoardGameEnv (legal_actions/get_state/set_state)"
+        )
+        self.obs_dim = int(np.prod(self.env.observation_space.shape))
+        self.n_actions = int(self.env.action_space.n)
+        self.params = init_lc0_params(
+            jax.random.PRNGKey(cfg.seed), self.obs_dim, self.n_actions, cfg.model_hiddens
+        )
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.params)
+        mlh_coeff = cfg.mlh_loss_coeff
+
+        def predict(params, obs, legal):
+            logp, v, _ = lc0_forward(params, obs[None], legal[None])
+            return jax.numpy.exp(logp)[0], v[0]
+
+        self._predict = jax.jit(predict)
+
+        def update(params, opt_state, obs, legal, target_pi, target_v, target_ml):
+            def loss_fn(p):
+                logp, v, ml = lc0_forward(p, obs, legal)
+                pi_loss = -(target_pi * logp).sum(-1).mean()
+                v_loss = ((v - target_v) ** 2).mean()
+                ml_loss = ((ml - target_ml) ** 2).mean()
+                return pi_loss + v_loss + mlh_coeff * ml_loss, (pi_loss, v_loss, ml_loss)
+
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+            return params, opt_state, loss, aux
+
+        self._update = jax.jit(update)
+        self._np_rng = np.random.default_rng(cfg.seed)
+        # Replay of recent self-play positions (obs, legal, pi, z, ml).
+        self._replay: list = []
+        self._timesteps_total = 0
+        self._episode_reward_window: list = []
+
+    def _mcts(self) -> ZeroSumMCTS:
+        cfg = self._algo_config
+
+        def predict(obs, legal):
+            p, v = self._predict(self.params, np.asarray(obs, np.float32), np.asarray(legal))
+            return np.asarray(p), float(v)
+
+        return ZeroSumMCTS(
+            self.env, predict, num_sims=cfg.num_sims, c_puct=cfg.c_puct,
+            dirichlet_alpha=cfg.dirichlet_alpha, dirichlet_eps=cfg.dirichlet_eps,
+            rng=self._np_rng,
+        )
+
+    def _self_play_game(self):
+        """One self-play game; returns per-position training rows."""
+        cfg: LeelaChessZeroConfig = self._algo_config
+        obs = self.env.reset()
+        mcts = self._mcts()
+        rows = []  # (obs, legal, pi, player_sign)
+        outcome = 0.0  # from player +1 (first mover) perspective
+        sign = 1.0
+        ply = 0
+        while True:
+            legal = self.env.legal_actions()
+            temp = 1.0 if ply < cfg.temperature_moves else 1e-7
+            pi, _ = mcts.search(temperature=temp)
+            rows.append((np.asarray(obs, np.float32), legal.copy(), pi, sign, ply))
+            a = int(self._np_rng.choice(self.n_actions, p=pi / pi.sum()))
+            obs, reward, done = self.env.step(a)
+            self._timesteps_total += 1
+            ply += 1
+            if done:
+                outcome = reward * sign  # mover's reward -> first-mover frame
+                break
+            sign = -sign
+        total_plies = ply
+        out = []
+        for o, legal, pi, s, p_idx in rows:
+            # z from THIS position's player-to-move perspective.
+            z = outcome * s
+            moves_left = float(total_plies - p_idx)
+            out.append((o, legal, pi.astype(np.float32), np.float32(z), np.float32(moves_left)))
+        return out, outcome
+
+    def training_step(self) -> dict:
+        import jax.numpy as jnp
+
+        cfg: LeelaChessZeroConfig = self._algo_config
+        first_mover_results = []
+        for _ in range(cfg.games_per_iter):
+            rows, outcome = self._self_play_game()
+            self._replay.append(rows)
+            first_mover_results.append(outcome)
+        self._replay = self._replay[-cfg.replay_games:]
+        flat = [r for game in self._replay for r in game]
+        metrics: dict = {}
+        if len(flat) >= cfg.train_batch_size:
+            for _ in range(cfg.sgd_iters):
+                idx = self._np_rng.choice(len(flat), cfg.train_batch_size, replace=False)
+                obs = jnp.asarray(np.stack([flat[i][0] for i in idx]))
+                legal = jnp.asarray(np.stack([flat[i][1] for i in idx]))
+                pi = jnp.asarray(np.stack([flat[i][2] for i in idx]))
+                z = jnp.asarray(np.stack([flat[i][3] for i in idx]))
+                ml = jnp.asarray(np.stack([flat[i][4] for i in idx]))
+                self.params, self.opt_state, loss, aux = self._update(
+                    self.params, self.opt_state, obs, legal, pi, z, ml
+                )
+            metrics = {
+                "total_loss": float(loss),
+                "policy_loss": float(aux[0]),
+                "value_loss": float(aux[1]),
+                "moves_left_loss": float(aux[2]),
+            }
+        self._episode_reward_window += first_mover_results
+        self._episode_reward_window = self._episode_reward_window[-100:]
+        metrics["replay_positions"] = len(flat)
+        # Draw rate is the convergence signal on solved games (perfect
+        # tic-tac-toe play is all draws).
+        metrics["draw_rate"] = float(np.mean([r == 0.0 for r in first_mover_results]))
+        return metrics
+
+    def step(self) -> dict:
+        import time
+
+        t0 = time.time()
+        result = self.training_step()
+        result["episode_reward_mean"] = (
+            float(np.mean(self._episode_reward_window))
+            if self._episode_reward_window
+            else float("nan")
+        )
+        result["timesteps_total"] = self._timesteps_total
+        result["time_this_iter_s"] = time.time() - t0
+        return result
+
+    def compute_single_action(self, obs=None, explore: bool = False, num_sims: Optional[int] = None):
+        """Best move for the env's CURRENT position by fresh search (greedy;
+        the board protocol is stateful, so obs is taken from the env)."""
+        mcts = self._mcts()
+        if num_sims:
+            mcts.num_sims = num_sims
+        pi, _ = mcts.search(temperature=1e-7)
+        return int(pi.argmax())
+
+    def save_checkpoint(self):
+        import jax
+
+        from ray_tpu.air.checkpoint import Checkpoint
+
+        return Checkpoint.from_dict({
+            "weights": jax.tree_util.tree_map(np.asarray, self.params),
+            "timesteps": self._timesteps_total,
+        })
+
+    def load_checkpoint(self, checkpoint) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        data = checkpoint.to_dict()
+        self.params = jax.tree_util.tree_map(jnp.asarray, data["weights"])
+        self._timesteps_total = data.get("timesteps", 0)
+
+    def cleanup(self) -> None:
+        env = getattr(self, "env", None)
+        if env is not None:
+            try:
+                env.close()
+            except Exception:
+                pass
+            self.env = None
+        eval_ws = getattr(self, "_eval_workers", None)
+        if eval_ws is not None:
+            eval_ws.stop()
+            self._eval_workers = None

@@ -1,0 +1,106 @@
+"""Tests for LeelaChessZero (two-player zero-sum AlphaZero, Lc0 heads).
+
+Mirrors the reference's leela_chess_zero tests in spirit on the in-tree
+TicTacToe board: the zero-sum search must be sound (sign-flipped backups
+find the tactical move), the value/policy/moves-left heads must train, and
+search+net must dominate a random player.
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib.env.board_env import TicTacToeEnv
+
+
+def test_tictactoe_env_protocol():
+    env = TicTacToeEnv()
+    obs = env.reset()
+    assert obs.shape == (9,) and not obs.any()
+    assert env.legal_actions().all()
+    # X plays 0, O plays 3, X plays 1, O plays 4, X plays 2 -> X wins row 0.
+    for a, expect_done in ((0, False), (3, False), (1, False), (4, False)):
+        obs, r, done = env.step(a)
+        assert r == 0.0 and done is expect_done
+    obs, r, done = env.step(2)
+    assert done and r == 1.0  # reward to the mover (X)
+    # State cloning round-trips.
+    env2 = TicTacToeEnv()
+    env2.reset()
+    env2.set_state(env.get_state())
+    assert np.array_equal(env2.observe(), env.observe())
+
+
+def test_zero_sum_mcts_finds_winning_move():
+    """With a uniform prior and no training, sign-flipped PUCT must still
+    find an immediate winning move (pure search soundness)."""
+    from ray_tpu.rllib.algorithms.leela_chess_zero.leela_chess_zero import ZeroSumMCTS
+
+    env = TicTacToeEnv()
+    env.reset()
+    # X: 0, O: 3, X: 1, O: 4 -> X to move, 2 wins immediately.
+    for a in (0, 3, 1, 4):
+        env.step(a)
+
+    def uniform_predict(obs, legal):
+        p = legal.astype(np.float32)
+        return p / p.sum(), 0.0
+
+    mcts = ZeroSumMCTS(env, uniform_predict, num_sims=200,
+                       dirichlet_eps=0.0, rng=np.random.default_rng(0))
+    pi, _ = mcts.search(temperature=1e-7)
+    assert pi.argmax() == 2, f"search missed the winning move: {pi}"
+
+
+def test_lc0_self_play_trains_and_beats_random():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from ray_tpu.rllib import LeelaChessZeroConfig
+
+    cfg = (
+        LeelaChessZeroConfig()
+        .environment(TicTacToeEnv)
+        .training(
+            lr=2e-3, num_sims=25, games_per_iter=8, sgd_iters=6,
+            train_batch_size=128, model_hiddens=(64, 64),
+        )
+        .debugging(seed=0)
+    )
+    algo = cfg.build()
+    algo.setup(cfg.to_dict())
+    try:
+        v_losses = []
+        for _ in range(6):
+            r = algo.step()
+            if "value_loss" in r:
+                v_losses.append(r["value_loss"])
+        assert v_losses, "network never trained (replay too small?)"
+        assert v_losses[-1] < v_losses[0], f"value head not learning: {v_losses}"
+        assert np.isfinite(r["moves_left_loss"])
+
+        # Search + trained net vs a random player: never lose across 20
+        # games (tic-tac-toe is a draw under correct play; random blunders).
+        rng = np.random.default_rng(1)
+        losses = 0
+        for g in range(20):
+            env = algo.env
+            env.reset()
+            agent_first = g % 2 == 0
+            agent_turn = agent_first
+            while True:
+                if agent_turn:
+                    a = algo.compute_single_action()
+                else:
+                    legal = np.flatnonzero(env.legal_actions())
+                    a = int(rng.choice(legal))
+                _, reward, done = env.step(a)
+                if done:
+                    if reward > 0 and not agent_turn:
+                        losses += 1
+                    break
+                agent_turn = not agent_turn
+        assert losses == 0, f"trained lc0 lost {losses}/20 games to random"
+        ckpt = algo.save_checkpoint()
+        algo.load_checkpoint(ckpt)
+    finally:
+        algo.cleanup()
