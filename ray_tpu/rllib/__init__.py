@@ -30,4 +30,13 @@ from ray_tpu.rllib.algorithms.crr import CRR, CRRConfig  # noqa: F401,E402
 from ray_tpu.rllib.algorithms.slateq import SlateQ, SlateQConfig  # noqa: F401,E402
 from ray_tpu.rllib.algorithms.alpha_zero import AlphaZero, AlphaZeroConfig  # noqa: F401,E402
 from ray_tpu.rllib.algorithms.dreamerv3 import DreamerV3, DreamerV3Config  # noqa: F401,E402
+from ray_tpu.rllib.algorithms.simple_q import SimpleQ, SimpleQConfig  # noqa: F401,E402
+from ray_tpu.rllib.algorithms.a3c import A3C, A3CConfig  # noqa: F401,E402
+from ray_tpu.rllib.algorithms.ddppo import DDPPO, DDPPOConfig  # noqa: F401,E402
+from ray_tpu.rllib.algorithms.apex_ddpg import ApexDDPG, ApexDDPGConfig  # noqa: F401,E402
+from ray_tpu.rllib.algorithms.maml import MAML, MAMLConfig  # noqa: F401,E402
+from ray_tpu.rllib.algorithms.mbmpo import MBMPO, MBMPOConfig  # noqa: F401,E402
+from ray_tpu.rllib.algorithms.alpha_star import AlphaStar, AlphaStarConfig  # noqa: F401,E402
+from ray_tpu.rllib.algorithms.leela_chess_zero import LeelaChessZero, LeelaChessZeroConfig  # noqa: F401,E402
+from ray_tpu.rllib.callbacks import DefaultCallbacks  # noqa: F401,E402
 from ray_tpu.rllib.env.external_env import ExternalEnv, ExternalEnvRunner  # noqa: F401,E402

@@ -47,6 +47,20 @@ class AlgorithmConfig:
         self.evaluation_num_workers = 1
         self.evaluation_duration = 10
         self.evaluation_duration_unit = "episodes"  # or "timesteps"
+        # Fault tolerance (reference: algorithm_config.py .fault_tolerance()):
+        # dead rollout workers are respawned up to max_worker_restarts times
+        # total; with recreate_failed_workers=False the set degrades instead.
+        self.recreate_failed_workers = True
+        self.max_worker_restarts = 100
+        # Reporting (reference: .reporting()):
+        self.metrics_num_episodes_for_smoothing = 100
+        self.min_time_s_per_iteration: Optional[float] = None
+        # Offline data (reference: .offline_data()); consumed by the offline
+        # families (MARWIL/BC/CQL/CRR/DT) which override these defaults.
+        self.input_ = None
+        self.output = None
+        # Callbacks class (reference: .callbacks()).
+        self.callbacks_class = None
         self.extra: dict = {}
 
     # -- fluent sections (reference: .environment/.rollouts/.training) ----
@@ -120,6 +134,68 @@ class AlgorithmConfig:
             self.seed = seed
         return self
 
+    def exploration(self, *, explore: Optional[bool] = None,
+                    exploration_config: Optional[dict] = None) -> "AlgorithmConfig":
+        """Exploration switches (reference: algorithm_config.py
+        .exploration()). ``explore`` gates stochastic action sampling at
+        compute-action time; ``exploration_config`` entries land on the
+        algorithm config's matching attributes (epsilon schedules for the
+        Q-family, noise scales for the deterministic-policy family — each
+        algo config declares its own)."""
+        if explore is not None:
+            self.explore = explore
+        if exploration_config:
+            self.update_from_dict(dict(exploration_config))
+        return self
+
+    def fault_tolerance(self, *, recreate_failed_workers: Optional[bool] = None,
+                        max_worker_restarts: Optional[int] = None) -> "AlgorithmConfig":
+        """Rollout-worker failure policy (reference: .fault_tolerance()):
+        respawn dead workers (WorkerSet._replace_worker) up to a budget, or
+        degrade to the survivors."""
+        if recreate_failed_workers is not None:
+            self.recreate_failed_workers = recreate_failed_workers
+        if max_worker_restarts is not None:
+            self.max_worker_restarts = max_worker_restarts
+        return self
+
+    def reporting(self, *, metrics_num_episodes_for_smoothing: Optional[int] = None,
+                  min_time_s_per_iteration: Optional[float] = None) -> "AlgorithmConfig":
+        """Result-shaping knobs (reference: .reporting()):
+        episode_reward_mean smoothing window and a minimum wall-clock per
+        train() iteration (step() keeps running training_steps until it is
+        reached — the reference's min_time_s_per_iteration semantics)."""
+        if metrics_num_episodes_for_smoothing is not None:
+            self.metrics_num_episodes_for_smoothing = metrics_num_episodes_for_smoothing
+        if min_time_s_per_iteration is not None:
+            self.min_time_s_per_iteration = min_time_s_per_iteration
+        return self
+
+    def offline_data(self, *, input_=None, output=None) -> "AlgorithmConfig":
+        """Offline dataset source/sink (reference: .offline_data()). The
+        offline families consume ``input_`` (path/glob/list/Dataset); online
+        families may set ``output`` to log rollouts (JSON writer)."""
+        if input_ is not None:
+            self.input_ = input_
+        if output is not None:
+            self.output = output
+        return self
+
+    def callbacks(self, callbacks_class) -> "AlgorithmConfig":
+        """Attach a DefaultCallbacks subclass (reference: .callbacks())."""
+        self.callbacks_class = callbacks_class
+        return self
+
+    def framework(self, framework: Optional[str] = None, **_ignored) -> "AlgorithmConfig":
+        """Parity shim: this stack is JAX-native; "jax" (or None) is the
+        only accepted value — naming torch/tf here is a porting bug we
+        surface loudly instead of silently training something else."""
+        if framework not in (None, "jax"):
+            raise ValueError(
+                f"framework {framework!r} unavailable: ray_tpu.rllib is JAX-native"
+            )
+        return self
+
     def update_from_dict(self, overrides: dict) -> "AlgorithmConfig":
         """Apply {attr: value} overrides; unknown keys land in .extra
         (shared by the CLI, tuned-example runner, and __init__)."""
@@ -156,7 +232,12 @@ class Algorithm(Trainable):
             self._algo_config = config
         else:
             self._algo_config = self.get_default_config().update_from_dict(config or {})
+        from ray_tpu.rllib.callbacks import make_callbacks
+
+        self.callbacks = make_callbacks(getattr(self._algo_config, "callbacks_class", None))
         super().__init__(config=self._algo_config.to_dict())
+        # Trainable.__init__ ran setup(); the algorithm is live now.
+        self.callbacks.on_algorithm_init(algorithm=self)
 
     @classmethod
     def get_default_config(cls) -> AlgorithmConfig:
@@ -197,6 +278,8 @@ class Algorithm(Trainable):
             lambda_=cfg.lambda_,
             seed=cfg.seed,
             observation_filter=getattr(cfg, "observation_filter", None),
+            recreate_failed_workers=getattr(cfg, "recreate_failed_workers", True),
+            max_worker_restarts=getattr(cfg, "max_worker_restarts", 100),
         )
         self.learner_group = self._build_learner_group(cfg)
         self.workers.sync_weights(self.learner_group.get_weights())
@@ -335,11 +418,16 @@ class Algorithm(Trainable):
     def train(self) -> dict:
         """One training iteration + (when due) an evaluation round attached
         under result["evaluation"] (reference: Algorithm.step wiring
-        evaluate() by evaluation_interval)."""
+        evaluate() by evaluation_interval), then the on_train_result
+        callback (which may mutate the result in place)."""
         result = super().train()
         interval = getattr(self._algo_config, "evaluation_interval", None)
         if interval and self.iteration % int(interval) == 0:
             result.update(self.evaluate())
+            self.callbacks.on_evaluate_end(
+                algorithm=self, evaluation_metrics=result.get("evaluation", {})
+            )
+        self.callbacks.on_train_result(algorithm=self, result=result)
         return result
 
     def _build_learner_group(self, cfg: AlgorithmConfig) -> LearnerGroup:
@@ -374,18 +462,37 @@ class Algorithm(Trainable):
     def step(self) -> dict:
         t0 = time.time()
         result = self.training_step()
+        # Honor the reporting floor: keep running training_steps until the
+        # iteration has consumed min_time_s_per_iteration of wall clock
+        # (reference: .reporting() min_time_s_per_iteration).
+        min_time = getattr(self._algo_config, "min_time_s_per_iteration", None)
+        while min_time and time.time() - t0 < float(min_time):
+            result = self.training_step()
         # Keep observation-filter statistics consistent across workers
         # (reference: FilterManager.synchronize each iteration).
         if getattr(self.workers, "observation_filter", None):
             self.workers.sync_filters()
         stats = self.workers.episode_stats()
+        window = int(getattr(self._algo_config, "metrics_num_episodes_for_smoothing", 100))
         self._episode_reward_window += stats["episode_rewards"]
-        self._episode_reward_window = self._episode_reward_window[-100:]
+        self._episode_reward_window = self._episode_reward_window[-window:]
         result.setdefault("episode_reward_mean", float(np.mean(self._episode_reward_window)) if self._episode_reward_window else float("nan"))
         result["episodes_this_iter"] = len(stats["episode_rewards"])
         result["timesteps_total"] = self._timesteps_total
         result["time_this_iter_s"] = time.time() - t0
         return result
+
+    def save(self) -> Checkpoint:
+        """Trainable.save + the checkpoint callback — overriding here (not
+        save_checkpoint) covers every algorithm's custom checkpoint
+        format."""
+        ckpt = super().save()
+        self.callbacks.on_checkpoint_saved(algorithm=self, checkpoint=ckpt)
+        return ckpt
+
+    def restore(self, checkpoint: Checkpoint) -> None:
+        super().restore(checkpoint)
+        self.callbacks.on_checkpoint_loaded(algorithm=self)
 
     def save_checkpoint(self) -> Checkpoint:
         return Checkpoint.from_dict({"weights": self.learner_group.get_weights(), "timesteps": self._timesteps_total})

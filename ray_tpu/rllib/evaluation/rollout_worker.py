@@ -299,8 +299,15 @@ class WorkerSet:
                  env_config: Optional[dict] = None, gamma: float = 0.99, lambda_: float = 0.95,
                  seed: int = 0, num_cpus_per_worker: float = 1,
                  observation_filter: Optional[str] = None, agent_connectors=None,
-                 clip_actions: bool = True):
+                 clip_actions: bool = True, recreate_failed_workers: bool = True,
+                 max_worker_restarts: int = 100):
         self.observation_filter = observation_filter
+        # Failure policy (reference: AlgorithmConfig.fault_tolerance()):
+        # respawn dead workers while the restart budget lasts; afterwards
+        # (or with recreate_failed_workers=False) degrade to the survivors.
+        self.recreate_failed_workers = recreate_failed_workers
+        self.max_worker_restarts = max_worker_restarts
+        self._restarts = 0
         self._filter_base = None  # merged filter history (driver-side)
         self._make_worker = lambda idx: ray_tpu.remote(num_cpus=num_cpus_per_worker)(RolloutWorker).remote(
             env_spec, spec, idx, num_envs_per_worker, env_config, gamma, lambda_, seed,
@@ -320,12 +327,29 @@ class WorkerSet:
     def _replace_worker(self, pos: int):
         """Respawn the worker at list position `pos`. The old actor MUST be
         killed first: a merely-slow actor that we abandoned would keep its
-        CPU reservation forever and starve future creations."""
+        CPU reservation forever and starve future creations. When the
+        restart budget is spent (or recreation is disabled), the dead
+        worker is dropped instead and the set degrades — unless it was the
+        LAST one, where degrading means silently training on nothing."""
         old = self._workers[pos]
         try:
             ray_tpu.kill(old)
         except Exception:
             pass
+        if (not self.recreate_failed_workers or self._restarts >= self.max_worker_restarts):
+            if len(self._workers) <= 1:
+                raise RuntimeError(
+                    "last rollout worker died and the restart budget is spent "
+                    f"(restarts={self._restarts}, recreate={self.recreate_failed_workers})"
+                )
+            logger.warning(
+                "dropping dead rollout worker %d (restarts=%d, budget=%d)",
+                self._indices[pos], self._restarts, self.max_worker_restarts,
+            )
+            del self._workers[pos]
+            del self._indices[pos]
+            return None
+        self._restarts += 1
         self._workers[pos] = self._make_worker(self._indices[pos])
         if self._async_fragment_len is not None:
             # Restarted into async mode; its runner idles until the next
@@ -336,14 +360,29 @@ class WorkerSet:
                 pass
         return self._workers[pos]
 
+    def _replace_by_identity(self, w):
+        """_replace_worker keyed by actor handle (safe across drops that
+        shift positional indices)."""
+        try:
+            return self._replace_worker(self._workers.index(w))
+        except ValueError:
+            return None
+
     def sync_weights(self, weights):
-        for i, w in enumerate(list(self._workers)):
+        for w in list(self._workers):
             try:
                 ray_tpu.get(w.set_weights.remote(weights), timeout=120)
             except Exception:
-                logger.warning("sync_weights: worker %d dead; respawning", i)
-                replacement = self._replace_worker(i)
-                ray_tpu.get(replacement.set_weights.remote(weights), timeout=120)
+                # Position by identity: a drop earlier in this loop shifts
+                # positional indices.
+                try:
+                    pos = self._workers.index(w)
+                except ValueError:
+                    continue
+                logger.warning("sync_weights: worker %d dead; respawning", self._indices[pos])
+                replacement = self._replace_worker(pos)
+                if replacement is not None:
+                    ray_tpu.get(replacement.set_weights.remote(weights), timeout=120)
 
     def sample(self, steps_per_worker: int, explore: bool = True) -> List[SampleBatch]:
         """Synchronous parallel sampling with fault tolerance: a worker that
@@ -366,7 +405,7 @@ class WorkerSet:
                 logger.warning("rollout worker %d failed; respawning", idx)
                 dead.append((idx, w))
         for idx, w in dead:
-            self._replace_worker(self._workers.index(w))
+            self._replace_by_identity(w)
         return results
 
     # -- async env-runner orchestration (reference: AsyncSampler) --------
@@ -397,17 +436,17 @@ class WorkerSet:
         deadline = _time.monotonic() + timeout
         while total < min_steps and _time.monotonic() < deadline:
             refs = {}
-            for i, w in enumerate(list(self._workers)):
+            for w in list(self._workers):
                 try:
-                    refs[w.get_async.remote(timeout=5.0)] = i
+                    refs[w.get_async.remote(timeout=5.0)] = w
                 except Exception:
-                    self._replace_worker(i)
-            for ref, i in refs.items():
+                    self._replace_by_identity(w)
+            for ref, w in refs.items():
                 try:
                     items = ray_tpu.get(ref, timeout=120)
                 except Exception:
-                    logger.warning("async rollout worker %d failed; respawning", i)
-                    self._replace_worker(i)
+                    logger.warning("async rollout worker failed; respawning")
+                    self._replace_by_identity(w)
                     continue
                 for item in items:
                     batches.append(item["batch"])
