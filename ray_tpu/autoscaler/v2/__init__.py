@@ -1,0 +1,12 @@
+from ray_tpu.autoscaler.v2.instance_manager import (  # noqa: F401
+    Instance,
+    InstanceManager,
+    InstanceStatus,
+    InstanceStorage,
+)
+from ray_tpu.autoscaler.v2.batching_node_provider import (  # noqa: F401
+    BatchingNodeProvider,
+    NodeData,
+    ScaleRequest,
+)
+from ray_tpu.autoscaler.v2.autoscaler_v2 import AutoscalerV2  # noqa: F401

@@ -1,0 +1,108 @@
+"""Batching node provider — one desired-state request per autoscaler tick.
+
+Reference: python/ray/autoscaler/batching_node_provider.py
+(BatchingNodeProvider, NodeData, ScaleRequest): cloud backends whose API is
+"declare the replica count" (k8s operators, GKE/TPU pod managers, managed
+instance groups) can't efficiently serve v1's per-node create_node/
+terminate_node calls. The batching provider records what the autoscaler
+wants during an update and flushes ONE ScaleRequest at the end
+(post_process), and reads cluster membership in ONE get_node_data call at
+the start.
+
+Subclasses implement exactly two methods (get_node_data /
+submit_scale_request); the v1 NodeProvider surface is adapted on top so
+both StandardAutoscaler (v1) and AutoscalerV2 can drive it unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Set
+
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+
+@dataclass
+class NodeData:
+    """Provider-side view of one node (reference: batching_node_provider.py
+    NodeData)."""
+
+    kind: str            # "head" | "worker"
+    type: str            # node type name (cluster-config key)
+    ip: str = ""
+    status: str = "running"
+
+
+@dataclass
+class ScaleRequest:
+    """The one batched ask (reference: ScaleRequest)."""
+
+    desired_num_workers: Dict[str, int] = field(default_factory=dict)
+    workers_to_delete: Set[str] = field(default_factory=set)
+
+
+class BatchingNodeProvider(NodeProvider):
+    """Adapter: v1 NodeProvider calls accumulate into a ScaleRequest that
+    flushes in post_process() — called once per autoscaler update."""
+
+    def __init__(self, provider_config: dict, cluster_name: str):
+        super().__init__(provider_config, cluster_name)
+        self.node_data_dict: Dict[str, NodeData] = {}
+        self.scale_request = ScaleRequest()
+        self.scale_change_needed = False
+
+    # -- subclass surface --------------------------------------------------
+    def get_node_data(self) -> Dict[str, NodeData]:
+        raise NotImplementedError
+
+    def submit_scale_request(self, scale_request: ScaleRequest) -> None:
+        raise NotImplementedError
+
+    # -- v1 NodeProvider adaptation ---------------------------------------
+    def non_terminated_nodes(self) -> List[str]:
+        """Refreshes the cached membership AND resets the pending scale
+        request to current reality — the autoscaler calls this exactly once
+        at the top of each update (reference: same contract)."""
+        self.node_data_dict = self.get_node_data()
+        counts: Dict[str, int] = {}
+        for data in self.node_data_dict.values():
+            if data.kind == "worker":
+                counts[data.type] = counts.get(data.type, 0) + 1
+        self.scale_request = ScaleRequest(desired_num_workers=counts)
+        self.scale_change_needed = False
+        return list(self.node_data_dict)
+
+    def node_tags(self, node_id: str) -> dict:
+        data = self.node_data_dict[node_id]
+        return {
+            "ray-node-kind": data.kind,
+            "ray-user-node-type": data.type,
+            "ray-node-status": data.status,
+        }
+
+    def is_running(self, node_id: str) -> bool:
+        return self.node_data_dict.get(node_id, NodeData("", "", status="gone")).status == "running"
+
+    def create_node(self, node_config: dict, tags: dict, count: int) -> List[str]:
+        node_type = tags["ray-user-node-type"]
+        self.scale_request.desired_num_workers[node_type] = (
+            self.scale_request.desired_num_workers.get(node_type, 0) + count
+        )
+        self.scale_change_needed = True
+        return []  # ids are assigned by the backend; visible next tick
+
+    def terminate_node(self, node_id: str) -> None:
+        data = self.node_data_dict.get(node_id)
+        if data is None:
+            return
+        cur = self.scale_request.desired_num_workers.get(data.type, 0)
+        self.scale_request.desired_num_workers[data.type] = max(0, cur - 1)
+        self.scale_request.workers_to_delete.add(node_id)
+        self.scale_change_needed = True
+
+    def post_process(self) -> None:
+        """Flush the batch (reference: called at the end of every
+        StandardAutoscaler.update)."""
+        if self.scale_change_needed:
+            self.submit_scale_request(self.scale_request)
+            self.scale_change_needed = False

@@ -1,0 +1,232 @@
+"""Instance manager — the autoscaler v2 instance-lifecycle state machine.
+
+Reference: python/ray/autoscaler/v2/instance_manager/ (instance_manager.py,
+instance_storage.py, common.py InstanceUtil): every cluster node is an
+INSTANCE record owned by the manager and driven through an explicit
+lifecycle:
+
+    QUEUED -> REQUESTED -> ALLOCATED -> RAY_RUNNING -> RAY_STOPPING
+                                                -> TERMINATING -> TERMINATED
+    (+ ALLOCATION_FAILED from REQUESTED, RAY_FAILED from RAY_RUNNING)
+
+v1's autoscaler infers state by diffing provider tags each tick; v2 makes
+state explicit and versioned so concurrent reconcilers can't clobber each
+other (instance_storage.py batch_upsert CAS semantics) and stuck
+transitions are detectable by timestamp (InstanceUtil.has_timeout). The
+reconciler maps cloud instances and live ray nodes onto the records each
+tick.
+
+TPU-native note: an instance's ``node_type`` may be a multi-host pod slice
+(TPUPodProvider); the lifecycle is the same — gang-ness lives in the
+node-type resource shape, not in the state machine.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Dict, List, Optional, Set, Tuple
+
+
+class InstanceStatus(str, Enum):
+    QUEUED = "QUEUED"                      # wanted, not yet asked of the cloud
+    REQUESTED = "REQUESTED"                # create issued to the provider
+    ALLOCATED = "ALLOCATED"                # cloud instance exists
+    RAY_RUNNING = "RAY_RUNNING"            # raylet registered with the GCS
+    RAY_STOPPING = "RAY_STOPPING"          # drain requested
+    RAY_FAILED = "RAY_FAILED"              # raylet died; instance may remain
+    TERMINATING = "TERMINATING"            # terminate issued to the provider
+    TERMINATED = "TERMINATED"              # gone (terminal)
+    ALLOCATION_FAILED = "ALLOCATION_FAILED"  # provider refused (terminal)
+
+
+# Legal transitions (reference: InstanceUtil.get_valid_transitions).
+_TRANSITIONS: Dict[InstanceStatus, Set[InstanceStatus]] = {
+    InstanceStatus.QUEUED: {InstanceStatus.REQUESTED, InstanceStatus.TERMINATED},
+    InstanceStatus.REQUESTED: {
+        InstanceStatus.ALLOCATED,
+        InstanceStatus.ALLOCATION_FAILED,
+        InstanceStatus.QUEUED,  # retry after request timeout
+    },
+    InstanceStatus.ALLOCATED: {
+        InstanceStatus.RAY_RUNNING,
+        InstanceStatus.TERMINATING,
+        InstanceStatus.RAY_FAILED,
+    },
+    InstanceStatus.RAY_RUNNING: {
+        InstanceStatus.RAY_STOPPING,
+        InstanceStatus.RAY_FAILED,
+        InstanceStatus.TERMINATING,
+    },
+    InstanceStatus.RAY_STOPPING: {InstanceStatus.TERMINATING, InstanceStatus.RAY_FAILED},
+    InstanceStatus.RAY_FAILED: {InstanceStatus.TERMINATING, InstanceStatus.QUEUED},
+    InstanceStatus.TERMINATING: {InstanceStatus.TERMINATED},
+    InstanceStatus.TERMINATED: set(),
+    InstanceStatus.ALLOCATION_FAILED: {InstanceStatus.QUEUED},
+}
+
+
+@dataclass
+class Instance:
+    instance_id: str
+    node_type: str
+    status: InstanceStatus = InstanceStatus.QUEUED
+    cloud_instance_id: Optional[str] = None
+    ray_node_id: Optional[str] = None
+    launch_attempts: int = 0
+    # status -> last time it was entered (reference keeps the full history;
+    # timestamps are what timeout detection needs).
+    status_times: Dict[str, float] = field(default_factory=dict)
+
+    def __post_init__(self):
+        self.status_times.setdefault(self.status.value, time.time())
+
+    def time_in_status(self) -> float:
+        return time.time() - self.status_times.get(self.status.value, time.time())
+
+    @staticmethod
+    def new(node_type: str) -> "Instance":
+        return Instance(instance_id=uuid.uuid4().hex[:12], node_type=node_type)
+
+
+class InstanceStorage:
+    """Versioned record store (reference: instance_storage.py). Every batch
+    upsert carries the version the writer read; a stale writer loses —
+    the CAS discipline that lets reconciler and scheduler run unlocked."""
+
+    def __init__(self):
+        self._instances: Dict[str, Instance] = {}
+        self._version = 0
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    def get_instances(self) -> Tuple[Dict[str, Instance], int]:
+        return dict(self._instances), self._version
+
+    def batch_upsert(self, instances: List[Instance], expected_version: int) -> bool:
+        if expected_version != self._version:
+            return False
+        for inst in instances:
+            self._instances[inst.instance_id] = inst
+        self._version += 1
+        return True
+
+    def delete(self, instance_ids: List[str], expected_version: int) -> bool:
+        if expected_version != self._version:
+            return False
+        for iid in instance_ids:
+            self._instances.pop(iid, None)
+        self._version += 1
+        return True
+
+
+class InstanceManager:
+    """Owns the storage; validates every state change (reference:
+    instance_manager.py update_instance_manager_state)."""
+
+    def __init__(self, storage: Optional[InstanceStorage] = None,
+                 request_timeout_s: float = 120.0, max_launch_attempts: int = 3):
+        self.storage = storage or InstanceStorage()
+        self.request_timeout_s = request_timeout_s
+        self.max_launch_attempts = max_launch_attempts
+
+    # -- state changes -----------------------------------------------------
+    def add_instances(self, node_types: List[str]) -> List[Instance]:
+        """Queue new desired instances."""
+        while True:
+            _, version = self.storage.get_instances()
+            fresh = [Instance.new(t) for t in node_types]
+            if self.storage.batch_upsert(fresh, version):
+                return fresh
+
+    def set_status(self, instance_id: str, status: InstanceStatus, **fields) -> Instance:
+        """One validated transition; raises on an illegal edge."""
+        while True:
+            instances, version = self.storage.get_instances()
+            inst = instances[instance_id]
+            if status not in _TRANSITIONS[inst.status]:
+                raise ValueError(
+                    f"illegal transition {inst.status.value} -> {status.value} "
+                    f"for instance {instance_id}"
+                )
+            inst.status = status
+            inst.status_times[status.value] = time.time()
+            for k, v in fields.items():
+                setattr(inst, k, v)
+            if self.storage.batch_upsert([inst], version):
+                return inst
+
+    def instances(self, *statuses: InstanceStatus) -> List[Instance]:
+        insts, _ = self.storage.get_instances()
+        if not statuses:
+            return list(insts.values())
+        want = set(statuses)
+        return [i for i in insts.values() if i.status in want]
+
+    # -- reconciliation ----------------------------------------------------
+    def reconcile(self, cloud_instances: Dict[str, str],
+                  ray_nodes: Dict[str, str]) -> None:
+        """Fold provider + GCS truth into the records.
+
+        cloud_instances: cloud_instance_id -> node_type (currently existing)
+        ray_nodes: cloud_instance_id -> ray_node_id (raylets alive in GCS)
+        """
+        insts, _ = self.storage.get_instances()
+        known_cloud = {
+            i.cloud_instance_id for i in insts.values() if i.cloud_instance_id
+        }
+        # 1. REQUESTED instances that the provider has now satisfied: adopt
+        # unclaimed cloud instances of the matching type (oldest request
+        # first — provider APIs don't echo request ids back).
+        unclaimed = [cid for cid in cloud_instances if cid not in known_cloud]
+        for inst in sorted(
+            self.instances(InstanceStatus.REQUESTED),
+            key=lambda i: i.status_times.get(InstanceStatus.REQUESTED.value, 0),
+        ):
+            match = next(
+                (cid for cid in unclaimed if cloud_instances[cid] == inst.node_type),
+                None,
+            )
+            if match is not None:
+                unclaimed.remove(match)
+                self.set_status(
+                    inst.instance_id, InstanceStatus.ALLOCATED, cloud_instance_id=match
+                )
+            elif inst.time_in_status() > self.request_timeout_s:
+                # Stuck request: retry or give up (reference: stuck-instance
+                # reconciliation).
+                if inst.launch_attempts + 1 >= self.max_launch_attempts:
+                    self.set_status(inst.instance_id, InstanceStatus.ALLOCATION_FAILED)
+                else:
+                    self.set_status(
+                        inst.instance_id, InstanceStatus.QUEUED,
+                        launch_attempts=inst.launch_attempts + 1,
+                    )
+        # 2. ALLOCATED instances whose raylet registered -> RAY_RUNNING;
+        # RAY_RUNNING whose raylet vanished -> RAY_FAILED; cloud instance
+        # gone entirely -> TERMINATED.
+        for inst in self.instances(
+            InstanceStatus.ALLOCATED, InstanceStatus.RAY_RUNNING,
+            InstanceStatus.RAY_STOPPING, InstanceStatus.TERMINATING,
+        ):
+            cid = inst.cloud_instance_id
+            if cid not in cloud_instances:
+                if inst.status in (InstanceStatus.ALLOCATED, InstanceStatus.RAY_RUNNING):
+                    # Cloud killed it under us; route through TERMINATING so
+                    # the transition table stays the single source of edges.
+                    self.set_status(inst.instance_id, InstanceStatus.TERMINATING)
+                if inst.status in (InstanceStatus.RAY_STOPPING,):
+                    self.set_status(inst.instance_id, InstanceStatus.TERMINATING)
+                self.set_status(inst.instance_id, InstanceStatus.TERMINATED)
+                continue
+            if inst.status == InstanceStatus.ALLOCATED and cid in ray_nodes:
+                self.set_status(
+                    inst.instance_id, InstanceStatus.RAY_RUNNING,
+                    ray_node_id=ray_nodes[cid],
+                )
+            elif inst.status == InstanceStatus.RAY_RUNNING and cid not in ray_nodes:
+                self.set_status(inst.instance_id, InstanceStatus.RAY_FAILED)
