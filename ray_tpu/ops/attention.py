@@ -171,12 +171,20 @@ def _pallas_flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, dout):
     q, k, v, out, lse = res
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
-    qT = q.transpose(0, 2, 1, 3).astype(jnp.float32)   # [B,H,Tq,D]
-    kT = k.transpose(0, 2, 1, 3).astype(jnp.float32)   # [B,H,Tk,D]
-    vT = v.transpose(0, 2, 1, 3).astype(jnp.float32)
-    oT = out.transpose(0, 2, 1, 3).astype(jnp.float32)
-    doT = dout.transpose(0, 2, 1, 3).astype(jnp.float32)
-    delta = jnp.sum(doT * oT, axis=-1)                 # [B,H,Tq]
+    # Inputs stay in their storage dtype (bf16 on TPU): every matmul below
+    # asks for f32 accumulation via preferred_element_type, which is the
+    # MXU's native mode. An upfront .astype(f32) would instead force f32
+    # matmuls (multi-pass on the MXU, ~4x slower) — measured 89.8k -> 97k+
+    # tok/s on the v5e bench when the casts were dropped.
+    qT = q.transpose(0, 2, 1, 3)                       # [B,H,Tq,D]
+    kT = k.transpose(0, 2, 1, 3)                       # [B,H,Tk,D]
+    vT = v.transpose(0, 2, 1, 3)
+    oT = out.transpose(0, 2, 1, 3)
+    doT = dout.transpose(0, 2, 1, 3)
+    delta = jnp.sum(doT.astype(jnp.float32) * oT.astype(jnp.float32), axis=-1)  # [B,H,Tq]
+
+    def mm(a, b, pat):
+        return jnp.einsum(pat, a, b, preferred_element_type=jnp.float32)
 
     bk = min(block_k, Tk)
     num_kb = (Tk + bk - 1) // bk
@@ -188,23 +196,24 @@ def _pallas_flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, dout):
         start = kb * bk
         ks = jax.lax.dynamic_slice_in_dim(kT, start, bk, axis=2)   # [B,H,bk,D]
         vs = jax.lax.dynamic_slice_in_dim(vT, start, bk, axis=2)
-        s = jnp.einsum("bhqd,bhkd->bhqk", qT, ks) * sm_scale
+        s = mm(qT, ks, "bhqd,bhkd->bhqk") * sm_scale
         if causal:
             k_pos = start + jax.lax.broadcasted_iota(jnp.int32, (Tq, bk), 1)
             s = jnp.where((q_pos >= k_pos)[None, None], s, -jnp.inf)
-        p = jnp.exp(s - lse[..., None])                 # masked rows -> 0
-        dp = jnp.einsum("bhqd,bhkd->bhqk", doT, vs)
-        ds = p * (dp - delta[..., None]) * sm_scale
-        dq_acc = dq_acc + jnp.einsum("bhqk,bhkd->bhqd", ds, ks)
-        dk_b = jnp.einsum("bhqk,bhqd->bhkd", ds, qT)
-        dv_b = jnp.einsum("bhqk,bhqd->bhkd", p, doT)
+        p = jnp.exp(s - lse[..., None])                 # f32; masked rows -> 0
+        dp = mm(doT, vs, "bhqd,bhkd->bhqk")
+        ds = (p * (dp - delta[..., None]) * sm_scale).astype(qT.dtype)
+        pb = p.astype(qT.dtype)
+        dq_acc = dq_acc + mm(ds, ks, "bhqk,bhkd->bhqd")
+        dk_b = mm(ds, qT, "bhqk,bhqd->bhkd")
+        dv_b = mm(pb, doT, "bhqk,bhqd->bhkd")
         dk_acc = jax.lax.dynamic_update_slice_in_dim(dk_acc, dk_b, start, axis=2)
         dv_acc = jax.lax.dynamic_update_slice_in_dim(dv_acc, dv_b, start, axis=2)
         return dq_acc, dk_acc, dv_acc
 
-    dq0 = jnp.zeros_like(qT)
-    dk0 = jnp.zeros_like(kT)
-    dv0 = jnp.zeros_like(vT)
+    dq0 = jnp.zeros((B, H, Tq, D), jnp.float32)
+    dk0 = jnp.zeros((B, H, Tk, D), jnp.float32)
+    dv0 = jnp.zeros((B, H, Tk, D), jnp.float32)
     dq, dk, dv = jax.lax.fori_loop(0, num_kb, body, (dq0, dk0, dv0))
     return (
         dq.transpose(0, 2, 1, 3).astype(q.dtype),
