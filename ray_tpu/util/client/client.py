@@ -19,7 +19,7 @@ import uuid
 
 from ray_tpu._private import serialization
 from ray_tpu._private.ids import ObjectID
-from ray_tpu._private.rpc import RpcClient
+from ray_tpu._private.rpc import ConnectionLost, RpcClient
 from ray_tpu.object_ref import ObjectRef
 
 
@@ -34,28 +34,112 @@ class _GcsProxy:
 class ClientCoreWorker:
     mode = "CLIENT"
 
-    def __init__(self, address: tuple, namespace: str = ""):
-        self._rpc = RpcClient(tuple(address), label="ray-client")
+    # Methods that get a req_id so a reconnect-replay is at-most-once on
+    # the server (reference: dataclient acked sequence numbers). get and
+    # put_begin are included because their responses create server-side
+    # stream state that a blind replay would duplicate.
+    _MUTATING = {
+        "client_task", "client_create_actor", "client_actor_call",
+        "client_put", "client_put_commit", "client_put_begin", "client_get",
+    }
+
+    def __init__(self, address: tuple, namespace: str = "",
+                 reconnect_retries: int = 5, reconnect_backoff_s: float = 0.5):
+        self._address = tuple(address)
+        self._rpc = self._new_rpc()
         self._client_id = uuid.uuid4().hex
         self.namespace = namespace
         self.gcs = _GcsProxy(self)
         self._released: list[str] = []
         self._local_counts: dict[str, int] = {}
         self._release_lock = threading.Lock()
+        self._req_seq = 0
+        self._reconnect_retries = reconnect_retries
+        self._reconnect_backoff_s = reconnect_backoff_s
+        self._reconnect_lock = threading.Lock()
+        self._reconnects = 0  # observability; tests assert on it
+        # Keepalive: the server reaps sessions by last_seen; an idle-but-
+        # connected client must not lose its pins, so ping periodically.
+        self._keepalive_stop = threading.Event()
+        self._keepalive = threading.Thread(
+            target=self._keepalive_loop, daemon=True, name="ray-client-keepalive"
+        )
+        self._keepalive.start()
+
+    def _keepalive_loop(self, interval_s: float = 60.0):
+        while not self._keepalive_stop.wait(interval_s):
+            try:
+                self._rpc.call("client_ping", {"client_id": self._client_id})
+            except Exception:
+                pass  # next real call reconnects; the TTL is the backstop
 
     # -- plumbing -------------------------------------------------------
+    def _new_rpc(self) -> RpcClient:
+        """Transport with its internal retry disabled: the transport layer
+        re-sends on ConnectionLost AND on timeout, which would both multiply
+        this class's own reconnect loop and replay timed-out requests —
+        retries belong to exactly one layer, and _call owns them here."""
+        rpc = RpcClient(self._address, label="ray-client")
+        rpc._retries = 0
+        return rpc
+
+    def _next_req_id(self) -> str:
+        with self._release_lock:
+            self._req_seq += 1
+            return f"{self._client_id}:{self._req_seq}"
+
     def _call(self, method: str, payload: dict, timeout: float | None = None):
-        """RPC with the client id and any queued ref releases piggybacked."""
+        """RPC with the client id and any queued ref releases piggybacked.
+        On a lost connection the SAME request (same req_id) is replayed
+        after reconnecting — the server's response cache makes mutating
+        calls at-most-once (reference: client reconnect grace period)."""
+        import time as _time
+
         with self._release_lock:
             batch, self._released = self._released, []
         payload["client_id"] = self._client_id
+        if method in self._MUTATING and "req_id" not in payload:
+            payload["req_id"] = self._next_req_id()
         if batch:
             try:
                 self._rpc.call("client_release", {"client_id": self._client_id, "ids": batch})
             except Exception:
                 with self._release_lock:
                     self._released = batch + self._released
-        return self._rpc.call(method, payload, timeout=timeout)
+        last_err: Exception | None = None
+        for attempt in range(self._reconnect_retries + 1):
+            rpc = self._rpc
+            try:
+                return rpc.call(method, payload, timeout=timeout)
+            except TimeoutError:
+                # A timeout is an application outcome, not a transport
+                # failure — tearing down a healthy connection and replaying
+                # would multiply the caller's wait.
+                raise
+            except (ConnectionError, OSError) as e:
+                last_err = e
+            except ConnectionLost as e:
+                # The RPC layer's in-flight-loss error; application-level
+                # RpcErrors (handler exceptions) are NOT retriable.
+                last_err = e
+            if attempt == self._reconnect_retries:
+                break
+            _time.sleep(self._reconnect_backoff_s * (attempt + 1))
+            # Reconnect once per failed transport object: if another thread
+            # already swapped in a fresh client, reuse it instead of closing
+            # the connection it just opened.
+            with self._reconnect_lock:
+                if self._rpc is rpc:
+                    try:
+                        rpc.close()
+                    except Exception:
+                        pass
+                    self._rpc = self._new_rpc()
+                    self._reconnects += 1
+        raise ConnectionError(
+            f"ray client lost its server after {self._reconnect_retries} "
+            f"reconnect attempts: {last_err}"
+        )
 
     @staticmethod
     def _pack_args(args, kwargs) -> bytes:
@@ -117,11 +201,63 @@ class ClientCoreWorker:
         )
         if resp.get("error") is not None:
             raise serialization.loads(resp["error"])
-        values = serialization.loads(resp["values"])
+        if "stream" in resp:
+            # Data channel: pull the value in bounded chunks, sequentially
+            # (the pull cadence IS the backpressure — the server holds one
+            # blob, the wire carries one chunk at a time).
+            parts = []
+            offset = 0
+            while True:
+                c = self._call(
+                    "client_get_chunk", {"stream": resp["stream"], "offset": offset}
+                )
+                if c.get("error") is not None:
+                    raise serialization.loads(c["error"])
+                parts.append(c["data"])
+                offset += len(c["data"])
+                if c["done"]:
+                    break
+            # Ack completion so the server frees the blob now rather than
+            # at session TTL (chunks stay replayable until this lands).
+            try:
+                self._call("client_stream_done", {"stream": resp["stream"]})
+            except Exception:
+                pass
+            values = serialization.loads(b"".join(parts))
+        else:
+            values = serialization.loads(resp["values"])
         return values[0] if single else values
 
+    # Values above this upload through the chunked data channel.
+    _PUT_STREAM_THRESHOLD = 1024 * 1024
+
     def put(self, value) -> ObjectRef:
-        resp = self._call("client_put", {"value": serialization.dumps(value)})
+        blob = serialization.dumps(value)
+        if len(blob) <= self._PUT_STREAM_THRESHOLD:
+            resp = self._call("client_put", {"value": blob})
+            return self._refs_from_ids([resp["id"]])[0]
+        begin = self._call("client_put_begin", {})
+        sid = begin["stream"]
+        chunk_size = int(begin.get("chunk_size", 256 * 1024))
+        try:
+            for seq, off in enumerate(range(0, len(blob), chunk_size)):
+                c = self._call(
+                    "client_put_chunk",
+                    {"stream": sid, "seq": seq, "data": blob[off:off + chunk_size]},
+                )
+                if c.get("error") is not None:
+                    raise serialization.loads(c["error"])
+        except BaseException:
+            # Don't leave a partial multi-MB buffer pinned server-side
+            # until the stream TTL.
+            try:
+                self._call("client_put_abort", {"stream": sid})
+            except Exception:
+                pass
+            raise
+        resp = self._call("client_put_commit", {"stream": sid})
+        if resp.get("error") is not None:
+            raise serialization.loads(resp["error"])
         return self._refs_from_ids([resp["id"]])[0]
 
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
@@ -174,11 +310,15 @@ class ClientCoreWorker:
         return fut
 
     def shutdown(self, job_state: str | None = None):
+        self._keepalive_stop.set()
         with self._release_lock:
             batch, self._released = self._released, []
         try:
             if batch:
                 self._rpc.call("client_release", {"client_id": self._client_id, "ids": batch})
+            # Explicit goodbye frees the server session immediately instead
+            # of waiting out the reconnect grace TTL.
+            self._rpc.call("client_disconnect", {"client_id": self._client_id})
         except Exception:
             pass
         self._rpc.close()

@@ -1,43 +1,167 @@
 """Client server — runs on the head node, executes for thin clients.
 
-Analog of the reference's util/client/server/server.py: holds a real driver
-CoreWorker connected to the cluster; every RPC maps 1:1 to a driver-side API
-call. Returned ObjectRefs are pinned in a registry keyed by id so the
-cluster-side refcount stays >0 while any client holds the id; clients release
-ids explicitly (ObjectRef.__del__ → client_release)."""
+Analog of the reference's util/client/server/server.py + dataservicer: holds
+a real driver CoreWorker connected to the cluster; every RPC maps 1:1 to a
+driver-side API call. Returned ObjectRefs are pinned in a per-client session
+so the cluster-side refcount stays >0 while any client holds the id; clients
+release ids explicitly (ObjectRef.__del__ → client_release).
+
+Reconnect semantics (reference: server/proxier + client reconnect_grace):
+every mutating request carries a ``req_id``; the session caches recent
+responses so a client that lost its connection mid-call can reconnect and
+REPLAY the request without double-submitting (the reference's data channel
+achieves the same with acked sequence numbers). Sessions survive connection
+loss and are reaped only after ``session_ttl_s`` without any call.
+
+Data channel (reference: dataservicer 64KiB chunking): values larger than
+``stream_threshold`` transfer as chunk streams (client_get_chunk /
+client_put_begin+chunk+commit) so no single RPC frame carries an unbounded
+payload — bounded memory per message is the backpressure story, and the
+client pulls chunks strictly sequentially."""
 
 from __future__ import annotations
 
+import collections
 import logging
 import threading
+import time
+import uuid
 
 from ray_tpu._private import serialization
 from ray_tpu._private.rpc import RpcServer
 
 logger = logging.getLogger(__name__)
 
+CHUNK_SIZE = 256 * 1024
+
+
+class _Session:
+    __slots__ = (
+        "refs", "resp_cache", "streams", "uploads", "stream_ts", "inflight",
+        "last_seen",
+    )
+
+    def __init__(self):
+        self.refs: dict[str, object] = {}
+        self.resp_cache: "collections.OrderedDict[str, dict]" = collections.OrderedDict()
+        self.streams: dict[str, bytes] = {}
+        self.uploads: dict[str, list] = {}
+        self.stream_ts: dict[str, float] = {}  # sid -> created (both kinds)
+        self.inflight: dict[str, object] = {}  # req_id -> asyncio.Future
+        self.last_seen = time.time()
+
 
 class ClientServer:
-    def __init__(self, core_worker, host: str = "0.0.0.0", port: int = 0):
+    def __init__(self, core_worker, host: str = "0.0.0.0", port: int = 0,
+                 stream_threshold: int = 1024 * 1024, session_ttl_s: float = 300.0,
+                 resp_cache_size: int = 128, stream_ttl_s: float = 180.0):
         """``core_worker`` is a DRIVER-mode CoreWorker already connected."""
         self.cw = core_worker
-        # client_id -> {id hex -> ObjectRef}. One pin per (client, id); the
-        # client releases when its LAST local ref for the id dies, so a
-        # release from one client can never unpin another's objects.
-        self._refs: dict[str, dict[str, object]] = {}
+        self.stream_threshold = stream_threshold
+        self.session_ttl_s = session_ttl_s
+        self.resp_cache_size = resp_cache_size
+        self.stream_ttl_s = stream_ttl_s
+        self._sessions: dict[str, _Session] = {}
+        self._last_reap = 0.0
         self._lock = threading.Lock()
         self.server = RpcServer(name="client-server")
         self.server.register_all(self, prefix="client_")
         self.server.start(host=host, port=port)
         self.address = self.server.address
 
-    # -- helpers --------------------------------------------------------
+    # -- session helpers -------------------------------------------------
+    def _session(self, client_id: str) -> _Session:
+        with self._lock:
+            s = self._sessions.get(client_id or "")
+            if s is None:
+                s = self._sessions[client_id or ""] = _Session()
+            now = time.time()
+            s.last_seen = now
+            # Lazy reap, throttled: the scan is O(sessions + streams) and
+            # this method sits on every RPC — once per few seconds is
+            # plenty for TTLs measured in minutes.
+            if now - self._last_reap >= 5.0:
+                self._last_reap = now
+                # Sessions silent past the TTL lose their pins — the
+                # reconnect grace period for DISCONNECTED clients (live
+                # clients stay fresh via their keepalive pings).
+                dead = [
+                    cid for cid, sess in self._sessions.items()
+                    if now - sess.last_seen > self.session_ttl_s
+                ]
+                for cid in dead:
+                    logger.info("client session %s expired; releasing %d refs",
+                                cid, len(self._sessions[cid].refs))
+                    del self._sessions[cid]
+                # Abandoned chunk streams/uploads inside LIVE sessions
+                # (aborted transfers) get their own, shorter IDLE ttl —
+                # stream_ts refreshes on every chunk access, so only
+                # stalled transfers expire, however long the object.
+                for sess in self._sessions.values():
+                    stale = [
+                        sid for sid, ts in sess.stream_ts.items()
+                        if now - ts > self.stream_ttl_s
+                    ]
+                    for sid in stale:
+                        sess.stream_ts.pop(sid, None)
+                        sess.streams.pop(sid, None)
+                        sess.uploads.pop(sid, None)
+        return s
+
+    async def _cached_call(self, req: dict, acompute):
+        """At-most-once execution for mutating calls: a replayed req_id
+        (same client reconnecting and retrying) returns the cached response
+        instead of re-running the side effect. A replay that lands while
+        the ORIGINAL is still executing awaits the same in-flight future —
+        without this, the mid-call-loss window would double-execute."""
+        import asyncio
+
+        sess = self._session(req.get("client_id", ""))
+        req_id = req.get("req_id")
+        fut = None
+        if req_id:
+            with self._lock:
+                cached = sess.resp_cache.get(req_id)
+                if cached is not None:
+                    return cached
+                pending = sess.inflight.get(req_id)
+                if pending is None:
+                    fut = asyncio.get_event_loop().create_future()
+                    sess.inflight[req_id] = fut
+            if fut is None:
+                return await asyncio.shield(pending)
+        try:
+            resp = await acompute()
+        except Exception as e:
+            if fut is not None:
+                with self._lock:
+                    sess.inflight.pop(req_id, None)
+                if not fut.done():
+                    fut.set_exception(e)
+                    # A waiter consumes the exception; without one, silence
+                    # the "exception never retrieved" warning.
+                    fut.exception()
+            raise
+        # "_nocache": the handler judged the response safe to recompute and
+        # too big to hold (mid-size get values) — replay just re-executes.
+        nocache = resp.pop("_nocache", False)
+        if req_id:
+            with self._lock:
+                sess.inflight.pop(req_id, None)
+                if not nocache:
+                    sess.resp_cache[req_id] = resp
+                    while len(sess.resp_cache) > self.resp_cache_size:
+                        sess.resp_cache.popitem(last=False)
+            if fut is not None and not fut.done():
+                fut.set_result(resp)
+        return resp
+
     def _pin(self, client_id: str, refs) -> list[str]:
+        sess = self._session(client_id)
         out = []
         with self._lock:
-            table = self._refs.setdefault(client_id or "", {})
             for r in refs:
-                table.setdefault(r.hex(), r)
+                sess.refs.setdefault(r.hex(), r)
                 out.append(r.hex())
         return out
 
@@ -48,16 +172,16 @@ class ClientServer:
         from ray_tpu._private.ids import ObjectID
         from ray_tpu.object_ref import ObjectRef
 
+        sess = self._session(client_id)
         out = []
         with self._lock:
-            table = self._refs.setdefault(client_id or "", {})
             for pos, i in enumerate(ids):
-                ref = table.get(i)
+                ref = sess.refs.get(i)
                 if ref is None:
                     owner = owners[pos] if owners and pos < len(owners) else None
                     ref = ObjectRef(ObjectID.from_hex(i), owner, _register=False)
                     self.cw.register_ref(ref)
-                    table[i] = ref
+                    sess.refs[i] = ref
                 out.append(ref)
         return out
 
@@ -79,47 +203,165 @@ class ClientServer:
 
     # -- RPC methods ----------------------------------------------------
     async def rpc_task(self, req):
-        func = serialization.loads(req["func"])
-        args, kwargs = serialization.loads(req["args"])
-        opts = req.get("opts") or {}
-        refs = await self._off_loop(lambda: self.cw.submit_task(func, args, kwargs, **opts))
-        return {"ids": self._pin(req.get("client_id", ""), refs)}
+        async def compute():
+            def compute_sync():
+                func = serialization.loads(req["func"])
+                args, kwargs = serialization.loads(req["args"])
+                opts = req.get("opts") or {}
+                return self.cw.submit_task(func, args, kwargs, **opts)
+
+            refs = await self._off_loop(compute_sync)
+            return {"ids": self._pin(req.get("client_id", ""), refs)}
+
+        return await self._cached_call(req, compute)
 
     async def rpc_create_actor(self, req):
-        cls = serialization.loads(req["cls"])
-        args, kwargs = serialization.loads(req["args"])
-        opts = req.get("opts") or {}
-        info = await self._off_loop(lambda: self.cw.create_actor(cls, args, kwargs, **opts))
-        return {"info": info}
+        async def compute():
+            def compute_sync():
+                cls = serialization.loads(req["cls"])
+                args, kwargs = serialization.loads(req["args"])
+                opts = req.get("opts") or {}
+                return self.cw.create_actor(cls, args, kwargs, **opts)
+
+            info = await self._off_loop(compute_sync)
+            return {"info": info}
+
+        return await self._cached_call(req, compute)
 
     async def rpc_actor_call(self, req):
-        args, kwargs = serialization.loads(req["args"])
-        refs = await self._off_loop(
-            lambda: self.cw.submit_actor_task(
-                req["actor_id"],
-                req["method"],
-                args,
-                kwargs,
-                num_returns=req.get("num_returns", 1),
-                max_task_retries=req.get("max_task_retries", 0),
-            )
-        )
-        return {"ids": self._pin(req.get("client_id", ""), refs)}
+        async def compute():
+            def compute_sync():
+                # loads runs off-loop and inside the worker_context override
+                # (big payloads must not stall the loop; nested ObjectRefs
+                # must register on this driver).
+                args, kwargs = serialization.loads(req["args"])
+                return self.cw.submit_actor_task(
+                    req["actor_id"],
+                    req["method"],
+                    args,
+                    kwargs,
+                    num_returns=req.get("num_returns", 1),
+                    max_task_retries=req.get("max_task_retries", 0),
+                )
+
+            refs = await self._off_loop(compute_sync)
+            return {"ids": self._pin(req.get("client_id", ""), refs)}
+
+        return await self._cached_call(req, compute)
 
     async def rpc_get(self, req):
-        try:
-            refs = self._lookup(req.get("client_id", ""), req["ids"], req.get("owners"))
-            values = await self._off_loop(
-                lambda: self.cw.get(refs, timeout=req.get("timeout"))
-            )
-        except Exception as e:
-            return {"error": serialization.dumps(e)}
-        return {"values": serialization.dumps(values)}
+        # Routed through the replay cache: a replayed get whose response
+        # was lost must return the SAME stream id instead of serializing a
+        # second (possibly huge) blob into the session.
+        async def compute():
+            def fetch_and_dump():
+                # get AND serialize off-loop: dumps of a multi-GB value
+                # would stall every other client's RPCs on the event loop.
+                refs = self._lookup(req.get("client_id", ""), req["ids"], req.get("owners"))
+                values = self.cw.get(refs, timeout=req.get("timeout"))
+                return serialization.dumps(values)
+
+            try:
+                blob = await self._off_loop(fetch_and_dump)
+            except Exception as e:
+                return {"error": serialization.dumps(e)}
+            if len(blob) <= self.stream_threshold:
+                resp = {"values": blob}
+                if len(blob) > 64 * 1024:
+                    # Idempotent to recompute; not worth pinning in the
+                    # replay cache (128 entries x up to 1MiB adds up).
+                    resp["_nocache"] = True
+                return resp
+            # Large value: hand back a chunk stream (data channel).
+            sess = self._session(req.get("client_id", ""))
+            sid = uuid.uuid4().hex
+            with self._lock:
+                sess.streams[sid] = blob
+                sess.stream_ts[sid] = time.time()
+            return {"stream": sid, "size": len(blob), "chunk_size": CHUNK_SIZE}
+
+        return await self._cached_call(req, compute)
+
+    async def rpc_get_chunk(self, req):
+        sess = self._session(req.get("client_id", ""))
+        sid, offset = req["stream"], int(req["offset"])
+        with self._lock:
+            blob = sess.streams.get(sid)
+            if blob is None:
+                return {"error": serialization.dumps(KeyError(f"stream {sid} expired"))}
+            sess.stream_ts[sid] = time.time()  # active transfer: not stale
+            chunk = blob[offset:offset + CHUNK_SIZE]
+            done = offset + len(chunk) >= len(blob)
+        # The blob is NOT deleted here: a connection drop after serving the
+        # final chunk must leave the replayed request servable. The client
+        # acks completion with client_stream_done; the session TTL reaps
+        # anything a vanished client never acked.
+        return {"data": chunk, "done": done}
+
+    async def rpc_stream_done(self, req):
+        sess = self._session(req.get("client_id", ""))
+        with self._lock:
+            sess.streams.pop(req["stream"], None)
+            sess.stream_ts.pop(req["stream"], None)
+        return {"ok": True}
 
     async def rpc_put(self, req):
-        value = serialization.loads(req["value"])
-        ref = await self._off_loop(lambda: self.cw.put(value))
-        return {"id": self._pin(req.get("client_id", ""), [ref])[0]}
+        async def compute():
+            ref = await self._off_loop(
+                lambda: self.cw.put(serialization.loads(req["value"]))
+            )
+            return {"id": self._pin(req.get("client_id", ""), [ref])[0]}
+
+        return await self._cached_call(req, compute)
+
+    # -- chunked upload (data channel, put direction) --------------------
+    async def rpc_put_begin(self, req):
+        async def compute():
+            sess = self._session(req.get("client_id", ""))
+            sid = uuid.uuid4().hex
+            with self._lock:
+                sess.uploads[sid] = []
+                sess.stream_ts[sid] = time.time()
+            return {"stream": sid, "chunk_size": CHUNK_SIZE}
+
+        # Replay-cached: a lost begin-response must not orphan a buffer.
+        return await self._cached_call(req, compute)
+
+    async def rpc_put_chunk(self, req):
+        sess = self._session(req.get("client_id", ""))
+        with self._lock:
+            parts = sess.uploads.get(req["stream"])
+            if parts is None:
+                return {"error": serialization.dumps(KeyError("upload expired"))}
+            sess.stream_ts[req["stream"]] = time.time()  # active: not stale
+            # seq makes retried chunk sends idempotent after a reconnect.
+            seq = int(req["seq"])
+            if seq == len(parts):
+                parts.append(req["data"])
+            elif seq > len(parts):
+                return {"error": serialization.dumps(
+                    ValueError(f"chunk gap: got seq {seq}, expected {len(parts)}")
+                )}
+        return {"ack": True}
+
+    async def rpc_put_commit(self, req):
+        async def compute():
+            sess = self._session(req.get("client_id", ""))
+            with self._lock:
+                parts = sess.uploads.pop(req["stream"], None)
+                sess.stream_ts.pop(req["stream"], None)
+            if parts is None:
+                return {"error": serialization.dumps(KeyError("upload expired"))}
+
+            def join_load_put():
+                # join + loads off-loop (multi-GB values must not stall the
+                # event loop).
+                return self.cw.put(serialization.loads(b"".join(parts)))
+
+            ref = await self._off_loop(join_load_put)
+            return {"id": self._pin(req.get("client_id", ""), [ref])[0]}
+
+        return await self._cached_call(req, compute)
 
     async def rpc_wait(self, req):
         refs = self._lookup(req.get("client_id", ""), req["ids"], req.get("owners"))
@@ -134,13 +376,31 @@ class ClientServer:
         return {"ready": [r.hex() for r in ready], "not_ready": [r.hex() for r in not_ready]}
 
     async def rpc_release(self, req):
+        sess = self._session(req.get("client_id", ""))
         with self._lock:
-            table = self._refs.get(req.get("client_id", ""), {})
             for i in req.get("ids", []):
-                table.pop(i, None)
+                sess.refs.pop(i, None)
+        return {"ok": True}
+
+    async def rpc_put_abort(self, req):
+        sess = self._session(req.get("client_id", ""))
+        with self._lock:
+            sess.uploads.pop(req["stream"], None)
+            sess.stream_ts.pop(req["stream"], None)
+        return {"ok": True}
+
+    async def rpc_ping(self, req):
+        """Keepalive: refreshes the session's last_seen (the reap clock)."""
+        self._session(req.get("client_id", ""))
+        return {"ok": True}
+
+    async def rpc_disconnect(self, req):
+        with self._lock:
+            self._sessions.pop(req.get("client_id", ""), None)
         return {"ok": True}
 
     async def rpc_gcs_call(self, req):
+        self._session(req.get("client_id", ""))
         return await self._off_loop(
             lambda: self.cw.gcs.call(req["method"], req.get("payload") or {})
         )

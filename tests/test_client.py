@@ -105,3 +105,63 @@ def test_client_task_error_propagates(client_connection):
 
     with pytest.raises(Exception, match="client boom"):
         ray_tpu.get(boom.remote())
+
+
+def test_client_large_object_streams_both_ways(client_connection):
+    """Values above the data-channel threshold transfer as bounded chunks
+    (reference: dataservicer chunking), transparently to the caller."""
+    big = np.arange(400_000, dtype=np.float64)  # ~3.2 MB serialized
+    ref = ray_tpu.put(big)
+    back = ray_tpu.get(ref)
+    assert np.array_equal(back, big)
+
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    out = ray_tpu.get(double.remote(ref))
+    assert np.array_equal(out, big * 2)
+
+
+def test_client_reconnects_transparently(client_connection):
+    """A mid-flight connection loss is retried on a fresh connection and
+    the request replayed; the session (pinned refs) survives on the
+    server. (A clean socket close heals inside the transport; a LOST
+    in-flight call surfaces ConnectionLost and exercises this layer.)"""
+    from ray_tpu._private import worker_context
+    from ray_tpu._private.rpc import ConnectionLost
+
+    cw = worker_context.get_core_worker_if_initialized()
+    ref = ray_tpu.put({"k": 1})
+    failed = {"n": 0}
+    orig_call = cw._rpc.call
+
+    def dies_mid_flight(method, payload, timeout=None):
+        failed["n"] += 1
+        raise ConnectionLost("injected: connection lost mid-call")
+
+    cw._rpc.call = dies_mid_flight  # replaced wholesale on reconnect
+    assert ray_tpu.get(ref) == {"k": 1}
+    # >= 1: a queued ref-release piggyback may hit the injected failure
+    # first (it is caught and re-queued, also through this path).
+    assert failed["n"] >= 1
+    assert cw._reconnects >= 1
+    assert cw._rpc.call is not dies_mid_flight
+    del orig_call
+
+
+def test_client_replayed_mutation_is_at_most_once(client_connection):
+    """The same req_id re-sent after a reconnect must NOT re-run the side
+    effect: the server's session response cache replays the original
+    answer (at-most-once semantics for mutating calls)."""
+    from ray_tpu._private import serialization, worker_context
+
+    cw = worker_context.get_core_worker_if_initialized()
+    payload = {
+        "client_id": cw._client_id,
+        "req_id": cw._next_req_id(),
+        "value": serialization.dumps("only-once"),
+    }
+    r1 = cw._rpc.call("client_put", dict(payload))
+    r2 = cw._rpc.call("client_put", dict(payload))
+    assert r1["id"] == r2["id"], "replay created a second object"
