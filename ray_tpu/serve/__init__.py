@@ -17,6 +17,7 @@ from ray_tpu.serve.api import (  # noqa: F401
     get_deployment_handle,
     http_address,
     http_addresses,
+    ingress,
     run,
     shutdown,
     start,
@@ -42,6 +43,7 @@ __all__ = [
     "multiplexed",
     "http_address",
     "http_addresses",
+    "ingress",
     "run",
     "shutdown",
     "start",

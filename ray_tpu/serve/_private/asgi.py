@@ -1,0 +1,522 @@
+"""ASGI boundary for Serve ingress.
+
+The reference's proxy IS an ASGI application served by uvicorn
+(python/ray/serve/_private/http_proxy.py:320 `HTTPProxy.__call__(scope,
+receive, send)`), and replicas mount user ASGI apps (FastAPI) via
+`serve.ingress` (python/ray/serve/api.py:100). This module gives ray_tpu the
+same seam with the servers available in this image:
+
+- `ProxyASGIApp` — the ingress routing logic as a pure ASGI-3 callable. No
+  aiohttp types anywhere in it; it speaks only scope/receive/send.
+- `AiohttpASGIServer` — adapter that serves ANY ASGI-3 app on aiohttp (the
+  only HTTP server in the image). Swapping servers (e.g. to uvicorn) means
+  replacing this one class; the app and everything behind it are untouched.
+- `run_asgi_request` — replica-side bridge: drives a user ASGI app from the
+  `HTTPRequest` a replica receives, so `@serve.ingress(asgi_app)` mounts raw
+  ASGI apps (what the reference does with FastAPI) on deployments.
+
+Responses flow back as either a buffered envelope dict
+(`{"__serve_http_response__": True, status, headers, body}`) or a
+`StreamingResponse` whose chunks ride the replica's stream pump — both of
+which `ProxyASGIApp` translates back into ASGI send events.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import threading
+from urllib.parse import parse_qsl, urlencode
+
+logger = logging.getLogger(__name__)
+
+_DISCONNECT = {"type": "http.disconnect"}
+
+
+class ClientDisconnected(Exception):
+    """Raised from ``send`` inside a user ASGI app once the client is gone —
+    the ASGI-standard way a server stops a producer (uvicorn raises on send
+    after disconnect); the app unwinds through its own finally blocks."""
+
+
+def _build_scope(method, path, root_path, query_string: bytes, headers, client=None, server=None):
+    """One scope-dict construction for both bridges (adapter + replica)."""
+    return {
+        "type": "http",
+        "asgi": {"version": "3.0", "spec_version": "2.3"},
+        "http_version": "1.1",
+        "method": method,
+        "scheme": "http",
+        "path": path,
+        "raw_path": path.encode("latin-1"),
+        "root_path": root_path,
+        "query_string": query_string,
+        "headers": headers,
+        "client": client,
+        "server": server,
+    }
+
+
+async def _read_body(receive) -> bytes:
+    """Drain `http.request` events into one body (ASGI allows chunking)."""
+    parts = []
+    while True:
+        msg = await receive()
+        if msg["type"] == "http.request":
+            parts.append(msg.get("body", b""))
+            if not msg.get("more_body", False):
+                break
+        else:  # http.disconnect
+            break
+    return b"".join(parts)
+
+
+async def _respond_start(send, status: int, content_type: str, extra_headers: dict):
+    headers = [(b"content-type", content_type.encode("latin-1"))]
+    for k, v in extra_headers.items():
+        if k.lower() != "content-type":
+            headers.append((k.lower().encode("latin-1"), str(v).encode("latin-1")))
+    await send({"type": "http.response.start", "status": status, "headers": headers})
+
+
+async def _respond(send, status: int, body: bytes, content_type: str, extra_headers: dict | None = None):
+    extra = dict(extra_headers or {})
+    ctype = next((v for k, v in extra.items() if k.lower() == "content-type"), content_type)
+    await _respond_start(send, status, ctype, extra)
+    await send({"type": "http.response.body", "body": body, "more_body": False})
+
+
+def _np_default(o):
+    import numpy as np
+
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    if isinstance(o, np.generic):
+        return o.item()
+    raise TypeError(f"not JSON serializable: {type(o)}")
+
+
+class ProxyASGIApp:
+    """Serve's HTTP ingress as an ASGI-3 application.
+
+    Routes by longest prefix through the shared Router, forwards the request
+    to a replica (in an executor — replica calls block on the object store),
+    and pumps streaming responses chunk-by-chunk. Mirrors the reference's
+    `HTTPProxy` ASGI app (http_proxy.py:320) over ray_tpu's replica
+    protocol.
+    """
+
+    def __init__(self, router, pool):
+        self._router = router
+        self._pool = pool
+
+    async def __call__(self, scope, receive, send):
+        if scope["type"] == "lifespan":
+            while True:
+                msg = await receive()
+                if msg["type"] == "lifespan.startup":
+                    await send({"type": "lifespan.startup.complete"})
+                elif msg["type"] == "lifespan.shutdown":
+                    await send({"type": "lifespan.shutdown.complete"})
+                    return
+        if scope["type"] != "http":
+            return  # websockets not supported
+        path = scope.get("path", "/")
+        if path == "/-/healthz":
+            await _respond(send, 200, b"ok", "text/plain")
+            return
+        if path == "/-/routes":
+            with self._router._lock:
+                routes = {
+                    name: e.get("route_prefix") for name, e in self._router._table.items()
+                }
+            await _respond(send, 200, json.dumps(routes).encode(), "application/json")
+            return
+        deployment, matched_prefix = self._router.route_and_prefix_for(path)
+        if deployment is None:
+            await _respond(send, 404, f"no deployment for path {path}".encode(), "text/plain")
+            return
+        body = await _read_body(receive)
+        method = scope.get("method", "GET")
+        raw_query = scope.get("query_string", b"").decode("latin-1")
+        query = dict(parse_qsl(raw_query, keep_blank_values=True))
+        headers = {
+            k.decode("latin-1"): v.decode("latin-1") for k, v in scope.get("headers", [])
+        }
+        loop = asyncio.get_running_loop()
+        import ray_tpu
+
+        def call():
+            from ray_tpu.serve._private.common import MULTIPLEXED_MODEL_ID_HEADER
+
+            model_id = next(
+                (v for k, v in headers.items() if k.lower() == MULTIPLEXED_MODEL_ID_HEADER),
+                "",
+            )
+            replica = self._router.assign_replica(deployment, model_id=model_id)
+            try:
+                actor = self._router.handle_for(replica)
+                ref = actor.handle_http_request.remote(
+                    method, path, query, body, headers, model_id, matched_prefix,
+                    raw_query,
+                )
+                result = ray_tpu.get(ref, timeout=120)
+            except BaseException:
+                self._router.release(replica)
+                raise
+            if isinstance(result, dict) and "__serve_stream__" in result:
+                # Streaming: the replica stays assigned (queue metrics + its
+                # generator live there) until the pump finishes.
+                return replica, result
+            self._router.release(replica)
+            return None, result
+
+        try:
+            replica, result = await loop.run_in_executor(self._pool, call)
+        except Exception as e:
+            logger.exception("request to %s failed", deployment)
+            await _respond(send, 500, f"{type(e).__name__}: {e}".encode(), "text/plain")
+            return
+
+        if replica is not None:
+            await self._pump_stream(send, loop, deployment, replica, result)
+            return
+
+        status, payload, ctype, extra = _encode_result(result)
+        await _respond(send, status, payload, ctype, extra)
+
+    async def _pump_stream(self, send, loop, deployment, replica, envelope):
+        import ray_tpu
+
+        sid = envelope["__serve_stream__"]
+        await _respond_start(
+            send,
+            int(envelope.get("status", 200)),
+            envelope.get("content_type", "application/octet-stream"),
+            envelope.get("headers") or {},
+        )
+        actor = self._router.handle_for(replica)
+        finished = False
+        try:
+            while True:
+                batch = await loop.run_in_executor(
+                    self._pool,
+                    lambda: ray_tpu.get(actor.next_stream_chunk.remote(sid), timeout=120),
+                )
+                if batch is None:
+                    finished = True
+                    break
+                for chunk in batch["chunks"]:
+                    await send({"type": "http.response.body", "body": chunk, "more_body": True})
+                if batch["done"]:
+                    finished = True
+                    break
+        except Exception:
+            logger.exception("stream from %s aborted", deployment)
+        finally:
+            if not finished:
+                # Client disconnect / pump error: tear the stream down now
+                # rather than leaving its generator to the replica's
+                # 5-minute idle reaper.
+                try:
+                    actor.cancel_stream.remote(sid)
+                except Exception:
+                    pass
+            self._router.release(replica)
+        await send({"type": "http.response.body", "body": b"", "more_body": False})
+
+
+def _encode_result(result):
+    """Replica return value -> (status, payload bytes, content_type, extra_headers)."""
+    if isinstance(result, dict) and result.get("__serve_http_response__"):
+        body = result.get("body", b"")
+        if isinstance(body, str):
+            body = body.encode()
+        headers = dict(result.get("headers") or {})
+        ctype = next(
+            (v for k, v in headers.items() if k.lower() == "content-type"),
+            "application/octet-stream",
+        )
+        headers = {k: v for k, v in headers.items() if k.lower() != "content-type"}
+        return int(result.get("status", 200)), body, ctype, headers
+    if isinstance(result, bytes):
+        return 200, result, "application/octet-stream", None
+    if isinstance(result, str):
+        return 200, result.encode(), "text/plain; charset=utf-8", None
+    return 200, json.dumps(result, default=_np_default).encode(), "application/json", None
+
+
+class AiohttpASGIServer:
+    """Serve any ASGI-3 application on aiohttp.
+
+    The seam the reference gets from uvicorn: this class is the ONLY place
+    that knows the HTTP server's types. `await start()` on the serving loop
+    binds the socket; `.port` is the actual bound port.
+    """
+
+    def __init__(self, app, host: str = "127.0.0.1", port: int = 0):
+        self._app = app
+        self._host = host
+        self._want_port = port
+        self.port: int | None = None
+        self._runner = None
+
+    async def start(self):
+        from aiohttp import web
+
+        async def handle(request: "web.Request"):
+            scope = _build_scope(
+                request.method,
+                request.path,
+                "",
+                request.query_string.encode("latin-1"),
+                [
+                    (k.lower().encode("latin-1"), v.encode("latin-1"))
+                    for k, v in request.headers.items()
+                ],
+                client=request.transport.get_extra_info("peername")
+                if request.transport
+                else None,
+                server=(self._host, self.port),
+            )
+            body = await request.read()
+            delivered = [False]
+            # Set when the final http.response.body lands; a second receive()
+            # blocks until then (a live client is NOT "disconnected" — apps
+            # that race response-writing against a disconnect listener must
+            # not see an instant disconnect). A real mid-stream disconnect
+            # cancels this handler task, which cancels the app coroutine at
+            # whatever await it is parked on — the uvicorn behavior.
+            response_done = asyncio.Event()
+
+            async def receive():
+                if not delivered[0]:
+                    delivered[0] = True
+                    return {"type": "http.request", "body": body, "more_body": False}
+                await response_done.wait()
+                return dict(_DISCONNECT)
+
+            state: dict = {"status": 200, "headers": [], "resp": None}
+
+            async def send(event):
+                if event["type"] == "http.response.start":
+                    state["status"] = event["status"]
+                    state["headers"] = event.get("headers", [])
+                    return
+                if event["type"] != "http.response.body":
+                    return
+                chunk = event.get("body", b"")
+                more = event.get("more_body", False)
+                hdrs = {
+                    k.decode("latin-1"): v.decode("latin-1") for k, v in state["headers"]
+                }
+                if state["resp"] is None:
+                    if not more:
+                        state["resp"] = web.Response(
+                            status=state["status"], body=chunk, headers=hdrs
+                        )
+                        response_done.set()
+                        return
+                    resp = web.StreamResponse(status=state["status"], headers=hdrs)
+                    await resp.prepare(request)
+                    if chunk:
+                        await resp.write(chunk)
+                    state["resp"] = resp
+                    return
+                resp = state["resp"]
+                if isinstance(resp, web.StreamResponse) and not isinstance(resp, web.Response):
+                    if chunk:
+                        await resp.write(chunk)
+                    if not more:
+                        await resp.write_eof()
+                        response_done.set()
+
+            await self._app(scope, receive, send)
+            resp = state["resp"]
+            if resp is None:
+                resp = web.Response(status=500, text="ASGI app sent no response")
+            return resp
+
+        app = web.Application(client_max_size=1 << 30)
+        app.router.add_route("*", "/{tail:.*}", handle)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self._host, self._want_port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        return self
+
+
+_ingress_loop_lock = threading.Lock()
+_ingress_loop = None
+
+
+def _get_ingress_loop():
+    """One persistent event loop thread per process for all serve.ingress
+    apps — loop-bound app state (connection pools, caches) survives across
+    requests and no thread/loop is created per request."""
+    global _ingress_loop
+    with _ingress_loop_lock:
+        if _ingress_loop is None or not _ingress_loop[1].is_alive():
+            loop = asyncio.new_event_loop()
+            thread = threading.Thread(
+                target=lambda: (asyncio.set_event_loop(loop), loop.run_forever()),
+                name="asgi-ingress",
+                daemon=True,
+            )
+            thread.start()
+            _ingress_loop = (loop, thread)
+        return _ingress_loop[0]
+
+
+class _AppBridge:
+    """send/receive pair driving a user ASGI app from sync replica code.
+
+    - ``send`` events land in an unbounded queue drained by the caller; once
+      ``closed`` is set (client gone or response fully consumed) further
+      sends raise ClientDisconnected so the app stops producing — the leak
+      guard for infinite SSE producers whose client went away.
+    - a second ``receive`` blocks until ``closed``, then reports
+      http.disconnect — never an instant disconnect while the response is
+      still being consumed (spec: disconnect means the client is GONE).
+    """
+
+    def __init__(self, body: bytes):
+        import queue as _queue
+
+        self.out: _queue.Queue = _queue.Queue()
+        self.closed = threading.Event()
+        self._body = body
+        self._delivered = False
+
+    async def receive(self):
+        if not self._delivered:
+            self._delivered = True
+            return {"type": "http.request", "body": self._body, "more_body": False}
+        await asyncio.get_running_loop().run_in_executor(None, self.closed.wait)
+        return dict(_DISCONNECT)
+
+    async def send(self, event):
+        if self.closed.is_set():
+            raise ClientDisconnected()
+        self.out.put(event)
+
+
+def run_asgi_request(asgi_app, request):
+    """Drive a user ASGI app with a replica `HTTPRequest`, sync->async bridge.
+
+    Replica side of `serve.ingress` (reference mounts FastAPI apps this way,
+    python/ray/serve/api.py:100; here any ASGI-3 callable). The app runs on
+    the shared per-process ingress loop; its send events are collected from
+    a queue. Buffered responses return the envelope dict `_encode_result`
+    understands; streaming responses (more_body=True) return a
+    `StreamingResponse` whose generator drains the queue as the app
+    produces chunks — riding the replica's existing stream pump.
+
+    Scope mapping: the deployment's matched route prefix becomes ASGI
+    `root_path` and the app sees the sub-path, so apps behave identically
+    under any mount point (starlette mount semantics). The query string is
+    the raw wire bytes the proxy saw (duplicate keys and ordering intact).
+    """
+    from ray_tpu.serve.api import StreamingResponse
+
+    raw_query = getattr(request, "raw_query_string", None)
+    if raw_query is None:
+        raw_query = urlencode(request.query_params or {})
+    scope = _build_scope(
+        request.method,
+        request.sub_path,
+        (request.route_prefix or "").rstrip("/"),
+        raw_query.encode("latin-1"),
+        [
+            (k.lower().encode("latin-1"), str(v).encode("latin-1"))
+            for k, v in (request.headers or {}).items()
+        ],
+    )
+    bridge = _AppBridge(request.body or b"")
+    out = bridge.out
+
+    fut = asyncio.run_coroutine_threadsafe(
+        asgi_app(scope, bridge.receive, bridge.send), _get_ingress_loop()
+    )
+
+    def _on_done(f):
+        try:
+            exc = f.exception()
+        except asyncio.CancelledError:
+            exc = None
+        if exc is not None and not isinstance(exc, ClientDisconnected):
+            out.put({"type": "__app_error__", "error": exc})
+        else:
+            out.put({"type": "__app_done__"})
+
+    fut.add_done_callback(_on_done)
+
+    status, headers = 200, {}
+    chunks: list[bytes] = []
+    streaming = False
+    try:
+        while True:
+            ev = out.get(timeout=120)
+            if ev["type"] == "__app_error__":
+                raise ev["error"]
+            if ev["type"] == "__app_done__":
+                break
+            if ev["type"] == "http.response.start":
+                status = ev["status"]
+                headers = {
+                    k.decode("latin-1"): v.decode("latin-1")
+                    for k, v in ev.get("headers", [])
+                }
+            elif ev["type"] == "http.response.body":
+                chunk = ev.get("body", b"")
+                if ev.get("more_body", False):
+                    streaming = True  # the generator owns bridge closure
+
+                    def gen(first=chunk):
+                        try:
+                            if first:
+                                yield first
+                            while True:
+                                e2 = out.get(timeout=300)
+                                if e2["type"] == "__app_error__":
+                                    raise e2["error"]
+                                if e2["type"] == "__app_done__":
+                                    return
+                                if e2["type"] == "http.response.body":
+                                    b2 = e2.get("body", b"")
+                                    if b2:
+                                        yield b2
+                                    if not e2.get("more_body", False):
+                                        return
+                        finally:
+                            # Normal end, client disconnect (GeneratorExit
+                            # via the stream pump's close), or error: stop
+                            # the producer and unblock its receive().
+                            bridge.closed.set()
+
+                    ctype = next(
+                        (v for k, v in headers.items() if k.lower() == "content-type"),
+                        "application/octet-stream",
+                    )
+                    sr = StreamingResponse(gen(), content_type=ctype)
+                    sr.status = status
+                    sr.headers = {
+                        k: v for k, v in headers.items() if k.lower() != "content-type"
+                    }
+                    return sr
+                chunks.append(chunk)
+                break  # complete buffered response
+    finally:
+        # Buffered response consumed, app finished, or collection failed:
+        # post-response sends raise and a parked disconnect-listener
+        # receive() resolves. The streaming path closes from its generator.
+        if not streaming:
+            bridge.closed.set()
+    return {
+        "__serve_http_response__": True,
+        "status": status,
+        "headers": headers,
+        "body": b"".join(chunks),
+    }

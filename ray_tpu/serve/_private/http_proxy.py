@@ -2,15 +2,17 @@
 
 Reference: python/ray/serve/_private/http_proxy.py:320 HTTPProxy (ASGI app),
 :553 HTTPProxyActor — one proxy actor per node, routing by longest prefix to
-deployment replicas. Here the ASGI stack is aiohttp running on a dedicated
-thread inside the proxy actor process; replica calls run in an executor so
-the HTTP loop never blocks on the object store.
+deployment replicas. The routing logic lives in `ProxyASGIApp`
+(_private/asgi.py), a pure ASGI-3 application — exactly the reference's
+shape — and this actor just binds it to a server. The server is the
+`AiohttpASGIServer` adapter (uvicorn is absent from the image); swapping
+servers touches only that adapter, never the app. Replica calls run in an
+executor so the HTTP loop never blocks on the object store.
 """
 
 from __future__ import annotations
 
 import asyncio
-import json
 import logging
 import threading
 from concurrent.futures import ThreadPoolExecutor
@@ -42,122 +44,13 @@ class HTTPProxy:
         return self._ready.is_set()
 
     def _serve(self):
-        from aiohttp import web
+        from ray_tpu.serve._private.asgi import AiohttpASGIServer, ProxyASGIApp
 
         loop = asyncio.new_event_loop()
         asyncio.set_event_loop(loop)
-
-        async def handler(request: "web.Request"):
-            path = request.path
-            if path == "/-/healthz":
-                return web.Response(text="ok")
-            if path == "/-/routes":
-                with self._router._lock:
-                    routes = {
-                        name: e.get("route_prefix")
-                        for name, e in self._router._table.items()
-                    }
-                return web.json_response(routes)
-            deployment, matched_prefix = self._router.route_and_prefix_for(path)
-            if deployment is None:
-                return web.Response(status=404, text=f"no deployment for path {path}")
-            body = await request.read()
-            method = request.method
-            query = dict(request.query)
-            headers = dict(request.headers)
-
-            def call():
-                from ray_tpu.serve._private.common import MULTIPLEXED_MODEL_ID_HEADER
-
-                # Case-insensitive header lookup without mutating the header
-                # dict user deployments receive.
-                model_id = next(
-                    (v for k, v in headers.items() if k.lower() == MULTIPLEXED_MODEL_ID_HEADER),
-                    "",
-                )
-                replica = self._router.assign_replica(deployment, model_id=model_id)
-                try:
-                    actor = self._router.handle_for(replica)
-                    ref = actor.handle_http_request.remote(
-                        method, path, query, body, headers, model_id,
-                        matched_prefix,
-                    )
-                    result = ray_tpu.get(ref, timeout=120)
-                except BaseException:
-                    self._router.release(replica)
-                    raise
-                if isinstance(result, dict) and "__serve_stream__" in result:
-                    # Streaming: the replica stays assigned (queue metrics +
-                    # its generator lives there) until the pump finishes.
-                    return replica, result
-                self._router.release(replica)
-                return None, result
-
-            try:
-                replica, result = await loop.run_in_executor(self._pool, call)
-            except Exception as e:
-                logger.exception("request to %s failed", deployment)
-                return web.Response(status=500, text=f"{type(e).__name__}: {e}")
-            if replica is not None:
-                sid = result["__serve_stream__"]
-                resp = web.StreamResponse(
-                    headers={"Content-Type": result.get("content_type", "application/octet-stream")}
-                )
-                await resp.prepare(request)
-                actor = self._router.handle_for(replica)
-                finished = False
-                try:
-                    while True:
-                        batch = await loop.run_in_executor(
-                            self._pool,
-                            lambda: ray_tpu.get(
-                                actor.next_stream_chunk.remote(sid), timeout=120
-                            ),
-                        )
-                        if batch is None:
-                            finished = True
-                            break
-                        for chunk in batch["chunks"]:
-                            await resp.write(chunk)
-                        if batch["done"]:
-                            finished = True
-                            break
-                except Exception:
-                    logger.exception("stream from %s aborted", deployment)
-                finally:
-                    if not finished:
-                        # Client disconnect / pump error: tear the stream
-                        # down now rather than leaving its generator to the
-                        # replica's 5-minute idle reaper.
-                        try:
-                            actor.cancel_stream.remote(sid)
-                        except Exception:
-                            pass
-                    self._router.release(replica)
-                await resp.write_eof()
-                return resp
-            if isinstance(result, bytes):
-                return web.Response(body=result)
-            if isinstance(result, str):
-                return web.Response(text=result)
-            return web.json_response(result, dumps=lambda o: json.dumps(o, default=_np_default))
-
-        app = web.Application(client_max_size=1 << 30)
-        app.router.add_route("*", "/{tail:.*}", handler)
-        runner = web.AppRunner(app, access_log=None)
-        loop.run_until_complete(runner.setup())
-        site = web.TCPSite(runner, self._host, self._port)
-        loop.run_until_complete(site.start())
-        self._actual_port = site._server.sockets[0].getsockname()[1]
+        app = ProxyASGIApp(self._router, self._pool)
+        server = AiohttpASGIServer(app, self._host, self._port)
+        loop.run_until_complete(server.start())
+        self._actual_port = server.port
         self._ready.set()
         loop.run_forever()
-
-
-def _np_default(o):
-    import numpy as np
-
-    if isinstance(o, np.ndarray):
-        return o.tolist()
-    if isinstance(o, np.generic):
-        return o.item()
-    raise TypeError(f"not JSON serializable: {type(o)}")

@@ -167,14 +167,16 @@ class Replica:
         headers: dict,
         multiplexed_model_id: str = "",
         route_prefix: str | None = None,
+        raw_query_string: str | None = None,
     ):
         """HTTP entry: the callable gets a lightweight Request object. The
         proxy passes the multiplexed model id it already extracted for
-        routing — one extraction, no divergence — and the matched route
-        prefix so sub-route dispatch (DAGDriver) works under any mount."""
+        routing — one extraction, no divergence — the matched route
+        prefix so sub-route dispatch (DAGDriver) works under any mount, and
+        the raw query string so ASGI ingress apps see wire-exact bytes."""
         request = HTTPRequest(
             method=method, path=path, query=query, body=body, headers=headers,
-            route_prefix=route_prefix,
+            route_prefix=route_prefix, raw_query_string=raw_query_string,
         )
         result = self.handle_request(
             "__call__", (request,), {}, multiplexed_model_id=multiplexed_model_id
@@ -189,14 +191,22 @@ class Replica:
             # next_stream_chunk and writes chunks to the socket as produced.
             if isinstance(result, StreamingResponse):
                 gen, ctype = iter(result.iterator), result.content_type
+                status = getattr(result, "status", 200)
+                extra = getattr(result, "headers", None) or {}
             else:
                 gen, ctype = result, "application/octet-stream"
+                status, extra = 200, {}
             with self._lock:
                 self._reap_idle_streams_locked()
                 self._stream_counter += 1
                 sid = str(self._stream_counter)
                 self._streams[sid] = _StreamPump(gen, multiplexed_model_id)
-            return {"__serve_stream__": sid, "content_type": ctype}
+            return {
+                "__serve_stream__": sid,
+                "content_type": ctype,
+                "status": status,
+                "headers": extra,
+            }
         return result
 
     def _reap_idle_streams_locked(self):
@@ -291,13 +301,16 @@ class HTTPRequest:
     (stands in for the reference's starlette.requests.Request)."""
 
     def __init__(self, method: str, path: str, query: dict, body: bytes, headers: dict,
-                 route_prefix: str | None = None):
+                 route_prefix: str | None = None, raw_query_string: str | None = None):
         self.method = method
         self.path = path
         self.query_params = query
         self.body = body
         self.headers = headers
         self.route_prefix = route_prefix
+        # Wire-exact query string (duplicate keys/order intact) for ASGI
+        # ingress; query_params remains the collapsed dict convenience.
+        self.raw_query_string = raw_query_string
 
     @property
     def sub_path(self) -> str:

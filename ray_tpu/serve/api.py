@@ -324,6 +324,48 @@ class StreamingResponse:
                 return StreamingResponse(self.generate(), content_type="text/plain")
     """
 
-    def __init__(self, iterator, content_type: str = "application/octet-stream"):
+    def __init__(
+        self,
+        iterator,
+        content_type: str = "application/octet-stream",
+        status: int = 200,
+        headers: Optional[dict] = None,
+    ):
         self.iterator = iterator
         self.content_type = content_type
+        self.status = status
+        self.headers = headers or {}
+
+
+def ingress(asgi_app):
+    """Mount an ASGI-3 application as a deployment's HTTP entry.
+
+    Reference: python/ray/serve/api.py:100 `serve.ingress(fastapi_app)` —
+    there it mounts FastAPI; here any raw ASGI-3 callable (fastapi/starlette
+    are not in the image, and the seam is the ASGI protocol itself, not a
+    particular framework). Apply UNDER @serve.deployment:
+
+        @serve.deployment(route_prefix="/svc")
+        @serve.ingress(my_asgi_app)
+        class Svc:
+            pass
+
+    HTTP requests routed to the deployment drive ``my_asgi_app`` with the
+    matched route prefix as ASGI root_path (starlette mount semantics);
+    handle calls still reach methods defined on the class.
+    """
+
+    def decorator(cls):
+        from ray_tpu.serve._private.asgi import run_asgi_request
+
+        class ASGIWrapped(cls):
+            def __call__(self, request):
+                return run_asgi_request(asgi_app, request)
+
+        ASGIWrapped.__name__ = cls.__name__
+        ASGIWrapped.__qualname__ = getattr(cls, "__qualname__", cls.__name__)
+        ASGIWrapped.__module__ = cls.__module__
+        ASGIWrapped.__doc__ = cls.__doc__
+        return ASGIWrapped
+
+    return decorator

@@ -1,0 +1,216 @@
+"""ASGI seam tests: adapter unit tests + serve.ingress end-to-end.
+
+Models the reference's ASGI-boundary coverage (its proxy is an ASGI app
+served by uvicorn and serve.ingress mounts user ASGI apps —
+python/ray/serve/tests/test_fastapi.py). Here the apps are raw ASGI-3
+callables and the server is the aiohttp adapter.
+"""
+
+import asyncio
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+async def echo_app(scope, receive, send):
+    """ASGI-3 echo: reports method/path/root_path/query/body, status 201."""
+    if scope["type"] != "http":
+        return
+    body = b""
+    while True:
+        msg = await receive()
+        if msg["type"] == "http.request":
+            body += msg.get("body", b"")
+            if not msg.get("more_body", False):
+                break
+        else:
+            break
+    payload = json.dumps(
+        {
+            "method": scope["method"],
+            "path": scope["path"],
+            "root_path": scope.get("root_path", ""),
+            "query": scope["query_string"].decode(),
+            "body": body.decode(),
+        }
+    ).encode()
+    await send(
+        {
+            "type": "http.response.start",
+            "status": 201,
+            "headers": [(b"content-type", b"application/json"), (b"x-custom", b"yes")],
+        }
+    )
+    await send({"type": "http.response.body", "body": payload, "more_body": False})
+
+
+async def chunked_app(scope, receive, send):
+    """Streams three chunks with more_body=True."""
+    if scope["type"] != "http":
+        return
+    await receive()
+    await send(
+        {
+            "type": "http.response.start",
+            "status": 200,
+            "headers": [(b"content-type", b"text/plain")],
+        }
+    )
+    for part in (b"alpha-", b"beta-", b"gamma"):
+        await send({"type": "http.response.body", "body": part, "more_body": True})
+    await send({"type": "http.response.body", "body": b"", "more_body": False})
+
+
+def _get(url, data=None, method=None):
+    req = urllib.request.Request(url, data=data, method=method)
+    try:
+        resp = urllib.request.urlopen(req, timeout=30)
+        return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+# ---------------------------------------------------------------------------
+# Adapter unit tests: AiohttpASGIServer serving raw ASGI apps, no cluster.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def asgi_server():
+    from ray_tpu.serve._private.asgi import AiohttpASGIServer
+
+    started = threading.Event()
+    holder = {}
+
+    async def dispatch(scope, receive, send):
+        if scope.get("path", "").startswith("/chunked"):
+            await chunked_app(scope, receive, send)
+        else:
+            await echo_app(scope, receive, send)
+
+    def serve_thread():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        server = AiohttpASGIServer(dispatch, "127.0.0.1", 0)
+        loop.run_until_complete(server.start())
+        holder["port"] = server.port
+        holder["loop"] = loop
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=serve_thread, daemon=True)
+    t.start()
+    assert started.wait(10)
+    yield holder["port"]
+    holder["loop"].call_soon_threadsafe(holder["loop"].stop)
+
+
+def test_adapter_buffered_response(asgi_server):
+    status, headers, body = _get(
+        f"http://127.0.0.1:{asgi_server}/a/b?x=1&y=", data=b"ping", method="POST"
+    )
+    assert status == 201
+    assert headers.get("x-custom") == "yes"
+    out = json.loads(body)
+    assert out["method"] == "POST"
+    assert out["path"] == "/a/b"
+    assert out["query"] == "x=1&y="
+    assert out["body"] == "ping"
+
+
+def test_adapter_streamed_response(asgi_server):
+    status, _, body = _get(f"http://127.0.0.1:{asgi_server}/chunked")
+    assert status == 200
+    assert body == b"alpha-beta-gamma"
+
+
+# ---------------------------------------------------------------------------
+# serve.ingress end-to-end through the proxy.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def serve_instance():
+    ray_tpu.init(num_cpus=8, object_store_memory=128 * 1024 * 1024)
+    serve.start()
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_ingress_asgi_app(serve_instance):
+    @serve.deployment
+    @serve.ingress(echo_app)
+    class EchoSvc:
+        pass
+
+    serve.run(EchoSvc.bind(), route_prefix="/svc")
+    host, port = serve.http_address()
+    status, headers, body = _get(
+        f"http://{host}:{port}/svc/sub/route?k=v", data=b"hello", method="POST"
+    )
+    assert status == 201
+    assert headers.get("x-custom") == "yes"
+    out = json.loads(body)
+    # Mount semantics: app sees the sub-path; mount point is root_path.
+    assert out["path"] == "/sub/route"
+    assert out["root_path"] == "/svc"
+    assert out["body"] == "hello"
+    assert out["query"] == "k=v"
+    serve.delete("EchoSvc")
+
+
+def test_ingress_raw_query_string(serve_instance):
+    """Duplicate keys and ordering survive to the app's scope (wire-exact)."""
+
+    @serve.deployment
+    @serve.ingress(echo_app)
+    class QuerySvc:
+        pass
+
+    serve.run(QuerySvc.bind(), route_prefix="/q")
+    host, port = serve.http_address()
+    _, _, body = _get(f"http://{host}:{port}/q?tag=a&tag=b&z=1")
+    assert json.loads(body)["query"] == "tag=a&tag=b&z=1"
+    serve.delete("QuerySvc")
+
+
+def test_ingress_streaming_asgi_app(serve_instance):
+    @serve.deployment
+    @serve.ingress(chunked_app)
+    class ChunkSvc:
+        pass
+
+    serve.run(ChunkSvc.bind(), route_prefix="/chunks")
+    host, port = serve.http_address()
+    status, headers, body = _get(f"http://{host}:{port}/chunks")
+    assert status == 200
+    assert body == b"alpha-beta-gamma"
+    serve.delete("ChunkSvc")
+
+
+def test_http_response_envelope_status(serve_instance):
+    """Non-ASGI deployments can also set status/headers via the envelope."""
+
+    @serve.deployment
+    def teapot(request):
+        return {
+            "__serve_http_response__": True,
+            "status": 418,
+            "headers": {"x-kind": "teapot", "content-type": "text/plain"},
+            "body": "short and stout",
+        }
+
+    serve.run(teapot.bind(), route_prefix="/teapot")
+    host, port = serve.http_address()
+    status, headers, body = _get(f"http://{host}:{port}/teapot")
+    assert status == 418
+    assert headers.get("x-kind") == "teapot"
+    assert body == b"short and stout"
+    serve.delete("teapot")
