@@ -42,6 +42,16 @@ def _make_provider(config: dict) -> NodeProvider:
         from ray_tpu.autoscaler.node_provider import TPUPodProvider
 
         return TPUPodProvider(pconf, config.get("cluster_name", "default"))
+    if ptype in ("aws", "gcp", "gce", "azure"):
+        from ray_tpu.autoscaler import cloud_providers
+
+        cls = {
+            "aws": cloud_providers.AWSNodeProvider,
+            "gcp": cloud_providers.GCENodeProvider,
+            "gce": cloud_providers.GCENodeProvider,
+            "azure": cloud_providers.AzureNodeProvider,
+        }[ptype]
+        return cls(pconf, config.get("cluster_name", "default"))
     raise ValueError(f"unknown provider type {ptype!r}")
 
 

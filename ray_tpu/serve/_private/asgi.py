@@ -49,7 +49,10 @@ def _build_scope(method, path, root_path, query_string: bytes, headers, client=N
         "method": method,
         "scheme": "http",
         "path": path,
-        "raw_path": path.encode("latin-1"),
+        # utf-8, not latin-1: `path` arrives percent-DECODED (aiohttp's
+        # request.path / the replica sub_path) and may contain any unicode;
+        # headers stay latin-1 per the HTTP wire format.
+        "raw_path": path.encode("utf-8"),
         "root_path": root_path,
         "query_string": query_string,
         "headers": headers,
@@ -139,7 +142,9 @@ class ProxyASGIApp:
             return
         body = await _read_body(receive)
         method = scope.get("method", "GET")
-        raw_query = scope.get("query_string", b"").decode("latin-1")
+        # surrogateescape so arbitrary wire bytes survive the str hop to the
+        # replica and re-encode back to the identical bytes for its scope.
+        raw_query = scope.get("query_string", b"").decode("utf-8", "surrogateescape")
         query = dict(parse_qsl(raw_query, keep_blank_values=True))
         headers = {
             k.decode("latin-1"): v.decode("latin-1") for k, v in scope.get("headers", [])
@@ -270,7 +275,7 @@ class AiohttpASGIServer:
                 request.method,
                 request.path,
                 "",
-                request.query_string.encode("latin-1"),
+                request.query_string.encode("utf-8"),
                 [
                     (k.lower().encode("latin-1"), v.encode("latin-1"))
                     for k, v in request.headers.items()
@@ -382,10 +387,16 @@ class _AppBridge:
       still being consumed (spec: disconnect means the client is GONE).
     """
 
+    # Bounded: a fast producer with a slow client parks in ``send`` instead
+    # of buffering the whole response in replica memory (uvicorn's
+    # backpressure, expressed as a poll so the shared ingress loop is never
+    # blocked by one stream).
+    _MAX_BUFFERED_EVENTS = 256
+
     def __init__(self, body: bytes):
         import queue as _queue
 
-        self.out: _queue.Queue = _queue.Queue()
+        self.out: _queue.Queue = _queue.Queue(maxsize=self._MAX_BUFFERED_EVENTS)
         self.closed = threading.Event()
         self._body = body
         self._delivered = False
@@ -398,9 +409,16 @@ class _AppBridge:
         return dict(_DISCONNECT)
 
     async def send(self, event):
-        if self.closed.is_set():
-            raise ClientDisconnected()
-        self.out.put(event)
+        import queue as _queue
+
+        while True:
+            if self.closed.is_set():
+                raise ClientDisconnected()
+            try:
+                self.out.put_nowait(event)
+                return
+            except _queue.Full:
+                await asyncio.sleep(0.02)
 
 
 def run_asgi_request(asgi_app, request):
@@ -428,7 +446,7 @@ def run_asgi_request(asgi_app, request):
         request.method,
         request.sub_path,
         (request.route_prefix or "").rstrip("/"),
-        raw_query.encode("latin-1"),
+        raw_query.encode("utf-8", "surrogateescape"),
         [
             (k.lower().encode("latin-1"), str(v).encode("latin-1"))
             for k, v in (request.headers or {}).items()
@@ -500,12 +518,14 @@ def run_asgi_request(asgi_app, request):
                         (v for k, v in headers.items() if k.lower() == "content-type"),
                         "application/octet-stream",
                     )
-                    sr = StreamingResponse(gen(), content_type=ctype)
-                    sr.status = status
-                    sr.headers = {
-                        k: v for k, v in headers.items() if k.lower() != "content-type"
-                    }
-                    return sr
+                    return StreamingResponse(
+                        gen(),
+                        content_type=ctype,
+                        status=status,
+                        headers={
+                            k: v for k, v in headers.items() if k.lower() != "content-type"
+                        },
+                    )
                 chunks.append(chunk)
                 break  # complete buffered response
     finally:
