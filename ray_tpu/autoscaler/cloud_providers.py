@@ -27,7 +27,7 @@ from __future__ import annotations
 import base64
 import hashlib
 import hmac
-import json
+import logging
 import time
 import urllib.error
 import urllib.parse
@@ -35,13 +35,13 @@ import urllib.request
 import uuid
 import xml.etree.ElementTree as ET
 
-from ray_tpu.autoscaler.node_provider import NodeProvider
-
-_DEFAULT_STARTUP = (
-    "#! /bin/bash\n"
-    "python -m ray_tpu.scripts.scripts start --address {gcs_address} "
-    "--labels '{{\"provider_node_id\": \"{node_id}\"}}' --block\n"
+from ray_tpu.autoscaler.node_provider import (
+    DEFAULT_STARTUP_TEMPLATE,
+    NodeProvider,
+    bearer_json_request,
 )
+
+logger = logging.getLogger(__name__)
 
 
 def _render_startup(template: str, node_id: str, gcs_address: str) -> str:
@@ -57,13 +57,18 @@ class _CloudProviderBase(NodeProvider):
         super().__init__(provider_config, cluster_name)
         self.gcs_address_for_workers = provider_config.get("gcs_address", "")
         self.startup_script_template = provider_config.get(
-            "startup_script_template", _DEFAULT_STARTUP
+            "startup_script_template", DEFAULT_STARTUP_TEMPLATE
         )
         self.poll_interval_s = provider_config.get("poll_interval_s", 2.0)
         self.create_timeout_s = provider_config.get("create_timeout_s", 600.0)
         # Tests block until creation lands; autoscaler ticks must not.
         self.wait_for_ready = provider_config.get("wait_for_ready", False)
         self._tags_cache: dict[str, dict] = {}
+        self._token_provider = provider_config.get("_token_provider")
+        self._token = provider_config.get("access_token")
+
+    def _bearer_token(self) -> str | None:
+        return self._token_provider() if self._token_provider else self._token
 
     def _startup(self, node_id: str) -> str:
         return _render_startup(
@@ -234,7 +239,10 @@ class AWSNodeProvider(_CloudProviderBase):
                 nid = tags.get("provider_node_id") or iid
                 out.append({"id": nid, "instance_id": iid, "state": state, "tags": tags})
         self._tags_cache = {n["id"]: n["tags"] for n in out}
-        self._instance_ids = {n["id"]: n["instance_id"] for n in out}
+        # Merge, don't replace: a just-created instance can be missing from
+        # an eventually-consistent DescribeInstances response, and dropping
+        # its mapping would leave terminate_node without the EC2 id.
+        self._instance_ids.update({n["id"]: n["instance_id"] for n in out})
         return out
 
     def non_terminated_nodes(self) -> list[str]:
@@ -298,9 +306,17 @@ class AWSNodeProvider(_CloudProviderBase):
         iid = self._instance_ids.get(node_id)
         if iid is None:
             self._list_instances()  # refresh the id map (autoscaler restart)
-            iid = self._instance_ids.get(node_id, node_id)
+            iid = self._instance_ids.get(node_id)
+        if iid is None and node_id.startswith("i-"):
+            iid = node_id  # caller already holds a raw EC2 id
         self._tags_cache.pop(node_id, None)
         self._instance_ids.pop(node_id, None)
+        if iid is None:
+            # Unknown to EC2 (already terminated + aged out of Describe):
+            # sending the provider node id would be InvalidInstanceID —
+            # treat like the 404 path of the other providers.
+            logger.warning("terminate_node: no EC2 instance id for %s; skipping", node_id)
+            return
         self._call("TerminateInstances", {"InstanceId.1": iid})
 
     def is_running(self, node_id: str) -> bool:
@@ -342,8 +358,6 @@ class GCENodeProvider(_CloudProviderBase):
             "api_endpoint", "https://compute.googleapis.com"
         ).rstrip("/")
         self.base = f"{endpoint}/compute/v1/projects/{self.project}/zones/{self.zone}"
-        self._token_provider = provider_config.get("_token_provider")
-        self._token = provider_config.get("access_token")
         if endpoint == "https://compute.googleapis.com" and not (
             self._token or self._token_provider
         ):
@@ -355,15 +369,7 @@ class GCENodeProvider(_CloudProviderBase):
 
     def _request(self, method: str, path: str, body: dict | None = None) -> dict:
         url = path if path.startswith("http") else self.base + path
-        data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(url, data=data, method=method)
-        req.add_header("Content-Type", "application/json")
-        token = self._token_provider() if self._token_provider else self._token
-        if token:
-            req.add_header("Authorization", f"Bearer {token}")
-        with urllib.request.urlopen(req, timeout=60) as resp:
-            payload = resp.read()
-        return json.loads(payload) if payload else {}
+        return bearer_json_request(method, url, body, self._bearer_token())
 
     def _list_nodes(self) -> list[dict]:
         resp = self._request(
@@ -372,7 +378,17 @@ class GCENodeProvider(_CloudProviderBase):
             + urllib.parse.quote(f"labels.ray-cluster-name={_gce_safe(self.cluster_name)}"),
         )
         items = resp.get("items", [])
-        self._tags_cache = {n["name"]: dict(n.get("labels", {})) for n in items}
+        cache = {}
+        for n in items:
+            labels = dict(n.get("labels", {}))
+            # Labels are _gce_safe-sanitized; the ORIGINAL node_type (which
+            # must match config["node_types"] keys exactly for autoscaler
+            # reconciliation) rides free-form instance metadata.
+            for item in (n.get("metadata") or {}).get("items", []):
+                if item.get("key") == "ray-node-type":
+                    labels["node_type"] = item.get("value", labels.get("node_type"))
+            cache[n["name"]] = labels
+        self._tags_cache = cache
         return items
 
     def non_terminated_nodes(self) -> list[str]:
@@ -417,14 +433,15 @@ class GCENodeProvider(_CloudProviderBase):
                     {"network": conf.get("network", "global/networks/default")}
                 ],
             }
+            meta_items = [{"key": "ray-node-type", "value": node_type}]
             if self.gcs_address_for_workers:
-                body["metadata"] = {
-                    "items": [
-                        {"key": "startup-script", "value": self._startup(node_id)}
-                    ]
-                }
+                meta_items.append(
+                    {"key": "startup-script", "value": self._startup(node_id)}
+                )
+            body["metadata"] = {"items": meta_items}
             ops.append(self._request("POST", "/instances", body))
             created.append(node_id)
+            labels["node_type"] = node_type  # original, metadata-backed
             self._tags_cache[node_id] = labels
         if self.wait_for_ready:
             self._wait_operations(ops)
@@ -493,8 +510,6 @@ class AzureNodeProvider(_CloudProviderBase):
             f"{endpoint}/subscriptions/{self.subscription}/resourceGroups/"
             f"{self.resource_group}/providers/Microsoft.Compute/virtualMachines"
         )
-        self._token_provider = provider_config.get("_token_provider")
-        self._token = provider_config.get("access_token")
         if endpoint == "https://management.azure.com" and not (
             self._token or self._token_provider
         ):
@@ -505,15 +520,7 @@ class AzureNodeProvider(_CloudProviderBase):
             )
 
     def _request(self, method: str, url: str, body: dict | None = None) -> dict:
-        data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(url, data=data, method=method)
-        req.add_header("Content-Type", "application/json")
-        token = self._token_provider() if self._token_provider else self._token
-        if token:
-            req.add_header("Authorization", f"Bearer {token}")
-        with urllib.request.urlopen(req, timeout=60) as resp:
-            payload = resp.read()
-        return json.loads(payload) if payload else {}
+        return bearer_json_request(method, url, body, self._bearer_token())
 
     def _list_nodes(self) -> list[dict]:
         resp = self._request("GET", f"{self.base}?{self._API}")

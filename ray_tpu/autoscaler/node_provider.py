@@ -26,6 +26,31 @@ import time
 import uuid
 
 
+DEFAULT_STARTUP_TEMPLATE = (
+    "#! /bin/bash\n"
+    "python -m ray_tpu.scripts.scripts start --address {gcs_address} "
+    "--labels '{{\"provider_node_id\": \"{node_id}\"}}' --block\n"
+)
+
+
+def bearer_json_request(
+    method: str, url: str, body: dict | None = None, token: str | None = None,
+    timeout: float = 60.0,
+) -> dict:
+    """JSON-over-HTTP with optional bearer auth — the one REST transport
+    shared by every GCE-style provider (TPU pods, GCE VMs, Azure ARM)."""
+    import urllib.request
+
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    req.add_header("Content-Type", "application/json")
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        payload = resp.read()
+    return json.loads(payload) if payload else {}
+
+
 class NodeProvider:
     """Provider interface (create/terminate/list)."""
 
@@ -182,10 +207,7 @@ class TPUPodProvider(NodeProvider):
         # recycle (billable) slices forever on boot timeout. Template fields:
         # {node_id}, {gcs_address}.
         self.startup_script_template = provider_config.get(
-            "startup_script_template",
-            "#! /bin/bash\n"
-            "python -m ray_tpu.scripts.scripts start --address {gcs_address} "
-            "--labels '{{\"provider_node_id\": \"{node_id}\"}}' --block\n",
+            "startup_script_template", DEFAULT_STARTUP_TEMPLATE
         )
         self.gcs_address_for_workers = provider_config.get("gcs_address", "")
         if self.endpoint == "https://tpu.googleapis.com" and not (self._token or self._token_provider):
@@ -198,19 +220,9 @@ class TPUPodProvider(NodeProvider):
     # -- HTTP plumbing -------------------------------------------------
 
     def _request(self, method: str, path: str, body: dict | None = None) -> dict:
-        import json as _json
-        import urllib.request
-
         url = path if path.startswith("http") else self.base + path
-        data = _json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(url, data=data, method=method)
-        req.add_header("Content-Type", "application/json")
         token = self._token_provider() if self._token_provider else self._token
-        if token:
-            req.add_header("Authorization", f"Bearer {token}")
-        with urllib.request.urlopen(req, timeout=60) as resp:
-            payload = resp.read()
-        return _json.loads(payload) if payload else {}
+        return bearer_json_request(method, url, body, token)
 
     def _op_url(self, name: str) -> str:
         # Operation names come back WITHOUT the API version segment

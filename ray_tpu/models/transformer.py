@@ -58,6 +58,11 @@ class TransformerConfig:
     # with the next's prologue: +12% train throughput on the single-chip
     # v5e bench (79.3k -> 88.7k tok/s). Unroll only without pp sharding.
     scan_unroll: int = 1
+    # Fuse the LM-head projection into a chunked cross-entropy
+    # (ops/losses.fused_lm_loss) so the [B*T, V] f32 logits tensor never
+    # hits HBM — loss_fn only; forward() still returns full logits for
+    # generation/eval paths.
+    fused_loss: bool = True
 
     @property
     def head_dim(self) -> int:
@@ -225,14 +230,14 @@ def _layer(lp, x, positions, cfg: TransformerConfig, mesh, attn_impl: str):
     return x, aux
 
 
-def forward(
+def forward_hidden(
     params: dict,
     tokens,
     cfg: TransformerConfig,
     mesh=None,
     attn_impl: str = "auto",
 ):
-    """tokens [B, T] int32 -> logits [B, T, V] (f32)."""
+    """tokens [B, T] int32 -> (final hidden [B, T, D], moe aux)."""
     B, T = tokens.shape
     x = params["embed"].astype(cfg.dtype)[tokens]
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
@@ -248,9 +253,23 @@ def forward(
 
     unroll = max(1, min(int(cfg.scan_unroll or 1), cfg.n_layers))
     (x, aux), _ = lax.scan(scan_body, (x, 0.0), params["layers"], unroll=unroll)
-    x = _rms_norm(x, params["norm_f"], cfg.norm_eps)
-    head = params["lm_head"] if "lm_head" in params else params["embed"].T
-    logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
+    return _rms_norm(x, params["norm_f"], cfg.norm_eps), aux
+
+
+def _head(params):
+    return params["lm_head"] if "lm_head" in params else params["embed"].T
+
+
+def forward(
+    params: dict,
+    tokens,
+    cfg: TransformerConfig,
+    mesh=None,
+    attn_impl: str = "auto",
+):
+    """tokens [B, T] int32 -> logits [B, T, V] (f32)."""
+    x, aux = forward_hidden(params, tokens, cfg, mesh=mesh, attn_impl=attn_impl)
+    logits = (x @ _head(params).astype(x.dtype)).astype(jnp.float32)
     return logits, aux
 
 
@@ -258,6 +277,11 @@ def loss_fn(params, batch, cfg: TransformerConfig, mesh=None, attn_impl: str = "
     """batch: {"tokens": [B, T+1]} next-token LM loss."""
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    if cfg.fused_loss:
+        from ray_tpu.ops.losses import fused_lm_loss
+
+        x, aux = forward_hidden(params, inputs, cfg, mesh=mesh, attn_impl=attn_impl)
+        return fused_lm_loss(x, _head(params), targets) + 0.01 * aux
     logits, aux = forward(params, inputs, cfg, mesh=mesh, attn_impl=attn_impl)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]

@@ -1,0 +1,119 @@
+"""Loss kernels.
+
+``fused_lm_loss``: next-token cross-entropy fused with the LM-head matmul,
+computed over sequence chunks so the full ``[B*T, V]`` f32 logits tensor is
+never materialized. At bench shapes (B8 T1024 V32k) the unfused loss writes
+~1 GiB of f32 logits + log-softmax intermediates to HBM in the forward and
+reads them back in the backward — pure bandwidth, no MXU work. The chunked
+form keeps one ``[chunk, V]`` tile live at a time (64 MiB at chunk=512) and
+recomputes it in the backward: classic flash-style trade of FLOPs for HBM,
+the same rematerialisation XLA cannot do on its own across the
+matmul+softmax+gather boundary.
+
+Forward per chunk: ``logits = x_c @ head; lse = logsumexp(logits);
+nll_c = lse - logits[target]``. Backward per chunk:
+``p = exp(logits - lse); p[target] -= 1; dx_c = g/N * (p @ head^T);
+dhead += x_c^T @ (g/N * p)`` — the standard softmax-CE gradient, rebuilt
+blockwise from the saved (tiny) ``lse`` rather than saved logits.
+
+(The reference delegates LM losses to torch/HF — SURVEY.md §5.7; this is
+the TPU-native hot-path equivalent, same role as ops/attention.py.)
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _pick_chunk(n: int, want: int) -> int:
+    """Largest divisor of n that is <= want (prefer multiples of 128 for
+    clean MXU tiling; n is B*T which is 128-aligned in practice)."""
+    want = max(1, min(want, n))
+    for c in range(want, 0, -1):
+        if n % c == 0:
+            return c
+    return n
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _fused_lm_loss_sum(x, head, targets, chunk):
+    """sum of per-token NLL. x: [N, D] (model dtype), head: [D, V],
+    targets: [N] int32. Returns f32 scalar."""
+    s, _ = _fused_fwd_scan(x, head, targets, chunk)
+    return s
+
+
+def _fused_fwd_scan(x, head, targets, chunk):
+    N, D = x.shape
+    xc = x.reshape(N // chunk, chunk, D)
+    tc = targets.reshape(N // chunk, chunk)
+
+    def body(total, ct):
+        xb, tb = ct
+        logits = jnp.dot(xb, head, preferred_element_type=jnp.float32)  # [c, V]
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)  # [c]
+        tgt = jnp.take_along_axis(logits, tb[:, None], axis=-1)[:, 0]
+        return total + jnp.sum(lse - tgt), lse
+
+    total, lses = lax.scan(body, jnp.float32(0.0), (xc, tc))
+    return total, lses.reshape(N)
+
+
+def _fused_lm_loss_fwd(x, head, targets, chunk):
+    total, lse = _fused_fwd_scan(x, head, targets, chunk)
+    return total, (x, head, targets, lse)
+
+
+def _fused_lm_loss_bwd(chunk, res, g):
+    x, head, targets, lse = res
+    N, D = x.shape
+    xc = x.reshape(N // chunk, chunk, D)
+    tc = targets.reshape(N // chunk, chunk)
+    lc = lse.reshape(N // chunk, chunk)
+
+    def body(dhead_acc, ct):
+        xb, tb, lb = ct
+        logits = jnp.dot(xb, head, preferred_element_type=jnp.float32)  # [c, V]
+        p = jnp.exp(logits - lb[:, None])  # softmax, rebuilt from saved lse
+        p = p - jax.nn.one_hot(tb, logits.shape[-1], dtype=p.dtype)
+        pg = (p * g).astype(x.dtype)
+        dxb = jnp.dot(pg, head.T, preferred_element_type=jnp.float32).astype(x.dtype)
+        dhead_acc = dhead_acc + jnp.dot(
+            xb.T, pg, preferred_element_type=jnp.float32
+        )
+        return dhead_acc, dxb
+
+    dhead, dxc = lax.scan(body, jnp.zeros(head.shape, jnp.float32), (xc, tc, lc))
+    return dxc.reshape(N, D), dhead.astype(head.dtype), None
+
+
+_fused_lm_loss_sum.defvjp(_fused_lm_loss_fwd, _fused_lm_loss_bwd)
+
+
+def fused_lm_loss(
+    x,
+    head,
+    targets,
+    *,
+    chunk_size: int = 512,
+    mean: bool = True,
+):
+    """Cross-entropy LM loss fused with the head projection.
+
+    x: [B, T, D] or [N, D] final hidden states (bf16 fine — the matmul
+    accumulates f32); head: [D, V]; targets: [B, T] or [N] int32.
+    Numerically identical (f32 accumulation, logsumexp-stable) to
+    ``log_softmax(x @ head)`` gathering, without ever holding [N, V].
+    """
+    if x.ndim == 3:
+        B, T, D = x.shape
+        x = x.reshape(B * T, D)
+        targets = targets.reshape(B * T)
+    N = x.shape[0]
+    chunk = _pick_chunk(N, chunk_size)
+    total = _fused_lm_loss_sum(x, head.astype(x.dtype), targets, chunk)
+    return total / N if mean else total

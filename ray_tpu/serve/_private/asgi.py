@@ -27,6 +27,7 @@ import asyncio
 import json
 import logging
 import threading
+import time
 from urllib.parse import parse_qsl, urlencode
 
 logger = logging.getLogger(__name__)
@@ -378,10 +379,14 @@ def _get_ingress_loop():
 class _AppBridge:
     """send/receive pair driving a user ASGI app from sync replica code.
 
-    - ``send`` events land in an unbounded queue drained by the caller; once
+    - ``send`` events land in a BOUNDED queue drained by the caller (fast
+      producers park in ``send`` — uvicorn-style backpressure); once
       ``closed`` is set (client gone or response fully consumed) further
       sends raise ClientDisconnected so the app stops producing — the leak
       guard for infinite SSE producers whose client went away.
+    - app completion is signalled via the ``done`` flag + ``error`` holder
+      (never a queue put, which could block the shared ingress loop on a
+      full queue); a sentinel wake is best-effort with put_nowait.
     - a second ``receive`` blocks until ``closed``, then reports
       http.disconnect — never an instant disconnect while the response is
       still being consumed (spec: disconnect means the client is GONE).
@@ -398,8 +403,22 @@ class _AppBridge:
 
         self.out: _queue.Queue = _queue.Queue(maxsize=self._MAX_BUFFERED_EVENTS)
         self.closed = threading.Event()
+        self.done = threading.Event()
+        self.error: BaseException | None = None
         self._body = body
         self._delivered = False
+
+    def finish(self, error: BaseException | None):
+        """Mark the app coroutine finished. Runs on the shared ingress loop,
+        so it must never block: flag first, then a best-effort wake."""
+        import queue as _queue
+
+        self.error = error
+        self.done.set()
+        try:
+            self.out.put_nowait({"type": "__app_done__"})
+        except _queue.Full:
+            pass  # consumer will drain the queue and then see the flag
 
     async def receive(self):
         if not self._delivered:
@@ -419,6 +438,31 @@ class _AppBridge:
                 return
             except _queue.Full:
                 await asyncio.sleep(0.02)
+
+
+def _next_event(bridge: _AppBridge, deadline_s: float):
+    """Next send event from the bridge, or None once the app has finished
+    and the queue is drained. Raises the app's error (after in-order
+    delivery of everything it sent first) or TimeoutError on a stalled app."""
+    import queue as _queue
+
+    end = time.monotonic() + deadline_s
+    while True:
+        try:
+            ev = bridge.out.get(timeout=0.1)
+        except _queue.Empty:
+            if bridge.done.is_set():
+                if bridge.error is not None:
+                    raise bridge.error
+                return None
+            if time.monotonic() > end:
+                raise TimeoutError("ASGI app produced no event within deadline")
+            continue
+        if ev["type"] == "__app_done__":
+            if bridge.error is not None:
+                raise bridge.error
+            return None
+        return ev
 
 
 def run_asgi_request(asgi_app, request):
@@ -453,8 +497,6 @@ def run_asgi_request(asgi_app, request):
         ],
     )
     bridge = _AppBridge(request.body or b"")
-    out = bridge.out
-
     fut = asyncio.run_coroutine_threadsafe(
         asgi_app(scope, bridge.receive, bridge.send), _get_ingress_loop()
     )
@@ -464,10 +506,9 @@ def run_asgi_request(asgi_app, request):
             exc = f.exception()
         except asyncio.CancelledError:
             exc = None
-        if exc is not None and not isinstance(exc, ClientDisconnected):
-            out.put({"type": "__app_error__", "error": exc})
-        else:
-            out.put({"type": "__app_done__"})
+        if isinstance(exc, ClientDisconnected):
+            exc = None
+        bridge.finish(exc)
 
     fut.add_done_callback(_on_done)
 
@@ -476,10 +517,8 @@ def run_asgi_request(asgi_app, request):
     streaming = False
     try:
         while True:
-            ev = out.get(timeout=120)
-            if ev["type"] == "__app_error__":
-                raise ev["error"]
-            if ev["type"] == "__app_done__":
+            ev = _next_event(bridge, 120.0)
+            if ev is None:
                 break
             if ev["type"] == "http.response.start":
                 status = ev["status"]
@@ -497,10 +536,8 @@ def run_asgi_request(asgi_app, request):
                             if first:
                                 yield first
                             while True:
-                                e2 = out.get(timeout=300)
-                                if e2["type"] == "__app_error__":
-                                    raise e2["error"]
-                                if e2["type"] == "__app_done__":
+                                e2 = _next_event(bridge, 300.0)
+                                if e2 is None:
                                     return
                                 if e2["type"] == "http.response.body":
                                     b2 = e2.get("body", b"")

@@ -263,11 +263,13 @@ def test_gce_provider_lifecycle():
             "c1",
         )
         _lifecycle(provider)
-        # Startup script rode the instance metadata.
-        created = provider.create_node({}, {"node_type": "worker"}, 1)
-        meta = api.instances[created[0]]["metadata"]["items"][0]
-        assert meta["key"] == "startup-script"
-        assert "--address 10.0.0.1:6379" in meta["value"]
+        # Startup script + original node_type rode the instance metadata.
+        created = provider.create_node({}, {"node_type": "Worker_A"}, 1)
+        meta = {i["key"]: i["value"] for i in api.instances[created[0]]["metadata"]["items"]}
+        assert "--address 10.0.0.1:6379" in meta["startup-script"]
+        assert meta["ray-node-type"] == "Worker_A"
+        # Labels are sanitized but node_tags round-trips the original type.
+        assert provider.node_tags(created[0])["node_type"] == "Worker_A"
     finally:
         srv.shutdown()
 
