@@ -20,7 +20,7 @@ from ray_tpu.rllib.algorithms.sac.sac import (
     _squashed_sample,
     init_sac_params,
 )
-from ray_tpu.rllib.offline import DatasetReader, JsonReader
+from ray_tpu.rllib.offline import make_input_reader
 from ray_tpu.rllib.policy.sample_batch import (
     ACTIONS,
     DONES,
@@ -89,10 +89,7 @@ class CQL(OffPolicyTraining, Algorithm):
             self._act_scale = (high - low) / 2.0
             self._act_offset = (high + low) / 2.0
         probe.close()
-        if hasattr(cfg.input_, "take_all"):
-            self.reader = DatasetReader(cfg.input_, gamma=cfg.gamma, seed=cfg.seed)
-        else:
-            self.reader = JsonReader(cfg.input_, gamma=cfg.gamma, seed=cfg.seed)
+        self.reader = make_input_reader(cfg.input_, gamma=cfg.gamma, seed=cfg.seed)
         self.params = init_sac_params(
             jax.random.PRNGKey(cfg.seed), self.obs_dim, self.action_dim, self.discrete, cfg.model_hiddens
         )

@@ -32,7 +32,7 @@ from ray_tpu.rllib.algorithms.sac.sac import (
     _mlp_params,
     _squashed_sample,
 )
-from ray_tpu.rllib.offline import DatasetReader, JsonReader
+from ray_tpu.rllib.offline import make_input_reader
 from ray_tpu.rllib.policy.sample_batch import ACTIONS, DONES, NEXT_OBS, OBS, REWARDS
 
 
@@ -97,10 +97,7 @@ class CRR(OffPolicyTraining, Algorithm):
             self._act_scale = (high - low) / 2.0
             self._act_offset = (high + low) / 2.0
         probe.close()
-        if hasattr(cfg.input_, "take_all"):
-            self.reader = DatasetReader(cfg.input_, gamma=cfg.gamma, seed=cfg.seed)
-        else:
-            self.reader = JsonReader(cfg.input_, gamma=cfg.gamma, seed=cfg.seed)
+        self.reader = make_input_reader(cfg.input_, gamma=cfg.gamma, seed=cfg.seed)
 
         keys = jax.random.split(jax.random.PRNGKey(cfg.seed), 3)
         H = cfg.model_hiddens

@@ -95,12 +95,9 @@ class MARWIL(Algorithm):
         cfg: MARWILConfig = self._algo_config
         if cfg.input_ is None:
             raise ValueError(f"{type(self).__name__} requires config.offline_data(input_=...)")
-        from ray_tpu.rllib.offline import DatasetReader, JsonReader
+        from ray_tpu.rllib.offline import make_input_reader
 
-        if hasattr(cfg.input_, "take_all"):  # a ray_tpu.data Dataset
-            self.reader = DatasetReader(cfg.input_, gamma=cfg.gamma, seed=cfg.seed)
-        else:
-            self.reader = JsonReader(cfg.input_, gamma=cfg.gamma, seed=cfg.seed)
+        self.reader = make_input_reader(cfg.input_, gamma=cfg.gamma, seed=cfg.seed)
 
     def _build_learner_group(self, cfg: MARWILConfig) -> LearnerGroup:
         return LearnerGroup(

@@ -142,6 +142,77 @@ class DatasetReader:
     next = JsonReader.next
 
 
+class ExternalInputReader:
+    """Input reader over a live ``PolicyServerInput`` — train directly from
+    external simulators.
+
+    Reference parity: there ``PolicyServerInput`` IS an input reader plugged
+    in via ``config.input_`` (``"input": lambda ioctx: PolicyServerInput(...)``,
+    rllib/env/policy_server_input.py), so offline-capable algorithms consume
+    client-driven episodes instead of files. Here the same seam: the first
+    ``next()`` blocks until ``min_episodes`` external episodes have
+    completed; every later call drains whatever episodes have finished since
+    (min 1, so nothing sits stale). Return targets are computed per drained
+    fragment and the rows land in a preallocated FIFO ``ReplayBuffer``
+    window (O(fresh) writes, no full-window copies). Sampling is uniform
+    with replacement at exactly ``batch_size`` rows, so the training batch
+    shape is static from the first step — no per-fold XLA retraces.
+    """
+
+    def __init__(
+        self,
+        server,
+        gamma: float = 0.99,
+        seed: int = 0,
+        min_episodes: int = 1,
+        window_rows: int = 50_000,
+        poll_interval_s: float = 0.05,
+        timeout_s: float = 60.0,
+    ):
+        from ray_tpu.rllib.utils.replay_buffers import ReplayBuffer
+
+        self._server = server
+        self._gamma = gamma
+        self._min_episodes = min_episodes
+        self._poll = poll_interval_s
+        self._timeout = timeout_s
+        self._window = ReplayBuffer(window_rows, seed=seed)
+
+    def next(self, batch_size: Optional[int] = None) -> SampleBatch:
+        import time as _time
+
+        deadline = _time.monotonic() + self._timeout
+        while True:
+            # One call drains every completed episode held by the server;
+            # after the initial fill, any single finished episode is folded
+            # immediately rather than waiting for min_episodes again.
+            need = self._min_episodes if len(self._window) == 0 else 1
+            fresh = self._server.next_batch(need)
+            if fresh is not None:
+                self._window.add(_add_return_targets(fresh, self._gamma))
+            if len(self._window) > 0:
+                break
+            if _time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"no external episodes completed within {self._timeout}s"
+                )
+            _time.sleep(self._poll)
+        if batch_size is None:
+            batch_size = len(self._window)
+        return self._window.sample(batch_size)
+
+
+def make_input_reader(input_, gamma: float = 0.99, seed: int = 0):
+    """Dispatch config.input_ to the right reader — shared by every
+    offline-capable algorithm (MARWIL/BC, CQL, CRR): a ray_tpu.data Dataset,
+    a live PolicyServerInput (external simulators), or json path(s)."""
+    if hasattr(input_, "take_all"):
+        return DatasetReader(input_, gamma=gamma, seed=seed)
+    if hasattr(input_, "next_batch"):
+        return ExternalInputReader(input_, gamma=gamma, seed=seed)
+    return JsonReader(input_, gamma=gamma, seed=seed)
+
+
 from ray_tpu.rllib.offline.estimators import (  # noqa: F401,E402
     AlgorithmPolicyAdapter,
     DirectMethod,
