@@ -1,0 +1,185 @@
+"""KV-cache inference for the flagship transformer: prefill + decode + sample.
+
+The serving-side counterpart of models/transformer.py's training path. The
+reference delegates inference to external frameworks (its Serve examples
+wrap HF pipelines; SURVEY.md §5.7) — this is the TPU-native equivalent:
+
+- static shapes throughout: the cache is preallocated at ``max_len`` and
+  masked by position, so one compiled prefill + one compiled decode step
+  serve every request length (no per-length recompiles);
+- the whole generation loop is a ``lax.scan`` under one jit — no
+  host→device round trip per token (under a remote-TPU tunnel that RTT
+  would dominate decode latency);
+- prefill reuses the Pallas flash kernel over the prompt (MXU-bound),
+  decode attends one query row against the cache with a position mask
+  (HBM-bandwidth-bound, as it should be);
+- bf16 cache, f32 logits/sampling; greedy, temperature, and top-k.
+
+Layer math intentionally mirrors transformer._attention_block/_mlp_block on
+the same param pytree — decode diverges (cache writes, single-row masking)
+enough that sharing one function would tangle the training hot path.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.models.transformer import (
+    TransformerConfig,
+    _head,
+    _rms_norm,
+    _rope,
+)
+
+
+def init_cache(cfg: TransformerConfig, batch: int, max_len: int):
+    """Preallocated KV cache: k/v of shape [L, B, max_len, KV, Dh] (bf16 on
+    TPU — cache reads are the decode bandwidth bill)."""
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+    }
+
+
+def _project_qkv(lp, x, positions, cfg):
+    B, T, _ = x.shape
+    H, KV, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    h = _rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ lp["wq"].astype(h.dtype)).reshape(B, T, H, Dh)
+    k = (h @ lp["wk"].astype(h.dtype)).reshape(B, T, KV, Dh)
+    v = (h @ lp["wv"].astype(h.dtype)).reshape(B, T, KV, Dh)
+    return _rope(q, positions, cfg.rope_theta), _rope(k, positions, cfg.rope_theta), v
+
+
+def _mlp(lp, x, cfg):
+    h = _rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu(h @ lp["wg"].astype(h.dtype))
+    up = h @ lp["wi"].astype(h.dtype)
+    return x + (gate * up) @ lp["wo_mlp"].astype(h.dtype)
+
+
+def _cache_attention(q, ck, cv, pos_mask, cfg):
+    """q: [B, T, H, Dh] against the full cache ck/cv: [B, S, KV, Dh], rows
+    masked by pos_mask [B, T, S] (True = attend)."""
+    H, KV = cfg.n_heads, cfg.n_kv_heads
+    if KV != H:
+        rep = H // KV
+        ck = jnp.repeat(ck, rep, axis=2)
+        cv = jnp.repeat(cv, rep, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, ck, preferred_element_type=jnp.float32)
+    s = s * (cfg.head_dim ** -0.5)
+    s = jnp.where(pos_mask[:, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(cv.dtype), cv,
+                   preferred_element_type=jnp.float32)
+    return o.astype(q.dtype)
+
+
+def prefill(params, tokens, cache, cfg: TransformerConfig):
+    """Run the prompt through the model, filling cache[:, :, :T].
+
+    tokens: [B, T] int32 (the full prompt; pad+mask externally for ragged
+    batches). Returns (logits_last [B, V] f32, cache, next_pos=T).
+    """
+    B, T = tokens.shape
+    S = cache["k"].shape[2]
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
+
+    def body(x, layer):
+        lp, ck_slot, cv_slot = layer
+        q, k, v = _project_qkv(lp, x, positions, cfg)
+        ck = lax.dynamic_update_slice_in_dim(ck_slot, k, 0, axis=1)  # [B,S,KV,Dh]
+        cv = lax.dynamic_update_slice_in_dim(cv_slot, v, 0, axis=1)
+        # Causal over the prompt; nothing beyond T is visible.
+        k_pos = jnp.arange(S, dtype=jnp.int32)
+        mask = (k_pos[None, None, :] <= positions[:, :, None]) & (k_pos[None, None, :] < T)
+        o = _cache_attention(q, ck, cv, mask, cfg)
+        x = x + o.reshape(B, T, -1) @ lp["wo"].astype(o.dtype)
+        x = _mlp(lp, x, cfg)
+        return x, (ck, cv)
+
+    x, (ks, vs) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    x = _rms_norm(x, params["norm_f"], cfg.norm_eps)
+    logits = (x[:, -1] @ _head(params).astype(x.dtype)).astype(jnp.float32)
+    return logits, {"k": ks, "v": vs}, jnp.int32(T)
+
+
+def decode_step(params, token, cache, pos, cfg: TransformerConfig):
+    """One token: token [B] int32 at position pos (scalar int32).
+
+    Returns (logits [B, V] f32, updated cache)."""
+    B = token.shape[0]
+    x = params["embed"].astype(cfg.dtype)[token][:, None, :]  # [B, 1, D]
+    positions = jnp.full((B, 1), pos, jnp.int32)
+    S = cache["k"].shape[2]
+
+    def body(x, layer):
+        lp, ck_slot, cv_slot = layer
+        q, k, v = _project_qkv(lp, x, positions, cfg)
+        ck = lax.dynamic_update_slice(ck_slot, k, (0, pos, 0, 0))
+        cv = lax.dynamic_update_slice(cv_slot, v, (0, pos, 0, 0))
+        k_pos = jnp.arange(S, dtype=jnp.int32)
+        mask = jnp.broadcast_to(k_pos[None, None, :] <= pos, (B, 1, S))
+        o = _cache_attention(q, ck, cv, mask, cfg)
+        x = x + o.reshape(B, 1, -1) @ lp["wo"].astype(o.dtype)
+        x = _mlp(lp, x, cfg)
+        return x, (ck, cv)
+
+    x, (ks, vs) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    x = _rms_norm(x, params["norm_f"], cfg.norm_eps)
+    logits = (x[:, 0] @ _head(params).astype(x.dtype)).astype(jnp.float32)
+    return logits, {"k": ks, "v": vs}
+
+
+def _sample(logits, key, temperature: float, top_k: int):
+    if temperature == 0.0:
+        return logits.argmax(axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature", "top_k"))
+def generate(
+    params,
+    prompt,
+    cfg: TransformerConfig,
+    max_new_tokens: int = 32,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    key=None,
+):
+    """prompt [B, T] int32 -> generated [B, max_new_tokens] int32.
+
+    One jit: prefill + a lax.scan of decode steps (no per-token host
+    round trips). temperature=0 is greedy; top_k=0 disables truncation.
+    """
+    if cfg.num_experts > 0:
+        raise NotImplementedError(
+            "KV-cache decode supports dense MLP configs; MoE decode needs "
+            "expert dispatch in the step function (train-side MoE lives in "
+            "parallel/moe.py)."
+        )
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    B, T = prompt.shape
+    cache = init_cache(cfg, B, T + max_new_tokens)
+    logits, cache, pos = prefill(params, prompt, cache, cfg)
+
+    def step(carry, k):
+        logits, cache, pos = carry
+        tok = _sample(logits, k, temperature, top_k)
+        logits, cache = decode_step(params, tok, cache, pos, cfg)
+        return (logits, cache, pos + 1), tok
+
+    keys = jax.random.split(key, max_new_tokens)
+    _, toks = lax.scan(step, (logits, cache, pos), keys)
+    return toks.T  # [B, max_new_tokens]

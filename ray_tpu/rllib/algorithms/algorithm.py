@@ -171,14 +171,21 @@ class AlgorithmConfig:
             self.min_time_s_per_iteration = min_time_s_per_iteration
         return self
 
-    def offline_data(self, *, input_=None, output=None) -> "AlgorithmConfig":
+    def offline_data(
+        self, *, input_=None, output=None, input_reader_kwargs=None
+    ) -> "AlgorithmConfig":
         """Offline dataset source/sink (reference: .offline_data()). The
-        offline families consume ``input_`` (path/glob/list/Dataset); online
-        families may set ``output`` to log rollouts (JSON writer)."""
+        offline families consume ``input_`` (path/glob/list/Dataset/live
+        PolicyServerInput); online families may set ``output`` to log
+        rollouts (JSON writer). ``input_reader_kwargs`` reach the
+        constructed reader (e.g. timeout_s/min_episodes/window_rows for
+        slow external simulators)."""
         if input_ is not None:
             self.input_ = input_
         if output is not None:
             self.output = output
+        if input_reader_kwargs is not None:
+            self.input_reader_kwargs = dict(input_reader_kwargs)
         return self
 
     def callbacks(self, callbacks_class) -> "AlgorithmConfig":

@@ -44,9 +44,11 @@ class CQLConfig(AlgorithmConfig):
         self.input_: Optional[object] = None  # path / list / Dataset
         self.model_hiddens = (256, 256)
 
-    def offline_data(self, *, input_=None) -> "CQLConfig":
+    def offline_data(self, *, input_=None, input_reader_kwargs=None) -> "CQLConfig":
         if input_ is not None:
             self.input_ = input_
+        if input_reader_kwargs is not None:
+            self.input_reader_kwargs = dict(input_reader_kwargs)
         return self
 
     def training(self, *, tau=None, initial_alpha=None, cql_alpha=None,
@@ -89,7 +91,10 @@ class CQL(OffPolicyTraining, Algorithm):
             self._act_scale = (high - low) / 2.0
             self._act_offset = (high + low) / 2.0
         probe.close()
-        self.reader = make_input_reader(cfg.input_, gamma=cfg.gamma, seed=cfg.seed)
+        self.reader = make_input_reader(
+            cfg.input_, gamma=cfg.gamma, seed=cfg.seed,
+            **getattr(cfg, "input_reader_kwargs", {}),
+        )
         self.params = init_sac_params(
             jax.random.PRNGKey(cfg.seed), self.obs_dim, self.action_dim, self.discrete, cfg.model_hiddens
         )

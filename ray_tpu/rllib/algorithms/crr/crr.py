@@ -51,9 +51,11 @@ class CRRConfig(AlgorithmConfig):
         self.input_: Optional[object] = None
         self.model_hiddens = (256, 256)
 
-    def offline_data(self, *, input_=None) -> "CRRConfig":
+    def offline_data(self, *, input_=None, input_reader_kwargs=None) -> "CRRConfig":
         if input_ is not None:
             self.input_ = input_
+        if input_reader_kwargs is not None:
+            self.input_reader_kwargs = dict(input_reader_kwargs)
         return self
 
     def training(self, *, tau=None, weight_type=None, temperature=None,
@@ -97,7 +99,10 @@ class CRR(OffPolicyTraining, Algorithm):
             self._act_scale = (high - low) / 2.0
             self._act_offset = (high + low) / 2.0
         probe.close()
-        self.reader = make_input_reader(cfg.input_, gamma=cfg.gamma, seed=cfg.seed)
+        self.reader = make_input_reader(
+            cfg.input_, gamma=cfg.gamma, seed=cfg.seed,
+            **getattr(cfg, "input_reader_kwargs", {}),
+        )
 
         keys = jax.random.split(jax.random.PRNGKey(cfg.seed), 3)
         H = cfg.model_hiddens

@@ -68,9 +68,11 @@ class MARWILConfig(AlgorithmConfig):
         self.num_rollout_workers = 0
         self.evaluation_interval = None
 
-    def offline_data(self, *, input_=None) -> "MARWILConfig":
+    def offline_data(self, *, input_=None, input_reader_kwargs=None) -> "MARWILConfig":
         if input_ is not None:
             self.input_ = input_
+        if input_reader_kwargs is not None:
+            self.input_reader_kwargs = dict(input_reader_kwargs)
         return self
 
     def training(self, *, beta: Optional[float] = None, vf_coeff: Optional[float] = None,
@@ -97,7 +99,10 @@ class MARWIL(Algorithm):
             raise ValueError(f"{type(self).__name__} requires config.offline_data(input_=...)")
         from ray_tpu.rllib.offline import make_input_reader
 
-        self.reader = make_input_reader(cfg.input_, gamma=cfg.gamma, seed=cfg.seed)
+        self.reader = make_input_reader(
+            cfg.input_, gamma=cfg.gamma, seed=cfg.seed,
+            **getattr(cfg, "input_reader_kwargs", {}),
+        )
 
     def _build_learner_group(self, cfg: MARWILConfig) -> LearnerGroup:
         return LearnerGroup(

@@ -189,7 +189,18 @@ class ExternalInputReader:
             need = self._min_episodes if len(self._window) == 0 else 1
             fresh = self._server.next_batch(need)
             if fresh is not None:
-                self._window.add(_add_return_targets(fresh, self._gamma))
+                fresh = _add_return_targets(fresh, self._gamma)
+                n = len(fresh)
+                cap = self._window.capacity
+                if n > cap:
+                    # One drain can exceed the window (many sims ran before
+                    # training started): keep only the newest rows —
+                    # ReplayBuffer.add would otherwise wrap/clobber (or
+                    # raise past 2x capacity).
+                    fresh = SampleBatch(
+                        {k: np.asarray(v)[n - cap:] for k, v in fresh.items()}
+                    )
+                self._window.add(fresh)
             if len(self._window) > 0:
                 break
             if _time.monotonic() > deadline:
@@ -202,15 +213,19 @@ class ExternalInputReader:
         return self._window.sample(batch_size)
 
 
-def make_input_reader(input_, gamma: float = 0.99, seed: int = 0):
+def make_input_reader(input_, gamma: float = 0.99, seed: int = 0, **reader_kwargs):
     """Dispatch config.input_ to the right reader — shared by every
     offline-capable algorithm (MARWIL/BC, CQL, CRR): a ray_tpu.data Dataset,
-    a live PolicyServerInput (external simulators), or json path(s)."""
+    a live PolicyServerInput (external simulators), or json path(s).
+
+    ``reader_kwargs`` (config.offline_data(input_reader_kwargs=...)) reach
+    the constructed reader — e.g. ``timeout_s``/``min_episodes``/
+    ``window_rows`` for slow external simulators."""
     if hasattr(input_, "take_all"):
-        return DatasetReader(input_, gamma=gamma, seed=seed)
+        return DatasetReader(input_, gamma=gamma, seed=seed, **reader_kwargs)
     if hasattr(input_, "next_batch"):
-        return ExternalInputReader(input_, gamma=gamma, seed=seed)
-    return JsonReader(input_, gamma=gamma, seed=seed)
+        return ExternalInputReader(input_, gamma=gamma, seed=seed, **reader_kwargs)
+    return JsonReader(input_, gamma=gamma, seed=seed, **reader_kwargs)
 
 
 from ray_tpu.rllib.offline.estimators import (  # noqa: F401,E402

@@ -94,6 +94,30 @@ def test_marwil_trains_from_external_clients(ray_cluster):
         server.shutdown()
 
 
+def test_input_reader_kwargs_reach_the_reader(ray_cluster):
+    """config.offline_data(input_reader_kwargs=...) tunes the external
+    reader (slow-simulator timeout etc.) without bypassing the input_ seam."""
+    from ray_tpu.rllib import MARWILConfig
+
+    server = PolicyServerInput(compute_action=lambda obs, explore: 0)
+    try:
+        _drive_external_episodes(server.address, 1, policy=lambda o: 0, max_steps=5)
+        cfg = MARWILConfig().environment("CartPole-v1").rollouts(num_rollout_workers=0)
+        cfg.offline_data(
+            input_=server,
+            input_reader_kwargs={"timeout_s": 5.0, "min_episodes": 1, "window_rows": 256},
+        )
+        algo = cfg.build()
+        algo.setup(cfg.to_dict())
+        try:
+            assert algo.reader._timeout == 5.0
+            assert algo.reader._window.capacity == 256
+        finally:
+            algo.cleanup()
+    finally:
+        server.shutdown()
+
+
 def test_dqn_serves_actions_and_trains_on_external_episodes(ray_cluster):
     """The live algorithm's policy answers client get_action; its replay
     buffer ingests the collected external episodes and a gradient step
@@ -130,30 +154,46 @@ def test_dqn_serves_actions_and_trains_on_external_episodes(ray_cluster):
 
 
 def test_concurrent_external_clients(ray_cluster):
-    """Multiple client sims against one server: episode isolation holds
-    (every episode's rows stay contiguous under its own EPS_ID)."""
+    """Multiple client sims against one server: episode isolation holds.
+    Every client stamps its thread id into all its observations AND
+    actions, so cross-episode contamination (one client's rows landing in
+    another's episode) is directly detectable — not just contiguity."""
     server = PolicyServerInput(compute_action=lambda obs, explore: 1)
+    steps_per_ep, eps_per_client, n_clients = 7, 3, 3
+
+    def drive(tid):
+        client = PolicyClient(server.address)
+        for ep in range(eps_per_client):
+            eid = client.start_episode()
+            for step in range(steps_per_ep):
+                obs = np.array([tid, ep, step, 0], np.float32)
+                client.log_action(eid, obs, int(tid))
+                client.log_returns(eid, float(tid))
+            client.end_episode(eid, np.array([tid, ep, steps_per_ep, 0], np.float32))
+
     try:
-        threads = [
-            threading.Thread(
-                target=_drive_external_episodes,
-                args=(server.address, 3),
-                kwargs={"policy": lambda obs: 0, "max_steps": 10},
-            )
-            for _ in range(3)
-        ]
+        threads = [threading.Thread(target=drive, args=(t,)) for t in range(n_clients)]
         for t in threads:
             t.start()
         for t in threads:
             t.join(timeout=60)
-        batch = server.next_batch(min_episodes=9)
+        batch = server.next_batch(min_episodes=n_clients * eps_per_client)
         assert batch is not None
+        assert len(batch) == n_clients * eps_per_client * steps_per_ep
         eps = np.asarray(batch["eps_id"])
-        dones = np.asarray(batch["dones"])
-        # Each eps_id appears in one contiguous run ending with done=1.
-        changes = np.flatnonzero(np.diff(eps) != 0)
-        assert len(set(eps.tolist())) == len(changes) + 1
-        for boundary in changes:
-            assert dones[boundary] == 1.0
+        obs = np.asarray(batch["obs"])
+        acts = np.asarray(batch["actions"])
+        rews = np.asarray(batch["rewards"])
+        assert len(set(eps.tolist())) == n_clients * eps_per_client
+        for e in set(eps.tolist()):
+            rows = eps == e
+            tids = obs[rows][:, 0]
+            # All rows of one episode belong to exactly one client...
+            assert len(set(tids.tolist())) == 1, f"episode {e} mixes clients"
+            tid = tids[0]
+            # ...and carry that client's actions/rewards/step sequence.
+            assert (acts[rows] == tid).all()
+            assert (rews[rows] == tid).all()
+            assert obs[rows][:, 2].tolist() == list(range(steps_per_ep))
     finally:
         server.shutdown()

@@ -1,0 +1,65 @@
+"""Serve × models/generate: a jit-compiled LLM decode path behind HTTP.
+
+The end-to-end shape of TPU model serving: replica holds params + compiled
+generate(); requests ride the proxy; batched handle calls share one compile.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def serve_instance():
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    serve.start()
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_llm_deployment_generates(serve_instance):
+    @serve.deployment
+    class TinyLM:
+        def __init__(self):
+            import jax
+            import jax.numpy as jnp
+
+            from ray_tpu.models.transformer import TransformerConfig, init_params
+
+            self.cfg = TransformerConfig(
+                vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=4,
+                d_ff=64, max_seq_len=32, dtype=jnp.float32, remat=False,
+            )
+            self.params = init_params(jax.random.PRNGKey(0), self.cfg)
+
+        def __call__(self, request):
+            import jax.numpy as jnp
+            import numpy as np
+
+            from ray_tpu.models.generate import generate
+
+            toks = request.json()["tokens"]
+            out = generate(
+                self.params, jnp.asarray([toks], jnp.int32), self.cfg,
+                max_new_tokens=4, temperature=0.0,
+            )
+            return {"tokens": np.asarray(out)[0].tolist()}
+
+    serve.run(TinyLM.bind(), route_prefix="/llm")
+    host, port = serve.http_address()
+    req = urllib.request.Request(
+        f"http://{host}:{port}/llm",
+        data=json.dumps({"tokens": [1, 2, 3]}).encode(),
+    )
+    out = json.loads(urllib.request.urlopen(req, timeout=60).read())
+    assert len(out["tokens"]) == 4
+    assert all(0 <= t < 64 for t in out["tokens"])
+    # Greedy decode is deterministic: same prompt, same continuation.
+    out2 = json.loads(urllib.request.urlopen(req, timeout=60).read())
+    assert out2 == out
+    serve.delete("TinyLM")
