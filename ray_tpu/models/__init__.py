@@ -1,0 +1,23 @@
+"""Model zoo: the flagship decoder-only transformer (training + KV-cache
+inference), plus MLP / ResNet / ViT used by Train/Tune/RLlib tests.
+
+The reference has no in-tree LLM zoo (its Train/RLlib models are torch
+modules; SURVEY.md §5.7) — these are the TPU-native equivalents of what it
+delegates to HF/DeepSpeed."""
+
+from ray_tpu.models.transformer import (  # noqa: F401
+    TransformerConfig,
+    forward,
+    forward_hidden,
+    init_params,
+    loss_fn,
+    make_train_step,
+    num_params,
+    param_logical_axes,
+)
+from ray_tpu.models.generate import (  # noqa: F401
+    decode_step,
+    generate,
+    init_cache,
+    prefill,
+)

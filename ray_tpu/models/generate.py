@@ -10,9 +10,11 @@ wrap HF pipelines; SURVEY.md §5.7) — this is the TPU-native equivalent:
 - the whole generation loop is a ``lax.scan`` under one jit — no
   host→device round trip per token (under a remote-TPU tunnel that RTT
   would dominate decode latency);
-- prefill reuses the Pallas flash kernel over the prompt (MXU-bound),
-  decode attends one query row against the cache with a position mask
-  (HBM-bandwidth-bound, as it should be);
+- prefill attends densely over the prompt rows only (MXU-bound, masked for
+  causality + per-row padding; the unwritten generation region of the
+  cache is never scored), decode attends one query row against the cache
+  with a position mask (HBM-bandwidth-bound, as it should be) and GQA
+  caches are read at KV width via grouped einsums — never repeated to H;
 - bf16 cache, f32 logits/sampling; greedy, temperature, and top-k.
 
 Layer math intentionally mirrors transformer._attention_block/_mlp_block on
@@ -64,30 +66,43 @@ def _mlp(lp, x, cfg):
 
 
 def _cache_attention(q, ck, cv, pos_mask, cfg):
-    """q: [B, T, H, Dh] against the full cache ck/cv: [B, S, KV, Dh], rows
-    masked by pos_mask [B, T, S] (True = attend)."""
-    H, KV = cfg.n_heads, cfg.n_kv_heads
+    """q: [B, T, H, Dh] against cache rows ck/cv: [B, S, KV, Dh], masked by
+    pos_mask [B, T, S] (True = attend). GQA uses grouped einsums so K/V are
+    READ at KV width — never physically repeated to H heads (the cache read
+    is the decode bandwidth bill; repeating would multiply it by H/KV)."""
+    B, T, H, Dh = q.shape
+    KV = ck.shape[2]
+    scale = cfg.head_dim ** -0.5
     if KV != H:
         rep = H // KV
-        ck = jnp.repeat(ck, rep, axis=2)
-        cv = jnp.repeat(cv, rep, axis=2)
+        qg = q.reshape(B, T, KV, rep, Dh)
+        s = jnp.einsum("bqgrd,bkgd->bgrqk", qg, ck, preferred_element_type=jnp.float32)
+        s = jnp.where(pos_mask[:, None, None], s * scale, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bgrqk,bkgd->bqgrd", p.astype(cv.dtype), cv,
+                       preferred_element_type=jnp.float32)
+        return o.reshape(B, T, H, Dh).astype(q.dtype)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, ck, preferred_element_type=jnp.float32)
-    s = s * (cfg.head_dim ** -0.5)
-    s = jnp.where(pos_mask[:, None], s, -jnp.inf)
+    s = jnp.where(pos_mask[:, None], s * scale, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(cv.dtype), cv,
                    preferred_element_type=jnp.float32)
     return o.astype(q.dtype)
 
 
-def prefill(params, tokens, cache, cfg: TransformerConfig):
+def prefill(params, tokens, cache, cfg: TransformerConfig, prompt_lens=None):
     """Run the prompt through the model, filling cache[:, :, :T].
 
-    tokens: [B, T] int32 (the full prompt; pad+mask externally for ragged
-    batches). Returns (logits_last [B, V] f32, cache, next_pos=T).
+    tokens: [B, T] int32. ``prompt_lens`` [B] int32 enables RAGGED batches:
+    each row's real prompt occupies tokens[b, :prompt_lens[b]] (padding at
+    the end, any values) — padded key rows are masked out of attention and
+    the returned logits come from each row's LAST REAL token. Shapes stay
+    static, so one compile serves every length mix (the batched-serving
+    shape). Returns (logits_last [B, V] f32, cache, next_pos [B] int32).
     """
     B, T = tokens.shape
-    S = cache["k"].shape[2]
+    if prompt_lens is None:
+        prompt_lens = jnp.full((B,), T, jnp.int32)
     x = params["embed"].astype(cfg.dtype)[tokens]
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
 
@@ -96,36 +111,48 @@ def prefill(params, tokens, cache, cfg: TransformerConfig):
         q, k, v = _project_qkv(lp, x, positions, cfg)
         ck = lax.dynamic_update_slice_in_dim(ck_slot, k, 0, axis=1)  # [B,S,KV,Dh]
         cv = lax.dynamic_update_slice_in_dim(cv_slot, v, 0, axis=1)
-        # Causal over the prompt; nothing beyond T is visible.
-        k_pos = jnp.arange(S, dtype=jnp.int32)
-        mask = (k_pos[None, None, :] <= positions[:, :, None]) & (k_pos[None, None, :] < T)
-        o = _cache_attention(q, ck, cv, mask, cfg)
+        # Attend only over the prompt's T rows — the generation region of
+        # the cache is not written yet; scoring it would waste S/T the
+        # FLOPs/HBM. Causal within the prompt; per-row padding invisible.
+        k_pos = jnp.arange(T, dtype=jnp.int32)
+        mask = (
+            (k_pos[None, None, :] <= positions[:, :, None])
+            & (k_pos[None, None, :] < prompt_lens[:, None, None])
+        )
+        o = _cache_attention(q, ck[:, :T], cv[:, :T], mask, cfg)
         x = x + o.reshape(B, T, -1) @ lp["wo"].astype(o.dtype)
         x = _mlp(lp, x, cfg)
         return x, (ck, cv)
 
     x, (ks, vs) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
     x = _rms_norm(x, params["norm_f"], cfg.norm_eps)
-    logits = (x[:, -1] @ _head(params).astype(x.dtype)).astype(jnp.float32)
-    return logits, {"k": ks, "v": vs}, jnp.int32(T)
+    last = jnp.take_along_axis(x, (prompt_lens - 1)[:, None, None], axis=1)[:, 0]
+    logits = (last @ _head(params).astype(x.dtype)).astype(jnp.float32)
+    return logits, {"k": ks, "v": vs}, prompt_lens
 
 
 def decode_step(params, token, cache, pos, cfg: TransformerConfig):
-    """One token: token [B] int32 at position pos (scalar int32).
+    """One token per row: token [B] int32 written at per-row position
+    ``pos`` ([B] int32, or a scalar for aligned batches).
 
     Returns (logits [B, V] f32, updated cache)."""
     B = token.shape[0]
+    pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (B,))
     x = params["embed"].astype(cfg.dtype)[token][:, None, :]  # [B, 1, D]
-    positions = jnp.full((B, 1), pos, jnp.int32)
+    positions = pos[:, None]
     S = cache["k"].shape[2]
+
+    def write_row(slot, kv, p):
+        # slot [S, KV, Dh], kv [1, KV, Dh] at row position p
+        return lax.dynamic_update_slice(slot, kv, (p, 0, 0))
 
     def body(x, layer):
         lp, ck_slot, cv_slot = layer
         q, k, v = _project_qkv(lp, x, positions, cfg)
-        ck = lax.dynamic_update_slice(ck_slot, k, (0, pos, 0, 0))
-        cv = lax.dynamic_update_slice(cv_slot, v, (0, pos, 0, 0))
+        ck = jax.vmap(write_row)(ck_slot, k, pos)
+        cv = jax.vmap(write_row)(cv_slot, v, pos)
         k_pos = jnp.arange(S, dtype=jnp.int32)
-        mask = jnp.broadcast_to(k_pos[None, None, :] <= pos, (B, 1, S))
+        mask = k_pos[None, None, :] <= pos[:, None, None]
         o = _cache_attention(q, ck, cv, mask, cfg)
         x = x + o.reshape(B, 1, -1) @ lp["wo"].astype(o.dtype)
         x = _mlp(lp, x, cfg)
@@ -156,11 +183,14 @@ def generate(
     temperature: float = 0.0,
     top_k: int = 0,
     key=None,
+    prompt_lens=None,
 ):
     """prompt [B, T] int32 -> generated [B, max_new_tokens] int32.
 
     One jit: prefill + a lax.scan of decode steps (no per-token host
     round trips). temperature=0 is greedy; top_k=0 disables truncation.
+    ``prompt_lens`` [B] batches RAGGED prompts (rows padded at the end to
+    T): row b continues from its real prompt tokens[b, :prompt_lens[b]].
     """
     if cfg.num_experts > 0:
         raise NotImplementedError(
@@ -172,7 +202,7 @@ def generate(
         key = jax.random.PRNGKey(0)
     B, T = prompt.shape
     cache = init_cache(cfg, B, T + max_new_tokens)
-    logits, cache, pos = prefill(params, prompt, cache, cfg)
+    logits, cache, pos = prefill(params, prompt, cache, cfg, prompt_lens=prompt_lens)
 
     def step(carry, k):
         logits, cache, pos = carry

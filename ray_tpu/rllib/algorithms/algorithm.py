@@ -59,6 +59,7 @@ class AlgorithmConfig:
         # families (MARWIL/BC/CQL/CRR/DT) which override these defaults.
         self.input_ = None
         self.output = None
+        self.input_reader_kwargs: dict = {}
         # Callbacks class (reference: .callbacks()).
         self.callbacks_class = None
         self.extra: dict = {}

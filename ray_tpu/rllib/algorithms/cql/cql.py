@@ -93,7 +93,7 @@ class CQL(OffPolicyTraining, Algorithm):
         probe.close()
         self.reader = make_input_reader(
             cfg.input_, gamma=cfg.gamma, seed=cfg.seed,
-            **getattr(cfg, "input_reader_kwargs", {}),
+            **cfg.input_reader_kwargs,
         )
         self.params = init_sac_params(
             jax.random.PRNGKey(cfg.seed), self.obs_dim, self.action_dim, self.discrete, cfg.model_hiddens

@@ -101,7 +101,7 @@ class CRR(OffPolicyTraining, Algorithm):
         probe.close()
         self.reader = make_input_reader(
             cfg.input_, gamma=cfg.gamma, seed=cfg.seed,
-            **getattr(cfg, "input_reader_kwargs", {}),
+            **cfg.input_reader_kwargs,
         )
 
         keys = jax.random.split(jax.random.PRNGKey(cfg.seed), 3)

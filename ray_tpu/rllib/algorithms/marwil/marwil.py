@@ -101,7 +101,7 @@ class MARWIL(Algorithm):
 
         self.reader = make_input_reader(
             cfg.input_, gamma=cfg.gamma, seed=cfg.seed,
-            **getattr(cfg, "input_reader_kwargs", {}),
+            **cfg.input_reader_kwargs,
         )
 
     def _build_learner_group(self, cfg: MARWILConfig) -> LearnerGroup:

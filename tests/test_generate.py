@@ -62,7 +62,7 @@ def test_prefill_logits_match_forward():
     np.testing.assert_allclose(
         np.asarray(logits), np.asarray(full[:, -1]), rtol=2e-4, atol=2e-5
     )
-    assert int(pos) == 9
+    assert np.asarray(pos).tolist() == [9, 9, 9]
     # Cache beyond the prompt is untouched zeros.
     assert float(jnp.abs(cache["k"][:, :, 9:]).sum()) == 0.0
 
@@ -96,6 +96,31 @@ def test_sampling_modes():
     assert np.asarray(a).shape == (2, 6)
     assert ((np.asarray(a) >= 0) & (np.asarray(a) < cfg.vocab_size)).all()
     assert not np.array_equal(np.asarray(a), np.asarray(c))  # overwhelmingly likely
+
+
+def test_ragged_prompt_batch_matches_per_row():
+    """Ragged batch (prompt_lens) must produce exactly what each row
+    produces generated alone — padding must be invisible."""
+    cfg = _cfg(n_kv_heads=2)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    rows = [
+        jax.random.randint(jax.random.PRNGKey(i), (n,), 0, cfg.vocab_size)
+        for i, n in enumerate((3, 7, 5))
+    ]
+    T = max(len(r) for r in rows)
+    padded = jnp.stack([
+        jnp.pad(r, (0, T - len(r)), constant_values=99) for r in rows
+    ])
+    lens = jnp.asarray([len(r) for r in rows], jnp.int32)
+    got = np.asarray(
+        generate(params, padded, cfg, max_new_tokens=6, temperature=0.0,
+                 prompt_lens=lens)
+    )
+    for i, r in enumerate(rows):
+        solo = np.asarray(
+            generate(params, r[None], cfg, max_new_tokens=6, temperature=0.0)
+        )[0]
+        np.testing.assert_array_equal(got[i], solo, err_msg=f"row {i}")
 
 
 def test_moe_decode_rejected():

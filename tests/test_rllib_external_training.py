@@ -184,7 +184,16 @@ def test_concurrent_external_clients(ray_cluster):
         obs = np.asarray(batch["obs"])
         acts = np.asarray(batch["actions"])
         rews = np.asarray(batch["rewards"])
+        dones = np.asarray(batch["dones"])
         assert len(set(eps.tolist())) == n_clients * eps_per_client
+        # Episodes must be CONTIGUOUS runs each ending in done=1 —
+        # _add_return_targets's single backward scan (resetting on dones)
+        # depends on this batch layout.
+        changes = np.flatnonzero(np.diff(eps) != 0)
+        assert len(set(eps.tolist())) == len(changes) + 1
+        for boundary in changes:
+            assert dones[boundary] == 1.0
+        assert dones[-1] == 1.0
         for e in set(eps.tolist()):
             rows = eps == e
             tids = obs[rows][:, 0]
