@@ -21,3 +21,4 @@ from ray_tpu.autoscaler.node_provider import (  # noqa: F401
 from ray_tpu.autoscaler.resource_demand_scheduler import (  # noqa: F401
     ResourceDemandScheduler,
 )
+from ray_tpu.autoscaler.sdk import request_resources  # noqa: F401

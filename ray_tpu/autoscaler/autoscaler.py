@@ -78,7 +78,9 @@ class StandardAutoscaler:
     def _gcs(self) -> RpcClient:
         return RpcClient(self._gcs_address, label="autoscaler")
 
-    def _read_state(self) -> tuple[list[dict], list[dict]]:
+    def _read_state(self) -> tuple[list[dict], list[dict], list[dict]]:
+        from ray_tpu.autoscaler.sdk import read_resource_request
+
         gcs = self._gcs()
         try:
             nodes = [
@@ -87,20 +89,28 @@ class StandardAutoscaler:
                 if n["state"] == "ALIVE"
             ]
             pgs = gcs.call("list_placement_groups").get("placement_groups", [])
+            requested = read_resource_request(gcs)
         finally:
             gcs.close()
-        return nodes, pgs
+        return nodes, pgs, requested
 
     def update(self):
         """One reconcile tick. Safe to call from any thread/process."""
-        nodes, pgs = self._read_state()
+        nodes, pgs, requested = self._read_state()
         if self._head_node_id is None and nodes:
             # First-seen node is the head (started before the autoscaler);
             # never terminate it.
             self._head_node_id = nodes[0]["node_id"]
 
         # ---- demand ----
-        demands: list[dict] = []
+        # sdk.request_resources shapes are a STANDING floor satisfied from
+        # TOTAL cluster capacity (reference semantics): shapes no live node
+        # could hold join the launch demand; shapes a node covers instead
+        # protect that node from idle reaping below. Fitting the launch
+        # side against availability would relaunch forever while a covering
+        # node is merely busy (launch/reap churn).
+        protected, uncovered = self._cover_request(requested, nodes)
+        demands: list[dict] = list(uncovered)
         for n in nodes:
             for entry in n.get("load", []) or []:
                 shape = entry.get("resources", {})
@@ -165,7 +175,13 @@ class StandardAutoscaler:
 
         # ---- idle termination ----
         now = time.time()
-        feasible_demand = bool(to_launch) or any(self._shape_feasible(s, nodes) for s in demands)
+        # Live (task/PG) demand pins the whole cluster; the standing
+        # sdk.request_resources floor pins only the nodes needed to COVER
+        # it — extra idle capacity beyond the request still scales down.
+        live_demands = demands[len(uncovered):]
+        feasible_demand = bool(to_launch) or any(
+            self._shape_feasible(s, nodes) for s in live_demands
+        )
         if feasible_demand:
             # Busy cluster: reset idle clocks to avoid flapping. Demand no
             # node type (or node) could ever satisfy must NOT pin the
@@ -175,6 +191,9 @@ class StandardAutoscaler:
         idle_gcs_nodes = []
         for n in nodes:
             if n["node_id"] == self._head_node_id:
+                continue
+            if n["node_id"] in protected:
+                self._idle_since.pop(n["node_id"], None)
                 continue
             total, avail = n.get("resources_total", {}), n.get("resources_available", {})
             resources_idle = all(avail.get(k, 0) >= v for k, v in total.items())
@@ -211,6 +230,26 @@ class StandardAutoscaler:
             self.provider.terminate_node(pid)
             self._node_type_of.pop(pid, None)
             self._idle_since.pop(n["node_id"], None)
+
+    def _cover_request(self, shapes: list[dict], nodes: list[dict]) -> tuple[set, list[dict]]:
+        """First-fit the requested shapes onto live nodes by TOTAL capacity.
+
+        Returns (protected node ids — they hold at least one shape and the
+        standing request shields them from idle reaping; uncovered shapes —
+        launch demand no live node could hold)."""
+        protected: set = set()
+        uncovered: list[dict] = []
+        remaining = [dict(n.get("resources_total", {})) for n in nodes]
+        for shape in shapes:
+            for i, cap in enumerate(remaining):
+                if all(cap.get(k, 0) >= v for k, v in shape.items()):
+                    for k, v in shape.items():
+                        cap[k] = cap.get(k, 0) - v
+                    protected.add(nodes[i]["node_id"])
+                    break
+            else:
+                uncovered.append(shape)
+        return protected, uncovered
 
     def _shape_feasible(self, shape: dict, nodes: list[dict]) -> bool:
         """Could this demand ever be satisfied — by a configured node type or

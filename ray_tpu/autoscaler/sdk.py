@@ -1,0 +1,58 @@
+"""Programmatic autoscaler SDK.
+
+Reference: python/ray/autoscaler/sdk/sdk.py:206 ``request_resources`` — an
+explicit, STANDING demand floor the autoscaler provisions for regardless of
+queued work, until overridden by the next call (an empty request clears it).
+The request rides GCS KV (the same channel the reference uses via its
+resource-request gRPC into the monitor), so any driver in the cluster can
+set it and the autoscaler's reconcile tick picks it up.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+RESOURCE_REQUEST_KEY = "autoscaler/resource_request"
+
+
+def request_resources(num_cpus: Optional[int] = None, bundles: Optional[list] = None):
+    """Command the cluster to scale to accommodate the given resources.
+
+    ``num_cpus`` expands to that many 1-CPU bundles (reference semantics);
+    ``bundles`` is a list of resource-shape dicts (e.g. ``[{"TPU": 4}]``).
+    Calling with neither (or empty) clears the standing request.
+    """
+    from ray_tpu._private import worker_context
+
+    shapes: list[dict] = []
+    if num_cpus:
+        shapes.extend([{"CPU": 1.0}] * int(num_cpus))
+    for b in bundles or []:
+        if b:
+            shapes.append({k: float(v) for k, v in b.items()})
+    cw = worker_context.get_core_worker()
+    cw.gcs.call(
+        "kv_put",
+        {
+            "key": RESOURCE_REQUEST_KEY,
+            "value": json.dumps(shapes).encode(),
+            "overwrite": True,
+        },
+    )
+
+
+def read_resource_request(gcs) -> list[dict]:
+    """Autoscaler-side: the standing request as demand shapes ([] if none).
+    Takes an open GCS RpcClient (the autoscaler's tick already holds one)."""
+    try:
+        resp = gcs.call("kv_get", {"key": RESOURCE_REQUEST_KEY})
+    except Exception:
+        return []
+    if not resp.get("found"):
+        return []
+    try:
+        shapes = json.loads(bytes(resp["value"]).decode())
+    except (ValueError, TypeError):
+        return []
+    return [s for s in shapes if isinstance(s, dict) and s]

@@ -103,6 +103,11 @@ def prefill(params, tokens, cache, cfg: TransformerConfig, prompt_lens=None):
     B, T = tokens.shape
     if prompt_lens is None:
         prompt_lens = jnp.full((B,), T, jnp.int32)
+    else:
+        # Empty rows are undefined (all-masked softmax -> NaN, gather at
+        # -1); clamp to 1 so a stray len-0 row behaves as "prompt is
+        # tokens[b, :1]" instead of silently poisoning the whole batch.
+        prompt_lens = jnp.maximum(jnp.asarray(prompt_lens, jnp.int32), 1)
     x = params["embed"].astype(cfg.dtype)[tokens]
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
 
@@ -137,9 +142,13 @@ def decode_step(params, token, cache, pos, cfg: TransformerConfig):
 
     Returns (logits [B, V] f32, updated cache)."""
     B = token.shape[0]
-    pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (B,))
+    pos = jnp.asarray(pos, jnp.int32)
+    # Aligned batches (scalar pos) keep the single fused dynamic_update_slice
+    # cache write; only genuinely ragged batches pay the per-row scatter.
+    aligned = pos.ndim == 0
+    pos_b = jnp.broadcast_to(pos, (B,))
     x = params["embed"].astype(cfg.dtype)[token][:, None, :]  # [B, 1, D]
-    positions = pos[:, None]
+    positions = pos_b[:, None]
     S = cache["k"].shape[2]
 
     def write_row(slot, kv, p):
@@ -149,10 +158,14 @@ def decode_step(params, token, cache, pos, cfg: TransformerConfig):
     def body(x, layer):
         lp, ck_slot, cv_slot = layer
         q, k, v = _project_qkv(lp, x, positions, cfg)
-        ck = jax.vmap(write_row)(ck_slot, k, pos)
-        cv = jax.vmap(write_row)(cv_slot, v, pos)
+        if aligned:
+            ck = lax.dynamic_update_slice(ck_slot, k, (0, pos, 0, 0))
+            cv = lax.dynamic_update_slice(cv_slot, v, (0, pos, 0, 0))
+        else:
+            ck = jax.vmap(write_row)(ck_slot, k, pos_b)
+            cv = jax.vmap(write_row)(cv_slot, v, pos_b)
         k_pos = jnp.arange(S, dtype=jnp.int32)
-        mask = k_pos[None, None, :] <= pos[:, None, None]
+        mask = k_pos[None, None, :] <= pos_b[:, None, None]
         o = _cache_attention(q, ck, cv, mask, cfg)
         x = x + o.reshape(B, 1, -1) @ lp["wo"].astype(o.dtype)
         x = _mlp(lp, x, cfg)
@@ -203,6 +216,10 @@ def generate(
     B, T = prompt.shape
     cache = init_cache(cfg, B, T + max_new_tokens)
     logits, cache, pos = prefill(params, prompt, cache, cfg, prompt_lens=prompt_lens)
+    if prompt_lens is None:
+        # Aligned batch: a SCALAR position keeps decode's cache write a
+        # single fused dynamic_update_slice instead of a per-row scatter.
+        pos = jnp.int32(T)
 
     def step(carry, k):
         logits, cache, pos = carry

@@ -212,3 +212,61 @@ def test_autoscaler_end_to_end_scale_up_down():
     finally:
         scaler.shutdown()
         ray_tpu.shutdown()
+
+
+def test_request_resources_standing_floor(ray_start_regular):
+    """sdk.request_resources is a standing demand floor: it launches to
+    cover the request, booting capacity satisfies it across ticks, and
+    clearing it stops influencing the plan (reference: autoscaler/sdk)."""
+    provider = _RecordingProvider()
+    node = ray_tpu._global_node
+    config = {
+        "cluster_name": "t",
+        "max_workers": 4,
+        "idle_timeout_s": 9999,
+        "provider": {"type": "fake", "gcs_address": "%s:%d" % tuple(node.gcs_address)},
+        "node_types": {"big": {"resources": {"CPU": 16}, "max_workers": 2}},
+    }
+    scaler = StandardAutoscaler(config, provider=provider)
+    from ray_tpu.autoscaler import request_resources
+
+    request_resources(bundles=[{"CPU": 16}])
+    scaler.update()
+    assert len(provider.created) == 1  # head's CPUs can't hold CPU:16
+    assert provider.created[0][1]["resources"] == {"CPU": 16}
+    # Standing request + booting node capacity: no duplicate launch.
+    scaler.update()
+    assert len(provider.created) == 1
+    # num_cpus that already fits on the head adds nothing.
+    request_resources(num_cpus=1)
+    scaler.update()
+    assert len(provider.created) == 1
+    # Clearing the request leaves the plan untouched.
+    request_resources()
+    scaler.update()
+    assert len(provider.created) == 1
+
+
+def test_cover_request_first_fit():
+    """The standing request protects only the nodes needed to COVER it
+    (fit against TOTALS — a busy covering node still counts, no churn) and
+    returns the uncovered remainder as launch demand."""
+    scaler = StandardAutoscaler.__new__(StandardAutoscaler)
+    nodes = [
+        {"node_id": "a", "resources_total": {"CPU": 4}},
+        {"node_id": "b", "resources_total": {"CPU": 16}},
+        {"node_id": "c", "resources_total": {"CPU": 16}},
+    ]
+    protected, uncovered = scaler._cover_request([{"CPU": 16}], nodes)
+    assert protected == {"b"} and uncovered == []
+    protected, uncovered = scaler._cover_request(
+        [{"CPU": 2}, {"CPU": 2}, {"CPU": 16}], nodes
+    )
+    assert protected == {"a", "b"} and uncovered == []  # small shapes share "a"
+    assert scaler._cover_request([], nodes) == (set(), [])
+    # Infeasible-for-the-fleet shapes come back as launch demand.
+    protected, uncovered = scaler._cover_request([{"GPU": 1}], nodes)
+    assert protected == set() and uncovered == [{"GPU": 1}]
+    # Three big shapes onto two big nodes: one uncovered.
+    protected, uncovered = scaler._cover_request([{"CPU": 16}] * 3, nodes)
+    assert protected == {"b", "c"} and uncovered == [{"CPU": 16}]

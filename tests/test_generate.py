@@ -123,6 +123,24 @@ def test_ragged_prompt_batch_matches_per_row():
         np.testing.assert_array_equal(got[i], solo, err_msg=f"row {i}")
 
 
+def test_zero_length_prompt_row_is_clamped():
+    """A stray len-0 row behaves as len-1 (defined, finite) instead of
+    poisoning the batch with NaN."""
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0, cfg.vocab_size)
+    got = np.asarray(
+        generate(params, prompt, cfg, max_new_tokens=4, temperature=0.0,
+                 prompt_lens=jnp.asarray([0, 5], jnp.int32))
+    )
+    as_one = np.asarray(
+        generate(params, prompt, cfg, max_new_tokens=4, temperature=0.0,
+                 prompt_lens=jnp.asarray([1, 5], jnp.int32))
+    )
+    np.testing.assert_array_equal(got, as_one)
+    assert (got >= 0).all() and (got < cfg.vocab_size).all()
+
+
 def test_moe_decode_rejected():
     cfg = _cfg(num_experts=4)
     params_cfg = _cfg()  # params shape irrelevant; trace fails first
