@@ -32,157 +32,7 @@
 #include <string>
 #include <vector>
 
-// ---------------------------------------------------------------------------
-// Minimal msgpack encoder (maps/arrays/str/bin/uint/int/bool/nil).
-// ---------------------------------------------------------------------------
-struct Packer {
-  std::string out;
-  void raw(const void* p, size_t n) { out.append((const char*)p, n); }
-  void u8(uint8_t b) { out.push_back((char)b); }
-  void be16(uint16_t v) { uint16_t x = htons(v); raw(&x, 2); }
-  void be32(uint32_t v) { uint32_t x = htonl(v); raw(&x, 4); }
-  void be64(uint64_t v) {
-    for (int i = 7; i >= 0; --i) u8((v >> (8 * i)) & 0xff);
-  }
-  void nil() { u8(0xc0); }
-  void boolean(bool b) { u8(b ? 0xc3 : 0xc2); }
-  void integer(int64_t v) {
-    if (v >= 0) {
-      if (v < 128) u8((uint8_t)v);
-      else if (v <= 0xff) { u8(0xcc); u8((uint8_t)v); }
-      else if (v <= 0xffff) { u8(0xcd); be16((uint16_t)v); }
-      else if (v <= 0xffffffffLL) { u8(0xce); be32((uint32_t)v); }
-      else { u8(0xcf); be64((uint64_t)v); }
-    } else {
-      if (v >= -32) u8((uint8_t)(0xe0 | (v + 32)));
-      else { u8(0xd3); be64((uint64_t)v); }
-    }
-  }
-  void str(const std::string& s) {
-    size_t n = s.size();
-    if (n < 32) u8(0xa0 | (uint8_t)n);
-    else if (n <= 0xff) { u8(0xd9); u8((uint8_t)n); }
-    else if (n <= 0xffff) { u8(0xda); be16((uint16_t)n); }
-    else { u8(0xdb); be32((uint32_t)n); }
-    raw(s.data(), n);
-  }
-  void bin(const std::string& b) {
-    size_t n = b.size();
-    if (n <= 0xff) { u8(0xc4); u8((uint8_t)n); }
-    else if (n <= 0xffff) { u8(0xc5); be16((uint16_t)n); }
-    else { u8(0xc6); be32((uint32_t)n); }
-    raw(b.data(), n);
-  }
-  void array_header(uint32_t n) {
-    if (n < 16) u8(0x90 | (uint8_t)n);
-    else if (n <= 0xffff) { u8(0xdc); be16((uint16_t)n); }
-    else { u8(0xdd); be32(n); }
-  }
-  void map_header(uint32_t n) {
-    if (n < 16) u8(0x80 | (uint8_t)n);
-    else if (n <= 0xffff) { u8(0xde); be16((uint16_t)n); }
-    else { u8(0xdf); be32(n); }
-  }
-};
-
-// ---------------------------------------------------------------------------
-// Minimal msgpack value + decoder.
-// ---------------------------------------------------------------------------
-struct Value {
-  enum Kind { NIL, BOOL, INT, FLOAT, STR, BIN, ARR, MAP } kind = NIL;
-  bool b = false;
-  int64_t i = 0;
-  double f = 0;
-  std::string s;  // STR and BIN payloads
-  std::vector<Value> arr;
-  std::map<std::string, Value> map;  // string-keyed maps only (our wire shape)
-
-  const Value* get(const std::string& key) const {
-    auto it = map.find(key);
-    return it == map.end() ? nullptr : &it->second;
-  }
-  bool truthy() const {
-    switch (kind) {
-      case BOOL: return b;
-      case INT: return i != 0;
-      case NIL: return false;
-      default: return true;
-    }
-  }
-};
-
-struct Unpacker {
-  const uint8_t* p;
-  const uint8_t* end;
-  explicit Unpacker(const std::string& buf)
-      : p((const uint8_t*)buf.data()), end(p + buf.size()) {}
-  uint8_t u8() { need(1); return *p++; }
-  void need(size_t n) {
-    if ((size_t)(end - p) < n) throw std::runtime_error("msgpack truncated");
-  }
-  uint64_t be(int n) {
-    need(n);
-    uint64_t v = 0;
-    for (int i = 0; i < n; ++i) v = (v << 8) | *p++;
-    return v;
-  }
-  std::string bytes(size_t n) {
-    need(n);
-    std::string s((const char*)p, n);
-    p += n;
-    return s;
-  }
-  Value decode() {
-    uint8_t t = u8();
-    Value v;
-    if (t < 0x80) { v.kind = Value::INT; v.i = t; return v; }
-    if (t >= 0xe0) { v.kind = Value::INT; v.i = (int8_t)t; return v; }
-    if ((t & 0xf0) == 0x80) return map_body(t & 0x0f);
-    if ((t & 0xf0) == 0x90) return arr_body(t & 0x0f);
-    if ((t & 0xe0) == 0xa0) { v.kind = Value::STR; v.s = bytes(t & 0x1f); return v; }
-    switch (t) {
-      case 0xc0: return v;
-      case 0xc2: v.kind = Value::BOOL; v.b = false; return v;
-      case 0xc3: v.kind = Value::BOOL; v.b = true; return v;
-      case 0xc4: v.kind = Value::BIN; v.s = bytes(be(1)); return v;
-      case 0xc5: v.kind = Value::BIN; v.s = bytes(be(2)); return v;
-      case 0xc6: v.kind = Value::BIN; v.s = bytes(be(4)); return v;
-      case 0xca: { v.kind = Value::FLOAT; uint32_t raw = (uint32_t)be(4);
-                   float f; memcpy(&f, &raw, 4); v.f = f; return v; }
-      case 0xcb: { v.kind = Value::FLOAT; uint64_t raw = be(8);
-                   memcpy(&v.f, &raw, 8); return v; }
-      case 0xcc: v.kind = Value::INT; v.i = (int64_t)be(1); return v;
-      case 0xcd: v.kind = Value::INT; v.i = (int64_t)be(2); return v;
-      case 0xce: v.kind = Value::INT; v.i = (int64_t)be(4); return v;
-      case 0xcf: v.kind = Value::INT; v.i = (int64_t)be(8); return v;
-      case 0xd0: v.kind = Value::INT; v.i = (int8_t)be(1); return v;
-      case 0xd1: v.kind = Value::INT; v.i = (int16_t)be(2); return v;
-      case 0xd2: v.kind = Value::INT; v.i = (int32_t)be(4); return v;
-      case 0xd3: v.kind = Value::INT; v.i = (int64_t)be(8); return v;
-      case 0xd9: v.kind = Value::STR; v.s = bytes(be(1)); return v;
-      case 0xda: v.kind = Value::STR; v.s = bytes(be(2)); return v;
-      case 0xdb: v.kind = Value::STR; v.s = bytes(be(4)); return v;
-      case 0xdc: return arr_body(be(2));
-      case 0xdd: return arr_body(be(4));
-      case 0xde: return map_body(be(2));
-      case 0xdf: return map_body(be(4));
-      default: throw std::runtime_error("msgpack type not handled");
-    }
-  }
-  Value arr_body(uint64_t n) {
-    Value v; v.kind = Value::ARR;
-    for (uint64_t i = 0; i < n; ++i) v.arr.push_back(decode());
-    return v;
-  }
-  Value map_body(uint64_t n) {
-    Value v; v.kind = Value::MAP;
-    for (uint64_t i = 0; i < n; ++i) {
-      Value k = decode();
-      v.map[k.s] = decode();  // keys are strings on this wire
-    }
-    return v;
-  }
-};
+#include "msgpack_mini.h"
 
 // ---------------------------------------------------------------------------
 // RPC client: 4-byte BE length + msgpack [type, seq, method, payload].

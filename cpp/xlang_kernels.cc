@@ -1,0 +1,140 @@
+// ray_tpu cross-language kernels — native user functions callable from the
+// task plane (reference: the C++/Java user-function surface behind
+// ray.cross_language, python/ray/cross_language.py + cpp/src task execution).
+//
+// ABI (the seam ray_tpu/cross_language.py invokes over ctypes):
+//
+//   int <symbol>(const uint8_t* in, size_t in_len,
+//                uint8_t** out, size_t* out_len);
+//     in:  msgpack array of the call's positional args
+//     0  -> *out = malloc'd msgpack-encoded result
+//     !0 -> *out = malloc'd utf-8 error message
+//   void ray_tpu_xlang_free(uint8_t* p);   // caller returns the buffer
+//
+// Results cross back in the language-agnostic msgpack object format
+// (serialization.py format "x"), so non-Python drivers (the C++ client)
+// can decode them without pickle.
+//
+// Build: g++ -O2 -std=c++17 -shared -fPIC -o libxlang_kernels.so cpp/xlang_kernels.cc
+
+#include <cmath>
+#include <cstdlib>
+#include <cstring>
+
+#include "msgpack_mini.h"
+
+namespace {
+
+uint8_t* dup(const std::string& s, size_t* out_len) {
+  uint8_t* p = (uint8_t*)std::malloc(s.size());
+  std::memcpy(p, s.data(), s.size());
+  *out_len = s.size();
+  return p;
+}
+
+int fail(const std::string& msg, uint8_t** out, size_t* out_len) {
+  *out = dup(msg, out_len);
+  return 1;
+}
+
+Value parse_args(const uint8_t* in, size_t in_len) {
+  Unpacker up(in, in_len);  // decode straight from the caller's buffer
+  Value v = up.decode();
+  if (v.kind != Value::ARR) throw std::runtime_error("args must be a msgpack array");
+  return v;
+}
+
+}  // namespace
+
+extern "C" {
+
+void ray_tpu_xlang_free(uint8_t* p) { std::free(p); }
+
+// sum of a numeric array -> number. xlang_sum([[1, 2, 3.5]]) == 6.5
+int xlang_sum(const uint8_t* in, size_t in_len, uint8_t** out, size_t* out_len) {
+  try {
+    Value args = parse_args(in, in_len);
+    if (args.arr.size() != 1 || args.arr[0].kind != Value::ARR)
+      return fail("xlang_sum expects one array argument", out, out_len);
+    // Exact int64 accumulation while the input stays integral (a double
+    // would silently round past 2^53); switch to double on the first float.
+    int64_t itotal = 0;
+    double ftotal = 0;
+    bool all_int = true;
+    for (const Value& v : args.arr[0].arr) {
+      if (v.kind == Value::INT) {
+        if (all_int && __builtin_add_overflow(itotal, v.i, &itotal))
+          return fail("xlang_sum: int64 overflow", out, out_len);
+        if (!all_int) ftotal += (double)v.i;
+      } else if (v.kind == Value::FLOAT) {
+        if (all_int) { ftotal = (double)itotal; all_int = false; }
+        ftotal += v.f;
+      } else {
+        return fail("xlang_sum: non-numeric element", out, out_len);
+      }
+    }
+    Packer pk;
+    if (all_int) pk.integer(itotal);
+    else { pk.u8(0xcb); pk.be64([](double d){ uint64_t u; std::memcpy(&u, &d, 8); return u; }(ftotal)); }
+    *out = dup(pk.out, out_len);
+    return 0;
+  } catch (const std::exception& e) {
+    return fail(e.what(), out, out_len);
+  }
+}
+
+// scale a little-endian f32 buffer: [bin, scale] -> bin
+int xlang_vector_scale(const uint8_t* in, size_t in_len, uint8_t** out, size_t* out_len) {
+  try {
+    Value args = parse_args(in, in_len);
+    if (args.arr.size() != 2 || args.arr[0].kind != Value::BIN)
+      return fail("xlang_vector_scale expects (bytes, scale)", out, out_len);
+    const Value& s = args.arr[1];
+    if (s.kind != Value::FLOAT && s.kind != Value::INT)
+      return fail("xlang_vector_scale: scale must be numeric", out, out_len);
+    double scale = s.kind == Value::FLOAT ? s.f : (double)s.i;
+    std::string buf = std::move(args.arr[0].s);
+    if (buf.size() % 4) return fail("buffer length not a multiple of 4", out, out_len);
+    for (size_t i = 0; i < buf.size(); i += 4) {
+      float f;
+      std::memcpy(&f, buf.data() + i, 4);
+      f = (float)(f * scale);
+      std::memcpy(&buf[i], &f, 4);
+    }
+    Packer pk;
+    pk.bin(buf);
+    *out = dup(pk.out, out_len);
+    return 0;
+  } catch (const std::exception& e) {
+    return fail(e.what(), out, out_len);
+  }
+}
+
+// word counts of a string -> {word: count}
+int xlang_wordcount(const uint8_t* in, size_t in_len, uint8_t** out, size_t* out_len) {
+  try {
+    Value args = parse_args(in, in_len);
+    if (args.arr.size() != 1 || args.arr[0].kind != Value::STR)
+      return fail("xlang_wordcount expects one string", out, out_len);
+    std::map<std::string, int64_t> counts;
+    const std::string& text = args.arr[0].s;
+    std::string word;
+    for (char c : text) {
+      if (c == ' ' || c == '\n' || c == '\t') {
+        if (!word.empty()) { counts[word]++; word.clear(); }
+      } else {
+        word.push_back(c);
+      }
+    }
+    if (!word.empty()) counts[word]++;
+    Packer pk;
+    pk.map_header((uint32_t)counts.size());
+    for (const auto& kv : counts) { pk.str(kv.first); pk.integer(kv.second); }
+    *out = dup(pk.out, out_len);
+    return 0;
+  } catch (const std::exception& e) {
+    return fail(e.what(), out, out_len);
+  }
+}
+
+}  // extern "C"

@@ -46,19 +46,23 @@ def record_contained_ref(ref) -> None:
 
 @dataclass
 class SerializedObject:
-    pickled: bytes
+    pickled: bytes  # payload: pickle bytes, or msgpack bytes when format="x"
     buffers: list  # list of buffer-protocol objects
     contained_refs: list = field(default_factory=list)
+    # "pickle" (default, omitted from the header) or "x" — the
+    # cross-language msgpack format any runtime can decode (reference:
+    # cross-language serialization for C++/Java workers).
+    format: str = "pickle"
 
     @property
     def header(self) -> bytes:
-        return msgpack.packb(
-            {
-                "p": len(self.pickled),
-                "b": [len(memoryview(b)) for b in self.buffers],
-            },
-            use_bin_type=True,
-        )
+        h = {
+            "p": len(self.pickled),
+            "b": [len(memoryview(b)) for b in self.buffers],
+        }
+        if self.format != "pickle":
+            h["f"] = self.format
+        return msgpack.packb(h, use_bin_type=True)
 
     @property
     def total_size(self) -> int:
@@ -225,9 +229,23 @@ class _Pickler(cloudpickle.CloudPickler):
         return super().reducer_override(obj)
 
 
+class XLangBytes:
+    """Marker: store these pre-encoded msgpack bytes as a format-"x" object
+    (language-agnostic — a C++/Java driver decodes it without pickle).
+    Produced by cross_language invokers; deserialize() returns the decoded
+    plain data, so Python callers never see this wrapper."""
+
+    __slots__ = ("data",)
+
+    def __init__(self, data: bytes):
+        self.data = bytes(data)
+
+
 def serialize(obj) -> SerializedObject:
     import io
 
+    if isinstance(obj, XLangBytes):
+        return SerializedObject(pickled=obj.data, buffers=[], format="x")
     buffers: list = []
     prev = _thread_ctx.contained_refs
     _thread_ctx.contained_refs = []
@@ -248,14 +266,17 @@ def deserialize(view) -> object:
     header_len = int.from_bytes(view[:4], "big")
     header = msgpack.unpackb(view[4 : 4 + header_len], raw=False)
     pos = _align(4 + header_len)
-    pickled = view[pos : pos + header["p"]]
+    payload = view[pos : pos + header["p"]]
     pos += header["p"]
+    if header.get("f") == "x":
+        # Cross-language msgpack object: plain data, no pickle involved.
+        return msgpack.unpackb(bytes(payload), raw=False)
     buffers = []
     for size in header["b"]:
         pos = _align(pos)
         buffers.append(pickle.PickleBuffer(view[pos : pos + size]))
         pos += size
-    return pickle.loads(pickled, buffers=buffers)
+    return pickle.loads(payload, buffers=buffers)
 
 
 def dumps(obj) -> bytes:

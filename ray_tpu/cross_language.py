@@ -1,0 +1,103 @@
+"""Cross-language task invocation: native (C/C++) functions on the task plane.
+
+Reference: python/ray/cross_language.py (``ray.cross_language.java_function``
+/ ``cpp_function``) — remote handles whose execution happens in another
+language, with args/results in a language-agnostic serialization instead of
+pickle. Here the native side is a C-ABI shared library (the image's C++
+toolchain; see cpp/xlang_kernels.cc for the contract and example kernels):
+
+    int <symbol>(const uint8_t* in, size_t in_len,
+                 uint8_t** out, size_t* out_len);   // msgpack in/out
+    void ray_tpu_xlang_free(uint8_t*);
+
+``cpp_function(symbol, library)`` returns a RemoteFunction; calls ship
+msgpack-encoded positional args across the ABI and the result is stored in
+the object store as a format-"x" (msgpack) object — decodable by ANY
+runtime, including the C++ client driver, with no pickle involved. Python
+callers just see plain data from ``ray_tpu.get``.
+
+Arg values must be msgpack-encodable (None/bool/int/float/str/bytes and
+lists/dicts thereof — the same constraint the reference places on
+cross-language calls).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+
+_lib_lock = threading.Lock()
+_lib_cache: dict = {}
+
+
+class CrossLanguageError(RuntimeError):
+    """The native function reported an error (its utf-8 message follows)."""
+
+
+def _load(library_path: str):
+    with _lib_lock:
+        lib = _lib_cache.get(library_path)
+        if lib is None:
+            lib = ctypes.CDLL(library_path)
+            lib.ray_tpu_xlang_free.argtypes = [ctypes.c_void_p]
+            lib.ray_tpu_xlang_free.restype = None
+            _lib_cache[library_path] = lib
+        return lib
+
+
+class CppFunctionInvoker:
+    """The callable a worker executes: msgpack the args across the C ABI,
+    wrap the result bytes as a format-"x" object (serialization.XLangBytes)
+    so the stored object is language-agnostic."""
+
+    def __init__(self, library_path: str, symbol: str):
+        self.library_path = library_path
+        self.symbol = symbol
+        self.__name__ = f"cpp:{symbol}"
+        self.__qualname__ = self.__name__
+
+    def __call__(self, *args):
+        import msgpack
+
+        from ray_tpu._private.serialization import XLangBytes
+
+        lib = _load(self.library_path)
+        try:
+            fn = getattr(lib, self.symbol)
+        except AttributeError:
+            raise CrossLanguageError(
+                f"symbol {self.symbol!r} not found in {self.library_path}"
+            ) from None
+        fn.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
+        fn.restype = ctypes.c_int
+        payload = msgpack.packb(list(args), use_bin_type=True)
+        out = ctypes.c_void_p()
+        out_len = ctypes.c_size_t()
+        rc = fn(payload, len(payload), ctypes.byref(out), ctypes.byref(out_len))
+        try:
+            data = ctypes.string_at(out, out_len.value) if out.value else b""
+        finally:
+            if out.value:
+                lib.ray_tpu_xlang_free(out)
+        if rc != 0:
+            raise CrossLanguageError(
+                f"{self.symbol} failed (rc={rc}): {data.decode('utf-8', 'replace')}"
+            )
+        return XLangBytes(data)
+
+
+def cpp_function(symbol: str, library: str, **remote_options):
+    """Remote handle for a native function: ``cpp_function("xlang_sum",
+    "/path/libkernels.so").remote([1, 2, 3])``. ``remote_options`` are the
+    usual task options (num_cpus=..., resources=...)."""
+    import ray_tpu
+
+    invoker = CppFunctionInvoker(library, symbol)
+    if remote_options:
+        return ray_tpu.remote(**remote_options)(invoker)
+    return ray_tpu.remote(invoker)
