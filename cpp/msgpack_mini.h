@@ -9,6 +9,7 @@
 #include <arpa/inet.h>
 
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <map>
 #include <stdexcept>
@@ -138,7 +139,12 @@ struct Unpacker {
       case 0xcc: v.kind = Value::INT; v.i = (int64_t)be(1); return v;
       case 0xcd: v.kind = Value::INT; v.i = (int64_t)be(2); return v;
       case 0xce: v.kind = Value::INT; v.i = (int64_t)be(4); return v;
-      case 0xcf: v.kind = Value::INT; v.i = (int64_t)be(8); return v;
+      case 0xcf: {  // uint64: values past INT64_MAX would wrap negative
+        uint64_t u = be(8);
+        if (u > (uint64_t)INT64_MAX)
+          throw std::runtime_error("msgpack uint64 exceeds int64 range");
+        v.kind = Value::INT; v.i = (int64_t)u; return v;
+      }
       case 0xd0: v.kind = Value::INT; v.i = (int8_t)be(1); return v;
       case 0xd1: v.kind = Value::INT; v.i = (int16_t)be(2); return v;
       case 0xd2: v.kind = Value::INT; v.i = (int32_t)be(4); return v;
@@ -162,9 +168,43 @@ struct Unpacker {
     Value v; v.kind = Value::MAP;
     for (uint64_t i = 0; i < n; ++i) {
       Value k = decode();
-      v.map[k.s] = decode();  // keys are strings on this wire
+      if (k.kind != Value::STR)  // loud, not a silent one-entry collapse
+        throw std::runtime_error("msgpack map key is not a string");
+      v.map[std::move(k.s)] = decode();
     }
     return v;
   }
 };
 
+
+// Debug/print representation (JSON-ish; BIN shown as <N bytes>).
+inline std::string value_repr(const Value& v) {
+  switch (v.kind) {
+    case Value::NIL: return "null";
+    case Value::BOOL: return v.b ? "true" : "false";
+    case Value::INT: return std::to_string(v.i);
+    case Value::FLOAT: {
+      char buf[32];
+      snprintf(buf, sizeof buf, "%g", v.f);
+      return buf;
+    }
+    case Value::STR: return "\"" + v.s + "\"";
+    case Value::BIN: return "<" + std::to_string(v.s.size()) + " bytes>";
+    case Value::ARR: {
+      std::string out = "[";
+      for (size_t i = 0; i < v.arr.size(); ++i)
+        out += (i ? "," : "") + value_repr(v.arr[i]);
+      return out + "]";
+    }
+    case Value::MAP: {
+      std::string out = "{";
+      bool first = true;
+      for (const auto& kv : v.map) {
+        out += (first ? "" : ",") + ("\"" + kv.first + "\":") + value_repr(kv.second);
+        first = false;
+      }
+      return out + "}";
+    }
+  }
+  return "?";
+}

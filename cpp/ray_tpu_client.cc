@@ -234,6 +234,30 @@ int main(int argc, char** argv) {
         checksum ^= data[i];
         checksum *= 1099511628211ULL;
       }
+      // Cross-language objects (serialization.py format "x") decode right
+      // here — no Python, no pickle: [4B BE header_len][msgpack header
+      // {p,b,f}][64-aligned msgpack payload].
+      if (size >= 4) {
+        uint64_t hlen = ((uint64_t)data[0] << 24) | (data[1] << 16) |
+                        (data[2] << 8) | data[3];
+        if (4 + hlen <= size) {
+          try {
+            Unpacker hu(data + 4, (size_t)hlen);
+            Value h = hu.decode();
+            const Value* f = h.get("f");
+            const Value* p = h.get("p");
+            if (f && f->s == "x" && p) {
+              uint64_t pos = (4 + hlen + 63) & ~63ULL;  // _ALIGN = 64
+              if (pos + (uint64_t)p->i <= size) {
+                Unpacker pu(data + pos, (size_t)p->i);
+                printf("XLANG_RESULT %s\n", value_repr(pu.decode()).c_str());
+              }
+            }
+          } catch (const std::exception&) {
+            // Not a decodable framework object — raw reads stay valid.
+          }
+        }
+      }
       idx_release(ih, slot, ver);
       printf("SHM_READ %llu %016llx\n", (unsigned long long)size,
              (unsigned long long)checksum);

@@ -16,9 +16,10 @@ the object store as a format-"x" (msgpack) object — decodable by ANY
 runtime, including the C++ client driver, with no pickle involved. Python
 callers just see plain data from ``ray_tpu.get``.
 
-Arg values must be msgpack-encodable (None/bool/int/float/str/bytes and
-lists/dicts thereof — the same constraint the reference places on
-cross-language calls).
+Arg values must be msgpack-encodable (None/bool/int/float/str/bytes, lists,
+and STRING-KEYED dicts thereof; ints must fit int64 — the kernel-side
+decoder rejects anything else loudly, mirroring the constraint the
+reference places on cross-language calls).
 """
 
 from __future__ import annotations

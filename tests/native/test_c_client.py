@@ -105,3 +105,41 @@ def test_c_client_end_to_end(cluster, binary):
     assert sz == size
     assert f"{h:016x}" == c_checksum
     assert "C_CLIENT_PASS" in out
+
+
+def test_c_client_decodes_xlang_object(cluster, binary):
+    """Format-'x' objects round-trip into C++ with no pickle: the client
+    prints XLANG_RESULT with the natively decoded value."""
+    import msgpack
+
+    from ray_tpu._private.serialization import XLangBytes
+    from ray_tpu._private.worker_context import get_core_worker
+
+    cw = get_core_worker()
+    value = {"answer": 42, "parts": [1, 2.5, "three", True, None]}
+    # Pad so the object lands in shm, not any inline path.
+    value["pad"] = "x" * 200_000
+    ref = ray_tpu.put(XLangBytes(msgpack.packb(value, use_bin_type=True)))
+    assert ray_tpu.get(ref)["answer"] == 42  # python side sees plain data
+
+    function_key = cw._export_function(_result_task)
+    gcs_host, gcs_port = cw.gcs.address
+    raylet_host, raylet_port = cw.raylet.address
+    arena_name = os.environ.get("RAY_TPU_ARENA_NAME") or f"/rtpu_{cw.node_id[:12]}"
+    native_dir = os.path.join(REPO, "ray_tpu", "_native", "build")
+    proc = subprocess.run(
+        [
+            binary,
+            gcs_host, str(gcs_port), raylet_host, str(raylet_port),
+            function_key, cw.job_id.hex(),
+            native_dir, arena_name, arena_name + "_idx", ref.hex(),
+        ],
+        capture_output=True, text=True, timeout=120,
+    )
+    sys.stderr.write(proc.stderr)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    xl = [ln for ln in proc.stdout.splitlines() if ln.startswith("XLANG_RESULT")]
+    assert xl, proc.stdout
+    decoded = xl[0][len("XLANG_RESULT "):]
+    assert '"answer":42' in decoded
+    assert '[1,2.5,"three",true,null]' in decoded
