@@ -1,0 +1,36 @@
+"""Run a C++ function on the task plane (ray_tpu.cross_language).
+
+Compiles the example kernels, then calls them as remote tasks: args cross
+as msgpack, results are stored language-agnostically (the C++ client can
+read them back without Python).
+
+Run: python examples/cross_language_task.py
+"""
+
+import os
+import subprocess
+import tempfile
+
+
+def main():
+    import ray_tpu
+    from ray_tpu.cross_language import cpp_function
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    so = os.path.join(tempfile.mkdtemp(), "libxlang_kernels.so")
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", so,
+         os.path.join(repo, "cpp", "xlang_kernels.cc")],
+        check=True,
+    )
+
+    ray_tpu.init(num_cpus=2)
+    sum_fn = cpp_function("xlang_sum", so)
+    wc = cpp_function("xlang_wordcount", so)
+    print("sum:", ray_tpu.get(sum_fn.remote([1, 2, 3, 4.5])))
+    print("wordcount:", ray_tpu.get(wc.remote("to be or not to be")))
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+"""Train PPO on CartPole with rollout workers + a jitted learner.
+
+Run: python examples/rllib_ppo.py [iters]
+"""
+
+import sys
+
+
+def main(iters: int = 3):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import ray_tpu
+    from ray_tpu.rllib import PPOConfig
+
+    ray_tpu.init(num_cpus=4)
+    cfg = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .rollouts(num_rollout_workers=1)
+        .training(lr=5e-4, train_batch_size=512)
+        .evaluation(evaluation_interval=2, evaluation_duration=3)
+        .debugging(seed=0)
+    )
+    algo = cfg.build()
+    algo.setup(cfg.to_dict())
+    try:
+        for i in range(iters):
+            m = algo.step()
+            print(
+                f"iter {i}: reward={m.get('episode_reward_mean'):.1f} "
+                f"eval={m.get('evaluation/episode_reward_mean', float('nan'))}"
+            )
+    finally:
+        algo.cleanup()
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 3)

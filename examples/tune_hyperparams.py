@@ -1,0 +1,43 @@
+"""Hyperparameter sweep with Tune: ASHA early stopping over trial actors.
+
+Run: python examples/tune_hyperparams.py
+"""
+
+
+def objective(config):
+    from ray_tpu import tune
+
+    lr, width = config["lr"], config["width"]
+    for step in range(20):
+        # Synthetic objective with a known optimum at lr=0.1, width=32.
+        score = 1.0 / (1 + abs(lr - 0.1) * 10 + abs(width - 32) / 32) * (step + 1) / 20
+        tune.report({"score": score})
+
+
+def main():
+    import ray_tpu
+    from ray_tpu import tune
+    from ray_tpu.tune.schedulers import ASHAScheduler
+
+    ray_tpu.init(num_cpus=4)
+    tuner = tune.Tuner(
+        objective,
+        param_space={
+            "lr": tune.loguniform(1e-3, 1.0),
+            "width": tune.choice([8, 16, 32, 64]),
+        },
+        tune_config=tune.TuneConfig(
+            num_samples=8,
+            metric="score",
+            mode="max",
+            scheduler=ASHAScheduler(max_t=20, grace_period=4),
+        ),
+    )
+    results = tuner.fit()
+    best = results.get_best_result()
+    print("best config:", best.config, "score:", best.metrics["score"])
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -86,6 +86,13 @@ def init(
     from ray_tpu._private.core_worker import DRIVER, CoreWorker
     from ray_tpu._private.node import Node
 
+    # Honor RAY_TPU_JAX_CONFIG_PLATFORMS in the DRIVER too (workers apply
+    # it in worker_main): a sitecustomize-pinned jax_platforms config BEATS
+    # the JAX_PLATFORMS env var, so the pin must be re-applied here.
+    from ray_tpu._private.jax_platform import apply_forced_jax_platforms
+
+    apply_forced_jax_platforms()
+
     if address is None and os.environ.get("RAY_TPU_ADDRESS"):
         # Set by `ray_tpu job submit` driver subprocesses and operators —
         # mirrors the reference's RAY_ADDRESS behavior.

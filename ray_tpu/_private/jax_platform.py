@@ -1,0 +1,48 @@
+"""One place for the force-jax-platforms pin.
+
+A sitecustomize (e.g. a TPU-plugin environment) may pin ``jax_platforms``
+via ``jax.config.update`` at interpreter startup — and config BEATS the
+``JAX_PLATFORMS`` env var, so a CPU-pinned run must re-update the config in
+EVERY process that already imported jax, and set the env var for processes
+that haven't. Used by both the driver (``ray_tpu.init``) and workers
+(``worker_main``); keep the semantics identical.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+logger = logging.getLogger(__name__)
+
+
+def apply_forced_jax_platforms(forced: str | None = None) -> None:
+    """Pin jax to ``forced`` platforms (default: the
+    RAY_TPU_JAX_CONFIG_PLATFORMS env var; no-op when unset).
+
+    Overwrites JAX_PLATFORMS (the pin is authoritative — a stale
+    conflicting value would dial the wrong backend on the lazy first
+    import) and, when jax is already imported, re-updates the config. A
+    failed config update is WARNED about, not swallowed: the symptom it
+    leads to is a multi-minute TPU-tunnel hang holding the chip claim.
+    """
+    if forced is None:
+        forced = os.environ.get("RAY_TPU_JAX_CONFIG_PLATFORMS")
+    if not forced:
+        return
+    os.environ["JAX_PLATFORMS"] = forced
+    if "jax" in sys.modules:
+        try:
+            import jax
+
+            if jax.config.jax_platforms != forced:
+                jax.config.update("jax_platforms", forced)
+        except Exception:
+            logger.warning(
+                "could not re-pin jax_platforms to %r — this process may "
+                "initialize the wrong jax backend (and hang dialing a TPU "
+                "plugin)",
+                forced,
+                exc_info=True,
+            )

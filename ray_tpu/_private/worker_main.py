@@ -437,13 +437,9 @@ def main():
     # this process, the env var governs the (lazy) first import instead —
     # eagerly importing jax here cost ~2s on EVERY worker spawn, dominating
     # the actor-creation envelope.
-    forced = os.environ.get("RAY_TPU_JAX_CONFIG_PLATFORMS")
-    if forced:
-        os.environ["JAX_PLATFORMS"] = forced
-        if "jax" in sys.modules:
-            import jax
+    from ray_tpu._private.jax_platform import apply_forced_jax_platforms
 
-            jax.config.update("jax_platforms", forced)
+    apply_forced_jax_platforms()
 
     from ray_tpu._private import worker_context
     from ray_tpu._private.core_worker import WORKER, CoreWorker
