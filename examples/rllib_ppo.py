@@ -22,8 +22,7 @@ def main(iters: int = 3):
         .evaluation(evaluation_interval=2, evaluation_duration=3)
         .debugging(seed=0)
     )
-    algo = cfg.build()
-    algo.setup(cfg.to_dict())
+    algo = cfg.build()  # build() constructs AND sets up the algorithm
     try:
         for i in range(iters):
             m = algo.step()

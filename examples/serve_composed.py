@@ -1,0 +1,81 @@
+"""Composed serving: model graph + DAGDriver routes + a raw ASGI app.
+
+Three Serve idioms in one app: nested bound deployments (preprocess ->
+model), a DAGDriver exposing multiple routes, and serve.ingress mounting an
+ASGI callable.
+
+Run: python examples/serve_composed.py
+"""
+
+import json
+import urllib.request
+
+
+def main():
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=8)  # four deployment replicas + controller + proxy
+    serve.start()
+
+    @serve.deployment
+    class Doubler:
+        def __call__(self, x):
+            return x * 2
+
+    @serve.deployment
+    class Squarer:
+        def __call__(self, x):
+            return x * x
+
+    @serve.deployment
+    class Gateway:
+        """Graph node: fans a request out to bound sub-deployments."""
+
+        def __init__(self, doubler, squarer):
+            self.doubler = doubler
+            self.squarer = squarer
+
+        def __call__(self, request):
+            v = request.json()["v"]
+            # Issue both calls BEFORE getting either: the children run
+            # concurrently, so request latency is the max, not the sum.
+            d_ref = self.doubler.remote(v)
+            s_ref = self.squarer.remote(v)
+            return {"double": ray_tpu.get(d_ref), "square": ray_tpu.get(s_ref)}
+
+    serve.run(Gateway.bind(Doubler.bind(), Squarer.bind()), route_prefix="/math")
+
+    async def echo_asgi(scope, receive, send):
+        if scope["type"] != "http":
+            return
+        await receive()
+        body = json.dumps({"path": scope["path"], "mount": scope["root_path"]}).encode()
+        await send({"type": "http.response.start", "status": 200,
+                    "headers": [(b"content-type", b"application/json")]})
+        await send({"type": "http.response.body", "body": body, "more_body": False})
+
+    @serve.deployment
+    @serve.ingress(echo_asgi)
+    class Echo:
+        pass
+
+    serve.run(Echo.bind(), route_prefix="/echo", name="echo")
+
+    host, port = serve.http_address()
+
+    def post(path, payload):
+        req = urllib.request.Request(
+            f"http://{host}:{port}{path}", data=json.dumps(payload).encode()
+        )
+        return json.loads(urllib.request.urlopen(req, timeout=30).read())
+
+    print("math:", post("/math", {"v": 7}))
+    print("echo:", json.loads(urllib.request.urlopen(
+        f"http://{host}:{port}/echo/sub", timeout=30).read()))
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -19,6 +19,8 @@ EXAMPLES = [
     ("data_pipeline.py", [], "jax batches ok"),
     ("rllib_ppo.py", ["1"], "iter 0:"),
     ("cross_language_task.py", [], "wordcount:"),
+    ("serve_composed.py", [], "math:"),
+    ("rllib_offline.py", [], "expert agreement:"),
 ]
 
 
