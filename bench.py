@@ -29,6 +29,14 @@ def train_loop(config):
     from ray_tpu.models.transformer import TransformerConfig, init_params, make_train_step
 
     on_tpu = jax.default_backend() in ("tpu", "axon")
+    # A/B knobs (defaults = the measured-best config; see PERF_NOTES.md):
+    #   BENCH_FUSED=0        unfused LM loss (materialized logits)
+    #   BENCH_UNROLL=N       layer-scan unroll factor
+    #   BENCH_LAG=N          framework-loop metrics lag depth
+    #   BENCH_NO_ASYNC_COPY=1  skip per-step copy_to_host_async
+    #   BENCH_STEPS=N        timed steps
+    fused = os.environ.get("BENCH_FUSED", "1") != "0"
+    unroll = int(os.environ.get("BENCH_UNROLL", "8"))
     if on_tpu:
         cfg = TransformerConfig(
             vocab_size=32000,
@@ -42,9 +50,10 @@ def train_loop(config):
             remat=False,
             # Single chip, no pp: full unroll lets XLA schedule across layer
             # boundaries (+12% measured on v5e — see TransformerConfig).
-            scan_unroll=8,
+            scan_unroll=unroll,
+            fused_loss=fused,
         )
-        batch, seq, steps = 8, 1024, 30
+        batch, seq, steps = 8, 1024, int(os.environ.get("BENCH_STEPS", "30"))
     else:
         cfg = TransformerConfig(
             vocab_size=1024,
@@ -56,8 +65,10 @@ def train_loop(config):
             max_seq_len=128,
             dtype=jnp.float32,
             remat=False,
+            fused_loss=fused,
+            scan_unroll=min(unroll, 2),
         )
-        batch, seq, steps = 4, 128, 10
+        batch, seq, steps = 4, 128, int(os.environ.get("BENCH_STEPS", "10"))
 
     params = init_params(jax.random.PRNGKey(0), cfg)
     n_params = sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
@@ -93,15 +104,17 @@ def train_loop(config):
     # reported, in order.
     import collections
 
-    lag = 4
+    lag = int(os.environ.get("BENCH_LAG", "4"))
+    async_copy = os.environ.get("BENCH_NO_ASYNC_COPY", "0") != "1"
     pending: collections.deque = collections.deque()
     t0 = time.perf_counter()
     for i in range(steps):
         params, opt_state, loss = step(params, opt_state, batch_arr)
-        try:
-            loss.copy_to_host_async()
-        except Exception:
-            pass
+        if async_copy:
+            try:
+                loss.copy_to_host_async()
+            except Exception:
+                pass
         pending.append((i, loss))
         if len(pending) > lag:
             pi, pl = pending.popleft()

@@ -27,31 +27,33 @@ def main():
     n = 2000
     obs = rng.uniform(-1, 1, size=(n, 4)).astype(np.float32)
     actions = (obs[:, 0] > 0).astype(np.int64)
-    log_dir = tempfile.mkdtemp()
-    w = JsonWriter(log_dir)
-    w.write(SampleBatch({
-        "obs": obs, "actions": actions,
-        "rewards": np.ones(n, np.float32), "dones": np.ones(n, bool),
-    }))
-    w.close()
+    with tempfile.TemporaryDirectory() as log_dir:
+        w = JsonWriter(log_dir)
+        w.write(SampleBatch({
+            "obs": obs, "actions": actions,
+            "rewards": np.ones(n, np.float32), "dones": np.ones(n, bool),
+        }))
+        w.close()
 
-    cfg = (
-        MARWILConfig()
-        .environment("CartPole-v1")
-        .rollouts(num_rollout_workers=0)
-        .training(lr=5e-3, train_batch_size=512, beta=1.0)
-        .debugging(seed=0)
-    )
-    cfg.offline_data(input_=log_dir)
-    algo = cfg.build()  # build() constructs AND sets up the algorithm
-    try:
-        for _ in range(40):
-            algo.step()
-        probe = rng.uniform(-1, 1, size=(20, 4)).astype(np.float32)
-        agree = sum(int(algo.compute_single_action(o) == int(o[0] > 0)) for o in probe)
-        print(f"expert agreement: {agree}/20")
-    finally:
-        algo.cleanup()
+        cfg = (
+            MARWILConfig()
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=0)
+            .training(lr=5e-3, train_batch_size=512, beta=1.0)
+            .debugging(seed=0)
+        )
+        cfg.offline_data(input_=log_dir)
+        algo = cfg.build()  # build() constructs AND sets up the algorithm
+        try:
+            for _ in range(40):
+                algo.step()
+            probe = rng.uniform(-1, 1, size=(20, 4)).astype(np.float32)
+            agree = sum(
+                int(algo.compute_single_action(o) == int(o[0] > 0)) for o in probe
+            )
+            print(f"expert agreement: {agree}/20")
+        finally:
+            algo.cleanup()
     ray_tpu.shutdown()
 
 

@@ -1,8 +1,10 @@
-"""Composed serving: model graph + DAGDriver routes + a raw ASGI app.
+"""Composed serving: a deployment graph + multiple routed apps + raw ASGI.
 
-Three Serve idioms in one app: nested bound deployments (preprocess ->
-model), a DAGDriver exposing multiple routes, and serve.ingress mounting an
-ASGI callable.
+Three Serve idioms in one cluster: a Gateway composed of nested bound
+deployments (Gateway.bind(Doubler.bind(), Squarer.bind())) fanning each
+request out concurrently, a second independently-routed app, and
+serve.ingress mounting an ASGI callable. (For a single driver deployment
+dispatching sub-routes over one graph, see ray_tpu.serve.DAGDriver.)
 
 Run: python examples/serve_composed.py
 """
