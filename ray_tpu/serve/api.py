@@ -17,6 +17,7 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import json
+import os
 import pickle
 import time
 
@@ -178,13 +179,28 @@ def run(app: Application, *, name: str = "default", route_prefix: Optional[str] 
     ray_tpu.get(controller.deploy.remote([pickle.dumps(i) for i in infos.values()]))
     router = Router.shared(controller)
     if _blocking:
+        # Worker spawn is ~seconds per replica on an idle box but degrades
+        # under CPU contention; scale the readiness budget with the app's
+        # STARTUP replica count — autoscaled deployments start at
+        # min_replicas, not num_replicas — and apply it to BOTH waits
+        # below (overridable: RAY_TPU_SERVE_READY_TIMEOUT_S).
+        def _startup_replicas(info) -> int:
+            auto = getattr(info.config, "autoscaling", None)
+            if auto is not None:
+                return max(int(getattr(auto, "min_replicas", 1) or 1), 1)
+            return max(int(getattr(info.config, "num_replicas", 1) or 1), 1)
+
+        total_replicas = sum(_startup_replicas(i) for i in infos.values())
+        timeout_s = float(
+            os.environ.get("RAY_TPU_SERVE_READY_TIMEOUT_S", 60 + 30 * total_replicas)
+        )
         for dep_name, info in infos.items():
-            if not router.wait_for_deployment(dep_name, timeout_s=60):
+            if not router.wait_for_deployment(dep_name, timeout_s=timeout_s):
                 raise TimeoutError(f"deployment {dep_name} did not become ready")
             # Block until the full target replica count for this version is
             # RUNNING and stale-version replicas are retired (reference:
             # serve.run waits for the application to reach RUNNING state).
-            deadline = time.time() + 60
+            deadline = time.time() + timeout_s
             while time.time() < deadline:
                 st = ray_tpu.get(controller.get_deployments.remote()).get(dep_name)
                 if (

@@ -38,7 +38,7 @@ def test_example_runs(script, args, expect):
             [sys.executable, os.path.join(REPO, "examples", script), *args],
             capture_output=True,
             text=True,
-            timeout=420,
+            timeout=560,
             env=env,
             cwd=REPO,
         )
