@@ -191,9 +191,10 @@ def run(app: Application, *, name: str = "default", route_prefix: Optional[str] 
             return max(int(getattr(info.config, "num_replicas", 1) or 1), 1)
 
         total_replicas = sum(_startup_replicas(i) for i in infos.values())
-        timeout_s = float(
-            os.environ.get("RAY_TPU_SERVE_READY_TIMEOUT_S", 60 + 30 * total_replicas)
-        )
+        try:
+            timeout_s = float(os.environ["RAY_TPU_SERVE_READY_TIMEOUT_S"])
+        except (KeyError, ValueError):  # unset, "" or malformed -> computed default
+            timeout_s = 60.0 + 30.0 * total_replicas
         for dep_name, info in infos.items():
             if not router.wait_for_deployment(dep_name, timeout_s=timeout_s):
                 raise TimeoutError(f"deployment {dep_name} did not become ready")
