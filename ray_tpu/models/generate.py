@@ -19,7 +19,9 @@ wrap HF pipelines; SURVEY.md §5.7) — this is the TPU-native equivalent:
 
 Layer math intentionally mirrors transformer._attention_block/_mlp_block on
 the same param pytree — decode diverges (cache writes, single-row masking)
-enough that sharing one function would tangle the training hot path.
+enough that sharing one function would tangle the training hot path. MoE
+configs decode through the same parallel/moe.moe_layer dispatch the
+training block uses (T=1: each row's token rides its top-1 expert's slot).
 """
 
 from __future__ import annotations
@@ -60,6 +62,18 @@ def _project_qkv(lp, x, positions, cfg):
 
 def _mlp(lp, x, cfg):
     h = _rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    if cfg.num_experts > 0:
+        from ray_tpu.models.transformer import _moe_mlp
+
+        # LOSSLESS dispatch at inference: capacity_factor=E gives every
+        # token a slot (capacity == T), so routing is per-token and
+        # independent of batch padding — ragged rows behave exactly like
+        # solo rows, and prefill agrees with T=1 decode. Training's
+        # capacity drops (expert_capacity_factor) are an efficiency
+        # approximation that inference deliberately does not replicate.
+        # Aux loss is meaningless at inference and discarded.
+        out, _aux = _moe_mlp(lp, h, float(cfg.num_experts))
+        return x + out
     gate = jax.nn.silu(h @ lp["wg"].astype(h.dtype))
     up = h @ lp["wi"].astype(h.dtype)
     return x + (gate * up) @ lp["wo_mlp"].astype(h.dtype)
@@ -205,12 +219,6 @@ def generate(
     ``prompt_lens`` [B] batches RAGGED prompts (rows padded at the end to
     T): row b continues from its real prompt tokens[b, :prompt_lens[b]].
     """
-    if cfg.num_experts > 0:
-        raise NotImplementedError(
-            "KV-cache decode supports dense MLP configs; MoE decode needs "
-            "expert dispatch in the step function (train-side MoE lives in "
-            "parallel/moe.py)."
-        )
     if key is None:
         key = jax.random.PRNGKey(0)
     B, T = prompt.shape

@@ -206,16 +206,27 @@ def _attention_block(lp, x, positions, cfg: TransformerConfig, mesh, attn_impl: 
     return x + o @ lp["wo"].astype(o.dtype)
 
 
+def _moe_mlp(lp, h, capacity_factor: float):
+    """The one MoE dispatch call both the training block and KV-cache decode
+    share (they differ only in capacity: training drops over-capacity
+    tokens as an efficiency trade, inference runs lossless)."""
+    from ray_tpu.parallel.moe import moe_layer
+
+    return moe_layer(
+        {
+            "gate": lp["gate"].astype(h.dtype),
+            "wi": lp["wi_e"].astype(h.dtype),
+            "wo": lp["wo_e"].astype(h.dtype),
+        },
+        h,
+        capacity_factor=capacity_factor,
+    )
+
+
 def _mlp_block(lp, x, cfg: TransformerConfig):
     h = _rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
     if cfg.num_experts > 0:
-        from ray_tpu.parallel.moe import moe_layer
-
-        out, aux = moe_layer(
-            {"gate": lp["gate"].astype(h.dtype), "wi": lp["wi_e"].astype(h.dtype), "wo": lp["wo_e"].astype(h.dtype)},
-            h,
-            capacity_factor=cfg.expert_capacity_factor,
-        )
+        out, aux = _moe_mlp(lp, h, cfg.expert_capacity_factor)
         # SwiGLU-ish gate path folded into experts (wg_e unused in moe path
         # to keep dispatch einsums lean; kept in params for parity).
         return x + out, aux

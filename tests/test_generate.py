@@ -190,10 +190,40 @@ def test_generate_with_tp_sharded_params():
     assert out.shape == (2, 5) and (out < cfg.vocab_size).all()
 
 
-def test_moe_decode_rejected():
-    cfg = _cfg(num_experts=4)
-    params_cfg = _cfg()  # params shape irrelevant; trace fails first
-    params = init_params(jax.random.PRNGKey(0), params_cfg)
-    prompt = jnp.zeros((1, 4), jnp.int32)
-    with pytest.raises(NotImplementedError, match="MoE"):
-        generate(params, prompt, cfg, max_new_tokens=2)
+def test_moe_greedy_generate_matches_lossless_forward():
+    """MoE inference is LOSSLESS by design (every token gets an expert
+    slot), deliberately not replicating training's capacity drops — so
+    generate under the DEFAULT capacity factor must match a forward whose
+    capacity is raised to never drop."""
+    import dataclasses
+
+    cfg = _cfg(num_experts=4)  # default expert_capacity_factor (1.25)
+    lossless = dataclasses.replace(cfg, expert_capacity_factor=float(cfg.num_experts))
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0, cfg.vocab_size)
+    want = _greedy_reference(params, prompt, lossless, n_new=6)
+    got = np.asarray(generate(params, prompt, cfg, max_new_tokens=6, temperature=0.0))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_moe_ragged_prompts_match_solo():
+    """Padding must stay invisible under MoE too: lossless dispatch makes
+    routing per-token, so capacity never couples rows or padding."""
+    cfg = _cfg(num_experts=4, n_kv_heads=2)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    rows = [
+        jax.random.randint(jax.random.PRNGKey(i), (n,), 0, cfg.vocab_size)
+        for i, n in enumerate((2, 6))
+    ]
+    T = max(len(r) for r in rows)
+    padded = jnp.stack([jnp.pad(r, (0, T - len(r)), constant_values=3) for r in rows])
+    lens = jnp.asarray([len(r) for r in rows], jnp.int32)
+    got = np.asarray(
+        generate(params, padded, cfg, max_new_tokens=5, temperature=0.0,
+                 prompt_lens=lens)
+    )
+    for i, r in enumerate(rows):
+        solo = np.asarray(
+            generate(params, r[None], cfg, max_new_tokens=5, temperature=0.0)
+        )[0]
+        np.testing.assert_array_equal(got[i], solo, err_msg=f"row {i}")
