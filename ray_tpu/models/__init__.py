@@ -16,8 +16,11 @@ from ray_tpu.models.transformer import (  # noqa: F401
     param_logical_axes,
 )
 from ray_tpu.models.generate import (  # noqa: F401
+    decode_chunk,
     decode_step,
     generate,
     init_cache,
     prefill,
+    prefill_chunked,
+    speculative_generate,
 )

@@ -150,45 +150,94 @@ def prefill(params, tokens, cache, cfg: TransformerConfig, prompt_lens=None):
     return logits, {"k": ks, "v": vs}, prompt_lens
 
 
-def decode_step(params, token, cache, pos, cfg: TransformerConfig):
-    """One token per row: token [B] int32 written at per-row position
-    ``pos`` ([B] int32, or a scalar for aligned batches).
-
-    Returns (logits [B, V] f32, updated cache)."""
-    B = token.shape[0]
+def _decode_chunk_hidden(params, tokens, cache, pos, cfg: TransformerConfig):
+    """decode_chunk without the head projection: returns the final normed
+    hidden states [B, q, D] + cache. Callers that need logits for only a
+    subset of rows (chunked prefill needs just the final one) project
+    themselves instead of paying [B, q, V]."""
+    B, q = tokens.shape
     pos = jnp.asarray(pos, jnp.int32)
-    # Aligned batches (scalar pos) keep the single fused dynamic_update_slice
-    # cache write; only genuinely ragged batches pay the per-row scatter.
     aligned = pos.ndim == 0
     pos_b = jnp.broadcast_to(pos, (B,))
-    x = params["embed"].astype(cfg.dtype)[token][:, None, :]  # [B, 1, D]
-    positions = pos_b[:, None]
+    x = params["embed"].astype(cfg.dtype)[tokens]  # [B, q, D]
+    offs = jnp.arange(q, dtype=jnp.int32)
+    positions = pos_b[:, None] + offs[None, :]  # [B, q]
     S = cache["k"].shape[2]
 
-    def write_row(slot, kv, p):
-        # slot [S, KV, Dh], kv [1, KV, Dh] at row position p
+    def write_rows(slot, kv, p):
+        # slot [S, KV, Dh], kv [q, KV, Dh] at row position p
         return lax.dynamic_update_slice(slot, kv, (p, 0, 0))
 
     def body(x, layer):
         lp, ck_slot, cv_slot = layer
-        q, k, v = _project_qkv(lp, x, positions, cfg)
+        qh, k, v = _project_qkv(lp, x, positions, cfg)
         if aligned:
             ck = lax.dynamic_update_slice(ck_slot, k, (0, pos, 0, 0))
             cv = lax.dynamic_update_slice(cv_slot, v, (0, pos, 0, 0))
         else:
-            ck = jax.vmap(write_row)(ck_slot, k, pos_b)
-            cv = jax.vmap(write_row)(cv_slot, v, pos_b)
+            ck = jax.vmap(write_rows)(ck_slot, k, pos_b)
+            cv = jax.vmap(write_rows)(cv_slot, v, pos_b)
         k_pos = jnp.arange(S, dtype=jnp.int32)
-        mask = k_pos[None, None, :] <= pos_b[:, None, None]
-        o = _cache_attention(q, ck, cv, mask, cfg)
-        x = x + o.reshape(B, 1, -1) @ lp["wo"].astype(o.dtype)
+        # Causal against the cache: row j of the chunk sees positions
+        # <= pos[b] + j (its own and everything before it).
+        mask = k_pos[None, None, :] <= positions[:, :, None]
+        o = _cache_attention(qh, ck, cv, mask, cfg)
+        x = x + o.reshape(B, q, -1) @ lp["wo"].astype(o.dtype)
         x = _mlp(lp, x, cfg)
         return x, (ck, cv)
 
     x, (ks, vs) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
-    x = _rms_norm(x, params["norm_f"], cfg.norm_eps)
-    logits = (x[:, 0] @ _head(params).astype(x.dtype)).astype(jnp.float32)
-    return logits, {"k": ks, "v": vs}
+    return _rms_norm(x, params["norm_f"], cfg.norm_eps), {"k": ks, "v": vs}
+
+
+def decode_chunk(params, tokens, cache, pos, cfg: TransformerConfig):
+    """q tokens per row against the cache: tokens [B, q] int32 written at
+    per-row positions pos[b]..pos[b]+q-1 (pos [B] int32 or scalar).
+
+    Returns (logits [B, q, V] f32 — one next-token distribution per fed
+    token — and the updated cache). The position mask makes any stale cache
+    rows beyond pos invisible, so callers may freely re-write positions
+    (speculative decoding rejects; chunked prefill) without a cache rewind.
+    """
+    x, cache = _decode_chunk_hidden(params, tokens, cache, pos, cfg)
+    logits = (x @ _head(params).astype(x.dtype)).astype(jnp.float32)
+    return logits, cache
+
+
+def prefill_chunked(params, tokens, cache, cfg: TransformerConfig, chunk: int = 512):
+    """Prefill long prompts in fixed-size chunks: peak attention-score
+    memory is [B, H, chunk, S] instead of [B, H, T, T] — the bounded-memory
+    path for long-context serving. Aligned (non-ragged) prompts only.
+
+    Returns (logits_last [B, V], cache, next_pos [B]) like prefill().
+    """
+    B, T = tokens.shape
+    if T % chunk:
+        # Clean tiling keeps one compiled chunk shape; callers pad prompts
+        # to a chunk multiple (the serving idiom) or use prefill().
+        raise ValueError(f"prompt length {T} not divisible by chunk {chunk}")
+    n = T // chunk
+    tok_chunks = tokens.reshape(B, n, chunk).transpose(1, 0, 2)  # [n, B, chunk]
+
+    def body(carry, tok):
+        cache, pos = carry
+        # Hidden states only: projecting every chunk row to [chunk, V]
+        # logits would waste head FLOPs on a path whose point is bounding
+        # memory — only the final row's logits are needed.
+        x, cache = _decode_chunk_hidden(params, tok, cache, pos, cfg)
+        return (cache, pos + chunk), x[:, -1]
+
+    (cache, pos), last = lax.scan(body, (cache, jnp.int32(0)), tok_chunks)
+    logits = (last[-1] @ _head(params).astype(last.dtype)).astype(jnp.float32)
+    return logits, cache, jnp.full((B,), T, jnp.int32)
+
+
+def decode_step(params, token, cache, pos, cfg: TransformerConfig):
+    """One token per row: token [B] int32 written at per-row position
+    ``pos`` ([B] int32, or a scalar for aligned batches). The q=1 case of
+    decode_chunk. Returns (logits [B, V] f32, updated cache)."""
+    logits, cache = decode_chunk(params, token[:, None], cache, pos, cfg)
+    return logits[:, 0], cache
 
 
 def _sample(logits, key, temperature: float, top_k: int):
@@ -238,3 +287,108 @@ def generate(
     keys = jax.random.split(key, max_new_tokens)
     _, toks = lax.scan(step, (logits, cache, pos), keys)
     return toks.T  # [B, max_new_tokens]
+
+
+@partial(
+    jax.jit, static_argnames=("cfg", "draft_cfg", "max_new_tokens", "k")
+)
+def speculative_generate(
+    params,
+    draft_params,
+    prompt,
+    cfg: TransformerConfig,
+    draft_cfg: TransformerConfig,
+    max_new_tokens: int = 32,
+    k: int = 4,
+):
+    """Greedy speculative decoding: a small draft model proposes ``k``
+    tokens per round from its own cache; the target verifies all of them in
+    ONE ``decode_chunk`` and commits the accepted prefix plus its own next
+    token (1..k+1 tokens per target pass).
+
+    Output is EXACTLY ``generate(params, prompt, cfg, temperature=0.0)`` —
+    the draft changes only how many target forward passes are spent, never
+    the result (greedy acceptance: a draft token is accepted iff it equals
+    the target argmax at that position). Both models must share the vocab.
+    No cache rewind on rejection: stale rows past the committed position
+    are invisible to the position mask and simply overwritten next round.
+
+    Returns (tokens [B, max_new_tokens] int32, rounds int32 — target
+    passes spent; rounds << max_new_tokens when the draft agrees often).
+    """
+    B, T = prompt.shape
+    S = T + max_new_tokens + k + 1
+    t_cache = init_cache(cfg, B, S)
+    d_cache = init_cache(draft_cfg, B, S)
+    t_logits, t_cache, pos = prefill(params, prompt, t_cache, cfg)
+    _, d_cache, _ = prefill(draft_params, prompt, d_cache, draft_cfg)
+    # The two caches are position-locked: one pos drives both (they commit
+    # the identical token sequence every round).
+    cur = t_logits.argmax(axis=-1).astype(jnp.int32)  # first emitted token
+
+    out = jnp.zeros((B, max_new_tokens), jnp.int32)
+    out = out.at[:, 0].set(cur)
+    n = jnp.ones((B,), jnp.int32)  # tokens emitted so far
+
+    def draft_propose(d_cache, cur, d_pos):
+        # k+1 steps so the draft cache holds rows for cur AND all k
+        # proposals (including d_k): a fully-accepted round advances by
+        # k+1 rows, and every one of them must be written. The (k+1)-th
+        # prediction is discarded.
+        def body(carry, _):
+            cache, tok, pos = carry
+            logits, cache = decode_step(draft_params, tok, cache, pos, draft_cfg)
+            nxt = logits.argmax(axis=-1).astype(jnp.int32)
+            return (cache, nxt, pos + 1), nxt
+
+        (d_cache, _, d_pos), drafts = lax.scan(
+            body, (d_cache, cur, d_pos), None, length=k + 1
+        )
+        return d_cache, drafts.T[:, :k], d_pos  # proposals [B, k]
+
+    def round_body(state):
+        out, n, cur, pos, t_cache, d_cache, rounds = state
+        d_cache, drafts, _ = draft_propose(d_cache, cur, pos)
+        fed = jnp.concatenate([cur[:, None], drafts], axis=1)  # [B, k+1]
+        logits, t_cache = decode_chunk(params, fed, t_cache, pos, cfg)
+        preds = logits.argmax(axis=-1).astype(jnp.int32)  # [B, k+1]
+        # accepted[b] = longest prefix of drafts matching target argmax.
+        match = drafts == preds[:, :k]  # [B, k]
+        accepted = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+        # Emit d1..d_accepted then the target's own token at the divergence
+        # (or after all k when fully accepted): k+1 candidate slots.
+        bonus = jnp.take_along_axis(preds, accepted[:, None], axis=1)[:, 0]
+        emit = jnp.where(
+            jnp.arange(k + 1)[None, :] < accepted[:, None],
+            jnp.concatenate([drafts, jnp.zeros((B, 1), jnp.int32)], axis=1),
+            0,
+        )
+        emit = emit.at[jnp.arange(B), accepted].set(bonus)  # slot `accepted`
+        n_emit_raw = accepted + 1
+        room = jnp.maximum(max_new_tokens - n, 0)
+        n_emit = jnp.minimum(n_emit_raw, room)
+        # Scatter emit[:, :n_emit] into out at per-row offset n.
+        for i in range(k + 1):  # static k: unrolled masked writes
+            idx = jnp.clip(n + i, 0, max_new_tokens - 1)
+            valid = i < n_emit
+            prev = out[jnp.arange(B), idx]
+            out = out.at[jnp.arange(B), idx].set(
+                jnp.where(valid, emit[:, i], prev)
+            )
+        # Advance: committed rows are cur + accepted drafts. Rows already
+        # at capacity advance nothing (their writes were masked anyway).
+        adv = jnp.where(room > 0, accepted + 1, 0)
+        new_cur = jnp.where(
+            n_emit > 0,
+            jnp.take_along_axis(emit, jnp.maximum(n_emit - 1, 0)[:, None], axis=1)[:, 0],
+            cur,
+        )
+        return (out, n + n_emit, new_cur, pos + adv, t_cache, d_cache, rounds + 1)
+
+    def round_cond(state):
+        _, n, *_rest = state
+        return jnp.any(n < max_new_tokens)
+
+    state = (out, n, cur, pos, t_cache, d_cache, jnp.int32(0))
+    out, n, *_r, rounds = lax.while_loop(round_cond, round_body, state)
+    return out, rounds

@@ -82,6 +82,83 @@ def test_decode_step_extends_prefill():
     )
 
 
+def test_decode_chunk_matches_forward():
+    """Multi-token cached decode: feeding q tokens at once produces the
+    same per-position logits as the cache-free forward."""
+    from ray_tpu.models.generate import decode_chunk
+
+    cfg = _cfg(n_kv_heads=2)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 4), 0, cfg.vocab_size)
+    extra = jax.random.randint(jax.random.PRNGKey(2), (2, 3), 0, cfg.vocab_size)
+    cache = init_cache(cfg, 2, 16)
+    _, cache, pos = prefill(params, prompt, cache, cfg)
+    chunk_logits, _ = decode_chunk(params, extra, cache, pos, cfg)
+    full, _ = forward(params, jnp.concatenate([prompt, extra], axis=1), cfg)
+    # chunk_logits[j] is the next-token distribution after consuming
+    # extra[j] at absolute position 4+j -> full-forward logits[4+j].
+    np.testing.assert_allclose(
+        np.asarray(chunk_logits), np.asarray(full[:, 4:7]), rtol=2e-4, atol=2e-5
+    )
+
+
+def test_prefill_chunked_matches_prefill():
+    """Chunked prefill (bounded-memory long-prompt path) ends in the same
+    cache state and last-token logits as one-shot prefill."""
+    from ray_tpu.models.generate import prefill_chunked
+
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0, cfg.vocab_size)
+    one_logits, one_cache, one_pos = prefill(
+        params, prompt, init_cache(cfg, 2, 16), cfg
+    )
+    ch_logits, ch_cache, ch_pos = prefill_chunked(
+        params, prompt, init_cache(cfg, 2, 16), cfg, chunk=4
+    )
+    np.testing.assert_allclose(
+        np.asarray(ch_logits), np.asarray(one_logits), rtol=2e-4, atol=2e-5
+    )
+    np.testing.assert_array_equal(np.asarray(ch_pos), np.asarray(one_pos))
+    np.testing.assert_allclose(
+        np.asarray(ch_cache["k"][:, :, :12]),
+        np.asarray(one_cache["k"][:, :, :12]),
+        rtol=2e-4,
+        atol=2e-5,
+    )
+    with pytest.raises(ValueError, match="divisible"):
+        prefill_chunked(params, prompt, init_cache(cfg, 2, 16), cfg, chunk=5)
+
+
+def test_speculative_generate_exact_and_fewer_passes():
+    """Speculative decoding is EXACT for greedy (accept iff draft token ==
+    target argmax) — same tokens as generate() — and when the draft IS the
+    target every proposal is accepted, so target passes collapse to
+    ~max_new/(k+1)."""
+    from ray_tpu.models.generate import speculative_generate
+
+    cfg = _cfg(n_kv_heads=2)
+    draft_cfg = _cfg(n_layers=1, d_ff=64)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    draft_params = init_params(jax.random.PRNGKey(9), draft_cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 6), 0, cfg.vocab_size)
+
+    want = np.asarray(generate(params, prompt, cfg, max_new_tokens=12, temperature=0.0))
+    got, rounds = speculative_generate(
+        params, draft_params, prompt, cfg, draft_cfg, max_new_tokens=12, k=3
+    )
+    np.testing.assert_array_equal(np.asarray(got), want)
+    assert 1 <= int(rounds) <= 12  # never worse than one pass per token
+
+    # Perfect draft (the target itself): every round accepts all k, so
+    # rounds ~= ceil((max_new - 1) / (k + 1)).
+    got2, rounds2 = speculative_generate(
+        params, params, prompt, cfg, cfg, max_new_tokens=12, k=3
+    )
+    np.testing.assert_array_equal(np.asarray(got2), want)
+    assert int(rounds2) <= 4, f"perfect draft should collapse passes, got {int(rounds2)}"
+
+
 def test_sampling_modes():
     cfg = _cfg()
     params = init_params(jax.random.PRNGKey(0), cfg)
