@@ -1,4 +1,17 @@
+from ray_tpu.train.jax.checkpointing import (
+    TrainCheckpointer,
+    restore_sharded,
+    save_sharded,
+)
 from ray_tpu.train.jax.jax_trainer import JaxConfig, JaxTrainer
 from ray_tpu.train.jax.train_loop_utils import prepare_batch, shard_batch
 
-__all__ = ["JaxConfig", "JaxTrainer", "prepare_batch", "shard_batch"]
+__all__ = [
+    "JaxConfig",
+    "JaxTrainer",
+    "TrainCheckpointer",
+    "prepare_batch",
+    "restore_sharded",
+    "save_sharded",
+    "shard_batch",
+]
