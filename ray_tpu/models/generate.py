@@ -138,6 +138,8 @@ def prefill(params, tokens, cache, cfg: TransformerConfig, prompt_lens=None):
             (k_pos[None, None, :] <= positions[:, :, None])
             & (k_pos[None, None, :] < prompt_lens[:, None, None])
         )
+        if cfg.sliding_window:
+            mask &= positions[:, :, None] - k_pos[None, None, :] < cfg.sliding_window
         o = _cache_attention(q, ck[:, :T], cv[:, :T], mask, cfg)
         x = x + o.reshape(B, T, -1) @ lp["wo"].astype(o.dtype)
         x = _mlp(lp, x, cfg)
@@ -181,6 +183,8 @@ def _decode_chunk_hidden(params, tokens, cache, pos, cfg: TransformerConfig):
         # Causal against the cache: row j of the chunk sees positions
         # <= pos[b] + j (its own and everything before it).
         mask = k_pos[None, None, :] <= positions[:, :, None]
+        if cfg.sliding_window:
+            mask &= positions[:, :, None] - k_pos[None, None, :] < cfg.sliding_window
         o = _cache_attention(qh, ck, cv, mask, cfg)
         x = x + o.reshape(B, q, -1) @ lp["wo"].astype(o.dtype)
         x = _mlp(lp, x, cfg)

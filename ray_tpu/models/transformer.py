@@ -58,6 +58,11 @@ class TransformerConfig:
     # with the next's prologue: +12% train throughput on the single-chip
     # v5e bench (79.3k -> 88.7k tok/s). Unroll only without pp sharding.
     scan_unroll: int = 1
+    # Mistral-style sliding-window causal attention (0 = full causal):
+    # row i attends keys (i-sliding_window, i]. Rides the flash kernel's
+    # k-block pruning in training and the decode position mask at
+    # inference; not combinable with ring/Ulysses sequence parallelism.
+    sliding_window: int = 0
     # Fuse the LM-head projection into a chunked cross-entropy
     # (ops/losses.fused_lm_loss) so the [B*T, V] f32 logits tensor never
     # hits HBM — loss_fn only; forward() still returns full logits for
@@ -193,15 +198,19 @@ def _attention_block(lp, x, positions, cfg: TransformerConfig, mesh, attn_impl: 
     from ray_tpu.ops.attention import flash_attention
 
     if attn_impl == "ring" and mesh is not None and mesh.shape.get("sp", 1) > 1:
+        if cfg.sliding_window:
+            raise NotImplementedError("sliding_window + ring attention not supported")
         from ray_tpu.parallel.ring_attention import ring_attention
 
         o = ring_attention(q, k, v, mesh, causal=True)
     elif attn_impl == "ulysses" and mesh is not None and mesh.shape.get("sp", 1) > 1:
+        if cfg.sliding_window:
+            raise NotImplementedError("sliding_window + Ulysses attention not supported")
         from ray_tpu.parallel.ulysses import ulysses_attention
 
         o = ulysses_attention(q, k, v, mesh, causal=True)
     else:
-        o = flash_attention(q, k, v, causal=True)
+        o = flash_attention(q, k, v, causal=True, window=cfg.sliding_window)
     o = o.reshape(B, T, H * Dh)
     return x + o @ lp["wo"].astype(o.dtype)
 

@@ -26,9 +26,10 @@ import jax
 import jax.numpy as jnp
 
 
-def _xla_attention(q, k, v, causal: bool, sm_scale: float, bias=None):
+def _xla_attention(q, k, v, causal: bool, sm_scale: float, bias=None, window: int = 0):
     """Reference implementation (XLA fuses this fine on CPU; used for
-    correctness tests and non-TPU fallback)."""
+    correctness tests and non-TPU fallback). ``window`` > 0: sliding-window
+    causal attention — row i sees keys (i-window, i]."""
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * sm_scale
@@ -36,6 +37,10 @@ def _xla_attention(q, k, v, causal: bool, sm_scale: float, bias=None):
         logits = logits + bias
     if causal:
         mask = jnp.tril(jnp.ones((Tq, Tk), dtype=bool), k=Tk - Tq)
+        if window > 0:
+            q_pos = (Tk - Tq) + jnp.arange(Tq)[:, None]
+            k_pos = jnp.arange(Tk)[None, :]
+            mask = mask & (q_pos - k_pos < window)
         logits = jnp.where(mask[None, None], logits, -jnp.inf)
     probs = jax.nn.softmax(logits, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
@@ -49,7 +54,7 @@ def _xla_attention(q, k, v, causal: bool, sm_scale: float, bias=None):
 _LSE_LANES = 128
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_k: int, causal: bool, sm_scale: float, seq_k: int, block_q: int):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_k: int, causal: bool, sm_scale: float, seq_k: int, block_q: int, window: int = 0):
     from jax.experimental import pallas as pl
 
     q = q_ref[...]  # [block_q, d]
@@ -66,11 +71,18 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_k: int, cau
     # usual mask when Tq == Tk; for Tq < Tk (decode with cache) the tail of
     # the keys is what's visible.
     causal_offset = seq_k - block_q * pl.num_programs(1)
+    start_block = 0
     if causal:
         # K blocks strictly after this Q block's last visible key are masked.
         last_q_row = (q_idx + 1) * block_q - 1 + causal_offset
         num_k_blocks = jnp.minimum(num_k_blocks, (last_q_row // block_k) + 1)
         num_k_blocks = jnp.maximum(num_k_blocks, 0)
+        if window > 0:
+            # Sliding window: K blocks entirely before the FIRST q row's
+            # window are skipped — the FLOPs saving that makes long-context
+            # windowed attention O(T*W) instead of O(T^2).
+            first_q_row = q_idx * block_q + causal_offset
+            start_block = jnp.maximum(0, (first_q_row - window + 1) // block_k)
 
     def body(kb, carry):
         m_prev, l_prev, acc_prev = carry
@@ -82,10 +94,19 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_k: int, cau
         if causal:
             q_pos = q_idx * block_q + causal_offset + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+            visible = q_pos >= k_pos
+            if window > 0:
+                visible &= q_pos - k_pos < window
+            s = jnp.where(visible, s, -jnp.inf)
         m_cur = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
-        correction = jnp.exp(m_prev - m_cur)
-        p = jnp.exp(s - m_cur)
+        # Fully-masked-so-far rows (possible under a sliding window: early
+        # k-blocks can be entirely outside a late row's window) have
+        # m_cur = -inf; exp(-inf - -inf) would be NaN. Substituting 0 for
+        # the max keeps correction = p = exp(-inf) = 0 — the correct
+        # "contributes nothing" behavior.
+        safe_m = jnp.where(jnp.isneginf(m_cur), 0.0, m_cur)
+        correction = jnp.exp(m_prev - safe_m)
+        p = jnp.exp(s - safe_m)
         l_cur = l_prev * correction + p.sum(axis=-1, keepdims=True)
         pv = jax.lax.dot_general(
             p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
@@ -94,7 +115,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_k: int, cau
         acc_cur = acc_prev * correction + pv
         return m_cur, l_cur, acc_cur
 
-    m, l, acc = jax.lax.fori_loop(0, num_k_blocks, body, (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(start_block, num_k_blocks, body, (m0, l0, acc0))
     o_ref[...] = (acc / l).astype(o_ref.dtype)
     if lse_ref is not None:
         # Log-sum-exp per row: the residual the backward pass needs to
@@ -105,7 +126,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_k: int, cau
         lse_ref[...] = jnp.broadcast_to(m + jnp.log(l), lse_ref.shape).astype(lse_ref.dtype)
 
 
-def _pallas_flash_with_lse(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: int, interpret: bool, save_lse: bool = True):
+def _pallas_flash_with_lse(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: int, interpret: bool, save_lse: bool = True, window: int = 0):
     from jax.experimental import pallas as pl
 
     B, Tq, H, D = q.shape
@@ -123,6 +144,7 @@ def _pallas_flash_with_lse(q, k, v, causal: bool, sm_scale: float, block_q: int,
         sm_scale=sm_scale,
         seq_k=Tk,
         block_q=block_q,
+        window=window,
     )
     out_specs = [pl.BlockSpec((None, block_q, D), lambda bh, qb: (bh, qb, 0))]
     out_shape = [jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype)]
@@ -146,18 +168,18 @@ def _pallas_flash_with_lse(q, k, v, causal: bool, sm_scale: float, block_q: int,
     return out, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _pallas_flash(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: int, interpret: bool):
-    out, _ = _pallas_flash_with_lse(q, k, v, causal, sm_scale, block_q, block_k, interpret, save_lse=False)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _pallas_flash(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: int, interpret: bool, window: int = 0):
+    out, _ = _pallas_flash_with_lse(q, k, v, causal, sm_scale, block_q, block_k, interpret, save_lse=False, window=window)
     return out
 
 
-def _pallas_flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    out, lse = _pallas_flash_with_lse(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+def _pallas_flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, window=0):
+    out, lse = _pallas_flash_with_lse(q, k, v, causal, sm_scale, block_q, block_k, interpret, window=window)
     return out, (q, k, v, out, lse)
 
 
-def _pallas_flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, dout):
+def _pallas_flash_bwd(causal, sm_scale, block_q, block_k, interpret, window, res, dout):
     """Memory-efficient flash backward, expressed in XLA (lax.fori_loop over
     K blocks — the compiler tiles the matmuls onto the MXU; peak memory is
     one [B,H,Tq,block_k] logits block instead of the full [Tq,Tk] matrix).
@@ -188,25 +210,45 @@ def _pallas_flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, dout):
 
     bk = min(block_k, Tk)
     num_kb = (Tk + bk - 1) // bk
+    # Sliding window: only q rows with k_pos <= q_pos < k_pos + window can
+    # attend a given k block, so the q range touching block [start,
+    # start+bk) spans at most bk + window - 1 rows. Slicing q to that
+    # (static) width keeps the backward O(T·window) like the forward
+    # kernel, instead of scoring all Tq rows per block.
+    qw = min(Tq, bk + window - 1) if (causal and window > 0) else Tq
     # Same bottom-right causal alignment as forward kernel/_xla_attention.
-    q_pos = (Tk - Tq) + jax.lax.broadcasted_iota(jnp.int32, (Tq, bk), 0)
+    q_row = jax.lax.broadcasted_iota(jnp.int32, (qw, bk), 0)
 
     def body(kb, carry):
         dq_acc, dk_acc, dv_acc = carry
         start = kb * bk
+        qs_start = (
+            jnp.clip(start - (Tk - Tq), 0, Tq - qw) if qw < Tq else jnp.int32(0)
+        )
         ks = jax.lax.dynamic_slice_in_dim(kT, start, bk, axis=2)   # [B,H,bk,D]
         vs = jax.lax.dynamic_slice_in_dim(vT, start, bk, axis=2)
-        s = mm(qT, ks, "bhqd,bhkd->bhqk") * sm_scale
+        qs = jax.lax.dynamic_slice_in_dim(qT, qs_start, qw, axis=2)
+        dos = jax.lax.dynamic_slice_in_dim(doT, qs_start, qw, axis=2)
+        lses = jax.lax.dynamic_slice_in_dim(lse, qs_start, qw, axis=2)
+        deltas = jax.lax.dynamic_slice_in_dim(delta, qs_start, qw, axis=2)
+        s = mm(qs, ks, "bhqd,bhkd->bhqk") * sm_scale
         if causal:
-            k_pos = start + jax.lax.broadcasted_iota(jnp.int32, (Tq, bk), 1)
-            s = jnp.where((q_pos >= k_pos)[None, None], s, -jnp.inf)
-        p = jnp.exp(s - lse[..., None])                 # f32; masked rows -> 0
-        dp = mm(doT, vs, "bhqd,bhkd->bhqk")
-        ds = (p * (dp - delta[..., None]) * sm_scale).astype(qT.dtype)
+            q_pos = (Tk - Tq) + qs_start + q_row
+            k_pos = start + jax.lax.broadcasted_iota(jnp.int32, (qw, bk), 1)
+            visible = q_pos >= k_pos
+            if window > 0:
+                visible &= q_pos - k_pos < window
+            s = jnp.where(visible[None, None], s, -jnp.inf)
+        p = jnp.exp(s - lses[..., None])                # f32; masked rows -> 0
+        dp = mm(dos, vs, "bhqd,bhkd->bhqk")
+        ds = (p * (dp - deltas[..., None]) * sm_scale).astype(qT.dtype)
         pb = p.astype(qT.dtype)
-        dq_acc = dq_acc + mm(ds, ks, "bhqk,bhkd->bhqd")
-        dk_b = mm(ds, qT, "bhqk,bhqd->bhkd")
-        dv_b = mm(pb, doT, "bhqk,bhqd->bhkd")
+        dq_slice = jax.lax.dynamic_slice_in_dim(dq_acc, qs_start, qw, axis=2)
+        dq_acc = jax.lax.dynamic_update_slice_in_dim(
+            dq_acc, dq_slice + mm(ds, ks, "bhqk,bhkd->bhqd"), qs_start, axis=2
+        )
+        dk_b = mm(ds, qs, "bhqk,bhqd->bhkd")
+        dv_b = mm(pb, dos, "bhqk,bhqd->bhkd")
         dk_acc = jax.lax.dynamic_update_slice_in_dim(dk_acc, dk_b, start, axis=2)
         dv_acc = jax.lax.dynamic_update_slice_in_dim(dv_acc, dv_b, start, axis=2)
         return dq_acc, dk_acc, dv_acc
@@ -250,12 +292,18 @@ def flash_attention(
     bias=None,
     force_pallas: bool | None = None,
     interpret: bool = False,
+    window: int = 0,
 ):
     """Multi-head attention, [B, T, H, D] layout.
 
     Pallas on TPU; XLA reference elsewhere (or with a bias, which the kernel
-    does not support yet).
+    does not support yet). ``window`` > 0 (requires causal) is Mistral-style
+    sliding-window attention: row i attends keys (i-window, i]; the kernel
+    SKIPS k-blocks entirely outside the window, so long-context cost is
+    O(T·window), not O(T²).
     """
+    if window and not causal:
+        raise ValueError("sliding window requires causal=True")
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     use_pallas = force_pallas if force_pallas is not None else (_on_tpu() or interpret)
@@ -265,8 +313,8 @@ def flash_attention(
     # Block sizes must tile the sequence exactly: a clamped tail slice would
     # read overlapping rows (and the backward would double-count them).
     if bias is not None or not use_pallas or Tq % bq or Tk % bk:
-        return _xla_attention(q, k, v, causal, sm_scale, bias)
-    return _pallas_flash(q, k, v, causal, sm_scale, bq, bk, interpret)
+        return _xla_attention(q, k, v, causal, sm_scale, bias, window=window)
+    return _pallas_flash(q, k, v, causal, sm_scale, bq, bk, interpret, window)
 
 
 def _fit_block(want: int, t: int) -> int:

@@ -42,16 +42,27 @@ def _proc0() -> bool:
 
 
 def _barrier(tag: str) -> None:
-    """Multi-process sync point; no-op single-process."""
+    """Multi-process sync point; no-op single-process. A FAILED barrier in
+    a real multi-host world propagates — proceeding unsynchronized would
+    let hosts race the filesystem mutations the barrier fences."""
     import jax
 
     try:
-        if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
-
-            multihost_utils.sync_global_devices(f"ray_tpu_ckpt_{tag}")
+        multi = jax.process_count() > 1
     except Exception:
-        pass
+        return  # distributed runtime not initialized: single-process
+    if multi:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"ray_tpu_ckpt_{tag}")
+
+
+def _recover_interrupted_swap(path: str) -> None:
+    """A crash between save_sharded's two renames leaves the data at
+    ``path + ".old"`` with nothing at ``path`` — finish the swap."""
+    old = path + ".old"
+    if not os.path.exists(path) and os.path.exists(old) and _proc0():
+        os.rename(old, path)
 
 
 def save_sharded(path: str, tree: Any) -> str:
@@ -65,8 +76,10 @@ def save_sharded(path: str, tree: Any) -> str:
     """
     path = os.path.abspath(path)
     tmp = path + ".saving"
-    if _proc0() and os.path.exists(tmp):
-        shutil.rmtree(tmp)
+    if _proc0():
+        _recover_interrupted_swap(path)
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
     _barrier("pre_save")
     _ckptr().save(tmp, tree)  # collective across processes; blocks to finalize
     _barrier("post_save")
@@ -93,6 +106,7 @@ def restore_sharded(path: str, like: Any = None) -> Any:
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
+    _recover_interrupted_swap(path)
     if like is None:
         return _ckptr().restore(path)
 
@@ -111,8 +125,11 @@ class TrainCheckpointer:
     """Step-numbered sharded checkpoints with top-K retention.
 
     save(step, tree) -> <dir>/step_<N>; latest_step()/restore(step, like=)
-    pick them back up. Retention deletes the OLDEST dirs beyond
-    ``keep`` (the reference CheckpointManager's num_to_keep semantics).
+    pick them back up. Retention and "latest" rank by SAVE RECENCY
+    (directory mtime), not step number — after a rollback, save(10) with a
+    stale step_12 on disk must neither delete itself nor resume from the
+    abandoned future step (the reference CheckpointManager's num_to_keep
+    semantics are save-order too).
     """
 
     _STEP_RE = re.compile(r"^step_(\d+)$")
@@ -123,12 +140,18 @@ class TrainCheckpointer:
         os.makedirs(self.directory, exist_ok=True)
 
     def _steps(self) -> list[int]:
+        """Steps ordered oldest-save-first (mtime, step as tiebreak)."""
         out = []
         for name in os.listdir(self.directory):
             m = self._STEP_RE.match(name)
             if m:
-                out.append(int(m.group(1)))
-        return sorted(out)
+                full = os.path.join(self.directory, name)
+                try:
+                    mtime = os.path.getmtime(full)
+                except OSError:
+                    continue  # reaped concurrently
+                out.append((mtime, int(m.group(1))))
+        return [step for _, step in sorted(out)]
 
     def _step_dir(self, step: int) -> str:
         return os.path.join(self.directory, f"step_{step}")

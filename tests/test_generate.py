@@ -159,6 +159,22 @@ def test_speculative_generate_exact_and_fewer_passes():
     assert int(rounds2) <= 4, f"perfect draft should collapse passes, got {int(rounds2)}"
 
 
+def test_sliding_window_generate_matches_forward():
+    """Windowed config: cached decode (position-mask window) must agree
+    with the cache-free forward (kernel/XLA-mask window) token for token."""
+    cfg = _cfg(sliding_window=6, n_kv_heads=2)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 9), 0, cfg.vocab_size)
+    want = _greedy_reference(params, prompt, cfg, n_new=8)
+    got = np.asarray(generate(params, prompt, cfg, max_new_tokens=8, temperature=0.0))
+    np.testing.assert_array_equal(got, want)
+    # The window matters: a full-attention config diverges from it.
+    full = np.asarray(
+        generate(params, prompt, _cfg(n_kv_heads=2), max_new_tokens=8, temperature=0.0)
+    )
+    assert not np.array_equal(got, full), "window had no effect"
+
+
 def test_sampling_modes():
     cfg = _cfg()
     params = init_params(jax.random.PRNGKey(0), cfg)

@@ -90,6 +90,34 @@ def test_train_checkpointer_retention(tmp_path):
         TrainCheckpointer(str(tmp_path / "empty")).restore()
 
 
+def test_rollback_save_is_not_self_deleting(tmp_path):
+    """Retention and latest rank by SAVE RECENCY: after a rollback, saving
+    a lower step must not delete itself, and resume must pick the rollback
+    lineage, not a stale higher-numbered future step."""
+    ck = TrainCheckpointer(str(tmp_path / "run"), keep=2)
+    for step in (9, 12):
+        ck.save(step, {"step": jnp.int32(step)})
+    ck.save(10, {"step": jnp.int32(10)})  # rollback to 9, continue from 10
+    assert 10 in ck._steps()  # did not delete itself
+    assert ck.latest_step() == 10  # resume point is the newest SAVE
+    assert int(ck.restore()["step"]) == 10
+    ck.save(11, {"step": jnp.int32(11)})
+    assert sorted(ck._steps()) == [10, 11]  # stale step_12 finally reaped
+
+
+def test_interrupted_swap_recovers_on_read(tmp_path):
+    """A crash between save_sharded's two renames leaves only path+'.old';
+    the next read finishes the swap instead of losing the checkpoint."""
+    import os
+
+    path = str(tmp_path / "ck")
+    save_sharded(path, {"w": jnp.arange(4.0)})
+    os.rename(path, path + ".old")  # simulate dying mid-swap
+    got = restore_sharded(path)
+    np.testing.assert_array_equal(np.asarray(got["w"]), np.arange(4.0))
+    assert os.path.exists(path) and not os.path.exists(path + ".old")
+
+
 def test_overwrite_is_durable_swap(tmp_path):
     """Re-saving the same path keeps data consistent and leaves no tmp
     residue (the old checkpoint is only replaced after the new one is
