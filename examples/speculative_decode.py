@@ -1,0 +1,56 @@
+"""Speculative decoding: a small draft model accelerates the big one.
+
+Greedy speculative decoding is EXACT — identical tokens to vanilla
+generation — while spending fewer target-model passes the more often the
+draft agrees. An UNTRAINED random draft agrees almost never (~31 passes
+for 32 tokens); the ceiling demo below uses the target as its own draft,
+where every proposal is accepted: 32 tokens in ~7 target passes at k=4.
+
+Run: python examples/speculative_decode.py
+"""
+
+
+def main():
+    import numpy as np
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from ray_tpu.models import (
+        TransformerConfig,
+        generate,
+        init_params,
+        speculative_generate,
+    )
+
+    target_cfg = TransformerConfig(
+        vocab_size=512, d_model=128, n_layers=4, n_heads=4, n_kv_heads=2,
+        d_ff=256, dtype=jnp.float32, remat=False,
+    )
+    draft_cfg = TransformerConfig(
+        vocab_size=512, d_model=64, n_layers=1, n_heads=4, n_kv_heads=2,
+        d_ff=128, dtype=jnp.float32, remat=False,
+    )
+    target = init_params(jax.random.PRNGKey(0), target_cfg)
+    draft = init_params(jax.random.PRNGKey(7), draft_cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 16), 0, 512)
+
+    vanilla = np.asarray(generate(target, prompt, target_cfg, max_new_tokens=32))
+    spec, rounds = speculative_generate(
+        target, draft, prompt, target_cfg, draft_cfg, max_new_tokens=32, k=4
+    )
+    assert np.array_equal(np.asarray(spec), vanilla), "speculative must be exact"
+    print(f"untrained draft: {int(rounds)} target passes for 32 tokens (vanilla: 32)")
+
+    # A perfect draft (the target itself) shows the ceiling.
+    _, rounds2 = speculative_generate(
+        target, target, prompt, target_cfg, target_cfg, max_new_tokens=32, k=4
+    )
+    print(f"perfect draft:  {int(rounds2)} target passes for 32 tokens")
+    print("exact-output speculative decoding ok")
+
+
+if __name__ == "__main__":
+    main()

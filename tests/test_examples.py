@@ -21,6 +21,7 @@ EXAMPLES = [
     ("cross_language_task.py", [], "wordcount:"),
     ("serve_composed.py", [], "math:"),
     ("rllib_offline.py", [], "expert agreement:"),
+    ("speculative_decode.py", [], "exact-output speculative decoding ok"),
 ]
 
 
