@@ -205,3 +205,45 @@ def test_flash_attention_cross_length_causal_alignment():
     out = flash_attention(q, k, v, causal=True, sm_scale=0.125,
                           force_pallas=True, interpret=True, block_q=64, block_k=64)
     assert float(jnp.abs(out - ref).max()) < 1e-5
+
+
+def test_pallas_backward_kernels_vs_oracle(monkeypatch):
+    """The Pallas dkv/dq backward kernels (transposed-score orientation,
+    causal/window loop pruning) must match the XLA attention's autodiff
+    exactly — including the Tq != Tk bottom-right alignment and the
+    sliding-window mask, at block sizes that exercise multi-block loops."""
+    monkeypatch.setenv("RAY_TPU_FLASH_BWD_BLOCK", "128")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.ops.attention import _xla_attention, flash_attention
+
+    rng = np.random.default_rng(7)
+    cases = [
+        # (Tq, Tk, causal, window) — 512-length at block 128 gives 4 blocks
+        # per axis, so the causal/window loop pruning runs multi-iteration
+        # spans (qb_start/qb_end interior values), not just 0..1.
+        (512, 512, True, 0),
+        (256, 256, False, 0),
+        (256, 512, True, 0),    # decode-style cross-length alignment
+        (512, 512, True, 192),  # sliding window, multi-block pruning
+    ]
+    for Tq, Tk, causal, window in cases:
+        q = jnp.asarray(rng.standard_normal((2, Tq, 2, 32)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((2, Tk, 2, 32)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((2, Tk, 2, 32)), jnp.float32)
+
+        def loss_p(q, k, v, _c=causal, _w=window):
+            return (flash_attention(q, k, v, causal=_c, sm_scale=0.2, window=_w,
+                                    force_pallas=True, interpret=True,
+                                    block_q=64, block_k=64) ** 2).sum()
+
+        def loss_x(q, k, v, _c=causal, _w=window):
+            return (_xla_attention(q, k, v, _c, 0.2, window=_w) ** 2).sum()
+
+        gp = jax.grad(loss_p, argnums=(0, 1, 2))(q, k, v)
+        gx = jax.grad(loss_x, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", gp, gx):
+            rel = float(jnp.abs(a - b).max() / (jnp.abs(b).max() + 1e-9))
+            assert rel < 1e-4, f"T={Tq}/{Tk} causal={causal} w={window} d{name}: {rel}"
