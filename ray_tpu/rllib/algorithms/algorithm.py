@@ -549,3 +549,21 @@ class Algorithm(Trainable):
 
     def get_policy_weights(self):
         return self.learner_group.get_weights()
+
+    def get_policy(self):
+        """Legacy-API view of the trained module (reference:
+        Algorithm.get_policy → rllib/policy/policy.py:175). The returned
+        Policy shares NO live state — it snapshots current weights (and the
+        observation-filter statistics, which a filtered policy needs at
+        inference); call again after more training for fresh ones."""
+        from ray_tpu.rllib.policy.policy import Policy
+
+        return Policy(
+            self.module_spec,
+            self.learner_group.get_weights(),
+            config={
+                "gamma": getattr(self.config, "gamma", 0.99),
+                "lambda": getattr(self.config, "lambda_", 0.95),
+            },
+            obs_filter_state=getattr(self.workers, "_filter_base", None),
+        )

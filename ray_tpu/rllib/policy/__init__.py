@@ -1,1 +1,2 @@
+from ray_tpu.rllib.policy.policy import Policy  # noqa: F401
 from ray_tpu.rllib.policy.sample_batch import MultiAgentBatch, SampleBatch, compute_gae  # noqa: F401

@@ -209,7 +209,10 @@ def test_external_env_drives_dqn():
     runner = ExternalEnvRunner(ext, algo)
     best = 0.0
     try:
-        for _ in range(40):
+        # 60 rounds (early-exit at reward 100): under full-suite load on a
+        # 1-core box the collector thread gets starved and 40 rounds was
+        # marginal — passed standalone, flaked in-suite.
+        for _ in range(60):
             runner.collect(min_steps=500, timeout=60)
             for _ in range(60):
                 algo._train_once()
