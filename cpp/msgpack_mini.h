@@ -57,6 +57,12 @@ struct Packer {
     else { u8(0xc6); be32((uint32_t)n); }
     raw(b.data(), n);
   }
+  void floating(double d) {
+    uint64_t raw;
+    memcpy(&raw, &d, 8);
+    u8(0xcb);
+    be64(raw);
+  }
   void array_header(uint32_t n) {
     if (n < 16) u8(0x90 | (uint8_t)n);
     else if (n <= 0xffff) { u8(0xdc); be16((uint16_t)n); }
@@ -176,6 +182,27 @@ struct Unpacker {
   }
 };
 
+
+// Re-encode a decoded Value (round trip; map keys re-sort, semantically
+// identical on the framework's string-keyed wire).
+inline void pack_value(Packer& pk, const Value& v) {
+  switch (v.kind) {
+    case Value::NIL: pk.nil(); return;
+    case Value::BOOL: pk.boolean(v.b); return;
+    case Value::INT: pk.integer(v.i); return;
+    case Value::FLOAT: pk.floating(v.f); return;
+    case Value::STR: pk.str(v.s); return;
+    case Value::BIN: pk.bin(v.s); return;
+    case Value::ARR:
+      pk.array_header((uint32_t)v.arr.size());
+      for (const Value& e : v.arr) pack_value(pk, e);
+      return;
+    case Value::MAP:
+      pk.map_header((uint32_t)v.map.size());
+      for (const auto& kv : v.map) { pk.str(kv.first); pack_value(pk, kv.second); }
+      return;
+  }
+}
 
 // Debug/print representation (JSON-ish; BIN shown as <N bytes>).
 inline std::string value_repr(const Value& v) {

@@ -1,0 +1,478 @@
+// ray_tpu C++ worker runtime — a native (no-Python) task executor.
+//
+// Completes the N22 surface past the client driver (ray_tpu_client.cc):
+// where the reference ships a full C++ worker runtime (cpp/src/ray/runtime/
+// — task execution loop, object store access, core-worker protocol), this
+// binary is the framework's native analog: the raylet's worker pool spawns
+// it for language="cpp" tasks (see _private/cpp_worker.py and raylet.py),
+// it registers back over the real msgpack wire exactly like a Python worker
+// (worker_main.py), receives `push_task` dispatches, executes C-ABI
+// functions from a shared library (the cross_language contract of
+// cpp/xlang_kernels.cc), and reports results straight to the OWNER's core
+// worker as format-"x" (msgpack) objects — no pickle anywhere in the path.
+//
+// Protocol surface (mirrors worker_main.py for normal tasks):
+//   server:  push_task {spec}        -> {"ok": true}, execute, then
+//            kill_self               -> exit(0)
+//            lease_ping / ping       -> {"ok": true}
+//   client:  raylet.register_worker {worker_id, address, pid}
+//            owner.task_done {task_id, results|error, duration_s}
+//            raylet.task_finished {worker_id}
+//            raylet.store_contains   (idle-time liveness probe; exit when
+//                                     the parent raylet goes away —
+//                                     reference: core_worker.cc
+//                                     ExitIfParentRayletDies)
+//
+// v1 limits (documented in PARITY.md): normal tasks only (no actors), args
+// must be inline cross-language values ("v" entries — ObjectRef args are
+// answered with a typed error), single return, inline results.
+//
+// Build (automatic, cached): g++ -O2 -std=c++17 -o ray_tpu_cpp_worker
+//   cpp/ray_tpu_worker.cc -ldl
+
+#include <arpa/inet.h>
+#include <dlfcn.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <poll.h>
+
+#include <algorithm>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "msgpack_mini.h"
+
+// ---------------------------------------------------------------------------
+// Wire helpers: 4-byte BE length + msgpack [type, seq, method, payload].
+// ---------------------------------------------------------------------------
+
+static void send_all(int fd, const std::string& buf) {
+  size_t off = 0;
+  while (off < buf.size()) {
+    ssize_t n = write(fd, buf.data() + off, buf.size() - off);
+    if (n <= 0) throw std::runtime_error("write failed");
+    off += (size_t)n;
+  }
+}
+
+static bool read_exact(int fd, char* out, size_t n) {
+  size_t off = 0;
+  while (off < n) {
+    ssize_t got = read(fd, out + off, n - off);
+    if (got <= 0) return false;
+    off += (size_t)got;
+  }
+  return true;
+}
+
+static std::string frame(const std::string& body) {
+  std::string out;
+  uint32_t len = htonl((uint32_t)body.size());
+  out.append((const char*)&len, 4);
+  out += body;
+  return out;
+}
+
+struct RpcClient {
+  int fd = -1;
+  uint32_t seq = 0;
+  std::string host;
+  int port = 0;
+
+  RpcClient(const std::string& h, int p) : host(h), port(p) { connect_now(); }
+  ~RpcClient() { if (fd >= 0) close(fd); }
+
+  void connect_now() {
+    fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) throw std::runtime_error("socket() failed");
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons((uint16_t)port);
+    if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+      // Not a numeric IP — resolve (the raylet may advertise a hostname).
+      addrinfo hints{}, *res = nullptr;
+      hints.ai_family = AF_INET;
+      hints.ai_socktype = SOCK_STREAM;
+      if (getaddrinfo(host.c_str(), nullptr, &hints, &res) != 0 || !res)
+        throw std::runtime_error("cannot resolve host " + host);
+      addr.sin_addr = ((sockaddr_in*)res->ai_addr)->sin_addr;
+      freeaddrinfo(res);
+    }
+    if (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0)
+      throw std::runtime_error("connect to " + host + " failed");
+  }
+
+  Value call(const std::string& method, const std::string& payload_body) {
+    Packer pk;
+    pk.array_header(4);
+    pk.integer(0);  // REQUEST
+    pk.integer(++seq);
+    pk.str(method);
+    pk.out += payload_body;
+    send_all(fd, frame(pk.out));
+    for (;;) {
+      char hdr[4];
+      if (!read_exact(fd, hdr, 4)) throw std::runtime_error("rpc read failed");
+      uint32_t blen = ntohl(*(const uint32_t*)hdr);
+      std::string body(blen, '\0');
+      if (!read_exact(fd, &body[0], blen)) throw std::runtime_error("rpc read failed");
+      Unpacker up(body);
+      Value msg = up.decode();
+      int64_t mtype = msg.arr.at(0).i;
+      if (mtype == 3) continue;  // PUSH frames (log fan-out) are not ours
+      if ((uint32_t)msg.arr.at(1).i != seq) continue;
+      if (mtype == 2) {
+        const Value* detail = msg.arr.at(3).get("error");
+        throw std::runtime_error("rpc error from " + method + ": " +
+                                 (detail ? detail->s : std::string("?")));
+      }
+      return msg.arr.at(3);
+    }
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Framework object codec: [4B BE hlen][msgpack {"p","b","f"}][64-pad][payload]
+// (serialization.py wire format; "x" = cross-language msgpack object).
+// ---------------------------------------------------------------------------
+
+static const uint64_t kAlign = 64;
+
+static std::string encode_x_object(const std::string& payload, const char* fmt) {
+  Packer h;
+  h.map_header(3);
+  h.str("p"); h.integer((int64_t)payload.size());
+  h.str("b"); h.array_header(0);
+  h.str("f"); h.str(fmt);
+  std::string out;
+  uint32_t hlen = htonl((uint32_t)h.out.size());
+  out.append((const char*)&hlen, 4);
+  out += h.out;
+  while (out.size() % kAlign) out.push_back('\0');
+  out += payload;
+  return out;
+}
+
+// Decode an inline framework object; only format-"x" is native-decodable.
+static bool decode_x_object(const std::string& blob, Value* out, std::string* err) {
+  if (blob.size() < 4) { *err = "object too short"; return false; }
+  const uint8_t* d = (const uint8_t*)blob.data();
+  uint64_t hlen = ((uint64_t)d[0] << 24) | (d[1] << 16) | (d[2] << 8) | d[3];
+  if (4 + hlen > blob.size()) { *err = "bad header length"; return false; }
+  Unpacker hu(d + 4, (size_t)hlen);
+  Value h = hu.decode();
+  const Value* f = h.get("f");
+  const Value* p = h.get("p");
+  if (!f || f->s != "x" || !p) {
+    *err = "arg is not a cross-language (format-\"x\") object — C++ workers "
+           "execute msgpack-plain args only";
+    return false;
+  }
+  uint64_t pos = (4 + hlen + kAlign - 1) & ~(kAlign - 1);
+  if (pos + (uint64_t)p->i > blob.size()) { *err = "payload overruns object"; return false; }
+  Unpacker pu(d + pos, (size_t)p->i);
+  *out = pu.decode();
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// Kernel execution: the cross_language C ABI (cpp/xlang_kernels.cc).
+// ---------------------------------------------------------------------------
+
+typedef int (*kernel_fn)(const uint8_t*, size_t, uint8_t**, size_t*);
+typedef void (*free_fn)(uint8_t*);
+
+struct LoadedLib {
+  void* handle;
+  free_fn freer;
+};
+
+static std::map<std::string, LoadedLib> g_libs;
+
+static bool run_kernel(const std::string& library, const std::string& symbol,
+                       const std::string& args_msgpack, std::string* result,
+                       std::string* err) {
+  auto it = g_libs.find(library);
+  if (it == g_libs.end()) {
+    void* h = dlopen(library.c_str(), RTLD_NOW);
+    if (!h) { *err = std::string("dlopen failed: ") + dlerror(); return false; }
+    free_fn fr = (free_fn)dlsym(h, "ray_tpu_xlang_free");
+    if (!fr) { *err = "library lacks ray_tpu_xlang_free"; return false; }
+    it = g_libs.emplace(library, LoadedLib{h, fr}).first;
+  }
+  kernel_fn fn = (kernel_fn)dlsym(it->second.handle, symbol.c_str());
+  if (!fn) { *err = "symbol " + symbol + " not found in " + library; return false; }
+  uint8_t* out = nullptr;
+  size_t out_len = 0;
+  int rc = fn((const uint8_t*)args_msgpack.data(), args_msgpack.size(), &out, &out_len);
+  std::string data = out ? std::string((const char*)out, out_len) : std::string();
+  if (out) it->second.freer(out);
+  if (rc != 0) {
+    *err = symbol + " failed (rc=" + std::to_string(rc) + "): " + data;
+    return false;
+  }
+  *result = data;
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// Worker runtime.
+// ---------------------------------------------------------------------------
+
+struct Config {
+  std::string worker_id;
+  std::string raylet_host;
+  int raylet_port = 0;
+};
+
+// Parse the minimal JSON shape `["host", port]` from RAY_TPU_RAYLET_ADDR.
+static bool parse_addr(const char* json, std::string* host, int* port) {
+  if (!json) return false;
+  const char* q1 = strchr(json, '"');
+  if (!q1) return false;
+  const char* q2 = strchr(q1 + 1, '"');
+  if (!q2) return false;
+  host->assign(q1 + 1, q2 - q1 - 1);
+  const char* c = strchr(q2, ',');
+  if (!c) return false;
+  *port = atoi(c + 1);
+  return *port > 0;
+}
+
+static std::unique_ptr<RpcClient> g_raylet;
+static Config g_cfg;
+
+static RpcClient* owner_client(const std::string& host, int port,
+                               std::map<std::string, std::unique_ptr<RpcClient>>& cache) {
+  std::string key = host + ":" + std::to_string(port);
+  auto it = cache.find(key);
+  if (it == cache.end())
+    it = cache.emplace(key, std::unique_ptr<RpcClient>(new RpcClient(host, port))).first;
+  return it->second.get();
+}
+
+// Execute one pushed task spec; report to the owner and the raylet.
+static void execute_task(const Value& spec,
+                         std::map<std::string, std::unique_ptr<RpcClient>>& owners) {
+  const Value* tid = spec.get("task_id");
+  const Value* fkey = spec.get("function_key");
+  const Value* oaddr = spec.get("owner_addr");
+  const Value* name = spec.get("name");
+  if (!tid) return;  // nothing to report against
+  std::string task_name = name ? name->s : "cpp_task";
+
+  struct timespec t0;
+  clock_gettime(CLOCK_MONOTONIC, &t0);
+
+  std::string err;
+  std::string result_payload;
+  bool ok = true;
+
+  // function_key: "cpp!<library>!<symbol>" (set by core_worker.submit_task).
+  std::string library, symbol;
+  if (!fkey || fkey->s.rfind("cpp!", 0) != 0) {
+    ok = false;
+    err = "C++ worker received a non-cpp function key";
+  } else {
+    size_t bang = fkey->s.rfind('!');
+    library = fkey->s.substr(4, bang - 4);
+    symbol = fkey->s.substr(bang + 1);
+  }
+
+  // Args: inline "v" entries decode natively; "r" refs are a v1 limit.
+  if (ok) {
+    Packer args_pk;
+    const Value* args = spec.get("args");
+    uint32_t n = args && args->kind == Value::ARR ? (uint32_t)args->arr.size() : 0;
+    args_pk.array_header(n);
+    for (uint32_t i = 0; ok && i < n; ++i) {
+      const Value& a = args->arr[i];
+      if (a.kind != Value::ARR || a.arr.empty()) { ok = false; err = "malformed arg"; break; }
+      if (a.arr[0].s == "r") {
+        ok = false;
+        err = "ObjectRef args are not supported by the C++ worker runtime yet "
+              "— pass plain values to cpp_function tasks";
+        break;
+      }
+      Value decoded;
+      if (!decode_x_object(a.arr[1].s, &decoded, &err)) { ok = false; break; }
+      pack_value(args_pk, decoded);
+    }
+    if (ok) ok = run_kernel(library, symbol, args_pk.out, &result_payload, &err);
+  }
+
+  struct timespec t1;
+  clock_gettime(CLOCK_MONOTONIC, &t1);
+  double dur = (t1.tv_sec - t0.tv_sec) + (t1.tv_nsec - t0.tv_nsec) * 1e-9;
+
+  // task_done payload to the owner.
+  Packer done;
+  done.map_header(4);
+  done.str("task_id"); done.str(tid->s);
+  if (ok) {
+    done.str("results");
+    done.array_header(1);
+    done.array_header(4);
+    done.str(tid->s + "00000000");  // ObjectID.for_return(task_id, 0)
+    done.str("inline");
+    done.bin(encode_x_object(result_payload, "x"));
+    done.array_header(0);  // no contained refs in plain msgpack data
+    done.str("error"); done.nil();
+  } else {
+    // Format-"xe": serialization.deserialize maps it to a TaskError
+    // wrapping CrossLanguageError, so ray_tpu.get raises exactly like a
+    // Python task failure.
+    Packer ep;
+    ep.map_header(2);
+    ep.str("message"); ep.str(err);
+    ep.str("task_name"); ep.str(task_name);
+    done.str("results"); done.array_header(0);
+    done.str("error"); done.bin(encode_x_object(ep.out, "xe"));
+  }
+  done.str("duration_s"); done.floating(dur);
+
+  if (oaddr && oaddr->kind == Value::ARR && oaddr->arr.size() == 2) {
+    try {
+      RpcClient* owner = owner_client(oaddr->arr[0].s, (int)oaddr->arr[1].i, owners);
+      owner->call("task_done", done.out);
+    } catch (const std::exception& e) {
+      fprintf(stderr, "cpp_worker: task_done to owner failed: %s\n", e.what());
+    }
+  }
+  try {
+    Packer fin;
+    fin.map_header(1);
+    fin.str("worker_id"); fin.str(g_cfg.worker_id);
+    g_raylet->call("task_finished", fin.out);
+  } catch (const std::exception& e) {
+    fprintf(stderr, "cpp_worker: task_finished failed: %s — raylet gone, exiting\n", e.what());
+    exit(1);
+  }
+}
+
+int main() {
+  const char* wid = getenv("RAY_TPU_WORKER_ID");
+  if (!wid || !parse_addr(getenv("RAY_TPU_RAYLET_ADDR"), &g_cfg.raylet_host,
+                          &g_cfg.raylet_port)) {
+    fprintf(stderr, "cpp_worker: RAY_TPU_WORKER_ID / RAY_TPU_RAYLET_ADDR missing\n");
+    return 2;
+  }
+  g_cfg.worker_id = wid;
+  try {
+    // Listen before registering: tasks may be pushed immediately after.
+    int lfd = socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = 0;
+    if (bind(lfd, (sockaddr*)&addr, sizeof(addr)) != 0 || listen(lfd, 16) != 0)
+      throw std::runtime_error("listen failed");
+    socklen_t alen = sizeof(addr);
+    getsockname(lfd, (sockaddr*)&addr, &alen);
+    int port = ntohs(addr.sin_port);
+
+    g_raylet.reset(new RpcClient(g_cfg.raylet_host, g_cfg.raylet_port));
+    {
+      Packer reg;
+      reg.map_header(3);
+      reg.str("worker_id"); reg.str(g_cfg.worker_id);
+      reg.str("address");
+      reg.array_header(2);
+      reg.str(g_cfg.raylet_host);  // same host as the raylet (one node)
+      reg.integer(port);
+      reg.str("pid"); reg.integer((int64_t)getpid());
+      Value r = g_raylet->call("register_worker", reg.out);
+      const Value* okf = r.get("ok");
+      if (okf && !okf->truthy()) return 0;  // retired id — orphan, exit
+    }
+    printf("CPP_WORKER_READY %s port=%d\n", g_cfg.worker_id.c_str(), port);
+    fflush(stdout);
+
+    std::map<std::string, std::unique_ptr<RpcClient>> owners;
+    std::vector<int> conns;
+    std::map<int, std::string> bufs;  // per-connection receive buffer
+    time_t last_probe = time(nullptr);
+
+    for (;;) {
+      std::vector<pollfd> fds;
+      fds.push_back({lfd, POLLIN, 0});
+      for (int fd : conns) fds.push_back({fd, POLLIN, 0});
+      int nready = poll(fds.data(), fds.size(), 2000);
+      if (nready < 0) throw std::runtime_error("poll failed");
+      // Idle liveness probe: workers exit if the parent raylet dies
+      // (reference: core_worker.cc ExitIfParentRayletDies).
+      if (time(nullptr) - last_probe >= 2) {
+        last_probe = time(nullptr);
+        try {
+          Packer p;
+          p.map_header(1);
+          p.str("object_id");
+          p.str(std::string(56, '0'));
+          g_raylet->call("store_contains", p.out);
+        } catch (const std::exception&) {
+          fprintf(stderr, "cpp_worker: parent raylet unreachable; exiting\n");
+          return 1;
+        }
+      }
+      if (fds[0].revents & POLLIN) {
+        int c = accept(lfd, nullptr, nullptr);
+        if (c >= 0) { conns.push_back(c); bufs[c] = ""; }
+      }
+      for (size_t i = 1; i < fds.size(); ++i) {
+        if (!(fds[i].revents & (POLLIN | POLLHUP | POLLERR))) continue;
+        int fd = fds[i].fd;
+        char chunk[65536];
+        ssize_t n = read(fd, chunk, sizeof chunk);
+        if (n <= 0) {
+          close(fd);
+          conns.erase(std::find(conns.begin(), conns.end(), fd));
+          bufs.erase(fd);
+          continue;
+        }
+        std::string& buf = bufs[fd];
+        buf.append(chunk, (size_t)n);
+        // Drain complete frames.
+        while (buf.size() >= 4) {
+          uint32_t blen = ntohl(*(const uint32_t*)buf.data());
+          if (buf.size() < 4 + (size_t)blen) break;
+          std::string body = buf.substr(4, blen);
+          buf.erase(0, 4 + blen);
+          Unpacker up(body);
+          Value msg = up.decode();
+          int64_t seq = msg.arr.at(1).i;
+          const std::string& method = msg.arr.at(2).s;
+          // Reply first (the Python worker acks push_task before
+          // executing too), then run the task synchronously.
+          Packer resp;
+          resp.array_header(4);
+          resp.integer(1);  // RESPONSE
+          resp.integer(seq);
+          resp.str(method);
+          resp.map_header(1);
+          resp.str("ok");
+          resp.boolean(true);
+          send_all(fd, frame(resp.out));
+          if (method == "push_task") {
+            const Value* spec = msg.arr.at(3).get("spec");
+            if (spec) execute_task(*spec, owners);
+          } else if (method == "kill_self") {
+            return 0;
+          }  // lease_ping / unknown: ok-ack above suffices
+        }
+      }
+    }
+  } catch (const std::exception& e) {
+    fprintf(stderr, "cpp_worker: fatal: %s\n", e.what());
+    return 1;
+  }
+}

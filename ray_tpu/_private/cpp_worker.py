@@ -1,0 +1,82 @@
+"""C++ worker runtime build helper.
+
+Compiles ``cpp/ray_tpu_worker.cc`` (the native task executor for
+language="cpp" specs — see its header comment for the protocol surface)
+on demand with g++ and caches the binary next to the other native
+components in ``_native/build/``, the same build-on-first-use scheme as
+the shm arena (store/arena.py). Returns None when the toolchain is
+unavailable so the raylet can fall back to executing cpp_function tasks
+in Python workers (ctypes path in cross_language.py) — behavior is
+identical, only the runtime hosting the C ABI call differs.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import threading
+
+logger = logging.getLogger(__name__)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO, "cpp", "ray_tpu_worker.cc")
+_BIN = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "_native",
+    "build",
+    "ray_tpu_cpp_worker",
+)
+
+_lock = threading.Lock()
+_result: dict = {}
+
+
+def cpp_worker_binary() -> str | None:
+    """Path to the compiled worker binary, building it if needed (BLOCKS
+    for the g++ run on first use — do not call from an event loop)."""
+    with _lock:
+        if "path" in _result:
+            return _result["path"]
+        path = _build()
+        _result["path"] = path
+        return path
+
+
+def cpp_worker_binary_nowait() -> str | None:
+    """Non-blocking variant for the raylet's dispatch loop: returns the
+    binary path if it is already built, else kicks off a background build
+    and returns None (the caller falls back to a Python worker for this
+    spawn; later spawns find the binary)."""
+    if (
+        os.path.exists(_BIN)
+        and os.path.exists(_SRC)
+        and os.path.getmtime(_BIN) >= os.path.getmtime(_SRC)
+    ):
+        return _BIN
+    with _lock:
+        if "path" in _result:
+            return _result["path"]
+        if "bg" not in _result:
+            _result["bg"] = threading.Thread(target=cpp_worker_binary, daemon=True)
+            _result["bg"].start()
+    return None
+
+
+def _build() -> str | None:
+    if not os.path.exists(_SRC):
+        return None
+    os.makedirs(os.path.dirname(_BIN), exist_ok=True)
+    if os.path.exists(_BIN) and os.path.getmtime(_BIN) >= os.path.getmtime(_SRC):
+        return _BIN
+    tmp = _BIN + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O2", "-std=c++17", "-o", tmp, _SRC, "-ldl"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=180)
+        os.replace(tmp, _BIN)
+        return _BIN
+    except Exception as e:
+        logger.warning(
+            "C++ worker build failed (%s); cpp tasks will run in Python workers", e
+        )
+        return None

@@ -54,6 +54,15 @@ def _runtime_env_hash(runtime_env: dict | None) -> str | None:
     return hashlib.md5(json.dumps(runtime_env, sort_keys=True).encode()).hexdigest()[:16]
 
 
+def _worker_key(runtime_env: dict | None, language: str = "py") -> str | None:
+    """Worker-pool matching key: runtime env PLUS execution language
+    (reference: worker_pool.cc keys cached workers per (language,
+    runtime-env hash)). language="cpp" workers are the native runtime
+    (cpp/ray_tpu_worker.cc) and never serve Python tasks, and vice versa."""
+    h = _runtime_env_hash(runtime_env)
+    return h if language == "py" else f"lang={language}|{h}"
+
+
 @dataclass
 class WorkerHandle:
     worker_id: str
@@ -903,7 +912,7 @@ class Raylet:
                 if not self._has_pool(spec) or not self._fits_now(spec):
                     self._infeasible.append(spec)
                     continue
-                spec_env_hash = _runtime_env_hash(spec.runtime_env)
+                spec_env_hash = _worker_key(spec.runtime_env, getattr(spec, "language", "py"))
                 worker = self._pop_idle_worker(spec_env_hash)
                 if worker is None:
                     # Start enough workers for the whole backlog at once
@@ -960,14 +969,15 @@ class Raylet:
                     # whole queue here cost O(n) per submission at depth.
                     import itertools
 
-                    pending_envs = [spec.runtime_env] + [
-                        s.runtime_env
+                    pending_envs = [(spec.runtime_env, getattr(spec, "language", "py"))] + [
+                        (s.runtime_env, getattr(s, "language", "py"))
                         for s in itertools.islice(self.task_queue, max(deficit, 0))
                     ]
                     for i in range(max(deficit, 0)):
-                        self._start_worker(
-                            pending_envs[i] if i < len(pending_envs) else None
+                        env_i, lang_i = (
+                            pending_envs[i] if i < len(pending_envs) else (None, "py")
                         )
+                        self._start_worker(env_i, lang_i)
                     self.task_queue.appendleft(spec)
                     return
                 if not self._acquire_for(spec):
@@ -1187,7 +1197,7 @@ class Raylet:
             if w.pid == pid and isinstance(w.proc, ZygoteWorkerProc):
                 w.proc.returncode = returncode
 
-    def _start_worker(self, runtime_env: dict | None = None):
+    def _start_worker(self, runtime_env: dict | None = None, language: str = "py"):
         worker_id = WorkerID.from_random().hex()
         delta = self._worker_env_delta(worker_id, runtime_env)
         log_path = os.path.join(self.session_dir, "logs", f"worker-{worker_id[:8]}")
@@ -1195,9 +1205,26 @@ class Raylet:
         handle = WorkerHandle(
             worker_id=worker_id,
             pid=0,
-            runtime_env_hash=_runtime_env_hash(runtime_env),
+            runtime_env_hash=_worker_key(runtime_env, language),
         )
         self.workers[worker_id] = handle
+        if language == "cpp":
+            # Native worker runtime (cpp/ray_tpu_worker.cc): spawned
+            # directly (no zygote — nothing Python to pre-fork). The first
+            # ever spawn may find the binary not yet compiled: the build
+            # runs in a background thread (a synchronous g++ here would
+            # stall the raylet event loop for seconds) and THIS worker
+            # falls back to a Python process under the SAME pool key — it
+            # executes cpp specs through the ctypes path (_load_function
+            # "cpp!" fallback), so behavior is identical; later spawns pick
+            # up the compiled binary.
+            from ray_tpu._private.cpp_worker import cpp_worker_binary_nowait
+
+            binary = cpp_worker_binary_nowait()
+            self._popen_worker(
+                handle, delta, log_path, argv=[binary] if binary else None
+            )
+            return
         zygote = self._zygote_client()
         if zygote is not None:
             asyncio.ensure_future(
@@ -1206,18 +1233,23 @@ class Raylet:
         else:
             self._popen_worker(handle, delta, log_path)
 
-    def _popen_worker(self, handle: WorkerHandle, delta: dict, log_path: str):
+    def _popen_worker(
+        self, handle: WorkerHandle, delta: dict, log_path: str, argv: list | None = None
+    ):
+        """Spawn a worker process. Default argv is the Python worker entry;
+        a custom argv spawns a native runtime (the C++ worker binary)."""
         env = os.environ.copy()
-        if not self.resources_total.get("TPU"):
+        if argv is not None or not self.resources_total.get("TPU"):
             # On a TPU host a sitecustomize hook dials the TPU plugin during
             # interpreter start (~2s); workers on CPU-only nodes never touch
-            # the chip, so skip it — worker spawn drops ~10x.
+            # the chip, so skip it — worker spawn drops ~10x. Native workers
+            # never dial the chip at all.
             env.pop("PALLAS_AXON_POOL_IPS", None)
         env.update(delta)
         stdout = open(log_path + ".out", "ab")
         stderr = open(log_path + ".err", "ab")
         proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.worker_main"],
+            argv or [sys.executable, "-m", "ray_tpu._private.worker_main"],
             env=env,
             stdout=stdout,
             stderr=stderr,

@@ -271,6 +271,20 @@ def deserialize(view) -> object:
     if header.get("f") == "x":
         # Cross-language msgpack object: plain data, no pickle involved.
         return msgpack.unpackb(bytes(payload), raw=False)
+    if header.get("f") == "xe":
+        # Cross-language task ERROR (produced by the C++ worker runtime,
+        # cpp/ray_tpu_worker.cc): map onto the same TaskError the Python
+        # execution path ships, so ray_tpu.get raises it identically.
+        from ray_tpu.cross_language import CrossLanguageError
+        from ray_tpu.exceptions import TaskError
+
+        info = msgpack.unpackb(bytes(payload), raw=False)
+        msg = info.get("message", "native task failed")
+        return TaskError(
+            cause=CrossLanguageError(msg),
+            remote_traceback=msg,
+            task_name=info.get("task_name", ""),
+        )
     buffers = []
     for size in header["b"]:
         pos = _align(pos)

@@ -55,6 +55,12 @@ class TaskSpec:
     # util/tracing/tracing_helper.py — span context rides task metadata).
     trace_ctx: dict = field(default_factory=dict)
     runtime_env: dict = field(default_factory=dict)
+    # Execution language (reference: TaskSpecification language field,
+    # src/ray/common/task/task_spec.h — drives worker-pool selection).
+    # "py" workers run pickled functions; "cpp" specs carry a
+    # self-describing "cpp!<library>!<symbol>" function key and route to
+    # the native worker runtime (cpp/ray_tpu_worker.cc).
+    language: str = "py"
     # Non-empty marks this spec as a WORKER-LEASE REQUEST (reference:
     # direct_task_transport.cc lease requests ride the task scheduler): it
     # flows through the raylet queue like a task, but dispatch grants the

@@ -1,0 +1,124 @@
+"""C++ worker runtime: language="cpp" tasks execute in a NATIVE worker.
+
+cpp/ray_tpu_worker.cc is the framework's analog of the reference's C++
+worker runtime (cpp/src/ray/runtime/ — native task execution loop): the
+raylet's worker pool spawns it for cpp_function tasks, it registers over
+the real msgpack wire, executes C-ABI kernels, and reports format-"x"
+results straight to the owner — no Python in the execution path. These
+tests drive that full path and verify the native worker (not a Python
+fallback) actually hosted the execution.
+"""
+
+import glob
+import os
+import subprocess
+
+import pytest
+
+import ray_tpu
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+SRC = os.path.join(REPO, "cpp", "xlang_kernels.cc")
+
+
+@pytest.fixture(scope="module")
+def kernels_so(tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("xlangw") / "libxlang_kernels.so")
+    proc = subprocess.run(
+        ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", out, SRC],
+        capture_output=True,
+        text=True,
+    )
+    if proc.returncode != 0:
+        pytest.fail(f"xlang kernels failed to compile:\n{proc.stderr}")
+    return out
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+def _session_logs() -> str:
+    node = ray_tpu._global_node
+    assert node is not None
+    return os.path.join(node.session_dir, "logs")
+
+
+def _native_worker_was_used() -> bool:
+    for path in glob.glob(os.path.join(_session_logs(), "worker-*.out")):
+        try:
+            with open(path, "rb") as f:
+                if b"CPP_WORKER_READY" in f.read():
+                    return True
+        except OSError:
+            pass
+    return False
+
+
+def test_cpp_worker_binary_builds():
+    from ray_tpu._private.cpp_worker import cpp_worker_binary
+
+    binary = cpp_worker_binary()
+    assert binary is not None and os.path.exists(binary)
+
+
+def test_cpp_task_executes_in_native_worker(cluster, kernels_so):
+    from ray_tpu.cross_language import cpp_function
+
+    sum_fn = cpp_function("xlang_sum", kernels_so)
+    assert ray_tpu.get(sum_fn.remote([1, 2, 3]), timeout=60) == 6
+    assert ray_tpu.get(sum_fn.remote([1.5, 2.5]), timeout=60) == 4.0
+    # The proof this ran NATIVELY: the C++ worker announces itself in its
+    # log on startup; a Python-fallback run would leave no such marker.
+    assert _native_worker_was_used(), "cpp task did not run in the C++ worker"
+
+    # Worker reuse: a second wave should not need new worker spawns to
+    # produce correct results (same pool key).
+    outs = ray_tpu.get([sum_fn.remote([i, i]) for i in range(8)], timeout=60)
+    assert outs == [2 * i for i in range(8)]
+
+
+def test_cpp_task_error_raises_cross_language_error(cluster, kernels_so):
+    from ray_tpu.cross_language import CrossLanguageError, cpp_function
+    from ray_tpu.exceptions import TaskError
+
+    bad = cpp_function("xlang_sum", kernels_so)
+    with pytest.raises((TaskError, CrossLanguageError)) as exc_info:
+        # xlang_sum rejects non-array args with rc != 0.
+        ray_tpu.get(bad.remote("not-an-array"), timeout=60)
+    assert "xlang_sum" in str(exc_info.value)
+
+    missing = cpp_function("no_such_symbol", kernels_so)
+    with pytest.raises((TaskError, CrossLanguageError)) as exc_info:
+        ray_tpu.get(missing.remote([1]), timeout=60)
+    assert "no_such_symbol" in str(exc_info.value)
+
+
+def test_cpp_task_ref_args_fall_back_to_python_path(cluster, kernels_so):
+    """ObjectRef (and plasma-sized) args need owner-fetch machinery the
+    native runtime doesn't implement yet; those calls fall back to the
+    Python ctypes path with IDENTICAL results rather than failing."""
+    from ray_tpu.cross_language import cpp_function
+
+    sum_fn = cpp_function("xlang_sum", kernels_so)
+    ref = ray_tpu.put([1, 2, 3])
+    assert ray_tpu.get(sum_fn.remote(ref), timeout=60) == 6
+
+
+def test_python_tasks_unaffected_alongside_cpp(cluster, kernels_so):
+    """Language-keyed pools: python and cpp workers coexist; a python task
+    never lands on a native worker (it would have no pickle runtime)."""
+    from ray_tpu.cross_language import cpp_function
+
+    @ray_tpu.remote
+    def py_add(a, b):
+        return a + b
+
+    sum_fn = cpp_function("xlang_sum", kernels_so)
+    py_refs = [py_add.remote(i, i) for i in range(4)]
+    cpp_refs = [sum_fn.remote([i, 1]) for i in range(4)]
+    assert ray_tpu.get(py_refs, timeout=60) == [2 * i for i in range(4)]
+    assert ray_tpu.get(cpp_refs, timeout=60) == [i + 1 for i in range(4)]
