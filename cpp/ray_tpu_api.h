@@ -1,0 +1,311 @@
+// ray_tpu C++ driver API — the user-facing native surface (N22).
+//
+// The reference's C++ API (cpp/include/ray/api.h) lets a C++ program be a
+// first-class driver: `ray::Task(f).Remote(args)` then `ray::Get(ref)`.
+// This header is the framework's analog over the real wire protocol:
+//
+//   rtpu::Driver driver(raylet_host, raylet_port);
+//   auto ref = driver.Task("xlang_sum", "/path/libkernels.so")
+//                    .Remote(rtpu::List({rtpu::V(1), rtpu::V(2)}));
+//   Value out = driver.Get(ref);             // msgpack value, throws on error
+//
+// The Driver is a true OWNER, not a KV-polling spectator: it runs a small
+// owner-side RPC server (a thread), stamps its own address as owner_addr on
+// submitted specs, and workers — the native C++ worker runtime
+// (ray_tpu_worker.cc) for language="cpp" specs, or Python workers on
+// fallback — push `task_done` payloads straight back to it, exactly the
+// reference's direct-call result path (owner-routed results, no
+// polling). Results are format-"x" msgpack objects; task failures arrive
+// as format-"xe" errors (or Python-pickle errors from fallback workers)
+// and throw rtpu::TaskFailed from Get.
+//
+// Header-only; depends on msgpack_mini.h + ray_tpu_wire.h. Linux sockets.
+
+#pragma once
+
+#include <poll.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <stdexcept>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "ray_tpu_wire.h"
+
+namespace rtpu {
+
+using rtpu_wire::RpcClient;
+
+struct TaskFailed : std::runtime_error {
+  explicit TaskFailed(const std::string& m) : std::runtime_error(m) {}
+};
+struct GetTimeout : std::runtime_error {
+  explicit GetTimeout(const std::string& m) : std::runtime_error(m) {}
+};
+
+// -- Value construction sugar ------------------------------------------------
+
+inline Value V(int64_t v) { Value x; x.kind = Value::INT; x.i = v; return x; }
+inline Value V(int v) { return V((int64_t)v); }
+inline Value V(double v) { Value x; x.kind = Value::FLOAT; x.f = v; return x; }
+inline Value V(bool v) { Value x; x.kind = Value::BOOL; x.b = v; return x; }
+inline Value V(const std::string& v) { Value x; x.kind = Value::STR; x.s = v; return x; }
+inline Value V(const char* v) { return V(std::string(v)); }
+inline Value Bin(const std::string& v) { Value x; x.kind = Value::BIN; x.s = v; return x; }
+inline Value List(std::vector<Value> items) {
+  Value x;
+  x.kind = Value::ARR;
+  x.arr = std::move(items);
+  return x;
+}
+
+struct ObjectRef {
+  std::string task_id;  // 48-hex; the return object is task_id + "00000000"
+};
+
+class Driver;
+
+// `driver.Task(symbol, library).Remote(v...)` — the reference's
+// `ray::Task(fn).Remote(...)` shape for C-ABI kernel functions.
+class TaskHandle {
+ public:
+  TaskHandle(Driver* d, std::string symbol, std::string library)
+      : d_(d), symbol_(std::move(symbol)), library_(std::move(library)) {}
+
+  template <typename... A>
+  ObjectRef Remote(A&&... a);
+
+ private:
+  Driver* d_;
+  std::string symbol_, library_;
+};
+
+class Driver {
+ public:
+  // Connects to a running cluster's raylet. The driver advertises
+  // `owner_host` (defaults to the raylet's host — correct whenever driver
+  // and raylet share a machine or routable hostname).
+  Driver(const std::string& raylet_host, int raylet_port,
+         const std::string& owner_host = "")
+      : raylet_(new RpcClient(raylet_host, raylet_port)),
+        owner_host_(owner_host.empty() ? raylet_host : owner_host) {
+    start_owner_server();
+    job_id_ = rtpu_wire::random_hex(4);
+  }
+
+  ~Driver() {
+    stopping_ = true;
+    if (wake_fd_ >= 0) {
+      char b = 'x';
+      (void)!write(wake_fd_, &b, 1);
+    }
+    if (server_.joinable()) server_.join();
+    if (listen_fd_ >= 0) close(listen_fd_);
+    if (wake_fd_ >= 0) close(wake_fd_);
+    if (wake_rd_ >= 0) close(wake_rd_);
+  }
+
+  TaskHandle Task(const std::string& symbol, const std::string& library) {
+    return TaskHandle(this, symbol, library);
+  }
+
+  // Submit a cross-language task; args are msgpack Values (see V/Bin/List).
+  ObjectRef Submit(const std::string& library, const std::string& symbol,
+                   const std::vector<Value>& args) {
+    std::string task_id = rtpu_wire::random_hex(24);
+    Packer p;
+    p.map_header(1);
+    p.str("spec");
+    p.map_header(8);
+    p.str("task_id"); p.str(task_id);
+    p.str("job_id"); p.str(job_id_);
+    p.str("name"); p.str("cpp:" + symbol);
+    p.str("function_key"); p.str("cpp!" + library + "!" + symbol);
+    p.str("language"); p.str("cpp");
+    p.str("args");
+    p.array_header((uint32_t)args.size());
+    for (const Value& a : args) {
+      Packer ap;
+      pack_value(ap, a);
+      p.array_header(2);
+      p.str("v");
+      p.bin(rtpu_wire::encode_x_object(ap.out, "x"));
+    }
+    p.str("owner_addr");
+    p.array_header(2);
+    p.str(owner_host_);
+    p.integer(owner_port_);
+    p.str("resources");
+    p.map_header(1);
+    p.str("CPU"); p.integer(1);
+    std::lock_guard<std::mutex> lk(raylet_mu_);
+    Value r = raylet_->call("submit_task", p.out);
+    const Value* ok = r.get("ok");
+    if (ok && !ok->truthy()) throw std::runtime_error("submit_task rejected");
+    return ObjectRef{task_id};
+  }
+
+  // Block until the task's result arrives at this owner; decode and return
+  // the msgpack value. Throws TaskFailed on task error, GetTimeout on
+  // timeout.
+  Value Get(const ObjectRef& ref, int timeout_ms = 60000) {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (!cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                      [&] { return done_.count(ref.task_id) > 0; }))
+      throw GetTimeout("no result for task " + ref.task_id.substr(0, 8));
+    // Results stay cached so Get is repeatable (ray.get semantics); the
+    // cache is FIFO-bounded (kMaxDone) so abandoned refs cannot grow the
+    // owner without bound.
+    Value payload = done_[ref.task_id];
+    lk.unlock();
+
+    const Value* err = payload.get("error");
+    if (err && err->kind == Value::BIN) {
+      Value einfo;
+      std::string derr;
+      if (rtpu_wire::decode_x_object(err->s, "xe", &einfo, &derr)) {
+        const Value* msg = einfo.get("message");
+        throw TaskFailed(msg ? msg->s : "task failed");
+      }
+      throw TaskFailed("task failed (non-native error payload)");
+    }
+    const Value* results = payload.get("results");
+    if (!results || results->arr.empty())
+      throw TaskFailed("task completed with no results");
+    const Value& entry = results->arr[0];
+    if (entry.arr.size() < 3 || entry.arr[1].s != "inline")
+      throw TaskFailed("non-inline result (not supported by the C++ driver)");
+    Value out;
+    std::string derr;
+    if (!rtpu_wire::decode_x_object(entry.arr[2].s, "x", &out, &derr))
+      throw TaskFailed("result decode failed: " + derr);
+    return out;
+  }
+
+ private:
+  // Owner-side server: accepts connections from workers and records
+  // task_done payloads (the reference's owner-routed result path).
+  void start_owner_server() {
+    listen_fd_ = socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = 0;
+    if (bind(listen_fd_, (sockaddr*)&addr, sizeof(addr)) != 0 ||
+        listen(listen_fd_, 16) != 0)
+      throw std::runtime_error("owner server listen failed");
+    socklen_t alen = sizeof(addr);
+    getsockname(listen_fd_, (sockaddr*)&addr, &alen);
+    owner_port_ = ntohs(addr.sin_port);
+    int pipefd[2];
+    if (pipe(pipefd) != 0) throw std::runtime_error("pipe failed");
+    wake_rd_ = pipefd[0];
+    wake_fd_ = pipefd[1];
+    server_ = std::thread([this] { serve(); });
+  }
+
+  void serve() {
+    std::vector<int> conns;
+    std::map<int, std::string> bufs;
+    while (!stopping_) {
+      std::vector<pollfd> fds;
+      fds.push_back({listen_fd_, POLLIN, 0});
+      fds.push_back({wake_rd_, POLLIN, 0});
+      for (int fd : conns) fds.push_back({fd, POLLIN, 0});
+      if (poll(fds.data(), fds.size(), 1000) < 0) break;
+      if (stopping_) break;
+      if (fds[0].revents & POLLIN) {
+        int c = accept(listen_fd_, nullptr, nullptr);
+        if (c >= 0) { conns.push_back(c); bufs[c] = ""; }
+      }
+      for (size_t i = 2; i < fds.size(); ++i) {
+        if (!(fds[i].revents & (POLLIN | POLLHUP | POLLERR))) continue;
+        int fd = fds[i].fd;
+        char chunk[65536];
+        ssize_t n = read(fd, chunk, sizeof chunk);
+        if (n <= 0) {
+          close(fd);
+          conns.erase(std::find(conns.begin(), conns.end(), fd));
+          bufs.erase(fd);
+          continue;
+        }
+        std::string& buf = bufs[fd];
+        buf.append(chunk, (size_t)n);
+        while (buf.size() >= 4) {
+          uint32_t blen = ntohl(*(const uint32_t*)buf.data());
+          if (buf.size() < 4 + (size_t)blen) break;
+          std::string body = buf.substr(4, blen);
+          buf.erase(0, 4 + blen);
+          try {
+            handle_frame(fd, body);
+          } catch (const std::exception&) {
+            // Malformed frame: drop it, keep the connection.
+          }
+        }
+      }
+    }
+  }
+
+  void handle_frame(int fd, const std::string& body) {
+    Unpacker up(body);
+    Value msg = up.decode();
+    int64_t seq = msg.arr.at(1).i;
+    const std::string& method = msg.arr.at(2).s;
+    Packer resp;
+    resp.array_header(4);
+    resp.integer(1);  // RESPONSE
+    resp.integer(seq);
+    resp.str(method);
+    resp.map_header(1);
+    resp.str("ok");
+    resp.boolean(true);
+    rtpu_wire::send_all(fd, rtpu_wire::frame(resp.out));
+    if (method == "task_done") {
+      const Value& payload = msg.arr.at(3);
+      const Value* tid = payload.get("task_id");
+      if (tid) {
+        std::lock_guard<std::mutex> lk(mu_);
+        if (done_.emplace(tid->s, payload).second) {
+          done_order_.push_back(tid->s);
+          while (done_order_.size() > kMaxDone) {
+            done_.erase(done_order_.front());
+            done_order_.pop_front();
+          }
+        }
+      }
+      cv_.notify_all();
+    }  // other owner RPCs (ping, location queries) are ok-acked above
+  }
+
+  std::unique_ptr<RpcClient> raylet_;
+  std::mutex raylet_mu_;
+  std::string owner_host_;
+  std::string job_id_;
+  int owner_port_ = 0;
+  int listen_fd_ = -1;
+  int wake_fd_ = -1;
+  int wake_rd_ = -1;
+  std::thread server_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  static const size_t kMaxDone = 4096;
+  std::map<std::string, Value> done_;
+  std::deque<std::string> done_order_;
+  std::atomic<bool> stopping_{false};
+};
+
+template <typename... A>
+ObjectRef TaskHandle::Remote(A&&... a) {
+  std::vector<Value> args{std::forward<A>(a)...};
+  return d_->Submit(library_, symbol_, args);
+}
+
+}  // namespace rtpu

@@ -32,92 +32,11 @@
 #include <string>
 #include <vector>
 
-#include "msgpack_mini.h"
 
-// ---------------------------------------------------------------------------
-// RPC client: 4-byte BE length + msgpack [type, seq, method, payload].
-// ---------------------------------------------------------------------------
-struct RpcClient {
-  int fd = -1;
-  uint32_t seq = 0;
+#include "ray_tpu_wire.h"
 
-  RpcClient(const std::string& host, int port) {
-    fd = socket(AF_INET, SOCK_STREAM, 0);
-    if (fd < 0) throw std::runtime_error("socket() failed");
-    sockaddr_in addr{};
-    addr.sin_family = AF_INET;
-    addr.sin_port = htons((uint16_t)port);
-    if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1)
-      throw std::runtime_error("bad host " + host);
-    if (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0)
-      throw std::runtime_error("connect to " + host + " failed");
-  }
-  ~RpcClient() { if (fd >= 0) close(fd); }
-
-  void send_all(const std::string& buf) {
-    size_t off = 0;
-    while (off < buf.size()) {
-      ssize_t n = write(fd, buf.data() + off, buf.size() - off);
-      if (n <= 0) throw std::runtime_error("write failed");
-      off += (size_t)n;
-    }
-  }
-  std::string read_exact(size_t n) {
-    std::string buf(n, '\0');
-    size_t off = 0;
-    while (off < n) {
-      ssize_t got = read(fd, &buf[off], n - off);
-      if (got <= 0) throw std::runtime_error("read failed");
-      off += (size_t)got;
-    }
-    return buf;
-  }
-
-  // payload_body: pre-packed msgpack for the payload element.
-  Value call(const std::string& method, const std::string& payload_body) {
-    Packer pk;
-    pk.array_header(4);
-    pk.integer(0);  // REQUEST
-    pk.integer(++seq);
-    pk.str(method);
-    pk.out += payload_body;
-    std::string frame;
-    uint32_t len = htonl((uint32_t)pk.out.size());
-    frame.append((const char*)&len, 4);
-    frame += pk.out;
-    send_all(frame);
-    for (;;) {
-      std::string hdr = read_exact(4);
-      uint32_t blen = ntohl(*(const uint32_t*)hdr.data());
-      std::string body = read_exact(blen);
-      Unpacker up(body);
-      Value msg = up.decode();
-      int64_t mtype = msg.arr.at(0).i;
-      if (mtype == 3) continue;  // PUSH frames are not ours to handle
-      if ((uint32_t)msg.arr.at(1).i != seq) continue;  // stale response
-      if (mtype == 2) {  // ERROR payload is {"error": ..., "traceback": ...}
-        const Value& pl = msg.arr.at(3);
-        const Value* detail = pl.get("error");
-        throw std::runtime_error("rpc error from " + method + ": " +
-                                 (detail ? detail->s : pl.s));
-      }
-      return msg.arr.at(3);
-    }
-  }
-};
-
-static std::string random_hex(size_t nbytes) {
-  static const char* digits = "0123456789abcdef";
-  std::random_device rd;
-  std::mt19937_64 gen(rd());
-  std::string out;
-  for (size_t i = 0; i < nbytes; ++i) {
-    uint8_t b = (uint8_t)(gen() & 0xff);
-    out.push_back(digits[b >> 4]);
-    out.push_back(digits[b & 0x0f]);
-  }
-  return out;
-}
+using rtpu_wire::RpcClient;
+using rtpu_wire::random_hex;
 
 static std::string from_hex(const std::string& hex) {
   std::string out;

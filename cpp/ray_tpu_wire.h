@@ -1,0 +1,181 @@
+// Shared native wire-protocol helpers for the ray_tpu C++ surfaces
+// (worker runtime, driver API): length-prefixed msgpack framing, a small
+// blocking RPC client with hostname resolution, the framework object codec
+// (serialization.py wire format), and id helpers.
+#pragma once
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <random>
+#include <stdexcept>
+#include <string>
+
+#include "msgpack_mini.h"
+
+namespace rtpu_wire {
+
+inline void send_all(int fd, const std::string& buf) {
+  size_t off = 0;
+  while (off < buf.size()) {
+    ssize_t n = write(fd, buf.data() + off, buf.size() - off);
+    if (n <= 0) throw std::runtime_error("write failed");
+    off += (size_t)n;
+  }
+}
+
+inline bool read_exact(int fd, char* out, size_t n) {
+  size_t off = 0;
+  while (off < n) {
+    ssize_t got = read(fd, out + off, n - off);
+    if (got <= 0) return false;
+    off += (size_t)got;
+  }
+  return true;
+}
+
+// [4-byte BE length][body]
+inline std::string frame(const std::string& body) {
+  std::string out;
+  uint32_t len = htonl((uint32_t)body.size());
+  out.append((const char*)&len, 4);
+  out += body;
+  return out;
+}
+
+// Blocking RPC client: requests are [0, seq, method, payload]; responses
+// [1, seq, payload]; [2, ...] is an error; [3, ...] PUSH frames are skipped.
+struct RpcClient {
+  int fd = -1;
+  uint32_t seq = 0;
+  std::string host;
+  int port = 0;
+
+  RpcClient(const std::string& h, int p) : host(h), port(p) { connect_now(); }
+  ~RpcClient() {
+    if (fd >= 0) close(fd);
+  }
+  RpcClient(const RpcClient&) = delete;
+  RpcClient& operator=(const RpcClient&) = delete;
+
+  void connect_now() {
+    fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) throw std::runtime_error("socket() failed");
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons((uint16_t)port);
+    if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+      // Not a numeric IP — resolve (daemons may advertise a hostname).
+      addrinfo hints{}, *res = nullptr;
+      hints.ai_family = AF_INET;
+      hints.ai_socktype = SOCK_STREAM;
+      if (getaddrinfo(host.c_str(), nullptr, &hints, &res) != 0 || !res)
+        throw std::runtime_error("cannot resolve host " + host);
+      addr.sin_addr = ((sockaddr_in*)res->ai_addr)->sin_addr;
+      freeaddrinfo(res);
+    }
+    if (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0)
+      throw std::runtime_error("connect to " + host + " failed");
+  }
+
+  Value call(const std::string& method, const std::string& payload_body) {
+    Packer pk;
+    pk.array_header(4);
+    pk.integer(0);  // REQUEST
+    pk.integer(++seq);
+    pk.str(method);
+    pk.out += payload_body;
+    send_all(fd, frame(pk.out));
+    for (;;) {
+      char hdr[4];
+      if (!read_exact(fd, hdr, 4)) throw std::runtime_error("rpc read failed");
+      uint32_t blen = ntohl(*(const uint32_t*)hdr);
+      std::string body(blen, '\0');
+      if (!read_exact(fd, &body[0], blen)) throw std::runtime_error("rpc read failed");
+      Unpacker up(body);
+      Value msg = up.decode();
+      int64_t mtype = msg.arr.at(0).i;
+      if (mtype == 3) continue;  // PUSH frames (log fan-out) are not ours
+      if ((uint32_t)msg.arr.at(1).i != seq) continue;
+      if (mtype == 2) {
+        const Value* detail = msg.arr.at(3).get("error");
+        throw std::runtime_error("rpc error from " + method + ": " +
+                                 (detail ? detail->s : std::string("?")));
+      }
+      return msg.arr.at(3);
+    }
+  }
+};
+
+// --------------------------------------------------------------------------
+// Framework object codec: [4B BE hlen][msgpack {"p","b","f"}][64-pad][payload]
+// (serialization.py wire format; "x" = cross-language msgpack object,
+// "xe" = cross-language task error).
+// --------------------------------------------------------------------------
+
+static const uint64_t kAlign = 64;
+
+inline std::string encode_x_object(const std::string& payload, const char* fmt) {
+  Packer h;
+  h.map_header(3);
+  h.str("p"); h.integer((int64_t)payload.size());
+  h.str("b"); h.array_header(0);
+  h.str("f"); h.str(fmt);
+  std::string out;
+  uint32_t hlen = htonl((uint32_t)h.out.size());
+  out.append((const char*)&hlen, 4);
+  out += h.out;
+  while (out.size() % kAlign) out.push_back('\0');
+  out += payload;
+  return out;
+}
+
+// Decode an inline framework object of the expected format ("x" or "xe").
+inline bool decode_x_object(const std::string& blob, const char* want_fmt,
+                            Value* out, std::string* err) {
+  if (blob.size() < 4) { *err = "object too short"; return false; }
+  const uint8_t* d = (const uint8_t*)blob.data();
+  uint64_t hlen = ((uint64_t)d[0] << 24) | (d[1] << 16) | (d[2] << 8) | d[3];
+  if (4 + hlen > blob.size()) { *err = "bad header length"; return false; }
+  Unpacker hu(d + 4, (size_t)hlen);
+  Value h = hu.decode();
+  const Value* f = h.get("f");
+  const Value* p = h.get("p");
+  if (!f || f->s != want_fmt || !p) {
+    *err = std::string("object is not format-\"") + want_fmt +
+           "\" (cross-language msgpack)";
+    return false;
+  }
+  uint64_t pos = (4 + hlen + kAlign - 1) & ~(kAlign - 1);
+  if (pos + (uint64_t)p->i > blob.size()) { *err = "payload overruns object"; return false; }
+  Unpacker pu(d + pos, (size_t)p->i);
+  *out = pu.decode();
+  return true;
+}
+
+inline std::string random_hex(size_t nbytes) {
+  // Every byte drawn from the OS entropy source: a PRNG seeded from one
+  // 32-bit random_device draw would give task/job IDs only 32 bits of
+  // entropy — birthday collisions at ~90k submissions.
+  static const char* digits = "0123456789abcdef";
+  static thread_local std::random_device rd;
+  std::string out;
+  uint32_t pool = 0;
+  int avail = 0;
+  for (size_t i = 0; i < nbytes; ++i) {
+    if (avail == 0) { pool = rd(); avail = 4; }
+    uint8_t b = (uint8_t)(pool & 0xff);
+    pool >>= 8;
+    --avail;
+    out.push_back(digits[b >> 4]);
+    out.push_back(digits[b & 0x0f]);
+  }
+  return out;
+}
+
+}  // namespace rtpu_wire

@@ -50,138 +50,24 @@
 #include <string>
 #include <vector>
 
-#include "msgpack_mini.h"
+#include "ray_tpu_wire.h"
 
-// ---------------------------------------------------------------------------
-// Wire helpers: 4-byte BE length + msgpack [type, seq, method, payload].
-// ---------------------------------------------------------------------------
+using rtpu_wire::RpcClient;
+using rtpu_wire::encode_x_object;
+using rtpu_wire::frame;
+using rtpu_wire::send_all;
 
-static void send_all(int fd, const std::string& buf) {
-  size_t off = 0;
-  while (off < buf.size()) {
-    ssize_t n = write(fd, buf.data() + off, buf.size() - off);
-    if (n <= 0) throw std::runtime_error("write failed");
-    off += (size_t)n;
-  }
-}
-
-static bool read_exact(int fd, char* out, size_t n) {
-  size_t off = 0;
-  while (off < n) {
-    ssize_t got = read(fd, out + off, n - off);
-    if (got <= 0) return false;
-    off += (size_t)got;
-  }
-  return true;
-}
-
-static std::string frame(const std::string& body) {
-  std::string out;
-  uint32_t len = htonl((uint32_t)body.size());
-  out.append((const char*)&len, 4);
-  out += body;
-  return out;
-}
-
-struct RpcClient {
-  int fd = -1;
-  uint32_t seq = 0;
-  std::string host;
-  int port = 0;
-
-  RpcClient(const std::string& h, int p) : host(h), port(p) { connect_now(); }
-  ~RpcClient() { if (fd >= 0) close(fd); }
-
-  void connect_now() {
-    fd = socket(AF_INET, SOCK_STREAM, 0);
-    if (fd < 0) throw std::runtime_error("socket() failed");
-    sockaddr_in addr{};
-    addr.sin_family = AF_INET;
-    addr.sin_port = htons((uint16_t)port);
-    if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
-      // Not a numeric IP — resolve (the raylet may advertise a hostname).
-      addrinfo hints{}, *res = nullptr;
-      hints.ai_family = AF_INET;
-      hints.ai_socktype = SOCK_STREAM;
-      if (getaddrinfo(host.c_str(), nullptr, &hints, &res) != 0 || !res)
-        throw std::runtime_error("cannot resolve host " + host);
-      addr.sin_addr = ((sockaddr_in*)res->ai_addr)->sin_addr;
-      freeaddrinfo(res);
-    }
-    if (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0)
-      throw std::runtime_error("connect to " + host + " failed");
-  }
-
-  Value call(const std::string& method, const std::string& payload_body) {
-    Packer pk;
-    pk.array_header(4);
-    pk.integer(0);  // REQUEST
-    pk.integer(++seq);
-    pk.str(method);
-    pk.out += payload_body;
-    send_all(fd, frame(pk.out));
-    for (;;) {
-      char hdr[4];
-      if (!read_exact(fd, hdr, 4)) throw std::runtime_error("rpc read failed");
-      uint32_t blen = ntohl(*(const uint32_t*)hdr);
-      std::string body(blen, '\0');
-      if (!read_exact(fd, &body[0], blen)) throw std::runtime_error("rpc read failed");
-      Unpacker up(body);
-      Value msg = up.decode();
-      int64_t mtype = msg.arr.at(0).i;
-      if (mtype == 3) continue;  // PUSH frames (log fan-out) are not ours
-      if ((uint32_t)msg.arr.at(1).i != seq) continue;
-      if (mtype == 2) {
-        const Value* detail = msg.arr.at(3).get("error");
-        throw std::runtime_error("rpc error from " + method + ": " +
-                                 (detail ? detail->s : std::string("?")));
-      }
-      return msg.arr.at(3);
-    }
-  }
-};
-
-// ---------------------------------------------------------------------------
-// Framework object codec: [4B BE hlen][msgpack {"p","b","f"}][64-pad][payload]
-// (serialization.py wire format; "x" = cross-language msgpack object).
-// ---------------------------------------------------------------------------
-
-static const uint64_t kAlign = 64;
-
-static std::string encode_x_object(const std::string& payload, const char* fmt) {
-  Packer h;
-  h.map_header(3);
-  h.str("p"); h.integer((int64_t)payload.size());
-  h.str("b"); h.array_header(0);
-  h.str("f"); h.str(fmt);
-  std::string out;
-  uint32_t hlen = htonl((uint32_t)h.out.size());
-  out.append((const char*)&hlen, 4);
-  out += h.out;
-  while (out.size() % kAlign) out.push_back('\0');
-  out += payload;
-  return out;
-}
-
-// Decode an inline framework object; only format-"x" is native-decodable.
-static bool decode_x_object(const std::string& blob, Value* out, std::string* err) {
-  if (blob.size() < 4) { *err = "object too short"; return false; }
-  const uint8_t* d = (const uint8_t*)blob.data();
-  uint64_t hlen = ((uint64_t)d[0] << 24) | (d[1] << 16) | (d[2] << 8) | d[3];
-  if (4 + hlen > blob.size()) { *err = "bad header length"; return false; }
-  Unpacker hu(d + 4, (size_t)hlen);
-  Value h = hu.decode();
-  const Value* f = h.get("f");
-  const Value* p = h.get("p");
-  if (!f || f->s != "x" || !p) {
-    *err = "arg is not a cross-language (format-\"x\") object — C++ workers "
-           "execute msgpack-plain args only";
+// Decode an inline framework arg; only format-"x" is native-decodable.
+static bool decode_arg(const std::string& blob, Value* out, std::string* err) {
+  if (!rtpu_wire::decode_x_object(blob, "x", out, err)) {
+    // Keep corruption diagnostics ("object too short", "bad header
+    // length", ...) verbatim; only the FORMAT mismatch gets the
+    // what-to-do-instead message.
+    if (err->rfind("object is not format-", 0) == 0)
+      *err = "arg is not a cross-language (format-\"x\") object — C++ workers "
+             "execute msgpack-plain args only";
     return false;
   }
-  uint64_t pos = (4 + hlen + kAlign - 1) & ~(kAlign - 1);
-  if (pos + (uint64_t)p->i > blob.size()) { *err = "payload overruns object"; return false; }
-  Unpacker pu(d + pos, (size_t)p->i);
-  *out = pu.decode();
   return true;
 }
 
@@ -305,7 +191,7 @@ static void execute_task(const Value& spec,
         break;
       }
       Value decoded;
-      if (!decode_x_object(a.arr[1].s, &decoded, &err)) { ok = false; break; }
+      if (!decode_arg(a.arr[1].s, &decoded, &err)) { ok = false; break; }
       pack_value(args_pk, decoded);
     }
     if (ok) ok = run_kernel(library, symbol, args_pk.out, &result_payload, &err);
