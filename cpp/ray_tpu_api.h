@@ -158,9 +158,16 @@ class Driver {
   // timeout.
   Value Get(const ObjectRef& ref, int timeout_ms = 60000) {
     std::unique_lock<std::mutex> lk(mu_);
-    if (!cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms),
-                      [&] { return done_.count(ref.task_id) > 0; }))
+    if (!cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms), [&] {
+          return done_.count(ref.task_id) > 0 || failed_.count(ref.task_id) > 0;
+        }))
       throw GetTimeout("no result for task " + ref.task_id.substr(0, 8));
+    auto fit = failed_.find(ref.task_id);
+    if (fit != failed_.end()) {
+      std::string why = fit->second;
+      lk.unlock();
+      throw TaskFailed(why);  // raylet-reported worker death (task_failed)
+    }
     // Results stay cached so Get is repeatable (ray.get semantics); the
     // cache is FIFO-bounded (kMaxDone) so abandoned refs cannot grow the
     // owner without bound.
@@ -220,7 +227,10 @@ class Driver {
       fds.push_back({listen_fd_, POLLIN, 0});
       fds.push_back({wake_rd_, POLLIN, 0});
       for (int fd : conns) fds.push_back({fd, POLLIN, 0});
-      if (poll(fds.data(), fds.size(), 1000) < 0) break;
+      if (poll(fds.data(), fds.size(), 1000) < 0) {
+        if (errno == EINTR) continue;  // a stray signal must not kill Get()
+        break;
+      }
       if (stopping_) break;
       if (fds[0].revents & POLLIN) {
         int c = accept(listen_fd_, nullptr, nullptr);
@@ -282,6 +292,20 @@ class Driver {
         }
       }
       cv_.notify_all();
+    } else if (method == "task_failed") {
+      // The raylet reports worker death (crash/OOM) to the owner; surface
+      // it from Get immediately with the reason instead of a blind
+      // GetTimeout 60s later.
+      const Value& payload = msg.arr.at(3);
+      const Value* tid = payload.get("task_id");
+      if (tid) {
+        const Value* etype = payload.get("error");
+        const Value* emsg = payload.get("message");
+        std::lock_guard<std::mutex> lk(mu_);
+        failed_[tid->s] = (etype ? etype->s : std::string("TaskFailed")) +
+                          (emsg ? ": " + emsg->s : std::string());
+      }
+      cv_.notify_all();
     }  // other owner RPCs (ping, location queries) are ok-acked above
   }
 
@@ -298,6 +322,7 @@ class Driver {
   std::condition_variable cv_;
   static const size_t kMaxDone = 4096;
   std::map<std::string, Value> done_;
+  std::map<std::string, std::string> failed_;
   std::deque<std::string> done_order_;
   std::atomic<bool> stopping_{false};
 };

@@ -23,7 +23,9 @@ namespace rtpu_wire {
 inline void send_all(int fd, const std::string& buf) {
   size_t off = 0;
   while (off < buf.size()) {
-    ssize_t n = write(fd, buf.data() + off, buf.size() - off);
+    // MSG_NOSIGNAL: a peer that resets mid-write must surface as EPIPE
+    // (caught by callers), not a process-killing SIGPIPE.
+    ssize_t n = send(fd, buf.data() + off, buf.size() - off, MSG_NOSIGNAL);
     if (n <= 0) throw std::runtime_error("write failed");
     off += (size_t)n;
   }

@@ -294,7 +294,10 @@ int main() {
       fds.push_back({lfd, POLLIN, 0});
       for (int fd : conns) fds.push_back({fd, POLLIN, 0});
       int nready = poll(fds.data(), fds.size(), 2000);
-      if (nready < 0) throw std::runtime_error("poll failed");
+      if (nready < 0) {
+        if (errno == EINTR) continue;  // stray signal must not kill the worker
+        throw std::runtime_error("poll failed");
+      }
       // Idle liveness probe: workers exit if the parent raylet dies
       // (reference: core_worker.cc ExitIfParentRayletDies).
       if (time(nullptr) - last_probe >= 2) {

@@ -1,0 +1,52 @@
+"""A fully NATIVE driver→worker round trip (the N22 C++ user API).
+
+Boots a cluster from Python (the daemons), then hands the raylet address
+to a compiled C++ program built on cpp/ray_tpu_api.h — the reference's
+`ray::Task(...).Remote()` / `ray::Get()` shape. The C++ driver submits
+language="cpp" tasks, the raylet spawns the C++ worker runtime
+(cpp/ray_tpu_worker.cc) to execute them, and results are pushed back to
+the driver's own owner-side server: once the cluster is up, neither the
+driver nor the worker runs any Python.
+
+Run: python examples/cpp_native_driver.py
+"""
+
+import os
+import subprocess
+import tempfile
+
+
+def main():
+    import ray_tpu
+    from ray_tpu._private.cpp_worker import cpp_worker_binary
+    from ray_tpu._private.worker_context import get_core_worker
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    build = tempfile.mkdtemp()
+    so = os.path.join(build, "libxlang_kernels.so")
+    driver = os.path.join(build, "api_example")
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", so,
+         os.path.join(repo, "cpp", "xlang_kernels.cc")],
+        check=True,
+    )
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17", "-o", driver,
+         os.path.join(repo, "cpp", "api_example.cc"), "-lpthread"],
+        check=True,
+    )
+    # Pre-build the native worker so the first task runs in it (otherwise
+    # the pool serves a Python fallback while g++ runs in the background).
+    assert cpp_worker_binary() is not None
+
+    ray_tpu.init(num_cpus=2)
+    host, port = get_core_worker().raylet.address
+    out = subprocess.run(
+        [driver, host, str(port), so], capture_output=True, text=True, check=True
+    )
+    print(out.stdout, end="")
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -272,7 +272,24 @@ def kill(actor: ActorHandle, *, no_restart: bool = True):
     from ray_tpu._private import worker_context
 
     cw = worker_context.get_core_worker()
-    cw.gcs.call("kill_actor", {"actor_id": actor.actor_id, "no_restart": no_restart})
+    # Bounded AND best-effort: a wedged GCS/worker must not block the
+    # caller forever (a Tune controller hung here for 90 minutes when a
+    # recycled worker port swallowed the GCS's kill_self relay), and kill
+    # has never raised on slow delivery — swallow the timeout, the GCS
+    # actor reaper finishes the job.
+    import logging
+
+    try:
+        cw.gcs.call(
+            "kill_actor",
+            {"actor_id": actor.actor_id, "no_restart": no_restart},
+            timeout=10,
+        )
+    except TimeoutError:
+        logging.getLogger(__name__).warning(
+            "kill(%s) did not confirm within the timeout; actor teardown "
+            "continues asynchronously", actor.actor_id[:8],
+        )
 
 
 def get_actor(name: str, namespace: str = "") -> ActorHandle:

@@ -355,7 +355,18 @@ class GcsServer:
         if addr:
             try:
                 client = RpcClient(tuple(addr), label="actor-worker")
-                await client.acall("kill_self", {"no_restart": no_restart})
+                # Best-effort and BOUNDED: the worker address is ephemeral
+                # and may have been reused by an unrelated listener that
+                # accepts but never replies (observed: a cycled port landing
+                # on a non-framework server hung this await — and with it
+                # the caller's no-timeout kill() — forever). The worker
+                # reaper + actor-updates publish cover delivery failure.
+                # Outer wait_for: acall RETRIES TimeoutError internally, so
+                # a per-attempt timeout alone would still take 4x + sleeps.
+                await asyncio.wait_for(
+                    client.acall("kill_self", {"no_restart": no_restart}, timeout=5),
+                    timeout=5,
+                )
                 client.close()
             except Exception:
                 pass

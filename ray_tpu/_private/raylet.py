@@ -1425,14 +1425,20 @@ class Raylet:
             if spec.owner_addr:
                 try:
                     owner = RpcClient(tuple(spec.owner_addr), label="owner")
-                    await owner.acall(
-                        "task_failed",
-                        {
-                            "task_id": spec.task_id,
-                            "error": "OutOfMemoryError" if oom else "WorkerCrashedError",
-                            "message": reason,
-                            "retriable": True,
-                        },
+                    # Bounded: the owner address may be a dead driver's
+                    # recycled port (same hazard as the GCS kill_self relay).
+                    await asyncio.wait_for(
+                        owner.acall(
+                            "task_failed",
+                            {
+                                "task_id": spec.task_id,
+                                "error": "OutOfMemoryError" if oom else "WorkerCrashedError",
+                                "message": reason,
+                                "retriable": True,
+                            },
+                            timeout=5,
+                        ),
+                        timeout=5,
                     )
                     owner.close()
                 except Exception:

@@ -22,6 +22,7 @@ EXAMPLES = [
     ("serve_composed.py", [], "math:"),
     ("rllib_offline.py", [], "expert agreement:"),
     ("speculative_decode.py", [], "exact-output speculative decoding ok"),
+    ("cpp_native_driver.py", [], "CPP_API_PASS"),
 ]
 
 
