@@ -162,9 +162,11 @@ class Driver {
           return done_.count(ref.task_id) > 0 || failed_.count(ref.task_id) > 0;
         }))
       throw GetTimeout("no result for task " + ref.task_id.substr(0, 8));
-    auto fit = failed_.find(ref.task_id);
-    if (fit != failed_.end()) {
-      std::string why = fit->second;
+    // done_ wins over failed_: a worker can deliver the result and THEN
+    // crash before telling the raylet — the late task_failed must not turn
+    // an already-delivered success into an error on a repeated Get.
+    if (done_.count(ref.task_id) == 0) {
+      std::string why = failed_[ref.task_id];
       lk.unlock();
       throw TaskFailed(why);  // raylet-reported worker death (task_failed)
     }
@@ -302,8 +304,20 @@ class Driver {
         const Value* etype = payload.get("error");
         const Value* emsg = payload.get("message");
         std::lock_guard<std::mutex> lk(mu_);
-        failed_[tid->s] = (etype ? etype->s : std::string("TaskFailed")) +
-                          (emsg ? ": " + emsg->s : std::string());
+        // Shares done_'s FIFO bound (failures of abandoned refs must not
+        // grow the owner forever), and never shadows a delivered result.
+        if (done_.count(tid->s) == 0 &&
+            failed_.emplace(tid->s,
+                            (etype ? etype->s : std::string("TaskFailed")) +
+                                (emsg ? ": " + emsg->s : std::string()))
+                .second) {
+          done_order_.push_back(tid->s);
+          while (done_order_.size() > kMaxDone) {
+            done_.erase(done_order_.front());
+            failed_.erase(done_order_.front());
+            done_order_.pop_front();
+          }
+        }
       }
       cv_.notify_all();
     }  // other owner RPCs (ping, location queries) are ok-acked above

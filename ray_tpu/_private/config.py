@@ -60,6 +60,11 @@ class Config:
     lease_max_per_shape: int = 8   # concurrent leases per (env, resources)
     lease_idle_release_s: float = 0.5  # linger before returning an idle lease
     worker_idle_timeout_s: float = 300.0  # idle workers kept warm for reuse
+    # Lost-task sweep (core_worker._sweep_lost_tasks): raylet-path specs can
+    # die WITH a spilled-to node; owners locate aged pending tasks across
+    # alive raylets and resubmit ones held by nobody.
+    lost_task_sweep_interval_s: float = 15.0
+    lost_task_age_s: float = 30.0
     max_workers_per_node: int = 64
     worker_startup_timeout_s: float = 60.0
     scheduler_spread_threshold: float = 0.5  # hybrid policy pack->spread knob

@@ -83,6 +83,15 @@ class PendingTask:
     # Task that submitted this one (the executing task's id when submitted
     # from inside a worker) — drives recursive cancellation.
     parent_task_id: str = ""
+    # Lost-task sweep bookkeeping (raylet-path tasks only): server-side
+    # spillback means a spec can die WITH a node and be held by nobody;
+    # the owner sweeps alive raylets (locate_tasks) and resubmits specs
+    # found nowhere twice in a row. via_lease tasks are excluded — the
+    # lease manager owns their failover.
+    via_lease: bool = False
+    submitted_ts: float = 0.0
+    sweep_misses: int = 0
+    sweep_resubmits: int = 0
 
 
 @dataclass
@@ -196,6 +205,10 @@ class CoreWorker:
         # Direct task transport (lease_manager.py), created on first
         # eligible submit.
         self._lease_mgr = None
+        # Lost-task sweep (raylet-path orphan recovery), started on first
+        # non-lease submit.
+        self._lost_sweep_task = None
+        self._sweep_clients: dict[tuple, RpcClient] = {}
         # Last (job, task name) announced to the log pipeline (in-band
         # attribution).
         self._log_attr_name: tuple | None = None
@@ -624,15 +637,124 @@ class CoreWorker:
                 # "submitted" (it recalls from the transport).
                 return
             p.phase = "submitted"
-        if self._lease_eligible(spec):
+            p.submitted_ts = time.monotonic()
+            p.via_lease = self._lease_eligible(spec)
+        if p.via_lease:
             self._get_lease_manager().submit(spec)
             return
+        self._ensure_lost_task_sweeper()
         with self._submit_lock:
             self._submit_buf.append(spec)
             if self._submit_flush_scheduled:
                 return
             self._submit_flush_scheduled = True
         self._io.spawn(self._flush_submits())
+
+    # ---- lost-task sweep (raylet-path orphan recovery) -------------------
+    #
+    # Server-side spillback forwards a spec raylet-to-raylet and forgets
+    # it; a node that dies holding the spec leaves the owner waiting on
+    # its returns forever (no raylet will ever report task_done /
+    # task_failed for it). The reference avoids this shape by owner-side
+    # spillback replies (direct_task_transport.cc) — our lease path has
+    # the same owner-owned failover, but SPREAD/affinity/PG/streaming
+    # tasks ride the classic raylet queue. This sweep is their safety
+    # net: aged submitted tasks are located across alive raylets
+    # (locate_tasks) and resubmitted when found nowhere twice in a row.
+
+    def _ensure_lost_task_sweeper(self):
+        # Under the lock: submit_task runs on user threads, and two racing
+        # spawns would double the sweep cadence — a single transient
+        # "not found" could then reach the two-miss confirm in one window.
+        with self._lock:
+            if self._lost_sweep_task is None and not self._shutdown:
+                self._lost_sweep_task = self._io.spawn(self._lost_task_sweep_loop())
+
+    async def _lost_task_sweep_loop(self):
+        interval = getattr(self.cfg, "lost_task_sweep_interval_s", 15.0)
+        while not self._shutdown:
+            await asyncio.sleep(interval)
+            try:
+                await self._sweep_lost_tasks()
+            except Exception:
+                logger.debug("lost-task sweep iteration failed", exc_info=True)
+
+    async def _sweep_lost_tasks(self):
+        now = time.monotonic()
+        with self._lock:
+            cands = [
+                p
+                for p in self.pending_tasks.values()
+                if p.phase == "submitted"
+                and not p.via_lease
+                and not p.cancel_requested
+                and p.spec.task_type == NORMAL_TASK
+                and now - p.submitted_ts > getattr(self.cfg, "lost_task_age_s", 30.0)
+            ]
+        if not cands:
+            return
+        resp = await self.gcs.acall("get_nodes", {}, timeout=10)
+        raylets = [
+            tuple(info["address"])
+            for info in resp.get("nodes", {}).values()
+            if info.get("state") == "ALIVE" and info.get("address")
+        ]
+        ids = [p.spec.task_id for p in cands]
+        found: set = set()
+        for addr in raylets:
+            client = self._sweep_clients.get(addr)
+            if client is None:
+                client = self._sweep_clients[addr] = RpcClient(
+                    addr, label="sweep-raylet"
+                )
+            try:
+                r = await client.acall("locate_tasks", {"task_ids": ids}, timeout=5)
+                found.update(r.get("found", []))
+            except Exception:
+                # Unreachable raylet: absence is unprovable this round —
+                # treat everything as found rather than double-execute.
+                found.update(ids)
+                self._sweep_clients.pop(addr, None)
+                client.close()
+                break
+        for p in cands:
+            tid = p.spec.task_id
+            # Re-verify under the lock: the task may have COMPLETED during
+            # the get_nodes/locate awaits above (done pops it from
+            # pending_tasks; locate then reports it nowhere) — resubmitting
+            # a finished task would re-run its side effects.
+            with self._lock:
+                live = self.pending_tasks.get(tid)
+            if live is not p or p.phase != "submitted":
+                continue
+            if tid in found or p.cancel_requested:
+                p.sweep_misses = 0
+                continue
+            p.sweep_misses += 1
+            if p.sweep_misses < 2:
+                continue  # could be mid-spillback; confirm next sweep
+            p.sweep_misses = 0
+            if p.sweep_resubmits >= 5:
+                from ray_tpu.exceptions import WorkerCrashedError
+
+                self._fail_task(
+                    tid,
+                    WorkerCrashedError(
+                        f"task {p.spec.name} ({tid[:8]}) was lost repeatedly "
+                        "(no alive raylet holds it after resubmission)"
+                    ),
+                )
+                continue
+            p.sweep_resubmits += 1
+            logger.warning(
+                "task %s (%s) held by no alive raylet; resubmitting (%d/5)",
+                tid[:8], p.spec.name, p.sweep_resubmits,
+            )
+            self._reset_stream_for_retry(tid)
+            try:
+                await self.raylet.acall("submit_task", {"spec": p.spec.to_wire()})
+            except Exception:
+                logger.warning("lost-task resubmit of %s failed", tid[:8])
 
     async def _flush_submits(self) -> None:
         await asyncio.sleep(0)  # let the submitting thread's burst accumulate
@@ -1984,6 +2106,12 @@ class CoreWorker:
 
     def shutdown(self, job_state: str | None = None):
         self._shutdown = True
+        if self._lost_sweep_task is not None:
+            self._lost_sweep_task.cancel()
+            self._lost_sweep_task = None
+        for c in list(self._sweep_clients.values()):
+            c.close()
+        self._sweep_clients.clear()
         if self._lease_mgr is not None:
             try:
                 self._lease_mgr.close()

@@ -353,6 +353,7 @@ class GcsServer:
                 self.named_actors.pop((info["namespace"], info["name"]), None)
                 self._wal("named_actors", (info["namespace"], info["name"]))
         if addr:
+            client = None
             try:
                 client = RpcClient(tuple(addr), label="actor-worker")
                 # Best-effort and BOUNDED: the worker address is ephemeral
@@ -367,9 +368,11 @@ class GcsServer:
                     client.acall("kill_self", {"no_restart": no_restart}, timeout=5),
                     timeout=5,
                 )
-                client.close()
             except Exception:
                 pass
+            finally:
+                if client is not None:
+                    client.close()  # timeout path must not leak the socket
         if no_restart:
             await self._publish("actor_updates", {"actor_id": actor_id, "state": DEAD, "reason": "killed"})
         return {"ok": True}

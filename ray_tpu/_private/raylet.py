@@ -139,6 +139,10 @@ class Raylet:
         # these is refused and the orphan reaped.
         self._retired_worker_ids: set[str] = set()
         self.task_queue: deque[TaskSpec] = deque()
+        # Specs currently being forwarded to a peer (out of the queue, the
+        # forward RPC in flight): visible to rpc_locate_tasks so the owner's
+        # lost-task sweep never mistakes a mid-spillback task for lost.
+        self._forwarding: set[str] = set()
         # Tasks whose resources/pool/placement can't currently be satisfied
         # park here instead of rotating through task_queue (reference keeps a
         # separate infeasible queue too, cluster_task_manager.h). They are
@@ -300,7 +304,11 @@ class Raylet:
         stuck = [s for s in self.task_queue if self._must_reroute(s)]
         for spec in stuck:
             self.task_queue.remove(spec)
-            await self._queue_and_schedule(spec)
+            self._forwarding.add(spec.task_id)
+            try:
+                await self._queue_and_schedule(spec)
+            finally:
+                self._forwarding.discard(spec.task_id)
 
     def _must_reroute(self, spec: TaskSpec) -> bool:
         if spec.placement_group_id:
@@ -680,6 +688,30 @@ class Raylet:
         await self._queue_and_schedule(spec)
         return {"ok": True}
 
+    @schema(task_ids=list)
+    async def rpc_locate_tasks(self, req):
+        """Which of these task ids does THIS raylet currently hold (queued,
+        infeasible, or executing on a worker)? Owners sweep this across
+        alive nodes to find tasks orphaned by server-side spillback: a spec
+        forwarded to a node that died with it is held by NOBODY, and
+        without the sweep the owner would wait on its returns forever
+        (observed: a chaos-killed node took queued shuffle tasks with it
+        and dataset.sum() hung)."""
+        wanted = set(req["task_ids"])
+        found = [tid for tid in self._forwarding if tid in wanted]
+        for q in (self.task_queue, self._infeasible):
+            for spec in q:
+                if spec.task_id in wanted:
+                    found.append(spec.task_id)
+        for w in self.workers.values():
+            cur = w.current_task
+            if cur is not None and cur.task_id in wanted:
+                found.append(cur.task_id)
+            # Leased workers execute owner-shipped specs the raylet does not
+            # see; the lease manager owns THOSE tasks' failover, and the
+            # owner's sweep excludes lease-path tasks entirely.
+        return {"found": found}
+
     # ---- task cancellation (reference: node_manager.cc HandleCancelTask +
     # cluster_task_manager.cc CancelTask) ----
 
@@ -787,11 +819,14 @@ class Raylet:
             # Spillback (reference: cluster_task_manager.cc:44 + spillback reply).
             node = self.cluster_view.get(target)
             if node is not None:
+                self._forwarding.add(spec.task_id)
                 try:
                     await self._peer(target, node["address"]).acall("submit_task", {"spec": spec.to_wire()})
                     return
                 except Exception:
                     pass
+                finally:
+                    self._forwarding.discard(spec.task_id)
         self.task_queue.append(spec)
         if dispatch:
             await self._dispatch()
@@ -1423,26 +1458,31 @@ class Raylet:
             self._release_for(spec)
             # Tell the owner so it can retry (reference: task_manager.h:335).
             if spec.owner_addr:
+                owner = None
                 try:
                     owner = RpcClient(tuple(spec.owner_addr), label="owner")
-                    # Bounded: the owner address may be a dead driver's
-                    # recycled port (same hazard as the GCS kill_self relay).
-                    await asyncio.wait_for(
-                        owner.acall(
-                            "task_failed",
-                            {
-                                "task_id": spec.task_id,
-                                "error": "OutOfMemoryError" if oom else "WorkerCrashedError",
-                                "message": reason,
-                                "retriable": True,
-                            },
-                            timeout=5,
-                        ),
+                    # Per-attempt timeout, retries KEPT (acall retries
+                    # TimeoutError/ConnectionLost): losing this notification
+                    # hangs the owner's wait() forever, so transient owner
+                    # stalls (chaos load on a small box) must be retried —
+                    # a single 5s shot dropped deaths and deadlocked the
+                    # chaos suite. Total stays bounded (~20s) against the
+                    # recycled-port black hole.
+                    await owner.acall(
+                        "task_failed",
+                        {
+                            "task_id": spec.task_id,
+                            "error": "OutOfMemoryError" if oom else "WorkerCrashedError",
+                            "message": reason,
+                            "retriable": True,
+                        },
                         timeout=5,
                     )
-                    owner.close()
                 except Exception:
                     pass
+                finally:
+                    if owner is not None:
+                        owner.close()  # failed-delivery path must not leak
         if prev_state == "actor" and worker.actor_id:
             try:
                 await self.gcs.acall(
