@@ -33,6 +33,7 @@
 #include <map>
 #include <memory>
 #include <mutex>
+#include <set>
 #include <stdexcept>
 #include <string>
 #include <thread>
@@ -162,6 +163,10 @@ class Driver {
           return done_.count(ref.task_id) > 0 || failed_.count(ref.task_id) > 0;
         }))
       throw GetTimeout("no result for task " + ref.task_id.substr(0, 8));
+    // Mark consumed (either outcome): consumed entries are preferred for
+    // eviction once the cache bound is hit.
+    if (consumed_.insert(ref.task_id).second)
+      consumed_order_.push_back(ref.task_id);
     // done_ wins over failed_: a worker can deliver the result and THEN
     // crash before telling the raylet — the late task_failed must not turn
     // an already-delivered success into an error on a repeated Get.
@@ -170,9 +175,11 @@ class Driver {
       lk.unlock();
       throw TaskFailed(why);  // raylet-reported worker death (task_failed)
     }
-    // Results stay cached so Get is repeatable (ray.get semantics); the
-    // cache is FIFO-bounded (kMaxDone) so abandoned refs cannot grow the
-    // owner without bound.
+    // Results stay cached so Get is repeatable (ray.get semantics) — up to
+    // the kMaxDone bound: with >4096 results cached, already-consumed
+    // entries are evicted first (then oldest unconsumed), so a repeated Get
+    // of a long-ago-consumed ref past that point times out. Abandoned refs
+    // cannot grow the owner without bound either way.
     Value payload = done_[ref.task_id];
     lk.unlock();
 
@@ -287,10 +294,7 @@ class Driver {
         std::lock_guard<std::mutex> lk(mu_);
         if (done_.emplace(tid->s, payload).second) {
           done_order_.push_back(tid->s);
-          while (done_order_.size() > kMaxDone) {
-            done_.erase(done_order_.front());
-            done_order_.pop_front();
-          }
+          enforce_bound_locked();
         }
       }
       cv_.notify_all();
@@ -312,15 +316,53 @@ class Driver {
                                 (emsg ? ": " + emsg->s : std::string()))
                 .second) {
           done_order_.push_back(tid->s);
-          while (done_order_.size() > kMaxDone) {
-            done_.erase(done_order_.front());
-            failed_.erase(done_order_.front());
-            done_order_.pop_front();
-          }
+          enforce_bound_locked();
         }
       }
       cv_.notify_all();
     }  // other owner RPCs (ping, location queries) are ok-acked above
+  }
+
+  // Evict one cached result, preferring entries the caller has already
+  // consumed via Get (oldest consumed first); only when every cached entry
+  // is still unconsumed does the oldest unconsumed go (>kMaxDone refs
+  // outstanding — abandoned refs must not grow the owner without bound).
+  // Both deques may hold ids already evicted via the other path; those are
+  // skipped lazily, which keeps eviction O(1) amortized — the bound check
+  // must therefore count the maps, not done_order_.
+  void evict_one_locked() {
+    while (!consumed_order_.empty()) {
+      const std::string id = consumed_order_.front();
+      consumed_order_.pop_front();
+      consumed_.erase(id);
+      if (done_.erase(id) + failed_.erase(id) > 0) return;
+    }
+    while (!done_order_.empty()) {
+      const std::string id = done_order_.front();
+      done_order_.pop_front();
+      if (done_.erase(id) + failed_.erase(id) > 0) return;
+    }
+  }
+
+  size_t cached_locked() const { return done_.size() + failed_.size(); }
+
+  // Bound the cache AND the order deques. Lazy skipping leaves stale ids in
+  // the deques (an id evicted via the other deque); in the every-result-
+  // consumed workload the fallback loop never runs, so without a hard cap
+  // done_order_ would leak one id per task forever. Past 2x the cache bound,
+  // force-FIFO-evict (the pre-consumed-tracking behavior).
+  void enforce_bound_locked() {
+    while (cached_locked() > kMaxDone) evict_one_locked();
+    while (done_order_.size() > 2 * kMaxDone) {
+      const std::string id = done_order_.front();
+      done_order_.pop_front();
+      done_.erase(id);
+      failed_.erase(id);
+    }
+    while (consumed_order_.size() > 2 * kMaxDone) {
+      consumed_.erase(consumed_order_.front());
+      consumed_order_.pop_front();
+    }
   }
 
   std::unique_ptr<RpcClient> raylet_;
@@ -338,6 +380,8 @@ class Driver {
   std::map<std::string, Value> done_;
   std::map<std::string, std::string> failed_;
   std::deque<std::string> done_order_;
+  std::set<std::string> consumed_;
+  std::deque<std::string> consumed_order_;
   std::atomic<bool> stopping_{false};
 };
 

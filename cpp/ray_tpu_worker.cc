@@ -336,25 +336,42 @@ int main() {
           if (buf.size() < 4 + (size_t)blen) break;
           std::string body = buf.substr(4, blen);
           buf.erase(0, 4 + blen);
-          Unpacker up(body);
-          Value msg = up.decode();
-          int64_t seq = msg.arr.at(1).i;
-          const std::string& method = msg.arr.at(2).s;
+          // Decode under a narrow catch: one malformed frame from a peer
+          // must not kill the worker (the driver's serve() drops these
+          // too). Ack/execute failures stay OUTSIDE it — they must keep
+          // propagating to the outer handler so the worker dies and the
+          // raylet reports task_failed, instead of silently leaking the
+          // lease with the owner blocked.
+          Value msg;
+          int64_t seq;
+          const std::string* method;
+          try {
+            Unpacker up(body);
+            msg = up.decode();
+            seq = msg.arr.at(1).i;
+            method = &msg.arr.at(2).s;
+          } catch (const std::exception& e) {
+            fprintf(stderr, "cpp_worker: dropped malformed frame: %s\n",
+                    e.what());
+            continue;
+          }
           // Reply first (the Python worker acks push_task before
           // executing too), then run the task synchronously.
           Packer resp;
           resp.array_header(4);
           resp.integer(1);  // RESPONSE
           resp.integer(seq);
-          resp.str(method);
+          resp.str(*method);
           resp.map_header(1);
           resp.str("ok");
           resp.boolean(true);
           send_all(fd, frame(resp.out));
-          if (method == "push_task") {
-            const Value* spec = msg.arr.at(3).get("spec");
+          if (*method == "push_task") {
+            // Bounds-checked: a 3-element frame is malformed, not fatal.
+            const Value* spec =
+                msg.arr.size() > 3 ? msg.arr[3].get("spec") : nullptr;
             if (spec) execute_task(*spec, owners);
-          } else if (method == "kill_self") {
+          } else if (*method == "kill_self") {
             return 0;
           }  // lease_ping / unknown: ok-ack above suffices
         }

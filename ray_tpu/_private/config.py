@@ -117,6 +117,13 @@ class Config:
     # --- collectives ---
     collective_rendezvous_timeout_s: float = 60.0
 
+    # --- GCS durability ---
+    # WAL sync policy: "0" = flush only (page cache: survives process kill),
+    # "1" = fsync per mutation (survives host crash, slowest), "everysec" =
+    # batched fdatasync at most once per second (redis appendfsync-everysec
+    # class: bounded ~1s loss window on host crash). Env: RAY_TPU_WAL_FSYNC.
+    wal_fsync: str = "everysec"
+
     # --- misc ---
     session_dir_root: str = "/tmp/ray_tpu"
 

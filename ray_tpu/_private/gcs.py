@@ -76,6 +76,17 @@ class GcsServer:
         self._wal_path = persist_path + ".wal" if persist_path else None
         self._wal_file = None
         self._wal_records = 0
+        # RAY_TPU_WAL_FSYNC: "0" flush-only, "1" per-mutation fsync,
+        # "everysec" batched fdatasync (default; redis everysec class).
+        # An unrecognized value must not silently mean flush-only.
+        self._wal_fsync = str(get_config().wal_fsync).lower()
+        if self._wal_fsync not in ("0", "1", "everysec"):
+            logger.warning(
+                "unknown wal_fsync=%r; falling back to 'everysec'", self._wal_fsync
+            )
+            self._wal_fsync = "everysec"
+        self._wal_dirty = False
+        self._wal_dirty_epoch = 0
         restored = False
         if persist_path and os.path.exists(persist_path):
             self._load_snapshot()
@@ -797,8 +808,32 @@ class GcsServer:
         tick; a mutation burst coalesces into one snapshot ~150ms later —
         the crash-loss window is that debounce, not a fixed 2s period."""
         saved_at = -1
+        last_fsync = time.monotonic()
         while True:
             await asyncio.sleep(0.1)
+            # everysec WAL policy: batched fdatasync at most once per second
+            # while dirty — host-crash loss window is bounded by ~1s.
+            if (
+                self._wal_dirty
+                and self._wal_file is not None
+                and time.monotonic() - last_fsync >= 1.0
+            ):
+                # Off-loop: a slow disk's fdatasync must not stall heartbeat
+                # and lease RPC handling (redis offloads everysec fsync to a
+                # background thread for the same reason). Appends landing
+                # during the sync bump the epoch, keeping the tail dirty;
+                # only a successful sync of an unchanged epoch clears it.
+                epoch = self._wal_dirty_epoch
+                try:
+                    fd = self._wal_file.fileno()
+                    await asyncio.get_event_loop().run_in_executor(
+                        None, os.fdatasync, fd
+                    )
+                    if self._wal_dirty_epoch == epoch:
+                        self._wal_dirty = False
+                except Exception:
+                    logger.debug("wal fdatasync failed", exc_info=True)
+                last_fsync = time.monotonic()
             if self._mutations == saved_at:
                 continue  # nothing changed since the last snapshot
             await asyncio.sleep(0.05)  # coalesce the rest of the burst
@@ -827,9 +862,29 @@ class GcsServer:
         try:
             data = pickle.dumps(rec, protocol=pickle.HIGHEST_PROTOCOL)
             f.write(len(data).to_bytes(4, "big") + data)
-            f.flush()  # page cache: survives process kill (fsync would also
-            # survive machine crash; the reference's Redis default is
-            # everysec fsync — same durability class)
+            # flush reaches the page cache: survives process kill. Host-crash
+            # durability is the fsync policy's job (wal_fsync, redis
+            # appendfsync analog): "1" syncs before the handler replies,
+            # "everysec" batches fdatasync in _persist_loop (~1s loss
+            # window on host crash), "0" stops at the page cache.
+            f.flush()
+            if self._wal_fsync == "1":
+                try:
+                    os.fsync(f.fileno())
+                except OSError:
+                    # The sync-before-reply guarantee cannot hold under I/O
+                    # error; say so loudly and hand the tail to the everysec
+                    # retry path instead of silently acking as durable.
+                    logger.error(
+                        "WAL fsync failed; acknowledged mutation is NOT yet "
+                        "host-crash durable (will retry via fdatasync)",
+                        exc_info=True,
+                    )
+                    self._wal_dirty = True
+                    self._wal_dirty_epoch += 1
+            elif self._wal_fsync == "everysec":
+                self._wal_dirty = True
+                self._wal_dirty_epoch += 1
             self._wal_records += 1
         except Exception:
             logger.debug("wal append failed", exc_info=True)
@@ -881,10 +936,27 @@ class GcsServer:
         tmp = self.persist_path + ".tmp"
         with open(tmp, "wb") as f:
             pickle.dump(self._snapshot(), f)
+            # Under a syncing WAL policy the snapshot must be host-crash
+            # durable BEFORE it replaces the old one and truncates the WAL —
+            # otherwise compaction trades fsynced WAL records for page-cache
+            # bytes and an acknowledged "durable" mutation can vanish.
+            if self._wal_fsync != "0":
+                f.flush()
+                os.fsync(f.fileno())
         os.replace(tmp, self.persist_path)
+        if self._wal_fsync != "0":
+            try:
+                dfd = os.open(os.path.dirname(self.persist_path) or ".", os.O_RDONLY)
+                try:
+                    os.fsync(dfd)  # make the rename itself durable
+                finally:
+                    os.close(dfd)
+            except OSError:
+                pass
         if self._wal_file is not None:
             self._wal_file.close()
             self._wal_file = open(self._wal_path, "wb")
+            self._wal_dirty = False
             self._wal_records = 0
 
     def save_snapshot(self):

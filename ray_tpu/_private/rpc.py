@@ -360,9 +360,22 @@ class RpcClient:
             await self._writer.drain()
         return fut
 
-    async def acall(self, method: str, payload: dict | None = None, timeout: float | None = None):
-        """Async call from the IO loop."""
+    async def acall(
+        self,
+        method: str,
+        payload: dict | None = None,
+        timeout: float | None = None,
+        retries: int | None = None,
+    ):
+        """Async call from the IO loop.
+
+        ``timeout`` is PER ATTEMPT and TimeoutError is retried, so the worst
+        case block is ``(retries+1) * timeout`` plus backoff. Callers that
+        need a total bound pass ``retries=0`` (single attempt, safe only when
+        dropping the message is acceptable) or wrap in an outer wait_for.
+        """
         payload = payload or {}
+        max_retries = self._retries if retries is None else retries
         attempt = 0
         while True:
             try:
@@ -372,7 +385,7 @@ class RpcClient:
                 return await fut
             except (ConnectionLost, asyncio.TimeoutError):
                 attempt += 1
-                if self._closed or attempt > self._retries:
+                if self._closed or attempt > max_retries:
                     raise
                 await asyncio.sleep(self._retry_delay * attempt)
 
@@ -385,8 +398,14 @@ class RpcClient:
 
     # ---- blocking API (from user threads) ----
 
-    def call(self, method: str, payload: dict | None = None, timeout: float | None = None):
-        return self._io.run(self.acall(method, payload, timeout=timeout))
+    def call(
+        self,
+        method: str,
+        payload: dict | None = None,
+        timeout: float | None = None,
+        retries: int | None = None,
+    ):
+        return self._io.run(self.acall(method, payload, timeout=timeout, retries=retries))
 
     def push(self, method: str, payload: dict | None = None):
         return self._io.run(self.apush(method, payload))

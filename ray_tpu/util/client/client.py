@@ -27,7 +27,10 @@ class _GcsProxy:
     def __init__(self, client: "ClientCoreWorker"):
         self._client = client
 
-    def call(self, method: str, payload: dict | None = None) -> dict:
+    def call(self, method: str, payload: dict | None = None, **kwargs) -> dict:
+        # timeout/retries knobs apply to the server's GCS hop, which the
+        # proxy cannot steer; accept and drop them so direct-mode callers
+        # (e.g. ray_tpu.kill's bounded single attempt) work unchanged.
         return self._client._call("client_gcs_call", {"method": method, "payload": payload or {}})
 
 
