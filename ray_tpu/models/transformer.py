@@ -170,27 +170,60 @@ def _rms_norm(x, weight, eps):
     return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight.astype(x.dtype)
 
 
-def _rope(x, positions, theta):
-    # x: [B, T, H, Dh]
-    Dh = x.shape[-1]
+def _rope_tables(positions, Dh: int, theta):
+    """cos/sin rotation tables [B, T, Dh/2] for the given positions. The
+    training path computes these ONCE per step (forward_hidden) instead of
+    per layer per projection — positions are layer-invariant, and 16 sin+cos
+    sweeps per step over [B,T,Dh/2] is pure wasted VPU time."""
     freqs = theta ** (-jnp.arange(0, Dh // 2, dtype=jnp.float32) / (Dh // 2))
-    angles = positions[:, :, None].astype(jnp.float32) * freqs[None, None, :]  # [B,T,Dh/2]
-    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    angles = positions[:, :, None].astype(jnp.float32) * freqs[None, None, :]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def _rope_apply(x, cos, sin):
+    # x: [B, T, H, Dh]; cos/sin: [B, T, Dh/2]
     x1, x2 = jnp.split(x, 2, axis=-1)
     rx1 = x1 * cos[:, :, None, :] - x2 * sin[:, :, None, :]
     rx2 = x2 * cos[:, :, None, :] + x1 * sin[:, :, None, :]
     return jnp.concatenate([rx1, rx2], axis=-1).astype(x.dtype)
 
 
-def _attention_block(lp, x, positions, cfg: TransformerConfig, mesh, attn_impl: str):
+def _rope(x, positions, theta):
+    # Convenience form (decode paths in models/generate.py use this).
+    cos, sin = _rope_tables(positions, x.shape[-1], theta)
+    return _rope_apply(x, cos, sin)
+
+
+def _attention_block(lp, x, rope_cs, cfg: TransformerConfig, mesh, attn_impl: str):
+    import os
+
     B, T, D = x.shape
     H, KV, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     h = _rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-    q = (h @ lp["wq"].astype(h.dtype)).reshape(B, T, H, Dh)
-    k = (h @ lp["wk"].astype(h.dtype)).reshape(B, T, KV, Dh)
-    v = (h @ lp["wv"].astype(h.dtype)).reshape(B, T, KV, Dh)
-    q = _rope(q, positions, cfg.rope_theta)
-    k = _rope(k, positions, cfg.rope_theta)
+    if os.environ.get("RAY_TPU_FUSED_QKV", "0") == "1":
+        # One [D, (H+2KV)·Dh] matmul instead of three: fewer MXU launches
+        # at identical FLOPs (the weight concat is folded by XLA). A/B knob,
+        # read at trace time.
+        wqkv = jnp.concatenate(
+            [lp["wq"], lp["wk"], lp["wv"]], axis=-1
+        ).astype(h.dtype)
+        qkv = h @ wqkv
+        q = qkv[..., : H * Dh].reshape(B, T, H, Dh)
+        k = qkv[..., H * Dh : (H + KV) * Dh].reshape(B, T, KV, Dh)
+        v = qkv[..., (H + KV) * Dh :].reshape(B, T, KV, Dh)
+    else:
+        q = (h @ lp["wq"].astype(h.dtype)).reshape(B, T, H, Dh)
+        k = (h @ lp["wk"].astype(h.dtype)).reshape(B, T, KV, Dh)
+        v = (h @ lp["wv"].astype(h.dtype)).reshape(B, T, KV, Dh)
+    if isinstance(rope_cs, tuple):
+        cos, sin = rope_cs
+    else:
+        # A/B fallback (RAY_TPU_ROPE_PER_LAYER=1): rope_cs is the raw
+        # positions array; recompute tables in-layer — measures whether
+        # XLA's CSE already hoists them from the scan.
+        cos, sin = _rope_tables(rope_cs, Dh, cfg.rope_theta)
+    q = _rope_apply(q, cos, sin)
+    k = _rope_apply(k, cos, sin)
     if KV != H:  # GQA: repeat kv heads
         rep = H // KV
         k = jnp.repeat(k, rep, axis=2)
@@ -244,8 +277,8 @@ def _mlp_block(lp, x, cfg: TransformerConfig):
     return x + (gate * up) @ lp["wo_mlp"].astype(h.dtype), 0.0
 
 
-def _layer(lp, x, positions, cfg: TransformerConfig, mesh, attn_impl: str):
-    x = _attention_block(lp, x, positions, cfg, mesh, attn_impl)
+def _layer(lp, x, rope_cs, cfg: TransformerConfig, mesh, attn_impl: str):
+    x = _attention_block(lp, x, rope_cs, cfg, mesh, attn_impl)
     x, aux = _mlp_block(lp, x, cfg)
     return x, aux
 
@@ -261,6 +294,14 @@ def forward_hidden(
     B, T = tokens.shape
     x = params["embed"].astype(cfg.dtype)[tokens]
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
+    # Rope tables are layer-invariant: one sin+cos sweep per step, shared by
+    # every layer's q and k (vs 2·n_layers recomputations inside the scan).
+    import os
+
+    if os.environ.get("RAY_TPU_ROPE_PER_LAYER", "0") == "1":
+        rope_cs = positions  # recomputed per layer (A/B fallback)
+    else:
+        rope_cs = _rope_tables(positions, cfg.head_dim, cfg.rope_theta)
 
     layer_fn = partial(_layer, cfg=cfg, mesh=mesh, attn_impl=attn_impl)
     if cfg.remat:
@@ -268,7 +309,7 @@ def forward_hidden(
 
     def scan_body(carry, lp):
         x, aux = carry
-        x, a = layer_fn(lp, x, positions)
+        x, a = layer_fn(lp, x, rope_cs)
         return (x, aux + a), None
 
     unroll = max(1, min(int(cfg.scan_unroll or 1), cfg.n_layers))

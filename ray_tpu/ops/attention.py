@@ -84,38 +84,55 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_k: int, cau
             first_q_row = q_idx * block_q + causal_offset
             start_block = jnp.maximum(0, (first_q_row - window + 1) // block_k)
 
-    def body(kb, carry):
-        m_prev, l_prev, acc_prev = carry
-        k_blk = k_ref[pl.ds(kb * block_k, block_k), :]
-        v_blk = v_ref[pl.ds(kb * block_k, block_k), :]
-        s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * sm_scale  # [block_q, block_k]
-        if causal:
-            q_pos = q_idx * block_q + causal_offset + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            visible = q_pos >= k_pos
-            if window > 0:
-                visible &= q_pos - k_pos < window
-            s = jnp.where(visible, s, -jnp.inf)
-        m_cur = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
-        # Fully-masked-so-far rows (possible under a sliding window: early
-        # k-blocks can be entirely outside a late row's window) have
-        # m_cur = -inf; exp(-inf - -inf) would be NaN. Substituting 0 for
-        # the max keeps correction = p = exp(-inf) = 0 — the correct
-        # "contributes nothing" behavior.
-        safe_m = jnp.where(jnp.isneginf(m_cur), 0.0, m_cur)
-        correction = jnp.exp(m_prev - safe_m)
-        p = jnp.exp(s - safe_m)
-        l_cur = l_prev * correction + p.sum(axis=-1, keepdims=True)
-        pv = jax.lax.dot_general(
-            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        acc_cur = acc_prev * correction + pv
-        return m_cur, l_cur, acc_cur
+    def make_body(masked: bool):
+        def body(kb, carry):
+            m_prev, l_prev, acc_prev = carry
+            k_blk = k_ref[pl.ds(kb * block_k, block_k), :]
+            v_blk = v_ref[pl.ds(kb * block_k, block_k), :]
+            s = jax.lax.dot_general(
+                q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            ) * sm_scale  # [block_q, block_k]
+            if masked:
+                q_pos = q_idx * block_q + causal_offset + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+                k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+                visible = q_pos >= k_pos
+                if window > 0:
+                    visible &= q_pos - k_pos < window
+                s = jnp.where(visible, s, -jnp.inf)
+            m_cur = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+            # Fully-masked-so-far rows (possible under a sliding window: early
+            # k-blocks can be entirely outside a late row's window) have
+            # m_cur = -inf; exp(-inf - -inf) would be NaN. Substituting 0 for
+            # the max keeps correction = p = exp(-inf) = 0 — the correct
+            # "contributes nothing" behavior.
+            safe_m = jnp.where(jnp.isneginf(m_cur), 0.0, m_cur)
+            correction = jnp.exp(m_prev - safe_m)
+            p = jnp.exp(s - safe_m)
+            l_cur = l_prev * correction + p.sum(axis=-1, keepdims=True)
+            pv = jax.lax.dot_general(
+                p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            acc_cur = acc_prev * correction + pv
+            return m_cur, l_cur, acc_cur
 
-    m, l, acc = jax.lax.fori_loop(start_block, num_k_blocks, body, (m0, l0, acc0))
+        return body
+
+    if causal and window == 0:
+        # Split the k-loop at the diagonal: blocks entirely below it (every
+        # k_pos visible to every row of this q block) skip the iota/compare/
+        # select mask — pure VPU work that at (1024,1024)-class tiles costs
+        # the same order as the score matmul itself. Only diagonal-crossing
+        # blocks pay for masking. (Windowed attention keeps the uniform
+        # masked loop: its left edge re-masks early blocks too.)
+        first_q_row = q_idx * block_q + causal_offset
+        full_end = jnp.clip((first_q_row + 1) // block_k, start_block, num_k_blocks)
+        carry = jax.lax.fori_loop(start_block, full_end, make_body(False), (m0, l0, acc0))
+        m, l, acc = jax.lax.fori_loop(full_end, num_k_blocks, make_body(True), carry)
+    else:
+        m, l, acc = jax.lax.fori_loop(
+            start_block, num_k_blocks, make_body(causal), (m0, l0, acc0)
+        )
     o_ref[...] = (acc / l).astype(o_ref.dtype)
     if lse_ref is not None:
         # Log-sum-exp per row: the residual the backward pass needs to
@@ -473,14 +490,18 @@ def flash_attention(
     *,
     causal: bool = False,
     sm_scale: float | None = None,
-    # Measured on v5e at bench shapes (B8/H16/T1024/D64, full train step):
-    # (128,128) << (256,512) < (1024,1024) — bigger blocks mean fewer grid
-    # steps and less per-block overhead, and _fit_block clamps them to the
-    # sequence, so short sequences degrade gracefully to block == seq.
+    # Default block size: env RAY_TPU_FLASH_FWD_BLOCK (read at trace time),
+    # else 1024. Measured on v5e at bench shapes (r4: B8/H8/T1024/D128, full
+    # train step): (128,128) << (256,512) < (1024,1024) for the UNsplit
+    # causal loop — bigger blocks mean fewer grid steps and less per-block
+    # overhead, and _fit_block clamps them to the sequence, so short
+    # sequences degrade gracefully to block == seq.
     # VMEM bound: a (1024, 1024) fp32 score tile is 4 MiB of the ~16 MiB
     # budget, leaving room for the q/k/v/o tiles at head_dim <= 256.
-    block_q: int = 1024,
-    block_k: int = 1024,
+    # With the split-at-the-diagonal mask loop, smaller blocks also PRUNE:
+    # at (512,512) causal T=1024 skips 1/4 of the score tiles entirely.
+    block_q: int | None = None,
+    block_k: int | None = None,
     bias=None,
     force_pallas: bool | None = None,
     interpret: bool = False,
@@ -498,6 +519,12 @@ def flash_attention(
         raise ValueError("sliding window requires causal=True")
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if block_q is None or block_k is None:
+        import os
+
+        dflt = int(os.environ.get("RAY_TPU_FLASH_FWD_BLOCK", "1024"))
+        block_q = dflt if block_q is None else block_q
+        block_k = dflt if block_k is None else block_k
     use_pallas = force_pallas if force_pallas is not None else (_on_tpu() or interpret)
     Tq, Tk = q.shape[1], k.shape[1]
     bq = _fit_block(block_q, Tq)

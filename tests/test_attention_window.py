@@ -55,6 +55,10 @@ def test_wide_window_equals_full_causal():
                            interpret=True, block_q=64, block_k=64)
     wide = flash_attention(q, k, v, causal=True, window=10_000, force_pallas=True,
                            interpret=True, block_q=64, block_k=64)
-    np.testing.assert_allclose(np.asarray(wide), np.asarray(full), rtol=1e-6)
+    # Value-level f32 equivalence, not bitwise: the full-causal path takes
+    # the split-at-the-diagonal loop (no mask select below the diagonal)
+    # while the windowed path keeps the uniform masked loop, so the two
+    # compile to different programs with different fusion/rounding.
+    np.testing.assert_allclose(np.asarray(wide), np.asarray(full), rtol=1e-5, atol=1e-6)
     with pytest.raises(ValueError, match="causal"):
         flash_attention(q, k, v, causal=False, window=8)
