@@ -26,5 +26,6 @@ from ray_tpu.tune.trainable import (  # noqa: F401
 )
 from ray_tpu.air.config import CheckpointConfig, FailureConfig, RunConfig  # noqa: F401
 from ray_tpu.tune.tune_config import TuneConfig  # noqa: F401
+from ray_tpu.tune.analysis import ExperimentAnalysis  # noqa: F401
 from ray_tpu.tune.result_grid import ResultGrid  # noqa: F401
 from ray_tpu.tune.tuner import Tuner, run  # noqa: F401

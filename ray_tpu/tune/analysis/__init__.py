@@ -1,0 +1,3 @@
+from ray_tpu.tune.analysis.experiment_analysis import ExperimentAnalysis
+
+__all__ = ["ExperimentAnalysis"]

@@ -410,13 +410,14 @@ class TuneController:
 
     @staticmethod
     def load_experiment_state(experiment_dir: str) -> dict:
-        with open(os.path.join(experiment_dir, "experiment_state.json")) as f:
-            state = json.load(f)
-        for ts in state["trials"]:
-            ckpt_dir = os.path.join(experiment_dir, f"checkpoint_{ts['trial_id']}")
-            if os.path.isdir(ckpt_dir):
-                try:
-                    ts["checkpoint"] = Checkpoint.from_directory(ckpt_dir)
-                except Exception:
-                    ts["checkpoint"] = None
+        # Shared loader: Tuner.restore and offline ExperimentAnalysis read
+        # the experiment directory through the same schema/parser.
+        from ray_tpu.tune.analysis import ExperimentAnalysis
+
+        ea = ExperimentAnalysis(experiment_dir)
+        state = ea._state
+        for ts, rec in zip(state["trials"], ea.trials):
+            ckpt = rec.checkpoint
+            if ckpt is not None:
+                ts["checkpoint"] = ckpt
         return state

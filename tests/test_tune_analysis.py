@@ -1,0 +1,132 @@
+"""Offline ExperimentAnalysis tests (reference
+python/ray/tune/analysis/experiment_analysis.py + tests/test_experiment_analysis.py):
+a finished experiment is analyzable from its directory alone — no live
+controller, and even when the directory was written by another process."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune import ExperimentAnalysis
+
+
+def _write_foreign_experiment(root):
+    """Hand-write the on-disk schema (what any finished run leaves behind)."""
+    os.makedirs(root, exist_ok=True)
+    trials = []
+    for tid, xs in (("t1", [0.3, 0.7, 0.5]), ("t2", [0.2, 0.9, 0.8])):
+        tdir = os.path.join(root, tid)
+        os.makedirs(tdir, exist_ok=True)
+        with open(os.path.join(tdir, "params.json"), "w") as f:
+            json.dump({"lr": 0.1 if tid == "t1" else 0.01}, f)
+        with open(os.path.join(tdir, "result.json"), "w") as f:
+            for i, x in enumerate(xs):
+                f.write(json.dumps({"training_iteration": i + 1, "acc": x}) + "\n")
+        trials.append(
+            {
+                "trial_id": tid,
+                "status": "TERMINATED",
+                "config": {"lr": 0.1 if tid == "t1" else 0.01},
+                "last_result": {"training_iteration": len(xs), "acc": xs[-1]},
+            }
+        )
+    with open(os.path.join(root, "experiment_state.json"), "w") as f:
+        json.dump(
+            {"experiment_name": "foreign", "metric": "acc", "mode": "max", "trials": trials},
+            f,
+        )
+
+
+def test_analysis_over_foreign_directory(tmp_path):
+    root = str(tmp_path / "exp")
+    _write_foreign_experiment(root)
+    ea = ExperimentAnalysis(root)
+
+    # defaults come from the experiment state
+    assert ea.default_metric == "acc" and ea.default_mode == "max"
+    assert ea.stats["num_trials"] == 2
+
+    # scope="last" compares final reports: t2 ends at 0.8 > t1's 0.5
+    assert ea.get_best_trial().trial_id == "t2"
+    assert ea.get_best_config() == {"lr": 0.01}
+    assert ea.get_best_logdir().endswith("t2")
+    # scope="all" compares best-ever reports: t2 peaked at 0.9
+    assert ea.get_best_trial(scope="all").trial_id == "t2"
+    # min mode flips it
+    assert ea.get_best_trial(mode="min").trial_id == "t1"
+
+    # per-trial dataframes carry the full history in order
+    dfs = ea.trial_dataframes
+    assert list(dfs["t1"]["acc"]) == [0.3, 0.7, 0.5]
+    assert list(dfs["t2"]["training_iteration"]) == [1, 2, 3]
+
+    # dataframe(): one row per trial; with metric/mode it picks each
+    # trial's best report for that metric
+    df = ea.dataframe()
+    assert set(df["trial_id"]) == {"t1", "t2"}
+    best_df = ea.dataframe(metric="acc", mode="max")
+    assert sorted(best_df["acc"]) == [0.7, 0.9]
+
+    assert ea.get_all_configs() == {"t1": {"lr": 0.1}, "t2": {"lr": 0.01}}
+
+
+def test_analysis_rejects_non_experiment_dir(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        ExperimentAnalysis(str(tmp_path))
+
+
+_CHILD = r"""
+import sys
+sys.path.insert(0, {repo!r})
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import RunConfig
+
+def trainable(config):
+    for i in range(3):
+        score = config["x"] * (i + 1)
+        tune.report({{"score": score}}, checkpoint=Checkpoint.from_dict({{"score": score}}))
+
+ray_tpu.init(num_cpus=2)
+tune.Tuner(
+    trainable,
+    param_space={{"x": tune.grid_search([1.0, 2.0])}},
+    tune_config=tune.TuneConfig(metric="score", mode="max"),
+    run_config=RunConfig(storage_path={storage!r}, name="offline_exp"),
+).fit()
+ray_tpu.shutdown()
+"""
+
+
+def test_analysis_over_experiment_written_by_previous_process(tmp_path):
+    """The analysis target is literally another process's output directory."""
+    storage = str(tmp_path)
+    script = _CHILD.format(repo="/root/repo", storage=storage)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", RAY_TPU_NUM_TPUS="0")
+    proc = subprocess.run(
+        [sys.executable, "-c", script], env=env, capture_output=True, text=True, timeout=300
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+    exp_dir = os.path.join(storage, "offline_exp")
+    ea = ExperimentAnalysis(exp_dir)
+    assert ea.stats["num_trials"] == 2
+    best = ea.get_best_trial()
+    assert best.config["x"] == 2.0
+    assert ea.best_result["score"] == pytest.approx(6.0)
+    # every trial reported 3 results, all recoverable in order
+    for t in ea.trials:
+        rows = t.results()
+        assert [r["training_iteration"] for r in rows] == [1, 2, 3]
+    # the best trial's persisted checkpoint is loadable
+    ckpt = ea.get_best_checkpoint()
+    assert ckpt is not None and ckpt.to_dict()["score"] == pytest.approx(6.0)
+    # Tuner.restore rides the same loader over the same directory
+    t = tune.Tuner.restore(exp_dir, lambda cfg: None)
+    assert len(t._restore_state["trials"]) == 2
