@@ -12,6 +12,7 @@ from ray_tpu.data.aggregate import AbsMax, AggregateFn, Count, Max, Mean, Min, S
 from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata  # noqa: F401
 from ray_tpu.data.context import DataContext  # noqa: F401
 from ray_tpu.data.dataset import Dataset  # noqa: F401
+from ray_tpu.data.dataset_pipeline import DatasetPipeline  # noqa: F401
 from ray_tpu.data.grouped_data import GroupedData  # noqa: F401
 from ray_tpu.data.iterator import DataIterator  # noqa: F401
 from ray_tpu.data.read_api import (  # noqa: F401
@@ -52,6 +53,7 @@ __all__ = [
     "DataContext",
     "DataIterator",
     "Dataset",
+    "DatasetPipeline",
     "GroupedData",
     "Max",
     "Mean",

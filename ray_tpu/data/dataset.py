@@ -189,6 +189,24 @@ class Dataset:
     def zip(self, other: "Dataset") -> "Dataset":
         return Dataset(ZipOp(name="Zip", input_op=self._plan, other=other._plan))
 
+    def window(self, *, blocks_per_window: int = 10) -> "DatasetPipeline":
+        """Epoch/window pipelining (reference: data/dataset_pipeline.py):
+        stream this dataset's blocks in windows of ``blocks_per_window``,
+        each exposed as its own Dataset — nothing is materialized beyond
+        the window in flight."""
+        from ray_tpu.data.dataset_pipeline import DatasetPipeline
+
+        return DatasetPipeline.from_dataset(self, blocks_per_window)
+
+    def repeat(self, times: Optional[int] = None) -> "DatasetPipeline":
+        """Repeat this dataset for ``times`` epochs (None = forever). A lazy
+        plan re-executes per epoch — fresh reads, bounded memory."""
+        from ray_tpu.data.dataset_pipeline import DatasetPipeline
+
+        if times is not None and times < 1:
+            raise ValueError("repeat() takes times >= 1 (or None for forever)")
+        return DatasetPipeline(lambda: iter([self]), epochs=times)
+
     def groupby(self, key: Optional[str]) -> "GroupedData":
         from ray_tpu.data.grouped_data import GroupedData
 
