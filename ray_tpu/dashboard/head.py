@@ -182,6 +182,23 @@ class DashboardHead:
                 return
             req._send(200, {"result": fn(address="%s:%d" % self._gcs_address)})
             return
+        if path.startswith("/api/workflows/events/"):
+            # HTTP event provider (reference workflow/http_event_provider.py):
+            # read back a delivered event.
+            from ray_tpu._private.rpc import RpcClient
+            from ray_tpu.workflow.event_listener import EVENT_KV_PREFIX
+
+            key = path[len("/api/workflows/events/") :]
+            gcs = RpcClient(self._gcs_address, label="dashboard-events")
+            try:
+                resp = gcs.call("kv_get", {"key": EVENT_KV_PREFIX + key})
+            finally:
+                gcs.close()
+            if not resp.get("found"):
+                req._send(404, {"error": f"no event for key {key!r}"})
+            else:
+                req._send(200, {"key": key, "event": json.loads(bytes(resp["value"]).decode())})
+            return
         if path == "/api/jobs":
             req._send(200, self.job_manager.list_jobs())
             return
@@ -221,6 +238,28 @@ class DashboardHead:
                 req._send(400, {"error": str(e)})
                 return
             req._send(200, {"submission_id": sid})
+            return
+        if path.startswith("/api/workflows/events/"):
+            # HTTP event provider: deliver an event payload to workflows
+            # polling KVEventListener(key) (reference http_event_provider.py
+            # POST /event/send_event/{workflow_id}).
+            from ray_tpu._private.rpc import RpcClient
+            from ray_tpu.workflow.event_listener import EVENT_KV_PREFIX
+
+            key = path[len("/api/workflows/events/") :]
+            gcs = RpcClient(self._gcs_address, label="dashboard-events")
+            try:
+                gcs.call(
+                    "kv_put",
+                    {
+                        "key": EVENT_KV_PREFIX + key,
+                        "value": json.dumps(body).encode(),
+                        "overwrite": True,
+                    },
+                )
+            finally:
+                gcs.close()
+            req._send(200, {"delivered": key})
             return
         if path.startswith("/api/jobs/") and path.endswith("/stop"):
             sid = path[len("/api/jobs/") : -len("/stop")]

@@ -11,6 +11,12 @@ from __future__ import annotations
 import threading
 
 from ray_tpu.workflow import workflow_storage as _storage_mod
+from ray_tpu.workflow.event_listener import (  # noqa: F401
+    EventListener,
+    KVEventListener,
+    deliver_event,
+    run_listener_method,
+)
 from ray_tpu.workflow.workflow_executor import execute_workflow
 from ray_tpu.workflow.workflow_storage import WorkflowStorage, list_workflows
 
@@ -23,6 +29,13 @@ __all__ = [
     "get_output",
     "list_all",
     "delete",
+    "wait",
+    "sleep",
+    "continuation",
+    "wait_for_event",
+    "EventListener",
+    "KVEventListener",
+    "deliver_event",
 ]
 
 _counter_lock = threading.Lock()
@@ -101,6 +114,113 @@ def resume(workflow_id: str):
     except BaseException:
         storage.save_status("FAILED")
         raise
+
+
+def wait(workflows: list, *, num_returns: int = 1, timeout: float | None = None):
+    """A workflow step resolving once ``num_returns`` of the given workflow
+    nodes have finished (reference api.py ``workflow.wait``): its value is
+    ``(ready_values, num_remaining)``. Divergence from the reference noted:
+    the remaining entries are reported as a COUNT, not as resumable workflow
+    handles — consumers that need every result wait for all of them."""
+    import ray_tpu
+
+    workflows = list(workflows)
+    if num_returns < 1 or num_returns > len(workflows):
+        raise ValueError(
+            f"num_returns must be in [1, {len(workflows)}], got {num_returns}"
+        )
+
+    @ray_tpu.remote(num_cpus=0)
+    def __workflow_wait__(refs, k, to):
+        import ray_tpu as _r
+        from ray_tpu.object_ref import ObjectRef
+
+        # On resume, already-persisted upstream steps arrive as VALUES (the
+        # executor replays them from the log), live ones as ObjectRefs.
+        pending = [r for r in refs if isinstance(r, ObjectRef)]
+        ready_vals = [r for r in refs if not isinstance(r, ObjectRef)]
+        need = max(0, k - len(ready_vals))
+        remaining = len(pending)
+        if need and pending:
+            ready, rest = _r.wait(
+                pending, num_returns=min(need, len(pending)), timeout=to
+            )
+            ready_vals += [_r.get(r) for r in ready]
+            remaining = len(rest)
+        return (ready_vals, remaining)
+
+    # The upstream nodes ride inside a list, so the executor passes their
+    # ObjectRefs through unresolved (nested refs are not auto-materialized)
+    # and the wait step sees refs it can ray_tpu.wait on.
+    return __workflow_wait__.bind(workflows, num_returns, timeout)
+
+
+def sleep(duration: float):
+    """A workflow step that resolves after ``duration`` seconds (reference
+    api.py:585). Durable like any step: a resume AFTER it completed does not
+    sleep again."""
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=0)
+    def __workflow_sleep__(d):
+        import time
+
+        time.sleep(d)
+        return None
+
+    return __workflow_sleep__.bind(duration)
+
+
+def wait_for_event(event_listener_type, *args, **kwargs):
+    """Two-step poll->commit DAG for an external event (reference api.py:557).
+    The poll step blocks in ``listener.poll_for_event``; after the event
+    value exists, the commit step runs ``listener.event_checkpointed``. A
+    driver killed mid-poll resumes by re-polling (at-least-once delivery,
+    exactly-once consumption via the durable step log)."""
+    import ray_tpu
+    from ray_tpu.workflow.event_listener import EventListener as _EL
+    from ray_tpu.workflow.event_listener import run_listener_method
+
+    if not (isinstance(event_listener_type, type) and issubclass(event_listener_type, _EL)):
+        raise TypeError(
+            "wait_for_event expects an EventListener subclass, got "
+            f"{event_listener_type!r}"
+        )
+
+    @ray_tpu.remote(num_cpus=0)
+    def __workflow_poll_event__(*a, **kw):
+        listener = event_listener_type()
+        return run_listener_method(listener.poll_for_event, *a, **kw)
+
+    @ray_tpu.remote(num_cpus=0)
+    def __workflow_event_committed__(event):
+        listener = event_listener_type()
+        run_listener_method(listener.event_checkpointed, event)
+        return event
+
+    return __workflow_event_committed__.bind(
+        __workflow_poll_event__.bind(*args, **kwargs)
+    )
+
+
+def continuation(dag_node):
+    """Convert a DAG into a continuation (reference api.py:712): inside a
+    workflow step, return it to extend the workflow dynamically (the
+    executor runs the sub-DAG durably under the step's namespace); outside
+    workflow execution it simply executes the DAG and returns the result."""
+    import os
+
+    from ray_tpu.dag.dag_node import DAGNode
+
+    if not isinstance(dag_node, DAGNode):
+        raise TypeError("workflow.continuation expects a DAG node")
+    if os.environ.get("RAY_TPU_IN_WORKFLOW") == "1":
+        return dag_node
+    import ray_tpu
+    from ray_tpu.object_ref import ObjectRef
+
+    out = dag_node.execute()
+    return ray_tpu.get(out) if isinstance(out, ObjectRef) else out
 
 
 def get_status(workflow_id: str) -> str:

@@ -1,0 +1,88 @@
+"""Workflow event listeners (analog of reference
+python/ray/workflow/event_listener.py:11 ``EventListener`` and
+http_event_provider.py's pollable HTTP provider).
+
+``workflow.wait_for_event(ListenerType, *args)`` builds a two-step DAG
+(poll -> commit, reference api.py:557): the poll step blocks until the
+listener returns an event; once the event value is DURABLY persisted by the
+executor, ``event_checkpointed`` fires so an external provider can commit
+(e.g. ack a queue offset). A driver killed mid-poll leaves no persisted
+result, so ``workflow.resume`` re-polls — delivery is effectively
+at-least-once with exactly-once workflow consumption.
+
+``KVEventListener`` is the built-in pollable provider: it watches a GCS KV
+key that external systems set either directly (``kv_put``) or over HTTP via
+the dashboard route ``POST /api/workflows/events/<key>`` — the HTTP event
+provider analog.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import json
+import time
+
+EVENT_KV_PREFIX = "workflow:event:"
+
+
+class EventListener:
+    """Subclass with ``poll_for_event`` (sync or async) and optionally
+    ``event_checkpointed``. Listeners must be stateless — they are
+    re-instantiated (possibly in a different process) on resume."""
+
+    def __init__(self):
+        pass
+
+    def poll_for_event(self, *args, **kwargs):
+        """Return only when the event has arrived."""
+        raise NotImplementedError
+
+    def event_checkpointed(self, event) -> None:
+        """Called after the event is durably checkpointed; commit side
+        effects (e.g. ack the message) here."""
+
+
+def run_listener_method(method, *args, **kwargs):
+    """Call a listener method, awaiting it if it is async."""
+    result = method(*args, **kwargs)
+    if inspect.iscoroutine(result):
+        return asyncio.run(result)
+    return result
+
+
+class KVEventListener(EventListener):
+    """Polls the cluster KV for ``workflow:event:<key>`` (JSON payload).
+
+    Producers: ``ray_tpu.workflow.deliver_event(key, payload)`` from any
+    driver/worker, or ``POST /api/workflows/events/<key>`` on the dashboard.
+    """
+
+    poll_interval_s = 0.25
+
+    def poll_for_event(self, key: str):
+        from ray_tpu._private import worker_context
+
+        cw = worker_context.get_core_worker()
+        full = EVENT_KV_PREFIX + key
+        while True:
+            resp = cw.gcs.call("kv_get", {"key": full})
+            if resp.get("found"):
+                return json.loads(bytes(resp["value"]).decode())
+            time.sleep(self.poll_interval_s)
+
+
+def deliver_event(key: str, payload) -> None:
+    """Publish an event for ``KVEventListener(key)`` pollers (what the
+    dashboard's POST /api/workflows/events/<key> route calls)."""
+    from ray_tpu._private import worker_context
+
+    cw = worker_context.get_core_worker()
+    cw.gcs.call(
+        "kv_put",
+        {
+            "key": EVENT_KV_PREFIX + key,
+            "value": json.dumps(payload).encode(),
+            "overwrite": True,
+        },
+    )

@@ -124,8 +124,15 @@ def execute_workflow(
     input_kwargs,
     max_retries: int = 0,
     catch_exceptions: bool = False,
+    _namespace: str = "",
 ):
-    """Run (or resume) the DAG durably; returns the final output."""
+    """Run (or resume) the DAG durably; returns the final output.
+
+    ``_namespace`` prefixes step ids — continuations (a step returning a
+    DAGNode, reference workflow.continuation / api.py:712) execute their
+    sub-DAG under ``<parent-step-id>/`` so sub-step results persist and
+    replay independently of the parent's log.
+    """
     import ray_tpu
 
     order = dag.topological_order()
@@ -135,66 +142,151 @@ def execute_workflow(
                 "workflows support function nodes only (durable replay of "
                 "actor state is not defined); got " + type(node).__name__
             )
-    step_ids = _content_ids(order)
+    step_ids = {
+        nid: _namespace + sid for nid, sid in _content_ids(order).items()
+    }
 
     ctx = {"input_args": tuple(input_args), "input_kwargs": dict(input_kwargs)}
-    results = {}
+    results = {}  # id(node) -> ObjectRef (pending step) or final value
+    final = {}  # id(node) -> final (continuation-resolved) value
     ctx["_results"] = results
-    # Pass 1: submit every unfinished step eagerly, passing ObjectRefs of
-    # earlier steps straight through — independent branches run concurrently.
-    pending: dict = {}  # ref -> (sid, node)
-    for node in order:
-        if isinstance(node, FunctionNode):
-            sid = step_ids[id(node)]
-            if storage.has_step_result(sid):
-                results[id(node)] = storage.load_step_result(sid)
-                continue
-            args, kwargs = node._resolved_args(results)
-            opts = {k: v for k, v in node._options.items() if k != "catch_exceptions"}
-            catch = bool(node._options.get("catch_exceptions", catch_exceptions))
-            retries = opts.get("max_retries", max_retries)
-            if retries:
-                opts["max_retries"] = retries
-                opts.setdefault("retry_exceptions", True)
-            fn = node._remote_fn.options(**opts) if opts else node._remote_fn
-            ref = fn.remote(*args, **kwargs)
-            if catch:
-                # Consumers see (result, error); boxing the ref defers its
-                # materialization into the catch task itself.
-                ref = _get_catch_task().remote([ref])
-            pending[ref] = (sid, node)
-            results[id(node)] = ref
-        else:
-            args, kwargs = node._resolved_args(results)
-            results[id(node)] = node._execute_impl(args, kwargs, ctx)
 
-    # Pass 2: persist step results in COMPLETION order — a crash mid-run
-    # keeps every step that finished, whatever branch it was on.
+    def _submit(node, sid):
+        args, kwargs = node._resolved_args(results)
+        opts = {k: v for k, v in node._options.items() if k != "catch_exceptions"}
+        catch = bool(node._options.get("catch_exceptions", catch_exceptions))
+        retries = opts.get("max_retries", max_retries)
+        if retries:
+            opts["max_retries"] = retries
+            opts.setdefault("retry_exceptions", True)
+        # Steps run under RAY_TPU_IN_WORKFLOW=1 so workflow.continuation
+        # can tell workflow execution (defer: return the DAG) from plain
+        # driver use (execute eagerly) — reference workflow_context.
+        renv = dict(opts.get("runtime_env") or {})
+        renv["env_vars"] = dict(renv.get("env_vars") or {}, RAY_TPU_IN_WORKFLOW="1")
+        opts["runtime_env"] = renv
+        ref = node._remote_fn.options(**opts).remote(*args, **kwargs)
+        if catch:
+            # Consumers see (result, error); boxing the ref defers its
+            # materialization into the catch task itself.
+            ref = _get_catch_task().remote([ref])
+        return ref, catch
+
+    def _deps_ready(node) -> bool:
+        """Submission gate. TOP-LEVEL DAGNode args must hold their FINAL
+        values — a pending ref could resolve to a continuation DAG, and
+        piping that raw DAG into a consumer corrupts it. NESTED nodes
+        (inside lists/dicts: the workflow.wait / catch idioms) deliberately
+        flow as live ObjectRefs, so merely-submitted is enough for them —
+        this is what keeps independent branches and wait() concurrent."""
+        top = [v for v in node._bound_args if isinstance(v, DAGNode)]
+        top += [v for v in node._bound_kwargs.values() if isinstance(v, DAGNode)]
+        for child in node._children():
+            if isinstance(child, FunctionNode):
+                if any(child is t for t in top):
+                    if id(child) not in final:
+                        return False
+                elif id(child) not in results:
+                    return False
+            elif id(child) not in results:
+                return False
+        return True
+
+    # Completion-driven scheduling: every READY unfinished step is in
+    # flight concurrently; results persist in COMPLETION order — a crash
+    # mid-run keeps every step that finished, whatever branch it was on.
+    todo = list(order)
+    pending: dict = {}  # ref -> (sid, node)
     first_error = None
-    remaining = dict(pending)
-    while remaining:
-        done, _ = ray_tpu.wait(list(remaining.keys()), num_returns=1)
+    while todo or pending:
+        progressed = False
+        for node in list(todo):
+            if isinstance(node, FunctionNode):
+                sid = step_ids[id(node)]
+                if storage.has_step_result(sid):
+                    value = storage.load_step_result(sid)
+                    results[id(node)] = final[id(node)] = value
+                elif _deps_ready(node):
+                    ref, catch = _submit(node, sid)
+                    pending[ref] = (sid, node, catch)
+                    results[id(node)] = ref
+                else:
+                    continue
+            elif _deps_ready(node):
+                args, kwargs = node._resolved_args(results)
+                results[id(node)] = node._execute_impl(args, kwargs, ctx)
+            else:
+                continue
+            todo.remove(node)
+            progressed = True
+        if not pending:
+            if first_error is not None:
+                break  # a failed step starves its consumers; surface it
+            if todo and not progressed:
+                raise RuntimeError(
+                    "workflow made no progress (cyclic or unresolvable deps): "
+                    + ", ".join(type(n).__name__ for n in todo)
+                )
+            continue
+        done, _ = ray_tpu.wait(list(pending.keys()), num_returns=1)
         ref = done[0]
-        sid, node = remaining.pop(ref)
+        sid, node, catch = pending.pop(ref)
         try:
             value = ray_tpu.get(ref)
         except Exception as e:  # noqa: BLE001 — recorded, then re-raised below
             if first_error is None:
                 first_error = e
             continue
+        # Continuation detection must see THROUGH the catch box: a caught
+        # step's value is (result, error), and a returned sub-DAG rides in
+        # the result slot.
+        cont = None
+        if isinstance(value, DAGNode):
+            cont = value
+        elif catch and isinstance(value, tuple) and len(value) == 2 and isinstance(value[0], DAGNode):
+            cont = value[0]
+        if cont is not None:
+            # Continuation: the step returned a sub-DAG (dynamic workflow).
+            # Execute it durably under this step's namespace; its output IS
+            # the step's value. A crash mid-sub-DAG leaves the parent step
+            # unpersisted, so resume re-runs the (deterministic) parent step
+            # and replays the sub-DAG from its own persisted steps.
+            try:
+                sub = execute_workflow(
+                    storage, cont, (), {},
+                    max_retries=max_retries,
+                    catch_exceptions=catch_exceptions,
+                    _namespace=sid + "/",
+                )
+                value = (sub, None) if cont is not value else sub
+            except Exception as e:  # noqa: BLE001 — same contract as above
+                if catch:
+                    value = (None, e)  # the catch contract applies to the sub-DAG too
+                else:
+                    if first_error is None:
+                        first_error = e
+                    continue
+            # Consumers need the MATERIALIZED sub-output (there is no ref
+            # for it) — continuation steps forgo ref pass-through.
+            results[id(node)] = value
         storage.save_step_result(sid, value)
-        results[id(node)] = value
+        final[id(node)] = value
     if first_error is not None:
         raise first_error
 
     # Pass 3: non-function nodes (input projections, MultiOutput) captured
-    # refs during pass 1; recompute them over materialized values (pure).
+    # refs during scheduling; recompute them over MATERIALIZED values
+    # (steps kept refs in `results` for pass-through; `final` holds their
+    # completed values).
+    view = dict(results)
+    view.update(final)
     for node in order:
         if not isinstance(node, (FunctionNode, InputNode)):
-            args, kwargs = node._resolved_args(results)
-            results[id(node)] = node._execute_impl(args, kwargs, ctx)
+            args, kwargs = node._resolved_args(view)
+            view[id(node)] = node._execute_impl(args, kwargs, ctx)
 
-    output = results[id(order[-1])]
-    storage.save_output(output)
-    storage.save_status("SUCCESSFUL")
+    output = view[id(order[-1])]
+    if not _namespace:  # sub-DAGs persist via their parent step, not as the
+        storage.save_output(output)  # workflow's final output
+        storage.save_status("SUCCESSFUL")
     return output
