@@ -30,6 +30,11 @@ class AlgorithmConfig:
         self.num_envs_per_worker = 1
         self.rollout_fragment_length = 200
         self.observation_filter: Optional[str] = None
+        # Connector pipelines (reference: .env_runners(env_to_module_connector)
+        # / legacy agent+action connectors): lists of stage instances shipped
+        # to every rollout AND eval worker.
+        self.agent_connectors: Optional[list] = None
+        self.action_connectors: Optional[list] = None
         self.gamma = 0.99
         self.lambda_ = 0.95
         self.lr = 5e-5
@@ -74,7 +79,9 @@ class AlgorithmConfig:
 
     def rollouts(self, *, num_rollout_workers: Optional[int] = None, num_envs_per_worker: Optional[int] = None,
                  rollout_fragment_length: Optional[int] = None,
-                 observation_filter: Optional[str] = None) -> "AlgorithmConfig":
+                 observation_filter: Optional[str] = None,
+                 agent_connectors: Optional[list] = None,
+                 action_connectors: Optional[list] = None) -> "AlgorithmConfig":
         if num_rollout_workers is not None:
             self.num_rollout_workers = num_rollout_workers
         if num_envs_per_worker is not None:
@@ -83,6 +90,10 @@ class AlgorithmConfig:
             self.rollout_fragment_length = rollout_fragment_length
         if observation_filter is not None:
             self.observation_filter = observation_filter
+        if agent_connectors is not None:
+            self.agent_connectors = list(agent_connectors)
+        if action_connectors is not None:
+            self.action_connectors = list(action_connectors)
         return self
 
     def training(self, *, lr: Optional[float] = None, gamma: Optional[float] = None,
@@ -286,6 +297,8 @@ class Algorithm(Trainable):
             lambda_=cfg.lambda_,
             seed=cfg.seed,
             observation_filter=getattr(cfg, "observation_filter", None),
+            agent_connectors=getattr(cfg, "agent_connectors", None),
+            action_connectors=getattr(cfg, "action_connectors", None),
             recreate_failed_workers=getattr(cfg, "recreate_failed_workers", True),
             max_worker_restarts=getattr(cfg, "max_worker_restarts", 100),
         )
@@ -314,6 +327,11 @@ class Algorithm(Trainable):
                 # Offset so eval envs never mirror training-env seeds.
                 seed=cfg.seed + 100_000,
                 observation_filter=getattr(cfg, "observation_filter", None),
+                # Eval samples through the SAME pipelines as training
+                # (transform-only for stateful stages; reference: eval
+                # workers share connector config).
+                agent_connectors=getattr(cfg, "agent_connectors", None),
+                action_connectors=getattr(cfg, "action_connectors", None),
             )
             self._eval_workers = ws
         return ws

@@ -11,10 +11,17 @@ the same broadcast path, keeping everything mesh-friendly (pure arrays).
 
 from ray_tpu.rllib.connectors.connector import (  # noqa: F401
     ActionConnector,
+    ActionConnectorPipeline,
     AgentConnector,
+    AgentConnectorPipeline,
     ClipActions,
     ClipObservations,
     ConnectorPipeline,
+    ConvertToNumpy,
     FlattenObservations,
+    FrameStack,
     MeanStdFilter,
+    ObsPreprocessor,
+    UnsquashActions,
+    ViewRequirementConnector,
 )

@@ -46,26 +46,44 @@ class RolloutWorker:
     def __init__(self, env_spec, spec, worker_index: int = 0, num_envs: int = 1,
                  env_config: Optional[dict] = None, gamma: float = 0.99,
                  lambda_: float = 0.95, seed: int = 0, observation_filter: Optional[str] = None,
-                 agent_connectors=None, clip_actions: bool = True):
+                 agent_connectors=None, clip_actions: bool = True,
+                 action_connectors=None):
         import jax
 
         jax.config.update("jax_platforms", "cpu")  # rollouts stay off-chip
         # make_vector_env flattens MultiAgentEnvs into per-agent slots
         # (shared-policy training, reference's default policy mapping).
         self.env = make_vector_env(env_spec, num_envs, env_config, worker_index, seed=seed + worker_index * 1000)
-        # Connector pipelines (reference: rllib/connectors/{agent,action}):
-        # agent connectors shape observations before the policy forward;
-        # action connectors shape sampled actions before env.step — Box
-        # spaces get automatic action clipping (the policy's gaussian sample
-        # is unbounded).
-        from ray_tpu.rllib.connectors import ClipActions, ConnectorPipeline
+        # Connector pipelines (reference: rllib/connectors/connector.py:320 +
+        # agent/pipeline.py:21): agent connectors shape observations before
+        # the policy forward; action connectors shape sampled actions before
+        # env.step. The stateful observation filter is a PIPELINE STAGE (not
+        # ad hoc worker code): it runs first, user stages after. Box spaces
+        # get automatic action clipping appended (the policy's gaussian
+        # sample is unbounded).
+        from ray_tpu.rllib.connectors import (
+            ActionConnectorPipeline,
+            AgentConnectorPipeline,
+            ClipActions,
+            MeanStdFilter,
+        )
 
-        self.agent_connectors = ConnectorPipeline(list(agent_connectors or []))
-        action_stages = []
+        self._filter_stage = None
+        self._filter_delta = None
+        agent_stages = list(agent_connectors or [])
+        if observation_filter in ("MeanStdFilter", "mean_std"):
+            self._filter_stage = MeanStdFilter()
+            # Local-only accumulation since the last sync; the driver merges
+            # DELTAS (reference: FilterManager flushes buffers), because
+            # re-merging full states would double-count shared history.
+            self._filter_delta = MeanStdFilter()
+            agent_stages.insert(0, self._filter_stage)
+        self.agent_connectors = AgentConnectorPipeline(agent_stages)
+        action_stages = list(action_connectors or [])
         space = getattr(self.env, "action_space", None)
         if clip_actions and space is not None and hasattr(space, "low"):
             action_stages.append(ClipActions(space.low, space.high))
-        self.action_connectors = ConnectorPipeline(action_stages)
+        self.action_connectors = ActionConnectorPipeline(action_stages)
         # Async env-runner state (started on demand by start_async).
         self._async_thread: Optional[threading.Thread] = None
         self._async_stop: Optional[threading.Event] = None
@@ -79,16 +97,6 @@ class RolloutWorker:
         # train_batch_size stays agent-count-invariant.
         self._rows_per_step = max(1, self.env.num_envs // max(num_envs, 1))
         self.spec = spec
-        self.obs_filter = None
-        self._filter_delta = None
-        if observation_filter in ("MeanStdFilter", "mean_std"):
-            from ray_tpu.rllib.connectors import MeanStdFilter
-
-            self.obs_filter = MeanStdFilter()
-            # Local-only accumulation since the last sync; the driver merges
-            # DELTAS (reference: FilterManager flushes buffers), because
-            # re-merging full states would double-count shared history.
-            self._filter_delta = MeanStdFilter()
         self.gamma = gamma
         self.lambda_ = lambda_
         self._rng = jax.random.PRNGKey(seed + worker_index)
@@ -105,25 +113,26 @@ class RolloutWorker:
         self._params = jax.tree_util.tree_map(jnp.asarray, weights)
         return True
 
-    def _shape_obs(self, obs: np.ndarray, explore: bool) -> np.ndarray:
-        """Observation pipeline: stateful filter (stats update only while
-        exploring), then the agent connectors (transform-only when not
-        exploring, so stateful connectors never learn from eval/bootstrap
-        observations)."""
-        if self.obs_filter is not None:
-            with self._filter_lock:
-                if explore:
-                    self._filter_delta(obs)  # stats only; result unused
-                    obs = self.obs_filter(obs)
-                else:
-                    obs = self.obs_filter.transform(obs)
-        if self.agent_connectors.connectors:
-            obs = (
+    def _shape_obs(self, obs: np.ndarray, explore: bool, peek: bool = False) -> np.ndarray:
+        """One pipeline call: while exploring, stateful stages update
+        (__call__); otherwise transform-only, so learned statistics never
+        absorb eval observations (temporal stages like FrameStack advance
+        either way — see AgentConnector.transform). ``peek=True`` freezes
+        ALL state, temporal buffers included — for bootstrap forwards over
+        an obs the stepping loop will shape again (a transform there would
+        double-push the fragment-boundary frame)."""
+        if not self.agent_connectors.connectors:
+            return obs
+        with self._filter_lock:
+            if peek:
+                return self.agent_connectors.peek(obs)
+            if self._filter_stage is not None and explore:
+                self._filter_delta(obs)  # delta stats only; result unused
+            return (
                 self.agent_connectors(obs)
                 if explore
                 else self.agent_connectors.transform(obs)
             )
-        return obs
 
     def sample(self, num_steps: int, explore: bool = True) -> SampleBatch:
         """Collect `num_steps` per sub-env; GAE over each env's fragment."""
@@ -146,6 +155,10 @@ class RolloutWorker:
             cols[OBS].append(obs)
             cols[EPS_ID].append(self.env.eps_ids())
             _, rewards, dones, _ = self.env.step(env_actions)
+            # Episode boundaries reach temporal connectors (frame stacks
+            # re-seed finished slots before the next episode's first obs).
+            if np.any(dones):
+                self.agent_connectors.on_episode_done(dones)
             # The TRAINING batch keeps the raw sampled action: logp was
             # computed for it, and training on the clipped action would
             # bias the policy gradient at the clip boundary (reference
@@ -155,9 +168,10 @@ class RolloutWorker:
             cols[DONES].append(dones)
             cols[LOGPS].append(np.asarray(logp))
             cols[VF_PREDS].append(np.asarray(value))
-        # Bootstrap value for the final obs of each env.
+        # Bootstrap value for the final obs of each env (peek: the next
+        # fragment shapes this same obs as its first step).
         self._rng, key = jax.random.split(self._rng)
-        final_obs = self._shape_obs(self.env.current_obs().astype(np.float32), False)
+        final_obs = self._shape_obs(self.env.current_obs().astype(np.float32), False, peek=True)
         _, _, last_values = self._sample_fn(self._params, final_obs, key, False)
         last_values = np.asarray(last_values)
         # [T, N, ...] -> per-env fragments -> GAE -> concat.
@@ -264,7 +278,7 @@ class RolloutWorker:
         return {"episode_rewards": rewards, "episode_lens": lens}
 
     def get_filter_state(self):
-        return self.obs_filter.get_state() if self.obs_filter is not None else None
+        return self._filter_stage.get_state() if self._filter_stage is not None else None
 
     def pop_filter_delta(self):
         """Return accumulation since the last sync and reset it."""
@@ -278,9 +292,34 @@ class RolloutWorker:
         return state
 
     def set_filter_state(self, state) -> bool:
-        if self.obs_filter is not None and state is not None:
+        if self._filter_stage is not None and state is not None:
             with self._filter_lock:
-                self.obs_filter.set_state(state)
+                self._filter_stage.set_state(state)
+        return True
+
+    def get_connector_state(self) -> dict:
+        """Serialized agent+action pipelines (structure AND state) — what a
+        checkpoint carries so a restored worker resumes filters/stacks."""
+        with self._filter_lock:
+            return {
+                "agent": self.agent_connectors.serialize(),
+                "action": self.action_connectors.serialize(),
+            }
+
+    def set_connector_state(self, blobs: dict) -> bool:
+        from ray_tpu.rllib.connectors import ConnectorPipeline, MeanStdFilter
+
+        with self._filter_lock:
+            self.agent_connectors = ConnectorPipeline.deserialize(blobs["agent"])
+            self.action_connectors = ConnectorPipeline.deserialize(blobs["action"])
+            self._filter_stage = next(
+                (c for c in self.agent_connectors.connectors if isinstance(c, MeanStdFilter)),
+                None,
+            )
+            # Keep the delta accumulator consistent with the restored
+            # pipeline: a worker built filterless gains one, a worker whose
+            # restored pipeline dropped the filter must stop accumulating.
+            self._filter_delta = MeanStdFilter() if self._filter_stage is not None else None
         return True
 
     def ping(self) -> bool:
@@ -300,7 +339,7 @@ class WorkerSet:
                  seed: int = 0, num_cpus_per_worker: float = 1,
                  observation_filter: Optional[str] = None, agent_connectors=None,
                  clip_actions: bool = True, recreate_failed_workers: bool = True,
-                 max_worker_restarts: int = 100):
+                 max_worker_restarts: int = 100, action_connectors=None):
         self.observation_filter = observation_filter
         # Failure policy (reference: AlgorithmConfig.fault_tolerance()):
         # respawn dead workers while the restart budget lasts; afterwards
@@ -311,7 +350,7 @@ class WorkerSet:
         self._filter_base = None  # merged filter history (driver-side)
         self._make_worker = lambda idx: ray_tpu.remote(num_cpus=num_cpus_per_worker)(RolloutWorker).remote(
             env_spec, spec, idx, num_envs_per_worker, env_config, gamma, lambda_, seed,
-            observation_filter, agent_connectors, clip_actions
+            observation_filter, agent_connectors, clip_actions, action_connectors
         )
         self._workers = [self._make_worker(i + 1) for i in range(num_workers)]
         self._indices = list(range(1, num_workers + 1))
