@@ -163,7 +163,43 @@ class ClientCoreWorker:
                 "opts": _plain_opts(opts),
             },
         )
+        if "gen" in resp:
+            # num_returns="streaming" through the proxy (reference:
+            # util/client/worker.py streaming generators): item refs are
+            # pulled one at a time, so iteration overlaps the producer and
+            # the server buffers no values for slow consumers.
+            return ClientObjectRefGenerator(self, resp["gen"])
         return self._refs_from_ids(resp["ids"])
+
+    def stream_next(self, gen_id: str, index: int, timeout: float | None = None):
+        """Pull item `index` of a remote streaming generator; returns the
+        pinned ref id (hex). Raises StopIteration / GetTimeoutError /
+        the producer's error like the in-cluster generator."""
+        import time as _time
+
+        from ray_tpu.exceptions import GetTimeoutError
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        while True:
+            per_call = 10.0
+            if deadline is not None:
+                per_call = max(0.05, min(per_call, deadline - _time.monotonic()))
+            resp = self._call(
+                "client_gen_next",
+                {"gen": gen_id, "index": index, "timeout": per_call},
+                timeout=per_call + 30.0,
+            )
+            if resp.get("done"):
+                raise StopIteration
+            if "error" in resp:
+                raise serialization.loads(resp["error"])
+            if resp.get("pending"):
+                if deadline is not None and _time.monotonic() >= deadline:
+                    raise GetTimeoutError(
+                        f"stream item {index} not produced within {timeout}s"
+                    )
+                continue
+            return resp["id"]
 
     def create_actor(self, cls, args, kwargs, **opts):
         resp = self._call(
@@ -330,6 +366,30 @@ class ClientCoreWorker:
 def _plain_opts(opts: dict) -> dict:
     """Options must be msgpack-able; drop Nones."""
     return {k: v for k, v in opts.items() if v is not None}
+
+
+class ClientObjectRefGenerator:
+    """Client-side iterator over a remote streaming task's returns (the
+    proxy analog of ObjectRefGenerator): each __next__ pulls one pinned item
+    ref from the server, overlapping iteration with the remote producer."""
+
+    def __init__(self, cw: ClientCoreWorker, gen_id: str):
+        self._cw = cw
+        self._gen_id = gen_id
+        self._index = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> ObjectRef:
+        oid_hex = self._cw.stream_next(self._gen_id, self._index)
+        self._index += 1
+        return self._cw._refs_from_ids([oid_hex])[0]
+
+    def next_with_timeout(self, timeout: float) -> ObjectRef:
+        oid_hex = self._cw.stream_next(self._gen_id, self._index, timeout=timeout)
+        self._index += 1
+        return self._cw._refs_from_ids([oid_hex])[0]
 
 
 class ClientContext:

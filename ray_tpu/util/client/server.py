@@ -54,13 +54,20 @@ class _Session:
 class ClientServer:
     def __init__(self, core_worker, host: str = "0.0.0.0", port: int = 0,
                  stream_threshold: int = 1024 * 1024, session_ttl_s: float = 300.0,
-                 resp_cache_size: int = 128, stream_ttl_s: float = 180.0):
-        """``core_worker`` is a DRIVER-mode CoreWorker already connected."""
+                 resp_cache_size: int = 128, stream_ttl_s: float = 180.0,
+                 max_stream_bytes: int = 256 * 1024 * 1024):
+        """``core_worker`` is a DRIVER-mode CoreWorker already connected.
+
+        ``max_stream_bytes`` caps the bytes buffered in a session's download
+        streams: a slow consumer that opens gets faster than it drains them
+        BLOCKS further gets (data-channel backpressure) instead of growing
+        server memory without bound."""
         self.cw = core_worker
         self.stream_threshold = stream_threshold
         self.session_ttl_s = session_ttl_s
         self.resp_cache_size = resp_cache_size
         self.stream_ttl_s = stream_ttl_s
+        self.max_stream_bytes = max_stream_bytes
         self._sessions: dict[str, _Session] = {}
         self._last_reap = 0.0
         self._lock = threading.Lock()
@@ -211,9 +218,40 @@ class ClientServer:
                 return self.cw.submit_task(func, args, kwargs, **opts)
 
             refs = await self._off_loop(compute_sync)
+            from ray_tpu.object_ref import ObjectRefGenerator
+
+            if isinstance(refs, ObjectRefGenerator):
+                # num_returns="streaming": the client pulls item refs one at
+                # a time (client_gen_next) — values stay IN the cluster until
+                # fetched, so a slow consumer buffers nothing server-side.
+                return {"gen": refs._task_id}
             return {"ids": self._pin(req.get("client_id", ""), refs)}
 
         return await self._cached_call(req, compute)
+
+    async def rpc_gen_next(self, req):
+        """Next item ref of a streaming generator. Bounded wait per call
+        ({"pending": True} when the producer hasn't yielded item `index`
+        yet — the client re-polls), {"done": True} past the end."""
+        from ray_tpu.exceptions import GetTimeoutError
+        from ray_tpu.object_ref import ObjectID, ObjectRef
+
+        def pull():
+            try:
+                oid_hex = self.cw.stream_next(
+                    req["gen"], int(req["index"]),
+                    timeout=min(float(req.get("timeout") or 10.0), 30.0),
+                )
+            except StopIteration:
+                return {"done": True}
+            except GetTimeoutError:
+                return {"pending": True}
+            except Exception as e:  # producer raised: surface to the client
+                return {"error": serialization.dumps(e)}
+            ref = ObjectRef(ObjectID.from_hex(oid_hex), self.cw.address)
+            return {"id": self._pin(req.get("client_id", ""), [ref])[0]}
+
+        return await self._off_loop(pull)
 
     async def rpc_create_actor(self, req):
         async def compute():
@@ -272,12 +310,27 @@ class ClientServer:
                     # replay cache (128 entries x up to 1MiB adds up).
                     resp["_nocache"] = True
                 return resp
-            # Large value: hand back a chunk stream (data channel).
+            # Large value: hand back a chunk stream (data channel), gated by
+            # the per-session buffer cap — a consumer with undrained streams
+            # waits here (backpressure) rather than stacking blobs.
+            import asyncio
+
             sess = self._session(req.get("client_id", ""))
             sid = uuid.uuid4().hex
-            with self._lock:
-                sess.streams[sid] = blob
-                sess.stream_ts[sid] = time.time()
+            deadline = time.time() + self.stream_ttl_s
+            while True:
+                with self._lock:
+                    buffered = sum(len(b) for b in sess.streams.values())
+                    if not sess.streams or buffered + len(blob) <= self.max_stream_bytes:
+                        sess.streams[sid] = blob
+                        sess.stream_ts[sid] = time.time()
+                        break
+                if time.time() > deadline:
+                    return {"error": serialization.dumps(RuntimeError(
+                        f"data channel backlog: {buffered} bytes undrained "
+                        f"(cap {self.max_stream_bytes}); drain or raise the cap"
+                    ))}
+                await asyncio.sleep(0.05)
             return {"stream": sid, "size": len(blob), "chunk_size": CHUNK_SIZE}
 
         return await self._cached_call(req, compute)
