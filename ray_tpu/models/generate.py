@@ -293,8 +293,29 @@ def generate(
     return toks.T  # [B, max_new_tokens]
 
 
+def _processed_probs(logits, temperature: float, top_p: float):
+    """Temperature + nucleus(top-p) processed distribution [..., V] (f32).
+    Spec-decode exactness is defined W.R.T. this processed distribution —
+    the same processing applies to target and draft."""
+    logits = logits.astype(jnp.float32) / max(temperature, 1e-6)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if top_p < 1.0:
+        sorted_probs = jnp.flip(jnp.sort(probs, axis=-1), axis=-1)
+        cum = jnp.cumsum(sorted_probs, axis=-1)
+        # keep the smallest prefix with mass >= top_p (ties at the cutoff
+        # prob all kept — standard nucleus caveat)
+        n_keep = jnp.sum(cum - sorted_probs < top_p, axis=-1)
+        cutoff = jnp.take_along_axis(
+            sorted_probs, jnp.maximum(n_keep - 1, 0)[..., None], axis=-1
+        )
+        probs = jnp.where(probs >= cutoff, probs, 0.0)
+        probs = probs / jnp.maximum(probs.sum(-1, keepdims=True), 1e-30)
+    return probs
+
+
 @partial(
-    jax.jit, static_argnames=("cfg", "draft_cfg", "max_new_tokens", "k")
+    jax.jit,
+    static_argnames=("cfg", "draft_cfg", "max_new_tokens", "k", "temperature", "top_p"),
 )
 def speculative_generate(
     params,
@@ -304,22 +325,36 @@ def speculative_generate(
     draft_cfg: TransformerConfig,
     max_new_tokens: int = 32,
     k: int = 4,
+    temperature: float = 0.0,
+    top_p: float = 1.0,
+    key=None,
 ):
-    """Greedy speculative decoding: a small draft model proposes ``k``
-    tokens per round from its own cache; the target verifies all of them in
-    ONE ``decode_chunk`` and commits the accepted prefix plus its own next
-    token (1..k+1 tokens per target pass).
+    """Speculative decoding: a small draft model proposes ``k`` tokens per
+    round from its own cache; the target verifies all of them in ONE
+    ``decode_chunk`` and commits the accepted prefix plus one more token
+    (1..k+1 tokens per target pass).
 
-    Output is EXACTLY ``generate(params, prompt, cfg, temperature=0.0)`` —
-    the draft changes only how many target forward passes are spent, never
-    the result (greedy acceptance: a draft token is accepted iff it equals
-    the target argmax at that position). Both models must share the vocab.
-    No cache rewind on rejection: stale rows past the committed position
-    are invisible to the position mask and simply overwritten next round.
+    ``temperature == 0`` is greedy-exact: output is EXACTLY
+    ``generate(params, prompt, cfg, temperature=0.0)`` — a draft token is
+    accepted iff it equals the target argmax at that position.
+
+    ``temperature > 0`` is sampling-exact IN DISTRIBUTION via the standard
+    accept-reject scheme (Leviathan et al. 2023; Chen et al. 2023): the
+    draft SAMPLES x_i ~ q_i, the target accepts with prob
+    min(1, p_i(x_i)/q_i(x_i)), and the first rejection resamples from the
+    leftover distribution norm(max(p_i - q_i, 0)); a fully-accepted round
+    samples its bonus token from p_{k+1}. Each emitted token is marginally
+    distributed exactly as temperature/top-p sampling from the target.
+    Both models must share the vocab. No cache rewind on rejection: stale
+    rows past the committed position are invisible to the position mask and
+    simply overwritten next round.
 
     Returns (tokens [B, max_new_tokens] int32, rounds int32 — target
     passes spent; rounds << max_new_tokens when the draft agrees often).
     """
+    sampling = temperature > 0.0
+    if key is None:
+        key = jax.random.PRNGKey(0)
     B, T = prompt.shape
     S = T + max_new_tokens + k + 1
     t_cache = init_cache(cfg, B, S)
@@ -328,40 +363,78 @@ def speculative_generate(
     _, d_cache, _ = prefill(draft_params, prompt, d_cache, draft_cfg)
     # The two caches are position-locked: one pos drives both (they commit
     # the identical token sequence every round).
-    cur = t_logits.argmax(axis=-1).astype(jnp.int32)  # first emitted token
+    key, k0 = jax.random.split(key)
+    if sampling:
+        p0 = _processed_probs(t_logits, temperature, top_p)
+        cur = jax.random.categorical(k0, jnp.log(p0 + 1e-30), axis=-1).astype(jnp.int32)
+    else:
+        cur = t_logits.argmax(axis=-1).astype(jnp.int32)  # first emitted token
 
     out = jnp.zeros((B, max_new_tokens), jnp.int32)
     out = out.at[:, 0].set(cur)
     n = jnp.ones((B,), jnp.int32)  # tokens emitted so far
 
-    def draft_propose(d_cache, cur, d_pos):
+    def draft_propose(d_cache, cur, d_pos, kd):
         # k+1 steps so the draft cache holds rows for cur AND all k
         # proposals (including d_k): a fully-accepted round advances by
         # k+1 rows, and every one of them must be written. The (k+1)-th
         # prediction is discarded.
-        def body(carry, _):
+        def body(carry, kk):
             cache, tok, pos = carry
             logits, cache = decode_step(draft_params, tok, cache, pos, draft_cfg)
-            nxt = logits.argmax(axis=-1).astype(jnp.int32)
-            return (cache, nxt, pos + 1), nxt
+            if sampling:
+                q = _processed_probs(logits, temperature, top_p)
+                nxt = jax.random.categorical(kk, jnp.log(q + 1e-30), axis=-1)
+                nxt = nxt.astype(jnp.int32)
+            else:
+                q = jnp.zeros((B, logits.shape[-1]), jnp.float32)
+                nxt = logits.argmax(axis=-1).astype(jnp.int32)
+            return (cache, nxt, pos + 1), (nxt, q)
 
-        (d_cache, _, d_pos), drafts = lax.scan(
-            body, (d_cache, cur, d_pos), None, length=k + 1
+        (d_cache, _, d_pos), (drafts, qs) = lax.scan(
+            body, (d_cache, cur, d_pos), jax.random.split(kd, k + 1)
         )
-        return d_cache, drafts.T[:, :k], d_pos  # proposals [B, k]
+        # proposals [B, k]; their processed draft distributions [B, k, V]
+        return d_cache, drafts.T[:, :k], qs.transpose(1, 0, 2)[:, :k], d_pos
 
     def round_body(state):
-        out, n, cur, pos, t_cache, d_cache, rounds = state
-        d_cache, drafts, _ = draft_propose(d_cache, cur, pos)
+        out, n, cur, pos, t_cache, d_cache, rounds, key = state
+        key, kd, ka, kb = jax.random.split(key, 4)
+        d_cache, drafts, qs, _ = draft_propose(d_cache, cur, pos, kd)
         fed = jnp.concatenate([cur[:, None], drafts], axis=1)  # [B, k+1]
         logits, t_cache = decode_chunk(params, fed, t_cache, pos, cfg)
-        preds = logits.argmax(axis=-1).astype(jnp.int32)  # [B, k+1]
-        # accepted[b] = longest prefix of drafts matching target argmax.
-        match = drafts == preds[:, :k]  # [B, k]
-        accepted = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
-        # Emit d1..d_accepted then the target's own token at the divergence
-        # (or after all k when fully accepted): k+1 candidate slots.
-        bonus = jnp.take_along_axis(preds, accepted[:, None], axis=1)[:, 0]
+        if sampling:
+            ps = _processed_probs(logits, temperature, top_p)  # [B, k+1, V]
+            p_at = jnp.take_along_axis(ps[:, :k], drafts[..., None], axis=-1)[..., 0]
+            q_at = jnp.take_along_axis(qs, drafts[..., None], axis=-1)[..., 0]
+            u = jax.random.uniform(ka, (B, k))
+            # accept x_i iff u < p(x_i)/q(x_i)  (u*q < p is div-by-zero safe)
+            accept = u * q_at < p_at
+            accepted = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)
+            # Rejection at position r = accepted: resample from the leftover
+            # norm(max(p_r - q_r, 0)); full acceptance: sample from p_k.
+            p_r = jnp.take_along_axis(
+                ps, accepted[:, None, None], axis=1
+            )[:, 0]  # [B, V]
+            q_r = jnp.take_along_axis(
+                qs, jnp.minimum(accepted, k - 1)[:, None, None], axis=1
+            )[:, 0]
+            q_r = jnp.where((accepted < k)[:, None], q_r, 0.0)
+            resid = jnp.maximum(p_r - q_r, 0.0)
+            z = resid.sum(-1, keepdims=True)
+            # Degenerate residual (p <= q everywhere, numerically) -> p_r.
+            resid = jnp.where(z > 1e-30, resid / jnp.maximum(z, 1e-30), p_r)
+            bonus = jax.random.categorical(
+                kb, jnp.log(resid + 1e-30), axis=-1
+            ).astype(jnp.int32)
+        else:
+            preds = logits.argmax(axis=-1).astype(jnp.int32)  # [B, k+1]
+            # accepted[b] = longest prefix of drafts matching target argmax.
+            match = drafts == preds[:, :k]  # [B, k]
+            accepted = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+            bonus = jnp.take_along_axis(preds, accepted[:, None], axis=1)[:, 0]
+        # Emit d1..d_accepted then the bonus token at the divergence (or
+        # after all k when fully accepted): k+1 candidate slots.
         emit = jnp.where(
             jnp.arange(k + 1)[None, :] < accepted[:, None],
             jnp.concatenate([drafts, jnp.zeros((B, 1), jnp.int32)], axis=1),
@@ -387,12 +460,12 @@ def speculative_generate(
             jnp.take_along_axis(emit, jnp.maximum(n_emit - 1, 0)[:, None], axis=1)[:, 0],
             cur,
         )
-        return (out, n + n_emit, new_cur, pos + adv, t_cache, d_cache, rounds + 1)
+        return (out, n + n_emit, new_cur, pos + adv, t_cache, d_cache, rounds + 1, key)
 
     def round_cond(state):
         _, n, *_rest = state
         return jnp.any(n < max_new_tokens)
 
-    state = (out, n, cur, pos, t_cache, d_cache, jnp.int32(0))
-    out, n, *_r, rounds = lax.while_loop(round_cond, round_body, state)
+    state = (out, n, cur, pos, t_cache, d_cache, jnp.int32(0), key)
+    out, n, *_r, rounds, _key = lax.while_loop(round_cond, round_body, state)
     return out, rounds

@@ -63,3 +63,60 @@ def test_llm_deployment_generates(serve_instance):
     out2 = json.loads(urllib.request.urlopen(req, timeout=60).read())
     assert out2 == out
     serve.delete("TinyLM")
+
+
+def test_llm_deployment_speculative_sampling(serve_instance):
+    """Sampling-mode speculative decoding behind Serve: the deployment holds
+    target + draft params and serves temperature/top-p spec-decode; seeded
+    requests are reproducible, different seeds vary."""
+
+    @serve.deployment
+    class SpecLM:
+        def __init__(self):
+            import jax
+            import jax.numpy as jnp
+
+            from ray_tpu.models.transformer import TransformerConfig, init_params
+
+            mk = lambda d_model, d_ff, layers: TransformerConfig(
+                vocab_size=64, d_model=d_model, n_layers=layers, n_heads=4,
+                n_kv_heads=4, d_ff=d_ff, max_seq_len=48, dtype=jnp.float32,
+                remat=False,
+            )
+            self.cfg, self.draft_cfg = mk(32, 64, 2), mk(16, 32, 1)
+            self.params = init_params(jax.random.PRNGKey(0), self.cfg)
+            self.draft_params = init_params(jax.random.PRNGKey(9), self.draft_cfg)
+
+        def __call__(self, request):
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            from ray_tpu.models.generate import speculative_generate
+
+            body = request.json()
+            out, rounds = speculative_generate(
+                self.params, self.draft_params,
+                jnp.asarray([body["tokens"]], jnp.int32),
+                self.cfg, self.draft_cfg, max_new_tokens=6, k=2,
+                temperature=0.8, top_p=0.95,
+                key=jax.random.PRNGKey(int(body.get("seed", 0))),
+            )
+            return {"tokens": np.asarray(out)[0].tolist(), "rounds": int(rounds)}
+
+    serve.run(SpecLM.bind(), route_prefix="/speclm")
+    host, port = serve.http_address()
+
+    def ask(seed):
+        req = urllib.request.Request(
+            f"http://{host}:{port}/speclm",
+            data=json.dumps({"tokens": [1, 2, 3], "seed": seed}).encode(),
+        )
+        return json.loads(urllib.request.urlopen(req, timeout=120).read())
+
+    a, b, c = ask(7), ask(7), ask(8)
+    assert a == b, "seeded sampling must be reproducible"
+    assert len(a["tokens"]) == 6 and all(0 <= t < 64 for t in a["tokens"])
+    assert 1 <= a["rounds"] <= 6
+    assert c["tokens"] != a["tokens"] or c["rounds"] != a["rounds"]
+    serve.delete("SpecLM")
