@@ -49,6 +49,31 @@ int main(int argc, char** argv) {
       printf("ERROR_OK %s\n", e.what());
     }
 
+    // 5. Object pipeline (native data path): one task PRODUCES 8 MiB
+    //    (stored in the node's plasma arena, reported as a ["plasma"]
+    //    result), the next consumes it BY REF (the C++ worker reads it
+    //    zero-copy through the shm index), and the final plasma-sized
+    //    result streams back to this driver over the wire (store_get +
+    //    chunk fetches — the driver itself stays shm-free).
+    const int64_t N = 2 * 1024 * 1024;  // floats -> 8 MiB
+    rtpu::ObjectRef big = driver.Task("xlang_make_floats", lib).Remote(rtpu::V(N));
+    rtpu::ObjectRef scaled =
+        driver.Task("xlang_vector_scale", lib).Remote(big, rtpu::V(3.0));
+    Value v5 = driver.Get(scaled, 120000);
+    if (v5.kind != Value::BIN || v5.s.size() != (size_t)N * 4) {
+      fprintf(stderr, "pipeline: bad result (%zu bytes)\n", v5.s.size());
+      return 1;
+    }
+    for (int64_t i : {int64_t(0), int64_t(12345), N - 1}) {
+      float f;
+      std::memcpy(&f, v5.s.data() + (size_t)i * 4, 4);
+      if (f != (float)i * 0.5f * 3.0f) {
+        fprintf(stderr, "pipeline: wrong value at %lld: %f\n", (long long)i, f);
+        return 1;
+      }
+    }
+    printf("PIPELINE_OK %zu bytes\n", v5.s.size());
+
     printf("CPP_API_PASS\n");
     return 0;
   } catch (const std::exception& e) {

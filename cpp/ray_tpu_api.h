@@ -70,12 +70,23 @@ inline Value List(std::vector<Value> items) {
 
 struct ObjectRef {
   std::string task_id;  // 48-hex; the return object is task_id + "00000000"
+  std::string object_id() const { return task_id + "00000000"; }
+};
+
+// One task argument: a plain msgpack value, or a previous task's ObjectRef
+// (ships as an ["r", oid, owner] entry; the worker fetches it natively).
+struct Arg {
+  Value value;
+  std::string ref_oid;  // non-empty => ObjectRef arg
+  Arg(Value v) : value(std::move(v)) {}          // NOLINT(runtime/explicit)
+  Arg(const ObjectRef& r) : ref_oid(r.object_id()) {}  // NOLINT
 };
 
 class Driver;
 
 // `driver.Task(symbol, library).Remote(v...)` — the reference's
-// `ray::Task(fn).Remote(...)` shape for C-ABI kernel functions.
+// `ray::Task(fn).Remote(...)` shape for C-ABI kernel functions. Args are
+// msgpack Values or ObjectRefs of earlier tasks.
 class TaskHandle {
  public:
   TaskHandle(Driver* d, std::string symbol, std::string library)
@@ -118,9 +129,10 @@ class Driver {
     return TaskHandle(this, symbol, library);
   }
 
-  // Submit a cross-language task; args are msgpack Values (see V/Bin/List).
+  // Submit a cross-language task; args are msgpack Values (see V/Bin/List)
+  // or ObjectRefs of this driver's earlier tasks.
   ObjectRef Submit(const std::string& library, const std::string& symbol,
-                   const std::vector<Value>& args) {
+                   const std::vector<Arg>& args) {
     std::string task_id = rtpu_wire::random_hex(24);
     Packer p;
     p.map_header(1);
@@ -133,9 +145,19 @@ class Driver {
     p.str("language"); p.str("cpp");
     p.str("args");
     p.array_header((uint32_t)args.size());
-    for (const Value& a : args) {
+    for (const Arg& a : args) {
+      if (!a.ref_oid.empty()) {
+        // ["r", oid, [host, port]] — this driver is the owner.
+        p.array_header(3);
+        p.str("r");
+        p.str(a.ref_oid);
+        p.array_header(2);
+        p.str(owner_host_);
+        p.integer(owner_port_);
+        continue;
+      }
       Packer ap;
-      pack_value(ap, a);
+      pack_value(ap, a.value);
       p.array_header(2);
       p.str("v");
       p.bin(rtpu_wire::encode_x_object(ap.out, "x"));
@@ -197,13 +219,57 @@ class Driver {
     if (!results || results->arr.empty())
       throw TaskFailed("task completed with no results");
     const Value& entry = results->arr[0];
-    if (entry.arr.size() < 3 || entry.arr[1].s != "inline")
-      throw TaskFailed("non-inline result (not supported by the C++ driver)");
+    if (entry.arr.size() < 3)
+      throw TaskFailed("malformed result entry");
+    std::string wire;
+    if (entry.arr[1].s == "inline") {
+      wire = entry.arr[2].s;
+    } else if (entry.arr[1].s == "plasma") {
+      // Plasma-sized result: ride the wire through our raylet — store_get
+      // pulls it local (if produced elsewhere) and pins it; chunk reads
+      // assemble the serialized object; release drops the pin. (Workers
+      // read the arena zero-copy; the driver stays shm-free and portable.)
+      wire = FetchPlasma(entry.arr[0].s);
+    } else {
+      throw TaskFailed("unknown result location '" + entry.arr[1].s + "'");
+    }
     Value out;
     std::string derr;
-    if (!rtpu_wire::decode_x_object(entry.arr[2].s, "x", &out, &derr))
+    if (!rtpu_wire::decode_x_object(wire, "x", &out, &derr))
       throw TaskFailed("result decode failed: " + derr);
     return out;
+  }
+
+  std::string FetchPlasma(const std::string& oid) {
+    std::lock_guard<std::mutex> lk(raylet_mu_);
+    Packer g;
+    g.map_header(2);
+    g.str("object_id"); g.str(oid);
+    g.str("timeout"); g.floating(60.0);
+    Value got = raylet_->call("store_get", g.out);
+    const Value* sz = got.get("size");
+    if (!sz) throw TaskFailed("store_get returned no size for " + oid.substr(0, 12));
+    std::string wire;
+    wire.reserve((size_t)sz->i);
+    const int64_t kChunk = 4 * 1024 * 1024;
+    for (int64_t pos = 0; pos < sz->i;) {
+      Packer c;
+      c.map_header(3);
+      c.str("object_id"); c.str(oid);
+      c.str("start"); c.integer(pos);
+      c.str("length"); c.integer(kChunk);
+      Value chunk = raylet_->call("fetch_object_chunk", c.out);
+      const Value* data = chunk.get("data");
+      if (!data || data->s.empty())
+        throw TaskFailed("fetch_object_chunk starved at " + std::to_string(pos));
+      wire += data->s;
+      pos += (int64_t)data->s.size();
+    }
+    Packer r;
+    r.map_header(1);
+    r.str("object_id"); r.str(oid);
+    try { raylet_->call("store_release", r.out); } catch (...) {}
+    return wire;
   }
 
  private:
@@ -283,6 +349,42 @@ class Driver {
     resp.integer(1);  // RESPONSE
     resp.integer(seq);
     resp.str(method);
+    if (method == "get_inline") {
+      // Serve an owned result to a borrower (the native worker fetching a
+      // ref arg of a follow-up task). Non-blocking: the serve thread also
+      // processes task_done, so it must never wait on one — a not-yet-done
+      // producer answers "missing" and the worker polls.
+      const Value* oid = msg.arr.at(3).get("object_id");
+      std::string kind = "missing", data, location;
+      if (oid && oid->s.size() > 8) {
+        const std::string task_id = oid->s.substr(0, oid->s.size() - 8);
+        std::lock_guard<std::mutex> lk(mu_);
+        auto it = done_.find(task_id);
+        if (it != done_.end()) {
+          const Value* results = it->second.get("results");
+          if (results) {
+            for (const Value& entry : results->arr) {
+              if (entry.arr.size() >= 3 && entry.arr[0].s == oid->s) {
+                if (entry.arr[1].s == "inline") {
+                  kind = "inline";
+                  data = entry.arr[2].s;
+                } else if (entry.arr[1].s == "plasma") {
+                  kind = "plasma";
+                  location = entry.arr[2].s;
+                }
+                break;
+              }
+            }
+          }
+        }
+      }
+      resp.map_header(kind == "missing" ? 1 : 2);
+      resp.str("kind"); resp.str(kind);
+      if (kind == "inline") { resp.str("data"); resp.bin(data); }
+      else if (kind == "plasma") { resp.str("location"); resp.str(location); }
+      rtpu_wire::send_all(fd, rtpu_wire::frame(resp.out));
+      return;
+    }
     resp.map_header(1);
     resp.str("ok");
     resp.boolean(true);
@@ -387,7 +489,7 @@ class Driver {
 
 template <typename... A>
 ObjectRef TaskHandle::Remote(A&&... a) {
-  std::vector<Value> args{std::forward<A>(a)...};
+  std::vector<Arg> args{Arg(std::forward<A>(a))...};
   return d_->Submit(library_, symbol_, args);
 }
 

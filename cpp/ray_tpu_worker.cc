@@ -23,12 +23,23 @@
 //                                     reference: core_worker.cc
 //                                     ExitIfParentRayletDies)
 //
-// v1 limits (documented in PARITY.md): normal tasks only (no actors), args
-// must be inline cross-language values ("v" entries — ObjectRef args are
-// answered with a typed error), single return, inline results.
+// Object data path (the reference's native task_executor.cc +
+// object_store.cc analog): ObjectRef args resolve NATIVELY — local sealed
+// objects read zero-copy through the shm index + arena (the same C APIs
+// ctypes uses, compiled in), misses fetch from the OWNER over the wire
+// (get_inline; a "plasma" answer routes back through this node's raylet
+// store_get, which pulls cross-node), and plasma-sized RESULTS are written
+// into the arena (store_create -> memcpy -> store_seal) and reported as
+// ["plasma", node_id] entries instead of inline bytes. Only format-"x"
+// objects are native-decodable; the owner's router (core_worker.submit_task)
+// guarantees that by keeping non-provably-"x" ref args on the Python path.
+//
+// Remaining limits (documented in PARITY.md): normal tasks only (no
+// actors), single return.
 //
 // Build (automatic, cached): g++ -O2 -std=c++17 -o ray_tpu_cpp_worker
-//   cpp/ray_tpu_worker.cc -ldl
+//   cpp/ray_tpu_worker.cc ray_tpu/_native/shm_arena.cc
+//   ray_tpu/_native/shm_index.cc -ldl
 
 #include <arpa/inet.h>
 #include <dlfcn.h>
@@ -56,6 +67,40 @@ using rtpu_wire::RpcClient;
 using rtpu_wire::encode_x_object;
 using rtpu_wire::frame;
 using rtpu_wire::send_all;
+
+// shm arena/index C APIs (ray_tpu/_native/shm_{arena,index}.cc — compiled
+// into this binary; the same functions Python drives through ctypes).
+extern "C" {
+int arena_attach(const char* name);
+void* arena_base(int handle);
+int arena_close(int handle, int unlink_seg);
+int idx_attach(const char* name);
+int idx_get_pinned(int handle, const uint8_t* key, uint64_t* offset,
+                   uint64_t* size, uint32_t* version, uint64_t* slot);
+int idx_release(int handle, uint64_t slot, uint32_t version);
+int idx_close(int handle, int unlink_seg);
+}
+
+static int g_arena = -1;
+static int g_idx = -1;
+static std::string g_node_id;
+static const size_t kObjectKeyLen = 28;  // ids.py OBJECT_ID_SIZE
+
+static bool hex_to_key(const std::string& hex, uint8_t* key) {
+  if (hex.size() != 2 * kObjectKeyLen) return false;
+  auto nib = [](char c) -> int {
+    if (c >= '0' && c <= '9') return c - '0';
+    if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+    if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+    return -1;
+  };
+  for (size_t i = 0; i < kObjectKeyLen; ++i) {
+    int hi = nib(hex[2 * i]), lo = nib(hex[2 * i + 1]);
+    if (hi < 0 || lo < 0) return false;
+    key[i] = (uint8_t)((hi << 4) | lo);
+  }
+  return true;
+}
 
 // Decode an inline framework arg; only format-"x" is native-decodable.
 static bool decode_arg(const std::string& blob, Value* out, std::string* err) {
@@ -147,6 +192,124 @@ static RpcClient* owner_client(const std::string& host, int port,
   return it->second.get();
 }
 
+// ---------------------------------------------------------------------------
+// Object data path (reference: cpp/src/ray/runtime/object/object_store.cc).
+// ---------------------------------------------------------------------------
+
+// Fetch an object's serialized wire bytes by id. Fast path: local sealed
+// object via the shm index (pin -> copy out -> release). Miss: ask the
+// OWNER (get_inline) — inline objects arrive as bytes, plasma answers route
+// through this node's raylet store_get, which pulls cross-node if needed.
+static bool fetch_object_bytes(const std::string& oid_hex,
+                               const std::string& owner_host, int owner_port,
+                               std::map<std::string, std::unique_ptr<RpcClient>>& owners,
+                               std::string* out, std::string* err) {
+  uint8_t key[kObjectKeyLen];
+  if (g_arena >= 0 && g_idx >= 0 && hex_to_key(oid_hex, key)) {
+    uint64_t offset = 0, size = 0, slot = 0;
+    uint32_t version = 0;
+    if (idx_get_pinned(g_idx, key, &offset, &size, &version, &slot)) {
+      const char* base = (const char*)arena_base(g_arena);
+      out->assign(base + offset, size);
+      idx_release(g_idx, slot, version);
+      return true;
+    }
+  }
+  // Not sealed locally: the owner knows where it lives. Python owners
+  // block server-side on wait=true; the C++ driver's owner server answers
+  // "missing" for not-yet-done producers (its serve thread must not block),
+  // so poll with a bounded budget.
+  try {
+    RpcClient* owner = owner_client(owner_host, owner_port, owners);
+    Packer p;
+    p.map_header(2);
+    p.str("object_id"); p.str(oid_hex);
+    p.str("wait"); p.boolean(true);
+    Value resp;
+    for (int attempt = 0; ; ++attempt) {
+      resp = owner->call("get_inline", p.out);
+      const Value* k = resp.get("kind");
+      if (!k || k->s != "missing") break;
+      if (attempt >= 600) {  // ~60s
+        *err = "object " + oid_hex.substr(0, 12) + " never materialized at its owner";
+        return false;
+      }
+      struct timespec ts = {0, 100 * 1000 * 1000};
+      nanosleep(&ts, nullptr);
+    }
+    const Value* kind = resp.get("kind");
+    if (kind && kind->s == "inline") {
+      const Value* data = resp.get("data");
+      if (!data) { *err = "owner get_inline returned no data"; return false; }
+      *out = data->s;
+      return true;
+    }
+    if (kind && kind->s == "plasma") {
+      // Somewhere in the cluster's plasma tier: store_get on OUR raylet
+      // blocks until it is sealed locally (pulling if remote).
+      Packer q;
+      q.map_header(2);
+      q.str("object_id"); q.str(oid_hex);
+      q.str("timeout"); q.floating(60.0);
+      Value got = g_raylet->call("store_get", q.out);
+      const Value* off = got.get("offset");
+      const Value* sz = got.get("size");
+      if (!off || !sz || g_arena < 0) {
+        *err = "store_get gave no offset/size (or no arena attached)";
+        return false;
+      }
+      const char* base = (const char*)arena_base(g_arena);
+      out->assign(base + (uint64_t)off->i, (size_t)sz->i);
+      Packer r;
+      r.map_header(1);
+      r.str("object_id"); r.str(oid_hex);
+      try { g_raylet->call("store_release", r.out); } catch (...) {}
+      return true;
+    }
+    *err = "object " + oid_hex.substr(0, 12) + " unavailable (owner says " +
+           (kind ? kind->s : "?") + ")";
+    return false;
+  } catch (const std::exception& e) {
+    *err = std::string("object fetch failed: ") + e.what();
+    return false;
+  }
+}
+
+// Write a plasma-sized result into the arena via the raylet's create/seal
+// protocol. Returns false (fall back to inline) on any trouble.
+static bool store_result_bytes(const std::string& oid_hex, const std::string& bytes,
+                               std::string* err) {
+  if (g_arena < 0) { *err = "no arena"; return false; }
+  try {
+    Packer c;
+    c.map_header(2);
+    c.str("object_id"); c.str(oid_hex);
+    c.str("size"); c.integer((int64_t)bytes.size());
+    Value resp = g_raylet->call("store_create", c.out);
+    const Value* exists = resp.get("exists");
+    if (exists && exists->truthy()) {
+      const Value* sealed = resp.get("sealed");
+      // Sealed: idempotent re-execution, nothing to write. Unsealed: a
+      // rival session owns the buffer — don't co-write it.
+      if (sealed && sealed->truthy()) return true;
+      *err = "rival unsealed create";
+      return false;
+    }
+    const Value* off = resp.get("offset");
+    if (!off) { *err = "store_create gave no offset"; return false; }
+    std::memcpy((char*)arena_base(g_arena) + (uint64_t)off->i, bytes.data(),
+                bytes.size());
+    Packer s;
+    s.map_header(1);
+    s.str("object_id"); s.str(oid_hex);
+    g_raylet->call("store_seal", s.out);
+    return true;
+  } catch (const std::exception& e) {
+    *err = e.what();
+    return false;
+  }
+}
+
 // Execute one pushed task spec; report to the owner and the raylet.
 static void execute_task(const Value& spec,
                          std::map<std::string, std::unique_ptr<RpcClient>>& owners) {
@@ -175,7 +338,9 @@ static void execute_task(const Value& spec,
     symbol = fkey->s.substr(bang + 1);
   }
 
-  // Args: inline "v" entries decode natively; "r" refs are a v1 limit.
+  // Args: inline "v" entries decode in place; "r" refs resolve through the
+  // native object path (shm zero-copy locally, owner/raylet fetch
+  // otherwise). Both end as format-"x" wire bytes -> msgpack values.
   if (ok) {
     Packer args_pk;
     const Value* args = spec.get("args");
@@ -184,14 +349,26 @@ static void execute_task(const Value& spec,
     for (uint32_t i = 0; ok && i < n; ++i) {
       const Value& a = args->arr[i];
       if (a.kind != Value::ARR || a.arr.empty()) { ok = false; err = "malformed arg"; break; }
+      std::string wire_bytes;
       if (a.arr[0].s == "r") {
-        ok = false;
-        err = "ObjectRef args are not supported by the C++ worker runtime yet "
-              "— pass plain values to cpp_function tasks";
-        break;
+        // ["r", oid_hex, [owner_host, owner_port]]
+        if (a.arr.size() < 3 || a.arr[2].kind != Value::ARR ||
+            a.arr[2].arr.size() != 2) {
+          ok = false;
+          err = "malformed ref arg";
+          break;
+        }
+        if (!fetch_object_bytes(a.arr[1].s, a.arr[2].arr[0].s,
+                                (int)a.arr[2].arr[1].i, owners, &wire_bytes,
+                                &err)) {
+          ok = false;
+          break;
+        }
+      } else {
+        wire_bytes = a.arr[1].s;
       }
       Value decoded;
-      if (!decode_arg(a.arr[1].s, &decoded, &err)) { ok = false; break; }
+      if (!decode_arg(wire_bytes, &decoded, &err)) { ok = false; break; }
       pack_value(args_pk, decoded);
     }
     if (ok) ok = run_kernel(library, symbol, args_pk.out, &result_payload, &err);
@@ -201,17 +378,36 @@ static void execute_task(const Value& spec,
   clock_gettime(CLOCK_MONOTONIC, &t1);
   double dur = (t1.tv_sec - t0.tv_sec) + (t1.tv_nsec - t0.tv_nsec) * 1e-9;
 
-  // task_done payload to the owner.
+  // task_done payload to the owner. Plasma-sized results go to the arena
+  // (matching core_worker._package_one's 100KB inline cutoff) and ship as
+  // ["plasma", node_id]; everything else stays inline.
   Packer done;
   done.map_header(4);
   done.str("task_id"); done.str(tid->s);
   if (ok) {
+    const std::string oid = tid->s + "00000000";  // ObjectID.for_return(.., 0)
+    std::string wire = encode_x_object(result_payload, "x");
+    const char* thr_env = getenv("RAY_TPU_MAX_DIRECT_CALL_OBJECT_SIZE");
+    size_t threshold = thr_env ? (size_t)atoll(thr_env) : 100 * 1024;
+    bool plasma = false;
+    if (wire.size() > threshold && !g_node_id.empty()) {
+      std::string serr;
+      plasma = store_result_bytes(oid, wire, &serr);
+      if (!plasma)
+        fprintf(stderr, "cpp_worker: plasma result write failed (%s); "
+                "falling back to inline\n", serr.c_str());
+    }
     done.str("results");
     done.array_header(1);
     done.array_header(4);
-    done.str(tid->s + "00000000");  // ObjectID.for_return(task_id, 0)
-    done.str("inline");
-    done.bin(encode_x_object(result_payload, "x"));
+    done.str(oid);
+    if (plasma) {
+      done.str("plasma");
+      done.str(g_node_id);
+    } else {
+      done.str("inline");
+      done.bin(wire);
+    }
     done.array_header(0);  // no contained refs in plain msgpack data
     done.str("error"); done.nil();
   } else {
@@ -254,6 +450,14 @@ int main() {
     return 2;
   }
   g_cfg.worker_id = wid;
+  // Object data path: attach the node's shm arena + index (zero-copy local
+  // reads, plasma result writes). Absence degrades to owner-fetch + inline
+  // results, not failure.
+  if (const char* arena_name = getenv("RAY_TPU_ARENA_NAME")) {
+    g_arena = arena_attach(arena_name);
+    g_idx = idx_attach((std::string(arena_name) + "_idx").c_str());
+  }
+  if (const char* nid = getenv("RAY_TPU_NODE_ID")) g_node_id = nid;
   try {
     // Listen before registering: tasks may be pushed immediately after.
     int lfd = socket(AF_INET, SOCK_STREAM, 0);

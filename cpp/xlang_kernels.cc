@@ -110,6 +110,30 @@ int xlang_vector_scale(const uint8_t* in, size_t in_len, uint8_t** out, size_t* 
   }
 }
 
+// n float32s (value = index * 0.5) -> bin. A data PRODUCER for object-
+// pipeline tests: its multi-MiB result exercises the plasma result path.
+int xlang_make_floats(const uint8_t* in, size_t in_len, uint8_t** out, size_t* out_len) {
+  try {
+    Value args = parse_args(in, in_len);
+    if (args.arr.size() != 1 || args.arr[0].kind != Value::INT)
+      return fail("xlang_make_floats expects one int (count)", out, out_len);
+    int64_t n = args.arr[0].i;
+    if (n < 0 || n > (64LL << 20))
+      return fail("xlang_make_floats: count out of range", out, out_len);
+    std::string buf((size_t)n * 4, '\0');
+    for (int64_t i = 0; i < n; ++i) {
+      float f = (float)i * 0.5f;
+      std::memcpy(&buf[(size_t)i * 4], &f, 4);
+    }
+    Packer pk;
+    pk.bin(buf);
+    *out = dup(pk.out, out_len);
+    return 0;
+  } catch (const std::exception& e) {
+    return fail(e.what(), out, out_len);
+  }
+}
+
 // word counts of a string -> {word: count}
 int xlang_wordcount(const uint8_t* in, size_t in_len, uint8_t** out, size_t* out_len) {
   try {

@@ -100,6 +100,10 @@ class OwnedObject:
     pinned: int = 0  # pins from in-flight tasks that use this object as an arg
     in_plasma: bool = False
     location_hint: str | None = None
+    # Serialization format when known ("x" = cross-language msgpack): the
+    # native-routing gate for cpp tasks with ref args — only provably
+    # native-decodable objects may ship to the C++ worker runtime.
+    format: str | None = None
     # Refs nested inside this object's value (reference: nested-ref borrow
     # handoff, reference_count.h). The producer increfs each on our behalf;
     # we decref them when this object itself is freed.
@@ -425,16 +429,23 @@ class CoreWorker:
                 for a in args
             )
         wire_args, arg_refs = self._prepare_args(args, kwargs)
-        # Native routing only when every arg actually shipped inline ("v")
-        # and there is exactly one return: ObjectRef/plasma-spilled args and
-        # multi-return packaging need machinery the C++ worker runtime does
-        # not implement yet, so those stay on the Python ctypes path —
-        # identical results, different hosting runtime. Deciding AFTER
-        # _prepare_args makes the check exact (the spill threshold applies
-        # to the framed object, not the raw payload).
+        # Native routing when every arg is native-decodable: inline "v"
+        # entries always are (wrapped as format-"x" above); ObjectRef args
+        # qualify when this owner can PROVE the object is format "x" —
+        # the C++ worker fetches those itself (local shm zero-copy, or
+        # owner get_inline / raylet store_get over the wire). Pickle-format
+        # refs and multi-return stay on the Python ctypes path — identical
+        # results, different hosting runtime. Deciding AFTER _prepare_args
+        # makes the check exact (the spill threshold applies to the framed
+        # object, not the raw payload).
+        def _native_arg(w) -> bool:
+            if w[0] == "v":
+                return True
+            return self._known_xlang_object(w[1])
+
         language = (
             "cpp"
-            if is_cpp and num_returns == 1 and all(w[0] == "v" for w in wire_args)
+            if is_cpp and num_returns == 1 and all(_native_arg(w) for w in wire_args)
             else "py"
         )
         spec = TaskSpec(
@@ -892,6 +903,7 @@ class CoreWorker:
         with self._lock:
             entry = self.owned.setdefault(oid_hex, OwnedObject())
             entry.contained = contained
+            entry.format = ser.format
         if ser.total_size > self.cfg.max_direct_call_object_size:
             self.store.put_serialized(oid_hex, ser)
             with self._lock:
@@ -1698,6 +1710,10 @@ class CoreWorker:
                 else:  # plasma
                     obj.in_plasma = True
                     obj.location_hint = data
+                if pending.spec.language == "cpp":
+                    # Native results are format-"x" by construction — makes
+                    # them eligible as ref args of further native tasks.
+                    obj.format = "x"
             if error is not None:
                 for oid in pending.spec.return_object_ids():
                     self.in_process_store[oid] = {"data": error}
@@ -1881,6 +1897,18 @@ class CoreWorker:
             fn = cloudpickle.loads(resp["value"])
             self._function_cache[key] = fn
         return fn
+
+    def _known_xlang_object(self, oid_hex: str) -> bool:
+        """True iff this worker can PROVE the object is format-"x" (owned
+        with a recorded format, or in-process with a parseable header)."""
+        with self._lock:
+            obj = self.owned.get(oid_hex)
+            entry = self.in_process_store.get(oid_hex)
+        if obj is not None and obj.format == "x":
+            return True
+        if entry is not None:
+            return serialization.peek_format(entry["data"]) == "x"
+        return False
 
     def _resolve_args(self, wire_args: list):
         from ray_tpu.object_ref import ObjectRef

@@ -28,8 +28,19 @@ _BIN = os.path.join(
     "ray_tpu_cpp_worker",
 )
 
+_NATIVE = os.path.join(_REPO, "ray_tpu", "_native")
+_ALL_SRCS = [
+    _SRC,
+    os.path.join(_NATIVE, "shm_arena.cc"),
+    os.path.join(_NATIVE, "shm_index.cc"),
+]
+
 _lock = threading.Lock()
 _result: dict = {}
+
+
+def _srcs_mtime() -> float:
+    return max(os.path.getmtime(p) for p in _ALL_SRCS if os.path.exists(p))
 
 
 def cpp_worker_binary() -> str | None:
@@ -51,7 +62,7 @@ def cpp_worker_binary_nowait() -> str | None:
     if (
         os.path.exists(_BIN)
         and os.path.exists(_SRC)
-        and os.path.getmtime(_BIN) >= os.path.getmtime(_SRC)
+        and os.path.getmtime(_BIN) >= _srcs_mtime()
     ):
         return _BIN
     with _lock:
@@ -67,10 +78,10 @@ def _build() -> str | None:
     if not os.path.exists(_SRC):
         return None
     os.makedirs(os.path.dirname(_BIN), exist_ok=True)
-    if os.path.exists(_BIN) and os.path.getmtime(_BIN) >= os.path.getmtime(_SRC):
+    if os.path.exists(_BIN) and os.path.getmtime(_BIN) >= _srcs_mtime():
         return _BIN
     tmp = _BIN + f".tmp{os.getpid()}"
-    cmd = ["g++", "-O2", "-std=c++17", "-o", tmp, _SRC, "-ldl"]
+    cmd = ["g++", "-O2", "-std=c++17", "-o", tmp, _SRC] + _ALL_SRCS[1:] + ["-ldl"]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=180)
         os.replace(tmp, _BIN)

@@ -293,6 +293,18 @@ def deserialize(view) -> object:
     return pickle.loads(payload, buffers=buffers)
 
 
+def peek_format(data) -> str:
+    """The wire object's format tag without deserializing ("pickle" when
+    the header omits "f") — the cpp-native routing gate reads this."""
+    try:
+        view = memoryview(data).cast("B")
+        header_len = int.from_bytes(view[:4], "big")
+        header = msgpack.unpackb(view[4 : 4 + header_len], raw=False)
+        return header.get("f", "pickle")
+    except Exception:
+        return "unknown"
+
+
 def dumps(obj) -> bytes:
     """One-shot serialize to bytes (for RPC payload embedding)."""
     return serialize(obj).to_bytes()

@@ -74,4 +74,7 @@ def test_cpp_api_end_to_end(cluster, kernels_so, example):
     assert "BATCH_OK" in out
     assert "WORDCOUNT_OK" in out
     assert "ERROR_OK" in out and "xlang_sum" in out
+    # Native object pipeline: plasma-sized producer result consumed BY REF
+    # by the next task, plasma result streamed back to the driver.
+    assert "PIPELINE_OK" in out
     assert "CPP_API_PASS" in out
