@@ -230,6 +230,28 @@ class Driver {
       // assemble the serialized object; release drops the pin. (Workers
       // read the arena zero-copy; the driver stays shm-free and portable.)
       wire = FetchPlasma(entry.arr[0].s);
+      if (wire.size() <= kPlasmaCacheMax) {
+        // Repeated Gets should behave like the inline path: rewrite the
+        // cached entry in place. Bounded per entry — the kMaxDone FIFO
+        // caps count, this caps bytes; larger objects refetch.
+        std::lock_guard<std::mutex> lk(mu_);
+        auto it = done_.find(ref.task_id);
+        if (it != done_.end()) {
+          Value* results_mut = nullptr;
+          auto rit = it->second.map.find("results");
+          if (rit != it->second.map.end()) results_mut = &rit->second;
+          if (results_mut) {
+            for (Value& e : results_mut->arr) {
+              if (e.arr.size() >= 3 && e.arr[0].s == entry.arr[0].s) {
+                e.arr[1].s = "inline";
+                e.arr[2].kind = Value::BIN;
+                e.arr[2].s = wire;
+                break;
+              }
+            }
+          }
+        }
+      }
     } else {
       throw TaskFailed("unknown result location '" + entry.arr[1].s + "'");
     }
@@ -376,12 +398,22 @@ class Driver {
               }
             }
           }
+        } else {
+          // A FAILED producer must answer with its failure, not "missing" —
+          // a borrower polling for a result that will never exist would
+          // stall its full budget and then mislabel the error.
+          auto fit = failed_.find(task_id);
+          if (fit != failed_.end()) {
+            kind = "failed";
+            data = fit->second;  // reason rides in "message"
+          }
         }
       }
       resp.map_header(kind == "missing" ? 1 : 2);
       resp.str("kind"); resp.str(kind);
       if (kind == "inline") { resp.str("data"); resp.bin(data); }
       else if (kind == "plasma") { resp.str("location"); resp.str(location); }
+      else if (kind == "failed") { resp.str("message"); resp.str(data); }
       rtpu_wire::send_all(fd, rtpu_wire::frame(resp.out));
       return;
     }
@@ -479,6 +511,7 @@ class Driver {
   std::mutex mu_;
   std::condition_variable cv_;
   static const size_t kMaxDone = 4096;
+  static const size_t kPlasmaCacheMax = 16 * 1024 * 1024;
   std::map<std::string, Value> done_;
   std::map<std::string, std::string> failed_;
   std::deque<std::string> done_order_;

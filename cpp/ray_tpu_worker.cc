@@ -246,7 +246,7 @@ static bool fetch_object_bytes(const std::string& oid_hex,
     }
     if (kind && kind->s == "plasma") {
       // Somewhere in the cluster's plasma tier: store_get on OUR raylet
-      // blocks until it is sealed locally (pulling if remote).
+      // blocks until it is sealed locally (pulling if remote), and pins it.
       Packer q;
       q.map_header(2);
       q.str("object_id"); q.str(oid_hex);
@@ -254,17 +254,43 @@ static bool fetch_object_bytes(const std::string& oid_hex,
       Value got = g_raylet->call("store_get", q.out);
       const Value* off = got.get("offset");
       const Value* sz = got.get("size");
-      if (!off || !sz || g_arena < 0) {
-        *err = "store_get gave no offset/size (or no arena attached)";
-        return false;
+      if (!off || !sz) { *err = "store_get gave no offset/size"; return false; }
+      if (g_arena >= 0) {
+        const char* base = (const char*)arena_base(g_arena);
+        out->assign(base + (uint64_t)off->i, (size_t)sz->i);
+      } else {
+        // Arena attach failed at startup: degrade to wire chunk reads
+        // (exactly the driver's shm-free path), not task failure.
+        out->clear();
+        out->reserve((size_t)sz->i);
+        const int64_t kChunk = 4 * 1024 * 1024;
+        for (int64_t pos = 0; pos < sz->i;) {
+          Packer c;
+          c.map_header(3);
+          c.str("object_id"); c.str(oid_hex);
+          c.str("start"); c.integer(pos);
+          c.str("length"); c.integer(kChunk);
+          Value chunk = g_raylet->call("fetch_object_chunk", c.out);
+          const Value* data = chunk.get("data");
+          if (!data || data->s.empty()) {
+            *err = "fetch_object_chunk starved at " + std::to_string(pos);
+            return false;
+          }
+          *out += data->s;
+          pos += (int64_t)data->s.size();
+        }
       }
-      const char* base = (const char*)arena_base(g_arena);
-      out->assign(base + (uint64_t)off->i, (size_t)sz->i);
       Packer r;
       r.map_header(1);
       r.str("object_id"); r.str(oid_hex);
       try { g_raylet->call("store_release", r.out); } catch (...) {}
       return true;
+    }
+    if (kind && kind->s == "failed") {
+      const Value* msg = resp.get("message");
+      *err = "producer of " + oid_hex.substr(0, 12) + " failed: " +
+             (msg ? msg->s : "task failed");
+      return false;
     }
     *err = "object " + oid_hex.substr(0, 12) + " unavailable (owner says " +
            (kind ? kind->s : "?") + ")";

@@ -123,7 +123,12 @@ class LeaseManager:
         )
 
     async def _drain_submits(self):
-        await asyncio.sleep(0)  # let the submitting thread's burst accumulate
+        if len(self._submit_buf) <= 1:
+            # A lone submit gains nothing from the coalescing pass; the
+            # extra loop hop is pure latency on the sync ping-pong path.
+            pass
+        else:
+            await asyncio.sleep(0)  # let the submitting thread's burst accumulate
         with self._submit_lock:
             batch, self._submit_buf = self._submit_buf, []
             self._submit_scheduled = False

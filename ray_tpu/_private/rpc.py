@@ -98,13 +98,50 @@ def _pack(msg) -> bytes:
     return len(body).to_bytes(4, "big") + body
 
 
-async def _read_frame(reader: asyncio.StreamReader):
-    header = await reader.readexactly(4)
-    length = int.from_bytes(header, "big")
-    if length > _MAX_FRAME:
-        raise RpcError(f"frame too large: {length}")
-    body = await reader.readexactly(length)
-    return msgpack.unpackb(body, raw=False)
+_READ_CHUNK = 256 * 1024
+# Responses are written without awaiting drain() unless the socket buffer
+# has actually backed up: drain is a scheduling point per frame, and the
+# transport already buffers — only genuine backpressure should suspend.
+_WRITE_HIGH_WATER = 1 << 20
+
+
+async def _frame_stream(reader: asyncio.StreamReader):
+    """Yield decoded frames, draining every COMPLETE frame per socket read.
+
+    The hot dispatch path: readexactly(4)+readexactly(n) costs two loop
+    wakeups per frame; one buffered read() serves however many frames
+    arrived, which is what makes pipelined task/result streams cheap."""
+    buf = bytearray()
+    pos = 0
+    while True:
+        avail = len(buf) - pos
+        if avail >= 4:
+            length = int.from_bytes(buf[pos : pos + 4], "big")
+            if length > _MAX_FRAME:
+                raise RpcError(f"frame too large: {length}")
+            if avail >= 4 + length:
+                start = pos + 4
+                frame = msgpack.unpackb(bytes(buf[start : start + length]), raw=False)
+                pos = start + length
+                yield frame
+                continue
+        if pos:
+            del buf[:pos]  # compact consumed bytes before growing
+            pos = 0
+        chunk = await reader.read(_READ_CHUNK)
+        if not chunk:
+            raise asyncio.IncompleteReadError(bytes(buf), None)
+        buf += chunk
+
+
+def _drain_if_needed(writer: asyncio.StreamWriter):
+    """Awaitable-or-None: drain only under real backpressure."""
+    try:
+        if writer.transport.get_write_buffer_size() > _WRITE_HIGH_WATER:
+            return writer.drain()
+    except Exception:
+        pass
+    return None
 
 
 class EventLoopThread:
@@ -187,17 +224,16 @@ class RpcServer:
         _set_nodelay(writer)
         self._conns.add(writer)
         try:
-            while True:
-                try:
-                    mtype, seq, method, payload = await _read_frame(reader)
-                except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError, OSError):
-                    return
+            async for frame in _frame_stream(reader):
+                mtype, seq, method, payload = frame
                 if mtype == REQUEST:
                     asyncio.ensure_future(
                         self._dispatch(writer, seq, method, payload)
                     )
                 elif mtype == PUSH:
                     asyncio.ensure_future(self._dispatch(None, seq, method, payload))
+        except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError, OSError):
+            return
         finally:
             self._conns.discard(writer)
             try:
@@ -219,7 +255,9 @@ class RpcServer:
             result = await handler(payload)
             if writer is not None:
                 writer.write(_pack([RESPONSE, seq, method, result]))
-                await writer.drain()
+                pending = _drain_if_needed(writer)
+                if pending is not None:
+                    await pending
         except (ConnectionResetError, BrokenPipeError):
             pass  # peer went away mid-response (routine at shutdown)
         except Exception as e:
@@ -315,8 +353,8 @@ class RpcClient:
 
     async def _read_loop(self, reader):
         try:
-            while True:
-                mtype, seq, method, payload = await _read_frame(reader)
+            async for frame in _frame_stream(reader):
+                mtype, seq, method, payload = frame
                 if mtype in (RESPONSE, ERROR):
                     fut = self._pending.pop(seq, None)
                     if fut is not None and not fut.done():
@@ -357,7 +395,9 @@ class RpcClient:
             fut = asyncio.get_event_loop().create_future()
             self._pending[seq] = fut
             self._writer.write(_pack([REQUEST, seq, method, payload or {}]))
-            await self._writer.drain()
+            pending = _drain_if_needed(self._writer)
+            if pending is not None:
+                await pending
         return fut
 
     async def acall(
@@ -394,7 +434,9 @@ class RpcClient:
             await self._ensure_connected()
             self._seq += 1
             self._writer.write(_pack([PUSH, self._seq, method, payload or {}]))
-            await self._writer.drain()
+            pending = _drain_if_needed(self._writer)
+            if pending is not None:
+                await pending
 
     # ---- blocking API (from user threads) ----
 

@@ -25,6 +25,11 @@ from concurrent.futures import ThreadPoolExecutor
 logger = logging.getLogger(__name__)
 
 
+def _set_result_if_pending(fut, payload):
+    if not fut.done():
+        fut.set_result(payload)
+
+
 class _MainThreadExecutor:
     """Executor-protocol shim that runs submitted callables on the worker's
     MAIN thread (worker_main.main() drains the queue in run_forever).
@@ -52,12 +57,33 @@ class _MainThreadExecutor:
         self._q.put((fut, fn, args, kwargs))
         return fut
 
+    def submit_callback(self, fn, args, callback):
+        """Zero-Future fast path: run fn(*args) on the exec thread, deliver
+        the result to callback(result) ON THAT THREAD (callers hop back to
+        their loop themselves). Saves the cf.Future + wrap_future + done-
+        callback machinery per task — measurable on the lease hot loop."""
+        self._q.put((None, fn, args, callback))
+
     def run_forever(self):
         while not self._stopped:
             item = self._q.get()
             if item is None:
                 break
             fut, fn, args, kwargs = item
+            if fut is None:  # submit_callback fast path
+                callback = kwargs
+                try:
+                    result = fn(*args)
+                except BaseException:  # noqa: BLE001 — fn is _safe_execute-
+                    # class (never raises); a raise here is a framework bug,
+                    # but the callback must still fire or a task is lost.
+                    logger.exception("submit_callback fn raised")
+                    result = None
+                try:
+                    callback(result)
+                except BaseException:  # noqa: BLE001
+                    logger.exception("submit_callback delivery failed")
+                continue
             if not fut.set_running_or_notify_cancel():
                 continue
             try:
@@ -77,8 +103,6 @@ class WorkerExecutor:
         self.cw = core_worker
         self.raylet = raylet_client
         self._loop = core_worker._io.loop
-        self._actor_queue: asyncio.Queue | None = None
-        self._consumer_task = None
         self._concurrency_pool: ThreadPoolExecutor | None = None
         server = core_worker.server
         server.register("push_task", self.rpc_push_task)
@@ -89,13 +113,16 @@ class WorkerExecutor:
         server.register("cancel_exec", self.rpc_cancel_exec)
         # Leased-task pipeline (reference: direct task transport worker side,
         # core_worker.cc task receiver): owners ship batches of specs; we
-        # execute FIFO and push completion payloads back, coalescing results
-        # that finish while a previous report RPC is still in flight.
-        self._lease_buf: list = []
-        self._lease_event: asyncio.Event | None = None
-        self._lease_task = None
+        # execute FIFO (the main-thread exec queue) and push completion
+        # payloads back, coalescing results that finish while a previous
+        # report RPC is still in flight.
         self._done_buf: list = []
         self._done_flushing = False
+        # Queued-but-unstarted specs (task_id -> ("lease", owner_addr, spec)
+        # or ("actor", fut, spec)): lets pre-dispatch cancellation resolve
+        # the caller IMMEDIATELY instead of waiting behind the running task.
+        # Entries pop at execution start (exec thread; GIL-atomic dict ops).
+        self._fast_queued: dict = {}
 
     def _safe_execute(self, spec):
         """execute_task catches everything inside its own try; anything that
@@ -163,9 +190,8 @@ class WorkerExecutor:
                 self._concurrency_pool = ThreadPoolExecutor(
                     max_workers=spec.max_concurrency, thread_name_prefix="actor-cg"
                 )
-            else:
-                self._actor_queue = asyncio.Queue()
-                self._consumer_task = asyncio.ensure_future(self._actor_consumer())
+            # max_concurrency == 1 needs no queue of its own: ordered calls
+            # ride the main-thread exec queue (rpc_actor_call fast path).
             resp = await self.cw.gcs.acall(
                 "actor_alive",
                 {
@@ -199,29 +225,75 @@ class WorkerExecutor:
     async def rpc_lease_exec(self, req):
         from ray_tpu._private.task_spec import TaskSpec
 
-        if self._lease_event is None:
-            self._lease_event = asyncio.Event()
-        for wire in req["specs"]:
-            self._lease_buf.append(TaskSpec.from_wire(wire))
-        self._lease_event.set()
-        if self._lease_task is None or self._lease_task.done():
-            self._lease_task = asyncio.ensure_future(self._lease_consumer())
+        specs = [TaskSpec.from_wire(wire) for wire in req["specs"]]
+        ex = self.cw._executor
+        if hasattr(ex, "submit_callback"):
+            # Hot loop: specs go straight onto the main-thread exec queue
+            # (FIFO preserved — one queue, one thread) and completions hop
+            # back with a single call_soon_threadsafe each. No consumer
+            # coroutine, no cf.Future per task.
+            import functools
+
+            for spec in specs:
+                self._fast_queued[spec.task_id] = ("lease", tuple(spec.owner_addr), spec)
+                ex.submit_callback(
+                    self._fast_execute,
+                    (spec,),
+                    functools.partial(
+                        self._lease_result_from_thread, tuple(spec.owner_addr), spec
+                    ),
+                )
+        else:
+            # Fallback executors (no submit_callback) are single-worker
+            # ThreadPoolExecutors — submission order IS execution order.
+            loop = asyncio.get_event_loop()
+            for spec in specs:
+                asyncio.ensure_future(self._lease_exec_fallback(loop, spec))
         # Ack = accepted-into-queue, not executed: the owner's flow control
         # is per-task (tasks_done), so the ack must not wait on execution.
-        return {"accepted": len(req["specs"])}
+        return {"accepted": len(specs)}
 
-    async def _lease_consumer(self):
-        loop = asyncio.get_event_loop()
-        while True:
-            while not self._lease_buf:
-                self._lease_event.clear()
-                await self._lease_event.wait()
-            spec = self._lease_buf.pop(0)
-            payload = await loop.run_in_executor(self.cw._executor, self._safe_execute, spec)
-            self._done_buf.append((tuple(spec.owner_addr), payload))
-            if not self._done_flushing:
-                self._done_flushing = True
-                asyncio.ensure_future(self._flush_done())
+    async def _lease_exec_fallback(self, loop, spec):
+        payload = await loop.run_in_executor(self.cw._executor, self._safe_execute, spec)
+        self._lease_done(tuple(spec.owner_addr), payload)
+
+    def _fast_execute(self, spec):
+        """Exec-thread entry: unregister from the queued set, then run.
+        A cancel that raced us already delivered a cancelled payload and
+        tombstoned the id — execute_task's entry check drops the body and
+        the duplicate completion is ignored by the owner (pending popped)."""
+        self._fast_queued.pop(spec.task_id, None)
+        return self._safe_execute(spec)
+
+    def _bug_payload(self, spec):
+        """A completion for a spec whose execution path itself broke:
+        dropping it instead would hang the owner forever (its lease probe
+        pings THIS worker, which is alive)."""
+        from ray_tpu._private import serialization
+        from ray_tpu.exceptions import TaskError
+
+        err = TaskError.from_exception(
+            RuntimeError("worker framework error during task execution"),
+            task_name=spec.name,
+        )
+        return {
+            "task_id": spec.task_id,
+            "results": [],
+            "error": serialization.serialize(err).to_bytes(),
+            "duration_s": 0.0,
+        }
+
+    def _lease_result_from_thread(self, owner_addr, spec, payload):
+        """Runs on the exec thread; marshal the completion to the loop."""
+        if payload is None:  # submit_callback swallowed a framework bug
+            payload = self._bug_payload(spec)
+        self._loop.call_soon_threadsafe(self._lease_done, owner_addr, payload)
+
+    def _lease_done(self, owner_addr, payload):
+        self._done_buf.append((owner_addr, payload))
+        if not self._done_flushing:
+            self._done_flushing = True
+            asyncio.ensure_future(self._flush_done())
 
     async def _flush_done(self):
         """Deliver completion payloads, re-queuing on failure: dropping a
@@ -279,65 +351,58 @@ class WorkerExecutor:
             return await loop.run_in_executor(
                 self._concurrency_pool, self._safe_execute, spec
             )
-        if self._actor_queue is None:
-            # Call raced actor initialisation; serialize behind creation.
-            return await loop.run_in_executor(self.cw._executor, self._safe_execute, spec)
-        fut = loop.create_future()
-        self._actor_queue.put_nowait((spec, fut))  # pre-await: preserves order
-        return await fut
+        ex = self.cw._executor
+        if hasattr(ex, "submit_callback"):
+            # Hot loop: straight onto the main-thread exec queue (FIFO =
+            # actor order; creation rides the same queue, so calls racing
+            # init serialize behind it automatically). One threadsafe hop
+            # back, no cf.Future. Pre-dispatch cancellation resolves the
+            # future immediately via _fast_queued (see rpc_cancel_exec).
+            fut = loop.create_future()
+            self._fast_queued[spec.task_id] = ("actor", fut, spec)
 
-    async def _actor_consumer(self):
-        """Ordered execution queue (reference: actor_scheduling_queue.h:40)."""
-        loop = asyncio.get_event_loop()
-        while True:
-            spec, fut = await self._actor_queue.get()
-            try:
-                payload = await loop.run_in_executor(
-                    self.cw._executor, self._safe_execute, spec
-                )
-                if not fut.done():
-                    fut.set_result(payload)
-            except Exception as e:
-                if not fut.done():
-                    fut.set_exception(e)
+            def deliver(payload, _fut=fut, _loop=loop, _spec=spec):
+                if payload is None:  # framework bug: never leave fut hanging
+                    payload = self._bug_payload(_spec)
+                _loop.call_soon_threadsafe(_set_result_if_pending, _fut, payload)
+
+            ex.submit_callback(self._fast_execute, (spec,), deliver)
+            return await fut
+        # Fallback executors are single-worker ThreadPoolExecutors:
+        # submission order is execution order.
+        return await loop.run_in_executor(self.cw._executor, self._safe_execute, spec)
 
     # ---- cancellation (reference: core_worker.cc HandleCancelTask) ----
 
     async def rpc_cancel_exec(self, req):
-        """Recall a task delivered to this worker: dequeue if still queued
-        (lease buffer / actor queue), interrupt if running, tombstone if it
-        has not arrived yet; recursively cancel children this worker owns."""
+        """Recall a task delivered to this worker: resolve immediately if
+        still queued (exec-queue registry), interrupt if running, tombstone
+        if it has not arrived yet; recursively cancel children this worker
+        owns."""
         task_id = req["task_id"]
         force = bool(req.get("force"))
         recursive = req.get("recursive", True)
         handled = False
-        # Queued leased task, not yet started.
-        for i, s in enumerate(self._lease_buf):
-            if s.task_id == task_id:
-                spec = self._lease_buf.pop(i)
-                self._done_buf.append((tuple(spec.owner_addr), self.cw.cancelled_payload(spec)))
-                if not self._done_flushing:
-                    self._done_flushing = True
-                    asyncio.ensure_future(self._flush_done())
-                handled = True
-                break
-        # Queued actor call, not yet dispatched (reference: pre-dispatch
-        # actor-task cancellation).
-        if not handled and self._actor_queue is not None:
-            kept, target = [], None
-            while not self._actor_queue.empty():
-                item = self._actor_queue.get_nowait()
-                if item[0].task_id == task_id:
-                    target = item
-                else:
-                    kept.append(item)
-            for item in kept:
-                self._actor_queue.put_nowait(item)
-            if target is not None:
-                spec, fut = target
-                if not fut.done():
-                    fut.set_result(self.cw.cancelled_payload(spec))
-                handled = True
+        # Queued-but-unstarted (any kind): tombstone FIRST so a racing
+        # dequeue drops the body at execute_task entry, then answer the
+        # caller NOW — a cancelled call must not wait behind the currently
+        # running task. The spec still flows through the exec queue; its
+        # duplicate cancelled completion is ignored by the owner (pending
+        # already popped) / the already-resolved future.
+        entry = None
+        if task_id in self._fast_queued:
+            # Tombstone BEFORE popping: if the exec thread dequeues the spec
+            # in this window, the entry check still drops the body.
+            self.cw.mark_cancelled(task_id)
+            entry = self._fast_queued.pop(task_id, None)
+        if entry is not None:
+            if entry[0] == "lease":
+                _, owner_addr, spec = entry
+                self._lease_done(owner_addr, self.cw.cancelled_payload(spec))
+            else:  # actor
+                _, fut, spec = entry
+                _set_result_if_pending(fut, self.cw.cancelled_payload(spec))
+            handled = True
         # Running right now.
         if not handled:
             handled = self.cw.interrupt_running_task(task_id, force=force)
