@@ -185,7 +185,6 @@ class DashboardHead:
         if path.startswith("/api/workflows/events/"):
             # HTTP event provider (reference workflow/http_event_provider.py):
             # read back a delivered event.
-            from ray_tpu._private.rpc import RpcClient
             from ray_tpu.workflow.event_listener import EVENT_KV_PREFIX
 
             key = path[len("/api/workflows/events/") :]
@@ -243,7 +242,6 @@ class DashboardHead:
             # HTTP event provider: deliver an event payload to workflows
             # polling KVEventListener(key) (reference http_event_provider.py
             # POST /event/send_event/{workflow_id}).
-            from ray_tpu._private.rpc import RpcClient
             from ray_tpu.workflow.event_listener import EVENT_KV_PREFIX
 
             key = path[len("/api/workflows/events/") :]
