@@ -74,6 +74,25 @@ int main(int argc, char** argv) {
     }
     printf("PIPELINE_OK %zu bytes\n", v5.s.size());
 
+    // 6. A ref arg whose PRODUCER FAILED: the consumer must fail fast with
+    //    the producer's reason (the driver's owner server answers
+    //    get_inline with kind="failed"), not stall out a polling budget.
+    rtpu::ObjectRef bad = driver.Task("xlang_sum", lib).Remote(rtpu::V("boom"));
+    try { driver.Get(bad); } catch (const rtpu::TaskFailed&) {}
+    rtpu::ObjectRef chained =
+        driver.Task("xlang_vector_scale", lib).Remote(bad, rtpu::V(2.0));
+    try {
+      driver.Get(chained, 30000);
+      fprintf(stderr, "chained-on-failed did not throw\n");
+      return 1;
+    } catch (const rtpu::TaskFailed& e) {
+      if (std::string(e.what()).find("failed") == std::string::npos) {
+        fprintf(stderr, "chained-on-failed: unhelpful error: %s\n", e.what());
+        return 1;
+      }
+      printf("FAILED_REF_OK %s\n", e.what());
+    }
+
     printf("CPP_API_PASS\n");
     return 0;
   } catch (const std::exception& e) {

@@ -398,6 +398,22 @@ class Driver {
               }
             }
           }
+          if (kind == "missing") {
+            // The task completed WITH AN ERROR (results empty, "error"
+            // payload): the borrower must see the failure, not poll.
+            const Value* errv = it->second.get("error");
+            if (errv && errv->kind == Value::BIN) {
+              kind = "failed";
+              Value einfo;
+              std::string derr;
+              if (rtpu_wire::decode_x_object(errv->s, "xe", &einfo, &derr)) {
+                const Value* m = einfo.get("message");
+                data = m ? m->s : "task failed";
+              } else {
+                data = "task failed";
+              }
+            }
+          }
         } else {
           // A FAILED producer must answer with its failure, not "missing" —
           // a borrower polling for a result that will never exist would

@@ -77,4 +77,6 @@ def test_cpp_api_end_to_end(cluster, kernels_so, example):
     # Native object pipeline: plasma-sized producer result consumed BY REF
     # by the next task, plasma result streamed back to the driver.
     assert "PIPELINE_OK" in out
+    # A ref arg with a FAILED producer surfaces the producer's failure fast.
+    assert "FAILED_REF_OK" in out
     assert "CPP_API_PASS" in out
