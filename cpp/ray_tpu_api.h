@@ -51,6 +51,12 @@ struct TaskFailed : std::runtime_error {
 struct GetTimeout : std::runtime_error {
   explicit GetTimeout(const std::string& m) : std::runtime_error(m) {}
 };
+// A repeated Get of a result the owner cache already evicted (count or byte
+// bound). Distinct from GetTimeout: the result is definitively gone — the
+// caller learns instantly instead of burning its full timeout budget.
+struct ResultEvicted : std::runtime_error {
+  explicit ResultEvicted(const std::string& m) : std::runtime_error(m) {}
+};
 
 // -- Value construction sugar ------------------------------------------------
 
@@ -182,9 +188,19 @@ class Driver {
   Value Get(const ObjectRef& ref, int timeout_ms = 60000) {
     std::unique_lock<std::mutex> lk(mu_);
     if (!cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms), [&] {
-          return done_.count(ref.task_id) > 0 || failed_.count(ref.task_id) > 0;
-        }))
+          return done_.count(ref.task_id) > 0 || failed_.count(ref.task_id) > 0 ||
+                 evicted_.count(ref.task_id) > 0;
+        })) {
+      // Distinguish "never arrived" from "arrived and was evicted" even when
+      // eviction happened while we waited.
+      if (evicted_.count(ref.task_id) > 0)
+        throw ResultEvicted("result for task " + ref.task_id.substr(0, 8) +
+                            " evicted from owner cache");
       throw GetTimeout("no result for task " + ref.task_id.substr(0, 8));
+    }
+    if (done_.count(ref.task_id) == 0 && failed_.count(ref.task_id) == 0)
+      throw ResultEvicted("result for task " + ref.task_id.substr(0, 8) +
+                          " evicted from owner cache");
     // Mark consumed (either outcome): consumed entries are preferred for
     // eviction once the cache bound is hit.
     if (consumed_.insert(ref.task_id).second)
@@ -198,10 +214,11 @@ class Driver {
       throw TaskFailed(why);  // raylet-reported worker death (task_failed)
     }
     // Results stay cached so Get is repeatable (ray.get semantics) — up to
-    // the kMaxDone bound: with >4096 results cached, already-consumed
-    // entries are evicted first (then oldest unconsumed), so a repeated Get
-    // of a long-ago-consumed ref past that point times out. Abandoned refs
-    // cannot grow the owner without bound either way.
+    // the kMaxDone entry bound AND the kMaxDoneBytes aggregate byte budget:
+    // past either, already-consumed entries are evicted first (then oldest
+    // unconsumed) and a repeated Get of an evicted ref throws ResultEvicted
+    // immediately (the id is remembered). Abandoned refs cannot grow the
+    // owner without bound either way.
     Value payload = done_[ref.task_id];
     lk.unlock();
 
@@ -246,6 +263,12 @@ class Driver {
                 e.arr[1].s = "inline";
                 e.arr[2].kind = Value::BIN;
                 e.arr[2].s = wire;
+                // The rewrite grew the cached entry: re-charge it against
+                // the byte budget (may evict OTHER entries; this one was
+                // just touched and `wire` is already copied out).
+                done_bytes_ += wire.size();
+                cached_bytes_[ref.task_id] += wire.size();
+                enforce_bound_locked();
                 break;
               }
             }
@@ -422,6 +445,11 @@ class Driver {
           if (fit != failed_.end()) {
             kind = "failed";
             data = fit->second;  // reason rides in "message"
+          } else if (evicted_.count(task_id) > 0) {
+            // An evicted result will never reappear: tell the borrower now
+            // instead of letting it poll out its full budget.
+            kind = "failed";
+            data = "result evicted from owner cache";
           }
         }
       }
@@ -444,6 +472,14 @@ class Driver {
         std::lock_guard<std::mutex> lk(mu_);
         if (done_.emplace(tid->s, payload).second) {
           done_order_.push_back(tid->s);
+          const size_t sz = payload_bytes(payload);
+          // += not =: a failed-then-done sequence (worker crash raced the
+          // delivery) has both maps populated for this id; one eviction
+          // erases both, so the charge must cover both or done_bytes_
+          // drifts upward permanently.
+          cached_bytes_[tid->s] += sz;
+          done_bytes_ += sz;
+          evicted_.erase(tid->s);  // a re-delivered result is cached again
           enforce_bound_locked();
         }
       }
@@ -466,6 +502,9 @@ class Driver {
                                 (emsg ? ": " + emsg->s : std::string()))
                 .second) {
           done_order_.push_back(tid->s);
+          const size_t sz = failed_[tid->s].size();
+          cached_bytes_[tid->s] += sz;  // see task_done: one eviction, one charge
+          done_bytes_ += sz;
           enforce_bound_locked();
         }
       }
@@ -485,16 +524,52 @@ class Driver {
       const std::string id = consumed_order_.front();
       consumed_order_.pop_front();
       consumed_.erase(id);
-      if (done_.erase(id) + failed_.erase(id) > 0) return;
+      if (done_.erase(id) + failed_.erase(id) > 0) {
+        drop_accounting_locked(id);
+        return;
+      }
     }
     while (!done_order_.empty()) {
       const std::string id = done_order_.front();
       done_order_.pop_front();
-      if (done_.erase(id) + failed_.erase(id) > 0) return;
+      if (done_.erase(id) + failed_.erase(id) > 0) {
+        drop_accounting_locked(id);
+        return;
+      }
+    }
+  }
+
+  // Shared post-eviction bookkeeping: release the entry's bytes and remember
+  // the id so a later Get fails fast with ResultEvicted instead of waiting
+  // out its full timeout as GetTimeout.
+  void drop_accounting_locked(const std::string& id) {
+    auto bit = cached_bytes_.find(id);
+    if (bit != cached_bytes_.end()) {
+      done_bytes_ -= std::min(done_bytes_, bit->second);
+      cached_bytes_.erase(bit);
+    }
+    if (evicted_.insert(id).second) evicted_order_.push_back(id);
+    while (evicted_order_.size() > 2 * kMaxDone) {
+      evicted_.erase(evicted_order_.front());
+      evicted_order_.pop_front();
     }
   }
 
   size_t cached_locked() const { return done_.size() + failed_.size(); }
+
+  // Sum of a task_done payload's data bytes (inline result blobs, plasma
+  // location strings, error blobs) — what the byte budget charges.
+  static size_t payload_bytes(const Value& payload) {
+    size_t sz = 0;
+    auto rit = payload.map.find("results");
+    if (rit != payload.map.end()) {
+      for (const Value& e : rit->second.arr)
+        if (e.arr.size() >= 3) sz += e.arr[0].s.size() + e.arr[2].s.size();
+    }
+    auto eit = payload.map.find("error");
+    if (eit != payload.map.end()) sz += eit->second.s.size();
+    return sz;
+  }
 
   // Bound the cache AND the order deques. Lazy skipping leaves stale ids in
   // the deques (an id evicted via the other deque); in the every-result-
@@ -503,11 +578,15 @@ class Driver {
   // force-FIFO-evict (the pre-consumed-tracking behavior).
   void enforce_bound_locked() {
     while (cached_locked() > kMaxDone) evict_one_locked();
+    // Aggregate byte budget (ADVICE r5 #1): the per-entry 16 MiB rewrite cap
+    // and the 4096-entry bound still admit ~64 GiB resident in a pathological
+    // workload; cap total bytes too. Keep at least one entry so the result
+    // just delivered (or just rewritten) survives its own insertion.
+    while (done_bytes_ > kMaxDoneBytes && cached_locked() > 1) evict_one_locked();
     while (done_order_.size() > 2 * kMaxDone) {
       const std::string id = done_order_.front();
       done_order_.pop_front();
-      done_.erase(id);
-      failed_.erase(id);
+      if (done_.erase(id) + failed_.erase(id) > 0) drop_accounting_locked(id);
     }
     while (consumed_order_.size() > 2 * kMaxDone) {
       consumed_.erase(consumed_order_.front());
@@ -528,11 +607,16 @@ class Driver {
   std::condition_variable cv_;
   static const size_t kMaxDone = 4096;
   static const size_t kPlasmaCacheMax = 16 * 1024 * 1024;
+  static const size_t kMaxDoneBytes = 256 * 1024 * 1024;
   std::map<std::string, Value> done_;
   std::map<std::string, std::string> failed_;
   std::deque<std::string> done_order_;
   std::set<std::string> consumed_;
   std::deque<std::string> consumed_order_;
+  std::map<std::string, size_t> cached_bytes_;  // id -> charged bytes
+  size_t done_bytes_ = 0;
+  std::set<std::string> evicted_;  // ids dropped from the cache (fast-fail)
+  std::deque<std::string> evicted_order_;
   std::atomic<bool> stopping_{false};
 };
 

@@ -83,7 +83,11 @@ def _build() -> str | None:
     tmp = _BIN + f".tmp{os.getpid()}"
     cmd = ["g++", "-O2", "-std=c++17", "-o", tmp, _SRC] + _ALL_SRCS[1:] + ["-ldl"]
     try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=180)
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=180)
+        except subprocess.CalledProcessError:
+            # glibc < 2.34 keeps shm_open/shm_unlink in librt; retry with it.
+            subprocess.run(cmd + ["-lrt"], check=True, capture_output=True, timeout=180)
         os.replace(tmp, _BIN)
         return _BIN
     except Exception as e:

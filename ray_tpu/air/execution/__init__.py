@@ -1,0 +1,33 @@
+"""ray_tpu.air.execution — the shared fault-tolerant execution substrate
+beneath the libraries (reference: python/ray/air/execution/).
+
+One audited set of actor restart/leak semantics instead of one per library:
+Tune's trial loop and Train's BackendExecutor both route actor lifecycle and
+resource acquisition through :class:`ActorManager` +
+:class:`ResourceManager`. Serve's controller is a documented follow-up
+(PARITY.md).
+"""
+
+from ray_tpu.air.execution.actor_manager import (  # noqa: F401
+    ActorManager,
+    TrackedActor,
+    TrackedActorTask,
+)
+from ray_tpu.air.execution.resources import (  # noqa: F401
+    AcquiredResources,
+    FixedResourceManager,
+    PlacementGroupResourceManager,
+    ResourceManager,
+    ResourceRequest,
+)
+
+__all__ = [
+    "ActorManager",
+    "TrackedActor",
+    "TrackedActorTask",
+    "AcquiredResources",
+    "FixedResourceManager",
+    "PlacementGroupResourceManager",
+    "ResourceManager",
+    "ResourceRequest",
+]

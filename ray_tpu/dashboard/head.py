@@ -35,6 +35,12 @@ class DashboardHead:
         self._gcs_address = tuple(gcs_address)
         self._session_dir = session_dir
         self.job_manager = JobManager(gcs_address, session_dir)
+        # One cached GCS client shared by request handlers (guarded: the
+        # ThreadingHTTPServer serves concurrent requests). Building a fresh
+        # RpcClient per request costs a TCP connect on every poll of a hot
+        # endpoint and leaks sockets under load when handlers die mid-write.
+        self._gcs_client = None
+        self._gcs_client_lock = threading.Lock()
         head = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -88,6 +94,14 @@ class DashboardHead:
         self._thread.start()
 
     # ------------------------------------------------------------------
+    def _gcs(self) -> RpcClient:
+        """Cached GCS client (RpcClient is safe to call from any thread and
+        reconnects internally; only creation needs the guard)."""
+        with self._gcs_client_lock:
+            if self._gcs_client is None:
+                self._gcs_client = RpcClient(self._gcs_address, label="dashboard-gcs")
+            return self._gcs_client
+
     def _state(self):
         from ray_tpu._private.state import GlobalState
 
@@ -120,11 +134,7 @@ class DashboardHead:
         if path == "/metrics":
             from ray_tpu.util.metrics import prometheus_text
 
-            gcs = RpcClient(self._gcs_address, label="dashboard-metrics")
-            try:
-                req._send(200, prometheus_text(gcs), content_type="text/plain; version=0.0.4")
-            finally:
-                gcs.close()
+            req._send(200, prometheus_text(self._gcs()), content_type="text/plain; version=0.0.4")
             return
         if path == "/api/v0/tasks/summarize":
             from ray_tpu.util.state import summarize_tasks
@@ -188,15 +198,23 @@ class DashboardHead:
             from ray_tpu.workflow.event_listener import EVENT_KV_PREFIX
 
             key = path[len("/api/workflows/events/") :]
-            gcs = RpcClient(self._gcs_address, label="dashboard-events")
-            try:
-                resp = gcs.call("kv_get", {"key": EVENT_KV_PREFIX + key})
-            finally:
-                gcs.close()
+            resp = self._gcs().call("kv_get", {"key": EVENT_KV_PREFIX + key})
             if not resp.get("found"):
                 req._send(404, {"error": f"no event for key {key!r}"})
-            else:
-                req._send(200, {"key": key, "event": json.loads(bytes(resp["value"]).decode())})
+                return
+            # The KV value may have been written by a non-JSON producer
+            # (direct kv_put): surface a client error, not a 500. Strict
+            # decode — UnicodeDecodeError is a ValueError — so invalid UTF-8
+            # 422s instead of being mangled to U+FFFD and served as 200.
+            try:
+                event = json.loads(bytes(resp["value"]).decode("utf-8"))
+            except (ValueError, TypeError):
+                req._send(
+                    422,
+                    {"error": f"event value for key {key!r} is not valid JSON"},
+                )
+                return
+            req._send(200, {"key": key, "event": event})
             return
         if path == "/api/jobs":
             req._send(200, self.job_manager.list_jobs())
@@ -245,18 +263,14 @@ class DashboardHead:
             from ray_tpu.workflow.event_listener import EVENT_KV_PREFIX
 
             key = path[len("/api/workflows/events/") :]
-            gcs = RpcClient(self._gcs_address, label="dashboard-events")
-            try:
-                gcs.call(
-                    "kv_put",
-                    {
-                        "key": EVENT_KV_PREFIX + key,
-                        "value": json.dumps(body).encode(),
-                        "overwrite": True,
-                    },
-                )
-            finally:
-                gcs.close()
+            self._gcs().call(
+                "kv_put",
+                {
+                    "key": EVENT_KV_PREFIX + key,
+                    "value": json.dumps(body).encode(),
+                    "overwrite": True,
+                },
+            )
             req._send(200, {"delivered": key})
             return
         if path.startswith("/api/jobs/") and path.endswith("/stop"):
@@ -276,3 +290,10 @@ class DashboardHead:
             self._server.server_close()
         except Exception:
             pass
+        with self._gcs_client_lock:
+            client, self._gcs_client = self._gcs_client, None
+        if client is not None:
+            try:
+                client.close()
+            except Exception:
+                pass

@@ -17,11 +17,9 @@ from jax.sharding import PartitionSpec as P
 
 
 def _shard_map():
-    if hasattr(jax, "shard_map"):
-        return jax.shard_map
-    from jax.experimental.shard_map import shard_map
+    from ray_tpu.util.jax_compat import shard_map
 
-    return shard_map
+    return shard_map()
 
 
 def pipeline_apply(

@@ -36,11 +36,9 @@ from jax.sharding import PartitionSpec as P
 
 
 def _shard_map():
-    if hasattr(jax, "shard_map"):
-        return jax.shard_map
-    from jax.experimental.shard_map import shard_map
+    from ray_tpu.util.jax_compat import shard_map
 
-    return shard_map
+    return shard_map()
 
 
 _NEG_INF = -1e30  # finite stand-in for -inf: keeps exp/where math NaN-free

@@ -23,11 +23,9 @@ from ray_tpu.ops.attention import flash_attention
 
 
 def _shard_map():
-    if hasattr(jax, "shard_map"):
-        return jax.shard_map
-    from jax.experimental.shard_map import shard_map
+    from ray_tpu.util.jax_compat import shard_map
 
-    return shard_map
+    return shard_map()
 
 
 def ulysses_attention(

@@ -157,8 +157,13 @@ class RolloutWorker:
             _, rewards, dones, _ = self.env.step(env_actions)
             # Episode boundaries reach temporal connectors (frame stacks
             # re-seed finished slots before the next episode's first obs).
+            # Under the filter lock: on_episode_done mutates connector state
+            # (temporal buffers), and in async mode set_connector_state /
+            # set_filter_state swap that state from the actor main thread
+            # mid-sample — ALL pipeline mutation serializes on one lock.
             if np.any(dones):
-                self.agent_connectors.on_episode_done(dones)
+                with self._filter_lock:
+                    self.agent_connectors.on_episode_done(dones)
             # The TRAINING batch keeps the raw sampled action: logp was
             # computed for it, and training on the clipped action would
             # bias the policy gradient at the clip boundary (reference

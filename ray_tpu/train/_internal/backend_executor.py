@@ -2,13 +2,22 @@
 
 Analog of the reference's BackendExecutor
 (python/ray/train/_internal/backend_executor.py: start:104,
-start_training:342) + the backend plugin protocol (train/torch/config.py:155):
-creates the WorkerGroup (under a placement group for TPU gangs), runs the
-backend's ``on_start`` (mesh/collective bootstrap — the reference's
-``dist.init_process_group`` moment, SURVEY.md §3.4 step 5), starts the user
-loop everywhere, polls reports, and restarts the whole gang from the last
-checkpoint on worker failure (an XLA collective world is static — membership
-change means rebuild, SURVEY.md §7 hard part 1).
+start_training:342) + the backend plugin protocol (train/torch/config.py:155).
+Worker-gang LIFECYCLE goes through the shared AIR execution layer
+(`ray_tpu.air.execution.ActorManager`): the gang's resources are one
+multi-bundle ``ResourceRequest`` (a placement group for TPU gangs — one ICI
+domain under STRICT_PACK), each ``TrainWorker`` is a tracked actor pinned to
+its bundle, and gang start / gang restart / shutdown are manager operations.
+That makes release guaranteed: a gang restart frees the old placement group
+before reserving the new one (the pre-manager code leaked one PG per
+restart), and ``shutdown()`` leaves nothing in ``GlobalState``.
+
+The run loop itself is unchanged: run the backend's ``on_start``
+(mesh/collective bootstrap — the reference's ``dist.init_process_group``
+moment, SURVEY.md §3.4 step 5), start the user loop everywhere, poll
+reports, and restart the whole gang from the last checkpoint on worker
+failure (an XLA collective world is static — membership change means
+rebuild, SURVEY.md §7 hard part 1).
 """
 
 from __future__ import annotations
@@ -19,7 +28,13 @@ import time
 import ray_tpu
 from ray_tpu.air.checkpoint import Checkpoint
 from ray_tpu.air.config import ScalingConfig
-from ray_tpu.train._internal.worker_group import WorkerGroup
+from ray_tpu.air.execution import (
+    ActorManager,
+    FixedResourceManager,
+    PlacementGroupResourceManager,
+    ResourceRequest,
+)
+from ray_tpu.train._internal.worker_group import TrainWorker, WorkerGroup
 
 logger = logging.getLogger(__name__)
 
@@ -70,23 +85,59 @@ class BackendExecutor:
         self.scaling_config = scaling_config
         self.max_failures = max_failures
         self.worker_group: WorkerGroup | None = None
-        self._pg = None
+        # TPU gangs need atomic co-reservation (one ICI domain); CPU gangs
+        # get budget bookkeeping with raylet enforcement.
+        resource_manager = (
+            PlacementGroupResourceManager()
+            if scaling_config.use_tpu
+            else FixedResourceManager()
+        )
+        self._actor_manager = ActorManager(resource_manager)
+        self._tracked: list = []
+        self.num_gang_restarts = 0
 
     def start(self):
         sc = self.scaling_config
-        if sc.use_tpu:
-            from ray_tpu.util.placement_group import placement_group
-
-            self._pg = placement_group(
-                sc.as_placement_group_bundles(), strategy=sc.placement_strategy
+        n = sc.num_workers
+        # One request for the whole gang: N bundles, acquired and released
+        # as a unit (refcounted by the manager across the N tracked actors).
+        request = ResourceRequest(
+            sc.as_placement_group_bundles(), strategy=sc.placement_strategy
+        )
+        self._tracked = [
+            self._actor_manager.add_actor(
+                TrainWorker,
+                kwargs=dict(rank=rank, world_size=n),
+                resource_request=request,
+                bundle_index=rank,
+                # Whole-gang restart is executor policy (static XLA world):
+                # a lone member restarting in place would rejoin a dead
+                # collective, so per-actor auto-restart stays off.
+                max_restarts=0,
+                graceful_stop_method="shutdown",
             )
-            self._pg.ready(timeout=300)
-        self.worker_group = WorkerGroup(
-            sc.num_workers,
-            resources_per_worker=sc.worker_resources(),
-            placement_group=self._pg,
+            for rank in range(n)
+        ]
+        try:
+            self._actor_manager.wait_for_actors(self._tracked, timeout=300)
+        except (TimeoutError, RuntimeError):
+            # Guaranteed release on failed start: no PG/bundle survives a
+            # gang that never came up.
+            self._remove_gang()
+            raise
+        self.worker_group = WorkerGroup.from_handles(
+            [t.actor_handle for t in self._tracked]
         )
         self.backend.on_start(self.worker_group, sc)
+
+    def _remove_gang(self):
+        """Tear the gang down through the manager: cancels in-flight tasks,
+        kills the workers, and frees the gang's resource acquisition (the
+        placement group) once the last member is removed."""
+        for tracked in self._tracked:
+            self._actor_manager.remove_actor(tracked)
+        self._tracked = []
+        self.worker_group = None
 
     def run(
         self,
@@ -116,7 +167,10 @@ class BackendExecutor:
                     e,
                     "checkpoint" if latest_checkpoint else "scratch",
                 )
-                self.worker_group.shutdown()
+                # Gang restart as manager operations: remove (frees the old
+                # placement group) then start (reserves a fresh one).
+                self._remove_gang()
+                self.num_gang_restarts += 1
                 self.start()
 
     def _run_once(self, train_fn, config, shards_per_rank, on_report, checkpoint):
@@ -159,15 +213,10 @@ class BackendExecutor:
         return final_reports
 
     def shutdown(self):
-        if self.worker_group is not None:
-            self.worker_group.shutdown()
-        if self._pg is not None:
-            from ray_tpu.util.placement_group import remove_placement_group
-
-            try:
-                remove_placement_group(self._pg)
-            except Exception:
-                pass
+        self._remove_gang()
+        # Belt-and-braces: clear() force-releases anything still acquired,
+        # so the executor cannot leak a placement group on any exit path.
+        self._actor_manager.clear()
 
 
 class TrainingFailedError(RuntimeError):

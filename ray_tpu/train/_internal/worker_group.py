@@ -131,6 +131,16 @@ class WorkerGroup:
                 )
             self.workers.append(actor_cls.options(**opts).remote(rank, num_workers, env))
 
+    @classmethod
+    def from_handles(cls, workers: list) -> "WorkerGroup":
+        """Wrap pre-created TrainWorker handles (the BackendExecutor creates
+        the gang through the AIR execution layer's ActorManager; this class
+        stays the fan-out/execute surface the Backend plugins see)."""
+        group = cls.__new__(cls)
+        group.workers = list(workers)
+        group.num_workers = len(group.workers)
+        return group
+
     def execute(self, fn, *args, timeout: float | None = 300, **kwargs):
         """Run fn on every worker; returns per-rank results."""
         refs = [w.execute.remote(fn, *args, **kwargs) for w in self.workers]

@@ -1,11 +1,15 @@
 """TuneController (analog of reference python/ray/tune/execution/
-tune_controller.py:49 + ray_trial_executor.py:188): the experiment step loop.
+tune_controller.py:49): the experiment step loop.
 
 Each trial runs in a dedicated **trial actor** (`_TrialActor`) holding one
-Trainable; the controller drives train/save/stop via actor calls and reacts to
-results with the searcher + scheduler. Failed trials are retried up to
-``max_failures`` by recreating the actor from the latest checkpoint — same
-gang-restart shape the JaxTrainer BackendExecutor uses.
+Trainable. Actor lifecycle — acquisition of the trial's resources, creation,
+process-death detection, tracked restarts, release — goes through the shared
+AIR execution layer (`ray_tpu.air.execution.ActorManager`, the reference's
+RayActorManager shape): the controller schedules `train`/`save` tasks with
+callbacks and reacts to results with the searcher + scheduler. Failed trials
+(application errors AND actor death) are retried up to ``max_failures`` by
+recreating the actor from the latest checkpoint through the manager — the
+same restart semantics Train's BackendExecutor gets from the same component.
 """
 
 from __future__ import annotations
@@ -13,10 +17,14 @@ from __future__ import annotations
 import json
 import os
 import time
-import traceback
 
 import ray_tpu
-from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.execution import (
+    ActorManager,
+    FixedResourceManager,
+    PlacementGroupResourceManager,
+    ResourceRequest,
+)
 from ray_tpu.tune.experiment.trial import (
     ERROR,
     PAUSED,
@@ -89,6 +97,7 @@ class TuneController:
         experiment_name: str = "exp",
         checkpoint_frequency: int = 1,
         sync_config=None,
+        resource_manager=None,
     ):
         if isinstance(trainable, type) and issubclass(trainable, Trainable):
             self.trainable_cls = trainable
@@ -120,6 +129,17 @@ class TuneController:
 
             self._logger_manager = LoggerManager(experiment_dir)
 
+        # The shared AIR execution substrate. TPU trials gang-reserve their
+        # chips through placement groups (one ICI domain per trial); plain
+        # CPU trials use budget bookkeeping with raylet enforcement.
+        if resource_manager is None:
+            resource_manager = (
+                PlacementGroupResourceManager()
+                if "TPU" in self.resources_per_trial
+                else FixedResourceManager()
+            )
+        self._actor_manager = ActorManager(resource_manager)
+
         self.trials: list[Trial] = []
         self._searcher_done = False
         self._start_time = time.time()
@@ -130,51 +150,126 @@ class TuneController:
 
     # -- trial lifecycle ----------------------------------------------------
 
-    def _actor_options(self, trial: Trial | None = None) -> dict:
+    def _trial_resources(self, trial: Trial) -> dict:
         # Per-trial override (ResourceChangingScheduler) wins over the
         # experiment-wide default.
-        res = dict(
+        return dict(
             trial.resources
-            if trial is not None and trial.resources
+            if trial.resources
             else self.resources_per_trial
         )
-        opts: dict = {}
-        ncpu = res.pop("CPU", None)
-        ntpu = res.pop("TPU", None)
-        if ncpu:
-            opts["num_cpus"] = ncpu
-        if ntpu:
-            opts["num_tpus"] = ntpu
-        if res:
-            opts["resources"] = res
-        return opts
 
     def _start_trial(self, trial: Trial, checkpoint=None, config: dict | None = None):
         if config is not None:
             trial.config = config
-        cls = ray_tpu.remote(_TrialActor)
-        trial.runner = cls.options(
-            max_restarts=0, **self._actor_options(trial)
-        ).remote(
-            self.trainable_cls, trial.config,
-            checkpoint if checkpoint is not None else trial.checkpoint,
-            trial.resources or self.resources_per_trial,
+        if checkpoint is not None:
+            trial.checkpoint = checkpoint
+        res = self._trial_resources(trial)
+
+        def _constructor_kwargs():
+            # Re-resolved on every (re)start by the manager, so a restart
+            # after a failure picks up the LATEST checkpoint and config.
+            return dict(
+                trainable_cls=self.trainable_cls,
+                config=trial.config,
+                checkpoint=trial.checkpoint,
+                trial_resources=self._trial_resources(trial),
+            )
+
+        trial.tracked_actor = self._actor_manager.add_actor(
+            _TrialActor,
+            kwargs_fn=_constructor_kwargs,
+            resource_request=ResourceRequest([res]),
+            on_start=self._make_on_start(trial),
+            on_failure=self._make_on_failure(trial),
+            # Process-death restarts share the trial's failure budget; the
+            # manager recreates from the latest checkpoint via kwargs_fn.
+            max_restarts=(-1 if self.max_failures < 0 else self.max_failures),
+            restart_backoff_s=0.5,
+            graceful_stop_method="stop",
         )
         trial.status = RUNNING
-        trial.start_time = time.time()
-        trial.pending_future = trial.runner.train.remote()
-        trial.pending_action = "train"
 
     def _stop_trial(self, trial: Trial, status: str = TERMINATED):
-        if trial.runner is not None:
-            try:
-                trial.runner.stop.remote()
-                ray_tpu.kill(trial.runner)
-            except Exception:
-                pass
-        trial.runner = None
-        trial.pending_future = None
+        if trial.tracked_actor is not None:
+            self._actor_manager.remove_actor(trial.tracked_actor)
+            trial.tracked_actor = None
         trial.status = status
+
+    # -- manager callbacks --------------------------------------------------
+
+    def _make_on_start(self, trial: Trial):
+        def on_start(tracked):
+            if trial.tracked_actor is not tracked:
+                return  # stale callback from a replaced actor
+            trial.start_time = time.time()
+            self._schedule_train(trial)
+
+        return on_start
+
+    def _make_on_failure(self, trial: Trial):
+        def on_failure(tracked, error, will_restart):
+            if trial.tracked_actor is not tracked:
+                return
+            trial.num_failures += 1
+            trial.error_msg = f"{type(error).__name__}: {error}"
+            if will_restart:
+                # The manager recreates the actor from the latest checkpoint
+                # (kwargs_fn); on_start reschedules training.
+                return
+            self._fail_trial(trial)
+
+        return on_failure
+
+    def _fail_trial(self, trial: Trial):
+        """Terminal failure (budget exhausted): same bookkeeping whether the
+        last straw was a process death or an application exception."""
+        self.searcher.on_trial_complete(trial.trial_id, error=True)
+        self.scheduler.on_trial_error(self, trial)
+        self._stop_trial(trial, ERROR)
+
+    def _schedule_train(self, trial: Trial):
+        tracked = trial.tracked_actor
+        self._actor_manager.schedule_actor_task(
+            tracked,
+            "train",
+            on_result=lambda value: self._on_result(trial, tracked, value),
+            on_error=lambda err: self._on_app_error(trial, tracked, err),
+        )
+
+    def _save_then(self, trial: Trial, next_action: str):
+        tracked = trial.tracked_actor
+        self._actor_manager.schedule_actor_task(
+            tracked,
+            "save",
+            on_result=lambda value: self._on_saved(trial, tracked, value, next_action),
+            on_error=lambda err: self._on_app_error(trial, tracked, err),
+        )
+
+    def _on_saved(self, trial: Trial, tracked, value, next_action: str):
+        if trial.tracked_actor is not tracked:
+            return
+        if value is not None:
+            trial.checkpoint = value
+        if next_action == "train":
+            self._schedule_train(trial)
+        else:  # pause
+            self._stop_trial(trial, PAUSED)
+
+    def _on_app_error(self, trial: Trial, tracked, err: Exception):
+        """The trainable raised (the actor process is still alive). Shares
+        the trial failure budget with process-death restarts: retry from the
+        latest checkpoint through the manager, else surface the error."""
+        if trial.tracked_actor is not tracked:
+            return
+        trial.num_failures += 1
+        trial.error_msg = f"{type(err).__name__}: {err}"
+        if trial.num_failures <= self.max_failures or self.max_failures < 0:
+            # Manager-driven recreate: kwargs_fn re-resolves to the latest
+            # checkpoint; on_start fires again and reschedules training.
+            self._actor_manager.restart_actor(tracked)
+        else:
+            self._fail_trial(trial)
 
     def _maybe_add_trial(self) -> bool:
         """Ask the searcher for a new config; returns True if a trial was added."""
@@ -212,7 +307,9 @@ class TuneController:
 
     # -- result handling ----------------------------------------------------
 
-    def _on_result(self, trial: Trial, result: dict):
+    def _on_result(self, trial: Trial, tracked, result: dict):
+        if trial.tracked_actor is not tracked:
+            return  # stale callback from a replaced actor
         # A bare done sentinel (function trainable ending) carries no new
         # metrics — logging it would duplicate the last row. Trainable.train
         # decorates every result with iteration/timing bookkeeping, so only
@@ -247,23 +344,20 @@ class TuneController:
             if self.checkpoint_frequency and trial.iteration % self.checkpoint_frequency == 0:
                 self._save_then(trial, next_action="train")
             else:
-                trial.pending_future = trial.runner.train.remote()
-                trial.pending_action = "train"
-
-    def _save_then(self, trial: Trial, next_action: str):
-        trial.pending_future = trial.runner.save.remote()
-        trial.pending_action = f"save:{next_action}"
+                self._schedule_train(trial)
 
     def _complete_trial(self, trial: Trial, result: dict):
         self.searcher.on_trial_complete(trial.trial_id, result)
         self.scheduler.on_trial_complete(self, trial, result)
         # capture a final checkpoint before teardown
-        try:
-            ckpt = ray_tpu.get(trial.runner.save.remote(), timeout=30)
-            if ckpt is not None:
-                trial.checkpoint = ckpt
-        except Exception:
-            pass
+        tracked = trial.tracked_actor
+        if tracked is not None and tracked.actor_handle is not None:
+            try:
+                ckpt = ray_tpu.get(tracked.actor_handle.save.remote(), timeout=30)
+                if ckpt is not None:
+                    trial.checkpoint = ckpt
+            except Exception:
+                pass
         self._stop_trial(trial, TERMINATED)
 
     def stop_trial(self, trial: Trial):
@@ -277,25 +371,13 @@ class TuneController:
     def _exploit(self, trial: Trial, donor: Trial, new_config: dict):
         """PBT: restart `trial` from donor's checkpoint with a mutated config."""
         self._stop_trial(trial, PENDING)
-        trial.checkpoint = donor.checkpoint
         self._start_trial(trial, checkpoint=donor.checkpoint, config=new_config)
-
-    def _on_error(self, trial: Trial, err: Exception):
-        trial.num_failures += 1
-        trial.error_msg = f"{type(err).__name__}: {err}"
-        if trial.num_failures <= self.max_failures or self.max_failures < 0:
-            self._stop_trial(trial, PENDING)  # retried from latest checkpoint
-        else:
-            # Only tell the searcher once the trial is truly finished — a
-            # retried trial will complete (or exhaust retries) later.
-            self.searcher.on_trial_complete(trial.trial_id, error=True)
-            self.scheduler.on_trial_error(self, trial)
-            self._stop_trial(trial, ERROR)
 
     # -- main loop ----------------------------------------------------------
 
     def step(self):
-        """One controller iteration: top up trials, wait on one future, react."""
+        """One controller iteration: top up trials, drive the actor manager
+        (starts, task results, failures), react via callbacks."""
         cap = self.max_concurrent or max(1, int(ray_tpu.cluster_resources().get("CPU", 1)))
         while len(self._live_trials()) < cap:
             pending = [t for t in self.trials if t.status in (PENDING, PAUSED)]
@@ -329,32 +411,9 @@ class TuneController:
             if not self._maybe_add_trial():
                 break
 
-        live = self._live_trials()
-        if not live:
+        if not self._live_trials():
             return
-        futures = {t.pending_future: t for t in live if t.pending_future is not None}
-        if not futures:
-            return
-        ready, _ = ray_tpu.wait(list(futures), num_returns=1, timeout=10.0)
-        for ref in ready:
-            trial = futures[ref]
-            try:
-                value = ray_tpu.get(ref)
-            except Exception as e:
-                self._on_error(trial, e)
-                continue
-            action = trial.pending_action
-            if action == "train":
-                self._on_result(trial, value)
-            elif action.startswith("save"):
-                if value is not None:
-                    trial.checkpoint = value
-                nxt = action.split(":", 1)[1]
-                if nxt == "train":
-                    trial.pending_future = trial.runner.train.remote()
-                    trial.pending_action = "train"
-                else:  # pause
-                    self._stop_trial(trial, PAUSED)
+        self._actor_manager.next(timeout=10.0)
 
     def is_finished(self) -> bool:
         if self.time_budget_s and time.time() - self._start_time > self.time_budget_s:
@@ -372,6 +431,9 @@ class TuneController:
         finally:
             for t in self._live_trials():
                 self._stop_trial(t, TERMINATED)
+            # Guaranteed release: whatever the exit path, no trial actor nor
+            # resource acquisition survives the controller.
+            self._actor_manager.clear()
             self.save_experiment_state()
             if self._logger_manager is not None:
                 self._logger_manager.close()

@@ -34,9 +34,7 @@ class Trial:
     # the experiment-wide resources_per_trial applies.
     resources: dict | None = None
     # runtime handles (not persisted)
-    runner: Any = None  # trial actor handle
-    pending_future: Any = None  # in-flight train() ObjectRef
-    pending_action: str = ""  # "train" | "save" | "stop"
+    tracked_actor: Any = None  # air.execution.TrackedActor driving this trial
 
     def __post_init__(self):
         if not self.trial_id:

@@ -60,13 +60,9 @@ def _routable_ip() -> str:
 
 
 def _shard_map():
-    import jax
+    from ray_tpu.util.jax_compat import shard_map
 
-    if hasattr(jax, "shard_map"):
-        return jax.shard_map
-    from jax.experimental.shard_map import shard_map
-
-    return shard_map
+    return shard_map()
 
 
 class TpuCollectiveGroup:

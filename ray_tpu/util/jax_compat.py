@@ -1,0 +1,50 @@
+"""JAX version-compat shims shared across the codebase.
+
+One definition instead of a copy per module: ``shard_map()`` moved from
+``jax.experimental.shard_map`` into ``jax.shard_map``, and its replication-
+checking kwarg was renamed ``check_rep`` -> ``check_vma``. Callers here use
+the NEW names; this shim resolves whatever the installed JAX provides.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+
+_shard_map_cached = None
+
+
+def shard_map():
+    """Return a ``shard_map`` callable accepting the new-style ``check_vma``
+    kwarg on any supported JAX version (translated to ``check_rep``, or
+    dropped, for older installs)."""
+    global _shard_map_cached
+    if _shard_map_cached is not None:
+        return _shard_map_cached
+
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        fn = jax.shard_map
+    else:
+        from jax.experimental.shard_map import shard_map as fn
+
+    try:
+        params = inspect.signature(fn).parameters
+    except (TypeError, ValueError):
+        _shard_map_cached = fn
+        return fn
+    if "check_vma" in params:
+        _shard_map_cached = fn
+        return fn
+
+    @functools.wraps(fn)
+    def compat(*args, **kwargs):
+        if "check_vma" in kwargs:
+            value = kwargs.pop("check_vma")
+            if "check_rep" in params:
+                kwargs["check_rep"] = value
+        return fn(*args, **kwargs)
+
+    _shard_map_cached = compat
+    return compat

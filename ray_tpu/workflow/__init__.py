@@ -17,7 +17,10 @@ from ray_tpu.workflow.event_listener import (  # noqa: F401
     deliver_event,
     run_listener_method,
 )
-from ray_tpu.workflow.workflow_executor import execute_workflow
+from ray_tpu.workflow.workflow_executor import (
+    WorkflowCancellationError,
+    execute_workflow,
+)
 from ray_tpu.workflow.workflow_storage import WorkflowStorage, list_workflows
 
 __all__ = [
@@ -25,8 +28,10 @@ __all__ = [
     "run",
     "run_async",
     "resume",
+    "cancel",
     "get_status",
     "get_output",
+    "get_metadata",
     "list_all",
     "delete",
     "wait",
@@ -35,6 +40,7 @@ __all__ = [
     "wait_for_event",
     "EventListener",
     "KVEventListener",
+    "WorkflowCancellationError",
     "deliver_event",
 ]
 
@@ -69,33 +75,59 @@ def run(
     ``max_retries``/``catch_exceptions`` are run-level defaults for every
     step; per-step values via ``node.options(max_retries=...,
     catch_exceptions=...)`` win (reference: workflow.options)."""
+    import time
+
     wid = workflow_id or _auto_id()
     storage = WorkflowStorage(wid)
     if storage.has_output():
         # idempotent re-run of a finished workflow returns the stored output
         return storage.load_output()
     storage.save_dag((dag, args, kwargs, {"max_retries": max_retries, "catch_exceptions": catch_exceptions}))
-    storage.save_status("RUNNING")
+    prev = storage.load_status()
+    if prev["status"] == "CANCELED":
+        # The previous run's cancel fully landed (terminal status): its
+        # marker is stale and this run supersedes it. An IN-FLIGHT cancel
+        # (marker written, status not yet CANCELED) is deliberately NOT
+        # cleared — clearing unconditionally would silently discard a cancel
+        # that raced this run's start.
+        storage.clear_cancel()
+    start = prev.get("start_time") or time.time()
+    storage.save_status("RUNNING", {"start_time": start})
     try:
-        return execute_workflow(
+        result = execute_workflow(
             storage, dag, args, kwargs,
             max_retries=max_retries, catch_exceptions=catch_exceptions,
         )
-    except BaseException:
-        storage.save_status("FAILED")
+    except WorkflowCancellationError:
+        storage.save_status("CANCELED", {"start_time": start, "end_time": time.time()})
         raise
+    except BaseException:
+        storage.save_status("FAILED", {"start_time": start, "end_time": time.time()})
+        raise
+    storage.save_status("SUCCESSFUL", {"start_time": start, "end_time": time.time()})
+    return result
 
 
 def run_async(dag, *args, workflow_id: str | None = None, **kwargs):
     """Execute durably in a background thread; returns (workflow_id, thread)."""
     wid = workflow_id or _auto_id()
-    t = threading.Thread(target=run, args=(dag, *args), kwargs={"workflow_id": wid, **kwargs}, daemon=True)
+
+    def _run():
+        try:
+            run(dag, *args, workflow_id=wid, **kwargs)
+        except WorkflowCancellationError:
+            pass  # expected exit: workflow.cancel() was called
+
+    t = threading.Thread(target=_run, daemon=True)
     t.start()
     return wid, t
 
 
 def resume(workflow_id: str):
-    """Resume an interrupted workflow from its durable log."""
+    """Resume an interrupted (or cancelled) workflow from its durable log:
+    persisted steps replay, unfinished ones re-run."""
+    import time
+
     storage = WorkflowStorage(workflow_id)
     if storage.has_output():
         return storage.load_output()
@@ -108,12 +140,19 @@ def resume(workflow_id: str):
     else:
         dag, args, kwargs = loaded
         opts = {}
-    storage.save_status("RUNNING")
+    storage.clear_cancel()  # resuming a cancelled workflow restarts it
+    start = storage.load_status().get("start_time") or time.time()
+    storage.save_status("RUNNING", {"start_time": start})
     try:
-        return execute_workflow(storage, dag, args, kwargs, **opts)
-    except BaseException:
-        storage.save_status("FAILED")
+        result = execute_workflow(storage, dag, args, kwargs, **opts)
+    except WorkflowCancellationError:
+        storage.save_status("CANCELED", {"start_time": start, "end_time": time.time()})
         raise
+    except BaseException:
+        storage.save_status("FAILED", {"start_time": start, "end_time": time.time()})
+        raise
+    storage.save_status("SUCCESSFUL", {"start_time": start, "end_time": time.time()})
+    return result
 
 
 def wait(workflows: list, *, num_returns: int = 1, timeout: float | None = None):
@@ -221,6 +260,60 @@ def continuation(dag_node):
 
     out = dag_node.execute()
     return ray_tpu.get(out) if isinstance(out, ObjectRef) else out
+
+
+def cancel(workflow_id: str) -> None:
+    """Cancel a running workflow (reference api.py ``workflow.cancel``):
+    writes a durable cancel marker the executor honors within ~1s — pending
+    steps are ``ray_tpu.cancel``-ed best-effort, completed step results stay
+    persisted, and the status becomes CANCELED. Works cross-process (any
+    driver sharing the storage root can cancel). A cancelled workflow can be
+    restarted later with ``workflow.resume``."""
+    import time
+
+    storage = WorkflowStorage(workflow_id)
+    if not storage.has_dag():
+        raise ValueError(f"workflow '{workflow_id}' not found in storage")
+    prev = storage.load_status()
+    if storage.has_output() or prev["status"] in ("FAILED", "CANCELED"):
+        return  # terminal already; don't clobber SUCCESSFUL/FAILED records
+    storage.request_cancel()
+    storage.save_status(
+        "CANCELED",
+        {
+            "start_time": prev.get("start_time"),
+            "end_time": time.time(),
+        },
+    )
+
+
+def get_metadata(workflow_id: str, task_id: str | None = None) -> dict:
+    """Workflow- or task-level metadata (reference api.py ``get_metadata``).
+
+    Without ``task_id``: the workflow's status, timing stats, and the ids of
+    every persisted (completed) step. With ``task_id`` (a step id as listed
+    in ``tasks``): that step's completion record. Raises ``ValueError`` for
+    an unknown workflow or a task with no persisted result yet."""
+    storage = WorkflowStorage(workflow_id)
+    if not storage.has_dag():
+        raise ValueError(f"workflow '{workflow_id}' not found in storage")
+    if task_id is not None:
+        meta = storage.step_metadata(task_id)
+        if meta is None:
+            raise ValueError(
+                f"workflow '{workflow_id}' has no completed task {task_id!r}"
+            )
+        return meta
+    st = storage.load_status()
+    stats = {
+        k: st[k] for k in ("start_time", "end_time") if st.get(k) is not None
+    }
+    return {
+        "workflow_id": workflow_id,
+        "status": st["status"],
+        "stats": stats,
+        "tasks": storage.list_step_ids(),
+    }
 
 
 def get_status(workflow_id: str) -> str:

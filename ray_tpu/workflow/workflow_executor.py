@@ -30,6 +30,11 @@ import inspect
 from ray_tpu.dag.dag_node import ClassMethodNode, ClassNode, DAGNode, FunctionNode, InputNode
 from ray_tpu.workflow.workflow_storage import WorkflowStorage
 
+
+class WorkflowCancellationError(RuntimeError):
+    """The workflow was cancelled via ``workflow.cancel`` while running."""
+
+
 _catch_task = None
 
 
@@ -199,6 +204,18 @@ def execute_workflow(
     pending: dict = {}  # ref -> (sid, node)
     first_error = None
     while todo or pending:
+        # Cancellation gate (workflow.cancel writes a durable marker): abort
+        # in-flight steps best-effort and stop scheduling. Completed steps
+        # stay persisted — a later resume replays them.
+        if storage.cancel_requested():
+            for ref in list(pending):
+                try:
+                    ray_tpu.cancel(ref)
+                except Exception:
+                    pass
+            raise WorkflowCancellationError(
+                f"workflow '{storage.workflow_id}' was cancelled"
+            )
         progressed = False
         for node in list(todo):
             if isinstance(node, FunctionNode):
@@ -228,7 +245,11 @@ def execute_workflow(
                     + ", ".join(type(n).__name__ for n in todo)
                 )
             continue
-        done, _ = ray_tpu.wait(list(pending.keys()), num_returns=1)
+        # Bounded wait so a cancel landing mid-wait is noticed within ~1s
+        # (a blocking wait would pin the executor until some step finished).
+        done, _ = ray_tpu.wait(list(pending.keys()), num_returns=1, timeout=1.0)
+        if not done:
+            continue
         ref = done[0]
         sid, node, catch = pending.pop(ref)
         try:
@@ -259,6 +280,8 @@ def execute_workflow(
                     _namespace=sid + "/",
                 )
                 value = (sub, None) if cont is not value else sub
+            except WorkflowCancellationError:
+                raise  # cancellation is not a step error; propagate
             except Exception as e:  # noqa: BLE001 — same contract as above
                 if catch:
                     value = (None, e)  # the catch contract applies to the sub-DAG too

@@ -72,9 +72,48 @@ class WorkflowStorage:
         with open(p) as f:
             return json.load(f)
 
+    # -- cancellation ------------------------------------------------------
+    def _cancel_path(self) -> str:
+        return os.path.join(self.root, "cancel")
+
+    def request_cancel(self):
+        """Durable cancel marker: the executor checks it between events and
+        aborts; it survives the requesting process."""
+        _atomic_write(self._cancel_path(), b"1")
+
+    def cancel_requested(self) -> bool:
+        return os.path.exists(self._cancel_path())
+
+    def clear_cancel(self):
+        try:
+            os.unlink(self._cancel_path())
+        except FileNotFoundError:
+            pass
+
     # -- step results ------------------------------------------------------
     def _step_path(self, step_id: str) -> str:
         return os.path.join(self.root, "steps", f"{step_id}.pkl")
+
+    def list_step_ids(self) -> list[str]:
+        """Ids of every persisted (completed) step, sub-DAG steps included."""
+        steps_root = os.path.join(self.root, "steps")
+        out = []
+        for root, _dirs, names in os.walk(steps_root):
+            for name in names:
+                if name.endswith(".pkl"):
+                    full = os.path.join(root, name)
+                    out.append(os.path.relpath(full, steps_root)[: -len(".pkl")])
+        return sorted(out)
+
+    def step_metadata(self, step_id: str) -> dict | None:
+        p = self._step_path(step_id)
+        if not os.path.exists(p):
+            return None
+        return {
+            "task_id": step_id,
+            "status": "SUCCESSFUL",
+            "end_time": os.path.getmtime(p),
+        }
 
     def save_step_result(self, step_id: str, value):
         import cloudpickle

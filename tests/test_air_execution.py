@@ -1,0 +1,321 @@
+"""AIR execution layer tests (reference: python/ray/air/execution — the
+RayActorManager + resource manager substrate adopted by Tune and Train).
+
+Covers the failure paths the layer exists for: pooled actor killed mid-task
+(on_actor_failure fires, restart counter increments, the replacement is
+rescheduled), restart budget exhaustion, clean cancellation of in-flight
+tasks on removal, and — the leak audit — placement-group release on every
+exit path (no reserved bundle survives in GlobalState)."""
+
+import os
+import signal
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.air.execution import (
+    ActorManager,
+    FixedResourceManager,
+    PlacementGroupResourceManager,
+    ResourceRequest,
+)
+
+
+class _Worker:
+    def __init__(self, tag="w"):
+        self.tag = tag
+
+    def pid(self):
+        return os.getpid()
+
+    def work(self, x):
+        return x * 2
+
+    def slow(self):
+        time.sleep(30)
+        return "done"
+
+    def boom(self):
+        raise ValueError("app-level")
+
+
+def _drive(mgr, pred, timeout=60.0, step=0.25):
+    """Pump manager events until pred() or timeout; returns pred()."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline and not pred():
+        mgr.next(timeout=step)
+    return pred()
+
+
+def _no_reserved_pgs():
+    from ray_tpu._private.state import GlobalState
+
+    state = GlobalState()
+    return not any(
+        pg["state"] in ("CREATED", "PENDING") for pg in state.placement_groups()
+    )
+
+
+def _cluster_cpus_free(timeout=30.0):
+    """True once every CPU is back in the availability ledger (release is
+    asynchronous: the raylet reaps the worker, then reports to the GCS)."""
+    deadline = time.monotonic() + timeout
+    while True:
+        total = ray_tpu.cluster_resources().get("CPU", 0)
+        avail = ray_tpu.available_resources().get("CPU", 0)
+        if avail >= total:
+            return True
+        if time.monotonic() > deadline:
+            return False
+        time.sleep(0.1)
+
+
+# ---------- resource managers ----------
+
+
+def test_fixed_resource_manager_budget(ray_start_regular):
+    rm = FixedResourceManager(total_resources={"CPU": 2})
+    req1 = ResourceRequest([{"CPU": 1}])
+    req2 = ResourceRequest([{"CPU": 1}])
+    req3 = ResourceRequest([{"CPU": 1}])
+    for r in (req1, req2, req3):
+        rm.request_resources(r)
+    a1 = rm.acquire_resources(req1)
+    a2 = rm.acquire_resources(req2)
+    assert a1 is not None and a2 is not None
+    assert not rm.has_resources_ready(req3)
+    assert rm.acquire_resources(req3) is None
+    rm.free_resources(a1)
+    assert rm.has_resources_ready(req3)
+    # double-free is a no-op, not a budget corruption
+    rm.free_resources(a1)
+    a3 = rm.acquire_resources(req3)
+    assert a3 is not None
+    assert not rm.has_resources_ready(ResourceRequest([{"CPU": 1}]))
+    rm.clear()
+    assert rm.has_resources_ready(ResourceRequest([{"CPU": 2}]))
+
+
+def test_fixed_manager_actor_options_mapping(ray_start_regular):
+    rm = FixedResourceManager(total_resources={"CPU": 4, "TPU": 2, "custom": 1})
+    req = ResourceRequest([{"CPU": 2, "TPU": 1, "custom": 1}])
+    rm.request_resources(req)
+    acq = rm.acquire_resources(req)
+    opts = acq.actor_options(0)
+    assert opts["num_cpus"] == 2
+    assert opts["num_tpus"] == 1
+    assert opts["resources"] == {"custom": 1}
+    with pytest.raises(IndexError):
+        acq.actor_options(1)
+    rm.free_resources(acq)
+
+
+def test_pg_manager_acquire_and_guaranteed_release(ray_start_regular):
+    rm = PlacementGroupResourceManager()
+    req = ResourceRequest([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    rm.request_resources(req)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and not rm.has_resources_ready(req):
+        time.sleep(0.1)
+    assert rm.has_resources_ready(req)
+    acq = rm.acquire_resources(req)
+    assert acq is not None and acq.placement_group is not None
+    opts = acq.actor_options(1)
+    assert opts["scheduling_strategy"].placement_group_bundle_index == 1
+    assert not _no_reserved_pgs()  # the PG is live
+    rm.free_resources(acq)
+    assert _no_reserved_pgs()
+    # cancel of a never-acquired request also releases its pending PG
+    req2 = ResourceRequest([{"CPU": 1}])
+    rm.request_resources(req2)
+    rm.cancel_resource_request(req2)
+    assert _no_reserved_pgs()
+    rm.clear()
+
+
+# ---------- actor manager: tasks + app errors ----------
+
+
+def test_actor_task_callbacks_and_app_error(ray_start_regular):
+    mgr = ActorManager(FixedResourceManager())
+    results, errors, started = [], [], []
+    t = mgr.add_actor(
+        _Worker,
+        {"tag": "a"},
+        resource_request=ResourceRequest([{"CPU": 1}]),
+        on_start=lambda tr: started.append(tr.tracked_id),
+    )
+    # Scheduled before the actor is up: queued, then submitted on start.
+    mgr.schedule_actor_task(t, "work", (21,), on_result=results.append)
+    assert _drive(mgr, lambda: results == [42])
+    assert started and t.state == "ALIVE"
+    # An application exception is a TASK error: actor stays alive.
+    mgr.schedule_actor_task(t, "boom", on_error=lambda e: errors.append(e))
+    assert _drive(mgr, lambda: len(errors) == 1)
+    assert t.state == "ALIVE" and t.restart_count == 0
+    mgr.schedule_actor_task(t, "work", (5,), on_result=results.append)
+    assert _drive(mgr, lambda: 10 in results)
+    mgr.clear()
+    assert _cluster_cpus_free()
+
+
+def test_remove_actor_cancels_inflight_cleanly(ray_start_regular):
+    mgr = ActorManager(FixedResourceManager())
+    fired = []
+    t = mgr.add_actor(_Worker, resource_request=ResourceRequest([{"CPU": 1}]))
+    assert _drive(mgr, lambda: t.state == "ALIVE")
+    mgr.schedule_actor_task(
+        t, "slow", on_result=fired.append, on_error=fired.append
+    )
+    mgr.next(timeout=0.5)
+    mgr.remove_actor(t)
+    assert t.state == "STOPPED"
+    # the cancelled in-flight task's callbacks never fire
+    for _ in range(8):
+        mgr.next(timeout=0.25)
+    assert fired == []
+    with pytest.raises(ValueError):
+        mgr.schedule_actor_task(t, "work", (1,))
+    mgr.clear()
+
+
+# ---------- chaos: SIGKILL a managed actor ----------
+
+
+def test_chaos_sigkill_restarts_and_releases_pg(ray_start_regular):
+    """The acceptance-criteria chaos test: SIGKILL a pooled PG-backed actor
+    mid-task; on_actor_failure fires, the restart counter increments, the
+    replacement actor serves rescheduled work, and removal releases the
+    placement group — no reserved bundles remain in GlobalState."""
+    mgr = ActorManager(PlacementGroupResourceManager())
+    failures, results = [], []
+    t = mgr.add_actor(
+        _Worker,
+        {"tag": "chaos"},
+        resource_request=ResourceRequest([{"CPU": 1}]),
+        max_restarts=2,
+        restart_backoff_s=0.1,
+        on_failure=lambda tr, err, will_restart: failures.append(
+            (type(err).__name__, will_restart)
+        ),
+    )
+    assert _drive(mgr, lambda: t.state == "ALIVE")
+    pids = []
+    mgr.schedule_actor_task(t, "pid", on_result=pids.append)
+    assert _drive(mgr, lambda: pids)
+
+    # Kill the actor process while a task is in flight.
+    mgr.schedule_actor_task(t, "slow", on_result=results.append)
+    mgr.next(timeout=0.5)
+    os.kill(pids[0], signal.SIGKILL)
+
+    assert _drive(mgr, lambda: t.restart_count == 1 and t.state == "ALIVE", timeout=90)
+    assert failures and failures[0][1] is True  # will_restart
+    assert results == []  # the doomed task's callback was swallowed, not faked
+
+    # The replacement is schedulable and is a NEW process.
+    mgr.schedule_actor_task(t, "pid", on_result=pids.append)
+    mgr.schedule_actor_task(t, "work", (100,), on_result=results.append)
+    assert _drive(mgr, lambda: 200 in results and len(pids) == 2)
+    assert pids[1] != pids[0]
+
+    mgr.remove_actor(t)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and not _no_reserved_pgs():
+        time.sleep(0.1)
+    assert _no_reserved_pgs()
+    mgr.clear()
+
+
+def test_restart_budget_exhausted_fails_and_releases(ray_start_regular):
+    mgr = ActorManager(PlacementGroupResourceManager())
+    failures = []
+    t = mgr.add_actor(
+        _Worker,
+        resource_request=ResourceRequest([{"CPU": 1}]),
+        max_restarts=0,
+        on_failure=lambda tr, err, will_restart: failures.append(will_restart),
+    )
+    assert _drive(mgr, lambda: t.state == "ALIVE")
+    pids = []
+    mgr.schedule_actor_task(t, "pid", on_result=pids.append)
+    assert _drive(mgr, lambda: pids)
+    os.kill(pids[0], signal.SIGKILL)
+    assert _drive(mgr, lambda: t.state == "FAILED", timeout=90)
+    assert failures == [False]
+    assert t.last_error is not None
+    # terminal failure released the PG without an explicit remove_actor
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and not _no_reserved_pgs():
+        time.sleep(0.1)
+    assert _no_reserved_pgs()
+    mgr.clear()
+
+
+# ---------- gang semantics ----------
+
+
+def test_gang_shares_one_pg_released_with_last_member(ray_start_regular):
+    """A multi-bundle request shared by N actors holds ONE placement group,
+    refcounted: removing one member keeps it, removing the last frees it."""
+    from ray_tpu._private.state import GlobalState
+
+    mgr = ActorManager(PlacementGroupResourceManager())
+    req = ResourceRequest([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    gang = [
+        mgr.add_actor(
+            _Worker, {"tag": f"g{i}"}, resource_request=req, bundle_index=i
+        )
+        for i in range(2)
+    ]
+    mgr.wait_for_actors(gang, timeout=60)
+    state = GlobalState()
+    live = [pg for pg in state.placement_groups() if pg["state"] == "CREATED"]
+    assert len(live) == 1 and len(live[0]["bundles"]) == 2
+
+    mgr.remove_actor(gang[0])
+    live = [pg for pg in state.placement_groups() if pg["state"] == "CREATED"]
+    assert len(live) == 1  # still held by the surviving member
+
+    mgr.remove_actor(gang[1])
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and not _no_reserved_pgs():
+        time.sleep(0.1)
+    assert _no_reserved_pgs()
+    mgr.clear()
+
+
+def test_backend_executor_gang_restart_releases_resources(ray_start_regular):
+    """Train's gang restart through the manager must not leak acquisitions:
+    after a worker death + whole-gang restart + shutdown, the full CPU
+    budget is back and no tracked actor survives."""
+    from ray_tpu.air.config import ScalingConfig
+    from ray_tpu.train._internal.backend_executor import BackendExecutor, JaxBackend
+
+    marker = f"/tmp/rtpu_air_gang_{os.getpid()}"
+    if os.path.exists(marker):
+        os.unlink(marker)
+
+    def flaky_loop(config):
+        import os as _os
+
+        from ray_tpu.air import session
+
+        if not _os.path.exists(config["marker"]):
+            with open(config["marker"], "w") as f:
+                f.write("1")
+            _os._exit(1)
+        session.report({"ok": 1})
+
+    executor = BackendExecutor(
+        JaxBackend(), ScalingConfig(num_workers=1), max_failures=1
+    )
+    executor.start()
+    reports = executor.run(flaky_loop, config={"marker": marker})
+    assert reports[0]["ok"] == 1
+    assert executor.num_gang_restarts == 1
+    executor.shutdown()
+    assert executor._actor_manager.num_tracked_actors == 0
+    assert _cluster_cpus_free()
+    os.unlink(marker)
