@@ -3,9 +3,13 @@
 Analog of `ray microbenchmark` (reference: python/ray/_private/ray_perf.py:93)
 plus envelope stresses from release/benchmarks (queued-task depth, actor
 count, object broadcast). Run per round; results land in MICROBENCH_r{N}.json
-so the envelope is tracked across rounds (VERDICT r1 #5).
+so the envelope is tracked across rounds (VERDICT r1 #5). Every artifact
+includes a `deltas_vs_prev` block diffing against the previous round's JSON
+so regressions are named in the artifact itself (VERDICT r5 #8).
 
 Usage: python microbench.py [--round N] [--quick]
+       python microbench.py --hop-budget   # per-hop dispatch latency table
+       python microbench.py --smoke        # <30s CI sanity pass (tier-1)
 """
 
 from __future__ import annotations
@@ -66,6 +70,89 @@ def basic_suite(results, duration):
         timeit(lambda: ray_tpu.get(ray_tpu.put(arr)), duration), 1
     )
     ray_tpu.shutdown()
+
+
+def hop_budget_suite(results, duration):
+    """--hop-budget: measured per-hop dispatch latency budget.
+
+    Runs the sync ping-pong loops with RAY_TPU_HOP_TIMING=1 so every frame
+    carries monotonic stage timestamps, then prints/records the per-hop µs
+    table per transport path: warm lease (steady-state normal task, raylet
+    OFF the path), direct actor call, and the classic raylet-queued path
+    (SPREAD forces it) as the before/after contrast."""
+    os.environ["RAY_TPU_HOP_TIMING"] = "1"
+    try:
+        import ray_tpu
+        from ray_tpu.util import tracing
+
+        ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+
+        @ray_tpu.remote
+        def small():
+            return b"ok"
+
+        @ray_tpu.remote(scheduling_strategy="SPREAD")
+        def small_spread():
+            return b"ok"
+
+        @ray_tpu.remote
+        class Actor:
+            def ping(self):
+                return b"ok"
+
+        a = Actor.remote()
+        ray_tpu.get(a.ping.remote())
+        ray_tpu.get(small.remote())
+        ray_tpu.get(small_spread.remote())
+        tracing.drain_hop_records()  # discard warmup records
+        records = []
+        for fn in (
+            lambda: ray_tpu.get(small.remote()),        # warm lease
+            lambda: ray_tpu.get(a.ping.remote()),       # direct actor
+            lambda: ray_tpu.get(small_spread.remote()),  # classic raylet path
+        ):
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < duration:
+                fn()
+            # Harvest per phase: the owner's hop ring buffer holds 4096
+            # records, and a fast later phase would evict an earlier one's.
+            records.extend(tracing.drain_hop_records())
+        summary = tracing.summarize_hop_records(records)
+        results["hop_budget"] = summary
+        print(tracing.format_hop_table(summary))
+        ray_tpu.shutdown()
+    finally:
+        os.environ.pop("RAY_TPU_HOP_TIMING", None)
+
+
+def compute_deltas_vs_prev(results: dict, round_no: int, prev_path: str | None = None):
+    """Diff numeric metrics against the previous round's artifact so a
+    regression is named IN the artifact, not discovered by a later reviewer
+    (VERDICT r5 #8). Keys ending in _per_s count as higher-is-better;
+    regressions beyond 5% are listed explicitly."""
+    if prev_path is None:
+        prev_path = f"MICROBENCH_r{round_no - 1}.json"
+    block: dict = {"prev_artifact": prev_path if os.path.exists(prev_path) else None}
+    if block["prev_artifact"]:
+        with open(prev_path) as f:
+            prev = json.load(f)
+        deltas = {}
+        for key, cur in results.items():
+            pv = prev.get(key)
+            if (
+                isinstance(cur, (int, float))
+                and isinstance(pv, (int, float))
+                and not isinstance(cur, bool)
+                and pv
+            ):
+                deltas[key] = {"prev": pv, "cur": cur, "pct": round((cur - pv) / pv * 100.0, 1)}
+        block["deltas"] = deltas
+        block["regressions"] = sorted(
+            key
+            for key, d in deltas.items()
+            if key.endswith("_per_s") and d["pct"] < -5.0
+        )
+    results["deltas_vs_prev"] = block
 
 
 def queued_tasks_stress(results, n_tasks):
@@ -242,8 +329,53 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--round", type=int, default=int(os.environ.get("GRAFT_ROUND", "2")))
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument(
+        "--smoke",
+        action="store_true",
+        help="tiny-count CPU-only sanity pass (<30s): basic suite only, "
+        "nonzero exit on any error — invoked from tier-1 so dispatch-path "
+        "breakage fails pytest instead of the next bench round",
+    )
+    ap.add_argument(
+        "--hop-budget",
+        action="store_true",
+        help="measure and print the per-hop dispatch latency budget "
+        "(warm lease vs direct actor vs classic raylet path)",
+    )
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
+
+    if args.smoke:
+        results = {"host_cpus": os.cpu_count(), "mode": "smoke"}
+        t0 = time.perf_counter()
+        basic_suite(results, duration=0.3)
+        results["smoke_wall_s"] = round(time.perf_counter() - t0, 1)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(results, f, indent=1)
+        print(json.dumps(results))
+        required = [
+            "task_sync_per_s",
+            "task_async100_per_s",
+            "actor_call_sync_per_s",
+            "actor_call_async100_per_s",
+            "put_1mib_per_s",
+            "putget_1mib_per_s",
+        ]
+        bad = [k for k in required if not results.get(k)]
+        if bad:
+            print(f"SMOKE FAILED: missing/zero metrics {bad}", file=sys.stderr)
+            sys.exit(1)
+        return
+
+    if args.hop_budget:
+        results = {"host_cpus": os.cpu_count(), "mode": "hop_budget"}
+        hop_budget_suite(results, duration=1.0 if args.quick else 3.0)
+        compute_deltas_vs_prev(results, args.round)
+        out = args.out or f"HOPBUDGET_r{args.round}.json"
+        with open(out, "w") as f:
+            json.dump(results, f, indent=1)
+        return
 
     # Reference envelope shapes (release/benchmarks/README.md:21-31), scaled
     # to this host in --quick mode: 1M queued / 10k args / 3k returns /
@@ -260,6 +392,7 @@ def main():
     results: dict = {"host_cpus": os.cpu_count()}
     for name, fn in [
         ("basic", lambda: basic_suite(results, duration)),
+        ("hop_budget", lambda: hop_budget_suite(results, min(duration, 2.0))),
         ("queued", lambda: queued_tasks_stress(results, n_tasks)),
         ("actors", lambda: actor_swarm_stress(results, n_actors)),
         ("many_args", lambda: many_args_stress(results, n_args)),
@@ -276,6 +409,7 @@ def main():
             results[f"{name}_error"] = f"{type(e).__name__}: {e}"
         results[f"{name}_wall_s"] = round(time.perf_counter() - t0, 1)
 
+    compute_deltas_vs_prev(results, args.round)
     out = args.out or f"MICROBENCH_r{args.round}.json"
     with open(out, "w") as f:
         json.dump(results, f, indent=1)

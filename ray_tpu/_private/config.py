@@ -104,6 +104,15 @@ class Config:
     # worker spawn + user __init__, slow under load) before giving up.
     actor_creation_timeout_s: float = 180.0
 
+    # --- hop-level dispatch instrumentation ---
+    # When on, every task submission carries monotonic per-hop timestamps
+    # (owner submit -> ship -> [raylet] -> worker recv -> exec -> reply ->
+    # owner recv -> future wake) in the existing msgpack frames; the owner
+    # aggregates them into a per-hop latency budget (util/tracing.py
+    # summarize_hop_records, microbench.py --hop-budget). Off by default:
+    # the stamps are cheap but non-zero on the 1k+/s dispatch hot path.
+    hop_timing: bool = False
+
     # --- logging / events ---
     log_to_driver: bool = True
     event_stats: bool = True

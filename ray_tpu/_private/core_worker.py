@@ -80,6 +80,10 @@ class PendingTask:
     # "resolving" = still owner-local (waiting on ObjectRef args);
     # "submitted" = handed to the raylet / lease transport / actor.
     phase: str = "resolving"
+    # First completion claims the task (duplicate completion payloads are
+    # routine: cancel races, lease failover double-delivery) so arg unpin /
+    # borrowed decref run exactly once.
+    done_claimed: bool = False
     # Task that submitted this one (the executing task's id when submitted
     # from inside a worker) — drives recursive cancellation.
     parent_task_id: str = ""
@@ -190,12 +194,32 @@ class CoreWorker:
         self.in_process_store: dict[str, dict] = {}  # oid -> {data | value}
         self.owned: dict[str, OwnedObject] = {}
         self._object_events: dict[str, asyncio.Event] = {}
+        # Synchronous get() waiters: oid -> [threading.Event]. The warm-path
+        # result wake — a completion handler on the IO loop sets the event
+        # and the blocked user thread runs, ONE handoff — replacing the old
+        # run_coroutine_threadsafe + asyncio.Event + cf.Future chain (three
+        # serial loop ticks + two thread handoffs per sync call).
+        self._sync_waiters: dict[str, list] = {}
+        # Hop-level dispatch records (config.hop_timing): per-task stage
+        # timestamp dicts, merged owner+worker sides at completion. Ring
+        # buffer; microbench --hop-budget and util/tracing read it.
+        self._hop_log: collections.deque = collections.deque(maxlen=4096)
+        self._hop_by_task: dict[str, dict] = {}
         self._owner_client_cache: dict[tuple, RpcClient] = {}
         self.pending_tasks: dict[str, PendingTask] = {}
         # Tombstones for cancelled tasks that may not have reached this
         # process yet (cancel racing submission); checked at execution
         # entry. Bounded FIFO — cancellation is rare.
         self._cancelled_tasks = BoundedIdSet()
+        # Completion-payload ids already processed (task_done/tasks_done are
+        # delivered at-least-once: resends after a connection failure can
+        # duplicate a payload that DID arrive). Without this filter a
+        # duplicate ERROR payload double-decrements the retry budget in
+        # _handle_task_done's retry branch. Sized to cover the resend
+        # horizon (worker _flush_done retries for up to ~60s) at multi-k/s
+        # completion rates: 64k ids ≈ a few MB, and a filter miss degrades
+        # to the pre-filter behavior (a wasted retry), never corrupts.
+        self._seen_completions = BoundedIdSet(65536)
         self.lineage: collections.OrderedDict[str, TaskSpec] = collections.OrderedDict()
         self._borrowed_decref_queue: list = []
 
@@ -471,6 +495,7 @@ class CoreWorker:
             scheduling_strategy=opts.get("scheduling_strategy", "DEFAULT"),
             runtime_env=self._merged_runtime_env(opts.get("runtime_env")),
             trace_ctx=self._trace_ctx(),
+            hop_ts={"submit": time.monotonic()} if self.cfg.hop_timing else {},
         )
         if spec.is_streaming():
             with self._lock:
@@ -774,6 +799,11 @@ class CoreWorker:
             self._submit_flush_scheduled = False
         if not batch:
             return
+        if self.cfg.hop_timing:
+            now = time.monotonic()
+            for s in batch:
+                if s.hop_ts:
+                    s.hop_ts["ship"] = now
         try:
             if len(batch) == 1:
                 await self.raylet.acall("submit_task", {"spec": batch[0].to_wire()})
@@ -925,12 +955,36 @@ class CoreWorker:
         return ev
 
     def _set_event(self, oid_hex: str):
-        def _set():
-            with self._lock:
-                ev = self._ensure_event(oid_hex)
-            ev.set()
+        self._set_events((oid_hex,))
 
-        self._io.loop.call_soon_threadsafe(_set)
+    def _set_events(self, oid_hexes):
+        """Signal completion of one or more objects, coalesced.
+
+        Sync get() waiters wake directly (threading.Event.set is safe from
+        any thread — no loop round-trip); asyncio waiters are set inline
+        when already on the IO loop (a batch of results then costs ZERO
+        extra loop ticks) and via one call_soon_threadsafe for the whole
+        batch otherwise."""
+        if not oid_hexes:
+            return
+        with self._lock:
+            waiter_lists = [
+                w for o in oid_hexes for w in (self._sync_waiters.pop(o, None),) if w
+            ]
+        for lst in waiter_lists:
+            for ev in lst:
+                ev.set()
+
+        def _set_all():
+            with self._lock:
+                evs = [self._ensure_event(o) for o in oid_hexes]
+            for ev in evs:
+                ev.set()
+
+        if threading.current_thread() is self._io._thread:
+            _set_all()
+        else:
+            self._io.loop.call_soon_threadsafe(_set_all)
 
     async def _wait_event(self, oid_hex: str, timeout: float | None):
         with self._lock:
@@ -969,6 +1023,7 @@ class CoreWorker:
         oid_hex = ref.hex()
         is_owner = ref.owner_addr is None or tuple(ref.owner_addr) == tuple(self.address)
         attempts = 0
+        missing_probes = 0  # CONSECUTIVE no-location probes (not loop passes)
         while True:
             attempts += 1
             # 1. In-process store.
@@ -976,15 +1031,45 @@ class CoreWorker:
                 entry = self.in_process_store.get(oid_hex)
             if entry is not None:
                 return self._materialize(oid_hex, entry)
-            # 2. Pending task we own: wait for completion.
+            # 2. Pending task we own: wait for completion. Direct threading
+            # waiter — the completion handler (on the IO loop) sets it and
+            # this thread runs: one handoff, no loop scheduling. Registration
+            # re-checks completion under the lock so a result landing between
+            # the pending probe and the registration can't strand the waiter.
             task_id = oid_hex[: TaskID.SIZE * 2]
             with self._lock:
                 pending = task_id in self.pending_tasks
             if pending and is_owner:
-                try:
-                    self._io.run(self._wait_event(oid_hex, self._remaining(deadline)))
-                except (asyncio.TimeoutError, TimeoutError):
-                    raise GetTimeoutError("ray_tpu.get() timed out")
+                waiter = threading.Event()
+                with self._lock:
+                    # Unlike the persistent asyncio.Event this replaced, a
+                    # threading waiter registered AFTER the signal would miss
+                    # it — so availability (inline result, or a plasma copy:
+                    # streaming items of a still-running task land there) is
+                    # re-checked under the same lock every producer stores
+                    # under before it signals.
+                    obj = self.owned.get(oid_hex)
+                    if (
+                        task_id in self.pending_tasks
+                        and oid_hex not in self.in_process_store
+                        and not (obj is not None and obj.in_plasma)
+                    ):
+                        self._sync_waiters.setdefault(oid_hex, []).append(waiter)
+                    else:
+                        waiter = None
+                if waiter is not None:
+                    rem = self._remaining(deadline)
+                    if not waiter.wait(rem):
+                        with self._lock:
+                            lst = self._sync_waiters.get(oid_hex)
+                            if lst is not None and waiter in lst:
+                                lst.remove(waiter)
+                                if not lst:
+                                    self._sync_waiters.pop(oid_hex, None)
+                        raise GetTimeoutError("ray_tpu.get() timed out")
+                    rec = self._hop_by_task.get(task_id)
+                    if rec is not None and "wake" not in rec:
+                        rec["wake"] = time.monotonic()
                 continue
             # 3. Local/remote plasma.
             with self._lock:
@@ -1032,12 +1117,21 @@ class CoreWorker:
                     pass
             else:
                 # Only reconstruct when no copy exists anywhere (a slow pull
-                # must not trigger a spurious re-execution).
+                # must not trigger a spurious re-execution). Location rows
+                # are registered asynchronously at seal time, so one missing
+                # probe is not proof of loss — require two CONSECUTIVE
+                # missing probes (a counter of its own: the overall loop
+                # counter also ticks on waits that never probed locations)
+                # before re-executing.
                 if not self._has_any_location(oid_hex):
-                    if self._try_reconstruct(oid_hex):
+                    missing_probes += 1
+                    if missing_probes >= 2 and self._try_reconstruct(oid_hex):
+                        missing_probes = 0
                         continue
-                    if attempts > 3:
+                    if missing_probes >= 4:
                         raise ObjectLostError(oid_hex)
+                else:
+                    missing_probes = 0
             time.sleep(0.05)
             self._remaining(deadline)
 
@@ -1269,6 +1363,7 @@ class CoreWorker:
             seq_no=self._actor_seq[actor_id],
             max_task_retries=max_task_retries,
             trace_ctx=self._trace_ctx(),
+            hop_ts={"submit": time.monotonic()} if self.cfg.hop_timing else {},
         )
         self._register_pending(spec, arg_refs)
         self._actor_pending[actor_id].add(spec.task_id)
@@ -1277,6 +1372,20 @@ class CoreWorker:
             ObjectRef(ObjectID.for_return(task_id, i), self.address)
             for i in range(num_returns)
         ]
+
+    def _actor_client_cached(self, actor_id: str) -> RpcClient | None:
+        """Loop-safe fast path: the already-resolved, address-matching client
+        for an actor, or None. Skips the run_in_executor round trip (two
+        thread handoffs) that the cold resolve path needs for its blocking
+        GCS lookup — on the warm sync-call loop that round trip was the
+        single largest owner-side cost."""
+        addr = self._actor_addrs.get(actor_id)
+        if addr is None:
+            return None
+        client = self._actor_clients.get(actor_id)
+        if client is None or client.address != addr:
+            return None
+        return client
 
     async def _drive_actor_call(self, spec: TaskSpec, attempts_left: int):
         actor_id = spec.actor_id
@@ -1288,9 +1397,18 @@ class CoreWorker:
         while True:
             try:
                 async with lock:
-                    client = await loop.run_in_executor(None, self._actor_client, actor_id)
-                    fut = await client.astart_call("actor_call", {"spec": spec.to_wire()})
+                    client = self._actor_client_cached(actor_id)
+                    if client is None:
+                        client = await loop.run_in_executor(None, self._actor_client, actor_id)
+                    if spec.hop_ts:
+                        spec.hop_ts["ship"] = time.monotonic()
+                    wire = {"spec": spec.to_wire()}
+                    fut = client.send_nowait("actor_call", wire)
+                    if fut is None:
+                        fut = await client.astart_call("actor_call", wire)
                 resp = await fut
+                if spec.hop_ts:
+                    resp.setdefault("hop", {})["owner_recv"] = time.monotonic()
                 self._handle_task_done(spec.task_id, resp)
                 return
             except ActorDiedError as e:
@@ -1527,20 +1645,23 @@ class CoreWorker:
 
     def _fail_task(self, task_id: str, error: BaseException):
         with self._lock:
-            pending = self.pending_tasks.pop(task_id, None)
-        if pending is None:
-            return
+            pending = self.pending_tasks.get(task_id)
+            if pending is None or pending.done_claimed:
+                return
+            pending.done_claimed = True
         ser = serialization.serialize(error).to_bytes()
         with self._lock:
             stream = self._streams.get(task_id)
             for oid in pending.spec.return_object_ids():
                 self.in_process_store[oid] = {"data": ser, "value": error}
+            # Pop only after the error entries are visible (same ordering
+            # contract as _handle_task_done).
+            self.pending_tasks.pop(task_id, None)
         if stream is not None:
             with stream["cond"]:
                 stream["error"] = ser
                 stream["cond"].notify_all()
-        for oid in pending.spec.return_object_ids():
-            self._set_event(oid)
+        self._set_events(pending.spec.return_object_ids())
         if pending.spec.actor_id:
             self._actor_pending[pending.spec.actor_id].discard(task_id)
         self._unpin_args(pending.arg_refs)
@@ -1549,15 +1670,37 @@ class CoreWorker:
     # Owner-side RPC handlers
     # ==================================================================
 
+    def _duplicate_completion(self, payload: dict) -> bool:
+        cid = payload.get("cid")
+        if not cid:
+            return False
+        if cid in self._seen_completions:
+            return True
+        self._seen_completions.add(cid)
+        return False
+
     async def rpc_task_done(self, req):
+        if self._duplicate_completion(req):
+            return {"ok": True}
+        if req.get("hop") is not None:
+            req["hop"]["owner_recv"] = time.monotonic()
         self._handle_task_done(req["task_id"], req)
         return {"ok": True}
 
     async def rpc_tasks_done(self, req):
-        """Batched completions from a leased worker (lease_manager.py)."""
+        """Batched completions from a leased worker (lease_manager.py).
+
+        Runs on the IO loop, so _handle_task_done's event sets are inline —
+        the whole batch of future wakeups costs zero extra loop ticks
+        (sync getters wake directly off their threading.Event)."""
+        now = time.monotonic()
         lm = self._lease_mgr
         shapes = set()
         for payload in req["batch"]:
+            if self._duplicate_completion(payload):
+                continue
+            if payload.get("hop") is not None:
+                payload["hop"]["owner_recv"] = now
             if lm is not None:
                 shapes.add(lm.on_task_done(payload["task_id"], payload.get("duration_s")))
             self._handle_task_done(payload["task_id"], payload)
@@ -1673,8 +1816,8 @@ class CoreWorker:
     def _handle_task_done(self, task_id: str, payload: dict):
         with self._lock:
             pending = self.pending_tasks.get(task_id)
-        if pending is None:
-            return
+            if pending is None or pending.done_claimed:
+                return
         error = payload.get("error")
         if (
             error is not None
@@ -1689,7 +1832,9 @@ class CoreWorker:
             self._io.spawn(self.raylet.acall("submit_task", {"spec": pending.spec.to_wire()}))
             return
         with self._lock:
-            self.pending_tasks.pop(task_id, None)
+            if pending.done_claimed:
+                return  # duplicate completion raced us past the first check
+            pending.done_claimed = True
             stream = self._streams.get(task_id)
         if stream is not None:
             with stream["cond"]:
@@ -1721,11 +1866,48 @@ class CoreWorker:
             self.lineage[task_id] = pending.spec
             while len(self.lineage) > 10_000:
                 self.lineage.popitem(last=False)
-        for oid in pending.spec.return_object_ids():
-            self._set_event(oid)
+            # Pop LAST, after results are visible: a getter observing the
+            # task gone from pending_tasks must find its results (the old
+            # pop-first ordering had a window where a concurrent get() saw
+            # neither and misread the object as lost).
+            self.pending_tasks.pop(task_id, None)
+        if pending.spec.hop_ts or payload.get("hop"):
+            self._record_hops(pending, payload)
+        self._set_events(pending.spec.return_object_ids())
         if pending.spec.actor_id:
             self._actor_pending[pending.spec.actor_id].discard(task_id)
         self._unpin_args(pending.arg_refs)
+
+    def _record_hops(self, pending: PendingTask, payload: dict):
+        """Merge owner-side stamps (kept on the local spec object) with the
+        worker-side stamps returned in the completion payload."""
+        spec = pending.spec
+        rec = {"task_id": spec.task_id, "name": spec.name}
+        rec["path"] = (
+            "actor" if spec.is_actor_task()
+            else ("lease" if pending.via_lease else "classic")
+        )
+        rec.update(spec.hop_ts)
+        rec.update(payload.get("hop") or {})
+        rec["owner_done"] = time.monotonic()
+        self._hop_log.append(rec)
+        if len(self._hop_by_task) > 8192:
+            self._hop_by_task.clear()
+        self._hop_by_task[spec.task_id] = rec
+
+    def hop_records(self) -> list[dict]:
+        """Completed-dispatch hop records (config.hop_timing); each maps
+        stage name -> monotonic seconds. Consumed by tracing.summarize_hop_records."""
+        return list(self._hop_log)
+
+    def drain_hop_records(self) -> list[dict]:
+        """hop_records() + clear. Harvest per measurement phase — the ring
+        buffer holds 4096 records, so a multi-phase run that only collects
+        at the end would have its earliest phase evicted by the later ones."""
+        recs = list(self._hop_log)
+        self._hop_log.clear()
+        self._hop_by_task.clear()
+        return recs
 
     async def rpc_task_failed(self, req):
         """Raylet tells us a worker died mid-task (reference: retry path)."""
@@ -1983,6 +2165,8 @@ class CoreWorker:
 
         trace_token = tracing.set_task_context(spec.trace_ctx)
         start = time.time()
+        if spec.hop_ts:
+            spec.hop_ts["exec_start"] = time.monotonic()
         self.record_task_event(spec, "RUNNING", start_ts=start)
         try:
             if spec.is_actor_task():
@@ -1998,12 +2182,17 @@ class CoreWorker:
                 values = []
             else:
                 out = fn(*args, **kwargs)
-                if asyncio.iscoroutine(out):
+                import inspect as _inspect
+
+                # inspect.iscoroutine, NOT asyncio.iscoroutine: on
+                # Python <= 3.10 the latter also matches plain generators
+                # (legacy generator-based coroutines), which would route
+                # num_returns="streaming" generators into the async-actor
+                # loop and blow up on `await <generator>`.
+                if _inspect.iscoroutine(out):
                     out = self._run_actor_coroutine(out)
                 if spec.is_streaming():
-                    import inspect
-
-                    if not inspect.isgenerator(out) and not hasattr(out, "__iter__"):
+                    if not _inspect.isgenerator(out) and not hasattr(out, "__iter__"):
                         raise TypeError(
                             f"num_returns='streaming' task {spec.name} must "
                             f"return a generator/iterable, got {type(out).__name__}"
@@ -2104,6 +2293,11 @@ class CoreWorker:
                 except BaseException:  # noqa: BLE001 — late cancel mid-cleanup
                     continue
         payload["duration_s"] = time.time() - start
+        if spec.hop_ts:
+            # Worker-side stamps travel back in the completion payload; the
+            # transport layer adds its "reply" stamp as the payload leaves.
+            spec.hop_ts["exec_end"] = time.monotonic()
+            payload["hop"] = dict(spec.hop_ts)
         return payload
 
     def _run_actor_coroutine(self, coro):

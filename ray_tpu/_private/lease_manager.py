@@ -118,17 +118,29 @@ class LeaseManager:
             if self._submit_scheduled:
                 return
             self._submit_scheduled = True
-        self.cw._io.loop.call_soon_threadsafe(
-            lambda: asyncio.ensure_future(self._drain_submits())
-        )
+        self.cw._io.loop.call_soon_threadsafe(self._drain_entry)
+
+    def _drain_entry(self):
+        """Loop callback. The warm sync ping-pong case — ONE pending spec,
+        a warm lease with room — stages and writes the lease_exec frame
+        synchronously right here: zero further loop hops between the user
+        thread's wakeup of the loop and the frame hitting the socket.
+        Bursts fall back to the coalescing async drain."""
+        with self._submit_lock:
+            single = len(self._submit_buf) == 1
+            if single:
+                batch, self._submit_buf = self._submit_buf, []
+                self._submit_scheduled = False
+        if not single:
+            asyncio.ensure_future(self._drain_submits())
+            return
+        spec = batch[0]
+        shape = self._shape_for(spec)
+        shape.queue.append(spec)
+        self._pump(shape)
 
     async def _drain_submits(self):
-        if len(self._submit_buf) <= 1:
-            # A lone submit gains nothing from the coalescing pass; the
-            # extra loop hop is pure latency on the sync ping-pong path.
-            pass
-        else:
-            await asyncio.sleep(0)  # let the submitting thread's burst accumulate
+        await asyncio.sleep(0)  # let the submitting thread's burst accumulate
         with self._submit_lock:
             batch, self._submit_buf = self._submit_buf, []
             self._submit_scheduled = False
@@ -139,7 +151,7 @@ class LeaseManager:
             if shape not in shapes:
                 shapes.append(shape)
         for shape in shapes:
-            await self._pump(shape)
+            self._pump(shape)
 
     def _shape_for(self, spec: TaskSpec) -> _Shape:
         key = (
@@ -155,17 +167,18 @@ class LeaseManager:
 
     # ---- dispatch ----
 
-    async def _pump(self, shape: _Shape):
+    def _pump(self, shape: _Shape):
+        """Synchronous (IO-loop-only): stages ready specs onto warm leases —
+        writing the lease_exec frames inline on warm connections — and tops
+        up lease requests. Only the RPC *acks* are awaited, in background
+        tasks, so one dead worker's 15s timeout can never head-of-line
+        block other shapes/leases."""
         if self._closed:
             return
         for lease in list(shape.leases.values()):
             if not shape.queue:
                 break
-            # Fire-and-forget: _feed pops its chunk synchronously (single
-            # loop, no race) and then awaits the worker RPC — awaiting it
-            # HERE would let one dead worker's 15s timeout head-of-line
-            # block every other shape/lease in the batch.
-            _bg(self._feed(lease))
+            self._feed(lease)
         want = min(len(shape.queue), self.cfg.lease_max_per_shape) - (
             len(shape.leases) + len(shape.pending_requests)
         )
@@ -174,7 +187,7 @@ class LeaseManager:
         if self._maintenance_task is None or self._maintenance_task.done():
             self._maintenance_task = asyncio.ensure_future(self._maintenance_loop())
 
-    async def _feed(self, lease: _Lease):
+    def _feed(self, lease: _Lease):
         shape = lease.shape
         # Staging depth adapts to OBSERVED task duration: short tasks stack
         # up to lease_max_inflight (the per-completion round trip would
@@ -193,14 +206,32 @@ class LeaseManager:
         chunk = []
         while shape.queue and len(chunk) < room:
             chunk.append(shape.queue.popleft())
+        now = time.monotonic()
         for s in chunk:
             lease.inflight[s.task_id] = s
             self._task_lease[s.task_id] = lease
-        lease.last_active = time.monotonic()
+            if s.hop_ts:
+                s.hop_ts["ship"] = now  # worker-direct: no raylet stage
+        lease.last_active = now
+        payload = {"specs": [s.to_wire() for s in chunk]}
+        # Warm connection: the frame is written synchronously HERE (no
+        # task-scheduling iteration between staging and the wire); only the
+        # accepted-ack is awaited in the background.
+        fut = lease.client.send_nowait("lease_exec", payload)
+        if fut is not None:
+            _bg(self._await_exec_ack(lease, fut))
+        else:
+            _bg(self._send_exec(lease, payload))
+
+    async def _await_exec_ack(self, lease: _Lease, fut):
         try:
-            await lease.client.acall(
-                "lease_exec", {"specs": [s.to_wire() for s in chunk]}, timeout=15
-            )
+            await asyncio.wait_for(fut, 15)
+        except Exception:
+            await self._lease_failed(lease, "lease_exec failed")
+
+    async def _send_exec(self, lease: _Lease, payload: dict):
+        try:
+            await lease.client.acall("lease_exec", payload, timeout=15)
         except Exception:
             await self._lease_failed(lease, "lease_exec failed")
 
@@ -243,7 +274,7 @@ class LeaseManager:
             # nothing is coming, retry after a beat instead of spinning.
             if shape.queue and not shape.leases and not shape.pending_requests:
                 await asyncio.sleep(0.2)
-                await self._pump(shape)
+                self._pump(shape)
             return
         client = RpcClient(tuple(resp["address"]), label=f"lease-{resp['worker_id'][:8]}")
         lease = _Lease(
@@ -251,7 +282,7 @@ class LeaseManager:
             tuple(resp.get("raylet_address") or self.cw.raylet.address),
         )
         shape.leases[lease_id] = lease
-        await self._feed(lease)
+        self._feed(lease)
 
     # ---- completion / failure ----
 
@@ -295,7 +326,7 @@ class LeaseManager:
     def topup(self, shapes):
         for shape in shapes:
             if shape is not None and (shape.queue or shape.pending_requests):
-                asyncio.ensure_future(self._pump(shape))
+                self._pump(shape)
 
     def on_lease_revoked(self, lease_id: str, oom: bool = False, reason: str = "revoked by raylet"):
         for shape in self._shapes.values():
@@ -342,7 +373,7 @@ class LeaseManager:
                         f"({reason}); retries exhausted"
                     )
                 self.cw._fail_task(s.task_id, err)
-        await self._pump(shape)
+        self._pump(shape)
 
     # ---- maintenance ----
 
@@ -369,11 +400,22 @@ class LeaseManager:
                         # dead one fails over without waiting for the raylet.
                         asyncio.ensure_future(self._probe(lease))
             # Renew against the raylet that HOLDS each lease (spilled grants
-            # live on peers).
+            # live on peers). The LOCAL raylet's renewal also carries the
+            # owner's current per-shape backlog: under warm leases the
+            # initial request's backlog figure goes stale while the lease is
+            # held, and the autoscaler must keep seeing the live queue depth
+            # (reference: backlog_size reporting in ReportWorkerBacklog).
+            local = tuple(self.cw.raylet.address)
             for addr, ids in by_raylet.items():
+                payload = {"lease_ids": ids, "owner": self.cw.worker_id}
+                if tuple(addr) == local:
+                    payload["backlogs"] = [
+                        [dict(s.resources), len(s.queue)]
+                        for s in self._shapes.values()
+                    ]
                 try:
                     resp = await self._raylet_for(addr).acall(
-                        "renew_worker_leases", {"lease_ids": ids}, timeout=10
+                        "renew_worker_leases", payload, timeout=10
                     )
                     for lid in resp.get("revoked", []):
                         self.on_lease_revoked(lid)

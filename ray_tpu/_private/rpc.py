@@ -400,6 +400,38 @@ class RpcClient:
                 await pending
         return fut
 
+    def send_nowait(self, method: str, payload: dict | None = None):
+        """LOOP-THREAD-ONLY fast path: write the request frame synchronously
+        when the connection is up and no other sender holds the client lock;
+        returns the response future, or None (caller falls back to acall).
+
+        Saves the task-scheduling loop iteration astart_call costs per send —
+        measurable on the sync dispatch ping-pong. Write ordering is
+        preserved: every writer (here and astart_call) runs on the one IO
+        loop, and the lock.locked() guard keeps us from interleaving with a
+        sender that is mid-connect under the lock."""
+        if (
+            self._closed
+            or self._writer is None
+            or self._writer.is_closing()
+            or self._lock.locked()
+        ):
+            return None
+        try:
+            if self._writer.transport.get_write_buffer_size() > _WRITE_HIGH_WATER:
+                # Genuine backpressure (stalled peer): fall back to the
+                # acall path, which awaits drain — an unchecked write here
+                # would grow the socket buffer without bound.
+                return None
+        except Exception:
+            pass
+        self._seq += 1
+        seq = self._seq
+        fut = asyncio.get_event_loop().create_future()
+        self._pending[seq] = fut
+        self._writer.write(_pack([REQUEST, seq, method, payload or {}]))
+        return fut
+
     async def acall(
         self,
         method: str,

@@ -344,20 +344,24 @@ class StoreClient:
         self.raylet.call("store_seal", {"object_id": object_id_hex})
 
     def get_view(self, object_id_hex: str, timeout: float | None = None) -> memoryview:
-        """Blocks until sealed locally; returns a zero-copy view (pinned)."""
+        """Blocks until sealed locally; returns a zero-copy READ-ONLY view
+        (pinned). Read-only is load-bearing: the view aliases the node's
+        shared arena, and numpy arrays deserialized zero-copy from it would
+        otherwise be writable in place — one caller's mutation would corrupt
+        the sealed object for every other reader on the node."""
         if self.index is not None:
             hit = self.index.get_pinned(object_id_hex)
             if hit is not None:
                 offset, size, token = hit
                 with self._pins_lock:
                     self._pins.setdefault(object_id_hex, []).append(("idx", token))
-                return self.arena.read(offset, size)
+                return self.arena.read(offset, size).toreadonly()
         resp = self.raylet.call(
             "store_get", {"object_id": object_id_hex, "timeout": timeout}, timeout=timeout
         )
         with self._pins_lock:
             self._pins.setdefault(object_id_hex, []).append(("rpc", None))
-        return self.arena.read(resp["offset"], resp["size"])
+        return self.arena.read(resp["offset"], resp["size"]).toreadonly()
 
     def contains(self, object_id_hex: str) -> bool:
         if self.index is not None:

@@ -66,6 +66,13 @@ class TaskSpec:
     # flows through the raylet queue like a task, but dispatch grants the
     # worker to the owner instead of pushing a task onto it.
     lease_id: str = ""
+    # Hop-level dispatch timestamps (config.hop_timing): stage name ->
+    # CLOCK_MONOTONIC seconds. Same-host comparable across processes; each
+    # stage stamps as the spec passes through (owner submit/ship, raylet
+    # recv/dispatch on the classic path, worker recv), and the completion
+    # payload carries the worker-side stamps back. Empty (elided from the
+    # wire) unless instrumentation is on.
+    hop_ts: dict = field(default_factory=dict)
 
     def to_wire(self) -> dict:
         """Delta-encoded against field defaults: a typical no-frills task

@@ -20,6 +20,7 @@ import logging
 import os
 import sys
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 
 logger = logging.getLogger(__name__)
@@ -159,6 +160,8 @@ class WorkerExecutor:
         from ray_tpu._private.task_spec import TaskSpec
 
         spec = TaskSpec.from_wire(req["spec"])
+        if spec.hop_ts:
+            spec.hop_ts["worker_recv"] = time.monotonic()
         asyncio.ensure_future(self._execute_pushed(spec))
         return {"ok": True}
 
@@ -168,12 +171,47 @@ class WorkerExecutor:
         if spec.is_actor_creation():
             await self._finish_actor_creation(spec, payload)
         else:
-            # Report to owner, then free the lease.
-            await self._report_to_owner(spec, payload)
-            try:
-                await self.raylet.acall("task_finished", {"worker_id": self.cw.worker_id})
-            except Exception:
-                pass
+            if payload.get("hop") is not None:
+                payload["hop"]["reply"] = time.monotonic()
+            payload["cid"] = os.urandom(8).hex()  # owner-side duplicate filter
+            # Piggybacked completion: once the task_done frame is ON THE
+            # WIRE, task_finished runs concurrently with the owner's ack
+            # (was two serial RTTs per classic-path task). Ordering is
+            # load-bearing: freeing the worker FIRST would let a crash in
+            # the window clear worker.current_task at the raylet, so a
+            # death before the owner got the result would send no
+            # task_failed and the owner would wait for the slow lost-task
+            # sweep. task_finished stays an acknowledged, retried acall — a
+            # one-way push frame lost to a resetting connection would
+            # strand the worker 'busy' forever.
+            sent = None
+            if spec.owner_addr is not None:
+                try:
+                    owner = self.cw._owner_client(tuple(spec.owner_addr))
+                    sent = owner.send_nowait("task_done", payload)
+                except Exception:
+                    sent = None
+            if sent is None:
+                # Cold or backpressured owner connection: keep the fully
+                # crash-safe serial order (owner ack, then free the worker).
+                await self._report_to_owner(spec, payload)
+                try:
+                    await self.raylet.acall(
+                        "task_finished", {"worker_id": self.cw.worker_id}
+                    )
+                except Exception:
+                    pass
+            else:
+                fin = asyncio.ensure_future(
+                    self.raylet.acall("task_finished", {"worker_id": self.cw.worker_id})
+                )
+                fin.add_done_callback(lambda t: t.cancelled() or t.exception())
+                try:
+                    await sent
+                except Exception:
+                    # Connection failed before the ack: re-deliver through
+                    # the retrying path (owner drops a duplicate by cid).
+                    await self._report_to_owner(spec, payload)
 
     async def _report_to_owner(self, spec, payload):
         if spec.owner_addr is None:
@@ -226,6 +264,10 @@ class WorkerExecutor:
         from ray_tpu._private.task_spec import TaskSpec
 
         specs = [TaskSpec.from_wire(wire) for wire in req["specs"]]
+        now = time.monotonic()
+        for spec in specs:
+            if spec.hop_ts:
+                spec.hop_ts["worker_recv"] = now
         ex = self.cw._executor
         if hasattr(ex, "submit_callback"):
             # Hot loop: specs go straight onto the main-thread exec queue
@@ -290,6 +332,34 @@ class WorkerExecutor:
         self._loop.call_soon_threadsafe(self._lease_done, owner_addr, payload)
 
     def _lease_done(self, owner_addr, payload):
+        if payload.get("hop") is not None:
+            payload["hop"]["reply"] = time.monotonic()
+        # Delivery here is at-least-once (both the direct-send fallback and
+        # _flush_done re-send payloads whose connection failed after the
+        # frame may already have arrived); the cid lets the owner drop the
+        # duplicates instead of double-consuming retry budget.
+        payload.setdefault("cid", os.urandom(8).hex())
+        # Clear pipe + warm connection: write the tasks_done frame NOW
+        # (zero scheduling between completion and the wire). Failures fall
+        # back into the buffered retry path below, which is also taken
+        # whenever a flush is already in flight (keeps rough FIFO).
+        if not self._done_buf and not self._done_flushing:
+            fut = None
+            try:
+                owner = self.cw._owner_client(owner_addr)
+                fut = owner.send_nowait("tasks_done", {"batch": [payload]})
+            except Exception:
+                fut = None
+            if fut is not None:
+                def _delivered(f, oa=owner_addr, p=payload):
+                    if f.cancelled() or f.exception() is not None:
+                        self._lease_done_buffered(oa, p)
+
+                fut.add_done_callback(_delivered)
+                return
+        self._lease_done_buffered(owner_addr, payload)
+
+    def _lease_done_buffered(self, owner_addr, payload):
         self._done_buf.append((owner_addr, payload))
         if not self._done_flushing:
             self._done_flushing = True
@@ -312,7 +382,12 @@ class WorkerExecutor:
                 for owner_addr, payloads in by_owner.items():
                     try:
                         owner = self.cw._owner_client(owner_addr)
-                        await owner.acall("tasks_done", {"batch": payloads})
+                        batch = {"batch": payloads}
+                        fut = owner.send_nowait("tasks_done", batch)
+                        if fut is not None:
+                            await fut
+                        else:
+                            await owner.acall("tasks_done", batch)
                     except Exception:
                         logger.warning(
                             "lease result delivery to %s failed (%d results)",
@@ -344,13 +419,15 @@ class WorkerExecutor:
         from ray_tpu._private.task_spec import TaskSpec
 
         spec = TaskSpec.from_wire(req["spec"])
+        if spec.hop_ts:
+            spec.hop_ts["worker_recv"] = time.monotonic()
         loop = asyncio.get_event_loop()
         if self._concurrency_pool is not None:
             # Threaded actor: concurrent execution, no ordering guarantee
             # (reference: concurrency groups / max_concurrency > 1).
-            return await loop.run_in_executor(
+            return self._stamp_reply(await loop.run_in_executor(
                 self._concurrency_pool, self._safe_execute, spec
-            )
+            ))
         ex = self.cw._executor
         if hasattr(ex, "submit_callback"):
             # Hot loop: straight onto the main-thread exec queue (FIFO =
@@ -367,10 +444,19 @@ class WorkerExecutor:
                 _loop.call_soon_threadsafe(_set_result_if_pending, _fut, payload)
 
             ex.submit_callback(self._fast_execute, (spec,), deliver)
-            return await fut
+            return self._stamp_reply(await fut)
         # Fallback executors are single-worker ThreadPoolExecutors:
         # submission order is execution order.
-        return await loop.run_in_executor(self.cw._executor, self._safe_execute, spec)
+        return self._stamp_reply(
+            await loop.run_in_executor(self.cw._executor, self._safe_execute, spec)
+        )
+
+    @staticmethod
+    def _stamp_reply(payload):
+        """Hop stamp as the actor-call response leaves for the wire."""
+        if payload.get("hop") is not None:
+            payload["hop"]["reply"] = time.monotonic()
+        return payload
 
     # ---- cancellation (reference: core_worker.cc HandleCancelTask) ----
 

@@ -81,6 +81,144 @@ def reset_task_context(token):
     _current.reset(token)
 
 
+# ---------------------------------------------------------------------------
+# Hop-level dispatch budget (config.hop_timing / RAY_TPU_HOP_TIMING=1)
+# ---------------------------------------------------------------------------
+#
+# Each completed dispatch leaves a record of monotonic stage timestamps on
+# the owner (CoreWorker.hop_records()); the stages chain differently per
+# transport path. summarize_hop_records() turns the raw records into the
+# per-hop latency budget that microbench.py --hop-budget emits.
+
+# Ordered stage transitions per path. A "hop" that crosses a process
+# boundary is a wire frame; the rest are in-process thread/loop handoffs.
+_HOP_CHAINS = {
+    # Warm-lease / steady-state normal task: owner ships worker-direct, the
+    # worker replies owner-direct — the raylet is not on the path at all.
+    "lease": [
+        ("submit", "ship"),          # user thread -> owner IO loop + stage
+        ("ship", "worker_recv"),     # WIRE owner -> worker
+        ("worker_recv", "exec_start"),  # worker loop -> main-thread exec queue
+        ("exec_start", "exec_end"),  # user code
+        ("exec_end", "reply"),       # worker main thread -> worker IO loop
+        ("reply", "owner_recv"),     # WIRE worker -> owner
+        ("owner_recv", "wake"),      # owner IO loop -> blocked getter thread
+    ],
+    "actor": [
+        ("submit", "ship"),
+        ("ship", "worker_recv"),
+        ("worker_recv", "exec_start"),
+        ("exec_start", "exec_end"),
+        ("exec_end", "reply"),
+        ("reply", "owner_recv"),
+        ("owner_recv", "wake"),
+    ],
+    # Classic raylet-queued path (PG / SPREAD / affinity / streaming): two
+    # extra raylet stages on the way in, plus the task_finished frame.
+    "classic": [
+        ("submit", "ship"),
+        ("ship", "raylet_recv"),         # WIRE owner -> raylet
+        ("raylet_recv", "raylet_dispatch"),  # raylet queue + grant
+        ("raylet_dispatch", "worker_recv"),  # WIRE raylet -> worker
+        ("worker_recv", "exec_start"),
+        ("exec_start", "exec_end"),
+        ("exec_end", "reply"),
+        ("reply", "owner_recv"),         # WIRE worker -> owner
+        ("owner_recv", "wake"),
+    ],
+}
+
+# Serial wire frames (process boundary crossings) on each path's critical
+# path. The warm-lease fast path is 2 — matching the reference's steady
+# state (owner->worker push, worker->owner reply); classic is 4 (submit,
+# dispatch, task_done, piggybacked task_finished push).
+_SERIAL_PROCESS_HOPS = {"lease": 2, "actor": 2, "classic": 4}
+_RAYLET_RPCS = {"lease": 0, "actor": 0, "classic": 2}
+
+
+def _pctl(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[idx]
+
+
+def summarize_hop_records(records: list[dict]) -> dict:
+    """Aggregate raw hop records into a per-path, per-stage µs budget."""
+    by_path: dict[str, list[dict]] = {}
+    for rec in records:
+        by_path.setdefault(rec.get("path", "classic"), []).append(rec)
+    out: dict = {}
+    for path, recs in by_path.items():
+        chain = _HOP_CHAINS.get(path, _HOP_CHAINS["classic"])
+        stages: dict[str, dict] = {}
+        totals: list[float] = []
+        for a, b in chain:
+            deltas = sorted(
+                (rec[b] - rec[a]) * 1e6
+                for rec in recs
+                if a in rec and b in rec and rec[b] >= rec[a]
+            )
+            if deltas:
+                stages[f"{a}->{b}"] = {
+                    "p50_us": round(_pctl(deltas, 0.5), 1),
+                    "p90_us": round(_pctl(deltas, 0.9), 1),
+                    "n": len(deltas),
+                }
+        for rec in recs:
+            first, last = chain[0][0], chain[-1][1]
+            if first in rec and last in rec:
+                totals.append((rec[last] - rec[first]) * 1e6)
+        totals.sort()
+        out[path] = {
+            "count": len(recs),
+            "stages_us": stages,
+            "total_p50_us": round(_pctl(totals, 0.5), 1) if totals else None,
+            "total_p90_us": round(_pctl(totals, 0.9), 1) if totals else None,
+            "serial_process_hops": _SERIAL_PROCESS_HOPS.get(path),
+            "raylet_rpcs_per_call": _RAYLET_RPCS.get(path),
+        }
+    return out
+
+
+def format_hop_table(summary: dict) -> str:
+    """Human-readable per-hop µs table from summarize_hop_records output."""
+    lines = []
+    for path, info in summary.items():
+        lines.append(
+            f"[{path}] n={info['count']}  total p50={info['total_p50_us']}us "
+            f"p90={info['total_p90_us']}us  serial process hops="
+            f"{info['serial_process_hops']}  raylet rpcs/call={info['raylet_rpcs_per_call']}"
+        )
+        lines.append(f"  {'stage':<30} {'p50 us':>10} {'p90 us':>10} {'n':>6}")
+        for stage, s in info["stages_us"].items():
+            lines.append(f"  {stage:<30} {s['p50_us']:>10.1f} {s['p90_us']:>10.1f} {s['n']:>6}")
+    return "\n".join(lines)
+
+
+def collect_hop_records() -> list[dict]:
+    """Hop records from the connected core worker (empty when hop timing is
+    off or nothing has completed)."""
+    from ray_tpu._private import worker_context
+
+    cw = worker_context.get_core_worker_if_initialized()
+    if cw is None:
+        return []
+    return cw.hop_records()
+
+
+def drain_hop_records() -> list[dict]:
+    """collect_hop_records() + clear — use between measurement phases so an
+    earlier phase's records can't be evicted from the bounded ring buffer
+    by a later, faster phase."""
+    from ray_tpu._private import worker_context
+
+    cw = worker_context.get_core_worker_if_initialized()
+    if cw is None:
+        return []
+    return cw.drain_hop_records()
+
+
 def export_spans(address=None) -> list[dict]:
     """Reconstruct spans from the task-event log: one span per task with
     trace/span/parent ids, name, timestamps, and status."""

@@ -22,6 +22,7 @@ import time
 import pytest
 
 import ray_tpu
+from conftest import skip_without_multiprocess_collectives
 from ray_tpu._private import worker_context
 from ray_tpu._private.config import init_config
 from ray_tpu._private.core_worker import DRIVER, CoreWorker
@@ -184,6 +185,7 @@ def test_tasks_and_shuffle_survive_node_kills(process_cluster):
     assert ray_tpu.get(chunk.remote(123), timeout=120) == 123
 
 
+@skip_without_multiprocess_collectives
 def test_checkpointed_trainer_survives_node_kill(process_cluster):
     """A 2-worker JaxTrainer run rides out a node SIGKILL via whole-gang
     restart (reference: Train fault tolerance under chaos)."""

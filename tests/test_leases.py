@@ -52,6 +52,68 @@ def test_lease_reused_and_returned(ray_start_regular):
             break
         time.sleep(0.2)
     assert held == 0, "idle lease was never returned"
+    # ... and the worker goes back to the raylet's idle pool (reusable by
+    # the next lease or classic dispatch), not into limbo.
+    raylet = getattr(ray_tpu._global_node, "raylet", None)
+    if raylet is not None:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if any(w.state == "idle" for w in raylet.workers.values()):
+                break
+            time.sleep(0.2)
+        assert any(w.state == "idle" for w in raylet.workers.values()), (
+            "released lease did not return its worker to the idle pool"
+        )
+
+
+def test_warm_lease_reuse_skips_raylet(monkeypatch):
+    """Steady-state sync loop: the raylet grants ONE lease up front; the
+    following tasks ship worker-direct — request_worker_lease is not called
+    again and every task runs in the same worker process. (The in-process
+    raylet shares the test's IO loop, so its handler call counts are
+    directly observable.)"""
+    import ray_tpu
+    from ray_tpu._private.rpc import EventLoopThread
+
+    # Long linger so the maintenance loop cannot return the lease between
+    # sync calls on a slow/loaded box.
+    monkeypatch.setenv("RAY_TPU_LEASE_IDLE_RELEASE_S", "30")
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    try:
+
+        @ray_tpu.remote
+        def pid():
+            return os.getpid()
+
+        first = ray_tpu.get(pid.remote())  # cold: requests the lease
+        stats = EventLoopThread.get().handler_stats
+        key = next((k for k in stats if k.endswith(".request_worker_lease")), None)
+        assert key is not None, "no lease request ever reached the raylet"
+        grants_before = stats[key][0]
+        pids = [ray_tpu.get(pid.remote()) for _ in range(3)]
+        assert pids == [first] * 3, "warm tasks left the leased worker"
+        assert stats[key][0] == grants_before, (
+            "warm-lease tasks contacted the raylet for new leases"
+        )
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_sigkill_warm_leased_worker_fails_over(ray_start_regular):
+    """SIGKILL of the warm-leased worker: the next task fails over to a
+    fresh lease (new worker) without a lost task."""
+    import signal
+
+    import ray_tpu
+
+    @ray_tpu.remote(max_retries=2)
+    def pid():
+        return os.getpid()
+
+    victim = ray_tpu.get(pid.remote())  # lease now warm on this worker
+    os.kill(victim, signal.SIGKILL)
+    survivor = ray_tpu.get(pid.remote(), timeout=90)
+    assert survivor != victim
 
 
 def test_classic_path_for_placement_sensitive_tasks(ray_start_regular):

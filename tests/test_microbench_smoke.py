@@ -1,0 +1,41 @@
+"""Tier-1 dispatch-path smoke (microbench.py --smoke).
+
+Runs the sync/async task, actor-call, and 1 MiB object-plane loops at tiny
+counts (CPU-only, <30 s on an unloaded box) in a subprocess, so breakage of
+the dispatch stack fails pytest here instead of only surfacing at the next
+bench round.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_microbench_smoke(tmp_path):
+    out = tmp_path / "smoke.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", RAY_TPU_NUM_TPUS="0")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "microbench.py"), "--smoke", "--out", str(out)],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=240,  # generous for loaded CI boxes; ~5 s unloaded
+    )
+    assert proc.returncode == 0, (
+        f"microbench --smoke failed (rc={proc.returncode})\n"
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    data = json.loads(out.read_text())
+    for key in (
+        "task_sync_per_s",
+        "task_async100_per_s",
+        "actor_call_sync_per_s",
+        "actor_call_async100_per_s",
+        "put_1mib_per_s",
+        "putget_1mib_per_s",
+    ):
+        assert data.get(key, 0) > 0, f"{key} missing/zero in smoke artifact: {data}"
