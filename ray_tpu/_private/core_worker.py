@@ -40,6 +40,7 @@ from dataclasses import dataclass, field
 import cloudpickle
 
 from ray_tpu._private import serialization
+from ray_tpu._private.concurrency import any_thread, blocking, loop_only
 from ray_tpu._private.config import get_config
 from ray_tpu._private.ids import ActorID, BoundedIdSet, JobID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.rpc import ConnectionLost, EventLoopThread, RpcClient, RpcError, RpcServer
@@ -957,6 +958,7 @@ class CoreWorker:
     def _set_event(self, oid_hex: str):
         self._set_events((oid_hex,))
 
+    @any_thread
     def _set_events(self, oid_hexes):
         """Signal completion of one or more objects, coalesced.
 
@@ -994,6 +996,7 @@ class CoreWorker:
         else:
             await asyncio.wait_for(ev.wait(), timeout)
 
+    @blocking
     def get(self, refs, timeout: float | None = None):
         single = not isinstance(refs, list)
         ref_list = [refs] if single else refs
@@ -1191,6 +1194,7 @@ class CoreWorker:
 
     # ---- wait ----
 
+    @blocking
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
         deadline = None if timeout is None else time.monotonic() + timeout
         pending = list(refs)
@@ -1292,6 +1296,7 @@ class CoreWorker:
             "name": spec.actor_name,
         }
 
+    @blocking
     def _resolve_actor(self, actor_id: str, timeout: float | None = None) -> tuple:
         """Wait for the actor's address. Reference semantics: calls to an
         actor still being created BUFFER until it is ready (creation can
@@ -1326,6 +1331,7 @@ class CoreWorker:
                 )
             time.sleep(0.05)
 
+    @blocking
     def _actor_client(self, actor_id: str) -> RpcClient:
         addr = self._resolve_actor(actor_id)
         client = self._actor_clients.get(actor_id)
@@ -1373,6 +1379,7 @@ class CoreWorker:
             for i in range(num_returns)
         ]
 
+    @loop_only
     def _actor_client_cached(self, actor_id: str) -> RpcClient | None:
         """Loop-safe fast path: the already-resolved, address-matching client
         for an actor, or None. Skips the run_in_executor round trip (two
@@ -1554,6 +1561,7 @@ class CoreWorker:
         except Exception:
             logger.exception("cancel of task %s failed", task_id[:8])
 
+    @any_thread
     def mark_cancelled(self, task_id: str):
         """Tombstone: drop this task if it arrives for execution later."""
         self._cancelled_tasks.add(task_id)
@@ -1643,6 +1651,7 @@ class CoreWorker:
             return {"found": True, "error": str(e)}
         return {"found": found}
 
+    @any_thread
     def _fail_task(self, task_id: str, error: BaseException):
         with self._lock:
             pending = self.pending_tasks.get(task_id)
@@ -1767,6 +1776,7 @@ class CoreWorker:
                 stream["error"] = None
                 stream["count"] = None
 
+    @blocking
     def stream_next(self, task_id: str, index: int, timeout: float | None = None):
         """Block until stream item `index` exists; returns its oid hex.
         Raises StopIteration past the end and re-raises task errors."""
@@ -1813,6 +1823,7 @@ class CoreWorker:
                     raise GetTimeoutError(f"stream item {index} of {task_id[:8]} timed out")
                 stream["cond"].wait(timeout=min(remaining, 1.0) if remaining else 1.0)
 
+    @loop_only
     def _handle_task_done(self, task_id: str, payload: dict):
         with self._lock:
             pending = self.pending_tasks.get(task_id)

@@ -30,6 +30,7 @@ import time
 from collections import deque
 from dataclasses import dataclass, field
 
+from ray_tpu._private.concurrency import any_thread, loop_only
 from ray_tpu._private.rpc import RpcClient
 from ray_tpu._private.task_spec import TaskSpec
 from ray_tpu.exceptions import WorkerCrashedError
@@ -109,6 +110,7 @@ class LeaseManager:
 
     # ---- entry points ----
 
+    @any_thread
     def submit(self, spec: TaskSpec):
         """Any-thread entry: queue the ready-to-run spec for lease dispatch.
         Bursts coalesce into ONE loop hop (a per-spec call_soon_threadsafe
@@ -120,6 +122,7 @@ class LeaseManager:
             self._submit_scheduled = True
         self.cw._io.loop.call_soon_threadsafe(self._drain_entry)
 
+    @loop_only
     def _drain_entry(self):
         """Loop callback. The warm sync ping-pong case — ONE pending spec,
         a warm lease with room — stages and writes the lease_exec frame
@@ -167,6 +170,7 @@ class LeaseManager:
 
     # ---- dispatch ----
 
+    @loop_only
     def _pump(self, shape: _Shape):
         """Synchronous (IO-loop-only): stages ready specs onto warm leases —
         writing the lease_exec frames inline on warm connections — and tops
@@ -187,6 +191,7 @@ class LeaseManager:
         if self._maintenance_task is None or self._maintenance_task.done():
             self._maintenance_task = asyncio.ensure_future(self._maintenance_loop())
 
+    @loop_only
     def _feed(self, lease: _Lease):
         shape = lease.shape
         # Staging depth adapts to OBSERVED task duration: short tasks stack
@@ -286,6 +291,7 @@ class LeaseManager:
 
     # ---- completion / failure ----
 
+    @loop_only
     def cancel_queued(self, task_id: str) -> bool:
         """Recall a spec still staged owner-side (pre-ship). IO-loop only."""
         with self._submit_lock:
@@ -301,10 +307,12 @@ class LeaseManager:
                     return True
         return False
 
+    @loop_only
     def lease_for(self, task_id: str):
         """The lease (worker) a shipped task is in flight on, if any."""
         return self._task_lease.get(task_id)
 
+    @loop_only
     def on_task_done(self, task_id: str, duration_s: float | None = None):
         """Bookkeeping on result arrival (the payload itself is handled by
         CoreWorker._handle_task_done). Returns the shape to top up."""
@@ -323,11 +331,13 @@ class LeaseManager:
             )
         return shape
 
+    @loop_only
     def topup(self, shapes):
         for shape in shapes:
             if shape is not None and (shape.queue or shape.pending_requests):
                 self._pump(shape)
 
+    @loop_only
     def on_lease_revoked(self, lease_id: str, oom: bool = False, reason: str = "revoked by raylet"):
         for shape in self._shapes.values():
             lease = shape.leases.get(lease_id)
@@ -429,10 +439,14 @@ class LeaseManager:
         except Exception:
             await self._lease_failed(lease, "worker unresponsive")
 
+    @any_thread
     def close(self):
         self._closed = True
         if self._maintenance_task is not None:
-            self._maintenance_task.cancel()
+            # asyncio.Task.cancel is NOT threadsafe and close() runs on the
+            # caller's (shutdown) thread: hop to the loop. Found by graftlint
+            # while annotating this file.
+            self.cw._io.loop.call_soon_threadsafe(self._maintenance_task.cancel)
 
         async def _release_all():
             for shape in self._shapes.values():

@@ -29,6 +29,16 @@ class LogMonitor:
         # path -> read offset
         self._offsets: dict[str, int] = {}
 
+    @staticmethod
+    def _read_chunk(path: str, offset: int, length: int):
+        """Executor-side file read (None when the file vanished mid-tick)."""
+        try:
+            with open(path, "rb") as f:
+                f.seek(offset)
+                return f.read(length)
+        except OSError:
+            return None
+
     def _worker_for(self, path: str):
         """Map worker-<wid8>.out/.err to the raylet's worker handle."""
         base = os.path.basename(path)
@@ -65,9 +75,14 @@ class LogMonitor:
             offset = self._offsets.get(path, 0)
             if size <= offset:
                 continue
-            with open(path, "rb") as f:
-                f.seek(offset)
-                chunk = f.read(min(size - offset, 1 << 20))
+            # Off-loop read: up to 1 MiB of file IO per path per tick would
+            # otherwise stall every RPC on the raylet's loop (graftlint:
+            # blocking/file-io-in-async).
+            chunk = await asyncio.get_event_loop().run_in_executor(
+                None, self._read_chunk, path, offset, min(size - offset, 1 << 20)
+            )
+            if chunk is None:
+                continue
             # Only consume complete lines; partial tail re-read next tick.
             last_nl = chunk.rfind(b"\n")
             if last_nl < 0:

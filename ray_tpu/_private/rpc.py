@@ -24,6 +24,8 @@ from typing import Any, Awaitable, Callable
 
 import msgpack
 
+from ray_tpu._private.concurrency import any_thread, blocking, loop_only
+
 logger = logging.getLogger(__name__)
 
 REQUEST, RESPONSE, ERROR, PUSH = 0, 1, 2, 3
@@ -183,11 +185,15 @@ class EventLoopThread:
         if inst is not None:
             inst.loop.call_soon_threadsafe(inst.loop.stop)
 
+    @blocking
     def run(self, coro: Awaitable, timeout: float | None = None):
-        """Run a coroutine on the IO loop from any other thread, blocking."""
+        """Run a coroutine on the IO loop from any other thread, blocking.
+        @blocking: calling this FROM the loop thread deadlocks it (the loop
+        would wait on a future only the loop can complete)."""
         fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
         return fut.result(timeout)
 
+    @any_thread
     def spawn(self, coro: Awaitable) -> "asyncio.Future":
         return asyncio.run_coroutine_threadsafe(coro, self.loop)
 
@@ -400,6 +406,7 @@ class RpcClient:
                 await pending
         return fut
 
+    @loop_only
     def send_nowait(self, method: str, payload: dict | None = None):
         """LOOP-THREAD-ONLY fast path: write the request frame synchronously
         when the connection is up and no other sender holds the client lock;
@@ -472,6 +479,7 @@ class RpcClient:
 
     # ---- blocking API (from user threads) ----
 
+    @blocking
     def call(
         self,
         method: str,
@@ -481,12 +489,15 @@ class RpcClient:
     ):
         return self._io.run(self.acall(method, payload, timeout=timeout, retries=retries))
 
+    @blocking
     def push(self, method: str, payload: dict | None = None):
         return self._io.run(self.apush(method, payload))
 
+    @any_thread
     def set_push_handler(self, handler: Callable[[str, dict], None]):
         self._push_handler = handler
 
+    @any_thread
     def close(self):
         self._closed = True
 

@@ -23,6 +23,8 @@ import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 
+from ray_tpu._private.concurrency import any_thread, loop_only
+
 logger = logging.getLogger(__name__)
 
 
@@ -51,6 +53,7 @@ class _MainThreadExecutor:
         self._q: "queue.SimpleQueue" = queue.SimpleQueue()
         self._stopped = False
 
+    @any_thread
     def submit(self, fn, *args, **kwargs):
         import concurrent.futures
 
@@ -58,6 +61,7 @@ class _MainThreadExecutor:
         self._q.put((fut, fn, args, kwargs))
         return fut
 
+    @any_thread
     def submit_callback(self, fn, args, callback):
         """Zero-Future fast path: run fn(*args) on the exec thread, deliver
         the result to callback(result) ON THAT THREAD (callers hop back to
@@ -325,12 +329,14 @@ class WorkerExecutor:
             "duration_s": 0.0,
         }
 
+    @any_thread
     def _lease_result_from_thread(self, owner_addr, spec, payload):
         """Runs on the exec thread; marshal the completion to the loop."""
         if payload is None:  # submit_callback swallowed a framework bug
             payload = self._bug_payload(spec)
         self._loop.call_soon_threadsafe(self._lease_done, owner_addr, payload)
 
+    @loop_only
     def _lease_done(self, owner_addr, payload):
         if payload.get("hop") is not None:
             payload["hop"]["reply"] = time.monotonic()
@@ -359,6 +365,7 @@ class WorkerExecutor:
                 return
         self._lease_done_buffered(owner_addr, payload)
 
+    @loop_only
     def _lease_done_buffered(self, owner_addr, payload):
         self._done_buf.append((owner_addr, payload))
         if not self._done_flushing:

@@ -260,15 +260,23 @@ class ZygoteClient:
         import time as _time
 
         log_dir = os.path.join(self.session_dir, "logs")
-        os.makedirs(log_dir, exist_ok=True)
-        log = open(os.path.join(log_dir, "zygote.log"), "ab")
-        self.proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.zygote", self.socket_path],
-            env=self.base_env,
-            stdout=log,
-            stderr=subprocess.STDOUT,
-            cwd=os.getcwd(),
-        )
+
+        def _spawn():
+            # fork+exec plus the log-file open are milliseconds of syscalls —
+            # off-loop so a slow disk can't stall every RPC on the raylet's
+            # loop while the fork-server boots (graftlint:
+            # blocking/subprocess-in-async).
+            os.makedirs(log_dir, exist_ok=True)
+            with open(os.path.join(log_dir, "zygote.log"), "ab") as log:
+                return subprocess.Popen(
+                    [sys.executable, "-m", "ray_tpu._private.zygote", self.socket_path],
+                    env=self.base_env,
+                    stdout=log,
+                    stderr=subprocess.STDOUT,
+                    cwd=os.getcwd(),
+                )
+
+        self.proc = await asyncio.get_event_loop().run_in_executor(None, _spawn)
         deadline = _time.monotonic() + 30.0
         while True:
             try:

@@ -30,6 +30,8 @@ import threading
 import time
 from urllib.parse import parse_qsl, urlencode
 
+from ray_tpu._private.concurrency import any_thread, blocking
+
 logger = logging.getLogger(__name__)
 
 _DISCONNECT = {"type": "http.disconnect"}
@@ -408,9 +410,15 @@ class _AppBridge:
         self._body = body
         self._delivered = False
 
+    @any_thread
     def finish(self, error: BaseException | None):
-        """Mark the app coroutine finished. Runs on the shared ingress loop,
-        so it must never block: flag first, then a best-effort wake."""
+        """Mark the app coroutine finished. Usually runs on the shared
+        ingress loop (future done-callback), so it must never block: flag
+        first, then a best-effort wake. @any_thread, not @loop_only: when
+        the app coroutine finishes before ``add_done_callback`` registers,
+        the callback fires synchronously on the REPLICA thread instead
+        (audited for graftlint: the run_coroutine_threadsafe result is
+        never ``.result()``-ed anywhere the ingress loop could reach)."""
         import queue as _queue
 
         self.error = error
@@ -465,6 +473,7 @@ def _next_event(bridge: _AppBridge, deadline_s: float):
         return ev
 
 
+@blocking
 def run_asgi_request(asgi_app, request):
     """Drive a user ASGI app with a replica `HTTPRequest`, sync->async bridge.
 

@@ -1,0 +1,27 @@
+"""graftlint — concurrency static analysis for the ray_tpu runtime.
+
+Machine-checks the three families of invariants the runtime's hot paths
+rely on (see CONCURRENCY.md and ray_tpu/_private/concurrency.py):
+
+1. **Loop affinity** (``passes: affinity``): call-graph analysis proving no
+   path from a thread entry point reaches a ``@loop_only`` function without a
+   ``call_soon_threadsafe``/``run_coroutine_threadsafe`` hop, no loop-context
+   path reaches a ``@blocking`` function without a ``run_in_executor`` hop,
+   and no provably-on-loop code pays for a redundant threadsafe hop.
+2. **Blocking-in-async** (``blocking``): lexical scan of ``async def`` bodies
+   for calls that stall the event loop (``time.sleep``, ``subprocess``,
+   sync ``Event.wait``/``Lock.acquire``, ``cf.Future.result``, file/socket
+   IO).
+3. **Lock order** (``lockorder``): extracts the sync-lock nesting relation
+   (including one level of interprocedural summaries), reports cycles
+   (AB/BA deadlocks), self-nesting of non-reentrant locks, and ``await``
+   reachable while a sync lock is held.
+
+Run: ``python -m ray_tpu.tools.graftlint ray_tpu/`` (never imports the
+analyzed code — pure AST). A committed ``graftlint_baseline.json`` makes CI
+fail only on NEW violations. Suppress a single finding in place with a
+``# graftlint: ignore[<code>]`` comment on the offending line.
+"""
+
+from ray_tpu.tools.graftlint.core import PackageIndex  # noqa: F401
+from ray_tpu.tools.graftlint.cli import main  # noqa: F401

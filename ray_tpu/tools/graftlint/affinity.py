@@ -1,0 +1,216 @@
+"""Pass 1 — loop affinity.
+
+Builds an intra-package call graph (same-thread edges only: threadsafe hops
+and executor/thread spawns are *context switches*, not calls) and checks:
+
+- ``affinity-leak``: a path from a thread entry point (``threading.Thread``
+  target, ``run_in_executor``/``submit`` callable, ``@any_thread`` API,
+  public module-level sync API) into a ``@loop_only`` function with no
+  ``call_soon_threadsafe``/``run_coroutine_threadsafe`` hop in between.
+- ``blocking-on-loop``: a path from loop context (``async def`` bodies,
+  ``@loop_only`` functions, threadsafe-hop targets) into a ``@blocking``
+  function with no ``run_in_executor`` hop in between (deadlock risk: the
+  loop waits on something only the loop can produce).
+- ``redundant-hop``: provably-on-loop code (``@loop_only`` or ``async def``)
+  paying for a ``call_soon_threadsafe``/``run_coroutine_threadsafe`` round
+  trip it does not need.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.tools.graftlint.core import FunctionInfo, PackageIndex, resolve_call
+from ray_tpu.tools.graftlint.findings import Finding
+
+PASS = "affinity"
+
+
+def _edges(index: PackageIndex, fi: FunctionInfo):
+    """Resolved same-thread call edges out of ``fi`` (callee, lineno)."""
+    out = []
+    for cs in fi.calls:
+        target = resolve_call(index, fi, cs.name, cs.receiver)
+        if target is not None and target.key != fi.key:
+            out.append((target, cs.lineno))
+    return out
+
+
+def _resolved_targets(index: PackageIndex, fi: FunctionInfo, pairs):
+    out = []
+    for name, receiver, lineno in pairs:
+        target = resolve_call(index, fi, name, receiver)
+        if target is not None:
+            out.append((target, lineno))
+    return out
+
+
+def run(index: PackageIndex) -> list[Finding]:
+    findings: list[Finding] = []
+    edge_cache: dict[str, list] = {}
+
+    def edges_of(fi):
+        if fi.key not in edge_cache:
+            edge_cache[fi.key] = _edges(index, fi)
+        return edge_cache[fi.key]
+
+    # ---- root sets -------------------------------------------------------
+    any_roots: list[FunctionInfo] = []
+    loop_roots: list[FunctionInfo] = []
+    for fi in index.all_functions():
+        if fi.is_async or "loop_only" in fi.markers:
+            loop_roots.append(fi)
+        elif "any_thread" in fi.markers:
+            any_roots.append(fi)
+        # Public module-level sync API (ray_tpu/__init__.py) runs on user
+        # threads by definition.
+        if (
+            not fi.is_async
+            and fi.cls is None
+            and "." not in fi.qualname
+            and not fi.name.startswith("_")
+            and fi.relpath.endswith("__init__.py")
+            and fi.relpath.count("/") + fi.relpath.count("\\") <= 1
+        ):
+            any_roots.append(fi)
+        for target, lineno in _resolved_targets(index, fi, fi.thread_targets):
+            if not target.is_async:
+                any_roots.append(target)
+        for target, lineno in _resolved_targets(index, fi, fi.hop_targets):
+            loop_roots.append(target)
+
+    # ---- ANY-context BFS: reaching @loop_only is a leak ------------------
+    seen: dict[str, tuple] = {}  # key -> (parent_key, via_lineno)
+    queue: list[FunctionInfo] = []
+    for root in any_roots:
+        if root.is_async or "loop_only" in root.markers or root.key in seen:
+            continue
+        seen[root.key] = (None, root.lineno)
+        queue.append(root)
+    while queue:
+        fi = queue.pop(0)
+        for callee, lineno in edges_of(fi):
+            if callee.is_async:
+                continue  # bare call of an async fn only builds a coroutine
+            if "loop_only" in callee.markers:
+                chain = _chain(index, seen, fi.key) + [callee.qualname]
+                findings.append(
+                    Finding(
+                        pass_name=PASS,
+                        code="affinity-leak",
+                        file=fi.relpath,
+                        line=lineno,
+                        symbol=fi.qualname,
+                        detail=callee.qualname,
+                        message=(
+                            f"{callee.qualname} is @loop_only but is reachable "
+                            f"from a thread context without a threadsafe hop: "
+                            + " -> ".join(chain)
+                        ),
+                    )
+                )
+                continue
+            if "any_thread" in callee.markers:
+                pass  # documented cross-thread entry: keep walking its body
+            if callee.key not in seen:
+                seen[callee.key] = (fi.key, lineno)
+                queue.append(callee)
+
+    # ---- LOOP-context BFS: reaching @blocking is a deadlock risk ---------
+    lseen: dict[str, tuple] = {}
+    lqueue: list[FunctionInfo] = []
+    for root in loop_roots:
+        if "blocking" in root.markers or root.key in lseen:
+            continue
+        lseen[root.key] = (None, root.lineno)
+        lqueue.append(root)
+    while lqueue:
+        fi = lqueue.pop(0)
+        for callee, lineno in edges_of(fi):
+            if "blocking" in callee.markers:
+                chain = _chain(index, lseen, fi.key) + [callee.qualname]
+                findings.append(
+                    Finding(
+                        pass_name=PASS,
+                        code="blocking-on-loop",
+                        file=fi.relpath,
+                        line=lineno,
+                        symbol=fi.qualname,
+                        detail=callee.qualname,
+                        message=(
+                            f"{callee.qualname} is @blocking but is reachable "
+                            f"from loop context without a run_in_executor hop: "
+                            + " -> ".join(chain)
+                        ),
+                    )
+                )
+                continue
+            if callee.key not in lseen:
+                lseen[callee.key] = (fi.key, lineno)
+                lqueue.append(callee)
+
+    # ---- redundant threadsafe hops from provably-on-loop code ------------
+    for fi in index.all_functions():
+        definitely_loop = ("loop_only" in fi.markers or fi.is_async) and (
+            "any_thread" not in fi.markers
+        )
+        if not definitely_loop:
+            continue
+        for kind, lineno in fi.hop_sites:
+            findings.append(
+                Finding(
+                    pass_name=PASS,
+                    code="redundant-hop",
+                    file=fi.relpath,
+                    line=lineno,
+                    symbol=fi.qualname,
+                    detail=kind,
+                    message=(
+                        f"{fi.qualname} always runs on the event loop but uses "
+                        f"{kind}; call directly (or ensure_future) — the "
+                        "threadsafe hop costs a wakeup and hides the affinity"
+                    ),
+                )
+            )
+    return findings
+
+
+def _chain(index: PackageIndex, seen: dict, key: str) -> list[str]:
+    names = []
+    hops = 0
+    while key is not None and hops < 20:
+        fi = index.by_key.get(key)
+        if fi is None:
+            break
+        names.append(fi.qualname)
+        key = seen.get(key, (None, 0))[0]
+        hops += 1
+    return list(reversed(names))
+
+
+def suggest_annotations(index: PackageIndex) -> list[str]:
+    """--fix-annotations report: unannotated functions whose role is implied
+    by how they are scheduled."""
+    suggestions = []
+    hop_targets: dict[str, int] = {}
+    thread_targets: dict[str, int] = {}
+    for fi in index.all_functions():
+        for target, lineno in _resolved_targets(index, fi, fi.hop_targets):
+            hop_targets.setdefault(target.key, lineno)
+        for target, lineno in _resolved_targets(index, fi, fi.thread_targets):
+            thread_targets.setdefault(target.key, lineno)
+    for key in sorted(hop_targets):
+        fi = index.by_key[key]
+        if not fi.markers and not fi.is_async:
+            suggestions.append(
+                f"{fi.relpath}:{fi.lineno}: {fi.qualname} is scheduled onto the "
+                "loop (call_soon_threadsafe/run_coroutine_threadsafe target) — "
+                "consider @loop_only"
+            )
+    for key in sorted(thread_targets):
+        fi = index.by_key[key]
+        if not fi.markers and not fi.is_async:
+            suggestions.append(
+                f"{fi.relpath}:{fi.lineno}: {fi.qualname} runs on an executor/"
+                "thread (Thread target / run_in_executor / submit) — consider "
+                "@any_thread (and audit what it calls)"
+            )
+    return suggestions

@@ -1,0 +1,90 @@
+"""Pass 2 — blocking calls lexically inside ``async def`` bodies.
+
+One stalled handler stalls EVERY socket in the process (the runtime runs all
+RPC on one loop thread), so anything that can block for more than a syscall
+must leave the loop via ``run_in_executor``. Checked lexically per async
+body; nested ``def``/``lambda`` bodies are excluded (they are deferred —
+usually run by an executor), and calls that are direct arguments of an
+awaited call are excluded (``await asyncio.wait_for(ev.wait(), t)`` is the
+asyncio idiom, not a block).
+"""
+
+from __future__ import annotations
+
+from ray_tpu.tools.graftlint.core import FunctionInfo, PackageIndex
+from ray_tpu.tools.graftlint.findings import Finding
+
+PASS = "blocking"
+
+_SUBPROCESS_FNS = {"run", "call", "check_call", "check_output", "Popen"}
+_SOCKET_FNS = {"recv", "recv_into", "accept", "sendall", "makefile", "connect"}
+_SYNC_WAITERS = {"wait", "acquire"}
+_THREADY_RE = ("thread", "proc", "worker")
+
+
+def _check_call(mod_from_imports, fi: FunctionInfo, cs) -> tuple[str, str] | None:
+    """(code, what) if this call blocks, else None."""
+    name, recv = cs.name, cs.receiver
+    low = recv.lower()
+    if name == "sleep":
+        if recv == "time" or (
+            recv == "" and mod_from_imports.get("sleep", ("", ""))[0] == "time"
+        ):
+            return "sleep-in-async", f"{recv or 'time'}.sleep"
+        return None
+    if recv == "subprocess" and name in _SUBPROCESS_FNS:
+        return "subprocess-in-async", f"subprocess.{name}"
+    if recv == "" and mod_from_imports.get(name, ("", ""))[0] == "subprocess":
+        return "subprocess-in-async", f"subprocess.{name}"
+    if name == "open" and recv == "":
+        return "file-io-in-async", "open()"
+    if recv == "os" and name in ("system", "popen"):
+        return "file-io-in-async", f"os.{name}"
+    if name == "result" and not cs.awaited and not cs.arg_of_awaited:
+        return "future-result-in-async", f"{recv}.result()"
+    if (
+        name in _SYNC_WAITERS
+        and not cs.awaited
+        and not cs.arg_of_awaited
+        and "asyncio" not in low
+    ):
+        return "sync-wait-in-async", f"{recv}.{name}()"
+    if (
+        name == "join"
+        and not cs.awaited
+        and not cs.arg_of_awaited
+        and any(h in low for h in _THREADY_RE)
+    ):
+        return "thread-join-in-async", f"{recv}.join()"
+    if name in _SOCKET_FNS and "sock" in low:
+        return "socket-io-in-async", f"{recv}.{name}()"
+    return None
+
+
+def run(index: PackageIndex) -> list[Finding]:
+    findings: list[Finding] = []
+    for fi in index.all_functions():
+        if not fi.is_async:
+            continue
+        mod = index.module_of(fi)
+        for cs in fi.calls:
+            hit = _check_call(mod.from_imports, fi, cs)
+            if hit is None:
+                continue
+            code, what = hit
+            findings.append(
+                Finding(
+                    pass_name=PASS,
+                    code=code,
+                    file=fi.relpath,
+                    line=cs.lineno,
+                    symbol=fi.qualname,
+                    detail=what,
+                    message=(
+                        f"{what} blocks the event loop inside async "
+                        f"{fi.qualname}; move it off-loop (run_in_executor) "
+                        "or use the asyncio equivalent"
+                    ),
+                )
+            )
+    return findings

@@ -1,0 +1,168 @@
+"""graftlint CLI.
+
+``python -m ray_tpu.tools.graftlint ray_tpu/`` — exit 0 when every finding
+is baselined or suppressed, 1 on new violations, 2 on usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+from ray_tpu.tools.graftlint import affinity, blocking, lockorder
+from ray_tpu.tools.graftlint.core import PackageIndex
+from ray_tpu.tools.graftlint.findings import (
+    Finding,
+    apply_baseline,
+    load_baseline,
+    write_baseline,
+)
+
+PASSES = {
+    "affinity": affinity.run,
+    "blocking": blocking.run,
+    "lockorder": lockorder.run,
+}
+
+
+def default_baseline_path(target: str) -> str | None:
+    """Walk up from the analyzed path looking for a committed baseline."""
+    cur = os.path.abspath(target)
+    if os.path.isfile(cur):
+        cur = os.path.dirname(cur)
+    for _ in range(6):
+        cand = os.path.join(cur, "graftlint_baseline.json")
+        if os.path.exists(cand):
+            return cand
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            break
+        cur = parent
+    return None
+
+
+def analyze(paths: list[str], passes=None) -> tuple[PackageIndex, list[Finding]]:
+    index = PackageIndex(paths)
+    findings: list[Finding] = []
+    for name, fn in PASSES.items():
+        if passes and name not in passes:
+            continue
+        findings.extend(fn(index))
+    findings = [
+        f for f in findings if not index.ignored(f.file, f.line, f.code)
+    ]
+    findings.sort(key=lambda f: (f.file, f.line, f.code, f.detail))
+    return index, findings
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="graftlint",
+        description="concurrency static analysis for the ray_tpu runtime "
+        "(loop affinity / blocking-in-async / lock order)",
+    )
+    parser.add_argument("paths", nargs="*", default=None, help="files/dirs to analyze")
+    parser.add_argument(
+        "--baseline",
+        default=None,
+        help="baseline json (default: nearest graftlint_baseline.json above "
+        "the analyzed path)",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true", help="report baselined findings too"
+    )
+    parser.add_argument(
+        "--write-baseline",
+        metavar="PATH",
+        default=None,
+        help="write ALL current findings to PATH as the new baseline",
+    )
+    parser.add_argument(
+        "--passes",
+        default=None,
+        help="comma-separated subset of passes (affinity,blocking,lockorder)",
+    )
+    parser.add_argument(
+        "--stats", action="store_true", help="print per-pass violation counts"
+    )
+    parser.add_argument(
+        "--fix-annotations",
+        action="store_true",
+        help="report unannotated functions whose affinity is implied by how "
+        "they are scheduled (suggested @loop_only/@any_thread sites)",
+    )
+    args = parser.parse_args(argv)
+
+    paths = args.paths or ["ray_tpu"]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"graftlint: no such path: {p}", file=sys.stderr)
+            return 2
+    passes = set(args.passes.split(",")) if args.passes else None
+    if passes and passes - set(PASSES):
+        print(f"graftlint: unknown passes: {sorted(passes - set(PASSES))}",
+              file=sys.stderr)
+        return 2
+
+    t0 = time.monotonic()
+    index, findings = analyze(paths, passes)
+    for err in index.errors:
+        print(f"graftlint: parse error: {err}", file=sys.stderr)
+
+    if args.write_baseline:
+        write_baseline(args.write_baseline, findings)
+        print(
+            f"graftlint: wrote {len({f.key for f in findings})} baseline "
+            f"entries to {args.write_baseline}"
+        )
+        return 0
+
+    baseline_path = args.baseline or default_baseline_path(paths[0])
+    baseline = set() if args.no_baseline else load_baseline(baseline_path or "")
+    apply_baseline(findings, baseline)
+
+    new = [f for f in findings if not f.baselined]
+    for f in findings if args.no_baseline else new:
+        print(f.render())
+
+    if args.fix_annotations:
+        suggestions = affinity.suggest_annotations(index)
+        if suggestions:
+            print(f"\n--fix-annotations: {len(suggestions)} suggestion(s)")
+            for s in suggestions:
+                print("  " + s)
+
+    if args.stats:
+        nfiles = len(index.modules)
+        nfuncs = len(index.by_key)
+        print(
+            f"\ngraftlint: {nfiles} files, {nfuncs} functions, "
+            f"{time.monotonic() - t0:.2f}s"
+            + (f", baseline: {baseline_path}" if baseline_path else "")
+        )
+        for name in PASSES:
+            sub = [f for f in findings if f.pass_name == name]
+            nsub = [f for f in sub if not f.baselined]
+            by_code: dict[str, int] = {}
+            for f in sub:
+                by_code[f.code] = by_code.get(f.code, 0) + 1
+            codes = ", ".join(f"{c}={n}" for c, n in sorted(by_code.items()))
+            print(
+                f"  {name}: {len(sub)} finding(s), {len(nsub)} new"
+                + (f" ({codes})" if codes else "")
+            )
+
+    if new:
+        print(
+            f"\ngraftlint: {len(new)} new violation(s)"
+            + (f" ({len(findings) - len(new)} baselined)" if baseline else ""),
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,602 @@
+"""AST index shared by the graftlint passes.
+
+Parses every ``.py`` file under the analyzed roots (never imports them) and
+records, per function: markers (``@loop_only``/``@any_thread``/``@blocking``),
+call sites with receiver text, threadsafe-hop and thread-spawn targets, lock
+``with``-blocks, and awaits. The passes (affinity/blocking/lockorder) consume
+this index; resolution of call sites to functions lives in resolve().
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+MARKERS = {"loop_only", "any_thread", "blocking"}
+
+# Constructs that schedule a callable ONTO an event loop (a legal hop from a
+# foreign thread; the scheduled callee runs in loop context).
+HOP_SCHEDULERS = {"call_soon_threadsafe", "run_coroutine_threadsafe"}
+# Constructs that schedule a coroutine on the CURRENT loop (callee is loop
+# context, caller must already be on the loop — not a cross-thread hop).
+LOOP_SCHEDULERS = {"ensure_future", "create_task", "call_soon", "call_later"}
+# EventLoopThread.run/.spawn wrap run_coroutine_threadsafe; recognized via
+# receiver hints (see HINTS) so e.g. subprocess.run is not misread.
+IO_SCHEDULERS = {"run", "spawn"}
+IO_RECEIVER_RE = re.compile(r"(^|\.)_?io$|_io\b|io_loop|loop_thread", re.IGNORECASE)
+
+LOCKISH_RE = re.compile(r"lock|cond|mutex", re.IGNORECASE)
+
+_IGNORE_RE = re.compile(r"#\s*graftlint:\s*ignore\[([a-z0-9_,\- ]+)\]")
+
+
+@dataclass
+class CallSite:
+    name: str          # simple callee name
+    receiver: str      # unparsed receiver expression text ("" = bare name)
+    lineno: int
+    awaited: bool = False
+    arg_of_awaited: bool = False
+    held_locks: tuple = ()  # lock ids held at this call site (lexically)
+
+
+@dataclass
+class WithLock:
+    lock_id: str
+    lineno: int
+    is_async_ctx: bool  # `async with` (asyncio lock) — informational only
+
+
+@dataclass
+class FunctionInfo:
+    key: str            # f"{relpath}::{qualname}"
+    relpath: str
+    qualname: str
+    name: str
+    cls: str | None
+    lineno: int
+    is_async: bool
+    markers: set = field(default_factory=set)
+    calls: list = field(default_factory=list)       # [CallSite]
+    hop_targets: list = field(default_factory=list)     # [(name, receiver, lineno)]
+    thread_targets: list = field(default_factory=list)  # [(name, receiver, lineno)]
+    hop_sites: list = field(default_factory=list)       # [(kind, lineno)] threadsafe hops USED
+    direct_locks: set = field(default_factory=set)      # lock ids acquired in this body
+    lock_edges: list = field(default_factory=list)      # [(outer_id, inner_id, lineno)]
+    awaits_under: list = field(default_factory=list)    # [(lock_ids, lineno)] await w/ sync lock held
+    nested: dict = field(default_factory=dict)          # simple name -> FunctionInfo
+
+
+@dataclass
+class ModuleInfo:
+    relpath: str
+    stem: str
+    functions: dict = field(default_factory=dict)   # qualname -> FunctionInfo
+    toplevel: dict = field(default_factory=dict)    # name -> FunctionInfo
+    classes: dict = field(default_factory=dict)     # cls -> {meth: FunctionInfo}
+    bases: dict = field(default_factory=dict)       # cls -> [base-name]
+    imports: dict = field(default_factory=dict)     # local name -> dotted module
+    from_imports: dict = field(default_factory=dict)  # local name -> (module, orig)
+    sync_locks: dict = field(default_factory=dict)  # f"{cls}.{attr}"/f"{stem}.{name}" -> "Lock"/"RLock"/...
+    async_locks: set = field(default_factory=set)   # ids assigned from asyncio.*
+    ignores: dict = field(default_factory=dict)     # lineno -> set(codes)
+
+
+def _expr_text(node) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse is total on valid trees
+        return "?"
+
+
+def _callee_parts(call: ast.Call) -> tuple[str, str]:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr, _expr_text(f.value)
+    if isinstance(f, ast.Name):
+        return f.id, ""
+    return "", _expr_text(f)
+
+
+def _callable_ref(node) -> tuple[str, str] | None:
+    """(name, receiver) for a callable reference passed as an argument."""
+    # functools.partial(f, ...) / lambda wrappers around a single call
+    if isinstance(node, ast.Call):
+        name, _ = _callee_parts(node)
+        if name == "partial" and node.args:
+            return _callable_ref(node.args[0])
+        return _callee_parts(node)  # e.g. run_coroutine_threadsafe(self._foo(...))
+    if isinstance(node, ast.Attribute):
+        return node.attr, _expr_text(node.value)
+    if isinstance(node, ast.Name):
+        return node.id, ""
+    return None
+
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+
+
+class _ModuleVisitor(ast.NodeVisitor):
+    def __init__(self, relpath: str, src: str):
+        self.mod = ModuleInfo(relpath=relpath, stem=os.path.basename(relpath)[:-3])
+        for i, line in enumerate(src.splitlines(), 1):
+            m = _IGNORE_RE.search(line)
+            if m:
+                self.mod.ignores[i] = {c.strip() for c in m.group(1).split(",")}
+        self._cls_stack: list[str] = []
+        self._fn_stack: list[FunctionInfo] = []
+
+    # ---- imports ----
+
+    def visit_Import(self, node):
+        for a in node.names:
+            self.mod.imports[a.asname or a.name.split(".")[0]] = a.name
+
+    def visit_ImportFrom(self, node):
+        if node.module:
+            for a in node.names:
+                self.mod.from_imports[a.asname or a.name] = (node.module, a.name)
+
+    # ---- classes / functions ----
+
+    def visit_ClassDef(self, node):
+        self._cls_stack.append(node.name)
+        self.mod.classes.setdefault(node.name, {})
+        self.mod.bases[node.name] = [
+            b.id if isinstance(b, ast.Name) else getattr(b, "attr", "")
+            for b in node.bases
+        ]
+        self.generic_visit(node)
+        self._cls_stack.pop()
+
+    def _enter_function(self, node, is_async: bool):
+        if self._fn_stack:
+            cls = self._fn_stack[-1].cls  # nested def keeps the method's class
+        elif self._cls_stack:
+            cls = self._cls_stack[-1]
+        else:
+            cls = None
+        if self._fn_stack:
+            qual = f"{self._fn_stack[-1].qualname}.<locals>.{node.name}"
+        elif cls:
+            qual = f"{cls}.{node.name}"
+        else:
+            qual = node.name
+        fi = FunctionInfo(
+            key=f"{self.mod.relpath}::{qual}",
+            relpath=self.mod.relpath,
+            qualname=qual,
+            name=node.name,
+            cls=cls,
+            lineno=node.lineno,
+            is_async=is_async,
+        )
+        for dec in node.decorator_list:
+            ref = _callable_ref(dec)
+            if ref and ref[0] in MARKERS:
+                fi.markers.add(ref[0])
+        self.mod.functions[qual] = fi
+        if self._fn_stack:
+            self._fn_stack[-1].nested[node.name] = fi
+        elif cls:
+            self.mod.classes[cls][node.name] = fi
+        else:
+            self.mod.toplevel[node.name] = fi
+        self._fn_stack.append(fi)
+        _BodyVisitor(self, fi).run(node)
+        # Descend into NESTED function definitions (the body visitor skipped
+        # them); their call sites belong to their own FunctionInfo. The parent
+        # stays on the stack so nested qualnames get the <locals> prefix.
+        for child in node.body:
+            self._recurse_defs(child)
+        self._fn_stack.pop()
+
+    def _recurse_defs(self, node):
+        if isinstance(node, ast.FunctionDef):
+            self._enter_function(node, is_async=False)
+            return
+        if isinstance(node, ast.AsyncFunctionDef):
+            self._enter_function(node, is_async=True)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._recurse_defs(child)
+
+    def visit_FunctionDef(self, node):
+        self._enter_function(node, is_async=False)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._enter_function(node, is_async=True)
+
+    # ---- lock classification (self.X = threading.Lock() / asyncio.Lock()) ----
+
+    def note_lock_assign(self, target, value, cls: str | None):
+        if not isinstance(value, ast.Call):
+            return
+        name, recv = _callee_parts(value)
+        if name not in _LOCK_CTORS:
+            return
+        if isinstance(target, ast.Attribute) and isinstance(target.value, ast.Name) \
+                and target.value.id in ("self", "cls") and cls:
+            lock_id = f"{cls}.{target.attr}"
+        elif isinstance(target, ast.Name) and not self._cls_stack:
+            lock_id = f"{self.mod.stem}.{target.id}"
+        elif isinstance(target, ast.Name) and self._cls_stack:
+            lock_id = f"{self._cls_stack[-1]}.{target.id}"
+        else:
+            return
+        if recv == "asyncio" or self.mod.imports.get(recv) == "asyncio":
+            self.mod.async_locks.add(lock_id)
+        else:
+            self.mod.sync_locks[lock_id] = name
+
+    def visit_Assign(self, node):
+        cls = self._cls_stack[-1] if self._cls_stack else None
+        for t in node.targets:
+            self.note_lock_assign(t, node.value, cls)
+        self.generic_visit(node)
+
+
+class _BodyVisitor(ast.NodeVisitor):
+    """Visits ONE function body; does not descend into nested defs/lambdas."""
+
+    def __init__(self, mv: _ModuleVisitor, fi: FunctionInfo):
+        self.mv = mv
+        self.fi = fi
+        self._scheduled: set = set()   # Call node ids consumed by hop wrappers
+        self._await_args: set = set()  # Call node ids that are args of awaited calls
+        self._awaited: set = set()     # Call node ids directly awaited
+        self._held: list[str] = []
+
+    def run(self, node):
+        for child in node.body:
+            self.visit(child)
+
+    # never descend into nested defs / lambdas — separate bodies
+    def visit_FunctionDef(self, node):
+        cls = self.mv._cls_stack[-1] if self.mv._cls_stack else None
+        for t in [n for n in ast.walk(node) if isinstance(n, ast.Assign)]:
+            for tgt in t.targets:
+                self.mv.note_lock_assign(tgt, t.value, cls)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+    # function-local imports feed the same module-level resolution maps
+    def visit_Import(self, node):
+        self.mv.visit_Import(node)
+
+    def visit_ImportFrom(self, node):
+        self.mv.visit_ImportFrom(node)
+
+    def visit_Assign(self, node):
+        cls = self.mv._cls_stack[-1] if self.mv._cls_stack else None
+        for t in node.targets:
+            self.mv.note_lock_assign(t, node.value, cls)
+        self.generic_visit(node)
+
+    def visit_Await(self, node):
+        if isinstance(node.value, ast.Call):
+            self._awaited.add(id(node.value))
+            for arg in list(node.value.args) + [k.value for k in node.value.keywords]:
+                if isinstance(arg, ast.Call):
+                    self._await_args.add(id(arg))
+        if self._held:
+            self.fi.awaits_under.append((tuple(self._held), node.lineno))
+        self.generic_visit(node)
+
+    # ---- locks ----
+
+    def _lock_id_for(self, expr) -> str | None:
+        text = _expr_text(expr)
+        cls = self.fi.cls
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
+                and expr.value.id in ("self", "cls"):
+            lock_id = f"{cls}.{expr.attr}" if cls else f"{self.mv.mod.stem}.{expr.attr}"
+            if lock_id in self.mv.mod.async_locks:
+                return None
+            if lock_id in self.mv.mod.sync_locks or LOCKISH_RE.search(expr.attr):
+                return lock_id
+            return None
+        if isinstance(expr, ast.Name):
+            mod_id = f"{self.mv.mod.stem}.{expr.id}"
+            if mod_id in self.mv.mod.async_locks:
+                return None
+            if mod_id in self.mv.mod.sync_locks:
+                return mod_id
+            if LOCKISH_RE.search(expr.id):
+                return mod_id
+            return None
+        if isinstance(expr, ast.Attribute):
+            # Class-level / foreign-object locks: Cls._instance_lock etc.
+            base = _expr_text(expr.value)
+            cand = f"{base}.{expr.attr}"
+            if cand in self.mv.mod.sync_locks:
+                return cand
+            for c in self.mv.mod.classes:
+                if base == c and f"{c}.{expr.attr}" in self.mv.mod.sync_locks:
+                    return f"{c}.{expr.attr}"
+            if LOCKISH_RE.search(expr.attr):
+                return f"{self.fi.cls or self.mv.mod.stem}.{expr.attr}"
+            return None
+        if LOCKISH_RE.search(text):
+            norm = re.sub(r"""['"\s]""", "", text).replace("self.", "")
+            return f"{self.fi.cls or self.mv.mod.stem}.{norm}"
+        return None
+
+    def _visit_with(self, node, is_async: bool):
+        ids = []
+        for item in node.items:
+            lock_id = None if is_async else self._lock_id_for(item.context_expr)
+            if lock_id is not None:
+                for outer in self._held:
+                    self.fi.lock_edges.append((outer, lock_id, node.lineno))
+                ids.append(lock_id)
+                self.fi.direct_locks.add(lock_id)
+            if isinstance(item.context_expr, ast.Call):
+                self.visit(item.context_expr)
+        self._held.extend(ids)
+        for child in node.body:
+            self.visit(child)
+        for _ in ids:
+            self._held.pop()
+
+    def visit_With(self, node):
+        self._visit_with(node, is_async=False)
+
+    def visit_AsyncWith(self, node):
+        self._visit_with(node, is_async=True)
+
+    # ---- calls ----
+
+    def visit_Call(self, node):
+        name, receiver = _callee_parts(node)
+        is_io_recv = bool(IO_RECEIVER_RE.search(receiver)) if receiver else False
+        if name in HOP_SCHEDULERS and node.args:
+            ref = _callable_ref(node.args[0])
+            if ref:
+                self.fi.hop_targets.append((ref[0], ref[1], node.lineno))
+            if isinstance(node.args[0], ast.Call):
+                self._scheduled.add(id(node.args[0]))
+            self.fi.hop_sites.append((name, node.lineno))
+        elif name in LOOP_SCHEDULERS and node.args:
+            arg = node.args[-1] if name == "call_later" else node.args[0]
+            ref = _callable_ref(arg)
+            if ref:
+                self.fi.hop_targets.append((ref[0], ref[1], node.lineno))
+            if isinstance(arg, ast.Call):
+                self._scheduled.add(id(arg))
+        elif name in IO_SCHEDULERS and is_io_recv and node.args:
+            ref = _callable_ref(node.args[0])
+            if ref:
+                self.fi.hop_targets.append((ref[0], ref[1], node.lineno))
+            if isinstance(node.args[0], ast.Call):
+                self._scheduled.add(id(node.args[0]))
+        elif name == "run_in_executor" and len(node.args) >= 2:
+            ref = _callable_ref(node.args[1])
+            if ref:
+                self.fi.thread_targets.append((ref[0], ref[1], node.lineno))
+            if isinstance(node.args[1], ast.Call):
+                self._scheduled.add(id(node.args[1]))
+        elif name == "Thread" and receiver in ("", "threading"):
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    ref = _callable_ref(kw.value)
+                    if ref:
+                        self.fi.thread_targets.append((ref[0], ref[1], node.lineno))
+        elif name in ("submit", "submit_callback") and node.args:
+            refs = [node.args[0]]
+            if name == "submit_callback" and len(node.args) >= 3:
+                refs.append(node.args[2])  # the delivery callback runs on the
+                # exec thread too
+            for r in refs:
+                ref = _callable_ref(r)
+                if ref:
+                    self.fi.thread_targets.append((ref[0], ref[1], node.lineno))
+        if id(node) not in self._scheduled and name:
+            self.fi.calls.append(
+                CallSite(
+                    name=name,
+                    receiver=receiver,
+                    lineno=node.lineno,
+                    awaited=id(node) in self._awaited,
+                    arg_of_awaited=id(node) in self._await_args,
+                    held_locks=tuple(self._held),
+                )
+            )
+        self.generic_visit(node)
+
+
+class PackageIndex:
+    """All modules under the analyzed roots, plus cross-module resolution."""
+
+    def __init__(self, roots: list[str], exclude: tuple[str, ...] = ("__pycache__",)):
+        self.roots = [os.path.abspath(r) for r in roots]
+        self.base = (
+            os.path.dirname(self.roots[0])
+            if os.path.isdir(self.roots[0])
+            else os.getcwd()
+        )
+        self.modules: dict[str, ModuleInfo] = {}
+        self.errors: list[str] = []
+        for path in self._iter_files(exclude):
+            rel = os.path.relpath(path, self.base)
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    src = f.read()
+                tree = ast.parse(src)
+            except (SyntaxError, UnicodeDecodeError, OSError) as e:
+                self.errors.append(f"{rel}: {e}")
+                continue
+            mv = _ModuleVisitor(rel, src)
+            mv.visit(tree)
+            self.modules[rel] = mv.mod
+        # name -> [FunctionInfo] (marked functions only: the cross-object
+        # resolution set — precise where it matters, silent elsewhere)
+        self.marked_by_name: dict[str, list[FunctionInfo]] = {}
+        self.by_key: dict[str, FunctionInfo] = {}
+        self.class_methods: dict[str, dict] = {}  # cls -> {meth: FI} package-wide
+        for mod in self.modules.values():
+            for fi in mod.functions.values():
+                self.by_key[fi.key] = fi
+                if fi.markers:
+                    self.marked_by_name.setdefault(fi.name, []).append(fi)
+            for cls, meths in mod.classes.items():
+                self.class_methods.setdefault(cls, {}).update(meths)
+
+    def _iter_files(self, exclude):
+        for root in self.roots:
+            if os.path.isfile(root):
+                yield root
+                continue
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames[:] = [d for d in dirnames if d not in exclude]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+
+    def module_of(self, fi: FunctionInfo) -> ModuleInfo:
+        return self.modules[fi.relpath]
+
+    def all_functions(self):
+        for mod in self.modules.values():
+            yield from mod.functions.values()
+
+    def ignored(self, relpath: str, lineno: int, code: str) -> bool:
+        mod = self.modules.get(relpath)
+        if mod is None:
+            return False
+        codes = mod.ignores.get(lineno)
+        return codes is not None and (code in codes or "all" in codes)
+
+
+# ---------------------------------------------------------------------------
+# Call-site resolution
+# ---------------------------------------------------------------------------
+
+# Method names too generic to resolve package-wide by name alone: an edge is
+# only drawn when the receiver text passes the hint for the marked target.
+# Hints match as standalone identifiers within the receiver expression, so
+# ``self._workers.get(id)`` (a dict lookup) never resolves to CoreWorker.get
+# while ``self.cw.get(...)`` does.
+RECEIVER_HINTS = {
+    "call": ("gcs", "raylet", "client", "owner"),
+    "push": ("gcs", "raylet", "client", "owner"),
+    "run": ("_io", "io"),
+    "spawn": ("_io", "io"),
+    "get": ("cw", "core_worker", "get_core_worker"),
+    "put": ("cw", "core_worker", "get_core_worker"),
+    "wait": ("cw", "core_worker", "get_core_worker"),
+    "submit": ("lease_mgr", "lease_manager", "get_lease_manager"),
+}
+# Generic names that must NEVER resolve package-wide without a hint entry.
+NEVER_GLOBAL = {"close", "start", "stop", "cancel", "send", "write", "read", "main"}
+
+
+def _receiver_tail(receiver: str) -> str:
+    """Final attribute component of a receiver expression: dots inside
+    parens/brackets don't split (``self._owner_client(tuple(a.b))`` ->
+    ``_owner_client(tuple(a.b))``; ``self.cw.pending_tasks`` ->
+    ``pending_tasks``)."""
+    depth = 0
+    last = 0
+    for i, ch in enumerate(receiver):
+        if ch in "([{":
+            depth += 1
+        elif ch in ")]}":
+            depth -= 1
+        elif ch == "." and depth == 0:
+            last = i + 1
+    return receiver[last:]
+
+
+def _hint_ok(name: str, receiver: str) -> bool:
+    hints = RECEIVER_HINTS.get(name)
+    if hints is None:
+        return name not in NEVER_GLOBAL
+    tail = _receiver_tail(receiver).lower()
+    return any(
+        re.search(rf"(^|[._(\s_]){re.escape(h)}($|[._(\s)_])", tail) for h in hints
+    )
+
+
+def resolve_call(
+    index: "PackageIndex",
+    caller: FunctionInfo,
+    name: str,
+    receiver: str,
+    local_only: bool = False,
+) -> FunctionInfo | None:
+    """Best-effort: the FunctionInfo a call site refers to, or None.
+
+    Resolution order: nested defs of the caller, bare module-level names,
+    ``self.``/``cls.`` methods (following in-package base classes), imported
+    module attributes — then, unless ``local_only``, package-wide resolution
+    into the MARKED function set by unique method name + receiver hint."""
+    mod = index.module_of(caller)
+    if receiver == "":
+        cur = caller
+        while cur is not None:
+            if name in cur.nested:
+                return cur.nested[name]
+            parent_qual = cur.qualname.rsplit(".<locals>.", 1)[0]
+            cur = mod.functions.get(parent_qual) if ".<locals>." in cur.qualname else None
+        if name in mod.toplevel:
+            return mod.toplevel[name]
+        imp = mod.from_imports.get(name)
+        if imp is not None:
+            target_mod = _find_module(index, imp[0])
+            if target_mod is not None:
+                return target_mod.toplevel.get(imp[1])
+        return None
+    if receiver in ("self", "cls") and caller.cls:
+        seen = set()
+        queue = [caller.cls]
+        while queue:
+            cls = queue.pop(0)
+            if cls in seen:
+                continue
+            seen.add(cls)
+            meths = index.class_methods.get(cls, {})
+            if name in meths:
+                return meths[name]
+            for mod2 in index.modules.values():
+                for base in mod2.bases.get(cls, []):
+                    if base:
+                        queue.append(base)
+        return None
+    # module-attribute call (import ray_tpu; ray_tpu.get(...))
+    dotted = mod.imports.get(receiver)
+    if dotted is not None:
+        target_mod = _find_module(index, dotted)
+        if target_mod is not None:
+            return target_mod.toplevel.get(name)
+    if local_only:
+        return None
+    candidates = index.marked_by_name.get(name, [])
+    if len({c.key for c in candidates}) == 1 and _hint_ok(name, receiver):
+        return candidates[0]
+    if len(candidates) > 1:
+        hinted = [c for c in candidates if _hint_ok(name, receiver)]
+        if len({c.key for c in hinted}) == 1:
+            return hinted[0]
+    return None
+
+
+def _find_module(index: "PackageIndex", dotted: str):
+    """ModuleInfo for a dotted import path, if it lives under the roots."""
+    rel_pkg = dotted.replace(".", os.sep)
+    for cand in (rel_pkg + ".py", os.path.join(rel_pkg, "__init__.py")):
+        if cand in index.modules:
+            return index.modules[cand]
+    # Roots may be nested differently (e.g. analyzing a fixture dir): match
+    # by suffix.
+    for rel, mod in index.modules.items():
+        if rel.endswith(rel_pkg + ".py") or rel.endswith(
+            os.path.join(rel_pkg, "__init__.py")
+        ):
+            return mod
+    return None

@@ -1,0 +1,68 @@
+"""Finding record + baseline file handling."""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Finding:
+    pass_name: str   # "affinity" | "blocking" | "lockorder"
+    code: str        # stable violation code, e.g. "affinity-leak"
+    file: str        # repo-relative path of the violating site
+    line: int
+    symbol: str      # enclosing function qualname (or lock-cycle id)
+    detail: str      # target qualname / lock id / callee — part of the key
+    message: str = ""
+    baselined: bool = field(default=False, compare=False)
+
+    @property
+    def key(self) -> str:
+        # Line numbers are deliberately NOT part of the key: refactors move
+        # code; a baseline entry tracks the violation, not its coordinates.
+        return f"{self.pass_name}:{self.file}:{self.symbol}:{self.code}:{self.detail}"
+
+    def render(self) -> str:
+        mark = " [baselined]" if self.baselined else ""
+        return (
+            f"{self.file}:{self.line}: [{self.pass_name}/{self.code}]{mark} "
+            f"{self.message}"
+        )
+
+
+def load_baseline(path: str) -> set[str]:
+    if not path or not os.path.exists(path):
+        return set()
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return {e["key"] for e in data.get("entries", [])}
+
+
+def write_baseline(path: str, findings: list[Finding]) -> None:
+    entries = sorted(
+        {f.key: f for f in findings}.values(), key=lambda f: f.key
+    )
+    data = {
+        "version": 1,
+        "comment": (
+            "graftlint suppression baseline: committed findings that predate "
+            "the linter or whose fix is risky enough to deserve its own PR. "
+            "CI fails only on NEW violations. Never baseline the warm-lease "
+            "hot path (_private/rpc.py, _private/lease_manager.py, "
+            "_private/worker_main.py)."
+        ),
+        "entries": [
+            {"key": f.key, "message": f.message} for f in entries
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=False)
+        f.write("\n")
+
+
+def apply_baseline(findings: list[Finding], baseline: set[str]) -> None:
+    for f in findings:
+        if f.key in baseline:
+            f.baselined = True

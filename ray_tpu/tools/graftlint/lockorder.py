@@ -1,0 +1,195 @@
+"""Pass 3 — sync-lock order.
+
+Extracts the ``with <lock>:`` nesting relation per class/module into a
+global lock-order graph:
+
+- direct nesting (``with A: with B:``) gives an A→B edge;
+- interprocedural edges via per-function acquired-lock summaries computed to
+  a fixpoint over locally-resolvable calls (``self.x()``, same-module
+  functions): holding A and calling a function that (transitively) takes B
+  also gives A→B;
+- cycles in the graph are potential deadlocks (``lock-cycle``);
+- an A→A edge on a non-reentrant ``Lock`` is a self-deadlock
+  (``lock-self-nest``); documented RLocks are exempt;
+- an ``await`` lexically reachable while a sync lock is held parks the ONLY
+  thread that can release it (``await-under-lock``).
+"""
+
+from __future__ import annotations
+
+from ray_tpu.tools.graftlint.core import PackageIndex, resolve_call
+from ray_tpu.tools.graftlint.findings import Finding
+
+PASS = "lockorder"
+
+
+def run(index: PackageIndex) -> list[Finding]:
+    findings: list[Finding] = []
+
+    # ---- per-function transitive acquired-lock summaries (fixpoint) ------
+    acquired: dict[str, set] = {
+        fi.key: set(fi.direct_locks) for fi in index.all_functions()
+    }
+    callees: dict[str, list] = {}
+    for fi in index.all_functions():
+        resolved = []
+        for cs in fi.calls:
+            target = resolve_call(index, fi, cs.name, cs.receiver, local_only=True)
+            if target is not None and target.key != fi.key:
+                resolved.append((cs, target))
+        callees[fi.key] = resolved
+    for _ in range(6):  # call chains deeper than 6 don't exist here
+        changed = False
+        for key, pairs in callees.items():
+            acc = acquired[key]
+            before = len(acc)
+            for _cs, target in pairs:
+                acc |= acquired[target.key]
+            changed = changed or len(acc) != before
+        if not changed:
+            break
+
+    # ---- edges: direct nesting + held-at-call-site × callee summary ------
+    # edge -> (file, line, via-symbol)
+    edges: dict[tuple, tuple] = {}
+    rlocks = set()
+    for mod in index.modules.values():
+        for lock_id, ctor in mod.sync_locks.items():
+            if ctor == "RLock":
+                rlocks.add(lock_id)
+    for fi in index.all_functions():
+        for outer, inner, lineno in fi.lock_edges:
+            edges.setdefault((outer, inner), (fi.relpath, lineno, fi.qualname))
+        for cs, target in callees[fi.key]:
+            for inner in acquired[target.key]:
+                for outer in cs.held_locks:
+                    edges.setdefault(
+                        (outer, inner),
+                        (fi.relpath, cs.lineno, f"{fi.qualname} -> {target.qualname}"),
+                    )
+
+    # ---- self-nesting of non-reentrant locks -----------------------------
+    for (outer, inner), (relpath, lineno, symbol) in sorted(edges.items()):
+        if outer == inner and outer not in rlocks:
+            findings.append(
+                Finding(
+                    pass_name=PASS,
+                    code="lock-self-nest",
+                    file=relpath,
+                    line=lineno,
+                    symbol=symbol,
+                    detail=outer,
+                    message=(
+                        f"{outer} is re-acquired while already held (via "
+                        f"{symbol}); threading.Lock self-deadlocks — use an "
+                        "RLock or split the critical section"
+                    ),
+                )
+            )
+
+    # ---- cycles (Tarjan SCC over the lock graph) -------------------------
+    graph: dict[str, set] = {}
+    for (outer, inner) in edges:
+        if outer != inner:
+            graph.setdefault(outer, set()).add(inner)
+            graph.setdefault(inner, set())
+    for scc in _sccs(graph):
+        if len(scc) < 2:
+            continue
+        cyc = sorted(scc)
+        sites = [
+            f"{relpath}:{lineno} ({symbol})"
+            for (o, i), (relpath, lineno, symbol) in sorted(edges.items())
+            if o in scc and i in scc
+        ]
+        relpath, lineno, _ = next(
+            v for (o, i), v in sorted(edges.items()) if o in scc and i in scc
+        )
+        findings.append(
+            Finding(
+                pass_name=PASS,
+                code="lock-cycle",
+                file=relpath,
+                line=lineno,
+                symbol="<cycle>",
+                detail="<->".join(cyc),
+                message=(
+                    "lock-order cycle (potential deadlock): "
+                    + " <-> ".join(cyc)
+                    + "; acquisition sites: "
+                    + "; ".join(sites[:6])
+                ),
+            )
+        )
+
+    # ---- await while holding a sync lock ---------------------------------
+    for fi in index.all_functions():
+        if not fi.is_async:
+            continue
+        for lock_ids, lineno in fi.awaits_under:
+            findings.append(
+                Finding(
+                    pass_name=PASS,
+                    code="await-under-lock",
+                    file=fi.relpath,
+                    line=lineno,
+                    symbol=fi.qualname,
+                    detail=",".join(lock_ids),
+                    message=(
+                        f"await in {fi.qualname} while holding sync lock(s) "
+                        f"{', '.join(lock_ids)}: parks the loop thread inside "
+                        "the critical section — every other acquirer (any "
+                        "thread) blocks until this coroutine resumes"
+                    ),
+                )
+            )
+    return findings
+
+
+def _sccs(graph: dict[str, set]):
+    """Tarjan strongly-connected components (iterative)."""
+    index_counter = [0]
+    stack: list[str] = []
+    lowlink: dict[str, int] = {}
+    number: dict[str, int] = {}
+    on_stack: set = set()
+    result = []
+
+    for start in graph:
+        if start in number:
+            continue
+        work = [(start, iter(sorted(graph.get(start, ()))))]
+        number[start] = lowlink[start] = index_counter[0]
+        index_counter[0] += 1
+        stack.append(start)
+        on_stack.add(start)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in number:
+                    number[nxt] = lowlink[nxt] = index_counter[0]
+                    index_counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(sorted(graph.get(nxt, ())))))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    lowlink[node] = min(lowlink[node], number[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                lowlink[parent] = min(lowlink[parent], lowlink[node])
+            if lowlink[node] == number[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                result.append(comp)
+    return result

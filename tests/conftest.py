@@ -21,6 +21,14 @@ os.environ.setdefault("RAY_TPU_NUM_TPUS", "0")
 # environment sitecustomize may force jax_platforms to a TPU plugin, and a
 # config update is the only override that wins (env vars are read before it).
 os.environ["RAY_TPU_JAX_CONFIG_PLATFORMS"] = "cpu"
+# Dynamic backup for the graftlint static affinity checks: @loop_only /
+# @blocking markers (ray_tpu/_private/concurrency.py) install cheap runtime
+# asserts when this is set BEFORE first import. Driven by the lease/worker
+# test modules (test_leases, test_basic, test_actors, test_cancel, ...);
+# enabled process-wide because marker behavior binds at import and the suite
+# shares one interpreter — worker subprocesses inherit it, so the asserts
+# also run inside every spawned worker's IO loop and exec thread.
+os.environ.setdefault("RAY_TPU_DEBUG_AFFINITY", "1")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -1,0 +1,164 @@
+// In-process TSAN hammer for the shared native wire structs
+// (cpp/ray_tpu_wire.h) under the warm-lease teardown race: the r6 fast path
+// made completion delivery a synchronous frame write on a warm connection,
+// so the failure mode that matters is a peer RESETTING the connection while
+// a frame is mid-write. Two phases:
+//
+//   1. socketpair: a writer thread streams length-prefixed frames
+//      (send_all+frame — the worker's completion writer) while the reader
+//      validates a few frames for integrity (length + fill byte: a torn
+//      write surfaces as a mismatch) and then closes its end mid-stream.
+//      send_all must surface EPIPE as an exception (MSG_NOSIGNAL), never a
+//      process-killing SIGPIPE.
+//   2. loopback TCP: blocking RpcClients issue calls against a server that
+//      acks most requests but hard-resets every third connection mid-RPC;
+//      call() must either return the valid response or throw — no hangs, no
+//      races on teardown.
+//
+// Built with -fsanitize=thread by tests/test_native_races.py; any data race
+// aborts the run (halt_on_error=1). Prints HAMMER_OK on a clean pass.
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+
+#include "ray_tpu_wire.h"
+
+static std::atomic<uint64_t> g_frames{0}, g_resets{0}, g_calls{0};
+
+static bool run_stream_round(int round) {
+  int sv[2];
+  if (socketpair(AF_UNIX, SOCK_STREAM, 0, sv) != 0) return false;
+  const size_t payload_len = (size_t)(round % 37) * 113 + 64;
+  const char fill = (char)('a' + round % 26);
+  bool ok = true;
+
+  std::thread writer([&] {
+    std::string payload(payload_len, fill);
+    try {
+      for (;;) {
+        rtpu_wire::send_all(sv[0], rtpu_wire::frame(payload));
+        g_frames.fetch_add(1, std::memory_order_relaxed);
+      }
+    } catch (const std::exception&) {
+      // Peer reset mid-stream: the contract is an exception, not SIGPIPE.
+      g_resets.fetch_add(1, std::memory_order_relaxed);
+    }
+  });
+
+  int want = 1 + round % 17;
+  for (int k = 0; k < want; ++k) {
+    char hdr[4];
+    if (!rtpu_wire::read_exact(sv[1], hdr, 4)) break;
+    uint32_t len = ntohl(*(const uint32_t*)hdr);
+    std::string body(len, '\0');
+    if (!rtpu_wire::read_exact(sv[1], &body[0], len)) break;
+    if (len != payload_len || body[0] != fill || body[len - 1] != fill) {
+      printf("TORN FRAME round=%d len=%u want=%zu\n", round, len, payload_len);
+      ok = false;
+      break;
+    }
+  }
+  close(sv[1]);  // connection reset under the concurrent writer
+  writer.join();
+  close(sv[0]);
+  return ok;
+}
+
+int main(int argc, char** argv) {
+  int seconds = argc > 1 ? atoi(argv[1]) : 3;
+  auto deadline = std::chrono::steady_clock::now() + std::chrono::seconds(seconds);
+
+  // ---- phase 1: frame write vs. connection reset ----
+  int round = 0;
+  while (std::chrono::steady_clock::now() < deadline) {
+    if (!run_stream_round(round++)) return 3;
+  }
+
+  // ---- phase 2: RpcClient vs. a resetting server ----
+  int lfd = socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = 0;
+  if (bind(lfd, (sockaddr*)&addr, sizeof(addr)) != 0 || listen(lfd, 16) != 0) {
+    printf("listen failed\n");
+    return 2;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(lfd, (sockaddr*)&addr, &alen);
+  int port = ntohs(addr.sin_port);
+
+  std::atomic<bool> stop{false};
+  std::thread server([&] {
+    int nconn = 0;  // server-thread-local: decides which connections reset
+    while (!stop.load(std::memory_order_relaxed)) {
+      pollfd p{lfd, POLLIN, 0};
+      if (poll(&p, 1, 50) <= 0) continue;
+      int c = accept(lfd, nullptr, nullptr);
+      if (c < 0) continue;
+      ++nconn;
+      for (;;) {
+        char hdr[4];
+        if (!rtpu_wire::read_exact(c, hdr, 4)) break;
+        uint32_t len = ntohl(*(const uint32_t*)hdr);
+        std::string body(len, '\0');
+        if (!rtpu_wire::read_exact(c, &body[0], len)) break;
+        if (nconn % 3 == 0) break;  // hard reset mid-RPC (no reply)
+        try {
+          Unpacker up(body);
+          Value msg = up.decode();
+          Packer pk;
+          pk.array_header(4);
+          pk.integer(1);  // RESPONSE
+          pk.integer(msg.arr.at(1).i);
+          pk.str("ping");
+          pk.map_header(1);
+          pk.str("ok");
+          pk.boolean(true);
+          rtpu_wire::send_all(c, rtpu_wire::frame(pk.out));
+        } catch (const std::exception&) {
+          break;
+        }
+      }
+      close(c);
+    }
+  });
+
+  deadline = std::chrono::steady_clock::now() + std::chrono::seconds(seconds);
+  while (std::chrono::steady_clock::now() < deadline) {
+    try {
+      rtpu_wire::RpcClient client("127.0.0.1", port);
+      Packer payload;
+      payload.map_header(0);
+      for (int k = 0; k < 4; ++k) {
+        Value r = client.call("ping", payload.out);
+        const Value* okf = r.get("ok");
+        if (!okf || !okf->truthy()) {
+          printf("BAD RESPONSE\n");
+          return 3;
+        }
+        g_calls.fetch_add(1, std::memory_order_relaxed);
+      }
+    } catch (const std::exception&) {
+      g_resets.fetch_add(1, std::memory_order_relaxed);  // reset surfaced
+    }
+  }
+  stop.store(true);
+  server.join();
+  close(lfd);
+
+  printf("HAMMER_OK frames=%llu calls=%llu resets=%llu\n",
+         (unsigned long long)g_frames.load(), (unsigned long long)g_calls.load(),
+         (unsigned long long)g_resets.load());
+  return 0;
+}

@@ -1,0 +1,304 @@
+"""graftlint unit tests: synthetic fixture modules with PLANTED concurrency
+bugs, one per pass — a loop-affinity leak, a blocking call in ``async def``,
+an AB/BA lock cycle — asserting each pass catches exactly its bug (and not
+the correct twin right next to it), plus the baseline + pragma suppression
+mechanics and the RAY_TPU_DEBUG_AFFINITY runtime asserts."""
+
+import os
+import textwrap
+
+import pytest
+
+from ray_tpu.tools.graftlint.cli import analyze, main
+from ray_tpu.tools.graftlint.findings import write_baseline
+
+AFFINITY_FIXTURE = """
+    import asyncio
+    import threading
+    import time
+
+    from ray_tpu._private.concurrency import any_thread, blocking, loop_only
+
+
+    class Client:
+        @loop_only
+        def send_frame(self, data):
+            pass
+
+        @blocking
+        def call(self, method):
+            time.sleep(0.1)
+
+
+    class Good:
+        def __init__(self, client, loop):
+            self.client = client
+            self._loop = loop
+
+        @any_thread
+        def submit(self, item):
+            # correct: threadsafe hop onto the loop before touching the
+            # loop-only fast path
+            self._loop.call_soon_threadsafe(self._drain)
+
+        @loop_only
+        def _drain(self):
+            self.client.send_frame(b"x")
+
+        async def handler(self, req):
+            # correct: blocking work leaves the loop
+            loop = asyncio.get_event_loop()
+            return await loop.run_in_executor(None, self.client.call, "m")
+
+
+    class Leaky:
+        def __init__(self, client):
+            self.client = client
+
+        def start(self):
+            threading.Thread(target=self._worker_loop).start()
+
+        def _worker_loop(self):
+            # PLANTED: thread context straight into a @loop_only function
+            self.client.send_frame(b"x")
+
+
+    class DeadlockRisk:
+        def __init__(self, client):
+            self.client = client
+
+        async def rpc_handler(self, req):
+            # PLANTED: @blocking call on the event loop
+            return self.client.call("m")
+
+
+    class Redundant:
+        def __init__(self, loop):
+            self._loop = loop
+
+        @loop_only
+        def _already_on_loop(self):
+            # PLANTED: threadsafe hop from code that is already on the loop
+            self._loop.call_soon_threadsafe(self._noop)
+
+        def _noop(self):
+            pass
+"""
+
+BLOCKING_FIXTURE = """
+    import asyncio
+    import subprocess
+    import time
+
+
+    async def bad_sleep():
+        time.sleep(0.5)  # PLANTED
+        return 1
+
+
+    async def good_sleep():
+        await asyncio.sleep(0.01)
+        return 1
+
+
+    async def bad_wait(ev):
+        ev.wait()  # PLANTED (threading.Event)
+
+
+    async def good_wait(aev):
+        await asyncio.wait_for(aev.wait(), 1.0)  # asyncio idiom: not a block
+
+
+    async def bad_spawn(cmd):
+        subprocess.check_output(cmd)  # PLANTED
+
+
+    async def good_spawn(fn):
+        await asyncio.get_event_loop().run_in_executor(None, fn)
+
+
+    async def allowed_sleep():
+        time.sleep(0.01)  # graftlint: ignore[sleep-in-async] — documented
+"""
+
+LOCK_FIXTURE = """
+    import asyncio
+    import threading
+
+
+    class ABBA:
+        def __init__(self):
+            self._lock_a = threading.Lock()
+            self._lock_b = threading.Lock()
+
+        def a_then_b(self):
+            with self._lock_a:
+                with self._lock_b:
+                    pass
+
+        def b_then_a(self):
+            # PLANTED: reverse order via an interprocedural edge
+            with self._lock_b:
+                self._take_a()
+
+        def _take_a(self):
+            with self._lock_a:
+                pass
+
+
+    class SelfNest:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def outer(self):
+            with self._lock:
+                self._helper()  # PLANTED: re-acquires while held
+
+        def _helper(self):
+            with self._lock:
+                pass
+
+
+    class Ordered:
+        def __init__(self):
+            self._lock_x = threading.Lock()
+            self._lock_y = threading.Lock()
+
+        def fine(self):
+            with self._lock_x:
+                with self._lock_y:
+                    pass
+
+        def also_fine(self):
+            with self._lock_x:
+                pass
+
+        async def bad_await(self):
+            with self._lock_x:
+                await asyncio.sleep(0.1)  # PLANTED: await under sync lock
+"""
+
+
+@pytest.fixture
+def fixture_pkg(tmp_path):
+    pkg = tmp_path / "fixturepkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "aff.py").write_text(textwrap.dedent(AFFINITY_FIXTURE))
+    (pkg / "blk.py").write_text(textwrap.dedent(BLOCKING_FIXTURE))
+    (pkg / "lck.py").write_text(textwrap.dedent(LOCK_FIXTURE))
+    return str(pkg)
+
+
+def _by_code(findings):
+    out = {}
+    for f in findings:
+        out.setdefault(f.code, []).append(f)
+    return out
+
+
+def test_affinity_pass_catches_planted_leak(fixture_pkg):
+    _, findings = analyze([fixture_pkg], passes={"affinity"})
+    by_code = _by_code(findings)
+    leaks = by_code.get("affinity-leak", [])
+    assert len(leaks) == 1, [f.message for f in findings]
+    assert leaks[0].symbol == "Leaky._worker_loop"
+    assert "send_frame" in leaks[0].detail
+    blocked = by_code.get("blocking-on-loop", [])
+    assert len(blocked) == 1, [f.message for f in findings]
+    assert blocked[0].symbol == "DeadlockRisk.rpc_handler"
+    redundant = by_code.get("redundant-hop", [])
+    assert len(redundant) == 1
+    assert redundant[0].symbol == "Redundant._already_on_loop"
+    # the correct twins produced nothing
+    assert not any("Good" in f.symbol for f in findings), [f.message for f in findings]
+
+
+def test_blocking_pass_catches_planted_calls(fixture_pkg):
+    _, findings = analyze([fixture_pkg], passes={"blocking"})
+    symbols = {(f.symbol, f.code) for f in findings}
+    assert ("bad_sleep", "sleep-in-async") in symbols
+    assert ("bad_wait", "sync-wait-in-async") in symbols
+    assert ("bad_spawn", "subprocess-in-async") in symbols
+    # asyncio idioms and the pragma-suppressed sleep stay clean
+    assert not any("good" in s for s, _ in symbols), symbols
+    assert not any(s == "allowed_sleep" for s, _ in symbols)
+    assert len(findings) == 3, [f.message for f in findings]
+
+
+def test_lockorder_pass_catches_cycle_selfnest_and_await(fixture_pkg):
+    _, findings = analyze([fixture_pkg], passes={"lockorder"})
+    by_code = _by_code(findings)
+    cycles = by_code.get("lock-cycle", [])
+    assert len(cycles) == 1, [f.message for f in findings]
+    assert "ABBA._lock_a" in cycles[0].detail and "ABBA._lock_b" in cycles[0].detail
+    self_nest = by_code.get("lock-self-nest", [])
+    assert len(self_nest) == 1
+    assert self_nest[0].detail == "SelfNest._lock"
+    awaits = by_code.get("await-under-lock", [])
+    assert len(awaits) == 1
+    assert awaits[0].symbol == "Ordered.bad_await"
+    # the consistently-ordered Ordered locks are not part of any cycle
+    assert not any("Ordered" in f.detail for f in cycles)
+
+
+def test_baseline_suppresses_only_baselined_findings(fixture_pkg, tmp_path):
+    _, findings = analyze([fixture_pkg])
+    assert findings
+    baseline_path = str(tmp_path / "baseline.json")
+    write_baseline(baseline_path, findings)
+    # with every current finding baselined the CLI exits 0
+    assert main([fixture_pkg, "--baseline", baseline_path]) == 0
+    # a NEW violation still fails, and is the only one reported
+    extra = os.path.join(fixture_pkg, "extra.py")
+    with open(extra, "w") as f:
+        f.write("import time\nasync def fresh():\n    time.sleep(1)\n")
+    assert main([fixture_pkg, "--baseline", baseline_path]) == 1
+    _, findings2 = analyze([fixture_pkg])
+    new_keys = {x.key for x in findings2} - {x.key for x in findings}
+    assert len(new_keys) == 1 and "fresh" in next(iter(new_keys))
+    # --write-baseline + rerun converges back to exit 0
+    write_baseline(baseline_path, findings2)
+    assert main([fixture_pkg, "--baseline", baseline_path]) == 0
+
+
+def test_fix_annotations_suggests_roles(fixture_pkg, capsys):
+    rc = main([fixture_pkg, "--fix-annotations", "--no-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 1  # planted findings still fail
+    # Redundant._noop is a call_soon_threadsafe target without a marker
+    assert "Redundant._noop" in out and "@loop_only" in out
+    # Leaky._worker_loop is a Thread target without a marker
+    assert "Leaky._worker_loop" in out and "@any_thread" in out
+
+
+def test_debug_affinity_runtime_asserts():
+    """Dynamic backup for the static checks: with RAY_TPU_DEBUG_AFFINITY=1
+    (set by tests/conftest.py before ray_tpu import) the markers assert."""
+    from ray_tpu._private import concurrency
+
+    if not concurrency.DEBUG_AFFINITY:
+        pytest.skip("RAY_TPU_DEBUG_AFFINITY not enabled at import time")
+
+    @concurrency.loop_only
+    def on_loop_fn():
+        return "ok"
+
+    @concurrency.blocking
+    def blocking_fn():
+        return "ok"
+
+    # off-loop: loop_only must assert, blocking must pass
+    with pytest.raises(AssertionError, match="loop_only"):
+        on_loop_fn()
+    assert blocking_fn() == "ok"
+
+    # on a running loop: loop_only passes, blocking asserts
+    import asyncio
+
+    async def drive():
+        assert on_loop_fn() == "ok"
+        with pytest.raises(AssertionError, match="blocking"):
+            blocking_fn()
+
+    asyncio.run(drive())

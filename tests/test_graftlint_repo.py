@@ -1,0 +1,65 @@
+"""Tier-1 gate: graftlint over the real ``ray_tpu/`` tree.
+
+Runs the analyzer against the committed ``graftlint_baseline.json`` — any
+NEW concurrency violation (loop-affinity leak, blocking call in async,
+lock-order cycle) fails CI. Pure AST: must finish well under 10s and must
+never import jax (the analyzer parses the tree, it does not execute it)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_graftlint_repo_is_clean_and_fast():
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.tools.graftlint", "ray_tpu", "--stats"],
+        cwd=_REPO,
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 0, (
+        f"graftlint found NEW violations:\n{proc.stdout}\n{proc.stderr}"
+    )
+    assert elapsed < 10.0, f"graftlint took {elapsed:.1f}s (budget 10s)"
+    assert "graftlint:" in proc.stdout  # --stats footer rendered
+
+
+def test_graftlint_never_imports_jax():
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "import sys\n"
+            "from ray_tpu.tools.graftlint.cli import main\n"
+            "rc = main(['ray_tpu'])\n"
+            "assert 'jax' not in sys.modules, 'graftlint must not import jax'\n"
+            "raise SystemExit(rc)",
+        ],
+        cwd=_REPO,
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_baseline_has_no_hot_path_suppressions():
+    """Acceptance: the warm-lease hot path is CLEAN, not suppressed — the
+    baseline must hold zero entries for rpc.py / lease_manager.py /
+    worker_main.py."""
+    with open(os.path.join(_REPO, "graftlint_baseline.json")) as f:
+        data = json.load(f)
+    hot = ("_private/rpc.py", "_private/lease_manager.py", "_private/worker_main.py")
+    offenders = [
+        e["key"]
+        for e in data.get("entries", [])
+        if any(h in e["key"] for h in hot)
+    ]
+    assert not offenders, offenders

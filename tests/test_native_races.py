@@ -120,15 +120,19 @@ def test_tsan_builds_all_native_components(tmp_path):
         assert build.returncode == 0, f"{src} TSAN build failed:\n{build.stderr[-2000:]}"
 
 
-def _tsan_build_and_run(tmp_path, driver_name, src_name, binary_name, seconds="3"):
+def _tsan_build_and_run(tmp_path, driver_name, src_name, binary_name, seconds="3",
+                        include_dirs=()):
     gxx = shutil.which("g++")
     if gxx is None:
         pytest.skip("no g++")
     driver = os.path.join(_HERE, "native", driver_name)
-    src = os.path.join(os.path.dirname(_HERE), "ray_tpu", "_native", src_name)
+    srcs = [driver]
+    if src_name is not None:  # header-only drivers pass src_name=None
+        srcs.append(os.path.join(os.path.dirname(_HERE), "ray_tpu", "_native", src_name))
     binary = str(tmp_path / binary_name)
     build = subprocess.run(
-        [gxx, "-fsanitize=thread", "-O1", "-g", "-std=c++17", driver, src,
+        [gxx, "-fsanitize=thread", "-O1", "-g", "-std=c++17", *srcs,
+         *[f"-I{d}" for d in include_dirs],
          "-o", binary, "-lrt", "-lpthread"],
         capture_output=True, text=True, timeout=300,
     )
@@ -159,3 +163,15 @@ def test_tsan_sched_core_hammer(tmp_path):
     heartbeat view resets, node churn, and PG pool prepare/return; asserts
     availability stays within [0, total] throughout."""
     _tsan_build_and_run(tmp_path, "tsan_sched_core.cc", "sched_core.cc", "tsan_sched")
+
+
+def test_tsan_wire_hammer(tmp_path):
+    """The r6 warm-lease wire structs (cpp/ray_tpu_wire.h: send_all/frame/
+    read_exact/RpcClient) under concurrent frame write vs. connection reset:
+    a torn frame, a SIGPIPE death, a teardown data race, or a hung call()
+    against a resetting peer all fail the run (header-only: the driver
+    includes cpp/ directly)."""
+    _tsan_build_and_run(
+        tmp_path, "tsan_wire.cc", None, "tsan_wire",
+        include_dirs=(os.path.join(os.path.dirname(_HERE), "cpp"),),
+    )
