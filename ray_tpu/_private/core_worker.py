@@ -207,6 +207,12 @@ class CoreWorker:
         self._hop_log: collections.deque = collections.deque(maxlen=4096)
         self._hop_by_task: dict[str, dict] = {}
         self._owner_client_cache: dict[tuple, RpcClient] = {}
+        # Compiled-graph channel plane (experimental/channel/): reader gates
+        # for every channel this process consumes; the rpc_channel_* handlers
+        # below dispatch doorbells / side-channel chunks / poison into it.
+        from ray_tpu.experimental.channel.channel import ChannelRegistry
+
+        self.channels = ChannelRegistry()
         self.pending_tasks: dict[str, PendingTask] = {}
         # Tombstones for cancelled tasks that may not have reached this
         # process yet (cancel racing submission); checked at execution
@@ -1973,6 +1979,52 @@ class CoreWorker:
             if obj is not None and obj.in_plasma:
                 return {"kind": "plasma", "location": obj.location_hint}
         return {"kind": "missing"}
+
+    # ---- compiled-graph channel plane (experimental/channel/) ----
+
+    async def rpc_channel_doorbell(self, req):
+        """One-way producer wakeup: the reader blocked on this channel
+        re-checks its ring/side-channel now instead of at the next poll."""
+        self.channels.ring_doorbell(req["cid"])
+        return {"ok": True}
+
+    async def rpc_channel_data(self, req):
+        """Side-channel envelope chunk (oversize payloads and the cross-node
+        fallback ride this, chunked like the object push path)."""
+        gate = self.channels.gate_if_live(req["cid"])
+        if gate is None or gate.closed:
+            return {"ok": False, "closed": True}
+        gate.add_chunk(req["seq"], req["idx"], req["total"], req["data"])
+        return {"ok": True}
+
+    async def rpc_channel_query(self, req):
+        """Remote-mode backpressure probe: the producer bounds its in-flight
+        envelopes by the reader's queue depth."""
+        gate = self.channels.gate_if_live(req["cid"])
+        if gate is None:
+            return {"queued": 0, "closed": True}
+        return {"queued": gate.queued(), "closed": gate.closed}
+
+    async def rpc_channel_poison(self, req):
+        """Plant a sticky error envelope (actor death propagation): every
+        subsequent read on this channel returns the typed error."""
+        gate = self.channels.gate_if_live(req["cid"])
+        if gate is not None:
+            gate.poison(req["env"])
+        return {"ok": True}
+
+    async def rpc_channel_close(self, req):
+        """Teardown: blocked readers raise ChannelClosedError promptly."""
+        gate = self.channels.gate_if_live(req["cid"])
+        if gate is not None:
+            gate.close()
+        return {"ok": True}
+
+    @any_thread
+    def record_compiled_hop(self, rec: dict):
+        """Append a compiled-iteration hop record (path='compiled'); read by
+        tracing.summarize_hop_records like every other dispatch path."""
+        self._hop_log.append(rec)
 
     async def rpc_pubsub(self, req):
         """GCS pubsub push (driver: worker_logs echo)."""

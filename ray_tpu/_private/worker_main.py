@@ -116,6 +116,13 @@ class WorkerExecutor:
         server.register("lease_exec", self.rpc_lease_exec)
         server.register("lease_ping", self.rpc_lease_ping)
         server.register("cancel_exec", self.rpc_cancel_exec)
+        # Channel-loop mode (compiled execution graphs, dag/compiled.py):
+        # install starts a resident loop on a dedicated thread that serves
+        # channel iterations with no per-call task spec / ObjectRef / raylet
+        # RPC; classic calls keep flowing through the main exec queue.
+        server.register("channel_loop_install", self.rpc_channel_loop_install)
+        server.register("channel_loop_stop", self.rpc_channel_loop_stop)
+        self._channel_loops: dict = {}
         # Leased-task pipeline (reference: direct task transport worker side,
         # core_worker.cc task receiver): owners ship batches of specs; we
         # execute FIFO (the main-thread exec queue) and push completion
@@ -464,6 +471,54 @@ class WorkerExecutor:
         if payload.get("hop") is not None:
             payload["hop"]["reply"] = time.monotonic()
         return payload
+
+    # ---- channel-loop mode (compiled graphs; experimental/channel/) ----
+
+    async def rpc_channel_loop_install(self, req):
+        """Bind this actor into a compiled DAG: build the channel endpoints
+        and start the resident loop on its own dedicated thread. A separate
+        thread (the reference runs accelerated-DAG loops on a background
+        execution thread the same way) keeps the actor AVAILABLE: classic
+        method calls still run on the main exec queue instead of queuing
+        behind the loop forever. Mixing classic calls with compiled stages
+        therefore executes them concurrently — same hazard class as
+        max_concurrency > 1, and the user opted in by mixing the paths."""
+        from ray_tpu.experimental.channel.resident_loop import ChannelLoop
+
+        if self._channel_loops:
+            return {
+                "error": "actor already participates in a compiled graph; "
+                "teardown() the existing CompiledDAG first"
+            }
+        if self.cw._actor_instance is None:
+            return {"error": "channel loops require an actor worker"}
+        try:
+            loop = ChannelLoop(self.cw, req["loop_id"], req["stages"])
+        except Exception as e:  # bad descriptor / unknown method
+            return {"error": f"channel loop install failed: {e!r}"}
+        self._channel_loops[req["loop_id"]] = loop
+        threading.Thread(
+            target=loop.run, name="channel-loop", daemon=True
+        ).start()
+        return {"ok": True}
+
+    async def rpc_channel_loop_stop(self, req):
+        """Teardown: stop the resident loop, wait for its thread to exit,
+        and drop its reader gates. ok=False (loop still running — e.g. a
+        stage method stuck in user code) keeps the loop REGISTERED so a new
+        compile cannot double-bind the actor, and tells the driver not to
+        free arena blocks the loop may still write."""
+        loop = self._channel_loops.pop(req["loop_id"], None)
+        if loop is None:
+            return {"ok": True, "stopped": False}
+        loop.stop()
+        try:
+            await asyncio.wait_for(loop.exited.wait(), 15)
+        except asyncio.TimeoutError:
+            self._channel_loops[req["loop_id"]] = loop
+            return {"ok": False, "error": "channel loop did not exit within 15s"}
+        self.cw.channels.drop(loop.channel_ids)
+        return {"ok": True, "stopped": True}
 
     # ---- cancellation (reference: core_worker.cc HandleCancelTask) ----
 

@@ -1,5 +1,7 @@
-"""ray_tpu.dag — lazy task/actor DAGs (reference: python/ray/dag/)."""
+"""ray_tpu.dag — lazy task/actor DAGs (reference: python/ray/dag/) plus
+compiled execution graphs over shm channels (dag/compiled.py)."""
 
+from ray_tpu.dag.compiled import CompiledDAG, CompiledDAGRef  # noqa: F401
 from ray_tpu.dag.dag_node import (  # noqa: F401
     ClassMethodNode,
     ClassNode,
@@ -11,6 +13,8 @@ from ray_tpu.dag.dag_node import (  # noqa: F401
 )
 
 __all__ = [
+    "CompiledDAG",
+    "CompiledDAGRef",
     "DAGNode",
     "FunctionNode",
     "ClassNode",

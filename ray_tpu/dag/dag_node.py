@@ -78,6 +78,14 @@ class DAGNode:
     def _execute_impl(self, resolved_args, resolved_kwargs, ctx):
         raise NotImplementedError
 
+    def experimental_compile(self, **kwargs):
+        """Compile this static DAG of actor-method nodes for repeated
+        zero-RPC dispatch over pre-allocated shm channels; returns a
+        :class:`~ray_tpu.dag.compiled.CompiledDAG` (see dag/compiled.py)."""
+        from ray_tpu.dag.compiled import CompiledDAG
+
+        return CompiledDAG(self, **kwargs)
+
     def execute(self, *input_args, **input_kwargs):
         """Execute the DAG rooted at this node. Returns this node's result
         (an ObjectRef for function/method nodes, an ActorHandle for class
@@ -114,20 +122,42 @@ class FunctionNode(DAGNode):
 
 
 class ClassNode(DAGNode):
-    """A bound actor construction (reference: dag/class_node.py). Executing
-    it creates the actor; repeated executes within one DAG run share it."""
+    """A bound actor construction (reference: dag/class_node.py). The actor
+    is created ONCE per ClassNode and cached: repeated ``dag.execute()``
+    calls reuse the gang instead of spawning fresh actors per call (and
+    ``experimental_compile()`` resolves the same cache, so a DAG compiled
+    after a classic run binds the same actors). Only constructors whose
+    bound args contain other DAG nodes — i.e. truly per-execution actors —
+    keep the old create-per-execute behavior."""
 
     def __init__(self, actor_cls, args, kwargs, options=None):
         super().__init__(args, kwargs)
         self._actor_cls = actor_cls
         self._options = dict(options or {})
+        self._cached_handle = None
 
     def options(self, **opts):
         return ClassNode(self._actor_cls, self._bound_args, self._bound_kwargs, {**self._options, **opts})
 
+    def resolve_actor_handle(self, args=None, kwargs=None):
+        """The per-DAG actor cache: create the actor on first resolution,
+        return the same handle afterwards. Shared by classic execute() and
+        the compiled-graph planner."""
+        if self._cached_handle is None:
+            cls = self._actor_cls.options(**self._options) if self._options else self._actor_cls
+            self._cached_handle = cls.remote(
+                *(self._bound_args if args is None else args),
+                **(self._bound_kwargs if kwargs is None else kwargs),
+            )
+        return self._cached_handle
+
     def _execute_impl(self, args, kwargs, ctx):
-        cls = self._actor_cls.options(**self._options) if self._options else self._actor_cls
-        return cls.remote(*args, **kwargs)
+        if self._children():
+            # Constructor args flow from other DAG nodes: a fresh actor per
+            # execution is the only correct reading — no cache.
+            cls = self._actor_cls.options(**self._options) if self._options else self._actor_cls
+            return cls.remote(*args, **kwargs)
+        return self.resolve_actor_handle(args, kwargs)
 
     def __getattr__(self, method_name):
         if method_name.startswith("_"):

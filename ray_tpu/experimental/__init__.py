@@ -1,0 +1,1 @@
+"""ray_tpu.experimental — pre-stable subsystems (compiled-graph channels)."""

@@ -1,0 +1,168 @@
+"""Resident channel loop — the worker side of compiled execution.
+
+Installed on an actor worker by ``channel_loop_install`` (worker_main.py)
+and run on a DEDICATED daemon thread (the analog of the reference running
+accelerated-DAG loops on a background execution thread): the actor's main
+exec queue stays free, so classic method calls keep working while the actor
+participates in a compiled graph. Classic calls and compiled stages may
+therefore run concurrently on the actor instance — the same hazard class as
+``max_concurrency > 1``, opted into by mixing the two paths.
+
+Per iteration, for each bound stage in topological order: block on the
+stage's input channels -> execute the bound method on the live actor
+instance -> write the result envelope to every output channel. No task
+spec is decoded, no ObjectRef is allocated and no raylet RPC is issued —
+the loop touches only channel memory and the doorbell pipe.
+
+Error flow: an application exception becomes an error envelope for THAT
+iteration only (it forwards stage-to-stage to the driver, which re-raises
+it from ``CompiledDAGRef.get()``; the loop keeps running). A sticky poison
+envelope (actor death, planted by the driver's monitor) likewise forwards
+downstream. ``ChannelClosedError`` — teardown or the loop's stop event —
+exits the loop and its thread.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from ray_tpu._private import serialization
+from ray_tpu.experimental.channel.channel import (
+    KIND_ERROR,
+    KIND_VALUE,
+    ChannelClosedError,
+    ChannelReader,
+    ChannelWriter,
+)
+from ray_tpu.exceptions import TaskError
+
+logger = logging.getLogger(__name__)
+
+
+class _BoundStage:
+    """One compiled DAG node bound to this actor: resolved method, input
+    readers / constant args, and output writers."""
+
+    def __init__(self, cw, wire: dict):
+        self.label = wire["label"]
+        self.hop_key = wire.get("hop_key") or self.label
+        self.method = getattr(cw._actor_instance, wire["method"])
+        # Positional args then sorted kwargs — the deterministic read order
+        # both endpoints agree on (each arg has its own channel, so only
+        # blocking order matters, not data ordering).
+        self.args: list = []  # ("c", ChannelReader) | ("v", constant)
+        for spec in wire["args"]:
+            if spec[0] == "c":
+                self.args.append(("c", ChannelReader(spec[1], cw)))
+            else:
+                self.args.append(("v", serialization.deserialize(spec[1])))
+        self.kwargs: list = []  # (name, same spec shape)
+        for name in sorted(wire.get("kwargs") or {}):
+            spec = wire["kwargs"][name]
+            if spec[0] == "c":
+                self.kwargs.append((name, ("c", ChannelReader(spec[1], cw))))
+            else:
+                self.kwargs.append((name, ("v", serialization.deserialize(spec[1]))))
+        self.writers = [ChannelWriter(desc, cw) for desc in wire["outputs"]]
+
+    def channel_ids(self) -> list[str]:
+        cids = [ep.cid for kind, ep in self.args if kind == "c"]
+        cids += [spec[1].cid for _, spec in self.kwargs if spec[0] == "c"]
+        cids += [w.cid for w in self.writers]
+        return cids
+
+
+class ChannelLoop:
+    """The resident loop for one compiled DAG on one actor worker."""
+
+    def __init__(self, cw, loop_id: str, stages_wire: list):
+        self.cw = cw
+        self.loop_id = loop_id
+        self._stop = threading.Event()
+        self.stages = [_BoundStage(cw, wire) for wire in stages_wire]
+        self.channel_ids = [cid for s in self.stages for cid in s.channel_ids()]
+        # Completion signal for rpc_channel_loop_stop (set threadsafe from
+        # the exec thread when run() returns). Created on the IO loop.
+        import asyncio
+
+        self.exited = asyncio.Event()
+
+    def stop(self):
+        """Any-thread: ask the loop to exit; readers/writers observe the
+        stop event within one poll interval."""
+        self._stop.set()
+
+    def run(self):
+        """Dedicated-thread entry; runs until stop/teardown/close."""
+        try:
+            while not self._stop.is_set():
+                for stage in self.stages:
+                    self._run_stage(stage)
+        except ChannelClosedError:
+            pass  # teardown / stop: the normal exit path
+        except BaseException:  # noqa: BLE001 — must not kill the exec queue
+            logger.exception("compiled channel loop %s crashed", self.loop_id[:8])
+        finally:
+            loop = self.cw._io.loop
+            loop.call_soon_threadsafe(self.exited.set)
+
+    def _run_stage(self, stage: _BoundStage):
+        hop: dict | None = None
+        error_data = None
+        args = []
+        kwargs = {}
+        for kind, payload in stage.args:
+            if kind == "v":
+                args.append(payload)
+                continue
+            ekind, data, ehop = payload.read(stop=self._stop)
+            if ehop:
+                hop = {**(hop or {}), **ehop}
+            if ekind == KIND_ERROR:
+                error_data = error_data or data
+                args.append(None)
+            else:
+                args.append(serialization.deserialize(data))
+        for name, (kind, payload) in stage.kwargs:
+            if kind == "v":
+                kwargs[name] = payload
+                continue
+            ekind, data, ehop = payload.read(stop=self._stop)
+            if ehop:
+                hop = {**(hop or {}), **ehop}
+            if ekind == KIND_ERROR:
+                error_data = error_data or data
+                kwargs[name] = None
+            else:
+                kwargs[name] = serialization.deserialize(data)
+        if error_data is not None:
+            # Upstream error (application failure or death poison): forward
+            # it through every output channel without executing this stage.
+            for w in stage.writers:
+                w.write(KIND_ERROR, error_data, hop, stop=self._stop)
+            return
+        if hop is not None:
+            hop[f"{stage.hop_key}_recv"] = time.monotonic()
+        try:
+            value = stage.method(*args, **kwargs)
+            import inspect
+
+            if inspect.iscoroutine(value):
+                # Async actor methods run on the per-actor async loop, same
+                # as classic calls (core_worker._run_actor_coroutine).
+                value = self.cw._run_actor_coroutine(value)
+            out_kind = KIND_VALUE
+            data = serialization.serialize(value).to_bytes()
+        except ChannelClosedError:
+            raise
+        except BaseException as e:  # noqa: BLE001 — app errors flow downstream
+            out_kind = KIND_ERROR
+            data = serialization.serialize(
+                TaskError.from_exception(e, task_name=stage.label)
+            ).to_bytes()
+        if hop is not None:
+            hop[f"{stage.hop_key}_exec"] = time.monotonic()
+        for w in stage.writers:
+            w.write(out_kind, data, hop, stop=self._stop)

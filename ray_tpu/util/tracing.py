@@ -132,8 +132,8 @@ _HOP_CHAINS = {
 # path. The warm-lease fast path is 2 — matching the reference's steady
 # state (owner->worker push, worker->owner reply); classic is 4 (submit,
 # dispatch, task_done, piggybacked task_finished push).
-_SERIAL_PROCESS_HOPS = {"lease": 2, "actor": 2, "classic": 4}
-_RAYLET_RPCS = {"lease": 0, "actor": 0, "classic": 2}
+_SERIAL_PROCESS_HOPS = {"lease": 2, "actor": 2, "classic": 4, "compiled": 0}
+_RAYLET_RPCS = {"lease": 0, "actor": 0, "classic": 2, "compiled": 0}
 
 
 def _pctl(sorted_vals: list[float], q: float) -> float:
@@ -143,6 +143,23 @@ def _pctl(sorted_vals: list[float], q: float) -> float:
     return sorted_vals[idx]
 
 
+def _compiled_transitions(recs: list[dict]) -> tuple[dict, list[float]]:
+    """Per-record dynamic chains for compiled-graph iterations: the stage
+    set depends on the DAG (``s{i}_recv``/``s{i}_exec`` per stage), so the
+    chain is derived from each record's monotonic stamps sorted by time —
+    and the very absence of any ``raylet_*`` stamp is the recorded evidence
+    that compiled dispatch issues zero raylet RPCs per iteration."""
+    trans: dict[str, list[float]] = {}
+    totals: list[float] = []
+    for rec in recs:
+        stamps = sorted((v, k) for k, v in rec.items() if isinstance(v, float))
+        for (va, ka), (vb, kb) in zip(stamps, stamps[1:]):
+            trans.setdefault(f"{ka}->{kb}", []).append((vb - va) * 1e6)
+        if len(stamps) >= 2:
+            totals.append((stamps[-1][0] - stamps[0][0]) * 1e6)
+    return trans, totals
+
+
 def summarize_hop_records(records: list[dict]) -> dict:
     """Aggregate raw hop records into a per-path, per-stage µs budget."""
     by_path: dict[str, list[dict]] = {}
@@ -150,9 +167,28 @@ def summarize_hop_records(records: list[dict]) -> dict:
         by_path.setdefault(rec.get("path", "classic"), []).append(rec)
     out: dict = {}
     for path, recs in by_path.items():
-        chain = _HOP_CHAINS.get(path, _HOP_CHAINS["classic"])
         stages: dict[str, dict] = {}
         totals: list[float] = []
+        if path == "compiled":
+            trans, totals = _compiled_transitions(recs)
+            for key in trans:
+                deltas = sorted(trans[key])
+                stages[key] = {
+                    "p50_us": round(_pctl(deltas, 0.5), 1),
+                    "p90_us": round(_pctl(deltas, 0.9), 1),
+                    "n": len(deltas),
+                }
+            totals.sort()
+            out[path] = {
+                "count": len(recs),
+                "stages_us": stages,
+                "total_p50_us": round(_pctl(totals, 0.5), 1) if totals else None,
+                "total_p90_us": round(_pctl(totals, 0.9), 1) if totals else None,
+                "serial_process_hops": _SERIAL_PROCESS_HOPS.get(path),
+                "raylet_rpcs_per_call": _RAYLET_RPCS.get(path),
+            }
+            continue
+        chain = _HOP_CHAINS.get(path, _HOP_CHAINS["classic"])
         for a, b in chain:
             deltas = sorted(
                 (rec[b] - rec[a]) * 1e6

@@ -39,3 +39,40 @@ def test_microbench_smoke(tmp_path):
         "putget_1mib_per_s",
     ):
         assert data.get(key, 0) > 0, f"{key} missing/zero in smoke artifact: {data}"
+
+
+def test_microbench_dag_smoke(tmp_path):
+    """<30s classic-vs-compiled DAG case (microbench.py --dag --quick):
+    both paths produce throughput numbers, and the compiled loop's
+    control-plane evidence holds — 0 raylet RPCs and 0 new ObjectRefs per
+    iteration (deterministic counters, not timing)."""
+    out = tmp_path / "dagbench.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", RAY_TPU_NUM_TPUS="0")
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "microbench.py"),
+            "--dag",
+            "--quick",
+            "--out",
+            str(out),
+        ],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=240,  # generous for loaded CI boxes; ~7 s unloaded
+    )
+    assert proc.returncode == 0, (
+        f"microbench --dag failed (rc={proc.returncode})\n"
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    data = json.loads(out.read_text())
+    for key in ("dag_classic_per_s", "dag_compiled_per_s"):
+        assert data.get(key, 0) > 0, f"{key} missing/zero: {data}"
+    assert data["dag_compiled_raylet_rpcs_per_iter"] == 0
+    assert data["dag_compiled_new_object_refs_per_iter"] == 0
+    # Compiled stamps exist and contain no raylet stage.
+    compiled_budget = data["dag_hop_budget"]["compiled"]
+    assert compiled_budget["count"] > 0
+    assert not any("raylet" in s for s in compiled_budget["stages_us"])
