@@ -197,6 +197,96 @@ def dag_suite(results, duration):
         os.environ.pop("RAY_TPU_HOP_TIMING", None)
 
 
+def recorder_overhead_suite(results, block_tasks=256, pairs=150):
+    """--recorder-overhead: cost of the always-on observability plane
+    (flight recorder + 1-in-64 sampled hop stamps) on the task_sync hot
+    path, measured as many fine-grained paired A/B blocks.
+
+    Noise design for a loaded 1-core box (single-block rates here swing
+    +-6% while the instrumentation itself costs ~5us on a ~600us path):
+    BOTH arms run inside ONE cluster against the SAME warm-leased worker,
+    toggled at runtime (flight_recorder.set_enabled in driver AND worker +
+    cfg.hop_sample_n in the driver, which controls the worker's stamping
+    via spec.hop_ts). Blocks are COUNT-based (256 tasks ~ 150ms) and
+    alternate ABBA so drift cancels within each pair; the headline
+    overhead is the MEDIAN of per-pair ratios over many pairs — the only
+    estimator that converged on this box (the interquartile mean rides
+    along as recorder_overhead_iqmean_pct)."""
+    import statistics
+
+    import ray_tpu
+    from ray_tpu._private import flight_recorder
+    from ray_tpu._private.config import get_config
+
+    ray_tpu.init(num_cpus=1, object_store_memory=128 * 1024 * 1024)
+
+    @ray_tpu.remote
+    def small():
+        return b"ok"
+
+    @ray_tpu.remote
+    def _toggle(on):
+        # Runs on the same warm-leased worker the loop uses (num_cpus=1 and
+        # an identical shape key): flips the worker-side recorder.
+        from ray_tpu._private import flight_recorder as fr
+
+        fr.set_enabled(on)
+        return True
+
+    def set_mode(on: bool):
+        flight_recorder.set_enabled(on)
+        get_config().hop_sample_n = 64 if on else 0
+        assert ray_tpu.get(_toggle.remote(on))
+
+    def block(n: int) -> float:
+        t0 = time.perf_counter()
+        for _ in range(n):
+            ray_tpu.get(small.remote())
+        return n / (time.perf_counter() - t0)
+
+    # Warm the lease + both code paths.
+    set_mode(True)
+    block(200)
+    set_mode(False)
+    block(200)
+
+    ratios = []
+    on_rates, off_rates = [], []
+    for i in range(pairs):
+        # ABBA: alternate which arm goes first so drift cancels per pair.
+        order = [True, False] if i % 2 == 0 else [False, True]
+        rates = {}
+        for on in order:
+            set_mode(on)
+            rates[on] = block(block_tasks)
+        on_rates.append(rates[True])
+        off_rates.append(rates[False])
+        ratios.append(rates[False] / rates[True])
+    set_mode(True)  # leave the plane on, as in production
+    ray_tpu.shutdown()
+    ratios.sort()
+    q = max(1, len(ratios) // 4)
+    core = ratios[q : len(ratios) - q] or ratios
+    results["recorder_on_task_sync_per_s"] = round(statistics.median(on_rates), 1)
+    results["recorder_off_task_sync_per_s"] = round(statistics.median(off_rates), 1)
+    results["recorder_overhead_pct"] = round(
+        (statistics.median(ratios) - 1.0) * 100.0, 2
+    )
+    results["recorder_overhead_iqmean_pct"] = round(
+        (sum(core) / len(core) - 1.0) * 100.0, 2
+    )
+    results["recorder_pair_ratios"] = [round(r, 4) for r in ratios]
+    results["recorder_pairs"] = pairs
+    results["recorder_block_tasks"] = block_tasks
+    print(
+        f"recorder overhead on task_sync: {results['recorder_overhead_pct']}% "
+        f"(on={results['recorder_on_task_sync_per_s']}/s, "
+        f"off={results['recorder_off_task_sync_per_s']}/s, "
+        f"median of {pairs} ABBA pair ratios; "
+        f"IQ-mean={results['recorder_overhead_iqmean_pct']}%)"
+    )
+
+
 def compute_deltas_vs_prev(results: dict, round_no: int, prev_path: str | None = None):
     """Diff numeric metrics against the previous round's artifact so a
     regression is named IN the artifact, not discovered by a later reviewer
@@ -415,6 +505,12 @@ def main():
         "(warm lease vs direct actor vs classic raylet path)",
     )
     ap.add_argument(
+        "--recorder-overhead",
+        action="store_true",
+        help="measure the always-on flight-recorder + sampled-hop-stamp cost "
+        "on task_sync (paired ABBA windows, one cluster; OBSBENCH_r{N}.json)",
+    )
+    ap.add_argument(
         "--dag",
         action="store_true",
         help="classic dag.execute() vs compiled execution on a 4-stage "
@@ -445,6 +541,25 @@ def main():
         if bad:
             print(f"SMOKE FAILED: missing/zero metrics {bad}", file=sys.stderr)
             sys.exit(1)
+        return
+
+    if args.recorder_overhead:
+        results = {"host_cpus": os.cpu_count(), "mode": "recorder_overhead"}
+        t0 = time.perf_counter()
+        # 150 pairs (~60s) is where the median converges on this box: the
+        # noise is non-stationary (multi-second bursts), so short runs can
+        # land anywhere in +-4% while long-horizon medians repeat within
+        # ~0.4%.
+        recorder_overhead_suite(
+            results,
+            block_tasks=128 if args.quick else 256,
+            pairs=8 if args.quick else 150,
+        )
+        results["wall_s"] = round(time.perf_counter() - t0, 1)
+        out = args.out or f"OBSBENCH_r{args.round}.json"
+        with open(out, "w") as f:
+            json.dump(results, f, indent=1)
+        print(json.dumps(results))
         return
 
     if args.hop_budget:

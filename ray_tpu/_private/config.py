@@ -112,6 +112,17 @@ class Config:
     # summarize_hop_records, microbench.py --hop-budget). Off by default:
     # the stamps are cheap but non-zero on the 1k+/s dispatch hot path.
     hop_timing: bool = False
+    # Always-on production sampling: 1-in-N submissions carry hop stamps even
+    # with hop_timing off, feeding the ray_tpu_dispatch_latency_s histogram
+    # (self_metrics.py) and `ray_tpu timeline` flow spans at ~1/N of the
+    # full-tracing cost. 0 disables sampling. Env: RAY_TPU_HOP_SAMPLE_N.
+    hop_sample_n: int = 64
+
+    # --- flight recorder (always-on observability; flight_recorder.py) ---
+    # Ring capacity in events per process. The ring is mmap-backed under
+    # <session_dir>/flight/ so a SIGKILLed process's final events survive
+    # for `ray_tpu debug dump`. Disable with RAY_TPU_FLIGHT_RECORDER=0.
+    flight_ring_slots: int = 4096
 
     # --- logging / events ---
     log_to_driver: bool = True

@@ -39,7 +39,7 @@ from dataclasses import dataclass, field
 
 import cloudpickle
 
-from ray_tpu._private import serialization
+from ray_tpu._private import flight_recorder, self_metrics, serialization
 from ray_tpu._private.concurrency import any_thread, blocking, loop_only
 from ray_tpu._private.config import get_config
 from ray_tpu._private.ids import ActorID, BoundedIdSet, JobID, ObjectID, TaskID, WorkerID
@@ -152,6 +152,27 @@ class CoreWorker:
 
         self._io = EventLoopThread.get()
         _mark("io-loop")
+        # Always-on observability plane: the crash-surviving event ring
+        # (flight_recorder.py) plus the ray_tpu_* runtime instruments
+        # (self_metrics.py) that flow through the /metrics KV path.
+        flight_recorder.attach(session_dir, role=mode, ident=self.worker_id)
+        self._metrics = self_metrics.instruments()
+        # 1-in-N dispatch sampling counter (config.hop_sample_n): feeds the
+        # dispatch-latency histogram and timeline flow spans in production
+        # without full hop-timing cost.
+        self._hop_sample_ctr = 0
+        # task_done ring events are sampled 1-in-64: completion is implied
+        # by the NEXT task_exec on this worker, and a ring that ends with a
+        # task_exec (no later exec) is precisely the "died mid-task"
+        # postmortem signal — so per-task done events bought latency on the
+        # exec critical path without adding information. task_ship is
+        # sampled the same way (first ship after init always records): the
+        # driver ring's unique value is driver-death postmortems — for the
+        # common worker-death case the live driver's pending_tasks + task
+        # events already name every in-flight task exactly. task_exec and
+        # task_fail stay per-event.
+        self._done_event_ctr = 0
+        self._ship_event_ctr = 0
 
         self.gcs = RpcClient(tuple(gcs_address), label="gcs")
         self.raylet = RpcClient(tuple(raylet_address), label="raylet")
@@ -387,6 +408,21 @@ class CoreWorker:
         self._task_counter += 1
         return TaskID.for_task(ActorID(self.current_task_id.binary()[:16]))
 
+    def _hop_stamp_start(self) -> dict:
+        """Initial hop-stamp dict for a submission: every task under full
+        hop timing, 1-in-``hop_sample_n`` otherwise (always-on production
+        sampling — makes the PR 2 hop budget a live metric instead of an
+        opt-in microbench artifact). Empty dict = unstamped."""
+        if self.cfg.hop_timing:
+            return {"submit": time.monotonic()}
+        n = self.cfg.hop_sample_n
+        if n > 0:
+            self._hop_sample_ctr += 1
+            if self._hop_sample_ctr >= n:
+                self._hop_sample_ctr = 0
+                return {"submit": time.monotonic()}
+        return {}
+
     def _export_function(self, func) -> str:
         # Hot path: @ray_tpu.remote functions are submitted thousands of
         # times — cache the pickle/hash per function object (weak so
@@ -502,7 +538,7 @@ class CoreWorker:
             scheduling_strategy=opts.get("scheduling_strategy", "DEFAULT"),
             runtime_env=self._merged_runtime_env(opts.get("runtime_env")),
             trace_ctx=self._trace_ctx(),
-            hop_ts={"submit": time.monotonic()} if self.cfg.hop_timing else {},
+            hop_ts=self._hop_stamp_start(),
         )
         if spec.is_streaming():
             with self._lock:
@@ -682,6 +718,11 @@ class CoreWorker:
             p.phase = "submitted"
             p.submitted_ts = time.monotonic()
             p.via_lease = self._lease_eligible(spec)
+        self._ship_event_ctr += 1
+        if self._ship_event_ctr & 63 == 1:  # records at 1, 65, 129, ...
+            flight_recorder.record(
+                "task_ship", f"{spec.name}:{spec.task_id[:8]}:n={self._ship_event_ctr}"
+            )
         if p.via_lease:
             self._get_lease_manager().submit(spec)
             return
@@ -1375,7 +1416,7 @@ class CoreWorker:
             seq_no=self._actor_seq[actor_id],
             max_task_retries=max_task_retries,
             trace_ctx=self._trace_ctx(),
-            hop_ts={"submit": time.monotonic()} if self.cfg.hop_timing else {},
+            hop_ts=self._hop_stamp_start(),
         )
         self._register_pending(spec, arg_refs)
         self._actor_pending[actor_id].add(spec.task_id)
@@ -1908,6 +1949,17 @@ class CoreWorker:
         rec.update(payload.get("hop") or {})
         rec["owner_done"] = time.monotonic()
         self._hop_log.append(rec)
+        submit = rec.get("submit")
+        if submit is not None:
+            # Sampled dispatch-latency histogram (submit -> completion
+            # visible at owner); one observe per sampled task keeps the
+            # instrument lock off the unsampled hot path entirely.
+            try:
+                self._metrics["dispatch_latency"].observe(
+                    rec["owner_done"] - submit, tags={"path": rec["path"]}
+                )
+            except Exception:
+                pass
         if len(self._hop_by_task) > 8192:
             self._hop_by_task.clear()
         self._hop_by_task[spec.task_id] = rec
@@ -2011,6 +2063,7 @@ class CoreWorker:
         gate = self.channels.gate_if_live(req["cid"])
         if gate is not None:
             gate.poison(req["env"])
+            flight_recorder.record("channel_poison", req["cid"][:12])
         return {"ok": True}
 
     async def rpc_channel_close(self, req):
@@ -2018,6 +2071,7 @@ class CoreWorker:
         gate = self.channels.gate_if_live(req["cid"])
         if gate is not None:
             gate.close()
+            flight_recorder.record("channel_close", req["cid"][:12])
         return {"ok": True}
 
     @any_thread
@@ -2025,6 +2079,20 @@ class CoreWorker:
         """Append a compiled-iteration hop record (path='compiled'); read by
         tracing.summarize_hop_records like every other dispatch path."""
         self._hop_log.append(rec)
+        submit, wake = rec.get("submit"), rec.get("wake")
+        if submit is not None and wake is not None:
+            try:
+                self._metrics["dispatch_latency"].observe(
+                    wake - submit, tags={"path": "compiled"}
+                )
+            except Exception:
+                pass
+
+    async def rpc_debug_dump(self, req):
+        """This process's flight-recorder ring (the raylet's debug_dump
+        aggregates node-wide, including rings of already-dead processes)."""
+        proc = flight_recorder.dump()
+        return {"processes": [proc] if proc is not None else []}
 
     async def rpc_pubsub(self, req):
         """GCS pubsub push (driver: worker_logs echo)."""
@@ -2230,6 +2298,8 @@ class CoreWorker:
         start = time.time()
         if spec.hop_ts:
             spec.hop_ts["exec_start"] = time.monotonic()
+        task_tag = f"{spec.name}:{spec.task_id[:8]}"  # shared by exec/done/fail events
+        flight_recorder.record("task_exec", task_tag)
         self.record_task_event(spec, "RUNNING", start_ts=start)
         try:
             if spec.is_actor_task():
@@ -2303,6 +2373,11 @@ class CoreWorker:
             payload = {"task_id": spec.task_id, "results": results, "error": None}
             if spec.is_streaming() and not spec.is_actor_creation():
                 payload["stream_count"] = stream_count
+            self._done_event_ctr += 1
+            if self._done_event_ctr & 63 == 0:
+                flight_recorder.record(
+                    "task_done", f"{task_tag}:n={self._done_event_ctr}"
+                )
             self.record_task_event(spec, "FINISHED", start_ts=start, end_ts=time.time())
         except BaseException as e:  # noqa: BLE001 — errors ship to the caller
             # CANCELLED only when THIS task was the target of a cancel
@@ -2322,6 +2397,9 @@ class CoreWorker:
                 )
             else:
                 logger.debug("task %s raised", spec.name, exc_info=True)
+                flight_recorder.record(
+                    "task_fail", f"{task_tag}:{type(e).__name__}"
+                )
                 err = TaskError.from_exception(e, task_name=spec.name)
                 payload = {
                     "task_id": spec.task_id,
@@ -2406,6 +2484,16 @@ class CoreWorker:
             self.flush_task_events()
         except Exception:
             pass
+        # Final metrics window must not vanish with the process: the periodic
+        # flusher runs every metrics_flush_interval_s, and this GCS client is
+        # about to close.
+        try:
+            from ray_tpu.util.metrics import flush_metrics
+
+            flush_metrics(self)
+        except Exception:
+            pass
+        flight_recorder.record("exit", self.mode)
         if self.mode == DRIVER:
             from ray_tpu._private.usage_stats import write_usage_stats
 

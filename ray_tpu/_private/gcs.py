@@ -336,6 +336,15 @@ class GcsServer:
             info["num_restarts"] += 1
             info["state"] = RESTARTING
             info["address"] = None
+            from ray_tpu._private import flight_recorder, self_metrics
+
+            flight_recorder.record(
+                "actor_restart", f"{actor_id[:8]}:n={info['num_restarts']}"
+            )
+            try:
+                self_metrics.instruments()["actor_restarts"].inc()
+            except Exception:
+                pass
             self._wal("actors", actor_id)
             await self._publish("actor_updates", {"actor_id": actor_id, "state": RESTARTING})
             ok = await self._schedule_actor_creation(actor_id)

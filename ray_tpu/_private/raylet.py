@@ -33,6 +33,7 @@ import time
 from collections import deque
 from dataclasses import dataclass, field
 
+from ray_tpu._private import flight_recorder, self_metrics
 from ray_tpu._private.config import get_config
 from ray_tpu._private.ids import BoundedIdSet, NodeID, WorkerID
 from ray_tpu._private.rpc import EventLoopThread, RpcClient, RpcServer, schema
@@ -104,6 +105,10 @@ class Raylet:
         self.session_dir = session_dir
         self.node_ip = node_ip
         os.makedirs(session_dir, exist_ok=True)
+        # Always-on observability: crash-surviving event ring + ray_tpu_*
+        # runtime instruments (store gauges feed from the heartbeat loop).
+        flight_recorder.attach(session_dir, role="raylet", ident=self.node_id)
+        self._metrics = self_metrics.instruments()
 
         self.arena_name = f"/rtpu_{self.node_id[:12]}"
         capacity = object_store_memory or self.cfg.object_store_memory
@@ -205,6 +210,18 @@ class Raylet:
             },
         )
 
+    def _update_store_gauges(self):
+        """Arena gauges piggyback on the heartbeat cadence (0.5s): O(1)
+        reads, no extra loop."""
+        usage = self.store.usage()
+        try:
+            self._metrics["store_bytes"].set(usage["used"])
+            self._metrics["store_capacity"].set(usage["capacity"])
+            self._metrics["store_objects"].set(usage["num_objects"])
+        except Exception:
+            pass
+        return usage
+
     async def _heartbeat_loop(self):
         while True:
             try:
@@ -213,7 +230,7 @@ class Raylet:
                     {
                         "node_id": self.node_id,
                         "resources_available": self.resources_available,
-                        "store_usage": self.store.usage(),
+                        "store_usage": self._update_store_gauges(),
                         # Resource demand by shape (reference: resource load
                         # reporting in ray_syncer / autoscaler demand input).
                         "load": self._pending_load(),
@@ -1488,6 +1505,9 @@ class Raylet:
         prev_state = worker.state
         worker.state = "dead"
         spec = worker.current_task
+        flight_recorder.record(
+            "worker_death", f"{worker.worker_id[:8]}:{reason[:60]}"
+        )
         logger.warning("worker %s died: %s", worker.worker_id[:8], reason)
         if worker.actor_spec is not None:
             # Release the actor's lifetime resource hold.
@@ -1556,6 +1576,17 @@ class Raylet:
     # Introspection
     # ------------------------------------------------------------------
 
+    async def rpc_debug_dump(self, req):
+        """Node-wide flight-recorder dump: every ring in this session's
+        flight dir — live processes write through their mmap, and a
+        SIGKILLed worker's file still holds its final events, which is the
+        whole postmortem story. File scan runs off-loop (it is disk I/O)."""
+        loop = asyncio.get_event_loop()
+        processes = await loop.run_in_executor(
+            None, flight_recorder.collect_dir, self.session_dir
+        )
+        return {"node_id": self.node_id, "processes": processes}
+
     async def rpc_get_state(self, req):
         return {
             "node_id": self.node_id,
@@ -1617,6 +1648,13 @@ def main():
         labels=json.loads(args.labels),
         object_store_memory=args.object_store_memory or None,
     )
+    # Standalone raylet: no CoreWorker will ever exist in this process, so
+    # point the metrics flusher at our own GCS client (in-process heads use
+    # the driver CoreWorker path instead — setting both would double-export
+    # the shared registry under two KV keys).
+    from ray_tpu.util.metrics import set_fallback_flush_target
+
+    set_fallback_flush_target(raylet.gcs, raylet.node_id, f"raylet-{raylet.node_id[:12]}")
     if args.address_file:
         tmp = args.address_file + ".tmp"
         with open(tmp, "w") as f:

@@ -24,6 +24,7 @@ from typing import Any, Awaitable, Callable
 
 import msgpack
 
+from ray_tpu._private import flight_recorder
 from ray_tpu._private.concurrency import any_thread, blocking, loop_only
 
 logger = logging.getLogger(__name__)
@@ -31,6 +32,32 @@ logger = logging.getLogger(__name__)
 REQUEST, RESPONSE, ERROR, PUSH = 0, 1, 2, 3
 
 _MAX_FRAME = 1 << 31
+
+
+class _WireStats:
+    """Plain-int wire counters for the frame pump. Every reader/writer runs
+    on the one IO loop thread, so bare ``+=`` is race-free there; the rare
+    off-loop increments (connect bookkeeping) can at worst lose an event,
+    never corrupt. Folded into ``ray_tpu_rpc_*`` instruments at metrics-flush
+    cadence (self_metrics._collect_wire_stats) — an instrument lock per
+    frame would tax the dispatch hot path."""
+
+    __slots__ = (
+        "frames_out", "bytes_out", "frames_in", "bytes_in",
+        "connects", "resets", "hwm_stalls",
+    )
+
+    def __init__(self):
+        self.frames_out = 0
+        self.bytes_out = 0
+        self.frames_in = 0
+        self.bytes_in = 0
+        self.connects = 0
+        self.resets = 0
+        self.hwm_stalls = 0
+
+
+WIRE = _WireStats()
 
 
 def schema(**fields):
@@ -97,6 +124,8 @@ def _set_nodelay(writer: "asyncio.StreamWriter"):
 
 def _pack(msg) -> bytes:
     body = msgpack.packb(msg, use_bin_type=True)
+    WIRE.frames_out += 1
+    WIRE.bytes_out += len(body) + 4
     return len(body).to_bytes(4, "big") + body
 
 
@@ -125,6 +154,8 @@ async def _frame_stream(reader: asyncio.StreamReader):
                 start = pos + 4
                 frame = msgpack.unpackb(bytes(buf[start : start + length]), raw=False)
                 pos = start + length
+                WIRE.frames_in += 1
+                WIRE.bytes_in += length + 4
                 yield frame
                 continue
         if pos:
@@ -140,6 +171,8 @@ def _drain_if_needed(writer: asyncio.StreamWriter):
     """Awaitable-or-None: drain only under real backpressure."""
     try:
         if writer.transport.get_write_buffer_size() > _WRITE_HIGH_WATER:
+            WIRE.hwm_stalls += 1
+            flight_recorder.record("rpc_hwm_stall")
             return writer.drain()
     except Exception:
         pass
@@ -351,6 +384,8 @@ class RpcClient:
                 _set_nodelay(writer)
                 self._writer = writer
                 self._reader_task = asyncio.ensure_future(self._read_loop(reader))
+                WIRE.connects += 1
+                flight_recorder.record("rpc_connect", self.label)
                 return
             except OSError as e:
                 last_err = e
@@ -382,6 +417,9 @@ class RpcClient:
             pass
         finally:
             self._writer = None
+            if not self._closed:
+                WIRE.resets += 1
+                flight_recorder.record("rpc_reset", self.label)
             for fut in self._pending.values():
                 if not fut.done():
                     fut.set_exception(ConnectionLost(f"connection to {self.label} lost"))
@@ -429,6 +467,8 @@ class RpcClient:
                 # Genuine backpressure (stalled peer): fall back to the
                 # acall path, which awaits drain — an unchecked write here
                 # would grow the socket buffer without bound.
+                WIRE.hwm_stalls += 1
+                flight_recorder.record("rpc_hwm_stall", self.label)
                 return None
         except Exception:
             pass

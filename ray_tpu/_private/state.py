@@ -73,11 +73,53 @@ class GlobalState:
                 avail[k] = avail.get(k, 0) + v
         return avail
 
+    # ---- flight recorder ----
+
+    def flight_recorder_dump(self) -> list[dict]:
+        """Cluster-wide flight-recorder collection: every alive raylet's
+        ``debug_dump`` returns all rings on its node (scanned from the mmap
+        files, so SIGKILLed processes' final events are included), merged
+        into one stream ordered by stamp."""
+        from ray_tpu._private.flight_recorder import merge_events
+
+        processes: list[dict] = []
+        seen: set = set()
+        for node in self.nodes():
+            if node.get("state") != "ALIVE" or not node.get("address"):
+                continue
+            client = RpcClient(tuple(node["address"]), label="debug-raylet")
+            try:
+                resp = client.call("debug_dump", {}, timeout=10)
+                for proc in resp.get("processes", []):
+                    # Same-host clusters (cluster_utils.Cluster) share one
+                    # session dir across raylets, so every raylet's scan
+                    # returns every ring — dedupe by process identity or an
+                    # N-raylet cluster reports each event N times.
+                    key = (proc.get("pid"), proc.get("role"), proc.get("ident"))
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    proc["node_id"] = resp.get("node_id")
+                    processes.append(proc)
+            except Exception:
+                continue
+            finally:
+                client.close()
+        return merge_events(processes)
+
     # ---- timeline ----
 
-    def chrome_tracing_dump(self, filename: str | None = None) -> list[dict]:
+    def chrome_tracing_dump(
+        self,
+        filename: str | None = None,
+        flight_events: list[dict] | None = None,
+        hop_records: list[dict] | None = None,
+    ) -> list[dict]:
         """Convert the GCS task-event log into Chrome trace-event JSON
-        (open in chrome://tracing or Perfetto)."""
+        (open in chrome://tracing or Perfetto). ``flight_events`` (from
+        flight_recorder_dump) render as instant events per process/role;
+        ``hop_records`` render as per-stage slices plus flow arrows next to
+        the task rows (util.tracing.hop_trace_events)."""
         events = self.task_events()
         trace: list[dict] = []
         seen_procs: set[tuple] = set()
@@ -118,6 +160,24 @@ class GlobalState:
                         },
                     }
                 )
+        if flight_events:
+            for ev in flight_events:
+                trace.append(
+                    {
+                        "name": ev.get("type", "event"),
+                        "cat": "flight",
+                        "ph": "i",
+                        "s": "t",  # thread-scoped instant
+                        "ts": ev["ts"] * 1e6,
+                        "pid": f"flight:{ev.get('role', '?')}",
+                        "tid": str(ev.get("pid", "?")),
+                        "args": {"detail": ev.get("detail", ""), "seq": ev.get("seq")},
+                    }
+                )
+        if hop_records:
+            from ray_tpu.util.tracing import hop_trace_events
+
+            trace.extend(hop_trace_events(hop_records))
         if filename:
             with open(filename, "w") as f:
                 json.dump(trace, f)
@@ -130,7 +190,11 @@ class GlobalState:
 
 def timeline(filename: str | None = None) -> list[dict]:
     """Dump a Chrome-trace timeline of executed tasks (reference:
-    ``ray.timeline``, python/ray/_private/state.py:831)."""
+    ``ray.timeline``, python/ray/_private/state.py:831). When hop records
+    exist in the connected owner (RAY_TPU_HOP_TIMING=1, or the always-on
+    1-in-N sampling), the per-hop dispatch budget renders as flow spans
+    next to the task rows — classic, lease, actor, and ``path="compiled"``
+    records alike."""
     cw = worker_context.get_core_worker()
     cw.flush_task_events()
-    return GlobalState().chrome_tracing_dump(filename)
+    return GlobalState().chrome_tracing_dump(filename, hop_records=cw.hop_records())

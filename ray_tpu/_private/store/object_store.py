@@ -22,6 +22,8 @@ import os
 import time
 from dataclasses import dataclass, field
 
+from ray_tpu._private import flight_recorder
+
 logger = logging.getLogger(__name__)
 
 
@@ -61,6 +63,9 @@ class StoreCore:
         # Arena blocks whose index slot still has client pins: freed once the
         # readers drain (list of (object_id, offset)).
         self._deferred_frees: list[tuple[str, int]] = []
+        from ray_tpu._private import self_metrics
+
+        self._metrics = self_metrics.instruments()
 
     def _index_remove_then_free(self, object_id: str, offset: int | None):
         """Tombstone the index entry; free the arena block now if no client
@@ -130,6 +135,11 @@ class StoreCore:
         entry.sealed_event.set()
         if self.index is not None:
             self.index.seal(object_id)
+        flight_recorder.record("store_seal", f"{object_id[:12]}:{entry.size}")
+        try:
+            self._metrics["store_seals"].inc()
+        except Exception:
+            pass
 
     def abort(self, object_id: str):
         entry = self.objects.pop(object_id, None)
@@ -289,6 +299,11 @@ class StoreCore:
             await self._spill(entry)
             self._index_remove_then_free(entry.object_id, entry.offset)
             entry.offset = None
+            flight_recorder.record("store_evict", f"{entry.object_id[:12]}:{entry.size}")
+            try:
+                self._metrics["store_evictions"].inc()
+            except Exception:
+                pass
 
     async def _spill(self, entry: ObjectEntry):
         if entry.spilled_path:
@@ -298,6 +313,12 @@ class StoreCore:
         entry.spilled_path = await loop.run_in_executor(
             None, self.external_storage.put, entry.object_id, data
         )
+        flight_recorder.record("store_spill", f"{entry.object_id[:12]}:{entry.size}")
+        try:
+            self._metrics["store_spills"].inc()
+            self._metrics["store_spilled_bytes"].inc(entry.size)
+        except Exception:
+            pass
         logger.debug("spilled %s (%d bytes)", entry.object_id, entry.size)
 
     async def _restore(self, entry: ObjectEntry):
@@ -317,6 +338,7 @@ class StoreCore:
                 raise ObjectStoreFullError("cannot restore spilled object")
         self.arena.write(offset, data)
         entry.offset = offset
+        flight_recorder.record("store_restore", entry.object_id[:12])
         if self.index is not None:
             self.index.put(entry.object_id, offset, entry.size)
             self.index.seal(entry.object_id)

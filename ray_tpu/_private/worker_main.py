@@ -697,6 +697,13 @@ def main():
     import signal
 
     signal.signal(signal.SIGUSR2, _cancel_handler)
+    # Flight-recorder fatal-signal hook: a terminating signal stamps a final
+    # `fatal_signal` event into the mmap ring before the process dies, so
+    # `ray_tpu debug dump` shows WHY the ring ends where it does. (SIGKILL
+    # needs no hook — the mmap file survives it as-is.)
+    from ray_tpu._private import flight_recorder
+
+    flight_recorder.install_signal_dump([signal.SIGTERM])
     executor = WorkerExecutor(cw, cw.raylet)
     reply = cw.raylet.call(
         "register_worker",

@@ -415,7 +415,10 @@ class CompiledDAG:
         # mismatched iterations forever after a retried execute().
         for _, writer in self._input_writers:
             writer.wait_writable(timeout=self._submit_timeout)
-        hop = {"submit": time.monotonic()} if self._cw.cfg.hop_timing else None
+        # Full stamps under hop_timing, 1-in-N sampled otherwise — compiled
+        # iterations feed the same production dispatch-latency metric as the
+        # classic paths.
+        hop = self._cw._hop_stamp_start() or None
         idx = self._next_idx
         cache: dict = {}
         for key, writer in self._input_writers:
@@ -479,7 +482,7 @@ class CompiledDAG:
                 values.append(None)
             else:
                 values.append(serialization.deserialize(data))
-        if hop_rec and self._cw.cfg.hop_timing:
+        if hop_rec:
             hop_rec["owner_recv"] = hop_rec.get("owner_recv") or time.monotonic()
             hop_rec["wake"] = time.monotonic()
             self._cw.record_compiled_hop(

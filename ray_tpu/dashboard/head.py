@@ -136,6 +136,15 @@ class DashboardHead:
 
             req._send(200, prometheus_text(self._gcs()), content_type="text/plain; version=0.0.4")
             return
+        if path == "/api/v0/debug/flight_recorder":
+            # Cluster-wide flight-recorder dump (merged, stamp-ordered) —
+            # the HTTP face of `ray_tpu debug dump`.
+            state = self._state()
+            try:
+                req._send(200, {"result": state.flight_recorder_dump()})
+            finally:
+                state.close()
+            return
         if path == "/api/v0/tasks/summarize":
             from ray_tpu.util.state import summarize_tasks
 

@@ -37,8 +37,24 @@ class OpStats:
     def record_output(self, meta):
         self.end = time.perf_counter()
         self.blocks += 1
-        self.rows += max(0, getattr(meta, "num_rows", 0) or 0)
-        self.size_bytes += max(0, getattr(meta, "size_bytes", 0) or 0)
+        rows = max(0, getattr(meta, "num_rows", 0) or 0)
+        size = max(0, getattr(meta, "size_bytes", 0) or 0)
+        self.rows += rows
+        self.size_bytes += size
+        # Runtime metrics: per-op rows/bytes/blocks flow to /metrics under
+        # the ray_tpu_data_* family (one inc per block, not per row).
+        try:
+            from ray_tpu._private import self_metrics
+
+            inst = self_metrics.instruments()
+            tags = {"op": self.name}
+            inst["data_blocks"].inc(tags=tags)
+            if rows:
+                inst["data_rows"].inc(rows, tags=tags)
+            if size:
+                inst["data_bytes"].inc(size, tags=tags)
+        except Exception:
+            pass
 
     def line(self, index: int) -> str:
         return (

@@ -46,10 +46,32 @@ import time
 
 import msgpack
 
+from ray_tpu._private import flight_recorder
 from ray_tpu._private.concurrency import any_thread, blocking
 from ray_tpu.exceptions import RayTpuError
 
 logger = logging.getLogger(__name__)
+
+
+class _ChannelStats:
+    """Plain-int channel counters — compiled iterations are the hottest
+    loop in the runtime (built to shed per-iteration overhead), so writes
+    must not pay an instrument lock or tag-dict per envelope. Folded into
+    ray_tpu_channel_* instruments at metrics-flush cadence
+    (self_metrics collector), like rpc.WIRE and lease_manager.LEASE_STATS.
+    last_occupancy is the ring depth observed at the most recent sampled
+    write (process-wide: a per-channel gauge tag would accumulate one stale
+    series per torn-down channel forever)."""
+
+    __slots__ = ("writes", "backpressure", "last_occupancy")
+
+    def __init__(self):
+        self.writes = 0
+        self.backpressure = 0
+        self.last_occupancy = 0
+
+
+CHANNEL_STATS = _ChannelStats()
 
 HEADER_SIZE = 64
 _OFF_WRITE = 0
@@ -283,6 +305,16 @@ class ChannelWriter(_Endpoint):
             self._write_shm(env, deadline, stop)
         else:
             self._write_remote(env, deadline, stop)
+        # Plain-int accounting per write; the flight event and occupancy
+        # probe are 1-in-64 sampled (channel_block fires unsampled — it is
+        # rare and is the signal that matters).
+        writes = CHANNEL_STATS.writes = CHANNEL_STATS.writes + 1
+        if writes & 63 == 0:
+            flight_recorder.record("channel_write", f"{self.label}:n={writes}")
+            if self.shm:
+                CHANNEL_STATS.last_occupancy = (
+                    self._u64(_OFF_WRITE) - self._u64(_OFF_READ)
+                )
 
     @blocking
     def wait_writable(self, timeout: float | None = None, stop=None) -> None:
@@ -310,6 +342,10 @@ class ChannelWriter(_Endpoint):
         time.sleep(interval)
 
     def _write_shm(self, env: bytes, deadline, stop):
+        if self._u64(_OFF_WRITE) - self._u64(_OFF_READ) >= self.num_slots:
+            # Backpressure entry (once per blocked write, not per poll tick).
+            flight_recorder.record("channel_block", self.label)
+            CHANNEL_STATS.backpressure += 1
         while self._u64(_OFF_WRITE) - self._u64(_OFF_READ) >= self.num_slots:
             self._wait_tick(deadline, stop, _FULL_POLL_S)
         self._check_closed(stop)

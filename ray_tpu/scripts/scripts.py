@@ -339,6 +339,35 @@ def cmd_timeline(args):
     print(f"Wrote {len(events)} events to {args.output}")
 
 
+def cmd_debug(args):
+    """``ray_tpu debug dump``: collect every process's flight-recorder ring
+    cluster-wide (via the raylets' ``debug_dump`` RPC — mmap-backed rings, so
+    SIGKILLed workers' final events are included) and merge them with the
+    GCS task events into one Chrome-trace JSON."""
+    from ray_tpu._private.state import GlobalState
+
+    host, port = _gcs_address(args.address).rsplit(":", 1)
+    state = GlobalState(gcs_address=(host, int(port)))
+    try:
+        if args.debug_cmd == "dump":
+            flight = state.flight_recorder_dump()
+            trace = state.chrome_tracing_dump(
+                filename=args.output, flight_events=flight
+            )
+            by_type: dict[str, int] = {}
+            for ev in flight:
+                by_type[ev["type"]] = by_type.get(ev["type"], 0) + 1
+            procs = {(ev.get("node_id"), ev.get("pid"), ev.get("role")) for ev in flight}
+            print(
+                f"Wrote {len(trace)} trace events ({len(flight)} flight events "
+                f"from {len(procs)} processes) to {args.output}"
+            )
+            for etype in sorted(by_type):
+                print(f"  {etype:16} {by_type[etype]}")
+    finally:
+        state.close()
+
+
 def cmd_list(args):
     from ray_tpu.util.state import api as state_api
 
@@ -800,6 +829,13 @@ def main(argv=None):
     p.add_argument("--address", default=None)
     p.add_argument("-o", "--output", default="timeline.json")
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("debug", help="flight-recorder postmortem tooling")
+    dsub = p.add_subparsers(dest="debug_cmd", required=True)
+    dd = dsub.add_parser("dump", help="merge cluster flight rings + task events into a Chrome trace")
+    dd.add_argument("--address", default=None)
+    dd.add_argument("-o", "--output", default="flight_dump.json")
+    p.set_defaults(fn=cmd_debug)
 
     p = sub.add_parser("list", help="state API listing")
     p.add_argument(

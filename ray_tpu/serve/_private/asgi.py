@@ -156,12 +156,15 @@ class ProxyASGIApp:
         import ray_tpu
 
         def call():
+            import time as _time
+
             from ray_tpu.serve._private.common import MULTIPLEXED_MODEL_ID_HEADER
 
             model_id = next(
                 (v for k, v in headers.items() if k.lower() == MULTIPLEXED_MODEL_ID_HEADER),
                 "",
             )
+            t0 = _time.monotonic()
             replica = self._router.assign_replica(deployment, model_id=model_id)
             try:
                 actor = self._router.handle_for(replica)
@@ -171,13 +174,15 @@ class ProxyASGIApp:
                 )
                 result = ray_tpu.get(ref, timeout=120)
             except BaseException:
-                self._router.release(replica)
+                self._router.release(replica, deployment=deployment)
                 raise
             if isinstance(result, dict) and "__serve_stream__" in result:
                 # Streaming: the replica stays assigned (queue metrics + its
                 # generator live there) until the pump finishes.
                 return replica, result
-            self._router.release(replica)
+            self._router.release(
+                replica, deployment=deployment, duration_s=_time.monotonic() - t0
+            )
             return None, result
 
         try:
@@ -231,7 +236,7 @@ class ProxyASGIApp:
                     actor.cancel_stream.remote(sid)
                 except Exception:
                     pass
-            self._router.release(replica)
+            self._router.release(replica, deployment=deployment)
         await send({"type": "http.response.body", "body": b"", "more_body": False})
 
 

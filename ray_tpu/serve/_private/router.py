@@ -13,6 +13,7 @@ import threading
 import time
 
 import ray_tpu
+from ray_tpu._private import self_metrics
 
 logger = logging.getLogger(__name__)
 
@@ -31,6 +32,7 @@ class Router:
         self._handles: dict[str, object] = {}  # actor_name -> handle
         self._rr: dict[str, int] = {}
         self._inflight: dict[str, int] = {}  # replica actor_name -> count
+        self._metrics = self_metrics.instruments()
         self._lock = threading.Lock()
         self._update_event = threading.Event()
         self._poll_thread = threading.Thread(target=self._poll_loop, daemon=True)
@@ -122,6 +124,13 @@ class Router:
                             if not model_id:
                                 self._rr[deployment] = (start + i + 1) % n
                             self._inflight[name] = self._inflight.get(name, 0) + 1
+                            try:
+                                self._metrics["serve_requests"].inc(
+                                    tags={"deployment": deployment}
+                                )
+                                self._set_queue_depth_locked(deployment)
+                            except Exception:
+                                pass
                             return r
             if time.time() >= deadline:
                 raise TimeoutError(
@@ -130,10 +139,34 @@ class Router:
                 )
             time.sleep(0.01)
 
-    def release(self, replica):
+    def _set_queue_depth_locked(self, deployment: str):
+        """Refresh the deployment's in-flight gauge (caller holds _lock).
+        Updated on BOTH assign and release — a gauge only set on assign
+        would report the peak depth forever once traffic stops."""
+        entry = self._table.get(deployment)
+        if entry is None:
+            return
+        self._metrics["serve_queue_depth"].set(
+            sum(self._inflight.get(r["actor_name"], 0) for r in entry["replicas"]),
+            tags={"deployment": deployment},
+        )
+
+    def release(self, replica, deployment: str | None = None, duration_s: float | None = None):
         with self._lock:
             name = replica["actor_name"]
             self._inflight[name] = max(0, self._inflight.get(name, 0) - 1)
+            if deployment is not None:
+                try:
+                    self._set_queue_depth_locked(deployment)
+                except Exception:
+                    pass
+        if deployment is not None and duration_s is not None:
+            try:
+                self._metrics["serve_latency"].observe(
+                    duration_s, tags={"deployment": deployment}
+                )
+            except Exception:
+                pass
 
     def handle_for(self, replica) -> object:
         name = replica["actor_name"]

@@ -43,7 +43,10 @@ class DeploymentHandle:
         return _MethodCaller(self, item)
 
     def _invoke(self, method: str, args: tuple, kwargs: dict):
+        import time
+
         model_id = self._multiplexed_model_id
+        t0 = time.monotonic()
         replica = self._router.assign_replica(self._deployment, model_id=model_id)
         try:
             actor = self._router.handle_for(replica)
@@ -51,17 +54,21 @@ class DeploymentHandle:
                 method, args, kwargs, multiplexed_model_id=model_id
             )
         except Exception:
-            self._router.release(replica)
+            self._router.release(replica, deployment=self._deployment)
             self._router.invalidate_handle(replica)
             raise
-        # Release the slot once the result lands (fire-and-forget waiter).
+        # Release the slot once the result lands (fire-and-forget waiter);
+        # the assign->result interval feeds ray_tpu_serve_replica_latency_s.
         router = self._router
+        deployment = self._deployment
 
         def _release():
             try:
                 ray_tpu.wait([ref], num_returns=1, timeout=3600, fetch_local=False)
             finally:
-                router.release(replica)
+                router.release(
+                    replica, deployment=deployment, duration_s=time.monotonic() - t0
+                )
 
         threading.Thread(target=_release, daemon=True).start()
         return ref

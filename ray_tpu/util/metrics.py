@@ -20,6 +20,13 @@ import time
 _REGISTRY_LOCK = threading.Lock()
 _REGISTRY: dict[str, "Metric"] = {}
 _FLUSHER: threading.Thread | None = None
+# Collector hooks: called right before every flush/snapshot so cheap plain-int
+# hot-path counters (e.g. rpc.WIRE wire stats) can be folded into instruments
+# at flush frequency instead of paying instrument-lock costs per frame.
+_COLLECTORS: list = []
+# Fallback flush target for processes with no CoreWorker (a standalone
+# raylet): (gcs_client, node_id, entity_id).
+_FALLBACK_TARGET: tuple | None = None
 
 
 def _tag_key(tags: dict | None) -> tuple:
@@ -151,6 +158,31 @@ class Histogram(Metric):
             }
 
 
+def register_collector(fn) -> None:
+    """Register a zero-arg hook invoked before every flush/snapshot. Lets
+    hot paths keep plain-int counters (no instrument lock per event) that a
+    collector folds into Counters/Gauges at flush cadence."""
+    with _REGISTRY_LOCK:
+        if fn not in _COLLECTORS:
+            _COLLECTORS.append(fn)
+
+
+def set_fallback_flush_target(gcs_client, node_id: str, entity_id: str) -> None:
+    """Flush destination for processes that never build a CoreWorker (a
+    standalone raylet): snapshots land under ``metrics:<entity_id>`` exactly
+    like worker snapshots."""
+    global _FALLBACK_TARGET
+    _FALLBACK_TARGET = (gcs_client, node_id, entity_id)
+
+
+def _run_collectors():
+    for fn in list(_COLLECTORS):
+        try:
+            fn()
+        except Exception:
+            pass
+
+
 def _ensure_flusher():
     global _FLUSHER
     with _REGISTRY_LOCK:
@@ -158,41 +190,64 @@ def _ensure_flusher():
             return
         _FLUSHER = threading.Thread(target=_flush_loop, name="metrics-flush", daemon=True)
         _FLUSHER.start()
+    import atexit
+
+    # A short-lived worker's final window must not vanish: the periodic
+    # flusher only pushes every metrics_flush_interval_s, so a process that
+    # exits mid-window would lose everything it recorded since the last tick.
+    atexit.register(_flush_at_exit)
+
+
+def _flush_at_exit():
+    try:
+        flush_metrics()
+    except Exception:
+        pass
 
 
 def _flush_loop():
-    from ray_tpu._private import worker_context
     from ray_tpu._private.config import get_config
 
+    first = True
     while True:
         # Re-read each tick: init_config() may replace the Config after the
-        # first Metric (and thus this thread) was created.
-        time.sleep(get_config().metrics_flush_interval_s)
-        cw = worker_context.get_core_worker_if_initialized()
-        if cw is None:
-            continue
+        # first Metric (and thus this thread) was created. The FIRST flush
+        # runs within ~1s of registration — a worker that lives less than a
+        # full interval otherwise never exports anything.
+        interval = get_config().metrics_flush_interval_s
+        time.sleep(min(1.0, interval) if first else interval)
+        first = False
         try:
-            flush_metrics(cw)
+            flush_metrics()
         except Exception:
             pass
 
 
 def flush_metrics(core_worker=None):
     """Push this process's metric snapshots into the GCS KV (used by tests and
-    the background flusher)."""
+    the background flusher). Falls back to the target registered via
+    set_fallback_flush_target when no CoreWorker exists; no-op when neither
+    is available."""
     from ray_tpu._private import worker_context
 
-    cw = core_worker or worker_context.get_core_worker()
+    cw = core_worker or worker_context.get_core_worker_if_initialized()
+    if cw is not None:
+        gcs, node_id, entity = cw.gcs, cw.node_id, cw.worker_id
+    elif _FALLBACK_TARGET is not None:
+        gcs, node_id, entity = _FALLBACK_TARGET
+    else:
+        return
+    _run_collectors()
     with _REGISTRY_LOCK:
         snap = {name: m._snapshot() for name, m in _REGISTRY.items()}
     if not snap:
         return
     payload = json.dumps(
-        {"ts": time.time(), "node_id": cw.node_id, "metrics": snap}
+        {"ts": time.time(), "node_id": node_id, "metrics": snap}
     ).encode()
-    cw.gcs.call(
+    gcs.call(
         "kv_put",
-        {"key": f"metrics:{cw.worker_id}", "value": payload, "overwrite": True},
+        {"key": f"metrics:{entity}", "value": payload, "overwrite": True},
     )
 
 
@@ -247,4 +302,51 @@ def prometheus_text(gcs_client, stale_after_s: float = 60.0) -> str:
                 lines.append(f"{name}_count{{{label}}} {value['count']}")
             else:
                 lines.append(f"{name}{{{label}}} {value}")
+    lines.extend(_node_gauge_lines(gcs_client))
     return "\n".join(lines) + "\n"
+
+
+def _node_gauge_lines(gcs_client) -> list[str]:
+    """Synthesize ``ray_tpu_node_*`` gauges from the dashboard agent's node
+    samples (GCS node table ``stats``) — host CPU/memory and per-worker RSS
+    were previously reachable only via ``/api/cluster_status``."""
+    try:
+        nodes = gcs_client.call("get_nodes").get("nodes", {})
+    except Exception:
+        return []
+    host_gauges = [
+        ("ray_tpu_node_cpu_percent", "cpu_percent", "Host CPU utilization percent."),
+        ("ray_tpu_node_mem_used_bytes", "mem_used", "Host memory used in bytes."),
+        ("ray_tpu_node_mem_total_bytes", "mem_total", "Host memory total in bytes."),
+        ("ray_tpu_node_disk_used_bytes", "disk_used", "Session-dir disk used in bytes."),
+        ("ray_tpu_node_disk_total_bytes", "disk_total", "Session-dir disk total in bytes."),
+    ]
+    lines: list[str] = []
+    for metric, key, help_text in host_gauges:
+        samples = []
+        for nid, node in nodes.items():
+            stats = node.get("stats") or {}
+            if node.get("state") == "ALIVE" and key in stats:
+                samples.append((nid[:8], stats[key]))
+        if samples:
+            lines.append(f"# HELP {metric} {help_text}")
+            lines.append(f"# TYPE {metric} gauge")
+            for nid, value in samples:
+                lines.append(f'{metric}{{NodeId="{_escape(nid)}"}} {value}')
+    rss = []
+    for nid, node in nodes.items():
+        stats = node.get("stats") or {}
+        if node.get("state") != "ALIVE":
+            continue
+        for wid, w in (stats.get("workers") or {}).items():
+            if "rss" in w:
+                rss.append((nid[:8], wid[:8], w.get("pid", 0), w["rss"]))
+    if rss:
+        lines.append("# HELP ray_tpu_node_worker_rss_bytes Per-worker resident set size in bytes.")
+        lines.append("# TYPE ray_tpu_node_worker_rss_bytes gauge")
+        for nid, wid, pid, value in rss:
+            lines.append(
+                f'ray_tpu_node_worker_rss_bytes{{NodeId="{_escape(nid)}",'
+                f'WorkerId="{_escape(wid)}",pid="{pid}"}} {value}'
+            )
+    return lines

@@ -255,6 +255,59 @@ def drain_hop_records() -> list[dict]:
     return cw.drain_hop_records()
 
 
+def hop_trace_events(records: list[dict], mono_to_wall: float | None = None) -> list[dict]:
+    """Convert hop records into Chrome-trace events that render causally
+    next to task rows: per-stage ``X`` slices on a ``hop:<path>`` track plus
+    a flow arrow (``s``/``f``) from submit to wake, so a dispatch's wire
+    hops line up under the task that caused them.
+
+    ``mono_to_wall`` converts monotonic stamps onto the wall-clock axis the
+    task events use; stamps from every process on a host share
+    CLOCK_MONOTONIC, so one offset suffices. Records whose stamps span an
+    impossible interval are dropped: a record mixing stamps from hosts with
+    different monotonic epochs (multi-node classic dispatch) would sort its
+    stages by boot-time delta, not causality, and render garbage."""
+    import time as _time
+
+    if mono_to_wall is None:
+        mono_to_wall = _time.time() - _time.monotonic()
+    events: list[dict] = []
+    for n, rec in enumerate(records):
+        stamps = sorted((v, k) for k, v in rec.items() if isinstance(v, float))
+        if len(stamps) < 2:
+            continue
+        if stamps[-1][0] - stamps[0][0] > 600.0:
+            continue  # cross-host monotonic epochs — not renderable
+        path = rec.get("path", "classic")
+        pid = f"hop:{path}"
+        tid = rec.get("name", "dispatch")
+        flow_id = (hash(rec.get("task_id") or f"{tid}:{n}") & 0x7FFFFFFF) or 1
+        for (va, ka), (vb, kb) in zip(stamps, stamps[1:]):
+            events.append(
+                {
+                    "name": f"{ka}->{kb}",
+                    "cat": "hop",
+                    "ph": "X",
+                    "ts": (va + mono_to_wall) * 1e6,
+                    "dur": max(vb - va, 0) * 1e6,
+                    "pid": pid,
+                    "tid": tid,
+                    "args": {"task_id": rec.get("task_id"), "path": path},
+                }
+            )
+        first_ts = (stamps[0][0] + mono_to_wall) * 1e6
+        last_ts = (stamps[-1][0] + mono_to_wall) * 1e6
+        events.append(
+            {"name": "dispatch", "cat": "hop", "ph": "s", "id": flow_id,
+             "ts": first_ts, "pid": pid, "tid": tid}
+        )
+        events.append(
+            {"name": "dispatch", "cat": "hop", "ph": "f", "bp": "e", "id": flow_id,
+             "ts": last_ts, "pid": pid, "tid": tid}
+        )
+    return events
+
+
 def export_spans(address=None) -> list[dict]:
     """Reconstruct spans from the task-event log: one span per task with
     trace/span/parent ids, name, timestamps, and status."""

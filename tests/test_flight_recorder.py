@@ -1,0 +1,204 @@
+"""Flight recorder: ring mechanics, crash survival, cluster merge, postmortem.
+
+Covers ISSUE 8's observability plane: ring wrap, dump-on-signal, merge
+ordering by stamp, the worker/raylet ``debug_dump`` RPCs, the dashboard
+endpoint, and the acceptance scenario — a SIGKILLed worker's final ring
+events surfacing in the merged cluster dump."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from ray_tpu._private import flight_recorder as fr
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_ring_wrap_and_parse(tmp_path):
+    path = str(tmp_path / "flight" / "flight-1-test.bin")
+    rec = fr.FlightRecorder(path, slots=8, role="test", ident="abc")
+    for i in range(20):
+        rec.record(fr._CODE["mark"], f"ev{i}")
+    events = rec.dump()
+    # Ring holds the NEWEST 8 of 20; seq keeps the absolute position.
+    assert len(events) == 8
+    assert [e["detail"] for e in events] == [f"ev{i}" for i in range(12, 20)]
+    assert [e["seq"] for e in events] == list(range(12, 20))
+    monos = [e["mono"] for e in events]
+    assert monos == sorted(monos)
+    # The backing file parses to the same events (what a post-SIGKILL
+    # collector sees).
+    parsed = fr.parse_file(path)
+    assert parsed is not None
+    assert parsed["role"] == "test" and parsed["ident"] == "abc"
+    assert [e["detail"] for e in parsed["events"]] == [e["detail"] for e in events]
+    rec.close()
+
+
+def test_parse_rejects_bogus_files(tmp_path):
+    bogus = tmp_path / "flight-2-x.bin"
+    bogus.write_bytes(b"not a flight ring")
+    assert fr.parse_file(str(bogus)) is None
+    (tmp_path / "flight-3-y.bin").write_bytes(b"")
+    assert fr.parse_file(str(tmp_path / "flight-3-y.bin")) is None
+    # collect_dir skips unparseable rings instead of raising.
+    assert fr.collect_dir(str(tmp_path.parent / "nonexistent")) == []
+
+
+def test_merge_ordering_by_stamp(tmp_path):
+    d = tmp_path / "flight"
+    a = fr.FlightRecorder(str(d / "flight-10-a.bin"), slots=16, role="a", ident="")
+    b = fr.FlightRecorder(str(d / "flight-11-b.bin"), slots=16, role="b", ident="")
+    expected = []
+    for i in range(6):
+        rec = a if i % 2 == 0 else b
+        rec.record(fr._CODE["mark"], f"i{i}")
+        expected.append(f"i{i}")
+        time.sleep(0.002)
+    merged = fr.merge_events(
+        [{**a.meta(), "events": a.dump()}, {**b.meta(), "events": b.dump()}]
+    )
+    # Same-host rings share the monotonic base: the interleaving survives
+    # the merge exactly.
+    assert [e["detail"] for e in merged] == expected
+    assert {e["role"] for e in merged} == {"a", "b"}
+    a.close()
+    b.close()
+
+
+def test_detail_truncation_and_unicode(tmp_path):
+    rec = fr.FlightRecorder(str(tmp_path / "f.bin"), slots=4, role="t", ident="")
+    rec.record(fr._CODE["mark"], "x" * 500)
+    rec.record(fr._CODE["mark"], "ünïcode→")
+    events = rec.dump()
+    assert events[0]["detail"] == "x" * fr._DETAIL_MAX
+    assert events[1]["detail"] == "ünïcode→"
+    rec.close()
+
+
+def test_dump_on_fatal_signal(tmp_path):
+    """install_signal_dump stamps a final fatal_signal event before the
+    process dies on SIGTERM; the mmap file shows it afterwards."""
+    import uuid
+
+    # Unique session name: flight_dir() keys the (tmpfs) ring dir by the
+    # session BASENAME, and pytest recycles tmp_path basenames across runs.
+    session = str(tmp_path / f"sess_{uuid.uuid4().hex[:10]}")
+    script = f"""
+import signal, os
+from ray_tpu._private import flight_recorder as fr
+fr._enabled = True
+fr.attach({session!r}, role="victim", ident="v1")
+fr.record("mark", "before-signal")
+fr.install_signal_dump([signal.SIGTERM])
+signal.raise_signal(signal.SIGTERM)
+raise SystemExit("unreachable: SIGTERM should have killed us")
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", script], cwd=REPO, capture_output=True, timeout=60
+    )
+    assert proc.returncode == -signal.SIGTERM, proc.stderr.decode()
+    procs = fr.collect_dir(session)
+    assert len(procs) == 1
+    types = [e["type"] for e in procs[0]["events"]]
+    assert types[-1] == "fatal_signal"
+    assert "mark" in types
+    assert procs[0]["events"][-1]["detail"] == "SIGTERM"
+
+
+def test_cluster_postmortem_sigkill(ray_start_regular):
+    """Acceptance: `debug dump` on a cluster with a SIGKILLed worker contains
+    that worker's final ring events, and they merge into the Chrome trace."""
+    import ray_tpu
+    from ray_tpu._private.state import GlobalState
+
+    @ray_tpu.remote
+    def whoami():
+        return os.getpid()
+
+    victim = ray_tpu.get(whoami.remote())
+    os.kill(victim, signal.SIGKILL)
+    deadline = time.time() + 10
+    events = []
+    while time.time() < deadline:
+        merged = GlobalState().flight_recorder_dump()
+        events = [e for e in merged if e["pid"] == victim]
+        if any(e["type"] == "task_exec" for e in events):
+            break
+        time.sleep(0.3)
+    assert any(e["type"] == "task_exec" for e in events), events
+    assert any("whoami" in e["detail"] for e in events if e["type"] == "task_exec")
+    # Driver-side ring shows the ship; raylet ring eventually shows the death.
+    assert any(e["type"] == "task_ship" and "whoami" in e["detail"] for e in merged)
+    # Merged Chrome trace carries the flight events next to task rows.
+    trace = GlobalState().chrome_tracing_dump(flight_events=merged)
+    flight_rows = [t for t in trace if t.get("cat") == "flight"]
+    assert any(t["name"] == "task_exec" for t in flight_rows)
+
+
+def test_debug_dump_rpcs(ray_start_regular):
+    """Worker/raylet debug_dump RPC surface: the driver's own core-worker
+    server answers with its ring; the raylet answers node-wide."""
+    import ray_tpu
+    from ray_tpu._private import worker_context
+    from ray_tpu._private.rpc import RpcClient
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ray_tpu.get(f.remote())
+    cw = worker_context.get_core_worker()
+    client = RpcClient(tuple(cw.address), label="test-debug")
+    try:
+        own = client.call("debug_dump", {})
+    finally:
+        client.close()
+    assert len(own["processes"]) == 1
+    assert any(e["type"] == "task_ship" for e in own["processes"][0]["events"])
+
+    node = cw.raylet.call("debug_dump", {})
+    assert len(node["processes"]) >= 2  # head process + >= 1 worker
+    roles = {p["role"] for p in node["processes"]}
+    assert any("raylet" in r for r in roles)
+    assert any("worker" in r for r in roles)
+
+
+def test_dashboard_flight_recorder_endpoint(ray_start_regular):
+    import ray_tpu
+    from ray_tpu._private import worker_context
+    from ray_tpu.dashboard import DashboardHead
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ray_tpu.get(f.remote())
+    cw = worker_context.get_core_worker()
+    head = DashboardHead(cw.gcs.address, cw.session_dir)
+    try:
+        url = "http://%s:%d/api/v0/debug/flight_recorder" % head.address
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            body = json.loads(resp.read())
+        events = body["result"]
+        assert any(e["type"] == "task_exec" for e in events)
+    finally:
+        head.stop()
+
+
+def test_ring_disabled_via_env(tmp_path):
+    old = fr._enabled
+    try:
+        fr.set_enabled(False)
+        fr.record("mark", "dropped")  # must not raise, must not buffer
+        assert fr.dump() is None or all(
+            e["detail"] != "dropped" for e in fr.dump()["events"]
+        )
+    finally:
+        fr._enabled = old

@@ -41,6 +41,43 @@ def test_microbench_smoke(tmp_path):
         assert data.get(key, 0) > 0, f"{key} missing/zero in smoke artifact: {data}"
 
 
+def test_recorder_overhead_smoke(tmp_path):
+    """<30s --recorder-overhead --quick pass: the always-on observability
+    plane (flight recorder + 1-in-64 hop sampling) A/Bs against itself in
+    one cluster and stays under a lenient bound. The committed artifact
+    (OBSBENCH_r8.json, 150 pairs) records ~2%; the bound here is loose
+    because this 1-core CI box shows +-10% single-pair noise and the quick
+    pass only runs 8 pairs — it exists to catch an accidental O(task)
+    instrumentation blowup (e.g. a per-task lock or RPC), not to re-certify
+    the 3% acceptance number."""
+    out = tmp_path / "obsbench.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", RAY_TPU_NUM_TPUS="0")
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "microbench.py"),
+            "--recorder-overhead",
+            "--quick",
+            "--out",
+            str(out),
+        ],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=240,
+    )
+    assert proc.returncode == 0, (
+        f"microbench --recorder-overhead failed (rc={proc.returncode})\n"
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    data = json.loads(out.read_text())
+    assert data.get("recorder_on_task_sync_per_s", 0) > 0
+    assert data.get("recorder_off_task_sync_per_s", 0) > 0
+    assert len(data.get("recorder_pair_ratios", [])) >= 4
+    assert data["recorder_overhead_pct"] < 25.0, data
+
+
 def test_microbench_dag_smoke(tmp_path):
     """<30s classic-vs-compiled DAG case (microbench.py --dag --quick):
     both paths produce throughput numbers, and the compiled loop's

@@ -224,3 +224,251 @@ def test_global_state_resources(ray_start_regular):
     assert len(state.nodes()) == 1
     live = state.node_state(state.nodes()[0])
     assert "store" in live and "workers" in live
+
+
+# ---------------------------------------------------------------------------
+# Runtime self-metrics (ISSUE 8): the ray_tpu_* instrument plane
+# ---------------------------------------------------------------------------
+
+import re
+
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"          # metric name
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'   # first label
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})?'  # more labels
+    r" -?[0-9.eE+-]+(?:inf|nan)?$"         # value
+)
+
+
+def _parse_exposition(text: str):
+    """Strict Prometheus text-format check. Returns
+    {name: {"type": kind, "samples": [(sample_name, labels_str, value)]}}."""
+    families: dict = {}
+    declared_help: set = set()
+    current = None
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            declared_help.add(line.split()[2])
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(None, 3)
+            assert name not in families, f"duplicate TYPE for {name}"
+            families[name] = {"type": kind, "samples": []}
+            current = name
+            continue
+        assert not line.startswith("#"), f"unknown comment line: {line!r}"
+        assert _SAMPLE_RE.match(line), f"malformed sample line: {line!r}"
+        sample_name = line.split("{", 1)[0].split(" ", 1)[0]
+        base = re.sub(r"_(bucket|sum|count)$", "", sample_name)
+        owner = sample_name if sample_name in families else base
+        assert owner in families, f"sample {sample_name!r} precedes its TYPE"
+        labels = ""
+        if "{" in line:
+            labels = line[line.index("{") + 1 : line.rindex("}")]
+        value = float(line.rsplit(" ", 1)[1])
+        families[owner]["samples"].append((sample_name, labels, value))
+    for name in families:
+        assert name in declared_help, f"TYPE without HELP for {name}"
+    return families
+
+
+def _check_histograms(families):
+    for name, fam in families.items():
+        if fam["type"] != "histogram":
+            continue
+        by_series: dict = {}
+        for sample_name, labels, value in fam["samples"]:
+            base_labels = ",".join(
+                p for p in labels.split(",") if not p.startswith("le=")
+            )
+            entry = by_series.setdefault(base_labels, {"buckets": [], "count": None})
+            if sample_name.endswith("_bucket"):
+                le = [p for p in labels.split(",") if p.startswith("le=")][0]
+                entry["buckets"].append((le.split("=")[1].strip('"'), value))
+            elif sample_name.endswith("_count"):
+                entry["count"] = value
+        for labels, entry in by_series.items():
+            counts = [v for _le, v in entry["buckets"]]
+            assert counts == sorted(counts), f"{name}{{{labels}}} buckets not monotonic"
+            inf = [v for le, v in entry["buckets"] if le == "+Inf"]
+            assert inf, f"{name}{{{labels}}} missing +Inf bucket"
+            assert inf[0] == entry["count"], (
+                f"{name}{{{labels}}} +Inf bucket {inf[0]} != count {entry['count']}"
+            )
+
+
+def test_runtime_metrics_in_exposition(ray_start_regular):
+    """With NO user instruments, /metrics exposes >= 10 distinct ray_tpu_*
+    runtime families (lease, dispatch histogram, store, rpc) — and the whole
+    body is strictly valid Prometheus text exposition."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private import worker_context
+    from ray_tpu.util import metrics
+
+    @ray_tpu.remote
+    def f(i):
+        return i
+
+    # >= 2 sampled dispatches at the default 1-in-64 rate.
+    ray_tpu.get([f.remote(i) for i in range(130)])
+    # A plasma-sized object so the store seals + the gauges move.
+    ray_tpu.put(np.zeros(300_000, dtype=np.uint8))
+    time.sleep(1.2)  # one heartbeat (store gauges) + agent sample
+    cw = worker_context.get_core_worker()
+    metrics.flush_metrics(cw)
+    text = metrics.prometheus_text(cw.gcs)
+
+    families = _parse_exposition(text)
+    _check_histograms(families)
+
+    populated = {
+        name for name, fam in families.items()
+        if name.startswith("ray_tpu_") and fam["samples"]
+    }
+    assert len(populated) >= 10, sorted(populated)
+    for required in (
+        "ray_tpu_lease_grants_total",
+        "ray_tpu_lease_reuses_total",
+        "ray_tpu_lease_tasks_total",
+        "ray_tpu_dispatch_latency_s",
+        "ray_tpu_store_seals_total",
+        "ray_tpu_store_bytes_used",
+        "ray_tpu_rpc_frames_total",
+        "ray_tpu_rpc_bytes_total",
+        "ray_tpu_rpc_connects_total",
+    ):
+        assert required in populated, f"{required} missing; have {sorted(populated)}"
+    # The dispatch histogram carries a path tag and real observations.
+    hist = families["ray_tpu_dispatch_latency_s"]
+    assert hist["type"] == "histogram"
+    assert any("path=" in labels for _n, labels, _v in hist["samples"])
+    # Warm-lease hit ratio is computable and sane: reuses <= tasks.
+    def total(name):
+        return sum(v for _n, _l, v in families[name]["samples"])
+
+    assert 0 < total("ray_tpu_lease_reuses_total") <= total("ray_tpu_lease_tasks_total")
+
+
+def test_node_gauges_from_agent_samples(ray_start_regular):
+    """Dashboard-agent node samples export as ray_tpu_node_* gauges (they
+    were previously reachable only via /api/cluster_status)."""
+    from ray_tpu._private import worker_context
+    from ray_tpu.util import metrics
+
+    cw = worker_context.get_core_worker()
+    deadline = time.time() + 15
+    text = ""
+    while time.time() < deadline:
+        text = metrics.prometheus_text(cw.gcs)
+        if "ray_tpu_node_cpu_percent" in text:
+            break
+        time.sleep(0.5)
+    families = _parse_exposition(text)
+    for name in ("ray_tpu_node_cpu_percent", "ray_tpu_node_mem_used_bytes", "ray_tpu_node_mem_total_bytes"):
+        assert name in families and families[name]["samples"], name
+        assert all("NodeId=" in l for _n, l, _v in families[name]["samples"])
+    mem_total = families["ray_tpu_node_mem_total_bytes"]["samples"][0][2]
+    assert mem_total > 1024**3  # a real host figure, not a placeholder
+
+
+def test_serve_and_data_metric_wiring(ray_start_regular):
+    """The Serve-router and Data-operator hooks feed the shared registry and
+    come out of /metrics (unit-level: no Serve/Data cluster needed)."""
+    from ray_tpu._private import self_metrics, worker_context
+    from ray_tpu.data._internal.stats import OpStats
+    from ray_tpu.serve._private.router import Router
+    from ray_tpu.util import metrics
+
+    import threading
+
+    router = object.__new__(Router)
+    router._table = {
+        "app": {"replicas": [{"actor_name": "r1", "max_concurrent_queries": 4}], "route_prefix": "/"}
+    }
+    router._inflight = {}
+    router._rr = {}
+    router._lock = threading.Lock()
+    router._metrics = self_metrics.instruments()
+    replica = router.assign_replica("app", timeout_s=1)
+    router.release(replica, deployment="app", duration_s=0.01)
+
+    class _Meta:
+        num_rows = 42
+        size_bytes = 1000
+
+    stats = OpStats(name="map_test")
+    stats.mark_start()
+    stats.record_output(_Meta())
+
+    cw = worker_context.get_core_worker()
+    metrics.flush_metrics(cw)
+    text = metrics.prometheus_text(cw.gcs)
+    families = _parse_exposition(text)
+    assert families["ray_tpu_serve_requests_total"]["samples"]
+    assert families["ray_tpu_serve_router_queue_depth"]["samples"]
+    assert families["ray_tpu_serve_replica_latency_s"]["samples"]
+    rows = [v for _n, l, v in families["ray_tpu_data_output_rows_total"]["samples"] if 'op="map_test"' in l]
+    assert rows == [42.0]
+
+
+def test_timeline_hop_flow_events(ray_start_regular):
+    """`ray_tpu timeline` renders hop records as per-stage slices plus flow
+    arrows when records are present (full hop timing here; the sampled path
+    produces the identical record shape)."""
+    import ray_tpu
+    from ray_tpu._private.config import get_config
+    from ray_tpu._private import worker_context
+
+    get_config().hop_timing = True
+    try:
+        @ray_tpu.remote
+        def traced():
+            return 1
+
+        ray_tpu.get([traced.remote() for _ in range(3)])
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if worker_context.get_core_worker().hop_records():
+                break
+            time.sleep(0.1)
+        events = ray_tpu.timeline()
+    finally:
+        get_config().hop_timing = False
+    hop = [e for e in events if e.get("cat") == "hop"]
+    assert any(e["ph"] == "X" for e in hop)
+    flows = [e for e in hop if e["ph"] in ("s", "f")]
+    assert flows and {e["ph"] for e in flows} == {"s", "f"}
+    # Stage slices land on a per-path track with wall-clock timestamps.
+    assert any(str(e.get("pid", "")).startswith("hop:") for e in hop)
+
+
+def test_compiled_dag_channel_metrics(ray_start_regular):
+    """Compiled-graph channel writes surface as ray_tpu_channel_* series."""
+    import ray_tpu
+    from ray_tpu._private import worker_context
+    from ray_tpu.dag import InputNode
+    from ray_tpu.util import metrics
+
+    @ray_tpu.remote
+    class Stage:
+        def work(self, x):
+            return x + 1
+
+    with InputNode() as inp:
+        dag = Stage.bind().work.bind(inp)
+    compiled = dag.experimental_compile()
+    try:
+        for i in range(3):
+            assert compiled.execute(i).get() == i + 1
+    finally:
+        compiled.teardown()
+    cw = worker_context.get_core_worker()
+    metrics.flush_metrics(cw)
+    text = metrics.prometheus_text(cw.gcs)
+    families = _parse_exposition(text)
+    writes = families["ray_tpu_channel_writes_total"]["samples"]
+    assert writes and writes[0][2] >= 3
