@@ -197,6 +197,106 @@ def dag_suite(results, duration):
         os.environ.pop("RAY_TPU_HOP_TIMING", None)
 
 
+def device_objects_suite(results, duration):
+    """--device-objects: device-ref handoff vs host-shm put/get (ISSUE 9
+    acceptance artifact, DEVBENCH_r{N}.json).
+
+    Same-process: ``put(arr, tensor_transport="collective")`` seals only a
+    ~300-byte descriptor and ``get`` hands back the LIVE array — the
+    before/after contrast is the host path's serialize→shm→deserialize
+    round trip at 1 MiB / 32 MiB. Control-plane evidence rides along: the
+    node store's object count across the device loop (must be 0 — zero shm
+    copies of the payload) and the plane's own transfer counters.
+    Actor→actor: a tensor_transport holder hands a 1 MiB ref to a consumer
+    actor over a shared cpu collective group (on this CPU testbed the p2p
+    mailbox rides the GCS KV — a correctness stand-in for the ICI path, so
+    absolute throughput is NOT the device-plane claim; zero host-shm
+    payload traffic is)."""
+    import ray_tpu
+    from ray_tpu._private import worker_context
+    from ray_tpu.experimental.device_object import device_object_stats
+
+    ray_tpu.init(num_cpus=4, object_store_memory=512 * 1024 * 1024)
+    import jax.numpy as jnp
+
+    cw = worker_context.get_core_worker()
+
+    def store_objects() -> int:
+        return cw.raylet.call("get_state")["store"]["num_objects"]
+
+    for mib in (1, 32):
+        arr = jnp.zeros(mib * 1024 * 1024 // 4, jnp.float32)
+        arr.block_until_ready()
+        results[f"host_putget_{mib}mib_per_s"] = round(
+            timeit(lambda: ray_tpu.get(ray_tpu.put(arr)), duration), 1
+        )
+
+        def dev_roundtrip():
+            out = ray_tpu.get(ray_tpu.put(arr, tensor_transport="collective"))
+            assert out is arr  # live array, zero payload copies
+
+        before = store_objects()
+        t0 = device_object_stats()
+        results[f"devobj_putget_{mib}mib_per_s"] = round(timeit(dev_roundtrip, duration), 1)
+        t1 = device_object_stats()
+        results[f"devobj_putget_{mib}mib_store_objects_delta"] = store_objects() - before
+        results[f"devobj_putget_{mib}mib_local_transfers"] = (
+            t1["transfers_local"] - t0["transfers_local"]
+        )
+
+    # Actor→actor 1 MiB handoff: host-shm path vs device plane + collective.
+    @ray_tpu.remote
+    class HostHolder:
+        def make(self):
+            import jax.numpy as jnp
+
+            return jnp.zeros(1024 * 1024 // 4, jnp.float32)
+
+    @ray_tpu.remote(tensor_transport="collective")
+    class DevHolder:
+        def make(self):
+            import jax.numpy as jnp
+
+            return jnp.zeros(1024 * 1024 // 4, jnp.float32)
+
+        def init_collective(self, world_size, rank, backend, group_name):
+            from ray_tpu.util import collective as col
+
+            col.init_collective_group(world_size, rank, backend=backend, group_name=group_name)
+
+    @ray_tpu.remote
+    class Consumer:
+        def init_collective(self, world_size, rank, backend, group_name):
+            from ray_tpu.util import collective as col
+
+            col.init_collective_group(world_size, rank, backend=backend, group_name=group_name)
+
+        def consume(self, w):
+            return float(w[0])
+
+    from ray_tpu.util import collective as col
+
+    host_holder, dev_holder, consumer = HostHolder.remote(), DevHolder.remote(), Consumer.remote()
+    col.create_collective_group(
+        [dev_holder, consumer], backend="cpu", group_name="devbench"
+    )
+    results["handoff_host_1mib_per_s"] = round(
+        timeit(
+            lambda: ray_tpu.get(consumer.consume.remote(host_holder.make.remote())),
+            duration,
+        ),
+        1,
+    )
+    results["handoff_devobj_1mib_per_s"] = round(
+        timeit(
+            lambda: ray_tpu.get(consumer.consume.remote(dev_holder.make.remote())),
+            duration,
+        ),
+        1,
+    )
+    ray_tpu.shutdown()
+
+
 def recorder_overhead_suite(results, block_tasks=256, pairs=150):
     """--recorder-overhead: cost of the always-on observability plane
     (flight recorder + 1-in-64 sampled hop stamps) on the task_sync hot
@@ -511,6 +611,13 @@ def main():
         "on task_sync (paired ABBA windows, one cluster; OBSBENCH_r{N}.json)",
     )
     ap.add_argument(
+        "--device-objects",
+        action="store_true",
+        help="device-ref handoff vs host-shm put/get at 1 MiB / 32 MiB "
+        "(same-process zero-copy + actor→actor collective handoff); records "
+        "DEVBENCH_r{N}.json with the zero-shm-copy evidence",
+    )
+    ap.add_argument(
         "--dag",
         action="store_true",
         help="classic dag.execute() vs compiled execution on a 4-stage "
@@ -569,6 +676,20 @@ def main():
         out = args.out or f"HOPBUDGET_r{args.round}.json"
         with open(out, "w") as f:
             json.dump(results, f, indent=1)
+        return
+
+    if args.device_objects:
+        results = {"host_cpus": os.cpu_count(), "mode": "device_objects"}
+        t0 = time.perf_counter()
+        device_objects_suite(results, duration=0.4 if args.quick else 3.0)
+        results["wall_s"] = round(time.perf_counter() - t0, 1)
+        compute_deltas_vs_prev(
+            results, args.round, prev_path=f"DEVBENCH_r{args.round - 1}.json"
+        )
+        out = args.out or f"DEVBENCH_r{args.round}.json"
+        with open(out, "w") as f:
+            json.dump(results, f, indent=1)
+        print(json.dumps(results))
         return
 
     if args.dag:

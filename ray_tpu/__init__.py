@@ -231,10 +231,19 @@ def get(refs, *, timeout: float | None = None):
     return worker_context.get_core_worker().get(refs, timeout=timeout)
 
 
-def put(value) -> ObjectRef:
+def put(value, *, tensor_transport: str | None = None) -> ObjectRef:
+    """Store ``value`` and return an ObjectRef.
+
+    ``tensor_transport="collective"`` keeps a ``jax.Array`` resident on this
+    process's devices (experimental/device_object/): only a small descriptor
+    enters the store, and consumers resolve it out of band — same-process
+    gets hand back the live array, same-mesh actors transfer over a
+    ``util.collective`` group, and everything else falls back to the
+    host-shm path transparently.
+    """
     from ray_tpu._private import worker_context
 
-    return worker_context.get_core_worker().put(value)
+    return worker_context.get_core_worker().put(value, tensor_transport=tensor_transport)
 
 
 def wait(refs, *, num_returns: int = 1, timeout: float | None = None, fetch_local: bool = True):

@@ -137,6 +137,12 @@ class Config:
     # --- collectives ---
     collective_rendezvous_timeout_s: float = 60.0
 
+    # --- device object plane (experimental/device_object/) ---
+    # Per-process ceiling on device-resident object bytes; past it the
+    # holder spills LRU arrays device->host into the shm arena (restored on
+    # the next local resolve). 0 = no ceiling. Env: RAY_TPU_DEVOBJ_RESIDENT_LIMIT_BYTES.
+    devobj_resident_limit_bytes: int = 0
+
     # --- GCS durability ---
     # WAL sync policy: "0" = flush only (page cache: survives process kill),
     # "1" = fsync per mutation (survives host crash, slowest), "everysec" =

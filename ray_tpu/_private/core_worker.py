@@ -69,6 +69,20 @@ DRIVER = "driver"
 WORKER = "worker"
 
 
+def _maybe_jax_array(obj) -> bool:
+    """True iff obj is a jax.Array — without importing jax for non-jax
+    values (the module-name probe keeps cold paths jax-free)."""
+    mod = type(obj).__module__
+    if not (mod.startswith("jax") or mod.startswith("jaxlib")):
+        return False
+    try:
+        import jax
+
+        return isinstance(obj, jax.Array)
+    except ImportError:
+        return False
+
+
 @dataclass
 class PendingTask:
     spec: TaskSpec
@@ -113,6 +127,11 @@ class OwnedObject:
     # handoff, reference_count.h). The producer increfs each on our behalf;
     # we decref them when this object itself is freed.
     contained: list = field(default_factory=list)  # [(oid hex, owner addr)]
+    # Device object (experimental/device_object/): the payload lives on the
+    # HOLDER process's devices, only a descriptor is stored here.
+    # {"addr": [h, p], "id": holder id} — freeing this object releases the
+    # holder's device buffers through the ownership protocol.
+    device: dict | None = None
 
 
 class CoreWorker:
@@ -293,6 +312,15 @@ class CoreWorker:
         self._actor_instance = None
         self._actor_id: str | None = None
         self._actor_creation_spec: TaskSpec | None = None
+        # Device object plane (experimental/device_object/): tensor_transport
+        # declared by this actor's class (returns of jax.Arrays stay
+        # device-resident); the manager is created on first device put/return.
+        self._tensor_transport: str = ""
+        self._device_objects = None
+        # Short-connect clients for devobj_pull: a dead holder must surface
+        # as DeviceObjectLostError in seconds, not after the default
+        # connect budget (same rationale as _actor_client's 2s timeout).
+        self._devobj_clients: dict[tuple, RpcClient] = {}
         self._actor_exec_queue: asyncio.Queue | None = None
         self._actor_concurrency_pool: ThreadPoolExecutor | None = None
         self._actor_async_loop: asyncio.AbstractEventLoop | None = None
@@ -968,9 +996,97 @@ class CoreWorker:
 
     # ---- puts ----
 
-    def put(self, value) -> "object":
+    def put(self, value, tensor_transport: str | None = None) -> "object":
+        if tensor_transport:
+            return self.put_device(value, tensor_transport)
         ser = serialization.serialize(value)
         return self.put_serialized(ser)
+
+    # ---- device object plane (experimental/device_object/) ----
+
+    def _device_manager(self):
+        mgr = self._device_objects
+        if mgr is None:
+            from ray_tpu.experimental.device_object.manager import DeviceObjectManager
+
+            with self._lock:
+                if self._device_objects is None:
+                    self._device_objects = DeviceObjectManager(self)
+                mgr = self._device_objects
+        return mgr
+
+    def _holder_identity(self) -> tuple[str, str]:
+        if self._actor_id:
+            return self._actor_id, "actor"
+        return self.worker_id, "driver" if self.mode == DRIVER else "worker"
+
+    def put_device(self, value, transport: str):
+        """put() with tensor_transport=: the jax.Array stays resident on
+        this process's devices; only a small descriptor enters the store.
+        The returned ObjectRef is first-class (refcounted/waitable/passable)
+        and resolves out of band (same-process live array / collective p2p /
+        host fallback — see experimental/device_object/resolve.py)."""
+        from ray_tpu.experimental.device_object.descriptor import validate_transport
+        from ray_tpu.object_ref import ObjectRef
+
+        validate_transport(transport)
+        if not _maybe_jax_array(value):
+            raise TypeError(
+                "tensor_transport= requires a top-level jax.Array, got "
+                f"{type(value).__name__}; use a plain put() for host values"
+            )
+        oid = ObjectID.for_put(self.current_task_id)
+        oid_hex = oid.hex()
+        holder_id, holder_kind = self._holder_identity()
+        meta = self._device_manager().create_resident(oid_hex, value, transport, holder_id, holder_kind)
+        data = serialization.serialize(meta).to_bytes()
+        with self._lock:
+            entry = self.owned.setdefault(oid_hex, OwnedObject())
+            entry.device = {"addr": list(self.address), "id": holder_id}
+            self.in_process_store[oid_hex] = {"data": data, "value": meta}
+        self._set_event(oid_hex)
+        return ObjectRef(oid, self.address)
+
+    def _package_device(self, oid_hex: str, value) -> list:
+        """Actor-task return under tensor_transport=: keep the array here
+        (this actor is the holder), ship the descriptor as the inline result
+        plus the holder coordinates the owner's refcounting needs."""
+        holder_id, holder_kind = self._holder_identity()
+        meta = self._device_manager().create_resident(
+            oid_hex, value, self._tensor_transport, holder_id, holder_kind
+        )
+        data = serialization.serialize(meta).to_bytes()
+        return [oid_hex, "inline", data, [], {"addr": list(self.address), "id": holder_id}]
+
+    def _devobj_client(self, addr: tuple) -> RpcClient:
+        """Cached connection to a device-object holder with a SHORT connect
+        timeout: resolution probes holders that may be dead, and the typed
+        loss must surface quickly (the host-copy fallback runs after it)."""
+        with self._lock:
+            client = self._devobj_clients.get(addr)
+            if client is None:
+                client = RpcClient(addr, label=f"devobj-{addr}", connect_timeout=2.0)
+                self._devobj_clients[addr] = client
+            return client
+
+    @any_thread
+    def _free_device_object(self, oid: str, dev: dict):
+        """Owner-side release reached zero refs: tell the holder to drop the
+        device buffers (and any host copy it spilled)."""
+        addr = tuple(dev.get("addr") or ())
+        if addr == tuple(self.address):
+            mgr = self._device_objects
+            if mgr is not None:
+                mgr.free(oid)
+            return
+
+        async def _push():
+            try:
+                await self._owner_client(addr).apush("devobj_free", {"object_id": oid})
+            except Exception:
+                pass
+
+        self._io.spawn(_push())
 
     def put_serialized(self, ser: serialization.SerializedObject):
         from ray_tpu.object_ref import ObjectRef
@@ -1070,6 +1186,18 @@ class CoreWorker:
         return rem
 
     def _get_one(self, ref, deadline):
+        value = self._get_one_raw(ref, deadline)
+        # Device object descriptors resolve out of band (live array /
+        # collective transfer / host fallback). Name probe first so the
+        # ordinary get path never imports the device plane.
+        if type(value).__name__ == "DeviceObjectMeta":
+            from ray_tpu.experimental.device_object import DeviceObjectMeta, resolve_meta
+
+            if isinstance(value, DeviceObjectMeta):
+                return resolve_meta(self, value, deadline)
+        return value
+
+    def _get_one_raw(self, ref, deadline):
         oid_hex = ref.hex()
         is_owner = ref.owner_addr is None or tuple(ref.owner_addr) == tuple(self.address)
         attempts = 0
@@ -1305,6 +1433,10 @@ class CoreWorker:
 
     def create_actor(self, cls, args, kwargs, **opts):
         actor_id = ActorID.of(self.job_id)
+        if opts.get("tensor_transport"):
+            from ray_tpu.experimental.device_object.descriptor import validate_transport
+
+            validate_transport(opts["tensor_transport"])
         wire_args, arg_refs = self._prepare_args(args, kwargs or {})
         spec = TaskSpec(
             task_id=TaskID.for_task(actor_id).hex(),
@@ -1326,6 +1458,7 @@ class CoreWorker:
             actor_name=opts.get("name") or "",
             namespace=opts.get("namespace") or self.namespace,
             get_if_exists=opts.get("get_if_exists", False),
+            tensor_transport=opts.get("tensor_transport") or "",
             placement_group_id=opts.get("placement_group_id", ""),
             placement_group_bundle_index=opts.get("placement_group_bundle_index", -1),
             scheduling_strategy=opts.get("scheduling_strategy", "DEFAULT"),
@@ -1784,6 +1917,11 @@ class CoreWorker:
             obj = self.owned.setdefault(oid, OwnedObject())
             if contained:
                 obj.contained = contained
+            if len(result) > 4 and result[4]:
+                # Streaming actor tasks don't exist yet, but a device-object
+                # item must never lose its holder coordinates — that's the
+                # free protocol (see _handle_task_done).
+                obj.device = result[4]
             if kind == "inline":
                 self.in_process_store[oid] = {"data": data}
             else:
@@ -1908,6 +2046,10 @@ class CoreWorker:
                 obj = self.owned.setdefault(oid, OwnedObject())
                 if contained:
                     obj.contained = contained
+                if len(result) > 4 and result[4]:
+                    # Device object: result is a descriptor; the holder's
+                    # coordinates drive the free-on-last-ref protocol.
+                    obj.device = result[4]
                 if kind == "inline":
                     self.in_process_store[oid] = {"data": data}
                 else:  # plasma
@@ -2031,6 +2173,60 @@ class CoreWorker:
             if obj is not None and obj.in_plasma:
                 return {"kind": "plasma", "location": obj.location_hint}
         return {"kind": "missing"}
+
+    # ---- device object plane (experimental/device_object/) ----
+
+    async def rpc_devobj_pull(self, req):
+        """Consumer asks the holder for a device object's payload. Decides
+        the transfer in one round trip: a shared collective group (named by
+        the consumer) kicks off a p2p send the consumer recv()s; otherwise
+        small arrays ship inline and large ones are sealed into this node's
+        arena under the same object id for the store pull path."""
+        mgr = self._device_objects
+        oid = req["object_id"]
+        entry = mgr.entry(oid) if mgr is not None else None
+        if entry is None:
+            return {"kind": "missing"}
+        loop = asyncio.get_event_loop()
+        group = req.get("group")
+        if group is not None and entry.meta.transport == "collective":
+            from ray_tpu.util.collective import get_group, is_group_initialized
+
+            if is_group_initialized(group):
+                src_rank = get_group(group).rank
+                # Send on an executor thread: serialization + the mailbox
+                # round trips must not stall this process's IO loop.
+                loop.run_in_executor(
+                    None, mgr.send_via_group, oid, group, req["dst_rank"], req["tag"]
+                )
+                return {"kind": "collective", "group": group, "src_rank": src_rank}
+        # Spilled entries already have an arena copy under this oid: point
+        # the consumer at the store instead of restoring device-side just to
+        # re-serialize (the restore would also re-pin memory that pressure
+        # evicted).
+        if (
+            entry.array is not None
+            and entry.meta.nbytes <= self.cfg.max_direct_call_object_size
+        ):
+            data = await loop.run_in_executor(None, mgr.host_bytes, oid)
+            if data is not None:
+                return {"kind": "inline", "data": data}
+        ok = await loop.run_in_executor(None, mgr.materialize_to_store, oid)
+        if ok:
+            return {"kind": "plasma", "location": self.node_id}
+        return {"kind": "missing"}
+
+    async def rpc_devobj_free(self, req):
+        """Owner's last ref dropped: release the device buffers here."""
+        mgr = self._device_objects
+        if mgr is not None:
+            mgr.free(req["object_id"])
+        return {"ok": True}
+
+    async def rpc_devobj_stats(self, req):
+        from ray_tpu.experimental.device_object.manager import device_object_stats
+
+        return device_object_stats()
 
     # ---- compiled-graph channel plane (experimental/channel/) ----
 
@@ -2174,6 +2370,11 @@ class CoreWorker:
         self.in_process_store.pop(oid, None)
         self.owned.pop(oid, None)
         self._object_events.pop(oid, None)
+        if obj.device is not None:
+            dev, obj.device = obj.device, None
+            # Release the holder's device buffers (and any spilled copy).
+            # Async push / manager-internal lock only — we hold self._lock.
+            self._free_device_object(oid, dev)
         if obj.contained:
             contained, obj.contained = obj.contained, []
             # Decref outside any recursion concerns via the same thread; the
@@ -2245,6 +2446,10 @@ class CoreWorker:
         from ray_tpu._private.ids import ObjectID, TaskID
 
         oid = ObjectID.for_return(TaskID.from_hex(spec.task_id), index).hex()
+        if self._tensor_transport and spec.is_actor_task() and _maybe_jax_array(value):
+            # Device object plane: the array never leaves this actor's
+            # devices; the owner gets a descriptor + holder coordinates.
+            return self._package_device(oid, value)
         ser = serialization.serialize(value)
         contained = self._incref_contained(ser.contained_refs)
         if ser.total_size > self.cfg.max_direct_call_object_size:
@@ -2312,6 +2517,7 @@ class CoreWorker:
                 self._actor_instance = instance
                 self._actor_id = spec.actor_id
                 self._actor_creation_spec = spec
+                self._tensor_transport = spec.tensor_transport
                 values = []
             else:
                 out = fn(*args, **kwargs)
@@ -2510,6 +2716,8 @@ class CoreWorker:
         for c in list(self._actor_clients.values()):
             c.close()
         for c in list(self._owner_client_cache.values()):
+            c.close()
+        for c in list(self._devobj_clients.values()):
             c.close()
         self.server.stop()
         self.store.close()

@@ -89,6 +89,12 @@ EVENT_TYPES = (
     "worker_death",    # 21
     "fatal_signal",    # 22
     "exit",            # 23
+    # Device object plane (experimental/device_object/).
+    "devobj_create",   # 24
+    "devobj_transfer", # 25
+    "devobj_spill",    # 26
+    "devobj_restore",  # 27
+    "devobj_free",     # 28
 )
 _CODE = {name: i for i, name in enumerate(EVENT_TYPES)}
 

@@ -155,6 +155,30 @@ def instruments() -> dict:
                 "Blocks produced per Data operator.",
                 tag_keys=("op",),
             ),
+            # --- device object plane (experimental/device_object/) ---
+            "devobj_resident": m.Gauge(
+                "ray_tpu_devobj_resident",
+                "Device-resident objects held by this process.",
+            ),
+            "devobj_resident_bytes": m.Gauge(
+                "ray_tpu_devobj_resident_bytes",
+                "Bytes of device-resident object payloads held by this process.",
+            ),
+            "devobj_transfers": m.Counter(
+                "ray_tpu_devobj_transfers_total",
+                "Device-object resolutions by transfer kind "
+                "(local = same-process zero-copy, collective = group p2p, "
+                "host = inline/arena fallback).",
+                tag_keys=("kind",),
+            ),
+            "devobj_spills": m.Counter(
+                "ray_tpu_devobj_spills_total",
+                "Device objects spilled device->host into the arena.",
+            ),
+            "devobj_restores": m.Counter(
+                "ray_tpu_devobj_restores_total",
+                "Spilled device objects restored host->device.",
+            ),
             # --- actor lifecycle (gcs.py) ---
             "actor_restarts": m.Counter(
                 "ray_tpu_actor_restarts_total", "Actor restarts driven by the GCS."
@@ -163,6 +187,7 @@ def instruments() -> dict:
         m.register_collector(_collect_wire_stats)
         m.register_collector(_collect_lease_stats)
         m.register_collector(_collect_channel_stats)
+        m.register_collector(_collect_devobj_stats)
         _instruments = inst
     return _instruments
 
@@ -216,6 +241,26 @@ def _collect_channel_stats():
     ])
     if CHANNEL_STATS.writes:
         inst["channel_occupancy"].set(CHANNEL_STATS.last_occupancy)
+
+
+def _collect_devobj_stats():
+    from ray_tpu.experimental.device_object.manager import DEVOBJ_STATS, active_manager
+
+    inst = _instruments
+    if inst is None:
+        return
+    _fold("devobj", DEVOBJ_STATS, [
+        ("transfers_local", inst["devobj_transfers"], {"kind": "local"}),
+        ("transfers_collective", inst["devobj_transfers"], {"kind": "collective"}),
+        ("transfers_host", inst["devobj_transfers"], {"kind": "host"}),
+        ("spills", inst["devobj_spills"], None),
+        ("restores", inst["devobj_restores"], None),
+    ])
+    mgr = active_manager()
+    if mgr is not None:
+        usage = mgr.usage()
+        inst["devobj_resident"].set(usage["resident_count"])
+        inst["devobj_resident_bytes"].set(usage["resident_bytes"])
 
 
 def _collect_lease_stats():

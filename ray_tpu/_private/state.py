@@ -38,6 +38,23 @@ class GlobalState:
     def actors(self) -> list[dict]:
         return self._gcs.call("list_actors").get("actors", [])
 
+    def device_objects(self) -> list[dict]:
+        """Cluster-wide device-resident objects (experimental/device_object/):
+        every holder registers a best-effort ``devobj/<oid>`` KV row at
+        create and deletes it on free."""
+        keys = self._gcs.call("kv_keys", {"prefix": "devobj/"}).get("keys", [])
+        rows = []
+        for key in keys:
+            resp = self._gcs.call("kv_get", {"key": key})
+            if not resp.get("found"):
+                continue
+            try:
+                value = resp["value"]
+                rows.append(json.loads(value if isinstance(value, str) else value.decode()))
+            except Exception:
+                continue
+        return rows
+
     def placement_groups(self) -> list[dict]:
         return self._gcs.call("list_placement_groups").get("placement_groups", [])
 

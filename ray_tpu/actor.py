@@ -28,6 +28,9 @@ _ACTOR_OPTION_KEYS = {
     "placement_group",
     "placement_group_bundle_index",
     "runtime_env",
+    # Device object plane: jax.Array returns stay resident on this actor's
+    # devices (experimental/device_object/).
+    "tensor_transport",
 }
 
 
@@ -131,6 +134,7 @@ class ActorClass:
             max_task_retries=opts.get("max_task_retries", 0),
             max_concurrency=opts.get("max_concurrency", 1),
             runtime_env=opts.get("runtime_env"),
+            tensor_transport=opts.get("tensor_transport"),
             **_scheduling_opts(opts),
         )
         return ActorHandle(

@@ -2,10 +2,9 @@
 beneath the libraries (reference: python/ray/air/execution/).
 
 One audited set of actor restart/leak semantics instead of one per library:
-Tune's trial loop and Train's BackendExecutor both route actor lifecycle and
-resource acquisition through :class:`ActorManager` +
-:class:`ResourceManager`. Serve's controller is a documented follow-up
-(PARITY.md).
+Tune's trial loop, Train's BackendExecutor, and Serve's controller all
+route actor lifecycle and resource acquisition through
+:class:`ActorManager` + :class:`ResourceManager`.
 """
 
 from ray_tpu.air.execution.actor_manager import (  # noqa: F401

@@ -115,6 +115,7 @@ class TrackedActor:
         max_restarts: int = 0,
         restart_backoff_s: float = 0.5,
         graceful_stop_method: str | None = None,
+        actor_options: dict | None = None,
     ):
         self.tracked_id = next(self._ids)
         self.state = PENDING
@@ -133,6 +134,10 @@ class TrackedActor:
         self.max_restarts = max_restarts
         self.restart_backoff_s = restart_backoff_s
         self.graceful_stop_method = graceful_stop_method
+        # Extra .options() entries (name=, max_concurrency=, runtime_env=,
+        # ...) overlaid on the acquisition-derived scheduling options —
+        # library controllers with named actors (Serve) need both.
+        self.actor_options = dict(actor_options or {})
         self._restart_due = 0.0  # monotonic time the next restart may run
         self._queued_tasks: list[TrackedActorTask] = []
 
@@ -195,6 +200,7 @@ class ActorManager:
         max_restarts: int = 0,
         restart_backoff_s: float = 0.5,
         graceful_stop_method: str | None = None,
+        actor_options: dict | None = None,
     ) -> TrackedActor:
         """Track a new actor. Creation is asynchronous: the actor process
         starts once ``resource_request`` is ready (driven by ``next()``)."""
@@ -212,6 +218,7 @@ class ActorManager:
             max_restarts=max_restarts,
             restart_backoff_s=restart_backoff_s,
             graceful_stop_method=graceful_stop_method,
+            actor_options=actor_options,
         )
         self._tracked.append(tracked)
         if id(resource_request) not in self._acquisitions:
@@ -459,6 +466,7 @@ class ActorManager:
 
             cls = ray_tpu.remote(cls)
         opts = acq.actor_options(tracked.bundle_index)
+        opts.update(tracked.actor_options)
         # GCS-level restart stays OFF: restarts are manager-tracked so
         # callbacks fire and constructor kwargs re-resolve (a GCS restart
         # would silently hand back a fresh instance with stale state).

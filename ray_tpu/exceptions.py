@@ -69,6 +69,25 @@ class OwnerDiedError(ObjectLostError):
     """The object's owner process died; the object's lineage is gone."""
 
 
+class DeviceObjectLostError(ObjectLostError):
+    """A device-resident object (experimental/device_object/) is gone: the
+    holder process that kept the ``jax.Array`` on its devices is dead or
+    unreachable AND no spilled/host copy exists. Names the holder so the
+    postmortem starts at the right process."""
+
+    def __init__(self, object_id_hex: str = "", holder: str = "", msg: str = ""):
+        self.holder = holder
+        super().__init__(
+            object_id_hex,
+            msg
+            or (
+                f"device object {object_id_hex[:16]} was lost: holder "
+                f"{holder or '<unknown>'} is dead or unreachable and no "
+                "spilled/host copy exists"
+            ),
+        )
+
+
 class OutOfMemoryError(RayTpuError):
     """A task's worker was killed by the node memory monitor (reference:
     ray.exceptions.OutOfMemoryError + worker_killing_policy)."""

@@ -1,0 +1,59 @@
+"""Device object plane — device-resident ``jax.Array`` objects, passed by
+reference with out-of-band collective transfer.
+
+The base object plane (``_private/serialization.py``) DMAs every device
+array host-side on ``put`` and back on ``get``: an actor-to-actor tensor
+handoff (learner→sampler weight sync, KV-cache migration, pipeline
+activations) pays two host copies plus shm traffic even when both endpoints
+sit on the same mesh. This plane keeps the array ON its devices and seals
+only a small :class:`DeviceObjectMeta` descriptor into the normal store —
+the ``ObjectRef`` stays first-class (refcounted, waitable, passable,
+reconstruct-free), while the payload moves out of band:
+
+- **same process** — the consumer gets the live ``jax.Array`` back, zero
+  copies of the payload anywhere;
+- **same mesh** — a ``util/collective`` group p2p ``send``/``recv`` moves
+  it holder→consumer (CPU ring backend in tests, tpu backend on hardware),
+  sharding layout preserved;
+- **no shared group / cross-mesh** — the holder materializes a host copy
+  (inline for small arrays, its node's shm arena otherwise) and the
+  consumer resolves through the existing host-shm path, transparently.
+
+Opt in per value with ``ray_tpu.put(arr, tensor_transport="collective")``
+or per actor with ``@ray_tpu.remote(tensor_transport="collective")`` —
+every top-level ``jax.Array`` such an actor returns stays device-resident
+on the actor, which is the HOLDER; the caller stays the owner and the
+normal ownership protocol frees the device buffers when the last ref
+drops. Under memory pressure (``devobj_resident_limit_bytes``) the holder
+spills device→host into the arena and restores on the next resolve; holder
+death surfaces :class:`~ray_tpu.exceptions.DeviceObjectLostError` naming
+the holder, falling back to the spilled/host copy when one exists.
+
+Reference direction: Ray GPU objects / `tensor_transport=` direct tensor
+transport over ``ray.util.collective``; Podracer (arXiv:2104.06272) is the
+TPU-native case for keeping data device-resident end to end; the original
+Ray paper (arXiv:1712.05889) is why this stays inside the ObjectRef
+ownership model instead of becoming a side API.
+"""
+
+from ray_tpu.experimental.device_object.descriptor import (  # noqa: F401
+    TENSOR_TRANSPORTS,
+    DeviceObjectMeta,
+    validate_transport,
+)
+from ray_tpu.experimental.device_object.manager import (  # noqa: F401
+    DEVOBJ_STATS,
+    DeviceObjectManager,
+    device_object_stats,
+)
+from ray_tpu.experimental.device_object.resolve import resolve_meta  # noqa: F401
+
+__all__ = [
+    "DEVOBJ_STATS",
+    "DeviceObjectManager",
+    "DeviceObjectMeta",
+    "TENSOR_TRANSPORTS",
+    "device_object_stats",
+    "resolve_meta",
+    "validate_transport",
+]

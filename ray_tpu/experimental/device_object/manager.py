@@ -1,0 +1,372 @@
+"""DeviceObjectManager — per-process registry of device-resident payloads.
+
+One per core worker (lazily, first device put/return creates it). The
+manager holds the live ``jax.Array`` for every device object this process
+is the HOLDER of, plus the spill state: under memory pressure
+(``devobj_resident_limit_bytes``) the least-recently-used arrays are
+serialized device→host into the node's shm arena (under the SAME object id,
+so every existing host-path consumer — local deserialize, cross-node pull,
+holder-death fallback — finds the copy with zero new plumbing) and restored
+onto their devices on the next local resolve.
+
+Observability: every transition records a typed flight-recorder event
+(``devobj_create/transfer/spill/restore/free``) and bumps the plain-int
+``DEVOBJ_STATS`` counters folded into ``ray_tpu_devobj_*`` metrics by
+``self_metrics`` at flush time (no instrument lock on the create path).
+A best-effort GCS KV row (``devobj/<oid>``) backs the cluster state view
+(``ray_tpu list device_objects``).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from dataclasses import dataclass
+
+from ray_tpu._private import flight_recorder
+from ray_tpu._private.concurrency import any_thread, blocking
+
+logger = logging.getLogger(__name__)
+
+
+class _DevObjStats:
+    """Plain-int hot-path counters (self_metrics folds them at flush)."""
+
+    __slots__ = (
+        "creates",
+        "frees",
+        "spills",
+        "restores",
+        "transfers_local",
+        "transfers_collective",
+        "transfers_host",
+    )
+
+    def __init__(self):
+        for name in self.__slots__:
+            setattr(self, name, 0)
+
+
+DEVOBJ_STATS = _DevObjStats()
+
+# The process's manager, for the metrics collector and device_object_stats();
+# written once under _active_lock when the first device object is created.
+_active_manager = None
+_active_lock = threading.Lock()
+
+
+def active_manager():
+    return _active_manager
+
+
+def device_object_stats() -> dict:
+    """Snapshot of this process's device-object plane (tests, actor-side
+    introspection, the CLI state view's per-holder detail)."""
+    mgr = _active_manager
+    counters = {name: getattr(DEVOBJ_STATS, name) for name in _DevObjStats.__slots__}
+    if mgr is None:
+        return {"resident_count": 0, "resident_bytes": 0, "spilled_count": 0, **counters}
+    return {**mgr.usage(), **counters}
+
+
+@dataclass
+class DeviceObjectEntry:
+    meta: object  # DeviceObjectMeta
+    array: object | None  # live jax.Array; None once spilled
+    in_store: bool = False  # host copy sealed into the shm arena (same oid)
+    last_access: float = 0.0
+
+
+class DeviceObjectManager:
+    def __init__(self, core_worker):
+        global _active_manager
+        self.cw = core_worker
+        self._lock = threading.Lock()
+        self._entries: dict[str, DeviceObjectEntry] = {}
+        with _active_lock:
+            _active_manager = self
+
+    # ---- creation (holder side: put / actor-task return packaging) ----
+
+    @blocking
+    def create_resident(self, oid_hex: str, arr, transport: str, holder_id: str, holder_kind: str):
+        """Register ``arr`` as device-resident under ``oid_hex``; returns the
+        DeviceObjectMeta to seal into the normal store."""
+        from ray_tpu.experimental.device_object.descriptor import DeviceObjectMeta
+        from ray_tpu.util.collective import local_group_hints
+
+        if not getattr(arr, "is_fully_addressable", True):
+            raise TypeError(
+                "cannot keep a multi-host jax.Array device-resident: this "
+                "process only holds some of its shards; put per-host shards "
+                "as separate device objects instead"
+            )
+        try:
+            hints = local_group_hints()
+        except Exception:
+            hints = []
+        meta = DeviceObjectMeta(
+            object_id=oid_hex,
+            shape=tuple(arr.shape),
+            dtype=str(arr.dtype),
+            nbytes=int(arr.nbytes),
+            transport=transport,
+            holder_addr=tuple(self.cw.address),
+            holder_id=holder_id,
+            holder_kind=holder_kind,
+            sharding=repr(getattr(arr, "sharding", "")),
+            group_hints=hints,
+        )
+        with self._lock:
+            self._entries[oid_hex] = DeviceObjectEntry(
+                meta=meta, array=arr, last_access=time.monotonic()
+            )
+        DEVOBJ_STATS.creates += 1
+        flight_recorder.record("devobj_create", f"{oid_hex[:12]}:{meta.nbytes}")
+        self._registry_put(meta)
+        limit = getattr(self.cw.cfg, "devobj_resident_limit_bytes", 0)
+        if limit > 0:
+            self._spill_for_pressure(limit, protect=oid_hex)
+        return meta
+
+    # ---- resolution (consumer side, via resolve.py) ----
+
+    def entry(self, oid_hex: str) -> DeviceObjectEntry | None:
+        with self._lock:
+            return self._entries.get(oid_hex)
+
+    @blocking
+    def get_local(self, oid_hex: str):
+        """The live array if this process holds it (restoring a spilled one
+        from the arena first); None when not the holder."""
+        with self._lock:
+            entry = self._entries.get(oid_hex)
+            if entry is None:
+                return None
+            entry.last_access = time.monotonic()
+            arr = entry.array
+        if arr is not None:
+            return arr
+        return self._restore(oid_hex)
+
+    # ---- host materialization / spill / restore ----
+
+    @blocking
+    def host_bytes(self, oid_hex: str) -> bytes | None:
+        """Serialized host copy (small-object inline fallback)."""
+        from ray_tpu._private import serialization
+
+        arr = self.get_local(oid_hex)
+        if arr is None:
+            return None
+        return serialization.dumps(arr)
+
+    @blocking
+    def materialize_to_store(self, oid_hex: str) -> bool:
+        """Seal a host copy into the node's shm arena under the same object
+        id — the no-group/cross-mesh fallback target — KEEPING the device
+        copy resident. Idempotent."""
+        from ray_tpu._private import serialization
+
+        with self._lock:
+            entry = self._entries.get(oid_hex)
+            if entry is None:
+                return False
+            if entry.in_store:
+                return True
+            arr = entry.array
+        if arr is None:  # spilled: the arena copy already exists
+            return True
+        ser = serialization.serialize(arr)
+        self.cw.store.put_serialized(oid_hex, ser)
+        with self._lock:
+            entry = self._entries.get(oid_hex)
+            if entry is not None:
+                entry.in_store = True
+        if entry is None:
+            # free() raced the seal and saw in_store=False, so it skipped
+            # the store cleanup — the copy we just sealed would be orphaned.
+            async def _free_store():
+                try:
+                    await self.cw.raylet.acall("free_object", {"object_id": oid_hex})
+                except Exception:
+                    pass
+
+            self.cw._io.spawn(_free_store())
+            return False
+        return True
+
+    @blocking
+    def spill(self, oid_hex: str) -> bool:
+        """Device→host under memory pressure: seal the host copy into the
+        arena, then release the device buffers (drop the live array)."""
+        if not self.materialize_to_store(oid_hex):
+            return False
+        with self._lock:
+            entry = self._entries.get(oid_hex)
+            if entry is None or entry.array is None:
+                return entry is not None
+            entry.array = None
+            nbytes = entry.meta.nbytes
+        DEVOBJ_STATS.spills += 1
+        flight_recorder.record("devobj_spill", f"{oid_hex[:12]}:{nbytes}")
+        return True
+
+    @blocking
+    def _restore(self, oid_hex: str):
+        """Arena → device: deserialize the spilled copy (original sharding
+        reassembles via the jax.Array reducer) and pin it live again."""
+        from ray_tpu._private import serialization
+
+        view = self.cw.store.get_view(oid_hex, timeout=30.0)
+        try:
+            arr = serialization.deserialize(view)
+        finally:
+            self.cw.store.release(oid_hex)
+        with self._lock:
+            entry = self._entries.get(oid_hex)
+            if entry is None:
+                return arr  # freed while restoring: hand the value out anyway
+            if entry.array is None:
+                entry.array = arr
+            entry.last_access = time.monotonic()
+            arr = entry.array
+        DEVOBJ_STATS.restores += 1
+        flight_recorder.record("devobj_restore", oid_hex[:12])
+        return arr
+
+    @blocking
+    def _spill_for_pressure(self, limit_bytes: int, protect: str = ""):
+        """Spill LRU live entries until resident bytes fit the limit."""
+        while True:
+            with self._lock:
+                live = [
+                    (e.last_access, oid)
+                    for oid, e in self._entries.items()
+                    if e.array is not None and oid != protect
+                ]
+                resident = sum(
+                    e.meta.nbytes for e in self._entries.values() if e.array is not None
+                )
+            if resident <= limit_bytes or not live:
+                return
+            live.sort()
+            if not self.spill(live[0][1]):
+                return
+
+    # ---- transfer (holder side, driven by rpc_devobj_pull) ----
+
+    @blocking
+    def send_via_group(self, oid_hex: str, group_name: str, dst_rank: int, tag: str):
+        """p2p-send the live array to the consumer's rank. Runs on an
+        executor thread (the pull RPC handler must not block the IO loop).
+        A janitor deletes the mailbox key after a grace period: a consumer
+        that timed out (or died) mid-recv never picks it up, and the
+        serialized payload must not live in the GCS KV forever."""
+        from ray_tpu.util.collective import get_group
+        from ray_tpu.util.collective.p2p import mailbox_key
+
+        try:
+            arr = self.get_local(oid_hex)
+            if arr is None:
+                raise KeyError(oid_hex)
+            group = get_group(group_name)
+            group.send(arr, dst_rank, tag)
+            DEVOBJ_STATS.transfers_collective += 1
+            flight_recorder.record("devobj_transfer", f"{oid_hex[:12]}:collective:{group_name}")
+            self._schedule_mailbox_janitor(
+                mailbox_key(group_name, group.rank, dst_rank, tag)
+            )
+        except Exception:
+            logger.exception(
+                "collective send of device object %s on group %s failed",
+                oid_hex[:12], group_name,
+            )
+
+    def _schedule_mailbox_janitor(self, key: str, delay_s: float = 180.0):
+        async def _sweep():
+            import asyncio
+
+            await asyncio.sleep(delay_s)
+            try:
+                await self.cw.gcs.acall("kv_del", {"key": key})
+            except Exception:
+                pass
+
+        self.cw._io.spawn(_sweep())
+
+    # ---- release (ownership protocol: owner's last ref dropped) ----
+
+    @any_thread
+    def free(self, oid_hex: str):
+        with self._lock:
+            entry = self._entries.pop(oid_hex, None)
+        if entry is None:
+            return
+        DEVOBJ_STATS.frees += 1
+        flight_recorder.record("devobj_free", oid_hex[:12])
+        self._registry_del(oid_hex)
+        if entry.in_store:
+            # The arena/spilled copy is holder-managed (the owner's plasma
+            # bookkeeping never saw it) — delete it cluster-wide here.
+            async def _free_store():
+                try:
+                    await self.cw.raylet.acall("free_object", {"object_id": oid_hex})
+                except Exception:
+                    pass
+
+            self.cw._io.spawn(_free_store())
+
+    # ---- introspection ----
+
+    def usage(self) -> dict:
+        with self._lock:
+            live = [e for e in self._entries.values() if e.array is not None]
+            spilled = sum(1 for e in self._entries.values() if e.array is None)
+            return {
+                "resident_count": len(live),
+                "resident_bytes": sum(e.meta.nbytes for e in live),
+                "spilled_count": spilled,
+            }
+
+    def object_ids(self) -> list[str]:
+        with self._lock:
+            return list(self._entries)
+
+    # ---- cluster state registry (best-effort, async) ----
+
+    def _registry_put(self, meta):
+        row = json.dumps(
+            {
+                "object_id": meta.object_id,
+                "shape": list(meta.shape),
+                "dtype": meta.dtype,
+                "nbytes": meta.nbytes,
+                "transport": meta.transport,
+                "holder_id": meta.holder_id,
+                "holder_kind": meta.holder_kind,
+                "node_id": self.cw.node_id,
+                "created_ts": meta.created_ts,
+            }
+        ).encode()  # the GCS KV schema takes bytes values
+
+        async def _put():
+            try:
+                await self.cw.gcs.acall(
+                    "kv_put", {"key": f"devobj/{meta.object_id}", "value": row}
+                )
+            except Exception:
+                pass
+
+        self.cw._io.spawn(_put())
+
+    def _registry_del(self, oid_hex: str):
+        async def _del():
+            try:
+                await self.cw.gcs.acall("kv_del", {"key": f"devobj/{oid_hex}"})
+            except Exception:
+                pass
+
+        self.cw._io.spawn(_del())

@@ -840,7 +840,16 @@ def main(argv=None):
     p = sub.add_parser("list", help="state API listing")
     p.add_argument(
         "resource",
-        choices=["tasks", "actors", "nodes", "jobs", "objects", "workers", "placement_groups"],
+        choices=[
+            "tasks",
+            "actors",
+            "nodes",
+            "jobs",
+            "objects",
+            "device_objects",
+            "workers",
+            "placement_groups",
+        ],
     )
     p.add_argument("--address", default=None)
     p.add_argument("--limit", type=int, default=100)

@@ -10,6 +10,18 @@ The controller actor holds target state (deployments + configs), runs a
 reconcile thread that starts/stops replica actors to match, health-checks
 replicas, collects queue metrics, and serves long-poll subscriptions from
 routers/proxies for the replica membership table.
+
+Replica lifecycle rides the AIR execution layer (``air/execution``
+``ActorManager`` + ``FixedResourceManager``) — the same audited
+start/failure/release substrate beneath Tune and Train: replica actors are
+tracked actors (named, ``max_concurrency``-tuned via ``actor_options``),
+process death fires ``on_failure`` (replica leaves the routing table, the
+reconcile pass starts a replacement of the TARGET version — version-aware
+replacement is controller policy, so manager-level restart stays off), and
+resource acquisitions release with the actor, never leaking budget. A
+dedicated pump thread drives ``ActorManager.next``; every manager call
+holds ``_mgr_lock`` (taken OUTSIDE ``self._lock`` — callbacks run under it
+and take ``self._lock`` inside).
 """
 
 from __future__ import annotations
@@ -21,6 +33,7 @@ import time
 import uuid
 
 import ray_tpu
+from ray_tpu.air.execution import ActorManager, FixedResourceManager, ResourceRequest
 from ray_tpu.serve._private.common import (
     AutoscalingConfig,
     DeploymentConfig,
@@ -42,6 +55,12 @@ class ServeController:
         # rolling-update stall detector.
         self._starting_births: dict[str, dict[str, float]] = {}
         self._replica_handles: dict[str, object] = {}
+        # AIR execution layer: replica actors are manager-tracked. _mgr_lock
+        # serializes every manager call (pump thread, reconcile thread, RPC
+        # threads) and is ALWAYS taken outside self._lock.
+        self._mgr = ActorManager(FixedResourceManager())
+        self._mgr_lock = threading.RLock()
+        self._replica_tracked: dict[str, object] = {}  # replica_id -> TrackedActor
         # autoscaling bookkeeping
         self._metrics: dict[str, dict] = {}
         self._scale_marks: dict[str, float] = {}
@@ -68,10 +87,27 @@ class ServeController:
         self._proxy_backoff: dict[str, tuple[int, float]] = {}
         self._http_cfg: tuple | None = None
         self._proxy_thread: threading.Thread | None = None
+        self._mgr_thread = threading.Thread(
+            target=self._manager_loop, name="serve-actor-manager", daemon=True
+        )
+        self._mgr_thread.start()
         self._reconcile_thread = threading.Thread(
             target=self._reconcile_loop, name="serve-reconcile", daemon=True
         )
         self._reconcile_thread.start()
+
+    def _manager_loop(self):
+        """Drive the ActorManager: starts pending replicas, polls liveness,
+        dispatches task callbacks (readiness checks) on this thread."""
+        while not self._shutdown:
+            try:
+                with self._mgr_lock:
+                    progressed = self._mgr.next(timeout=0.2)
+            except Exception:
+                logger.exception("serve actor-manager pump failed")
+                progressed = False
+            if not progressed:
+                time.sleep(0.05)
 
     # ------------------------------------------------------------------
     # Target-state API (called by serve.run / serve.delete)
@@ -116,6 +152,10 @@ class ServeController:
             self._deployments.clear()
         self._reconcile_once()
         self._shutdown = True
+        # Guaranteed release: whatever reconcile missed (mid-start replicas,
+        # in-flight probes), the manager kills and frees.
+        with self._mgr_lock:
+            self._mgr.clear()
         return True
 
     # ------------------------------------------------------------------
@@ -340,6 +380,10 @@ class ServeController:
             except Exception:
                 logger.exception("replica health checks failed")
             try:
+                self._sweep_stale_births()
+            except Exception:
+                logger.exception("stale-birth sweep failed")
+            try:
                 self._reconcile_once()
             except Exception:
                 logger.exception("reconcile failed")
@@ -405,6 +449,7 @@ class ServeController:
             if present:
                 reps.remove(r)
                 self._bump_epoch_locked()
+            tracked = self._replica_tracked.pop(r.replica_id, None)
             handle = self._replica_handles.pop(r.replica_id, None)
             self._health_marks.pop(r.replica_id, None)
             self._metrics.get(name, {}).pop(r.replica_id, None)
@@ -416,7 +461,10 @@ class ServeController:
         )
         # Kill the actor too: a hung replica left alive would hold its CPU
         # reservation and starve the replacement on a full cluster.
-        if handle is not None:
+        if tracked is not None:
+            with self._mgr_lock:
+                self._mgr.remove_actor(tracked)
+        elif handle is not None:
             try:
                 ray_tpu.kill(handle)
             except Exception:
@@ -526,28 +574,28 @@ class ServeController:
                 self._bump_epoch_locked()
 
     def _start_replica(self, info: DeploymentInfo):
-        """Create the replica actor; enter the routing table only once its
-        first health check answers (reference: replica STARTING -> RUNNING
-        transition in deployment_state.py)."""
+        """Create the replica actor through the AIR ActorManager; it enters
+        the routing table only once its first health check answers
+        (reference: replica STARTING -> RUNNING transition in
+        deployment_state.py). The manager owns process lifecycle + resource
+        accounting; version-aware replacement stays controller policy."""
+        from ray_tpu.serve._private.common import CONTROLLER_NAME
         from ray_tpu.serve._private.replica import Replica
 
         replica_id = uuid.uuid4().hex[:8]
         actor_name = f"SERVE_REPLICA::{info.name}#{replica_id}"
         opts = dict(info.config.ray_actor_options or {})
-        opts.setdefault("num_cpus", 1)
+        bundle = {"CPU": opts.pop("num_cpus", 1)}
+        ntpu = opts.pop("num_tpus", None)
+        if ntpu:
+            bundle["TPU"] = ntpu
+        bundle.update(opts.pop("resources", None) or {})
+        actor_options = dict(opts)
+        actor_options["name"] = actor_name
         # Admit concurrent requests up to the routing limit so @serve.batch
         # can actually form batches (reference: replicas are async actors).
-        opts.setdefault("max_concurrency", min(info.config.max_concurrent_queries, 32))
-        opts["name"] = actor_name
-        from ray_tpu.serve._private.common import CONTROLLER_NAME
-
-        actor_cls = ray_tpu.remote(**opts)(Replica)
-        handle = actor_cls.remote(
-            info.import_spec,
-            info.config.user_config,
-            deployment_name=info.name,
-            replica_id=replica_id,
-            controller_name=CONTROLLER_NAME,
+        actor_options.setdefault(
+            "max_concurrency", min(info.config.max_concurrent_queries, 32)
         )
         rinfo = ReplicaInfo(
             replica_id=replica_id,
@@ -556,40 +604,117 @@ class ServeController:
             max_concurrent_queries=info.config.max_concurrent_queries,
             version=info.config.version,
         )
+
+        def _on_start(tracked):
+            # ALIVE at the GCS: run the readiness probe as a manager task so
+            # its result/error flows back through the pump thread.
+            self._mgr.schedule_actor_task(
+                tracked,
+                "check_health",
+                on_result=lambda ok: self._replica_ready(rinfo, tracked, bool(ok)),
+                on_error=lambda e: self._replica_ready(rinfo, tracked, False),
+            )
+
+        def _on_failure(tracked, error, will_restart):
+            self._replica_failed(rinfo, error)
+
+        with self._mgr_lock:
+            tracked = self._mgr.add_actor(
+                Replica,
+                {
+                    "import_spec": info.import_spec,
+                    "user_config": info.config.user_config,
+                    "deployment_name": info.name,
+                    "replica_id": replica_id,
+                    "controller_name": CONTROLLER_NAME,
+                },
+                resource_request=ResourceRequest([bundle]),
+                actor_options=actor_options,
+                on_start=_on_start,
+                on_failure=_on_failure,
+            )
         with self._lock:
             self._starting_births.setdefault(info.name, {})[replica_id] = time.time()
-            self._replica_handles[replica_id] = handle
+            self._replica_tracked[replica_id] = tracked
 
-        def _wait_ready():
-            ok = False
-            try:
-                ok = ray_tpu.get(handle.check_health.remote(), timeout=info.config.health_check_timeout_s)
-            except Exception:
-                logger.exception("replica %s of %s failed to start", replica_id, info.name)
-            with self._lock:
-                self._starting_births.get(info.name, {}).pop(replica_id, None)
-                if ok:
-                    self._forced_debt.pop(info.name, None)
-                if ok and info.name in self._deployments:
-                    self._replicas.setdefault(info.name, []).append(rinfo)
-                else:
-                    self._replica_handles.pop(replica_id, None)
-                    try:
-                        ray_tpu.kill(handle)
-                    except Exception:
-                        pass
+    def _replica_ready(self, rinfo: ReplicaInfo, tracked, ok: bool):
+        """Readiness probe answered (ActorManager pump thread, _mgr_lock
+        held): healthy replicas enter the routing table, anything else is
+        removed through the manager."""
+        name = rinfo.deployment_name
+        with self._lock:
+            self._starting_births.get(name, {}).pop(rinfo.replica_id, None)
             if ok:
-                with self._epoch_cv:
-                    self._bump_epoch_locked()
-                logger.info("replica %s of %s is running", replica_id, info.name)
+                self._forced_debt.pop(name, None)
+            admitted = ok and name in self._deployments
+            if admitted:
+                self._replicas.setdefault(name, []).append(rinfo)
+                self._replica_handles[rinfo.replica_id] = tracked.actor_handle
+            else:
+                self._replica_tracked.pop(rinfo.replica_id, None)
+                self._replica_handles.pop(rinfo.replica_id, None)
+        if admitted:
+            with self._epoch_cv:
+                self._bump_epoch_locked()
+            logger.info("replica %s of %s is running", rinfo.replica_id, name)
+        else:
+            if not ok:
+                logger.warning("replica %s of %s failed to start", rinfo.replica_id, name)
+            self._mgr.remove_actor(tracked)  # reentrant under _mgr_lock
 
-        threading.Thread(target=_wait_ready, daemon=True).start()
+    def _replica_failed(self, rinfo: ReplicaInfo, error: BaseException):
+        """Replica process died (ActorManager on_failure): drop it from the
+        routing table; the reconcile pass starts a target-version
+        replacement."""
+        name = rinfo.deployment_name
+        with self._lock:
+            reps = self._replicas.get(name, [])
+            present = rinfo in reps
+            if present:
+                reps.remove(rinfo)
+            self._starting_births.get(name, {}).pop(rinfo.replica_id, None)
+            self._replica_tracked.pop(rinfo.replica_id, None)
+            self._replica_handles.pop(rinfo.replica_id, None)
+            self._health_marks.pop(rinfo.replica_id, None)
+            self._metrics.get(name, {}).pop(rinfo.replica_id, None)
+        if present:
+            logger.warning(
+                "replica %s of %s died (%s); removing from routing table",
+                rinfo.replica_id, name, error,
+            )
+            with self._epoch_cv:
+                self._bump_epoch_locked()
+
+    def _sweep_stale_births(self):
+        """Abort STARTING replicas whose readiness never answered within the
+        health-check timeout (hung __init__ / lost probe): the pre-manager
+        controller bounded startup with a get(timeout=) — the manager probe
+        has no deadline of its own, so the sweep enforces one."""
+        stale = []
+        now = time.time()
+        with self._lock:
+            for name, births in self._starting_births.items():
+                info = self._deployments.get(name)
+                limit = max(
+                    30.0,
+                    info.config.health_check_timeout_s * 3 if info is not None else 30.0,
+                )
+                for rid, born in list(births.items()):
+                    if now - born > limit:
+                        births.pop(rid, None)
+                        stale.append((name, rid, self._replica_tracked.pop(rid, None)))
+        for name, rid, tracked in stale:
+            logger.warning("replica %s of %s never became ready; aborting", rid, name)
+            if tracked is not None:
+                with self._mgr_lock:
+                    self._mgr.remove_actor(tracked)
 
     def _stop_replica(self, name: str, rinfo: ReplicaInfo):
         with self._lock:
             reps = self._replicas.get(name, [])
             if rinfo in reps:
                 reps.remove(rinfo)
+            tracked = self._replica_tracked.pop(rinfo.replica_id, None)
             handle = self._replica_handles.pop(rinfo.replica_id, None)
             # Prune per-replica bookkeeping: under autoscaling churn these
             # maps would otherwise grow one entry per retired replica forever.
@@ -607,30 +732,12 @@ class ServeController:
                 )
             except Exception:
                 pass
+        if tracked is not None:
+            with self._mgr_lock:
+                self._mgr.remove_actor(tracked)  # kills + releases resources
+        elif handle is not None:
             try:
                 ray_tpu.kill(handle)
             except Exception:
                 pass
         logger.info("stopped replica %s of %s", rinfo.replica_id, name)
-
-    # Health: prune replicas whose actors died (reference: health checks in
-    # deployment_state; the GCS actor-death path marks them for restart).
-    def check_replicas(self) -> int:
-        dead = []
-        with self._lock:
-            all_reps = [(n, r) for n, reps in self._replicas.items() for r in reps]
-        for name, rinfo in all_reps:
-            try:
-                ray_tpu.get_actor(rinfo.actor_name)
-            except Exception:
-                dead.append((name, rinfo))
-        for name, rinfo in dead:
-            with self._lock:
-                reps = self._replicas.get(name, [])
-                if rinfo in reps:
-                    reps.remove(rinfo)
-                self._replica_handles.pop(rinfo.replica_id, None)
-        if dead:
-            with self._epoch_cv:
-                self._bump_epoch_locked()
-        return len(dead)

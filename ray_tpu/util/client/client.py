@@ -270,7 +270,17 @@ class ClientCoreWorker:
     # Values above this upload through the chunked data channel.
     _PUT_STREAM_THRESHOLD = 1024 * 1024
 
-    def put(self, value) -> ObjectRef:
+    def put(self, value, tensor_transport: str | None = None) -> ObjectRef:
+        if tensor_transport:
+            # Device residency means the PUTTING process holds the array for
+            # later out-of-band transfer; a thin client disconnects and has
+            # no serving plane — the option would silently degrade to a host
+            # copy, so reject it loudly.
+            raise NotImplementedError(
+                "tensor_transport= is not supported over the ray_tpu:// thin "
+                "client: the client process cannot serve as a device-object "
+                "holder. put() from a driver or actor on the cluster instead."
+            )
         blob = serialization.dumps(value)
         if len(blob) <= self._PUT_STREAM_THRESHOLD:
             resp = self._call("client_put", {"value": blob})

@@ -171,3 +171,25 @@ def barrier(group_name: str = "default"):
 def send_recv(tensor, perm, group_name: str = "default"):
     """Pairwise exchange (ppermute). The p2p primitive (reference send/recv)."""
     return _manager.get(group_name).send_recv(tensor, perm)
+
+
+def send(value, dst_rank: int, group_name: str = "default", tag: str = "0"):
+    """2-party point-to-point send (reference: collective.py:531): only the
+    two endpoints participate. ``tag`` pairs one send with one recv; device
+    arrays keep their sharding layout across the hop."""
+    return _manager.get(group_name).send(value, dst_rank, tag)
+
+
+def recv(src_rank: int, group_name: str = "default", tag: str = "0", timeout: float = 120.0):
+    """2-party point-to-point recv (reference: collective.py:594)."""
+    return _manager.get(group_name).recv(src_rank, tag, timeout)
+
+
+def local_group_hints() -> list:
+    """[(group_name, rank, world_size)] for every collective group THIS
+    process has initialized. The device-object plane stamps these into its
+    descriptors so a consumer can pick a transfer group it shares with the
+    holder without a directory service."""
+    with _manager._lock:
+        groups = list(_manager._groups.items())
+    return [(name, g.rank, g.world_size) for name, g in groups]

@@ -102,5 +102,19 @@ class CpuCollectiveGroup:
                 return stack[src]
         return np.asarray(x)
 
+    def send(self, value, dst_rank: int, tag: str) -> int:
+        """2-party p2p send (reference: collective.py:531). Unlike the ring
+        collectives above, only the two endpoints participate; device arrays
+        keep their sharding across the hop (p2p.py mailbox)."""
+        from ray_tpu.util.collective.p2p import mailbox_send
+
+        return mailbox_send(self.gcs, self.group_name, self.rank, dst_rank, tag, value)
+
+    def recv(self, src_rank: int, tag: str, timeout: float = 120.0):
+        """2-party p2p recv (reference: collective.py:594)."""
+        from ray_tpu.util.collective.p2p import mailbox_recv
+
+        return mailbox_recv(self.gcs, self.group_name, src_rank, self.rank, tag, timeout)
+
     def destroy(self):
         pass

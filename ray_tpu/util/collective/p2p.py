@@ -1,0 +1,75 @@
+"""Point-to-point transfer plane for collective groups.
+
+Analog of the reference's ``ray.util.collective`` ``send``/``recv``
+(python/ray/util/collective/collective.py:531/594): a 2-party transfer
+between two ranks of an initialized group, OUT OF BAND with respect to the
+shm object store — this is the wire the device-object plane
+(experimental/device_object/) rides for actor-to-actor tensor handoff.
+
+The mailbox rendezvous runs over the group's GCS KV (the same control plane
+the CPU ring collectives and the TPU world bootstrap already use): the
+sender posts the serialized value under a single-use tagged key, the
+receiver polls it down and deletes it. Device arrays serialize through
+``_private/serialization`` so sharding layout survives the hop and the
+receiver's ``device_put`` lands shards back on the matching devices.
+
+On real TPU hardware the collectives INSIDE jitted programs ride ICI; this
+2-party object mailbox stays on the host control plane until jax exposes a
+cross-process device-to-device transfer API in this image (the reference's
+NCCL p2p equivalent). The seam is ``TpuCollectiveGroup.send/recv`` — swap
+the mailbox for the device path there without touching any caller.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ray_tpu._private.concurrency import blocking
+
+_POLL_S = 0.003
+
+
+def mailbox_key(group_name: str, src_rank: int, dst_rank: int, tag: str) -> str:
+    """Public so senders can janitor abandoned transfers (a recv that timed
+    out or died never deletes the key; without cleanup the serialized
+    payload would sit in the GCS KV forever)."""
+    return f"collective/{group_name}/p2p/{src_rank}->{dst_rank}/{tag}"
+
+
+_key = mailbox_key
+
+
+@blocking
+def mailbox_send(gcs, group_name: str, src_rank: int, dst_rank: int, tag: str, value) -> int:
+    """Serialize ``value`` and post it for ``dst_rank``; returns byte size.
+    Single-use: the receiver deletes the key after pickup."""
+    from ray_tpu._private import serialization
+
+    data = serialization.dumps(value)
+    gcs.call(
+        "kv_put",
+        {"key": _key(group_name, src_rank, dst_rank, tag), "value": data},
+    )
+    return len(data)
+
+
+@blocking
+def mailbox_recv(gcs, group_name: str, src_rank: int, dst_rank: int, tag: str, timeout: float = 120.0):
+    """Block until the tagged value from ``src_rank`` arrives; deserializes
+    (device arrays reassemble with their original sharding) and deletes the
+    mailbox key."""
+    from ray_tpu._private import serialization
+
+    key = _key(group_name, src_rank, dst_rank, tag)
+    deadline = time.monotonic() + timeout
+    while True:
+        resp = gcs.call("kv_get", {"key": key})
+        if resp.get("found"):
+            gcs.call("kv_del", {"key": key})
+            return serialization.loads(resp["value"])
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"p2p recv on group {group_name!r} tag {tag!r} from rank "
+                f"{src_rank} timed out after {timeout}s"
+            )
+        time.sleep(_POLL_S)

@@ -426,6 +426,22 @@ class TpuCollectiveGroup:
         out = self._jit_op(("ppermute", x.shape, str(x.dtype), perm_t), build)(g)
         return self._local(out)[0]
 
+    def send(self, value, dst_rank: int, tag: str) -> int:
+        """2-party p2p send (reference: collective.py:531). In-program
+        collectives ride ICI (ppermute above); this out-of-band object
+        transfer uses the group's KV mailbox, and the receiver's device_put
+        re-lands shards on its mesh — swap in a device-direct transfer here
+        when jax exposes one (see util/collective/p2p.py)."""
+        from ray_tpu.util.collective.p2p import mailbox_send
+
+        return mailbox_send(self._gcs, self.group_name, self.rank, dst_rank, tag, value)
+
+    def recv(self, src_rank: int, tag: str, timeout: float = 120.0):
+        """2-party p2p recv (reference: collective.py:594)."""
+        from ray_tpu.util.collective.p2p import mailbox_recv
+
+        return mailbox_recv(self._gcs, self.group_name, src_rank, self.rank, tag, timeout)
+
     def destroy(self):
         """Tear down the XLA world so the group can re-form (gang restart):
         drops the compiled-op cache, shuts down jax.distributed (releasing

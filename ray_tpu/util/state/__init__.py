@@ -3,6 +3,7 @@
 from ray_tpu.util.state.api import (  # noqa: F401
     StateApiOptions,
     list_actors,
+    list_device_objects,
     list_jobs,
     list_nodes,
     list_objects,
@@ -15,6 +16,7 @@ from ray_tpu.util.state.api import (  # noqa: F401
 __all__ = [
     "StateApiOptions",
     "list_actors",
+    "list_device_objects",
     "list_jobs",
     "list_nodes",
     "list_objects",

@@ -182,6 +182,17 @@ def list_objects(address=None, filters=None, limit: int = 10_000) -> list[dict]:
         state.close()
 
 
+def list_device_objects(address=None, filters=None, limit: int = 10_000) -> list[dict]:
+    """Cluster-wide device-resident objects (experimental/device_object/):
+    one row per object the plane keeps on a holder's devices — shape, dtype,
+    payload bytes, transport, and the holder's identity."""
+    state = _state(address)
+    try:
+        return _apply_filters(state.device_objects(), filters)[:limit]
+    finally:
+        state.close()
+
+
 def summarize_tasks(address=None) -> dict:
     """Counts of tasks per (name, state) — reference's task summary view."""
     rows = list_tasks(address=address)

@@ -1,0 +1,354 @@
+"""Device object plane (experimental/device_object/, ISSUE 9).
+
+Device-resident jax.Array objects passed by reference: ``put(arr,
+tensor_transport="collective")`` / ``@remote(tensor_transport=...)`` seal
+only a descriptor into the store, the payload stays on the holder's
+devices and moves out of band — same-process live array (zero shm copies,
+asserted via store counters + flight-recorder events), collective p2p
+between group members (sharding preserved bit-exact), transparent
+host-shm fallback otherwise. Chaos: SIGKILLed holders surface
+DeviceObjectLostError NAMING the holder, a spilled copy rescues the same
+get, and out-of-scope refs verifiably free the device buffers.
+
+One module-scoped cluster: creating one per test would dominate tier-1
+wall time (see tier-1 budget notes in CHANGES).
+"""
+
+import gc
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import DeviceObjectLostError
+
+
+@pytest.fixture(scope="module")
+def dev_cluster():
+    ray_tpu.init(num_cpus=6, object_store_memory=256 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+def _store_objects() -> int:
+    from ray_tpu._private import worker_context
+
+    cw = worker_context.get_core_worker()
+    return cw.raylet.call("get_state")["store"]["num_objects"]
+
+
+def _driver_events(etype: str) -> list:
+    from ray_tpu._private import flight_recorder
+
+    proc = flight_recorder.dump() or {"events": []}
+    return [e for e in proc["events"] if e.get("type") == etype]
+
+
+def _sharded(n=64):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "tp"))
+    x = jnp.arange(float(n), dtype=jnp.float32).reshape(8, n // 8)
+    return jax.device_put(x, NamedSharding(mesh, P("dp", "tp")))
+
+
+@ray_tpu.remote(tensor_transport="collective")
+class Holder:
+    def pid(self):
+        return os.getpid()
+
+    def make(self, n=256):
+        import jax.numpy as jnp
+
+        return jnp.arange(float(n), dtype=jnp.float32)
+
+    def make_big(self, n):
+        import jax.numpy as jnp
+
+        return jnp.ones((n,), jnp.float32)
+
+    def make_sharded(self):
+        return _sharded()
+
+    def init_collective(self, world_size, rank, backend, group_name):
+        from ray_tpu.util import collective as col
+
+        col.init_collective_group(world_size, rank, backend=backend, group_name=group_name)
+
+    def spill_all(self):
+        from ray_tpu.experimental.device_object.manager import active_manager
+
+        m = active_manager()
+        return [m.spill(o) for o in m.object_ids()] if m is not None else []
+
+    def stats(self):
+        from ray_tpu.experimental.device_object import device_object_stats
+
+        return device_object_stats()
+
+
+@ray_tpu.remote
+class Consumer:
+    def init_collective(self, world_size, rank, backend, group_name):
+        from ray_tpu.util import collective as col
+
+        col.init_collective_group(world_size, rank, backend=backend, group_name=group_name)
+
+    def consume(self, w):
+        import jax
+
+        assert isinstance(w, jax.Array), type(w)
+        return {
+            "sum": float(np.asarray(w).sum()),
+            "sharding": repr(w.sharding),
+            "shards": sorted(
+                (s.device.id, tuple(sl.start or 0 for sl in s.index))
+                for s in w.addressable_shards
+            ),
+        }
+
+
+# ----------------------------------------------------------------------
+# acceptance: same-process handoff = zero host-shm copies of the payload
+# ----------------------------------------------------------------------
+
+
+def test_same_process_put_get_is_zero_copy(dev_cluster):
+    x = _sharded()
+    before_objects = _store_objects()
+    before_create = len(_driver_events("devobj_create"))
+    before_xfer = len(_driver_events("devobj_transfer"))
+    ref = ray_tpu.put(x, tensor_transport="collective")
+    out = ray_tpu.get(ref)
+    assert out is x  # the LIVE array, not a reassembled copy
+    # Store counters: the payload never touched the node's shm arena.
+    assert _store_objects() == before_objects
+    # Flight recorder: the plane narrated itself.
+    creates = _driver_events("devobj_create")
+    xfers = _driver_events("devobj_transfer")
+    assert len(creates) == before_create + 1
+    assert len(xfers) == before_xfer + 1
+    assert xfers[-1]["detail"].endswith(":local")
+    del ref, out
+
+
+def test_put_requires_jax_array(dev_cluster):
+    with pytest.raises(TypeError, match="jax.Array"):
+        ray_tpu.put(np.zeros(4), tensor_transport="collective")
+    with pytest.raises(ValueError, match="tensor_transport"):
+        ray_tpu.put(_sharded(), tensor_transport="nvlink")
+
+    @ray_tpu.remote(tensor_transport="bogus")
+    class Bad:
+        pass
+
+    with pytest.raises(ValueError, match="tensor_transport"):
+        Bad.remote()
+
+    @ray_tpu.remote
+    def fn():
+        return 1
+
+    with pytest.raises(ValueError, match="invalid"):
+        fn.options(tensor_transport="collective")  # tasks hold no state to be a holder
+
+
+# ----------------------------------------------------------------------
+# acceptance: cross-actor collective path, sharding preserved bit-exact
+# ----------------------------------------------------------------------
+
+
+def test_actor_to_actor_collective_handoff(dev_cluster):
+    from ray_tpu.util import collective as col
+
+    holder, consumer = Holder.remote(), Consumer.remote()
+    col.create_collective_group([holder, consumer], backend="cpu", group_name="plane")
+    before_objects = _store_objects()
+    wref = holder.make_sharded.remote()
+    out = ray_tpu.get(consumer.consume.remote(wref), timeout=120)
+    # Bit-exact, sharding preserved (same mesh axes, same per-device shards).
+    assert out["sum"] == float(np.arange(64.0).sum())
+    assert "dp" in out["sharding"] and "tp" in out["sharding"]
+    assert out["shards"] == sorted(
+        (s.device.id, tuple(sl.start or 0 for sl in s.index))
+        for s in _sharded().addressable_shards
+    )
+    st = ray_tpu.get(holder.stats.remote())
+    assert st["transfers_collective"] >= 1, st
+    # The payload rode the collective plane, not the shm store.
+    assert _store_objects() == before_objects
+    del wref
+    ray_tpu.kill(holder)
+    ray_tpu.kill(consumer)
+
+
+# ----------------------------------------------------------------------
+# no-group / cross-mesh fallback (transparent host path)
+# ----------------------------------------------------------------------
+
+
+def test_no_group_fallback_small_inline(dev_cluster):
+    holder = Holder.remote()
+    ref = holder.make.remote(256)
+    out = ray_tpu.get(ref, timeout=60)  # driver shares no group with holder
+    np.testing.assert_array_equal(np.asarray(out), np.arange(256.0))
+    del ref
+    ray_tpu.kill(holder)
+
+
+def test_no_group_fallback_large_via_store(dev_cluster):
+    holder = Holder.remote()
+    n = 1 << 20  # 4 MiB — far past the inline cutoff
+    ref = holder.make_big.remote(n)
+    out = ray_tpu.get(ref, timeout=120)
+    assert float(np.asarray(out).sum()) == float(n)
+    # Second get resolves again (from the sealed arena copy or the holder).
+    out2 = ray_tpu.get(ref, timeout=120)
+    assert float(np.asarray(out2).sum()) == float(n)
+    del ref, out, out2
+    ray_tpu.kill(holder)
+
+
+def test_device_ref_as_normal_task_arg(dev_cluster):
+    """A device ref passed to a plain (non-actor) task resolves through the
+    existing arg-resolution path in the leased worker."""
+    holder = Holder.remote()
+    ref = holder.make.remote(64)
+
+    @ray_tpu.remote
+    def total(w):
+        return float(np.asarray(w).sum())
+
+    assert ray_tpu.get(total.remote(ref), timeout=120) == float(np.arange(64.0).sum())
+    ready, _ = ray_tpu.wait([ref], timeout=10)
+    assert ready == [ref]
+    del ref
+    ray_tpu.kill(holder)
+
+
+# ----------------------------------------------------------------------
+# spill / restore under memory pressure
+# ----------------------------------------------------------------------
+
+
+def test_driver_spill_limit_and_restore(dev_cluster):
+    from ray_tpu._private.config import get_config
+    from ray_tpu.experimental.device_object import device_object_stats
+
+    import jax.numpy as jnp
+
+    cfg = get_config()
+    cfg.devobj_resident_limit_bytes = 6000
+    try:
+        before = device_object_stats()
+        r1 = ray_tpu.put(jnp.ones(1000, jnp.float32), tensor_transport="collective")
+        r2 = ray_tpu.put(jnp.full(1000, 2.0, jnp.float32), tensor_transport="collective")
+        st = device_object_stats()
+        # 8000 resident bytes > 6000 limit: the LRU entry (r1) spilled.
+        assert st["spills"] == before["spills"] + 1, st
+        assert st["resident_bytes"] <= 6000, st
+        v1 = ray_tpu.get(r1)  # restore on next resolve
+        np.testing.assert_array_equal(np.asarray(v1), np.ones(1000))
+        assert device_object_stats()["restores"] == before["restores"] + 1
+        np.testing.assert_array_equal(np.asarray(ray_tpu.get(r2)), np.full(1000, 2.0))
+        del r1, r2, v1
+    finally:
+        cfg.devobj_resident_limit_bytes = 0
+
+
+# ----------------------------------------------------------------------
+# chaos: holder death
+# ----------------------------------------------------------------------
+
+
+def test_sigkill_holder_names_it_in_lost_error(dev_cluster):
+    holder = Holder.remote()
+    pid = ray_tpu.get(holder.pid.remote())
+    ref = holder.make.remote(512)
+    ready, _ = ray_tpu.wait([ref], timeout=60)  # descriptor sealed at owner
+    assert ready
+    os.kill(pid, signal.SIGKILL)
+    time.sleep(0.5)
+    with pytest.raises(DeviceObjectLostError) as err:
+        ray_tpu.get(ref, timeout=60)
+    assert holder.actor_id[:16] in str(err.value)
+    del ref
+
+
+def test_sigkill_holder_with_spilled_copy_survives(dev_cluster):
+    holder = Holder.remote()
+    pid = ray_tpu.get(holder.pid.remote())
+    ref = holder.make.remote(2048)
+    ready, _ = ray_tpu.wait([ref], timeout=60)
+    assert ready
+    assert ray_tpu.get(holder.spill_all.remote()) == [True]
+    os.kill(pid, signal.SIGKILL)
+    time.sleep(0.5)
+    out = ray_tpu.get(ref, timeout=60)  # host copy in the arena rescues it
+    np.testing.assert_array_equal(np.asarray(out), np.arange(2048.0))
+    del ref, out
+
+
+# ----------------------------------------------------------------------
+# ownership: device buffers freed when refs go out of scope (no leak)
+# ----------------------------------------------------------------------
+
+
+def test_no_leak_across_100_iterations(dev_cluster):
+    holder = Holder.remote()
+    base = ray_tpu.get(holder.stats.remote())
+    for i in range(100):
+        ref = holder.make.remote(128)
+        out = ray_tpu.get(ref, timeout=60)
+        assert float(np.asarray(out)[1]) == 1.0
+        del ref, out
+    gc.collect()
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        st = ray_tpu.get(holder.stats.remote())
+        if (
+            st["resident_count"] == base["resident_count"]
+            and st["frees"] >= base["frees"] + 100
+        ):
+            break
+        time.sleep(0.2)
+    assert st["resident_count"] == base["resident_count"], st
+    assert st["creates"] >= base["creates"] + 100
+    assert st["frees"] >= base["frees"] + 100
+    ray_tpu.kill(holder)
+
+
+# ----------------------------------------------------------------------
+# state view
+# ----------------------------------------------------------------------
+
+
+def test_state_view_lists_device_objects(dev_cluster):
+    from ray_tpu.util.state import list_device_objects
+
+    x = _sharded()
+    ref = ray_tpu.put(x, tensor_transport="collective")
+    oid = ref.hex()
+    deadline = time.time() + 10
+    rows = []
+    while time.time() < deadline:
+        rows = [r for r in list_device_objects() if r["object_id"] == oid]
+        if rows:
+            break
+        time.sleep(0.1)
+    assert rows, "device object never appeared in the state view"
+    row = rows[0]
+    assert row["nbytes"] == x.nbytes and row["holder_kind"] == "driver"
+    del ref
+    gc.collect()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if not [r for r in list_device_objects() if r["object_id"] == oid]:
+            return
+        time.sleep(0.1)
+    raise AssertionError("freed device object still listed in the state view")
