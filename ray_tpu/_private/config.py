@@ -49,6 +49,19 @@ class Config:
     push_max_concurrent_per_dest: int = 2
     push_max_inbound: int = 8           # receiver-side concurrent push sessions
     push_admission_retries: int = 50    # sender retries while receiver is saturated
+    # pull-side transfer (pull_manager.py; reference: pull_manager.h:52)
+    pull_pipeline_depth: int = 4        # concurrent chunk RPCs per pull, per source
+    pull_max_sources: int = 4           # replicas a single pull stripes across
+    # Aggregate byte cap across concurrent inbound pulls on a node: past it,
+    # new pulls queue (admission_stall flight event) instead of over-
+    # committing the arena. A pull larger than the whole budget still admits
+    # alone. 0 = unbounded (the pre-PR-10 behavior).
+    pull_admission_budget_bytes: int = 256 * 1024 * 1024
+    # Raw-frame wire path for chunk transfer (rpc.py RAW_*): headers+payload
+    # straight from/into the arena, no msgpack encode of multi-MiB bytes.
+    # Negotiated per session; disabling forces the msgpack fallback
+    # everywhere (A/B lever for microbench --transfer).
+    transfer_raw_frames: bool = True
 
     # --- scheduling / raylet ---
     worker_lease_timeout_s: float = 30.0

@@ -95,6 +95,12 @@ EVENT_TYPES = (
     "devobj_spill",    # 26
     "devobj_restore",  # 27
     "devobj_free",     # 28
+    # Transfer plane (pull_manager.py / push_manager.py, PR 10).
+    "transfer_pull",   # 29: pull sealed (detail oid:bytes:sources:frame)
+    "transfer_push",   # 30: outbound push committed (detail oid:bytes:frame)
+    "transfer_relay",  # 31: cut-through relay began forwarding pre-seal
+    "admission_stall", # 32: pull queued on pull_admission_budget_bytes
+    "pull_source_demoted",  # 33: pull source errored; ranked last
 )
 _CODE = {name: i for i, name in enumerate(EVENT_TYPES)}
 

@@ -34,16 +34,36 @@ from collections import deque
 from dataclasses import dataclass, field
 
 from ray_tpu._private import flight_recorder, self_metrics
+from ray_tpu._private.concurrency import loop_only
 from ray_tpu._private.config import get_config
 from ray_tpu._private.ids import BoundedIdSet, NodeID, WorkerID
-from ray_tpu._private.rpc import EventLoopThread, RpcClient, RpcServer, schema
+from ray_tpu._private.rpc import (
+    RAW_CHUNK,
+    EventLoopThread,
+    RawFrame,
+    RawResult,
+    RpcClient,
+    RpcServer,
+    schema,
+)
+from ray_tpu._private.transfer_stats import TRANSFER
 from ray_tpu._private.store.arena import create_arena
 from ray_tpu._private.store.object_store import StoreCore
 from ray_tpu._private.task_spec import TaskSpec
 
 logger = logging.getLogger(__name__)
 
-CHUNK = 4 * 1024 * 1024
+def _binomial_split(targets: list) -> list[tuple[dict, list]]:
+    """Binomial-tree fan-out: peel a child off the front, hand it half the
+    remainder as its subtree, repeat — the root contacts O(log N) children
+    directly and every child does the same with its share."""
+    splits = []
+    rest = list(targets)
+    while rest:
+        child, rest = rest[0], rest[1:]
+        subtree, rest = rest[: len(rest) // 2], rest[len(rest) // 2 :]
+        splits.append((child, subtree))
+    return splits
 
 
 def _runtime_env_hash(runtime_env: dict | None) -> str | None:
@@ -159,15 +179,26 @@ class Raylet:
         self._last_progress = time.monotonic()
         self.cluster_view: dict = {}
         self._synced_peers: set[str] = set()
-        self._pulls_inflight: dict[str, asyncio.Future] = {}
         self._peer_clients: dict[str, RpcClient] = {}
-        self._inbound_pushes: dict[str, int] = {}  # object_id -> arena offset
+        self._inbound_pushes: dict[str, dict] = {}  # object_id -> push session
+        # Commit outcomes, remembered briefly (see rpc_push_commit): a
+        # sender retrying a timed-out/blipped commit must observe the REAL
+        # subtree verdict, not a contains() guess that drops relay failures.
+        self._commit_results: dict[str, asyncio.Future] = {}
+        # Advertised in push_begin replies and honored for fetch responses;
+        # flip off (config transfer_raw_frames / per-instance in tests) to
+        # force the msgpack fallback on every session through this node.
+        self.raw_frames_enabled = self.cfg.transfer_raw_frames
         from ray_tpu._private.push_manager import PushManager
 
         self.push_manager = PushManager(self)
+        from ray_tpu._private.pull_manager import PullManager
+
+        self.pull_manager = PullManager(self)
 
         self.server = RpcServer(f"raylet-{self.node_id[:8]}")
         self.server.register_all(self)
+        self.server.set_raw_handler(self._on_raw_frame)
         self.server.start(node_ip, 0)
         self.address = self.server.address
 
@@ -488,18 +519,46 @@ class Raylet:
         try:
             start = req["start"]
             end = min(start + req["length"], size)
-            data = bytes(self.arena.read(offset + start, end - start))
-            return {"data": data}
+            if start < 0 or end <= start:
+                # Out-of-range request (stale/buggy peer): answer empty on
+                # the msgpack path — the puller sees a short chunk and fails
+                # over — instead of handing arena.read a negative length.
+                return {"data": b""}
+            if req.get("raw") and self.raw_frames_enabled:
+                # Raw response: the arena view goes straight to the socket;
+                # the pin transfers to on_sent, released once the transport
+                # has taken the bytes.
+                view = self.arena.read(offset + start, end - start)
+                TRANSFER.chunks_raw_out += 1
+                TRANSFER.bytes_out += end - start
+                result = RawResult(
+                    object_id,
+                    start,
+                    view,
+                    on_sent=lambda: self.store.release(object_id),
+                )
+                offset = None  # pin now owned by on_sent
+                return result
+            TRANSFER.chunks_msgpack_out += 1
+            TRANSFER.bytes_out += end - start
+            return {"data": bytes(self.arena.read(offset + start, end - start))}
         finally:
-            self.store.release(object_id)
+            if offset is not None:
+                self.store.release(object_id)
 
     # ---- push-side transfer (reference: push_manager.h:29 sender pacing,
     # pull_manager.h:52 admission control) ----
 
-    @schema(object_id=str, size=int)
+    @schema(object_id=str, size=int, relay_targets=[list])
     async def rpc_push_begin(self, req):
         """Receiver-side admission: open a push session or refuse (saturated /
-        already present / no arena space). The pusher backs off and retries."""
+        already present / no arena space). The pusher backs off and retries.
+
+        ``relay_targets``: cut-through broadcast — this node starts relaying
+        the session's bytes to the subtree AS THEY ARRIVE (push_manager.
+        stream_from_session), not after seal; push_commit folds the subtree
+        outcome into its reply. The reply advertises ``raw_ok`` when this
+        node accepts raw chunk frames for the session."""
         from ray_tpu.exceptions import ObjectStoreFullError
 
         object_id, size = req["object_id"], req["size"]
@@ -528,40 +587,173 @@ class Raylet:
             if self.store.contains(object_id):
                 return {"accepted": False, "already": True}
             return {"accepted": False, "retry_after": 0.2}
-        self._inbound_pushes[object_id] = {
-            "offset": offset, "size": size, "ts": time.monotonic()
+        sess = self._inbound_pushes[object_id] = {
+            "offset": offset,
+            "size": size,
+            "ts": time.monotonic(),
+            # Contiguous-prefix watermark over received chunks: cut-through
+            # relays stream [0, contig) downstream while later chunks are
+            # still in flight (pipelined senders may arrive out of order).
+            "chunks": {},
+            "contig": 0,
+            "event": asyncio.Event(),
+            "aborted": False,
+            "relays": [],
         }
-        return {"accepted": True}
+        for child, subtree in _binomial_split(list(req.get("relay_targets") or [])):
+            # (task, child, subtree): commit needs the tree shape back to
+            # name the nodes a dead relay took down with it.
+            sess["relays"].append(
+                (
+                    asyncio.ensure_future(
+                        self.push_manager.stream_from_session(
+                            sess, object_id, child, subtree, req.get("timeout")
+                        )
+                    ),
+                    child,
+                    subtree,
+                )
+            )
+        return {"accepted": True, "raw_ok": self.raw_frames_enabled}
 
-    @schema(object_id=str, start=int, data=bytes)
-    async def rpc_push_chunk(self, req):
-        sess = self._inbound_pushes.get(req["object_id"])
-        if sess is None:
-            return {"ok": False}
-        start, data = req["start"], req["data"]
-        if start < 0 or start + len(data) > sess["size"]:
+    @loop_only
+    def _push_session_write(self, object_id: str, start: int, data) -> dict:
+        """Land one chunk (msgpack or raw path) into its session buffer and
+        advance the relay watermark. Synchronous — raw frames call this while
+        their payload memoryview is still valid."""
+        sess = self._inbound_pushes.get(object_id)
+        if sess is None or sess["aborted"]:
+            return {"ok": False, "error": "no session"}
+        length = len(data)
+        if start < 0 or start + length > sess["size"]:
             # Out-of-range write would corrupt the neighboring arena object.
             return {"ok": False, "error": "chunk out of range"}
         self.arena.write(sess["offset"] + start, data)
         sess["ts"] = time.monotonic()
+        TRANSFER.bytes_in += length
+        if start >= sess["contig"]:
+            chunks = sess["chunks"]
+            prev = chunks.get(start, 0)
+            if length > prev:
+                chunks[start] = length
+            while sess["contig"] in chunks:
+                sess["contig"] += chunks.pop(sess["contig"])
+            sess["event"].set()
         return {"ok": True}
+
+    @loop_only
+    def _on_raw_frame(self, frame: RawFrame) -> dict:
+        """Server raw sink (rpc.py): chunk payloads scatter straight into the
+        session's arena block — no msgpack decode, no intermediate bytes."""
+        if frame.kind == RAW_CHUNK:
+            TRANSFER.chunks_raw_in += 1
+            return self._push_session_write(frame.oid, frame.start, frame.payload)
+        return {"ok": False, "error": f"unknown raw frame kind {frame.kind}"}
+
+    @schema(object_id=str, start=int, data=bytes)
+    async def rpc_push_chunk(self, req):
+        TRANSFER.chunks_msgpack_in += 1
+        return self._push_session_write(req["object_id"], req["start"], req["data"])
 
     @schema(object_id=str)
     async def rpc_push_commit(self, req):
         object_id = req["object_id"]
-        if self._inbound_pushes.pop(object_id, None) is None:
-            # Session lost (abort raced the commit); present iff sealed earlier.
+        sess = self._inbound_pushes.pop(object_id, None)
+        if sess is None:
+            # No live session: either a RETRIED commit (the sender's first
+            # reply timed out or rode a reset connection) — serve the
+            # remembered outcome, which may still be gathering its relay
+            # subtree; this reply is the ONLY carrier of the cut-through
+            # verdict, and a bare contains() guess would report ok while
+            # dropping subtree failures — or an abort raced the commit
+            # (present iff sealed earlier).
+            fut = self._commit_results.get(object_id)
+            if fut is not None:
+                return await fut
             return {"ok": self.store.contains(object_id)}
+        fut = asyncio.get_event_loop().create_future()
+        self._commit_results[object_id] = fut
+        try:
+            result = await self._finish_commit(object_id, sess)
+        except Exception as e:  # noqa: BLE001
+            from ray_tpu._private.push_manager import subtree_node_ids
+
+            failed = [self.node_id]
+            for _, child, subtree in sess["relays"]:
+                failed.extend(subtree_node_ids(child, subtree))
+            result = {"ok": False, "failed": failed, "error": repr(e)}
+        fut.set_result(result)
+
+        def _forget(oid=object_id, f=fut):
+            if self._commit_results.get(oid) is f:  # never pop a successor's
+                self._commit_results.pop(oid, None)
+
+        asyncio.get_event_loop().call_later(120.0, _forget)
+        return result
+
+    async def _finish_commit(self, object_id: str, sess: dict) -> dict:
+        if sess["contig"] != sess["size"]:
+            # Commit without all bytes (sender bug / lost ack): refuse rather
+            # than seal a hole-y object.
+            self._abort_push_session(object_id, sess)
+            return {"ok": False, "error": "incomplete push session"}
         self.store.seal(object_id)
-        await self.gcs.acall(
-            "add_object_location", {"object_id": object_id, "node_id": self.node_id}
-        )
-        return {"ok": True}
+        # Pin IMMEDIATELY after seal, before ANY await: a sealed, unpinned
+        # object is spill/evict fair game, and the cut-through relays are
+        # still reading its arena block (sess["offset"]). seal() and the
+        # sealed-entry branch of get() run without suspending, so no other
+        # coroutine can evict in between; awaiting the GCS announce first
+        # (the original ordering) opened exactly that window.
+        pinned = bool(sess["relays"])
+        if pinned:
+            await self.store.get(object_id)
+        results = None
+        try:
+            try:
+                await self.gcs.acall(
+                    "add_object_location",
+                    {"object_id": object_id, "node_id": self.node_id},
+                )
+            finally:
+                # Drain the relays BEFORE any path can release the pin: even
+                # when the announce raises, the relay tasks keep reading
+                # sess["offset"], and an unpinned sealed object is evict
+                # fair game — they would forward reused-block bytes and the
+                # children would seal corrupt copies.
+                if sess["relays"]:
+                    results = await asyncio.gather(
+                        *(t for t, _, _ in sess["relays"]), return_exceptions=True
+                    )
+            if results is None:
+                return {"ok": True}
+            # Cut-through subtree outcome folds into THIS reply so failures
+            # propagate to the broadcast root.
+        finally:
+            if pinned:
+                self.store.release(object_id)
+        failed: list[str] = []
+        for (_, child, subtree), r in zip(sess["relays"], results):
+            if isinstance(r, BaseException):
+                # A relay that died without reporting takes its whole
+                # subtree down; name the NODES (the failed-list contract —
+                # callers reconcile entries against target node ids).
+                from ray_tpu._private.push_manager import subtree_node_ids
+
+                failed.extend(subtree_node_ids(child, subtree))
+            elif not r.get("ok"):
+                failed.extend(r.get("failed") or [child["node_id"]])
+        return {"ok": not failed, "failed": failed}
+
+    def _abort_push_session(self, object_id: str, sess: dict):
+        sess["aborted"] = True
+        sess["event"].set()  # wake relay waiters so they fail fast
+        self.store.abort(object_id)
 
     @schema(object_id=str)
     async def rpc_push_abort(self, req):
-        if self._inbound_pushes.pop(req["object_id"], None) is not None:
-            self.store.abort(req["object_id"])
+        sess = self._inbound_pushes.pop(req["object_id"], None)
+        if sess is not None:
+            self._abort_push_session(req["object_id"], sess)
         return {"ok": True}
 
     def _reap_stale_push_sessions(self):
@@ -572,7 +764,7 @@ class Raylet:
         for oid, sess in list(self._inbound_pushes.items()):
             if now - sess["ts"] > 60.0:
                 self._inbound_pushes.pop(oid, None)
-                self.store.abort(oid)
+                self._abort_push_session(oid, sess)
                 logger.warning("reaped stale inbound push session for %s", oid[:8])
 
     @schema(object_id=str, targets=[list])
@@ -580,103 +772,49 @@ class Raylet:
         """Fan an object out to `targets` over a binomial tree: this node
         pushes to O(log N) children, each child relays to its subtree. The
         1-GiB-to-50-nodes envelope (BASELINE.md) needs this — a flat push
-        loop would serialize on the root's NIC."""
+        loop would serialize on the root's NIC.
+
+        The subtree rides IN the push itself (push_begin relay_targets):
+        each level starts forwarding after its first received chunk
+        (cut-through), so end-to-end latency is O(size + depth × chunk)
+        instead of the old store-and-forward O(depth × size)."""
         object_id = req["object_id"]
         targets = list(req.get("targets", []))
+        timeout = req.get("timeout", 300.0)
         if not self.store.contains(object_id):
             # contains() is sealed-only on purpose: an unsealed entry (a
             # rival inbound session that may yet be aborted) must not make
             # us skip the pull and then block forever in push's store.get.
-            await self._pull_object(object_id, timeout=req.get("timeout", 300.0))
+            await self._pull_object(object_id, timeout=timeout)
+        from ray_tpu._private.push_manager import subtree_node_ids
 
-        async def relay(child, subtree):
-            ok = await self.push_manager.push(object_id, child["node_id"], child["address"])
-            if not ok:
-                raise RuntimeError(f"push to {child['node_id'][:8]} failed")
-            if subtree:
-                resp = await self._peer(child["node_id"], child["address"]).acall(
-                    "broadcast_object",
-                    {"object_id": object_id, "targets": subtree},
-                    timeout=req.get("timeout", 300.0),
+        splits = _binomial_split(targets)
+        results = await asyncio.gather(
+            *(
+                self.push_manager.push(
+                    object_id,
+                    child["node_id"],
+                    child["address"],
+                    relay_targets=subtree,
+                    timeout=timeout,
                 )
-                if not resp.get("ok"):
-                    raise RuntimeError(f"relay via {child['node_id'][:8]}: {resp.get('failed')}")
-
-        tasks = []
-        rest = targets
-        while rest:
-            child, rest = rest[0], rest[1:]
-            subtree, rest = rest[: len(rest) // 2], rest[len(rest) // 2 :]
-            tasks.append(relay(child, subtree))
-        results = await asyncio.gather(*tasks, return_exceptions=True)
-        failed = [str(r) for r in results if isinstance(r, Exception)]
+                for child, subtree in splits
+            ),
+            return_exceptions=True,
+        )
+        failed: list[str] = []
+        for (child, subtree), r in zip(splits, results):
+            if isinstance(r, BaseException):
+                failed.extend(subtree_node_ids(child, subtree))
+            elif not r.get("ok"):
+                failed.extend(r.get("failed") or [child["node_id"]])
         return {"ok": not failed, "failed": failed}
 
     async def _pull_object(self, object_id: str, timeout: float | None):
-        fut = self._pulls_inflight.get(object_id)
-        if fut is not None:
-            await fut
-            return
-        fut = asyncio.get_event_loop().create_future()
-        self._pulls_inflight[object_id] = fut
-        try:
-            deadline = time.monotonic() + (timeout if timeout is not None else 3600.0)
-            poll = 0.02
-            while time.monotonic() < deadline:
-                if self.store.contains(object_id):
-                    # A local task (or inbound push) produced AND SEALED it
-                    # while we were looking remotely; an unsealed rival
-                    # session doesn't count — it may still be aborted.
-                    fut.set_result(True)
-                    return
-                resp = await self.gcs.acall("get_object_locations", {"object_id": object_id})
-                locs = [l for l in resp["locations"] if l["node_id"] != self.node_id]
-                if not locs:
-                    await asyncio.sleep(poll)
-                    poll = min(poll * 1.5, 0.5)
-                    continue
-                loc = locs[0]
-                peer = self._peer(loc["node_id"], loc["address"])
-                try:
-                    info = await peer.acall("fetch_object_info", {"object_id": object_id})
-                    if not info.get("found"):
-                        await asyncio.sleep(0.05)
-                        continue
-                    size = info["size"]
-                    offset = await self.store.create(object_id, size)
-                    if offset is None:
-                        # Rival creator appeared during create: loop back and
-                        # wait for it to seal (or vanish).
-                        await asyncio.sleep(0.05)
-                        continue
-                    pos = 0
-                    while pos < size:
-                        chunk = await peer.acall(
-                            "fetch_object_chunk",
-                            {"object_id": object_id, "start": pos, "length": CHUNK},
-                        )
-                        data = chunk["data"]
-                        self.arena.write(offset + pos, data)
-                        pos += len(data)
-                    self.store.seal(object_id)
-                    await self.gcs.acall(
-                        "add_object_location", {"object_id": object_id, "node_id": self.node_id}
-                    )
-                    fut.set_result(True)
-                    return
-                except Exception as e:
-                    logger.debug("pull of %s from %s failed: %s", object_id[:8], loc["node_id"][:8], e)
-                    self.store.abort(object_id)
-                    await asyncio.sleep(0.05)
-            raise TimeoutError(f"pull of {object_id} timed out")
-        except BaseException as e:
-            if not fut.done():
-                fut.set_exception(e)
-            raise
-        finally:
-            self._pulls_inflight.pop(object_id, None)
-            if not fut.done():
-                fut.set_result(False)
+        """Fetch a remote object into the local store (pull_manager.py:
+        pipelined chunk requests striped across every known replica, ranked
+        failover, and an aggregate admission byte budget)."""
+        await self.pull_manager.pull(object_id, timeout)
 
     def _peer(self, node_id: str, address) -> RpcClient:
         client = self._peer_clients.get(node_id)
@@ -1467,7 +1605,9 @@ class Raylet:
             # between create and seal (active push/pull sessions exempt).
             try:
                 self.store.reap_orphaned_unsealed(
-                    60.0, exclude=set(self._inbound_pushes) | set(self._pulls_inflight)
+                    60.0,
+                    exclude=set(self._inbound_pushes)
+                    | self.pull_manager.inflight_ids(),
                 )
             except Exception:
                 pass

@@ -10,6 +10,19 @@ Wire format: 4-byte big-endian length, then a msgpack array
 ``[type, seq, method, payload]`` where type is REQUEST/RESPONSE/ERROR/PUSH.
 Payloads are msgpack-native structures; rich Python objects are serialized by
 the caller (see serialization.py) before they enter the RPC layer.
+
+Raw frames (transfer hot path): a multi-MiB object chunk riding the msgpack
+envelope costs an encode of the ``bytes`` payload plus a ``bytes(...)`` copy
+on each side. A RAW frame instead sets the top bit of the length prefix and
+carries a fixed binary header (kind, seq, object-id, start offset) followed
+by the payload bytes written straight from an arena ``memoryview``; the
+receive side hands the payload to a synchronous sink as a ``memoryview``
+into the read buffer, so it lands at its arena destination with a single
+copy and no intermediate Python ``bytes`` object. Raw support is negotiated
+per transfer session (``push_begin``/``fetch_object_chunk`` payload keys);
+peers that never advertise it keep the msgpack path, and a torn connection
+mid-raw-frame tears the whole connection exactly like a torn msgpack frame
+(the length prefix scopes both), so the stream can never desynchronize.
 """
 
 from __future__ import annotations
@@ -17,6 +30,7 @@ from __future__ import annotations
 import asyncio
 import collections
 import logging
+import struct
 import threading
 import time
 import traceback
@@ -32,6 +46,58 @@ logger = logging.getLogger(__name__)
 REQUEST, RESPONSE, ERROR, PUSH = 0, 1, 2, 3
 
 _MAX_FRAME = 1 << 31
+
+# ---- raw frames ----
+# Length prefix with the top bit set marks a raw frame; the low 31 bits are
+# the byte count of header+id+payload. A pre-raw peer that is mistakenly sent
+# one fails fast with "frame too large" and resets the connection — raw is
+# only ever sent after the receiver advertised it, so this is a bug trap,
+# not a compatibility channel.
+RAW_FLAG = 0x80000000
+# kind u8, flags u8 (reserved), oid_len u16, seq u32, start u64.
+_RAW_HDR = struct.Struct("<BBHIQ")
+RAW_CHUNK = 1  # client -> server: object chunk into an open push session
+RAW_RESP = 2   # server -> client: chunk payload answering a pending request
+
+
+class RawFrame:
+    """A decoded raw frame. ``payload`` is a memoryview into the connection
+    read buffer: valid ONLY until the consumer yields control back to the
+    frame stream (it is released on generator resume), so raw sinks/handlers
+    must consume it synchronously (one arena memcpy, no awaits)."""
+
+    __slots__ = ("kind", "seq", "oid", "start", "payload")
+
+    def __init__(self, kind, seq, oid, start, payload):
+        self.kind = kind
+        self.seq = seq
+        self.oid = oid
+        self.start = start
+        self.payload = payload
+
+
+class RawResult:
+    """Returned by an rpc_ handler to answer with a raw frame instead of a
+    msgpack RESPONSE. ``payload`` is written straight to the socket (an arena
+    memoryview stays zero-copy); ``on_sent`` runs after the transport has
+    taken the bytes — use it to release an object pin."""
+
+    __slots__ = ("oid", "start", "payload", "on_sent")
+
+    def __init__(self, oid: str, start: int, payload, on_sent=None):
+        self.oid = oid
+        self.start = start
+        self.payload = payload
+        self.on_sent = on_sent
+
+
+def _pack_raw_header(kind: int, seq: int, oid_b: bytes, start: int, payload_len: int) -> bytes:
+    n = _RAW_HDR.size + len(oid_b) + payload_len
+    return (
+        (RAW_FLAG | n).to_bytes(4, "big")
+        + _RAW_HDR.pack(kind, 0, len(oid_b), seq, start)
+        + oid_b
+    )
 
 
 class _WireStats:
@@ -148,9 +214,36 @@ async def _frame_stream(reader: asyncio.StreamReader):
         avail = len(buf) - pos
         if avail >= 4:
             length = int.from_bytes(buf[pos : pos + 4], "big")
-            if length > _MAX_FRAME:
+            if length & RAW_FLAG:
+                # Raw frame: fixed header + object id + payload, no msgpack.
+                n = length & ~RAW_FLAG
+                if n < _RAW_HDR.size or n > _MAX_FRAME:
+                    raise RpcError(f"bad raw frame length: {n}")
+                if avail >= 4 + n:
+                    kind, _flags, oid_len, seq, rstart = _RAW_HDR.unpack_from(
+                        buf, pos + 4
+                    )
+                    if _RAW_HDR.size + oid_len > n:
+                        raise RpcError("raw frame header overruns frame")
+                    id_at = pos + 4 + _RAW_HDR.size
+                    oid = bytes(buf[id_at : id_at + oid_len]).decode()
+                    pos += 4 + n
+                    WIRE.frames_in += 1
+                    WIRE.bytes_in += n + 4
+                    # The payload memoryview aliases the read buffer: hand it
+                    # out for the duration of ONE consumer step and release
+                    # it on resume, so the buffer can compact/grow again.
+                    mv = memoryview(buf)
+                    payload = mv[id_at + oid_len : pos]
+                    try:
+                        yield RawFrame(kind, seq, oid, rstart, payload)
+                    finally:
+                        payload.release()
+                        mv.release()
+                    continue
+            elif length > _MAX_FRAME:
                 raise RpcError(f"frame too large: {length}")
-            if avail >= 4 + length:
+            elif avail >= 4 + length:
                 start = pos + 4
                 frame = msgpack.unpackb(bytes(buf[start : start + length]), raw=False)
                 pos = start + length
@@ -245,9 +338,17 @@ class RpcServer:
         self._conns: set[asyncio.StreamWriter] = set()
         self.address: tuple[str, int] | str | None = None
         self._io = EventLoopThread.get()
+        # Raw-frame sink: a SYNCHRONOUS callable (frame: RawFrame) -> dict,
+        # invoked inline on the connection loop before the read buffer moves
+        # (the payload memoryview dies when the frame stream resumes).
+        self._raw_handler: Callable[[RawFrame], dict] | None = None
 
     def register(self, method: str, handler: Handler):
         self._handlers[method] = handler
+
+    @any_thread
+    def set_raw_handler(self, handler: Callable[[RawFrame], dict]):
+        self._raw_handler = handler
 
     def register_all(self, obj, prefix: str = ""):
         """Register every ``rpc_<name>`` coroutine method of obj as <name>."""
@@ -264,6 +365,23 @@ class RpcServer:
         self._conns.add(writer)
         try:
             async for frame in _frame_stream(reader):
+                if type(frame) is RawFrame:
+                    # Handled INLINE (not ensure_future): the payload view is
+                    # only valid until the stream resumes, and the arena
+                    # write is a synchronous memcpy anyway.
+                    handler = self._raw_handler
+                    try:
+                        if handler is None:
+                            result = {"ok": False, "error": "no raw handler"}
+                        else:
+                            result = handler(frame)
+                    except Exception as e:  # noqa: BLE001
+                        result = {"ok": False, "error": repr(e)}
+                    writer.write(_pack([RESPONSE, frame.seq, "raw_chunk", result]))
+                    pending = _drain_if_needed(writer)
+                    if pending is not None:
+                        await pending
+                    continue
                 mtype, seq, method, payload = frame
                 if mtype == REQUEST:
                     asyncio.ensure_future(
@@ -292,7 +410,31 @@ class RpcServer:
                 if problem:
                     raise RpcError(f"schema violation in {method!r}: {problem}")
             result = await handler(payload)
-            if writer is not None:
+            if isinstance(result, RawResult):
+                # Negotiated raw response: header + payload straight to the
+                # socket, no msgpack encode / bytes copy of the chunk. The
+                # transport owns the bytes once write() returns, so on_sent
+                # (typically an object-pin release) is safe immediately after.
+                try:
+                    if writer is not None:
+                        oid_b = result.oid.encode()
+                        writer.write(
+                            _pack_raw_header(
+                                RAW_RESP, seq, oid_b, result.start, len(result.payload)
+                            )
+                        )
+                        writer.write(result.payload)
+                        WIRE.frames_out += 1
+                        WIRE.bytes_out += (
+                            4 + _RAW_HDR.size + len(oid_b) + len(result.payload)
+                        )
+                        pending = _drain_if_needed(writer)
+                        if pending is not None:
+                            await pending
+                finally:
+                    if result.on_sent is not None:
+                        result.on_sent()
+            elif writer is not None:
                 writer.write(_pack([RESPONSE, seq, method, result]))
                 pending = _drain_if_needed(writer)
                 if pending is not None:
@@ -364,6 +506,10 @@ class RpcClient:
         self._writer: asyncio.StreamWriter | None = None
         self._reader_task: asyncio.Task | None = None
         self._pending: dict[int, asyncio.Future] = {}
+        # seq -> synchronous sink for a negotiated raw response: called with
+        # the RawFrame while its payload view is valid (scatter straight into
+        # the arena), its return value resolves the pending future.
+        self._raw_sinks: dict[int, Callable[[RawFrame], Any]] = {}
         self._seq = 0
         self._push_handler: Callable[[str, dict], None] | None = None
         self._closed = False
@@ -395,9 +541,28 @@ class RpcClient:
     async def _read_loop(self, reader):
         try:
             async for frame in _frame_stream(reader):
+                if type(frame) is RawFrame:
+                    sink = self._raw_sinks.pop(frame.seq, None)
+                    fut = self._pending.pop(frame.seq, None)
+                    try:
+                        result = sink(frame) if sink is not None else None
+                    except Exception as e:  # noqa: BLE001
+                        if fut is not None and not fut.done():
+                            fut.set_exception(
+                                RpcError(f"{self.label}: raw sink failed: {e!r}")
+                            )
+                    else:
+                        if fut is not None and not fut.done():
+                            fut.set_result(
+                                result
+                                if result is not None
+                                else {"ok": True, "len": len(frame.payload)}
+                            )
+                    continue
                 mtype, seq, method, payload = frame
                 if mtype in (RESPONSE, ERROR):
                     fut = self._pending.pop(seq, None)
+                    self._raw_sinks.pop(seq, None)  # peer answered in msgpack
                     if fut is not None and not fut.done():
                         if mtype == RESPONSE:
                             fut.set_result(payload)
@@ -424,21 +589,57 @@ class RpcClient:
                 if not fut.done():
                     fut.set_exception(ConnectionLost(f"connection to {self.label} lost"))
             self._pending.clear()
+            self._raw_sinks.clear()
 
-    async def astart_call(self, method: str, payload: dict | None = None) -> "asyncio.Future":
+    async def astart_call(
+        self, method: str, payload: dict | None = None, raw_sink=None
+    ) -> "asyncio.Future":
         """Send a request; return the response future without awaiting it.
 
         Lets callers pipeline ordered calls: the send happens under the client
         lock (FIFO), so two astart_call()s issued in order hit the wire in
         order (the analog of the reference's SequentialActorSubmitQueue).
+
+        ``raw_sink``: synchronous callable invoked with the RawFrame if the
+        peer answers this request with a raw frame (negotiated transfer
+        path); its return value resolves the future. A msgpack answer simply
+        resolves the future as usual (the sink is dropped) — that IS the
+        mixed-version fallback.
         """
         async with self._lock:
             await self._ensure_connected()
             self._seq += 1
             seq = self._seq
             fut = asyncio.get_event_loop().create_future()
+            fut._rtpu_seq = seq  # lets acall unregister on per-attempt timeout
             self._pending[seq] = fut
+            if raw_sink is not None:
+                self._raw_sinks[seq] = raw_sink
             self._writer.write(_pack([REQUEST, seq, method, payload or {}]))
+            pending = _drain_if_needed(self._writer)
+            if pending is not None:
+                await pending
+        return fut
+
+    async def astart_raw(
+        self, kind: int, oid: str, start: int, payload
+    ) -> "asyncio.Future":
+        """Send a raw frame (header + payload bytes, no msgpack); return the
+        future for the receiver's ack. ``payload`` is any buffer — an arena
+        memoryview goes to the socket without an intermediate ``bytes``
+        (the transport copies only what it cannot send immediately). Only
+        valid after the peer advertised raw support for this session."""
+        async with self._lock:
+            await self._ensure_connected()
+            self._seq += 1
+            seq = self._seq
+            fut = asyncio.get_event_loop().create_future()
+            self._pending[seq] = fut
+            oid_b = oid.encode()
+            self._writer.write(_pack_raw_header(kind, seq, oid_b, start, len(payload)))
+            self._writer.write(payload)
+            WIRE.frames_out += 1
+            WIRE.bytes_out += 4 + _RAW_HDR.size + len(oid_b) + len(payload)
             pending = _drain_if_needed(self._writer)
             if pending is not None:
                 await pending
@@ -485,6 +686,7 @@ class RpcClient:
         payload: dict | None = None,
         timeout: float | None = None,
         retries: int | None = None,
+        raw_sink=None,
     ):
         """Async call from the IO loop.
 
@@ -497,12 +699,24 @@ class RpcClient:
         max_retries = self._retries if retries is None else retries
         attempt = 0
         while True:
+            fut = None
             try:
-                fut = await self.astart_call(method, payload)
+                fut = await self.astart_call(method, payload, raw_sink=raw_sink)
                 if timeout is not None:
                     return await asyncio.wait_for(fut, timeout)
                 return await fut
             except (ConnectionLost, asyncio.TimeoutError):
+                # Unregister the abandoned attempt. CRITICAL for raw sinks: a
+                # LATE raw response must never invoke a sink whose caller has
+                # moved on — the sink writes memory (arena scatter), and its
+                # destination may have been freed/reused by then. With the
+                # entry popped, the late frame resolves nothing and is
+                # dropped on the floor.
+                if fut is not None:
+                    seq = getattr(fut, "_rtpu_seq", None)
+                    if seq is not None:
+                        self._pending.pop(seq, None)
+                        self._raw_sinks.pop(seq, None)
                 attempt += 1
                 if self._closed or attempt > max_retries:
                     raise

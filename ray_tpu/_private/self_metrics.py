@@ -108,6 +108,45 @@ def instruments() -> dict:
                 "ray_tpu_rpc_write_hwm_stalls_total",
                 "Writes that hit the socket write high-water mark (backpressure).",
             ),
+            # --- transfer plane (push_manager.py / pull_manager.py) ---
+            "transfer_bytes": m.Counter(
+                "ray_tpu_transfer_bytes_total",
+                "Object chunk payload bytes moved node-to-node, by direction.",
+                tag_keys=("dir",),
+            ),
+            "transfer_chunks": m.Counter(
+                "ray_tpu_transfer_chunks_total",
+                "Object chunks moved node-to-node, by direction and wire "
+                "framing (raw = zero-copy raw frames, msgpack = negotiated "
+                "fallback).",
+                tag_keys=("dir", "frame"),
+            ),
+            "transfer_pushes": m.Counter(
+                "ray_tpu_transfer_pushes_total", "Outbound pushes committed."
+            ),
+            "transfer_pulls": m.Counter(
+                "ray_tpu_transfer_pulls_total", "Pulls sealed into the local store."
+            ),
+            "transfer_relays": m.Counter(
+                "ray_tpu_transfer_relays_total",
+                "Cut-through broadcast relays completed (chunks forwarded "
+                "downstream before the local copy sealed).",
+            ),
+            "transfer_pull_sources": m.Counter(
+                "ray_tpu_transfer_pull_sources_total",
+                "Source replicas that served chunks of a striped pull "
+                "(per-pull average = this / pulls).",
+            ),
+            "transfer_admission_stalls": m.Counter(
+                "ray_tpu_transfer_admission_stalls_total",
+                "Pulls that queued on pull_admission_budget_bytes before "
+                "allocating arena space.",
+            ),
+            "transfer_source_demotions": m.Counter(
+                "ray_tpu_transfer_source_demotions_total",
+                "Pull sources demoted to the back of the ranking after an "
+                "error mid-transfer.",
+            ),
             # --- compiled-DAG channel plane (experimental/channel/) ---
             "channel_writes": m.Counter(
                 "ray_tpu_channel_writes_total", "Envelopes published to channels."
@@ -185,6 +224,7 @@ def instruments() -> dict:
             ),
         }
         m.register_collector(_collect_wire_stats)
+        m.register_collector(_collect_transfer_stats)
         m.register_collector(_collect_lease_stats)
         m.register_collector(_collect_channel_stats)
         m.register_collector(_collect_devobj_stats)
@@ -226,6 +266,28 @@ def _collect_wire_stats():
         ("connects", inst["rpc_connects"], None),
         ("resets", inst["rpc_resets"], None),
         ("hwm_stalls", inst["rpc_hwm_stalls"], None),
+    ])
+
+
+def _collect_transfer_stats():
+    from ray_tpu._private.transfer_stats import TRANSFER
+
+    inst = _instruments
+    if inst is None:
+        return
+    _fold("transfer", TRANSFER, [
+        ("bytes_out", inst["transfer_bytes"], {"dir": "out"}),
+        ("bytes_in", inst["transfer_bytes"], {"dir": "in"}),
+        ("chunks_raw_out", inst["transfer_chunks"], {"dir": "out", "frame": "raw"}),
+        ("chunks_msgpack_out", inst["transfer_chunks"], {"dir": "out", "frame": "msgpack"}),
+        ("chunks_raw_in", inst["transfer_chunks"], {"dir": "in", "frame": "raw"}),
+        ("chunks_msgpack_in", inst["transfer_chunks"], {"dir": "in", "frame": "msgpack"}),
+        ("pushes", inst["transfer_pushes"], None),
+        ("pulls", inst["transfer_pulls"], None),
+        ("relays", inst["transfer_relays"], None),
+        ("pull_sources", inst["transfer_pull_sources"], None),
+        ("admission_stalls", inst["transfer_admission_stalls"], None),
+        ("source_demotions", inst["transfer_source_demotions"], None),
     ])
 
 

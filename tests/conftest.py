@@ -40,6 +40,14 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from tier-1 (`-m 'not slow'`); wide sweeps and "
+        "long soak tests",
+    )
+
+
 def cpu_backend_lacks_multiprocess_collectives() -> bool:
     """True when multi-PROCESS XLA collectives cannot run in this
     environment: jax <= 0.4.x does not wire CPU cross-process collectives

@@ -41,6 +41,52 @@ def test_microbench_smoke(tmp_path):
         assert data.get(key, 0) > 0, f"{key} missing/zero in smoke artifact: {data}"
 
 
+def test_transfer_smoke(tmp_path):
+    """<30s --transfer --quick pass: raw-vs-msgpack push A/B, pull striping
+    over the modeled per-source link, cut-through broadcast, and the
+    dispatch-plane guards all produce nonzero numbers. Perf certification
+    lives in the committed TRANSFER_r10.json (full shapes); this exists so
+    transfer-plane breakage fails pytest instead of the next bench round."""
+    out = tmp_path / "transfer.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", RAY_TPU_NUM_TPUS="0")
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "microbench.py"),
+            "--transfer",
+            "--quick",
+            "--round",
+            "10",
+            "--out",
+            str(out),
+        ],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=240,
+    )
+    assert proc.returncode == 0, (
+        f"microbench --transfer failed (rc={proc.returncode})\n"
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    data = json.loads(out.read_text())
+    assert not [k for k in data if k.endswith("_error")], data
+    for key in (
+        "push_raw_mib_per_s",
+        "push_msgpack_mib_per_s",
+        "pull_1replica_mib_per_s",
+        "pull_2replica_mib_per_s",
+        "broadcast_aggregate_mib_per_s",
+        "putget_1mib_per_s",
+        "shuffle_push_rows_per_s",
+    ):
+        assert data.get(key, 0) > 0, f"{key} missing/zero in transfer artifact: {data}"
+    # The negotiated default must actually BE the raw path (a silent
+    # fallback to msgpack everywhere would still produce numbers).
+    assert data.get("transfer_chunks_raw", 0) > 0, data
+
+
 def test_recorder_overhead_smoke(tmp_path):
     """<30s --recorder-overhead --quick pass: the always-on observability
     plane (flight recorder + 1-in-64 hop sampling) A/Bs against itself in

@@ -1,0 +1,230 @@
+"""Raw-frame wire path (rpc.py RAW_*, ISSUE 10): bit-exact round trips at
+chunk boundaries, msgpack fallback negotiation, raw responses (RawResult),
+and torn-connection mid-raw-frame recovery — the stream must reset cleanly,
+never desynchronize.
+
+Pure rpc-layer tests: one RpcServer + clients on the shared IO loop, no
+cluster, so the whole module costs well under a second of tier-1 budget.
+"""
+
+import os
+import socket
+import time
+
+import pytest
+
+from ray_tpu._private.rpc import (
+    RAW_CHUNK,
+    EventLoopThread,
+    RawResult,
+    RpcClient,
+    RpcServer,
+    _pack_raw_header,
+)
+
+CHUNK = 64 * 1024  # stand-in chunk size; boundary math is what matters
+
+
+@pytest.fixture()
+def raw_server():
+    """Server whose raw handler scatters chunks into a per-object bytearray
+    (the arena stand-in) and whose fetch handler can answer raw."""
+    server = RpcServer("raw-test")
+    store: dict[str, bytearray] = {}
+
+    def on_raw(frame):
+        buf = store.setdefault(frame.oid, bytearray())
+        end = frame.start + len(frame.payload)
+        if len(buf) < end:
+            buf.extend(b"\0" * (end - len(buf)))
+        buf[frame.start : end] = frame.payload
+        return {"ok": True, "got": len(frame.payload)}
+
+    server.set_raw_handler(on_raw)
+
+    async def rpc_fetch(req):
+        data = bytes(store[req["object_id"]])
+        start = req["start"]
+        end = min(start + req["length"], len(data))
+        if req.get("raw"):
+            return RawResult(req["object_id"], start, memoryview(data)[start:end])
+        return {"data": data[start:end]}
+
+    async def rpc_ping(req):
+        return {"pong": req.get("n", 0)}
+
+    server.register("fetch", rpc_fetch)
+    server.register("ping", rpc_ping)
+    server.start("127.0.0.1", 0)
+    try:
+        yield server, store
+    finally:
+        server.stop()
+
+
+def _push_raw(client, oid, payload, chunk=CHUNK):
+    io = EventLoopThread.get()
+
+    async def _run():
+        acks = []
+        for start in range(0, len(payload), chunk):
+            fut = await client.astart_raw(
+                RAW_CHUNK, oid, start, memoryview(payload)[start : start + chunk]
+            )
+            acks.append(await fut)
+        return acks
+
+    return io.run(_run(), timeout=30)
+
+
+@pytest.mark.parametrize("size", [1, CHUNK - 1, CHUNK, CHUNK + 1, 3 * CHUNK + 7])
+def test_raw_push_bit_exact_at_chunk_boundaries(raw_server, size):
+    server, store = raw_server
+    payload = os.urandom(size)
+    client = RpcClient(server.address, label="raw-c")
+    try:
+        acks = _push_raw(client, f"obj-{size}", payload)
+        assert all(a["ok"] for a in acks)
+        assert bytes(store[f"obj-{size}"]) == payload
+    finally:
+        client.close()
+
+
+@pytest.mark.parametrize("size", [1, CHUNK - 1, CHUNK, CHUNK + 1])
+def test_raw_fetch_response_bit_exact(raw_server, size):
+    """Server answers with a RawResult frame; the client-side sink receives
+    the payload while the buffer view is valid and scatters it."""
+    server, store = raw_server
+    payload = os.urandom(size)
+    store["src"] = bytearray(payload)
+    client = RpcClient(server.address, label="raw-f")
+    out = bytearray(size)
+    io = EventLoopThread.get()
+
+    async def _fetch(start, length):
+        def sink(frame):
+            out[frame.start : frame.start + len(frame.payload)] = frame.payload
+            return {"len": len(frame.payload), "raw": True}
+
+        return await client.acall(
+            "fetch",
+            {"object_id": "src", "start": start, "length": length, "raw": True},
+            raw_sink=sink,
+            retries=0,
+        )
+
+    try:
+        got = 0
+        for start in range(0, size, CHUNK):
+            resp = io.run(_fetch(start, CHUNK), timeout=30)
+            assert resp["raw"]
+            got += resp["len"]
+        assert got == size
+        assert bytes(out) == payload
+    finally:
+        client.close()
+
+
+def test_msgpack_fallback_when_sink_requested(raw_server):
+    """A peer that answers a raw-capable request in msgpack (mixed-version /
+    raw disabled) resolves the same future with the msgpack payload — the
+    sink is simply never called."""
+    server, store = raw_server
+    store["src"] = bytearray(b"x" * 1000)
+    client = RpcClient(server.address, label="raw-fb")
+    io = EventLoopThread.get()
+    called = []
+
+    async def _fetch():
+        # No "raw" key -> the handler takes the msgpack branch.
+        return await client.acall(
+            "fetch",
+            {"object_id": "src", "start": 0, "length": 1000},
+            raw_sink=lambda frame: called.append(frame),
+            retries=0,
+        )
+
+    try:
+        resp = io.run(_fetch(), timeout=30)
+        assert resp["data"] == b"x" * 1000
+        assert not called
+    finally:
+        client.close()
+
+
+def test_raw_and_msgpack_interleave_on_one_connection(raw_server):
+    """Raw frames and msgpack requests share the stream; ordering and seq
+    bookkeeping must survive interleaving."""
+    server, store = raw_server
+    client = RpcClient(server.address, label="raw-mix")
+    io = EventLoopThread.get()
+
+    async def _mixed():
+        results = []
+        for i in range(10):
+            fut = await client.astart_raw(
+                RAW_CHUNK, "mix", i * 100, bytes([i]) * 100
+            )
+            ping = await client.astart_call("ping", {"n": i})
+            results.append((await fut, await ping))
+        return results
+
+    try:
+        results = io.run(_mixed(), timeout=30)
+        assert all(ack["ok"] and pong["pong"] == i for i, (ack, pong) in enumerate(results))
+        assert bytes(store["mix"]) == b"".join(bytes([i]) * 100 for i in range(10))
+    finally:
+        client.close()
+
+
+def test_torn_connection_mid_raw_frame_resets_cleanly(raw_server):
+    """Kill a connection halfway through a raw frame's payload: the server
+    must tear the connection down (the length prefix scopes the frame) and
+    keep serving fresh connections — no desynced stream, no poisoned state."""
+    server, store = raw_server
+    host, port = server.address
+    sock = socket.create_connection((host, port))
+    # A raw frame claiming 64 KiB of payload, but deliver only half of it.
+    header = _pack_raw_header(RAW_CHUNK, 1, b"torn", 0, CHUNK)
+    sock.sendall(header)
+    sock.sendall(b"A" * (CHUNK // 2))
+    time.sleep(0.1)
+    sock.close()  # torn mid-frame
+
+    # The partial frame must not have reached the handler...
+    assert "torn" not in store
+    # ...and the server still serves new connections and full transfers.
+    client = RpcClient(server.address, label="raw-after-tear")
+    try:
+        payload = os.urandom(2 * CHUNK + 5)
+        acks = _push_raw(client, "after", payload)
+        assert all(a["ok"] for a in acks)
+        assert bytes(store["after"]) == payload
+        assert client.call("ping", {"n": 7})["pong"] == 7
+    finally:
+        client.close()
+
+
+def test_oversize_raw_header_resets_connection(raw_server):
+    """A raw header whose oid length overruns the frame is a protocol error:
+    the server drops the connection instead of guessing at payload bounds."""
+    server, store = raw_server
+    host, port = server.address
+    sock = socket.create_connection((host, port))
+    # oid_len (1000) > frame length (20): header overruns.
+    import struct
+
+    bogus = (0x80000000 | 20).to_bytes(4, "big") + struct.pack(
+        "<BBHIQ", RAW_CHUNK, 0, 1000, 1, 0
+    ) + b"abcd"
+    sock.sendall(bogus)
+    sock.settimeout(5)
+    # Server closes on the protocol error.
+    assert sock.recv(1024) == b""
+    sock.close()
+    # Healthy clients unaffected.
+    client = RpcClient(server.address, label="raw-after-bogus")
+    try:
+        assert client.call("ping", {"n": 1})["pong"] == 1
+    finally:
+        client.close()
