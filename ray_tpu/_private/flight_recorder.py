@@ -101,6 +101,11 @@ EVENT_TYPES = (
     "transfer_relay",  # 31: cut-through relay began forwarding pre-seal
     "admission_stall", # 32: pull queued on pull_admission_budget_bytes
     "pull_source_demoted",  # 33: pull source errored; ranked last
+    # Continuous-batching LLM serving engine (serve/llm/, PR 11).
+    "llm_admit",       # 34: prompt admitted into a decode slot (detail rid:T:hit:slot)
+    "llm_preempt",     # 35: sequence preempted for KV blocks (recompute on readmit)
+    "llm_prefix_hit",  # 36: admission reused prefix-cache blocks (detail rid:Nblk)
+    "llm_evict",       # 37: refs-0 prefix-cache block evicted under pressure
 )
 _CODE = {name: i for i, name in enumerate(EVENT_TYPES)}
 

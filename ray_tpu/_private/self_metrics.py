@@ -178,6 +178,47 @@ def instruments() -> dict:
                 boundaries=_LATENCY_BOUNDS,
                 tag_keys=("deployment",),
             ),
+            # --- continuous-batching LLM engine (serve/llm/engine.py) ---
+            "serve_llm_running": m.Gauge(
+                "ray_tpu_serve_llm_running_sequences",
+                "Sequences occupying a decode slot in this process's engine.",
+            ),
+            "serve_llm_waiting": m.Gauge(
+                "ray_tpu_serve_llm_waiting_sequences",
+                "Prompts queued for a decode slot / KV blocks.",
+            ),
+            "serve_llm_kv_util": m.Gauge(
+                "ray_tpu_serve_llm_kv_block_utilization",
+                "Allocated fraction of the paged KV block pool (0..1; "
+                "includes refs-0 prefix-cache blocks held for reuse).",
+            ),
+            "serve_llm_prefix_hits": m.Counter(
+                "ray_tpu_serve_llm_prefix_hits_total",
+                "Prompt blocks served from the prefix cache at admission "
+                "(prefill skipped for those tokens).",
+            ),
+            "serve_llm_prefix_misses": m.Counter(
+                "ray_tpu_serve_llm_prefix_misses_total",
+                "Hashable prompt blocks that had to be prefilled.",
+            ),
+            "serve_llm_preemptions": m.Counter(
+                "ray_tpu_serve_llm_preemptions_total",
+                "Sequences preempted for KV blocks (recompute on readmission).",
+            ),
+            "serve_llm_evictions": m.Counter(
+                "ray_tpu_serve_llm_prefix_evictions_total",
+                "refs-0 prefix-cache blocks evicted under allocation pressure.",
+            ),
+            "serve_llm_ttft": m.Histogram(
+                "ray_tpu_serve_llm_ttft_s",
+                "Time to first token: submit -> first token emitted.",
+                boundaries=_LATENCY_BOUNDS,
+            ),
+            "serve_llm_tpot": m.Histogram(
+                "ray_tpu_serve_llm_time_per_output_token_s",
+                "Per-request mean inter-token latency (first -> last token).",
+                boundaries=_LATENCY_BOUNDS,
+            ),
             # --- Data executor (data/_internal/) ---
             "data_rows": m.Counter(
                 "ray_tpu_data_output_rows_total",
@@ -224,6 +265,7 @@ def instruments() -> dict:
             ),
         }
         m.register_collector(_collect_wire_stats)
+        m.register_collector(_collect_serve_llm_stats)
         m.register_collector(_collect_transfer_stats)
         m.register_collector(_collect_lease_stats)
         m.register_collector(_collect_channel_stats)
@@ -323,6 +365,36 @@ def _collect_devobj_stats():
         usage = mgr.usage()
         inst["devobj_resident"].set(usage["resident_count"])
         inst["devobj_resident_bytes"].set(usage["resident_bytes"])
+
+
+def _collect_serve_llm_stats():
+    from ray_tpu.serve.llm.stats import ENGINES, LLM
+
+    inst = _instruments
+    if inst is None:
+        return
+    _fold("serve_llm", LLM, [
+        ("prefix_hit_blocks", inst["serve_llm_prefix_hits"], None),
+        ("prefix_miss_blocks", inst["serve_llm_prefix_misses"], None),
+        ("preemptions", inst["serve_llm_preemptions"], None),
+        ("evicted_blocks", inst["serve_llm_evictions"], None),
+    ])
+    engines = list(ENGINES)
+    if not engines and not LLM.admitted:
+        return  # no engine has ever lived in this process
+    # Gauges are summed across LIVE engines at flush time (best-effort
+    # plain-int reads, like LLMEngine.stats()): several engines fold into
+    # one series, and once the last scheduler exits the sums — and the
+    # exported gauges — honestly drop to zero instead of going stale.
+    running = waiting = used = total = 0
+    for eng in engines:
+        running += sum(r is not None for r in eng._slots)
+        waiting += len(eng._waiting)
+        used += (eng.num_blocks - 1) - len(eng._free)
+        total += eng.num_blocks - 1
+    inst["serve_llm_running"].set(running)
+    inst["serve_llm_waiting"].set(waiting)
+    inst["serve_llm_kv_util"].set(used / total if total else 0.0)
 
 
 def _collect_lease_stats():

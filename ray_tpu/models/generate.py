@@ -244,6 +244,124 @@ def decode_step(params, token, cache, pos, cfg: TransformerConfig):
     return logits[:, 0], cache
 
 
+def init_paged_cache(cfg: TransformerConfig, num_blocks: int, block_size: int):
+    """Block-pool KV cache for continuous-batching serving: k/v of shape
+    [L, num_blocks, block_size, KV, Dh]. Physical block 0 is RESERVED as the
+    null block — allocators must never hand it out. Inactive decode slots and
+    write-masked prefill padding rows are routed there, so the compiled step
+    never needs a dynamic shape or a conditional write."""
+    shape = (cfg.n_layers, num_blocks, block_size, cfg.n_kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+    }
+
+
+def _paged_decode_chunk_hidden(
+    params,
+    tokens,
+    cache,
+    block_tables,
+    pos,
+    cfg: TransformerConfig,
+    valid_to=None,
+):
+    """``paged_decode_chunk`` without the head projection: returns the final
+    normed hidden states [B, q, D] + cache. Chunked prefill consumes logits
+    for at most ONE row per prompt — callers project that row themselves
+    instead of paying [B, q, V] (the `_decode_chunk_hidden` pattern)."""
+    B, q = tokens.shape
+    n_max = block_tables.shape[1]
+    block_size = cache["k"].shape[2]
+    S = n_max * block_size
+    pos = jnp.asarray(pos, jnp.int32)
+    pos_b = jnp.broadcast_to(pos, (B,))
+    block_tables = jnp.asarray(block_tables, jnp.int32)
+    x = params["embed"].astype(cfg.dtype)[tokens]  # [B, q, D]
+    offs = jnp.arange(q, dtype=jnp.int32)
+    positions = pos_b[:, None] + offs[None, :]  # [B, q]
+    # Physical write coordinates for every fed row (computed once, reused
+    # per layer). Out-of-table positions clamp to the last entry; engines
+    # validate lengths so this only guards compiler-visible bounds.
+    blk_idx = jnp.minimum(positions // block_size, n_max - 1)
+    blk_phys = jnp.take_along_axis(block_tables, blk_idx, axis=1)  # [B, q]
+    row_off = positions % block_size
+    if valid_to is not None:
+        writable = positions < jnp.asarray(valid_to, jnp.int32)[:, None]
+        blk_phys = jnp.where(writable, blk_phys, 0)
+
+    def body(x, layer):
+        lp, ck_slot, cv_slot = layer  # [N, Bs, KV, Dh]
+        qh, k, v = _project_qkv(lp, x, positions, cfg)
+        ck = ck_slot.at[blk_phys, row_off].set(k)
+        cv = cv_slot.at[blk_phys, row_off].set(v)
+        # Gather each row's logical cache view through its block table,
+        # then attend exactly like the dense path. Masked (p == 0) entries
+        # contribute nothing, so null-block garbage stays invisible.
+        ck_g = ck[block_tables].reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+        cv_g = cv[block_tables].reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+        k_pos = jnp.arange(S, dtype=jnp.int32)
+        mask = k_pos[None, None, :] <= positions[:, :, None]
+        if cfg.sliding_window:
+            mask &= positions[:, :, None] - k_pos[None, None, :] < cfg.sliding_window
+        o = _cache_attention(qh, ck_g, cv_g, mask, cfg)
+        x = x + o.reshape(B, q, -1) @ lp["wo"].astype(o.dtype)
+        x = _mlp(lp, x, cfg)
+        return x, (ck, cv)
+
+    x, (ks, vs) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    return _rms_norm(x, params["norm_f"], cfg.norm_eps), {"k": ks, "v": vs}
+
+
+def paged_decode_chunk(
+    params,
+    tokens,
+    cache,
+    block_tables,
+    pos,
+    cfg: TransformerConfig,
+    valid_to=None,
+):
+    """``decode_chunk`` over a PAGED cache: tokens [B, q] written at per-row
+    positions pos[b]..pos[b]+q-1, where logical position p of row b lives in
+    physical block ``block_tables[b, p // block_size]`` at row offset
+    ``p % block_size``.
+
+    - ``block_tables`` [B, n_max] int32: per-sequence physical block ids in
+      logical order; entries beyond the sequence's allocation are 0 (the
+      null block) and stay invisible behind the position mask. Shapes are
+      STATIC — one compile serves every schedule the engine can produce
+      (any mix of sequences, fragmentation, or mid-stream admissions).
+    - ``valid_to`` [B] int32 (optional): rows at positions >= valid_to[b]
+      have their K/V writes routed to the null block (used by chunked
+      prefill so a padded final chunk never touches unallocated blocks).
+      Their logits are garbage and must be ignored by the caller.
+    - An INACTIVE slot is (token 0, pos 0, all-zero block table): it writes
+      and attends only null-block row 0 — finite garbage, never NaN (an
+      all-masked softmax would poison MoE dispatch for the whole batch).
+
+    Returns (logits [B, q, V] f32, updated cache). Attention math is the
+    dense ``_cache_attention`` over the GATHERED logical view, so outputs
+    match the dense-cache path row for row (the serving oracle).
+    """
+    x, cache = _paged_decode_chunk_hidden(
+        params, tokens, cache, block_tables, pos, cfg, valid_to=valid_to
+    )
+    logits = (x @ _head(params).astype(x.dtype)).astype(jnp.float32)
+    return logits, cache
+
+
+def paged_decode_step(params, token, cache, block_tables, pos, cfg: TransformerConfig):
+    """One token per slot against the paged cache: token [B] int32 at
+    per-slot positions ``pos`` [B]. The q=1 case of ``paged_decode_chunk``
+    — the continuous-batching decode hot loop. Returns (logits [B, V] f32,
+    updated cache)."""
+    logits, cache = paged_decode_chunk(
+        params, token[:, None], cache, block_tables, pos, cfg
+    )
+    return logits[:, 0], cache
+
+
 def _sample(logits, key, temperature: float, top_k: int):
     if temperature == 0.0:
         return logits.argmax(axis=-1).astype(jnp.int32)

@@ -158,14 +158,23 @@ class ProxyASGIApp:
         def call():
             import time as _time
 
-            from ray_tpu.serve._private.common import MULTIPLEXED_MODEL_ID_HEADER
+            from ray_tpu.serve._private.common import (
+                MULTIPLEXED_MODEL_ID_HEADER,
+                PREFIX_HINT_HEADER,
+            )
 
             model_id = next(
                 (v for k, v in headers.items() if k.lower() == MULTIPLEXED_MODEL_ID_HEADER),
                 "",
             )
+            prefix_hint = next(
+                (v for k, v in headers.items() if k.lower() == PREFIX_HINT_HEADER),
+                "",
+            )
             t0 = _time.monotonic()
-            replica = self._router.assign_replica(deployment, model_id=model_id)
+            replica = self._router.assign_replica(
+                deployment, model_id=model_id, prefix_hint=prefix_hint
+            )
             try:
                 actor = self._router.handle_for(replica)
                 ref = actor.handle_http_request.remote(

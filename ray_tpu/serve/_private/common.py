@@ -62,6 +62,12 @@ PROXY_NAME = "SERVE_PROXY"
 # (reference: serve/_private/constants.py SERVE_MULTIPLEXED_MODEL_ID).
 MULTIPLEXED_MODEL_ID_HEADER = "serve_multiplexed_model_id"
 
+# HTTP header / handle option carrying the prefix-cache routing hint
+# (serve.llm.prefix_route_hint): requests sharing a system prompt carry the
+# same value and the router pins them to the replica holding those KV
+# blocks, falling back to least queue depth.
+PREFIX_HINT_HEADER = "serve_prefix_hash"
+
 
 class HandleMarker:
     """Placeholder for a DeploymentHandle inside pickled init args —

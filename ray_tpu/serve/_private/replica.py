@@ -22,9 +22,10 @@ class _StreamPump:
     its generator cannot head-of-line-block the replica's task slots (and a
     disconnected client's pump dies on cancel, not the 5-minute reap)."""
 
-    def __init__(self, gen, model_id: str):
+    def __init__(self, gen, model_id: str, on_cancel=None):
         self.gen = gen
         self.model_id = model_id
+        self.on_cancel = on_cancel
         self.q: _queue.Queue = _queue.Queue(maxsize=8)  # backpressure bound
         self.cancelled = threading.Event()
         self.last_pump = time.time()
@@ -62,6 +63,18 @@ class _StreamPump:
 
     def cancel(self):
         self.cancelled.set()
+        # Producer-side teardown (StreamingResponse.on_disconnect) fires
+        # HERE, synchronously: the generator thread may be parked inside
+        # its producer (e.g. the LLM engine's token queue) and only
+        # observes `cancelled` at its next yield — resources like decode
+        # slots and KV blocks must not wait for that. dict.pop is
+        # GIL-atomic, so concurrent cancel()s fire the callback once.
+        cb = self.__dict__.pop("on_cancel", None)
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                pass
 
 
 class Replica:
@@ -193,14 +206,18 @@ class Replica:
                 gen, ctype = iter(result.iterator), result.content_type
                 status = getattr(result, "status", 200)
                 extra = getattr(result, "headers", None) or {}
+                on_cancel = getattr(result, "on_disconnect", None)
             else:
                 gen, ctype = result, "application/octet-stream"
                 status, extra = 200, {}
+                on_cancel = None
             with self._lock:
                 self._reap_idle_streams_locked()
                 self._stream_counter += 1
                 sid = str(self._stream_counter)
-                self._streams[sid] = _StreamPump(gen, multiplexed_model_id)
+                self._streams[sid] = _StreamPump(
+                    gen, multiplexed_model_id, on_cancel=on_cancel
+                )
             return {
                 "__serve_stream__": sid,
                 "content_type": ctype,

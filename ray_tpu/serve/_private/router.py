@@ -34,11 +34,17 @@ class Router:
         self._inflight: dict[str, int] = {}  # replica actor_name -> count
         self._metrics = self_metrics.instruments()
         self._lock = threading.Lock()
+        # Saturated assigns park on this condition (same underlying lock);
+        # release() and table refreshes notify — no busy polling.
+        self._avail = threading.Condition(self._lock)
         self._update_event = threading.Event()
-        self._poll_thread = threading.Thread(target=self._poll_loop, daemon=True)
-        self._poll_thread.start()
-        # Synchronous first fetch so handles work immediately after run().
-        self._refresh(timeout_s=0.1)
+        # controller_handle=None is the unit-test seam: a bare router with a
+        # hand-fed table and no background poller.
+        if controller_handle is not None:
+            self._poll_thread = threading.Thread(target=self._poll_loop, daemon=True)
+            self._poll_thread.start()
+            # Synchronous first fetch so handles work immediately after run().
+            self._refresh(timeout_s=0.1)
 
     @classmethod
     def shared(cls, controller_handle) -> "Router":
@@ -59,6 +65,8 @@ class Router:
         with self._lock:
             self._epoch = resp["epoch"]
             self._table = resp["table"]
+            # New/scaled deployments can unblock saturated assigns.
+            self._avail.notify_all()
         self._update_event.set()
 
     def _poll_loop(self):
@@ -99,45 +107,99 @@ class Router:
             time.sleep(0.05)
         return False
 
-    def assign_replica(self, deployment: str, timeout_s: float = 30.0, model_id: str = ""):
-        """Round-robin over replicas, skipping ones at their queue limit
-        (reference: router.py:125 RoundRobinReplicaScheduler). A multiplexed
-        model id pins to a stable replica (warm model cache on TPU) with
-        round-robin fallback when that replica is saturated."""
-        deadline = time.time() + timeout_s
-        while True:
-            replicas = self.replicas_for(deployment)
-            if replicas:
-                with self._lock:
-                    n = len(replicas)
-                    if model_id:
-                        # Stable affinity: same model id -> same replica.
-                        import zlib
+    def assign_replica(
+        self,
+        deployment: str,
+        timeout_s: float = 30.0,
+        model_id: str = "",
+        prefix_hint: str = "",
+    ):
+        """Pick a replica and claim a queue slot on it.
 
-                        start = zlib.crc32(model_id.encode()) % n
-                    else:
-                        start = self._rr.get(deployment, 0)
-                    for i in range(n):
-                        r = replicas[(start + i) % n]
+        Policy (reference: router.py:125 RoundRobinReplicaScheduler, plus
+        the cache-aware layer for serve.llm):
+
+        - ``model_id`` pins to a stable replica (warm multiplexed model
+          cache) with round-robin fallback when it is saturated;
+        - ``prefix_hint`` (hash of a request's leading prompt block —
+          ``serve.llm.prefix_route_hint``) pins to a stable replica so
+          requests sharing a system prompt land where its KV prefix-cache
+          blocks already live, falling back to the LEAST-QUEUE-DEPTH
+          unsaturated replica (a cache miss should at least balance load);
+        - otherwise round-robin, skipping replicas at max_concurrent_queries.
+
+        When every replica is saturated the caller parks on a Condition that
+        ``release()`` (and table refreshes) notify — a freed slot hands off
+        in microseconds, not a 10 ms poll; ``timeout_s`` still bounds the
+        total wait.
+        """
+        deadline = time.time() + timeout_s
+        with self._avail:
+            while True:
+                entry = self._table.get(deployment)
+                replicas = list(entry["replicas"]) if entry else []
+                if replicas:
+                    r = self._pick_locked(deployment, replicas, model_id, prefix_hint)
+                    if r is not None:
                         name = r["actor_name"]
-                        if self._inflight.get(name, 0) < r["max_concurrent_queries"]:
-                            if not model_id:
-                                self._rr[deployment] = (start + i + 1) % n
-                            self._inflight[name] = self._inflight.get(name, 0) + 1
-                            try:
-                                self._metrics["serve_requests"].inc(
-                                    tags={"deployment": deployment}
-                                )
-                                self._set_queue_depth_locked(deployment)
-                            except Exception:
-                                pass
-                            return r
-            if time.time() >= deadline:
-                raise TimeoutError(
-                    f"no available replica for deployment {deployment!r} "
-                    f"within {timeout_s}s"
-                )
-            time.sleep(0.01)
+                        self._inflight[name] = self._inflight.get(name, 0) + 1
+                        try:
+                            self._metrics["serve_requests"].inc(
+                                tags={"deployment": deployment}
+                            )
+                            self._set_queue_depth_locked(deployment)
+                        except Exception:
+                            pass
+                        return r
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"no available replica for deployment {deployment!r} "
+                        f"within {timeout_s}s"
+                    )
+                # The 1 s cap is a backstop for changes nobody notifies
+                # about (e.g. a replica's limit raised by a new table
+                # version swallowed between checks).
+                self._avail.wait(timeout=min(remaining, 1.0))
+
+    def _pick_locked(self, deployment, replicas, model_id, prefix_hint):
+        """Choose an unsaturated replica (caller holds _lock); None if all
+        are at their queue limit."""
+        import zlib
+
+        n = len(replicas)
+
+        def free(r):
+            return self._inflight.get(r["actor_name"], 0) < r["max_concurrent_queries"]
+
+        if model_id:
+            # Stable affinity: same model id -> same replica; round-robin
+            # scan from there when saturated (existing behavior).
+            start = zlib.crc32(model_id.encode()) % n
+            for i in range(n):
+                r = replicas[(start + i) % n]
+                if free(r):
+                    return r
+            return None
+        if prefix_hint:
+            # Cache-aware: the replica holding the shared prefix blocks,
+            # else spill to the least-loaded unsaturated replica.
+            r = replicas[zlib.crc32(prefix_hint.encode()) % n]
+            if free(r):
+                return r
+            candidates = [x for x in replicas if free(x)]
+            if not candidates:
+                return None
+            return min(
+                candidates, key=lambda x: self._inflight.get(x["actor_name"], 0)
+            )
+        start = self._rr.get(deployment, 0)
+        for i in range(n):
+            r = replicas[(start + i) % n]
+            if free(r):
+                self._rr[deployment] = (start + i + 1) % n
+                return r
+        return None
 
     def _set_queue_depth_locked(self, deployment: str):
         """Refresh the deployment's in-flight gauge (caller holds _lock).
@@ -155,6 +217,7 @@ class Router:
         with self._lock:
             name = replica["actor_name"]
             self._inflight[name] = max(0, self._inflight.get(name, 0) - 1)
+            self._avail.notify_all()  # wake assigns parked on saturation
             if deployment is not None:
                 try:
                     self._set_queue_depth_locked(deployment)

@@ -347,11 +347,18 @@ class StreamingResponse:
         content_type: str = "application/octet-stream",
         status: int = 200,
         headers: Optional[dict] = None,
+        on_disconnect: Optional[Callable[[], None]] = None,
     ):
         self.iterator = iterator
         self.content_type = content_type
         self.status = status
         self.headers = headers or {}
+        # Called EXACTLY ONCE if the stream is torn down before completion
+        # (client disconnect via cancel_stream, or the idle reaper). Lets
+        # producers holding real resources — e.g. the LLM engine's decode
+        # slot + KV blocks — release them immediately instead of waiting
+        # for their generator to observe GeneratorExit on its next yield.
+        self.on_disconnect = on_disconnect
 
 
 def ingress(asgi_app):

@@ -23,15 +23,37 @@ class _MethodCaller:
 
 
 class DeploymentHandle:
-    def __init__(self, deployment_name: str, router, multiplexed_model_id: str = ""):
+    def __init__(
+        self,
+        deployment_name: str,
+        router,
+        multiplexed_model_id: str = "",
+        prefix_hint: str = "",
+    ):
         self._deployment = deployment_name
         self._router = router
         self._multiplexed_model_id = multiplexed_model_id
+        self._prefix_hint = prefix_hint
 
-    def options(self, *, multiplexed_model_id: str = "") -> "DeploymentHandle":
-        """Per-call options (reference: handle.options(multiplexed_model_id=…))."""
+    def options(
+        self,
+        *,
+        multiplexed_model_id: str | None = None,
+        prefix_hint: str | None = None,
+    ) -> "DeploymentHandle":
+        """Per-call options (reference: handle.options(multiplexed_model_id=…)).
+        ``prefix_hint`` routes to the replica holding a shared prompt's KV
+        prefix-cache blocks (serve.llm.prefix_route_hint). Unspecified
+        options keep the handle's current values (pass "" to clear one)."""
         return DeploymentHandle(
-            self._deployment, self._router, multiplexed_model_id=multiplexed_model_id
+            self._deployment,
+            self._router,
+            multiplexed_model_id=(
+                self._multiplexed_model_id
+                if multiplexed_model_id is None
+                else multiplexed_model_id
+            ),
+            prefix_hint=self._prefix_hint if prefix_hint is None else prefix_hint,
         )
 
     def remote(self, *args, **kwargs):
@@ -47,7 +69,9 @@ class DeploymentHandle:
 
         model_id = self._multiplexed_model_id
         t0 = time.monotonic()
-        replica = self._router.assign_replica(self._deployment, model_id=model_id)
+        replica = self._router.assign_replica(
+            self._deployment, model_id=model_id, prefix_hint=self._prefix_hint
+        )
         try:
             actor = self._router.handle_for(replica)
             ref = actor.handle_request.remote(

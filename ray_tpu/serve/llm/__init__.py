@@ -1,0 +1,22 @@
+"""Continuous-batching LLM serving (ISSUE 11): paged KV cache + slot-level
+scheduler + prefix-cache reuse, streamed through the Serve replica path.
+
+- ``LLMEngine`` — the batching brain: admission into decode slots, chunked
+  prefill interleaved with decode, paged-block free-list, prefix cache,
+  preemption, per-request token streams.
+- ``LLMDeployment`` — serve-ready wrapper (SSE streaming over HTTP).
+- ``prefix_route_hint`` — client-side helper producing the router affinity
+  hint for cache-aware routing (send as the ``serve_prefix_hash`` header or
+  ``handle.options(prefix_hint=...)``).
+"""
+
+from ray_tpu.serve.llm.deployment import LLMDeployment
+from ray_tpu.serve.llm.engine import LLMEngine, LLMRequest, block_hashes, prefix_route_hint
+
+__all__ = [
+    "LLMDeployment",
+    "LLMEngine",
+    "LLMRequest",
+    "block_hashes",
+    "prefix_route_hint",
+]

@@ -1,0 +1,107 @@
+"""Serve-ready wrapper around ``LLMEngine``: streaming chat behind HTTP.
+
+Deploy it like any callable — the replica holds the engine (params + the
+two compiled paged-cache programs), requests stream tokens over SSE through
+the existing replica ``_StreamPump`` path, and a client disconnect frees the
+request's decode slot and KV blocks immediately via
+``StreamingResponse.on_disconnect``:
+
+    from ray_tpu import serve
+    from ray_tpu.serve.llm import LLMDeployment
+
+    app = serve.deployment(num_replicas=2)(LLMDeployment).bind(
+        model_config={"vocab_size": 512, "d_model": 128, ...},
+        engine_config={"num_slots": 8, "block_size": 16},
+    )
+    serve.run(app, route_prefix="/llm")
+
+    curl -N http://host:port/llm -d '{"tokens": [1,2,3], "max_new_tokens": 16}'
+    data: {"token": 42}
+    ...
+    data: [DONE]
+
+Request body: ``{"tokens": [int], "max_new_tokens": int, "temperature":
+float, "top_k": int, "seed": int, "stream": bool}`` — ``stream`` defaults
+true (SSE); false buffers and returns ``{"tokens": [...]}``.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ray_tpu.serve.llm.engine import LLMEngine, prefix_route_hint  # noqa: F401
+
+
+class LLMDeployment:
+    def __init__(
+        self,
+        model_config: dict,
+        engine_config: dict | None = None,
+        init_seed: int = 0,
+        params=None,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models.transformer import TransformerConfig, init_params
+
+        model_config = dict(model_config)
+        dtype = model_config.get("dtype")
+        if isinstance(dtype, str):  # JSON-friendly configs
+            model_config["dtype"] = jnp.dtype(dtype).type
+        self.cfg = TransformerConfig(**model_config)
+        if params is None:
+            params = init_params(jax.random.PRNGKey(init_seed), self.cfg)
+        self.engine = LLMEngine(params, self.cfg, **(engine_config or {}))
+
+    def __call__(self, request):
+        from ray_tpu.serve.api import StreamingResponse
+
+        body = request.json() if hasattr(request, "json") else dict(request)
+        req = self.engine.submit(
+            body["tokens"],
+            max_new_tokens=int(body.get("max_new_tokens", 32)),
+            temperature=float(body.get("temperature", 0.0)),
+            top_k=int(body.get("top_k", 0)),
+            seed=int(body.get("seed", 0)),
+        )
+        if not body.get("stream", True):
+            try:
+                return {"tokens": req.result(timeout=float(body.get("timeout", 120.0)))}
+            except BaseException:
+                # A timed-out (or otherwise failed) buffered request must not
+                # keep generating into a queue nobody will read — free its
+                # decode slot and KV blocks now, like the SSE path does.
+                self.engine.cancel(req)
+                raise
+        engine = self.engine
+
+        def sse():
+            try:
+                for tok in req:
+                    yield f"data: {json.dumps({'token': tok})}\n\n"
+                yield "data: [DONE]\n\n"
+            finally:
+                # Belt: normal completion makes this a no-op; an aborted
+                # generator (pump saw `cancelled` at a yield) frees the
+                # request even if on_disconnect never fired.
+                engine.cancel(req)
+
+        return StreamingResponse(
+            sse(),
+            content_type="text/event-stream",
+            # Suspenders: fires synchronously from cancel_stream / the idle
+            # reaper, so the decode slot and KV blocks free immediately
+            # even while the generator is parked waiting for a token.
+            on_disconnect=lambda: engine.cancel(req),
+        )
+
+    def get_stats(self) -> dict:
+        """Engine snapshot (handle-callable; used by tests and benches)."""
+        return self.engine.stats()
+
+    def check_health(self):
+        self.engine.check_health()
+
+    def prepare_for_shutdown(self):
+        self.engine.shutdown()

@@ -87,6 +87,50 @@ def test_transfer_smoke(tmp_path):
     assert data.get("transfer_chunks_raw", 0) > 0, data
 
 
+def test_serve_llm_smoke(tmp_path):
+    """<30s --serve --quick pass (ISSUE 11): the closed-loop generator runs
+    both arms (serial-batch baseline + continuous batching) against the
+    serve.llm engine and produces nonzero TTFT/tokens-per-second numbers
+    with prefix-cache hits. Perf certification (>=2x tokens/s, p99 TTFT
+    reduced at 8 streams) lives in the committed SERVEBENCH_r11.json; this
+    exists so engine/scheduler breakage fails pytest instead of the next
+    bench round — the quick arms are too short/noisy to re-certify ratios."""
+    out = tmp_path / "servebench.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", RAY_TPU_NUM_TPUS="0")
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "microbench.py"),
+            "--serve",
+            "--quick",
+            "--round",
+            "11",
+            "--out",
+            str(out),
+        ],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=240,
+    )
+    assert proc.returncode == 0, (
+        f"microbench --serve failed (rc={proc.returncode})\n"
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    data = json.loads(out.read_text())
+    for key in (
+        "serve_serial_tokens_per_s",
+        "serve_continuous_tokens_per_s",
+        "serve_serial_ttft_p99_ms",
+        "serve_continuous_ttft_p99_ms",
+        "serve_continuous_tpot_mean_ms",
+    ):
+        assert data.get(key, 0), f"{key} missing/zero in serve artifact: {data}"
+    # The shared system prompt must actually ride the prefix cache.
+    assert data.get("serve_continuous_prefix_hit_blocks", 0) > 0, data
+
+
 def test_recorder_overhead_smoke(tmp_path):
     """<30s --recorder-overhead --quick pass: the always-on observability
     plane (flight recorder + 1-in-64 hop sampling) A/Bs against itself in

@@ -378,21 +378,15 @@ def test_node_gauges_from_agent_samples(ray_start_regular):
 def test_serve_and_data_metric_wiring(ray_start_regular):
     """The Serve-router and Data-operator hooks feed the shared registry and
     come out of /metrics (unit-level: no Serve/Data cluster needed)."""
-    from ray_tpu._private import self_metrics, worker_context
+    from ray_tpu._private import worker_context
     from ray_tpu.data._internal.stats import OpStats
     from ray_tpu.serve._private.router import Router
     from ray_tpu.util import metrics
 
-    import threading
-
-    router = object.__new__(Router)
+    router = Router(None)  # bare-router seam: no controller, hand-fed table
     router._table = {
         "app": {"replicas": [{"actor_name": "r1", "max_concurrent_queries": 4}], "route_prefix": "/"}
     }
-    router._inflight = {}
-    router._rr = {}
-    router._lock = threading.Lock()
-    router._metrics = self_metrics.instruments()
     replica = router.assign_replica("app", timeout_s=1)
     router.release(replica, deployment="app", duration_s=0.01)
 
