@@ -147,6 +147,15 @@ class Config:
     # --- metrics ---
     metrics_flush_interval_s: float = 5.0
 
+    # --- compiled-graph channel plane (experimental/channel/) ---
+    # Blocked channel readers are woken by the producer's doorbell frame;
+    # this is the FALLBACK re-poll cap for a lost doorbell. Readers back off
+    # exponentially from a few ms up to this cap while idle, so resident
+    # loops waiting on descriptor resolution don't burn a busy 1-CPU box,
+    # and a doorbell always wakes them immediately regardless of the cap.
+    # Env: RAY_TPU_CHANNEL_POLL_INTERVAL_MS.
+    channel_poll_interval_ms: int = 50
+
     # --- collectives ---
     collective_rendezvous_timeout_s: float = 60.0
 

@@ -253,6 +253,12 @@ class CoreWorker:
         from ray_tpu.experimental.channel.channel import ChannelRegistry
 
         self.channels = ChannelRegistry()
+        # Direct p2p mailbox (util/collective/p2p.py): landing zone for
+        # eager-pushed channel payloads (descriptor slots resolve from it
+        # without a pull round trip) — rpc_p2p_data deposits into it.
+        from ray_tpu.util.collective.p2p import P2PInbox
+
+        self.p2p_inbox = P2PInbox()
         self.pending_tasks: dict[str, PendingTask] = {}
         # Tombstones for cancelled tasks that may not have reached this
         # process yet (cancel racing submission); checked at execution
@@ -2221,6 +2227,29 @@ class CoreWorker:
         mgr = self._device_objects
         if mgr is not None:
             mgr.free(req["object_id"])
+        return {"ok": True}
+
+    async def rpc_devobj_release(self, req):
+        """A channel-payload consumer resolved its descriptor slot: drop
+        one pin; the last pin frees (device_envelope.release)."""
+        mgr = self._device_objects
+        if mgr is not None:
+            mgr.release_pin(req["object_id"])
+        return {"ok": True}
+
+    async def rpc_p2p_data(self, req):
+        """Direct-mailbox payload chunk (one-way): an eager-pushed channel
+        payload or any address-directed p2p transfer lands here for a
+        blocked direct_recv to take. A channel payload's deposit doubles as
+        the channel doorbell (the producer skipped the separate wakeup
+        frame: the payload lands right after the slot publish, so ONE frame
+        both delivers the bytes and wakes the blocked reader)."""
+        key = req["key"]
+        done = self.p2p_inbox.deposit(
+            key, req.get("idx", 0), req.get("total", 1), req["data"]
+        )
+        if done and key.startswith("chdev/"):
+            self.channels.ring_doorbell(key.split("/", 2)[1])
         return {"ok": True}
 
     async def rpc_devobj_stats(self, req):

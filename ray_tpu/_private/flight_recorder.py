@@ -106,6 +106,9 @@ EVENT_TYPES = (
     "llm_preempt",     # 35: sequence preempted for KV blocks (recompute on readmit)
     "llm_prefix_hit",  # 36: admission reused prefix-cache blocks (detail rid:Nblk)
     "llm_evict",       # 37: refs-0 prefix-cache block evicted under pressure
+    # Descriptor channel plane (device payloads through channel slots, PR 12).
+    "chan_devobj_send",  # 38: channel payload eager-pushed out of band (detail cid:seq:bytes)
+    "chan_devobj_recv",  # 39: descriptor slot resolved to the live value (detail cid:seq:path)
 )
 _CODE = {name: i for i, name in enumerate(EVENT_TYPES)}
 

@@ -161,6 +161,23 @@ def instruments() -> dict:
                 "in this process (per-channel tags would leak one stale "
                 "series per torn-down channel).",
             ),
+            # --- MPMD pipeline / descriptor channel plane (PR 12) ---
+            "pipeline_microbatches": m.Counter(
+                "ray_tpu_pipeline_microbatches_total",
+                "Resident-loop stage iterations completed in this process "
+                "(one microbatch through one stage).",
+            ),
+            "pipeline_stall": m.Counter(
+                "ray_tpu_pipeline_stall_seconds_total",
+                "Seconds resident-loop stages spent blocked on input "
+                "channels (pipeline bubble + upstream latency).",
+            ),
+            "pipeline_resolve_latency": m.Histogram(
+                "ray_tpu_pipeline_resolve_latency_s",
+                "Descriptor-slot resolution latency (KIND_DEVICE envelope "
+                "to live value: inbox take / pull fallback / local).",
+                boundaries=_LATENCY_BOUNDS,
+            ),
             # --- Serve router (serve/_private/router.py) ---
             "serve_requests": m.Counter(
                 "ray_tpu_serve_requests_total",
@@ -269,6 +286,7 @@ def instruments() -> dict:
         m.register_collector(_collect_transfer_stats)
         m.register_collector(_collect_lease_stats)
         m.register_collector(_collect_channel_stats)
+        m.register_collector(_collect_pipeline_stats)
         m.register_collector(_collect_devobj_stats)
         _instruments = inst
     return _instruments
@@ -347,6 +365,34 @@ def _collect_channel_stats():
         inst["channel_occupancy"].set(CHANNEL_STATS.last_occupancy)
 
 
+def _collect_pipeline_stats():
+    from ray_tpu.experimental.channel.channel import PIPELINE_STATS
+
+    inst = _instruments
+    if inst is None:
+        return
+    _fold("pipeline", PIPELINE_STATS, [
+        ("microbatches", inst["pipeline_microbatches"], None),
+    ])
+    # Stall is kept as plain ns on the hot path; fold the delta as seconds.
+    cur = PIPELINE_STATS.stall_ns
+    key = ("pipeline", "stall_ns")
+    delta = cur - _folded.get(key, 0)
+    if delta > 0:
+        _folded[key] = cur
+        inst["pipeline_stall"].inc(delta / 1e9)
+    # Drain buffered resolve-latency observations into the histogram at
+    # flush cadence (the resolver appends plain floats, no instrument lock
+    # per microbatch).
+    samples = PIPELINE_STATS.resolve_samples
+    while True:
+        try:
+            s = samples.popleft()
+        except IndexError:
+            break
+        inst["pipeline_resolve_latency"].observe(s)
+
+
 def _collect_devobj_stats():
     from ray_tpu.experimental.device_object.manager import DEVOBJ_STATS, active_manager
 
@@ -357,6 +403,8 @@ def _collect_devobj_stats():
         ("transfers_local", inst["devobj_transfers"], {"kind": "local"}),
         ("transfers_collective", inst["devobj_transfers"], {"kind": "collective"}),
         ("transfers_host", inst["devobj_transfers"], {"kind": "host"}),
+        ("chan_sends", inst["devobj_transfers"], {"kind": "chan_send"}),
+        ("chan_recvs", inst["devobj_transfers"], {"kind": "chan_recv"}),
         ("spills", inst["devobj_spills"], None),
         ("restores", inst["devobj_restores"], None),
     ])

@@ -122,6 +122,7 @@ class WorkerExecutor:
         # RPC; classic calls keep flowing through the main exec queue.
         server.register("channel_loop_install", self.rpc_channel_loop_install)
         server.register("channel_loop_stop", self.rpc_channel_loop_stop)
+        server.register("channel_loop_stats", self.rpc_channel_loop_stats)
         self._channel_loops: dict = {}
         # Leased-task pipeline (reference: direct task transport worker side,
         # core_worker.cc task receiver): owners ship batches of specs; we
@@ -518,7 +519,28 @@ class WorkerExecutor:
             self._channel_loops[req["loop_id"]] = loop
             return {"ok": False, "error": "channel loop did not exit within 15s"}
         self.cw.channels.drop(loop.channel_ids)
+        # Eager-pushed payloads nobody will ever take (producer raced the
+        # stop) must not sit in the inbox until the age sweep.
+        for cid in loop.channel_ids:
+            self.cw.p2p_inbox.purge_prefix(f"chdev/{cid}/")
         return {"ok": True, "stopped": True}
+
+    async def rpc_channel_loop_stats(self, req):
+        """Per-stage stall/busy/resolve split of a resident loop — the
+        driver-side bubble-fraction measurement reads it (parallel/
+        mpmd_pipeline.py, microbench --pipeline)."""
+        loop = self._channel_loops.get(req["loop_id"])
+        if loop is None:
+            return {"found": False, "stages": []}
+        if req.get("reset"):
+            import time as _time
+
+            for s in loop.stages:
+                s.stall_ns = s.busy_ns = s.resolve_ns = s.iters = 0
+                # Stamp the reset so an interval already in flight (a loop
+                # blocked in read()) charges only its post-reset portion.
+                s.reset_ns = _time.perf_counter_ns()
+        return {"found": True, "stages": [s.stats_dict() for s in loop.stages]}
 
     # ---- cancellation (reference: core_worker.cc HandleCancelTask) ----
 

@@ -52,6 +52,7 @@ from ray_tpu.dag.dag_node import (
 from ray_tpu.exceptions import ActorDiedError, GetTimeoutError, TaskError
 from ray_tpu.experimental.channel.channel import (
     _OFF_CLOSED,
+    KIND_DEVICE,
     KIND_ERROR,
     KIND_VALUE,
     ChannelClosedError,
@@ -66,6 +67,12 @@ from ray_tpu.experimental.channel.channel import (
 logger = logging.getLogger(__name__)
 
 _GET_SLICE_S = 0.1
+
+# Staged-slot markers for device-envelope resolution (_drain_next): a
+# resolved slot must be memoized so a get(timeout=) expiring on a LATER
+# output channel cannot re-resolve (and double-release) this one.
+_RESOLVED = -2
+_RESOLVE_ERR = -3
 
 
 class CompiledDAGRef:
@@ -152,9 +159,25 @@ class CompiledDAG:
         self._actor_outputs: dict[str, list] = {}  # actor_id -> [(label, desc)]
         self._dead_actors: set[str] = set()
 
+        # Channel payloads this driver creates (device-resident jax.Array
+        # inputs routed as descriptor slots) reclaim under this scope at
+        # teardown if a consumer's release never arrived.
+        self._payload_scope = f"dag:{self._dag_id}"
+
         try:
             self._stages = self._plan()
             self._staged = [None] * len(self._output_readers)
+            # Input writers grouped by projection key: one serialized body
+            # (or one device payload entry) per key per execute, fanned to
+            # every writer fed by that key.
+            groups: dict = {}
+            key_order: list = []
+            for key, writer in self._input_writers:
+                if key not in groups:
+                    groups[key] = []
+                    key_order.append(key)
+                groups[key].append(writer)
+            self._writers_by_key = [(k, groups[k]) for k in key_order]
             self._install()
         except BaseException:
             # Channels may already be allocated (validation interleaves with
@@ -420,15 +443,27 @@ class CompiledDAG:
         # classic paths.
         hop = self._cw._hop_stamp_start() or None
         idx = self._next_idx
-        cache: dict = {}
-        for key, writer in self._input_writers:
-            data = cache.get(key)
-            if data is None:
-                value = self._project_input(args, kwargs, key)
-                data = cache[key] = serialization.serialize(value).to_bytes()
+        from ray_tpu._private.core_worker import _maybe_jax_array
+
+        for key, writers in self._writers_by_key:
+            value = self._project_input(args, kwargs, key)
             if hop is not None:
                 hop["ship"] = time.monotonic()
-            writer.write(KIND_VALUE, data, hop, timeout=self._submit_timeout)
+            if _maybe_jax_array(value):
+                # A device-resident jax.Array must not be msgpack-serialized
+                # through the host ring (a silent D2H copy per iteration):
+                # the driver is the holder — route a descriptor slot and
+                # stream the payload out of band (device_envelope).
+                from ray_tpu.experimental.channel import device_envelope
+
+                device_envelope.emit(
+                    self._cw, value, writers, scope=self._payload_scope,
+                    hop=hop, timeout=self._submit_timeout,
+                )
+                continue
+            data = serialization.serialize(value).to_bytes()
+            for writer in writers:
+                writer.write(KIND_VALUE, data, hop, timeout=self._submit_timeout)
         self._next_idx += 1
         return CompiledDAGRef(self, idx)
 
@@ -466,6 +501,32 @@ class CompiledDAG:
         for i, reader in enumerate(self._output_readers):
             if self._staged[i] is None:
                 self._staged[i] = self._read_sliced(reader, deadline)
+        # Device descriptor slots resolve out of band; the outcome is
+        # memoized into the staged slot (resolution releases the consumer
+        # pin on the holder — it must happen exactly once even when a
+        # get(timeout=) expires while resolving a LATER output channel).
+        for i, reader in enumerate(self._output_readers):
+            kind, data, hop = self._staged[i]
+            if kind != KIND_DEVICE:
+                continue
+            from ray_tpu.experimental.channel import device_envelope
+
+            try:
+                value = device_envelope.resolve(
+                    self._cw, data, cid=reader.cid, seq=reader.last_seq,
+                    gate=reader.gate, deadline=deadline,
+                    consumer_release=not reader.shm,
+                )
+            except GetTimeoutError:
+                raise  # staged slot keeps the unresolved envelope; retryable
+            except ChannelClosedError:
+                raise ValueError(
+                    "this CompiledDAG was torn down while results were pending"
+                ) from None
+            except BaseException as e:  # noqa: BLE001 — typed loss/death
+                self._staged[i] = (_RESOLVE_ERR, e, hop)
+            else:
+                self._staged[i] = (_RESOLVED, value, hop)
         envs, self._staged = self._staged, [None] * len(self._output_readers)
         seq = self._next_out_seq
         self._next_out_seq += 1
@@ -475,7 +536,13 @@ class CompiledDAG:
         for kind, data, hop in envs:
             if hop:
                 hop_rec.update(hop)
-            if kind == KIND_ERROR:
+            if kind == _RESOLVED:
+                values.append(data)
+            elif kind == _RESOLVE_ERR:
+                if error is None:
+                    error = data
+                values.append(None)
+            elif kind == KIND_ERROR:
                 err = serialization.deserialize(data)
                 if error is None:
                     error = err
@@ -590,6 +657,14 @@ class CompiledDAG:
             self._torn_down = True
         self._monitor_stop.set()
         self._release_channels(list(self._actor_addrs))
+        # Reclaim driver-created channel payloads whose consumer releases
+        # never arrived (dead stage, torn connection): no leaked device
+        # buffers across teardown.
+        from ray_tpu.experimental.device_object.manager import active_manager
+
+        mgr = active_manager()
+        if mgr is not None:
+            mgr.reclaim_scope(self._payload_scope)
         if self._monitor.is_alive():
             self._monitor.join(timeout=2)
 
@@ -635,6 +710,10 @@ class CompiledDAG:
                 except Exception:
                     pass
         cw.channels.drop(local_cids)
+        # Eager payloads pushed at the driver that were never taken must
+        # not sit in the inbox until the age sweep.
+        for cid in local_cids:
+            cw.p2p_inbox.purge_prefix(f"chdev/{cid}/")
         # 3. Release the arena blocks (no leaked shm) — only once every
         # live endpoint is confirmed out of them (the closed words set in
         # step 2 stop an unconfirmed loop within one poll, but "within one

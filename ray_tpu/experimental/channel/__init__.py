@@ -5,6 +5,7 @@ failure semantics; ``ray_tpu/dag/compiled.py`` is the main consumer.
 """
 
 from ray_tpu.experimental.channel.channel import (  # noqa: F401
+    KIND_DEVICE,
     KIND_ERROR,
     KIND_VALUE,
     ChannelClosedError,
@@ -26,6 +27,7 @@ __all__ = [
     "ChannelReader",
     "ChannelRegistry",
     "ChannelWriter",
+    "KIND_DEVICE",
     "KIND_ERROR",
     "KIND_VALUE",
     "make_descriptor",

@@ -17,7 +17,9 @@ Wire/memory protocol (see README.md in this package for the full story):
   fills the slot COMPLETELY before bumping ``write_count`` (x86-TSO store
   ordering; the consumer never reads a slot at/past ``write_count``).
 - slot: u32 payload length then a msgpack envelope ``[kind, data, hop]``
-  (kind 0 = value, 1 = error; ``data`` = serialization.py bytes; ``hop`` =
+  (kind 0 = value, 1 = error, 2 = device descriptor; ``data`` =
+  serialization.py bytes — for kind 2 a serialized ``DeviceObjectMeta``
+  whose PAYLOAD moves out-of-band, see device_envelope.py; ``hop`` =
   optional hop-timing stamp dict). Length ``0xFFFFFFFF`` marks an OVERSIZE
   payload delivered out-of-band through the reader's side-channel (chunked
   ``channel_data`` RPCs, the compiled analog of the chunked push path).
@@ -25,7 +27,8 @@ Wire/memory protocol (see README.md in this package for the full story):
   ``channel_doorbell`` push frame at the READER's RPC server (the existing
   worker-to-worker pipe); the handler sets the reader's gate event. The
   doorbell is a latency optimization, not a correctness requirement — a
-  blocked reader also re-polls the ring every ``_POLL_S``.
+  blocked reader also re-polls the ring, backing off exponentially from
+  ``_POLL_BASE_S`` up to the ``channel_poll_interval_ms`` config cap.
 - cross-node fallback: when producer and consumer do not share the arena the
   ring is skipped entirely and every envelope rides the chunked
   ``channel_data`` path, with ``channel_query`` polls for backpressure.
@@ -73,18 +76,50 @@ class _ChannelStats:
 
 CHANNEL_STATS = _ChannelStats()
 
+
+class _PipelineStats:
+    """Plain-int pipeline counters fed by the resident loops (one stage
+    iteration = one microbatch through that stage) and the descriptor
+    resolver; folded into ``ray_tpu_pipeline_*`` instruments at metrics
+    flush (same pattern as CHANNEL_STATS above). ``resolve_samples`` is a
+    bounded deque of resolve latencies (seconds) drained into the
+    ``ray_tpu_pipeline_resolve_latency_s`` histogram by the flush-time
+    collector, so the hot path appends a float instead of paying the
+    instrument lock per microbatch."""
+
+    __slots__ = ("microbatches", "stall_ns", "resolve_samples")
+
+    def __init__(self):
+        import collections
+
+        self.microbatches = 0
+        self.stall_ns = 0
+        self.resolve_samples = collections.deque(maxlen=512)
+
+
+PIPELINE_STATS = _PipelineStats()
+
 HEADER_SIZE = 64
 _OFF_WRITE = 0
 _OFF_READ = 8
 _OFF_CLOSED = 16
 _SIDE_MARKER = 0xFFFFFFFF
-_POLL_S = 0.05
+# Idle re-poll backoff: first miss waits _POLL_BASE_S, then doubles per idle
+# round up to the channel_poll_interval_ms config cap. The doorbell (gate
+# event) short-circuits any wait, so the cap bounds only doorbell LOSS
+# recovery, and sustained idle converges to one wakeup per cap interval
+# instead of 20/s per blocked reader on a 1-CPU box.
+_POLL_BASE_S = 0.005
 _FULL_POLL_S = 0.002
 _CHUNK_BYTES = 512 * 1024
 
 # Envelope kinds.
 KIND_VALUE = 0
 KIND_ERROR = 1
+# Device-payload descriptor: the slot carries a ~300B DeviceObjectMeta; the
+# payload itself moved out-of-band (p2p direct mailbox / collective pull /
+# host fallback — experimental/channel/device_envelope.py).
+KIND_DEVICE = 2
 
 
 class ChannelError(RayTpuError):
@@ -254,6 +289,11 @@ class _Endpoint:
         self.shm = bool(desc.get("arena")) and getattr(arena, "name", None) == desc["arena"]
         self._view = arena.view if self.shm else None
         self.gate = cw.channels.gate(self.cid)
+        # Fallback re-poll cap (doorbell loss recovery); see _POLL_BASE_S.
+        self._poll_cap_s = max(
+            _POLL_BASE_S,
+            getattr(cw.cfg, "channel_poll_interval_ms", 50) / 1000.0,
+        )
 
     # ---- ring header accessors (shm mode only) ----
 
@@ -292,17 +332,36 @@ class ChannelWriter(_Endpoint):
         # is exhausted (bounded-credit, like the push path's admission),
         # not per write.
         self._inflight = 0
+        # Device payloads published through this writer whose holder pin is
+        # released by RING ADVANCE instead of a consumer frame: (seq, oid)
+        # FIFO, reaped by device_envelope.emit once the consumer's
+        # read_count proves the slot was popped AND its resolution is over
+        # (the consumer pops seq+1 only after fully processing seq, so
+        # everything <= read_count - 2 is done). shm mode only.
+        self.payload_fifo = None  # lazily a deque on first device emit
+
+    @any_thread
+    def next_seq(self) -> int:
+        """The sequence number the NEXT write() will publish under. Stable
+        between a call here and the following write (single producer, one
+        writing thread): device_envelope.emit uses it to key the eager
+        out-of-band payload push to the slot it belongs to."""
+        return self._u64(_OFF_WRITE) if self.shm else self._next_seq
 
     @blocking
     def write(self, kind: int, data: bytes, hop: dict | None = None,
-              timeout: float | None = None, stop=None) -> None:
+              timeout: float | None = None, stop=None,
+              doorbell: bool = True) -> None:
         """Publish one envelope; blocks while the ring is full (backpressure)
         up to ``timeout`` (None = forever). Raises ChannelClosedError if the
-        channel closes (teardown / stop event) while blocked."""
+        channel closes (teardown / stop event) while blocked.
+        ``doorbell=False`` skips the wakeup frame — device emits send the
+        payload frame right after the slot publish and ITS deposit rings
+        the reader's gate (one frame on the wire instead of two)."""
         env = pack_envelope(kind, data, hop)
         deadline = None if timeout is None else time.monotonic() + timeout
         if self.shm:
-            self._write_shm(env, deadline, stop)
+            self._write_shm(env, deadline, stop, doorbell)
         else:
             self._write_remote(env, deadline, stop)
         # Plain-int accounting per write; the flight event and occupancy
@@ -341,7 +400,7 @@ class ChannelWriter(_Endpoint):
             )
         time.sleep(interval)
 
-    def _write_shm(self, env: bytes, deadline, stop):
+    def _write_shm(self, env: bytes, deadline, stop, doorbell: bool = True):
         if self._u64(_OFF_WRITE) - self._u64(_OFF_READ) >= self.num_slots:
             # Backpressure entry (once per blocked write, not per poll tick).
             flight_recorder.record("channel_block", self.label)
@@ -363,7 +422,8 @@ class ChannelWriter(_Endpoint):
         # count bump makes them visible to the consumer.
         self._set_u64(_OFF_WRITE, seq + 1)
         self._next_seq = seq + 1
-        self._doorbell()
+        if doorbell:
+            self._doorbell()
 
     def _write_remote(self, env: bytes, deadline, stop):
         self._remote_credit_wait(deadline, stop)
@@ -423,7 +483,7 @@ class ChannelWriter(_Endpoint):
 
     def _doorbell(self):
         """One-way wakeup frame at the reader; loss is benign (readers
-        re-poll the ring every _POLL_S)."""
+        re-poll the ring, backing off to the channel_poll_interval_ms cap)."""
         try:
             client = self._reader_client()
             fut = self.cw._io.spawn(
@@ -440,14 +500,22 @@ class ChannelReader(_Endpoint):
     def __init__(self, desc: dict, cw):
         super().__init__(desc, cw)
         self._next_seq = self._u64(_OFF_READ) if self.shm else 0
+        # Sequence number of the most recently consumed envelope — the key
+        # device_envelope.resolve uses to find the eager-pushed payload for
+        # a KIND_DEVICE slot.
+        self.last_seq = -1
 
     @blocking
     def read(self, timeout: float | None = None, stop=None) -> tuple[int, bytes, dict | None]:
         """Block until the next envelope is available; returns
         ``(kind, data, hop)``. Honors ``timeout`` (ChannelTimeoutError),
         channel close and the caller's stop event (ChannelClosedError), and
-        sticky poison (returns the planted error envelope)."""
+        sticky poison (returns the planted error envelope). A doorbell (the
+        gate event) wakes the wait immediately; the fallback re-poll backs
+        off exponentially from _POLL_BASE_S to the channel_poll_interval_ms
+        cap while idle."""
         deadline = None if timeout is None else time.monotonic() + timeout
+        idle = 0
         while True:
             env = self._try_consume()
             if env is not None:
@@ -465,9 +533,11 @@ class ChannelReader(_Endpoint):
                 return unpack_envelope(env)
             if self.gate.sticky is not None:
                 return unpack_envelope(self.gate.sticky)
+            poll = min(_POLL_BASE_S * (1 << min(idle, 16)), self._poll_cap_s)
+            idle += 1
             remaining = None if deadline is None else deadline - time.monotonic()
             self.gate.event.wait(
-                _POLL_S if remaining is None else max(0.0, min(_POLL_S, remaining))
+                poll if remaining is None else max(0.0, min(poll, remaining))
             )
 
     def _try_consume(self) -> bytes | None:
@@ -485,8 +555,10 @@ class ChannelReader(_Endpoint):
                 env = bytes(self._view[off + 4 : off + 4 + length])
             self._set_u64(_OFF_READ, seq + 1)
             self._next_seq = seq + 1
+            self.last_seq = seq
             return env
         env = self.gate.pop(self._next_seq)
         if env is not None:
+            self.last_seq = self._next_seq
             self._next_seq += 1
         return env

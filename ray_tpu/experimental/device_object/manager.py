@@ -32,7 +32,10 @@ logger = logging.getLogger(__name__)
 
 
 class _DevObjStats:
-    """Plain-int hot-path counters (self_metrics folds them at flush)."""
+    """Plain-int hot-path counters (self_metrics folds them at flush).
+    ``chan_sends``/``chan_recvs`` count descriptor-channel payloads (PR 12)
+    eager-pushed to / taken from the p2p direct mailbox — the steady-state
+    microbatch path, distinct from the pull-driven transfer kinds above."""
 
     __slots__ = (
         "creates",
@@ -42,6 +45,8 @@ class _DevObjStats:
         "transfers_local",
         "transfers_collective",
         "transfers_host",
+        "chan_sends",
+        "chan_recvs",
     )
 
     def __init__(self):
@@ -77,6 +82,12 @@ class DeviceObjectEntry:
     array: object | None  # live jax.Array; None once spilled
     in_store: bool = False  # host copy sealed into the shm arena (same oid)
     last_access: float = 0.0
+    # Channel-payload bookkeeping (PR 12): pins = consumers that have not
+    # yet released this payload; scope = the resident loop / compiled DAG
+    # that created it, so teardown can reclaim whatever releases never
+    # arrived. scope == "" marks an ordinary ObjectRef-owned device object.
+    pins: int = 0
+    scope: str = ""
 
 
 class DeviceObjectManager:
@@ -130,6 +141,84 @@ class DeviceObjectManager:
         if limit > 0:
             self._spill_for_pressure(limit, protect=oid_hex)
         return meta
+
+    @blocking
+    def create_channel_payload(self, arr, pins: int, scope: str):
+        """Register a TRANSIENT channel payload (descriptor channel plane,
+        experimental/channel/device_envelope.py): this process holds the
+        live array while its DeviceObjectMeta rides a channel slot to
+        ``pins`` consumers. Unlike create_resident there is no ObjectRef
+        and no owner — consumers release their pin after resolving (the
+        last release frees), and ``reclaim_scope`` frees whatever is left
+        when the creating loop/DAG tears down. Skips the GCS state-registry
+        row (one KV write per microbatch per edge would be pure churn for
+        an object that lives milliseconds) and is exempt from spill
+        pressure (spilling would seal a host copy — exactly the copy the
+        descriptor plane exists to avoid)."""
+        import os
+
+        from ray_tpu.experimental.device_object.descriptor import DeviceObjectMeta
+        from ray_tpu.util.collective import local_group_hints
+
+        try:
+            hints = local_group_hints()
+        except Exception:
+            hints = []
+        oid_hex = os.urandom(14).hex()
+        holder_id, holder_kind = self.cw._holder_identity()
+        meta = DeviceObjectMeta(
+            object_id=oid_hex,
+            shape=tuple(arr.shape),
+            dtype=str(arr.dtype),
+            nbytes=int(arr.nbytes),
+            transport="collective",
+            holder_addr=tuple(self.cw.address),
+            holder_id=holder_id,
+            holder_kind=holder_kind,
+            # No sharding repr: jax renders it lazily and paying a string
+            # build per microbatch per edge is measurable on the hot loop;
+            # the layout itself travels exactly with the payload bytes.
+            sharding="",
+            group_hints=hints,
+        )
+        with self._lock:
+            self._entries[oid_hex] = DeviceObjectEntry(
+                meta=meta,
+                array=arr,
+                last_access=time.monotonic(),
+                pins=max(1, int(pins)),
+                scope=scope,
+            )
+        DEVOBJ_STATS.creates += 1
+        flight_recorder.record("devobj_create", f"{oid_hex[:12]}:{meta.nbytes}:chan")
+        return meta
+
+    @any_thread
+    def release_pin(self, oid_hex: str) -> None:
+        """One consumer of a channel payload is done with it; the last pin
+        release frees the entry (and with it the holder's reference to the
+        device buffers)."""
+        with self._lock:
+            entry = self._entries.get(oid_hex)
+            if entry is None:
+                return
+            entry.pins -= 1
+            if entry.pins > 0:
+                return
+        self.free(oid_hex)
+
+    @any_thread
+    def reclaim_scope(self, scope: str) -> int:
+        """Free every channel payload created under ``scope`` (a resident
+        loop or compiled DAG tearing down): releases that were lost to a
+        dead consumer or a torn connection must not leak device buffers."""
+        if not scope:
+            return 0
+        with self._lock:
+            victims = [o for o, e in self._entries.items() if e.scope == scope]
+        for oid in victims:
+            self.free(oid)
+        return len(victims)
 
     # ---- resolution (consumer side, via resolve.py) ----
 
@@ -245,7 +334,10 @@ class DeviceObjectManager:
                 live = [
                     (e.last_access, oid)
                     for oid, e in self._entries.items()
-                    if e.array is not None and oid != protect
+                    # Channel payloads (scope set) are exempt: they live
+                    # milliseconds, and spilling one would seal the very
+                    # host copy the descriptor plane avoids.
+                    if e.array is not None and oid != protect and not e.scope
                 ]
                 resident = sum(
                     e.meta.nbytes for e in self._entries.values() if e.array is not None
@@ -307,7 +399,8 @@ class DeviceObjectManager:
             return
         DEVOBJ_STATS.frees += 1
         flight_recorder.record("devobj_free", oid_hex[:12])
-        self._registry_del(oid_hex)
+        if not entry.scope:  # channel payloads never wrote a registry row
+            self._registry_del(oid_hex)
         if entry.in_store:
             # The arena/spilled copy is holder-managed (the owner's plasma
             # bookkeeping never saw it) — delete it cluster-wide here.

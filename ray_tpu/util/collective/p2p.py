@@ -1,4 +1,4 @@
-"""Point-to-point transfer plane for collective groups.
+"""Point-to-point transfer plane for collective groups and channel payloads.
 
 Analog of the reference's ``ray.util.collective`` ``send``/``recv``
 (python/ray/util/collective/collective.py:531/594): a 2-party transfer
@@ -6,27 +6,49 @@ between two ranks of an initialized group, OUT OF BAND with respect to the
 shm object store — this is the wire the device-object plane
 (experimental/device_object/) rides for actor-to-actor tensor handoff.
 
-The mailbox rendezvous runs over the group's GCS KV (the same control plane
-the CPU ring collectives and the TPU world bootstrap already use): the
-sender posts the serialized value under a single-use tagged key, the
-receiver polls it down and deletes it. Device arrays serialize through
-``_private/serialization`` so sharding layout survives the hop and the
-receiver's ``device_put`` lands shards back on the matching devices.
+Two rendezvous mechanisms share this seam:
 
-On real TPU hardware the collectives INSIDE jitted programs ride ICI; this
-2-party object mailbox stays on the host control plane until jax exposes a
-cross-process device-to-device transfer API in this image (the reference's
-NCCL p2p equivalent). The seam is ``TpuCollectiveGroup.send/recv`` — swap
-the mailbox for the device path there without touching any caller.
+- **GCS-KV mailbox** (``mailbox_send``/``mailbox_recv``): the group-rank
+  path. The sender posts the serialized value under a single-use tagged key
+  in the group's GCS KV (the same control plane the CPU ring collectives
+  and the TPU world bootstrap already use); the receiver polls it down and
+  deletes it. Needs no peer address — ranks are the only names.
+- **Direct mailbox** (``direct_send``/``direct_recv`` + ``P2PInbox``): the
+  address-direct path the descriptor channel plane (PR 12,
+  experimental/channel/device_envelope.py) streams microbatch payloads
+  over. The sender pushes chunked one-way ``p2p_data`` frames straight at
+  the consumer core worker's RPC server (no GCS round trips, no polling);
+  the receiver waits on its process-local inbox. Keys are caller-scoped
+  (``chdev/<cid>/<seq>`` for channel slots), delivery is at-most-once —
+  callers fall back to a pull (resolve.py) on a missed grace window.
+
+Device arrays serialize through ``_private/serialization`` so sharding
+layout survives either hop and the receiver's ``device_put`` lands shards
+back on the matching devices.
+
+On real TPU hardware the collectives INSIDE jitted programs ride ICI; both
+host mailboxes are correctness stand-ins until jax exposes a cross-process
+device-to-device transfer API in this image (the reference's NCCL p2p
+equivalent). The seams are ``TpuCollectiveGroup.send/recv`` and
+``direct_send/direct_recv`` — swap in the device path there without
+touching any caller.
 """
 
 from __future__ import annotations
 
+import threading
 import time
 
-from ray_tpu._private.concurrency import blocking
+from ray_tpu._private.concurrency import any_thread, blocking
 
 _POLL_S = 0.003
+# Direct-mailbox chunk size: one-way frames on the existing worker pipe,
+# bounded like the chunked object-push path.
+_DIRECT_CHUNK_BYTES = 512 * 1024
+# Unclaimed inbox entries (consumer died / tore down between the eager push
+# and the read) are swept after this age so a long-lived worker's inbox
+# cannot grow without bound on lost readers.
+_INBOX_SWEEP_AGE_S = 180.0
 
 
 def mailbox_key(group_name: str, src_rank: int, dst_rank: int, tag: str) -> str:
@@ -73,3 +95,169 @@ def mailbox_recv(gcs, group_name: str, src_rank: int, dst_rank: int, tag: str, t
                 f"{src_rank} timed out after {timeout}s"
             )
         time.sleep(_POLL_S)
+
+
+# ---------------------------------------------------------------------------
+# Direct mailbox (address-directed, no GCS round trips)
+# ---------------------------------------------------------------------------
+
+
+class P2PInbox:
+    """Per-process landing zone for ``p2p_data`` frames (one per core
+    worker; the ``rpc_p2p_data`` handler deposits into it). Chunked frames
+    reassemble here; a waiter blocks on a per-key event. All state behind
+    one lock; methods never block — deposit runs on the IO loop."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._parts: dict[str, dict] = {}    # key -> {idx: bytes}
+        self._parts_ts: dict[str, float] = {}  # key -> first-chunk monotonic ts
+        self._done: dict[str, tuple] = {}    # key -> (bytes, monotonic ts)
+        self._waiters: dict[str, threading.Event] = {}
+        self._deposits = 0
+
+    @any_thread
+    def deposit(self, key: str, idx: int, total: int, data: bytes) -> bool:
+        """Returns True when the payload is COMPLETE (all chunks landed)."""
+        complete = False
+        with self._lock:
+            parts = self._parts.get(key)
+            if parts is None:
+                parts = self._parts[key] = {}
+                self._parts_ts[key] = time.monotonic()
+            parts[idx] = data
+            if len(parts) == total:
+                self._parts.pop(key)
+                self._parts_ts.pop(key, None)
+                self._done[key] = (
+                    data if total == 1 else b"".join(parts[i] for i in range(total)),
+                    time.monotonic(),
+                )
+                waiter = self._waiters.get(key)
+                if waiter is not None:
+                    waiter.set()
+                complete = True
+            self._deposits += 1
+            sweep = self._deposits & 255 == 0
+        if sweep:
+            self.sweep()
+        return complete
+
+    @any_thread
+    def take(self, key: str) -> bytes | None:
+        with self._lock:
+            entry = self._done.pop(key, None)
+            return None if entry is None else entry[0]
+
+    @any_thread
+    def _waiter(self, key: str) -> threading.Event:
+        with self._lock:
+            if key in self._done:
+                ev = threading.Event()
+                ev.set()
+                return ev
+            ev = self._waiters.get(key)
+            if ev is None:
+                ev = self._waiters[key] = threading.Event()
+            return ev
+
+    @any_thread
+    def _drop_waiter(self, key: str) -> None:
+        with self._lock:
+            self._waiters.pop(key, None)
+
+    @any_thread
+    def purge_prefix(self, prefix: str) -> int:
+        """Drop every entry/partial under a key prefix (channel teardown:
+        cids are dead, nobody will ever take these payloads)."""
+        with self._lock:
+            victims = [k for k in self._done if k.startswith(prefix)]
+            for k in victims:
+                del self._done[k]
+            for k in [k for k in self._parts if k.startswith(prefix)]:
+                del self._parts[k]
+                self._parts_ts.pop(k, None)
+                victims.append(k)
+            return len(victims)
+
+    @any_thread
+    def sweep(self, max_age_s: float = _INBOX_SWEEP_AGE_S) -> int:
+        """Age out unclaimed payloads AND stale partial reassemblies (a
+        producer that died mid-push leaves chunks that will never
+        complete — lost writers must not leak any more than lost
+        readers)."""
+        cutoff = time.monotonic() - max_age_s
+        with self._lock:
+            victims = [k for k, (_, ts) in self._done.items() if ts < cutoff]
+            for k in victims:
+                del self._done[k]
+            stale = [k for k, ts in self._parts_ts.items() if ts < cutoff]
+            for k in stale:
+                self._parts.pop(k, None)
+                del self._parts_ts[k]
+            return len(victims) + len(stale)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._done),
+                "partials": len(self._parts),
+                "bytes": sum(len(d) for d, _ in self._done.values()),
+            }
+
+
+@any_thread
+def direct_send(cw, addr: tuple, key: str, data: bytes) -> None:
+    """Push serialized payload bytes at ``addr``'s inbox under ``key`` as
+    chunked ONE-WAY frames on the existing worker pipe (fire-and-forget,
+    like the channel doorbell): zero round trips on the hot path. Loss is
+    recoverable — the consumer's grace window expires and it falls back to
+    the pull path (resolve.py), where the holder still pins the payload."""
+    client = cw._owner_client(tuple(addr))
+    total = max(1, (len(data) + _DIRECT_CHUNK_BYTES - 1) // _DIRECT_CHUNK_BYTES)
+
+    async def _push_all():
+        try:
+            for i in range(total):
+                await client.apush(
+                    "p2p_data",
+                    {
+                        "key": key,
+                        "idx": i,
+                        "total": total,
+                        "data": data[
+                            i * _DIRECT_CHUNK_BYTES : (i + 1) * _DIRECT_CHUNK_BYTES
+                        ],
+                    },
+                )
+        except Exception:
+            pass  # consumer unreachable: its grace window handles it
+
+    cw._io.spawn(_push_all())
+
+
+@blocking
+def direct_recv(cw, key: str, timeout: float, abort_check=None) -> bytes | None:
+    """Wait for a direct-mailbox payload under ``key``. Returns the bytes,
+    or None when ``timeout`` expires (caller falls back to the pull path)
+    or ``abort_check()`` goes true (teardown / poison: caller surfaces its
+    own typed error). Steady state returns without sleeping — for channel
+    payloads the deposit itself is what woke the reader, so the bytes are
+    already here by the time the consumer resolves the slot."""
+    inbox = cw.p2p_inbox
+    deadline = time.monotonic() + timeout
+    ev = inbox._waiter(key)
+    try:
+        while True:
+            data = inbox.take(key)
+            if data is not None:
+                return data
+            if abort_check is not None and abort_check():
+                return None
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            ev.wait(min(0.05, remaining))
+            ev.clear()
+    finally:
+        inbox._drop_waiter(key)

@@ -168,6 +168,54 @@ def test_recorder_overhead_smoke(tmp_path):
     assert data["recorder_overhead_pct"] < 25.0, data
 
 
+def test_microbench_pipeline_smoke(tmp_path):
+    """<60s --pipeline --quick pass (ISSUE 12): all four arms (spmd
+    pipeline_apply, classic device-dispatch, classic host, MPMD compiled)
+    produce throughput numbers at M=4, the MPMD outputs are bit-exact vs
+    pipeline_apply, and the steady-state evidence holds — 0 raylet RPCs
+    per iteration, 0 host-store activation objects, 0 host-fallback
+    transfers (deterministic counters, not timing). Perf certification
+    (>=2x vs classic dispatch, bubble at M in {4,16}) lives in the
+    committed PIPEBENCH_r12.json — the quick arms are too short/noisy to
+    re-certify ratios."""
+    out = tmp_path / "pipebench.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", RAY_TPU_NUM_TPUS="0")
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "microbench.py"),
+            "--pipeline",
+            "--quick",
+            "--round",
+            "12",
+            "--out",
+            str(out),
+        ],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=360,
+    )
+    assert proc.returncode == 0, (
+        f"microbench --pipeline failed (rc={proc.returncode})\n"
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    data = json.loads(out.read_text())
+    for key in (
+        "pipeline_spmd_m4_iter_per_s",
+        "pipeline_classic_m4_iter_per_s",
+        "pipeline_classic_host_m4_iter_per_s",
+        "pipeline_mpmd_m4_iter_per_s",
+    ):
+        assert data.get(key, 0) > 0, f"{key} missing/zero: {data}"
+    assert data["pipeline_parity_bitexact"] is True, data
+    assert data["pipeline_mpmd_m4_raylet_rpcs_per_iter"] == 0, data
+    assert data["pipeline_mpmd_m4_store_objects_delta"] == 0, data
+    assert data["pipeline_mpmd_m4_host_transfers_delta"] == 0, data
+    assert data["pipeline_mpmd_m4_chan_sends"] > 0, data
+
+
 def test_microbench_device_objects_smoke(tmp_path):
     """<30s device-object plane case (microbench.py --device-objects
     --quick): host and device paths both produce throughput numbers, and

@@ -85,6 +85,44 @@ def test_pipeline_matches_sequential():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
+def test_pipeline_microbatches_exceed_stages():
+    """The GPipe schedule's bubble arithmetic (T = M + S - 1 steps) at
+    M > S — more microbatches than stages, the regime that actually shrinks
+    the bubble — was previously only exercised at M == S."""
+    from ray_tpu.parallel.pipeline import pipeline_apply
+
+    mesh = create_mesh(MeshConfig(pp=4, dp=2))
+    n_stages, d = 4, 8
+    ws = jax.random.normal(jax.random.PRNGKey(3), (n_stages, d, d)) * 0.3
+
+    def stage_fn(w, h):
+        return jnp.tanh(h @ w)
+
+    for M in (8, 16):
+        x = jax.random.normal(jax.random.PRNGKey(M), (M * 2, d))
+        ref = x
+        for i in range(n_stages):
+            ref = stage_fn(ws[i], ref)
+        out = pipeline_apply(stage_fn, ws, x, mesh, num_microbatches=M)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_pipeline_non_divisible_batch_asserts():
+    """A batch that doesn't divide into num_microbatches fails loudly at
+    the assertion, not with a silent reshape error downstream."""
+    from ray_tpu.parallel.pipeline import pipeline_apply
+
+    mesh = create_mesh(MeshConfig(pp=4, dp=2))
+    ws = jax.random.normal(jax.random.PRNGKey(4), (4, 8, 8))
+    x = jax.random.normal(jax.random.PRNGKey(5), (10, 8))  # 10 % 4 != 0
+
+    def stage_fn(w, h):
+        return jnp.tanh(h @ w)
+
+    with pytest.raises(AssertionError, match="not divisible"):
+        pipeline_apply(stage_fn, ws, x, mesh, num_microbatches=4)
+
+
 def test_moe_layer_shapes_and_balance():
     from ray_tpu.parallel.moe import init_moe_params, moe_layer
 
