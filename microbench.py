@@ -593,6 +593,256 @@ def recorder_overhead_suite(results, block_tasks=256, pairs=150):
     )
 
 
+def chaos_suite(results, quick=False):
+    """--chaos: recovery-time budget table for the wire chaos plane
+    (CHAOSBENCH_r{N}.json) — pull source failover under mid-frame reset,
+    device-object handoff under a lost pull round trip, broadcast
+    completion under a relay partition, acall heal-after-partition — plus
+    the injection-DISABLED overhead check on task_sync (PR 8's paired-ABBA
+    methodology: an installed-but-inert plan vs no plan; the no-plan arm
+    is the production configuration, whose entire seam cost is one is-None
+    check per frame, so the inert-plan arm upper-bounds it)."""
+    import statistics
+    import threading
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private import chaos
+    from ray_tpu._private.rpc import EventLoopThread, RpcClient, RpcServer
+    from ray_tpu.cluster_utils import Cluster
+
+    def oid_for(tag):
+        return tag.encode().hex().ljust(56, "0")[:56]
+
+    mib = 4 if quick else 16
+    results["chaos_object_mib"] = mib
+
+    # ---- acall heal-after-partition (no cluster needed) ----
+    srv = RpcServer("chaosbench")
+
+    async def _pong(req):
+        return {"ok": True}
+
+    srv.register("pong", _pong)
+    addr = srv.start()
+    cli = RpcClient(addr, label="chaosbench-cli")
+    cli.call("pong", {}, timeout=5)
+    key = f"{addr[0]}:{addr[1]}"
+    partition_s = 1.0
+    chaos.partition("*", key)
+    healed_at = {}
+
+    def _heal():
+        chaos.heal("*", key)
+        healed_at["t"] = time.perf_counter()
+
+    timer = threading.Timer(partition_s, _heal)
+    timer.start()
+    t0 = time.perf_counter()
+    cli.call("pong", {}, timeout=5, retries=10)
+    t_done = time.perf_counter()
+    timer.join()
+    chaos.clear()
+    cli.close()
+    srv.stop()
+    results["acall_partition_window_s"] = partition_s
+    results["acall_heal_total_s"] = round(t_done - t0, 3)
+    # Time from heal to success = the backoff schedule's probe latency;
+    # bounded by rpc_retry_backoff_max_ms by construction.
+    results["acall_heal_probe_latency_s"] = round(t_done - healed_at["t"], 3)
+
+    cluster = Cluster()
+    try:
+        nodes = [
+            cluster.add_node(num_cpus=1, object_store_memory=(mib * 8 + 64) * 1024 * 1024)
+            for _ in range(4)
+        ]
+        cluster.connect()
+        cluster.wait_for_nodes()
+        io = EventLoopThread.get()
+        data = np.random.default_rng(13).integers(
+            0, 255, mib * 1024 * 1024, dtype=np.uint8
+        ).tobytes()
+
+        def seal(node, o):
+            offset = io.run(node.store.create(o, len(data)))
+            node.arena.write(offset, data)
+            node.store.seal(o)
+            io.run(node.gcs.acall(
+                "add_object_location", {"object_id": o, "node_id": node.node_id}
+            ))
+
+        def read_ok(node, o):
+            offset, size = io.run(node.store.get(o))
+            try:
+                return bytes(node.arena.read(offset, size)) == data
+            finally:
+                node.store.release(o)
+
+        # ---- pull source failover under mid-frame reset ----
+        o1 = oid_for("chaosbenchA")
+        seal(nodes[0], o1)
+        io.run(nodes[1].pull_manager.pull(o1, 120), timeout=120)  # replica 2
+        t0 = time.perf_counter()
+        io.run(nodes[2].pull_manager.pull(o1, 120), timeout=120)
+        results["pull_unfaulted_s"] = round(time.perf_counter() - t0, 3)
+        o2 = oid_for("chaosbenchB")
+        seal(nodes[0], o2)
+        io.run(nodes[1].pull_manager.pull(o2, 120), timeout=120)
+        chaos.install({"rules": [{
+            "kind": "reset", "method": ["fetch_object_chunk"],
+            "peer": f"peer-{nodes[0].node_id[:8]}", "reset_at": 9, "times": 2,
+        }]}, seed=13)
+        t0 = time.perf_counter()
+        io.run(nodes[3].pull_manager.pull(o2, 120), timeout=120)
+        results["pull_failover_reset_s"] = round(time.perf_counter() - t0, 3)
+        results["pull_failover_injected"] = chaos.CHAOS_STATS.resets
+        chaos.clear()
+        assert read_ok(nodes[2], o1) and read_ok(nodes[3], o2)
+
+        # ---- broadcast completion under relay partition ----
+        o3 = oid_for("chaosbenchC")
+        seal(nodes[0], o3)
+        targets = [
+            {"node_id": n.node_id, "address": list(n.address)} for n in nodes[1:]
+        ]
+        t0 = time.perf_counter()
+        resp = io.run(
+            nodes[0].rpc_broadcast_object(
+                {"object_id": o3, "targets": targets, "timeout": 120.0}
+            ),
+            timeout=120,
+        )
+        results["broadcast_unfaulted_s"] = round(time.perf_counter() - t0, 3)
+        assert resp["ok"], resp
+        for n in nodes:
+            n.store.delete(o3)
+            io.run(n.gcs.acall("remove_object_location",
+                               {"object_id": o3, "node_id": n.node_id}))
+        o4 = oid_for("chaosbenchD")
+        seal(nodes[0], o4)
+        # Partition the FIRST relay child (binomial split hands it the
+        # subtree) for 1s mid-broadcast, healed by timer.
+        victim = nodes[1]
+        cluster.partition_node(victim)
+        timer = threading.Timer(1.0, lambda: cluster.heal_node(victim))
+        timer.start()
+        t0 = time.perf_counter()
+        resp = io.run(
+            nodes[0].rpc_broadcast_object(
+                {"object_id": o4, "targets": targets, "timeout": 120.0}
+            ),
+            timeout=120,
+        )
+        dt = time.perf_counter() - t0
+        timer.join()
+        cluster.heal_node(victim)
+        results["broadcast_relay_partition_s"] = round(dt, 3)
+        results["broadcast_relay_partition_window_s"] = 1.0
+        # Completion contract: delivered everywhere, or failures NAME nodes
+        # (the push plane fails fast on an unroutable relay rather than
+        # waiting out the tear — the caller owns the retry policy).
+        results["broadcast_relay_partition_ok"] = bool(resp.get("ok"))
+        results["broadcast_relay_partition_failed_named"] = resp.get("failed", [])
+        if not resp.get("ok"):
+            # The documented recovery: re-broadcast after heal completes
+            # (delivered targets answer "already"; the named failures get
+            # their copy now).
+            t0 = time.perf_counter()
+            resp2 = io.run(
+                nodes[0].rpc_broadcast_object(
+                    {"object_id": o4, "targets": targets, "timeout": 120.0}
+                ),
+                timeout=120,
+            )
+            results["broadcast_retry_after_heal_s"] = round(time.perf_counter() - t0, 3)
+            results["broadcast_retry_after_heal_ok"] = bool(resp2.get("ok"))
+
+        # ---- device-object handoff under a lost pull round trip ----
+        import jax.numpy as jnp
+
+        @ray_tpu.remote(max_retries=2)
+        def consume(arr):
+            return float(np.asarray(arr).sum())
+
+        warm = ray_tpu.put(jnp.ones(1024, jnp.float32), tensor_transport="collective")
+        assert ray_tpu.get(consume.remote(warm), timeout=120) == 1024.0
+        del warm
+        r1 = ray_tpu.put(jnp.ones(4096, jnp.float32), tensor_transport="collective")
+        t0 = time.perf_counter()
+        assert ray_tpu.get(consume.remote(r1), timeout=120) == 4096.0
+        results["devobj_handoff_unfaulted_s"] = round(time.perf_counter() - t0, 3)
+        del r1
+        r2 = ray_tpu.put(jnp.ones(4096, jnp.float32), tensor_transport="collective")
+        # Drop the driver's devobj_pull REPLY once: the worker's bounded
+        # per-attempt timeout retries (15s attempt cap — was a 60s stall
+        # before this round's fix).
+        chaos.install({"rules": [{
+            "kind": "drop", "method": "devobj_pull", "side": "resp", "times": 1,
+        }]}, seed=13)
+        t0 = time.perf_counter()
+        assert ray_tpu.get(consume.remote(r2), timeout=120) == 4096.0
+        results["devobj_handoff_lost_reply_s"] = round(time.perf_counter() - t0, 3)
+        chaos.clear()
+        del r2
+    finally:
+        chaos.clear()
+        cluster.shutdown()
+
+    # ---- injection-disabled overhead on task_sync (PR 8 methodology) ----
+    ray_tpu.init(num_cpus=1, object_store_memory=128 * 1024 * 1024)
+
+    @ray_tpu.remote
+    def small():
+        return b"ok"
+
+    inert_plan = {"rules": [{"kind": "drop", "method": "no_such_method"}]}
+
+    def set_mode(installed: bool):
+        if installed:
+            chaos.install(inert_plan, seed=1)
+        else:
+            chaos.clear()
+
+    def block(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            ray_tpu.get(small.remote())
+        return n / (time.perf_counter() - t0)
+
+    block(200)  # warm lease + jit paths
+    # 150 pairs, like OBSBENCH_r8: short runs on this box swing +-4% while
+    # the long-horizon median repeats within ~0.5%.
+    pairs = 8 if quick else 150
+    block_tasks = 128 if quick else 256
+    ratios, off_rates, on_rates = [], [], []
+    for i in range(pairs):
+        order = [True, False] if i % 2 == 0 else [False, True]
+        rates = {}
+        for installed in order:
+            set_mode(installed)
+            rates[installed] = block(block_tasks)
+        on_rates.append(rates[True])
+        off_rates.append(rates[False])
+        ratios.append(rates[False] / rates[True])
+    chaos.clear()
+    ray_tpu.shutdown()
+    results["chaos_off_task_sync_per_s"] = round(statistics.median(off_rates), 1)
+    results["chaos_inert_plan_task_sync_per_s"] = round(statistics.median(on_rates), 1)
+    results["chaos_inert_plan_overhead_pct"] = round(
+        (statistics.median(ratios) - 1.0) * 100.0, 2
+    )
+    results["chaos_overhead_pairs"] = pairs
+    print(
+        f"chaos plane: inert-plan overhead {results['chaos_inert_plan_overhead_pct']}% "
+        f"(no-plan {results['chaos_off_task_sync_per_s']}/s vs inert "
+        f"{results['chaos_inert_plan_task_sync_per_s']}/s over {pairs} ABBA pairs); "
+        f"disabled (no plan) is the production arm — its seam cost is one "
+        f"is-None check per frame, upper-bounded by the inert-plan arm"
+    )
+
+
 def compute_deltas_vs_prev(results: dict, round_no: int, prev_path: str | None = None):
     """Diff numeric metrics against the previous round's artifact so a
     regression is named IN the artifact, not discovered by a later reviewer
@@ -1164,6 +1414,15 @@ def main():
         "aggregate tokens/s; records SERVEBENCH_r{N}.json",
     )
     ap.add_argument(
+        "--chaos",
+        action="store_true",
+        help="chaos-plane recovery budgets (ISSUE 13): pull failover under "
+        "mid-frame reset, devobj handoff under a lost pull reply, broadcast "
+        "under relay partition, acall heal-after-partition, plus the "
+        "injection-disabled overhead check on task_sync; records "
+        "CHAOSBENCH_r{N}.json",
+    )
+    ap.add_argument(
         "--transfer",
         action="store_true",
         help="transfer-plane A/B (ISSUE 10): cut-through broadcast at the "
@@ -1276,6 +1535,17 @@ def main():
             results, args.round, prev_path=f"SERVEBENCH_r{args.round - 1}.json"
         )
         out = args.out or f"SERVEBENCH_r{args.round}.json"
+        with open(out, "w") as f:
+            json.dump(results, f, indent=1)
+        print(json.dumps(results))
+        return
+
+    if args.chaos:
+        results = {"host_cpus": os.cpu_count(), "mode": "chaos"}
+        t0 = time.perf_counter()
+        chaos_suite(results, quick=args.quick)
+        results["wall_s"] = round(time.perf_counter() - t0, 1)
+        out = args.out or f"CHAOSBENCH_r{args.round}.json"
         with open(out, "w") as f:
             json.dump(results, f, indent=1)
         print(json.dumps(results))

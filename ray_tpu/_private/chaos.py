@@ -1,0 +1,414 @@
+"""Wire-level chaos plane — seeded fault injection in the RPC transport.
+
+TPU-native analog of the reference's chaos tooling (python/ray/tests/
+test_chaos.py + test_utils.py NodeKillerActor, and the gRPC-level fault
+injection its network tests lean on): the coarse levers this repo already
+had (SIGKILL a process tree, ``Cluster.remove_node``, GCS restart) can kill
+a *component*, but none of them can produce the failure modes a real
+network produces — a lost one-way frame, a duplicated chunk, a connection
+reset mid-frame, a partition that heals. Every protocol above the frame
+seam (acall request/response, ``send_nowait`` one-way frames, push/pull
+chunk streams, cut-through relays, p2p direct mailbox, GCS calls) claims to
+recover from those; this module makes the claims testable.
+
+Design:
+
+- A per-process :class:`FaultPlan` holds an ordered rule list plus a
+  partition table. ``rpc.py`` consults it at the frame WRITE seam (client
+  sends and server responses) and at connect time — one ``is None`` check
+  per frame when no plan is installed, which is the entire production cost.
+- Rules are **deterministic and seeded**: matching is by (peer, method,
+  side) and firing is governed by ``after``/``every``/``times`` counters
+  plus an optional probability ``p`` drawn from the plan's own
+  ``random.Random(seed)``. The same seed over the same frame stream yields
+  the same injection sequence (``plan.log`` records it for replay
+  assertions).
+- Faults: **drop** (frame vanishes, connection stays up — the silent-loss
+  model), **delay** (frame written after a bounded jitter; delaying one
+  frame past its successors IS reordering), **dup** (frame written twice —
+  at-least-once delivery made concrete), **reset** (the first ``reset_at``
+  bytes are written, then the transport is torn — a mid-frame tear,
+  including mid-raw-frame), and **partition** (sends/connects between two
+  endpoints fail with ``ConnectionLost`` until healed; symmetric or
+  asymmetric, pairwise or a node **membrane**).
+- Install paths: config/env (``RAY_TPU_CHAOS_SEED``/``RAY_TPU_CHAOS_PLAN``,
+  read at CoreWorker/Raylet boot so spawned workers inherit the plan), or
+  at runtime via the ``chaos_set_plan`` RPC every raylet and worker serves
+  (tests flip faults mid-workload; a raylet can fan a plan out to its
+  registered workers).
+
+Partition model: an endpoint is an address key (``host:port`` or a unix
+socket path) as produced by :func:`rpc.addr_key`. Client sends know their
+target address and an optional ``chaos_scope`` (the raylet stamps its own
+address on the clients it owns, so "this node's outbound traffic" is
+matchable); a **pair** rule blocks (src→dst) with ``*`` wildcards, and a
+**membrane** blocks any link crossing an inside/outside boundary (the
+in-process network tear ``Cluster.partition_node`` uses — node-local links
+stay up, cross-membrane links drop). Partitions are enforced at clients
+and connects only: the first blocked send also tears the live socket, so
+the peer's half of the conversation dies with it, and worker processes get
+their own plan pushed when a whole node is severed.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import random
+import threading
+
+from ray_tpu._private import flight_recorder
+from ray_tpu._private.concurrency import any_thread
+
+logger = logging.getLogger(__name__)
+
+FAULT_KINDS = ("drop", "delay", "dup", "reset", "partition")
+
+# Methods never injected: the chaos control plane itself must stay
+# reachable (a plan that drops chaos_set_plan frames could never be
+# cleared remotely).
+_DEFAULT_EXCLUDE = frozenset({"chaos_set_plan"})
+
+
+class _ChaosStats:
+    """Plain-int injection counters (same pattern as rpc.WIRE): the seam
+    runs on the IO loop, bare ``+=`` is race-free there; folded into the
+    ``ray_tpu_chaos_injected_total`` instrument by the flush-time
+    collector (self_metrics._collect_chaos_stats)."""
+
+    __slots__ = ("injected", "drops", "delays", "dups", "resets", "partition_blocks")
+
+    def __init__(self):
+        self.injected = 0
+        self.drops = 0
+        self.delays = 0
+        self.dups = 0
+        self.resets = 0
+        self.partition_blocks = 0
+
+
+CHAOS_STATS = _ChaosStats()
+
+
+class Action:
+    """One injection decision, handed to the rpc seam to apply."""
+
+    __slots__ = ("kind", "delay_s", "reset_at")
+
+    def __init__(self, kind: str, delay_s: float = 0.0, reset_at: int = 8):
+        self.kind = kind
+        self.delay_s = delay_s
+        self.reset_at = reset_at
+
+
+class FaultRule:
+    """One match-and-fire rule. Matching is structural (peer substring,
+    method set, side); firing is counted (``after`` skipped matches, then
+    every ``every``-th match fires, at most ``times`` times) and optionally
+    thinned by probability ``p`` drawn from the plan's seeded RNG."""
+
+    __slots__ = (
+        "kind", "peer", "methods", "side", "p", "after", "every", "times",
+        "delay_ms", "reset_at", "matched", "fired",
+    )
+
+    def __init__(self, spec: dict):
+        kind = spec.get("kind")
+        if kind not in ("drop", "delay", "dup", "reset"):
+            raise ValueError(f"unknown fault kind {kind!r}")
+        self.kind = kind
+        self.peer = spec.get("peer")  # substring of client label OR addr key
+        methods = spec.get("method")
+        if methods is None:
+            self.methods = None
+        elif isinstance(methods, str):
+            self.methods = frozenset((methods,))
+        else:
+            self.methods = frozenset(methods)
+        self.side = spec.get("side")  # "send" | "resp" | None (both)
+        self.p = float(spec.get("p", 1.0))
+        self.after = int(spec.get("after", 0))
+        self.every = max(1, int(spec.get("every", 1)))
+        times = spec.get("times")
+        self.times = None if times is None else int(times)
+        lo, hi = spec.get("delay_ms", (5, 50)) or (5, 50)
+        self.delay_ms = (float(lo), float(hi))
+        self.reset_at = int(spec.get("reset_at", 8))
+        self.matched = 0
+        self.fired = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.kind, "peer": self.peer,
+            "method": sorted(self.methods) if self.methods else None,
+            "side": self.side, "p": self.p, "after": self.after,
+            "every": self.every, "times": self.times,
+            "delay_ms": list(self.delay_ms), "reset_at": self.reset_at,
+        }
+
+    def matches(self, label: str, addr: str, method: str, side: str) -> bool:
+        if self.times is not None and self.fired >= self.times:
+            return False
+        if self.side is not None and self.side != side:
+            return False
+        if self.methods is not None and method not in self.methods:
+            return False
+        if self.peer is not None and self.peer not in label and self.peer not in addr:
+            return False
+        return True
+
+
+class _Membrane:
+    __slots__ = ("inside", "local_inside")
+
+    def __init__(self, inside, local_inside: bool):
+        self.inside = frozenset(inside)
+        self.local_inside = bool(local_inside)
+
+
+class FaultPlan:
+    """The active per-process fault schedule. All decision entry points run
+    on the IO loop (the frame seam), so rule counters and the RNG need no
+    lock; installation swaps the whole plan atomically (module global)."""
+
+    def __init__(self, spec: dict | None = None, seed: int | None = None):
+        spec = spec or {}
+        if seed is None:
+            seed = int(spec.get("seed", 0))
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.rules: list[FaultRule] = []
+        self.exclude = frozenset(spec.get("exclude", ())) | _DEFAULT_EXCLUDE
+        # Deterministic injection record (kind:method:peer), for the
+        # same-seed-same-sequence assertion and for debugging a cell.
+        self.log: collections.deque = collections.deque(maxlen=1024)
+        # Partition state. Pairs are directed (src_scope, dst_addr) with
+        # "*" wildcards; membranes are inside/outside boundary sets.
+        self._pairs: set[tuple] = set()
+        self._membranes: dict[int, _Membrane] = {}
+        self._next_membrane = 1
+        self._mutate = threading.Lock()  # partition edits from user threads
+        for rule in spec.get("rules", ()):
+            if rule.get("kind") == "partition":
+                if "inside" in rule:
+                    # Membrane form: sever every link crossing the
+                    # inside/outside boundary (node tears).
+                    self.add_membrane(
+                        rule["inside"], bool(rule.get("local_inside", False))
+                    )
+                else:
+                    self.add_partition(
+                        rule.get("a", "*"), rule.get("b", "*"),
+                        symmetric=bool(rule.get("symmetric", True)),
+                    )
+            else:
+                self.rules.append(FaultRule(rule))
+
+    # ---- partitions ----
+
+    @any_thread
+    def add_partition(self, a: str, b: str = "*", symmetric: bool = True):
+        with self._mutate:
+            self._pairs.add((a, b))
+            if symmetric:
+                self._pairs.add((b, a))
+
+    @any_thread
+    def heal_partition(self, a: str, b: str = "*", symmetric: bool = True):
+        with self._mutate:
+            self._pairs.discard((a, b))
+            if symmetric:
+                self._pairs.discard((b, a))
+
+    @any_thread
+    def add_membrane(self, inside, local_inside: bool = False) -> int:
+        with self._mutate:
+            mid = self._next_membrane
+            self._next_membrane += 1
+            self._membranes[mid] = _Membrane(inside, local_inside)
+            return mid
+
+    @any_thread
+    def remove_membrane(self, mid: int):
+        with self._mutate:
+            self._membranes.pop(mid, None)
+
+    @any_thread
+    def heal_all(self):
+        with self._mutate:
+            self._pairs.clear()
+            self._membranes.clear()
+
+    @any_thread
+    def has_partitions(self) -> bool:
+        return bool(self._pairs or self._membranes)
+
+    @any_thread
+    def blocked(self, local_scope: str | None, remote: str) -> bool:
+        """Is the (local endpoint -> remote address) link severed?
+        ``local_scope`` is None for unscoped clients (driver/worker user
+        clients), which membranes classify by their ``local_inside``
+        default and pairs match only via the ``*`` wildcard."""
+        if not self._pairs and not self._membranes:
+            return False
+        for m in self._membranes.values():
+            li = (local_scope in m.inside) if local_scope is not None else m.local_inside
+            if li != (remote in m.inside):
+                return True
+        for src, dst in self._pairs:
+            if (src == "*" or src == local_scope) and (dst == "*" or dst == remote):
+                return True
+        return False
+
+    # ---- the frame-seam decision (rpc.py calls this; IO loop only) ----
+
+    def on_send(
+        self, local_scope: str | None, label: str, addr: str, method: str,
+        side: str = "send",
+    ) -> Action | None:
+        """Decide the fault (if any) for one outbound frame. First matching
+        rule that fires wins; partition outranks rules (a severed link
+        delivers nothing, not a delayed something). Partitions are enforced
+        at CLIENT sends/connects only — a response-side hit here would be
+        recorded but never applied (rpc._send_resp delivers it), so the
+        check is skipped entirely for side="resp" to keep the injection
+        log and counters truthful."""
+        if method in self.exclude:
+            return None
+        if side != "resp" and self.blocked(local_scope, addr):
+            self._record("partition", method, label)
+            CHAOS_STATS.partition_blocks += 1
+            return Action("partition")
+        for rule in self.rules:
+            if not rule.matches(label, addr, method, side):
+                continue
+            rule.matched += 1
+            if rule.matched <= rule.after:
+                continue
+            if (rule.matched - rule.after) % rule.every != 0:
+                continue
+            if rule.p < 1.0 and self.rng.random() >= rule.p:
+                continue
+            rule.fired += 1
+            self._record(rule.kind, method, label)
+            if rule.kind == "drop":
+                CHAOS_STATS.drops += 1
+                return Action("drop")
+            if rule.kind == "dup":
+                CHAOS_STATS.dups += 1
+                return Action("dup")
+            if rule.kind == "reset":
+                CHAOS_STATS.resets += 1
+                return Action("reset", reset_at=rule.reset_at)
+            lo, hi = rule.delay_ms
+            CHAOS_STATS.delays += 1
+            return Action("delay", delay_s=(lo + (hi - lo) * self.rng.random()) / 1000.0)
+        return None
+
+    def check_connect(self, local_scope: str | None, label: str, addr: str) -> bool:
+        """Connect-time partition gate (rpc._ensure_connected): True means
+        the connect must fail fast with ConnectionLost — a partitioned peer
+        is unroutable NOW, not after a 10s connect spin."""
+        if not self.blocked(local_scope, addr):
+            return False
+        CHAOS_STATS.partition_blocks += 1
+        self._record("partition", "connect", label)
+        return True
+
+    def _record(self, kind: str, method: str, label: str):
+        CHAOS_STATS.injected += 1
+        self.log.append(f"{kind}:{method}:{label}")
+        flight_recorder.record("chaos_inject", f"{kind}:{label[:24]}:{method}")
+
+
+# ---------------------------------------------------------------------------
+# Process-global plan
+# ---------------------------------------------------------------------------
+
+_install_lock = threading.Lock()
+
+
+def _publish(plan: FaultPlan | None):
+    from ray_tpu._private import rpc
+
+    rpc._CHAOS = plan
+
+
+@any_thread
+def active() -> FaultPlan | None:
+    from ray_tpu._private import rpc
+
+    return rpc._CHAOS
+
+
+@any_thread
+def install(spec: dict | FaultPlan | None, seed: int | None = None) -> FaultPlan | None:
+    """Install (or, with None, clear) the process fault plan. ``spec`` is
+    the JSON-able plan grammar (see CHAOS.md) or a prebuilt FaultPlan."""
+    with _install_lock:
+        if spec is None:
+            _publish(None)
+            return None
+        plan = spec if isinstance(spec, FaultPlan) else FaultPlan(spec, seed=seed)
+        _publish(plan)
+        return plan
+
+
+@any_thread
+def clear():
+    install(None)
+
+
+@any_thread
+def ensure_plan() -> FaultPlan:
+    """The active plan, installing an empty one if none is active (the
+    partition helpers need a plan object to hang state on)."""
+    with _install_lock:
+        plan = active()
+        if plan is None:
+            plan = FaultPlan({})
+            _publish(plan)
+        return plan
+
+
+@any_thread
+def partition(a: str, b: str = "*", symmetric: bool = True) -> FaultPlan:
+    """Sever the (a -> b) link (and b -> a when symmetric) until healed.
+    Endpoints are rpc.addr_key strings or "*"."""
+    plan = ensure_plan()
+    plan.add_partition(a, b, symmetric=symmetric)
+    return plan
+
+
+@any_thread
+def heal(a: str, b: str = "*", symmetric: bool = True):
+    plan = active()
+    if plan is not None:
+        plan.heal_partition(a, b, symmetric=symmetric)
+
+
+def maybe_install_from_env():
+    """Boot-time env install (RAY_TPU_CHAOS_PLAN json + RAY_TPU_CHAOS_SEED):
+    how spawned worker processes inherit the cluster's fault plan. A parse
+    failure disables chaos loudly rather than running half a plan."""
+    if active() is not None:
+        return
+    from ray_tpu._private.config import get_config
+
+    # config.chaos_plan already folds in the RAY_TPU_CHAOS_PLAN env var
+    # (apply_overrides) AND accepts _system_config={"chaos_plan": ...}.
+    raw = get_config().chaos_plan or os.environ.get("RAY_TPU_CHAOS_PLAN")
+    if not raw:
+        return
+    try:
+        spec = json.loads(raw)
+        if isinstance(spec, list):
+            spec = {"rules": spec}
+        seed_env = os.environ.get("RAY_TPU_CHAOS_SEED")
+        install(spec, seed=int(seed_env) if seed_env else None)
+        logger.warning("chaos: installed fault plan from env (seed=%s)",
+                       active().seed if active() else None)
+    except Exception:
+        logger.exception("chaos: RAY_TPU_CHAOS_PLAN is invalid; chaos disabled")

@@ -107,7 +107,25 @@ class Config:
     # --- RPC ---
     rpc_connect_timeout_s: float = 10.0
     rpc_retries: int = 3
-    rpc_retry_delay_s: float = 0.2
+    # acall retry pacing: capped exponential backoff (base * 2^(attempt-1),
+    # capped at max) with a [0.5, 1.0) jitter factor — a partitioned or
+    # recovering peer is probed at a decaying, decorrelated rate instead of
+    # the old fixed-pause hammering. retries=0 callers are unaffected.
+    rpc_retry_backoff_base_ms: float = 100.0
+    rpc_retry_backoff_max_ms: float = 2000.0
+    # Bounded wait for the ack of a one-way completion report
+    # (task_done/tasks_done send_nowait frames): a silently lost frame —
+    # receiver dropped it, or chaos did — re-delivers through the acked
+    # retrying path (owner dedupes by cid) instead of hanging the owner's
+    # get() until the lost-task sweep (or forever, on the lease path).
+    task_done_ack_timeout_s: float = 10.0
+
+    # --- chaos fault-injection plane (chaos.py; see CHAOS.md) ---
+    # JSON fault-plan spec installed at process boot (workers inherit the
+    # env var); empty = disabled. The per-frame cost when disabled is one
+    # is-None check at the rpc seam. Env: RAY_TPU_CHAOS_PLAN /
+    # RAY_TPU_CHAOS_SEED (also seeds acall backoff jitter).
+    chaos_plan: str = ""
 
     # --- tasks / actors ---
     default_max_retries: int = 3

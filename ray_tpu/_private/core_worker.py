@@ -193,13 +193,19 @@ class CoreWorker:
         self._done_event_ctr = 0
         self._ship_event_ctr = 0
 
+        # Chaos plane: spawned workers inherit the cluster's fault plan
+        # through the environment (chaos_set_plan flips it at runtime).
+        from ray_tpu._private import chaos
+
+        chaos.maybe_install_from_env()
+
         self.gcs = RpcClient(tuple(gcs_address), label="gcs")
         self.raylet = RpcClient(tuple(raylet_address), label="raylet")
         self.store = StoreClient(arena_name, self.raylet)
         _mark("store-attach")
 
         if job_id is None:
-            job_hex = self.gcs.call("next_job_id")["job_id"]
+            job_hex = self.gcs.call("next_job_id", timeout=15)["job_id"]
             job_id = JobID.from_hex(job_hex)
         self.job_id = job_id
         self._default_task_id = TaskID.for_driver(job_id)
@@ -470,7 +476,12 @@ class CoreWorker:
         pickled = cloudpickle.dumps(func)
         key = "fn:" + hashlib.sha1(pickled).hexdigest()
         if key not in self._exported_functions:
-            self.gcs.call("kv_put", {"key": key, "value": pickled, "overwrite": False})
+            # Bounded + retried (kv_put with overwrite=False is idempotent):
+            # a silently lost export frame must not hang .remote() forever.
+            self.gcs.call(
+                "kv_put", {"key": key, "value": pickled, "overwrite": False},
+                timeout=15,
+            )
             self._exported_functions.add(key)
             self._function_cache[key] = func
         try:
@@ -1327,12 +1338,38 @@ class CoreWorker:
     def _fetch_from_owner(self, ref, deadline):
         try:
             client = self._owner_client(tuple(ref.owner_addr))
-            rem = self._remaining(deadline)
-            resp = client.call(
-                "get_inline",
-                {"object_id": ref.hex(), "wait": True},
-                timeout=rem,
-            )
+            # get_inline with wait=True is an idempotent LONG-POLL, so wait
+            # in bounded slices and simply re-poll on a slice timeout OR an
+            # in-slice "missing" (= still pending) answer: a request/reply
+            # frame silently lost on the wire costs one slice (it used to
+            # park this borrower for the caller's whole deadline — forever
+            # for task-arg resolution, which has none), and the server
+            # parks its wait for at most the slice too, so abandoned
+            # slices cannot accumulate parked handler tasks on the owner.
+            # The overall wait envelope stays the pre-slicing one:
+            # worker_lease_timeout_s total, then "missing" falls through.
+            wait_deadline = time.monotonic() + self.cfg.worker_lease_timeout_s
+            while True:
+                rem = self._remaining(deadline)  # raises at the deadline
+                per = min(
+                    10.0,
+                    max(0.5, wait_deadline - time.monotonic()),
+                    rem if rem is not None else 10.0,
+                )
+                try:
+                    resp = client.call(
+                        "get_inline",
+                        {"object_id": ref.hex(), "wait": True, "timeout": per},
+                        timeout=per + 2.0,
+                        retries=0,
+                    )
+                except (asyncio.TimeoutError, TimeoutError):
+                    resp = None  # slice lost on the wire; re-poll
+                if resp is not None and resp.get("kind") != "missing":
+                    break
+                if time.monotonic() >= wait_deadline:
+                    resp = resp or {"kind": "missing"}
+                    break
         except GetTimeoutError:
             raise
         except Exception:
@@ -1473,9 +1510,32 @@ class CoreWorker:
         )
         for ref in arg_refs:
             self._pin_arg(ref)
-        resp = self.gcs.call("register_actor", {"spec": spec.to_wire()})
+        # Bounded per-attempt ack (acall retries on TimeoutError): a
+        # register_actor request/reply silently lost on the wire used to
+        # park .remote() FOREVER — no timeout, no backstop, not even the
+        # 2-minute kind. The GCS handler is idempotent under the retry
+        # (remembered outcome; see gcs.rpc_register_actor). Transport
+        # exhaustion surfaces as the TYPED unavailability error naming the
+        # component, not a bare TimeoutError.
+        from ray_tpu.exceptions import ActorUnavailableError
+
+        try:
+            resp = self.gcs.call(
+                "register_actor", {"spec": spec.to_wire()}, timeout=15
+            )
+        except (TimeoutError, ConnectionLost) as e:
+            raise ActorUnavailableError(
+                f"could not register actor {cls.__name__} with the GCS at "
+                f"{self.gcs.address}: {type(e).__name__}: {e}"
+            ) from e
         if not resp.get("ok"):
-            raise ValueError(resp.get("error", "actor registration failed"))
+            err = resp.get("error", "actor registration failed")
+            if "no feasible node" in err:
+                # Placement exhaustion is a (possibly transient) cluster
+                # condition, not a caller bug: surface the TYPED
+                # unavailability error; name collisions etc. stay ValueError.
+                raise ActorUnavailableError(f"actor {cls.__name__}: {err}")
+            raise ValueError(err)
         return {
             "actor_id": resp["actor_id"],
             "max_task_retries": spec.max_task_retries,
@@ -1496,7 +1556,9 @@ class CoreWorker:
             addr = self._actor_addrs.get(actor_id)
             if addr is not None:
                 return addr
-            resp = self.gcs.call("get_actor", {"actor_id": actor_id})
+            # Bounded read (idempotent): a lost reply costs one retry, not
+            # the resolve loop wedged forever inside its own deadline.
+            resp = self.gcs.call("get_actor", {"actor_id": actor_id}, timeout=10)
             if not resp.get("found"):
                 raise ActorDiedError(f"actor {actor_id[:8]} not found")
             info = resp["info"]
@@ -1580,6 +1642,72 @@ class CoreWorker:
             return None
         return client
 
+    async def _await_actor_resp(self, client, spec: TaskSpec, wire, fut):
+        """Await an actor call's response with LOSS detection. An actor
+        method may legitimately run for hours, so there is no result
+        timeout — but a silently lost request or response frame (the
+        connection stays up, so no ConnectionLost ever fires and no sweep
+        covers actor calls) used to park the call FOREVER. Every ack
+        interval with no response, probe the worker over the same FIFO
+        connection: 'never received' is proof of request loss (the probe
+        cannot overtake the request frame) -> resend, deduped worker-side
+        by task id; a cached result means the RESPONSE frame was lost ->
+        the probe re-delivers it."""
+        ack = max(2.0, self.cfg.task_done_ack_timeout_s)
+        futs = {fut}
+        try:
+            while True:
+                if not futs:
+                    # Every outstanding seq answered dup: the seq carrying
+                    # the real answer died with a reset connection while
+                    # the method still runs. PACE on the probe (an
+                    # immediate resend would spin dup/resend at round-trip
+                    # rate for the method's whole runtime) — completion
+                    # re-delivers through the worker's result cache.
+                    await asyncio.sleep(min(1.0, ack))
+                    probe = await client.acall(
+                        "actor_has_task", {"task_id": spec.task_id},
+                        timeout=5, retries=1,
+                    )
+                    if probe.get("result") is not None:
+                        return probe["result"]
+                    if probe.get("has"):
+                        continue  # still executing; keep pacing
+                    resent = client.send_nowait("actor_call", wire)
+                    if resent is None:
+                        resent = await client.astart_call("actor_call", wire)
+                    futs.add(resent)
+                done, _pending = await asyncio.wait(
+                    futs, timeout=ack, return_when=asyncio.FIRST_COMPLETED
+                )
+                for f in done:
+                    resp = await f  # done: instant; raises ConnectionLost up
+                    futs.discard(f)
+                    if not (isinstance(resp, dict) and resp.get("dup")):
+                        return resp
+                    # dup marker: the real answer rides another pending seq.
+                if done:
+                    continue
+                probe = await client.acall(
+                    "actor_has_task", {"task_id": spec.task_id}, timeout=5, retries=1
+                )
+                if probe.get("result") is not None:
+                    return probe["result"]
+                if not probe.get("has"):
+                    resent = client.send_nowait("actor_call", wire)
+                    if resent is None:
+                        resent = await client.astart_call("actor_call", wire)
+                    futs.add(resent)
+                # has=True, no result yet: the method is genuinely running —
+                # keep waiting with no bound, as before.
+        finally:
+            # Abandoned duplicates (we returned/raised with sends still
+            # pending) must not surface never-retrieved exceptions when the
+            # connection eventually resolves them.
+            for f in futs:
+                if not f.done():
+                    f.add_done_callback(lambda x: x.cancelled() or x.exception())
+
     async def _drive_actor_call(self, spec: TaskSpec, attempts_left: int):
         actor_id = spec.actor_id
         loop = asyncio.get_event_loop()
@@ -1599,7 +1727,7 @@ class CoreWorker:
                     fut = client.send_nowait("actor_call", wire)
                     if fut is None:
                         fut = await client.astart_call("actor_call", wire)
-                resp = await fut
+                resp = await self._await_actor_resp(client, spec, wire, fut)
                 if spec.hop_ts:
                     resp.setdefault("hop", {})["owner_recv"] = time.monotonic()
                 self._handle_task_done(spec.task_id, resp)
@@ -2170,7 +2298,14 @@ class CoreWorker:
         with self._lock:
             pending = task_id in self.pending_tasks
         if pending and req.get("wait"):
-            await self._wait_event(oid_hex, self.cfg.worker_lease_timeout_s)
+            # Honor the caller's slice bound when it sends one: borrowers
+            # long-poll in short re-poll slices (loss healing), and a
+            # handler parked past its slice serves a seq nobody awaits.
+            bound = min(
+                float(req.get("timeout") or self.cfg.worker_lease_timeout_s),
+                self.cfg.worker_lease_timeout_s,
+            )
+            await self._wait_event(oid_hex, bound)
             with self._lock:
                 entry = self.in_process_store.get(oid_hex)
                 obj = self.owned.get(oid_hex)
@@ -2313,6 +2448,19 @@ class CoreWorker:
             except Exception:
                 pass
 
+    async def rpc_chaos_set_plan(self, req):
+        """Runtime chaos-plan install/clear for this process (chaos.py) —
+        how a test severs or degrades a WORKER's wire mid-workload (the
+        raylet's handler fans out to workers with broadcast=True)."""
+        from ray_tpu._private import chaos
+
+        plan = req.get("plan")
+        if plan is None:
+            chaos.clear()
+        else:
+            chaos.install(plan, seed=req.get("seed"))
+        return {"ok": True}
+
     async def rpc_debug_dump(self, req):
         """This process's flight-recorder ring (the raylet's debug_dump
         aggregates node-wide, including rings of already-dead processes)."""
@@ -2434,7 +2582,7 @@ class CoreWorker:
             fn = CppFunctionInvoker(library, symbol)
             self._function_cache[key] = fn
         if fn is None:
-            resp = self.gcs.call("kv_get", {"key": key})
+            resp = self.gcs.call("kv_get", {"key": key}, timeout=15)
             if not resp.get("found"):
                 raise RuntimeError(f"function {key} not in GCS function table")
             fn = cloudpickle.loads(resp["value"])

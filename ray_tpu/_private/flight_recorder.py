@@ -109,6 +109,8 @@ EVENT_TYPES = (
     # Descriptor channel plane (device payloads through channel slots, PR 12).
     "chan_devobj_send",  # 38: channel payload eager-pushed out of band (detail cid:seq:bytes)
     "chan_devobj_recv",  # 39: descriptor slot resolved to the live value (detail cid:seq:path)
+    # Chaos fault-injection plane (chaos.py, PR 13).
+    "chaos_inject",    # 40: fault injected at the rpc seam (detail kind:peer:method)
 )
 _CODE = {name: i for i, name in enumerate(EVENT_TYPES)}
 

@@ -66,6 +66,10 @@ class GcsServer:
         self._mutations = 0
         self._subscribers: dict[str, list] = {}  # channel -> [writer]
         self._raylet_clients: dict[str, RpcClient] = {}
+        # actor_id -> in-flight creation-schedule future (register retries
+        # share one schedule; NOT in the actor info dict — that is
+        # WAL-persisted and a Future is unserializable).
+        self._creation_inflight: dict = {}
         self._io = EventLoopThread.get()
         # Write-ahead log (reference durability bar: redis_store_client.h).
         # Restore + open the WAL BEFORE the server starts accepting: a
@@ -221,6 +225,15 @@ class GcsServer:
         self._mutations += 1
         spec = TaskSpec.from_wire(req["spec"])
         actor_id = spec.actor_id
+        # IDEMPOTENT under at-least-once delivery: owners now retry a
+        # register whose reply was lost (bounded per-attempt timeout), and
+        # re-running the body would clobber a live actor's state back to
+        # PENDING_CREATION and schedule a DUPLICATE creation. Serve the
+        # remembered outcome instead; if the first attempt registered but
+        # could not schedule, re-drive just the scheduling.
+        prior = self.actors.get(actor_id)
+        if prior is not None and prior["state"] != DEAD:
+            return await self._ensure_creation_scheduled(actor_id)
         if spec.actor_name:
             key = (spec.namespace, spec.actor_name)
             existing = self.named_actors.get(key)
@@ -245,31 +258,79 @@ class GcsServer:
         self._wal("actors", actor_id)
         if spec.actor_name:
             self._wal("named_actors", (spec.namespace, spec.actor_name))
-        ok = await self._schedule_actor_creation(actor_id)
+        return await self._ensure_creation_scheduled(actor_id)
+
+    async def _ensure_creation_scheduled(self, actor_id: str) -> dict:
+        """Schedule the creation AT MOST ONCE even under concurrent
+        register retries: an owner whose first reply was lost re-enters
+        while the first schedule may still be awaiting its raylet ack —
+        both must share ONE in-flight schedule (kept OUTSIDE the actor
+        info dict: that dict is WAL-persisted and a Future is not
+        serializable) instead of racing duplicate creations."""
+        info = self.actors[actor_id]
+        if info.get("create_scheduled"):
+            return {"ok": True, "existing": False, "actor_id": actor_id}
+        fut = self._creation_inflight.get(actor_id)
+        if fut is None:
+            fut = self._creation_inflight[actor_id] = asyncio.ensure_future(
+                self._schedule_actor_creation(actor_id)
+            )
+        try:
+            ok = await fut
+        finally:
+            if self._creation_inflight.get(actor_id) is fut:
+                self._creation_inflight.pop(actor_id, None)
         if not ok:
             return {"ok": False, "error": "no feasible node for actor"}
+        info["create_scheduled"] = True
         return {"ok": True, "existing": False, "actor_id": actor_id}
 
     async def _schedule_actor_creation(self, actor_id: str) -> bool:
-        """Forward the creation task to a raylet (GcsActorScheduler analog)."""
+        """Forward the creation task to a raylet (GcsActorScheduler analog).
+        A target that cannot be REACHED (partitioned/resetting — its
+        heartbeat may not have lapsed yet) is excluded and the creation
+        fails over to the next feasible node: an unreachable first pick
+        used to surface as a bogus 'no feasible node' with two healthy
+        nodes sitting idle."""
         info = self.actors[actor_id]
         spec = TaskSpec.from_wire(info["spec"])
-        target = self._pick_node_for(spec)
-        if target is None:
-            return False
-        client = self._raylet_client(target)
-        try:
-            await client.acall("submit_task", {"spec": info["spec"]})
-            return True
-        except Exception:
-            logger.exception("failed to submit actor creation to node %s", target[:8])
-            return False
+        tried: set[str] = set()
+        for _ in range(3):
+            target = self._pick_node_for(spec, exclude=tried)
+            if target is None:
+                return False
+            client = self._raylet_client(target)
+            try:
+                # Two bounded attempts per node, then fail over (the
+                # transport default of 3 retries would turn 10s into ~40s
+                # per node and eat the owner's whole register budget inside
+                # one pick; zero retries let a single silently-dropped
+                # reply burn a healthy node — three drops exhausted the
+                # whole candidate list into a bogus 'no feasible node').
+                # A PARTITIONED pick still fails over in ~0.2s: its
+                # ConnectionLost is fail-fast, only silent drops pay the
+                # 10s slice. A reply lost AFTER the raylet accepted can
+                # double-submit; the actor_alive incumbent guard resolves
+                # that (duplicate worker exits).
+                await client.acall(
+                    "submit_task", {"spec": info["spec"]}, timeout=10, retries=1
+                )
+                return True
+            except Exception:
+                tried.add(target)
+                logger.warning(
+                    "failed to submit actor creation to node %s; failing over",
+                    target[:8],
+                )
+        return False
 
-    def _pick_node_for(self, spec: TaskSpec) -> str | None:
+    def _pick_node_for(self, spec: TaskSpec, exclude: set | None = None) -> str | None:
         # Least-loaded feasible node.
         best, best_score = None, None
         for node_id, node in self.nodes.items():
             if node["state"] != "ALIVE":
+                continue
+            if exclude and node_id in exclude:
                 continue
             total = node["resources_total"]
             if any(total.get(k, 0) < v for k, v in spec.resources.items()):

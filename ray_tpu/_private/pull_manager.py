@@ -40,9 +40,11 @@ from ray_tpu._private.transfer_stats import TRANSFER
 logger = logging.getLogger(__name__)
 
 # Per-attempt ceiling on one chunk RPC: long enough for a multi-MiB chunk on
-# a congested link, short enough that a hung source demotes before the
-# caller's patience runs out.
-_CHUNK_TIMEOUT_S = 30.0
+# a congested link, short enough that a hung source — or a silently lost
+# chunk request/reply — costs one bounded stall before the chunk fails over
+# to the next healthy replica (the 30s it used to be meant one lost frame
+# ate most of a caller's pull budget before failover even started).
+_CHUNK_TIMEOUT_S = 10.0
 
 # A demotion stamp this old no longer counts against a source: one transient
 # error during startup congestion must not derank (or, with more replicas

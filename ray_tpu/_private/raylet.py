@@ -44,6 +44,7 @@ from ray_tpu._private.rpc import (
     RawResult,
     RpcClient,
     RpcServer,
+    addr_key,
     schema,
 )
 from ray_tpu._private.transfer_stats import TRANSFER
@@ -119,8 +120,18 @@ class Raylet:
         labels: dict | None = None,
         node_ip: str = "127.0.0.1",
         object_store_memory: int | None = None,
+        exit_on_dead: bool = False,
     ):
         self.cfg = get_config()
+        # When the GCS declares this node dead (a partition outlived the
+        # death timeout, say): standalone raylet processes fail fast and
+        # exit (the reference's suicide-on-dead, main() passes True); an
+        # IN-PROCESS raylet must instead REJOIN — os._exit here would kill
+        # the host process, driver and sibling nodes included.
+        self._exit_on_dead = exit_on_dead
+        from ray_tpu._private import chaos
+
+        chaos.maybe_install_from_env()
         self.node_id = NodeID.from_random().hex()
         self.session_dir = session_dir
         self.node_ip = node_ip
@@ -201,8 +212,15 @@ class Raylet:
         self.server.set_raw_handler(self._on_raw_frame)
         self.server.start(node_ip, 0)
         self.address = self.server.address
+        # Chaos endpoint identity: this node's address key, stamped on the
+        # server and on every client this raylet owns, so a membrane
+        # partition can sever the NODE's links while its node-local ones
+        # (raylet <-> own workers) stay up.
+        self._addr_key = addr_key(self.address)
+        self.server.chaos_scope = self._addr_key
 
         self.gcs = RpcClient(tuple(gcs_address) if isinstance(gcs_address, (list, tuple)) else gcs_address, label="gcs")
+        self.gcs.chaos_scope = self._addr_key
         self._io = EventLoopThread.get()
         self._io.run(self._register())
         self._hb_task = self._io.spawn(self._heartbeat_loop())
@@ -275,21 +293,26 @@ class Raylet:
                     },
                 )
                 if resp.get("dead"):
-                    logger.error("raylet %s: GCS declared us dead; exiting", self.node_id[:8])
-                    os._exit(1)
+                    if self._exit_on_dead:
+                        logger.error("raylet %s: GCS declared us dead; exiting", self.node_id[:8])
+                        os._exit(1)
+                    # In-process node (tests, partition chaos): the GCS
+                    # outlived a partition/stall and wrote us off. Rejoin:
+                    # re-register under the same node id and republish our
+                    # sealed objects (the GCS dropped our location rows at
+                    # death). Actors the GCS declared dead STAY dead — the
+                    # reference's node-death semantics — but the node's
+                    # capacity and store contents come back.
+                    logger.warning(
+                        "raylet %s: GCS declared us dead; rejoining", self.node_id[:8]
+                    )
+                    await self._rejoin()
+                    continue
                 if resp.get("unknown"):
                     # GCS restarted and lost its node table: re-register and
                     # republish our sealed objects' locations.
                     logger.warning("raylet %s: GCS restarted; re-registering", self.node_id[:8])
-                    await self._register()
-                    for oid in self.store.object_ids():
-                        try:
-                            await self.gcs.acall(
-                                "add_object_location",
-                                {"object_id": oid, "node_id": self.node_id},
-                            )
-                        except Exception:
-                            pass
+                    await self._rejoin()
                     continue
                 self.cluster_view = resp.get("nodes", {})
                 # Mirror peers into the scheduler core (never self — the
@@ -314,6 +337,19 @@ class Raylet:
             except Exception:
                 pass
             await asyncio.sleep(self.cfg.heartbeat_interval_s)
+
+    async def _rejoin(self):
+        """Re-register with the GCS (restart recovery and post-partition
+        rejoin share this) and republish every sealed object's location."""
+        await self._register()
+        for oid in self.store.object_ids():
+            try:
+                await self.gcs.acall(
+                    "add_object_location",
+                    {"object_id": oid, "node_id": self.node_id},
+                )
+            except Exception:
+                pass
 
     def _pending_load(self) -> list:
         """Aggregate queued task resource shapes for the autoscaler. Parked
@@ -820,6 +856,7 @@ class Raylet:
         client = self._peer_clients.get(node_id)
         if client is None:
             client = RpcClient(tuple(address), label=f"peer-{node_id[:8]}")
+            client.chaos_scope = self._addr_key
             self._peer_clients[node_id] = client
         return client
 
@@ -1555,6 +1592,7 @@ class Raylet:
             self.workers[worker_id] = handle
         handle.address = tuple(req["address"])
         handle.client = RpcClient(handle.address, label=f"worker-{worker_id[:8]}")
+        handle.client.chaos_scope = self._addr_key
         handle.state = "idle"
         handle.last_idle = time.monotonic()
         await self._dispatch()
@@ -1661,6 +1699,7 @@ class Raylet:
             if spec.owner_addr:
                 try:
                     owner = RpcClient(tuple(spec.owner_addr), label="lease-owner")
+                    owner.chaos_scope = self._addr_key
                     await owner.acall(
                         "lease_revoked",
                         {"lease_id": spec.lease_id, "oom": bool(oom), "reason": reason},
@@ -1675,6 +1714,7 @@ class Raylet:
                 owner = None
                 try:
                     owner = RpcClient(tuple(spec.owner_addr), label="owner")
+                    owner.chaos_scope = self._addr_key
                     # Per-attempt timeout, retries KEPT (acall retries
                     # TimeoutError/ConnectionLost): losing this notification
                     # hangs the owner's wait() forever, so transient owner
@@ -1715,6 +1755,39 @@ class Raylet:
     # ------------------------------------------------------------------
     # Introspection
     # ------------------------------------------------------------------
+
+    @schema(plan=[dict], seed=[int], broadcast=[bool])
+    async def rpc_chaos_set_plan(self, req):
+        """Install (plan=null clears) this process's chaos fault plan at
+        runtime — tests flip faults mid-workload without restarting
+        anything. ``broadcast`` fans the same plan out to every registered
+        worker on this node (best-effort: a worker that cannot be reached
+        is reported, not fatal). NOTE: in-process clusters share one
+        process, so setting a plan \"on a raylet\" sets it for every
+        component hosted by that process — the per-process granularity is
+        real only across OS processes (workers, process-mode clusters)."""
+        from ray_tpu._private import chaos
+
+        plan = req.get("plan")
+        seed = req.get("seed")
+        if plan is None:
+            chaos.clear()
+        else:
+            chaos.install(plan, seed=seed)
+        reached = failed = 0
+        if req.get("broadcast"):
+            for w in list(self.workers.values()):
+                if w.client is None or w.state in ("starting", "dead"):
+                    continue
+                try:
+                    await w.client.acall(
+                        "chaos_set_plan", {"plan": plan, "seed": seed},
+                        timeout=5, retries=0,
+                    )
+                    reached += 1
+                except Exception:
+                    failed += 1
+        return {"ok": True, "workers_reached": reached, "workers_failed": failed}
 
     async def rpc_debug_dump(self, req):
         """Node-wide flight-recorder dump: every ring in this session's
@@ -1787,6 +1860,10 @@ def main():
         resources=json.loads(args.resources) or None,
         labels=json.loads(args.labels),
         object_store_memory=args.object_store_memory or None,
+        # Standalone process: suicide when the GCS writes us off, so the
+        # operator/autoscaler replaces the node (the reference's raylet
+        # behavior). In-process raylets rejoin instead — see __init__.
+        exit_on_dead=True,
     )
     # Standalone raylet: no CoreWorker will ever exist in this process, so
     # point the metrics flusher at our own GCS client (in-process heads use

@@ -30,6 +30,8 @@ from __future__ import annotations
 import asyncio
 import collections
 import logging
+import os
+import random
 import struct
 import threading
 import time
@@ -42,6 +44,21 @@ from ray_tpu._private import flight_recorder
 from ray_tpu._private.concurrency import any_thread, blocking, loop_only
 
 logger = logging.getLogger(__name__)
+
+# Chaos injection seam (chaos.py). None in production: the entire cost of
+# the disabled fault plane is this one is-None check per frame. chaos.py
+# swaps the plan in/out; it imports this module, never the reverse.
+_CHAOS = None
+
+
+def addr_key(address) -> str:
+    """Canonical endpoint string for chaos partition matching: unix socket
+    path, or host:port."""
+    if address is None:
+        return ""
+    if isinstance(address, str):
+        return address
+    return f"{address[0]}:{address[1]}"
 
 REQUEST, RESPONSE, ERROR, PUSH = 0, 1, 2, 3
 
@@ -260,6 +277,69 @@ async def _frame_stream(reader: asyncio.StreamReader):
         buf += chunk
 
 
+@loop_only
+def _apply_send_action(act, writer, parts, label: str) -> bool:
+    """Apply a chaos injection decision to one outbound frame (``parts`` =
+    the frame's byte buffers in wire order). Returns False when the frame
+    was dropped, True when bytes (possibly doctored) hit the transport.
+    Raises ConnectionLost for partition (the link is severed; the live
+    socket is torn so the peer's half dies too)."""
+    kind = act.kind
+    if kind == "drop":
+        return False
+    if kind == "partition":
+        try:
+            writer.close()
+        except Exception:
+            pass
+        raise ConnectionLost(f"chaos: partition blocks send to {label}")
+    if kind == "dup":
+        for p in parts:
+            writer.write(p)
+        for p in parts:
+            writer.write(bytes(p))  # the transport may already own the view
+        return True
+    if kind == "reset":
+        data = b"".join(bytes(p) for p in parts)
+        writer.write(data[: max(0, act.reset_at)])
+        try:
+            writer.close()
+        except Exception:
+            pass
+        return True
+    # delay: write the full frame later; delaying one frame past its
+    # successors IS reordering. The connection may die in the window.
+    data = b"".join(bytes(p) for p in parts)
+
+    def _late_write(w=writer, d=data):
+        try:
+            if not w.is_closing():
+                w.write(d)
+        except Exception:
+            pass
+
+    asyncio.get_event_loop().call_later(act.delay_s, _late_write)
+    return True
+
+
+# Seeded jitter source for acall retry backoff: RAY_TPU_CHAOS_SEED makes
+# the schedule reproducible under a chaos run; otherwise per-process random.
+_BACKOFF_RNG = random.Random(
+    int(os.environ.get("RAY_TPU_CHAOS_SEED", "0") or 0) ^ 0x5EEDBACC
+    if os.environ.get("RAY_TPU_CHAOS_SEED")
+    else None
+)
+
+
+def retry_backoff_s(attempt: int, base_s: float, max_s: float, rng=None) -> float:
+    """Capped exponential backoff with jitter for acall retries: attempt 1
+    waits ~base, doubling per attempt up to max, each scaled by a uniform
+    [0.5, 1.0) jitter factor so a fleet of retriers against one recovering
+    peer decorrelates instead of hammering in lockstep."""
+    r = (rng or _BACKOFF_RNG).random()
+    return min(max_s, base_s * (1 << max(0, attempt - 1))) * (0.5 + 0.5 * r)
+
+
 def _drain_if_needed(writer: asyncio.StreamWriter):
     """Awaitable-or-None: drain only under real backpressure."""
     try:
@@ -337,6 +417,9 @@ class RpcServer:
         self._server: asyncio.Server | None = None
         self._conns: set[asyncio.StreamWriter] = set()
         self.address: tuple[str, int] | str | None = None
+        # Chaos endpoint identity for response-side rule matching (the
+        # raylet stamps its own address key here after start()).
+        self.chaos_scope: str | None = None
         self._io = EventLoopThread.get()
         # Raw-frame sink: a SYNCHRONOUS callable (frame: RawFrame) -> dict,
         # invoked inline on the connection loop before the read buffer moves
@@ -377,7 +460,10 @@ class RpcServer:
                             result = handler(frame)
                     except Exception as e:  # noqa: BLE001
                         result = {"ok": False, "error": repr(e)}
-                    writer.write(_pack([RESPONSE, frame.seq, "raw_chunk", result]))
+                    self._send_resp(
+                        writer, "raw_chunk",
+                        [_pack([RESPONSE, frame.seq, "raw_chunk", result])],
+                    )
                     pending = _drain_if_needed(writer)
                     if pending is not None:
                         await pending
@@ -397,6 +483,24 @@ class RpcServer:
                 writer.close()
             except Exception:
                 pass
+
+    @loop_only
+    def _send_resp(self, writer, method: str, parts) -> bool:
+        """Response write seam: every server->client frame funnels here so
+        the chaos plane can doctor it. Disabled cost: one is-None check.
+        Partition actions never apply to responses (partitions are enforced
+        at clients/connects, which tears the shared socket anyway)."""
+        ch = _CHAOS
+        if ch is not None:
+            peer = writer.get_extra_info("peername")
+            act = ch.on_send(
+                self.chaos_scope, self.name, addr_key(peer), method, side="resp"
+            )
+            if act is not None and act.kind != "partition":
+                return _apply_send_action(act, writer, parts, self.name)
+        for p in parts:
+            writer.write(p)
+        return True
 
     async def _dispatch(self, writer, seq, method, payload):
         start = time.monotonic()
@@ -418,12 +522,16 @@ class RpcServer:
                 try:
                     if writer is not None:
                         oid_b = result.oid.encode()
-                        writer.write(
-                            _pack_raw_header(
-                                RAW_RESP, seq, oid_b, result.start, len(result.payload)
-                            )
+                        self._send_resp(
+                            writer, method,
+                            [
+                                _pack_raw_header(
+                                    RAW_RESP, seq, oid_b, result.start,
+                                    len(result.payload),
+                                ),
+                                result.payload,
+                            ],
                         )
-                        writer.write(result.payload)
                         WIRE.frames_out += 1
                         WIRE.bytes_out += (
                             4 + _RAW_HDR.size + len(oid_b) + len(result.payload)
@@ -435,7 +543,7 @@ class RpcServer:
                     if result.on_sent is not None:
                         result.on_sent()
             elif writer is not None:
-                writer.write(_pack([RESPONSE, seq, method, result]))
+                self._send_resp(writer, method, [_pack([RESPONSE, seq, method, result])])
                 pending = _drain_if_needed(writer)
                 if pending is not None:
                     await pending
@@ -498,10 +606,16 @@ class RpcClient:
         cfg = get_config()
         self.address = address
         self.label = label or str(address)
+        # Chaos identity: the canonical target endpoint, plus an optional
+        # local-endpoint scope (a raylet stamps its own address on clients
+        # it owns so "this node's outbound traffic" is partitionable).
+        self._addr_key = addr_key(address)
+        self.chaos_scope: str | None = None
         self._io = EventLoopThread.get()
         self._connect_timeout = connect_timeout or cfg.rpc_connect_timeout_s
         self._retries = cfg.rpc_retries
-        self._retry_delay = cfg.rpc_retry_delay_s
+        self._backoff_base_s = cfg.rpc_retry_backoff_base_ms / 1000.0
+        self._backoff_max_s = cfg.rpc_retry_backoff_max_ms / 1000.0
         self._lock = asyncio.Lock()
         self._writer: asyncio.StreamWriter | None = None
         self._reader_task: asyncio.Task | None = None
@@ -517,6 +631,17 @@ class RpcClient:
     # ---- connection management (runs on IO loop) ----
 
     async def _ensure_connected(self):
+        ch = _CHAOS
+        if ch is not None and ch.check_connect(self.chaos_scope, self.label, self._addr_key):
+            # Partitioned: fail the connect fast (the peer is unroutable NOW)
+            # and tear any live socket so the peer's half dies with it.
+            if self._writer is not None:
+                try:
+                    self._writer.close()
+                except Exception:
+                    pass
+                self._writer = None
+            raise ConnectionLost(f"chaos: partition blocks connect to {self.label}")
         if self._writer is not None and not self._writer.is_closing():
             return
         deadline = time.monotonic() + self._connect_timeout
@@ -591,6 +716,22 @@ class RpcClient:
             self._pending.clear()
             self._raw_sinks.clear()
 
+    @loop_only
+    def _send_frames(self, method: str, parts) -> bool:
+        """Client send seam: every outbound frame funnels here. Returns
+        False when the chaos plane dropped the frame (the caller's future
+        then heals by timeout/retry, exactly like real loss); raises
+        ConnectionLost on an injected partition. Disabled cost: one
+        is-None check per frame."""
+        ch = _CHAOS
+        if ch is not None:
+            act = ch.on_send(self.chaos_scope, self.label, self._addr_key, method)
+            if act is not None:
+                return _apply_send_action(act, self._writer, parts, self.label)
+        for p in parts:
+            self._writer.write(p)
+        return True
+
     async def astart_call(
         self, method: str, payload: dict | None = None, raw_sink=None
     ) -> "asyncio.Future":
@@ -615,7 +756,14 @@ class RpcClient:
             self._pending[seq] = fut
             if raw_sink is not None:
                 self._raw_sinks[seq] = raw_sink
-            self._writer.write(_pack([REQUEST, seq, method, payload or {}]))
+            try:
+                self._send_frames(method, [_pack([REQUEST, seq, method, payload or {}])])
+            except ConnectionLost:
+                # Injected partition: unregister the stillborn attempt so a
+                # late frame can never resolve it, then surface the loss.
+                self._pending.pop(seq, None)
+                self._raw_sinks.pop(seq, None)
+                raise
             pending = _drain_if_needed(self._writer)
             if pending is not None:
                 await pending
@@ -634,10 +782,17 @@ class RpcClient:
             self._seq += 1
             seq = self._seq
             fut = asyncio.get_event_loop().create_future()
+            fut._rtpu_seq = seq
             self._pending[seq] = fut
             oid_b = oid.encode()
-            self._writer.write(_pack_raw_header(kind, seq, oid_b, start, len(payload)))
-            self._writer.write(payload)
+            try:
+                self._send_frames(
+                    "raw_chunk",
+                    [_pack_raw_header(kind, seq, oid_b, start, len(payload)), payload],
+                )
+            except ConnectionLost:
+                self._pending.pop(seq, None)
+                raise
             WIRE.frames_out += 1
             WIRE.bytes_out += 4 + _RAW_HDR.size + len(oid_b) + len(payload)
             pending = _drain_if_needed(self._writer)
@@ -676,8 +831,15 @@ class RpcClient:
         self._seq += 1
         seq = self._seq
         fut = asyncio.get_event_loop().create_future()
+        fut._rtpu_seq = seq  # lets ack-timeout callers unregister the entry
         self._pending[seq] = fut
-        self._writer.write(_pack([REQUEST, seq, method, payload or {}]))
+        try:
+            self._send_frames(method, [_pack([REQUEST, seq, method, payload or {}])])
+        except ConnectionLost:
+            # Injected partition: behave like the cold-connection case —
+            # the caller falls back to acall, which raises/retries cleanly.
+            self._pending.pop(seq, None)
+            return None
         return fut
 
     async def acall(
@@ -720,13 +882,19 @@ class RpcClient:
                 attempt += 1
                 if self._closed or attempt > max_retries:
                     raise
-                await asyncio.sleep(self._retry_delay * attempt)
+                # Capped exponential backoff with seeded jitter: a
+                # partitioned/recovering peer is probed at a decaying rate
+                # instead of hammered at the fixed-pause full rate
+                # (retries=0 callers never reach this sleep).
+                await asyncio.sleep(
+                    retry_backoff_s(attempt, self._backoff_base_s, self._backoff_max_s)
+                )
 
     async def apush(self, method: str, payload: dict | None = None):
         async with self._lock:
             await self._ensure_connected()
             self._seq += 1
-            self._writer.write(_pack([PUSH, self._seq, method, payload or {}]))
+            self._send_frames(method, [_pack([PUSH, self._seq, method, payload or {}])])
             pending = _drain_if_needed(self._writer)
             if pending is not None:
                 await pending

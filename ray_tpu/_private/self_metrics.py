@@ -280,8 +280,16 @@ def instruments() -> dict:
             "actor_restarts": m.Counter(
                 "ray_tpu_actor_restarts_total", "Actor restarts driven by the GCS."
             ),
+            # --- chaos fault-injection plane (chaos.py) ---
+            "chaos_injected": m.Counter(
+                "ray_tpu_chaos_injected_total",
+                "Faults injected at the RPC frame seam by the active chaos "
+                "plan, by kind (zero in production: no plan installed).",
+                tag_keys=("kind",),
+            ),
         }
         m.register_collector(_collect_wire_stats)
+        m.register_collector(_collect_chaos_stats)
         m.register_collector(_collect_serve_llm_stats)
         m.register_collector(_collect_transfer_stats)
         m.register_collector(_collect_lease_stats)
@@ -326,6 +334,21 @@ def _collect_wire_stats():
         ("connects", inst["rpc_connects"], None),
         ("resets", inst["rpc_resets"], None),
         ("hwm_stalls", inst["rpc_hwm_stalls"], None),
+    ])
+
+
+def _collect_chaos_stats():
+    from ray_tpu._private.chaos import CHAOS_STATS
+
+    inst = _instruments
+    if inst is None:
+        return
+    _fold("chaos", CHAOS_STATS, [
+        ("drops", inst["chaos_injected"], {"kind": "drop"}),
+        ("delays", inst["chaos_injected"], {"kind": "delay"}),
+        ("dups", inst["chaos_injected"], {"kind": "dup"}),
+        ("resets", inst["chaos_injected"], {"kind": "reset"}),
+        ("partition_blocks", inst["chaos_injected"], {"kind": "partition"}),
     ])
 
 

@@ -112,6 +112,7 @@ class WorkerExecutor:
         server = core_worker.server
         server.register("push_task", self.rpc_push_task)
         server.register("actor_call", self.rpc_actor_call)
+        server.register("actor_has_task", self.rpc_actor_has_task)
         server.register("kill_self", self.rpc_kill_self)
         server.register("lease_exec", self.rpc_lease_exec)
         server.register("lease_ping", self.rpc_lease_ping)
@@ -136,6 +137,17 @@ class WorkerExecutor:
         # the caller IMMEDIATELY instead of waiting behind the running task.
         # Entries pop at execution start (exec thread; GIL-atomic dict ops).
         self._fast_queued: dict = {}
+        # Actor-call at-least-once state: received task ids (duplicate
+        # frames must NOT re-execute the method) and a bounded cache of
+        # recent results (re-answers a duplicate/probe after the original
+        # response frame was lost). See rpc_actor_call/rpc_actor_has_task.
+        from collections import deque
+
+        from ray_tpu._private.ids import BoundedIdSet
+
+        self._actor_call_seen = BoundedIdSet(cap=4096)
+        self._actor_results: dict = {}
+        self._actor_results_order: deque = deque()
 
     def _safe_execute(self, spec):
         """execute_task catches everything inside its own try; anything that
@@ -219,10 +231,27 @@ class WorkerExecutor:
                 )
                 fin.add_done_callback(lambda t: t.cancelled() or t.exception())
                 try:
-                    await sent
+                    # Bounded ack wait: a task_done frame lost WITHOUT a
+                    # connection reset (receiver dropped it, chaos drop)
+                    # used to park this await forever and the owner's get()
+                    # with it until the lost-task sweep. On timeout the
+                    # stale pending entry is unregistered and the payload
+                    # re-delivers through the acked retrying path (the
+                    # owner drops the duplicate by cid).
+                    await asyncio.wait_for(
+                        sent, self.cw.cfg.task_done_ack_timeout_s
+                    )
                 except Exception:
-                    # Connection failed before the ack: re-deliver through
-                    # the retrying path (owner drops a duplicate by cid).
+                    # Connection failed or the ack never came: re-deliver
+                    # through the retrying path (owner dedupes by cid).
+                    seq = getattr(sent, "_rtpu_seq", None)
+                    if seq is not None and spec.owner_addr is not None:
+                        try:
+                            self.cw._owner_client(
+                                tuple(spec.owner_addr)
+                            )._pending.pop(seq, None)
+                        except Exception:
+                            pass
                     await self._report_to_owner(spec, payload)
 
     async def _report_to_owner(self, spec, payload):
@@ -230,7 +259,12 @@ class WorkerExecutor:
             return
         try:
             owner = self.cw._owner_client(tuple(spec.owner_addr))
-            await owner.acall("task_done", payload)
+            # Per-attempt ack bound so a silently lost frame retries (acall
+            # re-sends on TimeoutError; the owner dedupes by cid) instead
+            # of parking this coroutine on an unresolvable future.
+            await owner.acall(
+                "task_done", payload, timeout=self.cw.cfg.task_done_ack_timeout_s
+            )
         except Exception:
             logger.warning("could not report task %s to owner", spec.task_id[:8])
 
@@ -370,6 +404,27 @@ class WorkerExecutor:
                         self._lease_done_buffered(oa, p)
 
                 fut.add_done_callback(_delivered)
+
+                # Ack watchdog: a tasks_done frame lost WITHOUT a reset
+                # (silent receiver drop, chaos drop) resolves this future
+                # never — the owner's get() used to hang forever because
+                # its lease probe pings THIS worker, which is alive.
+                # Cancelling routes into _delivered -> the acked retrying
+                # path (owner dedupes by cid).
+                def _ack_timeout(f=fut, oa=owner_addr):
+                    if f.done():
+                        return
+                    seq = getattr(f, "_rtpu_seq", None)
+                    if seq is not None:
+                        try:
+                            self.cw._owner_client(oa)._pending.pop(seq, None)
+                        except Exception:
+                            pass
+                    f.cancel()
+
+                self._loop.call_later(
+                    self.cw.cfg.task_done_ack_timeout_s, _ack_timeout
+                )
                 return
         self._lease_done_buffered(owner_addr, payload)
 
@@ -398,11 +453,20 @@ class WorkerExecutor:
                     try:
                         owner = self.cw._owner_client(owner_addr)
                         batch = {"batch": payloads}
+                        ack = self.cw.cfg.task_done_ack_timeout_s
                         fut = owner.send_nowait("tasks_done", batch)
                         if fut is not None:
-                            await fut
+                            # Bounded ack wait (silent-loss heal; the
+                            # timeout path re-queues, owner dedupes by cid).
+                            try:
+                                await asyncio.wait_for(fut, ack)
+                            except asyncio.TimeoutError:
+                                seq = getattr(fut, "_rtpu_seq", None)
+                                if seq is not None:
+                                    owner._pending.pop(seq, None)
+                                raise
                         else:
-                            await owner.acall("tasks_done", batch)
+                            await owner.acall("tasks_done", batch, timeout=ack)
                     except Exception:
                         logger.warning(
                             "lease result delivery to %s failed (%d results)",
@@ -434,13 +498,27 @@ class WorkerExecutor:
         from ray_tpu._private.task_spec import TaskSpec
 
         spec = TaskSpec.from_wire(req["spec"])
+        # At-least-once dedupe: the owner resends an actor_call whose frame
+        # it believes lost (probe-and-resend in _drive_actor_call), and the
+        # wire itself can duplicate under chaos. Without this tombstone a
+        # duplicated frame EXECUTED THE METHOD TWICE — user-visible state
+        # mutated twice. The duplicate is answered from the result cache
+        # when the first execution already finished, else with a dup marker
+        # (the live execution's response rides the original request).
+        tid = spec.task_id
+        if tid in self._actor_call_seen:
+            cached = self._actor_results.get(tid)
+            if cached is not None:
+                return cached
+            return {"dup": True, "task_id": tid}
+        self._actor_call_seen.add(tid)
         if spec.hop_ts:
             spec.hop_ts["worker_recv"] = time.monotonic()
         loop = asyncio.get_event_loop()
         if self._concurrency_pool is not None:
             # Threaded actor: concurrent execution, no ordering guarantee
             # (reference: concurrency groups / max_concurrency > 1).
-            return self._stamp_reply(await loop.run_in_executor(
+            return self._finish_actor_call(tid, await loop.run_in_executor(
                 self._concurrency_pool, self._safe_execute, spec
             ))
         ex = self.cw._executor
@@ -459,18 +537,35 @@ class WorkerExecutor:
                 _loop.call_soon_threadsafe(_set_result_if_pending, _fut, payload)
 
             ex.submit_callback(self._fast_execute, (spec,), deliver)
-            return self._stamp_reply(await fut)
+            return self._finish_actor_call(tid, await fut)
         # Fallback executors are single-worker ThreadPoolExecutors:
         # submission order is execution order.
-        return self._stamp_reply(
-            await loop.run_in_executor(self.cw._executor, self._safe_execute, spec)
+        return self._finish_actor_call(
+            tid,
+            await loop.run_in_executor(self.cw._executor, self._safe_execute, spec),
         )
 
-    @staticmethod
-    def _stamp_reply(payload):
-        """Hop stamp as the actor-call response leaves for the wire."""
+    async def rpc_actor_has_task(self, req):
+        """Owner-side loss probe (see _drive_actor_call): has this worker
+        ever RECEIVED the call, and if finished, what was its result? The
+        probe rides the same FIFO connection as the call itself, so 'never
+        received' is proof the frame was lost, not merely late."""
+        tid = req["task_id"]
+        cached = self._actor_results.get(tid)
+        return {
+            "has": tid in self._actor_call_seen,
+            "result": cached,
+        }
+
+    def _finish_actor_call(self, tid: str, payload):
+        """Hop stamp + result cache (answers duplicate/probe re-delivery
+        after a lost response frame; bounded FIFO)."""
         if payload.get("hop") is not None:
             payload["hop"]["reply"] = time.monotonic()
+        self._actor_results[tid] = payload
+        self._actor_results_order.append(tid)
+        while len(self._actor_results_order) > 512:
+            self._actor_results.pop(self._actor_results_order.popleft(), None)
         return payload
 
     # ---- channel-loop mode (compiled graphs; experimental/channel/) ----

@@ -24,6 +24,8 @@ class Cluster:
         self.session_dir = os.path.join("/tmp/ray_tpu", f"cluster_{os.getpid()}_{int(time.time())}")
         self.nodes: list[Raylet] = []
         self._connected = False
+        # node_id -> (membrane id, workers severed) for partition_node.
+        self._partitions: dict[str, tuple] = {}
 
     @property
     def gcs_address(self):
@@ -76,6 +78,81 @@ class Cluster:
         self.nodes.remove(raylet)
         raylet.stop()
 
+    def partition_node(self, raylet: Raylet, include_workers: bool = True):
+        """In-process NETWORK TEAR: sever `raylet` from the rest of the
+        cluster WITHOUT killing it (ROADMAP item 5's missing chaos lever —
+        remove_node models death, this models a switch losing a port).
+
+        Built on the chaos plane's membrane partition (chaos.py): the
+        membrane's inside set is the node's endpoints (raylet + its
+        registered workers), and any link crossing it fails with
+        ConnectionLost — while node-LOCAL links (raylet <-> its own
+        workers) stay up, like a real rack partition. Worker processes get
+        their own membrane plan pushed first (they are separate OS
+        processes; a plan here cannot see their sockets), with
+        local_inside=True since they sit inside the membrane.
+
+        Heal with heal_node() and the node rejoins: heartbeats resume, and
+        if the partition outlived node_death_timeout_s the raylet
+        re-registers + republishes its object locations (actors the GCS
+        declared dead stay dead, per node-death semantics)."""
+        from ray_tpu._private import chaos, rpc
+        from ray_tpu._private.rpc import EventLoopThread
+
+        inside = [rpc.addr_key(raylet.address)]
+        workers = [
+            w for w in raylet.workers.values()
+            if w.address is not None and w.client is not None
+            and w.state not in ("starting", "dead")
+        ]
+        inside += [rpc.addr_key(w.address) for w in workers]
+        worker_plan = {
+            "rules": [{"kind": "partition", "inside": inside, "local_inside": True}]
+        }
+        if include_workers:
+            # Push the workers' plans BEFORE severing the driver side —
+            # afterwards they are unreachable by construction.
+            io = EventLoopThread.get()
+            for w in workers:
+                try:
+                    io.run(
+                        w.client.acall(
+                            "chaos_set_plan", {"plan": worker_plan},
+                            timeout=5, retries=0,
+                        ),
+                        timeout=6,
+                    )
+                except Exception:
+                    pass  # a wedged worker is already chaos
+        plan = chaos.ensure_plan()
+        mid = plan.add_membrane(inside, local_inside=False)
+        self._partitions[raylet.node_id] = (mid, workers)
+        return mid
+
+    def heal_node(self, raylet: Raylet):
+        """Reverse partition_node: drop the membrane and clear the node's
+        worker plans (reachable again). The raylet rejoins on its next
+        heartbeat (or re-registers if it was declared dead meanwhile)."""
+        from ray_tpu._private import chaos
+        from ray_tpu._private.rpc import EventLoopThread
+
+        entry = self._partitions.pop(raylet.node_id, None)
+        if entry is None:
+            return
+        mid, workers = entry
+        plan = chaos.active()
+        if plan is not None:
+            plan.remove_membrane(mid)
+        io = EventLoopThread.get()
+        for w in workers:
+            try:
+                io.run(
+                    w.client.acall("chaos_set_plan", {"plan": None}, timeout=5, retries=0),
+                    timeout=6,
+                )
+            except Exception:
+                pass
+
     def wait_for_nodes(self, timeout: float = 10.0):
         deadline = time.monotonic() + timeout
         want = len(self.nodes)
@@ -91,7 +168,13 @@ class Cluster:
         raise TimeoutError("cluster nodes did not come up")
 
     def shutdown(self):
-        from ray_tpu._private import worker_context
+        from ray_tpu._private import chaos, worker_context
+
+        # A lingering fault plan (a test that partitioned and never healed)
+        # must not outlive its cluster into the next test's traffic.
+        if self._partitions or chaos.active() is not None:
+            self._partitions.clear()
+            chaos.clear()
 
         if self._connected:
             cw = worker_context.get_core_worker_if_initialized()

@@ -371,9 +371,23 @@ class CompiledDAG:
                     else None
                 )
             if arena and raylet is not None:
-                resp = raylet.call(
-                    "channel_create", {"channel_id": cid, "size": size}, timeout=30
-                )
+                # Short per-attempt ack, more retries: channel_create is
+                # idempotent on the raylet (an existing ring is returned),
+                # so a silently lost reply costs one 5s slice instead of a
+                # 30s stall; transport exhaustion surfaces as the TYPED
+                # channel error naming the node, not a bare TimeoutError.
+                try:
+                    resp = raylet.call(
+                        "channel_create", {"channel_id": cid, "size": size},
+                        timeout=5, retries=6,
+                    )
+                except Exception as e:
+                    from ray_tpu.experimental.channel.channel import ChannelError
+
+                    raise ChannelError(
+                        f"could not allocate channel {label or cid[:8]} on "
+                        f"node {reader_node[:8]}: {type(e).__name__}: {e}"
+                    ) from e
                 offset = resp["offset"]
                 self._allocs.append((raylet, cid))
             else:

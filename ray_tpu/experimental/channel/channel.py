@@ -166,21 +166,35 @@ class _Gate:
     one private lock; methods never block."""
 
     def __init__(self):
+        from ray_tpu._private.ids import BoundedIdSet
+
         self.event = threading.Event()
         self.lock = threading.Lock()
         self.parts: dict[int, dict] = {}  # seq -> {chunk_idx: bytes}
         self.done: dict[int, bytes] = {}  # seq -> assembled envelope bytes
         self.sticky: bytes | None = None  # poison envelope (actor death)
         self.closed = False
+        # Recently-completed seqs: ``channel_data`` chunks are
+        # at-least-once under connection blips (and chaos dup injection);
+        # a duplicate arriving after its envelope completed — or after
+        # pop() consumed it — used to re-open a forever-partial
+        # reassembly, leaking memory AND inflating queued(), which is the
+        # remote-mode writer's backpressure credit: enough duplicates and
+        # the producer throttles on phantom queue depth. Tombstoned seqs
+        # drop silently instead.
+        self._completed = BoundedIdSet(cap=512)
 
     @any_thread
     def add_chunk(self, seq: int, idx: int, total: int, data: bytes):
         with self.lock:
+            if seq in self._completed or seq in self.done:
+                return  # duplicate of an already-assembled envelope
             parts = self.parts.setdefault(seq, {})
             parts[idx] = data
             if len(parts) == total:
                 self.parts.pop(seq)
                 self.done[seq] = b"".join(parts[i] for i in range(total))
+                self._completed.add(seq)
         self.event.set()
 
     @any_thread

@@ -28,7 +28,11 @@ from ray_tpu.exceptions import DeviceObjectLostError, GetTimeoutError
 
 logger = logging.getLogger(__name__)
 
-_PULL_TIMEOUT_S = 60.0
+# Per-ATTEMPT ceiling on one devobj_pull RPC; with retries the unbounded-
+# deadline worst case stays at the old 60s total, but a lost frame now
+# costs one attempt (~15s), not the whole budget. Large enough for the
+# holder to materialize a multi-10s-of-MiB host copy before answering.
+_PULL_ATTEMPT_S = 15.0
 
 
 def _remaining(deadline, cap: float) -> float:
@@ -82,12 +86,19 @@ def resolve_meta(cw, meta, deadline=None):
         tag = f"{oid[:16]}-{os.urandom(4).hex()}"
         req.update({"group": group_name, "dst_rank": my_rank, "tag": tag})
     try:
-        # Short-connect client + single attempt: a dead holder surfaces in
-        # ~2s (ConnectionLost) and falls through to the host-copy fallback /
+        # Short-connect client: a dead holder surfaces in ~2s
+        # (ConnectionLost) and falls through to the host-copy fallback /
         # typed loss instead of grinding the full connect-retry budget.
+        # Per-ATTEMPT timeout is _PULL_ATTEMPT_S, not the whole pull
+        # budget: a silently lost request/reply frame (chaos drop; receiver
+        # hiccup) used to stall the resolve 60s before its one retry —
+        # bounded attempts heal it in ~15s while the deadline still caps
+        # the total (the holder's answer is idempotent, so a retry racing
+        # a slow first answer is harmless).
         client = cw._devobj_client(tuple(meta.holder_addr))
         resp = client.call(
-            "devobj_pull", req, timeout=_remaining(deadline, _PULL_TIMEOUT_S), retries=1
+            "devobj_pull", req,
+            timeout=_remaining(deadline, _PULL_ATTEMPT_S), retries=3,
         )
     except GetTimeoutError:
         raise
@@ -136,8 +147,8 @@ def _host_pull(cw, meta, deadline):
         resp = client.call(
             "devobj_pull",
             {"object_id": oid},
-            timeout=_remaining(deadline, _PULL_TIMEOUT_S),
-            retries=1,
+            timeout=_remaining(deadline, _PULL_ATTEMPT_S),
+            retries=3,
         )
     except GetTimeoutError:
         raise

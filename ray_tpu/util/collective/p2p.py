@@ -109,24 +109,41 @@ class P2PInbox:
     one lock; methods never block — deposit runs on the IO loop."""
 
     def __init__(self):
+        from ray_tpu._private.ids import BoundedIdSet
+
         self._lock = threading.Lock()
         self._parts: dict[str, dict] = {}    # key -> {idx: bytes}
         self._parts_ts: dict[str, float] = {}  # key -> first-chunk monotonic ts
         self._done: dict[str, tuple] = {}    # key -> (bytes, monotonic ts)
         self._waiters: dict[str, threading.Event] = {}
         self._deposits = 0
+        # Recently-COMPLETED keys: delivery of p2p_data frames is
+        # at-least-once under connection blips (and chaos dup injection),
+        # and a duplicate chunk arriving AFTER its payload completed used
+        # to re-open a partial reassembly that could never complete
+        # (leaked until the age sweep) — or, for a single-chunk payload,
+        # resurrect a consumed ``_done`` entry, breaking the at-most-once
+        # take() contract. Tombstoned keys drop silently.
+        self._completed = BoundedIdSet(cap=1024)
 
     @any_thread
     def deposit(self, key: str, idx: int, total: int, data: bytes) -> bool:
-        """Returns True when the payload is COMPLETE (all chunks landed)."""
+        """Returns True when the payload is COMPLETE (all chunks landed).
+        Idempotent under duplicated/reordered chunks: a repeat of a
+        still-assembling chunk overwrites in place, and any chunk of an
+        already-completed key is dropped."""
         complete = False
         with self._lock:
+            if key in self._completed or key in self._done:
+                self._deposits += 1
+                return False  # duplicate of a completed payload
             parts = self._parts.get(key)
             if parts is None:
                 parts = self._parts[key] = {}
                 self._parts_ts[key] = time.monotonic()
             parts[idx] = data
             if len(parts) == total:
+                self._completed.add(key)
                 self._parts.pop(key)
                 self._parts_ts.pop(key, None)
                 self._done[key] = (

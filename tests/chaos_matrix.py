@@ -1,0 +1,458 @@
+"""Chaos matrix harness — N workloads x M seeded fault cells.
+
+The library behind tests/test_chaos_matrix.py (and `microbench.py --chaos`):
+each CELL runs one small workload under one seeded fault plan injected at
+the RPC frame seam (chaos.py) and asserts the availability contract:
+
+(a) the workload COMPLETES, or raises/returns the documented *typed*
+    failure naming the failed component (never a raw 2-minute
+    TimeoutError);
+(b) recovery lands within the cell's wall-clock BUDGET;
+(c) nothing LEAKS: per-node store objects, channel count, and the
+    driver's device-object residents return to their pre-cell baseline
+    (the LLM workload additionally asserts its KV-block free list drains
+    back to full inside the workload itself).
+
+Fault plans are deterministic: counted rules (``after``/``every``/
+``times``) plus the plan's seeded RNG for jitter — same seed over the same
+frame stream, same injection sequence (pinned by
+test_chaos_plane.test_same_seed_same_injection_sequence); each cell's
+actual sequence is returned in the cell result for reproduction.
+
+Workloads (each a few seconds unfaulted):
+  tasks      task retry loop (12 remote tasks, max_retries)
+  actors     actor call fan-out (2 actors x 8 calls)
+  pull       2-replica striped pull onto a third node
+  broadcast  cut-through relay broadcast to 3 nodes
+  devobj     device-object handoff driver -> worker task
+  pipeline   compiled-DAG iterations (shm channels + doorbells)
+  llm        one LLM-engine streaming request (streaming generator task)
+
+Faults: drop, delay, dup, reset, partition (a victim node severed via
+Cluster.partition_node and healed mid-workload by a timer).
+"""
+
+from __future__ import annotations
+
+import gc
+import threading
+import time
+
+import numpy as np
+
+FAULTS = ("drop", "delay", "dup", "reset", "partition")
+WORKLOAD_NAMES = ("tasks", "actors", "pull", "broadcast", "devobj", "pipeline", "llm")
+
+# Methods whose frames each workload's hot path rides (drop/reset target
+# these so the injection provably lands on the workload, not bystander
+# heartbeats). delay/dup cells go wide (method=None) on purpose.
+_METHODS = {
+    "tasks": ["submit_task", "lease_exec", "push_task", "task_done",
+              "tasks_done", "request_worker_lease"],
+    "actors": ["actor_call", "submit_task", "task_done", "tasks_done"],
+    "pull": ["fetch_object_info", "fetch_object_chunk", "raw_chunk"],
+    "broadcast": ["push_begin", "push_chunk", "raw_chunk", "push_commit"],
+    "devobj": ["devobj_pull", "p2p_data", "get_inline", "lease_exec",
+               "tasks_done"],
+    "pipeline": ["channel_doorbell", "channel_data", "actor_call",
+                 "channel_create"],
+    "llm": ["stream_item", "lease_exec", "tasks_done", "push_task"],
+}
+
+# Typed failure contract (a): a cell may surface a RayTpuError subclass
+# that NAMES a component (ActorDiedError names the actor, TaskError the
+# task, DeviceObjectLostError the holder, ...). Timeouts are NOT typed —
+# a raw TimeoutError (or GetTimeoutError, which merely restates the
+# caller's patience) is exactly the 2-minute-silence failure mode the
+# matrix exists to ban.
+def _is_typed(e: BaseException) -> bool:
+    import ray_tpu.exceptions as ex
+
+    return isinstance(e, ex.RayTpuError) and not isinstance(e, TimeoutError)
+
+
+class CellResult:
+    def __init__(self, workload, fault, seed):
+        self.workload = workload
+        self.fault = fault
+        self.seed = seed
+        self.ok = False
+        self.error: str | None = None
+        self.typed = False
+        self.elapsed = 0.0
+        self.injected = 0
+        self.injection_log: list = []
+        self.leaks: dict = {}
+
+    def summary(self) -> dict:
+        return {
+            "cell": f"{self.workload}x{self.fault}",
+            "seed": self.seed, "ok": self.ok, "typed": self.typed,
+            "error": self.error, "elapsed_s": round(self.elapsed, 2),
+            "injected": self.injected, "leaks": self.leaks,
+        }
+
+
+# ---------------------------------------------------------------------------
+# fault plans
+# ---------------------------------------------------------------------------
+
+
+def fault_plan(fault: str, workload: str) -> dict | None:
+    """The seeded plan spec for one cell. Bounded (``times``) so every cell
+    can complete; `partition` returns None — it is driven by
+    partition_node + a heal timer instead of frame rules."""
+    methods = _METHODS[workload]
+    if fault == "drop":
+        return {"rules": [{"kind": "drop", "method": methods, "every": 2, "times": 4}]}
+    if fault == "delay":
+        return {"rules": [{"kind": "delay", "delay_ms": [10, 60], "every": 3, "times": 24}]}
+    if fault == "dup":
+        return {"rules": [{"kind": "dup", "every": 2, "times": 24}]}
+    if fault == "reset":
+        return {"rules": [
+            # Tear one frame mid-header and one mid-payload.
+            {"kind": "reset", "method": methods, "reset_at": 3, "times": 1},
+            {"kind": "reset", "method": methods, "reset_at": 40, "after": 4, "times": 1},
+        ]}
+    if fault == "partition":
+        return None
+    raise ValueError(fault)
+
+
+# ---------------------------------------------------------------------------
+# workloads
+# ---------------------------------------------------------------------------
+
+
+def _wl_tasks(ctx):
+    import ray_tpu
+
+    @ray_tpu.remote(max_retries=4)
+    def double(i):
+        return i * 2
+
+    refs = [double.remote(i) for i in range(12)]
+    out = ray_tpu.get(refs, timeout=ctx["budget_s"])
+    assert out == [i * 2 for i in range(12)], out
+
+
+def _wl_actors(ctx):
+    import ray_tpu
+
+    @ray_tpu.remote(max_restarts=1)
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self, k):
+            self.n += k
+            return self.n
+
+    actors = [Counter.remote() for _ in range(2)]
+    try:
+        refs = [a.bump.remote(1) for a in actors for _ in range(8)]
+        out = ray_tpu.get(refs, timeout=ctx["budget_s"])
+        assert sorted(out) == sorted(list(range(1, 9)) * 2), out
+    finally:
+        for a in actors:
+            ray_tpu.kill(a)
+
+
+def _oid(tag: str) -> str:
+    return tag.encode().hex().ljust(56, "0")[:56]
+
+
+def _seal_raw(io, node, oid, data):
+    offset = io.run(node.store.create(oid, len(data)))
+    assert offset is not None
+    node.arena.write(offset, data)
+    node.store.seal(oid)
+    io.run(node.gcs.acall(
+        "add_object_location", {"object_id": oid, "node_id": node.node_id}
+    ))
+
+
+def _free_all(nodes, oid):
+    for n in nodes:
+        try:
+            n.store.delete(oid)
+        except Exception:
+            pass
+
+
+def _prep_pull(ctx):
+    """Pre-fault setup: seal the object on nodes[0] and replicate it onto
+    nodes[1], so the faulted phase is a clean 2-replica striped pull (and a
+    partition of nodes[1] — a SOURCE — exercises failover, not setup)."""
+    io, nodes = ctx["io"], ctx["nodes"]
+    data = np.random.default_rng(ctx["seed"]).integers(
+        0, 255, 6 * 1024 * 1024, dtype=np.uint8
+    ).tobytes()
+    oid = _oid(f"chaospull{ctx['seed']}")
+    ctx["prep"] = {"oid": oid, "data": data}
+    _seal_raw(io, nodes[0], oid, data)
+    io.run(nodes[1].pull_manager.pull(oid, timeout=60), timeout=60)
+
+
+def _wl_pull(ctx):
+    """Chunked pull with 2 source replicas onto a third node: chunk faults
+    must fail over / retry, never corrupt (bytes compared)."""
+    io, nodes = ctx["io"], ctx["nodes"]
+    oid, data = ctx["prep"]["oid"], ctx["prep"]["data"]
+    budget = ctx["budget_s"] * 0.9
+    try:
+        io.run(nodes[2].pull_manager.pull(oid, timeout=budget), timeout=budget)
+        offset, size = io.run(nodes[2].store.get(oid))
+        try:
+            got = bytes(nodes[2].arena.read(offset, size))
+        finally:
+            nodes[2].store.release(oid)
+        assert got == data, "pulled bytes corrupt"
+    finally:
+        _free_all(nodes, oid)
+
+
+def _wl_broadcast(ctx):
+    """Cut-through relay broadcast to every other node; a not-ok outcome
+    must NAME the failed nodes (the documented typed failure shape)."""
+    io, nodes = ctx["io"], ctx["nodes"]
+    data = np.random.default_rng(ctx["seed"] + 1).integers(
+        0, 255, 5 * 1024 * 1024, dtype=np.uint8
+    ).tobytes()
+    oid = _oid(f"chaosbcast{ctx['seed']}")
+    try:
+        _seal_raw(io, nodes[0], oid, data)
+        resp = io.run(
+            nodes[0].rpc_broadcast_object({
+                "object_id": oid,
+                "targets": [
+                    {"node_id": n.node_id, "address": list(n.address)}
+                    for n in nodes[1:]
+                ],
+                "timeout": ctx["budget_s"] * 0.8,
+            }),
+            timeout=ctx["budget_s"] * 0.9,
+        )
+        if not resp.get("ok"):
+            # Documented failure shape: failed subtree NODES are named.
+            known = {n.node_id for n in nodes}
+            assert resp.get("failed"), resp
+            assert set(resp["failed"]) <= known, resp
+            return
+        for n in nodes[1:]:
+            offset, size = io.run(n.store.get(oid))
+            try:
+                assert bytes(n.arena.read(offset, size)) == data
+            finally:
+                n.store.release(oid)
+    finally:
+        _free_all(nodes, oid)
+
+
+def _wl_devobj(ctx):
+    """Device-object handoff: driver holds a jax.Array, a worker task
+    resolves it through devobj_pull (inline/host fallback on this CPU
+    testbed) — loss must surface as DeviceObjectLostError naming the
+    holder, never hang."""
+    import jax.numpy as jnp
+
+    import ray_tpu
+
+    @ray_tpu.remote(max_retries=2)
+    def consume(arr):
+        return float(np.asarray(arr).sum())
+
+    ref = ray_tpu.put(jnp.ones(512, jnp.float32), tensor_transport="collective")
+    try:
+        out = ray_tpu.get(consume.remote(ref), timeout=ctx["budget_s"])
+        assert out == 512.0, out
+    finally:
+        del ref
+
+
+def _wl_pipeline(ctx):
+    """Compiled-DAG iterations over shm channels: doorbell/side-channel
+    faults must be healed by the poll backstop; teardown must reclaim every
+    channel even after faults."""
+    import ray_tpu
+    from ray_tpu.dag import InputNode
+
+    @ray_tpu.remote
+    class Stage:
+        def work(self, x):
+            return x + 1
+
+    stages = [Stage.bind() for _ in range(2)]
+    compiled = None
+    try:
+        with InputNode() as inp:
+            d = inp
+            for s in stages:
+                d = s.work.bind(d)
+        compiled = d.experimental_compile()
+        for i in range(6):
+            assert compiled.execute(i).get(timeout=ctx["budget_s"] / 3) == i + 2
+    finally:
+        if compiled is not None:
+            compiled.teardown()
+
+
+def _wl_llm(ctx):
+    """One LLM-engine streaming request: tokens stream back over the wire
+    (streaming-generator stream_item frames) while the engine runs in a
+    worker; the KV-block free list must drain back to full."""
+    import ray_tpu
+
+    @ray_tpu.remote(num_returns="streaming", max_retries=2)
+    def llm_stream(n_tokens):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models.transformer import TransformerConfig, init_params
+        from ray_tpu.serve.llm import LLMEngine
+
+        cfg = TransformerConfig(
+            vocab_size=64, d_model=32, n_layers=1, n_heads=2, n_kv_heads=2,
+            d_ff=48, max_seq_len=48, dtype=jnp.float32, remat=False,
+        )
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        eng = LLMEngine(params, cfg, num_slots=1, block_size=4,
+                        max_model_len=32, prefill_chunk=4)
+        try:
+            req = eng.submit([1, 2, 3, 4], max_new_tokens=n_tokens)
+            for tok in req:
+                yield int(tok)
+            s = eng.stats()
+            # KV free-list back to baseline INSIDE the engine process.
+            assert s["free_blocks"] + s.get("cached_blocks", 0) == s["num_blocks"], s
+        finally:
+            eng.shutdown()
+
+    gen = llm_stream.remote(6)
+    toks = [ray_tpu.get(r, timeout=ctx["budget_s"]) for r in gen]
+    assert len(toks) == 6 and all(isinstance(t, int) for t in toks), toks
+
+
+WORKLOADS = {
+    "tasks": _wl_tasks,
+    "actors": _wl_actors,
+    "pull": _wl_pull,
+    "broadcast": _wl_broadcast,
+    "devobj": _wl_devobj,
+    "pipeline": _wl_pipeline,
+    "llm": _wl_llm,
+}
+
+# Pre-fault setup phases (run OUTSIDE the fault window): the faulted phase
+# must exercise the workload's recovery path, not its scaffolding.
+PREPARES = {"pull": _prep_pull}
+
+
+# ---------------------------------------------------------------------------
+# leak checks
+# ---------------------------------------------------------------------------
+
+
+def leak_baseline(ctx) -> dict:
+    from ray_tpu.experimental.device_object.manager import active_manager
+
+    gc.collect()
+    mgr = active_manager()
+    return {
+        "store_objects": [n.store.usage()["num_objects"] for n in ctx["nodes"]],
+        "channels": [n.store.usage()["num_channels"] for n in ctx["nodes"]],
+        "devobj_resident": 0 if mgr is None else mgr.usage()["resident_count"],
+    }
+
+
+def leak_check(ctx, baseline: dict, settle_s: float = 20.0) -> dict:
+    """Wait (frees are async) for every counter to return to baseline;
+    returns {} when clean, else the surviving diffs."""
+    deadline = time.monotonic() + settle_s
+    diff: dict = {}
+    while time.monotonic() < deadline:
+        gc.collect()
+        cur = leak_baseline(ctx)
+        diff = {
+            k: {"before": baseline[k], "after": cur[k]}
+            for k in baseline
+            if cur[k] != baseline[k]
+        }
+        if not diff:
+            return {}
+        time.sleep(0.25)
+    return diff
+
+
+# ---------------------------------------------------------------------------
+# the cell runner
+# ---------------------------------------------------------------------------
+
+
+def run_cell(ctx, workload: str, fault: str, seed: int,
+             budget_s: float = 60.0) -> CellResult:
+    """Run one (workload, fault) cell under its seeded plan. Asserts
+    nothing itself — returns a CellResult the caller asserts on (the test
+    layer and the bench artifact share this)."""
+    from ray_tpu._private import chaos
+    from ray_tpu._private.chaos import CHAOS_STATS
+
+    res = CellResult(workload, fault, seed)
+    ctx = dict(ctx, budget_s=budget_s, seed=seed)
+    baseline = leak_baseline(ctx)
+    prep = PREPARES.get(workload)
+    if prep is not None:
+        prep(ctx)  # pre-fault: the cell measures recovery, not setup
+    injected_before = CHAOS_STATS.injected
+    heal_timer = None
+    plan = None
+    t0 = time.monotonic()
+    try:
+        if fault == "partition":
+            # Sever a victim raylet (never nodes[0]: the driver's head node
+            # going dark is driver death, a different chaos class), heal
+            # mid-workload. The window stays under node_death_timeout_s so
+            # the cell exercises transport recovery; the full
+            # die-and-rejoin path has its own dedicated test.
+            victim = ctx["nodes"][1]
+            ctx["cluster"].partition_node(victim)
+            heal_timer = threading.Timer(
+                ctx.get("partition_s", 1.5),
+                lambda: ctx["cluster"].heal_node(victim),
+            )
+            heal_timer.daemon = True
+            heal_timer.start()
+        else:
+            plan = chaos.install(fault_plan(fault, workload), seed=seed)
+        WORKLOADS[workload](ctx)
+        res.ok = True
+    except Exception as e:  # noqa: BLE001 — the cell judges the class
+        res.error = f"{type(e).__name__}: {e}"
+        res.typed = _is_typed(e)
+    finally:
+        if heal_timer is not None:
+            heal_timer.cancel()
+            ctx["cluster"].heal_node(ctx["nodes"][1])
+        if plan is not None:
+            res.injection_log = list(plan.log)
+        chaos.clear()
+    res.elapsed = time.monotonic() - t0
+    res.injected = CHAOS_STATS.injected - injected_before
+    res.leaks = leak_check(ctx, baseline)
+    return res
+
+
+def assert_cell(res: CellResult, budget_s: float):
+    """Contract (a)+(b)+(c) for one cell."""
+    assert res.ok or res.typed, (
+        f"cell {res.workload}x{res.fault} failed UNTYPED: {res.error} "
+        f"(injections: {res.injection_log})"
+    )
+    assert res.elapsed <= budget_s, (
+        f"cell {res.workload}x{res.fault} blew its recovery budget: "
+        f"{res.elapsed:.1f}s > {budget_s}s"
+    )
+    assert not res.leaks, (
+        f"cell {res.workload}x{res.fault} leaked: {res.leaks}"
+    )

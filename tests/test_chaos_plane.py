@@ -1,0 +1,330 @@
+"""Chaos fault-injection plane (ISSUE 13): seeded deterministic rules, the
+rpc frame-seam injection for every fault kind, partition fail-fast + heal,
+acall retry backoff, and the duplicate-delivery idempotency fixes the plane
+exposed (P2PInbox and channel-gate reassembly).
+
+Everything here is clusterless (loopback RpcServer/RpcClient at most); the
+cluster-level chaos matrix lives in test_chaos_matrix.py.
+"""
+
+import random
+import threading
+import time
+
+import pytest
+
+from ray_tpu._private import chaos
+from ray_tpu._private.chaos import CHAOS_STATS, FaultPlan
+from ray_tpu._private.rpc import (
+    ConnectionLost,
+    RpcClient,
+    RpcServer,
+    retry_backoff_s,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    chaos.clear()
+    yield
+    chaos.clear()
+
+
+@pytest.fixture(scope="module")
+def echo_server():
+    srv = RpcServer("chaos-test")
+    calls = {"n": 0}
+
+    async def echo(req):
+        calls["n"] += 1
+        return {"x": req.get("x"), "n": calls["n"]}
+
+    srv.register("echo", echo)
+    addr = srv.start()
+    cli = RpcClient(addr, label="chaos-cli")
+    yield srv, cli, addr, calls
+    cli.close()
+    srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Rule mechanics: deterministic, seeded
+# ---------------------------------------------------------------------------
+
+
+def _drive(plan, frames):
+    """Feed a synthetic frame stream through the decision point; return the
+    (kind or None) decision sequence."""
+    out = []
+    for method in frames:
+        act = plan.on_send(None, "peer-x", "127.0.0.1:1", method)
+        out.append(None if act is None else act.kind)
+    return out
+
+
+def test_same_seed_same_injection_sequence():
+    """THE determinism contract: identical plan spec + seed over an
+    identical frame stream produce the identical injection sequence (and
+    log), including probabilistic rules — the RNG is the plan's own."""
+    spec = {
+        "rules": [
+            {"kind": "drop", "method": "a", "p": 0.5},
+            {"kind": "delay", "method": "b", "p": 0.7, "delay_ms": [1, 9]},
+            {"kind": "dup", "method": "c", "every": 3},
+        ]
+    }
+    frames = [random.Random(3).choice("abcd") for _ in range(200)]
+    p1, p2 = FaultPlan(spec, seed=42), FaultPlan(spec, seed=42)
+    assert _drive(p1, frames) == _drive(p2, frames)
+    assert list(p1.log) == list(p2.log)
+    # A different seed produces a different schedule for the p-thinned rules.
+    p3 = FaultPlan(spec, seed=43)
+    assert _drive(p3, frames) != _drive(p1, frames)
+
+
+def test_counted_rules_fire_deterministically():
+    plan = FaultPlan(
+        {"rules": [{"kind": "drop", "method": "m", "after": 2, "every": 2, "times": 3}]}
+    )
+    got = _drive(plan, ["m"] * 12)
+    # Matches 1,2 skipped (after=2); then every 2nd of the remainder fires,
+    # capped at 3 fires: matches 4, 6, 8.
+    assert [i for i, k in enumerate(got) if k == "drop"] == [3, 5, 7]
+
+
+def test_rule_matching_filters():
+    plan = FaultPlan({"rules": [{"kind": "drop", "method": ["a", "b"], "peer": "raylet"}]})
+    assert plan.on_send(None, "raylet-1", "x:1", "a") is not None
+    assert plan.on_send(None, "worker-1", "x:1", "a") is None  # peer mismatch
+    assert plan.on_send(None, "raylet-1", "x:1", "zzz") is None  # method mismatch
+    # The chaos control plane is never injected.
+    assert plan.on_send(None, "raylet-1", "x:1", "chaos_set_plan") is None
+
+
+def test_partition_membrane_semantics():
+    """Membrane: only links CROSSING the inside/outside boundary sever —
+    node-local links (inside<->inside) and outside<->outside stay up."""
+    plan = FaultPlan({})
+    plan.add_membrane({"node:1", "w:1"}, local_inside=False)
+    assert plan.blocked(None, "node:1")          # outside -> inside
+    assert plan.blocked("node:1", "gcs:1")       # inside -> outside
+    assert not plan.blocked("node:1", "w:1")     # inside -> inside (node-local)
+    assert not plan.blocked(None, "gcs:1")       # outside -> outside
+    plan.heal_all()
+    assert not plan.blocked(None, "node:1")
+
+
+# ---------------------------------------------------------------------------
+# Frame-seam injection over a real loopback connection
+# ---------------------------------------------------------------------------
+
+
+def test_drop_heals_by_retry(echo_server):
+    _, cli, _, _ = echo_server
+    assert cli.call("echo", {"x": 0}, timeout=5)["x"] == 0  # warm connection
+    plan = chaos.install({"rules": [{"kind": "drop", "method": "echo", "times": 1}]}, seed=1)
+    t0 = time.monotonic()
+    assert cli.call("echo", {"x": 1}, timeout=0.4, retries=2)["x"] == 1
+    assert time.monotonic() - t0 < 3.0
+    assert list(plan.log) == ["drop:echo:chaos-cli"]
+
+
+def test_dup_delivers_twice(echo_server):
+    _, cli, _, calls = echo_server
+    cli.call("echo", {"x": 0}, timeout=5)
+    chaos.install({"rules": [{"kind": "dup", "method": "echo", "times": 1}]})
+    before = calls["n"]
+    cli.call("echo", {"x": 1}, timeout=5)
+    deadline = time.monotonic() + 2
+    while calls["n"] - before < 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    # The duplicated REQUEST frame reaches the handler twice: requests are
+    # at-least-once under this plane, which is exactly what handlers must
+    # tolerate (and what the dedupe fixes below are for).
+    assert calls["n"] - before == 2
+
+
+def test_reset_mid_frame_tears_and_recovers(echo_server):
+    _, cli, _, _ = echo_server
+    cli.call("echo", {"x": 0}, timeout=5)
+    resets_before = CHAOS_STATS.resets
+    chaos.install(
+        {"rules": [{"kind": "reset", "method": "echo", "reset_at": 3, "times": 1}]}
+    )
+    # The torn frame kills the connection; the retry reconnects and lands.
+    assert cli.call("echo", {"x": 7}, timeout=2, retries=3)["x"] == 7
+    assert CHAOS_STATS.resets == resets_before + 1
+
+
+def test_delay_holds_the_frame(echo_server):
+    _, cli, _, _ = echo_server
+    cli.call("echo", {"x": 0}, timeout=5)
+    chaos.install(
+        {"rules": [{"kind": "delay", "method": "echo", "delay_ms": [150, 200], "times": 1}]},
+        seed=5,
+    )
+    t0 = time.monotonic()
+    assert cli.call("echo", {"x": 1}, timeout=5)["x"] == 1
+    assert time.monotonic() - t0 >= 0.14
+
+
+def test_partition_fails_fast_and_heals(echo_server):
+    _, cli, addr, _ = echo_server
+    cli.call("echo", {"x": 0}, timeout=5)
+    key = f"{addr[0]}:{addr[1]}"
+    chaos.partition("*", key)
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionLost):
+        cli.call("echo", {"x": 1}, timeout=2, retries=0)
+    # Fail-fast: an unroutable peer must not burn the 10s connect budget.
+    assert time.monotonic() - t0 < 1.0
+    chaos.heal("*", key)
+    assert cli.call("echo", {"x": 2}, timeout=5)["x"] == 2
+
+
+def test_response_side_injection(echo_server):
+    """side="resp" rules hit the server's response write, not the request:
+    the client sees a timeout while the handler DID run."""
+    srv, cli, _, calls = echo_server
+    cli.call("echo", {"x": 0}, timeout=5)
+    chaos.install(
+        {"rules": [{"kind": "drop", "method": "echo", "side": "resp", "times": 1}]}
+    )
+    before = calls["n"]
+    assert cli.call("echo", {"x": 1}, timeout=0.4, retries=2)["x"] == 1
+    assert calls["n"] - before == 2  # first attempt executed, reply dropped
+
+
+def test_injection_records_event_and_stats(echo_server, tmp_path):
+    from ray_tpu._private import flight_recorder
+
+    _, cli, _, _ = echo_server
+    cli.call("echo", {"x": 0}, timeout=5)
+    flight_recorder.attach(str(tmp_path), role="test", ident="chaos")
+    try:
+        drops_before = CHAOS_STATS.drops
+        chaos.install({"rules": [{"kind": "drop", "method": "echo", "times": 1}]})
+        cli.call("echo", {"x": 1}, timeout=0.4, retries=2)
+        assert CHAOS_STATS.drops == drops_before + 1
+        dump = flight_recorder.dump()
+        evs = [e for e in dump["events"] if e["type"] == "chaos_inject"]
+        assert evs and evs[-1]["detail"].startswith("drop:")
+    finally:
+        flight_recorder._reset_for_tests()
+
+
+def test_chaos_metric_collector_folds():
+    from ray_tpu._private import self_metrics
+
+    inst = self_metrics.instruments()
+    assert "chaos_injected" in inst
+    CHAOS_STATS.drops += 3
+    self_metrics._collect_chaos_stats()
+    # The flush-time collector folded the plain-int counter into the
+    # instrument (delta tracking recorded the new watermark).
+    assert self_metrics._folded[("chaos", "drops")] == CHAOS_STATS.drops
+
+
+# ---------------------------------------------------------------------------
+# acall retry backoff (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_backoff_schedule_is_capped_exponential_with_jitter():
+    rng = random.Random(0)
+    vals = [retry_backoff_s(a, 0.1, 2.0, rng) for a in range(1, 10)]
+    # Each raw delay is base*2^(attempt-1) capped at max, jittered into
+    # [0.5, 1.0) of that; assert the envelope per attempt.
+    for attempt, v in enumerate(vals, start=1):
+        raw = min(2.0, 0.1 * (2 ** (attempt - 1)))
+        assert 0.5 * raw <= v < raw
+    # The cap holds: attempts deep into the schedule never exceed max.
+    assert max(vals) < 2.0
+    # Seeded: replaying from the same rng state reproduces the schedule.
+    rng2 = random.Random(0)
+    assert vals == [retry_backoff_s(a, 0.1, 2.0, rng2) for a in range(1, 10)]
+
+
+def test_retries_zero_unaffected_by_backoff(echo_server):
+    """retries=0 callers raise immediately — no backoff sleep is inserted."""
+    _, cli, _, _ = echo_server
+    cli.call("echo", {"x": 0}, timeout=5)
+    chaos.install({"rules": [{"kind": "drop", "method": "echo"}]})
+    t0 = time.monotonic()
+    with pytest.raises(Exception):
+        cli.call("echo", {"x": 1}, timeout=0.3, retries=0)
+    # One attempt, one timeout, zero backoff sleeps.
+    assert time.monotonic() - t0 < 0.8
+
+
+def test_backoff_paces_retries_against_dead_peer(echo_server):
+    """The retry schedule against a repeatedly-failing peer spaces out:
+    total wall for N retries ~ sum of the capped-exponential schedule, not
+    N * fixed-pause."""
+    _, cli, _, _ = echo_server
+    cli.call("echo", {"x": 0}, timeout=5)
+    chaos.install({"rules": [{"kind": "drop", "method": "echo"}]})
+    t0 = time.monotonic()
+    with pytest.raises(Exception):
+        cli.call("echo", {"x": 1}, timeout=0.1, retries=3)
+    elapsed = time.monotonic() - t0
+    # 4 attempts * 0.1s timeout + backoffs of ~[0.05-0.1, 0.1-0.2, 0.2-0.4].
+    assert elapsed >= 0.4 + 0.05 + 0.1 + 0.2 - 0.05
+
+
+# ---------------------------------------------------------------------------
+# Duplicate/reordered one-way frames: reassembly idempotency (satellite +
+# two of the recovery bugs the matrix exposed, pinned)
+# ---------------------------------------------------------------------------
+
+
+def test_p2p_inbox_idempotent_under_duplicated_chunks():
+    from ray_tpu.util.collective.p2p import P2PInbox
+
+    inbox = P2PInbox()
+    # Reordered + duplicated 3-chunk payload.
+    assert not inbox.deposit("k", 2, 3, b"C")
+    assert not inbox.deposit("k", 0, 3, b"A")
+    assert not inbox.deposit("k", 0, 3, b"A")  # dup mid-assembly
+    assert inbox.deposit("k", 1, 3, b"B")
+    # PINNED REGRESSION: a duplicate arriving AFTER completion must not
+    # re-open a forever-partial reassembly (it used to leak in _parts until
+    # the 180s sweep) nor resurrect the completed entry.
+    assert not inbox.deposit("k", 1, 3, b"B")
+    s = inbox.stats()
+    assert s["partials"] == 0 and s["entries"] == 1
+    assert inbox.take("k") == b"ABC"
+    # PINNED REGRESSION: a duplicate after take() must not resurrect the
+    # consumed payload (at-most-once take contract).
+    assert not inbox.deposit("k", 1, 3, b"B")
+    assert not inbox.deposit("k2", 0, 1, b"Z") is None
+    assert inbox.take("k") is None
+    assert inbox.stats()["partials"] == 0
+
+
+def test_channel_gate_idempotent_under_duplicated_chunks():
+    from ray_tpu.experimental.channel.channel import _Gate
+
+    gate = _Gate()
+    gate.add_chunk(5, 1, 2, b"B")  # reordered
+    gate.add_chunk(5, 0, 2, b"A")
+    assert gate.pop(5) == b"AB"
+    # PINNED REGRESSION: duplicates after completion/pop used to re-open a
+    # partial whose phantom depth inflated queued() — the remote-mode
+    # writer's backpressure credit — throttling the producer on garbage.
+    gate.add_chunk(5, 0, 2, b"A")
+    gate.add_chunk(5, 1, 2, b"B")
+    assert gate.queued() == 0
+    assert gate.pop(5) is None  # not resurrected
+    # Fresh seqs still flow.
+    gate.add_chunk(6, 0, 1, b"Z")
+    assert gate.pop(6) == b"Z"
+
+
+def test_p2p_inbox_sweep_still_reaps_stale_partials():
+    from ray_tpu.util.collective.p2p import P2PInbox
+
+    inbox = P2PInbox()
+    inbox.deposit("dead", 0, 2, b"A")  # never completes
+    assert inbox.sweep(max_age_s=0.0) == 1
+    assert inbox.stats()["partials"] == 0
