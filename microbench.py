@@ -1329,6 +1329,235 @@ def serve_llm_suite(results, quick=False):
         )
 
 
+def serve_ft_suite(results, quick=False):
+    """--serve-ft: self-healing LLM serving (ISSUE 14) — FTBENCH_r{N}.json.
+
+    End to end over a REAL serve instance (cluster + controller + proxy +
+    2 LLM replicas), because the claims live in the proxy/controller, not
+    the engine:
+
+    - KILL arm: a seeded plan SIGKILLs the serving replica mid-stream (Nth
+      actor-call response); the proxy migrates the request with
+      resume_tokens= teacher-forced on a live replica. Reported:
+      time-to-stream-resume at the CLIENT (the max inter-token gap — the
+      kill->first-resumed-token stall dominates it), byte-exactness vs an
+      uninterrupted oracle run, dropped streams (must be 0).
+    - ROLLING arm, drain ON vs OFF: a closed loop of concurrent streams
+      rides a v(n) -> v(n+1) rolling update. Drain ON (default 30s bound)
+      retires old replicas only after their streams finish: zero drops AND
+      zero forced migrations. Drain OFF (drain_timeout_s=0, the pre-ISSUE
+      behavior) kills old replicas under live streams: the streams only
+      survive because the MIGRATION path catches them — visible as forced
+      migrations + a fatter p99 inter-token stall.
+    """
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu._private.rpc import EventLoopThread
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.serve._private.common import PREFIX_HINT_HEADER
+    from ray_tpu.serve.llm import LLMDeployment, prefix_route_hint
+
+    model = dict(
+        vocab_size=64, d_model=32, n_layers=1, n_heads=2, n_kv_heads=2,
+        d_ff=48, max_seq_len=64, dtype="float32", remat=False,
+    )
+    engine_cfg = dict(num_slots=4, block_size=4, max_model_len=64, prefill_chunk=4)
+    n_tokens = 16 if quick else 32
+    results["serve_ft_tokens_per_stream"] = n_tokens
+
+    def oracle(prompt, n):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models.transformer import TransformerConfig, init_params
+        from ray_tpu.serve.llm import LLMEngine
+
+        kw = dict(model)
+        kw["dtype"] = jnp.dtype(kw["dtype"]).type
+        cfg = TransformerConfig(**kw)
+        eng = LLMEngine(init_params(jax.random.PRNGKey(0), cfg), cfg, **engine_cfg)
+        try:
+            return eng.submit(prompt, max_new_tokens=n).result(120)
+        finally:
+            eng.shutdown()
+
+    def stream(url, body, headers=None, timeout=240):
+        """Returns (tokens, done, [arrival stamps])."""
+        req = urllib.request.Request(
+            url, data=json.dumps(body).encode(), headers=headers or {}
+        )
+        resp = urllib.request.urlopen(req, timeout=timeout)
+        toks, stamps, buf = [], [], b""
+        while True:
+            chunk = resp.read(64)
+            if not chunk:
+                return toks, False, stamps
+            buf += chunk
+            while b"\n\n" in buf:
+                event, buf = buf.split(b"\n\n", 1)
+                if not event.startswith(b"data: "):
+                    continue
+                payload = event[6:]
+                if payload == b"[DONE]":
+                    return toks, True, stamps
+                toks.append(json.loads(payload)["token"])
+                stamps.append(time.perf_counter())
+
+    def deploy(version, drain_timeout_s=30.0):
+        app = serve.deployment(
+            num_replicas=2, version=version, drain_timeout_s=drain_timeout_s
+        )(LLMDeployment).bind(model, engine_config=dict(engine_cfg))
+        serve.run(app, route_prefix="/llm")
+
+    def replica_actors():
+        from ray_tpu.serve._private.common import CONTROLLER_NAME
+
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        table = ray_tpu.get(controller.get_routing_table.remote(-2, 0.1))["table"]
+        return [r["actor_name"] for r in table.get("LLMDeployment", {}).get("replicas", [])]
+
+    def flight_count(cluster, kind, since):
+        io = EventLoopThread.get()
+        resp = io.run(cluster.nodes[0].rpc_debug_dump({}), timeout=15)
+        return sum(
+            1
+            for proc in resp.get("processes", [])
+            for ev in proc.get("events", [])
+            if ev.get("type") == kind and ev.get("ts", 0) >= since - 1.0
+        )
+
+    cluster = Cluster()
+    try:
+        cluster.add_node(num_cpus=6, object_store_memory=96 * 1024 * 1024)
+        cluster.connect()
+        cluster.wait_for_nodes()
+        serve.start()
+        deploy("v1")
+        host, port = serve.http_address()
+        url = f"http://{host}:{port}/llm"
+
+        # ---- KILL arm: seeded mid-stream replica kill -> migration ----
+        import zlib
+
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+        expect = oracle(prompt, n_tokens)
+        stream(url, dict(tokens=prompt, max_new_tokens=4))  # warm both paths
+        t_since = time.time()
+        hint = prefix_route_hint(prompt, engine_cfg["block_size"])
+        actors = replica_actors()
+        victim = actors[zlib.crc32(hint.encode()) % len(actors)]
+        assert cluster.install_plan_in_actor(
+            victim,
+            {"rules": [{"kind": "kill", "method": ["actor_call"],
+                        "side": "resp", "after": 2, "times": 1}]},
+            seed=13,
+        )
+        t0 = time.perf_counter()
+        toks, done, stamps = stream(
+            url, dict(tokens=prompt, max_new_tokens=n_tokens),
+            headers={PREFIX_HINT_HEADER: hint},
+        )
+        gaps = [b - a for a, b in zip(stamps, stamps[1:])] or [0.0]
+        results["kill_stream_ok"] = bool(done and toks == expect)
+        results["kill_stream_wall_s"] = round(time.perf_counter() - t0, 3)
+        results["kill_time_to_stream_resume_s"] = round(max(gaps), 3)
+        results["kill_median_token_gap_ms"] = round(
+            1000 * sorted(gaps)[len(gaps) // 2], 2
+        )
+        results["kill_migrations"] = flight_count(cluster, "llm_migrate", t_since)
+        results["kill_chaos_kills"] = flight_count(cluster, "chaos_kill", t_since)
+        print(
+            f"serve-ft[kill]: ok={results['kill_stream_ok']} "
+            f"resume={results['kill_time_to_stream_resume_s']}s "
+            f"migrations={results['kill_migrations']}"
+        )
+        # Let the controller finish replacing the victim before the next arm.
+        deadline = time.monotonic() + 120
+        while len(replica_actors()) < 2 and time.monotonic() < deadline:
+            time.sleep(0.25)
+
+        # ---- ROLLING arm: drain ON vs OFF under a closed loop ----
+        def rolling_arm(label, old_version, new_version, drain_timeout_s):
+            # (Re)deploy the old version with the arm's drain config, then
+            # roll under load.
+            deploy(old_version, drain_timeout_s=drain_timeout_s)
+            rng = np.random.default_rng(5)
+            prompts = [rng.integers(0, 64, 6).tolist() for _ in range(3)]
+            oracles = [oracle(p, n_tokens) for p in prompts]
+            t_since = time.time()
+            stop = threading.Event()
+            drops, completions, corrupt = [], [0], []
+            gaps_all: list = []
+            lock = threading.Lock()
+
+            def loop(i):
+                while not stop.is_set():
+                    try:
+                        toks, done, stamps = stream(
+                            url, dict(tokens=prompts[i], max_new_tokens=n_tokens)
+                        )
+                        if not done:
+                            drops.append(i)
+                            return
+                        if toks != oracles[i]:
+                            corrupt.append(i)
+                            return
+                        with lock:
+                            completions[0] += 1
+                            gaps_all.extend(
+                                b - a for a, b in zip(stamps, stamps[1:])
+                            )
+                    except Exception as e:  # noqa: BLE001
+                        drops.append(f"{i}:{type(e).__name__}")
+                        return
+
+            threads = [
+                threading.Thread(target=loop, args=(i,), daemon=True)
+                for i in range(len(prompts))
+            ]
+            for t in threads:
+                t.start()
+            deadline = time.monotonic() + 60
+            while completions[0] < 2 and not drops and time.monotonic() < deadline:
+                time.sleep(0.05)
+            t_roll = time.perf_counter()
+            deploy(new_version, drain_timeout_s=drain_timeout_s)
+            roll_wall = time.perf_counter() - t_roll
+            time.sleep(1.0)
+            stop.set()
+            for t in threads:
+                t.join(timeout=300)
+            gaps_all.sort()
+            p99 = gaps_all[min(len(gaps_all) - 1, int(0.99 * len(gaps_all)))] if gaps_all else 0.0
+            out = {
+                "dropped_streams": len(drops) + len(corrupt),
+                "completed_streams": completions[0],
+                "rolling_update_wall_s": round(roll_wall, 2),
+                "stall_p99_ms": round(1000 * p99, 1),
+                "max_stall_ms": round(1000 * (gaps_all[-1] if gaps_all else 0.0), 1),
+                "migrations": flight_count(cluster, "llm_migrate", t_since),
+                "drains_recorded": flight_count(cluster, "replica_drain", t_since),
+            }
+            for k, v in out.items():
+                results[f"rolling_{label}_{k}"] = v
+            print(f"serve-ft[rolling-{label}]: {out}")
+
+        if not quick:
+            rolling_arm("drain", "v2", "v3", drain_timeout_s=30.0)
+            rolling_arm("nodrain", "v4", "v5", drain_timeout_s=0.0)
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
+
+
 def putget_guard(results, duration):
     """1 MiB object-plane regression guard for the --transfer artifact: the
     rpc.py wire changes must not move the dispatch/store hot path.
@@ -1412,6 +1641,15 @@ def main():
         "load generator at N concurrent streams, continuous-batching engine "
         "vs serial-batch baseline — p50/p99 TTFT, time-per-output-token, "
         "aggregate tokens/s; records SERVEBENCH_r{N}.json",
+    )
+    ap.add_argument(
+        "--serve-ft",
+        dest="serve_ft",
+        action="store_true",
+        help="self-healing serving (ISSUE 14): time-to-stream-resume after "
+        "a seeded mid-stream replica kill (migration + teacher-forced "
+        "resume), and rolling-update dropped-stream counts with drain ON "
+        "vs OFF; records FTBENCH_r{N}.json",
     )
     ap.add_argument(
         "--chaos",
@@ -1535,6 +1773,17 @@ def main():
             results, args.round, prev_path=f"SERVEBENCH_r{args.round - 1}.json"
         )
         out = args.out or f"SERVEBENCH_r{args.round}.json"
+        with open(out, "w") as f:
+            json.dump(results, f, indent=1)
+        print(json.dumps(results))
+        return
+
+    if args.serve_ft:
+        results = {"host_cpus": os.cpu_count(), "mode": "serve_ft"}
+        t0 = time.perf_counter()
+        serve_ft_suite(results, quick=args.quick)
+        results["wall_s"] = round(time.perf_counter() - t0, 1)
+        out = args.out or f"FTBENCH_r{args.round}.json"
         with open(out, "w") as f:
             json.dump(results, f, indent=1)
         print(json.dumps(results))

@@ -28,9 +28,12 @@ Design:
   frame past its successors IS reordering), **dup** (frame written twice —
   at-least-once delivery made concrete), **reset** (the first ``reset_at``
   bytes are written, then the transport is torn — a mid-frame tear,
-  including mid-raw-frame), and **partition** (sends/connects between two
+  including mid-raw-frame), **partition** (sends/connects between two
   endpoints fail with ``ConnectionLost`` until healed; symmetric or
-  asymmetric, pairwise or a node **membrane**).
+  asymmetric, pairwise or a node **membrane**), and **kill** (the process
+  SIGKILLs ITSELF at the Nth matching frame — the crash-fault model; the
+  dying side stamps a ``chaos_kill`` flight event first, and the mmap
+  flight ring survives SIGKILL, so the kill point stays replayable).
 - Install paths: config/env (``RAY_TPU_CHAOS_SEED``/``RAY_TPU_CHAOS_PLAN``,
   read at CoreWorker/Raylet boot so spawned workers inherit the plan), or
   at runtime via the ``chaos_set_plan`` RPC every raylet and worker serves
@@ -64,7 +67,7 @@ from ray_tpu._private.concurrency import any_thread
 
 logger = logging.getLogger(__name__)
 
-FAULT_KINDS = ("drop", "delay", "dup", "reset", "partition")
+FAULT_KINDS = ("drop", "delay", "dup", "reset", "partition", "kill")
 
 # Methods never injected: the chaos control plane itself must stay
 # reachable (a plan that drops chaos_set_plan frames could never be
@@ -78,7 +81,10 @@ class _ChaosStats:
     ``ray_tpu_chaos_injected_total`` instrument by the flush-time
     collector (self_metrics._collect_chaos_stats)."""
 
-    __slots__ = ("injected", "drops", "delays", "dups", "resets", "partition_blocks")
+    __slots__ = (
+        "injected", "drops", "delays", "dups", "resets", "partition_blocks",
+        "kills",
+    )
 
     def __init__(self):
         self.injected = 0
@@ -87,6 +93,7 @@ class _ChaosStats:
         self.dups = 0
         self.resets = 0
         self.partition_blocks = 0
+        self.kills = 0
 
 
 CHAOS_STATS = _ChaosStats()
@@ -116,7 +123,7 @@ class FaultRule:
 
     def __init__(self, spec: dict):
         kind = spec.get("kind")
-        if kind not in ("drop", "delay", "dup", "reset"):
+        if kind not in ("drop", "delay", "dup", "reset", "kill"):
             raise ValueError(f"unknown fault kind {kind!r}")
         self.kind = kind
         self.peer = spec.get("peer")  # substring of client label OR addr key
@@ -173,11 +180,23 @@ class FaultPlan:
     on the IO loop (the frame seam), so rule counters and the RNG need no
     lock; installation swaps the whole plan atomically (module global)."""
 
-    def __init__(self, spec: dict | None = None, seed: int | None = None):
+    def __init__(
+        self,
+        spec: dict | None = None,
+        seed: int | None = None,
+        allow_kill: bool = False,
+    ):
         spec = spec or {}
         if seed is None:
             seed = int(spec.get("seed", 0))
         self.seed = seed
+        # kill rules SIGKILL the INSTALLING process. The remote install
+        # paths (chaos_set_plan RPC, env inheritance at worker boot) arm
+        # them — they target the process that is meant to die. A direct
+        # in-process install() refuses them so a driver/test process can't
+        # SIGKILL itself (and everything an in-process cluster hosts) by
+        # installing a plan written for its workers.
+        self.allow_kill = bool(allow_kill)
         self.rng = random.Random(seed)
         self.rules: list[FaultRule] = []
         self.exclude = frozenset(spec.get("exclude", ())) | _DEFAULT_EXCLUDE
@@ -191,6 +210,14 @@ class FaultPlan:
         self._next_membrane = 1
         self._mutate = threading.Lock()  # partition edits from user threads
         for rule in spec.get("rules", ()):
+            if rule.get("kind") == "kill" and not self.allow_kill:
+                raise ValueError(
+                    "kill rules are refused on direct in-process install: "
+                    "they SIGKILL THIS process. Push the plan into the "
+                    "target process via the chaos_set_plan RPC / env "
+                    "inheritance, or pass allow_kill=True if this process "
+                    "really is the victim."
+                )
             if rule.get("kind") == "partition":
                 if "inside" in rule:
                     # Membrane form: sever every link crossing the
@@ -302,6 +329,14 @@ class FaultPlan:
             if rule.kind == "reset":
                 CHAOS_STATS.resets += 1
                 return Action("reset", reset_at=rule.reset_at)
+            if rule.kind == "kill":
+                # Crash fault: the rpc seam SIGKILLs this process at this
+                # frame. Stamp the dedicated chaos_kill flight event NOW —
+                # the mmap ring survives SIGKILL, so the injection point
+                # stays replayable from the node's flight dir postmortem.
+                CHAOS_STATS.kills += 1
+                flight_recorder.record("chaos_kill", f"{label[:24]}:{method}")
+                return Action("kill")
             lo, hi = rule.delay_ms
             CHAOS_STATS.delays += 1
             return Action("delay", delay_s=(lo + (hi - lo) * self.rng.random()) / 1000.0)
@@ -344,14 +379,24 @@ def active() -> FaultPlan | None:
 
 
 @any_thread
-def install(spec: dict | FaultPlan | None, seed: int | None = None) -> FaultPlan | None:
+def install(
+    spec: dict | FaultPlan | None,
+    seed: int | None = None,
+    allow_kill: bool = False,
+) -> FaultPlan | None:
     """Install (or, with None, clear) the process fault plan. ``spec`` is
-    the JSON-able plan grammar (see CHAOS.md) or a prebuilt FaultPlan."""
+    the JSON-able plan grammar (see CHAOS.md) or a prebuilt FaultPlan.
+    ``allow_kill`` arms ``kill`` rules (SIGKILL of THIS process); the
+    remote install paths pass it, direct installs refuse by default."""
     with _install_lock:
         if spec is None:
             _publish(None)
             return None
-        plan = spec if isinstance(spec, FaultPlan) else FaultPlan(spec, seed=seed)
+        plan = (
+            spec
+            if isinstance(spec, FaultPlan)
+            else FaultPlan(spec, seed=seed, allow_kill=allow_kill)
+        )
         _publish(plan)
         return plan
 
@@ -407,7 +452,9 @@ def maybe_install_from_env():
         if isinstance(spec, list):
             spec = {"rules": spec}
         seed_env = os.environ.get("RAY_TPU_CHAOS_SEED")
-        install(spec, seed=int(seed_env) if seed_env else None)
+        # Env inheritance is a remote install path: a process booted under
+        # a kill plan IS the intended victim.
+        install(spec, seed=int(seed_env) if seed_env else None, allow_kill=True)
         logger.warning("chaos: installed fault plan from env (seed=%s)",
                        active().seed if active() else None)
     except Exception:

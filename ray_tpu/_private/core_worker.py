@@ -2458,7 +2458,9 @@ class CoreWorker:
         if plan is None:
             chaos.clear()
         else:
-            chaos.install(plan, seed=req.get("seed"))
+            # Remote install path: kill rules are armed — the pusher chose
+            # THIS process as the crash victim.
+            chaos.install(plan, seed=req.get("seed"), allow_kill=True)
         return {"ok": True}
 
     async def rpc_debug_dump(self, req):

@@ -111,6 +111,10 @@ EVENT_TYPES = (
     "chan_devobj_recv",  # 39: descriptor slot resolved to the live value (detail cid:seq:path)
     # Chaos fault-injection plane (chaos.py, PR 13).
     "chaos_inject",    # 40: fault injected at the rpc seam (detail kind:peer:method)
+    # Crash-fault dimension + self-healing serving (PR 14).
+    "chaos_kill",      # 41: this process SIGKILLs itself at a frame (detail peer:method) — last words, ring survives
+    "llm_migrate",     # 42: mid-stream LLM request migrated to another replica (detail deployment:ntok)
+    "replica_drain",   # 43: serve replica drain begin/done (detail replica_id:phase)
 )
 _CODE = {name: i for i, name in enumerate(EVENT_TYPES)}
 

@@ -1770,10 +1770,26 @@ class Raylet:
 
         plan = req.get("plan")
         seed = req.get("seed")
+        local = True
         if plan is None:
             chaos.clear()
         else:
-            chaos.install(plan, seed=seed)
+            # kill rules are armed only for STANDALONE raylet processes
+            # (exit_on_dead marks raylet main): an in-process raylet shares
+            # the driver/test process, and SIGKILLing it would take the
+            # whole host down. The SKIP is decided by inspection, not by
+            # catching install's ValueError — a malformed plan (unknown
+            # kind, bad field) must still error out to the caller instead
+            # of reading as ok=True. The broadcast below still arms kill
+            # rules in the node's worker processes — the supported
+            # crash-fault target.
+            has_kill = any(
+                r.get("kind") == "kill" for r in (plan.get("rules") or ())
+            )
+            if has_kill and not self._exit_on_dead:
+                local = False
+            else:
+                chaos.install(plan, seed=seed, allow_kill=self._exit_on_dead)
         reached = failed = 0
         if req.get("broadcast"):
             for w in list(self.workers.values()):
@@ -1787,7 +1803,12 @@ class Raylet:
                     reached += 1
                 except Exception:
                     failed += 1
-        return {"ok": True, "workers_reached": reached, "workers_failed": failed}
+        return {
+            "ok": True,
+            "local_install": local,
+            "workers_reached": reached,
+            "workers_failed": failed,
+        }
 
     async def rpc_debug_dump(self, req):
         """Node-wide flight-recorder dump: every ring in this session's

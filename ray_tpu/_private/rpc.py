@@ -287,6 +287,15 @@ def _apply_send_action(act, writer, parts, label: str) -> bool:
     kind = act.kind
     if kind == "drop":
         return False
+    if kind == "kill":
+        # Crash fault: this process dies NOW, mid-protocol, exactly like a
+        # real SIGKILL/OOM — no atexit, no flushes, no goodbye frames. The
+        # chaos_kill flight event was stamped by the plan (mmap ring
+        # survives), so the injection log outlives the process.
+        import signal as _signal
+
+        os.kill(os.getpid(), _signal.SIGKILL)
+        return False  # unreachable (SIGKILL is not deliverable-to-self-late)
     if kind == "partition":
         try:
             writer.close()

@@ -189,6 +189,18 @@ def instruments() -> dict:
                 "In-flight requests across this router's replicas.",
                 tag_keys=("deployment",),
             ),
+            "serve_migrations": m.Counter(
+                "ray_tpu_serve_migrations_total",
+                "Streaming requests migrated mid-stream to another replica "
+                "after a replica death (proxy-side teacher-forced resume).",
+                tag_keys=("deployment",),
+            ),
+            "serve_drains": m.Counter(
+                "ray_tpu_serve_drains_total",
+                "Replica drains completed before deliberate retirement "
+                "(downscale / rolling update), by outcome.",
+                tag_keys=("outcome",),
+            ),
             "serve_latency": m.Histogram(
                 "ray_tpu_serve_replica_latency_s",
                 "Replica request latency observed at the handle (assign -> result).",
@@ -349,6 +361,7 @@ def _collect_chaos_stats():
         ("dups", inst["chaos_injected"], {"kind": "dup"}),
         ("resets", inst["chaos_injected"], {"kind": "reset"}),
         ("partition_blocks", inst["chaos_injected"], {"kind": "partition"}),
+        ("kills", inst["chaos_injected"], {"kind": "kill"}),
     ])
 
 

@@ -78,6 +78,104 @@ class Cluster:
         self.nodes.remove(raylet)
         raylet.stop()
 
+    # ------------------------------------------------------------------
+    # Crash faults (ISSUE 14): SIGKILL a process by ROLE. The in-process
+    # raylets/GCS share the test process and cannot be SIGKILLed; worker
+    # processes (plain workers, actors, serve replicas/proxies) are real
+    # OS processes and can. The killer side stamps a ``chaos_kill`` flight
+    # event so the injection shows up in the node postmortem exactly like
+    # a plan-driven self-kill.
+    # ------------------------------------------------------------------
+
+    def _live_workers(self, raylet: Raylet | None = None):
+        nodes = [raylet] if raylet is not None else self.nodes
+        out = []
+        for n in nodes:
+            for w in n.workers.values():
+                if not w.pid or w.state in ("starting", "dead"):
+                    continue
+                try:
+                    # The raylet's monitor lags a SIGKILL by a poll tick;
+                    # probe the pid so an already-dead worker (a previous
+                    # cell's victim) is never picked again.
+                    os.kill(w.pid, 0)
+                except (ProcessLookupError, PermissionError):
+                    continue
+                out.append((n, w))
+        return out
+
+    def find_actor_worker(self, actor_name: str):
+        """(raylet, WorkerHandle) hosting the named actor, or None. The
+        GCS name registry maps name -> actor_id; raylets stamp actor_id on
+        the worker the creation task landed in."""
+        actor_id = next(
+            (
+                aid
+                for (_ns, name), aid in self.gcs.named_actors.items()
+                if name == actor_name
+            ),
+            None,
+        )
+        if actor_id is None:
+            return None
+        for n, w in self._live_workers():
+            if w.actor_id == actor_id:
+                return n, w
+        return None
+
+    def kill_role(self, role: str, raylet: Raylet | None = None, index: int = 0) -> int:
+        """SIGKILL one process by role; returns the pid killed.
+
+        - ``"worker"``: the ``index``-th live worker process (of ``raylet``
+          when given, else cluster-wide, in node order).
+        - ``"actor:<name>"``: the worker process hosting the named actor —
+          serve replicas (``SERVE_REPLICA::<deployment>#<id>``) and proxies
+          are actors, so this is the replica/proxy crash lever.
+        """
+        import signal
+
+        from ray_tpu._private import chaos, flight_recorder
+
+        if role.startswith("actor:"):
+            found = self.find_actor_worker(role[6:])
+            if found is None:
+                raise ValueError(f"no live worker hosts actor {role[6:]!r}")
+            _, w = found
+        else:
+            if role != "worker":
+                raise ValueError(f"unknown role {role!r} (worker | actor:<name>)")
+            workers = self._live_workers(raylet)
+            if not workers:
+                raise ValueError("no live worker processes to kill")
+            _, w = workers[index % len(workers)]
+        flight_recorder.record("chaos_kill", f"{role[:24]}:pid{w.pid}")
+        chaos.CHAOS_STATS.injected += 1
+        chaos.CHAOS_STATS.kills += 1
+        os.kill(w.pid, signal.SIGKILL)
+        return w.pid
+
+    def install_plan_in_actor(
+        self, actor_name: str, plan: dict | None, seed: int | None = None
+    ) -> bool:
+        """Push a chaos plan (None clears) into the worker PROCESS hosting
+        the named actor — the seeded-kill lever for serve replicas: a
+        ``kill`` rule on e.g. ``("next_stream_chunk", side="resp")`` makes
+        the replica SIGKILL itself at the Nth streamed chunk."""
+        from ray_tpu._private.rpc import EventLoopThread
+
+        found = self.find_actor_worker(actor_name)
+        if found is None or found[1].client is None:
+            return False
+        io = EventLoopThread.get()
+        io.run(
+            found[1].client.acall(
+                "chaos_set_plan", {"plan": plan, "seed": seed},
+                timeout=5, retries=0,
+            ),
+            timeout=6,
+        )
+        return True
+
     def partition_node(self, raylet: Raylet, include_workers: bool = True):
         """In-process NETWORK TEAR: sever `raylet` from the rest of the
         cluster WITHOUT killing it (ROADMAP item 5's missing chaos lever —

@@ -57,6 +57,29 @@ class ActorUnavailableError(ActorError):
     """The actor is temporarily unreachable (e.g. restarting)."""
 
 
+class ReplicaDrainingError(ActorError):
+    """A Serve replica in drain mode (deliberate retirement: downscale or
+    rolling update) refused a NEW request. In-flight work still completes
+    there; callers should reassign to another replica. The HTTP proxy does
+    so transparently (it owns the request until the response lands); a
+    DeploymentHandle caller sees this at ``ray_tpu.get()`` — after the
+    handle already returned its ref — and resubmits itself (the handle's
+    transparent reassign covers only the died-before-accepting race, which
+    is detectable at submission time)."""
+
+    def __init__(self, msg: str = "", deployment: str = "", replica_id: str = ""):
+        self.deployment = deployment
+        self.replica_id = replica_id
+        super().__init__(
+            msg
+            or (
+                f"replica {replica_id or '<unknown>'} of deployment "
+                f"{deployment or '<unknown>'} is draining and refuses new "
+                "requests"
+            )
+        )
+
+
 class ObjectLostError(RayTpuError):
     """Object was lost (all copies gone) and could not be reconstructed."""
 

@@ -103,6 +103,54 @@ def _np_default(o):
     raise TypeError(f"not JSON serializable: {type(o)}")
 
 
+def _replica_went_away(e: BaseException) -> bool:
+    """The typed this-replica-is-gone errors that justify a bounded
+    reassign/migration: process death (ActorDiedError and its unavailable
+    sibling) or deliberate drain (ReplicaDrainingError — possibly wrapped
+    in the TaskError envelope a raising remote method rides home in).
+    Anything else (app bugs, timeouts) surfaces unchanged."""
+    from ray_tpu.exceptions import (
+        ActorDiedError,
+        ActorUnavailableError,
+        ReplicaDrainingError,
+        TaskError,
+    )
+
+    if isinstance(e, (ActorDiedError, ActorUnavailableError, ReplicaDrainingError)):
+        return True
+    if isinstance(e, TaskError):
+        return isinstance(e.cause, ReplicaDrainingError)
+    return False
+
+
+class _SSETokenParser:
+    """Incremental parser over the SSE chunk bytes the proxy forwards:
+    collects the ``data: {"token": n}`` payloads the CLIENT has already
+    received — exactly the tokens a migrated request must teacher-force
+    and never re-emit. Chunk boundaries are arbitrary (the replica pump
+    batches), so events are split on the wire-level ``\\n\\n`` frame."""
+
+    def __init__(self):
+        self.tokens: list = []
+        self._buf = b""
+
+    def feed(self, chunk: bytes):
+        self._buf += bytes(chunk)
+        while b"\n\n" in self._buf:
+            event, self._buf = self._buf.split(b"\n\n", 1)
+            if not event.startswith(b"data: "):
+                continue
+            payload = event[6:]
+            if payload == b"[DONE]":
+                continue
+            try:
+                tok = json.loads(payload).get("token")
+            except Exception:
+                continue
+            if tok is not None:
+                self.tokens.append(int(tok))
+
+
 class ProxyASGIApp:
     """Serve's HTTP ingress as an ASGI-3 application.
 
@@ -171,20 +219,33 @@ class ProxyASGIApp:
                 (v for k, v in headers.items() if k.lower() == PREFIX_HINT_HEADER),
                 "",
             )
-            t0 = _time.monotonic()
-            replica = self._router.assign_replica(
-                deployment, model_id=model_id, prefix_hint=prefix_hint
-            )
-            try:
-                actor = self._router.handle_for(replica)
-                ref = actor.handle_http_request.remote(
-                    method, path, query, body, headers, model_id, matched_prefix,
-                    raw_query,
+            # ONE bounded reassign on the typed went-away errors: a replica
+            # that died after assignment (assign->dead race) or entered
+            # drain (deliberate retirement; the routing-table removal races
+            # this request by design) must not 500 the client while healthy
+            # replicas exist.
+            exclude: list = []
+            for attempt in range(2):
+                t0 = _time.monotonic()
+                replica = self._router.assign_replica(
+                    deployment, model_id=model_id, prefix_hint=prefix_hint,
+                    exclude=exclude,
                 )
-                result = ray_tpu.get(ref, timeout=120)
-            except BaseException:
-                self._router.release(replica, deployment=deployment)
-                raise
+                try:
+                    actor = self._router.handle_for(replica)
+                    ref = actor.handle_http_request.remote(
+                        method, path, query, body, headers, model_id, matched_prefix,
+                        raw_query,
+                    )
+                    result = ray_tpu.get(ref, timeout=120)
+                except BaseException as e:
+                    self._router.release(replica, deployment=deployment)
+                    if attempt == 0 and _replica_went_away(e):
+                        self._router.invalidate_handle(replica)
+                        exclude.append(replica["actor_name"])
+                        continue
+                    raise
+                break
             if isinstance(result, dict) and "__serve_stream__" in result:
                 # Streaming: the replica stays assigned (queue metrics + its
                 # generator live there) until the pump finishes.
@@ -208,10 +269,19 @@ class ProxyASGIApp:
         status, payload, ctype, extra = _encode_result(result)
         await _respond(send, status, payload, ctype, extra)
 
+    # Mid-stream migrations per request: one covers the common single
+    # replica death; the second covers dying onto a second casualty during
+    # a rolling restart. Beyond that the stream aborts honestly.
+    _MAX_MIGRATIONS = 2
+
     async def _pump_stream(self, send, loop, deployment, replica, envelope):
         import ray_tpu
 
         sid = envelope["__serve_stream__"]
+        resume = envelope.get("__serve_resume__")
+        parser = (
+            _SSETokenParser() if resume and resume.get("kind") == "sse_tokens" else None
+        )
         await _respond_start(
             send,
             int(envelope.get("status", 200)),
@@ -220,16 +290,52 @@ class ProxyASGIApp:
         )
         actor = self._router.handle_for(replica)
         finished = False
+        migrations = 0
+        dead: list = []
+        # Slot-accounting ownership: the dead replica is released at the
+        # START of a migration, so a failed migration must not let the
+        # finally below release it a second time (release() clamps at 0,
+        # but a double decrement would steal a count from another stream
+        # still assigned to the same replica).
+        held = True
         try:
             while True:
-                batch = await loop.run_in_executor(
-                    self._pool,
-                    lambda: ray_tpu.get(actor.next_stream_chunk.remote(sid), timeout=120),
-                )
+                try:
+                    batch = await loop.run_in_executor(
+                        self._pool,
+                        lambda: ray_tpu.get(
+                            actor.next_stream_chunk.remote(sid), timeout=120
+                        ),
+                    )
+                except Exception as e:
+                    if (
+                        parser is None
+                        or migrations >= self._MAX_MIGRATIONS
+                        or not _replica_went_away(e)
+                    ):
+                        raise
+                    # Typed replica death mid-stream: MIGRATE. Resubmit the
+                    # original request to another replica with the tokens
+                    # the client already received teacher-forced back in —
+                    # the engine continues bit-identically from there and
+                    # re-emits nothing.
+                    migrations += 1
+                    dead.append(replica["actor_name"])
+                    self._router.release(replica, deployment=deployment)
+                    self._router.invalidate_handle(replica)
+                    held = False
+                    replica, actor, sid = await loop.run_in_executor(
+                        self._pool,
+                        lambda: self._migrate_stream(deployment, resume, parser, dead),
+                    )
+                    held = True
+                    continue
                 if batch is None:
                     finished = True
                     break
                 for chunk in batch["chunks"]:
+                    if parser is not None:
+                        parser.feed(chunk)
                     await send({"type": "http.response.body", "body": chunk, "more_body": True})
                 if batch["done"]:
                     finished = True
@@ -245,8 +351,78 @@ class ProxyASGIApp:
                     actor.cancel_stream.remote(sid)
                 except Exception:
                     pass
-            self._router.release(replica, deployment=deployment)
+            if held:
+                self._router.release(replica, deployment=deployment)
         await send({"type": "http.response.body", "body": b"", "more_body": False})
+
+    def _migrate_stream(self, deployment, resume, parser, dead):
+        """Resubmit a broken stream's request to a live replica with
+        ``resume_tokens=`` (runs in the executor pool: blocking calls).
+        Returns (replica, actor, sid) of the resumed stream. The migration
+        TARGET can itself be mid-death/drain (stale table during a rolling
+        restart) — that is the same went-away race as everywhere else, so
+        it is excluded and the resubmit retried within a bound rather than
+        aborting a stream healthy replicas could still serve."""
+        import ray_tpu
+        from ray_tpu._private import flight_recorder, self_metrics
+
+        body2 = dict(resume.get("body") or {})
+        body2["resume_tokens"] = parser.tokens
+        body2["stream"] = True
+        payload = json.dumps(body2).encode()
+        # Replay the ORIGINAL request's routing context (stamped by the
+        # replica into the resume descriptor) — only the body changes. The
+        # dead replica is excluded, so prefix affinity is moot, but model
+        # affinity still steers multiplexed deployments to a warm replica.
+        ctx = resume.get("ctx") or {}
+        casualties = 0
+        while True:
+            replica = self._router.assign_replica(
+                deployment, model_id=ctx.get("model_id", ""), exclude=dead
+            )
+            try:
+                actor = self._router.handle_for(replica)
+                env2 = ray_tpu.get(
+                    actor.handle_http_request.remote(
+                        ctx.get("method", "POST"),
+                        ctx.get("path", "/"),
+                        ctx.get("query", {}),
+                        payload,
+                        ctx.get("headers", {}),
+                        ctx.get("model_id", ""),
+                        ctx.get("route_prefix"),
+                        ctx.get("raw_query"),
+                    ),
+                    timeout=120,
+                )
+                if not (isinstance(env2, dict) and "__serve_stream__" in env2):
+                    raise RuntimeError(
+                        f"migration resubmit did not return a stream: {type(env2)}"
+                    )
+            except BaseException as e:
+                self._router.release(replica, deployment=deployment)
+                casualties += 1
+                if casualties <= self._MAX_MIGRATIONS and _replica_went_away(e):
+                    self._router.invalidate_handle(replica)
+                    dead.append(replica["actor_name"])
+                    continue
+                raise
+            break
+        flight_recorder.record(
+            "llm_migrate", f"{deployment[:20]}:n{len(parser.tokens)}"
+        )
+        try:
+            self_metrics.instruments()["serve_migrations"].inc(
+                tags={"deployment": deployment}
+            )
+        except Exception:
+            pass
+        logger.warning(
+            "migrated stream of %s to %s after replica death "
+            "(%d tokens teacher-forced)",
+            deployment, replica["actor_name"], len(parser.tokens),
+        )
+        return replica, actor, env2["__serve_stream__"]
 
 
 def _encode_result(result):

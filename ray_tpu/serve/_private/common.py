@@ -18,6 +18,13 @@ class DeploymentConfig:
     health_check_period_s: float = 10.0
     health_check_timeout_s: float = 30.0
     graceful_shutdown_timeout_s: float = 20.0
+    # Drain-before-retire bound for DELIBERATE stops (downscale, rolling
+    # update, deployment delete): the replica leaves the routing table,
+    # refuses new requests, and gets up to this long for in-flight
+    # requests/streams to finish before the process is retired. 0 disables
+    # draining (immediate retire, the pre-drain behavior). Health-check
+    # failures always retire immediately — a dead replica drains nothing.
+    drain_timeout_s: float = 30.0
     autoscaling: Optional["AutoscalingConfig"] = None
     # None = autogenerate from code + init args + user_config at deploy time
     # (reference: unversioned deployments get a new version on every deploy,

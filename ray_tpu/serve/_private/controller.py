@@ -33,6 +33,7 @@ import time
 import uuid
 
 import ray_tpu
+from ray_tpu._private import self_metrics
 from ray_tpu.air.execution import ActorManager, FixedResourceManager, ResourceRequest
 from ray_tpu.serve._private.common import (
     AutoscalingConfig,
@@ -70,6 +71,11 @@ class ServeController:
         # Caps the stall-breaker at maxUnavailable=1: a rollout whose new
         # version never becomes healthy sacrifices at most one old replica.
         self._forced_debt: dict[str, int] = {}
+        # replica_id -> drain record for replicas in drain-before-retire
+        # (out of the routing table, refusing new work, finishing in-flight
+        # streams). A health-check failure mid-drain pops the record and
+        # retires IMMEDIATELY; the drain thread yields to it.
+        self._draining: dict[str, dict] = {}
         self._lock = threading.RLock()
         self._epoch = 0
         self._epoch_cv = threading.Condition(self._lock)
@@ -417,12 +423,35 @@ class ServeController:
                     if now - push_ts < 5.0:
                         continue  # fresh push == alive
                     due.append((name, r, info.config.health_check_timeout_s))
+            # DRAINING replicas left the routing table but still hold a
+            # process + in-flight streams: keep health-checking them so a
+            # replica that dies/wedges mid-drain is retired immediately
+            # instead of riding out the whole drain_timeout_s.
+            for rid, rec in list(self._draining.items()):
+                info = self._deployments.get(rec["name"])
+                period = info.config.health_check_period_s if info else 10.0
+                if now - self._health_marks.get(rid, 0.0) < period:
+                    continue
+                self._health_marks[rid] = now
+                push_ts = (
+                    self._metrics.get(rec["name"], {}).get(rid, (0, 0.0))[1]
+                )
+                if now - push_ts < 5.0:
+                    continue
+                due.append((
+                    rec["name"], rec["rinfo"],
+                    info.config.health_check_timeout_s if info else 30.0,
+                ))
         # Fan out ALL probes, then collect under one shared deadline: a node
         # death with N replicas must cost one timeout, not N.
         refs = []
         max_timeout = 0.0
         for name, r, timeout_s in due:
             handle = self._replica_handles.get(r.replica_id)
+            if handle is None:
+                with self._lock:
+                    rec = self._draining.get(r.replica_id)
+                handle = rec.get("handle") if rec else None
             max_timeout = max(max_timeout, timeout_s)
             if handle is None:
                 refs.append((name, r, None))
@@ -453,11 +482,19 @@ class ServeController:
             handle = self._replica_handles.pop(r.replica_id, None)
             self._health_marks.pop(r.replica_id, None)
             self._metrics.get(name, {}).pop(r.replica_id, None)
-        if not present:
+            # Health failure OUTRANKS an in-progress drain: a dead/wedged
+            # replica drains nothing, so claim the drain record (its thread
+            # yields once the record is gone) and kill NOW.
+            draining = self._draining.pop(r.replica_id, None)
+        if draining is not None:
+            tracked = tracked or draining.get("tracked")
+            handle = handle or draining.get("handle")
+        elif not present:
             return  # raced a deliberate stop (downscale/rollout) — no-op
         logger.warning(
-            "replica %s of %s failed its health check; removing and killing",
+            "replica %s of %s failed its health check; removing and killing%s",
             r.replica_id, name,
+            " (drain in progress, retired immediately)" if draining else "",
         )
         # Kill the actor too: a hung replica left alive would hold its CPU
         # reservation and starve the replacement on a full cluster.
@@ -549,25 +586,41 @@ class ServeController:
             retire = len(old_reps) if len(new_reps) >= target else min(
                 len(old_reps), max(0, len(new_reps) + len(old_reps) - target)
             )
+            forced = False
             if retire == 0 and old_reps and starting > 0:
-                # Rolling update stalled: new-version replicas are STARTING
-                # but none can come up (typically the old version holds all
-                # cluster resources). Force-retire ONE old replica to free
-                # resources — and only one outstanding at a time
-                # (maxUnavailable=1), so a rollout whose new version keeps
-                # crashing cannot drain the whole deployment.
+                # Rolling update stalled: new-version replicas CANNOT PLACE
+                # (tracked actors still PENDING = waiting for resources,
+                # typically because the old version holds them all).
+                # Force-retire ONE old replica to free resources — and only
+                # one outstanding at a time (maxUnavailable=1), so a
+                # rollout whose new version keeps crashing cannot drain the
+                # whole deployment. A replica that placed and is merely
+                # SLOW-STARTING (model load/compile) is NOT a stall: those
+                # used to trip this branch and rob old replicas of their
+                # drain (ISSUE 14).
+                from ray_tpu.air.execution.actor_manager import PENDING
+
                 with self._lock:
                     births = self._starting_births.get(name, {})
                     oldest = min(births.values()) if births else None
+                    unplaceable = any(
+                        self._replica_tracked.get(rid) is not None
+                        and self._replica_tracked[rid].state == PENDING
+                        for rid in births
+                    )
                     if (
                         oldest is not None
+                        and unplaceable
                         and time.time() - oldest > 3.0
                         and self._forced_debt.get(name, 0) == 0
                     ):
                         retire = 1
+                        forced = True
                         self._forced_debt[name] = 1
             for r in old_reps[:retire]:
-                self._stop_replica(name, r)
+                # Forced stall-breaker retires skip the drain: they exist
+                # to free resources for a wedged rollout NOW.
+                self._stop_replica(name, r, drain=not forced)
                 changed = True
         if changed:
             with self._epoch_cv:
@@ -677,6 +730,9 @@ class ServeController:
             self._replica_handles.pop(rinfo.replica_id, None)
             self._health_marks.pop(rinfo.replica_id, None)
             self._metrics.get(name, {}).pop(rinfo.replica_id, None)
+            # Died while draining: the manager already reaped the process;
+            # clearing the record makes the drainer thread exit quietly.
+            self._draining.pop(rinfo.replica_id, None)
         if present:
             logger.warning(
                 "replica %s of %s died (%s); removing from routing table",
@@ -709,8 +765,19 @@ class ServeController:
                 with self._mgr_lock:
                     self._mgr.remove_actor(tracked)
 
-    def _stop_replica(self, name: str, rinfo: ReplicaInfo):
+    def _stop_replica(self, name: str, rinfo: ReplicaInfo, drain: bool = True):
+        """Deliberate retirement (downscale / rolling update / delete).
+
+        With ``drain`` (and a positive ``drain_timeout_s``): the replica
+        leaves the routing table NOW (routers stop assigning on the next
+        epoch), is told to refuse new requests, and a drainer thread
+        retires the process only once its in-flight requests and stream
+        pumps hit zero — or the bound expires. The stall-breaker's forced
+        retire passes ``drain=False``: it exists to free resources for a
+        stuck rollout, and waiting on a drain would re-create the stall."""
         with self._lock:
+            if rinfo.replica_id in self._draining:
+                return  # a drainer already owns this replica
             reps = self._replicas.get(name, [])
             if rinfo in reps:
                 reps.remove(rinfo)
@@ -720,10 +787,93 @@ class ServeController:
             # maps would otherwise grow one entry per retired replica forever.
             self._health_marks.pop(rinfo.replica_id, None)
             self._metrics.get(name, {}).pop(rinfo.replica_id, None)
+            info = self._deployments.get(name)
+            # Deleted deployments still drain their live streams (the
+            # config is gone with the deployment; use the default bound).
+            timeout_s = (
+                info.config.drain_timeout_s
+                if info is not None
+                else DeploymentConfig.drain_timeout_s
+            )
+            start_drain = (
+                drain
+                and timeout_s > 0
+                and handle is not None
+                and not self._shutdown
+            )
+            if start_drain:
+                self._draining[rinfo.replica_id] = {
+                    "name": name,
+                    "rinfo": rinfo,
+                    "tracked": tracked,
+                    "handle": handle,
+                }
+        if start_drain:
+            threading.Thread(
+                target=self._drain_then_retire,
+                args=(name, rinfo, tracked, handle, timeout_s),
+                name=f"serve-drain-{rinfo.replica_id}",
+                daemon=True,
+            ).start()
+            return
+        self._retire_replica_process(name, rinfo, tracked, handle)
+
+    def _drain_then_retire(self, name, rinfo, tracked, handle, timeout_s):
+        """Drainer thread for ONE deliberately-stopped replica. Yields to
+        the health-check path: if that retires the replica mid-drain (dead
+        replicas drain nothing), the drain record vanishes and this thread
+        simply exits."""
+        from ray_tpu._private import flight_recorder
+
+        rid = rinfo.replica_id
+        flight_recorder.record("replica_drain", f"{rid}:begin")
+        outcome = "clean"
+        try:
+            ray_tpu.get(handle.drain.remote(), timeout=10)
+        except Exception:
+            # The replica may still be fine (a loaded box can blow a 10s
+            # bound); the routing-table removal already stops new assigns,
+            # so keep polling — the status loop decides liveness.
+            pass
+        deadline = time.monotonic() + timeout_s
+        fails = 0
+        while not self._shutdown:
+            with self._lock:
+                if self._draining.get(rid) is None:
+                    return  # force-retired by a health-check failure
+            if time.monotonic() > deadline:
+                outcome = "timeout"
+                break
+            try:
+                st = ray_tpu.get(handle.drain_status.remote(), timeout=10)
+            except Exception:
+                # Transient (slow box) vs dead: three consecutive misses
+                # within the drain window reads as dead — a single blown
+                # bound must not retire a replica with live streams.
+                fails += 1
+                if fails >= 3:
+                    outcome = "died_draining"
+                    break
+            else:
+                fails = 0
+                if st.get("ongoing", 0) == 0 and st.get("streams", 0) == 0:
+                    break
+            time.sleep(0.25)
+        with self._lock:
+            if self._draining.pop(rid, None) is None:
+                return  # raced the force-retire path; it owns the kill
+        flight_recorder.record("replica_drain", f"{rid}:{outcome}")
+        try:
+            self_metrics.instruments()["serve_drains"].inc(tags={"outcome": outcome})
+        except Exception:
+            pass
+        self._retire_replica_process(name, rinfo, tracked, handle)
+
+    def _retire_replica_process(self, name, rinfo, tracked, handle):
         if handle is not None:
             try:
-                # Graceful drain: let the user callable release resources
-                # before the actor process is killed.
+                # Graceful shutdown hook: let the user callable release
+                # resources before the actor process is killed.
                 ray_tpu.get(
                     handle.prepare_for_shutdown.remote(),
                     timeout=min(5.0, self._deployments[name].config.graceful_shutdown_timeout_s)
@@ -734,10 +884,20 @@ class ServeController:
                 pass
         if tracked is not None:
             with self._mgr_lock:
-                self._mgr.remove_actor(tracked)  # kills + releases resources
+                try:
+                    self._mgr.remove_actor(tracked)  # kills + releases resources
+                except Exception:
+                    pass  # already removed (died mid-drain; on_failure ran)
         elif handle is not None:
             try:
                 ray_tpu.kill(handle)
             except Exception:
                 pass
+        # A draining replica kept pushing queue metrics after the stop-time
+        # prune (its push thread stops only in prepare_for_shutdown above);
+        # prune AFTER the process is gone so retired replicas don't accrete
+        # map entries.
+        with self._lock:
+            self._health_marks.pop(rinfo.replica_id, None)
+            self._metrics.get(name, {}).pop(rinfo.replica_id, None)
         logger.info("stopped replica %s of %s", rinfo.replica_id, name)

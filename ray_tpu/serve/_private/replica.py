@@ -116,6 +116,9 @@ class Replica:
         self._lock = threading.Lock()
         self._streams: dict = {}
         self._stream_counter = 0
+        self._draining = False
+        self._deployment_name = deployment_name
+        self._replica_id = replica_id
         if user_config is not None:
             self.reconfigure(user_config)
         # Autoscaling metrics PUSH (reference: autoscaling_metrics.py —
@@ -158,6 +161,16 @@ class Replica:
         from ray_tpu.serve.multiplex import _set_multiplexed_model_id
 
         with self._lock:
+            if self._draining:
+                # Drain-before-retire: NEW requests are refused with the
+                # typed error (proxy/handle reassign on it); in-flight
+                # requests and live stream pumps keep running to completion.
+                from ray_tpu.exceptions import ReplicaDrainingError
+
+                raise ReplicaDrainingError(
+                    deployment=self._deployment_name,
+                    replica_id=self._replica_id,
+                )
             self._ongoing += 1
             self._total += 1
         try:
@@ -207,10 +220,11 @@ class Replica:
                 status = getattr(result, "status", 200)
                 extra = getattr(result, "headers", None) or {}
                 on_cancel = getattr(result, "on_disconnect", None)
+                resume = getattr(result, "resume", None)
             else:
                 gen, ctype = result, "application/octet-stream"
                 status, extra = 200, {}
-                on_cancel = None
+                on_cancel = resume = None
             with self._lock:
                 self._reap_idle_streams_locked()
                 self._stream_counter += 1
@@ -218,12 +232,33 @@ class Replica:
                 self._streams[sid] = _StreamPump(
                     gen, multiplexed_model_id, on_cancel=on_cancel
                 )
-            return {
+            envelope = {
                 "__serve_stream__": sid,
                 "content_type": ctype,
                 "status": status,
                 "headers": extra,
             }
+            if resume is not None:
+                # Migration descriptor rides the envelope: the proxy uses
+                # it to resubmit this request elsewhere if THIS replica
+                # dies mid-stream. The deployment supplies kind + body; the
+                # ORIGINAL routing context (method/path/headers/model id/
+                # mount) is stamped here so the resumed request dispatches
+                # identically — a multiplexed or sub-routed deployment must
+                # not resume under different semantics.
+                envelope["__serve_resume__"] = dict(
+                    resume,
+                    ctx={
+                        "method": method,
+                        "path": path,
+                        "query": query,
+                        "headers": headers,
+                        "model_id": multiplexed_model_id,
+                        "route_prefix": route_prefix,
+                        "raw_query": raw_query_string,
+                    },
+                )
+            return envelope
         return result
 
     def _reap_idle_streams_locked(self):
@@ -292,6 +327,55 @@ class Replica:
         """Queue stats for autoscaling (reference: autoscaling_metrics.py)."""
         with self._lock:
             return {"ongoing": self._ongoing, "total": self._total, "ts": time.time()}
+
+    def drain(self) -> bool:
+        """Enter drain mode (controller-initiated, deliberate retirement):
+        refuse NEW requests with the typed ReplicaDrainingError while
+        in-flight requests and live stream pumps run to completion. The
+        user callable's own drain() hook (e.g. the LLM engine's
+        refuse-admissions flag) is forwarded to."""
+        with self._lock:
+            self._draining = True
+        fn = getattr(self._callable, "drain", None)
+        if fn is not None and callable(fn):
+            try:
+                fn()
+            except Exception:
+                pass
+        return True
+
+    # While draining, a pump nobody polled for this long stops COUNTING
+    # toward drain completion: its proxy probably died without
+    # cancel_stream (a live proxy polls sub-second), and the normal 300s
+    # idle reaper only runs from handle_http_request, which the drain gate
+    # refuses — without this, one orphan pump rides out the whole
+    # drain_timeout_s on an otherwise idle replica. The pump is NOT
+    # cancelled here: a slow-but-alive consumer (proxy blocked in a big
+    # send) must not be silently truncated as "complete" — if it is still
+    # alive at retire, its next poll gets the typed went-away error and
+    # resumable streams migrate.
+    _DRAIN_IDLE_EXCLUDE_S = 10.0
+
+    def drain_status(self) -> dict:
+        """What the controller's drainer polls: retire once ongoing == 0
+        and no RECENTLY-PUMPED stream remains (or drain_timeout_s
+        expires)."""
+        with self._lock:
+            now = time.time()
+            streams = (
+                sum(
+                    1
+                    for pump in self._streams.values()
+                    if now - pump.last_pump <= self._DRAIN_IDLE_EXCLUDE_S
+                )
+                if self._draining
+                else len(self._streams)
+            )
+            return {
+                "draining": self._draining,
+                "ongoing": self._ongoing,
+                "streams": streams,
+            }
 
     def check_health(self) -> bool:
         fn = getattr(self._callable, "check_health", None)

@@ -32,6 +32,7 @@ class Router:
         self._handles: dict[str, object] = {}  # actor_name -> handle
         self._rr: dict[str, int] = {}
         self._inflight: dict[str, int] = {}  # replica actor_name -> count
+        self._alive_cache: dict[str, float] = {}  # actor_name -> verdict stamp
         self._metrics = self_metrics.instruments()
         self._lock = threading.Lock()
         # Saturated assigns park on this condition (same underlying lock);
@@ -113,6 +114,7 @@ class Router:
         timeout_s: float = 30.0,
         model_id: str = "",
         prefix_hint: str = "",
+        exclude=(),
     ):
         """Pick a replica and claim a queue slot on it.
 
@@ -132,12 +134,19 @@ class Router:
         ``release()`` (and table refreshes) notify — a freed slot hands off
         in microseconds, not a 10 ms poll; ``timeout_s`` still bounds the
         total wait.
+
+        ``exclude``: actor names to never pick — the reassign/migration
+        callers pass the replica they just watched die, since it can linger
+        in the table until the controller notices the death.
         """
         deadline = time.time() + timeout_s
+        exclude = set(exclude)
         with self._avail:
             while True:
                 entry = self._table.get(deployment)
                 replicas = list(entry["replicas"]) if entry else []
+                if exclude:
+                    replicas = [r for r in replicas if r["actor_name"] not in exclude]
                 if replicas:
                     r = self._pick_locked(deployment, replicas, model_id, prefix_hint)
                     if r is not None:
@@ -231,6 +240,44 @@ class Router:
             except Exception:
                 pass
 
+    # Positive liveness verdicts are cached briefly so the per-call probe
+    # costs ~one GCS RPC per replica per window, not one per request —
+    # the race window the probe closes narrows from forever to the TTL.
+    _ALIVE_TTL_S = 2.0
+
+    def replica_alive(self, replica) -> bool:
+        """Bounded GCS probe (TTL-cached when positive): is the replica's
+        actor still registered and not DEAD? Closes the assign->dead-replica
+        race for handle calls — a replica that died after assignment but
+        before accepting is detectable here, and the caller reassigns
+        instead of handing its caller a doomed ref. Unknown (GCS
+        unreachable) reads as alive: the probe must never turn a healthy
+        call into a failure."""
+        from ray_tpu._private.worker_context import get_core_worker
+
+        name = replica["actor_name"]
+        now = time.monotonic()
+        with self._lock:
+            stamp = self._alive_cache.get(name)
+            if stamp is not None and now - stamp < self._ALIVE_TTL_S:
+                return True
+        try:
+            cw = get_core_worker()
+            resp = cw.gcs.call(
+                "get_actor",
+                {"name": name, "namespace": cw.namespace},
+                timeout=2,
+            )
+        except Exception:
+            return True
+        alive = resp.get("found", False) and resp["info"].get("state") != "DEAD"
+        with self._lock:
+            if alive:
+                self._alive_cache[name] = now
+            else:
+                self._alive_cache.pop(name, None)
+        return alive
+
     def handle_for(self, replica) -> object:
         name = replica["actor_name"]
         handle = self._handles.get(name)
@@ -241,3 +288,5 @@ class Router:
 
     def invalidate_handle(self, replica):
         self._handles.pop(replica["actor_name"], None)
+        with self._lock:
+            self._alive_cache.pop(replica["actor_name"], None)

@@ -60,12 +60,15 @@ class Deployment:
     def options(self, *, num_replicas: Optional[int] = None, name: Optional[str] = None,
                 max_concurrent_queries: Optional[int] = None, user_config: Any = None,
                 ray_actor_options: Optional[dict] = None, autoscaling_config=None,
-                route_prefix: Optional[str] = "__unset__", version: Optional[str] = None) -> "Deployment":
+                route_prefix: Optional[str] = "__unset__", version: Optional[str] = None,
+                drain_timeout_s: Optional[float] = None) -> "Deployment":
         import dataclasses
 
         cfg = dataclasses.replace(self.config)
         if num_replicas is not None:
             cfg.num_replicas = num_replicas
+        if drain_timeout_s is not None:
+            cfg.drain_timeout_s = drain_timeout_s
         if max_concurrent_queries is not None:
             cfg.max_concurrent_queries = max_concurrent_queries
         if user_config is not None:
@@ -95,6 +98,7 @@ def deployment(
     autoscaling_config=None,
     route_prefix: Optional[str] = None,
     version: Optional[str] = None,
+    drain_timeout_s: float = 30.0,
 ):
     """``@serve.deployment`` decorator (reference: api.py:241)."""
 
@@ -106,6 +110,7 @@ def deployment(
             ray_actor_options=ray_actor_options or {},
             autoscaling=_coerce_autoscaling(autoscaling_config),
             version=version,
+            drain_timeout_s=drain_timeout_s,
         )
         return Deployment(cls_or_fn, name or cls_or_fn.__name__, cfg, route_prefix)
 
@@ -304,8 +309,13 @@ def delete(deployment_name: str):
     ray_tpu.get(controller.delete_deployments.remote([deployment_name]))
 
 
-def shutdown():
+def shutdown(timeout_s: float = 30.0):
+    """Tear down the Serve control plane. Every controller call is BOUNDED:
+    a wedged controller (hung reconcile, dead event loop) used to park this
+    call forever on an unbounded ``get``; now it is force-killed after
+    ``timeout_s`` and the typed ``ActorUnavailableError`` names it."""
     global _started
+    from ray_tpu.exceptions import ActorUnavailableError
     from ray_tpu.serve._private.router import Router
 
     # Another driver (e.g. the CLI) may shut down a running Serve instance:
@@ -316,17 +326,33 @@ def shutdown():
         if not _started:
             return
         controller = None
+    wedged = None
     try:
         if controller is None:
             raise RuntimeError("no controller")
-        ray_tpu.get(controller.shutdown_proxies.remote())
-        ray_tpu.get(controller.graceful_shutdown.remote())
+        ray_tpu.get(controller.shutdown_proxies.remote(), timeout=timeout_s)
+        ray_tpu.get(controller.graceful_shutdown.remote(), timeout=timeout_s)
         time.sleep(0.2)
         ray_tpu.kill(controller)
+    except TimeoutError as e:
+        # The controller exists but cannot answer: force-kill it so its
+        # replicas/proxies get reaped, then SURFACE the wedge (the old
+        # swallow-everything path hid a stuck control plane entirely).
+        wedged = ActorUnavailableError(
+            f"serve controller {CONTROLLER_NAME!r} did not answer "
+            f"graceful shutdown within {timeout_s}s ({type(e).__name__}); "
+            "force-killed"
+        )
+        try:
+            ray_tpu.kill(controller)
+        except Exception:
+            pass
     except Exception:
         pass
     Router.reset()
     _started = False
+    if wedged is not None:
+        raise wedged
 
 
 class StreamingResponse:
@@ -348,6 +374,7 @@ class StreamingResponse:
         status: int = 200,
         headers: Optional[dict] = None,
         on_disconnect: Optional[Callable[[], None]] = None,
+        resume: Optional[dict] = None,
     ):
         self.iterator = iterator
         self.content_type = content_type
@@ -359,6 +386,12 @@ class StreamingResponse:
         # slot + KV blocks — release them immediately instead of waiting
         # for their generator to observe GeneratorExit on its next yield.
         self.on_disconnect = on_disconnect
+        # Mid-stream migration descriptor ({"kind": "sse_tokens", "body":
+        # {...}}): if the replica dies mid-stream, the proxy resubmits
+        # body (+ resume_tokens it parsed from the chunks it already
+        # forwarded) to another replica instead of dropping the stream.
+        # None (the default) = the stream is not migratable.
+        self.resume = resume
 
 
 def ingress(asgi_app):

@@ -69,18 +69,39 @@ class DeploymentHandle:
 
         model_id = self._multiplexed_model_id
         t0 = time.monotonic()
-        replica = self._router.assign_replica(
-            self._deployment, model_id=model_id, prefix_hint=self._prefix_hint
-        )
-        try:
-            actor = self._router.handle_for(replica)
-            ref = actor.handle_request.remote(
-                method, args, kwargs, multiplexed_model_id=model_id
+        # Assign -> dead-replica race: a replica can die after the router
+        # hands it out but before it accepts (its table entry lingers until
+        # the controller notices). ONE bounded reassign, driven by a cheap
+        # GCS liveness probe after submission, keeps that window from
+        # surfacing a raw ActorDiedError to the caller.
+        exclude: list = []
+        for attempt in range(2):
+            replica = self._router.assign_replica(
+                self._deployment,
+                model_id=model_id,
+                prefix_hint=self._prefix_hint,
+                exclude=exclude,
             )
-        except Exception:
-            self._router.release(replica, deployment=self._deployment)
-            self._router.invalidate_handle(replica)
-            raise
+            try:
+                actor = self._router.handle_for(replica)
+                ref = actor.handle_request.remote(
+                    method, args, kwargs, multiplexed_model_id=model_id
+                )
+            except Exception:
+                self._router.release(replica, deployment=self._deployment)
+                self._router.invalidate_handle(replica)
+                if attempt == 0:
+                    exclude.append(replica["actor_name"])
+                    continue
+                raise
+            if attempt == 0 and not self._router.replica_alive(replica):
+                # Submitted into a corpse: the ref is doomed (its error
+                # resolves via refcounting; nobody waits on it). Reassign.
+                self._router.release(replica, deployment=self._deployment)
+                self._router.invalidate_handle(replica)
+                exclude.append(replica["actor_name"])
+                continue
+            break
         # Release the slot once the result lands (fire-and-forget waiter);
         # the assign->result interval feeds ray_tpu_serve_replica_latency_s.
         router = self._router

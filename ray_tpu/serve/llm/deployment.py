@@ -64,6 +64,7 @@ class LLMDeployment:
             temperature=float(body.get("temperature", 0.0)),
             top_k=int(body.get("top_k", 0)),
             seed=int(body.get("seed", 0)),
+            resume_tokens=body.get("resume_tokens"),
         )
         if not body.get("stream", True):
             try:
@@ -94,6 +95,16 @@ class LLMDeployment:
             # reaper, so the decode slot and KV blocks free immediately
             # even while the generator is parked waiting for a token.
             on_disconnect=lambda: engine.cancel(req),
+            # Migration descriptor: if THIS replica dies mid-stream, the
+            # proxy resubmits the original body to another replica with
+            # resume_tokens= the tokens it already forwarded; "sse_tokens"
+            # tells the proxy how to parse them back out of the SSE chunks
+            # it relayed. Counter-based sampling makes the continuation
+            # bit-identical, so the client never notices.
+            resume={
+                "kind": "sse_tokens",
+                "body": {k: v for k, v in body.items() if k != "resume_tokens"},
+            },
         )
 
     def get_stats(self) -> dict:
@@ -102,6 +113,11 @@ class LLMDeployment:
 
     def check_health(self):
         self.engine.check_health()
+
+    def drain(self):
+        """Controller-initiated drain-before-retire: the engine refuses new
+        admissions; in-flight decodes run to completion."""
+        self.engine.drain()
 
     def prepare_for_shutdown(self):
         self.engine.shutdown()

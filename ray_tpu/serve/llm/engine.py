@@ -57,6 +57,24 @@ from ray_tpu._private.concurrency import any_thread, blocking
 from ray_tpu.serve.llm.stats import ENGINES, LLM
 
 
+# Terminal-error sentinel for a DELIBERATE engine teardown (replica
+# retiring). Requests that die with it surface the typed
+# ReplicaDrainingError, which the serve proxy treats as migratable — a
+# stream outliving its replica's drain window resumes elsewhere instead of
+# dropping. Every other error string stays a plain RuntimeError.
+SHUTDOWN_ERROR = "engine shutdown"
+
+
+def _request_error(val: str) -> Exception:
+    if val == SHUTDOWN_ERROR:
+        from ray_tpu.exceptions import ReplicaDrainingError
+
+        return ReplicaDrainingError(
+            msg="llm engine shut down mid-request (replica retiring)"
+        )
+    return RuntimeError(val)
+
+
 class LLMRequest:
     """One generation request: scheduler-fed token queue + terminal state.
 
@@ -70,7 +88,13 @@ class LLMRequest:
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.top_k = int(top_k)
-        self.rng = np.random.default_rng(seed)
+        # The request's sampling randomness is a COUNTER-BASED stream: token
+        # i is drawn from default_rng((seed, i)), never from mutable RNG
+        # state. That makes the stream position-addressable, so a request
+        # resumed on ANOTHER replica with resume_tokens= (mid-stream
+        # migration) continues bit-identically — exactly like recompute
+        # preemption, which never left the process.
+        self.seed = int(seed) & 0xFFFFFFFFFFFFFFFF
         self.cancelled = threading.Event()
         self.error: Optional[str] = None
         self.t_submit = time.monotonic()
@@ -103,7 +127,7 @@ class LLMRequest:
             elif kind == "done":
                 return
             else:  # error
-                raise RuntimeError(val)
+                raise _request_error(val)
 
     @blocking
     def result(self, timeout: float = 120.0) -> list[int]:
@@ -123,7 +147,7 @@ class LLMRequest:
             elif kind == "done":
                 return out
             else:
-                raise RuntimeError(val)
+                raise _request_error(val)
 
 
 def block_hashes(tokens, block_size: int) -> list[bytes]:
@@ -241,6 +265,7 @@ class LLMEngine:
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._crashed: Optional[str] = None  # set under _lock by the crash sweep
+        self._draining = False  # drain-before-retire: refuse NEW submits only
         self._rid = itertools.count()
         self._admit_seq = itertools.count()
         # Per-engine counters for stats()/tests; the process-global LLM
@@ -284,10 +309,24 @@ class LLMEngine:
         temperature: float = 0.0,
         top_k: int = 0,
         seed: int = 0,
+        resume_tokens=None,
     ) -> LLMRequest:
+        """``resume_tokens``: tokens this request ALREADY emitted on a
+        replica that died mid-stream. They are teacher-forced through
+        chunked prefill exactly like recompute preemption re-admission
+        (they pre-seed the generated list, so admission's target covers
+        them) and are NEVER re-emitted on the token queue — the stream
+        continues from position len(resume_tokens), bit-identically under
+        the counter-based per-request RNG stream."""
         tokens = [int(t) for t in tokens]
         if not tokens:
             raise ValueError("empty prompt")
+        resume = [int(t) for t in (resume_tokens or ())]
+        if len(resume) > int(max_new_tokens):
+            raise ValueError(
+                f"resume_tokens ({len(resume)}) exceeds max_new_tokens "
+                f"({max_new_tokens})"
+            )
         if len(tokens) + int(max_new_tokens) > self.max_model_len:
             raise ValueError(
                 f"prompt ({len(tokens)}) + max_new_tokens ({max_new_tokens}) "
@@ -305,11 +344,20 @@ class LLMEngine:
         req = LLMRequest(
             f"llm-{next(self._rid)}", tokens, max_new_tokens, temperature, top_k, seed
         )
+        req._sched_generated = resume
         # Reuse applies to blocks fully inside tokens[:-1]: at least one
         # prompt token always runs through prefill so admission has logits
         # to sample the first output from.
         n_hashable = (len(tokens) - 1) // self.block_size
         req._sched_hashes = block_hashes(tokens, self.block_size)[:n_hashable]
+        if len(resume) >= int(max_new_tokens):
+            # Already complete on arrival (the dead replica emitted the last
+            # token but not the terminal event): nothing to generate.
+            req._finished = True
+            req._sched_state = "done"
+            req.t_done = time.monotonic()
+            req._q.put(("done", "complete"))
+            return req
         with self._lock:
             # A stopped scheduler can never serve this request — fail the
             # submit instead of parking the consumer on a queue nobody
@@ -320,6 +368,16 @@ class LLMEngine:
             # after shutdown() re-open submits by clearing _crashed.
             if self._crashed is not None:
                 raise RuntimeError(self._crashed)
+            if self._draining:
+                # TYPED: a submit racing the replica-gate/engine-drain
+                # window must read as went-away to the proxy/handle (one
+                # bounded reassign), not as an app bug 500.
+                from ray_tpu.exceptions import ReplicaDrainingError
+
+                raise ReplicaDrainingError(
+                    msg="llm engine is draining (replica retiring); "
+                    "resubmit on another replica"
+                )
             self._waiting.append(req)
         self._wake.set()
         return req
@@ -332,6 +390,15 @@ class LLMEngine:
         self._wake.set()
 
     @any_thread
+    def drain(self):
+        """Drain-before-retire: refuse NEW submits; everything already
+        accepted (running slots + the wait queue — their clients hold live
+        streams) decodes to completion. The replica retires once its
+        in-flight work hits zero or drain_timeout_s expires."""
+        with self._lock:
+            self._draining = True
+
+    @any_thread
     def stats(self) -> dict:
         """Best-effort snapshot (plain-int reads) for tests and benches."""
         return {
@@ -340,6 +407,7 @@ class LLMEngine:
             "cached_blocks": len(self._prefix),
             "running": sum(r is not None for r in self._slots),
             "waiting": len(self._waiting),
+            "draining": self._draining,
             **self._counts,
         }
 
@@ -392,7 +460,7 @@ class LLMEngine:
                 pending = list(self._slots) + list(self._waiting)
             for req in pending:
                 if req is not None:
-                    self._finish(req, error="engine shutdown")
+                    self._finish(req, error=SHUTDOWN_ERROR)
 
     def _sweep_cancelled(self):
         for req in self._slots:
@@ -651,7 +719,11 @@ class LLMEngine:
         logits -= logits.max()
         p = np.exp(logits)
         p /= p.sum()
-        return int(req.rng.choice(len(p), p=p))
+        # Counter-based draw: (seed, position) fully determines the token,
+        # so a resumed request samples position k identically on any
+        # replica (the migration bit-exactness contract).
+        rng = np.random.default_rng((req.seed, len(req._sched_generated)))
+        return int(rng.choice(len(p), p=p))
 
     def _emit_token(self, req: LLMRequest, logits_row: np.ndarray):
         tok = self._sample(req, logits_row)

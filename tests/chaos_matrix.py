@@ -29,7 +29,16 @@ Workloads (each a few seconds unfaulted):
   llm        one LLM-engine streaming request (streaming generator task)
 
 Faults: drop, delay, dup, reset, partition (a victim node severed via
-Cluster.partition_node and healed mid-workload by a timer).
+Cluster.partition_node and healed mid-workload by a timer), and kill —
+the CRASH column: a seeded plan pushed into the workload's WORKER
+processes makes one SIGKILL itself at the Nth matching frame (the raylets
+share the test process and cannot be killed; for the two raylet-plane
+workloads with no worker in the data path — pull, broadcast — the cell
+SIGKILLs a bystander worker via Cluster.kill_role instead, asserting
+crash NON-interference). Kill evidence comes from the flight recorder:
+the dying side stamps ``chaos_kill`` into its mmap ring first, and the
+cell harvests those events from the node postmortem into the injection
+log, since the killed process's in-memory plan.log dies with it.
 """
 
 from __future__ import annotations
@@ -40,7 +49,7 @@ import time
 
 import numpy as np
 
-FAULTS = ("drop", "delay", "dup", "reset", "partition")
+FAULTS = ("drop", "delay", "dup", "reset", "partition", "kill")
 WORKLOAD_NAMES = ("tasks", "actors", "pull", "broadcast", "devobj", "pipeline", "llm")
 
 # Methods whose frames each workload's hot path rides (drop/reset target
@@ -57,6 +66,21 @@ _METHODS = {
     "pipeline": ["channel_doorbell", "channel_data", "actor_call",
                  "channel_create"],
     "llm": ["stream_item", "lease_exec", "tasks_done", "push_task"],
+}
+
+# Crash column: per-workload kill rules for the WORKER-side frames the
+# workload rides (the plan is pushed into worker processes; a raylet-plane
+# frame can never match there). `after` picks the Nth matching frame —
+# counted firing, no RNG — so the kill point is deterministic per seed by
+# construction. pull/broadcast have no worker in their data path and use
+# the kill_role bystander kill instead.
+_KILL_RULES = {
+    "tasks": {"method": ["task_done", "tasks_done"], "after": 1},
+    "actors": {"method": ["actor_call"], "side": "resp", "after": 2},
+    "devobj": {"method": ["task_done", "tasks_done"], "after": 0},
+    "pipeline": {"method": ["channel_doorbell", "channel_data", "actor_call"],
+                 "after": 2},
+    "llm": {"method": ["stream_item"], "after": 2},
 }
 
 # Typed failure contract (a): a cell may surface a RayTpuError subclass
@@ -101,8 +125,15 @@ class CellResult:
 def fault_plan(fault: str, workload: str) -> dict | None:
     """The seeded plan spec for one cell. Bounded (``times``) so every cell
     can complete; `partition` returns None — it is driven by
-    partition_node + a heal timer instead of frame rules."""
+    partition_node + a heal timer instead of frame rules; `kill` returns
+    the worker-push plan (or None for the kill_role workloads) — run_cell
+    installs it in the WORKER processes, never this one."""
     methods = _METHODS[workload]
+    if fault == "kill":
+        rule = _KILL_RULES.get(workload)
+        if rule is None:
+            return None  # pull/broadcast: kill_role bystander crash
+        return {"rules": [dict(rule, kind="kill", times=1)]}
     if fault == "drop":
         return {"rules": [{"kind": "drop", "method": methods, "every": 2, "times": 4}]}
     if fault == "delay":
@@ -304,7 +335,11 @@ def _wl_llm(ctx):
     worker; the KV-block free list must drain back to full."""
     import ray_tpu
 
-    @ray_tpu.remote(num_returns="streaming", max_retries=2)
+    # max_retries exceeds the cluster's warm-worker count: a kill-cell
+    # retry can land on ANOTHER armed worker (its own kill rule unfired —
+    # only the streaming worker emits stream_item) and die again; the
+    # attempt budget must outlast every armed worker once.
+    @ray_tpu.remote(num_returns="streaming", max_retries=5)
     def llm_stream(n_tokens):
         import jax
         import jax.numpy as jnp
@@ -386,6 +421,55 @@ def leak_check(ctx, baseline: dict, settle_s: float = 20.0) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# kill-cell plumbing (crash column)
+# ---------------------------------------------------------------------------
+
+
+def _live_worker_clients(ctx):
+    out = []
+    for n in ctx["nodes"]:
+        for w in n.workers.values():
+            if w.client is not None and w.state not in ("starting", "dead"):
+                out.append(w)
+    return out
+
+
+def _push_plan_to_workers(ctx, plan, seed) -> list:
+    """Install a plan in every live WORKER process (the kill victims); the
+    driver/raylet process never sees it. Returns the workers reached."""
+    io, pushed = ctx["io"], []
+    for w in _live_worker_clients(ctx):
+        try:
+            io.run(
+                w.client.acall(
+                    "chaos_set_plan", {"plan": plan, "seed": seed},
+                    timeout=5, retries=0,
+                ),
+                timeout=6,
+            )
+            pushed.append(w)
+        except Exception:
+            pass  # already-dying workers are, well, chaos
+    return pushed
+
+
+def _collect_kill_events(ctx, since_wall: float) -> list:
+    """The killed process's plan.log died with it; its chaos_kill flight
+    event survived in the mmap ring. Harvest the node postmortem (raylets
+    share one session flight dir) into the cell's injection log."""
+    try:
+        resp = ctx["io"].run(ctx["nodes"][0].rpc_debug_dump({}), timeout=15)
+    except Exception:
+        return []
+    out = []
+    for proc in resp.get("processes", []):
+        for ev in proc.get("events", []):
+            if ev.get("type") == "chaos_kill" and ev.get("ts", 0) >= since_wall - 2.0:
+                out.append(f"kill:{ev.get('detail', '')}")
+    return out
+
+
+# ---------------------------------------------------------------------------
 # the cell runner
 # ---------------------------------------------------------------------------
 
@@ -407,9 +491,45 @@ def run_cell(ctx, workload: str, fault: str, seed: int,
     injected_before = CHAOS_STATS.injected
     heal_timer = None
     plan = None
+    pushed_kill: list = []
+    t_wall0 = time.time()
     t0 = time.monotonic()
     try:
-        if fault == "partition":
+        if fault == "kill":
+            spec = fault_plan("kill", workload)
+            if spec is None:
+                # Raylet-plane workload: SIGKILL a bystander worker process
+                # (crash NON-interference — the data path must not notice).
+                # Earlier kill cells may have eaten every warm worker, so
+                # spawn one to sacrifice if none is live.
+                if not ctx["cluster"]._live_workers():
+                    import ray_tpu
+
+                    @ray_tpu.remote
+                    def _sacrifice():
+                        return 1
+
+                    assert ray_tpu.get(_sacrifice.remote(), timeout=60) == 1
+                ctx["cluster"].kill_role("worker")
+            else:
+                # Arm AFTER re-warming the worker pool: a prior cell may
+                # have consumed workers (the actors workload kills its
+                # actor workers), and a workload task landing in a FRESH
+                # worker spawned after the push would run unarmed — the
+                # cell would pass with zero injections, which the subset
+                # rightly rejects.
+                import ray_tpu
+
+                @ray_tpu.remote
+                def _warm_pool():
+                    return 1
+
+                ray_tpu.get(
+                    [_warm_pool.remote() for _ in range(len(ctx["nodes"]))],
+                    timeout=60,
+                )
+                pushed_kill = _push_plan_to_workers(ctx, spec, seed)
+        elif fault == "partition":
             # Sever a victim raylet (never nodes[0]: the driver's head node
             # going dark is driver death, a different chaos class), heal
             # mid-workload. The window stays under node_death_timeout_s so
@@ -436,9 +556,27 @@ def run_cell(ctx, workload: str, fault: str, seed: int,
             ctx["cluster"].heal_node(ctx["nodes"][1])
         if plan is not None:
             res.injection_log = list(plan.log)
+        if pushed_kill:
+            # Disarm survivors (the fired victim is dead and unreachable).
+            for w in pushed_kill:
+                try:
+                    ctx["io"].run(
+                        w.client.acall(
+                            "chaos_set_plan", {"plan": None}, timeout=5, retries=0
+                        ),
+                        timeout=6,
+                    )
+                except Exception:
+                    pass
         chaos.clear()
     res.elapsed = time.monotonic() - t0
     res.injected = CHAOS_STATS.injected - injected_before
+    if fault == "kill":
+        # Kill evidence lives in the flight postmortem, not this process's
+        # counters (the victim's plan died with it; kill_role stamps the
+        # driver ring, plan-driven kills stamp the victim's).
+        res.injection_log = _collect_kill_events(ctx, t_wall0)
+        res.injected = max(res.injected, len(res.injection_log))
     res.leaks = leak_check(ctx, baseline)
     return res
 

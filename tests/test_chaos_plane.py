@@ -82,6 +82,49 @@ def test_same_seed_same_injection_sequence():
     assert _drive(p3, frames) != _drive(p1, frames)
 
 
+def test_kill_rule_same_seed_same_kill_point():
+    """Crash-column determinism (ISSUE 14 acceptance): the SAME seed over
+    the SAME frame stream selects the SAME kill frame — plan-level replay,
+    exercised here at the decision point only (applying the Action would
+    SIGKILL this test). Probability-thinned kill rules lean on the plan's
+    seeded RNG exactly like the other kinds."""
+    spec = {
+        "rules": [
+            {"kind": "kill", "method": "stream_item", "after": 2, "p": 0.6},
+        ]
+    }
+    rng = random.Random(7)
+    frames = [rng.choice(["stream_item", "other"]) for _ in range(80)]
+    runs = []
+    for _ in range(2):
+        plan = FaultPlan(spec, seed=21, allow_kill=True)
+        got = _drive(plan, frames)
+        # In reality the first fire is terminal (the process dies at that
+        # frame); the decision point keeps going, which is exactly what
+        # lets a REPLAY walk the same stream. The kill POINT is fire #1.
+        assert "kill" in got, got
+        runs.append((got.index("kill"), got, list(plan.log)))
+    assert runs[0] == runs[1]
+    # A different seed moves the p-thinned injection schedule.
+    alt = _drive(FaultPlan(spec, seed=22, allow_kill=True), frames)
+    assert alt != runs[0][1]
+
+
+def test_kill_rule_refused_on_direct_install():
+    """Foot-gun guard: a kill rule SIGKILLs the INSTALLING process, so the
+    direct in-process install path refuses it — only the remote push paths
+    (chaos_set_plan RPC, env inheritance at boot) arm kill rules."""
+    with pytest.raises(ValueError, match="kill"):
+        chaos.install({"rules": [{"kind": "kill", "method": "x"}]})
+    assert chaos.active() is None
+    # Explicit opt-in works (the victim process installing its own doom).
+    plan = chaos.install(
+        {"rules": [{"kind": "kill", "method": "never_called"}]}, allow_kill=True
+    )
+    assert plan is not None
+    chaos.clear()
+
+
 def test_counted_rules_fire_deterministically():
     plan = FaultPlan(
         {"rules": [{"kind": "drop", "method": "m", "after": 2, "every": 2, "times": 3}]}

@@ -1,0 +1,279 @@
+"""Controller-level drain/retire semantics (ISSUE 14 satellites), white-box:
+the ServeController object is driven directly in the driver process (its
+replicas are real actors on a real cluster, but no proxy/HTTP plane), so the
+raced-stop no-op branch and the retire-vs-drain ordering are pinned without
+a full serve instance. Plus the bounded serve.shutdown() satellite.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.serve._private.common import (
+    DeploymentConfig,
+    DeploymentInfo,
+    ReplicaInfo,
+)
+
+
+@pytest.fixture
+def drain_cluster(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=4, object_store_memory=64 * 1024 * 1024)
+    cluster.connect()
+    cluster.wait_for_nodes()
+    yield cluster
+
+
+class SlowCallable:
+    """Deployment body whose requests take long enough to straddle a drain."""
+
+    def __call__(self, x, delay=0.0):
+        if delay:
+            time.sleep(delay)
+        return x
+
+
+def _make_controller(name="draindep", drain_timeout_s=30.0, num_replicas=1):
+    import cloudpickle
+
+    from ray_tpu.serve._private.controller import ServeController
+
+    controller = ServeController()
+    info = DeploymentInfo(
+        name=name,
+        app_name="t",
+        import_spec=cloudpickle.dumps((SlowCallable, (), {})),
+        config=DeploymentConfig(
+            num_replicas=num_replicas,
+            version="v1",
+            drain_timeout_s=drain_timeout_s,
+            health_check_period_s=0.5,
+            health_check_timeout_s=5.0,
+        ),
+    )
+    controller.deploy([info])
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if len(controller._replicas.get(name, [])) >= num_replicas:
+            return controller, name
+        time.sleep(0.1)
+    controller.graceful_shutdown()
+    raise TimeoutError("replicas never came up")
+
+
+def test_retire_raced_deliberate_stop_is_noop(drain_cluster):
+    """Satellite: _retire_unhealthy_replica on a replica that is NOT in the
+    routing table and NOT draining (a raced deliberate stop already took
+    it) must be a pure no-op — no epoch bump, no kill, no state change."""
+    controller, name = _make_controller(drain_timeout_s=0.0)
+    try:
+        rinfo = controller._replicas[name][0]
+        ghost = ReplicaInfo(
+            replica_id="gone1234",
+            deployment_name=name,
+            actor_name="SERVE_REPLICA::ghost",
+            max_concurrent_queries=10,
+            version="v1",
+        )
+        epoch_before = controller._epoch
+        controller._retire_unhealthy_replica(name, ghost)
+        assert controller._epoch == epoch_before
+        assert controller._replicas[name] == [rinfo]
+        # The live replica still answers.
+        handle = controller._replica_handles[rinfo.replica_id]
+        assert ray_tpu.get(
+            handle.handle_request.remote("__call__", (7,), {}), timeout=60
+        ) == 7
+    finally:
+        controller.graceful_shutdown()
+
+
+def test_health_failure_mid_drain_retires_immediately(drain_cluster):
+    """Satellite: retire-vs-drain ordering. A deliberate stop starts a
+    drain (busy replica -> the drainer waits); a health-check failure DURING
+    the drain must claim the drain record and kill NOW — the drainer thread
+    yields instead of racing a second kill."""
+    controller, name = _make_controller(drain_timeout_s=60.0)
+    try:
+        rinfo = controller._replicas[name][0]
+        handle = controller._replica_handles[rinfo.replica_id]
+        # Occupy the replica so the drain cannot complete on its own.
+        busy_ref = handle.handle_request.remote("__call__", (1,), {"delay": 20.0})
+        time.sleep(0.3)
+        controller._stop_replica(name, rinfo)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if rinfo.replica_id in controller._draining:
+                break
+            time.sleep(0.05)
+        assert rinfo.replica_id in controller._draining, "drain never started"
+        # The drainer thread's drain() RPC lands asynchronously; wait for
+        # the replica to observe it (still busy with the slow request).
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            st = ray_tpu.get(handle.drain_status.remote(), timeout=30)
+            if st["draining"]:
+                break
+            time.sleep(0.05)
+        assert st["draining"] is True and st["ongoing"] == 1, st
+        # Health failure outranks the drain: immediate retire.
+        controller._retire_unhealthy_replica(name, rinfo)
+        assert rinfo.replica_id not in controller._draining
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                ray_tpu.get(handle.drain_status.remote(), timeout=2)
+            except Exception:
+                break  # actor is gone — the kill landed
+            time.sleep(0.2)
+        else:
+            pytest.fail("replica survived a health-failure retire mid-drain")
+        with pytest.raises(Exception):
+            ray_tpu.get(busy_ref, timeout=30)
+    finally:
+        controller.graceful_shutdown()
+
+
+def test_idle_replica_drains_clean_and_retires(drain_cluster):
+    """A deliberate stop of an idle replica drains 'clean' within one poll
+    and the process is retired; the drain record does not leak."""
+    controller, name = _make_controller(drain_timeout_s=30.0)
+    try:
+        rinfo = controller._replicas[name][0]
+        handle = controller._replica_handles[rinfo.replica_id]
+        controller._stop_replica(name, rinfo)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if rinfo.replica_id not in controller._draining:
+                try:
+                    ray_tpu.get(handle.drain_status.remote(), timeout=2)
+                except Exception:
+                    break  # retired
+            time.sleep(0.2)
+        else:
+            pytest.fail("idle replica did not retire after clean drain")
+        assert rinfo.replica_id not in controller._draining
+        assert rinfo not in controller._replicas.get(name, [])
+    finally:
+        controller.graceful_shutdown()
+
+
+def test_drain_status_excludes_abandoned_pumps():
+    """Clusterless pin: while DRAINING, a stream pump nobody polled for
+    _DRAIN_IDLE_EXCLUDE_S is an orphan (its proxy died without
+    cancel_stream) and must not hold the drain open for the whole
+    drain_timeout_s — the normal 300s idle reaper only runs from
+    handle_http_request, which the drain gate refuses. The orphan is
+    EXCLUDED from the count, not cancelled: a slow-but-alive consumer must
+    never be silently truncated — at retire its next poll gets the typed
+    went-away error (and resumable streams migrate)."""
+    import cloudpickle
+
+    from ray_tpu.serve._private.replica import Replica
+
+    r = Replica(cloudpickle.dumps((SlowCallable, (), {})))
+
+    class FakePump:
+        def __init__(self, age_s):
+            self.last_pump = time.time() - age_s
+            self.cancels = 0
+
+        def cancel(self):
+            self.cancels += 1
+
+    orphan, live = FakePump(60.0), FakePump(0.0)
+    r._streams = {"1": orphan, "2": live}
+    # Not draining: every pump counts.
+    assert r.drain_status()["streams"] == 2
+    r.drain()
+    st = r.drain_status()
+    assert st["streams"] == 1
+    # Nothing was cancelled or removed — no silent truncation.
+    assert orphan.cancels == 0 and live.cancels == 0
+    assert set(r._streams) == {"1", "2"}
+
+
+def test_resource_stalled_rollout_force_retires_undrained(ray_start_cluster):
+    """The stall-breaker survives the drain change, with its trigger
+    narrowed to GENUINE placement stalls: on a 1-CPU cluster the v2
+    replica cannot place while v1 holds the CPU (tracked actor PENDING),
+    so after the 3s stall window ONE old replica is force-retired WITHOUT
+    drain and the rollout completes. (A placed-but-slow-starting replica
+    no longer trips this branch — that robbed drains; pinned by the
+    rolling-update drain oracle in test_serve_ft.py.)"""
+    import cloudpickle
+
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1, object_store_memory=64 * 1024 * 1024)
+    cluster.connect()
+    cluster.wait_for_nodes()
+    controller, name = _make_controller(drain_timeout_s=60.0)
+    try:
+        old = controller._replicas[name][0]
+        info2 = DeploymentInfo(
+            name=name,
+            app_name="t",
+            import_spec=cloudpickle.dumps((SlowCallable, (), {})),
+            config=DeploymentConfig(
+                num_replicas=1, version="v2", drain_timeout_s=60.0,
+                health_check_period_s=0.5, health_check_timeout_s=5.0,
+            ),
+        )
+        controller.deploy([info2])
+        saw_drain = False
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            saw_drain = saw_drain or old.replica_id in controller._draining
+            reps = controller._replicas.get(name, [])
+            if reps and all(r.version == "v2" for r in reps):
+                break
+            time.sleep(0.05)
+        reps = controller._replicas.get(name, [])
+        assert reps and all(r.version == "v2" for r in reps), (
+            f"resource-stalled rollout never completed: {reps}"
+        )
+        # The old replica was retired through the FORCED (undrained) path.
+        assert not saw_drain, "stall-breaker routed through drain"
+    finally:
+        controller.graceful_shutdown()
+
+
+def test_serve_shutdown_bounds_wedged_controller(drain_cluster):
+    """Satellite: serve.shutdown() used to hang FOREVER on an unbounded
+    get against a wedged controller; now it is bounded, force-kills the
+    controller, and raises the typed error NAMING it."""
+    from ray_tpu import serve
+    from ray_tpu.exceptions import ActorUnavailableError
+    from ray_tpu.serve._private.common import CONTROLLER_NAME
+
+    class Wedged:
+        def shutdown_proxies(self):
+            return True
+
+        def graceful_shutdown(self):
+            time.sleep(600)  # the wedge
+
+    ray_tpu.remote(name=CONTROLLER_NAME)(Wedged).remote()
+    # Wait for the fake controller to be resolvable by name.
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        try:
+            ray_tpu.get_actor(CONTROLLER_NAME)
+            break
+        except Exception:
+            time.sleep(0.1)
+    t0 = time.monotonic()
+    with pytest.raises(ActorUnavailableError, match=CONTROLLER_NAME):
+        serve.shutdown(timeout_s=3.0)
+    assert time.monotonic() - t0 < 30.0, "shutdown was not bounded"
+    # The wedged controller was force-killed.
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            ray_tpu.get_actor(CONTROLLER_NAME)
+            time.sleep(0.2)
+        except Exception:
+            return
+    pytest.fail("wedged controller was not force-killed")

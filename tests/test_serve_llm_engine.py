@@ -382,6 +382,71 @@ def test_sampling_seeded_reproducible(model):
         eng.shutdown()
 
 
+@pytest.mark.parametrize(
+    "sampling",
+    [dict(temperature=0.0), dict(temperature=0.9, top_k=16, seed=7)],
+    ids=["greedy", "sampled"],
+)
+def test_resume_tokens_bit_identical(model, sampling):
+    """THE migration oracle (ISSUE 14), engine half: a request resumed on a
+    SECOND engine with resume_tokens= (the tokens the dead replica already
+    emitted) continues BIT-IDENTICALLY — teacher-forced through chunked
+    prefill like recompute preemption, nothing re-emitted — in both the
+    greedy and seeded-sampling arms (the counter-based per-request RNG
+    stream makes position k's draw replica-independent). KV blocks of both
+    engines return to baseline."""
+    from ray_tpu.serve.llm import LLMEngine
+
+    params, cfg = model
+    prompt = _rand_prompt(31, 7)
+    eng_a = LLMEngine(params, cfg, num_slots=2, block_size=4,
+                      max_model_len=32, prefill_chunk=4)
+    eng_b = LLMEngine(params, cfg, num_slots=2, block_size=4,
+                      max_model_len=32, prefill_chunk=4)
+    try:
+        full = eng_a.submit(prompt, max_new_tokens=8, **sampling).result(60)
+        assert len(full) == 8
+        for cut in (1, 4, 7, 8):
+            resumed = eng_b.submit(
+                prompt, max_new_tokens=8, resume_tokens=full[:cut], **sampling
+            ).result(60)
+            # Only the continuation is emitted; full sequence identical.
+            assert resumed == full[cut:], (cut, resumed, full)
+        for eng in (eng_a, eng_b):
+            s = eng.stats()
+            assert s["free_blocks"] + s["cached_blocks"] == s["num_blocks"], s
+    finally:
+        eng_a.shutdown()
+        eng_b.shutdown()
+
+
+def test_drain_refuses_new_submits_finishes_running(model):
+    """Engine half of drain-before-retire: drain() refuses NEW submits with
+    the TYPED ReplicaDrainingError (the proxy/handle reassign on it; an
+    untyped error here 500s a client caught in the replica-gate/engine-
+    drain race) while already-accepted requests decode to completion and
+    release their blocks."""
+    from ray_tpu.exceptions import ReplicaDrainingError
+    from ray_tpu.serve.llm import LLMEngine
+
+    params, cfg = model
+    eng = LLMEngine(params, cfg, num_slots=2, block_size=4,
+                    max_model_len=32, prefill_chunk=4)
+    try:
+        prompt = _rand_prompt(5, 6)
+        req = eng.submit(prompt, max_new_tokens=6)
+        eng.drain()
+        with pytest.raises(ReplicaDrainingError, match="draining"):
+            eng.submit(prompt, max_new_tokens=2)
+        assert req.result(60) == _dense(params, cfg, prompt, 6)
+        s = eng.stats()
+        assert s["draining"] is True
+        assert s["running"] == 0 and s["waiting"] == 0
+        assert s["free_blocks"] + s["cached_blocks"] == s["num_blocks"], s
+    finally:
+        eng.shutdown()
+
+
 def test_flight_events_recorded(model, tmp_path):
     """llm_admit/llm_prefix_hit land in the flight ring (codes 34+)."""
     from ray_tpu._private import flight_recorder as fr
