@@ -1,0 +1,64 @@
+"""Podracer learner/sampler topology on IMPALA (arXiv:2104.06272).
+
+The Podracer shape: ONE learner holding params on its device mesh, a fleet
+of CPU env actors feeding rollouts through the object store, and
+per-iteration weight sync as ONE device-object group broadcast instead of
+K per-worker pytree ships:
+
+- ``learner_mesh=True``   — the learner's jitted update runs on a pjit mesh
+  over every local device (batch sharded on the data axis, params
+  replicated); on a 1-chip host the mesh is trivial, on a TPU host the
+  same config uses all chips.
+- ``weight_sync="device_broadcast"`` — the learner packs its params into
+  one flat device-resident vector, seals ONE descriptor, and
+  ``device_object.broadcast`` fans the payload to every sampler's direct
+  mailbox with one group operation (cpu mailbox backend here; the
+  tpu backend maps the same seam to an ICI broadcast on hardware).
+
+Run: python examples/podracer_impala.py [iters]
+"""
+
+import sys
+
+
+def main(iters: int = 3):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import ray_tpu
+    from ray_tpu.rllib import IMPALAConfig
+
+    ray_tpu.init(num_cpus=6)
+    cfg = (
+        IMPALAConfig()
+        .environment("CartPole-v1")
+        .rollouts(num_rollout_workers=3, rollout_fragment_length=64)
+        .training(
+            lr=5e-4,
+            train_batch_size=384,
+            weight_sync="device_broadcast",
+            learner_mesh=True,
+        )
+        .debugging(seed=0)
+    )
+    algo = cfg.build()
+    try:
+        for i in range(iters):
+            m = algo.step()
+            print(
+                f"iter {i}: reward={m.get('episode_reward_mean'):.1f} "
+                f"loss={m.get('total_loss', float('nan')):.3f}"
+            )
+        from ray_tpu.util.collective.p2p import COLL
+
+        print(
+            f"group broadcasts fanned out by the learner/driver: "
+            f"{COLL.bcast_sends} ({COLL.bcast_send_bytes / 1e6:.1f} MB delivered)"
+        )
+    finally:
+        algo.cleanup()
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 3)

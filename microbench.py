@@ -503,6 +503,160 @@ def device_objects_suite(results, duration):
     ray_tpu.shutdown()
 
 
+def collective_suite(results, quick=False):
+    """--collective: ISSUE 15 — learner→fleet weight-sync fan-out A/B
+    (COLLBENCH_r{N}.json).
+
+    A tensor_transport learner actor holds a payload_mib flat weight vector
+    device-resident; K sampler actors apply it each sync. Baseline arm =
+    the K-serial-unicast path every pre-15 sync paid (each sampler's
+    resolve does its own devobj_pull → holder serializes PER SAMPLER and
+    ships through the group's GCS-KV mailbox). Broadcast arm = ONE
+    device_object.broadcast(ref, group): one serialize, concurrent acked
+    chunk pushes at every sampler's direct mailbox, samplers resolve from
+    their inbox with zero pull round trips. Both arms end in the same
+    state (every sampler applied the weights), timed over the same actors
+    in the same cluster; the device path's zero-host-store evidence
+    (store_objects_delta) rides along. An end-to-end Podracer row (IMPALA
+    on CartPole, device_broadcast vs host weight sync) closes the loop."""
+    import ray_tpu
+    from ray_tpu._private import worker_context
+    from ray_tpu.experimental import device_object
+    from ray_tpu.util import collective as col
+
+    fleet = [2] if quick else [2, 4, 8]
+    # 8 MiB ≈ a 2M-param f32 model: big enough that the payload path (the
+    # thing this issue changes) dominates the K fixed-cost actor round
+    # trips both arms share.
+    payload_mib = 2 if quick else 8
+    reps = 2 if quick else 5
+    n = payload_mib * 1024 * 1024 // 4
+    ray_tpu.init(num_cpus=16, object_store_memory=512 * 1024 * 1024)
+    cw = worker_context.get_core_worker()
+
+    def store_objects() -> int:
+        return cw.raylet.call("get_state")["store"]["num_objects"]
+
+    @ray_tpu.remote(tensor_transport="collective")
+    class LearnerActor:
+        def __init__(self):
+            self._version = 0
+
+        def init_collective(self, world_size, rank, backend, group_name):
+            col.init_collective_group(world_size, rank, backend=backend, group_name=group_name)
+
+        def make_weights(self, n):
+            import jax.numpy as jnp
+
+            self._version += 1
+            return jnp.full((n,), float(self._version), jnp.float32)
+
+        def residents(self):
+            from ray_tpu.experimental.device_object import device_object_stats
+
+            return device_object_stats()["resident_count"]
+
+    @ray_tpu.remote
+    class SamplerActor:
+        def init_collective(self, world_size, rank, backend, group_name):
+            col.init_collective_group(world_size, rank, backend=backend, group_name=group_name)
+
+        def apply(self, w):
+            # Arg resolution already resolved the descriptor (inbox on the
+            # broadcast arm, devobj_pull unicast on the baseline arm).
+            return float(w[0])
+
+    results["collective_payload_mib"] = payload_mib
+    for K in fleet:
+        learner = LearnerActor.remote()
+        samplers = [SamplerActor.remote() for _ in range(K)]
+        group = f"wsync{K}"
+        col.create_collective_group([learner] + samplers, backend="cpu", group_name=group)
+
+        def sync_serial():
+            ref = learner.make_weights.remote(n)
+            t0 = time.perf_counter()
+            for s in samplers:
+                ray_tpu.get(s.apply.remote(ref), timeout=120)
+            return time.perf_counter() - t0
+
+        def sync_broadcast():
+            ref = learner.make_weights.remote(n)
+            t0 = time.perf_counter()
+            info = device_object.broadcast(ref, group, timeout=120)
+            assert len(info["ok_ranks"]) == K, info
+            for s in samplers:
+                ray_tpu.get(s.apply.remote(ref), timeout=120)
+            return time.perf_counter() - t0
+
+        sync_serial()  # warm both code paths + worker jax imports
+        sync_broadcast()
+        serial = sorted(sync_serial() for _ in range(reps))[reps // 2]
+        # Snapshot AFTER the serial arm so the delta certifies the
+        # broadcast arm alone.
+        before = store_objects()
+        bcast = sorted(sync_broadcast() for _ in range(reps))[reps // 2]
+        results[f"wsync_serial_k{K}_s"] = round(serial, 4)
+        results[f"wsync_broadcast_k{K}_s"] = round(bcast, 4)
+        results[f"wsync_serial_k{K}_mib_per_s"] = round(K * payload_mib / serial, 1)
+        results[f"wsync_broadcast_k{K}_mib_per_s"] = round(K * payload_mib / bcast, 1)
+        results[f"wsync_speedup_k{K}"] = round(serial / bcast, 2)
+        results[f"wsync_broadcast_k{K}_store_objects_delta"] = store_objects() - before
+        # Ownership protocol: per-sync weight refs were dropped, so the
+        # learner's residents must drain back to zero (bounded wait for the
+        # async devobj_free pushes).
+        deadline = time.monotonic() + 30
+        residents = ray_tpu.get(learner.residents.remote())
+        while residents > 0 and time.monotonic() < deadline:
+            time.sleep(0.2)
+            residents = ray_tpu.get(learner.residents.remote())
+        results[f"wsync_k{K}_residents_after"] = residents
+        for a in [learner] + samplers:
+            ray_tpu.kill(a)
+    ray_tpu.shutdown()
+
+    # ---- end-to-end Podracer row: IMPALA on CartPole, host vs device sync ----
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from ray_tpu.rllib.algorithms.impala import IMPALAConfig
+
+    iters = 2 if quick else 4
+    for label, overrides in (
+        ("host", {"weight_sync": "host"}),
+        ("device_broadcast", {"weight_sync": "device_broadcast", "learner_mesh": True}),
+    ):
+        ray_tpu.init(num_cpus=6)
+        cfg = (
+            IMPALAConfig()
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=2, rollout_fragment_length=32)
+            .training(lr=5e-4, train_batch_size=128, **overrides)
+            .debugging(seed=0)
+        )
+        algo = cfg.build()
+        try:
+            # Warm compile + worker spawn outside the window. TWO steps: the
+            # mesh arm pays a second jit (committed-param avals) on step 2.
+            algo.step()
+            algo.step()
+            from ray_tpu.util.collective.p2p import COLL
+
+            bcasts0 = COLL.bcast_sends
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                algo.step()
+            dt = time.perf_counter() - t0
+            results[f"podracer_{label}_iters_per_s"] = round(iters / dt, 2)
+            if label == "device_broadcast":
+                # Every measured iteration's weight sync must actually have
+                # ridden the group-broadcast plane (driver = holder here).
+                results["podracer_device_broadcasts"] = COLL.bcast_sends - bcasts0
+        finally:
+            algo.cleanup()
+        ray_tpu.shutdown()
+
+
 def recorder_overhead_suite(results, block_tasks=256, pairs=150):
     """--recorder-overhead: cost of the always-on observability plane
     (flight recorder + 1-in-64 sampled hop stamps) on the task_sync hot
@@ -1661,6 +1815,14 @@ def main():
         "CHAOSBENCH_r{N}.json",
     )
     ap.add_argument(
+        "--collective",
+        action="store_true",
+        help="group-broadcast weight-sync A/B (ISSUE 15): device-object "
+        "broadcast vs K-serial-unicast at fleet sizes K, latency + "
+        "aggregate MiB/s, zero-host-store evidence, and an end-to-end "
+        "Podracer IMPALA iterations/s row; records COLLBENCH_r{N}.json",
+    )
+    ap.add_argument(
         "--transfer",
         action="store_true",
         help="transfer-plane A/B (ISSUE 10): cut-through broadcast at the "
@@ -1795,6 +1957,17 @@ def main():
         chaos_suite(results, quick=args.quick)
         results["wall_s"] = round(time.perf_counter() - t0, 1)
         out = args.out or f"CHAOSBENCH_r{args.round}.json"
+        with open(out, "w") as f:
+            json.dump(results, f, indent=1)
+        print(json.dumps(results))
+        return
+
+    if args.collective:
+        results = {"host_cpus": os.cpu_count(), "mode": "collective"}
+        t0 = time.perf_counter()
+        collective_suite(results, quick=args.quick)
+        results["wall_s"] = round(time.perf_counter() - t0, 1)
+        out = args.out or f"COLLBENCH_r{args.round}.json"
         with open(out, "w") as f:
             json.dump(results, f, indent=1)
         print(json.dumps(results))

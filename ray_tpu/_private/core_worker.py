@@ -1176,6 +1176,20 @@ class CoreWorker:
         else:
             await asyncio.wait_for(ev.wait(), timeout)
 
+    @staticmethod
+    def _raise_if_error(value):
+        """The one error surface for materialized values (shared by get()
+        and get_device_meta so new error types never diverge)."""
+        if isinstance(value, TaskError):
+            if isinstance(value.cause, (TaskCancelledError, ActorDiedError)):
+                raise value.cause
+            raise value
+        if isinstance(
+            value,
+            (ObjectLostError, WorkerCrashedError, ActorDiedError, TaskCancelledError, OutOfMemoryError),
+        ):
+            raise value
+
     @blocking
     def get(self, refs, timeout: float | None = None):
         single = not isinstance(refs, list)
@@ -1183,15 +1197,7 @@ class CoreWorker:
         deadline = None if timeout is None else time.monotonic() + timeout
         values = [self._get_one(ref, deadline) for ref in ref_list]
         for v in values:
-            if isinstance(v, TaskError):
-                if isinstance(v.cause, (TaskCancelledError, ActorDiedError)):
-                    raise v.cause
-                raise v
-            if isinstance(
-                v,
-                (ObjectLostError, WorkerCrashedError, ActorDiedError, TaskCancelledError, OutOfMemoryError),
-            ):
-                raise v
+            self._raise_if_error(v)
         return values[0] if single else values
 
     def _remaining(self, deadline) -> float | None:
@@ -1201,6 +1207,24 @@ class CoreWorker:
         if rem <= 0:
             raise GetTimeoutError("ray_tpu.get() timed out")
         return rem
+
+    @blocking
+    def get_device_meta(self, ref, timeout: float | None = None):
+        """The RAW DeviceObjectMeta behind a device-object ref, WITHOUT
+        resolving the payload (device_object.broadcast needs the holder
+        coordinates, not the array). Waits for the descriptor to
+        materialize exactly like get(); raises TypeError for refs that are
+        not device objects."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        value = self._get_one_raw(ref, deadline)
+        self._raise_if_error(value)
+        if type(value).__name__ == "DeviceObjectMeta":
+            return value
+        raise TypeError(
+            f"object {ref.hex()[:12]} is not a device object (resolved to "
+            f"{type(value).__name__}); group broadcast applies to "
+            "tensor_transport= refs"
+        )
 
     def _get_one(self, ref, deadline):
         value = self._get_one_raw(ref, deadline)
@@ -2386,6 +2410,56 @@ class CoreWorker:
         if done and key.startswith("chdev/"):
             self.channels.ring_doorbell(key.split("/", 2)[1])
         return {"ok": True}
+
+    async def rpc_p2p_ack(self, req):
+        """Delivery receipt for a direct-mailbox payload: True once every
+        chunk of ``key`` has landed (including already-taken payloads — the
+        tombstone remembers). The group-broadcast fan-out acalls this after
+        its chunk pushes, turning the one-way frames into a confirmed
+        delivery and a dead member into a NAMED failure."""
+        timeout = min(float(req.get("timeout", 2.0)), 30.0)
+        if self.p2p_inbox.completed(req["key"]):
+            return {"ok": True}
+        loop = asyncio.get_event_loop()
+        ok = await loop.run_in_executor(
+            None, self.p2p_inbox.wait_complete, req["key"], timeout
+        )
+        return {"ok": bool(ok)}
+
+    async def rpc_devobj_broadcast(self, req):
+        """Driver asks this HOLDER to fan a device object out: with a
+        ``group`` (one this process initialized), one group operation
+        delivers to every member's direct mailbox
+        (manager.broadcast_via_group); without one, materialize the host
+        copy into this node's arena so the caller can relay it cluster-wide
+        over the cut-through push tree (the cross-node fallback)."""
+        mgr = self._device_objects
+        oid = req["object_id"]
+        entry = mgr.entry(oid) if mgr is not None else None
+        if entry is None:
+            return {"kind": "missing"}
+        loop = asyncio.get_event_loop()
+        group = req.get("group")
+        if group is not None:
+            from ray_tpu.util.collective import is_group_initialized
+
+            if not is_group_initialized(group):
+                return {
+                    "kind": "error",
+                    "error": f"holder has no collective group {group!r}",
+                }
+            try:
+                result = await loop.run_in_executor(
+                    None, mgr.broadcast_via_group, oid, group,
+                    float(req.get("timeout", 30.0)),
+                )
+            except KeyError:
+                return {"kind": "missing"}
+            return {"kind": "collective", **result}
+        ok = await loop.run_in_executor(None, mgr.materialize_to_store, oid)
+        if ok:
+            return {"kind": "plasma", "location": self.node_id}
+        return {"kind": "missing"}
 
     async def rpc_devobj_stats(self, req):
         from ray_tpu.experimental.device_object.manager import device_object_stats

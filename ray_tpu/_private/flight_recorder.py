@@ -115,6 +115,8 @@ EVENT_TYPES = (
     "chaos_kill",      # 41: this process SIGKILLs itself at a frame (detail peer:method) — last words, ring survives
     "llm_migrate",     # 42: mid-stream LLM request migrated to another replica (detail deployment:ntok)
     "replica_drain",   # 43: serve replica drain begin/done (detail replica_id:phase)
+    # Group collectives on the device-object plane (PR 15).
+    "coll_broadcast",  # 44: holder fanned a device object to a group (detail oid:group:ok/targets:bytes)
 )
 _CODE = {name: i for i, name in enumerate(EVENT_TYPES)}
 

@@ -908,6 +908,28 @@ class RpcClient:
             if pending is not None:
                 await pending
 
+    @staticmethod
+    def pack_push_frame(method: str, payload: dict) -> bytes:
+        """Encode a one-way PUSH frame for apush_packed. seq is fixed at 0:
+        PUSH dispatch never consults it (no response to pair), so the same
+        bytes are valid on every connection."""
+        return _pack([PUSH, 0, method, payload])
+
+    async def apush_packed(self, method: str, frame: bytes):
+        """One-way push of a PRE-PACKED frame (see pack_push_frame). The
+        group-broadcast fan-out encodes each multi-MiB chunk frame ONCE and
+        writes the same bytes down every member connection — K-1 msgpack
+        encodes saved per chunk is most of the fan-out's CPU at scale.
+        ``method`` is passed for the chaos/observability seam only; the
+        wire bytes are ``frame`` verbatim."""
+        async with self._lock:
+            await self._ensure_connected()
+            self._seq += 1
+            self._send_frames(method, [frame])
+            pending = _drain_if_needed(self._writer)
+            if pending is not None:
+                await pending
+
     # ---- blocking API (from user threads) ----
 
     @blocking

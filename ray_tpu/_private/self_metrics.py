@@ -288,6 +288,37 @@ def instruments() -> dict:
                 "ray_tpu_devobj_restores_total",
                 "Spilled device objects restored host->device.",
             ),
+            # --- group collectives (util/collective, PR 15) ---
+            "collective_broadcasts": m.Counter(
+                "ray_tpu_collective_broadcasts_total",
+                "Group broadcasts fanned out by this process (one per "
+                "device_object.broadcast on the holder).",
+            ),
+            "collective_broadcast_bytes": m.Counter(
+                "ray_tpu_collective_broadcast_bytes_total",
+                "Serialized payload bytes delivered by group broadcasts "
+                "(payload size x delivered ranks).",
+            ),
+            "collective_bcast_recvs": m.Counter(
+                "ray_tpu_collective_bcast_recvs_total",
+                "Payloads this process took from its broadcast landing zone "
+                "(descriptor resolves + explicit bcast_recv_payload).",
+            ),
+            "collective_bcast_fallbacks": m.Counter(
+                "ray_tpu_collective_bcast_fallbacks_total",
+                "Per-rank broadcast deliveries that fell back to the GCS-KV "
+                "mailbox (member without a registered address).",
+            ),
+            "collective_bcast_failed_ranks": m.Counter(
+                "ray_tpu_collective_bcast_failed_ranks_total",
+                "Ranks a group broadcast could not deliver to (dead or "
+                "severed members; named in CollectiveBroadcastError).",
+            ),
+            "collective_timeouts": m.Counter(
+                "ray_tpu_collective_timeouts_total",
+                "Typed collective timeouts raised (CollectiveTimeoutError: "
+                "ring _collect and broadcast recv).",
+            ),
             # --- actor lifecycle (gcs.py) ---
             "actor_restarts": m.Counter(
                 "ray_tpu_actor_restarts_total", "Actor restarts driven by the GCS."
@@ -308,6 +339,7 @@ def instruments() -> dict:
         m.register_collector(_collect_channel_stats)
         m.register_collector(_collect_pipeline_stats)
         m.register_collector(_collect_devobj_stats)
+        m.register_collector(_collect_collective_stats)
         _instruments = inst
     return _instruments
 
@@ -449,6 +481,22 @@ def _collect_devobj_stats():
         usage = mgr.usage()
         inst["devobj_resident"].set(usage["resident_count"])
         inst["devobj_resident_bytes"].set(usage["resident_bytes"])
+
+
+def _collect_collective_stats():
+    from ray_tpu.util.collective.p2p import COLL
+
+    inst = _instruments
+    if inst is None:
+        return
+    _fold("collective", COLL, [
+        ("bcast_sends", inst["collective_broadcasts"], None),
+        ("bcast_send_bytes", inst["collective_broadcast_bytes"], None),
+        ("bcast_recvs", inst["collective_bcast_recvs"], None),
+        ("bcast_fallbacks", inst["collective_bcast_fallbacks"], None),
+        ("bcast_failed_ranks", inst["collective_bcast_failed_ranks"], None),
+        ("timeouts", inst["collective_timeouts"], None),
+    ])
 
 
 def _collect_serve_llm_stats():

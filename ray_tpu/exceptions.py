@@ -111,6 +111,51 @@ class DeviceObjectLostError(ObjectLostError):
         )
 
 
+class CollectiveError(RayTpuError):
+    """A collective-plane operation (util/collective) failed."""
+
+
+class CollectiveTimeoutError(CollectiveError):
+    """A collective op or p2p recv timed out waiting for peers. Names the
+    group, the ranks still missing, and (for p2p) the transfer tag, so the
+    postmortem starts at the right member. Deliberately NOT a TimeoutError
+    subclass: the chaos-matrix contract treats bare timeouts as untyped
+    failures, and this class exists to carry the blame."""
+
+    def __init__(self, msg: str = "", *, group: str = "", ranks=None, tag: str = ""):
+        self.group = group
+        self.ranks = sorted(ranks) if ranks else []
+        self.tag = tag
+        super().__init__(
+            msg
+            or (
+                f"collective op on group {group or '<unknown>'} timed out "
+                f"waiting for ranks {self.ranks}"
+                + (f" (tag {tag!r})" if tag else "")
+            )
+        )
+
+
+class CollectiveBroadcastError(CollectiveError):
+    """A device-object group broadcast could not deliver to every rank.
+    Surviving ranks HAVE the payload (their resolves stay local); ``failed``
+    maps each undelivered rank to the reason, so callers can name the dead
+    member and decide whether to respawn it (its replacement falls back to
+    the pull path transparently)."""
+
+    def __init__(self, msg: str = "", *, group: str = "", failed: dict | None = None, info: dict | None = None):
+        self.group = group
+        self.failed = dict(failed or {})
+        self.info = dict(info or {})
+        super().__init__(
+            msg
+            or (
+                f"group broadcast on {group or '<unknown>'} failed for ranks "
+                f"{sorted(self.failed)}: {self.failed}"
+            )
+        )
+
+
 class OutOfMemoryError(RayTpuError):
     """A task's worker was killed by the node memory monitor (reference:
     ray.exceptions.OutOfMemoryError + worker_killing_policy)."""

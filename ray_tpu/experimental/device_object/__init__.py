@@ -48,11 +48,143 @@ from ray_tpu.experimental.device_object.manager import (  # noqa: F401
 )
 from ray_tpu.experimental.device_object.resolve import resolve_meta  # noqa: F401
 
+
+def _unreachable_errors() -> tuple:
+    """Exception classes that mean 'the holder process cannot be reached'
+    (vs. 'the holder answered with an error')."""
+    from ray_tpu._private.rpc import ConnectionLost
+
+    return (ConnectionLost, ConnectionError, TimeoutError)
+
+
+def broadcast(ref, group_name: str | None = None, *, timeout: float = 60.0,
+              strict: bool = True, node_ids: list | None = None) -> dict:
+    """Fan a device object's payload out with ONE group operation, so a
+    learner syncing weights to K samplers stops paying K serial unicasts
+    (Podracer, arXiv:2104.06272 — the fan-out this plane exists for).
+
+    With ``group_name``: the holder runs a group broadcast over that
+    collective group (``p2p.group_bcast_send`` on the cpu backend — one
+    serialize, concurrent acked chunk pushes at every member's direct
+    mailbox; the tpu seam maps to an ICI broadcast on hardware). Each
+    member's NEXT resolve of ``ref`` (get / task-arg) takes the payload
+    straight from its inbox — zero pull round trips, zero host-store
+    copies. One broadcast per ref: the inbox tombstones repeated keys.
+
+    Without ``group_name``: the cross-node host fallback — the holder
+    seals a host copy into its arena and the copy rides the cut-through
+    relay tree (``util.object_transfer.broadcast_object``) to every alive
+    node (or ``node_ids``); consumers resolve from their LOCAL arena.
+
+    Returns the delivery map (``ok_ranks``/``fallback_ranks``/``failed``
+    for the group path, ``pushed_nodes`` for the host path). ``strict=True``
+    raises :class:`~ray_tpu.exceptions.CollectiveBroadcastError` NAMING any
+    rank the group path could not deliver to — surviving ranks keep their
+    payload either way, and a respawned member transparently falls back to
+    the pull path."""
+    from ray_tpu._private import worker_context
+    from ray_tpu.exceptions import CollectiveBroadcastError
+
+    cw = worker_context.get_core_worker()
+    meta = cw.get_device_meta(ref, timeout=timeout)
+    if group_name is None:
+        from ray_tpu.util.object_transfer import broadcast_object
+
+        if tuple(meta.holder_addr) == tuple(cw.address):
+            ok = cw._device_manager().materialize_to_store(meta.object_id)
+        else:
+            resp = cw._devobj_client(tuple(meta.holder_addr)).call(
+                "devobj_broadcast", {"object_id": meta.object_id}, timeout=timeout
+            )
+            ok = resp.get("kind") == "plasma"
+        if not ok:
+            raise CollectiveBroadcastError(
+                f"holder of device object {meta.object_id[:12]} could not "
+                f"materialize a host copy (holder {meta.holder_label()})",
+            )
+        pushed = broadcast_object(ref, node_ids=node_ids, timeout=timeout)
+        return {"kind": "plasma", "pushed_nodes": pushed}
+    if tuple(meta.holder_addr) == tuple(cw.address):
+        # Same typed surface as the RPC path: a freed entry is a lost
+        # object, an uninitialized group a broadcast error.
+        try:
+            result = cw._device_manager().broadcast_via_group(
+                meta.object_id, group_name, timeout
+            )
+        except KeyError:
+            from ray_tpu.exceptions import DeviceObjectLostError
+
+            raise DeviceObjectLostError(meta.object_id, holder=meta.holder_label())
+        except ValueError as e:
+            raise CollectiveBroadcastError(str(e), group=group_name) from e
+        result["kind"] = "collective"
+    else:
+        try:
+            result = cw._devobj_client(tuple(meta.holder_addr)).call(
+                "devobj_broadcast",
+                {"object_id": meta.object_id, "group": group_name, "timeout": timeout},
+                timeout=timeout + 20.0,
+            )
+        except _unreachable_errors() as e:
+            # Holder genuinely unreachable: the object may be lost with it.
+            from ray_tpu.exceptions import DeviceObjectLostError
+
+            raise DeviceObjectLostError(
+                meta.object_id,
+                holder=meta.holder_label(),
+                msg=(
+                    f"group broadcast of {meta.object_id[:12]} failed: holder "
+                    f"{meta.holder_label()} unreachable ({e!r})"
+                ),
+            ) from e
+        except Exception as e:
+            # Holder answered with an error (or a handler bug surfaced):
+            # the object is intact — a broadcast failure, not a loss.
+            raise CollectiveBroadcastError(
+                f"group broadcast of {meta.object_id[:12]} failed on holder "
+                f"{meta.holder_label()}: {e!r}",
+                group=group_name,
+            ) from e
+    kind = result.get("kind")
+    if kind == "missing":
+        from ray_tpu.exceptions import DeviceObjectLostError
+
+        raise DeviceObjectLostError(meta.object_id, holder=meta.holder_label())
+    if kind == "error":
+        raise CollectiveBroadcastError(result.get("error", "group broadcast failed"), group=group_name)
+    if strict and result.get("failed"):
+        raise CollectiveBroadcastError(group=group_name, failed=result["failed"], info=result)
+    return result
+
+
+def allgather(refs: list, group_name: str | None = None, *, timeout: float = 60.0,
+              strict: bool = True) -> list:
+    """Group allgather for device objects: every member ends up able to
+    resolve EVERY ref in ``refs`` locally — one descriptor and one group
+    operation per ref, with the per-holder fan-outs running concurrently
+    (the holders push in parallel; the driver's RPCs overlap on threads).
+    Returns one delivery map per ref, in order."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    if not refs:
+        return []
+    if len(refs) == 1:
+        return [broadcast(refs[0], group_name, timeout=timeout, strict=strict)]
+    with ThreadPoolExecutor(max_workers=min(8, len(refs))) as pool:
+        futs = [
+            pool.submit(broadcast, ref, group_name, timeout=timeout, strict=strict)
+            for ref in refs
+        ]
+        return [f.result() for f in futs]
+
+
 __all__ = [
     "DEVOBJ_STATS",
     "DeviceObjectManager",
     "DeviceObjectMeta",
     "TENSOR_TRANSPORTS",
+    "allgather",
+    "broadcast",
     "device_object_stats",
     "resolve_meta",
     "validate_transport",

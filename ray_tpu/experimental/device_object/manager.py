@@ -377,6 +377,41 @@ class DeviceObjectManager:
                 oid_hex[:12], group_name,
             )
 
+    @blocking
+    def broadcast_via_group(self, oid_hex: str, group_name: str, timeout: float = 30.0) -> dict:
+        """Group analog of :meth:`send_via_group`: fan the live array to
+        EVERY member of ``group_name`` with ONE group operation — one
+        serialize, concurrent acked chunk pushes at each member's direct
+        mailbox (``p2p.group_bcast_send``; ICI broadcast on the tpu
+        backend). Members then resolve the same descriptor straight from
+        their inbox, zero pull round trips. Runs on an executor thread
+        (driven by ``rpc_devobj_broadcast``; serialization plus K ack RTTs
+        must not stall the IO loop). Returns the per-rank delivery map —
+        a dead member lands in ``failed`` (the driver-side API turns that
+        into a typed CollectiveBroadcastError naming it) while surviving
+        ranks complete."""
+        from ray_tpu.util.collective import get_group
+
+        arr = self.get_local(oid_hex)
+        if arr is None:
+            raise KeyError(oid_hex)
+        group = get_group(group_name)
+        # No mailbox fallback: descriptor consumers resolve from the direct
+        # inbox only — a KV drop would be a false "delivered" plus dead
+        # payload bytes in the GCS until the janitor.
+        result = group.bcast_send_payload(
+            arr, tag=oid_hex, timeout=timeout, mailbox_fallback=False
+        )
+        result["group"] = group_name
+        result["src_rank"] = group.rank
+        DEVOBJ_STATS.transfers_collective += 1
+        flight_recorder.record(
+            "coll_broadcast",
+            f"{oid_hex[:12]}:{group_name}:{len(result['ok_ranks'])}/"
+            f"{group.world_size - 1}:{result['bytes']}",
+        )
+        return result
+
     def _schedule_mailbox_janitor(self, key: str, delay_s: float = 180.0):
         async def _sweep():
             import asyncio

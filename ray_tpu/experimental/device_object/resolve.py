@@ -75,6 +75,27 @@ def resolve_meta(cw, meta, deadline=None):
             DEVOBJ_STATS.transfers_local += 1
             flight_recorder.record("devobj_transfer", f"{oid[:12]}:local")
             return arr
+    # 1b. Group-sourced descriptor: a holder-side group broadcast
+    # (device_object.broadcast) pre-delivered the payload into this
+    # process's direct mailbox under a key derived from (group, oid, rank)
+    # — take it with zero round trips. Non-blocking: a miss (no broadcast
+    # happened, or this ref was already taken once) just falls through to
+    # the pull path.
+    if meta.transport == "collective":
+        value = _take_broadcast(cw, meta)
+        if value is not None:
+            return value
+    # 1c. A host copy already on THIS node's arena (the cut-through relay
+    # fallback of device_object.broadcast lands one per node, and a holder
+    # on this node may have materialized/spilled): resolve from local shm
+    # without waking the holder.
+    try:
+        if cw.store.contains(oid):
+            return _from_store(cw, meta, deadline)
+    except GetTimeoutError:
+        raise
+    except Exception:
+        logger.debug("local-store probe for device object %s failed", oid[:12], exc_info=True)
     # 2./3. Ask the holder. One RPC decides the path: it kicks off a
     # collective send when we named a shared group, else it hands back an
     # inline/arena host copy.
@@ -135,6 +156,38 @@ def resolve_meta(cw, meta, deadline=None):
     # "missing": the holder no longer tracks it (freed under us, or a stale
     # descriptor after holder restart) — a host copy may still exist.
     return _host_copy_or_lost(cw, meta, deadline)
+
+
+def _take_broadcast(cw, meta):
+    """Non-blocking inbox probe for a group-broadcast payload of this
+    descriptor: for every collective group this process shares with the
+    holder, try the deterministic broadcast key. At-most-once per ref per
+    process (the inbox take consumes the entry); a second resolve of the
+    same ref falls back to the pull path."""
+    from ray_tpu._private import serialization
+    from ray_tpu.util.collective import local_group_hints
+    from ray_tpu.util.collective.p2p import COLL, bcast_key
+
+    oid = meta.object_id
+    try:
+        local = {name: rank for name, rank, _ in local_group_hints()}
+    except Exception:
+        return None
+    for name, holder_rank, _ in meta.group_hints or []:
+        my_rank = local.get(name)
+        if my_rank is None or my_rank == holder_rank:
+            continue
+        data = cw.p2p_inbox.take(bcast_key(name, oid))
+        if data is None:
+            continue
+        value = serialization.loads(data)
+        from ray_tpu.experimental.device_object.manager import DEVOBJ_STATS
+
+        COLL.bcast_recvs += 1
+        DEVOBJ_STATS.transfers_collective += 1
+        flight_recorder.record("devobj_transfer", f"{oid[:12]}:bcast:{name}")
+        return value
+    return None
 
 
 def _host_pull(cw, meta, deadline):

@@ -40,6 +40,17 @@ class AlgorithmConfig:
         self.lr = 5e-5
         self.train_batch_size = 4000
         self.grad_clip: Optional[float] = None
+        # Weight-sync transport (Podracer topology, arXiv:2104.06272):
+        # "host" ships the params pytree through the object store per
+        # worker; "device_broadcast" packs them into ONE device-resident
+        # vector and fans the payload to the whole sampler fleet with one
+        # group operation (experimental.device_object.broadcast).
+        self.weight_sync = "host"
+        self.weight_sync_group = "rllib_weights"
+        self.weight_sync_backend = "cpu"  # "tpu" on hardware: ICI broadcast seam
+        # Podracer learner mesh: shard the update's batch over every local
+        # device (pjit data-parallel cell) instead of single-device jit.
+        self.learner_mesh = False
         self.model_hiddens = (64, 64)
         self.model_conv_filters = None  # [(out_ch, kernel, stride), ...] for image obs
         self.seed = 0
@@ -98,7 +109,10 @@ class AlgorithmConfig:
 
     def training(self, *, lr: Optional[float] = None, gamma: Optional[float] = None,
                  train_batch_size: Optional[int] = None, grad_clip: Optional[float] = None,
-                 model_hiddens=None, model_conv_filters=None, **extra) -> "AlgorithmConfig":
+                 model_hiddens=None, model_conv_filters=None,
+                 weight_sync: Optional[str] = None,
+                 weight_sync_backend: Optional[str] = None,
+                 learner_mesh: Optional[bool] = None, **extra) -> "AlgorithmConfig":
         if lr is not None:
             self.lr = lr
         if gamma is not None:
@@ -107,6 +121,13 @@ class AlgorithmConfig:
             self.train_batch_size = train_batch_size
         if grad_clip is not None:
             self.grad_clip = grad_clip
+        if weight_sync is not None:
+            assert weight_sync in ("host", "device_broadcast"), weight_sync
+            self.weight_sync = weight_sync
+        if weight_sync_backend is not None:
+            self.weight_sync_backend = weight_sync_backend
+        if learner_mesh is not None:
+            self.learner_mesh = learner_mesh
         if model_hiddens is not None:
             self.model_hiddens = tuple(model_hiddens)
         if model_conv_filters is not None:
@@ -240,6 +261,11 @@ class AlgorithmConfig:
         return self.algo_class(config=self)
 
 
+# Per-process counter making each Algorithm instance's weight-group name
+# unique (see _setup_device_weight_sync).
+_WEIGHT_GROUP_SEQ = 0
+
+
 class Algorithm(Trainable):
     """Extends the Tune Trainable so `tune.Tuner(PPO, ...)` works the same
     way as the reference (§3.6 of the survey)."""
@@ -303,9 +329,72 @@ class Algorithm(Trainable):
             max_worker_restarts=getattr(cfg, "max_worker_restarts", 100),
         )
         self.learner_group = self._build_learner_group(cfg)
-        self.workers.sync_weights(self.learner_group.get_weights())
+        self._device_sync_ready = False
+        if getattr(cfg, "weight_sync", "host") == "device_broadcast":
+            self._setup_device_weight_sync(cfg)
+        self.sync_worker_weights()
         self._episode_reward_window: list = []
         self._timesteps_total = 0
+
+    def _setup_device_weight_sync(self, cfg) -> None:
+        """Form the learner↔sampler weight group (Podracer topology): the
+        learner/driver is rank 0 (the holder the broadcast fans out from),
+        samplers take ranks 1..N. Best-effort — a failed gang init (e.g. a
+        worker died during setup) degrades to the host path rather than
+        failing setup."""
+        # Group names are per-process singletons and nothing outside this
+        # Algorithm ever joins its weight group, so suffix the configured
+        # name with an instance counter: two live Algorithms in one driver
+        # (train + eval experiment, two in-process trials) must not hijack
+        # each other's group/address rows.
+        global _WEIGHT_GROUP_SEQ
+        _WEIGHT_GROUP_SEQ += 1
+        group = self._weight_group = f"{cfg.weight_sync_group}-{_WEIGHT_GROUP_SEQ}"
+        backend = getattr(cfg, "weight_sync_backend", "cpu")
+        world = 1 + self.workers.num_workers
+        try:
+            from ray_tpu.util import collective as col
+
+            # A re-setup of THIS instance may still hold the name locally.
+            col.destroy_collective_group(group)
+            self.learner_group.init_weight_collective(world, 0, backend, group)
+            self.workers.init_weight_group(group, backend=backend, world_size=world, base_rank=1)
+            self._device_sync_ready = True
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "device weight-sync group init failed; falling back to host sync",
+                exc_info=True,
+            )
+
+    def sync_worker_weights(self):
+        """One weight sync, on whichever transport the config picked. The
+        device path broadcasts ONE device-object descriptor's payload to
+        the fleet (strict=False: a dead sampler is the sync loop's business
+        — it respawns the worker and the replacement pull-resolves) and
+        never lets a broadcast failure break training: any error degrades
+        that sync to the host path."""
+        cfg = self._algo_config
+        if (
+            getattr(cfg, "weight_sync", "host") == "device_broadcast"
+            and getattr(self, "_device_sync_ready", False)
+        ):
+            try:
+                from ray_tpu.experimental import device_object
+
+                ref = self.learner_group.pack_weight_ref()
+                device_object.broadcast(ref, self._weight_group, strict=False)
+                self.workers.sync_packed_weights(ref)
+                return
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "device-broadcast weight sync failed; using host sync for "
+                    "this round", exc_info=True,
+                )
+        self.workers.sync_weights(self.learner_group.get_weights())
 
     # -- evaluation (reference: Algorithm.evaluate, algorithm.py:850) ------
     @property
@@ -474,7 +563,7 @@ class Algorithm(Trainable):
                 # idle until the next broadcast, which the empty-batch early
                 # return would skip — re-broadcast here or the trainer
                 # livelocks in async_waiting forever.
-                self.workers.sync_weights(self.get_policy_weights())
+                self.sync_worker_weights()
             return batches
         per_worker = max(
             1,
@@ -530,6 +619,16 @@ class Algorithm(Trainable):
         self.workers.sync_weights(data["weights"])
 
     def cleanup(self) -> None:
+        if getattr(self, "_device_sync_ready", False):
+            # Release the weight group's name in THIS process (sampler/
+            # learner members die with their actors below).
+            try:
+                from ray_tpu.util import collective as col
+
+                col.destroy_collective_group(self._weight_group)
+            except Exception:
+                pass
+            self._device_sync_ready = False
         workers = getattr(self, "workers", None)
         if workers is not None:
             workers.stop()

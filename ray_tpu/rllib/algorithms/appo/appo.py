@@ -116,6 +116,7 @@ class APPO(Algorithm):
             seed=cfg.seed,
             num_learners=cfg.num_learners,
             num_tpus_per_learner=cfg.num_tpus_per_learner,
+            use_mesh=getattr(cfg, "learner_mesh", False),
         )
 
     def training_step(self) -> dict:
@@ -140,6 +141,7 @@ class APPO(Algorithm):
         for _ in range(cfg.num_sgd_iter):
             metrics = self.learner_group.update(batch, loss_cfg)
         if self.iteration % max(cfg.broadcast_interval, 1) == 0:
-            self.workers.sync_weights(self.learner_group.get_weights())
+            # Podracer seam: device-object group broadcast when configured.
+            self.sync_worker_weights()
         metrics["num_env_steps_sampled_this_iter"] = batch.count
         return dict(metrics)

@@ -123,6 +123,7 @@ class IMPALA(Algorithm):
             seed=cfg.seed,
             num_learners=cfg.num_learners,
             num_tpus_per_learner=cfg.num_tpus_per_learner,
+            use_mesh=getattr(cfg, "learner_mesh", False),
         )
 
     def training_step(self) -> dict:
@@ -145,5 +146,8 @@ class IMPALA(Algorithm):
         for _ in range(cfg.num_sgd_iter):
             metrics = self.learner_group.update(batch, loss_cfg)
         if self.iteration % max(cfg.broadcast_interval, 1) == 0:
-            self.workers.sync_weights(self.learner_group.get_weights())
+            # Podracer seam: one device-object group broadcast when the
+            # config picked weight_sync="device_broadcast", per-worker host
+            # pytree sync otherwise.
+            self.sync_worker_weights()
         return dict(metrics)

@@ -6,6 +6,14 @@ torch). TPU-native redesign: the loss is a pure function; the update is one
 jitted step (grad + optax apply). Data parallelism over learners is an
 allreduce of gradients through the collective plane (XLA psum over ICI when
 the group backend is "tpu"), not parameter-server averaging.
+
+Podracer weight sync (arXiv:2104.06272, wired by Algorithm when
+``weight_sync="device_broadcast"``): the learner packs its params pytree
+into ONE flat device vector (:func:`pack_weights`), keeps it device-resident
+as a device object, and ``device_object.broadcast`` fans it to the sampler
+fleet with one group operation — samplers rebuild the pytree against their
+own canonical template (:func:`unpack_weights`), so only leaf VALUES cross
+the wire, never tree structure.
 """
 
 from __future__ import annotations
@@ -21,10 +29,56 @@ from ray_tpu.rllib.policy.sample_batch import SampleBatch
 logger = logging.getLogger(__name__)
 
 
-class Learner:
-    """Single-process learner: params + optimizer + jitted update."""
+def pack_weights(params):
+    """Flatten a params pytree into ONE contiguous float32 vector (canonical
+    jax tree-flatten order). The single-array form is what lets a whole
+    model ride the device-object plane as ONE descriptor + ONE group
+    broadcast per sync."""
+    import jax
+    import jax.numpy as jnp
 
-    def __init__(self, spec, loss_fn: Callable, lr: float = 5e-5, grad_clip: Optional[float] = None, seed: int = 0, optimizer: str = "adam"):
+    leaves = jax.tree_util.tree_leaves(params)
+    if not leaves:
+        return jnp.zeros((0,), jnp.float32)
+    return jnp.concatenate([jnp.ravel(leaf).astype(jnp.float32) for leaf in leaves])
+
+
+def unpack_weights(flat, template):
+    """Rebuild a params pytree from :func:`pack_weights` output. ``template``
+    supplies structure, shapes, and dtypes — both sides derive it from the
+    SAME module spec (rl_module.init_params is deterministic in structure),
+    so no treedef ever crosses the wire."""
+    import jax
+    import jax.numpy as jnp
+
+    flat = jnp.asarray(flat)
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    sizes = [int(np.prod(leaf.shape)) if leaf.shape else 1 for leaf in leaves]
+    if sum(sizes) != flat.shape[0]:
+        raise ValueError(
+            f"packed weight vector has {flat.shape[0]} elements, template "
+            f"expects {sum(sizes)} — learner and sampler disagree on the module spec"
+        )
+    out = []
+    offset = 0
+    for leaf, n in zip(leaves, sizes):
+        out.append(flat[offset : offset + n].reshape(leaf.shape).astype(leaf.dtype))
+        offset += n
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+class Learner:
+    """Single-process learner: params + optimizer + jitted update.
+
+    ``use_mesh=True`` builds the Podracer learner mesh: a 1-axis
+    ``jax.sharding.Mesh`` over every local device with params REPLICATED
+    and the batch sharded along its leading (time/row) axis — the pjit
+    data-parallel shape (arXiv:2104.06272's Anakin cell on one host). On a
+    single-device process the mesh degenerates to trivial sharding, so the
+    same code path is exercised everywhere and the multi-chip layout is a
+    deployment detail, not a code change."""
+
+    def __init__(self, spec, loss_fn: Callable, lr: float = 5e-5, grad_clip: Optional[float] = None, seed: int = 0, optimizer: str = "adam", use_mesh: bool = False):
         import jax
         import optax
 
@@ -33,6 +87,15 @@ class Learner:
         self.spec = spec
         self.loss_fn = loss_fn
         self.params = rl_module.init_params(jax.random.PRNGKey(seed), spec)
+        self.mesh = None
+        if use_mesh:
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+            self.mesh = Mesh(np.array(jax.local_devices()), ("data",))
+            # Params live replicated on the mesh so every data shard reads
+            # them locally during the sharded forward/backward.
+            replicated = NamedSharding(self.mesh, P())
+            self.params = jax.device_put(self.params, replicated)
         chain = []
         if grad_clip:
             chain.append(optax.clip_by_global_norm(grad_clip))
@@ -48,8 +111,25 @@ class Learner:
         loss_fn = self.loss_fn
         spec = self.spec
         tx = self.tx
+        mesh = self.mesh
 
         def update(params, opt_state, batch, loss_cfg):
+            if mesh is not None and mesh.size > 1:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                # Constrain the batch onto the data axis (rows divisible by
+                # the mesh stay sharded; ragged tails fall back to
+                # replication rather than a compile error).
+                batch = {
+                    k: (
+                        jax.lax.with_sharding_constraint(
+                            v, NamedSharding(mesh, P("data"))
+                        )
+                        if getattr(v, "ndim", 0) >= 1 and v.shape[0] % mesh.size == 0
+                        else v
+                    )
+                    for k, v in batch.items()
+                }
             (loss, metrics), grads = jax.value_and_grad(
                 lambda p: loss_fn(p, batch, spec, loss_cfg), has_aux=True
             )(params)
@@ -87,11 +167,11 @@ class _RemoteLearner:
     """Learner living in its own actor; grads allreduced through the
     collective plane before the optimizer step (reference: DDP learners)."""
 
-    def __init__(self, spec, loss_fn, lr, grad_clip, seed, rank, world_size, group_name):
+    def __init__(self, spec, loss_fn, lr, grad_clip, seed, rank, world_size, group_name, use_mesh=False):
         self.rank = rank
         self.world_size = world_size
         self.group_name = group_name
-        self.learner = Learner(spec, loss_fn, lr, grad_clip, seed)
+        self.learner = Learner(spec, loss_fn, lr, grad_clip, seed, use_mesh=use_mesh)
 
     def init_collective(self, world, backend):
         from ray_tpu.util import collective
@@ -100,6 +180,24 @@ class _RemoteLearner:
             world_size=self.world_size, rank=self.rank, backend=backend, group_name=self.group_name
         )
         return True
+
+    def init_weight_collective(self, world_size, rank, backend, group_name):
+        """Join the learner↔sampler WEIGHT group (distinct from the grad
+        allreduce group above): this actor is the holder rank the device-
+        object broadcast fans out from."""
+        from ray_tpu.util import collective
+
+        collective.init_collective_group(
+            world_size=world_size, rank=rank, backend=backend, group_name=group_name
+        )
+        return True
+
+    def pack_weights(self):
+        """One flat device vector of the current params. On a
+        tensor_transport actor this returns as a DEVICE OBJECT: the vector
+        stays resident here (this learner is the holder) and only the
+        descriptor travels."""
+        return pack_weights(self.learner.params)
 
     def update(self, batch: SampleBatch, loss_cfg: dict) -> dict:
         import jax
@@ -142,17 +240,23 @@ class LearnerGroup:
 
     def __init__(self, spec, loss_fn, *, lr=5e-5, grad_clip=None, seed=0,
                  num_learners: int = 0, num_tpus_per_learner: float = 0,
-                 collective_backend: str = "cpu", group_name: str = "rllib_learners"):
+                 collective_backend: str = "cpu", group_name: str = "rllib_learners",
+                 use_mesh: bool = False):
         self._local: Optional[Learner] = None
         self._actors: list = []
         if num_learners <= 0:
-            self._local = Learner(spec, loss_fn, lr, grad_clip, seed)
+            self._local = Learner(spec, loss_fn, lr, grad_clip, seed, use_mesh=use_mesh)
         else:
+            # tensor_transport: a pack_weights() return stays device-resident
+            # on the learner actor (the holder) — the Podracer weight-sync
+            # path broadcasts its descriptor instead of shipping the vector
+            # through the host store.
             cls = ray_tpu.remote(
-                num_cpus=1, num_tpus=num_tpus_per_learner or None
+                num_cpus=1, num_tpus=num_tpus_per_learner or None,
+                tensor_transport="collective",
             )(_RemoteLearner)
             self._actors = [
-                cls.remote(spec, loss_fn, lr, grad_clip, seed, rank, num_learners, group_name)
+                cls.remote(spec, loss_fn, lr, grad_clip, seed, rank, num_learners, group_name, use_mesh)
                 for rank in range(num_learners)
             ]
             if num_learners > 1:
@@ -226,3 +330,29 @@ class LearnerGroup:
             self._local.set_weights(weights)
         else:
             ray_tpu.get([a.set_weights.remote(weights) for a in self._actors])
+
+    # ---- Podracer weight sync (device-object broadcast path) ----
+
+    def init_weight_collective(self, world_size: int, rank: int, backend: str, group_name: str):
+        """Join the learner↔sampler weight group as the HOLDER rank. Local
+        mode: the driver process itself is the holder (it owns the params),
+        so the group is initialized right here."""
+        if self._local is not None:
+            from ray_tpu.util import collective
+
+            collective.init_collective_group(
+                world_size=world_size, rank=rank, backend=backend, group_name=group_name
+            )
+            return True
+        return ray_tpu.get(
+            self._actors[0].init_weight_collective.remote(world_size, rank, backend, group_name)
+        )
+
+    def pack_weight_ref(self):
+        """ObjectRef of the packed flat weight vector as a DEVICE OBJECT —
+        the one descriptor a sync broadcasts. Local mode puts from the
+        driver (the driver is the holder); remote mode returns the learner
+        actor's device-resident result."""
+        if self._local is not None:
+            return ray_tpu.put(pack_weights(self._local.params), tensor_transport="collective")
+        return self._actors[0].pack_weights.remote()

@@ -113,6 +113,38 @@ class RolloutWorker:
         self._params = jax.tree_util.tree_map(jnp.asarray, weights)
         return True
 
+    # ---- Podracer weight sync (device-object broadcast path) ----
+
+    def init_collective(self, world_size: int, rank: int, backend: str = "cpu",
+                        group_name: str = "rllib_weights") -> bool:
+        """Join the learner↔sampler weight group: a device-object broadcast
+        from the learner then lands in this process's direct mailbox and
+        set_packed_weights' arg resolution takes it with zero pull RPCs."""
+        from ray_tpu.util import collective
+
+        collective.init_collective_group(
+            world_size=world_size, rank=rank, backend=backend, group_name=group_name
+        )
+        return True
+
+    def set_packed_weights(self, packed) -> bool:
+        """Weight sync from ONE flat vector (learner.pack_weights). The
+        descriptor arg resolves before this runs — group members take the
+        broadcast payload from their inbox; a worker outside the group
+        (e.g. a respawned replacement) transparently falls back to the pull
+        path. The pytree is rebuilt against this worker's own canonical
+        template, so only values crossed the wire."""
+        import jax
+
+        from ray_tpu.rllib.core import rl_module
+        from ray_tpu.rllib.core.learner import unpack_weights
+
+        template = self._params
+        if template is None:
+            template = rl_module.init_params(jax.random.PRNGKey(0), self.spec)
+        self._params = unpack_weights(packed, template)
+        return True
+
     def _shape_obs(self, obs: np.ndarray, explore: bool, peek: bool = False) -> np.ndarray:
         """One pipeline call: while exploring, stateful stages update
         (__call__); otherwise transform-only, so learned statistics never
@@ -413,9 +445,22 @@ class WorkerSet:
             return None
 
     def sync_weights(self, weights):
+        self._sync_weights_via(lambda w: w.set_weights.remote(weights))
+
+    def sync_packed_weights(self, ref):
+        """Podracer path: every worker sets weights from the SAME packed
+        device-object ref (the learner already group-broadcast the payload,
+        so group members resolve from their inbox; a respawned replacement
+        is outside the static group and falls back to the pull path — same
+        weights, one extra round trip)."""
+        self._sync_weights_via(lambda w: w.set_packed_weights.remote(ref))
+
+    def _sync_weights_via(self, submit):
+        """Shared fault-tolerant sync loop: a dead worker is respawned and
+        fed the same weights before the round completes."""
         for w in list(self._workers):
             try:
-                ray_tpu.get(w.set_weights.remote(weights), timeout=120)
+                ray_tpu.get(submit(w), timeout=120)
             except Exception:
                 # Position by identity: a drop earlier in this loop shifts
                 # positional indices.
@@ -426,7 +471,23 @@ class WorkerSet:
                 logger.warning("sync_weights: worker %d dead; respawning", self._indices[pos])
                 replacement = self._replace_worker(pos)
                 if replacement is not None:
-                    ray_tpu.get(replacement.set_weights.remote(weights), timeout=120)
+                    ray_tpu.get(submit(replacement), timeout=120)
+
+    def init_weight_group(self, group_name: str, *, backend: str = "cpu",
+                          world_size: int | None = None, base_rank: int = 1):
+        """Gang-join every rollout worker into the learner↔sampler weight
+        group at ranks base_rank..base_rank+N-1 (rank 0 is the learner/
+        holder). Static membership: replacements spawned later stay outside
+        and use the pull fallback."""
+        world = world_size or (base_rank + len(self._workers))
+        ray_tpu.get(
+            [
+                w.init_collective.remote(world, base_rank + i, backend, group_name)
+                for i, w in enumerate(self._workers)
+            ],
+            timeout=120,
+        )
+        return world
 
     def sample(self, steps_per_worker: int, explore: bool = True) -> List[SampleBatch]:
         """Synchronous parallel sampling with fault tolerance: a worker that

@@ -72,6 +72,15 @@ class GroupManager:
             group = CpuCollectiveGroup(group_name, world_size, rank, gcs=gcs)
         with self._lock:
             self._groups[group_name] = group
+        if cw is not None and gcs is not None:
+            # Publish this member's core-worker RPC address so a group
+            # broadcast (p2p.group_bcast_send) can push payload frames
+            # straight at its direct mailbox instead of going through the
+            # GCS-KV mailbox per rank. Best-effort: members without a row
+            # get the mailbox fallback.
+            from ray_tpu.util.collective.p2p import register_member_addr
+
+            register_member_addr(gcs, group_name, rank, cw.address)
         return group
 
     def get(self, group_name: str):

@@ -6,6 +6,13 @@ pure-Python fallback for host-memory collectives, so collective code runs on
 nodes with no accelerator (and in unit tests) without any extra dependency.
 Data moves through the GCS KV (small control-plane scale); the TPU group is
 the performance path.
+
+Payload semantics: values serialize through ``_private/serialization``, so a
+``jax.Array`` round-trips bit-exact WITH its sharding layout — ``broadcast``
+hands every rank the src rank's value as-is (a sharded weight tensor lands
+re-sharded on the receiver's devices), while the reducing ops and
+``allgather`` densify to numpy (a stack across ranks has no single sharding
+to preserve).
 """
 
 from __future__ import annotations
@@ -25,6 +32,23 @@ _REDUCE = {
 }
 
 
+def _uniform_stack(group_name: str, step: str, values: list) -> np.ndarray:
+    """np.stack with a TYPED shape check: ranks contributing mismatched
+    shapes/dtypes is a programming error that must name the offenders, not
+    surface as a bare numpy ValueError deep in a reduce."""
+    from ray_tpu.exceptions import CollectiveError
+
+    arrs = [np.asarray(v) for v in values]
+    shapes = {a.shape for a in arrs}
+    if len(shapes) > 1:
+        per_rank = {r: a.shape for r, a in enumerate(arrs)}
+        raise CollectiveError(
+            f"collective {step} on group {group_name!r} requires uniform "
+            f"shapes across ranks, got {per_rank}"
+        )
+    return np.stack(arrs)
+
+
 class CpuCollectiveGroup:
     def __init__(self, group_name: str, world_size: int, rank: int, gcs=None):
         from ray_tpu._private import worker_context
@@ -34,19 +58,24 @@ class CpuCollectiveGroup:
         self.rank = rank
         self.gcs = gcs or worker_context.get_core_worker().gcs
         self._epoch = 0
+        # {rank: core-worker addr} lazily fetched from the GCS registry —
+        # membership is static per group epoch, so one fetch serves every
+        # group broadcast this member fans out.
+        self._member_addrs: dict | None = None
 
     def _key(self, step: str, rank: int) -> str:
         return f"collective/{self.group_name}/{self._epoch}/{step}/{rank}"
 
-    def _post(self, step: str, arr: np.ndarray):
+    def _post(self, step: str, value):
         from ray_tpu._private import serialization
 
         self.gcs.call(
-            "kv_put", {"key": self._key(step, self.rank), "value": serialization.dumps(arr)}
+            "kv_put", {"key": self._key(step, self.rank), "value": serialization.dumps(value)}
         )
 
-    def _collect(self, step: str, timeout: float = 120.0) -> list[np.ndarray]:
+    def _collect(self, step: str, timeout: float = 120.0) -> list:
         from ray_tpu._private import serialization
+        from ray_tpu.exceptions import CollectiveTimeoutError
 
         out: list = [None] * self.world_size
         deadline = time.monotonic() + timeout
@@ -55,35 +84,45 @@ class CpuCollectiveGroup:
             for r in list(remaining):
                 resp = self.gcs.call("kv_get", {"key": self._key(step, r)})
                 if resp.get("found"):
-                    out[r] = np.asarray(serialization.loads(resp["value"]))
+                    out[r] = serialization.loads(resp["value"])
                     remaining.discard(r)
             if remaining:
                 time.sleep(0.01)
         if remaining:
-            raise TimeoutError(f"collective {step} timed out waiting for ranks {remaining}")
+            from ray_tpu.util.collective.p2p import COLL
+
+            COLL.timeouts += 1
+            raise CollectiveTimeoutError(
+                f"collective {step} on group {self.group_name!r} (rank "
+                f"{self.rank}) timed out after {timeout}s waiting for ranks "
+                f"{sorted(remaining)}",
+                group=self.group_name, ranks=remaining,
+            )
         return out
 
-    def _sync(self, step: str, arr) -> list[np.ndarray]:
-        arr = np.asarray(arr)
-        self._post(step, arr)
+    def _sync(self, step: str, value) -> list:
+        self._post(step, value)
         stack = self._collect(step)
         self._epoch += 1
         return stack
 
     def allreduce(self, x, op: ReduceOp = ReduceOp.SUM):
-        stack = self._sync("allreduce", x)
-        return _REDUCE[op](np.stack(stack))
+        stack = self._sync("allreduce", np.asarray(x))
+        return _REDUCE[op](_uniform_stack(self.group_name, "allreduce", stack))
 
     def allgather(self, x):
-        return np.stack(self._sync("allgather", x))
+        return _uniform_stack(self.group_name, "allgather", self._sync("allgather", x))
 
     def reducescatter(self, x, op: ReduceOp = ReduceOp.SUM):
         x = np.asarray(x)
         assert x.shape[0] == self.world_size
         stack = self._sync("reducescatter", x)
-        return _REDUCE[op](np.stack(stack))[self.rank]
+        return _REDUCE[op](_uniform_stack(self.group_name, "reducescatter", stack))[self.rank]
 
     def broadcast(self, x, src_rank: int = 0):
+        """Every rank gets the src rank's value AS POSTED: a jax.Array
+        round-trips bit-exact with its sharding (the payload-parity
+        contract the device-object broadcast path relies on)."""
         stack = self._sync("broadcast", x)
         return stack[src_rank]
 
@@ -99,7 +138,7 @@ class CpuCollectiveGroup:
         stack = self._sync("sendrecv", x)
         for src, dst in perm:
             if dst == self.rank:
-                return stack[src]
+                return np.asarray(stack[src])
         return np.asarray(x)
 
     def send(self, value, dst_rank: int, tag: str) -> int:
@@ -116,5 +155,45 @@ class CpuCollectiveGroup:
 
         return mailbox_recv(self.gcs, self.group_name, src_rank, self.rank, tag, timeout)
 
+    # ---- group broadcast (ONE op fanning a payload to every member) ----
+
+    def _addrs(self) -> dict:
+        from ray_tpu.util.collective.p2p import fetch_member_addrs
+
+        if self._member_addrs is None:
+            self._member_addrs = fetch_member_addrs(self.gcs, self.group_name, self.world_size)
+        return self._member_addrs
+
+    def bcast_send_payload(self, value, tag: str, timeout: float = 30.0,
+                           mailbox_fallback: bool = True) -> dict:
+        """Holder-side group broadcast: one serialize, concurrent acked
+        chunk pushes at every member's direct mailbox (p2p.group_bcast_send)
+        — the fan-out device_object.broadcast() rides. Returns the per-rank
+        delivery map; never raises for a dead member (the caller owns the
+        policy). ``mailbox_fallback=False`` when receivers only watch the
+        direct inbox (the descriptor-resolution path)."""
+        from ray_tpu._private import worker_context
+        from ray_tpu.util.collective.p2p import group_bcast_send
+
+        cw = worker_context.get_core_worker()
+        return group_bcast_send(
+            cw, self.gcs, self.group_name, self.rank, self.world_size, tag,
+            value, member_addrs=self._addrs(), timeout=timeout,
+            mailbox_fallback=mailbox_fallback,
+        )
+
+    def bcast_recv_payload(self, src_rank: int, tag: str, timeout: float = 120.0):
+        """Member-side receive of a group broadcast (direct mailbox, GCS
+        fallback, typed timeout naming group/rank/tag)."""
+        from ray_tpu._private import worker_context
+        from ray_tpu.util.collective.p2p import group_bcast_recv
+
+        cw = worker_context.get_core_worker()
+        return group_bcast_recv(
+            cw, self.gcs, self.group_name, src_rank, self.rank, tag, timeout
+        )
+
     def destroy(self):
-        pass
+        from ray_tpu.util.collective.p2p import unregister_member_addr
+
+        unregister_member_addr(self.gcs, self.group_name, self.rank)

@@ -90,9 +90,12 @@ def mailbox_recv(gcs, group_name: str, src_rank: int, dst_rank: int, tag: str, t
             gcs.call("kv_del", {"key": key})
             return serialization.loads(resp["value"])
         if time.monotonic() > deadline:
-            raise TimeoutError(
+            from ray_tpu.exceptions import CollectiveTimeoutError
+
+            raise CollectiveTimeoutError(
                 f"p2p recv on group {group_name!r} tag {tag!r} from rank "
-                f"{src_rank} timed out after {timeout}s"
+                f"{src_rank} timed out after {timeout}s",
+                group=group_name, ranks=[src_rank], tag=tag,
             )
         time.sleep(_POLL_S)
 
@@ -184,6 +187,35 @@ class P2PInbox:
             self._waiters.pop(key, None)
 
     @any_thread
+    def completed(self, key: str) -> bool:
+        """True once every chunk of ``key`` has landed — stays true after a
+        take() (the tombstone remembers), which is exactly the delivery
+        acknowledgement ``p2p_ack`` needs: 'the payload reached this
+        process', not 'it is still unclaimed'."""
+        with self._lock:
+            return key in self._completed or key in self._done
+
+    @blocking
+    def wait_complete(self, key: str, timeout: float) -> bool:
+        """Block (bounded) until ``key``'s payload has fully landed. Used by
+        the ``p2p_ack`` RPC: the ack rides the same connection as the data
+        frames, but handlers are dispatched as tasks, so a bounded wait
+        covers the (rare) reorder instead of trusting scheduling order."""
+        deadline = time.monotonic() + timeout
+        ev = self._waiter(key)
+        try:
+            while True:
+                if self.completed(key):
+                    return True
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                ev.wait(min(0.05, remaining))
+                ev.clear()
+        finally:
+            self._drop_waiter(key)
+
+    @any_thread
     def purge_prefix(self, prefix: str) -> int:
         """Drop every entry/partial under a key prefix (channel teardown:
         cids are dead, nobody will ever take these payloads)."""
@@ -251,6 +283,274 @@ def direct_send(cw, addr: tuple, key: str, data: bytes) -> None:
             pass  # consumer unreachable: its grace window handles it
 
     cw._io.spawn(_push_all())
+
+
+# ---------------------------------------------------------------------------
+# Group broadcast (ONE group op fanning a payload to every member)
+# ---------------------------------------------------------------------------
+
+# Per-member budget for the delivery acknowledgement round trip. The ack is
+# what turns the fire-and-forget chunk frames into a delivery receipt: it
+# rides the same FIFO connection as the data, so by the time the member
+# answers, its inbox either has the payload or never will.
+_BCAST_ACK_S = 10.0
+
+
+class _CollStats:
+    """Plain-int hot-path counters for the group-collective plane, folded
+    into ``ray_tpu_collective_*`` instruments by self_metrics at flush time
+    (same pattern as DEVOBJ_STATS — no instrument lock on the send path)."""
+
+    __slots__ = (
+        "bcast_sends",        # group broadcasts fanned out by this process
+        "bcast_send_bytes",   # serialized payload bytes × delivered ranks
+        "bcast_recvs",        # descriptor resolves served from a broadcast
+        "bcast_fallbacks",    # per-rank deliveries that fell back to the GCS mailbox
+        "bcast_failed_ranks", # ranks a broadcast could not deliver to
+        "timeouts",           # typed collective timeouts raised here
+    )
+
+    def __init__(self):
+        for name in self.__slots__:
+            setattr(self, name, 0)
+
+
+COLL = _CollStats()
+
+
+def bcast_key(group_name: str, tag: str) -> str:
+    """Inbox key of a group-broadcast payload. Deterministic from (group,
+    tag) and deliberately RANK-FREE: inboxes are per-process, so every
+    member gets the same key — which is what lets the fan-out encode each
+    chunk frame once and write identical bytes to every connection.
+    Device-object broadcasts use the object id as the tag, so one broadcast
+    per object id (the inbox tombstones a repeated key as a duplicate)."""
+    return f"collbcast/{group_name}/{tag}"
+
+
+def member_addr_key(group_name: str, rank: int) -> str:
+    return f"collective/{group_name}/addr/{rank}"
+
+
+def register_member_addr(gcs, group_name: str, rank: int, addr) -> None:
+    """Publish this member's core-worker RPC address so a group broadcast
+    can push payload frames straight at its inbox (no GCS mailbox on the
+    fan-out path). Best-effort: a member without a row just gets the
+    mailbox fallback."""
+    import json
+
+    try:
+        gcs.call(
+            "kv_put",
+            {"key": member_addr_key(group_name, rank), "value": json.dumps(list(addr)).encode()},
+        )
+    except Exception:
+        pass
+
+
+def unregister_member_addr(gcs, group_name: str, rank: int) -> None:
+    try:
+        gcs.call("kv_del", {"key": member_addr_key(group_name, rank)})
+    except Exception:
+        pass
+
+
+@blocking
+def fetch_member_addrs(gcs, group_name: str, world_size: int) -> dict:
+    """{rank: (host, port)} for every member that registered an address.
+    Callers cache this per group epoch — membership is static."""
+    import json
+
+    addrs: dict = {}
+    for rank in range(world_size):
+        try:
+            resp = gcs.call("kv_get", {"key": member_addr_key(group_name, rank)})
+            if resp.get("found"):
+                addrs[rank] = tuple(json.loads(bytes(resp["value"]).decode()))
+        except Exception:
+            continue
+    return addrs
+
+
+@blocking
+def group_bcast_send(
+    cw,
+    gcs,
+    group_name: str,
+    src_rank: int,
+    world_size: int,
+    tag: str,
+    value,
+    member_addrs: dict | None = None,
+    timeout: float = 30.0,
+    mailbox_fallback: bool = True,
+) -> dict:
+    """Fan ``value`` to every OTHER rank of the group as ONE group
+    operation: one serialize, each chunk frame ENCODED ONCE
+    (``RpcClient.pack_push_frame`` — the rank-free inbox key is what makes
+    the bytes identical) and written down every member connection
+    concurrently, each member confirmed by a ``p2p_ack`` round trip (wall
+    clock ≈ serialize + encode + max member RTT; CPU ≈ one encode instead
+    of K). Ranks without a registered address fall back to the GCS-KV
+    mailbox under the same logical tag. Never raises for a dead member:
+    the result names it so the caller owns the policy —
+    ``{"ok_ranks": [...], "fallback_ranks": [...], "failed": {rank: reason},
+    "bytes": payload_bytes}``.
+
+    This is the cpu-backend group op behind device_object.broadcast(); on
+    TPU hardware the same seam maps to an ICI broadcast (tpu_group.py)."""
+    import asyncio
+
+    from ray_tpu._private import serialization
+    from ray_tpu._private.rpc import RpcClient
+
+    data = serialization.dumps(value)
+    if member_addrs is None:
+        member_addrs = fetch_member_addrs(gcs, group_name, world_size)
+    total = max(1, (len(data) + _DIRECT_CHUNK_BYTES - 1) // _DIRECT_CHUNK_BYTES)
+    targets = [r for r in range(world_size) if r != src_rank]
+    result = {"ok_ranks": [], "fallback_ranks": [], "failed": {}, "bytes": len(data)}
+    key = bcast_key(group_name, tag)
+    frames = [
+        RpcClient.pack_push_frame(
+            "p2p_data",
+            {
+                "key": key,
+                "idx": i,
+                "total": total,
+                "data": data[i * _DIRECT_CHUNK_BYTES : (i + 1) * _DIRECT_CHUNK_BYTES],
+            },
+        )
+        for i in range(total)
+    ]
+
+    # Ack wait scales with the caller's budget (clamped by the server at
+    # 30s): a slow-but-healthy member still reassembling a large payload
+    # must not be branded a failed rank by a fixed small bound.
+    ack_wait = max(_BCAST_ACK_S, min(30.0, timeout))
+
+    async def _deliver(rank: int, addr: tuple):
+        client = cw._owner_client(tuple(addr))
+        for frame in frames:
+            await client.apush_packed("p2p_data", frame)
+        resp = await client.acall(
+            "p2p_ack", {"key": key, "timeout": ack_wait},
+            timeout=ack_wait + 5.0, retries=0,
+        )
+        if not resp.get("ok"):
+            raise RuntimeError("p2p_ack reported the payload never landed")
+
+    async def _fan_out():
+        tasks = {
+            rank: asyncio.ensure_future(
+                asyncio.wait_for(_deliver(rank, member_addrs[rank]), timeout)
+            )
+            for rank in targets
+            if rank in member_addrs
+        }
+        if tasks:
+            await asyncio.wait(tasks.values())
+        return {rank: t.exception() for rank, t in tasks.items()}
+
+    # Outer bound is a backstop over the per-member wait_for; each member's
+    # delivery is already clamped to ``timeout`` individually.
+    outcomes = cw._io.run(_fan_out(), timeout=timeout + 15.0) if targets else {}
+    for rank in targets:
+        if rank not in member_addrs:
+            # Never registered an address (old-style member): the GCS
+            # mailbox is its normal path, not a failure — but ONLY for
+            # callers whose receivers actually poll it
+            # (bcast_recv_payload). The device-object descriptor path
+            # resolves from the direct inbox alone, so there a mailbox
+            # drop would be dead weight in the KV and a false "delivered"
+            # — it reports the rank failed instead.
+            if not mailbox_fallback:
+                result["failed"][rank] = "no registered member address"
+                COLL.bcast_failed_ranks += 1
+                continue
+            try:
+                mailbox_send(gcs, group_name, src_rank, rank, f"bcast/{tag}", value)
+                _schedule_bcast_janitor(cw, gcs, mailbox_key(group_name, src_rank, rank, f"bcast/{tag}"))
+                result["fallback_ranks"].append(rank)
+                COLL.bcast_fallbacks += 1
+            except Exception as e:
+                result["failed"][rank] = repr(e)
+                COLL.bcast_failed_ranks += 1
+            continue
+        exc = outcomes.get(rank)
+        if exc is None:
+            result["ok_ranks"].append(rank)
+        else:
+            # A REGISTERED member we could not deliver to is dead, severed,
+            # or wedged — a GCS mailbox drop would "succeed" against a
+            # corpse (the KV is alive either way), so the honest outcome is
+            # a named failure the caller can act on.
+            result["failed"][rank] = repr(exc)
+            COLL.bcast_failed_ranks += 1
+    COLL.bcast_sends += 1
+    COLL.bcast_send_bytes += len(data) * (
+        len(result["ok_ranks"]) + len(result["fallback_ranks"])
+    )
+    return result
+
+
+def _schedule_bcast_janitor(cw, gcs, key: str, delay_s: float = 180.0) -> None:
+    """A mailbox-fallback payload a dead/slow member never claims must not
+    sit in the GCS KV forever (same janitor shape as
+    DeviceObjectManager._schedule_mailbox_janitor)."""
+    async def _sweep():
+        import asyncio
+
+        await asyncio.sleep(delay_s)
+        try:
+            await gcs.acall("kv_del", {"key": key})
+        except Exception:
+            pass
+
+    try:
+        cw._io.spawn(_sweep())
+    except Exception:
+        pass
+
+
+@blocking
+def group_bcast_recv(cw, gcs, group_name: str, src_rank: int, my_rank: int, tag: str, timeout: float = 120.0):
+    """Member-side receive of a group broadcast: watch BOTH landing zones —
+    the direct mailbox (steady state: the payload is already here, or
+    arrives whenever the sender's chunk pushes finish) and the GCS mailbox
+    (the sender's fallback for members it could not dial) — until the
+    deadline; typed timeout naming group/rank/tag otherwise. Interleaved
+    on purpose: a receiver that blocks before the sender starts (normal
+    collective ordering) must catch a direct delivery landing at ANY point
+    in the window, not just the first second."""
+    from ray_tpu._private import serialization
+    from ray_tpu.exceptions import CollectiveTimeoutError
+
+    deadline = time.monotonic() + timeout
+    key = bcast_key(group_name, tag)
+    gcs_key = mailbox_key(group_name, src_rank, my_rank, f"bcast/{tag}")
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            COLL.timeouts += 1
+            raise CollectiveTimeoutError(
+                f"group broadcast recv on {group_name!r} tag {tag!r}: nothing "
+                f"from rank {src_rank} within {timeout}s (direct mailbox and "
+                "GCS fallback both empty)",
+                group=group_name, ranks=[src_rank], tag=tag,
+            )
+        data = direct_recv(cw, key, timeout=min(0.25, remaining))
+        if data is not None:
+            COLL.bcast_recvs += 1
+            return serialization.loads(data)
+        try:
+            resp = gcs.call("kv_get", {"key": gcs_key})
+            if resp.get("found"):
+                gcs.call("kv_del", {"key": gcs_key})
+                COLL.bcast_recvs += 1
+                return serialization.loads(resp["value"])
+        except Exception:
+            pass  # GCS hiccup: the direct-path wait keeps the clock
 
 
 @blocking

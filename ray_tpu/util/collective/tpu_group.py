@@ -442,6 +442,44 @@ class TpuCollectiveGroup:
 
         return mailbox_recv(self._gcs, self.group_name, src_rank, self.rank, tag, timeout)
 
+    # ---- group broadcast (device_object.broadcast seam) ----
+    #
+    # IN-PROGRAM broadcasts already ride ICI (broadcast() above compiles to
+    # a masked psum over the mesh). These two move an OUT-OF-BAND payload —
+    # a sealed device object fanning holder→members — and, like send/recv,
+    # use the host direct-mailbox until jax exposes a cross-process
+    # device-to-device transfer in this image: swap the ICI/DMA group push
+    # in HERE (one serialize → one ICI broadcast over the group mesh)
+    # without touching any caller (DeviceObjectManager.broadcast_via_group).
+
+    def bcast_send_payload(self, value, tag: str, timeout: float = 30.0,
+                           mailbox_fallback: bool = True) -> dict:
+        from ray_tpu._private import worker_context
+        from ray_tpu.util.collective.p2p import fetch_member_addrs, group_bcast_send
+
+        cw = worker_context.get_core_worker()
+        # Membership is static per group epoch: one address fetch serves
+        # every broadcast (same cache shape as CpuCollectiveGroup._addrs).
+        addrs = getattr(self, "_bcast_addrs", None)
+        if addrs is None:
+            addrs = self._bcast_addrs = fetch_member_addrs(
+                self._gcs, self.group_name, self.world_size
+            )
+        return group_bcast_send(
+            cw, self._gcs, self.group_name, self.rank, self.world_size, tag,
+            value, member_addrs=addrs, timeout=timeout,
+            mailbox_fallback=mailbox_fallback,
+        )
+
+    def bcast_recv_payload(self, src_rank: int, tag: str, timeout: float = 120.0):
+        from ray_tpu._private import worker_context
+        from ray_tpu.util.collective.p2p import group_bcast_recv
+
+        cw = worker_context.get_core_worker()
+        return group_bcast_recv(
+            cw, self._gcs, self.group_name, src_rank, self.rank, tag, timeout
+        )
+
     def destroy(self):
         """Tear down the XLA world so the group can re-form (gang restart):
         drops the compiled-op cache, shuts down jax.distributed (releasing
@@ -451,6 +489,10 @@ class TpuCollectiveGroup:
         import jax
 
         self._op_cache.clear()
+        if self._gcs is not None:
+            from ray_tpu.util.collective.p2p import unregister_member_addr
+
+            unregister_member_addr(self._gcs, self.group_name, self.rank)
         if self.world_size > 1:
             try:
                 jax.distributed.shutdown()

@@ -1,0 +1,150 @@
+"""Podracer learner/sampler weight sync (ISSUE 15).
+
+The RLlib seam: ``weight_sync="device_broadcast"`` packs the learner's
+params into ONE flat device-resident vector, forms a learner↔sampler
+collective group at setup, and every sync is one
+``device_object.broadcast`` instead of K per-worker pytree ships —
+runnable from IMPALA and APPO unchanged. ``learner_mesh=True`` runs the
+jitted update on a pjit mesh over every local device (trivial on this
+1-device box; the multi-chip layout is a deployment detail).
+
+One module-scoped cluster (spin-up dominates tier-1 wall otherwise).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def pod_cluster():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    ray_tpu.init(num_cpus=6, object_store_memory=256 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# pack/unpack (clusterless)
+# ---------------------------------------------------------------------------
+
+
+def test_pack_unpack_roundtrip():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.core.learner import pack_weights, unpack_weights
+
+    params = {
+        "dense": {"w": jnp.arange(6.0, dtype=jnp.float32).reshape(2, 3),
+                  "b": jnp.full((3,), -1.5, jnp.float32)},
+        "head": jnp.ones((4,), jnp.float32),
+    }
+    flat = pack_weights(params)
+    assert flat.shape == (13,) and flat.dtype == jnp.float32
+    rebuilt = unpack_weights(np.asarray(flat), params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        params, rebuilt,
+    )
+
+
+def test_unpack_size_mismatch_raises():
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.core.learner import unpack_weights
+
+    with pytest.raises(ValueError, match="disagree on the module spec"):
+        unpack_weights(jnp.zeros((5,), jnp.float32), {"w": jnp.zeros((3, 3))})
+
+
+# ---------------------------------------------------------------------------
+# IMPALA / APPO on the device-broadcast topology
+# ---------------------------------------------------------------------------
+
+
+def _impala_config(**training_overrides):
+    from ray_tpu.rllib.algorithms.impala import IMPALAConfig
+
+    return (
+        IMPALAConfig()
+        .environment("CartPole-v1")
+        .rollouts(num_rollout_workers=2, rollout_fragment_length=16)
+        .training(lr=5e-4, train_batch_size=64, **training_overrides)
+        .debugging(seed=0)
+    )
+
+
+def test_impala_device_broadcast_topology(pod_cluster):
+    """IMPALA runs the Podracer topology end to end: the weight group forms
+    at setup, every broadcast-interval sync rides the group-broadcast plane
+    (COLL counters prove it), and training metrics stay finite."""
+    from ray_tpu.util.collective.p2p import COLL
+
+    cfg = _impala_config(weight_sync="device_broadcast", learner_mesh=True)
+    algo = cfg.build()
+    try:
+        assert algo._device_sync_ready
+        before = COLL.bcast_sends
+        m1 = algo.step()
+        m2 = algo.step()
+        # setup already synced once; each step syncs again (driver = holder,
+        # so the fan-outs are counted in THIS process).
+        assert COLL.bcast_sends - before >= 2
+        assert np.isfinite(m1["total_loss"]) and np.isfinite(m2["total_loss"])
+        # The learner's params actually reached the samplers: a fresh sync
+        # must be a no-op for behavior (greedy actions computable).
+        assert algo.compute_single_action(np.zeros(4, np.float32)) in (0, 1)
+    finally:
+        algo.cleanup()
+
+
+def test_impala_device_broadcast_survives_dead_sampler(pod_cluster):
+    """Kill one sampler between iterations: the sync loop respawns it and
+    feeds it the SAME packed ref — the replacement is outside the static
+    group and transparently falls back to the pull path."""
+    cfg = _impala_config(weight_sync="device_broadcast")
+    algo = cfg.build()
+    try:
+        algo.step()
+        ray_tpu.kill(algo.workers._workers[0])
+        algo.sync_worker_weights()  # must respawn + deliver, not raise
+        assert algo.workers.num_workers == 2
+        m = algo.step()
+        assert np.isfinite(m["total_loss"])
+    finally:
+        algo.cleanup()
+
+
+def test_appo_device_broadcast_runs(pod_cluster):
+    from ray_tpu.rllib.algorithms.appo import APPOConfig
+
+    cfg = (
+        APPOConfig()
+        .environment("CartPole-v1")
+        .rollouts(num_rollout_workers=2, rollout_fragment_length=16)
+        .training(lr=5e-4, train_batch_size=64, weight_sync="device_broadcast")
+        .debugging(seed=0)
+    )
+    algo = cfg.build()
+    try:
+        assert algo._device_sync_ready
+        m = algo.step()
+        assert np.isfinite(m["total_loss"])
+    finally:
+        algo.cleanup()
+
+
+def test_host_weight_sync_unchanged(pod_cluster):
+    """The default path stays the default: no group forms, no broadcast."""
+    cfg = _impala_config()
+    algo = cfg.build()
+    try:
+        assert not getattr(algo, "_device_sync_ready", False)
+        m = algo.step()
+        assert np.isfinite(m["total_loss"])
+    finally:
+        algo.cleanup()
