@@ -503,9 +503,10 @@ def device_objects_suite(results, duration):
     ray_tpu.shutdown()
 
 
-def collective_suite(results, quick=False):
-    """--collective: ISSUE 15 — learner→fleet weight-sync fan-out A/B
-    (COLLBENCH_r{N}.json).
+def collective_suite(results, quick=False, arms=("tree", "flat")):
+    """--collective: ISSUE 15 — learner→fleet weight-sync fan-out A/B, plus
+    ISSUE 16 — relay-tree vs flat group broadcast and the tree allreduce
+    oracle (COLLBENCH_r{N}.json).
 
     A tensor_transport learner actor holds a payload_mib flat weight vector
     device-resident; K sampler actors apply it each sync. Baseline arm =
@@ -613,6 +614,156 @@ def collective_suite(results, quick=False):
         results[f"wsync_k{K}_residents_after"] = residents
         for a in [learner] + samplers:
             ray_tpu.kill(a)
+
+    # ---- ISSUE 16: relay-tree vs flat broadcast + tree allreduce oracle ----
+    # On this 1-core loopback box raw wire time cannot separate the
+    # topologies, so the A/B runs under the PR 10 modeled-link convention:
+    # a 64 MiB/s per-process egress gate (p2p.set_modeled_egress) charges
+    # every collective push its wire time — the flat root pays K payloads
+    # through its gate, the tree root only its log-K children (relays pay
+    # theirs in PARALLEL on other processes). Raw loopback rows ride along
+    # unmodeled for honesty.
+    from ray_tpu.util.collective.p2p import COLL, set_modeled_egress
+
+    MODELED_MIB_S = 64.0
+    relay_mib = 1 if quick else 4
+    n_relay = relay_mib * 1024 * 1024 // 4
+    relay_fleet = [3] if quick else [4, 8]
+    relay_reps = 2 if quick else 3
+
+    @ray_tpu.remote
+    class RelayMember:
+        def init_collective(self, world_size, rank, backend, group_name):
+            col.init_collective_group(world_size, rank, backend=backend, group_name=group_name)
+
+        def set_egress(self, mib_per_s):
+            from ray_tpu.util.collective.p2p import set_modeled_egress as sme
+
+            sme(mib_per_s)
+            return True
+
+        def drain(self, group_name, src_rank, tag):
+            import numpy as np
+
+            out = col.get_group(group_name).bcast_recv_payload(src_rank, tag, timeout=120)
+            return int(np.asarray(out).size)
+
+        def allreduce(self, group_name, tag, n, flat_ring=False):
+            import numpy as np
+
+            g = col.get_group(group_name)
+            v = ((np.arange(n) % 97) + 3.0 * g.rank).astype(np.float32)
+            out = g.allreduce(v) if flat_ring else g.allreduce_payload(v, tag)
+            return np.asarray(out)
+
+        def coll_stats(self):
+            from ray_tpu.util.collective.p2p import COLL as C
+
+            return {k: getattr(C, k) for k in C.__slots__}
+
+    import numpy as np
+
+    results["relay_payload_mib"] = relay_mib
+    results["relay_modeled_egress_mib_per_s"] = MODELED_MIB_S
+    for K in relay_fleet:
+        members = [RelayMember.remote() for _ in range(K)]
+        group = f"relay{K}"
+        col.init_collective_group(K + 1, 0, backend="cpu", group_name=group)
+        ray_tpu.get(
+            [m.init_collective.remote(K + 1, i + 1, "cpu", group) for i, m in enumerate(members)],
+            timeout=120,
+        )
+        g = col.get_group(group)
+        payload = np.arange(n_relay, dtype=np.float32)
+        seq = iter(range(10_000))
+
+        def timed_bcast(topology):
+            tag = f"b{next(seq)}"
+            t0 = time.perf_counter()
+            info = g.bcast_send_payload(
+                payload, tag, timeout=120, mailbox_fallback=False, topology=topology
+            )
+            dt = time.perf_counter() - t0
+            assert len(info["ok_ranks"]) == K and not info["failed"], info
+            # Drain member inboxes OUTSIDE the timed send-to-ack window.
+            ray_tpu.get([m.drain.remote(group, 0, tag) for m in members], timeout=120)
+            return dt, info
+
+        def set_gate(mib):
+            set_modeled_egress(mib)
+            ray_tpu.get([m.set_egress.remote(mib) for m in members], timeout=60)
+
+        store_before = store_objects()
+        forwards_before = sum(
+            s["relay_forwards"]
+            for s in ray_tpu.get([m.coll_stats.remote() for m in members], timeout=60)
+        )
+        for topology in arms:
+            raw_dt, info = timed_bcast(topology)  # warm + raw loopback row
+            results[f"relay_{topology}_k{K}_raw_s"] = round(raw_dt, 4)
+            if topology == "tree":
+                assert info["topology"] == "tree", info
+                results[f"relay_tree_k{K}_root_egress_frac"] = round(
+                    info["root_egress_bytes"] / (K * info["bytes"]), 3
+                )
+            set_gate(MODELED_MIB_S)
+            try:
+                dts = sorted(timed_bcast(topology)[0] for _ in range(relay_reps))
+            finally:
+                set_gate(None)
+            dt = dts[relay_reps // 2]
+            results[f"relay_{topology}_k{K}_s"] = round(dt, 4)
+            results[f"relay_{topology}_k{K}_agg_mib_per_s"] = round(K * relay_mib / dt, 1)
+        if "tree" in arms and "flat" in arms:
+            results[f"relay_tree_speedup_k{K}"] = round(
+                results[f"relay_flat_k{K}_s"] / results[f"relay_tree_k{K}_s"], 2
+            )
+        forwards_after = sum(
+            s["relay_forwards"]
+            for s in ray_tpu.get([m.coll_stats.remote() for m in members], timeout=60)
+        )
+        results[f"relay_k{K}_relay_forwards"] = forwards_after - forwards_before
+        results[f"relay_k{K}_store_objects_delta"] = store_objects() - store_before
+        if "tree" in arms:
+            # Mid-tree relays actually carried payload, and nothing touched
+            # the host store — the quick-smoke contract.
+            assert results[f"relay_k{K}_relay_forwards"] > 0, results
+        assert results[f"relay_k{K}_store_objects_delta"] == 0, results
+
+        # Allreduce arm (raw loopback, both transports ungated): tree
+        # reduce-up/broadcast-down vs the flat GCS ring, with a BIT-EXACT
+        # integer-float32 oracle — combine order must not change the sum.
+        ar_group = f"ar{K}"
+        ray_tpu.get(
+            [m.init_collective.remote(K, i, "cpu", ar_group) for i, m in enumerate(members)],
+            timeout=120,
+        )
+        n_ar = (1 if quick else 2) * 1024 * 1024 // 4
+        expected = np.sum(
+            [((np.arange(n_ar) % 97) + 3.0 * r).astype(np.float32) for r in range(K)],
+            axis=0,
+            dtype=np.float64,
+        ).astype(np.float32)
+        for label, flat_ring in (("tree", False), ("ring", True)):
+            t0 = time.perf_counter()
+            outs = ray_tpu.get(
+                [m.allreduce.remote(ar_group, f"ar-{label}", n_ar, flat_ring) for m in members],
+                timeout=240,
+            )
+            dt = time.perf_counter() - t0
+            for out in outs:
+                assert (out == expected).all(), f"allreduce {label} k{K}: oracle mismatch"
+            results[f"allreduce_{label}_k{K}_s"] = round(dt, 4)
+            results[f"allreduce_{label}_k{K}_agg_mib_per_s"] = round(
+                K * (n_ar * 4 / 2**20) / dt, 1
+            )
+        results[f"allreduce_k{K}_bit_exact"] = 1
+
+        col.destroy_collective_group(group)
+        col.destroy_collective_group(ar_group)
+        for m in members:
+            ray_tpu.kill(m)
+    set_modeled_egress(None)
     ray_tpu.shutdown()
 
     # ---- end-to-end Podracer row: IMPALA on CartPole, host vs device sync ----
@@ -1820,7 +1971,21 @@ def main():
         help="group-broadcast weight-sync A/B (ISSUE 15): device-object "
         "broadcast vs K-serial-unicast at fleet sizes K, latency + "
         "aggregate MiB/s, zero-host-store evidence, and an end-to-end "
-        "Podracer IMPALA iterations/s row; records COLLBENCH_r{N}.json",
+        "Podracer IMPALA iterations/s row; plus (ISSUE 16) relay-tree vs "
+        "flat broadcast under a modeled egress link and the tree-allreduce "
+        "bit-exact oracle sweep; records COLLBENCH_r{N}.json",
+    )
+    ap.add_argument(
+        "--tree",
+        action="store_true",
+        help="with --collective: run only the relay-TREE broadcast arm of "
+        "the ISSUE 16 A/B (default: both arms)",
+    )
+    ap.add_argument(
+        "--flat",
+        action="store_true",
+        help="with --collective: run only the FLAT fan-out broadcast arm "
+        "of the ISSUE 16 A/B (default: both arms)",
     )
     ap.add_argument(
         "--transfer",
@@ -1964,8 +2129,11 @@ def main():
 
     if args.collective:
         results = {"host_cpus": os.cpu_count(), "mode": "collective"}
+        arms = tuple(
+            t for t, on in (("tree", args.tree), ("flat", args.flat)) if on
+        ) or ("tree", "flat")
         t0 = time.perf_counter()
-        collective_suite(results, quick=args.quick)
+        collective_suite(results, quick=args.quick, arms=arms)
         results["wall_s"] = round(time.perf_counter() - t0, 1)
         out = args.out or f"COLLBENCH_r{args.round}.json"
         with open(out, "w") as f:

@@ -262,9 +262,14 @@ class CoreWorker:
         # Direct p2p mailbox (util/collective/p2p.py): landing zone for
         # eager-pushed channel payloads (descriptor slots resolve from it
         # without a pull round trip) — rpc_p2p_data deposits into it.
-        from ray_tpu.util.collective.p2p import P2PInbox
+        from ray_tpu.util.collective.p2p import ChunkStreams, P2PInbox, RelayTable
 
         self.p2p_inbox = P2PInbox()
+        # Tree-collective planes: relay sessions forwarding broadcast
+        # chunks down the binomial tree (cut-through), and reduce partial
+        # streams combined chunk-at-a-time at each hop.
+        self.p2p_relays = RelayTable()
+        self.p2p_streams = ChunkStreams()
         self.pending_tasks: dict[str, PendingTask] = {}
         # Tombstones for cancelled tasks that may not have reached this
         # process yet (cancel racing submission); checked at execution
@@ -2404,9 +2409,23 @@ class CoreWorker:
         frame: the payload lands right after the slot publish, so ONE frame
         both delivers the bytes and wakes the blocked reader)."""
         key = req["key"]
+        if key.startswith("collred/"):
+            # Tree-reduce partials: consumed chunk-at-a-time by a combiner
+            # on an executor thread — never reassembled, so they land in
+            # the stream pads instead of the inbox.
+            self.p2p_streams.deposit(key, req.get("idx", 0), req["data"])
+            return {"ok": True}
         done = self.p2p_inbox.deposit(
             key, req.get("idx", 0), req.get("total", 1), req["data"]
         )
+        if req.get("relay"):
+            # Mid-tree member of a tree broadcast: forward this chunk to
+            # our own children the moment the contiguous prefix reaches it
+            # (cut-through; the inbox keeps its copy for the local take).
+            self.p2p_relays.feed(
+                self, key, req.get("idx", 0), req.get("total", 1),
+                req["data"], req["relay"],
+            )
         if done and key.startswith("chdev/"):
             self.channels.ring_doorbell(key.split("/", 2)[1])
         return {"ok": True}
@@ -2460,6 +2479,43 @@ class CoreWorker:
         if ok:
             return {"kind": "plasma", "location": self.node_id}
         return {"kind": "missing"}
+
+    async def rpc_devobj_reduce(self, req):
+        """One HOLDER's share of a device-object group reduce/allreduce:
+        feed the resident array into the tree combine on an executor
+        thread (chunk waits + elementwise math must not stall the IO
+        loop). The gang is concurrent by construction — the driver
+        dispatches every holder's RPC in parallel and each holder blocks
+        in the collective until its children/parent move."""
+        mgr = self._device_objects
+        oid = req["object_id"]
+        entry = mgr.entry(oid) if mgr is not None else None
+        if entry is None:
+            return {"kind": "missing"}
+        group = req.get("group")
+        from ray_tpu.util.collective import is_group_initialized
+
+        if group is None or not is_group_initialized(group):
+            return {
+                "kind": "error",
+                "error": f"holder has no collective group {group!r}",
+            }
+        loop = asyncio.get_event_loop()
+        try:
+            result = await loop.run_in_executor(
+                None, mgr.reduce_via_group, oid, group,
+                req.get("mode", "allreduce"), req.get("op", "SUM"),
+                int(req.get("dst_rank", 0)), req["tag"],
+                float(req.get("timeout", 60.0)),
+            )
+        except KeyError:
+            return {"kind": "missing"}
+        except Exception as e:
+            # The collective itself failed (timeout naming a silent child,
+            # shape disagreement, ...): the object is intact — answer with
+            # the error instead of severing the connection.
+            return {"kind": "error", "error": repr(e)}
+        return {"kind": "collective", **result}
 
     async def rpc_devobj_stats(self, req):
         from ray_tpu.experimental.device_object.manager import device_object_stats

@@ -117,6 +117,9 @@ EVENT_TYPES = (
     "replica_drain",   # 43: serve replica drain begin/done (detail replica_id:phase)
     # Group collectives on the device-object plane (PR 15).
     "coll_broadcast",  # 44: holder fanned a device object to a group (detail oid:group:ok/targets:bytes)
+    # Relay-tree collectives (PR 16).
+    "coll_relay",      # 45: this member relayed a tree-broadcast payload to its children (detail tag:group:rank:children:bytes)
+    "coll_reduce",     # 46: holder fed a device object into a group reduce/allreduce (detail oid:group:mode:rank:replaced)
 )
 _CODE = {name: i for i, name in enumerate(EVENT_TYPES)}
 

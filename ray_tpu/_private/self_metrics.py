@@ -319,6 +319,48 @@ def instruments() -> dict:
                 "Typed collective timeouts raised (CollectiveTimeoutError: "
                 "ring _collect and broadcast recv).",
             ),
+            # --- relay-tree collectives (PR 16) ---
+            "collective_tree_sends": m.Counter(
+                "ray_tpu_collective_tree_broadcasts_total",
+                "Group broadcasts that rode the binomial relay tree "
+                "(vs the flat per-rank fan-out).",
+            ),
+            "collective_bcast_retries": m.Counter(
+                "ray_tpu_collective_bcast_retries_total",
+                "Ranks re-delivered DIRECTLY after a relay failure orphaned "
+                "them (tree broadcast flat-fallback recoveries).",
+            ),
+            "collective_root_egress_bytes": m.Counter(
+                "ray_tpu_collective_root_egress_bytes_total",
+                "Payload bytes this process pushed as a broadcast ROOT — "
+                "sub-O(K) on the tree topology (the relay fan-out carries "
+                "the rest).",
+            ),
+            "collective_relay_forwards": m.Counter(
+                "ray_tpu_collective_relay_forwards_total",
+                "Relay legs completed by this process (every chunk of one "
+                "tree broadcast forwarded to one child).",
+            ),
+            "collective_relay_bytes": m.Counter(
+                "ray_tpu_collective_relay_bytes_total",
+                "Payload bytes this process forwarded mid-tree (cut-through "
+                "relay; counted at the forwarding member, not the root).",
+            ),
+            "collective_reduce_sends": m.Counter(
+                "ray_tpu_collective_reduce_sends_total",
+                "Tree-reduce participations by this process (one per "
+                "group_reduce_send call that completed).",
+            ),
+            "collective_reduce_bytes": m.Counter(
+                "ray_tpu_collective_reduce_bytes_total",
+                "Combined-partial bytes this process pushed up the reduce "
+                "tree toward its parent.",
+            ),
+            "collective_allreduces": m.Counter(
+                "ray_tpu_collective_allreduces_total",
+                "Allreduce participations (tree reduce up + broadcast "
+                "back down) by this process.",
+            ),
             # --- actor lifecycle (gcs.py) ---
             "actor_restarts": m.Counter(
                 "ray_tpu_actor_restarts_total", "Actor restarts driven by the GCS."
@@ -496,6 +538,14 @@ def _collect_collective_stats():
         ("bcast_fallbacks", inst["collective_bcast_fallbacks"], None),
         ("bcast_failed_ranks", inst["collective_bcast_failed_ranks"], None),
         ("timeouts", inst["collective_timeouts"], None),
+        ("tree_sends", inst["collective_tree_sends"], None),
+        ("bcast_retries", inst["collective_bcast_retries"], None),
+        ("root_egress_bytes", inst["collective_root_egress_bytes"], None),
+        ("relay_forwards", inst["collective_relay_forwards"], None),
+        ("relay_bytes", inst["collective_relay_bytes"], None),
+        ("reduce_sends", inst["collective_reduce_sends"], None),
+        ("reduce_bytes", inst["collective_reduce_bytes"], None),
+        ("allreduces", inst["collective_allreduces"], None),
     ])
 
 

@@ -156,6 +156,27 @@ class CollectiveBroadcastError(CollectiveError):
         )
 
 
+class CollectiveReduceError(CollectiveError):
+    """A device-object group reduce/allreduce could not complete on every
+    holder. Unlike a failed broadcast (survivors keep their payload), a
+    PARTIAL reduce is poison — some holders may already hold the combined
+    value while others kept their contribution — so ``failed`` names every
+    holder that did not finish and the caller must treat the gang as
+    divergent (re-run or rebuild)."""
+
+    def __init__(self, msg: str = "", *, group: str = "", failed: dict | None = None, info: dict | None = None):
+        self.group = group
+        self.failed = dict(failed or {})
+        self.info = dict(info or {})
+        super().__init__(
+            msg
+            or (
+                f"group reduce on {group or '<unknown>'} failed for holders "
+                f"{sorted(self.failed)}: {self.failed}"
+            )
+        )
+
+
 class OutOfMemoryError(RayTpuError):
     """A task's worker was killed by the node memory monitor (reference:
     ray.exceptions.OutOfMemoryError + worker_killing_policy)."""

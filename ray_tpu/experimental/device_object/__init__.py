@@ -36,6 +36,8 @@ Ray paper (arXiv:1712.05889) is why this stays inside the ObjectRef
 ownership model instead of becoming a side API.
 """
 
+import itertools
+
 from ray_tpu.experimental.device_object.descriptor import (  # noqa: F401
     TENSOR_TRANSPORTS,
     DeviceObjectMeta,
@@ -157,6 +159,98 @@ def broadcast(ref, group_name: str | None = None, *, timeout: float = 60.0,
     return result
 
 
+_REDUCE_SEQ = itertools.count(1)
+
+
+def reduce(refs: list, group_name: str, *, op=None, dst_rank: int = 0,
+           timeout: float = 120.0, strict: bool = True) -> dict:
+    """Group reduce over device objects: ``refs`` holds ONE ref per group
+    member (rank order), each device-resident on its holder; the holders
+    combine them elementwise up the relay tree (chunk-wise at every hop on
+    the cpu backend, psum on tpu) and the ``dst_rank`` holder's array is
+    REPLACED in place with the result — its descriptor is unchanged, so
+    the next resolve of ``refs[dst_rank]`` sees the combined value. Other
+    holders keep their contribution. ``strict=True`` raises
+    :class:`~ray_tpu.exceptions.CollectiveReduceError` naming any holder
+    that did not finish (a partial reduce is poison — see the exception)."""
+    return _reduce_verb(refs, group_name, "reduce", op, dst_rank, timeout, strict)
+
+
+def allreduce(refs: list, group_name: str, *, op=None,
+              timeout: float = 120.0, strict: bool = True) -> dict:
+    """Group allreduce over device objects: like :func:`reduce`, but the
+    combined result broadcasts back down the tree and EVERY holder's array
+    is replaced in place — after this, all of ``refs`` resolve to the same
+    reduced value (the multi-host gradient-sync primitive the Podracer
+    learner seam rides as ``grad_sync="device_allreduce"``)."""
+    return _reduce_verb(refs, group_name, "allreduce", op, 0, timeout, strict)
+
+
+def _reduce_verb(refs, group_name, mode, op, dst_rank, timeout, strict) -> dict:
+    from concurrent.futures import ThreadPoolExecutor
+
+    from ray_tpu._private import worker_context
+    from ray_tpu.exceptions import CollectiveReduceError
+    from ray_tpu.util.collective.types import ReduceOp
+
+    if not refs:
+        raise ValueError("reduce/allreduce needs one ref per group member")
+    op = op or ReduceOp.SUM
+    cw = worker_context.get_core_worker()
+    metas = [cw.get_device_meta(ref, timeout=timeout) for ref in refs]
+    # One tag per gang op: every holder must combine under the SAME stream
+    # keys, and a second reduce over the same refs must not collide with
+    # the first (unlike broadcast, reduces repeat per training step).
+    tag = f"{metas[0].object_id[:16]}.{next(_REDUCE_SEQ)}"
+
+    def _one(meta):
+        if tuple(meta.holder_addr) == tuple(cw.address):
+            try:
+                out = cw._device_manager().reduce_via_group(
+                    meta.object_id, group_name, mode, op.name, dst_rank, tag, timeout
+                )
+                return {"kind": "collective", **out}
+            except KeyError:
+                return {"kind": "missing"}
+            except Exception as e:
+                return {"kind": "error", "error": repr(e)}
+        try:
+            return cw._devobj_client(tuple(meta.holder_addr)).call(
+                "devobj_reduce",
+                {"object_id": meta.object_id, "group": group_name, "mode": mode,
+                 "op": op.name, "dst_rank": dst_rank, "tag": tag, "timeout": timeout},
+                timeout=timeout + 20.0,
+            )
+        except _unreachable_errors() as e:
+            return {"kind": "error", "error": f"holder unreachable: {e!r}"}
+        except Exception as e:
+            return {"kind": "error", "error": repr(e)}
+
+    # The gang is concurrent BY REQUIREMENT: every holder blocks inside the
+    # collective until its children/parent move, so the pool must be wide
+    # enough for all of them at once — a capped pool would deadlock the op.
+    with ThreadPoolExecutor(max_workers=len(metas)) as pool:
+        per_holder = list(pool.map(_one, metas))
+
+    failed: dict = {}
+    ranks = []
+    for meta, res in zip(metas, per_holder):
+        if res.get("kind") == "collective":
+            ranks.append(res.get("rank"))
+        elif res.get("kind") == "missing":
+            failed[meta.holder_label()] = "device object missing on holder"
+        else:
+            failed[meta.holder_label()] = res.get("error", "reduce failed")
+    result = {
+        "kind": "collective", "group": group_name, "mode": mode, "op": op.name,
+        "tag": tag, "ok_ranks": sorted(r for r in ranks if r is not None),
+        "failed": failed,
+    }
+    if strict and failed:
+        raise CollectiveReduceError(group=group_name, failed=failed, info=result)
+    return result
+
+
 def allgather(refs: list, group_name: str | None = None, *, timeout: float = 60.0,
               strict: bool = True) -> list:
     """Group allgather for device objects: every member ends up able to
@@ -184,8 +278,10 @@ __all__ = [
     "DeviceObjectMeta",
     "TENSOR_TRANSPORTS",
     "allgather",
+    "allreduce",
     "broadcast",
     "device_object_stats",
+    "reduce",
     "resolve_meta",
     "validate_transport",
 ]

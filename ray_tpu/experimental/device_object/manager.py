@@ -412,6 +412,75 @@ class DeviceObjectManager:
         )
         return result
 
+    @blocking
+    def reduce_via_group(self, oid_hex: str, group_name: str, mode: str,
+                         op_name: str, dst_rank: int, tag: str,
+                         timeout: float = 60.0) -> dict:
+        """This HOLDER's share of a device-object group reduce/allreduce:
+        feed the live array into the tree combine
+        (``group.allreduce_payload`` / ``reduce_send_payload`` — chunk-wise
+        combine at relay hops on the cpu backend, psum on tpu) and REPLACE
+        the resident array with the result — NCCL-style in-place semantics:
+        the descriptor keeps its identity/shape/dtype and every consumer's
+        NEXT resolve sees the combined value. ``allreduce`` replaces on
+        every holder; ``reduce`` only on the ``dst_rank`` holder (other
+        holders keep their contribution). Runs on an executor thread
+        (driven by ``rpc_devobj_reduce``). Raises KeyError when the entry
+        was freed; collective errors (typed timeout naming a silent child,
+        shape disagreement) propagate for the RPC layer to answer with."""
+        from ray_tpu.util.collective import get_group
+        from ray_tpu.util.collective.types import ReduceOp
+
+        arr = self.get_local(oid_hex)
+        if arr is None:
+            raise KeyError(oid_hex)
+        group = get_group(group_name)
+        op = ReduceOp[op_name] if isinstance(op_name, str) else op_name
+        if mode == "allreduce":
+            out = group.allreduce_payload(arr, tag=tag, op=op, timeout=timeout)
+        else:
+            out = group.reduce_send_payload(
+                arr, tag=tag, op=op, dst_rank=dst_rank, timeout=timeout
+            )
+        replaced = out is not None
+        if replaced:
+            self._replace_resident(oid_hex, out)
+        DEVOBJ_STATS.transfers_collective += 1
+        flight_recorder.record(
+            "coll_reduce",
+            f"{oid_hex[:12]}:{group_name}:{mode}:{group.rank}:{int(replaced)}",
+        )
+        return {"rank": group.rank, "world_size": group.world_size, "reduced": replaced}
+
+    @any_thread
+    def _replace_resident(self, oid_hex: str, value) -> None:
+        """Swap the live array under an existing entry, preserving the
+        descriptor's dtype/shape (the meta already sealed into the store
+        must stay truthful). A freed-while-reducing entry is a no-op."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            entry = self._entries.get(oid_hex)
+            if entry is None or entry.array is None:
+                return
+            entry.array = jnp.asarray(value, dtype=entry.array.dtype).reshape(
+                entry.array.shape
+            )
+            entry.last_access = time.monotonic()
+            had_store_copy = entry.in_store
+            entry.in_store = False
+        if had_store_copy:
+            # The arena held PRE-reduce bytes: a later spill/restore or
+            # host-path pull must not resurrect them. Delete the copy; the
+            # next materialize reseals from the combined array.
+            async def _free_store():
+                try:
+                    await self.cw.raylet.acall("free_object", {"object_id": oid_hex})
+                except Exception:
+                    pass
+
+            self.cw._io.spawn(_free_store())
+
     def _schedule_mailbox_janitor(self, key: str, delay_s: float = 180.0):
         async def _sweep():
             import asyncio

@@ -48,6 +48,12 @@ class AlgorithmConfig:
         self.weight_sync = "host"
         self.weight_sync_group = "rllib_weights"
         self.weight_sync_backend = "cpu"  # "tpu" on hardware: ICI broadcast seam
+        # Gradient-sync transport for multi-learner data parallelism:
+        # "host" allreduces each grad leaf through the ring collective;
+        # "device_allreduce" packs grads into ONE flat vector and rides the
+        # relay-tree allreduce (reduce up the binomial tree, broadcast back
+        # down) — same plane the Podracer weight broadcast uses.
+        self.grad_sync = "host"
         # Podracer learner mesh: shard the update's batch over every local
         # device (pjit data-parallel cell) instead of single-device jit.
         self.learner_mesh = False
@@ -112,6 +118,7 @@ class AlgorithmConfig:
                  model_hiddens=None, model_conv_filters=None,
                  weight_sync: Optional[str] = None,
                  weight_sync_backend: Optional[str] = None,
+                 grad_sync: Optional[str] = None,
                  learner_mesh: Optional[bool] = None, **extra) -> "AlgorithmConfig":
         if lr is not None:
             self.lr = lr
@@ -126,6 +133,9 @@ class AlgorithmConfig:
             self.weight_sync = weight_sync
         if weight_sync_backend is not None:
             self.weight_sync_backend = weight_sync_backend
+        if grad_sync is not None:
+            assert grad_sync in ("host", "device_allreduce"), grad_sync
+            self.grad_sync = grad_sync
         if learner_mesh is not None:
             self.learner_mesh = learner_mesh
         if model_hiddens is not None:

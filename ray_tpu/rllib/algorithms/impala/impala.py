@@ -124,6 +124,7 @@ class IMPALA(Algorithm):
             num_learners=cfg.num_learners,
             num_tpus_per_learner=cfg.num_tpus_per_learner,
             use_mesh=getattr(cfg, "learner_mesh", False),
+            grad_sync=getattr(cfg, "grad_sync", "host"),
         )
 
     def training_step(self) -> dict:

@@ -167,10 +167,12 @@ class _RemoteLearner:
     """Learner living in its own actor; grads allreduced through the
     collective plane before the optimizer step (reference: DDP learners)."""
 
-    def __init__(self, spec, loss_fn, lr, grad_clip, seed, rank, world_size, group_name, use_mesh=False):
+    def __init__(self, spec, loss_fn, lr, grad_clip, seed, rank, world_size, group_name, use_mesh=False, grad_sync="host"):
         self.rank = rank
         self.world_size = world_size
         self.group_name = group_name
+        self.grad_sync = grad_sync
+        self._grad_step = 0
         self.learner = Learner(spec, loss_fn, lr, grad_clip, seed, use_mesh=use_mesh)
 
     def init_collective(self, world, backend):
@@ -215,13 +217,31 @@ class _RemoteLearner:
 
             jb = {k: jnp.asarray(v) for k, v in batch.items()}
             (loss, metrics), grads = jax.value_and_grad(total_loss, has_aux=True)(self.learner.params, jb)
-            flat, treedef = jax.tree_util.tree_flatten(grads)
-            reduced = [collective.allreduce(np.asarray(g) / self.world_size, group_name=self.group_name) for g in flat]
-            grads = jax.tree_util.tree_unflatten(treedef, [jnp.asarray(g) for g in reduced])
+            grad_allreduce_tree = 0.0
+            if self.grad_sync == "device_allreduce":
+                # Relay-tree path: the whole grad pytree rides as ONE flat
+                # vector through the tree allreduce (reduce up the binomial
+                # tree with chunk-wise combine at relay hops, broadcast back
+                # down) instead of a per-leaf ring round-trip.
+                from ray_tpu.util.collective.p2p import COLL
+
+                group = collective.get_group(self.group_name)
+                self._grad_step += 1
+                before = COLL.reduce_sends
+                packed = pack_weights(grads) / self.world_size
+                avg = group.allreduce_payload(packed, tag=f"grad{self._grad_step}")
+                grads = unpack_weights(avg, grads)
+                grad_allreduce_tree = float(COLL.reduce_sends - before)
+            else:
+                flat, treedef = jax.tree_util.tree_flatten(grads)
+                reduced = [collective.allreduce(np.asarray(g) / self.world_size, group_name=self.group_name) for g in flat]
+                grads = jax.tree_util.tree_unflatten(treedef, [jnp.asarray(g) for g in reduced])
             updates, self.learner.opt_state = self.learner.tx.update(grads, self.learner.opt_state, self.learner.params)
             self.learner.params = jax.tree_util.tree_map(lambda p, u: p + u, self.learner.params, updates)
             out = {k: float(v) for k, v in dict(metrics).items()}
             out["total_loss"] = float(loss)
+            if self.grad_sync == "device_allreduce":
+                out["grad_allreduce_tree"] = grad_allreduce_tree
             return out
         return self.learner.update(batch, loss_cfg)
 
@@ -241,7 +261,7 @@ class LearnerGroup:
     def __init__(self, spec, loss_fn, *, lr=5e-5, grad_clip=None, seed=0,
                  num_learners: int = 0, num_tpus_per_learner: float = 0,
                  collective_backend: str = "cpu", group_name: str = "rllib_learners",
-                 use_mesh: bool = False):
+                 use_mesh: bool = False, grad_sync: str = "host"):
         self._local: Optional[Learner] = None
         self._actors: list = []
         if num_learners <= 0:
@@ -256,7 +276,7 @@ class LearnerGroup:
                 tensor_transport="collective",
             )(_RemoteLearner)
             self._actors = [
-                cls.remote(spec, loss_fn, lr, grad_clip, seed, rank, num_learners, group_name, use_mesh)
+                cls.remote(spec, loss_fn, lr, grad_clip, seed, rank, num_learners, group_name, use_mesh, grad_sync)
                 for rank in range(num_learners)
             ]
             if num_learners > 1:

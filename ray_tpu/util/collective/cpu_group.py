@@ -10,9 +10,14 @@ the performance path.
 Payload semantics: values serialize through ``_private/serialization``, so a
 ``jax.Array`` round-trips bit-exact WITH its sharding layout — ``broadcast``
 hands every rank the src rank's value as-is (a sharded weight tensor lands
-re-sharded on the receiver's devices), while the reducing ops and
-``allgather`` densify to numpy (a stack across ranks has no single sharding
-to preserve).
+re-sharded on the receiver's devices). The reducing ops
+(``allreduce``/``reduce``/``reducescatter``) now ALSO stay in jnp when
+every rank's contribution is a ``jax.Array`` (the stack-reduce runs under
+jax and the output is a device array — placement parity with
+``broadcast``). The densify-to-numpy cases that REMAIN: any round where at
+least one rank posts a non-jax value (the whole stack densifies),
+``allgather`` (a cross-rank stack has no single sharding to preserve), and
+``send_recv``.
 """
 
 from __future__ import annotations
@@ -32,6 +37,14 @@ _REDUCE = {
 }
 
 
+def _is_jax_array(v) -> bool:
+    try:
+        import jax
+    except Exception:
+        return False
+    return isinstance(v, jax.Array)
+
+
 def _uniform_stack(group_name: str, step: str, values: list) -> np.ndarray:
     """np.stack with a TYPED shape check: ranks contributing mismatched
     shapes/dtypes is a programming error that must name the offenders, not
@@ -47,6 +60,27 @@ def _uniform_stack(group_name: str, step: str, values: list) -> np.ndarray:
             f"shapes across ranks, got {per_rank}"
         )
     return np.stack(arrs)
+
+
+def _reduce_stack(group_name: str, step: str, values: list, op: ReduceOp):
+    """Stack-and-reduce that keeps the math in jnp when EVERY contribution
+    is a jax.Array — the reduce output is then a device array, matching
+    broadcast's payload-parity contract. Mixed or plain-numpy rounds take
+    the densifying path (with the typed uniform-shape check)."""
+    if values and all(_is_jax_array(v) for v in values):
+        from ray_tpu.exceptions import CollectiveError
+
+        shapes = {tuple(v.shape) for v in values}
+        if len(shapes) > 1:
+            per_rank = {r: tuple(v.shape) for r, v in enumerate(values)}
+            raise CollectiveError(
+                f"collective {step} on group {group_name!r} requires uniform "
+                f"shapes across ranks, got {per_rank}"
+            )
+        import jax.numpy as jnp
+
+        return _REDUCE[op](jnp.stack(values))
+    return _REDUCE[op](_uniform_stack(group_name, step, values))
 
 
 class CpuCollectiveGroup:
@@ -107,17 +141,20 @@ class CpuCollectiveGroup:
         return stack
 
     def allreduce(self, x, op: ReduceOp = ReduceOp.SUM):
-        stack = self._sync("allreduce", np.asarray(x))
-        return _REDUCE[op](_uniform_stack(self.group_name, "allreduce", stack))
+        # Post jax values as-is (they serialize with their sharding): if
+        # EVERY rank does, the reduce stays in jnp and the output is a
+        # device array (placement parity with broadcast).
+        stack = self._sync("allreduce", x if _is_jax_array(x) else np.asarray(x))
+        return _reduce_stack(self.group_name, "allreduce", stack, op)
 
     def allgather(self, x):
         return _uniform_stack(self.group_name, "allgather", self._sync("allgather", x))
 
     def reducescatter(self, x, op: ReduceOp = ReduceOp.SUM):
-        x = np.asarray(x)
-        assert x.shape[0] == self.world_size
-        stack = self._sync("reducescatter", x)
-        return _REDUCE[op](_uniform_stack(self.group_name, "reducescatter", stack))[self.rank]
+        post = x if _is_jax_array(x) else np.asarray(x)
+        assert post.shape[0] == self.world_size
+        stack = self._sync("reducescatter", post)
+        return _reduce_stack(self.group_name, "reducescatter", stack, op)[self.rank]
 
     def broadcast(self, x, src_rank: int = 0):
         """Every rank gets the src rank's value AS POSTED: a jax.Array
@@ -165,13 +202,15 @@ class CpuCollectiveGroup:
         return self._member_addrs
 
     def bcast_send_payload(self, value, tag: str, timeout: float = 30.0,
-                           mailbox_fallback: bool = True) -> dict:
-        """Holder-side group broadcast: one serialize, concurrent acked
-        chunk pushes at every member's direct mailbox (p2p.group_bcast_send)
-        — the fan-out device_object.broadcast() rides. Returns the per-rank
+                           mailbox_fallback: bool = True,
+                           topology: str = "tree") -> dict:
+        """Holder-side group broadcast: one serialize, acked chunk pushes
+        riding the binomial relay tree by default (p2p.group_bcast_send) —
+        the fan-out device_object.broadcast() rides. Returns the per-rank
         delivery map; never raises for a dead member (the caller owns the
         policy). ``mailbox_fallback=False`` when receivers only watch the
-        direct inbox (the descriptor-resolution path)."""
+        direct inbox (the descriptor-resolution path); ``topology="flat"``
+        forces PR 15's per-rank fan-out (the bench A/B arm)."""
         from ray_tpu._private import worker_context
         from ray_tpu.util.collective.p2p import group_bcast_send
 
@@ -179,7 +218,62 @@ class CpuCollectiveGroup:
         return group_bcast_send(
             cw, self.gcs, self.group_name, self.rank, self.world_size, tag,
             value, member_addrs=self._addrs(), timeout=timeout,
-            mailbox_fallback=mailbox_fallback,
+            mailbox_fallback=mailbox_fallback, topology=topology,
+        )
+
+    def _finalize_like(self, value, out):
+        """Payload-parity for the reducing verbs: a jax input produces a
+        jax output (the tree combines on the host — np bytes on the wire —
+        so the root converts back once before handing out/broadcasting)."""
+        if _is_jax_array(value):
+            import jax.numpy as jnp
+
+            return jnp.asarray(out)
+        return out
+
+    def reduce_send_payload(self, value, tag: str, op: ReduceOp = ReduceOp.SUM,
+                            dst_rank: int = 0, timeout: float = 60.0):
+        """Tree reduce toward ``dst_rank`` over the direct-mailbox plane
+        (p2p.group_reduce_send): partials combine chunk-wise at every relay
+        hop, so no single member ever receives K payloads. Returns the
+        reduced value on ``dst_rank`` (same placement as ``value``), None
+        elsewhere. Falls back to the GCS ring when any member lacks a
+        registered address (old-style members) or the group is trivial
+        (world_size < 2)."""
+        addrs = self._addrs()
+        missing = [r for r in range(self.world_size) if r != self.rank and r not in addrs]
+        if self.world_size < 2 or missing:
+            return self.reduce(value, dst_rank, op)
+        from ray_tpu._private import worker_context
+        from ray_tpu.util.collective.p2p import group_reduce_send
+
+        cw = worker_context.get_core_worker()
+        out = group_reduce_send(
+            cw, self.gcs, self.group_name, self.rank, self.world_size, tag,
+            value, op=op, dst_rank=dst_rank, member_addrs=addrs, timeout=timeout,
+        )
+        if out is None:
+            return None
+        return self._finalize_like(value, out)
+
+    def allreduce_payload(self, value, tag: str, op: ReduceOp = ReduceOp.SUM,
+                          timeout: float = 60.0):
+        """Tree allreduce (reduce up to rank 0, tree-broadcast back down):
+        every rank returns the same reduced value, placed like ``value``
+        (the root finalizes ONCE before the down-broadcast). Ring fallback
+        under the same conditions as :meth:`reduce_send_payload`."""
+        addrs = self._addrs()
+        missing = [r for r in range(self.world_size) if r != self.rank and r not in addrs]
+        if self.world_size < 2 or missing:
+            return self.allreduce(value, op)
+        from ray_tpu._private import worker_context
+        from ray_tpu.util.collective.p2p import group_allreduce
+
+        cw = worker_context.get_core_worker()
+        return group_allreduce(
+            cw, self.gcs, self.group_name, self.rank, self.world_size, tag,
+            value, op=op, member_addrs=addrs, timeout=timeout,
+            finalize=lambda reduced: self._finalize_like(value, reduced),
         )
 
     def bcast_recv_payload(self, src_rank: int, tag: str, timeout: float = 120.0):

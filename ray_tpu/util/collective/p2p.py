@@ -39,7 +39,8 @@ from __future__ import annotations
 import threading
 import time
 
-from ray_tpu._private.concurrency import any_thread, blocking
+from ray_tpu._private.concurrency import any_thread, blocking, loop_only
+from ray_tpu.util.collective.types import ReduceOp
 
 _POLL_S = 0.003
 # Direct-mailbox chunk size: one-way frames on the existing worker pipe,
@@ -286,6 +287,273 @@ def direct_send(cw, addr: tuple, key: str, data: bytes) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Modeled egress link (bench-only)
+# ---------------------------------------------------------------------------
+
+# When set, every outbound payload chunk on the group plane (root fan-out,
+# relay forwards, reduce up-pushes) serializes through ONE per-process
+# asyncio.Lock and sleeps bytes/bandwidth. This is the PR 10 convention
+# (PERF_NOTES.md): loopback has no per-NIC budget, so an unthrottled A/B
+# cannot show what a relay tree buys — the modeled link is the honest
+# stand-in for the per-host egress bandwidth the tree divides on a real
+# fleet. Off (None) outside the bench.
+_EGRESS_BPS: float | None = None
+_EGRESS_LOCK = None  # created lazily on the IO loop
+
+
+@any_thread
+def set_modeled_egress(mib_per_s: float | None) -> None:
+    """Install (or clear, with None) the modeled per-process egress link."""
+    global _EGRESS_BPS
+    _EGRESS_BPS = None if not mib_per_s else float(mib_per_s) * 1024 * 1024
+
+
+async def _gate_egress(nbytes: int) -> None:
+    global _EGRESS_LOCK
+    bps = _EGRESS_BPS
+    if not bps:
+        return
+    import asyncio
+
+    if _EGRESS_LOCK is None:
+        _EGRESS_LOCK = asyncio.Lock()
+    async with _EGRESS_LOCK:
+        await asyncio.sleep(nbytes / bps)
+
+
+# ---------------------------------------------------------------------------
+# Binomial relay tree
+# ---------------------------------------------------------------------------
+
+
+def _binomial_children(pos: int, n: int) -> list[int]:
+    """Child POSITIONS of ``pos`` in the binomial broadcast tree over ``n``
+    positions rooted at 0: ``pos + 2**k`` for every power of two strictly
+    greater than ``pos`` (depth ceil(log2 n), root degree floor(log2 n) —
+    the classic recursive-doubling shape, so the root writes O(log K)
+    streams instead of K)."""
+    kids = []
+    step = 1
+    while step <= pos:
+        step <<= 1
+    while pos + step < n:
+        kids.append(pos + step)
+        step <<= 1
+    return kids
+
+
+class RelayTable:
+    """Per-process cut-through relay sessions for TREE group broadcasts
+    (one per core worker; ``rpc_p2p_data`` feeds it when a chunk frame
+    carries a ``relay`` spec). Each landed chunk is forwarded to this
+    member's own tree children the moment the contiguous prefix reaches it
+    — the ``push_manager.stream_from_session`` watermark pattern, NOT
+    store-and-forward, so the next hop starts before this one finishes.
+    All state lives on the IO loop (deposits and forwarder tasks alike):
+    no lock. The inbox keeps its own copy for the local take()."""
+
+    def __init__(self):
+        from ray_tpu._private.ids import BoundedIdSet
+
+        self._sessions: dict[str, _RelaySession] = {}
+        # Delivery is at-least-once under connection blips (and chaos dup
+        # injection): a duplicate chunk landing after the session finished
+        # must not resurrect it.
+        self._finished = BoundedIdSet(cap=512)
+
+    @loop_only
+    def feed(self, cw, key: str, idx: int, total: int, data: bytes, relay: dict) -> None:
+        st = self._sessions.get(key)
+        if st is None:
+            if key in self._finished:
+                return
+            st = self._sessions[key] = _RelaySession(key, int(total), relay)
+            st.start(cw, self)
+        st.chunks[idx] = data
+        while st.contig in st.chunks:
+            st.contig += 1
+        st.event.set()
+
+    @loop_only
+    def finish(self, key: str) -> None:
+        if self._sessions.pop(key, None) is not None:
+            self._finished.add(key)
+
+    def stats(self) -> dict:
+        return {"sessions": len(self._sessions)}
+
+
+class _RelaySession:
+    """One in-flight relay: the chunks as they land, the contiguous-prefix
+    watermark, and a forwarder task per tree child racing it."""
+
+    __slots__ = ("key", "total", "relay", "chunks", "contig", "event",
+                 "pending", "bytes_forwarded", "forwarders", "watchdog")
+
+    def __init__(self, key: str, total: int, relay: dict):
+        import asyncio
+
+        self.key = key
+        self.total = total
+        self.relay = relay
+        self.chunks: dict[int, bytes] = {}
+        self.contig = 0
+        self.event = asyncio.Event()
+        self.pending = len(relay.get("children") or [])
+        self.bytes_forwarded = 0
+        self.forwarders: list = []
+        self.watchdog = None
+
+    def start(self, cw, table: RelayTable) -> None:
+        import asyncio
+
+        for child in self.relay.get("children") or []:
+            self.forwarders.append(
+                asyncio.ensure_future(_relay_forward(cw, table, self, child))
+            )
+        self.watchdog = asyncio.ensure_future(_relay_watchdog(table, self))
+
+
+async def _relay_forward(cw, table: RelayTable, st: _RelaySession, child: dict) -> None:
+    """Forward every chunk of ``st`` to ONE tree child as it becomes
+    contiguous. A dead child is swallowed on purpose: the ROOT's per-rank
+    ack round is what detects the orphaned subtree and re-delivers it
+    directly (flat fallback) — a relay has no policy of its own."""
+    try:
+        client = cw._owner_client(tuple(child["addr"]))
+        sub = child.get("children") or []
+        relay = {"rank": child["rank"], "children": sub} if sub else None
+        for idx in range(st.total):
+            while st.contig <= idx:
+                st.event.clear()
+                await st.event.wait()
+            data = st.chunks[idx]
+            payload = {"key": st.key, "idx": idx, "total": st.total, "data": data}
+            if relay is not None:
+                payload["relay"] = relay
+            await _gate_egress(len(data))
+            await client.apush("p2p_data", payload)
+            st.bytes_forwarded += len(data)
+            COLL.relay_bytes += len(data)
+        COLL.relay_forwards += 1
+    except Exception:
+        pass
+    finally:
+        st.pending -= 1
+        if st.pending <= 0:
+            _relay_finish(table, st)
+
+
+async def _relay_watchdog(table: RelayTable, st: _RelaySession) -> None:
+    """A relay whose payload never completes (root died mid-push) must not
+    park its forwarders and chunks forever."""
+    import asyncio
+
+    await asyncio.sleep(_INBOX_SWEEP_AGE_S)
+    for t in st.forwarders:
+        if not t.done():
+            t.cancel()
+    _relay_finish(table, st)
+
+
+def _relay_finish(table: RelayTable, st: _RelaySession) -> None:
+    if table._sessions.get(st.key) is not st:
+        return  # already recorded (forwarder finallys race the watchdog)
+    if st.watchdog is not None and not st.watchdog.done():
+        st.watchdog.cancel()
+    try:
+        from ray_tpu._private import flight_recorder
+
+        parts = st.key.split("/", 2)  # collbcast/<group>/<tag>
+        group = parts[1] if len(parts) == 3 else ""
+        tag = parts[2] if len(parts) == 3 else st.key
+        flight_recorder.record(
+            "coll_relay",
+            f"{tag[:12]}:{group}:{st.relay.get('rank')}:"
+            f"{len(st.relay.get('children') or [])}:{st.bytes_forwarded}",
+        )
+    except Exception:
+        pass
+    table.finish(st.key)
+
+
+class ChunkStreams:
+    """Landing pads for tree-REDUCE partial streams (``collred/`` keys).
+    Unlike :class:`P2PInbox`, chunks are consumed ONE AT A TIME by the
+    member combining them into its own slice (cut-through combine at every
+    relay hop) — nothing ever reassembles into a full payload. Combiners
+    run on executor threads while deposits land on the IO loop, so state
+    sits behind a lock with per-key events."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._chunks: dict[str, dict[int, bytes]] = {}
+        self._events: dict[str, threading.Event] = {}
+        self._ts: dict[str, float] = {}
+        self._deposits = 0
+
+    @any_thread
+    def deposit(self, key: str, idx: int, data: bytes) -> None:
+        with self._lock:
+            self._chunks.setdefault(key, {})[idx] = data
+            self._ts[key] = time.monotonic()
+            ev = self._events.get(key)
+            if ev is None:
+                ev = self._events[key] = threading.Event()
+            self._deposits += 1
+            sweep = self._deposits & 255 == 0
+        ev.set()
+        if sweep:
+            self.sweep()
+
+    @blocking
+    def wait_chunk(self, key: str, idx: int, deadline: float) -> bytes | None:
+        """Pop chunk ``idx`` of stream ``key`` (each chunk is consumed
+        exactly once), or None once ``deadline`` passes."""
+        while True:
+            with self._lock:
+                ev = self._events.get(key)
+                if ev is None:
+                    ev = self._events[key] = threading.Event()
+                ev.clear()  # before the check: a deposit between check and
+                # wait must leave the event set
+                d = self._chunks.get(key)
+                if d is not None and idx in d:
+                    return d.pop(idx)
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            ev.wait(min(0.05, remaining))
+
+    @any_thread
+    def purge(self, key: str) -> None:
+        with self._lock:
+            self._chunks.pop(key, None)
+            self._events.pop(key, None)
+            self._ts.pop(key, None)
+
+    @any_thread
+    def sweep(self, max_age_s: float = _INBOX_SWEEP_AGE_S) -> int:
+        """Age out streams nobody is combining (a reduce that raised on
+        this member leaves its children's later chunks behind)."""
+        cutoff = time.monotonic() - max_age_s
+        with self._lock:
+            stale = [k for k, ts in self._ts.items() if ts < cutoff]
+            for k in stale:
+                self._chunks.pop(k, None)
+                self._events.pop(k, None)
+                del self._ts[k]
+            return len(stale)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "streams": len(self._chunks),
+                "chunks": sum(len(d) for d in self._chunks.values()),
+            }
+
+
+# ---------------------------------------------------------------------------
 # Group broadcast (ONE group op fanning a payload to every member)
 # ---------------------------------------------------------------------------
 
@@ -308,6 +576,14 @@ class _CollStats:
         "bcast_fallbacks",    # per-rank deliveries that fell back to the GCS mailbox
         "bcast_failed_ranks", # ranks a broadcast could not deliver to
         "timeouts",           # typed collective timeouts raised here
+        "tree_sends",         # broadcasts that rode the binomial relay tree
+        "bcast_retries",      # ranks re-delivered directly after a relay failure
+        "root_egress_bytes",  # payload bytes THIS process pushed as broadcast root
+        "relay_forwards",     # relay legs completed here (all chunks to one child)
+        "relay_bytes",        # payload bytes forwarded mid-tree by this process
+        "reduce_sends",       # tree-reduce participations by this process
+        "reduce_bytes",       # partial-combine bytes pushed up the tree
+        "allreduces",         # allreduce participations (reduce + down-broadcast)
     )
 
     def __init__(self):
@@ -358,17 +634,33 @@ def unregister_member_addr(gcs, group_name: str, rank: int) -> None:
 @blocking
 def fetch_member_addrs(gcs, group_name: str, world_size: int) -> dict:
     """{rank: (host, port)} for every member that registered an address.
-    Callers cache this per group epoch — membership is static."""
+    Callers cache this per group epoch — membership is static.
+
+    The ``world_size`` lookups are batched CONCURRENTLY on the IO loop
+    (the serial per-rank round scaled the fetch O(K) in GCS RTTs), and a
+    GCS transport error PROPAGATES: a partitioned GCS must surface as a
+    failure the caller can see, not read as "nobody registered" — which
+    silently degraded every rank to the mailbox fallback. Only a per-row
+    decode problem skips that one rank (it keeps the fallback path)."""
+    import asyncio
     import json
 
+    from ray_tpu._private.rpc import EventLoopThread
+
+    keys = [member_addr_key(group_name, rank) for rank in range(world_size)]
+
+    async def _fetch_all():
+        return await asyncio.gather(*(gcs.acall("kv_get", {"key": k}) for k in keys))
+
+    responses = EventLoopThread.get().run(_fetch_all(), timeout=30.0)
     addrs: dict = {}
-    for rank in range(world_size):
-        try:
-            resp = gcs.call("kv_get", {"key": member_addr_key(group_name, rank)})
-            if resp.get("found"):
-                addrs[rank] = tuple(json.loads(bytes(resp["value"]).decode()))
-        except Exception:
+    for rank, resp in enumerate(responses):
+        if not resp.get("found"):
             continue
+        try:
+            addrs[rank] = tuple(json.loads(bytes(resp["value"]).decode()))
+        except Exception:
+            continue  # malformed row: that rank keeps the mailbox fallback
     return addrs
 
 
@@ -384,18 +676,31 @@ def group_bcast_send(
     member_addrs: dict | None = None,
     timeout: float = 30.0,
     mailbox_fallback: bool = True,
+    topology: str = "tree",
 ) -> dict:
     """Fan ``value`` to every OTHER rank of the group as ONE group
     operation: one serialize, each chunk frame ENCODED ONCE
     (``RpcClient.pack_push_frame`` — the rank-free inbox key is what makes
-    the bytes identical) and written down every member connection
-    concurrently, each member confirmed by a ``p2p_ack`` round trip (wall
-    clock ≈ serialize + encode + max member RTT; CPU ≈ one encode instead
-    of K). Ranks without a registered address fall back to the GCS-KV
-    mailbox under the same logical tag. Never raises for a dead member:
-    the result names it so the caller owns the policy —
+    the bytes identical), every rank confirmed by a ``p2p_ack`` round trip.
+    Ranks without a registered address fall back to the GCS-KV mailbox
+    under the same logical tag. Never raises for a dead member: the result
+    names it so the caller owns the policy —
     ``{"ok_ranks": [...], "fallback_ranks": [...], "failed": {rank: reason},
-    "bytes": payload_bytes}``.
+    "bytes": payload_bytes, "topology": ..., "root_egress_bytes": ...,
+    "retried_ranks": [...]}``.
+
+    ``topology="tree"`` (default, ≥2 addressed ranks): the root pushes
+    chunk frames only to its BINOMIAL-TREE children, each frame carrying
+    the child's relay spec; mid-tree members forward every chunk to their
+    own children the moment it lands (cut-through — :class:`RelayTable`),
+    so root egress is O(log K) streams instead of K. The per-member
+    contract is unchanged: the root still acks EVERY rank directly, and
+    any rank whose ack fails (a dead relay orphans its whole subtree) is
+    retried DIRECTLY with a flat resend — one dead relay costs one named
+    failure plus re-delivered orphans, not K/2 failed members. A rank
+    still failing after the direct retry is named with its orphaned
+    subtree. ``topology="flat"`` is PR 15's fan-out (every rank pushed
+    from the root), kept for the bench A/B and as the retry primitive.
 
     This is the cpu-backend group op behind device_object.broadcast(); on
     TPU hardware the same seam maps to an ICI broadcast (tpu_group.py)."""
@@ -409,52 +714,146 @@ def group_bcast_send(
         member_addrs = fetch_member_addrs(gcs, group_name, world_size)
     total = max(1, (len(data) + _DIRECT_CHUNK_BYTES - 1) // _DIRECT_CHUNK_BYTES)
     targets = [r for r in range(world_size) if r != src_rank]
-    result = {"ok_ranks": [], "fallback_ranks": [], "failed": {}, "bytes": len(data)}
+    addressed = [r for r in targets if r in member_addrs]
+    use_tree = topology == "tree" and len(addressed) >= 2
+    result = {
+        "ok_ranks": [], "fallback_ranks": [], "failed": {}, "bytes": len(data),
+        "topology": "tree" if use_tree else "flat",
+        "root_egress_bytes": 0, "retried_ranks": [],
+    }
     key = bcast_key(group_name, tag)
+    chunks = [
+        data[i * _DIRECT_CHUNK_BYTES : (i + 1) * _DIRECT_CHUNK_BYTES]
+        for i in range(total)
+    ]
     frames = [
         RpcClient.pack_push_frame(
             "p2p_data",
-            {
-                "key": key,
-                "idx": i,
-                "total": total,
-                "data": data[i * _DIRECT_CHUNK_BYTES : (i + 1) * _DIRECT_CHUNK_BYTES],
-            },
+            {"key": key, "idx": i, "total": total, "data": chunks[i]},
         )
         for i in range(total)
     ]
+
+    # Tree positions: [root] + addressed ranks in rank order — every rank
+    # appears exactly once, so parent/child is a pure function of the
+    # (group, membership) pair. ``subtree`` maps each rank to its
+    # descendant ranks for the orphan annotation on failures.
+    subtree: dict[int, list[int]] = {}
+    root_specs: list[dict] = []
+    if use_tree:
+        order = [src_rank] + sorted(addressed)
+
+        def _spec(pos: int) -> dict:
+            rank = order[pos]
+            kids = [_spec(c) for c in _binomial_children(pos, len(order))]
+            desc: list[int] = []
+            for k in kids:
+                desc.append(k["rank"])
+                desc.extend(subtree[k["rank"]])
+            subtree[rank] = sorted(desc)
+            return {"rank": rank, "addr": list(member_addrs[rank]), "children": kids}
+
+        root_specs = [_spec(c) for c in _binomial_children(0, len(order))]
+        result["root_children"] = sorted(s["rank"] for s in root_specs)
 
     # Ack wait scales with the caller's budget (clamped by the server at
     # 30s): a slow-but-healthy member still reassembling a large payload
     # must not be branded a failed rank by a fixed small bound.
     ack_wait = max(_BCAST_ACK_S, min(30.0, timeout))
 
-    async def _deliver(rank: int, addr: tuple):
-        client = cw._owner_client(tuple(addr))
-        for frame in frames:
+    async def _push_direct(rank: int):
+        client = cw._owner_client(tuple(member_addrs[rank]))
+        for i, frame in enumerate(frames):
+            await _gate_egress(len(chunks[i]))
             await client.apush_packed("p2p_data", frame)
+        result["root_egress_bytes"] += len(data)
+
+    async def _ack(rank: int, wait: float):
+        client = cw._owner_client(tuple(member_addrs[rank]))
         resp = await client.acall(
-            "p2p_ack", {"key": key, "timeout": ack_wait},
-            timeout=ack_wait + 5.0, retries=0,
+            "p2p_ack", {"key": key, "timeout": wait},
+            timeout=wait + 5.0, retries=0,
         )
         if not resp.get("ok"):
             raise RuntimeError("p2p_ack reported the payload never landed")
 
+    async def _deliver(rank: int):
+        await _push_direct(rank)
+        await _ack(rank, ack_wait)
+
+    async def _deliver_tree_child(spec: dict):
+        client = cw._owner_client(tuple(spec["addr"]))
+        if spec["children"]:
+            relay = {"rank": spec["rank"], "children": spec["children"]}
+            # Relay spec rides EVERY chunk frame: whichever lands first
+            # opens the session, so loss/reorder of any one frame cannot
+            # stall the whole subtree.
+            for i in range(total):
+                await _gate_egress(len(chunks[i]))
+                await client.apush(
+                    "p2p_data",
+                    {"key": key, "idx": i, "total": total,
+                     "data": chunks[i], "relay": relay},
+                )
+        else:
+            for i, frame in enumerate(frames):
+                await _gate_egress(len(chunks[i]))
+                await client.apush_packed("p2p_data", frame)
+        result["root_egress_bytes"] += len(data)
+        await _ack(spec["rank"], ack_wait)
+
     async def _fan_out():
-        tasks = {
-            rank: asyncio.ensure_future(
-                asyncio.wait_for(_deliver(rank, member_addrs[rank]), timeout)
-            )
-            for rank in targets
-            if rank in member_addrs
-        }
+        tasks: dict = {}
+        if use_tree:
+            for spec in root_specs:
+                tasks[spec["rank"]] = asyncio.ensure_future(
+                    asyncio.wait_for(_deliver_tree_child(spec), timeout)
+                )
+            for rank in addressed:
+                if rank not in tasks:  # delivered by a relay: ack only
+                    tasks[rank] = asyncio.ensure_future(
+                        asyncio.wait_for(_ack(rank, ack_wait), timeout)
+                    )
+        else:
+            for rank in addressed:
+                tasks[rank] = asyncio.ensure_future(
+                    asyncio.wait_for(_deliver(rank), timeout)
+                )
         if tasks:
             await asyncio.wait(tasks.values())
-        return {rank: t.exception() for rank, t in tasks.items()}
+        outcomes = {rank: t.exception() for rank, t in tasks.items()}
+        if use_tree:
+            round1 = [r for r, e in outcomes.items() if e is not None]
+            if round1:
+                # Orphan recovery: a failed ack means the rank is dead OR a
+                # relay above it died — re-deliver DIRECTLY (flat resend;
+                # duplicate chunks overwrite partials in the inbox) so one
+                # dead relay doesn't fail its whole healthy subtree.
+                retry_ack = max(5.0, min(ack_wait, 10.0))
+
+                async def _retry(rank: int):
+                    await _push_direct(rank)
+                    await _ack(rank, retry_ack)
+
+                rtasks = {
+                    r: asyncio.ensure_future(
+                        asyncio.wait_for(_retry(r), retry_ack + 10.0)
+                    )
+                    for r in round1
+                }
+                await asyncio.wait(rtasks.values())
+                for r, t in rtasks.items():
+                    if t.exception() is None:
+                        outcomes[r] = None
+                        result["retried_ranks"].append(r)
+                        COLL.bcast_retries += 1
+        return outcomes
 
     # Outer bound is a backstop over the per-member wait_for; each member's
-    # delivery is already clamped to ``timeout`` individually.
-    outcomes = cw._io.run(_fan_out(), timeout=timeout + 15.0) if targets else {}
+    # delivery is already clamped to ``timeout`` individually (plus the
+    # bounded retry round in tree mode).
+    outer = timeout + 15.0 + (20.0 if use_tree else 0.0)
+    outcomes = cw._io.run(_fan_out(), timeout=outer) if targets else {}
     for rank in targets:
         if rank not in member_addrs:
             # Never registered an address (old-style member): the GCS
@@ -485,9 +884,22 @@ def group_bcast_send(
             # or wedged — a GCS mailbox drop would "succeed" against a
             # corpse (the KV is alive either way), so the honest outcome is
             # a named failure the caller can act on.
-            result["failed"][rank] = repr(exc)
+            reason = repr(exc)
+            orphans = subtree.get(rank) or []
+            if orphans:
+                recovered = sorted(set(orphans) & set(result["retried_ranks"]))
+                reason += (
+                    f" [tree relay: orphaned subtree ranks {orphans}"
+                    + (f"; re-delivered directly: {recovered}" if recovered else "")
+                    + "]"
+                )
+            result["failed"][rank] = reason
             COLL.bcast_failed_ranks += 1
+    result["retried_ranks"].sort()
     COLL.bcast_sends += 1
+    if use_tree:
+        COLL.tree_sends += 1
+    COLL.root_egress_bytes += result["root_egress_bytes"]
     COLL.bcast_send_bytes += len(data) * (
         len(result["ok_ranks"]) + len(result["fallback_ranks"])
     )
@@ -578,3 +990,185 @@ def direct_recv(cw, key: str, timeout: float, abort_check=None) -> bytes | None:
             ev.clear()
     finally:
         inbox._drop_waiter(key)
+
+
+# ---------------------------------------------------------------------------
+# Group reduce / allreduce (chunk-wise combine at every relay hop)
+# ---------------------------------------------------------------------------
+
+
+def reduce_key(group_name: str, tag: str, src_rank: int) -> str:
+    """Stream key for ONE member's partial chunks flowing up the reduce
+    tree. Rank-scoped (unlike :func:`bcast_key`): a parent combining k
+    children must tell their streams apart. The ``collred/`` prefix routes
+    these frames into :class:`ChunkStreams` instead of the inbox."""
+    return f"collred/{group_name}/{tag}/{src_rank}"
+
+
+async def _push_reduce_chunk(client, key: str, idx: int, total: int, data: bytes):
+    await _gate_egress(len(data))
+    await client.apush(
+        "p2p_data", {"key": key, "idx": idx, "total": total, "data": data}
+    )
+
+
+@blocking
+def group_reduce_send(
+    cw,
+    gcs,
+    group_name: str,
+    my_rank: int,
+    world_size: int,
+    tag: str,
+    value,
+    op: ReduceOp = ReduceOp.SUM,
+    dst_rank: int = 0,
+    member_addrs: dict | None = None,
+    timeout: float = 60.0,
+):
+    """One member's share of a TREE reduce toward ``dst_rank``: wait per
+    chunk index for each tree child's combined partial, merge it into this
+    rank's own slice ELEMENTWISE, and push the result to the parent the
+    moment it's ready (cut-through combine — a chunk flows up while later
+    chunks are still arriving below). Every rank of the group must call
+    this with the same (tag, op, dst_rank); chunks travel as dense
+    ``dtype`` bytes (NOT serialized objects) so relay hops can combine
+    without a deserialize round trip.
+
+    Returns the reduced ``np.ndarray`` on ``dst_rank``, None elsewhere.
+    MEAN sums up the tree and divides ONCE at the root (matching
+    ``np.stack(...).mean(axis=0)`` bit-for-bit on exact inputs). Requires
+    every member to have a registered address — callers (cpu_group) fall
+    back to the GCS ring otherwise. A silent child raises a typed
+    CollectiveTimeoutError NAMING it; a shape/dtype disagreement surfaces
+    as a CollectiveError naming both ranks."""
+    import numpy as np
+
+    from ray_tpu.exceptions import CollectiveError, CollectiveTimeoutError
+
+    if member_addrs is None:
+        member_addrs = fetch_member_addrs(gcs, group_name, world_size)
+    missing = [
+        r for r in range(world_size) if r != my_rank and r not in member_addrs
+    ]
+    if missing:
+        raise CollectiveError(
+            f"tree reduce on group {group_name!r} needs a registered address "
+            f"for every member; missing ranks {missing}"
+        )
+    arr = np.ascontiguousarray(value)
+    combine = {
+        ReduceOp.SUM: np.add,
+        ReduceOp.PRODUCT: np.multiply,
+        ReduceOp.MIN: np.minimum,
+        ReduceOp.MAX: np.maximum,
+        ReduceOp.MEAN: np.add,  # summed at every hop; the root divides once
+    }[op]
+    # Same deterministic shape as the broadcast tree, rooted at dst_rank.
+    order = [dst_rank] + sorted(r for r in range(world_size) if r != dst_rank)
+    pos = order.index(my_rank)
+    kid_ranks = [order[c] for c in _binomial_children(pos, world_size)]
+    parent_client = None
+    if pos:
+        parent_rank = order[pos - (1 << (pos.bit_length() - 1))]
+        parent_client = cw._owner_client(tuple(member_addrs[parent_rank]))
+    data = arr.tobytes()
+    # Chunk on element boundaries so every chunk is a dense dtype slice.
+    itemsize = max(1, arr.dtype.itemsize)
+    chunk_bytes = max(itemsize, (_DIRECT_CHUNK_BYTES // itemsize) * itemsize)
+    total = max(1, (len(data) + chunk_bytes - 1) // chunk_bytes)
+    deadline = time.monotonic() + timeout
+    streams = cw.p2p_streams
+    up_key = reduce_key(group_name, tag, my_rank)
+    out_parts: list = []
+    try:
+        for idx in range(total):
+            own = np.frombuffer(
+                data[idx * chunk_bytes : (idx + 1) * chunk_bytes], dtype=arr.dtype
+            )
+            acc = own
+            for kr in kid_ranks:
+                chunk = streams.wait_chunk(reduce_key(group_name, tag, kr), idx, deadline)
+                if chunk is None:
+                    COLL.timeouts += 1
+                    raise CollectiveTimeoutError(
+                        f"tree reduce on group {group_name!r} tag {tag!r} "
+                        f"(rank {my_rank}): no chunk {idx}/{total} from child "
+                        f"rank {kr} within {timeout}s",
+                        group=group_name, ranks=[kr], tag=tag,
+                    )
+                if len(chunk) != own.nbytes:
+                    raise CollectiveError(
+                        f"tree reduce on group {group_name!r} tag {tag!r}: "
+                        f"chunk {idx} from rank {kr} is {len(chunk)} bytes, "
+                        f"rank {my_rank} expects {own.nbytes} — members "
+                        "disagree on shape/dtype"
+                    )
+                acc = combine(acc, np.frombuffer(chunk, dtype=arr.dtype))
+            if parent_client is None:
+                out_parts.append(acc)
+            else:
+                payload = acc.tobytes()
+                cw._io.run(
+                    _push_reduce_chunk(parent_client, up_key, idx, total, payload),
+                    timeout=30.0,
+                )
+                COLL.reduce_bytes += len(payload)
+    finally:
+        for kr in kid_ranks:
+            streams.purge(reduce_key(group_name, tag, kr))
+    COLL.reduce_sends += 1
+    if parent_client is not None:
+        return None
+    out = np.concatenate(out_parts) if len(out_parts) > 1 else out_parts[0]
+    out = np.array(out).reshape(arr.shape)
+    if op is ReduceOp.MEAN:
+        out = out / world_size
+    return out
+
+
+@blocking
+def group_allreduce(
+    cw,
+    gcs,
+    group_name: str,
+    my_rank: int,
+    world_size: int,
+    tag: str,
+    value,
+    op: ReduceOp = ReduceOp.SUM,
+    member_addrs: dict | None = None,
+    timeout: float = 60.0,
+    finalize=None,
+):
+    """Tree allreduce: reduce up to rank 0, then tree-broadcast the
+    combined result back down — every rank returns the same reduced value
+    after 2·depth hops instead of a K-wide ring epoch. ``finalize``
+    (optional) runs ON THE ROOT before the down-broadcast (e.g. a jnp
+    conversion), so output placement is decided once and every rank
+    receives the finalized payload — placement parity with ``broadcast``.
+    Raises CollectiveBroadcastError if the down-broadcast misses a rank
+    (an allreduce is all-or-nothing: a member without the result would
+    silently diverge)."""
+    from ray_tpu.exceptions import CollectiveBroadcastError
+
+    red = group_reduce_send(
+        cw, gcs, group_name, my_rank, world_size, tag, value,
+        op=op, dst_rank=0, member_addrs=member_addrs, timeout=timeout,
+    )
+    COLL.allreduces += 1
+    down_tag = f"allred/{tag}"
+    if my_rank == 0:
+        out = finalize(red) if finalize is not None else red
+        res = group_bcast_send(
+            cw, gcs, group_name, 0, world_size, down_tag, out,
+            member_addrs=member_addrs, timeout=timeout, mailbox_fallback=False,
+        )
+        if res["failed"]:
+            raise CollectiveBroadcastError(
+                f"allreduce down-broadcast on group {group_name!r} failed for "
+                f"ranks {sorted(res['failed'])}",
+                group=group_name, failed=res["failed"], info=res,
+            )
+        return out
+    return group_bcast_recv(cw, gcs, group_name, 0, my_rank, down_tag, timeout)

@@ -442,15 +442,20 @@ class TpuCollectiveGroup:
 
         return mailbox_recv(self._gcs, self.group_name, src_rank, self.rank, tag, timeout)
 
-    # ---- group broadcast (device_object.broadcast seam) ----
+    # ---- group payload verbs (device_object broadcast/reduce seam) ----
     #
-    # IN-PROGRAM broadcasts already ride ICI (broadcast() above compiles to
-    # a masked psum over the mesh). These two move an OUT-OF-BAND payload —
-    # a sealed device object fanning holder→members — and, like send/recv,
-    # use the host direct-mailbox until jax exposes a cross-process
-    # device-to-device transfer in this image: swap the ICI/DMA group push
-    # in HERE (one serialize → one ICI broadcast over the group mesh)
-    # without touching any caller (DeviceObjectManager.broadcast_via_group).
+    # IN-PROGRAM collectives already ride ICI (broadcast()/allreduce()/
+    # reduce() above compile to psum variants over the mesh). The verbs
+    # below move an OUT-OF-BAND payload — a sealed device object fanning
+    # holder→members or combining across holders — and, like send/recv, use
+    # the host plane until jax exposes a cross-process device-to-device
+    # transfer in this image: swap the ICI/DMA group op in HERE (one
+    # serialize → one ICI broadcast/allreduce over the group mesh) without
+    # touching any caller (DeviceObjectManager.broadcast_via_group /
+    # reduce_via_group). This seam now covers EVERY verb: on the tpu
+    # backend the reducing payload verbs map straight onto the psum-based
+    # collectives (the data is already on the mesh — no host relay tree
+    # needed), which is exactly the swap the cpu tree emulates.
 
     def bcast_send_payload(self, value, tag: str, timeout: float = 30.0,
                            mailbox_fallback: bool = True) -> dict:
@@ -479,6 +484,19 @@ class TpuCollectiveGroup:
         return group_bcast_recv(
             cw, self._gcs, self.group_name, src_rank, self.rank, tag, timeout
         )
+
+    def reduce_send_payload(self, value, tag: str, op: ReduceOp = ReduceOp.SUM,
+                            dst_rank: int = 0, timeout: float = 60.0):
+        """Out-of-band group reduce on the tpu backend: the members' arrays
+        live on the SAME mesh, so the combine IS a psum — no host relay
+        tree. ``tag``/``timeout`` are accepted for cpu-seam parity (the
+        gang rendezvous is the compiled program itself)."""
+        return self.reduce(value, dst_rank, op)
+
+    def allreduce_payload(self, value, tag: str, op: ReduceOp = ReduceOp.SUM,
+                          timeout: float = 60.0):
+        """Out-of-band group allreduce: psum over ICI (see seam note)."""
+        return self.allreduce(value, op)
 
     def destroy(self):
         """Tear down the XLA world so the group can re-form (gang restart):

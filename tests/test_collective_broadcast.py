@@ -307,6 +307,50 @@ def test_broadcast_resolution_host_fallback(coll_cluster):
 
 
 # ---------------------------------------------------------------------------
+# relay-tree broadcast (ISSUE 16): topology, sub-O(K) root egress
+# ---------------------------------------------------------------------------
+
+
+def test_tree_broadcast_topology_and_sub_o_k_root_egress(coll_cluster):
+    """A 5-rank group broadcast rides the binomial relay tree: the root
+    streams only to its tree children (ranks 1, 2, 4 — sub-O(K) egress),
+    rank 1 relays the payload onward to rank 3 (its COLL relay counters
+    prove the mid-tree forward), and every member still lands the exact
+    payload with a direct per-rank ack."""
+    import jax.numpy as jnp
+
+    from ray_tpu.util import collective as col
+
+    members = [Member.remote() for _ in range(4)]
+    group = "tree5"
+    col.init_collective_group(5, 0, backend="cpu", group_name=group)
+    try:
+        ray_tpu.get(
+            [m.init_collective.remote(5, i + 1, "cpu", group) for i, m in enumerate(members)],
+            timeout=60,
+        )
+        payload = jnp.arange(448 * 1024, dtype=jnp.float32)  # 1.75 MiB -> 4 chunks
+        info = col.get_group(group).bcast_send_payload(payload, "t16", timeout=60)
+        assert info["topology"] == "tree", info
+        assert info["root_children"] == [1, 2, 4], info
+        assert sorted(info["ok_ranks"]) == [1, 2, 3, 4], info
+        assert info["failed"] == {} and info["retried_ranks"] == []
+        # Sub-O(K): the root pushed the payload to its 3 tree children,
+        # not all 4 members — rank 3's copy came from the rank-1 relay.
+        assert info["root_egress_bytes"] == 3 * info["bytes"], info
+        sums = ray_tpu.get(
+            [m.bcast_recv.remote(group, 0, "t16", 30.0) for m in members], timeout=60
+        )
+        expected = float(np.asarray(payload).sum())
+        assert sums == [expected] * 4
+        stats1 = ray_tpu.get(members[0].coll_stats.remote(), timeout=30)
+        assert stats1["relay_forwards"] >= 1, stats1
+        assert stats1["relay_bytes"] >= info["bytes"], stats1
+    finally:
+        col.destroy_collective_group(group)
+
+
+# ---------------------------------------------------------------------------
 # chaos: sampler SIGKILLed mid-broadcast (seeded kill plan)
 # ---------------------------------------------------------------------------
 
@@ -391,5 +435,95 @@ def test_sampler_sigkill_mid_broadcast_names_dead_rank():
         usage = mgr.usage()
         assert usage["resident_count"] == 0, usage
         assert usage["spilled_count"] == 0, usage
+    finally:
+        cluster.shutdown()
+
+
+def test_mid_tree_relay_sigkill_reparents_orphans():
+    """A seeded kill plan SIGKILLs a MID-TREE relay rank at its first
+    forward attempt (outbound p2p_data), so its subtree never gets the
+    payload from the tree. The broadcast NAMES the dead relay with its
+    orphaned subtree, re-delivers the orphan DIRECTLY (flat fallback —
+    rank 3 lands in ``retried_ranks`` and succeeds), every survivor
+    completes AND consumes, and the driver's residents drain."""
+    from ray_tpu._private.rpc import EventLoopThread
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.experimental import device_object
+    from ray_tpu.util import collective as col
+
+    cluster = Cluster()
+    try:
+        nodes = [
+            cluster.add_node(num_cpus=3, object_store_memory=96 * 1024 * 1024)
+            for _ in range(2)
+        ]
+        cluster.connect()
+        cluster.wait_for_nodes()
+        samplers = [Member.remote() for _ in range(4)]
+        group = "chaostree"
+        col.init_collective_group(5, 0, backend="cpu", group_name=group)
+        ray_tpu.get(
+            [s.init_collective.remote(5, i + 1, "cpu", group) for i, s in enumerate(samplers)],
+            timeout=60,
+        )
+        pids = ray_tpu.get([s.pid.remote() for s in samplers], timeout=60)
+        # Rank 1 is a RELAY (tree order [0,1,2,3,4]: rank 1 forwards to
+        # rank 3). Its first outbound p2p_data IS that forward — the kill
+        # fires there, before its own multi-chunk payload completes, so it
+        # never acks and its subtree starves.
+        victim_pid = pids[0]
+        plan = {
+            "rules": [
+                {"kind": "kill", "method": ["p2p_data"], "side": "send",
+                 "after": 0, "times": 1}
+            ]
+        }
+        io = EventLoopThread.get()
+        pushed = False
+        for n in nodes:
+            for w in n.workers.values():
+                if w.pid == victim_pid and w.client is not None:
+                    io.run(
+                        w.client.acall(
+                            "chaos_set_plan", {"plan": plan, "seed": 16},
+                            timeout=5, retries=0,
+                        ),
+                        timeout=6,
+                    )
+                    pushed = True
+        assert pushed, "victim worker not found for plan push"
+
+        import jax.numpy as jnp
+
+        n_elems = 448 * 1024  # 1.75 MiB -> 4 chunks: dies mid-payload
+        ref = ray_tpu.put(
+            jnp.arange(float(n_elems), dtype=jnp.float32),
+            tensor_transport="collective",
+        )
+        with pytest.raises(CollectiveBroadcastError) as ei:
+            device_object.broadcast(ref, group, timeout=12)
+        err = ei.value
+        assert list(err.failed) == [1], err.failed  # dead RELAY named
+        reason = err.failed[1]
+        assert "orphaned subtree ranks [3]" in reason, reason
+        assert "re-delivered directly: [3]" in reason, reason
+        assert sorted(err.info.get("ok_ranks", [])) == [2, 3, 4], err.info
+        assert 3 in err.info.get("retried_ranks", []), err.info
+        assert isinstance(err, RayTpuError) and not isinstance(err, TimeoutError)
+        # Survivors — INCLUDING the re-parented orphan rank 3 — consume.
+        vals = ray_tpu.get(
+            [s.consume.remote(ref) for s in samplers[1:]], timeout=60
+        )
+        assert vals == [(0.0, n_elems)] * 3
+        from ray_tpu.experimental.device_object.manager import active_manager
+
+        del ref, err, ei
+        gc.collect()
+        deadline = time.monotonic() + 30
+        mgr = active_manager()
+        while mgr.usage()["resident_count"] > 0 and time.monotonic() < deadline:
+            time.sleep(0.2)
+        usage = mgr.usage()
+        assert usage["resident_count"] == 0, usage
     finally:
         cluster.shutdown()

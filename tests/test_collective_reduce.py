@@ -1,0 +1,281 @@
+"""Tree reduce / allreduce on the device-object collective plane (ISSUE 16).
+
+- Bit-exact oracle: the tree allreduce (reduce up the binomial tree with
+  chunk-wise combine at relay hops, broadcast back down) matches the flat
+  GCS-ring ``allreduce`` bit for bit across K ∈ {2, 4, 8} and the odd
+  K = 5 — integer-valued float32 payloads so SUM is exact regardless of
+  combine order.
+- Verb semantics: ``reduce_send_payload`` lands the result ONLY on
+  ``dst_rank`` (None elsewhere); MEAN sums up the tree and divides once at
+  the root; a jax input comes back as a jax.Array on EVERY rank (the root
+  finalizes once before the down-broadcast — payload-parity contract),
+  while an np input stays np.
+- ``device_object.allreduce``: a gang of holders combines their residents
+  IN PLACE (each ref resolves to the reduced value afterwards; no extra
+  residents appear).
+- Typed failures: a silent child rank raises CollectiveTimeoutError
+  NAMING it; a partitioned GCS makes ``fetch_member_addrs`` raise instead
+  of reading as "nobody registered".
+
+One module-scoped cluster; the 8 Red actors are reused across every K
+(one collective-group init per K, distinct group names).
+"""
+
+import gc
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import CollectiveTimeoutError
+
+
+@pytest.fixture(scope="module")
+def red_cluster():
+    ray_tpu.init(num_cpus=8, object_store_memory=256 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+def _contribution(rank: int, n: int) -> np.ndarray:
+    """Integer-valued float32, distinct per rank: float32 SUM over ranks is
+    EXACT, so tree-vs-ring comparisons are bit-for-bit, not tolerance."""
+    return ((np.arange(n) % 97) + 3.0 * rank).astype(np.float32)
+
+
+@ray_tpu.remote
+class Red:
+    """One reduce-group member: joins groups and runs the payload verbs."""
+
+    def init_collective(self, world_size, rank, backend, group_name):
+        from ray_tpu.util import collective as col
+
+        col.init_collective_group(world_size, rank, backend=backend, group_name=group_name)
+        return rank
+
+    def tree_allreduce(self, group_name, tag, n, op="SUM"):
+        from ray_tpu.util import collective as col
+        from ray_tpu.util.collective.types import ReduceOp
+
+        g = col.get_group(group_name)
+        out = g.allreduce_payload(_contribution(g.rank, n), tag, op=ReduceOp[op])
+        return np.asarray(out)
+
+    def ring_allreduce(self, group_name, n, op="SUM"):
+        from ray_tpu.util import collective as col
+        from ray_tpu.util.collective.types import ReduceOp
+
+        g = col.get_group(group_name)
+        return np.asarray(g.allreduce(_contribution(g.rank, n), op=ReduceOp[op]))
+
+    def tree_reduce(self, group_name, tag, n, dst_rank=0):
+        from ray_tpu.util import collective as col
+
+        g = col.get_group(group_name)
+        out = g.reduce_send_payload(_contribution(g.rank, n), tag, dst_rank=dst_rank)
+        return None if out is None else np.asarray(out)
+
+    def tree_allreduce_typed(self, group_name, tag, n, as_jax):
+        """(type name, is-jax-array) of the allreduce output — the
+        placement-parity probe."""
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.util import collective as col
+
+        g = col.get_group(group_name)
+        v = _contribution(g.rank, n)
+        out = g.allreduce_payload(jnp.asarray(v) if as_jax else v, tag)
+        return type(out).__name__, isinstance(out, jax.Array)
+
+    def coll_stats(self):
+        from ray_tpu.util.collective.p2p import COLL
+
+        return {k: getattr(COLL, k) for k in COLL.__slots__}
+
+
+@ray_tpu.remote(tensor_transport="collective")
+class Holder:
+    def init_collective(self, world_size, rank, backend, group_name):
+        from ray_tpu.util import collective as col
+
+        col.init_collective_group(world_size, rank, backend=backend, group_name=group_name)
+        return rank
+
+    def make(self, n, rank):
+        import jax.numpy as jnp
+
+        return jnp.asarray(_contribution(rank, n))
+
+    def residents(self):
+        from ray_tpu.experimental.device_object import device_object_stats
+
+        return device_object_stats()["resident_count"]
+
+
+# ---------------------------------------------------------------------------
+# bit-exact oracle: tree allreduce == flat ring allreduce
+# ---------------------------------------------------------------------------
+
+
+def test_tree_allreduce_bit_exact_vs_ring_oracle(red_cluster):
+    actors = [Red.remote() for _ in range(8)]
+    # K=4 and the odd K=5 use a MULTI-chunk payload (640 KiB+tail at f32)
+    # so chunk-wise combine at relay hops — including the ragged tail
+    # chunk — is on the oracle path; the other Ks stay small for speed.
+    for k, n in [(2, 4096), (4, 160 * 1024 + 7), (5, 160 * 1024 + 7), (8, 32768)]:
+        group = f"oracle{k}"
+        gang = actors[:k]
+        ray_tpu.get(
+            [a.init_collective.remote(k, i, "cpu", group) for i, a in enumerate(gang)],
+            timeout=60,
+        )
+        expected = np.sum(
+            [_contribution(r, n) for r in range(k)], axis=0, dtype=np.float64
+        ).astype(np.float32)
+        tree = ray_tpu.get(
+            [a.tree_allreduce.remote(group, f"t{k}", n) for a in gang], timeout=120
+        )
+        for rank, out in enumerate(tree):
+            np.testing.assert_array_equal(out, expected, err_msg=f"K={k} rank={rank}")
+        ring = ray_tpu.get([a.ring_allreduce.remote(group, n) for a in gang], timeout=120)
+        for rank, out in enumerate(ring):
+            # The flat-ring oracle is bit-identical, not merely close.
+            np.testing.assert_array_equal(out, tree[rank], err_msg=f"K={k} rank={rank}")
+        stats = ray_tpu.get(gang[0].coll_stats.remote(), timeout=30)
+        assert stats["reduce_sends"] >= 1, stats
+        assert stats["allreduces"] >= 1, stats
+
+
+def test_tree_reduce_lands_only_on_dst_rank(red_cluster):
+    actors = [Red.remote() for _ in range(3)]
+    group = "dst3"
+    ray_tpu.get(
+        [a.init_collective.remote(3, i, "cpu", group) for i, a in enumerate(actors)],
+        timeout=60,
+    )
+    n = 2048
+    outs = ray_tpu.get(
+        [a.tree_reduce.remote(group, "r1", n, 2) for a in actors], timeout=60
+    )
+    assert outs[0] is None and outs[1] is None
+    expected = np.sum(
+        [_contribution(r, n) for r in range(3)], axis=0, dtype=np.float64
+    ).astype(np.float32)
+    np.testing.assert_array_equal(outs[2], expected)
+
+
+def test_tree_allreduce_mean_divides_once_at_root(red_cluster):
+    actors = [Red.remote() for _ in range(4)]
+    group = "mean4"
+    ray_tpu.get(
+        [a.init_collective.remote(4, i, "cpu", group) for i, a in enumerate(actors)],
+        timeout=60,
+    )
+    n = 2048
+    outs = ray_tpu.get(
+        [a.tree_allreduce.remote(group, "m1", n, "MEAN") for a in actors], timeout=60
+    )
+    # Integer sum / 4 (a power of two) is exact in float32.
+    expected = (
+        np.sum([_contribution(r, n) for r in range(4)], axis=0, dtype=np.float64) / 4.0
+    ).astype(np.float32)
+    for out in outs:
+        np.testing.assert_array_equal(out, expected)
+
+
+def test_tree_allreduce_placement_parity(red_cluster):
+    actors = [Red.remote() for _ in range(2)]
+    group = "place2"
+    ray_tpu.get(
+        [a.init_collective.remote(2, i, "cpu", group) for i, a in enumerate(actors)],
+        timeout=60,
+    )
+    jax_outs = ray_tpu.get(
+        [a.tree_allreduce_typed.remote(group, "pj", 1024, True) for a in actors],
+        timeout=60,
+    )
+    for _, is_jax in jax_outs:
+        assert is_jax  # jax in -> jax out on EVERY rank (root finalized once)
+    np_outs = ray_tpu.get(
+        [a.tree_allreduce_typed.remote(group, "pn", 1024, False) for a in actors],
+        timeout=60,
+    )
+    for name, is_jax in np_outs:
+        assert not is_jax, name  # np in -> np out (no surprise device hop)
+
+
+# ---------------------------------------------------------------------------
+# device_object.allreduce: holders combine residents IN PLACE
+# ---------------------------------------------------------------------------
+
+
+def test_device_object_allreduce_in_place(red_cluster):
+    from ray_tpu.experimental import device_object
+
+    holders = [Holder.remote() for _ in range(3)]
+    group = "doar3"
+    ray_tpu.get(
+        [h.init_collective.remote(3, i, "cpu", group) for i, h in enumerate(holders)],
+        timeout=60,
+    )
+    n = 4096
+    refs = [h.make.remote(n, i) for i, h in enumerate(holders)]
+    ray_tpu.wait(refs, num_returns=3, timeout=60)
+    before = sum(ray_tpu.get([h.residents.remote() for h in holders], timeout=30))
+    info = device_object.allreduce(refs, group, timeout=120)
+    assert info["kind"] == "collective", info
+    assert info["mode"] == "allreduce" and info["op"] == "SUM", info
+    assert sorted(info["ok_ranks"]) == [0, 1, 2], info
+    assert info["failed"] == {}, info
+    # Every ref now resolves to the SAME combined value — replaced in
+    # place, no extra residents.
+    expected = np.sum(
+        [_contribution(r, n) for r in range(3)], axis=0, dtype=np.float64
+    ).astype(np.float32)
+    for ref in refs:
+        np.testing.assert_array_equal(np.asarray(ray_tpu.get(ref, timeout=60)), expected)
+    after = sum(ray_tpu.get([h.residents.remote() for h in holders], timeout=30))
+    assert after == before, (before, after)
+    del refs, info
+    gc.collect()
+
+
+# ---------------------------------------------------------------------------
+# typed failures
+# ---------------------------------------------------------------------------
+
+
+def test_silent_child_raises_typed_timeout_naming_rank(red_cluster):
+    from ray_tpu.util import collective as col
+
+    lurker = Red.remote()
+    group = "silent2"
+    g = col.init_collective_group(2, 0, backend="cpu", group_name=group)
+    try:
+        ray_tpu.get(lurker.init_collective.remote(2, 1, "cpu", group), timeout=60)
+        with pytest.raises(CollectiveTimeoutError) as ei:
+            g.reduce_send_payload(np.ones((64,), np.float32), "hush", timeout=1.5)
+        assert ei.value.group == group
+        assert ei.value.ranks == [1]  # the child that never sent, NAMED
+        assert ei.value.tag == "hush"
+        assert not isinstance(ei.value, TimeoutError)
+    finally:
+        col.destroy_collective_group(group)
+
+
+def test_fetch_member_addrs_propagates_gcs_transport_error(red_cluster):
+    """A partitioned GCS must surface as a FAILURE, not read as 'nobody
+    registered' (which silently degraded every rank to the mailbox
+    fallback)."""
+    from ray_tpu.util.collective.p2p import fetch_member_addrs
+
+    class _DeadGcs:
+        def acall(self, method, params, **kw):
+            async def _boom():
+                raise ConnectionError("gcs partitioned")
+
+            return _boom()
+
+    with pytest.raises(ConnectionError):
+        fetch_member_addrs(_DeadGcs(), "anygroup", 4)

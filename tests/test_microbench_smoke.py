@@ -309,6 +309,15 @@ def test_microbench_collective_smoke(tmp_path):
     assert data["wsync_k2_residents_after"] == 0, data
     # Every measured Podracer iteration's sync rode the broadcast plane.
     assert data["podracer_device_broadcasts"] >= 2, data
+    # ISSUE 16 relay-tree arm: mid-tree members actually forwarded payload,
+    # nothing touched the host store, and the allreduce oracle held
+    # bit-exact (deterministic counters — ratio certification lives in the
+    # committed COLLBENCH_r16.json full sweep).
+    for key in ("relay_tree_k3_s", "relay_flat_k3_s", "allreduce_tree_k3_s"):
+        assert data.get(key, 0) > 0, f"{key} missing/zero: {data}"
+    assert data["relay_k3_relay_forwards"] > 0, data
+    assert data["relay_k3_store_objects_delta"] == 0, data
+    assert data["allreduce_k3_bit_exact"] == 1, data
 
 
 @pytest.mark.slow
@@ -346,6 +355,20 @@ def test_collective_k8_sweep(tmp_path):
         assert data[f"wsync_broadcast_k{k}_store_objects_delta"] == 0, data
         assert data[f"wsync_k{k}_residents_after"] == 0, data
     assert data["wsync_speedup_k8"] > 1.2, data
+    # ISSUE 16: under the modeled per-process egress link the relay tree
+    # must beat the flat fan-out at K=8 and the gap must WIDEN with K
+    # (root egress is O(log K) vs O(K)); the allreduce oracle stays
+    # bit-exact at every K.
+    for k in (4, 8):
+        assert data.get(f"relay_tree_k{k}_agg_mib_per_s", 0) > 0, data
+        assert data[f"relay_k{k}_store_objects_delta"] == 0, data
+        assert data[f"relay_k{k}_relay_forwards"] > 0, data
+        assert data[f"allreduce_k{k}_bit_exact"] == 1, data
+    assert data["relay_tree_speedup_k8"] > 1.2, data
+    assert data["relay_tree_speedup_k8"] > data["relay_tree_speedup_k4"], data
+    assert (
+        data["relay_tree_k8_root_egress_frac"] < data["relay_tree_k4_root_egress_frac"]
+    ), data
 
 
 def test_microbench_dag_smoke(tmp_path):
