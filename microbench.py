@@ -808,6 +808,77 @@ def collective_suite(results, quick=False, arms=("tree", "flat")):
         ray_tpu.shutdown()
 
 
+def resize_suite(results, quick=False):
+    """--collective --resize: elastic Podracer fleet (ISSUE 17) — IMPALA on
+    the device-broadcast plane driven through a scripted grow/shrink
+    schedule (8→16→8 samplers; 2→4→2 under --quick). Growing gang-joins
+    the new samplers into the weight group at fresh tail ranks, shrinking
+    evicts the tail from the roster — no group teardown either way. Per
+    phase the suite records how weight syncs actually travelled: inbox
+    resolves summed over the live fleet (broadcast plane) vs host-sync
+    pull fallbacks, plus iterations/s and the resize wall itself. The
+    elastic contract is asserted inline: after the FIRST post-resize
+    iteration the fleet-wide fallback counter is FLAT and every measured
+    sync rode the plane."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import ray_tpu
+    from ray_tpu.rllib.algorithms.impala import IMPALAConfig
+
+    base = 2 if quick else 8
+    peak = 4 if quick else 16
+    iters = 2 if quick else 3
+    schedule = [base, peak, base]
+    results["resize_schedule"] = schedule
+    ray_tpu.init(num_cpus=(6 if quick else peak + 2))
+    cfg = (
+        IMPALAConfig()
+        .environment("CartPole-v1")
+        .rollouts(num_rollout_workers=base,
+                  rollout_fragment_length=16 if quick else 32)
+        .training(lr=5e-4, train_batch_size=64 if quick else 128,
+                  weight_sync="device_broadcast")
+        .debugging(seed=0)
+    )
+    algo = cfg.build()
+    try:
+        assert algo._device_sync_ready, "device weight-sync group failed to form"
+        algo.step()  # warm compile + worker spawn outside every window
+
+        def fleet_totals():
+            stats = [s for s in algo.workers.coll_stats() if s]
+            return (
+                sum(s["bcast_recvs"] for s in stats),
+                sum(s["host_sync_fallbacks"] for s in stats),
+            )
+
+        for phase, n in enumerate(schedule):
+            if algo.workers.num_workers != n:
+                t0 = time.perf_counter()
+                algo.resize_workers(n)
+                results[f"resize_p{phase}_to{n}_s"] = round(time.perf_counter() - t0, 3)
+            algo.step()  # the ONE iteration allowed to pull (post-resize)
+            b0, f0 = fleet_totals()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                algo.step()
+            dt = time.perf_counter() - t0
+            b1, f1 = fleet_totals()
+            results[f"resize_p{phase}_n{n}_iters_per_s"] = round(iters / dt, 2)
+            results[f"resize_p{phase}_n{n}_plane_syncs"] = b1 - b0
+            results[f"resize_p{phase}_n{n}_host_fallbacks"] = f1 - f0
+            # n workers x iters inbox resolves, zero pulls after the first
+            # post-resize iteration — the fast-path oracle.
+            assert b1 - b0 >= n * iters, results
+            assert f1 - f0 == 0, results
+        roster = algo.learner_group.weight_group_roster(algo._weight_group)
+        results["resize_final_roster_ranks"] = roster["ranks"] if roster else None
+    finally:
+        algo.cleanup()
+    ray_tpu.shutdown()
+
+
 def recorder_overhead_suite(results, block_tasks=256, pairs=150):
     """--recorder-overhead: cost of the always-on observability plane
     (flight recorder + 1-in-64 sampled hop stamps) on the task_sync hot
@@ -1988,6 +2059,14 @@ def main():
         "of the ISSUE 16 A/B (default: both arms)",
     )
     ap.add_argument(
+        "--resize",
+        action="store_true",
+        help="with --collective: elastic-fleet arm (ISSUE 17) — IMPALA on "
+        "the device-broadcast plane through a scripted 8→16→8 sampler "
+        "resize (2→4→2 with --quick), recording broadcast-plane syncs vs "
+        "host-sync fallbacks per phase; records RESIZEBENCH_r{N}.json",
+    )
+    ap.add_argument(
         "--transfer",
         action="store_true",
         help="transfer-plane A/B (ISSUE 10): cut-through broadcast at the "
@@ -2122,6 +2201,17 @@ def main():
         chaos_suite(results, quick=args.quick)
         results["wall_s"] = round(time.perf_counter() - t0, 1)
         out = args.out or f"CHAOSBENCH_r{args.round}.json"
+        with open(out, "w") as f:
+            json.dump(results, f, indent=1)
+        print(json.dumps(results))
+        return
+
+    if args.collective and args.resize:
+        results = {"host_cpus": os.cpu_count(), "mode": "resize"}
+        t0 = time.perf_counter()
+        resize_suite(results, quick=args.quick)
+        results["wall_s"] = round(time.perf_counter() - t0, 1)
+        out = args.out or f"RESIZEBENCH_r{args.round}.json"
         with open(out, "w") as f:
             json.dump(results, f, indent=1)
         print(json.dumps(results))

@@ -120,6 +120,8 @@ EVENT_TYPES = (
     # Relay-tree collectives (PR 16).
     "coll_relay",      # 45: this member relayed a tree-broadcast payload to its children (detail tag:group:rank:children:bytes)
     "coll_reduce",     # 46: holder fed a device object into a group reduce/allreduce (detail oid:group:mode:rank:replaced)
+    # Elastic collective groups (PR 17).
+    "coll_member_change",  # 47: roster epoch advanced — join/rejoin/leave/death/advance (detail group:reason:rank:epoch:nmembers)
 )
 _CODE = {name: i for i, name in enumerate(EVENT_TYPES)}
 

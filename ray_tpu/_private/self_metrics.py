@@ -361,6 +361,19 @@ def instruments() -> dict:
                 "Allreduce participations (tree reduce up + broadcast "
                 "back down) by this process.",
             ),
+            "collective_host_sync_fallbacks": m.Counter(
+                "ray_tpu_collective_host_sync_fallbacks_total",
+                "Broadcast payloads a GROUP MEMBER had to resolve over the "
+                "host pull path instead of its broadcast inbox — a fleet "
+                "quietly riding pull-resolve (off the elastic fast path) "
+                "shows up here, not in silence.",
+            ),
+            "collective_member_changes": m.Counter(
+                "ray_tpu_collective_member_changes_total",
+                "Roster epoch advances published by this process "
+                "(join/rejoin/leave/death/advance of elastic group "
+                "membership).",
+            ),
             # --- actor lifecycle (gcs.py) ---
             "actor_restarts": m.Counter(
                 "ray_tpu_actor_restarts_total", "Actor restarts driven by the GCS."
@@ -546,6 +559,8 @@ def _collect_collective_stats():
         ("reduce_sends", inst["collective_reduce_sends"], None),
         ("reduce_bytes", inst["collective_reduce_bytes"], None),
         ("allreduces", inst["collective_allreduces"], None),
+        ("host_sync_fallbacks", inst["collective_host_sync_fallbacks"], None),
+        ("member_changes", inst["collective_member_changes"], None),
     ])
 
 

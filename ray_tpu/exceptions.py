@@ -140,8 +140,11 @@ class CollectiveBroadcastError(CollectiveError):
     """A device-object group broadcast could not deliver to every rank.
     Surviving ranks HAVE the payload (their resolves stay local); ``failed``
     maps each undelivered rank to the reason, so callers can name the dead
-    member and decide whether to respawn it (its replacement falls back to
-    the pull path transparently)."""
+    member and decide whether to respawn it. Failed ranks were already
+    EVICTED from the group roster (epoch bump), so the next broadcast
+    addresses survivors only; a respawned replacement re-registers via
+    roster_join and is back on the broadcast plane from its first
+    post-rejoin sync."""
 
     def __init__(self, msg: str = "", *, group: str = "", failed: dict | None = None, info: dict | None = None):
         self.group = group

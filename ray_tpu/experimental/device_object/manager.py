@@ -405,10 +405,16 @@ class DeviceObjectManager:
         result["group"] = group_name
         result["src_rank"] = group.rank
         DEVOBJ_STATS.transfers_collective += 1
+        # Denominator is the ROSTER SNAPSHOT the send targeted (elastic
+        # membership), not the world size frozen at group init.
+        targets = (
+            len(result["ok_ranks"]) + len(result["fallback_ranks"])
+            + len(result["failed"])
+        )
         flight_recorder.record(
             "coll_broadcast",
             f"{oid_hex[:12]}:{group_name}:{len(result['ok_ranks'])}/"
-            f"{group.world_size - 1}:{result['bytes']}",
+            f"{targets}:{result['bytes']}",
         )
         return result
 
@@ -482,6 +488,12 @@ class DeviceObjectManager:
             self.cw._io.spawn(_free_store())
 
     def _schedule_mailbox_janitor(self, key: str, delay_s: float = 180.0):
+        # mailbox_key layout: collective/<group>/p2p/<src>-><dst>/<tag> —
+        # the sweep also runs the per-group stale-row janitor (dead-epoch
+        # roster/coord rows, orphaned addr rows of departed members).
+        parts = key.split("/")
+        group_name = parts[1] if len(parts) > 2 and parts[0] == "collective" else None
+
         async def _sweep():
             import asyncio
 
@@ -490,6 +502,10 @@ class DeviceObjectManager:
                 await self.cw.gcs.acall("kv_del", {"key": key})
             except Exception:
                 pass
+            if group_name:
+                from ray_tpu.util.collective.p2p import sweep_stale_group_rows
+
+                await sweep_stale_group_rows(self.cw.gcs, group_name)
 
         self.cw._io.spawn(_sweep())
 

@@ -100,6 +100,17 @@ def resolve_meta(cw, meta, deadline=None):
     # collective send when we named a shared group, else it hands back an
     # inline/arena host copy.
     pick = _pick_group(meta) if meta.transport == "collective" else None
+    if pick is not None:
+        # This process IS a member of a group it shares with the holder,
+        # yet the broadcast inbox had nothing — the member fell off the
+        # group-sync fast path (stale roster, missed epoch, respawn that
+        # never re-registered) and is quietly riding pull-resolve. Count
+        # it: this is the elastic-membership degradation signal
+        # (ray_tpu_collective_host_sync_fallbacks_total).
+        from ray_tpu.util.collective.p2p import COLL
+
+        COLL.host_sync_fallbacks += 1
+        flight_recorder.record("devobj_transfer", f"{oid[:12]}:host_sync_fallback:{pick[0]}")
     req: dict = {"object_id": oid}
     tag = ""
     if pick is not None:

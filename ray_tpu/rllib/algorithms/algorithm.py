@@ -382,9 +382,10 @@ class Algorithm(Trainable):
         """One weight sync, on whichever transport the config picked. The
         device path broadcasts ONE device-object descriptor's payload to
         the fleet (strict=False: a dead sampler is the sync loop's business
-        — it respawns the worker and the replacement pull-resolves) and
-        never lets a broadcast failure break training: any error degrades
-        that sync to the host path."""
+        — it respawns the worker, which re-registers into the group at its
+        old rank, so the FIRST post-respawn sync is already back on the
+        broadcast plane) and never lets a broadcast failure break training:
+        any error degrades that sync to the host path."""
         cfg = self._algo_config
         if (
             getattr(cfg, "weight_sync", "host") == "device_broadcast"
@@ -393,6 +394,10 @@ class Algorithm(Trainable):
             try:
                 from ray_tpu.experimental import device_object
 
+                # Self-heal the roster first: a live sampler that a prior
+                # broadcast evicted on a transient stall re-joins, so this
+                # sync already covers it over the group plane.
+                self.workers.ensure_registered()
                 ref = self.learner_group.pack_weight_ref()
                 device_object.broadcast(ref, self._weight_group, strict=False)
                 self.workers.sync_packed_weights(ref)
@@ -405,6 +410,24 @@ class Algorithm(Trainable):
                     "this round", exc_info=True,
                 )
         self.workers.sync_weights(self.learner_group.get_weights())
+
+    def resize_workers(self, num_workers: int) -> int:
+        """Autoscale the sampler fleet mid-training (Podracer elasticity).
+        Growing joins the new samplers into the weight group at fresh tail
+        ranks; shrinking evicts the tail ranks from the roster — either way
+        the roster epoch bumps, the learner's next broadcast snapshots the
+        new membership, and weight sync stays on the group plane (no
+        teardown/re-form of the group, no permanent pull-path fallback).
+        Syncs weights immediately so grown workers can sample at once.
+        Returns the new worker count."""
+        n = self.workers.resize(num_workers)
+        self.sync_worker_weights()
+        if getattr(self._algo_config, "observation_filter", None):
+            # Grown workers start with empty filter stats; hand them the
+            # merged base so their first fragments are normalized like the
+            # rest of the fleet's.
+            self.workers.sync_filters()
+        return n
 
     # -- evaluation (reference: Algorithm.evaluate, algorithm.py:850) ------
     @property

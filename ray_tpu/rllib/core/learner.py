@@ -194,6 +194,13 @@ class _RemoteLearner:
         )
         return True
 
+    def group_roster(self, group_name):
+        """Roster snapshot of a group this actor belongs to (elastic
+        membership introspection)."""
+        from ray_tpu.util import collective
+
+        return collective.roster(group_name)
+
     def pack_weights(self):
         """One flat device vector of the current params. On a
         tensor_transport actor this returns as a DEVICE OBJECT: the vector
@@ -356,7 +363,11 @@ class LearnerGroup:
     def init_weight_collective(self, world_size: int, rank: int, backend: str, group_name: str):
         """Join the learner↔sampler weight group as the HOLDER rank. Local
         mode: the driver process itself is the holder (it owns the params),
-        so the group is initialized right here."""
+        so the group is initialized right here. The join lands this rank in
+        the group's GCS roster; `world_size` is only the INITIAL gang size
+        — every later broadcast snapshots the roster, so the sampler fleet
+        can grow, shrink, or churn under the holder without re-forming the
+        group."""
         if self._local is not None:
             from ray_tpu.util import collective
 
@@ -367,6 +378,17 @@ class LearnerGroup:
         return ray_tpu.get(
             self._actors[0].init_weight_collective.remote(world_size, rank, backend, group_name)
         )
+
+    def weight_group_roster(self, group_name: str):
+        """Membership snapshot of the weight group as the holder would see
+        it at the next broadcast: ``{"epoch", "ranks", "world_size"}``, or
+        None before the first roster publish. Drives the resize oracle —
+        after a grow/shrink the roster must list exactly the live ranks."""
+        from ray_tpu.util import collective
+
+        if self._local is not None:
+            return collective.roster(group_name)
+        return ray_tpu.get(self._actors[0].group_roster.remote(group_name))
 
     def pack_weight_ref(self):
         """ObjectRef of the packed flat weight vector as a DEVICE OBJECT —

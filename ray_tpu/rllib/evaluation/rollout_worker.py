@@ -130,10 +130,12 @@ class RolloutWorker:
     def set_packed_weights(self, packed) -> bool:
         """Weight sync from ONE flat vector (learner.pack_weights). The
         descriptor arg resolves before this runs — group members take the
-        broadcast payload from their inbox; a worker outside the group
-        (e.g. a respawned replacement) transparently falls back to the pull
-        path. The pytree is rebuilt against this worker's own canonical
-        template, so only values crossed the wire."""
+        broadcast payload from their inbox. A respawned replacement is
+        re-registered into the group by WorkerSet._replace_worker (roster
+        epoch bump), so at most its FIRST post-respawn sync rides the pull
+        path; every later one is back on the broadcast plane. The pytree is
+        rebuilt against this worker's own canonical template, so only
+        values crossed the wire."""
         import jax
 
         from ray_tpu.rllib.core import rl_module
@@ -359,6 +361,23 @@ class RolloutWorker:
             self._filter_delta = MeanStdFilter() if self._filter_stage is not None else None
         return True
 
+    def rejoin_collective(self, group_name: str = "rllib_weights") -> bool:
+        """Live-member rejoin: re-assert this worker's roster membership in
+        a group it already initialized (a transient stall can get a live
+        member evicted by a broadcast that timed out on it). False when the
+        group is unknown here — the caller must init, not rejoin."""
+        from ray_tpu.util import collective as col
+
+        return col.rejoin_group(group_name) is not None
+
+    def get_coll_stats(self) -> dict:
+        """This process's collective counters (p2p.COLL). Lets the driver
+        and tests assert a sampler stayed on the broadcast plane —
+        bcast_recvs climbing while host_sync_fallbacks stays flat."""
+        from ray_tpu.util.collective.p2p import COLL
+
+        return {k: getattr(COLL, k) for k in COLL.__slots__}
+
     def ping(self) -> bool:
         return True
 
@@ -391,6 +410,13 @@ class WorkerSet:
         )
         self._workers = [self._make_worker(i + 1) for i in range(num_workers)]
         self._indices = list(range(1, num_workers + 1))
+        # Elastic weight-group state (set by init_weight_group): the
+        # (group_name, backend, base_rank) triple plus a positional list of
+        # each worker's rank in the group. _replace_worker re-registers a
+        # respawned replacement at its OLD rank; resize() joins/evicts
+        # ranks at the tail. None = no weight group (host sync mode).
+        self._weight_group: Optional[tuple] = None
+        self._group_ranks: List[int] = []
         # Async env-runner mode (None = sync). Set by start_async; replaced
         # workers are restarted into the same mode.
         self._async_fragment_len: Optional[int] = None
@@ -424,9 +450,11 @@ class WorkerSet:
             )
             del self._workers[pos]
             del self._indices[pos]
+            self._evict_rank(pos)
             return None
         self._restarts += 1
         self._workers[pos] = self._make_worker(self._indices[pos])
+        self._reregister_worker(pos)
         if self._async_fragment_len is not None:
             # Restarted into async mode; its runner idles until the next
             # weight broadcast delivers params.
@@ -435,6 +463,47 @@ class WorkerSet:
             except Exception:
                 pass
         return self._workers[pos]
+
+    def _reregister_worker(self, pos: int):
+        """Put a respawned replacement back into the learner↔sampler weight
+        group AT ITS OLD RANK. roster_join bumps the roster epoch, so the
+        learner's next broadcast snapshots a membership that includes the
+        replacement — the first post-respawn sync is already back on the
+        device_broadcast fast path (the degradation used to be permanent:
+        replacements stayed outside the static group forever). Best-effort:
+        a failed re-register leaves the worker on the pull path, which is
+        correct, just slower."""
+        if self._weight_group is None or pos >= len(self._group_ranks):
+            return
+        group_name, backend, _ = self._weight_group
+        rank = self._group_ranks[pos]
+        world = max(self._group_ranks) + 1
+        try:
+            ray_tpu.get(
+                self._workers[pos].init_collective.remote(world, rank, backend, group_name),
+                timeout=60,
+            )
+        except Exception:
+            logger.warning(
+                "re-register of respawned worker into weight group %r at rank "
+                "%d failed; it stays on the pull path", group_name, rank,
+            )
+
+    def _evict_rank(self, pos: int):
+        """Driver-side LEAVE for a worker dropped from the set: a killed
+        actor can't unregister itself, so the driver evicts its rank from
+        the roster (epoch bump) — the learner's next broadcast stops
+        addressing it instead of timing out against a ghost."""
+        if self._weight_group is None or pos >= len(self._group_ranks):
+            return
+        group_name = self._weight_group[0]
+        rank = self._group_ranks.pop(pos)
+        try:
+            from ray_tpu.util import collective as col
+
+            col.evict_member(group_name, rank, reason="death")
+        except Exception:
+            logger.debug("roster eviction of rank %d from %r failed", rank, group_name, exc_info=True)
 
     def _replace_by_identity(self, w):
         """_replace_worker keyed by actor handle (safe across drops that
@@ -450,9 +519,10 @@ class WorkerSet:
     def sync_packed_weights(self, ref):
         """Podracer path: every worker sets weights from the SAME packed
         device-object ref (the learner already group-broadcast the payload,
-        so group members resolve from their inbox; a respawned replacement
-        is outside the static group and falls back to the pull path — same
-        weights, one extra round trip)."""
+        so group members resolve from their inbox). Membership is elastic:
+        a respawned replacement was re-registered at its old rank, so it
+        resolves from the broadcast plane too — at most the one sync that
+        raced the respawn rides the pull path."""
         self._sync_weights_via(lambda w: w.set_packed_weights.remote(ref))
 
     def _sync_weights_via(self, submit):
@@ -477,8 +547,11 @@ class WorkerSet:
                           world_size: int | None = None, base_rank: int = 1):
         """Gang-join every rollout worker into the learner↔sampler weight
         group at ranks base_rank..base_rank+N-1 (rank 0 is the learner/
-        holder). Static membership: replacements spawned later stay outside
-        and use the pull fallback."""
+        holder). Membership is ELASTIC: each join lands in the group's
+        GCS roster, `_replace_worker` re-registers respawned replacements
+        at their old rank, and `resize` grows/shrinks the roster at the
+        tail — every broadcast snapshots the roster at send time, so the
+        fleet never falls off the fast path permanently."""
         world = world_size or (base_rank + len(self._workers))
         ray_tpu.get(
             [
@@ -487,7 +560,139 @@ class WorkerSet:
             ],
             timeout=120,
         )
+        self._weight_group = (group_name, backend, base_rank)
+        self._group_ranks = [base_rank + i for i in range(len(self._workers))]
         return world
+
+    def resize(self, num_workers: int) -> int:
+        """Grow or shrink the sampler fleet mid-training WITHOUT leaving
+        the broadcast fast path. Growing spawns workers at fresh worker
+        indices and gang-joins them into the weight group at fresh ranks
+        (each join bumps the roster epoch; the learner's next broadcast
+        snapshots the bigger membership). Shrinking stops + kills the tail
+        workers and evicts their ranks from the roster driver-side (a
+        killed actor can't leave for itself). New workers have no params
+        until the next weight sync — callers should sync immediately after
+        a grow. Returns the new worker count."""
+        target = int(num_workers)
+        if target < 1:
+            raise ValueError("resize needs at least one rollout worker")
+        if target == len(self._workers):
+            return target
+        if target < len(self._workers):
+            victims = self._workers[target:]
+            dropped_ranks = self._group_ranks[target:] if self._weight_group else []
+            for w in victims:
+                try:
+                    w.stop.remote()
+                except Exception:
+                    pass
+            for w in victims:
+                try:
+                    ray_tpu.kill(w)
+                except Exception:
+                    pass
+            del self._workers[target:]
+            del self._indices[target:]
+            if self._weight_group is not None:
+                del self._group_ranks[target:]
+                group_name = self._weight_group[0]
+                from ray_tpu.util import collective as col
+
+                for rank in dropped_ranks:
+                    try:
+                        col.evict_member(group_name, rank, reason="leave")
+                    except Exception:
+                        logger.debug(
+                            "shrink: roster eviction of rank %d from %r failed",
+                            rank, group_name, exc_info=True,
+                        )
+            logger.info("worker set shrunk to %d samplers", target)
+            return target
+        # Grow: fresh worker indices (never reuse — env seeds derive from
+        # them) and, when a weight group exists, fresh tail ranks.
+        next_idx = max(self._indices, default=0) + 1
+        new_positions = []
+        while len(self._workers) < target:
+            self._workers.append(self._make_worker(next_idx))
+            self._indices.append(next_idx)
+            new_positions.append(len(self._workers) - 1)
+            next_idx += 1
+        if self._weight_group is not None:
+            group_name, backend, base_rank = self._weight_group
+            next_rank = max(self._group_ranks, default=base_rank - 1) + 1
+            new_ranks = list(range(next_rank, next_rank + len(new_positions)))
+            self._group_ranks.extend(new_ranks)
+            world = max(self._group_ranks) + 1
+            refs = [
+                self._workers[pos].init_collective.remote(world, rank, backend, group_name)
+                for pos, rank in zip(new_positions, new_ranks)
+            ]
+            for rank, ref in zip(new_ranks, refs):
+                try:
+                    ray_tpu.get(ref, timeout=120)
+                except Exception:
+                    logger.warning(
+                        "grow: weight-group join at rank %d failed; that "
+                        "worker rides the pull path until re-registered", rank,
+                    )
+        if self._async_fragment_len is not None:
+            for pos in new_positions:
+                try:
+                    self._workers[pos].start_async.remote(self._async_fragment_len)
+                except Exception:
+                    pass
+        logger.info("worker set grown to %d samplers", target)
+        return target
+
+    def ensure_registered(self):
+        """Self-healing pre-sync check: a transient stall can get a LIVE
+        worker evicted from the weight-group roster (a broadcast that
+        timed out on it batch-evicts all failed ranks). One cheap roster
+        read; any live worker whose rank fell off re-joins before the next
+        broadcast, so a stall costs at most one pull-path sync instead of
+        a permanent fast-path exit."""
+        if self._weight_group is None:
+            return
+        from ray_tpu.util import collective as col
+
+        group_name, _, _ = self._weight_group
+        try:
+            snap = col.roster(group_name)
+        except Exception:
+            return
+        if snap is None:
+            return
+        listed = set(snap["ranks"])
+        for pos, rank in enumerate(self._group_ranks):
+            if rank in listed or pos >= len(self._workers):
+                continue
+            logger.warning(
+                "live worker at rank %d fell off weight-group %r roster; re-joining",
+                rank, group_name,
+            )
+            try:
+                ok = ray_tpu.get(
+                    self._workers[pos].rejoin_collective.remote(group_name), timeout=60
+                )
+            except Exception:
+                ok = False
+            if not ok:
+                # The worker never held the group locally (e.g. a respawn
+                # whose re-register failed) — full init at its old rank.
+                self._reregister_worker(pos)
+
+    def coll_stats(self) -> List[Optional[dict]]:
+        """Per-worker collective counters (None for unreachable workers) —
+        the elastic-membership observability hook tests assert against."""
+        refs = [w.get_coll_stats.remote() for w in self._workers]
+        out: List[Optional[dict]] = []
+        for ref in refs:
+            try:
+                out.append(ray_tpu.get(ref, timeout=30))
+            except Exception:
+                out.append(None)
+        return out
 
     def sample(self, steps_per_worker: int, explore: bool = True) -> List[SampleBatch]:
         """Synchronous parallel sampling with fault tolerance: a worker that

@@ -78,9 +78,21 @@ class GroupManager:
             # straight at its direct mailbox instead of going through the
             # GCS-KV mailbox per rank. Best-effort: members without a row
             # get the mailbox fallback.
-            from ray_tpu.util.collective.p2p import register_member_addr
+            from ray_tpu.util.collective.p2p import register_member_addr, roster_join
 
             register_member_addr(gcs, group_name, rank, cw.address)
+            # Then JOIN the epochal roster (address row first: a rank the
+            # roster lists always has a dialable row). A rank already
+            # listed is a RE-REGISTER — a respawned member at a new
+            # address — and still bumps the epoch, which is what drops
+            # every peer's address cache.
+            try:
+                roster_join(gcs, group_name, rank, world_size)
+            except Exception:
+                logger.warning(
+                    "roster join failed for group %r rank %s (verbs fall "
+                    "back to the static world)", group_name, rank,
+                )
         return group
 
     def get(self, group_name: str):
@@ -192,6 +204,55 @@ def send(value, dst_rank: int, group_name: str = "default", tag: str = "0"):
 def recv(src_rank: int, group_name: str = "default", tag: str = "0", timeout: float = 120.0):
     """2-party point-to-point recv (reference: collective.py:594)."""
     return _manager.get(group_name).recv(src_rank, tag, timeout)
+
+
+def roster(group_name: str = "default") -> dict | None:
+    """Current epochal-membership snapshot of ``group_name`` from the GCS:
+    ``{"epoch", "ranks", "world_size"}``, or None for a group that never
+    published one. Works from ANY process with a GCS connection (the
+    driver introspecting a group it is not a member of included)."""
+    from ray_tpu._private import worker_context
+    from ray_tpu.util.collective.p2p import fetch_roster
+
+    cw = worker_context.get_core_worker()
+    return fetch_roster(cw.gcs, group_name)
+
+
+def rejoin_group(group_name: str = "default") -> int | None:
+    """Re-assert THIS process's membership in a group it already holds
+    locally: re-publish the address row, then re-join the roster. The
+    self-healing lever for a LIVE member that a verb EVICTED on a
+    transient stall (eviction also deleted its address row) — the epoch
+    bump puts it back on every sender's fast path at the next snapshot.
+    Returns the new roster epoch, or None when this process never
+    initialized the group (a respawned replacement must init, not
+    rejoin)."""
+    from ray_tpu._private import worker_context
+    from ray_tpu.util.collective.p2p import register_member_addr, roster_join
+
+    try:
+        group = _manager.get(group_name)
+    except ValueError:
+        return None
+    cw = worker_context.get_core_worker_if_initialized()
+    if cw is None:
+        return None
+    register_member_addr(cw.gcs, group_name, group.rank, cw.address)
+    return roster_join(cw.gcs, group_name, group.rank, group.world_size)
+
+
+def evict_member(group_name: str, rank: int, reason: str = "leave") -> int | None:
+    """Driver-side LEAVE on behalf of a member that cannot leave for
+    itself (SIGKILLed actor, shrink of a fleet whose workers are killed
+    outright): drops ``rank`` from the roster, advances the epoch, and
+    deletes its orphaned address row. Returns the new epoch, or None if
+    the rank was not listed. The next verb on the group topologizes over
+    the survivors."""
+    from ray_tpu._private import worker_context
+    from ray_tpu.util.collective.p2p import roster_leave
+
+    cw = worker_context.get_core_worker()
+    return roster_leave(cw.gcs, group_name, rank, reason=reason)
 
 
 def local_group_hints() -> list:

@@ -92,10 +92,16 @@ class CpuCollectiveGroup:
         self.rank = rank
         self.gcs = gcs or worker_context.get_core_worker().gcs
         self._epoch = 0
-        # {rank: core-worker addr} lazily fetched from the GCS registry —
-        # membership is static per group epoch, so one fetch serves every
-        # group broadcast this member fans out.
-        self._member_addrs: dict | None = None
+        # (roster_epoch, {rank: core-worker addr}) — the address cache is
+        # KEYED ON THE ROSTER EPOCH and dropped on any bump: membership is
+        # elastic, and a member that re-registered at the SAME coordinator
+        # epoch has a new address under the same rank row (the bug the
+        # static "fetch once per group" cache had).
+        self._addr_cache: tuple[int, dict] | None = None
+        # Set by destroy(): a verb racing a concurrent
+        # destroy_collective_group must surface a typed CollectiveError,
+        # never park until its timeout.
+        self._destroyed = False
 
     def _key(self, step: str, rank: int) -> str:
         return f"collective/{self.group_name}/{self._epoch}/{step}/{rank}"
@@ -107,6 +113,15 @@ class CpuCollectiveGroup:
             "kv_put", {"key": self._key(step, self.rank), "value": serialization.dumps(value)}
         )
 
+    def _check_destroyed(self, verb: str) -> None:
+        if self._destroyed:
+            from ray_tpu.exceptions import CollectiveError
+
+            raise CollectiveError(
+                f"collective group {self.group_name!r} was destroyed "
+                f"(rank {self.rank}, during {verb})"
+            )
+
     def _collect(self, step: str, timeout: float = 120.0) -> list:
         from ray_tpu._private import serialization
         from ray_tpu.exceptions import CollectiveTimeoutError
@@ -115,6 +130,7 @@ class CpuCollectiveGroup:
         deadline = time.monotonic() + timeout
         remaining = set(range(self.world_size))
         while remaining and time.monotonic() < deadline:
+            self._check_destroyed(step)
             for r in list(remaining):
                 resp = self.gcs.call("kv_get", {"key": self._key(step, r)})
                 if resp.get("found"):
@@ -194,31 +210,53 @@ class CpuCollectiveGroup:
 
     # ---- group broadcast (ONE op fanning a payload to every member) ----
 
-    def _addrs(self) -> dict:
-        from ray_tpu.util.collective.p2p import fetch_member_addrs
+    def _snapshot(self) -> tuple:
+        """(roster, {rank: addr}) for the CURRENT roster epoch. One cheap
+        epoch read per verb; the address fan-fetch reruns only when the
+        epoch moved (join/leave/re-register all bump it). Groups that never
+        published a roster (pre-elastic callers) fall back to the static
+        ``range(world_size)`` world under cache key epoch 0."""
+        from ray_tpu.util.collective.p2p import fetch_member_addrs, fetch_roster
 
-        if self._member_addrs is None:
-            self._member_addrs = fetch_member_addrs(self.gcs, self.group_name, self.world_size)
-        return self._member_addrs
+        roster = fetch_roster(self.gcs, self.group_name)
+        repoch = roster["epoch"] if roster else 0
+        cache = self._addr_cache
+        if cache is None or cache[0] != repoch:
+            ranks = roster["ranks"] if roster else list(range(self.world_size))
+            world = max(self.world_size, roster["world_size"] if roster else 0)
+            cache = (repoch, fetch_member_addrs(self.gcs, self.group_name, world, ranks=ranks))
+            self._addr_cache = cache
+        return roster, cache[1]
+
+    def _addrs(self) -> dict:
+        return self._snapshot()[1]
 
     def bcast_send_payload(self, value, tag: str, timeout: float = 30.0,
                            mailbox_fallback: bool = True,
                            topology: str = "tree") -> dict:
         """Holder-side group broadcast: one serialize, acked chunk pushes
         riding the binomial relay tree by default (p2p.group_bcast_send) —
-        the fan-out device_object.broadcast() rides. Returns the per-rank
-        delivery map; never raises for a dead member (the caller owns the
-        policy). ``mailbox_fallback=False`` when receivers only watch the
-        direct inbox (the descriptor-resolution path); ``topology="flat"``
-        forces PR 15's per-rank fan-out (the bench A/B arm)."""
+        the fan-out device_object.broadcast() rides. The target set is the
+        ROSTER SNAPSHOT at send time (members that joined since init are
+        included, departed ones are not), a mid-op rejoiner is retried at
+        its fresh address, and unreachable members are evicted into the
+        next epoch. Returns the per-rank delivery map; never raises for a
+        dead member (the caller owns the policy). ``mailbox_fallback=False``
+        when receivers only watch the direct inbox (the
+        descriptor-resolution path); ``topology="flat"`` forces PR 15's
+        per-rank fan-out (the bench A/B arm)."""
         from ray_tpu._private import worker_context
         from ray_tpu.util.collective.p2p import group_bcast_send
 
+        self._check_destroyed("bcast_send_payload")
         cw = worker_context.get_core_worker()
+        roster, addrs = self._snapshot()
+        world = max(self.world_size, roster["world_size"] if roster else 0)
         return group_bcast_send(
-            cw, self.gcs, self.group_name, self.rank, self.world_size, tag,
-            value, member_addrs=self._addrs(), timeout=timeout,
+            cw, self.gcs, self.group_name, self.rank, world, tag,
+            value, member_addrs=addrs, timeout=timeout,
             mailbox_fallback=mailbox_fallback, topology=topology,
+            roster=roster,
         )
 
     def _finalize_like(self, value, out):
@@ -237,12 +275,14 @@ class CpuCollectiveGroup:
         (p2p.group_reduce_send): partials combine chunk-wise at every relay
         hop, so no single member ever receives K payloads. Returns the
         reduced value on ``dst_rank`` (same placement as ``value``), None
-        elsewhere. Falls back to the GCS ring when any member lacks a
-        registered address (old-style members) or the group is trivial
-        (world_size < 2)."""
-        addrs = self._addrs()
-        missing = [r for r in range(self.world_size) if r != self.rank and r not in addrs]
-        if self.world_size < 2 or missing:
+        elsewhere. The tree spans the roster snapshot at call time. Falls
+        back to the GCS ring when any member lacks a registered address
+        (old-style members) or the group is trivial (world_size < 2)."""
+        self._check_destroyed("reduce_send_payload")
+        roster, addrs = self._snapshot()
+        ranks = roster["ranks"] if roster else list(range(self.world_size))
+        missing = [r for r in ranks if r != self.rank and r not in addrs]
+        if len(ranks) < 2 or missing:
             return self.reduce(value, dst_rank, op)
         from ray_tpu._private import worker_context
         from ray_tpu.util.collective.p2p import group_reduce_send
@@ -251,6 +291,7 @@ class CpuCollectiveGroup:
         out = group_reduce_send(
             cw, self.gcs, self.group_name, self.rank, self.world_size, tag,
             value, op=op, dst_rank=dst_rank, member_addrs=addrs, timeout=timeout,
+            roster=roster,
         )
         if out is None:
             return None
@@ -262,9 +303,11 @@ class CpuCollectiveGroup:
         every rank returns the same reduced value, placed like ``value``
         (the root finalizes ONCE before the down-broadcast). Ring fallback
         under the same conditions as :meth:`reduce_send_payload`."""
-        addrs = self._addrs()
-        missing = [r for r in range(self.world_size) if r != self.rank and r not in addrs]
-        if self.world_size < 2 or missing:
+        self._check_destroyed("allreduce_payload")
+        roster, addrs = self._snapshot()
+        ranks = roster["ranks"] if roster else list(range(self.world_size))
+        missing = [r for r in ranks if r != self.rank and r not in addrs]
+        if len(ranks) < 2 or missing:
             return self.allreduce(value, op)
         from ray_tpu._private import worker_context
         from ray_tpu.util.collective.p2p import group_allreduce
@@ -274,20 +317,42 @@ class CpuCollectiveGroup:
             cw, self.gcs, self.group_name, self.rank, self.world_size, tag,
             value, op=op, member_addrs=addrs, timeout=timeout,
             finalize=lambda reduced: self._finalize_like(value, reduced),
+            roster=roster,
         )
 
     def bcast_recv_payload(self, src_rank: int, tag: str, timeout: float = 120.0):
         """Member-side receive of a group broadcast (direct mailbox, GCS
-        fallback, typed timeout naming group/rank/tag)."""
+        fallback, typed timeout naming group/rank/tag). A concurrent
+        destroy of this group aborts the wait with a typed CollectiveError
+        instead of parking until the deadline."""
         from ray_tpu._private import worker_context
         from ray_tpu.util.collective.p2p import group_bcast_recv
 
+        self._check_destroyed("bcast_recv_payload")
         cw = worker_context.get_core_worker()
         return group_bcast_recv(
-            cw, self.gcs, self.group_name, src_rank, self.rank, tag, timeout
+            cw, self.gcs, self.group_name, src_rank, self.rank, tag, timeout,
+            abort_check=lambda: self._destroyed,
         )
 
     def destroy(self):
-        from ray_tpu.util.collective.p2p import unregister_member_addr
+        from ray_tpu.util.collective.p2p import (
+            roster_leave,
+            sweep_group_kv,
+            unregister_member_addr,
+        )
 
+        self._destroyed = True
+        try:
+            roster_leave(self.gcs, self.group_name, self.rank)
+        except Exception:
+            pass
         unregister_member_addr(self.gcs, self.group_name, self.rank)
+        if self.rank == 0:
+            # Rank 0 (conventionally the driver/learner side, destroyed
+            # last in the gang-teardown idiom) sweeps the group's KV back
+            # to baseline: repoch + roster back-window + every addr row.
+            try:
+                sweep_group_kv(self.gcs, self.group_name, self.world_size)
+            except Exception:
+                pass

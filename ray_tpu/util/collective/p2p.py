@@ -584,6 +584,10 @@ class _CollStats:
         "reduce_sends",       # tree-reduce participations by this process
         "reduce_bytes",       # partial-combine bytes pushed up the tree
         "allreduces",         # allreduce participations (reduce + down-broadcast)
+        "host_sync_fallbacks",  # group members that resolved a broadcast payload
+                                # via the pull path (off the fast path: the
+                                # elastic-roster degradation signal)
+        "member_changes",     # roster epoch advances published by this process
     )
 
     def __init__(self):
@@ -632,11 +636,16 @@ def unregister_member_addr(gcs, group_name: str, rank: int) -> None:
 
 
 @blocking
-def fetch_member_addrs(gcs, group_name: str, world_size: int) -> dict:
+def fetch_member_addrs(gcs, group_name: str, world_size: int, ranks=None) -> dict:
     """{rank: (host, port)} for every member that registered an address.
-    Callers cache this per group epoch — membership is static.
+    Callers key their cache on the ROSTER EPOCH (``fetch_roster_epoch``)
+    and drop it on any roster bump — membership is elastic, and a member
+    that re-registered at the same coordinator epoch has a NEW address
+    under the same rank row.
 
-    The ``world_size`` lookups are batched CONCURRENTLY on the IO loop
+    ``ranks`` (optional) restricts the lookup to a roster snapshot's
+    member set; default is ``range(world_size)`` (static-world callers).
+    The lookups are batched CONCURRENTLY on the IO loop
     (the serial per-rank round scaled the fetch O(K) in GCS RTTs), and a
     GCS transport error PROPAGATES: a partitioned GCS must surface as a
     failure the caller can see, not read as "nobody registered" — which
@@ -647,14 +656,15 @@ def fetch_member_addrs(gcs, group_name: str, world_size: int) -> dict:
 
     from ray_tpu._private.rpc import EventLoopThread
 
-    keys = [member_addr_key(group_name, rank) for rank in range(world_size)]
+    ranks = list(ranks) if ranks is not None else list(range(world_size))
+    keys = [member_addr_key(group_name, rank) for rank in ranks]
 
     async def _fetch_all():
         return await asyncio.gather(*(gcs.acall("kv_get", {"key": k}) for k in keys))
 
     responses = EventLoopThread.get().run(_fetch_all(), timeout=30.0)
     addrs: dict = {}
-    for rank, resp in enumerate(responses):
+    for rank, resp in zip(ranks, responses):
         if not resp.get("found"):
             continue
         try:
@@ -662,6 +672,268 @@ def fetch_member_addrs(gcs, group_name: str, world_size: int) -> dict:
         except Exception:
             continue  # malformed row: that rank keeps the mailbox fallback
     return addrs
+
+
+# ---------------------------------------------------------------------------
+# Epochal roster (elastic membership)
+# ---------------------------------------------------------------------------
+
+# The roster makes the per-member address rows AUTHORITATIVE: the member set
+# of a group at any moment is `collective/<group>/roster/<epoch>` where
+# <epoch> is the value of `collective/<group>/repoch`. Members join / leave /
+# re-register by publishing the updated set at epoch+1 and bumping the
+# counter; every verb snapshots the roster at send time and builds its
+# topology over the CURRENT epoch. Mid-operation death is handled by retry
+# (survivors keep their payload, rejoiners are re-pushed at their fresh
+# address, the dead rank is left out of the next epoch) — NOT by a fence:
+# two members racing an epoch bump can disagree for one verb, which then
+# fails typed and the caller's next attempt sees the settled roster.
+
+# Bounded back-window for the stale-row sweep: epochs advance one at a time,
+# so sweeping this many predecessors on every bump keeps the KV at O(1) rows
+# per group without a scan API.
+_ROSTER_SWEEP_WINDOW = 16
+
+
+def roster_epoch_key(group_name: str) -> str:
+    return f"collective/{group_name}/repoch"
+
+
+def roster_key(group_name: str, epoch: int) -> str:
+    return f"collective/{group_name}/roster/{epoch}"
+
+
+@blocking
+def fetch_roster_epoch(gcs, group_name: str) -> int:
+    """Latest roster epoch; 0 = no roster published (static-world group).
+    The counter row is a fast-path HINT, not the truth: epoch rows are
+    claimed put-if-absent (publish_roster), so the row sequence is the
+    linearization point and a slow winner's counter write can land late
+    (lag below a newer claim, whose sweep may already have deleted the
+    hinted row). The frontier is found by scanning the live roster rows
+    (one kv_keys prefix call — the GCS serves it atomically); the counter
+    only covers the no-rows-but-counter-lingers case."""
+    try:
+        prefix = f"collective/{group_name}/roster/"
+        keys = gcs.call("kv_keys", {"prefix": prefix}).get("keys", [])
+        epochs = [int(k[len(prefix):]) for k in keys if k[len(prefix):].isdigit()]
+        resp = gcs.call("kv_get", {"key": roster_epoch_key(group_name)})
+        hinted = int(bytes(resp["value"]).decode()) if resp.get("found") else 0
+        return max(epochs + [hinted])
+    except Exception:
+        return 0
+
+
+@blocking
+def fetch_roster(gcs, group_name: str) -> dict | None:
+    """Snapshot the current roster: ``{"epoch", "ranks", "world_size"}``,
+    or None when the group never published one (pre-elastic callers).
+
+    A None here must MEAN no roster — a joiner that misreads a live group
+    as roster-less derives a singleton member set and breaks the epoch
+    chain (every claim must derive from its predecessor row). So a torn
+    read — the frontier row swept by a newer claim between the scan and
+    the get — is retried against the new frontier, and None is returned
+    only when the scan itself shows no live rows."""
+    import json
+
+    prefix = f"collective/{group_name}/roster/"
+    for attempt in range(4):
+        try:
+            keys = gcs.call("kv_keys", {"prefix": prefix}).get("keys", [])
+        except Exception:
+            return None
+        epochs = [int(k[len(prefix):]) for k in keys if k[len(prefix):].isdigit()]
+        if not epochs:
+            # Live rows only — the counter hint is deliberately NOT
+            # consulted: a lingering counter (destroy raced a publish)
+            # naming no live row must read as "no roster", not wedge
+            # every reader on a phantom epoch.
+            return None
+        epoch = max(epochs)
+        try:
+            resp = gcs.call("kv_get", {"key": roster_key(group_name, epoch)})
+            if not resp.get("found"):
+                continue  # swept mid-read: frontier moved, re-scan
+            doc = json.loads(bytes(resp["value"]).decode())
+            ranks = sorted(int(r) for r in doc.get("ranks", []))
+            return {
+                "epoch": epoch,
+                "ranks": ranks,
+                "world_size": int(doc.get("world_size") or ((max(ranks) + 1) if ranks else 0)),
+            }
+        except Exception:
+            return None
+    return None
+
+
+def _record_member_change(group_name: str, reason: str, rank, epoch: int, nranks: int) -> None:
+    try:
+        from ray_tpu._private import flight_recorder
+
+        flight_recorder.record(
+            "coll_member_change",
+            f"{group_name}:{reason}:r{'' if rank is None else rank}:e{epoch}:n{nranks}",
+        )
+    except Exception:
+        pass
+
+
+@blocking
+def publish_roster(gcs, group_name: str, ranks, world_size: int | None = None,
+                   reason: str = "advance", rank: int | None = None,
+                   base_epoch: int | None = None) -> int | None:
+    """CLAIM roster epoch ``base_epoch + 1`` with the given member set,
+    bump the counter hint, and sweep the stale predecessor rows (satellite
+    of the epoch advance: dead-epoch ``roster/<e>`` rows must not pile up
+    in the KV). Returns the claimed epoch, or **None when the claim lost**
+    the race.
+
+    The row is written put-if-absent and ONLY at base+1, which makes the
+    roster a derivation CHAIN: the winner of epoch e+1 provably derived
+    its set from row e (it read row e, and nobody else claimed e+1 in
+    between). A rank present in row e can therefore only disappear via an
+    explicit leave/evict, never a stale-read overwrite — the lost-update
+    hole where a gang joiner's stale read used to erase an already
+    verified peer. A None return means ``ranks`` was derived from a row
+    that is no longer the frontier; the caller must RE-READ and RE-DERIVE
+    (roster_join/roster_leave loop exactly that)."""
+    import json
+
+    ranks = sorted(set(int(r) for r in ranks))
+    world = int(world_size) if world_size else ((max(ranks) + 1) if ranks else 0)
+    if base_epoch is None:
+        base_epoch = fetch_roster_epoch(gcs, group_name)
+    epoch = int(base_epoch) + 1
+    doc = json.dumps({"ranks": ranks, "world_size": world}).encode()
+    resp = gcs.call(
+        "kv_put",
+        {"key": roster_key(group_name, epoch), "value": doc, "overwrite": False},
+    )
+    if not resp.get("added"):
+        return None
+    # Counter hint: never drag it BACKWARD below a later winner's write
+    # (the frontier scan heals any regression that slips through the
+    # read-check window).
+    try:
+        resp = gcs.call("kv_get", {"key": roster_epoch_key(group_name)})
+        hinted = int(bytes(resp["value"]).decode()) if resp.get("found") else 0
+    except Exception:
+        hinted = 0
+    if epoch > hinted:
+        gcs.call("kv_put", {"key": roster_epoch_key(group_name), "value": str(epoch).encode()})
+    # Hygiene sweep, LAGGED by a full window: rows in (epoch-W, epoch) must
+    # stay — deleting an immediate predecessor re-opens its key for a
+    # put-if-absent claim, letting a stale joiner "win" on a dead fork
+    # below the frontier (its membership would never enter the chain). A
+    # claimant would have to be W epochs stale within one read-claim
+    # round trip to fork past the lag.
+    for old in range(max(1, epoch - 2 * _ROSTER_SWEEP_WINDOW),
+                     max(1, epoch - _ROSTER_SWEEP_WINDOW + 1)):
+        try:
+            gcs.call("kv_del", {"key": roster_key(group_name, old)})
+        except Exception:
+            pass
+    COLL.member_changes += 1
+    _record_member_change(group_name, reason, rank, epoch, len(ranks))
+    return epoch
+
+
+@blocking
+def roster_join(gcs, group_name: str, rank: int, world_size: int | None = None,
+                attempts: int = 24) -> int:
+    """Add ``rank`` to the roster (join, or RE-REGISTER when the rank is
+    already listed — a respawned member at a new address must still bump
+    the epoch so every peer's address cache drops). Each attempt reads the
+    frontier row, unions itself in, and claims DIRECTLY on top of the row
+    it derived from (publish_roster, put-if-absent at base+1) — a won
+    claim therefore provably contains this rank AND every rank of the
+    predecessor row, so no verify pass is needed and no racing joiner can
+    erase an already returned peer. A lost claim means the frontier moved:
+    re-read, re-derive, retry — convergent because one claimant wins every
+    epoch (worst case: a K-member gang join takes K rounds)."""
+    rank = int(rank)
+    epoch = 0
+    for attempt in range(attempts):
+        cur = fetch_roster(gcs, group_name)
+        ranks = set(cur["ranks"]) if cur else set()
+        rejoin = rank in ranks
+        ranks.add(rank)
+        world = max(world_size or 0, (cur["world_size"] if cur else 0), rank + 1)
+        # base is the epoch this derivation OBSERVED — never a re-probed
+        # frontier (a fresh probe can see a row this read never did, and
+        # claiming on top of an unread row drops its members from the
+        # chain). A None read observed epoch 0: claim row 1 or lose and
+        # re-derive.
+        base = cur["epoch"] if cur else 0
+        epoch = publish_roster(
+            gcs, group_name, ranks, world,
+            reason="rejoin" if rejoin else "join", rank=rank, base_epoch=base,
+        )
+        if epoch is not None:
+            return epoch
+        time.sleep(0.005 * (attempt + 1))
+    import logging
+
+    logging.getLogger(__name__).warning(
+        "roster join for group %r rank %s lost every claim attempt "
+        "(pathological churn); membership not asserted", group_name, rank,
+    )
+    return fetch_roster_epoch(gcs, group_name)
+
+
+@blocking
+def roster_leave(gcs, group_name: str, rank: int, reason: str = "leave") -> int | None:
+    """Drop ``rank`` from the roster (voluntary leave, or a verb evicting a
+    member it could not deliver to — ``reason="death"``) and delete its now
+    orphaned address row. No-op (None) when the group has no roster or the
+    rank is already gone. Claims on top of the row it derived from
+    (publish_roster base+1); a lost claim re-reads and re-derives so a
+    racing join is never erased."""
+    for attempt in range(12):
+        cur = fetch_roster(gcs, group_name)
+        if cur is None or int(rank) not in cur["ranks"]:
+            return None
+        ranks = [r for r in cur["ranks"] if r != int(rank)]
+        epoch = publish_roster(
+            gcs, group_name, ranks, cur["world_size"], reason=reason,
+            rank=int(rank), base_epoch=cur["epoch"],
+        )
+        if epoch is not None:
+            unregister_member_addr(gcs, group_name, int(rank))
+            return epoch
+        time.sleep(0.005 * (attempt + 1))
+    return None
+
+
+@blocking
+def sweep_group_kv(gcs, group_name: str, world_size: int = 0) -> int:
+    """Teardown sweep: delete EVERY collective KV row of ``group_name`` —
+    the roster-epoch counter, the roster back-window, and all member
+    address rows — so a destroyed group leaves the KV at baseline. Returns
+    the number of delete calls issued (best-effort; a partitioned GCS
+    sweeps on the next destroy)."""
+    n = 0
+    try:
+        cur = fetch_roster(gcs, group_name)
+        epoch = fetch_roster_epoch(gcs, group_name)
+        world = max(
+            world_size, cur["world_size"] if cur else 0,
+            (max(cur["ranks"]) + 1) if cur and cur["ranks"] else 0,
+        )
+        keys = [roster_epoch_key(group_name)]
+        keys += [roster_key(group_name, e)
+                 for e in range(max(1, epoch - 2 * _ROSTER_SWEEP_WINDOW), epoch + 1)]
+        keys += [member_addr_key(group_name, r) for r in range(world)]
+        for key in keys:
+            try:
+                gcs.call("kv_del", {"key": key})
+                n += 1
+            except Exception:
+                pass
+    except Exception:
+        pass
+    return n
 
 
 @blocking
@@ -677,6 +949,7 @@ def group_bcast_send(
     timeout: float = 30.0,
     mailbox_fallback: bool = True,
     topology: str = "tree",
+    roster: dict | None = None,
 ) -> dict:
     """Fan ``value`` to every OTHER rank of the group as ONE group
     operation: one serialize, each chunk frame ENCODED ONCE
@@ -687,7 +960,19 @@ def group_bcast_send(
     names it so the caller owns the policy —
     ``{"ok_ranks": [...], "fallback_ranks": [...], "failed": {rank: reason},
     "bytes": payload_bytes, "topology": ..., "root_egress_bytes": ...,
-    "retried_ranks": [...]}``.
+    "retried_ranks": [...], "rejoined_ranks": [...], "evicted_ranks": [...],
+    "roster_epoch": ...}``.
+
+    ``roster`` is the elastic-membership snapshot (``fetch_roster``): when
+    present, the target set is the CURRENT epoch's member ranks (not
+    ``range(world_size)``), a rank that fails its first delivery is
+    re-fetched from the address registry and retried once at its fresh
+    address (it may have RE-REGISTERED mid-operation — survivors +
+    rejoiners, not a frozen world), and ranks that still cannot be reached
+    are EVICTED: the roster advances one epoch without them, so the next
+    verb builds its topology over the survivors instead of failing forever
+    against a corpse. When ``roster=None`` and no member_addrs are passed,
+    the snapshot is taken here.
 
     ``topology="tree"`` (default, ≥2 addressed ranks): the root pushes
     chunk frames only to its BINOMIAL-TREE children, each frame carrying
@@ -711,15 +996,27 @@ def group_bcast_send(
 
     data = serialization.dumps(value)
     if member_addrs is None:
-        member_addrs = fetch_member_addrs(gcs, group_name, world_size)
+        if roster is None:
+            roster = fetch_roster(gcs, group_name)
+        member_addrs = fetch_member_addrs(
+            gcs, group_name, world_size,
+            ranks=roster["ranks"] if roster else None,
+        )
+    else:
+        member_addrs = dict(member_addrs)
     total = max(1, (len(data) + _DIRECT_CHUNK_BYTES - 1) // _DIRECT_CHUNK_BYTES)
-    targets = [r for r in range(world_size) if r != src_rank]
+    if roster is not None:
+        targets = [r for r in roster["ranks"] if r != src_rank]
+    else:
+        targets = [r for r in range(world_size) if r != src_rank]
     addressed = [r for r in targets if r in member_addrs]
     use_tree = topology == "tree" and len(addressed) >= 2
     result = {
         "ok_ranks": [], "fallback_ranks": [], "failed": {}, "bytes": len(data),
         "topology": "tree" if use_tree else "flat",
-        "root_egress_bytes": 0, "retried_ranks": [],
+        "root_egress_bytes": 0, "retried_ranks": [], "rejoined_ranks": [],
+        "evicted_ranks": [],
+        "roster_epoch": roster["epoch"] if roster else 0,
     }
     key = bcast_key(group_name, tag)
     chunks = [
@@ -854,6 +1151,45 @@ def group_bcast_send(
     # bounded retry round in tree mode).
     outer = timeout + 15.0 + (20.0 if use_tree else 0.0)
     outcomes = cw._io.run(_fan_out(), timeout=outer) if targets else {}
+
+    # Elastic round: a rank that failed delivery may have RE-REGISTERED at
+    # a fresh address mid-operation (its replacement actor joined under the
+    # same rank). Re-read its address row — bypassing every cache — and
+    # retry once directly. This is the "survivors + rejoiners" half of the
+    # epochal contract; the eviction below is the other half.
+    if roster is not None:
+        lost = [r for r in addressed if outcomes.get(r) is not None]
+        if lost:
+            try:
+                fresh = fetch_member_addrs(gcs, group_name, world_size, ranks=lost)
+            except Exception:
+                fresh = {}
+            rejoiners = [
+                r for r in lost if fresh.get(r) and fresh[r] != member_addrs.get(r)
+            ]
+            if rejoiners:
+                member_addrs.update({r: fresh[r] for r in rejoiners})
+                rejoin_ack = max(5.0, min(ack_wait, 10.0))
+
+                async def _rejoin_round():
+                    tasks = {
+                        r: asyncio.ensure_future(
+                            asyncio.wait_for(_deliver(r), rejoin_ack + 10.0)
+                        )
+                        for r in rejoiners
+                    }
+                    await asyncio.wait(tasks.values())
+                    return {r: t.exception() for r, t in tasks.items()}
+
+                try:
+                    redone = cw._io.run(_rejoin_round(), timeout=rejoin_ack + 20.0)
+                except Exception:
+                    redone = {}
+                for r, exc in redone.items():
+                    if exc is None:
+                        outcomes[r] = None
+                        result["rejoined_ranks"].append(r)
+
     for rank in targets:
         if rank not in member_addrs:
             # Never registered an address (old-style member): the GCS
@@ -896,6 +1232,40 @@ def group_bcast_send(
             result["failed"][rank] = reason
             COLL.bcast_failed_ranks += 1
     result["retried_ranks"].sort()
+    if roster is not None and result["failed"]:
+        # Eviction: advance the epoch without the members this op could not
+        # reach — the NEXT verb topologizes over the survivors instead of
+        # failing forever against a corpse. A live member evicted by a
+        # transient stall is not stranded: its next re-register (or the
+        # sync loop's respawn) rejoins at epoch+1. One batch publish, not
+        # one bump per corpse.
+        dead = sorted(set(result["failed"]) & set(roster["ranks"]))
+        if dead:
+            try:
+                # Claim on top of the row the survivor set derives from;
+                # a lost claim (concurrent join/leave moved the frontier)
+                # re-reads and re-derives so a racing rejoiner is never
+                # erased by this eviction.
+                cur = roster
+                for attempt in range(6):
+                    survivors = [r for r in cur["ranks"] if r not in set(dead)]
+                    if set(survivors) == set(cur["ranks"]):
+                        break  # every dead rank already evicted elsewhere
+                    ep = publish_roster(
+                        gcs, group_name, survivors, cur["world_size"],
+                        reason="death", rank=dead[0], base_epoch=cur["epoch"],
+                    )
+                    if ep is not None:
+                        break
+                    time.sleep(0.005 * (attempt + 1))
+                    cur = fetch_roster(gcs, group_name)
+                    if cur is None:
+                        break
+                for r in dead:
+                    unregister_member_addr(gcs, group_name, r)
+                result["evicted_ranks"] = dead
+            except Exception:
+                pass  # GCS hiccup: the next verb's snapshot retries
     COLL.bcast_sends += 1
     if use_tree:
         COLL.tree_sends += 1
@@ -906,10 +1276,60 @@ def group_bcast_send(
     return result
 
 
+async def sweep_stale_group_rows(gcs, group_name: str) -> int:
+    """GCS hygiene for one group: delete dead-epoch ``roster/<e>`` and
+    coordinator ``coord/<e>`` rows behind the current epochs, plus orphaned
+    ``addr/<rank>`` rows of ranks no longer in the roster (a SIGKILLed
+    member never unregisters itself). Runs on the IO loop; called on every
+    roster advance (inline, via publish_roster's back-window) and from the
+    mailbox janitors. Best-effort: a partitioned GCS sweeps next time."""
+    import json
+
+    n = 0
+    try:
+        resp = await gcs.acall("kv_get", {"key": roster_epoch_key(group_name)})
+        epoch = int(bytes(resp["value"]).decode()) if resp.get("found") else 0
+        # Lagged like publish_roster's inline sweep: rows within a window
+        # of the frontier must stay, or their freed keys become claimable
+        # forks for a stale put-if-absent join.
+        for old in range(max(1, epoch - 2 * _ROSTER_SWEEP_WINDOW),
+                         max(1, epoch - _ROSTER_SWEEP_WINDOW + 1)):
+            await gcs.acall("kv_del", {"key": roster_key(group_name, old)})
+            n += 1
+        # tpu_group's jax.distributed rendezvous epochs (a separate counter:
+        # one per world re-formation, not per membership change).
+        resp = await gcs.acall("kv_get", {"key": f"collective/{group_name}/epoch"})
+        cepoch = int(bytes(resp["value"]).decode()) if resp.get("found") else 0
+        for old in range(max(1, cepoch - _ROSTER_SWEEP_WINDOW), cepoch):
+            await gcs.acall("kv_del", {"key": f"collective/{group_name}/coord/{old}"})
+            n += 1
+        if epoch:
+            resp = await gcs.acall("kv_get", {"key": roster_key(group_name, epoch)})
+            if resp.get("found"):
+                doc = json.loads(bytes(resp["value"]).decode())
+                ranks = set(int(r) for r in doc.get("ranks", []))
+                world = int(doc.get("world_size") or 0)
+                for r in range(world):
+                    if r not in ranks:
+                        await gcs.acall(
+                            "kv_del", {"key": member_addr_key(group_name, r)}
+                        )
+                        n += 1
+    except Exception:
+        pass
+    return n
+
+
 def _schedule_bcast_janitor(cw, gcs, key: str, delay_s: float = 180.0) -> None:
     """A mailbox-fallback payload a dead/slow member never claims must not
     sit in the GCS KV forever (same janitor shape as
-    DeviceObjectManager._schedule_mailbox_janitor)."""
+    DeviceObjectManager._schedule_mailbox_janitor). The sweep also runs the
+    per-group stale-row janitor: a group leaning on the mailbox fallback is
+    exactly the kind whose dead-epoch roster/coord/addr rows accumulate."""
+    # mailbox_key layout: collective/<group>/p2p/<src>-><dst>/<tag>
+    parts = key.split("/")
+    group_name = parts[1] if len(parts) > 2 and parts[0] == "collective" else None
+
     async def _sweep():
         import asyncio
 
@@ -918,6 +1338,8 @@ def _schedule_bcast_janitor(cw, gcs, key: str, delay_s: float = 180.0) -> None:
             await gcs.acall("kv_del", {"key": key})
         except Exception:
             pass
+        if group_name:
+            await sweep_stale_group_rows(gcs, group_name)
 
     try:
         cw._io.spawn(_sweep())
@@ -926,7 +1348,7 @@ def _schedule_bcast_janitor(cw, gcs, key: str, delay_s: float = 180.0) -> None:
 
 
 @blocking
-def group_bcast_recv(cw, gcs, group_name: str, src_rank: int, my_rank: int, tag: str, timeout: float = 120.0):
+def group_bcast_recv(cw, gcs, group_name: str, src_rank: int, my_rank: int, tag: str, timeout: float = 120.0, abort_check=None):
     """Member-side receive of a group broadcast: watch BOTH landing zones —
     the direct mailbox (steady state: the payload is already here, or
     arrives whenever the sender's chunk pushes finish) and the GCS mailbox
@@ -934,14 +1356,22 @@ def group_bcast_recv(cw, gcs, group_name: str, src_rank: int, my_rank: int, tag:
     deadline; typed timeout naming group/rank/tag otherwise. Interleaved
     on purpose: a receiver that blocks before the sender starts (normal
     collective ordering) must catch a direct delivery landing at ANY point
-    in the window, not just the first second."""
+    in the window, not just the first second. ``abort_check`` (optional)
+    turns a concurrent ``destroy_collective_group`` into an IMMEDIATE typed
+    CollectiveError instead of a full-timeout park — a destroyed group's
+    payload is never coming."""
     from ray_tpu._private import serialization
-    from ray_tpu.exceptions import CollectiveTimeoutError
+    from ray_tpu.exceptions import CollectiveError, CollectiveTimeoutError
 
     deadline = time.monotonic() + timeout
     key = bcast_key(group_name, tag)
     gcs_key = mailbox_key(group_name, src_rank, my_rank, f"bcast/{tag}")
     while True:
+        if abort_check is not None and abort_check():
+            raise CollectiveError(
+                f"group {group_name!r} was destroyed while rank {my_rank} "
+                f"waited for broadcast tag {tag!r} from rank {src_rank}"
+            )
         remaining = deadline - time.monotonic()
         if remaining <= 0:
             COLL.timeouts += 1
@@ -1025,6 +1455,7 @@ def group_reduce_send(
     dst_rank: int = 0,
     member_addrs: dict | None = None,
     timeout: float = 60.0,
+    roster: dict | None = None,
 ):
     """One member's share of a TREE reduce toward ``dst_rank``: wait per
     chunk index for each tree child's combined partial, merge it into this
@@ -1041,16 +1472,28 @@ def group_reduce_send(
     every member to have a registered address — callers (cpu_group) fall
     back to the GCS ring otherwise. A silent child raises a typed
     CollectiveTimeoutError NAMING it; a shape/dtype disagreement surfaces
-    as a CollectiveError naming both ranks."""
+    as a CollectiveError naming both ranks.
+
+    ``roster`` (elastic membership): the tree spans the CURRENT epoch's
+    member ranks, not ``range(world_size)`` — every participant must
+    snapshot the same epoch (they rendezvous through the per-rank stream
+    keys, so a disagreement surfaces as the typed child timeout and the
+    caller retries against the settled roster; a partial reduce is poison,
+    so there is no in-op rejoin round here, unlike broadcast)."""
     import numpy as np
 
     from ray_tpu.exceptions import CollectiveError, CollectiveTimeoutError
 
+    member_ranks = sorted(roster["ranks"]) if roster else list(range(world_size))
+    if roster is not None and (my_rank not in member_ranks or dst_rank not in member_ranks):
+        raise CollectiveError(
+            f"tree reduce on group {group_name!r}: rank {my_rank} -> "
+            f"{dst_rank} not in roster epoch {roster['epoch']} "
+            f"(members {member_ranks}) — re-register before reducing"
+        )
     if member_addrs is None:
-        member_addrs = fetch_member_addrs(gcs, group_name, world_size)
-    missing = [
-        r for r in range(world_size) if r != my_rank and r not in member_addrs
-    ]
+        member_addrs = fetch_member_addrs(gcs, group_name, world_size, ranks=member_ranks)
+    missing = [r for r in member_ranks if r != my_rank and r not in member_addrs]
     if missing:
         raise CollectiveError(
             f"tree reduce on group {group_name!r} needs a registered address "
@@ -1064,10 +1507,12 @@ def group_reduce_send(
         ReduceOp.MAX: np.maximum,
         ReduceOp.MEAN: np.add,  # summed at every hop; the root divides once
     }[op]
-    # Same deterministic shape as the broadcast tree, rooted at dst_rank.
-    order = [dst_rank] + sorted(r for r in range(world_size) if r != dst_rank)
+    # Same deterministic shape as the broadcast tree, rooted at dst_rank —
+    # a pure function of the (group, roster-epoch) pair, so every member's
+    # snapshot of the same epoch yields the same tree.
+    order = [dst_rank] + sorted(r for r in member_ranks if r != dst_rank)
     pos = order.index(my_rank)
-    kid_ranks = [order[c] for c in _binomial_children(pos, world_size)]
+    kid_ranks = [order[c] for c in _binomial_children(pos, len(order))]
     parent_client = None
     if pos:
         parent_rank = order[pos - (1 << (pos.bit_length() - 1))]
@@ -1123,7 +1568,7 @@ def group_reduce_send(
     out = np.concatenate(out_parts) if len(out_parts) > 1 else out_parts[0]
     out = np.array(out).reshape(arr.shape)
     if op is ReduceOp.MEAN:
-        out = out / world_size
+        out = out / len(order)
     return out
 
 
@@ -1140,29 +1585,34 @@ def group_allreduce(
     member_addrs: dict | None = None,
     timeout: float = 60.0,
     finalize=None,
+    roster: dict | None = None,
 ):
-    """Tree allreduce: reduce up to rank 0, then tree-broadcast the
-    combined result back down — every rank returns the same reduced value
-    after 2·depth hops instead of a K-wide ring epoch. ``finalize``
-    (optional) runs ON THE ROOT before the down-broadcast (e.g. a jnp
-    conversion), so output placement is decided once and every rank
-    receives the finalized payload — placement parity with ``broadcast``.
-    Raises CollectiveBroadcastError if the down-broadcast misses a rank
-    (an allreduce is all-or-nothing: a member without the result would
-    silently diverge)."""
+    """Tree allreduce: reduce up to the root (lowest roster rank; rank 0 in
+    a static world), then tree-broadcast the combined result back down —
+    every rank returns the same reduced value after 2·depth hops instead of
+    a K-wide ring epoch. ``finalize`` (optional) runs ON THE ROOT before
+    the down-broadcast (e.g. a jnp conversion), so output placement is
+    decided once and every rank receives the finalized payload — placement
+    parity with ``broadcast``. Raises CollectiveBroadcastError if the
+    down-broadcast misses a rank (an allreduce is all-or-nothing: a member
+    without the result would silently diverge). ``roster`` restricts the
+    whole op to the current epoch's member set."""
     from ray_tpu.exceptions import CollectiveBroadcastError
 
+    root = min(roster["ranks"]) if roster and roster["ranks"] else 0
     red = group_reduce_send(
         cw, gcs, group_name, my_rank, world_size, tag, value,
-        op=op, dst_rank=0, member_addrs=member_addrs, timeout=timeout,
+        op=op, dst_rank=root, member_addrs=member_addrs, timeout=timeout,
+        roster=roster,
     )
     COLL.allreduces += 1
     down_tag = f"allred/{tag}"
-    if my_rank == 0:
+    if my_rank == root:
         out = finalize(red) if finalize is not None else red
         res = group_bcast_send(
-            cw, gcs, group_name, 0, world_size, down_tag, out,
+            cw, gcs, group_name, root, world_size, down_tag, out,
             member_addrs=member_addrs, timeout=timeout, mailbox_fallback=False,
+            roster=roster,
         )
         if res["failed"]:
             raise CollectiveBroadcastError(
@@ -1171,4 +1621,4 @@ def group_allreduce(
                 group=group_name, failed=res["failed"], info=res,
             )
         return out
-    return group_bcast_recv(cw, gcs, group_name, 0, my_rank, down_tag, timeout)
+    return group_bcast_recv(cw, gcs, group_name, root, my_rank, down_tag, timeout)

@@ -460,20 +460,33 @@ class TpuCollectiveGroup:
     def bcast_send_payload(self, value, tag: str, timeout: float = 30.0,
                            mailbox_fallback: bool = True) -> dict:
         from ray_tpu._private import worker_context
-        from ray_tpu.util.collective.p2p import fetch_member_addrs, group_bcast_send
+        from ray_tpu.util.collective.p2p import (
+            fetch_member_addrs,
+            fetch_roster,
+            group_bcast_send,
+        )
 
         cw = worker_context.get_core_worker()
-        # Membership is static per group epoch: one address fetch serves
-        # every broadcast (same cache shape as CpuCollectiveGroup._addrs).
-        addrs = getattr(self, "_bcast_addrs", None)
-        if addrs is None:
-            addrs = self._bcast_addrs = fetch_member_addrs(
-                self._gcs, self.group_name, self.world_size
+        # The address cache is keyed on the ROSTER epoch (not the
+        # coordinator epoch): a member that re-registered at the same
+        # coordinator epoch — a respawn joining under its old rank — has a
+        # new address under the same row, and only a roster bump says so.
+        # Same cache shape as CpuCollectiveGroup._snapshot.
+        roster = fetch_roster(self._gcs, self.group_name)
+        repoch = roster["epoch"] if roster else 0
+        cached = getattr(self, "_bcast_addrs", None)
+        if cached is None or cached[0] != repoch:
+            ranks = roster["ranks"] if roster else None
+            world = max(self.world_size, roster["world_size"] if roster else 0)
+            cached = self._bcast_addrs = (
+                repoch,
+                fetch_member_addrs(self._gcs, self.group_name, world, ranks=ranks),
             )
+        world = max(self.world_size, roster["world_size"] if roster else 0)
         return group_bcast_send(
-            cw, self._gcs, self.group_name, self.rank, self.world_size, tag,
-            value, member_addrs=addrs, timeout=timeout,
-            mailbox_fallback=mailbox_fallback,
+            cw, self._gcs, self.group_name, self.rank, world, tag,
+            value, member_addrs=cached[1], timeout=timeout,
+            mailbox_fallback=mailbox_fallback, roster=roster,
         )
 
     def bcast_recv_payload(self, src_rank: int, tag: str, timeout: float = 120.0):
@@ -508,8 +521,12 @@ class TpuCollectiveGroup:
 
         self._op_cache.clear()
         if self._gcs is not None:
-            from ray_tpu.util.collective.p2p import unregister_member_addr
+            from ray_tpu.util.collective.p2p import roster_leave, unregister_member_addr
 
+            try:
+                roster_leave(self._gcs, self.group_name, self.rank)
+            except Exception:
+                pass
             unregister_member_addr(self._gcs, self.group_name, self.rank)
         if self.world_size > 1:
             try:
@@ -517,7 +534,18 @@ class TpuCollectiveGroup:
             except Exception as e:  # already down / never initialized
                 logger.debug("jax.distributed.shutdown: %s", e)
             if self.rank == 0 and self._gcs is not None:
+                # Sweep this epoch's coordinator row AND the dead-epoch
+                # rows behind it (every re-formation leaked its
+                # predecessor's coord/<e> before), plus the roster rows
+                # and orphaned addr rows — KV back to baseline.
+                from ray_tpu.util.collective.p2p import sweep_group_kv
+
+                for e in range(max(1, self.epoch - 16), self.epoch + 1):
+                    try:
+                        self._gcs.call("kv_del", {"key": f"collective/{self.group_name}/coord/{e}"})
+                    except Exception:
+                        pass
                 try:
-                    self._gcs.call("kv_del", {"key": f"collective/{self.group_name}/coord/{self.epoch}"})
+                    sweep_group_kv(self._gcs, self.group_name, self.world_size)
                 except Exception:
                     pass

@@ -1,0 +1,376 @@
+"""Elastic collective groups: epochal membership (ISSUE 17).
+
+- Roster unit cells: join/leave/re-register bump the roster epoch and the
+  member set converges (verify-and-retry join over the CAS-less KV).
+- Broadcast snapshots the roster at send time: a dead member is EVICTED
+  into the next epoch (one batch), later broadcasts address survivors
+  only, and a respawned member that re-registers at its old rank is back
+  on the fast path at its NEW address — the roster-epoch-keyed address
+  cache drops on the bump (the stale-cache satellite).
+- Destroy-vs-concurrent-verb race: a rank parked in bcast_recv_payload
+  while the group is destroyed surfaces a typed CollectiveError well
+  before its timeout (never hangs); verbs after destroy fail typed at
+  entry.
+- GCS hygiene: every collective KV row of a group (roster-epoch counter,
+  roster back-window, member address rows) is back to baseline after
+  teardown — the leak test satellite.
+- Chaos: membership-churn cell — seeded SIGKILL of a sampler
+  mid-broadcast, respawn, re-register, and the NEXT device-object
+  broadcast rides the group plane (bcast_recvs up, host_sync_fallbacks
+  flat on the replacement).
+
+Quick cells share one module-scoped cluster; the churn chaos cell builds
+its own 2-node Cluster because it pushes a seeded kill plan into a
+specific worker process.
+"""
+
+import gc
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import (
+    CollectiveBroadcastError,
+    CollectiveError,
+    RayTpuError,
+)
+
+
+@pytest.fixture(scope="module")
+def elastic_cluster():
+    ray_tpu.init(num_cpus=8, object_store_memory=256 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+def _gcs():
+    from ray_tpu._private import worker_context
+
+    return worker_context.get_core_worker().gcs
+
+
+@ray_tpu.remote
+class Member:
+    def pid(self):
+        return os.getpid()
+
+    def init_collective(self, world_size, rank, backend, group_name):
+        from ray_tpu.util import collective as col
+
+        col.init_collective_group(world_size, rank, backend=backend, group_name=group_name)
+        return rank
+
+    def bcast_recv(self, group_name, src_rank, tag, timeout=30.0):
+        from ray_tpu.util import collective as col
+
+        out = col.get_group(group_name).bcast_recv_payload(src_rank, tag, timeout=timeout)
+        return np.asarray(out).sum().item()
+
+    def consume(self, w):
+        return float(np.asarray(w).reshape(-1)[0]), int(np.asarray(w).size)
+
+    def coll_stats(self):
+        from ray_tpu.util.collective.p2p import COLL
+
+        return {k: getattr(COLL, k) for k in COLL.__slots__}
+
+    def destroy_group(self, group_name):
+        from ray_tpu.util import collective as col
+
+        col.destroy_collective_group(group_name)
+        return True
+
+    def destroy_race(self, group_name):
+        """Park in bcast_recv_payload on a tag nobody sends, destroy the
+        group from the actor main flow 0.5s later, and report how the wait
+        ended. The recv must abort TYPED well before its 60s window."""
+        import threading
+
+        from ray_tpu.util import collective as col
+
+        g = col.get_group(group_name)
+        out = {}
+
+        def _recv():
+            t0 = time.monotonic()
+            try:
+                g.bcast_recv_payload(0, "never-sent", timeout=60.0)
+                out["recv"] = "no-error"
+            except CollectiveError as e:
+                out["recv"] = f"typed:{type(e).__name__}:{e}"
+            except Exception as e:  # raw timeout/hang = the bug
+                out["recv"] = f"raw:{type(e).__name__}"
+            out["elapsed"] = time.monotonic() - t0
+
+        th = threading.Thread(target=_recv, daemon=True)
+        th.start()
+        time.sleep(0.5)
+        col.destroy_collective_group(group_name)
+        th.join(timeout=30)
+        out["joined"] = not th.is_alive()
+        try:
+            g.bcast_send_payload(np.zeros((4,), np.float32), "after-destroy")
+            out["send"] = "no-error"
+        except CollectiveError as e:
+            out["send"] = f"typed:{type(e).__name__}"
+        return out
+
+
+# ---------------------------------------------------------------------------
+# roster unit cells
+# ---------------------------------------------------------------------------
+
+
+def test_roster_join_leave_rejoin_epochs(elastic_cluster):
+    """join/leave/re-register each bump the roster epoch; the member set
+    converges; teardown sweeps every row."""
+    from ray_tpu.util.collective import p2p
+
+    gcs, group = _gcs(), "rg-unit"
+    try:
+        e1 = p2p.roster_join(gcs, group, 0, world_size=2)
+        assert e1 == 1
+        e2 = p2p.roster_join(gcs, group, 1, world_size=2)
+        assert e2 == 2
+        snap = p2p.fetch_roster(gcs, group)
+        assert snap == {"epoch": 2, "ranks": [0, 1], "world_size": 2}
+        e3 = p2p.roster_leave(gcs, group, 1)
+        assert e3 == 3
+        assert p2p.fetch_roster(gcs, group)["ranks"] == [0]
+        # Re-register at an already-listed rank still bumps the epoch:
+        # that bump is what drops every peer's address cache.
+        e4 = p2p.roster_join(gcs, group, 0, world_size=2)
+        assert e4 == 4
+        assert p2p.fetch_roster(gcs, group)["ranks"] == [0]
+        # Leaving a rank that is not listed is a no-op, not a bump.
+        assert p2p.roster_leave(gcs, group, 7) is None
+        assert p2p.fetch_roster_epoch(gcs, group) == 4
+    finally:
+        p2p.sweep_group_kv(gcs, group, world_size=2)
+    assert p2p.fetch_roster(gcs, group) is None
+    assert p2p.fetch_roster_epoch(gcs, group) == 0
+
+
+def test_group_kv_rows_return_to_baseline_after_destroy(elastic_cluster):
+    """The leak test: count the group's KV rows before, during, and after
+    a full create → broadcast → destroy cycle. After teardown the count is
+    back to the before-count (zero)."""
+    import jax.numpy as jnp
+
+    from ray_tpu.util import collective as col
+    from ray_tpu.util.collective import p2p
+
+    gcs, group = _gcs(), "kvbase2"
+    keys = (
+        [p2p.roster_epoch_key(group)]
+        + [p2p.roster_key(group, e) for e in range(1, 9)]
+        + [p2p.member_addr_key(group, r) for r in range(2)]
+    )
+
+    def count():
+        return sum(1 for k in keys if gcs.call("kv_get", {"key": k}).get("found"))
+
+    assert count() == 0
+    m = Member.remote()
+    col.init_collective_group(2, 0, backend="cpu", group_name=group)
+    ray_tpu.get(m.init_collective.remote(2, 1, "cpu", group), timeout=60)
+    pending = m.bcast_recv.remote(group, 0, "t1", 30.0)
+    info = col.get_group(group).bcast_send_payload(
+        jnp.ones((512,), jnp.float32), "t1", timeout=30
+    )
+    assert info["ok_ranks"] == [1], info
+    assert ray_tpu.get(pending, timeout=60) == 512.0
+    assert count() >= 3  # repoch + live roster row + addr rows
+    ray_tpu.get(m.destroy_group.remote(group), timeout=60)
+    col.destroy_collective_group(group)  # rank 0 last: sweeps to baseline
+    assert count() == 0
+
+
+# ---------------------------------------------------------------------------
+# elastic broadcast: eviction + re-register back onto the fast path
+# ---------------------------------------------------------------------------
+
+
+def test_broadcast_evicts_dead_rank_and_rejoiner_rides_fast_path(elastic_cluster):
+    """Kill rank 2 → the next broadcast evicts it into a new roster epoch
+    (one batch) and delivers to survivors; a fresh actor re-registering at
+    rank 2 lands at a NEW address under the same rank row, and the next
+    broadcast reaches it over the group plane — the roster-epoch-keyed
+    address cache dropped on the bump (stale-cache satellite)."""
+    import jax.numpy as jnp
+
+    from ray_tpu.util import collective as col
+    from ray_tpu.util.collective import p2p
+
+    gcs, group = _gcs(), "elastic3"
+    a, b = Member.remote(), Member.remote()
+    col.init_collective_group(3, 0, backend="cpu", group_name=group)
+    try:
+        ray_tpu.get([a.init_collective.remote(3, 1, "cpu", group),
+                     b.init_collective.remote(3, 2, "cpu", group)], timeout=60)
+        pid_b = ray_tpu.get(b.pid.remote(), timeout=60)
+        g = col.get_group(group)
+        payload = jnp.ones((256,), jnp.float32)
+        pend = [a.bcast_recv.remote(group, 0, "t1"), b.bcast_recv.remote(group, 0, "t1")]
+        info = g.bcast_send_payload(payload, "t1", timeout=30)
+        assert sorted(info["ok_ranks"]) == [1, 2], info
+        assert ray_tpu.get(pend, timeout=60) == [256.0, 256.0]
+        epoch_before = p2p.fetch_roster_epoch(gcs, group)
+
+        # kill() relays through the GCS — wait until the hosting process is
+        # actually GONE, or the broadcast below races a live inbox.
+        ray_tpu.kill(b)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            try:
+                os.kill(pid_b, 0)
+                time.sleep(0.1)
+            except ProcessLookupError:
+                break
+        else:
+            pytest.fail("victim worker process survived kill()")
+        pend = a.bcast_recv.remote(group, 0, "t2")
+        info = g.bcast_send_payload(payload, "t2", timeout=10)
+        assert info["ok_ranks"] == [1], info
+        assert 2 in info["failed"], info
+        assert info["evicted_ranks"] == [2], info
+        assert ray_tpu.get(pend, timeout=60) == 256.0
+        snap = p2p.fetch_roster(gcs, group)
+        assert snap["ranks"] == [0, 1], snap  # dead rank out, epoch advanced
+        assert snap["epoch"] > epoch_before
+
+        # Survivor-only broadcast: the dead rank is not even addressed.
+        pend = a.bcast_recv.remote(group, 0, "t3")
+        info = g.bcast_send_payload(payload, "t3", timeout=10)
+        assert info["ok_ranks"] == [1] and info["failed"] == {}, info
+        assert info["roster_epoch"] == snap["epoch"], info
+        assert ray_tpu.get(pend, timeout=60) == 256.0
+
+        # Respawn + re-register at the old rank: NEW address, same rank
+        # row — only the roster-epoch bump tells the sender to refetch.
+        c = Member.remote()
+        ray_tpu.get(c.init_collective.remote(3, 2, "cpu", group), timeout=60)
+        assert p2p.fetch_roster(gcs, group)["ranks"] == [0, 1, 2]
+        pend = [a.bcast_recv.remote(group, 0, "t4"), c.bcast_recv.remote(group, 0, "t4")]
+        info = g.bcast_send_payload(payload, "t4", timeout=30)
+        assert sorted(info["ok_ranks"]) == [1, 2], info  # rejoiner on fast path
+        assert info["failed"] == {}, info
+        assert ray_tpu.get(pend, timeout=60) == [256.0, 256.0]
+    finally:
+        col.destroy_collective_group(group)
+
+
+def test_destroy_racing_bcast_recv_raises_typed_never_hangs(elastic_cluster):
+    m = Member.remote()
+    ray_tpu.get(m.init_collective.remote(2, 1, "cpu", "race2"), timeout=60)
+    out = ray_tpu.get(m.destroy_race.remote("race2"), timeout=90)
+    assert out["joined"], out
+    assert out["recv"].startswith("typed:CollectiveError"), out
+    assert "destroyed" in out["recv"], out
+    assert out["elapsed"] < 30, out  # aborted, not timed out at 60s
+    assert out["send"] == "typed:CollectiveError", out
+
+
+# ---------------------------------------------------------------------------
+# chaos: membership churn — SIGKILL mid-broadcast, respawn, re-register
+# ---------------------------------------------------------------------------
+
+
+def test_membership_churn_sigkill_respawn_next_broadcast_fast_path():
+    """The churn cell: a seeded kill plan SIGKILLs the rank-2 sampler while
+    it answers the fan-out's p2p_ack (mid-broadcast). The broadcast names
+    the dead rank AND evicts it from the roster; a respawned sampler
+    re-registers at rank 2; the NEXT device-object broadcast covers the
+    whole fleet over the group plane — the replacement resolves from its
+    inbox (bcast_recvs up) with the host-sync fallback counter flat."""
+    from ray_tpu._private.rpc import EventLoopThread
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.experimental import device_object
+    from ray_tpu.util import collective as col
+    from ray_tpu.util.collective import p2p
+
+    cluster = Cluster()
+    try:
+        nodes = [
+            cluster.add_node(num_cpus=2, object_store_memory=96 * 1024 * 1024)
+            for _ in range(2)
+        ]
+        cluster.connect()
+        cluster.wait_for_nodes()
+        samplers = [Member.remote() for _ in range(3)]
+        group = "churn4"
+        col.init_collective_group(4, 0, backend="cpu", group_name=group)
+        ray_tpu.get(
+            [s.init_collective.remote(4, i + 1, "cpu", group) for i, s in enumerate(samplers)],
+            timeout=60,
+        )
+        pids = ray_tpu.get([s.pid.remote() for s in samplers], timeout=60)
+        victim_pid = pids[1]  # rank 2 dies mid-broadcast
+        plan = {
+            "rules": [
+                {"kind": "kill", "method": ["p2p_ack"], "side": "resp",
+                 "after": 0, "times": 1}
+            ]
+        }
+        io = EventLoopThread.get()
+        pushed = False
+        for n in nodes:
+            for w in n.workers.values():
+                if w.pid == victim_pid and w.client is not None:
+                    io.run(
+                        w.client.acall(
+                            "chaos_set_plan", {"plan": plan, "seed": 17},
+                            timeout=5, retries=0,
+                        ),
+                        timeout=6,
+                    )
+                    pushed = True
+        assert pushed, "victim worker not found for plan push"
+
+        import jax.numpy as jnp
+
+        ref = ray_tpu.put(
+            jnp.arange(65536.0, dtype=jnp.float32), tensor_transport="collective"
+        )
+        with pytest.raises(CollectiveBroadcastError) as ei:
+            device_object.broadcast(ref, group, timeout=30)
+        err = ei.value
+        assert list(err.failed) == [2], err.failed
+        assert isinstance(err, RayTpuError)
+        from ray_tpu._private import worker_context
+
+        gcs = worker_context.get_core_worker().gcs
+        snap = p2p.fetch_roster(gcs, group)
+        assert 2 not in snap["ranks"], snap  # evicted in one batch
+
+        # Respawn + re-register the dead rank, then broadcast AGAIN: the
+        # whole fleet — replacement included — is on the group plane.
+        replacement = Member.remote()
+        ray_tpu.get(replacement.init_collective.remote(4, 2, "cpu", group), timeout=60)
+        assert p2p.fetch_roster(gcs, group)["ranks"] == [0, 1, 2, 3]
+        ref2 = ray_tpu.put(
+            jnp.arange(32768.0, dtype=jnp.float32), tensor_transport="collective"
+        )
+        info = device_object.broadcast(ref2, group, timeout=30)
+        assert sorted(info["ok_ranks"]) == [1, 2, 3], info
+        assert info["failed"] == {}, info
+        fleet = [samplers[0], replacement, samplers[2]]
+        vals = ray_tpu.get([s.consume.remote(ref2) for s in fleet], timeout=60)
+        assert vals == [(0.0, 32768)] * 3
+        stats = ray_tpu.get(replacement.coll_stats.remote(), timeout=30)
+        assert stats["bcast_recvs"] >= 1, stats  # inbox, not pull
+        assert stats["host_sync_fallbacks"] == 0, stats  # fallback counter FLAT
+        del ref, ref2, err, ei
+        gc.collect()
+        from ray_tpu.experimental.device_object.manager import active_manager
+
+        deadline = time.monotonic() + 30
+        mgr = active_manager()
+        while mgr.usage()["resident_count"] > 0 and time.monotonic() < deadline:
+            time.sleep(0.2)
+        assert mgr.usage()["resident_count"] == 0
+    finally:
+        cluster.shutdown()

@@ -320,6 +320,53 @@ def test_microbench_collective_smoke(tmp_path):
     assert data["allreduce_k3_bit_exact"] == 1, data
 
 
+def test_microbench_resize_smoke(tmp_path):
+    """<90s --collective --resize --quick pass (ISSUE 17): IMPALA on the
+    device-broadcast plane through a scripted 2→4→2 sampler resize. The
+    suite's inline oracle is the real assertion — after the first
+    post-resize iteration every measured weight sync rides the broadcast
+    plane (fleet-wide host-sync fallback delta == 0 in every phase, which
+    a failed roster join/evict would break). Full-shape 8→16→8 evidence
+    lives in the committed RESIZEBENCH_r17.json."""
+    out = tmp_path / "resizebench.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", RAY_TPU_NUM_TPUS="0")
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "microbench.py"),
+            "--collective",
+            "--resize",
+            "--quick",
+            "--round",
+            "17",
+            "--out",
+            str(out),
+        ],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=360,
+    )
+    assert proc.returncode == 0, (
+        f"microbench --collective --resize failed (rc={proc.returncode})\n"
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    data = json.loads(out.read_text())
+    assert data["resize_schedule"] == [2, 4, 2], data
+    for phase, n in enumerate(data["resize_schedule"]):
+        assert data.get(f"resize_p{phase}_n{n}_iters_per_s", 0) > 0, data
+        # Plane syncs cover the whole fleet every measured iteration...
+        assert data[f"resize_p{phase}_n{n}_plane_syncs"] >= n * 2, data
+        # ...and ZERO host-sync fallbacks after the first post-resize iter.
+        assert data[f"resize_p{phase}_n{n}_host_fallbacks"] == 0, data
+    # Grow and shrink both happened and were timed.
+    assert data.get("resize_p1_to4_s", 0) > 0, data
+    assert data.get("resize_p2_to2_s", 0) > 0, data
+    # After the final shrink the roster is back to learner + 2 samplers.
+    assert data["resize_final_roster_ranks"] == [0, 1, 2], data
+
+
 @pytest.mark.slow
 def test_collective_k8_sweep(tmp_path):
     """Full-shape K in {2,4,8} sweep (slow): the broadcast arm must beat

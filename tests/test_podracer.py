@@ -1,4 +1,4 @@
-"""Podracer learner/sampler weight sync (ISSUE 15).
+"""Podracer learner/sampler weight sync (ISSUE 15) + elastic fleets (ISSUE 17).
 
 The RLlib seam: ``weight_sync="device_broadcast"`` packs the learner's
 params into ONE flat device-resident vector, forms a learner↔sampler
@@ -103,18 +103,67 @@ def test_impala_device_broadcast_topology(pod_cluster):
 
 
 def test_impala_device_broadcast_survives_dead_sampler(pod_cluster):
-    """Kill one sampler between iterations: the sync loop respawns it and
-    feeds it the SAME packed ref — the replacement is outside the static
-    group and transparently falls back to the pull path."""
+    """Kill one sampler between iterations: the sync loop respawns it, the
+    replacement RE-REGISTERS into the weight group at its old rank (roster
+    epoch bump), and the next broadcast covers it over the group plane —
+    the degradation is one pull at most, not permanent. The replacement's
+    own counters prove it: bcast_recvs climbs across the post-respawn
+    steps while host_sync_fallbacks stays ≤ 1 (only the sync that raced
+    the respawn may have pulled) and then stays FLAT."""
     cfg = _impala_config(weight_sync="device_broadcast")
     algo = cfg.build()
     try:
         algo.step()
         ray_tpu.kill(algo.workers._workers[0])
-        algo.sync_worker_weights()  # must respawn + deliver, not raise
+        algo.sync_worker_weights()  # must respawn + re-register + deliver
         assert algo.workers.num_workers == 2
-        m = algo.step()
+        m = algo.step()  # first post-respawn iteration: back on fast path
         assert np.isfinite(m["total_loss"])
+        base = algo.workers.coll_stats()[0]  # the replacement
+        assert base is not None and base["host_sync_fallbacks"] <= 1, base
+        algo.step()
+        after = algo.workers.coll_stats()[0]
+        assert after["bcast_recvs"] > base["bcast_recvs"], (base, after)
+        assert after["host_sync_fallbacks"] == base["host_sync_fallbacks"], (base, after)
+    finally:
+        algo.cleanup()
+
+
+def test_impala_resize_oracle_weight_sync_stays_on_fast_path(pod_cluster):
+    """The resize oracle: grow 2→4 and shrink 4→2 mid-IMPALA. Growing
+    joins the new samplers into the weight group at fresh tail ranks,
+    shrinking evicts the tail from the roster — no group teardown either
+    way — and after the first post-resize iteration every live sampler
+    resolves weight syncs from its broadcast inbox with the host-sync
+    fallback counter FLAT."""
+    cfg = _impala_config(weight_sync="device_broadcast")
+    algo = cfg.build()
+    try:
+        assert algo._device_sync_ready
+        algo.step()
+        assert algo.resize_workers(4) == 4
+        roster = algo.learner_group.weight_group_roster(algo._weight_group)
+        assert roster["ranks"] == [0, 1, 2, 3, 4], roster
+        m = algo.step()  # first post-grow iteration
+        assert np.isfinite(m["total_loss"])
+        base = algo.workers.coll_stats()
+        assert all(s is not None for s in base), base
+        algo.step()
+        after = algo.workers.coll_stats()
+        for b, a in zip(base, after):
+            assert a["bcast_recvs"] > b["bcast_recvs"], (base, after)
+            # ZERO fallbacks after the first post-resize iteration.
+            assert a["host_sync_fallbacks"] == b["host_sync_fallbacks"], (base, after)
+        assert algo.resize_workers(2) == 2
+        roster = algo.learner_group.weight_group_roster(algo._weight_group)
+        assert roster["ranks"] == [0, 1, 2], roster  # tail ranks evicted
+        m = algo.step()  # first post-shrink iteration
+        assert np.isfinite(m["total_loss"])
+        base = algo.workers.coll_stats()
+        algo.step()
+        after = algo.workers.coll_stats()
+        for b, a in zip(base, after):
+            assert a["host_sync_fallbacks"] == b["host_sync_fallbacks"], (base, after)
     finally:
         algo.cleanup()
 
