@@ -122,6 +122,9 @@ EVENT_TYPES = (
     "coll_reduce",     # 46: holder fed a device object into a group reduce/allreduce (detail oid:group:mode:rank:replaced)
     # Elastic collective groups (PR 17).
     "coll_member_change",  # 47: roster epoch advanced — join/rejoin/leave/death/advance (detail group:reason:rank:epoch:nmembers)
+    # Control-plane scale hardening (PR 19).
+    "locality_hit",    # 48: placement chose a node already holding the task's reference args (detail task:node)
+    "gcs_overload",    # 49: GCS task-event ring dropped oldest entries under fan-in (detail dropped:total)
 )
 _CODE = {name: i for i, name in enumerate(EVENT_TYPES)}
 

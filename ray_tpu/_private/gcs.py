@@ -27,6 +27,7 @@ mutation survives a GCS kill at any point after the reply.
 from __future__ import annotations
 
 import asyncio
+import collections
 import json
 import logging
 import os
@@ -58,10 +59,38 @@ class GcsServer:
         self.named_actors: dict[tuple[str, str], str] = {}  # (namespace, name) -> actor_id
         self.kv: dict[str, bytes] = {}
         self.object_locations: dict[str, set[str]] = {}
+        # Reverse index: node_id -> oids it holds. _on_node_death used to
+        # scan EVERY location row (O(objects) per death — a fan-in hot spot
+        # at 1k nodes); with the index a death touches only that node's rows.
+        self._locations_by_node: dict[str, set[str]] = {}
         self.placement_groups: dict[str, dict] = {}
         self.jobs: dict[str, dict] = {}
-        self.task_events: list[dict] = []
+        # Drop-oldest ring: event fan-in at sim scale must degrade
+        # observability (oldest history), never liveness or memory.
+        self.task_events: collections.deque = collections.deque(
+            maxlen=max(1, self.cfg.task_events_buffer_size)
+        )
+        self.events_dropped_total = 0
+        self._overload_flight_ts = 0.0
         self._job_counter = 0
+        # Versioned cluster-view sync (delta heartbeats). Every MATERIAL
+        # node-row change (register, death, drain, changed availability)
+        # bumps _view_version and stamps the row; a heartbeat carrying the
+        # client's last seen version gets only rows newer than it plus
+        # removal tombstones. Idle heartbeats don't bump anything, so the
+        # steady-state reply is empty — per-interval bytes go from O(N) per
+        # raylet (O(N^2) cluster-wide) to O(changes).
+        self._view_version = 0
+        self._view_removals: collections.deque = collections.deque()
+        # Clients whose version predates pruned tombstones get a full-view
+        # resync (also covers a GCS restart: versions restart at 0, so a
+        # client arriving "from the future" falls back to full view).
+        self._removals_floor = 0
+        # Heartbeat reply accounting for the scale bench (rows/bytes per
+        # reply). Payload measurement costs one msgpack encode per reply, so
+        # it is off unless the sim harness turns it on.
+        self.hb_account = False
+        self.hb_stats = {"replies": 0, "rows": 0, "full_replies": 0, "view_bytes": 0}
         # Bumped by mutating handlers; the persist loop skips unchanged state.
         self._mutations = 0
         self._subscribers: dict[str, list] = {}  # channel -> [writer]
@@ -129,6 +158,7 @@ class GcsServer:
             "last_heartbeat": time.monotonic(),
             "store_usage": {},
         }
+        self._bump_view(node_id)
         await self._publish("node_updates", {"node_id": node_id, "state": "ALIVE"})
         # New capacity may make parked placement groups feasible.
         asyncio.ensure_future(self._retry_pending_pgs())
@@ -145,30 +175,97 @@ class GcsServer:
         if node["state"] == "DEAD":
             return {"ok": False, "dead": True}
         node["last_heartbeat"] = time.monotonic()
-        node["resources_available"] = req.get("resources_available", node["resources_available"])
+        avail = req.get("resources_available")
+        if avail is not None and avail != node["resources_available"]:
+            # Material change: peers mirror availability into their local
+            # sched_core ledgers, so it must flow. Idle heartbeats (same
+            # availability) stamp nothing — the delta reply stays empty.
+            node["resources_available"] = avail
+            self._bump_view(req["node_id"])
         node["store_usage"] = req.get("store_usage", node["store_usage"])
         node["load"] = req.get("load", [])
         node["num_active_workers"] = req.get("num_active_workers", 0)
         # Return the cluster resource view: this doubles as the resource
         # syncer (reference: src/ray/common/ray_syncer/ray_syncer.h:86).
+        resp = {"ok": True, "tracing": bool(self.kv.get("tracing:enabled"))}
+        client_ver = req.get("view_version")
+        if client_ver is None:
+            # Legacy client: full view every interval (O(N) per reply).
+            resp["nodes"] = self._cluster_view()
+            self._account_hb(resp["nodes"], full=True)
+            return resp
+        if (
+            client_ver == 0
+            or client_ver > self._view_version
+            or client_ver < self._removals_floor
+        ):
+            # First contact, a GCS restart (client from the future), or the
+            # client missed so many generations its tombstones were pruned:
+            # full-view resync.
+            resp["view"] = self._cluster_view()
+            resp["view_removed"] = []
+            resp["view_full"] = True
+            self._account_hb(resp["view"], full=True)
+        else:
+            resp["view"] = {
+                nid: self._view_row(n)
+                for nid, n in self.nodes.items()
+                if n["state"] == "ALIVE" and n.get("view_ver", 0) > client_ver
+            }
+            resp["view_removed"] = [
+                nid for ver, nid in self._view_removals if ver > client_ver
+            ]
+            resp["view_full"] = False
+            self._account_hb(resp["view"], full=False)
+        resp["view_version"] = self._view_version
+        return resp
+
+    def _view_row(self, n: dict) -> dict:
         return {
-            "ok": True,
-            "nodes": self._cluster_view(),
-            "tracing": bool(self.kv.get("tracing:enabled")),
+            "address": n["address"],
+            "resources_total": n["resources_total"],
+            "resources_available": n["resources_available"],
+            "labels": n["labels"],
+            "state": n["state"],
         }
 
     def _cluster_view(self):
         return {
-            nid: {
-                "address": n["address"],
-                "resources_total": n["resources_total"],
-                "resources_available": n["resources_available"],
-                "labels": n["labels"],
-                "state": n["state"],
-            }
+            nid: self._view_row(n)
             for nid, n in self.nodes.items()
             if n["state"] == "ALIVE"
         }
+
+    def _bump_view(self, node_id: str, removed: bool = False):
+        """Stamp one node-row change into the versioned view. ``removed``
+        appends a tombstone (death/drain — the row leaves the ALIVE view);
+        tombstone history is bounded, with the pruned floor forcing lagging
+        clients onto the full-resync path."""
+        self._view_version += 1
+        if removed:
+            self._view_removals.append((self._view_version, node_id))
+            while len(self._view_removals) > 1024:
+                pruned_ver, _ = self._view_removals.popleft()
+                self._removals_floor = pruned_ver
+        else:
+            node = self.nodes.get(node_id)
+            if node is not None:
+                node["view_ver"] = self._view_version
+
+    def _account_hb(self, rows: dict, full: bool):
+        self.hb_stats["replies"] += 1
+        self.hb_stats["rows"] += len(rows)
+        if full:
+            self.hb_stats["full_replies"] += 1
+        if self.hb_account and rows:
+            import msgpack
+
+            try:
+                self.hb_stats["view_bytes"] += len(
+                    msgpack.packb(rows, use_bin_type=True)
+                )
+            except Exception:
+                pass
 
     async def rpc_get_nodes(self, req):
         return {"nodes": self.nodes}
@@ -187,6 +284,8 @@ class GcsServer:
         node = self.nodes.get(req["node_id"])
         if node is not None:
             node["state"] = "DRAINING"
+            # Leaves the ALIVE view: delta clients must see the removal.
+            self._bump_view(req["node_id"], removed=True)
         return {"ok": True}
 
     async def _health_check_loop(self):
@@ -206,11 +305,24 @@ class GcsServer:
             return
         node["state"] = "DEAD"
         logger.warning("GCS: node %s declared dead", node_id[:8])
-        # Drop its object copies from the directory.
-        for oid, locs in list(self.object_locations.items()):
-            locs.discard(node_id)
-            if not locs:
-                del self.object_locations[oid]
+        self._bump_view(node_id, removed=True)
+        # Drop its object copies from the directory — via the per-node
+        # reverse index: O(rows on the dead node), not O(all rows). The
+        # legacy full scan is kept behind the config toggle as the measured
+        # baseline arm for the scale bench.
+        if self.cfg.gcs_location_index:
+            for oid in self._locations_by_node.pop(node_id, set()):
+                locs = self.object_locations.get(oid)
+                if locs is not None:
+                    locs.discard(node_id)
+                    if not locs:
+                        del self.object_locations[oid]
+        else:
+            self._locations_by_node.pop(node_id, None)
+            for oid, locs in list(self.object_locations.items()):
+                locs.discard(node_id)
+                if not locs:
+                    del self.object_locations[oid]
         # Restart or kill its actors.
         for actor_id, info in list(self.actors.items()):
             if info.get("node_id") == node_id and info["state"] in (ALIVE, PENDING_CREATION):
@@ -517,6 +629,7 @@ class GcsServer:
     @schema(object_id=str, node_id=str)
     async def rpc_add_object_location(self, req):
         self.object_locations.setdefault(req["object_id"], set()).add(req["node_id"])
+        self._locations_by_node.setdefault(req["node_id"], set()).add(req["object_id"])
         return {"ok": True}
 
     @schema(object_id=str, node_id=str)
@@ -526,6 +639,11 @@ class GcsServer:
             locs.discard(req["node_id"])
             if not locs:
                 del self.object_locations[req["object_id"]]
+        by_node = self._locations_by_node.get(req["node_id"])
+        if by_node:
+            by_node.discard(req["object_id"])
+            if not by_node:
+                del self._locations_by_node[req["node_id"]]
         return {"ok": True}
 
     @schema(object_id=str)
@@ -742,14 +860,33 @@ class GcsServer:
 
     @schema(events=list)
     async def rpc_record_task_events(self, req):
-        self.task_events.extend(req["events"])
-        overflow = len(self.task_events) - self.cfg.task_events_buffer_size
+        events = req["events"]
+        ring = self.task_events
+        overflow = len(ring) + len(events) - ring.maxlen
+        ring.extend(events)  # deque(maxlen=...) drops oldest — never blocks
         if overflow > 0:
-            del self.task_events[:overflow]
-        return {"ok": True}
+            self.events_dropped_total += overflow
+            from ray_tpu._private import flight_recorder, self_metrics
+
+            try:
+                self_metrics.instruments()["gcs_events_dropped"].inc(overflow)
+            except Exception:
+                pass
+            now = time.monotonic()
+            if now - self._overload_flight_ts >= 5.0:
+                # Rate-limited: the overload condition is per-burst news,
+                # per-batch stamps would themselves flood the flight ring.
+                self._overload_flight_ts = now
+                flight_recorder.record(
+                    "gcs_overload",
+                    f"task_events dropped={self.events_dropped_total}",
+                )
+        return {"ok": True, "dropped": max(0, overflow)}
 
     async def rpc_get_task_events(self, req):
-        return {"events": self.task_events[-req.get("limit", 1000):]}
+        limit = req.get("limit", 1000)
+        events = list(self.task_events)
+        return {"events": events[-limit:]}
 
     # ------------------------------------------------------------------
     # Pub/sub (reference: src/ray/pubsub/publisher.h:307)

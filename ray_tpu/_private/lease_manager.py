@@ -287,10 +287,21 @@ class LeaseManager:
     async def _request_lease(self, shape: _Shape):
         lease_id = os.urandom(12).hex()
         shape.pending_requests.add(lease_id)
+        # Locality hint: the head-of-queue task's REFERENCE args ride the
+        # lease request (oid + owner only — never inline bytes), so the
+        # raylet can prefer a holder node when placing the lease
+        # (raylet._locality_prefs; the lease is what spills back).
+        head = shape.queue[0] if shape.queue else None
+        ref_args = (
+            [a for a in head.args if isinstance(a, (list, tuple)) and a and a[0] == "r"]
+            if head is not None
+            else []
+        )
         rep = TaskSpec(
             task_id=lease_id,
             job_id=self.cw.job_id.hex(),
             name="__lease__",
+            args=ref_args[: self.cfg.locality_max_args],
             resources=dict(shape.resources),
             runtime_env=dict(shape.runtime_env),
             owner_addr=list(self.cw.address),

@@ -67,6 +67,170 @@ def _binomial_split(targets: list) -> list[tuple[dict, list]]:
     return splits
 
 
+def rejoin_backoff_delay(attempt: int, cfg, rng) -> float:
+    """Jittered exponential backoff before a re-register: full jitter over
+    [0, min(max, base * 2^attempt)] — a GCS restart or mass partition-heal
+    otherwise makes every raylet re-register in the same heartbeat interval
+    (thundering herd on the register/republish fan-in)."""
+    ceiling = min(cfg.rejoin_backoff_max_s, cfg.rejoin_backoff_base_s * (2 ** attempt))
+    return rng.uniform(0, ceiling)
+
+
+class OptimisticDebitLedger:
+    """Self-healing bookkeeping for forward-time mirror debits.
+
+    Spilling a task to a peer debits the peer's MIRRORED availability
+    immediately, so a burst of picks spreads over fits-now peers instead of
+    dogpiling the first one. Under the legacy full-view heartbeat the debit
+    was provisional by construction — every reply overwrote the whole
+    mirror. Delta sync ships only CHANGED rows, which opens a leak: when the
+    peer acquires and releases entirely between its own heartbeats, its GCS
+    row never changes, no delta ever arrives, and the debit sticks forever —
+    the forwarder permanently under-estimates that peer (and locality
+    preference starts refusing a perfectly idle holder).
+
+    So every debit carries a deadline (a couple of heartbeat intervals): an
+    authoritative row for the node clears its debits (the upsert already
+    overwrote the mirror), and a debit that outlives its deadline is
+    credited back. sched_core.release clamps at capacity and ignores
+    unknown nodes, so a late credit after a real delta or a tombstone is
+    harmless."""
+
+    def __init__(self):
+        self._pending: list[tuple[float, str, dict]] = []
+
+    def note(self, node_id: str, resources: dict, interval_s: float):
+        self._pending.append(
+            (time.monotonic() + 2.5 * max(interval_s, 0.05), node_id, dict(resources))
+        )
+
+    def on_authoritative_rows(self, node_ids) -> None:
+        """Rows in a heartbeat reply (changed or tombstoned) supersede any
+        pending debit for those nodes."""
+        if self._pending and node_ids:
+            ids = set(node_ids)
+            self._pending = [p for p in self._pending if p[1] not in ids]
+
+    def expire(self, sched) -> None:
+        """Credit back debits never confirmed by an authoritative row."""
+        if not self._pending:
+            return
+        now = time.monotonic()
+        due = [p for p in self._pending if p[0] <= now]
+        if due:
+            self._pending = [p for p in self._pending if p[0] > now]
+            for _, nid, res in due:
+                sched.release(nid, res)
+
+
+def apply_heartbeat_view(resp: dict, node) -> None:
+    """Fold a heartbeat reply's cluster view into ``node`` (a Raylet or a
+    SimNode shell: anything with ``cluster_view``/``_view_version``/
+    ``_sched``/``node_id``/``_synced_peers``).
+
+    Three reply shapes: legacy full view under ``"nodes"``, delta-sync full
+    resync (``view_full``), and a delta (changed rows + removal tombstones).
+    Peers are mirrored into the local sched_core ledger — NEVER self: the
+    local ledger is authoritative, and a stale heartbeat echo (a delta row
+    for this node carrying pre-acquire availability) must not clobber
+    in-flight acquires."""
+    if "view" in resp:
+        node._view_version = resp.get("view_version", 0)
+        removed = resp.get("view_removed", ())
+        if resp.get("view_full"):
+            node.cluster_view = dict(resp["view"])
+        else:
+            for nid in removed:
+                node.cluster_view.pop(nid, None)
+            node.cluster_view.update(resp["view"])
+        changed = resp["view"]
+    elif "nodes" in resp:
+        node.cluster_view = resp.get("nodes", {})
+        changed = node.cluster_view
+        removed = ()
+    else:
+        return
+    for nid in changed:
+        if nid == node.node_id:
+            continue
+        row = node.cluster_view.get(nid)
+        if row is not None:
+            node._sched.node_upsert(
+                nid,
+                row.get("resources_total", {}),
+                row.get("resources_available", {}),
+            )
+    gone = node._synced_peers - set(node.cluster_view)
+    for nid in gone:
+        if nid != node.node_id:
+            node._sched.node_remove(nid)
+    node._synced_peers = set(node.cluster_view)
+    debits = getattr(node, "_opt_debits", None)
+    if debits is not None:
+        debits.on_authoritative_rows(set(changed) | set(removed) | gone)
+
+
+class ArgLocalityCache:
+    """oid -> holder node ids for locality-aware placement, bounded + TTL.
+
+    Reference args (``("r", oid, owner)``) are by construction plasma-sized
+    — anything under ``max_direct_call_object_size`` ships inline — so the
+    inline/reference split IS the large-arg threshold the Ray paper's
+    data-locality policy keys on. Shared by Raylet and SimNode shells."""
+
+    _MAX_ENTRIES = 4096
+
+    def __init__(self, gcs: RpcClient, cfg):
+        self.gcs = gcs
+        self.cfg = cfg
+        self._cache: dict[str, tuple[float, tuple]] = {}
+
+    async def holders(self, spec: TaskSpec) -> dict[str, int]:
+        """node_id -> how many of the task's reference args it holds."""
+        oids = [
+            a[1]
+            for a in spec.args
+            if isinstance(a, (list, tuple)) and len(a) >= 2 and a[0] == "r"
+        ][: self.cfg.locality_max_args]
+        if not oids:
+            return {}
+        now = time.monotonic()
+        counts: dict[str, int] = {}
+        missing = []
+        for oid in oids:
+            hit = self._cache.get(oid)
+            if hit is not None and now - hit[0] < self.cfg.locality_cache_ttl_s:
+                for nid in hit[1]:
+                    counts[nid] = counts.get(nid, 0) + 1
+            else:
+                missing.append(oid)
+        if missing:
+            results = await asyncio.gather(
+                *[
+                    self.gcs.acall(
+                        "get_object_locations",
+                        {"object_id": oid},
+                        timeout=2,
+                        retries=0,
+                    )
+                    for oid in missing
+                ],
+                return_exceptions=True,
+            )
+            if len(self._cache) >= self._MAX_ENTRIES:
+                # Bounded: evict the oldest-inserted half wholesale.
+                for k in list(self._cache)[: self._MAX_ENTRIES // 2]:
+                    self._cache.pop(k, None)
+            for oid, resp in zip(missing, results):
+                if isinstance(resp, BaseException):
+                    continue  # lookup failure: schedule without this arg's hint
+                nids = tuple(loc["node_id"] for loc in resp.get("locations", []))
+                self._cache[oid] = (now, nids)
+                for nid in nids:
+                    counts[nid] = counts.get(nid, 0) + 1
+        return counts
+
+
 def _runtime_env_hash(runtime_env: dict | None) -> str | None:
     """Canonical hash for worker<->task runtime-env matching."""
     if not runtime_env:
@@ -189,8 +353,17 @@ class Raylet:
         self._cancelled_tasks = BoundedIdSet()
         self._last_progress = time.monotonic()
         self.cluster_view: dict = {}
+        # Last cluster-view generation applied (delta heartbeat sync); 0
+        # forces a full view on the first heartbeat.
+        self._view_version = 0
         self._synced_peers: set[str] = set()
         self._peer_clients: dict[str, RpcClient] = {}
+        # Rejoin thundering-herd damping: per-node seeded jitter so a fleet
+        # rediscovering a restarted GCS staggers deterministically.
+        import random
+
+        self._rejoin_rng = random.Random(self.node_id)
+        self._rejoin_attempts = 0
         self._inbound_pushes: dict[str, dict] = {}  # object_id -> push session
         # Commit outcomes, remembered briefly (see rpc_push_commit): a
         # sender retrying a timed-out/blipped commit must observe the REAL
@@ -221,6 +394,10 @@ class Raylet:
 
         self.gcs = RpcClient(tuple(gcs_address) if isinstance(gcs_address, (list, tuple)) else gcs_address, label="gcs")
         self.gcs.chaos_scope = self._addr_key
+        # Locality-aware scheduling: bounded TTL cache of oid -> holder node
+        # ids (one GCS location lookup per arg per TTL window).
+        self._arg_locality = ArgLocalityCache(self.gcs, self.cfg)
+        self._opt_debits = OptimisticDebitLedger()
         self._io = EventLoopThread.get()
         self._io.run(self._register())
         self._hb_task = self._io.spawn(self._heartbeat_loop())
@@ -274,24 +451,28 @@ class Raylet:
     async def _heartbeat_loop(self):
         while True:
             try:
-                resp = await self.gcs.acall(
-                    "heartbeat",
-                    {
-                        "node_id": self.node_id,
-                        "resources_available": self.resources_available,
-                        "store_usage": self._update_store_gauges(),
-                        # Resource demand by shape (reference: resource load
-                        # reporting in ray_syncer / autoscaler demand input).
-                        "load": self._pending_load(),
-                        # Occupancy: actors may hold zero resources, so the
-                        # autoscaler must not treat resource-idle as idle.
-                        "num_active_workers": sum(
-                            1
-                            for w in self.workers.values()
-                            if w.state in ("busy", "actor")
-                        ),
-                    },
-                )
+                hb = {
+                    "node_id": self.node_id,
+                    "resources_available": self.resources_available,
+                    "store_usage": self._update_store_gauges(),
+                    # Resource demand by shape (reference: resource load
+                    # reporting in ray_syncer / autoscaler demand input).
+                    "load": self._pending_load(),
+                    # Occupancy: actors may hold zero resources, so the
+                    # autoscaler must not treat resource-idle as idle.
+                    "num_active_workers": sum(
+                        1
+                        for w in self.workers.values()
+                        if w.state in ("busy", "actor")
+                    ),
+                }
+                if self.cfg.heartbeat_delta_sync:
+                    # Versioned delta sync: carry the last view generation
+                    # seen; the reply holds only newer rows + tombstones
+                    # (full view only on resync) instead of the O(N) full
+                    # view every interval.
+                    hb["view_version"] = self._view_version
+                resp = await self.gcs.acall("heartbeat", hb)
                 if resp.get("dead"):
                     if self._exit_on_dead:
                         logger.error("raylet %s: GCS declared us dead; exiting", self.node_id[:8])
@@ -314,21 +495,9 @@ class Raylet:
                     logger.warning("raylet %s: GCS restarted; re-registering", self.node_id[:8])
                     await self._rejoin()
                     continue
-                self.cluster_view = resp.get("nodes", {})
-                # Mirror peers into the scheduler core (never self — the
-                # local ledger is authoritative, a stale heartbeat echo
-                # would clobber in-flight acquires).
-                for nid, node in self.cluster_view.items():
-                    if nid != self.node_id:
-                        self._sched.node_upsert(
-                            nid,
-                            node.get("resources_total", {}),
-                            node.get("resources_available", {}),
-                        )
-                for nid in self._synced_peers - set(self.cluster_view):
-                    if nid != self.node_id:
-                        self._sched.node_remove(nid)
-                self._synced_peers = set(self.cluster_view)
+                apply_heartbeat_view(resp, self)
+                self._opt_debits.expire(self._sched)
+                self._rejoin_attempts = 0  # healthy contact resets backoff
                 self._tracing_enabled = bool(resp.get("tracing"))
                 self._requeue_infeasible()  # cluster view refreshed
                 await self._retry_pg_tasks()
@@ -340,7 +509,15 @@ class Raylet:
 
     async def _rejoin(self):
         """Re-register with the GCS (restart recovery and post-partition
-        rejoin share this) and republish every sealed object's location."""
+        rejoin share this) and republish every sealed object's location.
+        Backs off with full jitter first: every raylet discovers a GCS
+        restart in the SAME heartbeat interval, and an unstaggered storm of
+        register + location-republish RPCs is exactly the fan-in spike a
+        freshly restarted GCS cannot afford."""
+        delay = rejoin_backoff_delay(self._rejoin_attempts, self.cfg, self._rejoin_rng)
+        self._rejoin_attempts += 1
+        if delay > 0:
+            await asyncio.sleep(delay)
         await self._register()
         for oid in self.store.object_ids():
             try:
@@ -1046,11 +1223,20 @@ class Raylet:
             if dispatch:
                 await self._dispatch()
             return
-        target = self._pick_node(spec)
+        target = self._pick_node(spec, prefer=await self._locality_prefs(spec))
         if target is not None and target != self.node_id:
             # Spillback (reference: cluster_task_manager.cc:44 + spillback reply).
             node = self.cluster_view.get(target)
             if node is not None:
+                # Optimistically debit the peer's MIRRORED availability: a
+                # burst of picks would otherwise all score the same stale
+                # fits-now peer and dogpile it. The debit is provisional —
+                # an authoritative heartbeat row overwrites it, and the
+                # debit ledger credits it back if none ever arrives.
+                if self._sched.try_acquire(target, spec.resources):
+                    self._opt_debits.note(
+                        target, spec.resources, self.cfg.heartbeat_interval_s
+                    )
                 self._forwarding.add(spec.task_id)
                 try:
                     await self._peer(target, node["address"]).acall("submit_task", {"spec": spec.to_wire()})
@@ -1114,9 +1300,12 @@ class Raylet:
             self._sched.release(self.node_id, spec.resources)
         self._requeue_infeasible()
 
-    def _pick_node(self, spec: TaskSpec) -> str | None:
+    def _pick_node(self, spec: TaskSpec, prefer: list | None = None) -> str | None:
         """Cluster-level placement: hybrid pack-then-spread policy
-        (reference: policy/hybrid_scheduling_policy.h:50)."""
+        (reference: policy/hybrid_scheduling_policy.h:50), with an optional
+        locality preference list (holder nodes of the task's reference args,
+        best-first) tried ahead of the policy — spilling to the policy's
+        least-loaded choice when every holder is saturated."""
         strategy = spec.scheduling_strategy or "DEFAULT"
         if spec.placement_group_id:
             return self.node_id if self._has_pool(spec) else self._pg_bundle_node(spec)
@@ -1129,6 +1318,17 @@ class Raylet:
             return self.node_id if soft else None
         from ray_tpu._private.sched_core import HYBRID, SPREAD
 
+        if prefer:
+            for nid in prefer:
+                if nid == self.node_id:
+                    if self._fits_now(spec):
+                        self._note_locality_hit(spec, nid)
+                        return nid
+                elif nid in self.cluster_view and self._sched.node_fits(
+                    nid, spec.resources
+                ):
+                    self._note_locality_hit(spec, nid)
+                    return nid
         # Both policies score over the core's cluster view (local ledger is
         # live; peers mirrored from heartbeats). Hybrid = pack the local node
         # while it fits now, spill to a fits-now peer, else queue wherever
@@ -1136,6 +1336,28 @@ class Raylet:
         # reference policy/hybrid_scheduling_policy.h:50.
         policy = SPREAD if strategy == "SPREAD" else HYBRID
         return self._sched.best_node(spec.resources, policy, self.node_id)
+
+    def _note_locality_hit(self, spec: TaskSpec, nid: str):
+        flight_recorder.record("locality_hit", f"{spec.task_id[:8]}->{nid[:8]}")
+        try:
+            self._metrics["locality_hits"].inc()
+        except Exception:
+            pass
+
+    async def _locality_prefs(self, spec: TaskSpec) -> list | None:
+        """Holder nodes of the task's reference args, most-args-held first;
+        None when locality doesn't apply (disabled, constrained strategy,
+        single-node view, or no reference args)."""
+        if not self.cfg.locality_aware_scheduling or spec.placement_group_id:
+            return None
+        if (spec.scheduling_strategy or "DEFAULT") != "DEFAULT":
+            return None
+        if len(self.cluster_view) <= 1:
+            return None
+        counts = await self._arg_locality.holders(spec)
+        if not counts:
+            return None
+        return sorted(counts, key=lambda n: -counts[n])
 
     def _pg_bundle_node(self, spec: TaskSpec) -> str | None:
         # Bundle lives on another node; ask GCS which.
@@ -1320,8 +1542,10 @@ class Raylet:
         # Cluster-level placement for the lease itself (reference: the lease
         # request is what spills back, cluster_task_manager.cc:44): forward
         # the whole request — the granted worker address is globally
-        # routable, so the owner talks straight to the remote worker.
-        target = self._pick_node(spec)
+        # routable, so the owner talks straight to the remote worker. The
+        # lease spec carries the first task's args, so locality preference
+        # applies here too (the default transport).
+        target = self._pick_node(spec, prefer=await self._locality_prefs(spec))
         if target is not None and target != self.node_id:
             node = self.cluster_view.get(target)
             if node is not None:

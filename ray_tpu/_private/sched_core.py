@@ -159,6 +159,15 @@ class _NativeSchedCore:
             self._interned[name] = j
         return float(self._lib.sc_node_avail(self._h, node_id.encode(), j))
 
+    def node_fits(self, node_id: str, demand: dict) -> bool:
+        """Non-mutating fits-now check against a (possibly mirrored) node's
+        availability — the locality-preference probe. Built over per-key
+        node_avail so the native ABI stays unchanged."""
+        return all(
+            v <= 0 or self.node_avail(node_id, k) >= v - 1e-9
+            for k, v in demand.items()
+        )
+
     def pool_avail(self, pool_key: str, name: str) -> float:
         j = self._interned.get(name)
         if j is None:
@@ -269,6 +278,12 @@ class _PySchedCore:
     def node_avail(self, node_id, name) -> float:
         node = self._nodes.get(node_id)
         return node[1].get(name, 0) / _SCALE if node else 0.0
+
+    def node_fits(self, node_id, demand) -> bool:
+        node = self._nodes.get(node_id)
+        if node is None:
+            return not any(v > 0 for v in demand.values())
+        return self._fits(node[1], self._to_fp(demand))
 
     def pool_avail(self, pool_key, name) -> float:
         pool = self._pools.get(pool_key)

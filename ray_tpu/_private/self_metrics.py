@@ -378,6 +378,18 @@ def instruments() -> dict:
             "actor_restarts": m.Counter(
                 "ray_tpu_actor_restarts_total", "Actor restarts driven by the GCS."
             ),
+            # --- GCS fan-in hardening (gcs.py) ---
+            "gcs_events_dropped": m.Counter(
+                "ray_tpu_gcs_events_dropped_total",
+                "Task events dropped (oldest-first) by the GCS ingest ring "
+                "under overload — observability degrades, liveness never "
+                "does (paired with the gcs_overload flight event).",
+            ),
+            "locality_hits": m.Counter(
+                "ray_tpu_sched_locality_hits_total",
+                "Tasks placed on a node already holding their reference "
+                "args (locality-aware scheduling fast path).",
+            ),
             # --- chaos fault-injection plane (chaos.py) ---
             "chaos_injected": m.Counter(
                 "ray_tpu_chaos_injected_total",

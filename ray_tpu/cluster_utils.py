@@ -251,6 +251,23 @@ class Cluster:
             except Exception:
                 pass
 
+    def restart_gcs(self) -> GcsServer:
+        """Stop the GCS and bring a fresh one up on the SAME address (no
+        persistence: the node table is gone). Every raylet's next heartbeat
+        returns ``unknown`` and it re-registers with jittered backoff,
+        republishing its object locations — the rejoin-storm path."""
+        host, port = self.gcs.address
+        self.gcs.stop()
+        deadline = time.monotonic() + 10
+        while True:
+            try:
+                self.gcs = GcsServer(host, port)
+                return self.gcs
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.1)
+
     def wait_for_nodes(self, timeout: float = 10.0):
         deadline = time.monotonic() + timeout
         want = len(self.nodes)

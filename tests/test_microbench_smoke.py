@@ -418,6 +418,74 @@ def test_collective_k8_sweep(tmp_path):
     ), data
 
 
+def test_microbench_sim_smoke(tmp_path):
+    """--sim --quick pass (ISSUE 19): the control-plane scale harness boots
+    64/128-shell sim clusters in both heartbeat arms and produces the full
+    evidence shape — delta arm with ZERO steady-state view rows vs the
+    legacy full-view arm's per-node byte tax, node-death index vs scan,
+    locality arms with 100% holder hits and a no-locality baseline, the
+    bounded task-event ring with an exact dropped count, and a passing SLO
+    scorecard. Scale certification (512/1000 shells, sub-quadratic curve)
+    lives in the committed SIMBENCH_r19.json — the quick arms only prove
+    the machinery."""
+    out = tmp_path / "simbench.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", RAY_TPU_NUM_TPUS="0")
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "microbench.py"),
+            "--sim",
+            "--quick",
+            "--round",
+            "19",
+            "--out",
+            str(out),
+        ],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=360,
+    )
+    assert proc.returncode == 0, (
+        f"microbench --sim failed (rc={proc.returncode})\n"
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    data = json.loads(out.read_text())
+    sweep = data["sim_sweep"]
+    for arm in ("n64_delta", "n64_legacy", "n128_delta", "n128_legacy"):
+        assert sweep[arm]["tasks_per_s"] > 0, sweep
+        assert sweep[arm]["placement_p99_ms"] > 0, sweep
+    # The fan-in fix, as counters: delta arm serves ZERO full replies and
+    # ZERO steady-state view rows; the legacy arm pays O(N) rows per reply.
+    for n in (64, 128):
+        assert sweep[f"n{n}_delta"]["hb_full_replies"] == 0, sweep
+        assert sweep[f"n{n}_delta"]["hb_view_rows_per_interval"] == 0, sweep
+        assert sweep[f"n{n}_legacy"]["hb_view_bytes_per_node_per_interval"] > 0, sweep
+    # Per-node heartbeat bytes GROW with N on the legacy arm (the quadratic
+    # signature) — the delta arm's stay flat at zero.
+    assert (
+        sweep["n128_legacy"]["hb_view_bytes_per_node_per_interval"]
+        > sweep["n64_legacy"]["hb_view_bytes_per_node_per_interval"]
+    ), sweep
+    # Node-death via the per-node location index beats the full-table scan.
+    death = data["sim_node_death"]
+    assert death["index"]["victim_rows"] == death["scan"]["victim_rows"] > 0, death
+    assert death["index"]["on_node_death_ms"] < death["scan"]["on_node_death_ms"], death
+    # Locality arm pins every ref-arg task to its holder, flight-evidenced;
+    # the no-locality arm is the measured zero baseline.
+    loc = data["sim_locality"]
+    assert loc["locality"]["holder_hit_frac"] == 1.0, loc
+    assert loc["locality"]["locality_hit_events"] > 0, loc
+    assert loc["no_locality"]["holder_hits"] == 0, loc
+    # Event flood: ring bounded, drops counted exactly.
+    ev = data["sim_task_events"]
+    assert ev["ring_size_after"] == ev["ring_maxlen"], ev
+    assert ev["events_dropped_total"] == ev["events_sent"] - ev["ring_maxlen"], ev
+    # Chaos cells all posted passing SLO verdicts.
+    assert data["sim_slo_ok"] is True, data.get("sim_slo_scorecard")
+
+
 def test_microbench_dag_smoke(tmp_path):
     """<30s classic-vs-compiled DAG case (microbench.py --dag --quick):
     both paths produce throughput numbers, and the compiled loop's
