@@ -656,6 +656,16 @@ def collective_suite(results, quick=False, arms=("tree", "flat")):
             out = g.allreduce(v) if flat_ring else g.allreduce_payload(v, tag)
             return np.asarray(out)
 
+        def reducescatter(self, group_name, tag, k, n, flat_ring=False):
+            import numpy as np
+
+            g = col.get_group(group_name)
+            v = ((np.arange(k * n).reshape(k, n) % 97) + 3.0 * g.rank).astype(
+                np.float32
+            )
+            out = g.reducescatter(v) if flat_ring else g.reducescatter_payload(v, tag)
+            return np.asarray(out)
+
         def coll_stats(self):
             from ray_tpu.util.collective.p2p import COLL as C
 
@@ -758,6 +768,60 @@ def collective_suite(results, quick=False, arms=("tree", "flat")):
                 K * (n_ar * 4 / 2**20) / dt, 1
             )
         results[f"allreduce_k{K}_bit_exact"] = 1
+
+        # Reducescatter verb (ISSUE 20 satellite): tree reduce-to-root +
+        # direct-mailbox shard scatter vs the flat GCS-mailbox ring, with
+        # the same integer-float32 bit-exact oracle — every rank's shard
+        # must equal its row of the full reduction regardless of combine
+        # order or which transport carried it.
+        n_rs = (256 if quick else 512) * 1024 // 4
+        full_rs = np.sum(
+            [
+                ((np.arange(K * n_rs).reshape(K, n_rs) % 97) + 3.0 * r).astype(
+                    np.float32
+                )
+                for r in range(K)
+            ],
+            axis=0,
+            dtype=np.float64,
+        ).astype(np.float32)
+        scatter0 = sum(
+            s["scatter_bytes"]
+            for s in ray_tpu.get([m.coll_stats.remote() for m in members], timeout=60)
+        )
+        for label, flat_ring in (("tree", False), ("ring", True)):
+            t0 = time.perf_counter()
+            outs = ray_tpu.get(
+                [
+                    m.reducescatter.remote(ar_group, f"rs-{label}", K, n_rs, flat_ring)
+                    for m in members
+                ],
+                timeout=240,
+            )
+            dt = time.perf_counter() - t0
+            # Roster position == rank here (members hold ranks 0..K-1), so
+            # rank i's shard is row i of the full reduction.
+            for pos, out in enumerate(outs):
+                assert (np.asarray(out) == full_rs[pos]).all(), (
+                    f"reducescatter {label} k{K} rank {pos}: oracle mismatch"
+                )
+            results[f"reducescatter_{label}_k{K}_s"] = round(dt, 4)
+            results[f"reducescatter_{label}_k{K}_agg_mib_per_s"] = round(
+                K * (K * n_rs * 4 / 2**20) / dt, 1
+            )
+        results[f"reducescatter_k{K}_bit_exact"] = 1
+        results[f"reducescatter_k{K}_scatter_bytes"] = (
+            sum(
+                s["scatter_bytes"]
+                for s in ray_tpu.get(
+                    [m.coll_stats.remote() for m in members], timeout=60
+                )
+            )
+            - scatter0
+        )
+        # The tree arm actually shipped shards over direct mailboxes (the
+        # ring arm rides the GCS mailbox and must not touch this counter).
+        assert results[f"reducescatter_k{K}_scatter_bytes"] > 0, results
 
         col.destroy_collective_group(group)
         col.destroy_collective_group(ar_group)
@@ -1934,6 +1998,373 @@ def serve_ft_suite(results, quick=False):
         cluster.shutdown()
 
 
+def serve_disagg_suite(results, quick=False):
+    """--serve-disagg: prefill/decode disaggregation + cluster prefix tier
+    (ISSUE 20) — DISAGGBENCH_r{N}.json.
+
+    End to end over a REAL serve instance (cluster + controller + proxy),
+    because the claim lives in the pool split, not the engine: under MIXED
+    load — long-prefill streams (384-token prompts on a compute-bound
+    model, 4 output tokens: pure prefill pressure) interleaved with
+    short-decode streams (48-token prompts, 12 output tokens: the
+    latency-sensitive traffic) — the
+    monolithic arm makes every short stream's prefill queue FIFO behind
+    whatever long prefill its replica is already chewing, while the
+    disaggregated arm routes prefills to a dedicated pool (where SJF lets
+    shorts jump the queue), seals the KV as a device object, and hands the
+    ~300B descriptor to an uncontended decode pool over direct-mailbox p2p.
+
+    Arms at EQUAL replica budget (4 engines each):
+    - mono:   4 replicas, role "both" — continuous batching, no handoff.
+    - disagg: 2 prefill + 2 decode replicas with the cluster prefix tier ON
+              (2 prefill replicas so the registry actually cross-imports:
+              a replica skips its own published rows).
+
+    Per arm: p50/p99 TTFT of the SHORT streams, aggregate tokens/s across
+    all streams, completed-request counts. The disagg arm also records the
+    deterministic evidence: KV handoff count (decode-side imports, flight
+    + engine counters agreeing), cluster-prefix import hits (>0 — seeded
+    by a serial warm round-robining the shared system prefix over both
+    prefill replicas), host-store object delta over the measured window
+    (0: descriptors ride actor RPC, payloads ride direct mailboxes), and
+    the leak oracle — every engine's free+cached block count restored to
+    pool size after the load quiesces."""
+    import statistics
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu._private import worker_context
+    from ray_tpu._private.rpc import EventLoopThread
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.serve.llm import LLMDeployment, disaggregated_llm_app
+
+    if quick:
+        # Machinery smoke: a dispatch-bound tiny model CANNOT show the TTFT
+        # story on this box (prefill costs less than one HTTP hop, so the
+        # handoff's fixed overhead dominates) — the quick pass only proves
+        # the plumbing: handoffs flow, prefix tier hits, zero store delta,
+        # zero leaked blocks. Ratio certification lives in the full sweep.
+        model = dict(
+            vocab_size=64, d_model=32, n_layers=1, n_heads=2, n_kv_heads=2,
+            d_ff=48, max_seq_len=160, dtype="float32", remat=False,
+        )
+        engine_cfg = dict(
+            num_slots=4, block_size=4, max_model_len=160, prefill_chunk=8
+        )
+        system = list(range(5, 5 + 16))  # 4 full blocks shared by every stream
+        long_prompt_len, short_new = 96, 12
+        n_long, n_short = 2, 2
+        duration = 5.0
+    else:
+        # Full sweep: a COMPUTE-bound model (a 384-token prefill costs
+        # hundreds of ms of matmul on this box — far above the per-hop
+        # dispatch cost), so a short stream queued FIFO behind a long
+        # prefill in the monolithic arm pays real latency, which is the
+        # regime disaggregation (SJF prefill pool + uncontended decode
+        # pool) targets.
+        model = dict(
+            vocab_size=128, d_model=256, n_layers=6, n_heads=4, n_kv_heads=2,
+            d_ff=1536, max_seq_len=512, dtype="float32", remat=False,
+        )
+        engine_cfg = dict(
+            num_slots=4, block_size=16, max_model_len=448, prefill_chunk=16
+        )
+        system = list(range(5, 5 + 32))  # 2 full blocks shared by every stream
+        long_prompt_len, short_new = 384, 12
+        n_long, n_short = 4, 4
+        duration = 25.0
+    vocab = model["vocab_size"]
+    suffix_len = len(system) // 2
+    results.update(
+        disagg_streams_long=n_long,
+        disagg_streams_short=n_short,
+        disagg_long_prompt_tokens=long_prompt_len,
+        disagg_short_prompt_tokens=len(system) + suffix_len,
+        disagg_short_new_tokens=short_new,
+        disagg_window_s=duration,
+        disagg_replicas={"mono": 4, "prefill": 2, "decode": 2},
+        disagg_model={k: v for k, v in model.items() if k != "dtype"},
+    )
+
+    def stream(url, body, timeout=240):
+        """Returns (tokens, done, [arrival stamps])."""
+        req = urllib.request.Request(url, data=json.dumps(body).encode())
+        resp = urllib.request.urlopen(req, timeout=timeout)
+        toks, stamps, buf = [], [], b""
+        while True:
+            chunk = resp.read(64)
+            if not chunk:
+                return toks, False, stamps
+            buf += chunk
+            while b"\n\n" in buf:
+                event, buf = buf.split(b"\n\n", 1)
+                if not event.startswith(b"data: "):
+                    continue
+                payload = event[6:]
+                if payload == b"[DONE]":
+                    return toks, True, stamps
+                toks.append(json.loads(payload)["token"])
+                stamps.append(time.perf_counter())
+
+    def flight_count(cluster, kind, since):
+        io = EventLoopThread.get()
+        resp = io.run(cluster.nodes[0].rpc_debug_dump({}), timeout=15)
+        return sum(
+            1
+            for proc in resp.get("processes", [])
+            for ev in proc.get("events", [])
+            if ev.get("type") == kind and ev.get("ts", 0) >= since - 1.0
+        )
+
+    def replica_stats(dep_names):
+        from ray_tpu.serve._private.common import CONTROLLER_NAME
+
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        table = ray_tpu.get(controller.get_routing_table.remote(-2, 0.1))["table"]
+        out = {}
+        for dep in dep_names:
+            stats = []
+            for r in table.get(dep, {}).get("replicas", []):
+                a = ray_tpu.get_actor(r["actor_name"])
+                stats.append(
+                    ray_tpu.get(
+                        a.handle_request.remote("get_stats", (), {}), timeout=30
+                    )
+                )
+            out[dep] = stats
+        return out
+
+    def pct(xs, p):
+        return xs[min(len(xs) - 1, int(p * len(xs)))] if xs else None
+
+    cluster = Cluster()
+    try:
+        cluster.add_node(num_cpus=12, object_store_memory=96 * 1024 * 1024)
+        cluster.connect()
+        cluster.wait_for_nodes()
+        cw = worker_context.get_core_worker()
+
+        def store_objects() -> int:
+            return cw.raylet.call("get_state")["store"]["num_objects"]
+
+        def run_arm(label, deploy_fn, dep_names):
+            serve.start()
+            deploy_fn()
+            host, port = serve.http_address()
+            url = f"http://{host}:{port}/llm"
+            # Warm every compiled program AND (disagg) seed the cluster
+            # prefix tier deterministically: 4 serial shared-prefix shorts
+            # round-robin over both prefill replicas, so replica B's probe
+            # finds replica A's published system-prefix row. One long warms
+            # the long-prompt prefill shape.
+            t_since = time.time()
+            rng = np.random.default_rng(7)
+            for i in range(4):
+                suffix = rng.integers(0, vocab, suffix_len).tolist()
+                toks, done, _ = stream(
+                    url, dict(tokens=system + suffix, max_new_tokens=4)
+                )
+                assert done and len(toks) == 4, (label, i, toks, done)
+            stream(
+                url,
+                dict(
+                    tokens=system
+                    + rng.integers(0, vocab, long_prompt_len - len(system)).tolist(),
+                    max_new_tokens=2,
+                ),
+            )
+            store_before = store_objects()
+            stop = threading.Event()
+            lock = threading.Lock()
+            short_ttfts: list = []
+            counts = {"tokens": 0, "short_done": 0, "long_done": 0, "errors": 0}
+
+            def short_loop(i):
+                srng = np.random.default_rng(100 + i)
+                while not stop.is_set():
+                    suffix = srng.integers(0, vocab, suffix_len).tolist()
+                    t0 = time.perf_counter()
+                    try:
+                        toks, done, stamps = stream(
+                            url, dict(tokens=system + suffix, max_new_tokens=short_new)
+                        )
+                    except Exception:
+                        with lock:
+                            counts["errors"] += 1
+                        return
+                    if not done:
+                        continue
+                    with lock:
+                        counts["tokens"] += len(toks)
+                        if not stop.is_set():
+                            counts["short_done"] += 1
+                            short_ttfts.append(stamps[0] - t0)
+
+            def long_loop(i):
+                lrng = np.random.default_rng(200 + i)
+                while not stop.is_set():
+                    body = lrng.integers(
+                        0, vocab, long_prompt_len - len(system)
+                    ).tolist()
+                    try:
+                        toks, done, _ = stream(
+                            url, dict(tokens=system + body, max_new_tokens=4)
+                        )
+                    except Exception:
+                        with lock:
+                            counts["errors"] += 1
+                        return
+                    with lock:
+                        counts["tokens"] += len(toks)
+                        if done and not stop.is_set():
+                            counts["long_done"] += 1
+
+            threads = [
+                threading.Thread(target=long_loop, args=(i,), daemon=True)
+                for i in range(n_long)
+            ] + [
+                threading.Thread(target=short_loop, args=(i,), daemon=True)
+                for i in range(n_short)
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            time.sleep(duration)
+            stop.set()
+            for t in threads:
+                t.join(timeout=300)
+            wall = time.perf_counter() - t0
+            assert counts["errors"] == 0, (label, counts)
+            short_ttfts.sort()
+            arm = {
+                "tokens_per_s": round(counts["tokens"] / wall, 1),
+                "short_completed": counts["short_done"],
+                "long_completed": counts["long_done"],
+                "short_ttft_p50_ms": round(1000 * pct(short_ttfts, 0.50), 1)
+                if short_ttfts
+                else None,
+                "short_ttft_p99_ms": round(1000 * pct(short_ttfts, 0.99), 1)
+                if short_ttfts
+                else None,
+                "short_ttft_mean_ms": round(1000 * statistics.mean(short_ttfts), 1)
+                if short_ttfts
+                else None,
+            }
+            # Handoff-path host-store evidence: descriptors ride actor RPC,
+            # KV payloads ride direct mailboxes — the measured window must
+            # add NOTHING to the node's shm store (bounded settle for the
+            # proxy's async stream-buffer frees).
+            deadline = time.monotonic() + 30
+            delta = store_objects() - store_before
+            while delta > 0 and time.monotonic() < deadline:
+                time.sleep(0.25)
+                delta = store_objects() - store_before
+            arm["store_objects_delta"] = delta
+            # Leak oracle: every engine's KV pool back to full (free blocks
+            # + resident prefix-cache blocks == pool size) once idle.
+            deadline = time.monotonic() + 30
+            while True:
+                stats = replica_stats(dep_names)
+                leak = sum(
+                    s["num_blocks"] - s["free_blocks"] - s["cached_blocks"]
+                    for ss in stats.values()
+                    for s in ss
+                )
+                if leak == 0 or time.monotonic() > deadline:
+                    break
+                time.sleep(0.25)
+            arm["kv_leak_blocks"] = leak
+            for k, v in arm.items():
+                results[f"{label}_{k}"] = v
+            print(f"serve-disagg[{label}]: {arm}")
+            return stats, t_since
+
+        # ---- mono arm: 4 role-"both" replicas, no pools ----
+        def deploy_mono():
+            app = serve.deployment(num_replicas=4, name="llm")(LLMDeployment).bind(
+                model_config=model, engine_config=dict(engine_cfg)
+            )
+            serve.run(app, route_prefix="/llm")
+
+        mono_stats, _ = run_arm("mono", deploy_mono, ["llm"])
+        assert all(s["handoffs"] == 0 for s in mono_stats["llm"]), mono_stats
+        serve.shutdown()
+
+        # ---- disagg arm: 2 prefill + 2 decode, cluster prefix tier ON ----
+        def deploy_disagg():
+            serve.run(
+                disaggregated_llm_app(
+                    model,
+                    dict(engine_cfg),
+                    name="llm",
+                    prefill_replicas=2,
+                    decode_replicas=2,
+                    cluster_prefix=True,
+                )
+            )
+
+        disagg_stats, t_since = run_arm(
+            "disagg", deploy_disagg, ["llm", "llm--prefill"]
+        )
+        dec = disagg_stats["llm"]
+        pre = disagg_stats["llm--prefill"]
+        results["disagg_handoffs"] = sum(s["handoffs"] for s in dec)
+        results["disagg_handoff_exports"] = sum(s["handoff_exports"] for s in pre)
+        results["disagg_handoff_failed"] = sum(
+            s["handoff_failed"] for s in dec + pre
+        )
+        results["disagg_prefix_import_hits"] = sum(
+            s["prefix_import_hits"] for s in pre
+        )
+        results["disagg_prefix_import_misses"] = sum(
+            s["prefix_import_misses"] for s in pre
+        )
+        results["disagg_published_prefixes"] = sum(
+            s["published_prefixes"] for s in pre
+        )
+        results["disagg_handoff_flight_events"] = flight_count(
+            cluster, "llm_kv_handoff", t_since
+        )
+        results["disagg_prefix_import_flight_events"] = flight_count(
+            cluster, "llm_prefix_import", t_since
+        )
+        # Pool-role hygiene: decode replicas never prefill-published, and
+        # every completed stream rode a handoff (no silent mono fallback).
+        assert all(s["role"] == "decode" for s in dec), dec
+        assert all(s["role"] == "prefill" for s in pre), pre
+        assert results["disagg_handoffs"] > 0, results
+        assert results["disagg_prefix_import_hits"] > 0, results
+        assert results["disagg_store_objects_delta"] == 0, results
+        serve.shutdown()
+
+        if results.get("mono_short_ttft_p99_ms") and results.get(
+            "disagg_short_ttft_p99_ms"
+        ):
+            results["disagg_short_ttft_p99_reduction_pct"] = round(
+                (
+                    1
+                    - results["disagg_short_ttft_p99_ms"]
+                    / results["mono_short_ttft_p99_ms"]
+                )
+                * 100.0,
+                1,
+            )
+        if results.get("mono_tokens_per_s"):
+            results["disagg_tokens_vs_mono"] = round(
+                results["disagg_tokens_per_s"] / results["mono_tokens_per_s"], 2
+            )
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
+
+
 def putget_guard(results, duration):
     """1 MiB object-plane regression guard for the --transfer artifact: the
     rpc.py wire changes must not move the dispatch/store hot path.
@@ -2304,6 +2735,17 @@ def main():
         "vs OFF; records FTBENCH_r{N}.json",
     )
     ap.add_argument(
+        "--serve-disagg",
+        dest="serve_disagg",
+        action="store_true",
+        help="prefill/decode disaggregation + cluster KV prefix tier "
+        "(ISSUE 20): mixed long-prefill/short-decode closed-loop load, "
+        "monolithic 4-replica arm vs 2-prefill+2-decode pools — short-"
+        "stream p99 TTFT, aggregate tokens/s, KV handoff + cluster-prefix-"
+        "import counters, zero-host-store handoff evidence; records "
+        "DISAGGBENCH_r{N}.json",
+    )
+    ap.add_argument(
         "--chaos",
         action="store_true",
         help="chaos-plane recovery budgets (ISSUE 13): pull failover under "
@@ -2476,6 +2918,17 @@ def main():
         serve_ft_suite(results, quick=args.quick)
         results["wall_s"] = round(time.perf_counter() - t0, 1)
         out = args.out or f"FTBENCH_r{args.round}.json"
+        with open(out, "w") as f:
+            json.dump(results, f, indent=1)
+        print(json.dumps(results))
+        return
+
+    if args.serve_disagg:
+        results = {"host_cpus": os.cpu_count(), "mode": "serve_disagg"}
+        t0 = time.perf_counter()
+        serve_disagg_suite(results, quick=args.quick)
+        results["wall_s"] = round(time.perf_counter() - t0, 1)
+        out = args.out or f"DISAGGBENCH_r{args.round}.json"
         with open(out, "w") as f:
             json.dump(results, f, indent=1)
         print(json.dumps(results))

@@ -2358,6 +2358,23 @@ class CoreWorker:
         if entry is None:
             return {"kind": "missing"}
         loop = asyncio.get_event_loop()
+        dkey = req.get("direct_key")
+        if dkey is not None:
+            # Direct-mailbox reply (serve.llm KV handoff / prefix tier): the
+            # consumer named its own inbox key in the request, so ONE round
+            # trip decides the transfer and the payload streams straight to
+            # its p2p inbox — no group membership, no store seal, no arena
+            # copy. Serialization runs off-loop; the entry may be freed
+            # concurrently (LRU eviction racing an import), in which case
+            # host_bytes reads None and the consumer gets a typed miss —
+            # never a torn payload.
+            data = await loop.run_in_executor(None, mgr.host_bytes, oid)
+            if data is None:
+                return {"kind": "missing"}
+            from ray_tpu.util.collective.p2p import direct_send
+
+            direct_send(self, tuple(req["direct_addr"]), dkey, data)
+            return {"kind": "direct", "nbytes": len(data)}
         group = req.get("group")
         if group is not None and entry.meta.transport == "collective":
             from ray_tpu.util.collective import get_group, is_group_initialized

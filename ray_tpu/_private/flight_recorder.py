@@ -125,6 +125,9 @@ EVENT_TYPES = (
     # Control-plane scale hardening (PR 19).
     "locality_hit",    # 48: placement chose a node already holding the task's reference args (detail task:node)
     "gcs_overload",    # 49: GCS task-event ring dropped oldest entries under fan-in (detail dropped:total)
+    # Disaggregated LLM serving (PR 20).
+    "llm_kv_handoff",  # 50: prefill→decode sealed-KV import landed on the decode side (detail oid:blocks:bytes:src->dst; ':failed:' arm on fetch error)
+    "llm_prefix_import",  # 51: cluster-prefix-tier KV import (detail oid:blocks:bytes:src->dst; ':error:' arm when the row's payload is gone)
 )
 _CODE = {name: i for i, name in enumerate(EVENT_TYPES)}
 

@@ -238,6 +238,20 @@ def instruments() -> dict:
                 "ray_tpu_serve_llm_prefix_evictions_total",
                 "refs-0 prefix-cache blocks evicted under allocation pressure.",
             ),
+            "serve_llm_handoffs": m.Counter(
+                "ray_tpu_serve_llm_handoffs_total",
+                "Completed prefill→decode KV handoffs (sealed payload "
+                "imported on the decode side; descriptors only in-band, "
+                "payloads on the direct-mailbox p2p plane).",
+            ),
+            "serve_llm_prefix_imports": m.Counter(
+                "ray_tpu_serve_llm_prefix_imports_total",
+                "Cluster-prefix-tier KV import attempts by outcome: hit "
+                "(payload landed), miss (no registry row for any probed "
+                "depth), error (row existed but the payload was gone or "
+                "the fetch failed).",
+                tag_keys=("outcome",),
+            ),
             "serve_llm_ttft": m.Histogram(
                 "ray_tpu_serve_llm_ttft_s",
                 "Time to first token: submit -> first token emitted.",
@@ -360,6 +374,16 @@ def instruments() -> dict:
                 "ray_tpu_collective_allreduces_total",
                 "Allreduce participations (tree reduce up + broadcast "
                 "back down) by this process.",
+            ),
+            "collective_reducescatters": m.Counter(
+                "ray_tpu_collective_reducescatters_total",
+                "Reduce-scatter participations (tree reduce up + per-rank "
+                "shard fan-out from the root) by this process.",
+            ),
+            "collective_scatter_bytes": m.Counter(
+                "ray_tpu_collective_scatter_bytes_total",
+                "Serialized reduce-scatter shard bytes this process pushed "
+                "to members as the scatter root.",
             ),
             "collective_host_sync_fallbacks": m.Counter(
                 "ray_tpu_collective_host_sync_fallbacks_total",
@@ -571,6 +595,8 @@ def _collect_collective_stats():
         ("reduce_sends", inst["collective_reduce_sends"], None),
         ("reduce_bytes", inst["collective_reduce_bytes"], None),
         ("allreduces", inst["collective_allreduces"], None),
+        ("reducescatters", inst["collective_reducescatters"], None),
+        ("scatter_bytes", inst["collective_scatter_bytes"], None),
         ("host_sync_fallbacks", inst["collective_host_sync_fallbacks"], None),
         ("member_changes", inst["collective_member_changes"], None),
     ])
@@ -587,6 +613,10 @@ def _collect_serve_llm_stats():
         ("prefix_miss_blocks", inst["serve_llm_prefix_misses"], None),
         ("preemptions", inst["serve_llm_preemptions"], None),
         ("evicted_blocks", inst["serve_llm_evictions"], None),
+        ("handoffs", inst["serve_llm_handoffs"], None),
+        ("prefix_import_hits", inst["serve_llm_prefix_imports"], {"outcome": "hit"}),
+        ("prefix_import_misses", inst["serve_llm_prefix_imports"], {"outcome": "miss"}),
+        ("prefix_import_errors", inst["serve_llm_prefix_imports"], {"outcome": "error"}),
     ])
     engines = list(ENGINES)
     if not engines and not LLM.admitted:

@@ -123,6 +123,22 @@ def _replica_went_away(e: BaseException) -> bool:
     return False
 
 
+def _drain_refused(e: BaseException) -> bool:
+    """The drain subset of :func:`_replica_went_away`: the replica is alive
+    and healthy but REFUSED the request because it is retiring. Unlike a
+    death this is a pure routing-table race — the caller marks the replica
+    draining on its router (so no policy picks it again) and retries
+    WITHOUT burning one of the bounded reassign/migration attempts, which
+    exist to cap work wasted on crashes, not on polite refusals."""
+    from ray_tpu.exceptions import ReplicaDrainingError, TaskError
+
+    if isinstance(e, ReplicaDrainingError):
+        return True
+    if isinstance(e, TaskError):
+        return isinstance(e.cause, ReplicaDrainingError)
+    return False
+
+
 class _SSETokenParser:
     """Incremental parser over the SSE chunk bytes the proxy forwards:
     collects the ``data: {"token": n}`` payloads the CLIENT has already
@@ -219,13 +235,34 @@ class ProxyASGIApp:
                 (v for k, v in headers.items() if k.lower() == PREFIX_HINT_HEADER),
                 "",
             )
+            # Disaggregated LLM (ISSUE 20): a paired "<name>--prefill"
+            # deployment in the table means LLM generate requests run their
+            # prefill leg on that pool first; the sealed-KV handoff envelope
+            # rewrites the body the decode pool (this deployment) receives.
+            # Any prefill-leg failure returns None and the decode pool
+            # simply recomputes the prefill — never a client-visible error.
+            req_body = body
+            from ray_tpu.serve._private.common import PREFILL_SUFFIX
+
+            prefill_dep = deployment + PREFILL_SUFFIX
+            if method == "POST" and self._router.replicas_for(prefill_dep):
+                req_body = (
+                    self._prefill_handoff(
+                        prefill_dep, body, headers, model_id, prefix_hint,
+                        path, query, matched_prefix, raw_query,
+                    )
+                    or body
+                )
             # ONE bounded reassign on the typed went-away errors: a replica
-            # that died after assignment (assign->dead race) or entered
-            # drain (deliberate retirement; the routing-table removal races
-            # this request by design) must not 500 the client while healthy
-            # replicas exist.
+            # that died after assignment (assign->dead race) must not 500
+            # the client while healthy replicas exist. Drain refusals
+            # (deliberate retirement; the routing-table removal races this
+            # request by design) retry WITHOUT consuming that bound — they
+            # mark the replica draining instead, capped by a deadline.
             exclude: list = []
-            for attempt in range(2):
+            casualties = 0
+            drain_deadline = _time.monotonic() + 30.0
+            while True:
                 t0 = _time.monotonic()
                 replica = self._router.assign_replica(
                     deployment, model_id=model_id, prefix_hint=prefix_hint,
@@ -234,13 +271,18 @@ class ProxyASGIApp:
                 try:
                     actor = self._router.handle_for(replica)
                     ref = actor.handle_http_request.remote(
-                        method, path, query, body, headers, model_id, matched_prefix,
-                        raw_query,
+                        method, path, query, req_body, headers, model_id,
+                        matched_prefix, raw_query,
                     )
                     result = ray_tpu.get(ref, timeout=120)
                 except BaseException as e:
                     self._router.release(replica, deployment=deployment)
-                    if attempt == 0 and _replica_went_away(e):
+                    if _drain_refused(e) and _time.monotonic() < drain_deadline:
+                        self._router.mark_draining(replica)
+                        exclude.append(replica["actor_name"])
+                        continue
+                    casualties += 1
+                    if casualties <= 1 and _replica_went_away(e):
                         self._router.invalidate_handle(replica)
                         exclude.append(replica["actor_name"])
                         continue
@@ -268,6 +310,81 @@ class ProxyASGIApp:
 
         status, payload, ctype, extra = _encode_result(result)
         await _respond(send, status, payload, ctype, extra)
+
+    def _prefill_handoff(
+        self, prefill_dep, body, headers, model_id, prefix_hint,
+        path, query, matched_prefix, raw_query,
+    ):
+        """Prefill leg of a disaggregated LLM request (runs in the executor
+        pool: blocking calls). Sends the ORIGINAL body to a prefill-pool
+        replica — prefix_hint affinity steers shared prompts to the replica
+        whose cache (local or imported via the cluster prefix tier) already
+        holds their KV — and translates the ``__llm_handoff__`` envelope it
+        returns into the decode-pool body: the original request plus the
+        sealed-KV descriptor, the first sampled token as resume_tokens, and
+        echo_resume so the client still sees that token.
+
+        Returns the rewritten body bytes, or None for ANY miss — body not
+        an LLM generate, already a resume/handoff, prefill pool saturated,
+        dead, draining, or unable to seal — in which case the caller sends
+        the original body to the decode pool and it recomputes the prefill.
+        The handoff is an optimization, never a point of failure."""
+        import ray_tpu
+
+        try:
+            parsed = json.loads(body or b"{}")
+        except Exception:
+            return None
+        if not isinstance(parsed, dict) or "tokens" not in parsed:
+            return None
+        if parsed.get("resume_tokens") or parsed.get("kv_import"):
+            return None  # mid-migration/handoff already — decode directly
+        exclude: list = []
+        casualties = 0
+        drain_deadline = time.monotonic() + 30.0
+        while True:
+            try:
+                replica = self._router.assign_replica(
+                    prefill_dep, timeout_s=10.0, model_id=model_id,
+                    prefix_hint=prefix_hint, exclude=exclude,
+                )
+            except TimeoutError:
+                return None
+            try:
+                actor = self._router.handle_for(replica)
+                result = ray_tpu.get(
+                    actor.handle_http_request.remote(
+                        "POST", path, query, body, headers, model_id,
+                        matched_prefix, raw_query,
+                    ),
+                    timeout=120,
+                )
+            except BaseException as e:
+                self._router.release(replica, deployment=prefill_dep)
+                if _drain_refused(e) and time.monotonic() < drain_deadline:
+                    self._router.mark_draining(replica)
+                    exclude.append(replica["actor_name"])
+                    continue
+                casualties += 1
+                if casualties <= 1 and _replica_went_away(e):
+                    self._router.invalidate_handle(replica)
+                    exclude.append(replica["actor_name"])
+                    continue
+                logger.warning(
+                    "prefill leg of %s failed (%s); decode pool recomputes",
+                    prefill_dep, type(e).__name__,
+                )
+                return None
+            self._router.release(replica, deployment=prefill_dep)
+            break
+        env = result.get("__llm_handoff__") if isinstance(result, dict) else None
+        if env is None:
+            return None  # engine decoded locally (could not seal)
+        body2 = dict(env.get("body") or {})
+        body2["resume_tokens"] = list(env.get("resume_tokens") or ())
+        body2["kv_import"] = env["kv_import"]
+        body2["echo_resume"] = True
+        return json.dumps(body2).encode()
 
     # Mid-stream migrations per request: one covers the common single
     # replica death; the second covers dying onto a second casualty during
@@ -376,6 +493,7 @@ class ProxyASGIApp:
         # affinity still steers multiplexed deployments to a warm replica.
         ctx = resume.get("ctx") or {}
         casualties = 0
+        drain_deadline = time.monotonic() + 30.0
         while True:
             replica = self._router.assign_replica(
                 deployment, model_id=ctx.get("model_id", ""), exclude=dead
@@ -401,6 +519,12 @@ class ProxyASGIApp:
                     )
             except BaseException as e:
                 self._router.release(replica, deployment=deployment)
+                # A draining target refused: not a casualty (the bound is
+                # for crashes) — mark it, exclude it, keep looking.
+                if _drain_refused(e) and time.monotonic() < drain_deadline:
+                    self._router.mark_draining(replica)
+                    dead.append(replica["actor_name"])
+                    continue
                 casualties += 1
                 if casualties <= self._MAX_MIGRATIONS and _replica_went_away(e):
                     self._router.invalidate_handle(replica)

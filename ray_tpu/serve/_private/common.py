@@ -75,6 +75,12 @@ MULTIPLEXED_MODEL_ID_HEADER = "serve_multiplexed_model_id"
 # blocks, falling back to least queue depth.
 PREFIX_HINT_HEADER = "serve_prefix_hash"
 
+# Naming convention pairing disaggregated LLM pools (ISSUE 20): the proxy
+# discovers the prefill pool as f"{decode_deployment}{PREFILL_SUFFIX}" in
+# its routing table. Lives here (not serve.llm.deployment, which re-exports
+# it) so the proxy path never imports the model stack.
+PREFILL_SUFFIX = "--prefill"
+
 
 class HandleMarker:
     """Placeholder for a DeploymentHandle inside pickled init args —

@@ -33,6 +33,15 @@ class Router:
         self._rr: dict[str, int] = {}
         self._inflight: dict[str, int] = {}  # replica actor_name -> count
         self._alive_cache: dict[str, float] = {}  # actor_name -> verdict stamp
+        # Replicas KNOWN to be draining (refused a request with the typed
+        # ReplicaDrainingError), actor_name -> expiry stamp. The controller
+        # eventually removes them from the table; until that table version
+        # lands, every assignment policy — round-robin, prefix-affinity pin,
+        # least-queue-depth spill, handoff targeting — must skip them, or a
+        # request burns one of its bounded reassign retries on a replica
+        # that is guaranteed to refuse it. TTL-bounded so a replica that
+        # aborts its drain (or a name reused by a new replica) recovers.
+        self._draining: dict[str, float] = {}
         self._metrics = self_metrics.instruments()
         self._lock = threading.Lock()
         # Saturated assigns park on this condition (same underlying lock);
@@ -145,6 +154,14 @@ class Router:
             while True:
                 entry = self._table.get(deployment)
                 replicas = list(entry["replicas"]) if entry else []
+                if self._draining:
+                    self._prune_draining_locked()
+                if self._draining:
+                    replicas = [
+                        r
+                        for r in replicas
+                        if r["actor_name"] not in self._draining
+                    ]
                 if exclude:
                     replicas = [r for r in replicas if r["actor_name"] not in exclude]
                 if replicas:
@@ -239,6 +256,42 @@ class Router:
                 )
             except Exception:
                 pass
+
+    # How long a drain verdict sticks without confirmation. Long enough to
+    # outlive the controller's table update (which removes the replica for
+    # real), short enough that a reused actor name or an aborted drain
+    # re-enters rotation on its own.
+    _DRAINING_TTL_S = 60.0
+
+    def mark_draining(self, replica_or_name, ttl_s: float | None = None):
+        """A caller saw this replica refuse a request with the typed
+        ReplicaDrainingError: take it out of every assignment policy until
+        the routing table catches up (or the TTL expires)."""
+        name = (
+            replica_or_name["actor_name"]
+            if isinstance(replica_or_name, dict)
+            else replica_or_name
+        )
+        with self._lock:
+            self._draining[name] = time.monotonic() + (
+                self._DRAINING_TTL_S if ttl_s is None else ttl_s
+            )
+
+    def is_draining(self, replica_or_name) -> bool:
+        name = (
+            replica_or_name["actor_name"]
+            if isinstance(replica_or_name, dict)
+            else replica_or_name
+        )
+        with self._lock:
+            self._prune_draining_locked()
+            return name in self._draining
+
+    def _prune_draining_locked(self):
+        now = time.monotonic()
+        for name, expiry in list(self._draining.items()):
+            if expiry <= now:
+                del self._draining[name]
 
     # Positive liveness verdicts are cached briefly so the per-call probe
     # costs ~one GCS RPC per replica per window, not one per request —

@@ -45,6 +45,12 @@ class Application:
         self.deployment = deployment
         self.init_args = init_args
         self.init_kwargs = init_kwargs
+        # Sibling applications deployed (and torn down) WITH this one but
+        # not referenced from its init args — e.g. the prefill pool paired
+        # with a disaggregated LLM decode deployment, which the proxy finds
+        # by naming convention rather than by handle. Each keeps its own
+        # name and route prefix.
+        self.extras: list = []
 
 
 class Deployment:
@@ -289,6 +295,8 @@ def _build_app_tree(
     )
     info._source_app_id = id(app)
     infos[dep.name] = info
+    for extra in getattr(app, "extras", ()):
+        _build_app_tree(extra, app_name, infos)
     return dep.name
 
 

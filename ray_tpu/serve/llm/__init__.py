@@ -10,13 +10,19 @@ scheduler + prefix-cache reuse, streamed through the Serve replica path.
   ``handle.options(prefix_hint=...)``).
 """
 
-from ray_tpu.serve.llm.deployment import LLMDeployment
+from ray_tpu.serve.llm.deployment import (
+    PREFILL_SUFFIX,
+    LLMDeployment,
+    disaggregated_llm_app,
+)
 from ray_tpu.serve.llm.engine import LLMEngine, LLMRequest, block_hashes, prefix_route_hint
 
 __all__ = [
     "LLMDeployment",
     "LLMEngine",
     "LLMRequest",
+    "PREFILL_SUFFIX",
     "block_hashes",
+    "disaggregated_llm_app",
     "prefix_route_hint",
 ]

@@ -23,12 +23,21 @@ request's decode slot and KV blocks immediately via
 Request body: ``{"tokens": [int], "max_new_tokens": int, "temperature":
 float, "top_k": int, "seed": int, "stream": bool}`` — ``stream`` defaults
 true (SSE); false buffers and returns ``{"tokens": [...]}``.
+
+Disaggregated serving (ISSUE 20): give the engine ``role="prefill"`` and
+requests terminate with a ``{"__llm_handoff__": ...}`` envelope — the
+sealed-KV descriptor plus the first sampled token — instead of decoding.
+The proxy forwards that descriptor to a decode-pool replica as
+``kv_import=`` + ``resume_tokens=`` (+ ``echo_resume``, so the client
+still sees the prefill-sampled token in its stream). Build the two-pool
+app with :func:`disaggregated_llm_app`.
 """
 
 from __future__ import annotations
 
 import json
 
+from ray_tpu.serve._private.common import PREFILL_SUFFIX  # noqa: F401
 from ray_tpu.serve.llm.engine import LLMEngine, prefix_route_hint  # noqa: F401
 
 
@@ -65,10 +74,22 @@ class LLMDeployment:
             top_k=int(body.get("top_k", 0)),
             seed=int(body.get("seed", 0)),
             resume_tokens=body.get("resume_tokens"),
+            kv_import=body.get("kv_import"),
         )
+        # Resume tokens a migrated/handed-off request already owns but the
+        # CLIENT has not seen yet (the handoff descriptor's first sampled
+        # token): echo them ahead of the engine's stream so the client's
+        # token sequence is complete. The engine itself never re-emits
+        # resume tokens — echoing is presentation, owned here.
+        echo = [int(t) for t in (body.get("resume_tokens") or ())] if body.get(
+            "echo_resume"
+        ) else []
+        if self.engine.role == "prefill":
+            return self._prefill_call(body, req)
         if not body.get("stream", True):
             try:
-                return {"tokens": req.result(timeout=float(body.get("timeout", 120.0)))}
+                toks = req.result(timeout=float(body.get("timeout", 120.0)))
+                return {"tokens": echo + toks}
             except BaseException:
                 # A timed-out (or otherwise failed) buffered request must not
                 # keep generating into a queue nobody will read — free its
@@ -79,6 +100,8 @@ class LLMDeployment:
 
         def sse():
             try:
+                for tok in echo:
+                    yield f"data: {json.dumps({'token': tok})}\n\n"
                 for tok in req:
                     yield f"data: {json.dumps({'token': tok})}\n\n"
                 yield "data: [DONE]\n\n"
@@ -99,13 +122,47 @@ class LLMDeployment:
             # proxy resubmits the original body to another replica with
             # resume_tokens= the tokens it already forwarded; "sse_tokens"
             # tells the proxy how to parse them back out of the SSE chunks
-            # it relayed. Counter-based sampling makes the continuation
-            # bit-identical, so the client never notices.
+            # it relayed. The one-shot handoff fields must NOT ride along:
+            # kv_import's payload is gone after the first import, and a
+            # re-echo would duplicate tokens the client already has.
+            # Counter-based sampling makes the continuation bit-identical,
+            # so the client never notices.
             resume={
                 "kind": "sse_tokens",
-                "body": {k: v for k, v in body.items() if k != "resume_tokens"},
+                "body": {
+                    k: v
+                    for k, v in body.items()
+                    if k not in ("resume_tokens", "kv_import", "echo_resume")
+                },
             },
         )
+
+    def _prefill_call(self, body: dict, req) -> dict:
+        """Prefill-role request: block until the engine finishes prefill and
+        return the handoff envelope the proxy forwards to the decode pool.
+        When the engine could not seal a payload (bare process) it decoded
+        locally instead — return the plain buffered result so a mono-pool
+        fallback still answers the client."""
+        try:
+            toks = req.result(timeout=float(body.get("timeout", 120.0)))
+        except BaseException:
+            self.engine.cancel(req)
+            raise
+        if req.handoff is None:
+            return {"tokens": toks}
+        desc = dict(req.handoff)
+        tok0 = desc.pop("tok0")
+        return {
+            "__llm_handoff__": {
+                "kv_import": desc,
+                "resume_tokens": [tok0],
+                "body": {
+                    k: v
+                    for k, v in body.items()
+                    if k not in ("resume_tokens", "kv_import", "echo_resume")
+                },
+            }
+        }
 
     def get_stats(self) -> dict:
         """Engine snapshot (handle-callable; used by tests and benches)."""
@@ -121,3 +178,55 @@ class LLMDeployment:
 
     def prepare_for_shutdown(self):
         self.engine.shutdown()
+
+
+def disaggregated_llm_app(
+    model_config: dict,
+    engine_config: dict | None = None,
+    *,
+    name: str = "llm",
+    prefill_replicas: int = 1,
+    decode_replicas: int = 1,
+    cluster_prefix: bool = True,
+    max_concurrent_queries: int = 100,
+    init_seed: int = 0,
+    route_prefix: str | None = "/llm",
+):
+    """Build the two-pool disaggregated serving application: a decode
+    deployment that OWNS the route and a paired ``<name>--prefill``
+    deployment the proxy discovers by naming convention. Pool sizes are
+    static config (no cross-pool autoscaler yet — see PARITY.md). Returns
+    the decode Application; ``serve.run(app)`` deploys both pools.
+    """
+    from ray_tpu import serve
+
+    engine_config = dict(engine_config or {})
+    engine_config.pop("role", None)
+    prefill_cfg = dict(
+        engine_config, role="prefill", cluster_prefix=cluster_prefix
+    )
+    decode_cfg = dict(engine_config, role="decode", cluster_prefix=False)
+    prefill = serve.deployment(
+        num_replicas=int(prefill_replicas),
+        name=f"{name}{PREFILL_SUFFIX}",
+        max_concurrent_queries=max_concurrent_queries,
+        route_prefix=None,
+    )(LLMDeployment).bind(
+        model_config=model_config,
+        engine_config=prefill_cfg,
+        init_seed=init_seed,
+    )
+    decode = serve.deployment(
+        num_replicas=int(decode_replicas),
+        name=name,
+        max_concurrent_queries=max_concurrent_queries,
+        route_prefix=route_prefix,
+    )(LLMDeployment).bind(
+        model_config=model_config,
+        engine_config=decode_cfg,
+        init_seed=init_seed,
+    )
+    # The decode app is the root; the prefill app rides as a sibling of
+    # the same application tree (deployed together, torn down together).
+    decode.extras.append(prefill)
+    return decode

@@ -97,6 +97,10 @@ class LLMRequest:
         self.seed = int(seed) & 0xFFFFFFFFFFFFFFFF
         self.cancelled = threading.Event()
         self.error: Optional[str] = None
+        # Prefill-role terminal state: the sealed-KV handoff descriptor
+        # (dict) a decode-pool replica continues from; None on engines that
+        # decode their own requests.
+        self.handoff: Optional[dict] = None
         self.t_submit = time.monotonic()
         self.t_first: Optional[float] = None
         self.t_done: Optional[float] = None
@@ -113,6 +117,11 @@ class LLMRequest:
         self._sched_registered_bids: set[int] = set()
         self._sched_hashes: list[bytes] = []
         self._sched_admit_seq = -1
+        # Fetched KV import awaiting admission-time scatter: (host payload
+        # [2, L, n_blocks, Bs, KV, Dh], kv_pos tokens it covers). Set by the
+        # SUBMIT thread (the network pull must not stall the scheduler);
+        # consumed and dropped by _admit.
+        self._sched_kv_import: Optional[tuple] = None
 
     @property
     def num_generated(self) -> int:
@@ -125,6 +134,9 @@ class LLMRequest:
             if kind == "token":
                 yield val
             elif kind == "done":
+                return
+            elif kind == "handoff":
+                self.handoff = val
                 return
             else:  # error
                 raise _request_error(val)
@@ -145,6 +157,12 @@ class LLMRequest:
             if kind == "token":
                 out.append(val)
             elif kind == "done":
+                return out
+            elif kind == "handoff":
+                # Prefill-role terminal: the first sampled token travels
+                # inside the descriptor (resume_tokens on the decode side),
+                # so the caller reads ``req.handoff``, not the token list.
+                self.handoff = val
                 return out
             else:
                 raise _request_error(val)
@@ -236,11 +254,39 @@ class LLMEngine:
         num_blocks: Optional[int] = None,
         prefill_chunk: int = 32,
         serial_batch: bool = False,
+        role: str = "both",
+        cluster_prefix: bool = False,
+        cluster_prefix_max: int = 16,
+        handoff_ttl_s: float = 120.0,
     ):
         from ray_tpu.models.generate import init_paged_cache
 
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(f"role must be both|prefill|decode, got {role!r}")
         self.params = params
         self.cfg = cfg
+        # Disaggregation role (ISSUE 20). "prefill": requests terminate at
+        # prefill completion with a sealed-KV handoff descriptor instead of
+        # entering decode, and the prefill queue runs shortest-remaining-
+        # first (a prefill-only pool has no decode fairness to protect, so
+        # SJF is safe and is what keeps short prompts from queueing behind
+        # long ones — the disaggregation TTFT win). "decode" behaves like
+        # "both" at the engine level (it must keep full prefill capability
+        # for teacher-forced resumption and migration recompute) — the role
+        # tag exists for routing/config introspection.
+        self.role = role
+        self.cluster_prefix = bool(cluster_prefix)
+        self.cluster_prefix_max = int(cluster_prefix_max)
+        self.handoff_ttl_s = float(handoff_ttl_s)
+        # Published prefix entries (deepest chain hash -> sealed payload +
+        # registry row keys), LRU-ordered; overflow frees the sealed copy
+        # and retracts its rows. _pub_oids is the same-engine import guard.
+        self._published: "OrderedDict[bytes, dict]" = OrderedDict()
+        self._pub_oids: set[str] = set()
+        # Outstanding handoff exports (oid -> reap deadline): the decode
+        # side releases the pin after importing; the TTL reaper frees
+        # payloads whose handoff never completed (proxy died mid-flight).
+        self._exports: dict[str, float] = {}
         self.num_slots = int(num_slots)
         self.block_size = int(block_size)
         self.max_model_len = int(max_model_len or cfg.max_seq_len)
@@ -279,6 +325,12 @@ class LLMEngine:
             "prefix_hit_blocks": 0,
             "prefix_miss_blocks": 0,
             "evicted_blocks": 0,
+            "handoffs": 0,
+            "handoff_exports": 0,
+            "handoff_failed": 0,
+            "prefix_import_hits": 0,
+            "prefix_import_misses": 0,
+            "prefix_import_errors": 0,
         }
         self._decode_fn, self._prefill_fn = _compiled_fns(cfg)
         try:
@@ -310,6 +362,7 @@ class LLMEngine:
         top_k: int = 0,
         seed: int = 0,
         resume_tokens=None,
+        kv_import=None,
     ) -> LLMRequest:
         """``resume_tokens``: tokens this request ALREADY emitted on a
         replica that died mid-stream. They are teacher-forced through
@@ -317,7 +370,14 @@ class LLMEngine:
         (they pre-seed the generated list, so admission's target covers
         them) and are NEVER re-emitted on the token queue — the stream
         continues from position len(resume_tokens), bit-identically under
-        the counter-based per-request RNG stream."""
+        the counter-based per-request RNG stream.
+
+        ``kv_import``: a sealed-KV handoff descriptor from a prefill-pool
+        replica. The payload is pulled HERE on the caller thread (network
+        I/O must not stall the scheduler) and scattered into freshly
+        allocated blocks at admission, so prefill resumes at the imported
+        position instead of recomputing the prompt. Any import failure
+        degrades to a plain recompute — the request still completes."""
         tokens = [int(t) for t in tokens]
         if not tokens:
             raise ValueError("empty prompt")
@@ -358,6 +418,10 @@ class LLMEngine:
             req.t_done = time.monotonic()
             req._q.put(("done", "complete"))
             return req
+        if kv_import is not None:
+            self._attach_handoff_import(req, kv_import)
+        elif self.cluster_prefix and not resume and req._sched_hashes:
+            self._attach_cluster_prefix(req)
         with self._lock:
             # A stopped scheduler can never serve this request — fail the
             # submit instead of parking the consumer on a queue nobody
@@ -408,6 +472,9 @@ class LLMEngine:
             "running": sum(r is not None for r in self._slots),
             "waiting": len(self._waiting),
             "draining": self._draining,
+            "role": self.role,
+            "published_prefixes": len(self._published),
+            "pending_exports": len(self._exports),
             **self._counts,
         }
 
@@ -423,6 +490,271 @@ class LLMEngine:
         return True
 
     # ------------------------------------------------------------------
+    # disaggregation: KV handoff import + cluster prefix tier (ISSUE 20)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _own_addr() -> str:
+        from ray_tpu._private import worker_context
+
+        cw = worker_context.get_core_worker_if_initialized()
+        return ":".join(str(x) for x in cw.address) if cw is not None else "local"
+
+    @blocking
+    def _attach_handoff_import(self, req: LLMRequest, desc: dict):
+        """Pull a prefill-pool replica's sealed KV payload on the SUBMIT
+        thread and stage it for admission-time scatter. Failure is not
+        fatal: the request recomputes its prompt like any fresh submit."""
+        from ray_tpu.serve.llm import kv_transfer
+
+        try:
+            payload = kv_transfer.fetch_kv_payload(desc, release=True)
+        except Exception as e:
+            self._counts["handoff_failed"] += 1
+            _flight.record(
+                "llm_kv_handoff",
+                f"{str(desc.get('oid', '?'))[:12]}:failed:{type(e).__name__}",
+            )
+            return
+        req._sched_kv_import = (payload, int(desc["kv_pos"]))
+        LLM.handoffs += 1
+        self._counts["handoffs"] += 1
+        src = ":".join(str(x) for x in desc.get("addr", ()))
+        _flight.record(
+            "llm_kv_handoff",
+            f"{desc['oid'][:12]}:{desc.get('blocks', 0)}blk:"
+            f"{desc.get('nbytes', 0)}B:{src}->{self._own_addr()}",
+        )
+
+    @blocking
+    def _attach_cluster_prefix(self, req: LLMRequest):
+        """Bounded longest→shortest cluster-registry probe for this
+        prompt's chain hashes. A hit stages the holder's sealed KV for
+        admission-time scatter (exactly the handoff import path); any
+        failure falls back to recompute. At most 4 registry lookups and
+        ONE payload fetch per submit — the local prefix cache stays the
+        fast path and short-circuits the probe entirely."""
+        from ray_tpu._private import worker_context
+        from ray_tpu.exceptions import DeviceObjectLostError
+        from ray_tpu.serve.llm import kv_transfer
+
+        cw = worker_context.get_core_worker_if_initialized()
+        if cw is None:
+            return
+        n = len(req._sched_hashes)
+        depths = sorted(
+            {n, n - 1, n // 2, n // 4} & set(range(1, n + 1)), reverse=True
+        )[:4]
+        probed = False
+        for d in depths:
+            h = req._sched_hashes[d - 1]
+            if h in self._prefix:
+                # Local cache already covers depth d — admission will take
+                # the refcounted hit; an import can only do worse. (Benign
+                # cross-thread dict read: a stale view just costs a probe.)
+                break
+            row = kv_transfer.lookup_prefix_row(cw, h)
+            probed = True
+            if row is None:
+                continue
+            if row.get("oid") in self._pub_oids:
+                continue  # our own publication — importing it is recompute with extra steps
+            use = min(int(row.get("use_blocks", 0)), d)
+            if use < 1 or int(row.get("block_size", 0)) != self.block_size:
+                continue
+            desc = {
+                "oid": row["oid"],
+                "addr": row["addr"],
+                "nbytes": int(row.get("nbytes", 0)),
+                "kv_pos": use * self.block_size,
+                "blocks": use,
+                "block_size": self.block_size,
+            }
+            try:
+                payload = kv_transfer.fetch_kv_payload(desc, release=False)
+            except Exception as e:
+                LLM.prefix_import_errors += 1
+                self._counts["prefix_import_errors"] += 1
+                if isinstance(e, DeviceObjectLostError):
+                    # The payload died under the row (holder eviction or
+                    # death): retract so the next prober skips the corpse.
+                    kv_transfer.retract_prefix_rows(
+                        cw, [kv_transfer.PREFIX_ROW + h.hex()], desc["oid"]
+                    )
+                _flight.record(
+                    "llm_prefix_import",
+                    f"{desc['oid'][:12]}:error:{type(e).__name__}",
+                )
+                return
+            req._sched_kv_import = (payload[:, :, :use], use * self.block_size)
+            LLM.prefix_import_hits += 1
+            self._counts["prefix_import_hits"] += 1
+            src = ":".join(str(x) for x in desc["addr"])
+            _flight.record(
+                "llm_prefix_import",
+                f"{desc['oid'][:12]}:{use}blk:{desc['nbytes']}B:"
+                f"{src}->{self._own_addr()}",
+            )
+            return
+        if probed:
+            LLM.prefix_import_misses += 1
+            self._counts["prefix_import_misses"] += 1
+
+    def _scatter_import(self, req: LLMRequest, cached: int):
+        """Admission-time KV import (scheduler thread): write the payload
+        blocks the local cache did not already cover into this request's
+        freshly allocated blocks, advance prefill past the imported extent,
+        and register the now-valid full prompt blocks in the LOCAL prefix
+        cache (the import seeds this replica for future local hits)."""
+        payload, kv_pos = req._sched_kv_import
+        req._sched_kv_import = None
+        # Always leave ≥1 prompt token for prefill: admission needs logits
+        # to sample from, exactly the n_hashable rule.
+        kv_pos = min(int(kv_pos), req._sched_target - 1)
+        if kv_pos <= req._sched_pos:
+            return
+        imp_blocks = -(-kv_pos // self.block_size)
+        if imp_blocks > len(req._sched_table) or imp_blocks > payload.shape[2]:
+            return  # malformed descriptor: recompute instead of corrupting
+        import jax.numpy as jnp
+
+        idx = jnp.asarray(req._sched_table[cached:imp_blocks], jnp.int32)
+        chunk = jnp.asarray(payload[:, :, cached:imp_blocks])
+        dt = self._cache["k"].dtype
+        self._cache["k"] = self._cache["k"].at[:, idx].set(chunk[0].astype(dt))
+        self._cache["v"] = self._cache["v"].at[:, idx].set(chunk[1].astype(dt))
+        req._sched_pos = kv_pos
+        self._register_prefix_blocks(req)
+
+    def _try_handoff(self, req: LLMRequest, logits_row: np.ndarray) -> bool:
+        """Prefill-role completion: sample the first output token, seal the
+        prompt's KV blocks as a transient device object, and finish the
+        request with the ~300B handoff descriptor. Returns False when
+        sealing is impossible (bare engine, seal error) — the caller then
+        decodes locally, bit-identically (the counter-based RNG draws the
+        same token at position 0 either way)."""
+        from ray_tpu.serve.llm import kv_transfer
+
+        n_exp = -(-len(req.prompt) // self.block_size)
+        try:
+            desc = kv_transfer.seal_kv_payload(
+                self._cache,
+                req._sched_table[:n_exp],
+                kv_pos=len(req.prompt),
+                block_size=self.block_size,
+                scope="llmkv",
+            )
+        except Exception:
+            desc = None
+        if desc is None:
+            return False
+        tok = self._sample(req, logits_row)
+        req._sched_generated.append(tok)
+        self._exports[desc["oid"]] = time.monotonic() + self.handoff_ttl_s
+        LLM.handoff_exports += 1
+        self._counts["handoff_exports"] += 1
+        self._finish(req, handoff=dict(desc, tok0=tok))
+        return True
+
+    def _publish_prefix(self, req: LLMRequest):
+        """Seal this request's hashable prompt prefix ONCE (an independent
+        copy — pool eviction can never tear an in-flight import) and
+        advertise one registry row per covered depth. LRU-capped at
+        cluster_prefix_max sealed prefixes; overflow frees the payload and
+        retracts its rows (read-check-delete, so a newer holder's
+        last-write-wins row survives)."""
+        hashes = req._sched_hashes
+        if not hashes:
+            return
+        deep = hashes[-1]
+        with self._lock:
+            if deep in self._published:
+                self._published.move_to_end(deep)
+                return
+        from ray_tpu._private import worker_context
+        from ray_tpu.serve.llm import kv_transfer
+
+        cw = worker_context.get_core_worker_if_initialized()
+        if cw is None:
+            return
+        try:
+            desc = kv_transfer.seal_kv_payload(
+                self._cache,
+                req._sched_table[: len(hashes)],
+                kv_pos=len(hashes) * self.block_size,
+                block_size=self.block_size,
+                scope="llmprefix",
+            )
+        except Exception:
+            desc = None
+        if desc is None:
+            return
+        holder_id, _ = cw._holder_identity()
+        keys = kv_transfer.publish_prefix_rows(cw, hashes, desc, holder_id)
+        evicted: list[dict] = []
+        with self._lock:
+            self._published[deep] = {"oid": desc["oid"], "keys": keys}
+            self._pub_oids.add(desc["oid"])
+            while len(self._published) > self.cluster_prefix_max:
+                _, entry = self._published.popitem(last=False)
+                self._pub_oids.discard(entry["oid"])
+                evicted.append(entry)
+        for entry in evicted:
+            self._retract_published(cw, entry)
+
+    def _retract_published(self, cw, entry: dict):
+        from ray_tpu.serve.llm import kv_transfer
+
+        kv_transfer.retract_prefix_rows(cw, entry["keys"], entry["oid"])
+        try:
+            cw._device_manager().free(entry["oid"])
+        except Exception:
+            pass
+
+    def _reap_exports(self):
+        """Free handoff payloads whose descriptor never came back (proxy
+        died between prefill and decode-assign) — the importing side's pin
+        release is the fast path, this TTL is the backstop."""
+        if not self._exports:
+            return
+        now = time.monotonic()
+        stale = [oid for oid, dl in self._exports.items() if dl < now]
+        if not stale:
+            return
+        from ray_tpu._private import worker_context
+
+        cw = worker_context.get_core_worker_if_initialized()
+        for oid in stale:
+            self._exports.pop(oid, None)
+            if cw is not None:
+                try:
+                    cw._device_manager().free(oid)
+                except Exception:
+                    pass
+
+    def _teardown_cluster_tier(self):
+        """Engine exit (shutdown or crash): retract every registry row this
+        engine published and free the sealed payloads + stale exports, so
+        the GCS KV returns to baseline and no importer chases a corpse."""
+        from ray_tpu._private import worker_context
+
+        cw = worker_context.get_core_worker_if_initialized()
+        with self._lock:
+            pubs = list(self._published.values())
+            self._published.clear()
+            self._pub_oids.clear()
+        for entry in pubs:
+            if cw is not None:
+                self._retract_published(cw, entry)
+        for oid in list(self._exports):
+            self._exports.pop(oid, None)
+            if cw is not None:
+                try:
+                    cw._device_manager().free(oid)
+                except Exception:
+                    pass
+
+    # ------------------------------------------------------------------
     # scheduler (one dedicated thread owns everything below)
     # ------------------------------------------------------------------
 
@@ -431,6 +763,7 @@ class LLMEngine:
         try:
             while not self._stop.is_set():
                 self._sweep_cancelled()
+                self._reap_exports()
                 self._admit()
                 busy = self._prefill_tick()
                 busy = self._decode_tick() or busy
@@ -461,6 +794,7 @@ class LLMEngine:
             for req in pending:
                 if req is not None:
                     self._finish(req, error=SHUTDOWN_ERROR)
+            self._teardown_cluster_tier()
 
     def _sweep_cancelled(self):
         for req in self._slots:
@@ -570,6 +904,8 @@ class LLMEngine:
             req._sched_table = table
             req._sched_pos = cached * self.block_size
             req._sched_target = target
+            if req._sched_kv_import is not None:
+                self._scatter_import(req, cached)
             req._sched_state = "prefill"
             req._sched_slot = slot
             req._sched_admit_seq = next(self._admit_seq)
@@ -584,9 +920,19 @@ class LLMEngine:
     # --- prefill (one fixed-shape chunk per tick, interleaved with decode) ---
 
     def _prefill_tick(self) -> bool:
+        if self.role == "prefill":
+            # Prefill-only pool: shortest-remaining-first. There is no
+            # decode fairness to protect here, so a short prompt jumps the
+            # queue instead of waiting out a long one's chunks — the
+            # disaggregation TTFT win for short streams under mixed load.
+            # admit_seq tiebreaks for determinism; starvation is bounded by
+            # the pool being prefill-only (every job leaves at completion).
+            key = lambda r: (r._sched_target - r._sched_pos, r._sched_admit_seq)  # noqa: E731
+        else:
+            key = lambda r: r._sched_admit_seq  # noqa: E731
         req = min(
             (r for r in self._slots if r is not None and r._sched_state == "prefill"),
-            key=lambda r: r._sched_admit_seq,
+            key=key,
             default=None,
         )
         if req is None:
@@ -615,7 +961,14 @@ class LLMEngine:
         req._sched_pos = min(pos0 + q, req._sched_target)
         self._register_prefix_blocks(req)
         if req._sched_pos >= req._sched_target:
-            self._emit_token(req, np.asarray(logits)[0])
+            # Publish BEFORE any terminal transition: sealing gathers from
+            # the request's still-allocated block table.
+            if self.cluster_prefix:
+                self._publish_prefix(req)
+            row_logits = np.asarray(logits)[0]
+            if self.role == "prefill" and self._try_handoff(req, row_logits):
+                return True
+            self._emit_token(req, row_logits)
         return True
 
     def _register_prefix_blocks(self, req: LLMRequest):
@@ -758,7 +1111,13 @@ class LLMEngine:
         with self._lock:
             self._waiting.appendleft(victim)  # resume first: FIFO-ish fairness
 
-    def _finish(self, req: LLMRequest, error: str | None = None, cancelled=False):
+    def _finish(
+        self,
+        req: LLMRequest,
+        error: str | None = None,
+        cancelled=False,
+        handoff: dict | None = None,
+    ):
         if req._finished:
             return
         req._finished = True
@@ -768,7 +1127,11 @@ class LLMEngine:
         req._sched_slot = None
         req._sched_state = "done"
         req.t_done = time.monotonic()
-        if cancelled:
+        if handoff is not None:
+            LLM.finished += 1
+            self._counts["finished"] += 1
+            req._q.put(("handoff", handoff))
+        elif cancelled:
             LLM.cancelled += 1
             self._counts["cancelled"] += 1
             req._q.put(("done", "cancelled"))

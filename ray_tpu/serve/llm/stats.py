@@ -29,6 +29,17 @@ class _LLMStats:
         "prefix_hit_blocks",
         "prefix_miss_blocks",
         "evicted_blocks",
+        # Disaggregated serving (ISSUE 20): completed prefill→decode KV
+        # handoffs counted on the IMPORTING (decode) side, exports sealed on
+        # the prefill side, and cluster-prefix-tier import attempts by
+        # outcome (hit = payload landed, miss = no registry row / local
+        # cache already covered it, error = row existed but the fetch
+        # failed: holder dead, payload evicted, or mailbox timeout).
+        "handoffs",
+        "handoff_exports",
+        "prefix_import_hits",
+        "prefix_import_misses",
+        "prefix_import_errors",
     )
 
     def __init__(self):

@@ -18,6 +18,15 @@ Example config (YAML or JSON):
             autoscaling_config:
               min_replicas: 1
               max_replicas: 4
+
+Disaggregated LLM pools (serve.llm.disaggregated_llm_app) are two sibling
+deployments of one application — size them independently with two entries:
+
+    deployments:
+      - name: llm            # decode pool (owns the route)
+        num_replicas: 2
+      - name: llm--prefill   # prefill pool (handle-only)
+        num_replicas: 2
 """
 
 from __future__ import annotations
@@ -126,6 +135,11 @@ def _apply_overrides(app, overrides: dict, used: set):
                 {k: rebuild(v) for k, v in node.init_kwargs.items()},
             )
             memo[id(node)] = out
+            # Sibling applications (disaggregated-LLM prefill pools) are
+            # part of the tree: rebuild them so a config file can size the
+            # two pools independently (e.g. override "llm" and
+            # "llm--prefill" num_replicas as two deployment entries).
+            out.extras = [rebuild(e) for e in getattr(node, "extras", ())]
             return out
         # Exact list/tuple/dict only — a namedtuple or tuple subclass has a
         # different constructor signature and passes through untouched.

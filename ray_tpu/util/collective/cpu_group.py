@@ -320,6 +320,33 @@ class CpuCollectiveGroup:
             roster=roster,
         )
 
+    def reducescatter_payload(self, value, tag: str, op: ReduceOp = ReduceOp.SUM,
+                              timeout: float = 60.0):
+        """Tree reduce-scatter over the direct-mailbox plane
+        (p2p.group_reducescatter): partials combine chunk-wise up the tree
+        and the root hands each member only ITS reduced slice — O(log K)
+        hops and 1/K of the ring's per-member download. Ring contract
+        preserved: leading dim == member count; sorted-roster position i
+        gets slice i, placed like ``value`` (the root finalizes per shard
+        before fanning out). Ring fallback under the same conditions as
+        :meth:`reduce_send_payload`."""
+        self._check_destroyed("reducescatter_payload")
+        roster, addrs = self._snapshot()
+        ranks = roster["ranks"] if roster else list(range(self.world_size))
+        missing = [r for r in ranks if r != self.rank and r not in addrs]
+        if len(ranks) < 2 or missing:
+            return self.reducescatter(value, op)
+        from ray_tpu._private import worker_context
+        from ray_tpu.util.collective.p2p import group_reducescatter
+
+        cw = worker_context.get_core_worker()
+        return group_reducescatter(
+            cw, self.gcs, self.group_name, self.rank, self.world_size, tag,
+            value, op=op, member_addrs=addrs, timeout=timeout,
+            finalize=lambda shard: self._finalize_like(value, shard),
+            roster=roster,
+        )
+
     def bcast_recv_payload(self, src_rank: int, tag: str, timeout: float = 120.0):
         """Member-side receive of a group broadcast (direct mailbox, GCS
         fallback, typed timeout naming group/rank/tag). A concurrent

@@ -584,6 +584,8 @@ class _CollStats:
         "reduce_sends",       # tree-reduce participations by this process
         "reduce_bytes",       # partial-combine bytes pushed up the tree
         "allreduces",         # allreduce participations (reduce + down-broadcast)
+        "reducescatters",     # reduce-scatter participations (reduce + shard fan-out)
+        "scatter_bytes",      # serialized shard bytes the root pushed to members
         "host_sync_fallbacks",  # group members that resolved a broadcast payload
                                 # via the pull path (off the fast path: the
                                 # elastic-roster degradation signal)
@@ -1622,3 +1624,81 @@ def group_allreduce(
             )
         return out
     return group_bcast_recv(cw, gcs, group_name, root, my_rank, down_tag, timeout)
+
+
+def scatter_key(group_name: str, tag: str, dst_rank: str | int) -> str:
+    """Inbox key of ONE member's reduce-scatter shard. Rank-scoped like
+    :func:`reduce_key` (every member gets a DIFFERENT shard, so there is no
+    shared-frame encoding to exploit, unlike broadcast)."""
+    return f"collscat/{group_name}/{tag}/{dst_rank}"
+
+
+@blocking
+def group_reducescatter(
+    cw,
+    gcs,
+    group_name: str,
+    my_rank: int,
+    world_size: int,
+    tag: str,
+    value,
+    op: ReduceOp = ReduceOp.SUM,
+    member_addrs: dict | None = None,
+    timeout: float = 60.0,
+    finalize=None,
+    roster: dict | None = None,
+):
+    """Tree reduce-scatter: combine every member's tensor up the binomial
+    tree to the root (lowest roster rank), which slices axis 0 into one
+    shard per member and pushes each member ITS shard over the direct
+    mailbox — each rank moves the full tensor up at most once and receives
+    exactly 1/K of the result, vs the GCS ring where every rank posts the
+    full tensor to the KV and downloads K of them. Semantics match the ring
+    ``reducescatter``: the leading dimension must equal the member count,
+    and the rank at sorted-roster position ``i`` returns reduced slice
+    ``i``. ``finalize`` (optional) runs per-shard ON THE ROOT before the
+    fan-out, so placement is decided once (allreduce's contract). The shard
+    frames are fire-and-forget; a lost one surfaces as a typed
+    CollectiveTimeoutError on the receiver NAMING the root. ``roster``
+    restricts the op to the current epoch's member set."""
+    from ray_tpu._private import serialization
+    from ray_tpu.exceptions import CollectiveError, CollectiveTimeoutError
+
+    member_ranks = sorted(roster["ranks"]) if roster else list(range(world_size))
+    k = len(member_ranks)
+    shape0 = getattr(value, "shape", (None,))[0] if hasattr(value, "shape") else None
+    if shape0 != k:
+        raise CollectiveError(
+            f"reducescatter on group {group_name!r} needs leading dimension "
+            f"== member count {k}, got shape {getattr(value, 'shape', '?')}"
+        )
+    root = member_ranks[0]
+    red = group_reduce_send(
+        cw, gcs, group_name, my_rank, world_size, tag, value,
+        op=op, dst_rank=root, member_addrs=member_addrs, timeout=timeout,
+        roster=roster,
+    )
+    COLL.reducescatters += 1
+    if my_rank != root:
+        data = direct_recv(cw, scatter_key(group_name, tag, my_rank), timeout=timeout)
+        if data is None:
+            COLL.timeouts += 1
+            raise CollectiveTimeoutError(
+                f"reducescatter on group {group_name!r} tag {tag!r}: rank "
+                f"{my_rank} received no shard from root rank {root} within "
+                f"{timeout}s",
+                group=group_name, ranks=[root], tag=tag,
+            )
+        return serialization.loads(data)
+    if member_addrs is None:
+        member_addrs = fetch_member_addrs(gcs, group_name, world_size, ranks=member_ranks)
+    shards = [red[pos] for pos in range(k)]
+    if finalize is not None:
+        shards = [finalize(s) for s in shards]
+    for pos, rank in enumerate(member_ranks):
+        if rank == root:
+            continue
+        data = serialization.dumps(shards[pos])
+        direct_send(cw, tuple(member_addrs[rank]), scatter_key(group_name, tag, rank), data)
+        COLL.scatter_bytes += len(data)
+    return shards[0]  # root is position 0: the lowest roster rank

@@ -43,6 +43,12 @@ def _contribution(rank: int, n: int) -> np.ndarray:
     return ((np.arange(n) % 97) + 3.0 * rank).astype(np.float32)
 
 
+def _scatter_input(rank: int, k: int, n: int) -> np.ndarray:
+    """Reduce-scatter input: leading dim == member count, every (rank,
+    slice) cell distinct, still integer-valued float32 (exact SUM)."""
+    return ((np.arange(k * n).reshape(k, n) % 97) + 3.0 * rank).astype(np.float32)
+
+
 @ray_tpu.remote
 class Red:
     """One reduce-group member: joins groups and runs the payload verbs."""
@@ -86,6 +92,32 @@ class Red:
         g = col.get_group(group_name)
         v = _contribution(g.rank, n)
         out = g.allreduce_payload(jnp.asarray(v) if as_jax else v, tag)
+        return type(out).__name__, isinstance(out, jax.Array)
+
+    def tree_reducescatter(self, group_name, tag, k, n, op="SUM"):
+        from ray_tpu.util import collective as col
+        from ray_tpu.util.collective.types import ReduceOp
+
+        g = col.get_group(group_name)
+        out = g.reducescatter_payload(_scatter_input(g.rank, k, n), tag, op=ReduceOp[op])
+        return np.asarray(out)
+
+    def ring_reducescatter(self, group_name, k, n, op="SUM"):
+        from ray_tpu.util import collective as col
+        from ray_tpu.util.collective.types import ReduceOp
+
+        g = col.get_group(group_name)
+        return np.asarray(g.reducescatter(_scatter_input(g.rank, k, n), op=ReduceOp[op]))
+
+    def tree_reducescatter_typed(self, group_name, tag, k, n, as_jax):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.util import collective as col
+
+        g = col.get_group(group_name)
+        v = _scatter_input(g.rank, k, n)
+        out = g.reducescatter_payload(jnp.asarray(v) if as_jax else v, tag)
         return type(out).__name__, isinstance(out, jax.Array)
 
     def coll_stats(self):
@@ -203,6 +235,70 @@ def test_tree_allreduce_placement_parity(red_cluster):
     )
     for name, is_jax in np_outs:
         assert not is_jax, name  # np in -> np out (no surprise device hop)
+
+
+# ---------------------------------------------------------------------------
+# reduce-scatter (ISSUE 20 satellite): tree verb == flat ring oracle
+# ---------------------------------------------------------------------------
+
+
+def test_tree_reducescatter_bit_exact_vs_ring_oracle(red_cluster):
+    actors = [Red.remote() for _ in range(5)]
+    # K=4 uses a multi-chunk payload so chunk-wise combine on the reduce leg
+    # is on the oracle path; the odd K=5 covers a non-power-of-two tree.
+    for k, n in [(2, 4096), (4, 48 * 1024 + 7), (5, 2048)]:
+        group = f"scat{k}"
+        gang = actors[:k]
+        ray_tpu.get(
+            [a.init_collective.remote(k, i, "cpu", group) for i, a in enumerate(gang)],
+            timeout=60,
+        )
+        full = np.sum(
+            [_scatter_input(r, k, n) for r in range(k)], axis=0, dtype=np.float64
+        ).astype(np.float32)
+        # np.array: gets deserialize zero-copy over shm, and per-rank
+        # DIFFERENT payloads must be materialized before the next round of
+        # gets can recycle the arena pages under them (the allreduce oracle
+        # never notices — every rank's output there is identical bytes).
+        tree = [
+            np.array(t)
+            for t in ray_tpu.get(
+                [a.tree_reducescatter.remote(group, f"s{k}", k, n) for a in gang],
+                timeout=120,
+            )
+        ]
+        for rank, out in enumerate(tree):
+            # Rank i gets reduced slice i, bit-for-bit.
+            np.testing.assert_array_equal(out, full[rank], err_msg=f"K={k} rank={rank}")
+        ring = ray_tpu.get(
+            [a.ring_reducescatter.remote(group, k, n) for a in gang], timeout=120
+        )
+        for rank, out in enumerate(ring):
+            np.testing.assert_array_equal(out, tree[rank], err_msg=f"K={k} rank={rank}")
+    stats = ray_tpu.get(actors[0].coll_stats.remote(), timeout=30)
+    assert stats["reducescatters"] >= 3, stats
+    assert stats["scatter_bytes"] > 0, stats  # rank 0 is always the root
+
+
+def test_tree_reducescatter_placement_parity(red_cluster):
+    actors = [Red.remote() for _ in range(2)]
+    group = "scatplace2"
+    ray_tpu.get(
+        [a.init_collective.remote(2, i, "cpu", group) for i, a in enumerate(actors)],
+        timeout=60,
+    )
+    jax_outs = ray_tpu.get(
+        [a.tree_reducescatter_typed.remote(group, "sj", 2, 512, True) for a in actors],
+        timeout=60,
+    )
+    for _, is_jax in jax_outs:
+        assert is_jax  # jax in -> jax shard out on EVERY rank
+    np_outs = ray_tpu.get(
+        [a.tree_reducescatter_typed.remote(group, "sn", 2, 512, False) for a in actors],
+        timeout=60,
+    )
+    for name, is_jax in np_outs:
+        assert not is_jax, name
 
 
 # ---------------------------------------------------------------------------

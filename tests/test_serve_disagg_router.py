@@ -1,0 +1,334 @@
+"""Router/proxy unit tests for disaggregated serving (ISSUE 20) — no
+cluster: a bare ``Router(None)`` with a hand-fed table, and the proxy ASGI
+app driven directly with fake replica actors.
+
+Pins the drain satellite:
+- draining replicas are excluded from EVERY assignment policy (round-robin,
+  model_id affinity, prefix-affinity pin AND its least-depth spill);
+- a drain-refused assignment never burns one of the proxy's bounded
+  reassign retries (the bound exists for crashes, not polite refusals);
+and the disaggregation tentpole's proxy leg:
+- a paired ``<name>--prefill`` deployment reroutes the prefill leg and the
+  handoff envelope rewrites the decode-pool body;
+- any prefill-leg failure falls back to the decode pool recomputing —
+  never a client-visible error.
+"""
+
+import asyncio
+import json
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from types import SimpleNamespace
+
+import pytest
+
+from ray_tpu.exceptions import ActorDiedError, ReplicaDrainingError
+from ray_tpu.serve._private.asgi import ProxyASGIApp
+from ray_tpu.serve._private.router import Router
+
+
+def _bare_router(table):
+    r = Router(None)
+    r._table = table
+    return r
+
+
+def _replicas(names, max_q=8):
+    return [{"actor_name": n, "max_concurrent_queries": max_q} for n in names]
+
+
+# ---------------------------------------------------------------------------
+# router: draining exclusion in every policy
+# ---------------------------------------------------------------------------
+
+
+def test_draining_excluded_from_round_robin_and_model_affinity():
+    router = _bare_router(
+        {"dep": {"route_prefix": "/dep", "replicas": _replicas(["a", "b", "c"])}}
+    )
+    router.mark_draining("b")
+    picks = set()
+    for _ in range(9):
+        rep = router.assign_replica("dep", timeout_s=1)
+        picks.add(rep["actor_name"])
+        router.release(rep, deployment="dep")
+    assert picks == {"a", "c"}
+    # model_id affinity never lands on the draining replica either, for any
+    # model id (crc32 start point is arbitrary — sweep several).
+    for mid in ("m0", "m1", "m2", "m3", "m4"):
+        rep = router.assign_replica("dep", timeout_s=1, model_id=mid)
+        assert rep["actor_name"] != "b"
+        router.release(rep, deployment="dep")
+
+
+def test_draining_excluded_from_prefix_pin_and_spill():
+    router = _bare_router(
+        {"dep": {"route_prefix": "/dep", "replicas": _replicas(["a", "b", "c"], max_q=2)}}
+    )
+    # Find a hint that pins to "b", then drain "b": the pin must move, and
+    # with the pin target saturated the SPILL candidates must skip "b" too.
+    import zlib
+
+    hint = next(
+        h
+        for h in (f"hint{i}" for i in range(64))
+        if zlib.crc32(h.encode()) % 3 == 1
+    )
+    assert router.assign_replica("dep", prefix_hint=hint)["actor_name"] == "b"
+    router.release(router._table["dep"]["replicas"][1], deployment="dep")
+    router.mark_draining("b")
+    seen = set()
+    held = []
+    for _ in range(4):  # 2 slots each on a and c
+        rep = router.assign_replica("dep", timeout_s=1, prefix_hint=hint)
+        seen.add(rep["actor_name"])
+        held.append(rep)
+    assert seen == {"a", "c"}  # pin moved off b, spill filled a AND c
+    for rep in held:
+        router.release(rep, deployment="dep")
+
+
+def test_draining_ttl_expires_and_replica_returns():
+    router = _bare_router(
+        {"dep": {"route_prefix": "/dep", "replicas": _replicas(["a", "b"])}}
+    )
+    router.mark_draining("a", ttl_s=0.2)
+    assert router.is_draining("a")
+    for _ in range(4):
+        rep = router.assign_replica("dep", timeout_s=1)
+        assert rep["actor_name"] == "b"
+        router.release(rep, deployment="dep")
+    time.sleep(0.25)
+    assert not router.is_draining("a")
+    picks = set()
+    for _ in range(4):
+        rep = router.assign_replica("dep", timeout_s=1)
+        picks.add(rep["actor_name"])
+        router.release(rep, deployment="dep")
+    assert picks == {"a", "b"}  # back in rotation after the TTL
+
+
+def test_all_draining_parks_until_one_recovers():
+    """Every replica draining: assign parks (no busy-fail) and completes as
+    soon as a drain verdict expires — the rolling-restart steady state."""
+    router = _bare_router(
+        {"dep": {"route_prefix": "/dep", "replicas": _replicas(["a", "b"])}}
+    )
+    router.mark_draining("a", ttl_s=0.3)
+    router.mark_draining("b", ttl_s=10.0)
+    got = {}
+
+    def assign():
+        got["r"] = router.assign_replica("dep", timeout_s=5)
+
+    t = threading.Thread(target=assign)
+    t.start()
+    t.join(timeout=5)
+    assert got["r"]["actor_name"] == "a"
+
+
+# ---------------------------------------------------------------------------
+# proxy: fake-actor harness (no cluster)
+# ---------------------------------------------------------------------------
+
+
+class _FakeActor:
+    """Stands in for a replica handle: ``handle_http_request.remote`` runs
+    the behavior synchronously and the monkeypatched ``ray_tpu.get`` below
+    passes its return value straight through."""
+
+    def __init__(self, fn):
+        self.handle_http_request = SimpleNamespace(remote=fn)
+
+    def cancel_stream(self, *a, **k):  # pragma: no cover - teardown path
+        return SimpleNamespace(remote=lambda *a2, **k2: None)
+
+
+def _drive(app, path, body):
+    """Run one POST through the proxy ASGI app; returns (status, body bytes)."""
+
+    async def go():
+        sent = {"status": None, "chunks": []}
+        delivered = [False]
+
+        async def receive():
+            if not delivered[0]:
+                delivered[0] = True
+                return {"type": "http.request", "body": body, "more_body": False}
+            return {"type": "http.disconnect"}
+
+        async def send(ev):
+            if ev["type"] == "http.response.start":
+                sent["status"] = ev["status"]
+            elif ev["type"] == "http.response.body":
+                sent["chunks"].append(ev.get("body", b""))
+
+        scope = {
+            "type": "http",
+            "method": "POST",
+            "path": path,
+            "query_string": b"",
+            "headers": [],
+        }
+        await app(scope, receive, send)
+        return sent["status"], b"".join(sent["chunks"])
+
+    return asyncio.run(go())
+
+
+@pytest.fixture
+def proxy_env(monkeypatch):
+    """(router, actors, pool) with ray_tpu.get pass-through and handle_for
+    resolving into the ``actors`` dict."""
+    import ray_tpu
+
+    monkeypatch.setattr(ray_tpu, "get", lambda ref, timeout=None: ref)
+    actors: dict = {}
+    router = _bare_router({})
+    router.handle_for = lambda replica: actors[replica["actor_name"]]
+    router.invalidate_handle = lambda replica: None
+    pool = ThreadPoolExecutor(max_workers=2)
+    yield router, actors, pool
+    pool.shutdown(wait=False)
+
+
+def test_drain_refusal_never_burns_the_reassign_retry(proxy_env):
+    """The request hits a draining replica (refusal), THEN a corpse, and
+    still lands on the healthy survivor. The old accounting burned the
+    single bounded retry on the drain refusal and 500'd the client on the
+    corpse; drain refusals must not count. (Round-robin walks the filtered
+    list, so the visit order after excluding r0 is r2 then r1.)"""
+    router, actors, pool = proxy_env
+    router._table = {
+        "dep": {"route_prefix": "/dep", "replicas": _replicas(["r0", "r1", "r2"])}
+    }
+    router._rr["dep"] = 0
+    calls = []
+
+    def refuse(*a):
+        calls.append("r0")
+        raise ReplicaDrainingError(replica_id="r0")
+
+    def die(*a):
+        calls.append("r2")
+        raise ActorDiedError("r2 died")
+
+    def ok(*a):
+        calls.append("r1")
+        return {"pong": True}
+
+    actors.update(
+        {"r0": _FakeActor(refuse), "r1": _FakeActor(ok), "r2": _FakeActor(die)}
+    )
+    status, out = _drive(ProxyASGIApp(router, pool), "/dep", b"{}")
+    assert status == 200 and json.loads(out) == {"pong": True}
+    assert calls == ["r0", "r2", "r1"]
+    # The refusal also poisoned r0 for future assignments on this router.
+    assert router.is_draining("r0") and not router.is_draining("r1")
+    # No leaked queue slots on any arm.
+    assert all(v == 0 for v in router._inflight.values()), router._inflight
+
+
+def test_prefill_handoff_rewrites_decode_body(proxy_env):
+    """A paired --prefill deployment gets the prefill leg; the decode pool
+    receives the envelope body + resume_tokens + kv_import + echo_resume."""
+    router, actors, pool = proxy_env
+    router._table = {
+        "llm": {"route_prefix": "/llm", "replicas": _replicas(["dec0"])},
+        "llm--prefill": {"route_prefix": None, "replicas": _replicas(["pre0"])},
+    }
+    desc = {"oid": "ab" * 14, "addr": ["n", 1], "nbytes": 128, "kv_pos": 4,
+            "blocks": 1, "block_size": 4}
+    orig = {"tokens": [1, 2, 3, 4], "max_new_tokens": 3, "stream": False,
+            "seed": 7}
+    seen = {}
+
+    def prefill(method, path, query, body, *rest):
+        seen["prefill_body"] = json.loads(body)
+        return {
+            "__llm_handoff__": {
+                "kv_import": desc,
+                "resume_tokens": [42],
+                "body": dict(orig),
+            }
+        }
+
+    def decode(method, path, query, body, *rest):
+        seen["decode_body"] = json.loads(body)
+        return {"tokens": [42, 5, 6]}
+
+    actors.update({"pre0": _FakeActor(prefill), "dec0": _FakeActor(decode)})
+    status, out = _drive(ProxyASGIApp(router, pool), "/llm",
+                         json.dumps(orig).encode())
+    assert status == 200 and json.loads(out) == {"tokens": [42, 5, 6]}
+    assert seen["prefill_body"] == orig  # prefill saw the original request
+    assert seen["decode_body"] == dict(
+        orig, resume_tokens=[42], kv_import=desc, echo_resume=True
+    )
+    assert all(v == 0 for v in router._inflight.values()), router._inflight
+
+
+def test_prefill_pool_failure_falls_back_to_decode_recompute(proxy_env):
+    """Prefill replica dead + its retry refused by a draining sibling: the
+    decode pool gets the ORIGINAL body (recompute), client sees no error."""
+    router, actors, pool = proxy_env
+    router._table = {
+        "llm": {"route_prefix": "/llm", "replicas": _replicas(["dec0"])},
+        "llm--prefill": {"route_prefix": None, "replicas": _replicas(["pre0", "pre1"])},
+    }
+    router._rr["llm--prefill"] = 0
+    orig = {"tokens": [9, 8, 7], "stream": False}
+    seen = {}
+
+    def pre_die(*a):
+        raise ActorDiedError("pre0 died")
+
+    def pre_drain(*a):
+        raise ReplicaDrainingError(replica_id="pre1")
+
+    def decode(method, path, query, body, *rest):
+        seen["decode_body"] = json.loads(body)
+        return {"tokens": [1]}
+
+    actors.update({
+        "pre0": _FakeActor(pre_die),
+        "pre1": _FakeActor(pre_drain),
+        "dec0": _FakeActor(decode),
+    })
+    status, out = _drive(ProxyASGIApp(router, pool), "/llm",
+                         json.dumps(orig).encode())
+    assert status == 200 and json.loads(out) == {"tokens": [1]}
+    assert seen["decode_body"] == orig  # untouched original body
+    assert all(v == 0 for v in router._inflight.values()), router._inflight
+
+
+def test_non_llm_posts_skip_the_prefill_leg(proxy_env):
+    """A paired prefill pool must not tax unrelated POSTs on the decode
+    route: no 'tokens' key (or an existing resume) goes straight through."""
+    router, actors, pool = proxy_env
+    router._table = {
+        "llm": {"route_prefix": "/llm", "replicas": _replicas(["dec0"])},
+        "llm--prefill": {"route_prefix": None, "replicas": _replicas(["pre0"])},
+    }
+    prefill_calls = []
+
+    def prefill(*a):  # pragma: no cover - must never run
+        prefill_calls.append(1)
+        return {}
+
+    bodies = []
+
+    def decode(method, path, query, body, *rest):
+        bodies.append(json.loads(body))
+        return {"ok": True}
+
+    actors.update({"pre0": _FakeActor(prefill), "dec0": _FakeActor(decode)})
+    app = ProxyASGIApp(router, pool)
+    for body in ({"not_llm": 1},
+                 {"tokens": [1], "resume_tokens": [2], "stream": False}):
+        status, out = _drive(app, "/llm", json.dumps(body).encode())
+        assert status == 200 and json.loads(out) == {"ok": True}
+    assert prefill_calls == []
+    assert bodies == [{"not_llm": 1},
+                      {"tokens": [1], "resume_tokens": [2], "stream": False}]
